@@ -1,0 +1,32 @@
+"""Test configuration: fake an 8-device TPU mesh on CPU.
+
+Mirrors the reference's local-mode Spark "fake cluster" test strategy
+(utils/.../test/TestSparkContext.scala:36-80): distributed semantics are
+exercised on a single host — here via XLA's virtual CPU devices.
+Must set flags before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_uids():
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,170 @@
+"""Tests for the semantic type system, columns, features and DAG layering.
+
+Parity model: reference FeatureTypeTest / FeatureBuilderTest / FeatureLikeTest
+(features/src/test/scala/com/salesforce/op/features/).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import ColumnarDataset, FeatureColumn
+from transmogrifai_tpu.features import Feature, FeatureBuilder, FeatureCycleError
+from transmogrifai_tpu.stages.base import LambdaTransformer
+from transmogrifai_tpu.workflow.dag import compute_dag
+
+
+class TestFeatureTypes:
+    def test_registry_has_all_35_plus_types(self):
+        names = {t.type_name() for t in ft.all_feature_types()}
+        expected = {
+            "Real", "RealNN", "Binary", "Integral", "Percent", "Currency",
+            "Date", "DateTime", "Text", "Email", "Base64", "Phone", "ID",
+            "URL", "TextArea", "PickList", "ComboBox", "Country", "State",
+            "PostalCode", "City", "Street", "TextList", "DateList",
+            "DateTimeList", "MultiPickList", "OPVector", "Geolocation",
+            "TextMap", "EmailMap", "PhoneMap", "IDMap", "URLMap",
+            "PickListMap", "RealMap", "IntegralMap", "BinaryMap",
+            "MultiPickListMap", "GeolocationMap", "Prediction", "NameStats",
+        }
+        assert expected <= names
+
+    def test_nullability_in_type(self):
+        assert ft.Real.is_nullable()
+        assert not ft.RealNN.is_nullable()
+        assert not ft.Prediction.is_nullable()
+
+    def test_traits(self):
+        assert issubclass(ft.RealNN, ft.SingleResponse)
+        assert issubclass(ft.PickList, ft.Categorical)
+        assert issubclass(ft.Country, ft.Location)
+        assert issubclass(ft.MultiPickList, ft.MultiResponse)
+
+    def test_type_by_name_roundtrip(self):
+        for t in ft.all_feature_types():
+            assert ft.type_by_name(t.type_name()) is t
+
+    def test_prediction_keys(self):
+        keys = ft.Prediction.keys_for(2)
+        assert keys == ["prediction", "rawPrediction_0", "rawPrediction_1",
+                        "probability_0", "probability_1"]
+
+
+class TestFeatureColumn:
+    def test_real_column_mask(self):
+        c = FeatureColumn.from_values(ft.Real, [1.0, None, 3.5])
+        assert c.mask.tolist() == [True, False, True]
+        assert c.to_list() == [1.0, None, 3.5]
+
+    def test_integral_column(self):
+        c = FeatureColumn.from_values(ft.Integral, [1, None, 3])
+        assert c.to_list() == [1, None, 3]
+
+    def test_binary_column(self):
+        c = FeatureColumn.from_values(ft.Binary, [True, None, False])
+        assert c.to_list() == [True, None, False]
+
+    def test_text_column(self):
+        c = FeatureColumn.from_values(ft.Text, ["a", None, ""])
+        assert c.to_list() == ["a", None, None]  # empty string = missing
+
+    def test_picklist_column(self):
+        c = FeatureColumn.from_values(ft.PickList, ["x", "y", None])
+        assert c.to_list() == ["x", "y", None]
+
+    def test_multipicklist(self):
+        c = FeatureColumn.from_values(ft.MultiPickList, [{"a", "b"}, None])
+        assert c.to_list()[0] == frozenset({"a", "b"})
+        assert c.to_list()[1] == frozenset()
+
+    def test_geolocation(self):
+        c = FeatureColumn.from_values(ft.Geolocation, [[1.0, 2.0, 3.0], None])
+        assert c.mask.tolist() == [True, False]
+
+    def test_map_column(self):
+        c = FeatureColumn.from_values(ft.RealMap, [{"a": 1.0}, None])
+        assert c.to_list() == [{"a": 1.0}, {}]
+
+    def test_masked_values_fill(self):
+        c = FeatureColumn.from_values(ft.Real, [1.0, None])
+        assert c.masked_values(fill=-1.0).tolist() == [1.0, -1.0]
+
+    def test_dataset_ragged_rejected(self):
+        a = FeatureColumn.from_values(ft.Real, [1.0, 2.0])
+        b = FeatureColumn.from_values(ft.Real, [1.0])
+        with pytest.raises(ValueError):
+            ColumnarDataset({"a": a, "b": b})
+
+    def test_dataset_pandas_roundtrip(self):
+        df = pd.DataFrame({"x": [1.0, None], "s": ["a", None]})
+        ds = ColumnarDataset.from_pandas(df, {"x": ft.Real, "s": ft.Text})
+        back = ds.to_pandas()
+        assert back["x"].tolist()[0] == 1.0
+        assert back["s"].tolist()[0] == "a"
+        assert back["s"].isna().tolist() == [False, True]
+
+
+class TestFeatureBuilder:
+    def test_typed_builder(self):
+        age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+        assert age.name == "age"
+        assert age.ftype is ft.Real
+        assert not age.is_response
+        assert age.is_raw
+
+    def test_response_type_check(self):
+        with pytest.raises(TypeError):
+            FeatureBuilder.Text("t").as_response()
+
+    def test_from_dataframe_inference(self):
+        df = pd.DataFrame({
+            "label": [1.0, 0.0] * 10,
+            "age": [20.5, None] * 10,
+            "count": list(range(20)),
+            "flag": [True, False] * 10,
+            "cat": ["a", "b"] * 10,
+        })
+        resp, preds = FeatureBuilder.from_dataframe(df, response="label")
+        assert resp.ftype is ft.RealNN and resp.is_response
+        types = {f.name: f.ftype for f in preds}
+        assert types["age"] is ft.Real
+        assert types["count"] is ft.Integral
+        assert types["flag"] is ft.Binary
+        assert types["cat"] is ft.PickList
+
+
+class TestFeatureDAG:
+    def test_transform_with_and_raw_features(self):
+        x = FeatureBuilder.Real("x").as_predictor()
+        doubled = x.transform_with(
+            LambdaTransformer(lambda c: c, output_type=ft.Real, operation_name="dbl")
+        )
+        assert doubled.parents == [x]
+        assert [f.name for f in doubled.raw_features()] == ["x"]
+        assert len(doubled.parent_stages()) == 2  # generator + lambda
+
+    def test_dag_layering(self):
+        x = FeatureBuilder.Real("x").as_predictor()
+        y = FeatureBuilder.Real("y").as_predictor()
+        s1 = LambdaTransformer(lambda c: c, ft.Real, "a")
+        s2 = LambdaTransformer(lambda c: c, ft.Real, "b")
+        f1 = x.transform_with(s1)
+        f2 = f1.transform_with(s2)
+        dag = compute_dag([f2, y])
+        sizes = [len(l) for l in dag.layers]
+        assert sizes == [2, 1, 1]  # [genX, genY], [s1], [s2]
+
+    def test_cycle_detection(self):
+        x = FeatureBuilder.Real("x").as_predictor()
+        s = LambdaTransformer(lambda c: c, ft.Real, "a")
+        f = x.transform_with(s)
+        f.parents.append(f)  # deliberately corrupt
+        with pytest.raises(FeatureCycleError):
+            f.raw_features()
+
+    def test_history(self):
+        x = FeatureBuilder.Real("x").as_predictor()
+        f = x.transform_with(LambdaTransformer(lambda c: c, ft.Real, "op"))
+        h = f.history()
+        assert h.origin_features == ["x"]
+        assert len(h.stages) == 2

@@ -1,0 +1,2 @@
+from .feature import Feature, FeatureCycleError, FeatureHistory  # noqa: F401
+from .builder import FeatureBuilder, infer_schema_from_pandas  # noqa: F401

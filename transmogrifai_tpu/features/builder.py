@@ -1,0 +1,152 @@
+"""FeatureBuilder — typed construction of raw features.
+
+Reference: features/FeatureBuilder.scala:51,193-330 —
+``FeatureBuilder.Real[Passenger].extract(_.age).asPredictor`` plus
+``FeatureBuilder.fromDataFrame`` which derives typed features from a DataFrame
+schema, picking the response by name.
+
+Python shape:
+
+    age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    features, label = FeatureBuilder.from_dataframe(df, response="Survived")
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..stages.generator import FeatureGeneratorStage
+from ..types import feature_types as ft
+from ..types.feature_types import FeatureType
+from .feature import Feature
+
+__all__ = ["FeatureBuilder", "infer_schema_from_pandas"]
+
+
+class _TypedFeatureBuilder:
+    def __init__(self, ftype: Type[FeatureType], name: str):
+        self.ftype = ftype
+        self.name = name
+        self._extract_fn: Optional[Callable[[Any], Any]] = None
+        self._aggregator: Optional[str] = None
+        self._window_ms: Optional[int] = None
+
+    def extract(self, fn: Callable[[Any], Any]) -> "_TypedFeatureBuilder":
+        self._extract_fn = fn
+        return self
+
+    def aggregate(self, aggregator: str) -> "_TypedFeatureBuilder":
+        """Set a registered monoid aggregator name (FeatureBuilder.aggregate)."""
+        self._aggregator = aggregator
+        return self
+
+    def window(self, window_ms: int) -> "_TypedFeatureBuilder":
+        self._window_ms = window_ms
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        stage = FeatureGeneratorStage(
+            name=self.name,
+            output_type=self.ftype,
+            extract_fn=self._extract_fn,
+            is_response=is_response,
+            aggregator=self._aggregator,
+            aggregate_window_ms=self._window_ms,
+        )
+        return stage.get_output()
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        if not issubclass(self.ftype, (ft.SingleResponse, ft.MultiResponse)):
+            raise TypeError(
+                f"{self.ftype.type_name()} cannot be a response feature"
+            )
+        return self._build(is_response=True)
+
+
+class _FeatureBuilderMeta(type):
+    """Provides ``FeatureBuilder.Real("x")`` etc. for every registered type."""
+
+    def __getattr__(cls, type_name: str):
+        try:
+            ftype = ft.type_by_name(type_name)
+        except KeyError as e:
+            raise AttributeError(type_name) from e
+
+        def make(name: str) -> _TypedFeatureBuilder:
+            return _TypedFeatureBuilder(ftype, name)
+
+        return make
+
+
+class FeatureBuilder(metaclass=_FeatureBuilderMeta):
+    """Entry point: ``FeatureBuilder.<TypeName>(name)`` or ``from_dataframe``."""
+
+    @staticmethod
+    def of(ftype: Type[FeatureType], name: str) -> _TypedFeatureBuilder:
+        return _TypedFeatureBuilder(ftype, name)
+
+    @staticmethod
+    def from_schema(
+        schema: Dict[str, Type[FeatureType]],
+        response: str,
+        response_type: Type[FeatureType] = ft.RealNN,
+    ) -> Tuple[Feature, List[Feature]]:
+        """Build (response, predictors) from {name: type}.
+
+        Reference FeatureBuilder.fromSchema/fromDataFrame
+        (features/FeatureBuilder.scala:193-246).
+        """
+        if response not in schema:
+            raise ValueError(f"response column {response!r} not in schema")
+        resp = _TypedFeatureBuilder(response_type, response).as_response()
+        preds = [
+            _TypedFeatureBuilder(t, n).as_predictor()
+            for n, t in schema.items()
+            if n != response
+        ]
+        return resp, preds
+
+    @staticmethod
+    def from_dataframe(
+        df,
+        response: str,
+        response_type: Type[FeatureType] = ft.RealNN,
+        overrides: Optional[Dict[str, Type[FeatureType]]] = None,
+    ) -> Tuple[Feature, List[Feature]]:
+        schema = infer_schema_from_pandas(df)
+        if overrides:
+            schema.update(overrides)
+        return FeatureBuilder.from_schema(schema, response, response_type)
+
+
+def infer_schema_from_pandas(df) -> Dict[str, Type[FeatureType]]:
+    """Map pandas dtypes -> semantic types (conservative defaults).
+
+    Heuristics mirror ``FeatureSparkTypes.featureTypeTagOf``: ints -> Integral,
+    floats -> Real, bools -> Binary, datetimes -> DateTime, low-cardinality
+    strings -> PickList, other strings -> Text.
+    """
+    schema: Dict[str, Type[FeatureType]] = {}
+    n = max(len(df), 1)
+    for name in df.columns:
+        s = df[name]
+        kind = s.dtype.kind
+        if kind == "b":
+            schema[name] = ft.Binary
+        elif kind in ("i", "u"):
+            nunique = s.nunique(dropna=True)
+            schema[name] = ft.Binary if nunique <= 2 and set(
+                s.dropna().unique()
+            ) <= {0, 1} else ft.Integral
+        elif kind == "f":
+            schema[name] = ft.Real
+        elif kind == "M":
+            schema[name] = ft.DateTime
+        else:
+            nunique = s.nunique(dropna=True)
+            schema[name] = ft.PickList if nunique <= max(50, 0.1 * n) else ft.Text
+    return schema

@@ -1,0 +1,203 @@
+"""Lazy feature DAG nodes.
+
+Reference: ``FeatureLike``/``Feature`` (features/FeatureLike.scala:48,
+features/Feature.scala:52).  A ``Feature`` is a *lazy* handle: it records which
+stage produces it and from which parent features; no data is attached.  The
+workflow reconstructs the full stage DAG from result features by walking
+parents (OpWorkflow.setStagesDAG, OpWorkflow.scala:208).
+
+Cycle detection parity: features/FeatureCycleException.scala.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Sequence, Set, Tuple, Type
+
+from ..types.feature_types import FeatureType, OPVector
+from ..utils.uid import uid_for
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..stages.base import PipelineStage
+
+__all__ = ["Feature", "FeatureCycleError", "FeatureHistory"]
+
+
+class FeatureCycleError(Exception):
+    """Raised when the feature graph contains a cycle (reference FeatureCycleException)."""
+
+
+class FeatureHistory:
+    """Provenance of a feature: origin raw features + stage path.
+
+    Reference: utils/.../op/FeatureHistory.scala.
+    """
+
+    def __init__(self, origin_features: Sequence[str], stages: Sequence[str]):
+        self.origin_features = sorted(set(origin_features))
+        self.stages = list(stages)
+
+    def merge(self, other: "FeatureHistory") -> "FeatureHistory":
+        return FeatureHistory(
+            self.origin_features + other.origin_features,
+            list(dict.fromkeys(self.stages + other.stages)),
+        )
+
+    def to_json(self) -> dict:
+        return {"originFeatures": self.origin_features, "stages": self.stages}
+
+
+class Feature:
+    """A typed node in the feature DAG.
+
+    ``origin_stage`` is None for raw features only after deserialization
+    corner-cases; normally raw features point at their ``FeatureGeneratorStage``
+    (reference Feature.scala:52 — raw features still have an origin stage).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        ftype: Type[FeatureType],
+        is_response: bool = False,
+        origin_stage: Optional["PipelineStage"] = None,
+        parents: Sequence["Feature"] = (),
+        uid: Optional[str] = None,
+    ):
+        self.name = name
+        self.ftype = ftype
+        self.is_response = bool(is_response)
+        self.origin_stage = origin_stage
+        self.parents: List[Feature] = list(parents)
+        self.uid = uid or uid_for("Feature")
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def is_raw(self) -> bool:
+        from ..stages.generator import FeatureGeneratorStage
+
+        return self.origin_stage is None or isinstance(
+            self.origin_stage, FeatureGeneratorStage
+        )
+
+    def traverse(self, visit: Callable[["Feature"], None]) -> None:
+        """DFS over ancestors with cycle detection (FeatureLike.traverse :309)."""
+        on_path: Set[int] = set()
+        seen: Set[int] = set()
+
+        def rec(f: "Feature"):
+            if id(f) in on_path:
+                raise FeatureCycleError(
+                    f"cycle detected in feature graph at {f.name!r}"
+                )
+            if id(f) in seen:
+                return
+            on_path.add(id(f))
+            visit(f)
+            for p in f.parents:
+                rec(p)
+            on_path.discard(id(f))
+            seen.add(id(f))
+
+        rec(self)
+
+    def raw_features(self) -> List["Feature"]:
+        """All raw ancestor features (FeatureLike.rawFeatures :345)."""
+        out: List[Feature] = []
+
+        def visit(f: Feature):
+            if f.is_raw:
+                out.append(f)
+
+        self.traverse(visit)
+        # dedupe by uid, stable order
+        seen: Set[str] = set()
+        uniq = []
+        for f in out:
+            if f.uid not in seen:
+                seen.add(f.uid)
+                uniq.append(f)
+        return uniq
+
+    def parent_stages(self) -> List["PipelineStage"]:
+        """All ancestor stages (FeatureLike.parentStages :360)."""
+        out: List["PipelineStage"] = []
+        seen: Set[str] = set()
+
+        def visit(f: Feature):
+            s = f.origin_stage
+            if s is not None and s.uid not in seen:
+                seen.add(s.uid)
+                out.append(s)
+
+        self.traverse(visit)
+        return out
+
+    def history(self) -> FeatureHistory:
+        raws = [f.name for f in self.raw_features()]
+        stages = [s.uid for s in self.parent_stages()]
+        return FeatureHistory(raws, stages)
+
+    # -- graph rewriting ----------------------------------------------------
+
+    def copy_with_new_stages(
+        self, stage_map: Dict[str, "PipelineStage"]
+    ) -> "Feature":
+        """Rebuild this feature's ancestry replacing stages by uid.
+
+        Used when substituting fitted models for estimators
+        (reference Feature.copyWithNewStages, Feature.scala:86).
+        """
+        cache: Dict[str, Feature] = {}
+
+        def rec(f: Feature) -> Feature:
+            if f.uid in cache:
+                return cache[f.uid]
+            new_parents = [rec(p) for p in f.parents]
+            stage = stage_map.get(f.origin_stage.uid, f.origin_stage) if f.origin_stage else None
+            nf = Feature(
+                f.name, f.ftype, f.is_response, stage, new_parents, uid=f.uid
+            )
+            cache[f.uid] = nf
+            return nf
+
+        return rec(self)
+
+    # -- typed combinators (DSL hooks attach more; see ops/dsl.py) ----------
+
+    def transform_with(self, stage: "PipelineStage", *others: "Feature") -> "Feature":
+        """Apply a stage to this (+ other) features, returning its output feature.
+
+        Reference FeatureLike.transformWith (:210-283).
+        """
+        stage.set_input(self, *others)
+        return stage.get_output()
+
+    # -- equality: by semantic ancestry, like FeatureLike.equals (:143) -----
+
+    def semantic_key(self) -> Tuple:
+        stage_key = self.origin_stage.uid if self.origin_stage else None
+        return (
+            self.name,
+            self.ftype.type_name(),
+            self.is_response,
+            stage_key,
+            tuple(p.semantic_key() for p in self.parents),
+        )
+
+    def __repr__(self):
+        return (
+            f"Feature(name={self.name!r}, type={self.ftype.type_name()}, "
+            f"response={self.is_response}, uid={self.uid!r})"
+        )
+
+    # -- serialization (FeatureJsonHelper parity) ---------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "typeName": self.ftype.type_name(),
+            "isResponse": self.is_response,
+            "uid": self.uid,
+            "originStage": self.origin_stage.uid if self.origin_stage else None,
+            "parents": [p.uid for p in self.parents],
+        }

@@ -1,0 +1,115 @@
+"""Date and geolocation vectorizers.
+
+Reference: ``DateToUnitCircleTransformer`` (impl/feature/DateToUnitCircleTransformer.scala)
+— projects a timestamp onto sin/cos of the chosen period(s) so cyclic time is
+linearly separable; ``DateListVectorizer`` modes; ``GeolocationVectorizer``
+(impl/feature/GeolocationVectorizer.scala) — fill with mean coordinates +
+null indicator.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..stages.base import SequenceEstimator, SequenceModel, SequenceTransformer
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import OPVector
+from .vector_metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
+from .vectorizers import _vec_column
+
+__all__ = ["DateToUnitCircleVectorizer", "GeolocationVectorizer",
+           "GeolocationVectorizerModel", "TIME_PERIODS"]
+
+_MS_PER_DAY = 86400000.0
+# period name -> ms wavelength
+TIME_PERIODS = {
+    "HourOfDay": 3600000.0 * 24,       # position within day
+    "DayOfWeek": _MS_PER_DAY * 7,
+    "DayOfMonth": _MS_PER_DAY * 30.4375,
+    "DayOfYear": _MS_PER_DAY * 365.25,
+}
+
+
+class DateToUnitCircleVectorizer(SequenceTransformer):
+    """Timestamp (ms) -> (sin, cos) per configured time period (stateless).
+
+    Default period HourOfDay matches the reference's
+    ``DateToUnitCircleTransformer`` default.
+    """
+
+    def __init__(self, time_periods: Sequence[str] = ("HourOfDay",),
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="dateToUnitCircle", output_type=OPVector, uid=uid)
+        self.time_periods = list(time_periods)
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        parts, meta = [], []
+        for f, c in zip(self.input_features, cols):
+            ms = np.nan_to_num(np.asarray(c.values, dtype=np.float64))
+            m = np.asarray(c.mask)
+            tname = f.ftype.type_name()
+            for period in self.time_periods:
+                wl = TIME_PERIODS[period]
+                theta = 2.0 * math.pi * ((ms % wl) / wl)
+                parts.append(np.where(m, np.sin(theta), 0.0))
+                parts.append(np.where(m, np.cos(theta), 0.0))
+                meta.append(VectorColumnMetadata(f.name, tname,
+                                                 descriptor_value=f"{period}_x"))
+                meta.append(VectorColumnMetadata(f.name, tname,
+                                                 descriptor_value=f"{period}_y"))
+            if self.track_nulls:
+                parts.append(~m)
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                 indicator_value=NULL_INDICATOR))
+        return _vec_column(np.stack(parts, axis=1), VectorMetadata("date_vec", meta))
+
+
+class GeolocationVectorizer(SequenceEstimator):
+    """(lat, lon, accuracy) -> filled triple + null indicator."""
+
+    def __init__(self, fill_with_mean: bool = True, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeo", output_type=OPVector, uid=uid)
+        self.fill_with_mean = fill_with_mean
+        self.track_nulls = track_nulls
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        fills = []
+        for c in cols:
+            m = np.asarray(c.mask)
+            if self.fill_with_mean and m.any():
+                fills.append(np.nan_to_num(
+                    np.asarray(c.values, dtype=np.float64)[m].mean(axis=0)
+                ).tolist())
+            else:
+                fills.append([0.0, 0.0, 0.0])
+        return GeolocationVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+
+
+class GeolocationVectorizerModel(SequenceModel):
+    def __init__(self, fills: List[List[float]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeo", output_type=OPVector, uid=uid)
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        parts, meta = [], []
+        for f, fill, c in zip(self.input_features, self.fills, cols):
+            vals = np.nan_to_num(np.asarray(c.values, dtype=np.float64))
+            m = np.asarray(c.mask)
+            filled = np.where(m[:, None], vals, np.asarray(fill)[None, :])
+            parts.append(filled)
+            tname = f.ftype.type_name()
+            for d in ("lat", "lon", "accuracy"):
+                meta.append(VectorColumnMetadata(f.name, tname,
+                                                 descriptor_value=d))
+            if self.track_nulls:
+                parts.append((~m)[:, None].astype(np.float64))
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                 indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1),
+                           VectorMetadata("geo_vec", meta))

@@ -1,0 +1,337 @@
+"""Map-typed feature vectorizers.
+
+Reference: ``OPMapVectorizer`` family — one vectorizer per map value type —
+plus ``TextMapPivotVectorizer`` and ``MultiPickListMapVectorizer``
+(core/.../impl/feature/OPMapVectorizer.scala, TextMapPivotVectorizer.scala).
+Map features hold {key -> value}; the estimator discovers the key set during
+fit (with allow/block lists) and each (map, key) pair becomes a column group
+vectorized like its scalar value type, with the key recorded as the
+``grouping`` in vector metadata.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..stages.base import SequenceEstimator, SequenceModel
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types import feature_types as ft
+from ..types.feature_types import OPVector
+from .vector_metadata import (
+    NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata, VectorMetadata,
+)
+from .vectorizers import _vec_column
+
+__all__ = ["NumericMapVectorizer", "NumericMapVectorizerModel",
+           "TextMapPivotVectorizer", "TextMapPivotVectorizerModel",
+           "MultiPickListMapVectorizer", "MultiPickListMapVectorizerModel",
+           "transmogrify_map_group"]
+
+
+def _discover_keys(col: FeatureColumn, allow: Optional[Sequence[str]],
+                   block: Sequence[str]) -> List[str]:
+    keys: Dict[str, None] = {}
+    for m in col.values:
+        for k in m:
+            keys.setdefault(k, None)
+    out = [k for k in keys if k not in set(block)]
+    if allow:
+        out = [k for k in out if k in set(allow)]
+    return sorted(out)
+
+
+class NumericMapVectorizer(SequenceEstimator):
+    """RealMap/IntegralMap/BinaryMap... -> per-key fill + null indicators."""
+
+    def __init__(self, fill_with_mean: bool = True, track_nulls: bool = True,
+                 allow_keys: Optional[List[str]] = None,
+                 block_keys: List[str] = (), uid: Optional[str] = None):
+        super().__init__(operation_name="vecNumMap", output_type=OPVector, uid=uid)
+        self.fill_with_mean = fill_with_mean
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+        self.block_keys = list(block_keys)
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        keysets, fills = [], []
+        for c in cols:
+            keys = _discover_keys(c, self.allow_keys, self.block_keys)
+            keysets.append(keys)
+            kf = {}
+            for k in keys:
+                vals = [float(m[k]) for m in c.values if k in m and m[k] is not None]
+                kf[k] = float(np.mean(vals)) if (vals and self.fill_with_mean) else 0.0
+            fills.append(kf)
+        return NumericMapVectorizerModel(keysets=keysets, fills=fills,
+                                         track_nulls=self.track_nulls)
+
+
+class NumericMapVectorizerModel(SequenceModel):
+    def __init__(self, keysets: List[List[str]], fills: List[Dict[str, float]],
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecNumMap", output_type=OPVector, uid=uid)
+        self.keysets = keysets
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        parts, meta = [], []
+        for f, keys, kf, c in zip(self.input_features, self.keysets,
+                                  self.fills, cols):
+            tname = f.ftype.type_name()
+            width = len(keys) * (2 if self.track_nulls else 1)
+            block = np.zeros((n, width), dtype=np.float32)
+            for j, k in enumerate(keys):
+                base = j * (2 if self.track_nulls else 1)
+                fill = kf.get(k, 0.0)
+                for row, m in enumerate(c.values):
+                    v = m.get(k)
+                    if v is None:
+                        block[row, base] = fill
+                        if self.track_nulls:
+                            block[row, base + 1] = 1.0
+                    else:
+                        block[row, base] = float(v)
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=k))
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata(
+                        f.name, tname, grouping=k,
+                        indicator_value=NULL_INDICATOR))
+            parts.append(block)
+        return _vec_column(np.concatenate(parts, axis=1) if parts
+                           else np.zeros((n, 0), np.float32),
+                           VectorMetadata("num_map_vec", meta))
+
+
+class TextMapPivotVectorizer(SequenceEstimator):
+    """TextMap/PickListMap -> per-key TopK pivot with OTHER + null columns."""
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True,
+                 allow_keys: Optional[List[str]] = None,
+                 block_keys: List[str] = (), uid: Optional[str] = None):
+        super().__init__(operation_name="pivotTextMap", output_type=OPVector, uid=uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+        self.block_keys = list(block_keys)
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        keysets, vocabs = [], []
+        for c in cols:
+            keys = _discover_keys(c, self.allow_keys, self.block_keys)
+            keysets.append(keys)
+            kv = {}
+            for k in keys:
+                counts = Counter(
+                    str(m[k]) for m in c.values if k in m and m[k] is not None
+                )
+                kv[k] = [v for v, cnt in counts.most_common(self.top_k)
+                         if cnt >= self.min_support]
+            vocabs.append(kv)
+        return TextMapPivotVectorizerModel(keysets=keysets, vocabs=vocabs,
+                                           track_nulls=self.track_nulls)
+
+
+class TextMapPivotVectorizerModel(SequenceModel):
+    def __init__(self, keysets: List[List[str]],
+                 vocabs: List[Dict[str, List[str]]],
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="pivotTextMap", output_type=OPVector, uid=uid)
+        self.keysets = keysets
+        self.vocabs = vocabs
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        parts, meta = [], []
+        for f, keys, kv, c in zip(self.input_features, self.keysets,
+                                  self.vocabs, cols):
+            tname = f.ftype.type_name()
+            for k in keys:
+                vocab = kv.get(k, [])
+                index = {v: i for i, v in enumerate(vocab)}
+                w = len(vocab) + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, w), dtype=np.float32)
+                for row, m in enumerate(c.values):
+                    v = m.get(k)
+                    if v is None:
+                        if self.track_nulls:
+                            block[row, w - 1] = 1.0
+                    else:
+                        j = index.get(str(v))
+                        if j is None:
+                            block[row, len(vocab)] = 1.0
+                        else:
+                            block[row, j] = 1.0
+                parts.append(block)
+                for v in vocab:
+                    meta.append(VectorColumnMetadata(f.name, tname, grouping=k,
+                                                     indicator_value=v))
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=k,
+                                                 indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata(
+                        f.name, tname, grouping=k,
+                        indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1) if parts
+                           else np.zeros((n, 0), np.float32),
+                           VectorMetadata("text_map_vec", meta))
+
+
+class MultiPickListMapVectorizer(TextMapPivotVectorizer):
+    """MultiPickListMap -> per-key multi-hot pivot."""
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        keysets, vocabs = [], []
+        for c in cols:
+            keys = _discover_keys(c, self.allow_keys, self.block_keys)
+            keysets.append(keys)
+            kv = {}
+            for k in keys:
+                counts: Counter = Counter()
+                for m in c.values:
+                    if k in m and m[k] is not None:
+                        counts.update(str(x) for x in m[k])
+                kv[k] = [v for v, cnt in counts.most_common(self.top_k)
+                         if cnt >= self.min_support]
+            vocabs.append(kv)
+        return MultiPickListMapVectorizerModel(keysets=keysets, vocabs=vocabs,
+                                               track_nulls=self.track_nulls)
+
+
+class MultiPickListMapVectorizerModel(TextMapPivotVectorizerModel):
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        parts, meta = [], []
+        for f, keys, kv, c in zip(self.input_features, self.keysets,
+                                  self.vocabs, cols):
+            tname = f.ftype.type_name()
+            for k in keys:
+                vocab = kv.get(k, [])
+                index = {v: i for i, v in enumerate(vocab)}
+                w = len(vocab) + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, w), dtype=np.float32)
+                for row, m in enumerate(c.values):
+                    vs = m.get(k)
+                    if not vs:
+                        if self.track_nulls:
+                            block[row, w - 1] = 1.0
+                        continue
+                    hit = False
+                    for v in vs:
+                        j = index.get(str(v))
+                        if j is not None:
+                            block[row, j] = 1.0
+                            hit = True
+                    if not hit:
+                        block[row, len(vocab)] = 1.0
+                parts.append(block)
+                for v in vocab:
+                    meta.append(VectorColumnMetadata(f.name, tname, grouping=k,
+                                                     indicator_value=v))
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=k,
+                                                 indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata(
+                        f.name, tname, grouping=k,
+                        indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1) if parts
+                           else np.zeros((n, 0), np.float32),
+                           VectorMetadata("mpl_map_vec", meta))
+
+
+_NUMERIC_MAPS = (ft.RealMap, ft.IntegralMap, ft.BinaryMap, ft.CurrencyMap,
+                 ft.PercentMap, ft.DateMap, ft.DateTimeMap)
+
+
+def transmogrify_map_group(feats: List[Feature], top_k: int, min_support: int,
+                           num_hash_features: int,
+                           track_nulls: bool) -> List[Feature]:
+    """Dispatch map features to the right map vectorizer (Transmogrifier map arm)."""
+    numeric = [f for f in feats if issubclass(f.ftype, _NUMERIC_MAPS)]
+    mpl = [f for f in feats if issubclass(f.ftype, ft.MultiPickListMap)]
+    text = [f for f in feats
+            if issubclass(f.ftype, ft.OPMap)
+            and f not in numeric and f not in mpl
+            and not issubclass(f.ftype, ft.GeolocationMap)]
+    geo = [f for f in feats if issubclass(f.ftype, ft.GeolocationMap)]
+    out: List[Feature] = []
+    if numeric:
+        s = NumericMapVectorizer(track_nulls=track_nulls)
+        s.set_input(*numeric)
+        out.append(s.get_output())
+    if text:
+        s = TextMapPivotVectorizer(top_k=top_k, min_support=min_support,
+                                   track_nulls=track_nulls)
+        s.set_input(*text)
+        out.append(s.get_output())
+    if mpl:
+        s = MultiPickListMapVectorizer(top_k=top_k, min_support=min_support,
+                                       track_nulls=track_nulls)
+        s.set_input(*mpl)
+        out.append(s.get_output())
+    if geo:
+        # geolocation maps: per-key (lat,lon,acc) via numeric path on flattened keys
+        s = GeoMapVectorizer(track_nulls=track_nulls)
+        s.set_input(*geo)
+        out.append(s.get_output())
+    return out
+
+
+class GeoMapVectorizer(SequenceEstimator):
+    """GeolocationMap -> per-key (lat, lon, accuracy) + null indicator."""
+
+    def __init__(self, track_nulls: bool = True,
+                 allow_keys: Optional[List[str]] = None,
+                 block_keys: List[str] = (), uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeoMap", output_type=OPVector, uid=uid)
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+        self.block_keys = list(block_keys)
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        keysets = [
+            _discover_keys(c, self.allow_keys, self.block_keys) for c in cols
+        ]
+        return GeoMapVectorizerModel(keysets=keysets, track_nulls=self.track_nulls)
+
+
+class GeoMapVectorizerModel(SequenceModel):
+    def __init__(self, keysets: List[List[str]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeoMap", output_type=OPVector, uid=uid)
+        self.keysets = keysets
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        parts, meta = [], []
+        for f, keys, c in zip(self.input_features, self.keysets, cols):
+            tname = f.ftype.type_name()
+            for k in keys:
+                w = 3 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, w), dtype=np.float32)
+                for row, m in enumerate(c.values):
+                    v = m.get(k)
+                    if v is None or len(v) != 3:
+                        if self.track_nulls:
+                            block[row, 3] = 1.0
+                    else:
+                        block[row, :3] = v
+                parts.append(block)
+                for d in ("lat", "lon", "accuracy"):
+                    meta.append(VectorColumnMetadata(f.name, tname, grouping=k,
+                                                     descriptor_value=d))
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata(
+                        f.name, tname, grouping=k,
+                        indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1) if parts
+                           else np.zeros((n, 0), np.float32),
+                           VectorMetadata("geo_map_vec", meta))

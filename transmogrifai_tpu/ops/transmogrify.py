@@ -1,0 +1,136 @@
+"""``transmogrify()`` — automated feature engineering dispatcher.
+
+Reference: ``Transmogrifier`` (core/.../impl/feature/Transmogrifier.scala:92-260)
+and the DSL entry ``RichFeaturesCollection.transmogrify``
+(core/.../dsl/RichFeaturesCollection.scala:69): group input features by
+semantic type, apply the per-type default vectorizer to each group, and
+combine the resulting OPVectors into one feature vector.
+
+Defaults mirror Transmogrifier.scala:52-90: TopK=20, MinSupport=10,
+512 hash features, null tracking on, fill-with-mean/mode for numerics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from ..features.feature import Feature
+from ..types import feature_types as ft
+from .date_geo import DateToUnitCircleVectorizer, GeolocationVectorizer
+from .map_vectorizers import transmogrify_map_group
+from .vectorizers import (
+    BinaryVectorizer, IntegralVectorizer, MultiPickListVectorizer,
+    OneHotVectorizer, RealVectorizer, SmartTextVectorizer,
+    TextHashingVectorizer, VectorsCombiner,
+)
+
+__all__ = ["transmogrify", "TransmogrifierDefaults"]
+
+
+class TransmogrifierDefaults:
+    TOP_K = 20
+    MIN_SUPPORT = 10
+    NUM_HASH_FEATURES = 512
+    MAX_HASH_FEATURES = 1 << 17
+    MAX_CARDINALITY = 100
+    TRACK_NULLS = True
+    FILL_WITH_MEAN = True
+    FILL_WITH_MODE = True
+
+
+# categorical text types that get a direct TopK pivot
+_PIVOT_TEXT = (ft.PickList, ft.ComboBox, ft.Country, ft.State, ft.City,
+               ft.PostalCode, ft.Street, ft.ID)
+# free-text types that go through SmartTextVectorizer
+_SMART_TEXT = (ft.Text,)
+
+
+def transmogrify(
+    features: Sequence[Feature],
+    top_k: int = TransmogrifierDefaults.TOP_K,
+    min_support: int = TransmogrifierDefaults.MIN_SUPPORT,
+    num_hash_features: int = TransmogrifierDefaults.NUM_HASH_FEATURES,
+    max_cardinality: int = TransmogrifierDefaults.MAX_CARDINALITY,
+    track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+) -> Feature:
+    """Vectorize a heterogeneous feature set into a single OPVector feature."""
+    groups: Dict[str, List[Feature]] = {}
+    for f in features:
+        groups.setdefault(_group_of(f.ftype), []).append(f)
+
+    vectors: List[Feature] = []
+    order = ["real", "integral", "binary", "date", "pivot_text", "smart_text",
+             "multi_pick_list", "text_list", "geolocation", "vector", "map"]
+    for g in order:
+        feats = groups.pop(g, [])
+        if not feats:
+            continue
+        if g == "real":
+            stage = RealVectorizer(track_nulls=track_nulls)
+        elif g == "integral":
+            stage = IntegralVectorizer(track_nulls=track_nulls)
+        elif g == "binary":
+            stage = BinaryVectorizer(track_nulls=track_nulls)
+        elif g == "date":
+            stage = DateToUnitCircleVectorizer(track_nulls=track_nulls)
+        elif g == "pivot_text":
+            stage = OneHotVectorizer(top_k=top_k, min_support=min_support,
+                                     track_nulls=track_nulls)
+        elif g == "smart_text":
+            stage = SmartTextVectorizer(
+                max_cardinality=max_cardinality, top_k=top_k,
+                min_support=min_support, num_hash_features=num_hash_features,
+                track_nulls=track_nulls)
+        elif g == "multi_pick_list":
+            stage = MultiPickListVectorizer(top_k=top_k, min_support=min_support,
+                                            track_nulls=track_nulls)
+        elif g == "text_list":
+            stage = TextHashingVectorizer(num_features=num_hash_features,
+                                          track_nulls=track_nulls)
+        elif g == "geolocation":
+            stage = GeolocationVectorizer(track_nulls=track_nulls)
+        elif g == "vector":
+            vectors.extend(feats)
+            continue
+        elif g == "map":
+            vectors.extend(transmogrify_map_group(
+                feats, top_k=top_k, min_support=min_support,
+                num_hash_features=num_hash_features, track_nulls=track_nulls))
+            continue
+        stage.set_input(*feats)
+        vectors.append(stage.get_output())
+    if groups:
+        raise TypeError(f"no default vectorizer for groups {sorted(groups)}")
+
+    if len(vectors) == 1:
+        return vectors[0]
+    combiner = VectorsCombiner()
+    combiner.set_input(*vectors)
+    return combiner.get_output()
+
+
+def _group_of(t: Type[ft.FeatureType]) -> str:
+    if issubclass(t, ft.OPMap):
+        return "map"
+    if issubclass(t, ft.OPVector):
+        return "vector"
+    if issubclass(t, ft.Geolocation):
+        return "geolocation"
+    if issubclass(t, ft.MultiPickList):
+        return "multi_pick_list"
+    if issubclass(t, ft.TextList):
+        return "text_list"
+    if issubclass(t, ft.DateList):
+        return "text_list"
+    if issubclass(t, ft.Binary):
+        return "binary"
+    if issubclass(t, (ft.Date, ft.DateTime)):
+        return "date"
+    if issubclass(t, ft.Integral):
+        return "integral"
+    if issubclass(t, (ft.Real,)):
+        return "real"
+    if issubclass(t, _PIVOT_TEXT):
+        return "pivot_text"
+    if issubclass(t, ft.Text):
+        return "smart_text"
+    raise TypeError(f"cannot transmogrify feature type {t.type_name()}")

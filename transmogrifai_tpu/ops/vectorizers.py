@@ -1,0 +1,568 @@
+"""Default vectorizers — the building blocks of ``transmogrify()``.
+
+Reference stages (core/.../stages/impl/feature/):
+ * numeric fills + null tracking — ``RealVectorizer``/``IntegralVectorizer``
+   via ``VectorizerDefaults`` (Transmogrifier defaults :52-90)
+ * ``OpOneHotVectorizer``/``OneHotEstimator`` — TopK pivot with minSupport,
+   OTHER and null-indicator columns (OpOneHotVectorizer.scala)
+ * ``OPCollectionHashingVectorizer`` — murmur3 feature hashing
+   (OPCollectionHashingVectorizer.scala:59)
+ * ``SmartTextVectorizer`` — cardinality-driven strategy per text field
+   (SmartTextVectorizer.scala:60,79,207-247,323)
+ * ``VectorsCombiner`` — concatenates OPVectors and merges their metadata
+   (VectorsCombiner.scala)
+
+All emit float32 (N, D) matrices (device-ready; bf16 conversion happens at
+model ingestion) plus a ``VectorMetadata`` recording slot provenance.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..stages.base import (
+    SequenceEstimator, SequenceModel, SequenceTransformer,
+)
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import (
+    Binary, MultiPickList, OPVector, Text, TextList,
+)
+from ..utils.hashing import murmur3_32
+from .vector_metadata import (
+    NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata, VectorMetadata,
+)
+
+__all__ = [
+    "RealVectorizer", "RealVectorizerModel",
+    "IntegralVectorizer", "IntegralVectorizerModel",
+    "BinaryVectorizer",
+    "OneHotVectorizer", "OneHotVectorizerModel",
+    "TextHashingVectorizer",
+    "SmartTextVectorizer", "SmartTextVectorizerModel", "TextStats",
+    "MultiPickListVectorizer", "MultiPickListVectorizerModel",
+    "VectorsCombiner",
+]
+
+
+def _vec_column(arr: np.ndarray, meta: VectorMetadata) -> FeatureColumn:
+    return FeatureColumn(OPVector, np.asarray(arr, dtype=np.float32), vmeta=meta)
+
+
+# ---------------------------------------------------------------------------
+# Numerics
+# ---------------------------------------------------------------------------
+
+class RealVectorizer(SequenceEstimator):
+    """Fill missing reals (mean or constant) + optional null-indicator slots.
+
+    Transmogrifier default for Real/Percent/Currency: FillWithMean + null
+    tracking (Transmogrifier.scala:52-90).
+    """
+
+    def __init__(self, fill_with_mean: bool = True, fill_value: float = 0.0,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecReal", output_type=OPVector, uid=uid)
+        self.fill_with_mean = fill_with_mean
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        fills = []
+        for c in cols:
+            if self.fill_with_mean:
+                vals = np.asarray(c.values, dtype=np.float64)
+                m = np.asarray(c.mask)
+                fills.append(float(np.nan_to_num(vals)[m].mean()) if m.any() else self.fill_value)
+            else:
+                fills.append(float(self.fill_value))
+        return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+
+
+class RealVectorizerModel(SequenceModel):
+    def __init__(self, fills: List[float], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecReal", output_type=OPVector, uid=uid)
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        parts, meta = [], []
+        for f, fill, c in zip(self.input_features, self.fills, cols):
+            vals = np.nan_to_num(np.asarray(c.values, dtype=np.float64))
+            m = np.asarray(c.mask)
+            parts.append(np.where(m, vals, fill))
+            meta.append(VectorColumnMetadata(f.name, f.ftype.type_name()))
+            if self.track_nulls:
+                parts.append(~m)
+                meta.append(VectorColumnMetadata(
+                    f.name, f.ftype.type_name(), indicator_value=NULL_INDICATOR))
+        out = np.stack(parts, axis=1)
+        return _vec_column(out, VectorMetadata(self.get_output().name if self._output_feature else "real_vec", meta))
+
+
+class IntegralVectorizer(SequenceEstimator):
+    """Fill missing integrals with mode + null tracking (Transmogrifier default)."""
+
+    def __init__(self, fill_with_mode: bool = True, fill_value: int = 0,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecIntegral", output_type=OPVector, uid=uid)
+        self.fill_with_mode = fill_with_mode
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        fills = []
+        for c in cols:
+            if self.fill_with_mode:
+                vals = np.asarray(c.values)[np.asarray(c.mask)]
+                if len(vals):
+                    uniq, cnt = np.unique(vals, return_counts=True)
+                    fills.append(float(uniq[np.argmax(cnt)]))
+                else:
+                    fills.append(float(self.fill_value))
+            else:
+                fills.append(float(self.fill_value))
+        return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+
+
+class BinaryVectorizer(SequenceTransformer):
+    """Binary -> {0,1} with fill + null tracking (stateless)."""
+
+    def __init__(self, fill_value: bool = False, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecBinary", output_type=OPVector, uid=uid)
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        parts, meta = [], []
+        for f, c in zip(self.input_features, cols):
+            vals = np.nan_to_num(np.asarray(c.values, dtype=np.float64))
+            m = np.asarray(c.mask)
+            parts.append(np.where(m, vals, float(self.fill_value)))
+            meta.append(VectorColumnMetadata(f.name, f.ftype.type_name()))
+            if self.track_nulls:
+                parts.append(~m)
+                meta.append(VectorColumnMetadata(
+                    f.name, f.ftype.type_name(), indicator_value=NULL_INDICATOR))
+        return _vec_column(np.stack(parts, axis=1),
+                           VectorMetadata("binary_vec", meta))
+
+
+# ---------------------------------------------------------------------------
+# Categorical pivot (one-hot)
+# ---------------------------------------------------------------------------
+
+class OneHotVectorizer(SequenceEstimator):
+    """TopK pivot of categorical text with OTHER + null indicator columns.
+
+    Reference OpOneHotVectorizer.scala; defaults TopK=20, minSupport=10
+    (Transmogrifier.scala:55-60).
+    """
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, unseen_to_other: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="pivotText", output_type=OPVector, uid=uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        self.unseen_to_other = unseen_to_other
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        vocabs: List[List[str]] = []
+        for c in cols:
+            vals = [v for v in c.values if v is not None]
+            counts = Counter(vals)
+            top = [
+                v for v, n in counts.most_common(self.top_k)
+                if n >= self.min_support
+            ]
+            vocabs.append(top)
+        return OneHotVectorizerModel(
+            vocabs=vocabs, track_nulls=self.track_nulls,
+            unseen_to_other=self.unseen_to_other)
+
+
+class OneHotVectorizerModel(SequenceModel):
+    def __init__(self, vocabs: List[List[str]], track_nulls: bool = True,
+                 unseen_to_other: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="pivotText", output_type=OPVector, uid=uid)
+        self.vocabs = vocabs
+        self.track_nulls = track_nulls
+        self.unseen_to_other = unseen_to_other
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        parts, meta = [], []
+        for f, vocab, c in zip(self.input_features, self.vocabs, cols):
+            index = {v: i for i, v in enumerate(vocab)}
+            k = len(vocab)
+            width = k + (1 if self.unseen_to_other else 0) + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width), dtype=np.float32)
+            for row, v in enumerate(c.values):
+                if v is None:
+                    if self.track_nulls:
+                        block[row, width - 1] = 1.0
+                elif v in index:
+                    block[row, index[v]] = 1.0
+                elif self.unseen_to_other:
+                    block[row, k] = 1.0
+            parts.append(block)
+            tname = f.ftype.type_name()
+            for v in vocab:
+                meta.append(VectorColumnMetadata(
+                    f.name, tname, grouping=f.name, indicator_value=v))
+            if self.unseen_to_other:
+                meta.append(VectorColumnMetadata(
+                    f.name, tname, grouping=f.name, indicator_value=OTHER_INDICATOR))
+            if self.track_nulls:
+                meta.append(VectorColumnMetadata(
+                    f.name, tname, grouping=f.name, indicator_value=NULL_INDICATOR))
+        out = np.concatenate(parts, axis=1) if parts else np.zeros((n, 0), np.float32)
+        return _vec_column(out, VectorMetadata("onehot_vec", meta))
+
+
+class MultiPickListVectorizer(SequenceEstimator):
+    """TopK multi-hot pivot of MultiPickList sets (OpSetVectorizer parity)."""
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="pivotSet", output_type=OPVector, uid=uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        vocabs = []
+        for c in cols:
+            counts: Counter = Counter()
+            for s in c.values:
+                counts.update(s)
+            vocabs.append([
+                v for v, n in counts.most_common(self.top_k)
+                if n >= self.min_support
+            ])
+        return MultiPickListVectorizerModel(vocabs=vocabs, track_nulls=self.track_nulls)
+
+
+class MultiPickListVectorizerModel(SequenceModel):
+    def __init__(self, vocabs: List[List[str]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="pivotSet", output_type=OPVector, uid=uid)
+        self.vocabs = vocabs
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        parts, meta = [], []
+        for f, vocab, c in zip(self.input_features, self.vocabs, cols):
+            index = {v: i for i, v in enumerate(vocab)}
+            k = len(vocab)
+            width = k + 1 + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width), dtype=np.float32)
+            for row, s in enumerate(c.values):
+                if not s:
+                    if self.track_nulls:
+                        block[row, width - 1] = 1.0
+                    continue
+                hit = False
+                for v in s:
+                    if v in index:
+                        block[row, index[v]] = 1.0
+                        hit = True
+                if not hit:
+                    block[row, k] = 1.0
+            parts.append(block)
+            tname = f.ftype.type_name()
+            for v in vocab:
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                 indicator_value=v))
+            meta.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                             indicator_value=OTHER_INDICATOR))
+            if self.track_nulls:
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                 indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1),
+                           VectorMetadata("set_vec", meta))
+
+
+# ---------------------------------------------------------------------------
+# Hashing
+# ---------------------------------------------------------------------------
+
+def _tokenize(v: Optional[str]) -> List[str]:
+    if v is None:
+        return []
+    return [t for t in _TOKEN_SPLIT.split(v.lower()) if t]
+
+
+import re
+_TOKEN_SPLIT = re.compile(r"[^\w']+", re.UNICODE)
+
+
+class TextHashingVectorizer(SequenceTransformer):
+    """Murmur3 feature hashing of tokenized text (stateless).
+
+    Reference OPCollectionHashingVectorizer / hashed text path of
+    SmartTextVectorizer; default 512 buckets (Transmogrifier.scala:55).
+    ``shared_hash_space``: one bucket space for all inputs vs per-feature
+    (HashSpaceStrategy parity).
+    """
+
+    def __init__(self, num_features: int = 512, binary_freq: bool = False,
+                 shared_hash_space: bool = False, track_nulls: bool = True,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(operation_name="textHash", output_type=OPVector, uid=uid)
+        self.num_features = num_features
+        self.binary_freq = binary_freq
+        self.shared_hash_space = shared_hash_space
+        self.track_nulls = track_nulls
+        self.seed = seed
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        nf = self.num_features
+        n_spaces = 1 if self.shared_hash_space else len(cols)
+        hashed = np.zeros((n, n_spaces * nf), dtype=np.float32)
+        nulls = np.zeros((n, len(cols)), dtype=np.float32)
+        # hash unique tokens once (host); scatter-add counts
+        for ci, c in enumerate(cols):
+            offset = 0 if self.shared_hash_space else ci * nf
+            cache: Dict[str, int] = {}
+            for row, v in enumerate(c.values):
+                toks = _tokenize(v) if isinstance(v, str) or v is None else list(v)
+                if v is None or not toks:
+                    nulls[row, ci] = 1.0
+                    continue
+                for t in toks:
+                    b = cache.get(t)
+                    if b is None:
+                        b = murmur3_32(t, self.seed) % nf
+                        cache[t] = b
+                    if self.binary_freq:
+                        hashed[row, offset + b] = 1.0
+                    else:
+                        hashed[row, offset + b] += 1.0
+        meta: List[VectorColumnMetadata] = []
+        if self.shared_hash_space:
+            pf = ",".join(f.name for f in self.input_features)
+            for b in range(nf):
+                meta.append(VectorColumnMetadata(pf, "Text", grouping=None,
+                                                 descriptor_value=f"hash_{b}"))
+        else:
+            for f in self.input_features:
+                for b in range(nf):
+                    meta.append(VectorColumnMetadata(f.name, f.ftype.type_name(),
+                                                     descriptor_value=f"hash_{b}"))
+        parts = [hashed]
+        if self.track_nulls:
+            parts.append(nulls)
+            for f in self.input_features:
+                meta.append(VectorColumnMetadata(f.name, f.ftype.type_name(),
+                                                 indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1),
+                           VectorMetadata("hash_vec", meta))
+
+
+# ---------------------------------------------------------------------------
+# SmartTextVectorizer
+# ---------------------------------------------------------------------------
+
+class TextStats:
+    """Streaming text statistics monoid (SmartTextVectorizer.scala:207-247)."""
+
+    def __init__(self, max_card: int = 100):
+        self.max_card = max_card
+        self.value_counts: Counter = Counter()
+        self.length_counts: Counter = Counter()
+        self.n = 0
+        self.n_null = 0
+        self.saturated = False
+
+    def update(self, v: Optional[str]):
+        self.n += 1
+        if v is None:
+            self.n_null += 1
+            return
+        self.length_counts[len(v)] += 1
+        if not self.saturated:
+            self.value_counts[v] += 1
+            if len(self.value_counts) > self.max_card:
+                self.saturated = True
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.value_counts)
+
+    def merge(self, other: "TextStats") -> "TextStats":
+        out = TextStats(self.max_card)
+        out.value_counts = self.value_counts + other.value_counts
+        out.length_counts = self.length_counts + other.length_counts
+        out.n = self.n + other.n
+        out.n_null = self.n_null + other.n_null
+        out.saturated = (
+            self.saturated or other.saturated
+            or len(out.value_counts) > out.max_card
+        )
+        return out
+
+
+class SmartTextVectorizer(SequenceEstimator):
+    """Cardinality-driven text strategy: pivot / hash / ignore per field.
+
+    Reference SmartTextVectorizer.scala:60,79,323 — computes TextStats per
+    field then chooses: categorical pivot when cardinality <= max_cardinality,
+    murmur3 hashing otherwise, ignore when the field is effectively empty.
+    """
+
+    PIVOT, HASH, IGNORE = "pivot", "hash", "ignore"
+
+    def __init__(self, max_cardinality: int = 100, top_k: int = 20,
+                 min_support: int = 10, num_hash_features: int = 512,
+                 auto_detect_languages: bool = False,
+                 min_fill_rate: float = 0.001, track_nulls: bool = True,
+                 track_text_len: bool = False, seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="smartTxtVec", output_type=OPVector, uid=uid)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_hash_features = num_hash_features
+        self.auto_detect_languages = auto_detect_languages
+        self.min_fill_rate = min_fill_rate
+        self.track_nulls = track_nulls
+        self.track_text_len = track_text_len
+        self.seed = seed
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        strategies, vocabs = [], []
+        for c in cols:
+            stats = TextStats(self.max_cardinality)
+            for v in c.values:
+                stats.update(v)
+            fill = (stats.n - stats.n_null) / max(stats.n, 1)
+            if fill < self.min_fill_rate:
+                strategies.append(self.IGNORE)
+                vocabs.append([])
+            elif not stats.saturated and stats.cardinality <= self.max_cardinality:
+                strategies.append(self.PIVOT)
+                vocabs.append([
+                    v for v, cnt in stats.value_counts.most_common(self.top_k)
+                    if cnt >= self.min_support
+                ])
+            else:
+                strategies.append(self.HASH)
+                vocabs.append([])
+        self.metadata["text_strategies"] = dict(
+            zip([f.name for f in self.input_features], strategies))
+        return SmartTextVectorizerModel(
+            strategies=strategies, vocabs=vocabs,
+            num_hash_features=self.num_hash_features,
+            track_nulls=self.track_nulls, track_text_len=self.track_text_len,
+            seed=self.seed)
+
+
+class SmartTextVectorizerModel(SequenceModel):
+    def __init__(self, strategies: List[str], vocabs: List[List[str]],
+                 num_hash_features: int = 512, track_nulls: bool = True,
+                 track_text_len: bool = False, seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="smartTxtVec", output_type=OPVector, uid=uid)
+        self.strategies = strategies
+        self.vocabs = vocabs
+        self.num_hash_features = num_hash_features
+        self.track_nulls = track_nulls
+        self.track_text_len = track_text_len
+        self.seed = seed
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        n = len(cols[0])
+        parts: List[np.ndarray] = []
+        meta: List[VectorColumnMetadata] = []
+        nf = self.num_hash_features
+        for f, strat, vocab, c in zip(
+            self.input_features, self.strategies, self.vocabs, cols
+        ):
+            tname = f.ftype.type_name()
+            if strat == SmartTextVectorizer.IGNORE:
+                pass
+            elif strat == SmartTextVectorizer.PIVOT:
+                index = {v: i for i, v in enumerate(vocab)}
+                k = len(vocab)
+                block = np.zeros((n, k + 1), dtype=np.float32)
+                for row, v in enumerate(c.values):
+                    if v is None:
+                        continue
+                    j = index.get(v)
+                    if j is None:
+                        block[row, k] = 1.0
+                    else:
+                        block[row, j] = 1.0
+                parts.append(block)
+                for v in vocab:
+                    meta.append(VectorColumnMetadata(f.name, tname,
+                                                     grouping=f.name,
+                                                     indicator_value=v))
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                 indicator_value=OTHER_INDICATOR))
+            else:  # HASH
+                block = np.zeros((n, nf), dtype=np.float32)
+                cache: Dict[str, int] = {}
+                for row, v in enumerate(c.values):
+                    for t in _tokenize(v):
+                        b = cache.get(t)
+                        if b is None:
+                            b = murmur3_32(t, self.seed) % nf
+                            cache[t] = b
+                        block[row, b] += 1.0
+                parts.append(block)
+                for b in range(nf):
+                    meta.append(VectorColumnMetadata(f.name, tname,
+                                                     descriptor_value=f"hash_{b}"))
+            if self.track_text_len:
+                lens = np.array([
+                    0.0 if v is None else float(len(v)) for v in c.values
+                ], dtype=np.float32)[:, None]
+                parts.append(lens)
+                meta.append(VectorColumnMetadata(f.name, tname,
+                                                 descriptor_value="textLen"))
+            if self.track_nulls:
+                nulls = np.array([v is None for v in c.values],
+                                 dtype=np.float32)[:, None]
+                parts.append(nulls)
+                meta.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                 indicator_value=NULL_INDICATOR))
+        out = (np.concatenate(parts, axis=1)
+               if parts else np.zeros((n, 0), np.float32))
+        return _vec_column(out, VectorMetadata("smart_text_vec", meta))
+
+
+# ---------------------------------------------------------------------------
+# Combiner
+# ---------------------------------------------------------------------------
+
+class VectorsCombiner(SequenceTransformer):
+    """Concatenate OPVector inputs + merge metadata (VectorsCombiner.scala)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="combineVecs", output_type=OPVector, uid=uid)
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        mats = [np.asarray(c.values, dtype=np.float32) for c in cols]
+        metas = []
+        for c, f in zip(cols, self.input_features):
+            if c.vmeta is not None:
+                metas.append(c.vmeta)
+            else:
+                metas.append(VectorMetadata(f.name, [
+                    VectorColumnMetadata(f.name, f.ftype.type_name(),
+                                         descriptor_value=f"slot_{i}")
+                    for i in range(mats[len(metas)].shape[1])
+                ]))
+        out_name = self._output_feature.name if self._output_feature else "features"
+        vm = VectorMetadata.flatten(out_name, metas)
+        self.metadata["vector_metadata"] = vm.to_json()
+        return _vec_column(np.concatenate(mats, axis=1), vm)

@@ -1,0 +1,298 @@
+"""Pipeline stage abstraction.
+
+Reference: ``OpPipelineStageBase``/``OpPipelineStage`` and the arity-typed
+estimator/transformer bases
+(features/src/main/scala/com/salesforce/op/stages/OpPipelineStages.scala:55,169,218-503;
+stages/base/unary/UnaryTransformer.scala:104, UnaryEstimator.scala:56,118;
+binary/ternary/quaternary/sequence equivalents).
+
+TPU-native redesign notes:
+ * Stages transform *columns* (vectorized numpy/JAX), not rows.  The
+   row-level ``OpTransformer.transformKeyValue`` used by the reference for
+   Spark-free local scoring (OpPipelineStages.scala:526-550) is replaced by
+   running the same columnar code on a batch of one — no second code path.
+ * Estimator ``fit`` receives the extracted input columns only, mirroring the
+   typed ``Dataset`` handed to ``fitFn`` in the reference.
+ * Param persistence: constructor kwargs are discovered via ``inspect`` (the
+   Python analogue of the reference's reflection-based
+   ``DefaultOpPipelineStageReaderWriter``) — see ``get_params``/``to_json``.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import FeatureType
+from ..utils.uid import uid_for
+
+__all__ = [
+    "PipelineStage", "Transformer", "Estimator", "Model",
+    "UnaryTransformer", "UnaryEstimator", "UnaryModel",
+    "BinaryTransformer", "BinaryEstimator", "BinaryModel",
+    "TernaryTransformer", "TernaryEstimator", "TernaryModel",
+    "QuaternaryTransformer", "QuaternaryEstimator", "QuaternaryModel",
+    "SequenceTransformer", "SequenceEstimator", "SequenceModel",
+    "BinarySequenceTransformer", "BinarySequenceEstimator", "BinarySequenceModel",
+    "LambdaTransformer",
+]
+
+
+class PipelineStage:
+    """Base of all stages.
+
+    Subclass constructors must call ``super().__init__(operation_name=...,
+    output_type=...)`` and store every hyperparameter as an attribute named
+    exactly like the constructor keyword (sklearn convention) so persistence
+    can round-trip it.
+    """
+
+    def __init__(
+        self,
+        operation_name: str,
+        output_type: Type[FeatureType],
+        uid: Optional[str] = None,
+    ):
+        self.operation_name = operation_name
+        self.output_type = output_type
+        self.uid = uid or uid_for(type(self))
+        self.input_features: List[Feature] = []
+        self._output_feature: Optional[Feature] = None
+        #: structured metadata attached during fit (summaries, vector metadata)
+        self.metadata: Dict[str, Any] = {}
+
+    # -- input wiring (OpPipelineStageBase.setInput / checkInputLength) -----
+
+    #: (min, max) allowed number of inputs; None = unbounded
+    input_arity: Tuple[int, Optional[int]] = (1, None)
+
+    def check_input_length(self, features: Sequence[Feature]) -> None:
+        lo, hi = self.input_arity
+        if len(features) < lo or (hi is not None and len(features) > hi):
+            raise ValueError(
+                f"{type(self).__name__} expects between {lo} and {hi} inputs, "
+                f"got {len(features)}"
+            )
+
+    def on_set_input(self) -> None:
+        """Hook called after inputs are set (OpPipelineStageBase.onSetInput)."""
+
+    def set_input(self, *features: Feature) -> "PipelineStage":
+        self.check_input_length(features)
+        self.input_features = list(features)
+        self.on_set_input()
+        self._output_feature = Feature(
+            name=self.make_output_name(),
+            ftype=self.output_type,
+            is_response=self.output_is_response(),
+            origin_stage=self,
+            parents=list(features),
+        )
+        return self
+
+    def output_is_response(self) -> bool:
+        return any(f.is_response for f in self.input_features)
+
+    def make_output_name(self) -> str:
+        base = "-".join(f.name for f in self.input_features[:4]) or "out"
+        return f"{base}_{self.operation_name}_{self.uid}"
+
+    def get_output(self) -> Feature:
+        if self._output_feature is None:
+            raise RuntimeError(f"{self.uid}: set_input() not called")
+        return self._output_feature
+
+    @property
+    def input_names(self) -> List[str]:
+        return [f.name for f in self.input_features]
+
+    # -- params / persistence ----------------------------------------------
+
+    # param names that are not hyperparameters
+    _NON_PARAMS = frozenset({"uid", "operation_name", "output_type"})
+
+    def get_params(self) -> Dict[str, Any]:
+        """Hyperparameters = constructor kwargs, read back from attributes."""
+        out = {}
+        for klass in type(self).__mro__:
+            if klass is object:
+                continue
+            try:
+                sig = inspect.signature(klass.__init__)
+            except (TypeError, ValueError):
+                continue
+            for name, p in sig.parameters.items():
+                if name in ("self",) or p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                    continue
+                if name in self._NON_PARAMS or name in out:
+                    continue
+                if hasattr(self, name):
+                    out[name] = getattr(self, name)
+        return out
+
+    def set_params(self, **params) -> "PipelineStage":
+        for k, v in params.items():
+            if not hasattr(self, k):
+                raise ValueError(f"{type(self).__name__} has no param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def copy(self, **overrides) -> "PipelineStage":
+        """Fresh instance with same params (reference ReflectionUtils.copy)."""
+        params = {**self.get_params(), **overrides}
+        new = type(self)(**params)
+        return new
+
+    def __repr__(self):
+        return f"{type(self).__name__}(uid={self.uid!r})"
+
+
+class Transformer(PipelineStage):
+    """A fitted/stateless stage: input columns -> one output column."""
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        raise NotImplementedError
+
+    def transform(self, data: ColumnarDataset) -> ColumnarDataset:
+        cols = [data[n] for n in self.input_names]
+        out = self.transform_columns(*cols)
+        if out.ftype is not self.output_type and not issubclass(
+            out.ftype, self.output_type
+        ):
+            out = FeatureColumn(self.output_type, out.values, out.mask)
+        data.set(self.get_output().name, out)
+        return data
+
+    def transform_values(self, *rows: Any) -> Any:
+        """Row-level transform via a batch of one (local-scoring parity)."""
+        cols = [
+            FeatureColumn.from_values(f.ftype, [v])
+            for f, v in zip(self.input_features, rows)
+        ]
+        return self.transform_columns(*cols).to_list()[0]
+
+
+class Model(Transformer):
+    """A fitted estimator. Keeps the parent estimator's uid so workflow DAG
+    substitution is by-uid (reference: models share the estimator uid)."""
+
+
+class Estimator(PipelineStage):
+    """A stage that must be fit before it can transform."""
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn) -> Model:
+        raise NotImplementedError
+
+    def fit(self, data: ColumnarDataset) -> Model:
+        cols = [data[n] for n in self.input_names]
+        model = self.fit_columns(data, *cols)
+        # the model answers for the same output feature / uid
+        model.uid = self.uid
+        model.operation_name = self.operation_name
+        model.input_features = list(self.input_features)
+        model._output_feature = self._output_feature
+        model.metadata = self.metadata
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Arity-typed conveniences (reference stages/base/{unary,binary,...})
+# ---------------------------------------------------------------------------
+
+class UnaryTransformer(Transformer):
+    input_arity = (1, 1)
+
+
+class BinaryTransformer(Transformer):
+    input_arity = (2, 2)
+
+
+class TernaryTransformer(Transformer):
+    input_arity = (3, 3)
+
+
+class QuaternaryTransformer(Transformer):
+    input_arity = (4, 4)
+
+
+class SequenceTransformer(Transformer):
+    """Variadic same-typed inputs (reference SequenceTransformer)."""
+    input_arity = (1, None)
+
+
+class BinarySequenceTransformer(Transformer):
+    """One distinguished input + variadic same-typed rest."""
+    input_arity = (2, None)
+
+
+class UnaryModel(Model):
+    input_arity = (1, 1)
+
+
+class BinaryModel(Model):
+    input_arity = (2, 2)
+
+
+class TernaryModel(Model):
+    input_arity = (3, 3)
+
+
+class QuaternaryModel(Model):
+    input_arity = (4, 4)
+
+
+class SequenceModel(Model):
+    input_arity = (1, None)
+
+
+class BinarySequenceModel(Model):
+    input_arity = (2, None)
+
+
+class UnaryEstimator(Estimator):
+    input_arity = (1, 1)
+
+
+class BinaryEstimator(Estimator):
+    input_arity = (2, 2)
+
+
+class TernaryEstimator(Estimator):
+    input_arity = (3, 3)
+
+
+class QuaternaryEstimator(Estimator):
+    input_arity = (4, 4)
+
+
+class SequenceEstimator(Estimator):
+    input_arity = (1, None)
+
+
+class BinarySequenceEstimator(Estimator):
+    input_arity = (2, None)
+
+
+class LambdaTransformer(UnaryTransformer):
+    """Wrap a plain column function as a stage (FeatureBuilder/DSL helper).
+
+    ``fn`` maps FeatureColumn -> FeatureColumn of ``output_type``.
+    Note: lambdas are not JSON-persistable; persistable pipelines should use
+    named stages (same caveat as the reference's macro-captured lambdas).
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[FeatureColumn], FeatureColumn],
+        output_type: Type[FeatureType],
+        operation_name: str = "lambda",
+        uid: Optional[str] = None,
+    ):
+        super().__init__(operation_name=operation_name, output_type=output_type, uid=uid)
+        self.fn = fn
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        return self.fn(col)

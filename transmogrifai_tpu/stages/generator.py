@@ -1,0 +1,66 @@
+"""Raw feature generation — stage #0 of every feature.
+
+Reference: ``FeatureGeneratorStage`` (features/stages/FeatureGeneratorStage.scala:67):
+holds the record->value ``extract_fn``, a default monoid aggregator for
+event-aggregated readers, and an optional aggregation time window.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Type
+
+from ..features.feature import Feature
+from ..types.columns import FeatureColumn
+from ..types.feature_types import FeatureType
+from .base import PipelineStage
+
+__all__ = ["FeatureGeneratorStage"]
+
+
+class FeatureGeneratorStage(PipelineStage):
+    """Generates one raw feature column from raw records.
+
+    ``extract_fn(record) -> value`` runs host-side over the reader's records
+    (the analogue of the reference's macro-captured extract lambdas); when the
+    reader yields ready-made columns (CSV/Parquet fast path) the stage simply
+    names the column.
+    """
+
+    input_arity = (0, 0)
+
+    def __init__(
+        self,
+        name: str,
+        output_type: Type[FeatureType],
+        extract_fn: Optional[Callable[[Any], Any]] = None,
+        is_response: bool = False,
+        aggregator: Optional[str] = None,
+        aggregate_window_ms: Optional[int] = None,
+        uid: Optional[str] = None,
+    ):
+        super().__init__(
+            operation_name="FeatureGenerator", output_type=output_type, uid=uid
+        )
+        self.name = name
+        self.extract_fn = extract_fn
+        self.is_response = is_response
+        # name of a registered monoid aggregator (aggregators module); None =
+        # the per-type default (MonoidAggregatorDefaults.aggregatorOf parity)
+        self.aggregator = aggregator
+        self.aggregate_window_ms = aggregate_window_ms
+        self._output_feature = Feature(
+            name=name,
+            ftype=output_type,
+            is_response=is_response,
+            origin_stage=self,
+            parents=[],
+        )
+
+    def make_output_name(self) -> str:
+        return self.name
+
+    def output_is_response(self) -> bool:
+        return self.is_response
+
+    def extract_column(self, records: Sequence[Any]) -> FeatureColumn:
+        fn = self.extract_fn or (lambda r: r.get(self.name) if isinstance(r, dict) else getattr(r, self.name))
+        return FeatureColumn.from_values(self.output_type, [fn(r) for r in records])

@@ -1,0 +1,2 @@
+from .feature_types import *  # noqa: F401,F403
+from .columns import ColumnarDataset, FeatureColumn  # noqa: F401

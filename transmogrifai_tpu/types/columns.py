@@ -1,0 +1,238 @@
+"""Columnar storage for feature values.
+
+The TPU-native replacement for the reference's row-oriented Spark DataFrame:
+each feature is stored as one ``FeatureColumn`` — a batch of N values in the
+representation best suited to its semantic type.  Numeric-like columns are
+(values, mask) numpy/JAX arrays ready to move to device; text/list/map columns
+stay host-side as Python object arrays until a vectorizer turns them into
+device arrays.
+
+Reference analogue: ``FeatureTypeSparkConverter`` / ``FeatureSparkTypes``
+(features/src/main/scala/com/salesforce/op/features/FeatureTypeSparkConverter.scala:44)
+which map each FeatureType to a Spark SQL storage type.  Here the mapping is to
+array layouts instead:
+
+    real/integral/binary/date  -> float64/int64 values + bool mask
+    text (incl. subtypes)      -> object ndarray of str|None
+    text_list/date_list        -> object ndarray of tuple
+    multi_pick_list            -> object ndarray of frozenset
+    geolocation                -> (N,3) float64 + bool mask
+    map                        -> object ndarray of dict
+    vector                     -> (N,D) float32 dense matrix (device-ready)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Type
+
+import numpy as np
+
+from .feature_types import (
+    FeatureType, OPVector, Prediction, RealMap, type_by_name,
+)
+
+__all__ = ["FeatureColumn", "ColumnarDataset"]
+
+_NUMERIC_STORAGE = ("real", "integral", "binary", "date")
+
+
+@dataclasses.dataclass
+class FeatureColumn:
+    """A batch of N values of one semantic feature type.
+
+    ``values``: layout depends on ``ftype.storage`` (see module docstring).
+    ``mask``: bool ndarray of shape (N,) — True where the value is present.
+              Always present for numeric storages; None for object storages
+              (presence is encoded in the objects themselves) and vectors.
+    """
+
+    ftype: Type[FeatureType]
+    values: Any
+    mask: Optional[np.ndarray] = None
+    #: for OPVector columns: per-slot provenance (ops.vector_metadata.VectorMetadata)
+    vmeta: Any = None
+
+    def __post_init__(self):
+        if self.ftype.storage in _NUMERIC_STORAGE and self.mask is None:
+            vals = np.asarray(self.values)
+            self.mask = ~np.isnan(vals) if vals.dtype.kind == "f" else np.ones(len(vals), bool)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def storage(self) -> str:
+        return self.ftype.storage
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_values(ftype: Type[FeatureType], raw: Sequence[Any]) -> "FeatureColumn":
+        """Build a column from Python values (None/NaN = missing).
+
+        This is the boundary where untyped host data becomes typed columnar
+        data — the analogue of ``FeatureTypeSparkConverter.fromSpark``.
+        """
+        st = ftype.storage
+        n = len(raw)
+        if st in ("real", "date"):
+            vals = np.array(
+                [np.nan if _is_missing(v) else float(v) for v in raw], dtype=np.float64
+            )
+            return FeatureColumn(ftype, vals, ~np.isnan(vals))
+        if st == "integral":
+            mask = np.array([not _is_missing(v) for v in raw], dtype=bool)
+            vals = np.array(
+                [0 if _is_missing(v) else int(v) for v in raw], dtype=np.int64
+            ).astype(np.float64)
+            return FeatureColumn(ftype, vals, mask)
+        if st == "binary":
+            mask = np.array([not _is_missing(v) for v in raw], dtype=bool)
+            vals = np.array(
+                [False if _is_missing(v) else bool(v) for v in raw], dtype=bool
+            ).astype(np.float64)
+            return FeatureColumn(ftype, vals, mask)
+        if st == "text":
+            arr = np.empty(n, dtype=object)
+            for i, v in enumerate(raw):
+                arr[i] = None if _is_missing(v) else str(v)
+            return FeatureColumn(ftype, arr)
+        if st in ("text_list", "date_list"):
+            arr = np.empty(n, dtype=object)
+            for i, v in enumerate(raw):
+                arr[i] = tuple(v) if v is not None else ()
+            return FeatureColumn(ftype, arr)
+        if st == "multi_pick_list":
+            arr = np.empty(n, dtype=object)
+            for i, v in enumerate(raw):
+                arr[i] = frozenset(v) if v is not None else frozenset()
+            return FeatureColumn(ftype, arr)
+        if st == "geolocation":
+            vals = np.full((n, 3), np.nan)
+            mask = np.zeros(n, dtype=bool)
+            for i, v in enumerate(raw):
+                if v is not None and len(v) == 3:
+                    vals[i] = v
+                    mask[i] = True
+            return FeatureColumn(ftype, vals, mask)
+        if st == "map":
+            arr = np.empty(n, dtype=object)
+            for i, v in enumerate(raw):
+                arr[i] = dict(v) if v is not None else {}
+            return FeatureColumn(ftype, arr)
+        if st == "vector":
+            return FeatureColumn(ftype, np.asarray(raw, dtype=np.float32))
+        raise ValueError(f"unknown storage {st!r} for {ftype.type_name()}")
+
+    # -- conversions --------------------------------------------------------
+
+    def to_list(self) -> List[Any]:
+        """Back to plain Python values (None for missing). For tests/local scoring."""
+        st = self.storage
+        if st in _NUMERIC_STORAGE:
+            out = []
+            for v, m in zip(np.asarray(self.values), np.asarray(self.mask)):
+                if not m:
+                    out.append(None)
+                elif st == "binary":
+                    out.append(bool(v))
+                elif st in ("integral", "date"):
+                    out.append(int(v))
+                else:
+                    out.append(float(v))
+            return out
+        if st == "geolocation":
+            return [
+                list(map(float, v)) if m else []
+                for v, m in zip(self.values, self.mask)
+            ]
+        if st == "vector":
+            return [np.asarray(v) for v in self.values]
+        return list(self.values)
+
+    def masked_values(self, fill: float = 0.0) -> np.ndarray:
+        """Numeric values with missing entries replaced by ``fill``."""
+        assert self.storage in _NUMERIC_STORAGE
+        vals = np.asarray(self.values, dtype=np.float64)
+        return np.where(np.asarray(self.mask), np.nan_to_num(vals), fill)
+
+    def take(self, idx: np.ndarray) -> "FeatureColumn":
+        mask = self.mask[idx] if self.mask is not None else None
+        return FeatureColumn(self.ftype, self.values[idx], mask, self.vmeta)
+
+
+def _is_missing(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    if isinstance(v, str) and v == "":
+        return True
+    return False
+
+
+class ColumnarDataset:
+    """An ordered {feature name -> FeatureColumn} batch — the working dataset.
+
+    Plays the role of the Spark DataFrame flowing through
+    ``FitStagesUtil.fitAndTransformDAG`` (reference FitStagesUtil.scala:212):
+    stages read input columns and attach new output columns.
+    """
+
+    def __init__(self, columns: Optional[Dict[str, FeatureColumn]] = None):
+        self.columns: Dict[str, FeatureColumn] = dict(columns or {})
+        lengths = {len(c) for c in self.columns.values()}
+        if len(lengths) > 1:
+            raise ValueError(f"ragged dataset: column lengths {lengths}")
+
+    # -- basic container ----------------------------------------------------
+
+    def __len__(self) -> int:
+        for c in self.columns.values():
+            return len(c)
+        return 0
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    def __getitem__(self, name: str) -> FeatureColumn:
+        return self.columns[name]
+
+    def set(self, name: str, col: FeatureColumn) -> None:
+        if self.columns and len(col) != len(self):
+            raise ValueError(
+                f"column {name!r} length {len(col)} != dataset length {len(self)}"
+            )
+        self.columns[name] = col
+
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def select(self, names: Iterable[str]) -> "ColumnarDataset":
+        return ColumnarDataset({n: self.columns[n] for n in names})
+
+    def drop(self, names: Iterable[str]) -> "ColumnarDataset":
+        dropset = set(names)
+        return ColumnarDataset(
+            {n: c for n, c in self.columns.items() if n not in dropset}
+        )
+
+    def take(self, idx: np.ndarray) -> "ColumnarDataset":
+        return ColumnarDataset({n: c.take(idx) for n, c in self.columns.items()})
+
+    def copy(self) -> "ColumnarDataset":
+        return ColumnarDataset(dict(self.columns))
+
+    # -- pandas bridge ------------------------------------------------------
+
+    @staticmethod
+    def from_pandas(df, schema: Dict[str, Type[FeatureType]]) -> "ColumnarDataset":
+        cols = {}
+        for name, ftype in schema.items():
+            cols[name] = FeatureColumn.from_values(ftype, df[name].tolist())
+        return ColumnarDataset(cols)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame({n: c.to_list() for n, c in self.columns.items()})

@@ -1,0 +1,46 @@
+"""Stage/feature UID generation.
+
+Reference: utils/src/main/scala/com/salesforce/op/UID.scala — UIDs of the form
+``ClassName_000000000001`` from a process-wide counter, with reset support for
+deterministic tests.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+from typing import Dict, Tuple
+
+_counter = itertools.count(1)
+_lock = threading.Lock()
+
+_UID_RE = re.compile(r"^(\w+)_(\w+)$")
+
+
+def uid_for(cls_or_name) -> str:
+    name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+    with _lock:
+        n = next(_counter)
+    return f"{name}_{n:012x}"
+
+
+def reset_uids(start: int = 1) -> None:
+    """Reset the counter (tests only)."""
+    global _counter
+    with _lock:
+        _counter = itertools.count(start)
+
+
+def parse_uid(uid: str) -> Tuple[str, str]:
+    m = _UID_RE.match(uid)
+    if not m:
+        raise ValueError(f"invalid uid {uid!r}")
+    return m.group(1), m.group(2)
+
+
+def count_uids(uids) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for u in uids:
+        name, _ = parse_uid(u)
+        out[name] = out.get(name, 0) + 1
+    return out

@@ -1,0 +1,188 @@
+"""Feature-DAG computation and layered execution.
+
+Reference: ``FitStagesUtil`` (core/.../utils/stages/FitStagesUtil.scala:173,212-300):
+``computeDAG`` layers the stage DAG topologically; ``fitAndTransformDAG``
+iterates layers, fitting estimators then bulk-applying the layer's
+transformers.
+
+TPU note: the reference bulk-applies each layer's row-UDFs as one Spark
+``select``; here each layer's columnar transforms run vectorized and the
+device-heavy stages (vectorizers/models) are jitted internally, so XLA does
+the fusion the reference got from Catalyst.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..features.feature import Feature, FeatureCycleError
+from ..stages.base import Estimator, Model, PipelineStage, Transformer
+from ..stages.generator import FeatureGeneratorStage
+from ..types.columns import ColumnarDataset
+
+__all__ = ["StagesDAG", "compute_dag", "fit_and_transform_dag", "transform_dag", "cut_dag"]
+
+
+class StagesDAG:
+    """Layers of stages, topologically ordered (layer 0 first = raw generators)."""
+
+    def __init__(self, layers: List[List[PipelineStage]]):
+        self.layers = layers
+
+    def all_stages(self) -> List[PipelineStage]:
+        return [s for layer in self.layers for s in layer]
+
+    def non_generator_layers(self) -> List[List[PipelineStage]]:
+        return [
+            [s for s in layer if not isinstance(s, FeatureGeneratorStage)]
+            for layer in self.layers
+        ]
+
+    def __repr__(self):
+        return f"StagesDAG({[len(l) for l in self.layers]} stages/layer)"
+
+
+def compute_dag(result_features: Sequence[Feature]) -> StagesDAG:
+    """Reconstruct + layer the stage DAG from result features.
+
+    Port of FitStagesUtil.computeDAG (FitStagesUtil.scala:173): stages are
+    grouped into layers by longest path from the raw generators, so every
+    stage appears after all its input producers.
+    """
+    # collect all stages reachable from result features (cycle-checked)
+    stages: Dict[str, PipelineStage] = {}
+    producers: Dict[str, PipelineStage] = {}  # feature uid -> producing stage
+
+    for rf in result_features:
+        def visit(f: Feature):
+            s = f.origin_stage
+            if s is None:
+                raise ValueError(f"feature {f.name!r} has no origin stage")
+            stages[s.uid] = s
+            producers[f.uid] = s
+
+        rf.traverse(visit)
+
+    # stage dependency edges: stage -> stages producing its inputs
+    depth: Dict[str, int] = {}
+
+    def stage_depth(s: PipelineStage, on_path: Tuple[str, ...] = ()) -> int:
+        if s.uid in depth:
+            return depth[s.uid]
+        if s.uid in on_path:
+            raise FeatureCycleError(f"cycle through stage {s.uid}")
+        if not s.input_features:
+            d = 0
+        else:
+            d = 0
+            for f in s.input_features:
+                p = f.origin_stage
+                if p is None:
+                    continue
+                stages.setdefault(p.uid, p)
+                d = max(d, 1 + stage_depth(p, on_path + (s.uid,)))
+        depth[s.uid] = d
+        return d
+
+    for s in list(stages.values()):
+        stage_depth(s)
+
+    n_layers = max(depth.values()) + 1 if depth else 0
+    layers: List[List[PipelineStage]] = [[] for _ in range(n_layers)]
+    # stable order: by first-seen insertion
+    for uid, s in stages.items():
+        layers[depth[uid]].append(s)
+    return StagesDAG(layers)
+
+
+def fit_and_transform_dag(
+    dag: StagesDAG,
+    train: ColumnarDataset,
+    apply_to: Optional[ColumnarDataset] = None,
+    fitted_substitutes: Optional[Dict[str, Model]] = None,
+) -> Tuple[List[PipelineStage], ColumnarDataset]:
+    """Fit estimators layer by layer, transforming as we go.
+
+    Port of FitStagesUtil.fitAndTransformDAG/fitAndTransformLayer
+    (FitStagesUtil.scala:212-300).  Returns (fitted stages in topo order,
+    transformed train data).  ``fitted_substitutes`` allows warm-start
+    (OpWorkflow.withModelStages parity): estimators whose uid appears there
+    are skipped and the fitted model used directly.
+    """
+    fitted_substitutes = fitted_substitutes or {}
+    fitted: List[PipelineStage] = []
+    data = train
+    for layer in dag.non_generator_layers():
+        for stage in layer:
+            if isinstance(stage, Estimator):
+                model = fitted_substitutes.get(stage.uid) or stage.fit(data)
+                fitted.append(model)
+                data = model.transform(data)
+                if apply_to is not None:
+                    apply_to = model.transform(apply_to)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                data = stage.transform(data)
+                if apply_to is not None:
+                    apply_to = stage.transform(apply_to)
+            else:
+                raise TypeError(f"cannot execute stage {stage!r}")
+    return fitted, data
+
+
+def transform_dag(
+    dag: StagesDAG, data: ColumnarDataset, up_to_feature: Optional[str] = None
+) -> ColumnarDataset:
+    """Apply an already-fitted DAG (scoring path; OpWorkflowCore.applyTransformationsDAG).
+
+    ``up_to_feature`` stops once the named feature is materialized
+    (OpWorkflow.computeDataUpTo parity).
+    """
+    for layer in dag.non_generator_layers():
+        for stage in layer:
+            if isinstance(stage, Estimator):
+                raise RuntimeError(
+                    f"unfitted estimator {stage.uid} in scoring DAG"
+                )
+            data = stage.transform(data)
+            if up_to_feature is not None and up_to_feature in data:
+                return data
+    return data
+
+
+def cut_dag(dag: StagesDAG, at_stage_uid: str) -> Tuple[StagesDAG, PipelineStage, StagesDAG]:
+    """Split the DAG at a stage (the ModelSelector) for workflow-level CV.
+
+    Port of FitStagesUtil.cutDAG (FitStagesUtil.scala:302): returns
+    (before-DAG, the stage itself, after-DAG).  Layers containing only the
+    target stage's ancestors go 'before'; the rest after.
+    """
+    before: List[List[PipelineStage]] = []
+    after: List[List[PipelineStage]] = []
+    target: Optional[PipelineStage] = None
+    # ancestor stage uids of the target
+    target_stage = None
+    for layer in dag.layers:
+        for s in layer:
+            if s.uid == at_stage_uid:
+                target_stage = s
+    if target_stage is None:
+        raise ValueError(f"stage {at_stage_uid} not in DAG")
+    ancestors: Set[str] = set()
+
+    def collect(s: PipelineStage):
+        for f in s.input_features:
+            p = f.origin_stage
+            if p is not None and p.uid not in ancestors:
+                ancestors.add(p.uid)
+                collect(p)
+
+    collect(target_stage)
+
+    for layer in dag.layers:
+        b = [s for s in layer if s.uid in ancestors]
+        a = [s for s in layer if s.uid not in ancestors and s.uid != at_stage_uid]
+        if b:
+            before.append(b)
+        if a:
+            after.append(a)
+    return StagesDAG(before), target_stage, StagesDAG(after)
