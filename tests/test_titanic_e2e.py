@@ -1,0 +1,104 @@
+"""End-to-end Titanic workflow — the reference's own headline demo.
+
+Parity target: OpTitanicSimple (helloworld/src/main/scala/com/salesforce/hw/
+OpTitanicSimple.scala:75-117) — LR grid AuPR 0.675-0.777, RF grid 0.778-0.810
+(reference README.md:63-78).  Uses the reference's test data read-only.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, grid,
+)
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.types import feature_types as ft
+
+TITANIC = "/root/reference/test-data/PassengerDataAll.csv"
+COLS = ["PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
+        "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked"]
+
+
+def load_titanic() -> pd.DataFrame:
+    if not os.path.exists(TITANIC):  # pragma: no cover
+        pytest.skip("titanic data unavailable")
+    return pd.read_csv(TITANIC, header=None, names=COLS)
+
+
+@pytest.fixture(scope="module")
+def titanic_df():
+    return load_titanic()
+
+
+def build_features():
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    pclass = FeatureBuilder.PickList("Pclass").as_predictor()
+    name = FeatureBuilder.Text("Name").as_predictor()
+    sex = FeatureBuilder.PickList("Sex").as_predictor()
+    age = FeatureBuilder.Real("Age").as_predictor()
+    sibsp = FeatureBuilder.Integral("SibSp").as_predictor()
+    parch = FeatureBuilder.Integral("Parch").as_predictor()
+    ticket = FeatureBuilder.PickList("Ticket").as_predictor()
+    fare = FeatureBuilder.Real("Fare").as_predictor()
+    cabin = FeatureBuilder.PickList("Cabin").as_predictor()
+    embarked = FeatureBuilder.PickList("Embarked").as_predictor()
+    predictors = [pclass, name, sex, age, sibsp, parch, ticket, fare,
+                  cabin, embarked]
+    return survived, predictors
+
+
+class TestTitanicEndToEnd:
+    def test_lr_workflow_aupr_in_reference_range(self, titanic_df):
+        survived, predictors = build_features()
+        features = transmogrify(predictors)
+        checked = SanityChecker(max_correlation=0.99).set_input(
+            survived, features).get_output()
+        selector = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3,
+            models_and_parameters=[
+                (OpLogisticRegression(), grid(
+                    reg_param=[0.001, 0.01, 0.1], elastic_net_param=[0.0])),
+            ])
+        prediction = selector.set_input(survived, checked).get_output()
+
+        wf = (OpWorkflow()
+              .set_result_features(prediction)
+              .set_input_data(titanic_df))
+        model = wf.train()
+
+        scored, metrics = model.score_and_evaluate(
+            Evaluators.BinaryClassification.auPR())
+        # reference LR demo: 0.675-0.777 AuPR (on a 90/10 split); full-data
+        # scoring should land at or above the bottom of that range
+        assert metrics["AuPR"] >= 0.65, metrics
+        assert metrics["AuROC"] >= 0.75, metrics
+
+        summary = model.summary()
+        sel_summary = next(
+            v["model_selector_summary"] for v in summary.values()
+            if "model_selector_summary" in v)
+        assert sel_summary["bestModelType"] == "OpLogisticRegression"
+        assert len(sel_summary["validationResults"]) == 3
+        holdout = sel_summary["holdoutMetrics"]
+        assert holdout["AuPR"] > 0.5
+        assert model.summary_pretty()
+
+    def test_sanity_checker_dropped_and_metadata(self, titanic_df):
+        survived, predictors = build_features()
+        features = transmogrify(predictors)
+        checked = SanityChecker().set_input(survived, features).get_output()
+        wf = OpWorkflow().set_result_features(checked).set_input_data(titanic_df)
+        model = wf.train()
+        scored = model.score(keep_intermediate_features=True,
+                             keep_raw_features=True)
+        col = scored[checked.name]
+        assert col.vmeta is not None
+        assert col.values.shape[1] == col.vmeta.size
+        # every slot traceable to a raw feature
+        parents = set(c.parent_feature for c in col.vmeta.columns)
+        assert parents <= {f.name for f in predictors}

@@ -1,0 +1,14 @@
+"""transmogrifai_tpu — a TPU-native AutoML framework for structured data.
+
+A ground-up JAX/XLA re-design of TransmogrifAI's capabilities (typed feature
+DAG, automated feature engineering/validation/model-selection, model insights,
+one-file persistence, lightweight local scoring) where the execution substrate
+is compiled XLA programs over device-resident columnar batches instead of
+Spark jobs over row RDDs.
+"""
+
+__version__ = "0.1.0"
+
+from .features import Feature, FeatureBuilder  # noqa: F401
+from .ops.transmogrify import transmogrify  # noqa: F401
+from .workflow.workflow import OpWorkflow, OpWorkflowModel  # noqa: F401

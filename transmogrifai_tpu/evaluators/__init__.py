@@ -1,0 +1,6 @@
+from .evaluators import (  # noqa: F401
+    Evaluators, OpBinaryClassificationEvaluator,
+    OpMultiClassificationEvaluator, OpRegressionEvaluator,
+    OpForecastEvaluator, OpBinScoreEvaluator,
+)
+from . import metrics  # noqa: F401

@@ -1,0 +1,284 @@
+"""Evaluator stages.
+
+Reference: ``OpEvaluatorBase`` hierarchy (core/.../evaluators/OpEvaluatorBase.scala:113),
+``OpBinaryClassificationEvaluator`` (:56), ``OpMultiClassificationEvaluator``,
+``OpRegressionEvaluator``, ``OpForecastEvaluator``, ``OpBinScoreEvaluator``
+(OpBinScoreEvaluator.scala:53), and the ``Evaluators`` factory
+(Evaluators.scala:40-240).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..types.columns import ColumnarDataset, FeatureColumn
+from .metrics import (
+    binary_classification_metrics, forecast_metrics, multiclass_metrics,
+    regression_metrics, threshold_curves,
+)
+
+__all__ = [
+    "OpEvaluatorBase", "OpBinaryClassificationEvaluator",
+    "OpMultiClassificationEvaluator", "OpRegressionEvaluator",
+    "OpForecastEvaluator", "OpBinScoreEvaluator", "Evaluators",
+]
+
+
+def _label_scores(data: ColumnarDataset, label_name: str, pred_name: str):
+    y = np.nan_to_num(np.asarray(data[label_name].values, np.float64))
+    batch = data[pred_name].values
+    return y, batch
+
+
+class OpEvaluatorBase:
+    """Computes {metric name -> value} from (label, prediction) columns."""
+
+    #: the single metric used for model selection (lower-is-better if
+    #: ``larger_better`` False)
+    default_metric: str = ""
+    larger_better: bool = True
+
+    def __init__(self, label_col: Optional[str] = None,
+                 prediction_col: Optional[str] = None):
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+
+    def evaluate(self, data: ColumnarDataset,
+                 sample_weight=None) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def evaluate_default(self, data: ColumnarDataset,
+                         sample_weight=None) -> float:
+        return self.evaluate(data, sample_weight)[self.default_metric]
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class OpBinaryClassificationEvaluator(OpEvaluatorBase):
+    default_metric = "AuPR"  # reference default for binary selection
+
+    def __init__(self, label_col=None, prediction_col=None,
+                 threshold: float = 0.5, n_thresholds: int = 0):
+        super().__init__(label_col, prediction_col)
+        self.threshold = threshold
+        self.n_thresholds = n_thresholds
+
+    def evaluate(self, data, sample_weight=None):
+        y, batch = _label_scores(data, self.label_col, self.prediction_col)
+        if getattr(batch, "probability", None) is not None:
+            score = np.asarray(batch.probability)[:, 1]
+        elif getattr(batch, "raw_prediction", None) is not None:
+            score = np.asarray(batch.raw_prediction)[:, 1]
+        else:
+            score = np.asarray(batch.prediction, np.float64)
+        out = binary_classification_metrics(y, score, sample_weight,
+                                            self.threshold)
+        if self.n_thresholds:
+            curves = threshold_curves(y, score, self.n_thresholds,
+                                      sample_weight)
+            out.update({k: v.tolist() for k, v in curves.items()
+                        if k != "thresholds"})
+        return out
+
+
+class OpMultiClassificationEvaluator(OpEvaluatorBase):
+    default_metric = "F1"
+
+    def evaluate(self, data, sample_weight=None):
+        y, batch = _label_scores(data, self.label_col, self.prediction_col)
+        pred = np.asarray(batch.prediction, np.float64)
+        n_classes = int(max(y.max(), pred.max())) + 1
+        out = multiclass_metrics(y.astype(int), pred.astype(int), n_classes,
+                                 sample_weight)
+        conf = out.pop("confusion")
+        out["confusionMatrix"] = np.asarray(conf).tolist()
+        if getattr(batch, "probability", None) is not None:
+            p = np.clip(np.asarray(batch.probability), 1e-15, 1.0)
+            idx = np.clip(y.astype(int), 0, p.shape[1] - 1)
+            out["LogLoss"] = float(
+                -np.mean(np.log(p[np.arange(len(y)), idx])))
+        return out
+
+
+class OpRegressionEvaluator(OpEvaluatorBase):
+    default_metric = "RootMeanSquaredError"
+    larger_better = False
+
+    def evaluate(self, data, sample_weight=None):
+        y, batch = _label_scores(data, self.label_col, self.prediction_col)
+        return regression_metrics(y, np.asarray(batch.prediction, np.float64),
+                                  sample_weight)
+
+
+class OpForecastEvaluator(OpEvaluatorBase):
+    default_metric = "SMAPE"
+    larger_better = False
+
+    def __init__(self, label_col=None, prediction_col=None,
+                 seasonal_period: int = 1):
+        super().__init__(label_col, prediction_col)
+        self.seasonal_period = seasonal_period
+
+    def evaluate(self, data, sample_weight=None):
+        y, batch = _label_scores(data, self.label_col, self.prediction_col)
+        return forecast_metrics(y, np.asarray(batch.prediction, np.float64),
+                                self.seasonal_period)
+
+
+class OpBinScoreEvaluator(OpEvaluatorBase):
+    """Calibration-bin diagnostics (OpBinScoreEvaluator.scala:53)."""
+
+    default_metric = "BrierScore"
+    larger_better = False
+
+    def __init__(self, label_col=None, prediction_col=None, num_bins: int = 100):
+        super().__init__(label_col, prediction_col)
+        self.num_bins = num_bins
+
+    def evaluate(self, data, sample_weight=None):
+        y, batch = _label_scores(data, self.label_col, self.prediction_col)
+        score = (np.asarray(batch.probability)[:, 1]
+                 if getattr(batch, "probability", None) is not None
+                 else np.asarray(batch.prediction, np.float64))
+        bins = np.clip((score * self.num_bins).astype(int), 0,
+                       self.num_bins - 1)
+        counts = np.bincount(bins, minlength=self.num_bins)
+        sum_scores = np.bincount(bins, weights=score, minlength=self.num_bins)
+        sum_labels = np.bincount(bins, weights=y, minlength=self.num_bins)
+        nz = np.maximum(counts, 1)
+        avg_score = sum_scores / nz
+        avg_conv = sum_labels / nz
+        brier = float(np.mean((score - y) ** 2))
+        return {
+            "BrierScore": brier,
+            "binCenters": ((np.arange(self.num_bins) + 0.5) / self.num_bins).tolist(),
+            "numberOfDataPoints": counts.tolist(),
+            "averageScore": avg_score.tolist(),
+            "averageConversionRate": avg_conv.tolist(),
+        }
+
+
+class Evaluators:
+    """Factory catalogue (Evaluators.scala:40-240)."""
+
+    class BinaryClassification:
+        @staticmethod
+        def auPR():
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "AuPR"
+            return ev
+
+        @staticmethod
+        def auROC():
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "AuROC"
+            return ev
+
+        @staticmethod
+        def precision():
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "Precision"
+            return ev
+
+        @staticmethod
+        def recall():
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "Recall"
+            return ev
+
+        @staticmethod
+        def f1():
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "F1"
+            return ev
+
+        @staticmethod
+        def error():
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "Error"
+            ev.larger_better = False
+            return ev
+
+        @staticmethod
+        def brierScore():
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "BrierScore"
+            ev.larger_better = False
+            return ev
+
+        @staticmethod
+        def custom(metric_name: str, larger_better: bool,
+                   fn: Callable[[np.ndarray, np.ndarray], float]):
+            ev = _CustomBinaryEvaluator(metric_name=metric_name, fn=fn)
+            ev.larger_better = larger_better
+            return ev
+
+    class MultiClassification:
+        @staticmethod
+        def f1():
+            ev = OpMultiClassificationEvaluator()
+            ev.default_metric = "F1"
+            return ev
+
+        @staticmethod
+        def precision():
+            ev = OpMultiClassificationEvaluator()
+            ev.default_metric = "Precision"
+            return ev
+
+        @staticmethod
+        def recall():
+            ev = OpMultiClassificationEvaluator()
+            ev.default_metric = "Recall"
+            return ev
+
+        @staticmethod
+        def error():
+            ev = OpMultiClassificationEvaluator()
+            ev.default_metric = "Error"
+            ev.larger_better = False
+            return ev
+
+    class Regression:
+        @staticmethod
+        def rmse():
+            ev = OpRegressionEvaluator()
+            ev.default_metric = "RootMeanSquaredError"
+            return ev
+
+        @staticmethod
+        def mse():
+            ev = OpRegressionEvaluator()
+            ev.default_metric = "MeanSquaredError"
+            return ev
+
+        @staticmethod
+        def mae():
+            ev = OpRegressionEvaluator()
+            ev.default_metric = "MeanAbsoluteError"
+            return ev
+
+        @staticmethod
+        def r2():
+            ev = OpRegressionEvaluator()
+            ev.default_metric = "R2"
+            ev.larger_better = True
+            return ev
+
+
+class _CustomBinaryEvaluator(OpEvaluatorBase):
+    def __init__(self, metric_name: str, fn, label_col=None,
+                 prediction_col=None):
+        super().__init__(label_col, prediction_col)
+        self.default_metric = metric_name
+        self.fn = fn
+
+    def evaluate(self, data, sample_weight=None):
+        y, batch = _label_scores(data, self.label_col, self.prediction_col)
+        score = (np.asarray(batch.probability)[:, 1]
+                 if getattr(batch, "probability", None) is not None
+                 else np.asarray(batch.prediction, np.float64))
+        return {self.default_metric: float(self.fn(y, score))}

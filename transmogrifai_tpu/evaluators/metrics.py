@@ -1,0 +1,210 @@
+"""Metric kernels (JAX, sort-based, static shapes).
+
+Reference: OpBinaryClassificationEvaluator (AuROC, AuPR, precision/recall/F1,
+Brier, threshold metrics — core/.../evaluators/OpBinaryClassificationEvaluator.scala:56,192-223),
+OpMultiClassificationEvaluator, OpRegressionEvaluator, OpForecastEvaluator
+(SMAPE/MASE).
+
+All binary metrics are computed from one descending sort of the scores —
+the TPU-friendly replacement for Spark's `BinaryClassificationMetrics`
+thresholded RDD sweeps.  Weighted variants support the CV fold-mask design.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "auroc", "aupr", "binary_metrics_at_threshold", "brier_score", "log_loss",
+    "binary_classification_metrics", "multiclass_metrics",
+    "regression_metrics", "forecast_metrics", "threshold_curves",
+]
+
+
+def _weights(y, w):
+    y = jnp.asarray(y, jnp.float32)
+    if w is None:
+        w = jnp.ones_like(y)
+    else:
+        w = jnp.asarray(w, jnp.float32)
+    return y, w
+
+
+@jax.jit
+def auroc(y_true, y_score, sample_weight=None) -> jnp.ndarray:
+    """Weighted AUC = P(s+ > s-) + 0.5 P(s+ = s-), computed over score tie
+    groups with segment sums (one device sort, static shapes)."""
+    y, w = _weights(y_true, sample_weight)
+    s = jnp.asarray(y_score, jnp.float32)
+    n = s.shape[0]
+    order = jnp.argsort(s)
+    s_sorted = s[order]
+    wy = (w * y)[order]
+    wn = (w * (1 - y))[order]
+    is_new = jnp.concatenate([jnp.ones(1, bool), s_sorted[1:] != s_sorted[:-1]])
+    gid = jnp.cumsum(is_new) - 1  # tie-group id per element
+    pos_g = jax.ops.segment_sum(wy, gid, num_segments=n)
+    neg_g = jax.ops.segment_sum(wn, gid, num_segments=n)
+    neg_below = jnp.cumsum(neg_g) - neg_g
+    w_pos = jnp.sum(wy)
+    w_neg = jnp.sum(wn)
+    num = jnp.sum(pos_g * (neg_below + 0.5 * neg_g))
+    return jnp.clip(num / jnp.maximum(w_pos * w_neg, 1e-12), 0.0, 1.0)
+
+
+@jax.jit
+def aupr(y_true, y_score, sample_weight=None) -> jnp.ndarray:
+    """Area under precision-recall via descending-score sweep, linear
+    interpolation in recall (matches sklearn/Spark average-precision style)."""
+    y, w = _weights(y_true, sample_weight)
+    s = jnp.asarray(y_score, jnp.float32)
+    n = s.shape[0]
+    order = jnp.argsort(-s)
+    s_sorted = s[order]
+    wy = (w * y)[order]
+    ww = w[order]
+    # evaluate precision/recall only at distinct-threshold boundaries
+    is_new = jnp.concatenate([jnp.ones(1, bool), s_sorted[1:] != s_sorted[:-1]])
+    gid = jnp.cumsum(is_new) - 1
+    pos_g = jax.ops.segment_sum(wy, gid, num_segments=n)
+    tot_g = jax.ops.segment_sum(ww, gid, num_segments=n)
+    tp = jnp.cumsum(pos_g)
+    all_pred = jnp.cumsum(tot_g)
+    pos = jnp.maximum(jnp.sum(wy), 1e-12)
+    precision = tp / jnp.maximum(all_pred, 1e-12)
+    dr = pos_g / pos
+    return jnp.clip(jnp.sum(dr * precision), 0.0, 1.0)
+
+
+@jax.jit
+def binary_metrics_at_threshold(y_true, y_score, threshold=0.5,
+                                sample_weight=None):
+    y, w = _weights(y_true, sample_weight)
+    s = jnp.asarray(y_score, jnp.float32)
+    pred = (s >= threshold).astype(jnp.float32)
+    tp = jnp.sum(w * pred * y)
+    fp = jnp.sum(w * pred * (1 - y))
+    fn = jnp.sum(w * (1 - pred) * y)
+    tn = jnp.sum(w * (1 - pred) * (1 - y))
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    error = (fp + fn) / jnp.maximum(tp + fp + fn + tn, 1e-12)
+    return {"Precision": precision, "Recall": recall, "F1": f1,
+            "Error": error, "TP": tp, "TN": tn, "FP": fp, "FN": fn}
+
+
+@jax.jit
+def brier_score(y_true, y_prob, sample_weight=None):
+    y, w = _weights(y_true, sample_weight)
+    p = jnp.asarray(y_prob, jnp.float32)
+    return jnp.sum(w * (p - y) ** 2) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+@jax.jit
+def log_loss(y_true, y_prob, sample_weight=None, eps: float = 1e-15):
+    y, w = _weights(y_true, sample_weight)
+    p = jnp.clip(jnp.asarray(y_prob, jnp.float32), eps, 1 - eps)
+    ll = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+    return jnp.sum(w * ll) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def binary_classification_metrics(y_true, y_prob, sample_weight=None,
+                                  threshold: float = 0.5) -> Dict[str, float]:
+    """Full binary metric set (OpBinaryClassificationEvaluator parity)."""
+    at_t = binary_metrics_at_threshold(y_true, y_prob, threshold, sample_weight)
+    out = {
+        "AuROC": float(auroc(y_true, y_prob, sample_weight)),
+        "AuPR": float(aupr(y_true, y_prob, sample_weight)),
+        "BrierScore": float(brier_score(y_true, y_prob, sample_weight)),
+        "LogLoss": float(log_loss(y_true, y_prob, sample_weight)),
+    }
+    out.update({k: float(v) for k, v in at_t.items()})
+    return out
+
+
+def threshold_curves(y_true, y_prob, n_thresholds: int = 100,
+                     sample_weight=None) -> Dict[str, np.ndarray]:
+    """Precision/recall/F1 across a threshold sweep (thresholdMetrics parity)."""
+    ts = np.linspace(0.0, 1.0, n_thresholds)
+    f = jax.jit(jax.vmap(
+        lambda t: binary_metrics_at_threshold(y_true, y_prob, t, sample_weight)
+    ))
+    res = f(jnp.asarray(ts, jnp.float32))
+    return {"thresholds": ts,
+            "precisionByThreshold": np.asarray(res["Precision"]),
+            "recallByThreshold": np.asarray(res["Recall"]),
+            "f1ByThreshold": np.asarray(res["F1"])}
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def _multiclass_core(y_true, y_pred, n_classes, sample_weight=None):
+    y = jnp.asarray(y_true, jnp.int32)
+    p = jnp.asarray(y_pred, jnp.int32)
+    w = (jnp.ones(y.shape[0], jnp.float32) if sample_weight is None
+         else jnp.asarray(sample_weight, jnp.float32))
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    correct = (y == p).astype(jnp.float32)
+    acc = jnp.sum(w * correct) / wsum
+    conf = jnp.zeros((n_classes, n_classes), jnp.float32).at[y, p].add(w)
+    tp = jnp.diag(conf)
+    support = conf.sum(axis=1)
+    pred_count = conf.sum(axis=0)
+    prec_k = tp / jnp.maximum(pred_count, 1e-12)
+    rec_k = tp / jnp.maximum(support, 1e-12)
+    f1_k = 2 * prec_k * rec_k / jnp.maximum(prec_k + rec_k, 1e-12)
+    wts = support / wsum
+    return {
+        "Accuracy": acc,
+        "Error": 1.0 - acc,
+        "Precision": jnp.sum(wts * prec_k),
+        "Recall": jnp.sum(wts * rec_k),
+        "F1": jnp.sum(wts * f1_k),
+        "confusion": conf,
+    }
+
+
+def multiclass_metrics(y_true, y_pred, n_classes: int,
+                       sample_weight=None) -> Dict[str, float]:
+    res = _multiclass_core(y_true, y_pred, n_classes, sample_weight)
+    return {k: (float(v) if k != "confusion" else np.asarray(v))
+            for k, v in res.items()}
+
+
+@jax.jit
+def _regression_core(y_true, y_pred, sample_weight=None):
+    y, w = _weights(y_true, sample_weight)
+    p = jnp.asarray(y_pred, jnp.float32)
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    err = p - y
+    mse = jnp.sum(w * err ** 2) / wsum
+    mae = jnp.sum(w * jnp.abs(err)) / wsum
+    ym = jnp.sum(w * y) / wsum
+    ss_tot = jnp.sum(w * (y - ym) ** 2)
+    ss_res = jnp.sum(w * err ** 2)
+    r2 = 1.0 - ss_res / jnp.maximum(ss_tot, 1e-12)
+    return {"RootMeanSquaredError": jnp.sqrt(mse), "MeanSquaredError": mse,
+            "MeanAbsoluteError": mae, "R2": r2}
+
+
+def regression_metrics(y_true, y_pred, sample_weight=None) -> Dict[str, float]:
+    return {k: float(v) for k, v in _regression_core(y_true, y_pred, sample_weight).items()}
+
+
+def forecast_metrics(y_true, y_pred, seasonal_period: int = 1) -> Dict[str, float]:
+    """SMAPE + MASE (OpForecastEvaluator parity)."""
+    y = np.asarray(y_true, np.float64)
+    p = np.asarray(y_pred, np.float64)
+    smape = float(np.mean(
+        2.0 * np.abs(p - y) / np.maximum(np.abs(p) + np.abs(y), 1e-12)))
+    m = seasonal_period
+    if len(y) > m:
+        scale = np.mean(np.abs(y[m:] - y[:-m]))
+        mase = float(np.mean(np.abs(p - y)) / max(scale, 1e-12))
+    else:
+        mase = float("nan")
+    return {"SMAPE": smape, "MASE": mase}

@@ -1,0 +1,14 @@
+from .classification import (  # noqa: F401
+    OpLogisticRegression, OpLinearSVC, OpNaiveBayes,
+)
+from .regression import (  # noqa: F401
+    OpLinearRegression, OpGeneralizedLinearRegression,
+    IsotonicRegressionCalibrator,
+)
+from .trees import (  # noqa: F401
+    OpRandomForestClassifier, OpRandomForestRegressor,
+    OpGBTClassifier, OpGBTRegressor,
+    OpDecisionTreeClassifier, OpDecisionTreeRegressor,
+    OpXGBoostClassifier, OpXGBoostRegressor,
+)
+from .prediction import PredictionBatch, PredictorEstimator, PredictorModel  # noqa: F401

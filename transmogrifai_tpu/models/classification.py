@@ -1,0 +1,231 @@
+"""Classification model stages (XLA-trained).
+
+Reference wrappers (core/.../impl/classification/): OpLogisticRegression
+(OpLogisticRegression.scala:46), OpLinearSVC (:47), OpNaiveBayes (:46),
+OpMultilayerPerceptronClassifier (:48).  Tree/boosted models live in
+``models.trees``.
+
+Each estimator takes (label RealNN, features OPVector) and yields a fitted
+``PredictorModel`` producing a ``Prediction`` column — same contract as the
+reference's OpPredictorWrapper pipeline.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types.columns import ColumnarDataset, FeatureColumn
+from .linear import (
+    fit_linear_svc, fit_logistic_regression, fit_multinomial_logreg,
+    fit_naive_bayes, logreg_predict_proba, naive_bayes_predict_log_proba,
+    softmax_predict_proba, svc_decision,
+)
+from .prediction import PredictionBatch, PredictorEstimator, PredictorModel
+
+__all__ = [
+    "OpLogisticRegression", "LogisticRegressionModel",
+    "OpLinearSVC", "LinearSVCModel",
+    "OpNaiveBayes", "NaiveBayesModel",
+]
+
+
+def _extract_xy(label_col: FeatureColumn, features_col: FeatureColumn):
+    X = np.asarray(features_col.values, dtype=np.float32)
+    y = np.asarray(label_col.values, dtype=np.float32)
+    return X, np.nan_to_num(y)
+
+
+class OpLogisticRegression(PredictorEstimator):
+    """L2/elastic-net logistic regression trained by jitted Newton-IRLS.
+
+    Param names follow Spark's (regParam, elasticNetParam, maxIter, tol,
+    fitIntercept) so default grids transfer verbatim
+    (DefaultSelectorParams.scala:36-75).
+    """
+
+    def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
+                 max_iter: int = 50, tol: float = 1e-6,
+                 fit_intercept: bool = True, standardization: bool = True,
+                 sample_weight_col: Optional[str] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="logreg", uid=uid)
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.max_iter = max_iter
+        self.tol = tol
+        self.fit_intercept = fit_intercept
+        self.standardization = standardization
+        self.sample_weight_col = sample_weight_col
+
+    def fit_columns(self, data: ColumnarDataset, label_col, features_col):
+        X, y = _extract_xy(label_col, features_col)
+        w = None
+        if self.sample_weight_col and self.sample_weight_col in data:
+            w = np.asarray(data[self.sample_weight_col].values, np.float32)
+        return self.fit_raw(X, y, w)
+
+    def fit_raw(self, X: np.ndarray, y: np.ndarray,
+                w: Optional[np.ndarray] = None):
+        classes = np.unique(y[~np.isnan(y)]).astype(int)
+        n_classes = max(int(classes.max()) + 1 if len(classes) else 2, 2)
+        mu, sigma = _standardize_stats(X, w) if self.standardization else (None, None)
+        Xs = _apply_standardize(X, mu, sigma)
+        if n_classes <= 2:
+            fit = fit_logistic_regression(
+                Xs, y, sample_weight=w, reg_param=self.reg_param,
+                elastic_net_param=self.elastic_net_param,
+                max_iter=self.max_iter, tol=self.tol,
+                fit_intercept=self.fit_intercept)
+            coef, intercept = _unstandardize(
+                np.asarray(fit.coef), float(np.asarray(fit.intercept)), mu, sigma)
+            return LogisticRegressionModel(
+                coef=coef.tolist(), intercept=float(intercept))
+        fit = fit_multinomial_logreg(
+            Xs, y.astype(np.int32), n_classes=n_classes, sample_weight=w,
+            reg_param=self.reg_param, elastic_net_param=self.elastic_net_param,
+            max_iter=self.max_iter, tol=self.tol,
+            fit_intercept=self.fit_intercept)
+        coefs, intercepts = [], []
+        for k in range(n_classes):
+            c, i = _unstandardize(np.asarray(fit.coef)[k],
+                                  float(np.asarray(fit.intercept)[k]), mu, sigma)
+            coefs.append(c.tolist())
+            intercepts.append(float(i))
+        return LogisticRegressionModel(coef=coefs, intercept=intercepts)
+
+
+def _standardize_stats(X, w):
+    if w is None:
+        mu = X.mean(axis=0)
+        sigma = X.std(axis=0)
+    else:
+        ws = max(w.sum(), 1e-12)
+        mu = (w[:, None] * X).sum(axis=0) / ws
+        sigma = np.sqrt((w[:, None] * (X - mu) ** 2).sum(axis=0) / ws)
+    sigma = np.where(sigma < 1e-12, 1.0, sigma)
+    return mu.astype(np.float32), sigma.astype(np.float32)
+
+
+def _apply_standardize(X, mu, sigma):
+    if mu is None:
+        return X
+    return (X - mu) / sigma
+
+
+def _unstandardize(coef, intercept, mu, sigma):
+    """Map standardized-space coefficients back to raw feature space."""
+    if mu is None:
+        return coef, intercept
+    raw = coef / sigma
+    return raw, intercept - float(np.dot(raw, mu))
+
+
+class LogisticRegressionModel(PredictorModel):
+    """Binary: coef (D,); multinomial: coef (K, D) + intercept list."""
+
+    def __init__(self, coef, intercept, uid: Optional[str] = None):
+        super().__init__(operation_name="logreg", uid=uid)
+        self.coef = coef
+        self.intercept = intercept
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        coef = jnp.asarray(self.coef, jnp.float32)
+        if coef.ndim == 1:
+            proba, raw = logreg_predict_proba(
+                coef, jnp.float32(self.intercept), X)
+            proba = np.asarray(proba)
+            return PredictionBatch(
+                prediction=(proba[:, 1] >= 0.5).astype(np.float64),
+                raw_prediction=np.asarray(raw),
+                probability=proba)
+        proba, raw = softmax_predict_proba(
+            coef, jnp.asarray(self.intercept, jnp.float32), X)
+        proba = np.asarray(proba)
+        return PredictionBatch(
+            prediction=proba.argmax(axis=1).astype(np.float64),
+            raw_prediction=np.asarray(raw),
+            probability=proba)
+
+
+class OpLinearSVC(PredictorEstimator):
+    """Squared-hinge linear SVM via jitted Newton (OpLinearSVC parity)."""
+
+    def __init__(self, reg_param: float = 1e-4, max_iter: int = 100,
+                 tol: float = 1e-6, fit_intercept: bool = True,
+                 standardization: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="linsvc", uid=uid)
+        self.reg_param = reg_param
+        self.max_iter = max_iter
+        self.tol = tol
+        self.fit_intercept = fit_intercept
+        self.standardization = standardization
+
+    def fit_columns(self, data: ColumnarDataset, label_col, features_col):
+        X, y = _extract_xy(label_col, features_col)
+        return self.fit_raw(X, y)
+
+    def fit_raw(self, X: np.ndarray, y: np.ndarray,
+                w: Optional[np.ndarray] = None):
+        mu, sigma = _standardize_stats(X, w) if self.standardization else (None, None)
+        fit = fit_linear_svc(
+            _apply_standardize(X, mu, sigma), y, sample_weight=w,
+            reg_param=self.reg_param,
+            max_iter=self.max_iter, tol=self.tol,
+            fit_intercept=self.fit_intercept)
+        coef, intercept = _unstandardize(
+            np.asarray(fit.coef), float(np.asarray(fit.intercept)), mu, sigma)
+        return LinearSVCModel(coef=coef.tolist(), intercept=float(intercept))
+
+
+class LinearSVCModel(PredictorModel):
+    def __init__(self, coef: List[float], intercept: float,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="linsvc", uid=uid)
+        self.coef = coef
+        self.intercept = intercept
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        z = np.asarray(svc_decision(jnp.asarray(self.coef, jnp.float32),
+                                    jnp.float32(self.intercept), X))
+        raw = np.stack([-z, z], axis=1)
+        return PredictionBatch(prediction=(z >= 0).astype(np.float64),
+                               raw_prediction=raw)
+
+
+class OpNaiveBayes(PredictorEstimator):
+    """Multinomial naive Bayes (OpNaiveBayes parity, smoothing=1.0)."""
+
+    def __init__(self, smoothing: float = 1.0, uid: Optional[str] = None):
+        super().__init__(operation_name="naivebayes", uid=uid)
+        self.smoothing = smoothing
+
+    def fit_columns(self, data: ColumnarDataset, label_col, features_col):
+        X, y = _extract_xy(label_col, features_col)
+        return self.fit_raw(X, y)
+
+    def fit_raw(self, X: np.ndarray, y: np.ndarray,
+                w: Optional[np.ndarray] = None):
+        classes = np.unique(y)
+        n_classes = max(int(classes.max()) + 1 if len(classes) else 2, 2)
+        log_prior, log_lik = fit_naive_bayes(
+            X, y.astype(np.int32), n_classes=n_classes, sample_weight=w,
+            smoothing=self.smoothing)
+        return NaiveBayesModel(log_prior=np.asarray(log_prior).tolist(),
+                               log_lik=np.asarray(log_lik).tolist())
+
+
+class NaiveBayesModel(PredictorModel):
+    def __init__(self, log_prior, log_lik, uid: Optional[str] = None):
+        super().__init__(operation_name="naivebayes", uid=uid)
+        self.log_prior = log_prior
+        self.log_lik = log_lik
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        logp = np.asarray(naive_bayes_predict_log_proba(
+            jnp.asarray(self.log_prior, jnp.float32),
+            jnp.asarray(self.log_lik, jnp.float32), X))
+        proba = np.exp(logp)
+        return PredictionBatch(prediction=proba.argmax(axis=1).astype(np.float64),
+                               raw_prediction=logp, probability=proba)

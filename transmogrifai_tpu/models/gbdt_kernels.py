@@ -1,0 +1,249 @@
+"""Histogram decision-tree kernels in pure JAX — the TPU replacement for
+XGBoost's C++ histogram GBDT core.
+
+Reference dependency being replaced: xgboost4j JNI (SURVEY §2.11 — the one
+genuinely native component of the reference; wrappers
+OpXGBoostClassifier.scala:47 / OpXGBoostRegressor.scala:48) and Spark MLlib's
+RandomForest/GBT (OpRandomForestClassifier.scala:58, OpGBTClassifier.scala:46).
+
+Design (gpu_hist-style, adapted to XLA):
+ * features pre-quantized to ``max_bins`` integer bins (quantile sketch on a
+   sample, host-side; binned matrix lives in HBM as int8/int32)
+ * trees grow level-wise; every level is one jitted kernel:
+     - histogram: scatter-add of [grad(K), hess(K), count] into
+       (nodes, D, B, 2K+1) via one flattened ``.at[].add`` — XLA lowers this
+       to an efficient sort/segment pattern on TPU
+     - split search: cumulative sums over bins -> best (feature, bin) per
+       node by the standard gain formula  GL²/(HL+λ) + GR²/(HR+λ) − G²/(H+λ)
+     - partition: rows move to ``2*node + go_right`` (no data movement — just
+       an int vector update)
+ * the tree is a *full* binary tree of ``max_depth`` levels in heap layout;
+   nodes that fail min-gain/min-weight constraints emit an "always left"
+   split (threshold = B), which keeps every shape static — no ragged trees,
+   no recompilation across rounds/trees (SURVEY §7 hard part a).
+ * multi-output targets (K>1) support multiclass GBDT (softmax, K trees'
+   worth of leaf values per round in one pass) and RF classification
+   (leaf = class histogram; variance gain over one-hot targets ≡ Gini gain).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["TreeEnsemble", "quantile_bins", "apply_bins", "grow_tree",
+           "predict_tree", "predict_ensemble"]
+
+
+class TreeEnsemble(NamedTuple):
+    """Stacked trees: feat (T, 2^d-1) int32, thresh (T, 2^d-1) int32,
+    leaf (T, 2^d, K) float32.  Heap layout: node i children 2i+1, 2i+2."""
+    feat: jnp.ndarray
+    thresh: jnp.ndarray
+    leaf: jnp.ndarray
+
+    @property
+    def max_depth(self) -> int:
+        # feat has 2^d - 1 internal nodes
+        return int(np.log2(self.feat.shape[1] + 1))
+
+
+# ---------------------------------------------------------------------------
+# Quantile binning
+# ---------------------------------------------------------------------------
+
+def quantile_bins(X: np.ndarray, max_bins: int = 32,
+                  sample_rows: int = 200_000, seed: int = 7) -> np.ndarray:
+    """Per-feature quantile bin edges, shape (D, max_bins-1).
+
+    Host-side on a row sample (the analogue of XGBoost's sketch); edges are
+    deduplicated so constant/low-cardinality features waste no bins.
+    """
+    X = np.asarray(X)
+    n, d = X.shape
+    if n > sample_rows:
+        rng = np.random.default_rng(seed)
+        X = X[rng.choice(n, sample_rows, replace=False)]
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    edges = np.quantile(X, qs, axis=0).T.astype(np.float32)  # (D, B-1)
+    # strictly increasing edges; collapse duplicates to +inf (unused bins)
+    eps = 1e-7
+    for j in range(d):
+        e = edges[j]
+        dup = np.concatenate([[False], np.diff(e) <= eps])
+        edges[j] = np.where(dup, np.inf, e)
+    return edges
+
+
+@jax.jit
+def apply_bins(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """Quantized matrix (N, D) int32 in [0, B)."""
+    X = jnp.asarray(X, jnp.float32)
+    # count of edges <= x  (edges padded with +inf never trigger)
+    return jnp.sum(X[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Level kernel
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def _level_kernel(binned, node, G, H, C, feat_mask, n_nodes: int,
+                  n_bins: int, lam, min_child_weight, min_info_gain,
+                  min_instances):
+    """One level of growth for all ``n_nodes`` nodes simultaneously.
+
+    Returns (feat (M,), thresh (M,), new node assignment (N,)).
+    G,H: (N, K) grad/hess channels; C: (N,) count weights.
+    """
+    n, d = binned.shape
+    k = G.shape[1]
+    nch = 2 * k + 1
+    M = n_nodes
+    B = n_bins
+
+    # --- histogram: one scatter-add over (M*D*B) cells x channels ----------
+    chans = jnp.concatenate([G, H, C[:, None]], axis=1)  # (N, 2K+1)
+    flat_idx = (node[:, None] * (d * B)
+                + jnp.arange(d)[None, :] * B
+                + binned)                                  # (N, D)
+    hist = jnp.zeros((M * d * B, nch), jnp.float32)
+    # updates broadcast (N,1,nch) -> (N,D,nch); XLA fuses the broadcast into
+    # the scatter so the (N*D) expansion is never materialized in HBM
+    hist = hist.at[flat_idx].add(chans[:, None, :])
+    hist = hist.reshape(M, d, B, nch)
+
+    Gh = hist[..., :k]           # (M, D, B, K)
+    Hh = hist[..., k:2 * k]
+    Ch = hist[..., 2 * k]        # (M, D, B)
+
+    GL = jnp.cumsum(Gh, axis=2)  # left sums for split at bin b (x <= b)
+    HL = jnp.cumsum(Hh, axis=2)
+    CL = jnp.cumsum(Ch, axis=2)
+    Gtot = GL[:, :1, -1:, :]     # totals are same for every feature; take f0
+    Htot = HL[:, :1, -1:, :]
+    Ctot = CL[:, :1, -1:]
+    GR = Gtot - GL
+    HR = Htot - HL
+    CR = Ctot - CL
+
+    def score(Gs, Hs):
+        return jnp.sum(Gs ** 2 / (Hs + lam), axis=-1)  # sum over K
+
+    gain = score(GL, HL) + score(GR, HR) - score(Gtot, Htot)  # (M, D, B)
+    hl_min = jnp.min(HL, axis=-1)
+    hr_min = jnp.min(HR, axis=-1)
+    valid = ((hl_min >= min_child_weight) & (hr_min >= min_child_weight)
+             & (CL >= min_instances) & (CR >= min_instances))
+    # last bin = degenerate split (everything left)
+    valid = valid & (jnp.arange(B)[None, None, :] < B - 1)
+    valid = valid & feat_mask[None, :, None]
+    # normalized gain threshold (minInfoGain semantics: impurity decrease
+    # per unit of node weight)
+    node_w = jnp.maximum(Ctot[..., 0], 1e-12)  # (M, 1)
+    gain = jnp.where(valid, gain, -jnp.inf)
+
+    flat_gain = gain.reshape(M, d * B)
+    best = jnp.argmax(flat_gain, axis=1)                  # (M,)
+    best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
+    ok = (best_gain > 0) & (best_gain / node_w[:, 0] >= min_info_gain) & \
+         jnp.isfinite(best_gain)
+    feat = jnp.where(ok, best // B, 0).astype(jnp.int32)
+    thresh = jnp.where(ok, best % B, B).astype(jnp.int32)  # B => always left
+
+    # --- partition rows ----------------------------------------------------
+    f_row = feat[node]                                     # (N,)
+    t_row = thresh[node]
+    x_row = jnp.take_along_axis(binned, f_row[:, None], 1)[:, 0]
+    go_right = (x_row > t_row).astype(jnp.int32)
+    new_node = 2 * node + go_right
+    return feat, thresh, new_node
+
+
+@functools.partial(jax.jit, static_argnames=("n_leaves",))
+def _leaf_kernel(node, G, H, C, n_leaves: int, lam, newton, lr):
+    """Leaf values for the final level: -lr*G/(H+λ) (newton) or G/C (mean)."""
+    k = G.shape[1]
+    Gs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(G)
+    Hs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(H)
+    Cs = jnp.zeros((n_leaves,), jnp.float32).at[node].add(C)
+    newton_val = -lr * Gs / (Hs + lam)
+    mean_val = Gs / jnp.maximum(Cs, 1e-12)[:, None]
+    return jnp.where(newton, newton_val, mean_val)
+
+
+def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
+              C: jnp.ndarray, max_depth: int, n_bins: int,
+              lam: float = 1.0, min_child_weight: float = 0.0,
+              min_info_gain: float = 0.0, min_instances: float = 1.0,
+              feat_mask: Optional[jnp.ndarray] = None,
+              newton_leaf: bool = True, learning_rate: float = 1.0,
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Grow one full tree; returns heap arrays (feat, thresh, leaf).
+
+    Python loop over ``max_depth`` levels — each level is a cached jitted
+    kernel (shapes depend only on (level, D, B, K), so compilation amortizes
+    across all trees, rounds, folds and grid points).
+    """
+    n, d = binned.shape
+    if feat_mask is None:
+        feat_mask = jnp.ones(d, bool)
+    node = jnp.zeros(n, jnp.int32)
+    feats, threshs = [], []
+    for level in range(max_depth):
+        f, t, node = _level_kernel(
+            binned, node, G, H, C, feat_mask, 2 ** level, n_bins,
+            jnp.float32(lam), jnp.float32(min_child_weight),
+            jnp.float32(min_info_gain), jnp.float32(min_instances))
+        feats.append(f)
+        threshs.append(t)
+    leaf = _leaf_kernel(node, G, H, C, 2 ** max_depth, jnp.float32(lam),
+                        jnp.bool_(newton_leaf), jnp.float32(learning_rate))
+    return (jnp.concatenate(feats), jnp.concatenate(threshs), leaf)
+
+
+# ---------------------------------------------------------------------------
+# Prediction
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_tree(binned: jnp.ndarray, feat: jnp.ndarray, thresh: jnp.ndarray,
+                 leaf: jnp.ndarray, max_depth: int) -> jnp.ndarray:
+    """Route rows through one tree; returns (N, K) leaf values."""
+    n = binned.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+
+    def level(l, node):
+        base = 2 ** l - 1
+        heap = base + node
+        f = feat[heap]
+        t = thresh[heap]
+        x = jnp.take_along_axis(binned, f[:, None], 1)[:, 0]
+        return 2 * node + (x > t).astype(jnp.int32)
+
+    node = lax.fori_loop(0, max_depth, level, node)
+    return leaf[node]
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_ensemble(binned: jnp.ndarray, feat: jnp.ndarray,
+                     thresh: jnp.ndarray, leaf: jnp.ndarray,
+                     max_depth: int) -> jnp.ndarray:
+    """Sum of all trees' outputs: feat/thresh (T, 2^d-1), leaf (T, 2^d, K).
+
+    scan over trees (static T unrolled by XLA where profitable).
+    """
+
+    def body(acc, tree):
+        f, t, lf = tree
+        return acc + predict_tree(binned, f, t, lf, max_depth), None
+
+    n = binned.shape[0]
+    k = leaf.shape[2]
+    acc0 = jnp.zeros((n, k), jnp.float32)
+    out, _ = lax.scan(body, acc0, (feat, thresh, leaf))
+    return out

@@ -1,0 +1,384 @@
+"""Jitted linear-model trainers — the XLA replacement for Spark MLlib's
+iterative LBFGS/OWLQN solvers.
+
+Reference model wrappers these back:
+ * OpLogisticRegression (core/.../impl/classification/OpLogisticRegression.scala:46)
+ * OpLinearRegression / OpGeneralizedLinearRegression (impl/regression/:47-48)
+ * OpLinearSVC (impl/classification/OpLinearSVC.scala:47)
+ * OpNaiveBayes (impl/classification/OpNaiveBayes.scala:46)
+
+TPU-first design decisions:
+ * Full-batch second-order solvers: tabular designs are (N large, D moderate),
+   so one Newton/IRLS step = one (D,N)@(N,D) matmul on the MXU + a (D,D)
+   Cholesky solve — far fewer passes over HBM than SGD.  Elastic net adds a
+   proximal step (ISTA-style) around the Newton direction.
+ * Everything is ``jax.jit``-compiled with static shapes and
+   ``lax.while_loop``/``fori_loop`` control flow, so the same compiled
+   program serves every fold × hyperparameter via ``vmap`` (no re-tracing
+   per grid point — SURVEY §7 hard part c).
+ * Sample weights everywhere: cross-validation folds are expressed as 0/1
+   weight masks over one resident feature matrix, so fold training never
+   reshapes or copies data (static shapes on device).
+ * float32 accumulation; inputs may arrive bf16 — matmuls hit the MXU either
+   way via XLA.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "LinearFit", "fit_logistic_regression", "fit_linear_regression",
+    "fit_linear_svc", "fit_naive_bayes", "fit_multinomial_logreg",
+    "logreg_predict_proba", "softmax_predict_proba",
+    "linear_predict", "svc_decision", "naive_bayes_predict_log_proba",
+]
+
+
+class LinearFit(NamedTuple):
+    """coef: (D,) or (K, D); intercept: scalar or (K,)."""
+    coef: jnp.ndarray
+    intercept: jnp.ndarray
+    n_iter: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def _prep(X, y, sample_weight):
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    if sample_weight is None:
+        w = jnp.ones(X.shape[0], jnp.float32)
+    else:
+        w = jnp.asarray(sample_weight, jnp.float32)
+    return X, y, w
+
+
+# ---------------------------------------------------------------------------
+# Binary logistic regression — weighted IRLS (Newton) with L2 + optional L1
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+def fit_logistic_regression(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    max_iter: int = 50,
+    tol: float = 1e-6,
+    fit_intercept: bool = True,
+) -> LinearFit:
+    """Newton-IRLS with ridge-damped Hessian; L1 handled by iterative
+    soft-thresholding of the Newton update (proximal Newton).
+
+    ``reg_param``/``elastic_net_param`` follow Spark's parameterisation
+    (regParam, elasticNetParam in DefaultSelectorParams.scala:36-75):
+    l2 = reg*(1-alpha), l1 = reg*alpha, scaled by n.
+    """
+    X, y, w = _prep(X, y, sample_weight)
+    n, d = X.shape
+    wsum = jnp.maximum(w.sum(), 1.0)
+    l2 = reg_param * (1.0 - elastic_net_param)
+    l1 = reg_param * elastic_net_param
+
+    def nll(beta):
+        z = X @ beta[:d] + (beta[d] if fit_intercept else 0.0)
+        # weighted mean logloss + l2
+        ll = w @ (jnp.logaddexp(0.0, z) - y * z) / wsum
+        return ll + 0.5 * l2 * jnp.sum(beta[:d] ** 2)
+
+    def step(state):
+        beta, _, it = state
+        z = X @ beta[:d] + (beta[d] if fit_intercept else 0.0)
+        p = jax.nn.sigmoid(z)
+        g_z = w * (p - y) / wsum                       # (N,)
+        s = jnp.maximum(w * p * (1 - p) / wsum, 1e-10)  # IRLS weights
+        if fit_intercept:
+            Xa = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
+        else:
+            Xa = X
+        grad = Xa.T @ g_z
+        grad = grad.at[:d].add(l2 * beta[:d])
+        H = (Xa * s[:, None]).T @ Xa
+        H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
+        H = H + 1e-8 * jnp.eye(Xa.shape[1], dtype=X.dtype)
+        delta = jax.scipy.linalg.solve(H, grad, assume_a="pos")
+        new_beta = beta - delta
+        # proximal step for l1 (soft threshold coefficients, not intercept);
+        # a no-op when l1 == 0, so applied unconditionally (keeps the program
+        # hyperparameter-polymorphic — no retrace per grid point)
+        new_beta = jnp.where(
+            jnp.arange(new_beta.shape[0]) < d,
+            jnp.sign(new_beta) * jnp.maximum(jnp.abs(new_beta) - l1, 0.0),
+            new_beta,
+        )
+        delta_norm = jnp.max(jnp.abs(new_beta - beta))
+        return new_beta, delta_norm, it + 1
+
+    def cond(state):
+        _, delta_norm, it = state
+        return (delta_norm > tol) & (it < max_iter)
+
+    beta0 = jnp.zeros(d + (1 if fit_intercept else 0), jnp.float32)
+    beta, delta_norm, it = lax.while_loop(
+        cond, step, (beta0, jnp.float32(jnp.inf), jnp.int32(0))
+    )
+    coef = beta[:d]
+    intercept = beta[d] if fit_intercept else jnp.float32(0.0)
+    return LinearFit(coef, intercept, it, delta_norm <= tol)
+
+
+def logreg_predict_proba(coef, intercept, X):
+    z = jnp.asarray(X, jnp.float32) @ coef + intercept
+    p1 = jax.nn.sigmoid(z)
+    return jnp.stack([1.0 - p1, p1], axis=1), jnp.stack([-z, z], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Multinomial (softmax) logistic regression — damped Newton on block-diagonal
+# Hessian approximation (per-class), good convergence for tabular K<=~50
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_classes", "max_iter", "fit_intercept"))
+def fit_multinomial_logreg(
+    X: jnp.ndarray,
+    y: jnp.ndarray,  # int labels (N,)
+    n_classes: int,
+    sample_weight: Optional[jnp.ndarray] = None,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    fit_intercept: bool = True,
+) -> LinearFit:
+    X = jnp.asarray(X, jnp.float32)
+    yi = jnp.asarray(y, jnp.int32)
+    n, d = X.shape
+    w = (jnp.ones(n, jnp.float32) if sample_weight is None
+         else jnp.asarray(sample_weight, jnp.float32))
+    wsum = jnp.maximum(w.sum(), 1.0)
+    Y = jax.nn.one_hot(yi, n_classes, dtype=jnp.float32)
+    l2 = reg_param * (1.0 - elastic_net_param)
+    l1 = reg_param * elastic_net_param
+    if fit_intercept:
+        Xa = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
+    else:
+        Xa = X
+    da = Xa.shape[1]
+
+    def step(state):
+        B, _, it = state  # (da, K)
+        Z = Xa @ B
+        P = jax.nn.softmax(Z, axis=1)
+        G = Xa.T @ (w[:, None] * (P - Y)) / wsum  # (da, K)
+        G = G.at[:d].add(l2 * B[:d])
+
+        # per-class block-diagonal Hessian: H_k = X^T diag(w p_k(1-p_k)) X
+        def solve_class(g_k, p_k, b_k):
+            s = jnp.maximum(w * p_k * (1 - p_k) / wsum, 1e-10)
+            H = (Xa * s[:, None]).T @ Xa
+            H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
+            H = H + 1e-8 * jnp.eye(da, dtype=X.dtype)
+            return jax.scipy.linalg.solve(H, g_k, assume_a="pos")
+
+        delta = jax.vmap(solve_class, in_axes=(1, 1, 1), out_axes=1)(G, P, B)
+        # damping for stability of blockwise Newton
+        newB = B - 0.9 * delta
+        mask = (jnp.arange(da) < d)[:, None]
+        newB = jnp.where(
+            mask,
+            jnp.sign(newB) * jnp.maximum(jnp.abs(newB) - l1, 0.0),
+            newB,
+        )
+        dn = jnp.max(jnp.abs(newB - B))
+        return newB, dn, it + 1
+
+    def cond(state):
+        _, dn, it = state
+        return (dn > tol) & (it < max_iter)
+
+    B0 = jnp.zeros((da, n_classes), jnp.float32)
+    B, dn, it = lax.while_loop(cond, step, (B0, jnp.float32(jnp.inf), jnp.int32(0)))
+    coef = B[:d].T  # (K, D)
+    intercept = B[d] if fit_intercept else jnp.zeros(n_classes, jnp.float32)
+    return LinearFit(coef, intercept, it, dn <= tol)
+
+
+def softmax_predict_proba(coef, intercept, X):
+    Z = jnp.asarray(X, jnp.float32) @ coef.T + intercept
+    return jax.nn.softmax(Z, axis=1), Z
+
+
+# ---------------------------------------------------------------------------
+# Linear regression — closed-form ridge / proximal coordinate-free elastic net
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+def fit_linear_regression(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    max_iter: int = 200,
+    tol: float = 1e-7,
+    fit_intercept: bool = True,
+) -> LinearFit:
+    """Ridge by normal equations (one MXU matmul + Cholesky); elastic net by
+    FISTA on the quadratic loss (still one gram matrix, no data passes)."""
+    X, y, w = _prep(X, y, sample_weight)
+    n, d = X.shape
+    wsum = jnp.maximum(w.sum(), 1.0)
+    l2 = reg_param * (1.0 - elastic_net_param)
+    l1 = reg_param * elastic_net_param
+
+    if fit_intercept:
+        xm = (w @ X) / wsum
+        ym = (w @ y) / wsum
+    else:
+        xm = jnp.zeros(d, X.dtype)
+        ym = jnp.float32(0.0)
+    Xc = X - xm
+    yc = y - ym
+    A = (Xc * w[:, None]).T @ Xc / wsum          # gram (D,D)
+    b = (Xc * w[:, None]).T @ yc / wsum          # (D,)
+
+    def ridge(_):
+        M = A + (l2 + 1e-9) * jnp.eye(d, dtype=X.dtype)
+        coef = jax.scipy.linalg.solve(M, b, assume_a="pos")
+        return coef, jnp.int32(1), jnp.bool_(True)
+
+    def fista(_):
+        # Lipschitz constant upper bound via power iteration
+        def pow_it(i, v):
+            v = A @ v
+            return v / (jnp.linalg.norm(v) + 1e-12)
+        v = pow_it(0, jnp.ones(d, X.dtype) / jnp.sqrt(d))
+        v = lax.fori_loop(0, 16, pow_it, v)
+        L = jnp.vdot(v, A @ v) + l2 + 1e-6
+
+        def step(state):
+            beta, z, t, _, it = state
+            grad = A @ z - b + l2 * z
+            nb = z - grad / L
+            nb = jnp.sign(nb) * jnp.maximum(jnp.abs(nb) - l1 / L, 0.0)
+            nt = 0.5 * (1 + jnp.sqrt(1 + 4 * t * t))
+            nz = nb + (t - 1) / nt * (nb - beta)
+            dn = jnp.max(jnp.abs(nb - beta))
+            return nb, nz, nt, dn, it + 1
+
+        def cond(state):
+            _, _, _, dn, it = state
+            return (dn > tol) & (it < max_iter)
+
+        beta0 = jnp.zeros(d, X.dtype)
+        beta, _, _, dn, it = lax.while_loop(
+            cond, step, (beta0, beta0, jnp.float32(1.0), jnp.float32(jnp.inf),
+                         jnp.int32(0)))
+        return beta, it, dn <= tol
+
+    coef, it, conv = lax.cond(l1 > 0, fista, ridge, operand=None)
+    intercept = ym - jnp.dot(xm, coef) if fit_intercept else jnp.float32(0.0)
+    return LinearFit(coef, intercept, it, conv)
+
+
+def linear_predict(coef, intercept, X):
+    return jnp.asarray(X, jnp.float32) @ coef + intercept
+
+
+# ---------------------------------------------------------------------------
+# Linear SVC — squared-hinge + L2 via Newton (smooth enough a.e.)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+def fit_linear_svc(
+    X: jnp.ndarray,
+    y: jnp.ndarray,  # {0,1}
+    sample_weight: Optional[jnp.ndarray] = None,
+    reg_param: float = 1e-4,
+    max_iter: int = 50,
+    tol: float = 1e-6,
+    fit_intercept: bool = True,
+) -> LinearFit:
+    X, y, w = _prep(X, y, sample_weight)
+    n, d = X.shape
+    ypm = 2.0 * y - 1.0  # {-1, +1}
+    wsum = jnp.maximum(w.sum(), 1.0)
+    if fit_intercept:
+        Xa = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
+    else:
+        Xa = X
+    da = Xa.shape[1]
+
+    def step(state):
+        beta, _, it = state
+        z = Xa @ beta
+        margin = 1.0 - ypm * z
+        active = (margin > 0).astype(X.dtype) * w / wsum
+        grad = Xa.T @ (-2.0 * active * ypm * margin)
+        grad = grad.at[:d].add(reg_param * beta[:d])
+        H = (Xa * (2.0 * active)[:, None]).T @ Xa
+        H = H.at[jnp.arange(d), jnp.arange(d)].add(reg_param)
+        H = H + 1e-6 * jnp.eye(da, dtype=X.dtype)
+        delta = jax.scipy.linalg.solve(H, grad, assume_a="pos")
+        nb = beta - delta
+        dn = jnp.max(jnp.abs(nb - beta))
+        return nb, dn, it + 1
+
+    def cond(state):
+        _, dn, it = state
+        return (dn > tol) & (it < max_iter)
+
+    beta0 = jnp.zeros(da, jnp.float32)
+    beta, dn, it = lax.while_loop(cond, step,
+                                  (beta0, jnp.float32(jnp.inf), jnp.int32(0)))
+    coef = beta[:d]
+    intercept = beta[d] if fit_intercept else jnp.float32(0.0)
+    return LinearFit(coef, intercept, it, dn <= tol)
+
+
+def svc_decision(coef, intercept, X):
+    return jnp.asarray(X, jnp.float32) @ coef + intercept
+
+
+# ---------------------------------------------------------------------------
+# (Multinomial/Bernoulli-ish) Naive Bayes on non-negative features
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def fit_naive_bayes(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    n_classes: int,
+    sample_weight: Optional[jnp.ndarray] = None,
+    smoothing: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Multinomial NB: returns (log_prior (K,), log_likelihood (K, D)).
+
+    Matches Spark's NaiveBayes multinomial default (smoothing=1.0).
+    Features must be non-negative (counts/indicators) — the transmogrified
+    matrix's one-hot/hash slots qualify; numeric slots are clipped at 0.
+    """
+    X = jnp.maximum(jnp.asarray(X, jnp.float32), 0.0)
+    yi = jnp.asarray(y, jnp.int32)
+    n, d = X.shape
+    w = (jnp.ones(n, jnp.float32) if sample_weight is None
+         else jnp.asarray(sample_weight, jnp.float32))
+    Y = jax.nn.one_hot(yi, n_classes, dtype=jnp.float32) * w[:, None]
+    class_count = Y.sum(axis=0)                      # (K,)
+    feat_count = Y.T @ X                             # (K, D)
+    log_prior = jnp.log(class_count + 1e-12) - jnp.log(
+        jnp.maximum(class_count.sum(), 1e-12))
+    log_lik = jnp.log(feat_count + smoothing) - jnp.log(
+        (feat_count.sum(axis=1, keepdims=True) + smoothing * d))
+    return log_prior, log_lik
+
+
+def naive_bayes_predict_log_proba(log_prior, log_lik, X):
+    X = jnp.maximum(jnp.asarray(X, jnp.float32), 0.0)
+    joint = X @ log_lik.T + log_prior                # (N, K)
+    return joint - jax.scipy.special.logsumexp(joint, axis=1, keepdims=True)

@@ -1,0 +1,106 @@
+"""Prediction column batch + shared predictor stage bases.
+
+Reference: the ``Prediction`` feature type (features/types/Maps.scala:339-394)
+and ``OpPredictorWrapper``/``OpProbabilisticClassifierModel``
+(core/.../sparkwrappers/specific/OpPredictorWrapper.scala:71,121).
+
+A ``PredictionBatch`` stores the whole batch's predictions as arrays
+(columnar, device-friendly) while presenting the reference's per-row
+``Map[String, Double]`` view for local scoring and tests.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..stages.base import BinaryEstimator, BinaryModel
+from ..types.columns import FeatureColumn
+from ..types.feature_types import Prediction
+
+__all__ = ["PredictionBatch", "prediction_column", "PredictorEstimator",
+           "PredictorModel"]
+
+
+@dataclasses.dataclass
+class PredictionBatch:
+    """Columnar predictions: prediction (N,), optional raw/proba (N, K)."""
+
+    prediction: np.ndarray
+    raw_prediction: Optional[np.ndarray] = None
+    probability: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return len(self.prediction)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, (int, np.integer)):
+            return self.row(int(idx))
+        return PredictionBatch(
+            self.prediction[idx],
+            None if self.raw_prediction is None else self.raw_prediction[idx],
+            None if self.probability is None else self.probability[idx],
+        )
+
+    def row(self, i: int) -> Dict[str, float]:
+        out = {"prediction": float(self.prediction[i])}
+        if self.raw_prediction is not None:
+            for k, v in enumerate(np.atleast_1d(self.raw_prediction[i])):
+                out[f"rawPrediction_{k}"] = float(v)
+        if self.probability is not None:
+            for k, v in enumerate(np.atleast_1d(self.probability[i])):
+                out[f"probability_{k}"] = float(v)
+        return out
+
+    def __iter__(self) -> Iterator[Dict[str, float]]:
+        for i in range(len(self)):
+            yield self.row(i)
+
+
+def prediction_column(prediction, raw_prediction=None, probability=None) -> FeatureColumn:
+    batch = PredictionBatch(
+        np.asarray(prediction),
+        None if raw_prediction is None else np.asarray(raw_prediction),
+        None if probability is None else np.asarray(probability),
+    )
+    return FeatureColumn(Prediction, batch)
+
+
+class PredictorEstimator(BinaryEstimator):
+    """Base for model estimators: inputs (response RealNN, features OPVector)."""
+
+    def __init__(self, operation_name: str, uid: Optional[str] = None):
+        super().__init__(operation_name=operation_name, output_type=Prediction,
+                         uid=uid)
+
+    def output_is_response(self) -> bool:
+        return False  # Prediction output is never the workflow response
+
+    @property
+    def label_feature(self) -> Feature:
+        return self.input_features[0]
+
+    @property
+    def features_feature(self) -> Feature:
+        return self.input_features[1]
+
+
+class PredictorModel(BinaryModel):
+    """Base for fitted predictors; subclasses implement predict(X)."""
+
+    def __init__(self, operation_name: str, uid: Optional[str] = None):
+        super().__init__(operation_name=operation_name, output_type=Prediction,
+                         uid=uid)
+
+    def output_is_response(self) -> bool:
+        return False
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        raise NotImplementedError
+
+    def transform_columns(self, label_col, features_col) -> FeatureColumn:
+        X = np.asarray(features_col.values, dtype=np.float32)
+        batch = self.predict_batch(X)
+        return FeatureColumn(Prediction, batch)

@@ -1,0 +1,248 @@
+"""Regression model stages (XLA-trained).
+
+Reference wrappers (core/.../impl/regression/): OpLinearRegression (:47),
+OpGeneralizedLinearRegression (:48), IsotonicRegressionCalibrator
+(IsotonicRegressionCalibrator.scala).  Tree regressors live in ``models.trees``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types.columns import ColumnarDataset, FeatureColumn
+from .classification import _apply_standardize, _extract_xy, _standardize_stats, _unstandardize
+from .linear import fit_linear_regression, linear_predict
+from .prediction import PredictionBatch, PredictorEstimator, PredictorModel
+
+__all__ = [
+    "OpLinearRegression", "LinearRegressionModel",
+    "OpGeneralizedLinearRegression",
+    "IsotonicRegressionCalibrator", "IsotonicRegressionModel",
+]
+
+
+class OpLinearRegression(PredictorEstimator):
+    """Ridge/elastic-net linear regression — closed-form / FISTA on device."""
+
+    def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
+                 max_iter: int = 200, tol: float = 1e-7,
+                 fit_intercept: bool = True, standardization: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="linreg", uid=uid)
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.max_iter = max_iter
+        self.tol = tol
+        self.fit_intercept = fit_intercept
+        self.standardization = standardization
+
+    def fit_columns(self, data: ColumnarDataset, label_col, features_col):
+        X, y = _extract_xy(label_col, features_col)
+        return self.fit_raw(X, y)
+
+    def fit_raw(self, X: np.ndarray, y: np.ndarray, w=None):
+        mu, sigma = _standardize_stats(X, w) if self.standardization else (None, None)
+        fit = fit_linear_regression(
+            _apply_standardize(X, mu, sigma), y, sample_weight=w,
+            reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param, max_iter=self.max_iter,
+            tol=self.tol, fit_intercept=self.fit_intercept)
+        coef, intercept = _unstandardize(
+            np.asarray(fit.coef), float(np.asarray(fit.intercept)), mu, sigma)
+        return LinearRegressionModel(coef=coef.tolist(), intercept=float(intercept))
+
+
+class LinearRegressionModel(PredictorModel):
+    def __init__(self, coef: List[float], intercept: float,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="linreg", uid=uid)
+        self.coef = coef
+        self.intercept = intercept
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        pred = np.asarray(linear_predict(
+            jnp.asarray(self.coef, jnp.float32),
+            jnp.float32(self.intercept), X))
+        return PredictionBatch(prediction=pred.astype(np.float64))
+
+
+class OpGeneralizedLinearRegression(PredictorEstimator):
+    """GLM via IRLS for gaussian/poisson/gamma families (log/identity links).
+
+    Reference OpGeneralizedLinearRegression (impl/regression/:48) wraps
+    Spark's GLR; here the IRLS loop is one jitted while_loop.
+    """
+
+    def __init__(self, family: str = "gaussian", link: Optional[str] = None,
+                 reg_param: float = 0.0, max_iter: int = 50, tol: float = 1e-6,
+                 fit_intercept: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="glm", uid=uid)
+        self.family = family
+        self.link = link or {"gaussian": "identity", "poisson": "log",
+                             "gamma": "log", "binomial": "logit"}[family]
+        self.reg_param = reg_param
+        self.max_iter = max_iter
+        self.tol = tol
+        self.fit_intercept = fit_intercept
+
+    def fit_columns(self, data: ColumnarDataset, label_col, features_col):
+        X, y = _extract_xy(label_col, features_col)
+        return self.fit_raw(X, y)
+
+    def fit_raw(self, X: np.ndarray, y: np.ndarray, w=None):
+        if self.family == "gaussian" and self.link == "identity":
+            fit = fit_linear_regression(
+                X, y, reg_param=self.reg_param, max_iter=self.max_iter,
+                tol=self.tol, fit_intercept=self.fit_intercept)
+            return GLMModel(coef=np.asarray(fit.coef).tolist(),
+                            intercept=float(np.asarray(fit.intercept)),
+                            link=self.link)
+        coef, intercept = _fit_glm_irls(
+            X, y, family=self.family, link=self.link, reg=self.reg_param,
+            max_iter=self.max_iter, tol=self.tol,
+            fit_intercept=self.fit_intercept)
+        return GLMModel(coef=coef.tolist(), intercept=float(intercept),
+                        link=self.link)
+
+
+def _fit_glm_irls(X, y, family, link, reg, max_iter, tol, fit_intercept):
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    n, d = X.shape
+    Xa = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], 1) if fit_intercept else X
+    da = Xa.shape[1]
+
+    def inv_link(eta):
+        if link == "log":
+            return jnp.exp(jnp.clip(eta, -30, 30))
+        if link == "logit":
+            return jax.nn.sigmoid(eta)
+        return eta
+
+    def variance(mu):
+        if family == "poisson":
+            return jnp.maximum(mu, 1e-8)
+        if family == "gamma":
+            return jnp.maximum(mu ** 2, 1e-8)
+        if family == "binomial":
+            return jnp.maximum(mu * (1 - mu), 1e-8)
+        return jnp.ones_like(mu)
+
+    def dmu_deta(eta, mu):
+        if link == "log":
+            return jnp.maximum(mu, 1e-8)
+        if link == "logit":
+            return jnp.maximum(mu * (1 - mu), 1e-8)
+        return jnp.ones_like(eta)
+
+    import functools
+    from jax import lax
+
+    def step(state):
+        beta, _, it = state
+        eta = Xa @ beta
+        mu = inv_link(eta)
+        gp = dmu_deta(eta, mu)
+        wirls = gp ** 2 / variance(mu)
+        z = eta + (y - mu) / gp
+        A = (Xa * wirls[:, None]).T @ Xa / n
+        A = A.at[jnp.arange(d), jnp.arange(d)].add(reg)
+        A = A + 1e-8 * jnp.eye(da, dtype=X.dtype)
+        b = (Xa * wirls[:, None]).T @ z / n
+        nb = jax.scipy.linalg.solve(A, b, assume_a="pos")
+        dn = jnp.max(jnp.abs(nb - beta))
+        return nb, dn, it + 1
+
+    def cond(state):
+        _, dn, it = state
+        return (dn > tol) & (it < max_iter)
+
+    beta0 = jnp.zeros(da, jnp.float32)
+    beta, _, _ = lax.while_loop(cond, step,
+                                (beta0, jnp.float32(jnp.inf), jnp.int32(0)))
+    coef = np.asarray(beta[:d])
+    intercept = float(beta[d]) if fit_intercept else 0.0
+    return coef, intercept
+
+
+class GLMModel(PredictorModel):
+    def __init__(self, coef, intercept, link: str = "identity",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="glm", uid=uid)
+        self.coef = coef
+        self.intercept = intercept
+        self.link = link
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        eta = X @ np.asarray(self.coef, np.float32) + self.intercept
+        if self.link == "log":
+            pred = np.exp(eta)
+        elif self.link == "logit":
+            pred = 1 / (1 + np.exp(-eta))
+        else:
+            pred = eta
+        return PredictionBatch(prediction=pred.astype(np.float64))
+
+
+class IsotonicRegressionCalibrator(PredictorEstimator):
+    """Isotonic calibration via pool-adjacent-violators (host-side).
+
+    Reference IsotonicRegressionCalibrator (impl/regression/).
+    """
+
+    def __init__(self, isotonic: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="isoreg", uid=uid)
+        self.isotonic = isotonic
+
+    def fit_columns(self, data: ColumnarDataset, label_col, score_col):
+        y = np.nan_to_num(np.asarray(label_col.values, np.float64))
+        x = np.asarray(
+            score_col.values.probability[:, 1]
+            if hasattr(score_col.values, "probability")
+            and score_col.values.probability is not None
+            else score_col.masked_values(), np.float64)
+        sign = 1.0 if self.isotonic else -1.0
+        order = np.argsort(x)
+        xs, ys = x[order], sign * y[order]
+        # pool adjacent violators
+        vals: List[float] = []
+        wts: List[float] = []
+        xs_blocks: List[List[float]] = []
+        for xi, yi in zip(xs, ys):
+            vals.append(yi)
+            wts.append(1.0)
+            xs_blocks.append([xi])
+            while len(vals) > 1 and vals[-2] > vals[-1]:
+                v = (vals[-2] * wts[-2] + vals[-1] * wts[-1]) / (wts[-2] + wts[-1])
+                w = wts[-2] + wts[-1]
+                xb = xs_blocks[-2] + xs_blocks[-1]
+                vals = vals[:-2] + [v]
+                wts = wts[:-2] + [w]
+                xs_blocks = xs_blocks[:-2] + [xb]
+        bx = [float(np.mean(b)) for b in xs_blocks]
+        by = [sign * v for v in vals]
+        return IsotonicRegressionModel(boundaries=bx, predictions=by)
+
+
+class IsotonicRegressionModel(PredictorModel):
+    def __init__(self, boundaries: List[float], predictions: List[float],
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="isoreg", uid=uid)
+        self.boundaries = boundaries
+        self.predictions = predictions
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        x = np.asarray(X).reshape(len(X), -1)[:, 0]
+        pred = np.interp(x, self.boundaries, self.predictions)
+        return PredictionBatch(prediction=pred.astype(np.float64))
+
+    def transform_columns(self, label_col, score_col) -> FeatureColumn:
+        vals = score_col.values
+        if hasattr(vals, "probability") and vals.probability is not None:
+            x = np.asarray(vals.probability[:, 1])
+        else:
+            x = np.asarray(score_col.masked_values())
+        return FeatureColumn(self.output_type, self.predict_batch(x[:, None]))

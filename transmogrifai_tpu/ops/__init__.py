@@ -1,0 +1,8 @@
+from .transmogrify import transmogrify, TransmogrifierDefaults  # noqa: F401
+from .vector_metadata import VectorMetadata, VectorColumnMetadata  # noqa: F401
+from .vectorizers import (  # noqa: F401
+    RealVectorizer, IntegralVectorizer, BinaryVectorizer, OneHotVectorizer,
+    TextHashingVectorizer, SmartTextVectorizer, MultiPickListVectorizer,
+    VectorsCombiner,
+)
+from .date_geo import DateToUnitCircleVectorizer, GeolocationVectorizer  # noqa: F401

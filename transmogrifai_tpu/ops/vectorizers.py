@@ -303,6 +303,41 @@ import re
 _TOKEN_SPLIT = re.compile(r"[^\w']+", re.UNICODE)
 
 
+def _row_tokens(v) -> List[str]:
+    """Tokens for one cell: strings are word-tokenized; collection cells
+    (lists/sets of arbitrary values, e.g. DateList epoch ints) hash their
+    elements' string forms."""
+    if v is None:
+        return []
+    if isinstance(v, str):
+        return _tokenize(v)
+    return [str(t) for t in v]
+
+
+def _hash_rows(values, block: np.ndarray, offset: int, nf: int, seed: int,
+               binary_freq: bool = False) -> np.ndarray:
+    """Scatter token counts of one column into ``block[:, offset:offset+nf]``;
+    returns a bool array marking rows with no tokens (null rows).
+    Shared by TextHashingVectorizer and SmartTextVectorizerModel."""
+    cache: Dict[str, int] = {}
+    empty = np.zeros(len(values), dtype=bool)
+    for row, v in enumerate(values):
+        toks = _row_tokens(v)
+        if not toks:
+            empty[row] = True
+            continue
+        for t in toks:
+            b = cache.get(t)
+            if b is None:
+                b = murmur3_32(t, seed) % nf
+                cache[t] = b
+            if binary_freq:
+                block[row, offset + b] = 1.0
+            else:
+                block[row, offset + b] += 1.0
+    return empty
+
+
 class TextHashingVectorizer(SequenceTransformer):
     """Murmur3 feature hashing of tokenized text (stateless).
 
@@ -328,24 +363,11 @@ class TextHashingVectorizer(SequenceTransformer):
         n_spaces = 1 if self.shared_hash_space else len(cols)
         hashed = np.zeros((n, n_spaces * nf), dtype=np.float32)
         nulls = np.zeros((n, len(cols)), dtype=np.float32)
-        # hash unique tokens once (host); scatter-add counts
         for ci, c in enumerate(cols):
             offset = 0 if self.shared_hash_space else ci * nf
-            cache: Dict[str, int] = {}
-            for row, v in enumerate(c.values):
-                toks = _tokenize(v) if isinstance(v, str) or v is None else list(v)
-                if v is None or not toks:
-                    nulls[row, ci] = 1.0
-                    continue
-                for t in toks:
-                    b = cache.get(t)
-                    if b is None:
-                        b = murmur3_32(t, self.seed) % nf
-                        cache[t] = b
-                    if self.binary_freq:
-                        hashed[row, offset + b] = 1.0
-                    else:
-                        hashed[row, offset + b] += 1.0
+            empty = _hash_rows(c.values, hashed, offset, nf, self.seed,
+                               self.binary_freq)
+            nulls[:, ci] = empty
         meta: List[VectorColumnMetadata] = []
         if self.shared_hash_space:
             pf = ",".join(f.name for f in self.input_features)
@@ -510,14 +532,7 @@ class SmartTextVectorizerModel(SequenceModel):
                                                  indicator_value=OTHER_INDICATOR))
             else:  # HASH
                 block = np.zeros((n, nf), dtype=np.float32)
-                cache: Dict[str, int] = {}
-                for row, v in enumerate(c.values):
-                    for t in _tokenize(v):
-                        b = cache.get(t)
-                        if b is None:
-                            b = murmur3_32(t, self.seed) % nf
-                            cache[t] = b
-                        block[row, b] += 1.0
+                _hash_rows(c.values, block, 0, nf, self.seed)
                 parts.append(block)
                 for b in range(nf):
                     meta.append(VectorColumnMetadata(f.name, tname,

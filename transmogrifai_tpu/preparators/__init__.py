@@ -1,0 +1,3 @@
+from .sanity_checker import (  # noqa: F401
+    SanityChecker, SanityCheckerModel, MinVarianceFilter,
+)

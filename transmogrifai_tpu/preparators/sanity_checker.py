@@ -1,0 +1,261 @@
+"""SanityChecker — automated feature validation against the label.
+
+Reference: ``SanityChecker`` (core/.../impl/preparators/SanityChecker.scala:232,
+fitFn :367-470, model :544-560), drop logic
+``DerivedFeatureFilterUtils.getFeaturesToDrop``
+(impl/preparators/DerivedFeatureFilterUtils.scala), summary metadata
+``SanityCheckerMetadata`` (impl/preparators/SanityCheckerMetadata.scala), and
+``MinVarianceFilter`` (impl/preparators/MinVarianceFilter.scala).
+
+TPU design: colStats + label correlations are two matmul-reductions over the
+device-resident (N, D) matrix (ops.stats); Cramér's V per categorical group is
+a one-hot matmul contingency.  The fitted model is an index-gather on the
+vector — the same "filter the slots" semantics as the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.stats import (
+    col_stats, cramers_v, pearson_with_label, spearman_with_label,
+)
+from ..ops.vector_metadata import VectorMetadata
+from ..stages.base import BinaryEstimator, BinaryModel
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import OPVector
+
+__all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary",
+           "MinVarianceFilter"]
+
+
+@dataclasses.dataclass
+class ColumnStat:
+    name: str
+    parent_feature: str
+    mean: float
+    variance: float
+    min: float
+    max: float
+    corr_label: float
+    cramers_v: Optional[float]
+    dropped: bool
+    reasons: List[str]
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+
+class SanityCheckerSummary:
+    """Structured fit summary (SanityCheckerSummary metadata parity)."""
+
+    def __init__(self, stats: List[ColumnStat], dropped: List[str],
+                 correlation_type: str, sample_size: float):
+        self.stats = stats
+        self.dropped = dropped
+        self.correlation_type = correlation_type
+        self.sample_size = sample_size
+
+    def to_json(self):
+        return {
+            "correlationType": self.correlation_type,
+            "sampleSize": self.sample_size,
+            "dropped": self.dropped,
+            "columnStats": [s.to_json() for s in self.stats],
+        }
+
+
+class SanityChecker(BinaryEstimator):
+    """Inputs: (label RealNN, features OPVector) -> cleaned OPVector."""
+
+    def __init__(self,
+                 check_sample: float = 1.0,
+                 sample_seed: int = 42,
+                 min_variance: float = 1e-5,
+                 min_correlation: float = 0.0,
+                 max_correlation: float = 0.95,
+                 max_cramers_v: float = 0.95,
+                 correlation_type: str = "pearson",
+                 remove_bad_features: bool = True,
+                 remove_feature_group: bool = True,
+                 categorical_label: Optional[bool] = None,
+                 max_label_classes: int = 100,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="sanityCheck", output_type=OPVector,
+                         uid=uid)
+        self.check_sample = check_sample
+        self.sample_seed = sample_seed
+        self.min_variance = min_variance
+        self.min_correlation = min_correlation
+        self.max_correlation = max_correlation
+        self.max_cramers_v = max_cramers_v
+        self.correlation_type = correlation_type
+        self.remove_bad_features = remove_bad_features
+        self.remove_feature_group = remove_feature_group
+        self.categorical_label = categorical_label
+        self.max_label_classes = max_label_classes
+
+    def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
+                    features_col: FeatureColumn):
+        X = np.asarray(features_col.values, dtype=np.float32)
+        y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
+        n, d = X.shape
+        if self.check_sample < 1.0:
+            rng = np.random.default_rng(self.sample_seed)
+            idx = rng.random(n) < self.check_sample
+            X, y = X[idx], y[idx]
+            n = len(y)
+        vmeta = features_col.vmeta or VectorMetadata(
+            "features", [])
+
+        stats = col_stats(X)
+        variance = np.asarray(stats.variance)
+        if self.correlation_type == "spearman":
+            corr = np.asarray(spearman_with_label(X, y))
+        else:
+            corr = np.asarray(pearson_with_label(X, y))
+        corr = np.nan_to_num(corr)
+
+        # label categorical? -> Cramér's V per categorical group
+        uniq = np.unique(y)
+        is_cat_label = (self.categorical_label
+                        if self.categorical_label is not None
+                        else len(uniq) <= min(self.max_label_classes, n // 2))
+        group_cv: Dict[Tuple[str, Optional[str]], float] = {}
+        if is_cat_label and vmeta.size == d:
+            labels_int = np.searchsorted(uniq, y)
+            groups: Dict[Tuple[str, Optional[str]], List[int]] = {}
+            for i, c in enumerate(vmeta.columns):
+                if c.indicator_value is not None:
+                    groups.setdefault((c.parent_feature, c.grouping), []).append(i)
+            for key, idxs in groups.items():
+                res = cramers_v(labels_int, X[:, idxs], len(uniq))
+                group_cv[key] = res["cramersV"]
+
+        # drop rules (DerivedFeatureFilterUtils.getFeaturesToDrop parity)
+        to_drop = np.zeros(d, dtype=bool)
+        reasons: List[List[str]] = [[] for _ in range(d)]
+        for j in range(d):
+            if variance[j] < self.min_variance:
+                to_drop[j] = True
+                reasons[j].append("low variance")
+            a = abs(corr[j])
+            if a > self.max_correlation:
+                to_drop[j] = True
+                reasons[j].append(
+                    f"label correlation {a:.3f} > {self.max_correlation} (leakage)")
+            elif 0 < self.min_correlation and a < self.min_correlation:
+                to_drop[j] = True
+                reasons[j].append("correlation below minimum")
+        if vmeta.size == d:
+            for j, c in enumerate(vmeta.columns):
+                cv = group_cv.get((c.parent_feature, c.grouping))
+                if cv is not None and cv > self.max_cramers_v:
+                    to_drop[j] = True
+                    reasons[j].append(
+                        f"group Cramér's V {cv:.3f} > {self.max_cramers_v}")
+
+        col_names = (vmeta.column_names() if vmeta.size == d
+                     else [f"f_{j}" for j in range(d)])
+        parents = ([c.parent_feature for c in vmeta.columns]
+                   if vmeta.size == d else ["features"] * d)
+        col_stats_out = [
+            ColumnStat(
+                name=col_names[j], parent_feature=parents[j],
+                mean=float(np.asarray(stats.mean)[j]), variance=float(variance[j]),
+                min=float(np.asarray(stats.min)[j]),
+                max=float(np.asarray(stats.max)[j]),
+                corr_label=float(corr[j]),
+                cramers_v=(group_cv.get((vmeta.columns[j].parent_feature,
+                                         vmeta.columns[j].grouping))
+                           if vmeta.size == d else None),
+                dropped=bool(to_drop[j]), reasons=reasons[j])
+            for j in range(d)
+        ]
+
+        if not self.remove_bad_features:
+            keep = list(range(d))
+        else:
+            keep = [j for j in range(d) if not to_drop[j]]
+        summary = SanityCheckerSummary(
+            stats=col_stats_out,
+            dropped=[col_names[j] for j in range(d) if to_drop[j]],
+            correlation_type=self.correlation_type, sample_size=float(n))
+        self.metadata["summary"] = summary.to_json()
+        new_meta = vmeta.select(keep) if vmeta.size == d else None
+        model = SanityCheckerModel(keep_indices=keep)
+        model._new_vmeta = new_meta
+        return model
+
+
+class SanityCheckerModel(BinaryModel):
+    """Index-filter on the feature vector (SanityChecker.scala:544-560)."""
+
+    def __init__(self, keep_indices: List[int], uid: Optional[str] = None):
+        super().__init__(operation_name="sanityCheck", output_type=OPVector,
+                         uid=uid)
+        self.keep_indices = list(keep_indices)
+        self._new_vmeta: Optional[VectorMetadata] = None
+
+    def transform_columns(self, label_col, features_col) -> FeatureColumn:
+        X = np.asarray(features_col.values)
+        out = X[:, self.keep_indices]
+        vmeta = self._new_vmeta
+        if vmeta is None and features_col.vmeta is not None:
+            vmeta = features_col.vmeta.select(self.keep_indices)
+            self._new_vmeta = vmeta
+        return FeatureColumn(OPVector, out.astype(np.float32), vmeta=vmeta)
+
+
+class MinVarianceFilter(BinaryEstimator):
+    """Unlabeled variance-only filter (MinVarianceFilter.scala parity).
+
+    Accepts (anything, features OPVector); the first input is ignored so the
+    stage shape matches SanityChecker and DAG wiring stays uniform.
+    """
+
+    input_arity = (1, 2)
+
+    def __init__(self, min_variance: float = 1e-5, uid: Optional[str] = None):
+        super().__init__(operation_name="minVariance", output_type=OPVector,
+                         uid=uid)
+        self.min_variance = min_variance
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        features_col = cols[-1]
+        X = np.asarray(features_col.values, dtype=np.float32)
+        variance = np.asarray(col_stats(X).variance)
+        keep = [j for j in range(X.shape[1])
+                if variance[j] >= self.min_variance]
+        vmeta = features_col.vmeta
+        self.metadata["summary"] = {
+            "dropped": ([vmeta.column_names()[j] for j in range(X.shape[1])
+                         if j not in set(keep)]
+                        if vmeta and vmeta.size == X.shape[1] else []),
+        }
+        model = MinVarianceFilterModel(keep_indices=keep)
+        model._new_vmeta = (vmeta.select(keep)
+                            if vmeta and vmeta.size == X.shape[1] else None)
+        return model
+
+
+class MinVarianceFilterModel(BinaryModel):
+    input_arity = (1, 2)
+
+    def __init__(self, keep_indices: List[int], uid: Optional[str] = None):
+        super().__init__(operation_name="minVariance", output_type=OPVector,
+                         uid=uid)
+        self.keep_indices = list(keep_indices)
+        self._new_vmeta = None
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        features_col = cols[-1]
+        X = np.asarray(features_col.values)
+        vmeta = self._new_vmeta
+        if vmeta is None and features_col.vmeta is not None:
+            vmeta = features_col.vmeta.select(self.keep_indices)
+        return FeatureColumn(OPVector, X[:, self.keep_indices].astype(np.float32),
+                             vmeta=vmeta)

@@ -1,0 +1,2 @@
+from .base import Reader, DataFrameReader, RecordsReader, reader_for  # noqa: F401
+from .files import CSVReader, CSVAutoReader, ParquetReader, JSONLinesReader, DataReaders  # noqa: F401

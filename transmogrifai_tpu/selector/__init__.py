@@ -1,0 +1,7 @@
+from .model_selector import (  # noqa: F401
+    ModelSelector, SelectedModel, BinaryClassificationModelSelector,
+    MultiClassificationModelSelector, RegressionModelSelector,
+    DefaultSelectorParams, RandomParamBuilder, grid,
+)
+from .splitters import DataSplitter, DataBalancer, DataCutter  # noqa: F401
+from .validators import OpCrossValidation, OpTrainValidationSplit  # noqa: F401

@@ -1,0 +1,429 @@
+"""ModelSelector — automated model selection with validation.
+
+Reference: ``ModelSelector`` estimator (core/.../impl/selector/ModelSelector.scala:72,
+fit :145-209), ``ModelSelectorSummary`` (impl/selector/ModelSelectorSummary.scala),
+factories ``BinaryClassificationModelSelector``
+(impl/classification/BinaryClassificationModelSelector.scala:49,54-108,260-266),
+``MultiClassificationModelSelector`` (:49,231-235),
+``RegressionModelSelector`` (impl/regression/RegressionModelSelector.scala:49,237-242),
+grid values ``DefaultSelectorParams`` (impl/selector/DefaultSelectorParams.scala:36-75),
+``ModelSelectorFactory``, ``RandomParamBuilder``
+(impl/selector/RandomParamBuilder.scala:52,169), ``SelectedModelCombiner``.
+
+Flow (ModelSelector.fit parity): splitter reserves a holdout and computes
+training weights -> validator scores every (model, params) candidate on CV
+folds (weight-masked, single resident matrix) -> best estimator refit on the
+full training split -> holdout + training metrics evaluated -> everything
+recorded as ``model_selector_summary`` metadata.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..evaluators.metrics import (
+    aupr, auroc, multiclass_metrics, regression_metrics,
+    binary_classification_metrics,
+)
+from ..models.prediction import (
+    PredictionBatch, PredictorEstimator, PredictorModel,
+)
+from ..types.columns import ColumnarDataset, FeatureColumn
+from .splitters import DataBalancer, DataCutter, DataSplitter
+from .validators import (
+    OpCrossValidation, OpTrainValidationSplit, ValidationResult,
+)
+
+__all__ = [
+    "ModelSelector", "SelectedModel", "ModelSelectorSummary",
+    "BinaryClassificationModelSelector", "MultiClassificationModelSelector",
+    "RegressionModelSelector", "DefaultSelectorParams", "RandomParamBuilder",
+]
+
+
+class DefaultSelectorParams:
+    """Default grid values (DefaultSelectorParams.scala:36-75)."""
+
+    MAX_DEPTH = [3, 6, 12]
+    MAX_BIN = [32]
+    MIN_INSTANCES_PER_NODE = [10, 100]
+    MIN_INFO_GAIN = [0.001, 0.01, 0.1]
+    REGULARIZATION = [0.001, 0.01, 0.1, 0.2]
+    MAX_ITER_LIN = [50]
+    MAX_ITER_TREE = [20]
+    STEP_SIZE = [0.1]
+    ELASTIC_NET = [0.1, 0.5]
+    MAX_TREES = [50]
+    TOL = [1e-6]
+    NB_SMOOTHING = [1.0]
+    NUM_ROUND_XGB = [200]
+    ETA_XGB = [0.02]
+    MIN_CHILD_WEIGHT_XGB = [1.0, 10.0]
+    MAX_DEPTH_XGB = [10]
+    EARLY_STOPPING_XGB = [20]
+    GAMMA_XGB = [0.8]
+
+
+def grid(**axes) -> List[Dict[str, Any]]:
+    """Cartesian parameter grid."""
+    keys = list(axes)
+    out = []
+    for combo in itertools.product(*(axes[k] for k in keys)):
+        out.append(dict(zip(keys, combo)))
+    return out
+
+
+class ModelSelectorSummary:
+    """Validation results + best model + metrics (ModelSelectorSummary parity)."""
+
+    def __init__(self, validation_results: List[ValidationResult],
+                 best_model_name: str, best_params: Dict[str, Any],
+                 validation_type: str, holdout_metrics: Dict[str, float],
+                 train_metrics: Dict[str, float],
+                 splitter_summary: Optional[dict]):
+        self.validation_results = validation_results
+        self.best_model_name = best_model_name
+        self.best_params = best_params
+        self.validation_type = validation_type
+        self.holdout_metrics = holdout_metrics
+        self.train_metrics = train_metrics
+        self.splitter_summary = splitter_summary
+
+    def to_json(self):
+        return {
+            "validationType": self.validation_type,
+            "validationResults": [r.to_json() for r in self.validation_results],
+            "bestModelType": self.best_model_name,
+            "bestModelParams": self.best_params,
+            "holdoutMetrics": self.holdout_metrics,
+            "trainEvaluationMetrics": self.train_metrics,
+            "dataPrepResults": self.splitter_summary,
+        }
+
+
+class ModelSelector(PredictorEstimator):
+    """Generic selector over (estimator prototype, param grid) candidates.
+
+    ``problem_type``: 'binary' | 'multiclass' | 'regression' — drives the
+    validation score extraction and default metrics.
+    """
+
+    def __init__(self,
+                 models_and_params: Sequence[Tuple[PredictorEstimator,
+                                                   List[Dict[str, Any]]]],
+                 problem_type: str,
+                 validator=None,
+                 splitter=None,
+                 validation_metric: Optional[str] = None,
+                 holdout_evaluators: Sequence = (),
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="modelSelector", uid=uid)
+        self.models_and_params = list(models_and_params)
+        self.problem_type = problem_type
+        self.validator = validator or OpCrossValidation(
+            num_folds=3, stratify=problem_type != "regression")
+        self.splitter = splitter
+        self.validation_metric = validation_metric or {
+            "binary": "AuPR", "multiclass": "F1",
+            "regression": "RootMeanSquaredError"}[problem_type]
+        self.holdout_evaluators = list(holdout_evaluators)
+
+    # -- validation plumbing -------------------------------------------------
+
+    def _score_fn(self, model: PredictorModel, X: np.ndarray) -> np.ndarray:
+        batch = model.predict_batch(X)
+        if self.problem_type == "binary":
+            if batch.probability is not None:
+                return np.asarray(batch.probability)[:, 1]
+            return np.asarray(batch.raw_prediction)[:, 1]
+        return np.asarray(batch.prediction)
+
+    def _metric(self, y, scores, w) -> float:
+        m = self.validation_metric
+        if self.problem_type == "binary":
+            if m == "AuPR":
+                return float(aupr(y, scores, w))
+            if m == "AuROC":
+                return float(auroc(y, scores, w))
+            return binary_classification_metrics(y, scores, w)[m]
+        if self.problem_type == "multiclass":
+            n_classes = int(max(y.max(), scores.max())) + 1
+            return multiclass_metrics(y.astype(int), scores.astype(int),
+                                      n_classes, w)[m]
+        return regression_metrics(y, scores, w)[m]
+
+    @property
+    def larger_better(self) -> bool:
+        return self.validation_metric not in (
+            "RootMeanSquaredError", "MeanSquaredError", "MeanAbsoluteError",
+            "Error", "LogLoss", "BrierScore")
+
+    def _candidates(self):
+        out = []
+        for proto, grid_points in self.models_and_params:
+            for params in grid_points:
+                def fitter(X, y, w, p, proto=proto):
+                    est = proto.copy(**p)
+                    model = est.fit_raw(X, y, w)
+                    return lambda Xe: self._score_fn(model, Xe)
+                out.append((type(proto).__name__, params, fitter))
+        return out
+
+    # -- fit -----------------------------------------------------------------
+
+    def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
+                    features_col: FeatureColumn):
+        X = np.asarray(features_col.values, dtype=np.float32)
+        y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
+        n = len(y)
+        splitter = self.splitter
+        if splitter is None:
+            splitter = {"binary": DataBalancer(),
+                        "multiclass": DataCutter(),
+                        "regression": DataSplitter()}[self.problem_type]
+        train_idx, holdout_idx = splitter.split_indices(n, y)
+        train_mask = np.zeros(n, dtype=bool)
+        train_mask[train_idx] = True
+        base_w = splitter.train_weights(y, train_mask)
+
+        candidates = self._candidates()
+        best_i, results = self.validator.validate(
+            candidates, X, y, base_w,
+            eval_fn=self._metric, metric_name=self.validation_metric,
+            larger_better=self.larger_better)
+        best_name, best_params, _ = candidates[best_i]
+
+        # refit best on the full training split (ModelSelector.fit :180)
+        best_proto = next(p for p, _ in self.models_and_params
+                          if type(p).__name__ == best_name)
+        best_est = best_proto.copy(**best_params)
+        best_model = best_est.fit_raw(X, y, base_w)
+
+        train_metrics = self._full_metrics(best_model, X, y, train_mask)
+        holdout_metrics = (
+            self._full_metrics(best_model, X, y, ~train_mask)
+            if len(holdout_idx) else {})
+
+        summary = ModelSelectorSummary(
+            validation_results=results, best_model_name=best_name,
+            best_params=best_params,
+            validation_type=type(self.validator).__name__,
+            holdout_metrics=holdout_metrics, train_metrics=train_metrics,
+            splitter_summary=(splitter.summary.to_json()
+                              if splitter.summary else None))
+        self.metadata["model_selector_summary"] = summary.to_json()
+        selected = SelectedModel(inner=best_model, best_name=best_name,
+                                 best_params=best_params)
+        return selected
+
+    def _full_metrics(self, model: PredictorModel, X, y,
+                      mask: np.ndarray) -> Dict[str, float]:
+        idx = np.where(mask)[0]
+        if not len(idx):
+            return {}
+        batch = model.predict_batch(X[idx])
+        yy = y[idx]
+        if self.problem_type == "binary":
+            score = (np.asarray(batch.probability)[:, 1]
+                     if batch.probability is not None
+                     else np.asarray(batch.prediction))
+            return binary_classification_metrics(yy, score)
+        if self.problem_type == "multiclass":
+            pred = np.asarray(batch.prediction).astype(int)
+            n_classes = int(max(yy.max(), pred.max())) + 1
+            out = multiclass_metrics(yy.astype(int), pred, n_classes)
+            out.pop("confusion", None)
+            return out
+        return regression_metrics(yy, np.asarray(batch.prediction))
+
+
+class SelectedModel(PredictorModel):
+    """The winning fitted model (SelectedModel parity)."""
+
+    def __init__(self, inner: PredictorModel, best_name: str = "",
+                 best_params: Optional[Dict[str, Any]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="modelSelector", uid=uid)
+        self.inner = inner
+        self.best_name = best_name
+        self.best_params = best_params or {}
+
+    def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        return self.inner.predict_batch(X)
+
+
+# ---------------------------------------------------------------------------
+# Factories with default model grids
+# ---------------------------------------------------------------------------
+
+def _binary_defaults() -> List[Tuple[PredictorEstimator, List[Dict[str, Any]]]]:
+    """Default binary models: LR + RF (+ GBT/XGB-equivalent when enabled)
+    (BinaryClassificationModelSelector.scala:54-108)."""
+    from ..models.classification import OpLogisticRegression
+    from ..models.trees import OpGBTClassifier, OpRandomForestClassifier
+
+    D = DefaultSelectorParams
+    return [
+        (OpLogisticRegression(), grid(
+            reg_param=D.REGULARIZATION, elastic_net_param=D.ELASTIC_NET,
+            max_iter=D.MAX_ITER_LIN)),
+        (OpRandomForestClassifier(), grid(
+            max_depth=D.MAX_DEPTH, min_instances_per_node=D.MIN_INSTANCES_PER_NODE,
+            min_info_gain=D.MIN_INFO_GAIN, num_trees=D.MAX_TREES)),
+    ]
+
+
+def _multiclass_defaults():
+    from ..models.classification import OpLogisticRegression
+    from ..models.trees import OpRandomForestClassifier
+
+    D = DefaultSelectorParams
+    return [
+        (OpLogisticRegression(), grid(
+            reg_param=D.REGULARIZATION, elastic_net_param=D.ELASTIC_NET,
+            max_iter=D.MAX_ITER_LIN)),
+        (OpRandomForestClassifier(), grid(
+            max_depth=D.MAX_DEPTH, min_instances_per_node=D.MIN_INSTANCES_PER_NODE,
+            min_info_gain=D.MIN_INFO_GAIN, num_trees=D.MAX_TREES)),
+    ]
+
+
+def _regression_defaults():
+    from ..models.regression import OpLinearRegression
+    from ..models.trees import OpGBTRegressor, OpRandomForestRegressor
+
+    D = DefaultSelectorParams
+    return [
+        (OpLinearRegression(), grid(
+            reg_param=D.REGULARIZATION, elastic_net_param=D.ELASTIC_NET,
+            max_iter=[200])),
+        (OpRandomForestRegressor(), grid(
+            max_depth=D.MAX_DEPTH, min_instances_per_node=D.MIN_INSTANCES_PER_NODE,
+            min_info_gain=D.MIN_INFO_GAIN, num_trees=D.MAX_TREES)),
+        (OpGBTRegressor(), grid(
+            max_depth=D.MAX_DEPTH, max_iter=D.MAX_ITER_TREE,
+            step_size=D.STEP_SIZE)),
+    ]
+
+
+class BinaryClassificationModelSelector:
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3, validation_metric: str = "AuPR",
+        splitter=None, seed: int = 42,
+        models_and_parameters=None, parallelism: int = 8,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_params=models_and_parameters or _binary_defaults(),
+            problem_type="binary",
+            validator=OpCrossValidation(num_folds=num_folds, seed=seed,
+                                        stratify=True,
+                                        parallelism=parallelism),
+            splitter=splitter if splitter is not None else DataBalancer(seed=seed),
+            validation_metric=validation_metric)
+
+    @staticmethod
+    def with_train_validation_split(
+        train_ratio: float = 0.75, validation_metric: str = "AuPR",
+        splitter=None, seed: int = 42, models_and_parameters=None,
+        parallelism: int = 8,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_params=models_and_parameters or _binary_defaults(),
+            problem_type="binary",
+            validator=OpTrainValidationSplit(train_ratio=train_ratio,
+                                             seed=seed, stratify=True,
+                                             parallelism=parallelism),
+            splitter=splitter if splitter is not None else DataBalancer(seed=seed),
+            validation_metric=validation_metric)
+
+
+class MultiClassificationModelSelector:
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3, validation_metric: str = "F1",
+        splitter=None, seed: int = 42, models_and_parameters=None,
+        parallelism: int = 8,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_params=models_and_parameters or _multiclass_defaults(),
+            problem_type="multiclass",
+            validator=OpCrossValidation(num_folds=num_folds, seed=seed,
+                                        stratify=True,
+                                        parallelism=parallelism),
+            splitter=splitter if splitter is not None else DataCutter(seed=seed),
+            validation_metric=validation_metric)
+
+    @staticmethod
+    def with_train_validation_split(
+        train_ratio: float = 0.75, validation_metric: str = "F1",
+        splitter=None, seed: int = 42, models_and_parameters=None,
+        parallelism: int = 8,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_params=models_and_parameters or _multiclass_defaults(),
+            problem_type="multiclass",
+            validator=OpTrainValidationSplit(train_ratio=train_ratio,
+                                             seed=seed, stratify=True,
+                                             parallelism=parallelism),
+            splitter=splitter if splitter is not None else DataCutter(seed=seed),
+            validation_metric=validation_metric)
+
+
+class RegressionModelSelector:
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3, validation_metric: str = "RootMeanSquaredError",
+        splitter=None, seed: int = 42, models_and_parameters=None,
+        parallelism: int = 8,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_params=models_and_parameters or _regression_defaults(),
+            problem_type="regression",
+            validator=OpCrossValidation(num_folds=num_folds, seed=seed,
+                                        parallelism=parallelism),
+            splitter=splitter if splitter is not None else DataSplitter(seed=seed),
+            validation_metric=validation_metric)
+
+    @staticmethod
+    def with_train_validation_split(
+        train_ratio: float = 0.75,
+        validation_metric: str = "RootMeanSquaredError",
+        splitter=None, seed: int = 42, models_and_parameters=None,
+        parallelism: int = 8,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_params=models_and_parameters or _regression_defaults(),
+            problem_type="regression",
+            validator=OpTrainValidationSplit(train_ratio=train_ratio,
+                                             seed=seed,
+                                             parallelism=parallelism),
+            splitter=splitter if splitter is not None else DataSplitter(seed=seed),
+            validation_metric=validation_metric)
+
+
+class RandomParamBuilder:
+    """Random-search grids (RandomParamBuilder.scala:52,169)."""
+
+    def __init__(self, seed: int = 42):
+        self._rng = np.random.default_rng(seed)
+        self._axes: Dict[str, Callable[[], Any]] = {}
+
+    def uniform(self, name: str, lo: float, hi: float) -> "RandomParamBuilder":
+        self._axes[name] = lambda: float(self._rng.uniform(lo, hi))
+        return self
+
+    def log_uniform(self, name: str, lo: float, hi: float) -> "RandomParamBuilder":
+        self._axes[name] = lambda: float(np.exp(
+            self._rng.uniform(np.log(lo), np.log(hi))))
+        return self
+
+    def choice(self, name: str, options: Sequence[Any]) -> "RandomParamBuilder":
+        opts = list(options)
+        self._axes[name] = lambda: opts[int(self._rng.integers(len(opts)))]
+        return self
+
+    def build(self, n: int) -> List[Dict[str, Any]]:
+        return [{k: fn() for k, fn in self._axes.items()} for _ in range(n)]

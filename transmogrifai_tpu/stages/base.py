@@ -141,10 +141,20 @@ class PipelineStage:
         return self
 
     def copy(self, **overrides) -> "PipelineStage":
-        """Fresh instance with same params (reference ReflectionUtils.copy)."""
+        """Fresh instance with same params (reference ReflectionUtils.copy).
+
+        Required constructor args that get_params() excludes as
+        non-hyperparameters (e.g. LambdaTransformer's output_type) are pulled
+        from the instance's attributes; uid is never copied (new identity).
+        """
         params = {**self.get_params(), **overrides}
-        new = type(self)(**params)
-        return new
+        sig = inspect.signature(type(self).__init__)
+        for name, p in sig.parameters.items():
+            if (name not in ("self", "uid") and p.default is p.empty
+                    and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+                    and name not in params and hasattr(self, name)):
+                params[name] = getattr(self, name)
+        return type(self)(**params)
 
     def __repr__(self):
         return f"{type(self).__name__}(uid={self.uid!r})"

@@ -269,14 +269,6 @@ class OPMap(FeatureType):
     value_type: Type[FeatureType] = Text
 
 
-def _map_type(name: str, value_type_: Type[FeatureType],
-              bases=(OPMap,), extra: dict = None) -> Type[OPMap]:
-    ns = {"value_type": value_type_, "storage": "map"}
-    if extra:
-        ns.update(extra)
-    return type(name, bases, ns)
-
-
 class TextMap(OPMap):
     value_type = Text
 

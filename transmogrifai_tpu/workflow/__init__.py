@@ -1,0 +1,2 @@
+from .workflow import OpWorkflow, OpWorkflowModel  # noqa: F401
+from .dag import compute_dag, fit_and_transform_dag, transform_dag  # noqa: F401

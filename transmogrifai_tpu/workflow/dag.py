@@ -50,7 +50,6 @@ def compute_dag(result_features: Sequence[Feature]) -> StagesDAG:
     """
     # collect all stages reachable from result features (cycle-checked)
     stages: Dict[str, PipelineStage] = {}
-    producers: Dict[str, PipelineStage] = {}  # feature uid -> producing stage
 
     for rf in result_features:
         def visit(f: Feature):
@@ -58,7 +57,6 @@ def compute_dag(result_features: Sequence[Feature]) -> StagesDAG:
             if s is None:
                 raise ValueError(f"feature {f.name!r} has no origin stage")
             stages[s.uid] = s
-            producers[f.uid] = s
 
         rf.traverse(visit)
 

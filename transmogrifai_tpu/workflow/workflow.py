@@ -1,0 +1,286 @@
+"""Workflow engine — the user-facing train/score orchestration.
+
+Reference: ``OpWorkflow`` (core/.../OpWorkflow.scala — train :347, fitStages
+:376-455, generateRawData :235), ``OpWorkflowModel`` (OpWorkflowModel.scala —
+score :259, evaluate :324, summary :187-221, save :223), shared core state
+``OpWorkflowCore`` (OpWorkflowCore.scala:53-324).
+
+The TPU substitution: rather than launching Spark jobs per estimator, the DAG
+executes in-process — host columnar transforms feed a device-resident feature
+matrix, and every estimator's fit is a compiled XLA program.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..evaluators.evaluators import OpEvaluatorBase
+from ..features.feature import Feature
+from ..readers.base import Reader, reader_for
+from ..stages.base import Estimator, Model, PipelineStage, Transformer
+from ..stages.generator import FeatureGeneratorStage
+from ..types.columns import ColumnarDataset
+from .dag import StagesDAG, compute_dag, fit_and_transform_dag, transform_dag
+
+__all__ = ["OpWorkflow", "OpWorkflowModel"]
+
+
+class _WorkflowCore:
+    """State shared by workflow and fitted model (OpWorkflowCore parity)."""
+
+    def __init__(self):
+        self.result_features: List[Feature] = []
+        self.reader: Optional[Reader] = None
+        self.blocklisted: List[str] = []
+        self.parameters: Dict[str, Dict[str, Any]] = {}
+
+    def set_reader(self, reader) -> "_WorkflowCore":
+        self.reader = reader_for(reader)
+        return self
+
+    def set_input_data(self, data) -> "_WorkflowCore":
+        """Ad-hoc dataset wrapped into a reader (setInputDataset parity)."""
+        self.reader = reader_for(data)
+        return self
+
+    def raw_features(self) -> List[Feature]:
+        out: List[Feature] = []
+        seen = set()
+        for rf in self.result_features:
+            for f in rf.raw_features():
+                if f.uid not in seen:
+                    seen.add(f.uid)
+                    out.append(f)
+        return out
+
+    def generate_raw_data(self) -> ColumnarDataset:
+        if self.reader is None:
+            raise RuntimeError("no reader set — call set_reader/set_input_data")
+        return self.reader.generate_dataset(self.raw_features())
+
+
+class OpWorkflow(_WorkflowCore):
+    def __init__(self):
+        super().__init__()
+        self._raw_feature_filter = None
+        self._model_stages: Dict[str, Model] = {}
+
+    # -- wiring -------------------------------------------------------------
+
+    def set_result_features(self, *features: Feature) -> "OpWorkflow":
+        self.result_features = list(features)
+        return self
+
+    def set_parameters(self, params: Dict[str, Dict[str, Any]]) -> "OpWorkflow":
+        """Per-stage param injection by class name or uid (OpParams parity,
+        OpWorkflow.setStageParameters OpWorkflow.scala:179-201)."""
+        self.parameters = dict(params)
+        return self
+
+    def with_raw_feature_filter(self, **kwargs) -> "OpWorkflow":
+        """Enable RawFeatureFilter (OpWorkflow.withRawFeatureFilter :537)."""
+        from ..filters.raw_feature_filter import RawFeatureFilter
+
+        self._raw_feature_filter = RawFeatureFilter(**kwargs)
+        return self
+
+    def with_model_stages(self, model: "OpWorkflowModel") -> "OpWorkflow":
+        """Warm-start: reuse fitted models for matching estimator uids
+        (OpWorkflow.withModelStages OpWorkflow.scala:468)."""
+        for s in model.stages:
+            if isinstance(s, Model):
+                self._model_stages[s.uid] = s
+        return self
+
+    # -- training -----------------------------------------------------------
+
+    def _inject_params(self, dag: StagesDAG) -> None:
+        if not self.parameters:
+            return
+        for stage in dag.all_stages():
+            for key in (stage.uid, type(stage).__name__):
+                if key in self.parameters:
+                    stage.set_params(**self.parameters[key])
+
+    def train(self) -> "OpWorkflowModel":
+        data = self.generate_raw_data()
+        filter_results = None
+        if self._raw_feature_filter is not None:
+            data, filter_results = self._raw_feature_filter.filter_raw_data(
+                data, self.raw_features())
+        dag = compute_dag(self.result_features)
+        self._validate_stages(dag)
+        self._inject_params(dag)
+        fitted, transformed = fit_and_transform_dag(
+            dag, data, fitted_substitutes=self._model_stages)
+        model = OpWorkflowModel(
+            result_features=self.result_features,
+            stages=fitted,
+            train_data=transformed,
+        )
+        model.reader = self.reader
+        model.raw_feature_filter_results = filter_results
+        return model
+
+    def _validate_stages(self, dag: StagesDAG) -> None:
+        """Distinct-uid check (OpWorkflow.scala:280-338 analogue)."""
+        seen = set()
+        for s in dag.all_stages():
+            if s.uid in seen:
+                raise ValueError(f"duplicate stage uid {s.uid}")
+            seen.add(s.uid)
+
+    def compute_data_up_to(self, feature: Feature,
+                           data=None) -> ColumnarDataset:
+        """Materialize features up to (and including) ``feature``
+        (OpWorkflow.computeDataUpTo :491).  Estimators above are fit."""
+        if data is not None:
+            self.set_input_data(data)
+        raw = self.generate_raw_data()
+        dag = compute_dag([feature])
+        fitted, transformed = fit_and_transform_dag(dag, raw)
+        return transformed
+
+    def load_model(self, path: str) -> "OpWorkflowModel":
+        from .persistence import load_workflow_model
+
+        return load_workflow_model(path)
+
+
+class OpWorkflowModel(_WorkflowCore):
+    def __init__(self, result_features: Sequence[Feature],
+                 stages: Sequence[PipelineStage],
+                 train_data: Optional[ColumnarDataset] = None):
+        super().__init__()
+        self.result_features = list(result_features)
+        self.stages = list(stages)
+        self.train_data = train_data
+        self.raw_feature_filter_results = None
+
+    def _scoring_dag(self) -> StagesDAG:
+        # rebuild feature DAG over fitted stages (copyWithNewStages parity)
+        stage_map = {s.uid: s for s in self.stages}
+        feats = [f.copy_with_new_stages(stage_map) for f in self.result_features]
+        return compute_dag(feats)
+
+    def score(self, data=None,
+              keep_raw_features: bool = False,
+              keep_intermediate_features: bool = False) -> ColumnarDataset:
+        """Batched scoring over the fitted transformer DAG
+        (OpWorkflowModel.score :259 / applyTransformationsDAG)."""
+        if data is not None:
+            self.set_input_data(data)
+        raw = self.generate_raw_data()
+        scored = transform_dag(self._scoring_dag(), raw.copy())
+        if keep_raw_features and keep_intermediate_features:
+            return scored
+        keep = [f.name for f in self.result_features if f.name in scored]
+        if keep_raw_features:
+            keep = [f.name for f in self.raw_features()] + keep
+        # always keep the response(s) for evaluation
+        responses = [f.name for f in self.raw_features() if f.is_response]
+        keep = responses + [k for k in keep if k not in responses]
+        return scored.select([k for k in keep if k in scored])
+
+    def evaluate(self, evaluator: OpEvaluatorBase, data=None,
+                 scored: Optional[ColumnarDataset] = None) -> Dict[str, float]:
+        if scored is None:
+            scored = self.score(data)
+        label, pred = self._eval_columns(scored)
+        evaluator.label_col = evaluator.label_col or label
+        evaluator.prediction_col = evaluator.prediction_col or pred
+        return evaluator.evaluate(scored)
+
+    def score_and_evaluate(self, evaluator: OpEvaluatorBase, data=None):
+        scored = self.score(data)
+        return scored, self.evaluate(evaluator, scored=scored)
+
+    def _eval_columns(self, scored: ColumnarDataset):
+        from ..types.feature_types import Prediction
+
+        label = next((f.name for f in self.raw_features() if f.is_response), None)
+        pred = next(
+            (f.name for f in self.result_features
+             if issubclass(f.ftype, Prediction) and f.name in scored), None)
+        if pred is None:
+            pred = next(
+                (n for n in scored.names()
+                 if issubclass(scored[n].ftype, Prediction)), None)
+        return label, pred
+
+    # -- introspection ------------------------------------------------------
+
+    def get_fitted_stage(self, uid_or_name: str) -> PipelineStage:
+        for s in self.stages:
+            if s.uid == uid_or_name or type(s).__name__ == uid_or_name:
+                return s
+        raise KeyError(uid_or_name)
+
+    def summary(self) -> Dict[str, Any]:
+        """Merged stage metadata (OpWorkflowModel.summary :187)."""
+        out: Dict[str, Any] = {}
+        for s in self.stages:
+            if s.metadata:
+                out[s.uid] = _jsonable(s.metadata)
+        return out
+
+    def summary_json(self) -> str:
+        return json.dumps(self.summary(), indent=2, default=str)
+
+    def summary_pretty(self) -> str:
+        """Human-readable training summary (summaryPretty :221)."""
+        from ..selector.model_selector import ModelSelectorSummary
+
+        lines: List[str] = []
+        for s in self.stages:
+            summ = s.metadata.get("model_selector_summary")
+            if summ:
+                lines.append("Evaluated models:")
+                for row in summ.get("validationResults", [])[:20]:
+                    lines.append(
+                        f"  {row['modelType']} {row['params']} -> "
+                        f"{row['metricName']}={row['metricValue']:.4f}")
+                lines.append(
+                    f"Best model: {summ.get('bestModelType')} "
+                    f"{summ.get('bestModelParams')}")
+                hold = summ.get("holdoutMetrics")
+                if hold:
+                    lines.append("Holdout metrics: " + json.dumps(hold))
+            sc = s.metadata.get("summary")
+            if sc and "dropped" in sc:
+                lines.append(
+                    f"SanityChecker dropped {len(sc['dropped'])} columns: "
+                    f"{sc['dropped'][:10]}")
+        return "\n".join(lines) if lines else "(no fitted summaries)"
+
+    def model_insights(self, feature: Optional[Feature] = None):
+        from ..insights.model_insights import extract_model_insights
+
+        return extract_model_insights(self, feature)
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from .persistence import save_workflow_model
+
+        save_workflow_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "OpWorkflowModel":
+        from .persistence import load_workflow_model
+
+        return load_workflow_model(path)
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {k: _jsonable(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_jsonable(v) for v in obj]
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        return str(obj)
