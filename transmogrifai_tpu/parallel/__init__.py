@@ -1,0 +1,15 @@
+"""Mesh / sharding substrate (SURVEY §2.12, §5.8 — Spark → JAX mapping)."""
+from .mesh import (
+    data_sharding, feature_sharding, make_mesh, matrix_sharding,
+    pad_to_multiple, replicated, shard_dataset,
+)
+from .sharded import (
+    TrainStepState, fit_logreg_sharded, full_train_step, make_train_step,
+)
+
+__all__ = [
+    "make_mesh", "data_sharding", "feature_sharding", "matrix_sharding",
+    "replicated", "shard_dataset", "pad_to_multiple",
+    "TrainStepState", "full_train_step", "make_train_step",
+    "fit_logreg_sharded",
+]

@@ -1,0 +1,121 @@
+"""Device-mesh utilities — the distributed substrate of the framework.
+
+Reference mapping (SURVEY §2.12, §5.8): the reference's distributed backend is
+Apache Spark — RDD row partitions across executors, driver-coordinated
+``treeAggregate`` reductions inside MLlib (SanityChecker.scala:407-470,
+FeatureDistribution.scala:187), JVM-thread parallel model fits
+(OpCrossValidation.scala:113-138) and Rabit allreduce inside XGBoost's C++
+core.  The TPU-native equivalent built here is single-controller JAX:
+
+ * rows (Spark partitions)        -> ``data`` mesh axis (batch sharding)
+ * feature-dim / wide vectors     -> ``model`` mesh axis (the tabular
+                                     analogue of tensor parallelism)
+ * treeAggregate / Rabit allreduce-> XLA collectives (psum/all_gather) that
+                                     GSPMD inserts from sharding annotations,
+                                     riding ICI within a slice and DCN across
+ * driver thread-pool over grid   -> vmap/stacked fits over the mesh
+
+Nothing in this module issues explicit collectives: trainers are written as
+whole-array programs and the partitioner derives the communication, which is
+exactly the "pick a mesh, annotate shardings, let XLA insert collectives"
+recipe.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh", "data_sharding", "feature_sharding", "matrix_sharding",
+    "replicated", "shard_dataset", "pad_to_multiple",
+]
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Tuple[str, str] = ("data", "model"),
+              model_parallelism: Optional[int] = None) -> Mesh:
+    """Build a 2-D (data, model) mesh over the available devices.
+
+    ``model_parallelism`` defaults to 1 (pure data parallel) unless the
+    device count is not a power-of-two multiple of it.  Tabular workloads
+    are row-dominated; the model axis exists for wide-feature sharding of
+    histogram builds and (D,D) normal-equation work.
+    """
+    devs = jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    devs = devs[:n]
+    mp = model_parallelism or 1
+    if n % mp != 0:
+        raise ValueError(f"n_devices={n} not divisible by model_parallelism={mp}")
+    arr = np.asarray(devs).reshape(n // mp, mp)
+    return Mesh(arr, axis_names)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over the data axis — a (N,) label/weight vector."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def feature_sharding(mesh: Mesh) -> NamedSharding:
+    """A (D,) or (D, D) object sharded over the model axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[1]))
+
+
+def matrix_sharding(mesh: Mesh) -> NamedSharding:
+    """The (N, D) feature matrix: rows over data axis, columns over model."""
+    return NamedSharding(mesh, P(mesh.axis_names[0], mesh.axis_names[1]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0,
+                    fill=0.0) -> Tuple[np.ndarray, int]:
+    """Pad ``axis`` up to a multiple so it tiles evenly over a mesh axis.
+
+    Static-shape substitute for Spark's arbitrary row partitioning; returns
+    (padded, n_pad).  Callers carry a weight mask so padding rows are inert
+    in every reduction.
+    """
+    size = arr.shape[axis]
+    target = int(math.ceil(size / multiple)) * multiple if size else multiple
+    n_pad = target - size
+    if n_pad == 0:
+        return arr, 0
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, n_pad)
+    return np.pad(arr, widths, constant_values=fill), n_pad
+
+
+def shard_dataset(X: np.ndarray, y: Optional[np.ndarray], mesh: Mesh,
+                  w: Optional[np.ndarray] = None):
+    """Place (X, y, w) onto the mesh: rows×cols sharded X, row-sharded y/w.
+
+    Rows are zero-padded to tile the data axis and masked out via ``w``;
+    columns are zero-padded to tile the model axis (inert: zero columns
+    contribute nothing to matmuls and get zero weights back).
+    Returns (X_dev, y_dev, w_dev) committed device arrays.
+    """
+    ndata = mesh.shape[mesh.axis_names[0]]
+    nmodel = mesh.shape[mesh.axis_names[1]]
+    n_rows = X.shape[0]
+    if w is None:
+        w = np.ones(n_rows, np.float32)
+    X, _ = pad_to_multiple(np.asarray(X, np.float32), ndata, axis=0)
+    X, _ = pad_to_multiple(X, nmodel, axis=1)
+    w, _ = pad_to_multiple(np.asarray(w, np.float32), ndata, axis=0)
+    X_dev = jax.device_put(X, matrix_sharding(mesh))
+    w_dev = jax.device_put(w, data_sharding(mesh))
+    y_dev = None
+    if y is not None:
+        y_pad, _ = pad_to_multiple(np.asarray(y, np.float32), ndata, axis=0)
+        y_dev = jax.device_put(y_pad, data_sharding(mesh))
+    return X_dev, y_dev, w_dev

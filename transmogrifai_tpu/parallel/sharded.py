@@ -1,0 +1,128 @@
+"""Mesh-sharded training programs.
+
+These are the multi-chip paths of the XLA trainers in ``models/``: identical
+math, but inputs committed to a (data, model) mesh so GSPMD partitions the
+matmuls/scatters and inserts the ICI collectives that replace Spark's
+``treeAggregate`` (SanityChecker.scala:407-470) and XGBoost's Rabit
+allreduce (SURVEY §2.11-2.12).
+
+``full_train_step`` is the single compiled program the driver dry-runs on an
+N-virtual-device mesh: one AutoML macro-step =
+  column stats (SanityChecker pass)            — psum over data axis
+  Newton-IRLS logistic-regression update       — (D,N)@(N,D) sharded matmul
+  one histogram GBDT level (hist+split+route)  — sharded scatter-add + argmax
+all under one jit, with explicit sharding constraints on the carried state.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import (
+    data_sharding, make_mesh, matrix_sharding, replicated, shard_dataset,
+)
+
+__all__ = ["TrainStepState", "full_train_step", "make_train_step",
+           "fit_logreg_sharded"]
+
+
+class TrainStepState(NamedTuple):
+    """Carried state for one AutoML macro-step (all replicated)."""
+    beta: jnp.ndarray       # (D+1,) logreg coefficients + intercept
+    col_mean: jnp.ndarray   # (D,)
+    col_var: jnp.ndarray    # (D,)
+    tree_feat: jnp.ndarray  # (n_nodes,) int32 — split feature per node
+    tree_thresh: jnp.ndarray  # (n_nodes,) int32
+
+
+def _colstats(X, w):
+    wsum = jnp.maximum(w.sum(), 1.0)
+    mean = (w @ X) / wsum
+    var = (w @ (X * X)) / wsum - mean ** 2
+    return mean, var
+
+
+def _newton_step(X, y, w, beta, l2=1e-3):
+    from ..models.linear import _damped_solve, _finite_or
+
+    n, d = X.shape
+    wsum = jnp.maximum(w.sum(), 1.0)
+    z = X @ beta[:d] + beta[d]
+    p = jax.nn.sigmoid(z)
+    g_z = w * (p - y) / wsum
+    s = jnp.maximum(w * p * (1 - p) / wsum, 1e-10)
+    Xa = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
+    grad = Xa.T @ g_z
+    grad = grad.at[:d].add(l2 * beta[:d])
+    H = (Xa * s[:, None]).T @ Xa
+    H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
+    return _finite_or(beta - _damped_solve(H, grad), beta)
+
+
+def _tree_level(binned, g, h, w, node, n_nodes, n_bins, lam=1.0):
+    n, d = binned.shape
+    chans = jnp.stack([g * w, h * w, w], axis=1)          # (N, 3)
+    flat_idx = (node[:, None] * (d * n_bins)
+                + jnp.arange(d)[None, :] * n_bins + binned)
+    hist = jnp.zeros((n_nodes * d * n_bins, 3), jnp.float32)
+    hist = hist.at[flat_idx].add(chans[:, None, :])
+    hist = hist.reshape(n_nodes, d, n_bins, 3)
+    GL = jnp.cumsum(hist[..., 0], axis=2)
+    HL = jnp.cumsum(hist[..., 1], axis=2)
+    Gt, Ht = GL[:, :1, -1:], HL[:, :1, -1:]
+    gain = (GL ** 2 / (HL + lam) + (Gt - GL) ** 2 / (Ht - HL + lam)
+            - Gt ** 2 / (Ht + lam))
+    gain = jnp.where(jnp.arange(n_bins)[None, None, :] < n_bins - 1,
+                     gain, -jnp.inf)
+    best = jnp.argmax(gain.reshape(n_nodes, d * n_bins), axis=1)
+    feat = (best // n_bins).astype(jnp.int32)
+    thresh = (best % n_bins).astype(jnp.int32)
+    x_row = jnp.take_along_axis(binned, feat[node][:, None], 1)[:, 0]
+    new_node = 2 * node + (x_row > thresh[node]).astype(jnp.int32)
+    return feat, thresh, new_node
+
+
+def full_train_step(X, binned, y, w, state: TrainStepState, *,
+                    n_bins: int = 32) -> TrainStepState:
+    """One AutoML macro-step over sharded data (see module docstring)."""
+    mean, var = _colstats(X, w)
+    beta = _newton_step(X, y, w, state.beta)
+    g = jax.nn.sigmoid(X @ beta[:-1] + beta[-1]) - y     # logloss grads
+    h = jnp.maximum(g + y, 1e-6) * jnp.maximum(1.0 - g - y, 1e-6)
+    node = jnp.zeros(X.shape[0], jnp.int32)
+    feat, thresh, _ = _tree_level(binned, g, h, w, node,
+                                  state.tree_feat.shape[0], n_bins)
+    return TrainStepState(beta, mean, var, feat, thresh)
+
+
+def make_train_step(mesh: Mesh, n_bins: int = 32):
+    """Jit ``full_train_step`` with replicated state in/out on ``mesh``."""
+    rep = replicated(mesh)
+    step = functools.partial(full_train_step, n_bins=n_bins)
+    return jax.jit(step, in_shardings=(matrix_sharding(mesh),
+                                       matrix_sharding(mesh),
+                                       data_sharding(mesh),
+                                       data_sharding(mesh), rep),
+                   out_shardings=rep)
+
+
+def fit_logreg_sharded(X: np.ndarray, y: np.ndarray, mesh: Mesh,
+                       w: Optional[np.ndarray] = None, **kwargs):
+    """Data/model-parallel logistic regression: shard inputs on the mesh and
+    run the standard jitted IRLS trainer — GSPMD partitions the per-iteration
+    (D,N)@(N,D) Gram matmuls and psums partial Hessians over ICI.
+
+    The returned fit is sliced back to the caller's feature count (column
+    padding used to tile the model axis is stripped)."""
+    from ..models.linear import LinearFit, fit_logistic_regression
+    d = X.shape[1]
+    X_dev, y_dev, w_dev = shard_dataset(X, y, mesh, w)
+    fit = fit_logistic_regression(X_dev, y_dev, w_dev, **kwargs)
+    coef = fit.coef[..., :d] if fit.coef.shape[-1] != d else fit.coef
+    return LinearFit(coef, fit.intercept, fit.n_iter, fit.converged)
