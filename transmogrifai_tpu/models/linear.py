@@ -47,6 +47,27 @@ class LinearFit(NamedTuple):
     converged: jnp.ndarray
 
 
+def _damped_solve(H, g, rel: float = 1e-5):
+    """Cholesky solve with damping scaled to the Hessian's magnitude.
+
+    Pivoted one-hot blocks make H exactly singular when reg_param=0 (the
+    indicator columns sum to the intercept column); a fixed 1e-8 jitter is
+    below float32 resolution at typical diag scales, so damping is relative:
+    eps = rel * max|diag(H)|.  This is a Levenberg-style modified Newton
+    step — direction stays ascent-aligned, convergence unaffected.
+    """
+    d = H.shape[0]
+    eps = rel * jnp.max(jnp.abs(jnp.diagonal(H))) + 1e-12
+    return jax.scipy.linalg.solve(H + eps * jnp.eye(d, dtype=H.dtype), g,
+                                  assume_a="pos")
+
+
+def _finite_or(new, old):
+    """Reject a non-finite update (keeps the last good iterate)."""
+    ok = jnp.all(jnp.isfinite(new))
+    return jnp.where(ok, new, old)
+
+
 def _prep(X, y, sample_weight):
     X = jnp.asarray(X, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
@@ -105,9 +126,8 @@ def fit_logistic_regression(
         grad = grad.at[:d].add(l2 * beta[:d])
         H = (Xa * s[:, None]).T @ Xa
         H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
-        H = H + 1e-8 * jnp.eye(Xa.shape[1], dtype=X.dtype)
-        delta = jax.scipy.linalg.solve(H, grad, assume_a="pos")
-        new_beta = beta - delta
+        delta = _damped_solve(H, grad)
+        new_beta = _finite_or(beta - delta, beta)
         # proximal step for l1 (soft threshold coefficients, not intercept);
         # a no-op when l1 == 0, so applied unconditionally (keeps the program
         # hyperparameter-polymorphic — no retrace per grid point)
@@ -182,12 +202,11 @@ def fit_multinomial_logreg(
             s = jnp.maximum(w * p_k * (1 - p_k) / wsum, 1e-10)
             H = (Xa * s[:, None]).T @ Xa
             H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
-            H = H + 1e-8 * jnp.eye(da, dtype=X.dtype)
-            return jax.scipy.linalg.solve(H, g_k, assume_a="pos")
+            return _damped_solve(H, g_k)
 
         delta = jax.vmap(solve_class, in_axes=(1, 1, 1), out_axes=1)(G, P, B)
         # damping for stability of blockwise Newton
-        newB = B - 0.9 * delta
+        newB = _finite_or(B - 0.9 * delta, B)
         mask = (jnp.arange(da) < d)[:, None]
         newB = jnp.where(
             mask,
@@ -248,8 +267,8 @@ def fit_linear_regression(
     b = (Xc * w[:, None]).T @ yc / wsum          # (D,)
 
     def ridge(_):
-        M = A + (l2 + 1e-9) * jnp.eye(d, dtype=X.dtype)
-        coef = jax.scipy.linalg.solve(M, b, assume_a="pos")
+        M = A + l2 * jnp.eye(d, dtype=X.dtype)
+        coef = _damped_solve(M, b)
         return coef, jnp.int32(1), jnp.bool_(True)
 
     def fista(_):
@@ -323,9 +342,8 @@ def fit_linear_svc(
         grad = grad.at[:d].add(reg_param * beta[:d])
         H = (Xa * (2.0 * active)[:, None]).T @ Xa
         H = H.at[jnp.arange(d), jnp.arange(d)].add(reg_param)
-        H = H + 1e-6 * jnp.eye(da, dtype=X.dtype)
-        delta = jax.scipy.linalg.solve(H, grad, assume_a="pos")
-        nb = beta - delta
+        delta = _damped_solve(H, grad)
+        nb = _finite_or(beta - delta, beta)
         dn = jnp.max(jnp.abs(nb - beta))
         return nb, dn, it + 1
 

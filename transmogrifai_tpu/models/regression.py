@@ -14,7 +14,9 @@ import numpy as np
 
 from ..types.columns import ColumnarDataset, FeatureColumn
 from .classification import _apply_standardize, _extract_xy, _standardize_stats, _unstandardize
-from .linear import fit_linear_regression, linear_predict
+from .linear import (
+    _damped_solve, _finite_or, fit_linear_regression, linear_predict,
+)
 from .prediction import PredictionBatch, PredictorEstimator, PredictorModel
 
 __all__ = [
@@ -150,9 +152,8 @@ def _fit_glm_irls(X, y, family, link, reg, max_iter, tol, fit_intercept):
         z = eta + (y - mu) / gp
         A = (Xa * wirls[:, None]).T @ Xa / n
         A = A.at[jnp.arange(d), jnp.arange(d)].add(reg)
-        A = A + 1e-8 * jnp.eye(da, dtype=X.dtype)
         b = (Xa * wirls[:, None]).T @ z / n
-        nb = jax.scipy.linalg.solve(A, b, assume_a="pos")
+        nb = _finite_or(_damped_solve(A, b), beta)
         dn = jnp.max(jnp.abs(nb - beta))
         return nb, dn, it + 1
 

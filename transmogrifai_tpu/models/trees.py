@@ -26,7 +26,8 @@ import numpy as np
 from ..evaluators.metrics import aupr
 from ..types.columns import ColumnarDataset
 from .gbdt_kernels import (
-    TreeEnsemble, apply_bins, grow_tree, predict_ensemble, quantile_bins,
+    TreeEnsemble, apply_bins, grow_forest, grow_tree, predict_ensemble,
+    quantile_bins,
 )
 from .prediction import PredictionBatch, PredictorEstimator, PredictorModel
 
@@ -156,27 +157,25 @@ class _RandomForestBase(PredictorEstimator):
             Y = y[:, None].astype(np.float32)
         msub = _feature_subset_size(self.feature_subset_strategy, d,
                                     self._classification)
-        feats, threshs, leaves = [], [], []
-        for t in range(self.num_trees):
-            # bootstrap via Poisson weights (weight-space bagging)
-            bw = base_w * rng.poisson(self.subsample_rate, n).astype(np.float32)
-            mask = np.zeros(d, bool)
-            mask[rng.choice(d, msub, replace=False)] = True
-            G = jnp.asarray(Y * bw[:, None])
-            H = jnp.asarray(np.repeat(bw[:, None], k, axis=1))
-            f, th, lf = grow_tree(
-                binned, G, H, jnp.asarray(bw), max_depth=self.max_depth,
-                n_bins=self.max_bins, lam=1e-3,
-                min_info_gain=self.min_info_gain,
-                min_instances=float(self.min_instances_per_node),
-                feat_mask=jnp.asarray(mask), newton_leaf=False)
-            feats.append(np.asarray(f))
-            threshs.append(np.asarray(th))
-            leaves.append(np.asarray(lf))
+        T = self.num_trees
+        # bootstrap via Poisson weights (weight-space bagging); all trees'
+        # weights and feature subsets drawn up front so the whole forest is
+        # a handful of XLA launches (grow_forest chunks by HBM budget)
+        BW = base_w[None, :] * rng.poisson(
+            self.subsample_rate, (T, n)).astype(np.float32)
+        masks = np.zeros((T, d), bool)
+        for t in range(T):
+            masks[t, rng.choice(d, msub, replace=False)] = True
+        f, th, lf = grow_forest(
+            binned, Y, BW, masks,
+            max_depth=self.max_depth, n_bins=self.max_bins, lam=1e-3,
+            min_info_gain=self.min_info_gain,
+            min_instances=float(self.min_instances_per_node),
+            newton_leaf=False)
         mode = "rf_cls" if self._classification else "rf_reg"
         return TreeEnsembleModel(
-            mode=mode, edges=edges, feat=np.stack(feats),
-            thresh=np.stack(threshs), leaf=np.stack(leaves),
+            mode=mode, edges=edges, feat=np.asarray(f),
+            thresh=np.asarray(th), leaf=np.asarray(lf),
             n_classes=k if self._classification else 2)
 
 
