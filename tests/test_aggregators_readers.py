@@ -1,0 +1,175 @@
+"""Aggregators + aggregate/conditional/joined readers + testkit.
+
+Mirrors reference FeatureAggregatorTest / MonoidAggregatorDefaultsTest /
+DataReaderTest / JoinedDataReaderDataTest coverage.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.aggregators import (
+    CustomMonoidAggregator, CutOffTime, Event, FeatureAggregator,
+    TimeBasedAggregator, default_aggregator,
+)
+from transmogrifai_tpu.readers import (
+    AggregateDataReader, ConditionalDataReader, DataReaders,
+    JoinedDataReader, RecordsReader,
+)
+from transmogrifai_tpu.testkit import (
+    RandomBinary, RandomPickList, RandomReal, TestFeatureBuilder,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+class TestMonoidDefaults:
+    def test_per_type_defaults(self):
+        assert default_aggregator(ft.Real).name == "sumNumeric"
+        assert default_aggregator(ft.Binary).name == "maxBoolean"
+        assert default_aggregator(ft.DateTime).name == "maxTime"
+        assert default_aggregator(ft.TextList).name == "concatList"
+        assert default_aggregator(ft.MultiPickList).name == "unionSet"
+        assert default_aggregator(ft.RealMap).name == "unionMap"
+        assert default_aggregator(ft.Text).name == "concatText"
+
+    def test_reduce_semantics(self):
+        assert default_aggregator(ft.Real).reduce([1.0, 2.0, 3.5]) == 6.5
+        assert default_aggregator(ft.Binary).reduce([False, True]) is True
+        assert default_aggregator(ft.RealMap).reduce(
+            [{"a": 1.0}, {"a": 2.0, "b": 5.0}]) == {"a": 3.0, "b": 5.0}
+        assert default_aggregator(ft.MultiPickList).reduce(
+            [{"x"}, {"y"}]) == {"x", "y"}
+
+    def test_custom_and_time_based(self):
+        mean = CustomMonoidAggregator(
+            zero=(0.0, 0), plus=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            prepare=lambda v: (v, 1),
+            present=lambda a: a[0] / max(a[1], 1))
+        assert mean.reduce([2.0, 4.0]) == 3.0
+        lastk = TimeBasedAggregator(k=2, last=True)
+        assert lastk.reduce([1, 2, 3, 4]) == [3, 4]
+        first = TimeBasedAggregator(k=1, last=False)
+        assert first.reduce([7, 8, 9]) == 7
+
+
+class TestFeatureAggregatorWindows:
+    def test_predictor_excludes_post_cutoff(self):
+        agg = FeatureAggregator(ft.Real, is_response=False)
+        events = [Event(10, 1.0), Event(20, 2.0), Event(30, 4.0)]
+        assert agg.extract(events, cutoff_ms=25) == 3.0   # 1+2, not 4
+        assert agg.extract(events, cutoff_ms=None) == 7.0
+
+    def test_response_takes_post_cutoff_window(self):
+        agg = FeatureAggregator(ft.Real, is_response=True,
+                                response_window_ms=15)
+        events = [Event(10, 1.0), Event(30, 4.0), Event(50, 8.0)]
+        assert agg.extract(events, cutoff_ms=25) == 4.0   # 30 only (<40)
+
+    def test_predictor_window(self):
+        agg = FeatureAggregator(ft.Real, is_response=False,
+                                predictor_window_ms=10)
+        events = [Event(5, 1.0), Event(18, 2.0), Event(22, 4.0)]
+        assert agg.extract(events, cutoff_ms=25) == 6.0   # >= 15 only
+
+
+EVENTS = [
+    {"id": "a", "t": 10, "amount": 5.0, "label": 0.0},
+    {"id": "a", "t": 20, "amount": 2.0, "label": 1.0},
+    {"id": "b", "t": 12, "amount": 7.0, "label": 0.0},
+    {"id": "a", "t": 40, "amount": 100.0, "label": 1.0},
+]
+
+
+def _event_features():
+    amount = FeatureBuilder.Real("amount").as_predictor()
+    label = FeatureBuilder.RealNN("label").as_response()
+    return amount, label
+
+
+class TestAggregateReader:
+    def test_sum_by_key_with_cutoff(self):
+        amount, label = _event_features()
+        reader = AggregateDataReader(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+            cutoff=CutOffTime.unix(30))
+        data = reader.generate_dataset([amount, label])
+        # predictors: strictly before 30 -> a: 5+2, b: 7
+        assert data["amount"].to_list() == [7.0, 7.0]
+        assert data["key"].to_list() == ["a", "b"]
+        # response: at/after 30 -> a: 1.0 (t=40), b: none
+        assert data["label"].to_list()[0] == 1.0
+
+    def test_no_cutoff_aggregates_all(self):
+        amount, label = _event_features()
+        reader = DataReaders.Aggregate.records(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["t"])
+        data = reader.generate_dataset([amount])
+        assert data["amount"].to_list() == [107.0, 7.0]
+
+
+class TestConditionalReader:
+    def test_cutoff_from_condition(self):
+        amount, label = _event_features()
+        reader = ConditionalDataReader(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+            target_condition=lambda r: r["label"] > 0)
+        data = reader.generate_dataset([amount, label])
+        # entity b has no positive record -> dropped
+        assert data["key"].to_list() == ["a"]
+        # a's first positive at t=20 -> predictors before 20: only t=10
+        assert data["amount"].to_list() == [5.0]
+
+    def test_keep_entities_without_target(self):
+        amount, _ = _event_features()
+        reader = ConditionalDataReader(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+            target_condition=lambda r: r["label"] > 0,
+            drop_if_no_target=False)
+        data = reader.generate_dataset([amount])
+        assert data["key"].to_list() == ["a", "b"]
+
+
+class TestJoinedReader:
+    def _sides(self):
+        left = [{"key": "k1", "x": 1.0}, {"key": "k2", "x": 2.0}]
+        right = [{"key": "k2", "z": 20.0}, {"key": "k3", "z": 30.0}]
+        xf = FeatureBuilder.Real("x").as_predictor()
+        zf = FeatureBuilder.Real("z").as_predictor()
+        return RecordsReader(left), RecordsReader(right), xf, zf
+
+    def test_inner_left_outer(self):
+        lr, rr, xf, zf = self._sides()
+        for jt, nkeys in (("inner", 1), ("left", 2), ("outer", 3)):
+            joined = JoinedDataReader(lr, rr, [xf], [zf], join_type=jt,
+                                      left_key="key", right_key="key")
+            data = joined.generate_dataset([xf, zf])
+            assert len(data["key"].to_list()) == nkeys, jt
+        inner = JoinedDataReader(lr, rr, [xf], [zf], join_type="inner",
+                                 left_key="key", right_key="key"
+                                 ).generate_dataset([xf, zf])
+        assert inner["x"].to_list() == [2.0]
+        assert inner["z"].to_list() == [20.0]
+
+    def test_unknown_join_type(self):
+        lr, rr, xf, zf = self._sides()
+        with pytest.raises(ValueError):
+            JoinedDataReader(lr, rr, [xf], [zf], join_type="cross")
+
+
+class TestTestkit:
+    def test_build_and_random(self):
+        data, feats = TestFeatureBuilder.build(
+            ("age", ft.Real, [1.0, None, 3.0]),
+            ("label", ft.RealNN, [0.0, 1.0, 0.0]),
+            response="label")
+        assert len(data) == 3
+        assert [f.is_response for f in feats] == [False, True]
+
+        data2, feats2 = TestFeatureBuilder.random(
+            50,
+            ("x", ft.Real, RandomReal.normal(seed=1,
+                                             probability_of_empty=0.3)),
+            ("c", ft.PickList, RandomPickList(["a", "b"], seed=2)),
+            ("y", ft.Binary, RandomBinary(0.7, seed=3)))
+        assert len(data2) == 50
+        xs = data2["x"].to_list()
+        assert 5 < sum(v is None for v in xs) < 45  # P(empty) respected

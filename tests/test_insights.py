@@ -1,0 +1,99 @@
+"""ModelInsights + RecordInsightsLOCO (reference ModelInsightsTest,
+RecordInsightsLOCOTest coverage)."""
+import json
+
+import numpy as np
+import pandas as pd
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.insights import (
+    RecordInsightsLOCO, extract_model_insights, parse_insights,
+)
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector, grid
+
+
+def _train(n=300, seed=5):
+    rng = np.random.default_rng(seed)
+    strong = rng.normal(size=n)
+    weak = rng.normal(size=n)
+    color = rng.choice(["red", "blue"], n)
+    z = 2.5 * strong + 1.2 * (color == "red")
+    label = (1 / (1 + np.exp(-z)) > rng.random(n)).astype(float)
+    df = pd.DataFrame({"label": label, "strong": strong, "weak": weak,
+                       "color": color})
+    label_f = FeatureBuilder.RealNN("label").as_response()
+    preds = [FeatureBuilder.Real("strong").as_predictor(),
+             FeatureBuilder.Real("weak").as_predictor(),
+             FeatureBuilder.PickList("color").as_predictor()]
+    features = transmogrify(preds)
+    checked = SanityChecker().set_input(label_f, features).get_output()
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01]))])
+    pred = sel.set_input(label_f, checked).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_data(df)
+    return wf.train(), df, pred, checked
+
+
+class TestModelInsights:
+    def test_structure_and_contributions(self):
+        model, df, pred, checked = _train()
+        ins = model.model_insights()
+        doc = ins.to_json()
+        assert doc["label"]["labelName"] == "label"
+        assert doc["label"]["distribution"]
+        names = {f.feature_name for f in ins.features}
+        assert {"strong", "weak", "color"} <= names
+        strong_f = next(f for f in ins.features if f.feature_name == "strong")
+        weak_f = next(f for f in ins.features if f.feature_name == "weak")
+        s_contrib = max(c["contribution"] or 0
+                        for c in strong_f.derived_columns)
+        w_contrib = max(c["contribution"] or 0
+                        for c in weak_f.derived_columns)
+        assert s_contrib > w_contrib  # the informative feature dominates
+        assert doc["selectedModelInfo"]["bestModelType"] == "OpLogisticRegression"
+        # sanity stats merged into the per-column entries
+        assert any(c.get("corr_label") is not None
+                   for c in strong_f.derived_columns)
+        assert ins.pretty_print()
+
+    def test_stage_info_lists_fitted_stages(self):
+        model, *_ = _train()
+        doc = model.model_insights().to_json()
+        stages = {s["stage"] for s in doc["stageInfo"]}
+        assert "SelectedModel" in stages
+        assert "SanityCheckerModel" in stages
+
+
+class TestRecordInsightsLOCO:
+    def test_loco_ranks_informative_feature(self):
+        model, df, pred, checked = _train()
+        scored = model.score(df, keep_intermediate_features=True,
+                             keep_raw_features=True)
+        features_col = scored[checked.name]
+        sel_stage = next(s for s in model.stages
+                         if "model_selector_summary" in s.metadata)
+        loco = RecordInsightsLOCO(sel_stage, top_k=5)
+        out = loco.transform_columns(features_col)
+        row = out.values[0]
+        parsed = parse_insights(row)
+        assert isinstance(parsed, dict) and parsed
+        # for most rows the top-|diff| feature should be 'strong'
+        tops = []
+        for i in range(50):
+            p = parse_insights(out.values[i])
+            top = max(p.items(), key=lambda kv: max(abs(x) for x in kv[1]))
+            tops.append(top[0])
+        assert sum(t == "strong" for t in tops) > 25
+
+    def test_loco_per_column_mode(self):
+        model, df, pred, checked = _train(n=120)
+        scored = model.score(df, keep_intermediate_features=True,
+                             keep_raw_features=True)
+        loco = RecordInsightsLOCO(
+            next(s for s in model.stages if hasattr(s, "predict_batch")),
+            top_k=3, aggregate_by_feature=False)
+        out = loco.transform_columns(scored[checked.name])
+        assert all(len(v) <= 3 for v in out.values)

@@ -1,0 +1,114 @@
+"""Model persistence round-trip + Spark-free local scoring parity.
+
+Reference tests being mirrored: OpWorkflowModelReaderWriterTest (save →
+load → identical behavior) and OpWorkflowModelLocalTest (batch score vs
+local score-function parity — local/OpWorkflowModelLocalTest).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.local import load_model_local, score_function
+from transmogrifai_tpu.models import OpLogisticRegression, OpRandomForestClassifier
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector, grid
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def make_df(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    age = rng.normal(40, 12, n).round(1)
+    age[rng.random(n) < 0.1] = np.nan
+    income = rng.lognormal(10, 1, n).round(2)
+    color = rng.choice(["red", "green", "blue", None], n, p=[0.4, 0.3, 0.2, 0.1])
+    z = 0.08 * (age - 40) + 0.9 * (color == "red") - 0.4
+    label = (1 / (1 + np.exp(-np.nan_to_num(z))) > rng.random(n)).astype(float)
+    return pd.DataFrame({
+        "label": label, "age": age, "income": income, "color": color,
+    })
+
+
+def build_and_train(df, models=None):
+    label = FeatureBuilder.RealNN("label").as_response()
+    age = FeatureBuilder.Real("age").as_predictor()
+    income = FeatureBuilder.Currency("income").as_predictor()
+    color = FeatureBuilder.PickList("color").as_predictor()
+    features = transmogrify([age, income, color])
+    checked = SanityChecker().set_input(label, features).get_output()
+    selector = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=models or [
+            (OpLogisticRegression(), grid(reg_param=[0.01])),
+            (OpRandomForestClassifier(num_trees=10, max_depth=4), [{}]),
+        ])
+    pred = selector.set_input(label, checked).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_data(df)
+    return wf.train(), pred
+
+
+class TestPersistenceRoundTrip:
+    def test_save_load_score_parity(self, tmp_path):
+        df = make_df()
+        model, pred = build_and_train(df)
+        scored = model.score(df)
+        path = str(tmp_path / "model")
+        model.save(path)
+
+        loaded = load_model_local(path)
+        rescored = loaded.score(df)
+        a = scored[pred.name].values
+        b = rescored[pred.name].values
+        np.testing.assert_allclose(a.probability, b.probability, atol=1e-6)
+        np.testing.assert_array_equal(a.prediction, b.prediction)
+
+    def test_saved_metadata_survives(self, tmp_path):
+        df = make_df()
+        model, _ = build_and_train(df)
+        path = str(tmp_path / "model")
+        model.save(path)
+        loaded = load_model_local(path)
+        summ = loaded.summary()
+        sel = next(v["model_selector_summary"] for v in summ.values()
+                   if "model_selector_summary" in v)
+        assert sel["bestModelType"] in ("OpLogisticRegression",
+                                        "OpRandomForestClassifier")
+        assert loaded.summary_pretty()
+
+    def test_overwrite_protection(self, tmp_path):
+        df = make_df(120)
+        model, _ = build_and_train(
+            df, models=[(OpLogisticRegression(), [{}])])
+        path = str(tmp_path / "m")
+        model.save(path)
+        with pytest.raises(FileExistsError):
+            model.save(path, overwrite=False)
+        model.save(path)  # overwrite ok
+
+
+class TestLocalScoring:
+    def test_score_function_matches_batch(self, tmp_path):
+        df = make_df()
+        model, pred = build_and_train(df)
+        batch_scored = model.score(df)
+        proba = batch_scored[pred.name].values.probability
+
+        path = str(tmp_path / "model")
+        model.save(path)
+        loaded = load_model_local(path)
+        fn = score_function(loaded)
+        rows = df.to_dict(orient="records")
+        for i in [0, 7, 42, 299]:
+            out = fn(rows[i])
+            m = out[pred.name]
+            assert set(m) >= {"prediction", "probability_0", "probability_1"}
+            np.testing.assert_allclose(m["probability_1"], proba[i, 1],
+                                       atol=2e-5)
+
+    def test_score_function_without_response(self, tmp_path):
+        df = make_df(150)
+        model, pred = build_and_train(
+            df, models=[(OpLogisticRegression(), [{}])])
+        fn = score_function(model)
+        row = {"age": 33.0, "income": 50000.0, "color": "red"}
+        out = fn(row)
+        assert 0.0 <= out[pred.name]["probability_1"] <= 1.0

@@ -1,0 +1,175 @@
+"""RawFeatureFilter + StreamingHistogram + FeatureDistribution.
+
+Mirrors the reference's RawFeatureFilterTest / FeatureDistributionTest /
+StreamingHistogramTest coverage (core/src/test/.../filters/).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.filters import (
+    FeatureDistribution, RawFeatureFilter, profile_column,
+)
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import FeatureColumn
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.streaming_histogram import StreamingHistogram
+
+
+class TestStreamingHistogram:
+    def test_bounded_bins_and_mass_conserved(self, rng):
+        h = StreamingHistogram(max_bins=20)
+        for _ in range(5):
+            h.update(rng.normal(size=1000))
+        assert h.centroids.size <= 20
+        assert h.total == 5000
+        assert np.all(np.diff(h.centroids) >= 0)
+
+    def test_quantiles_approximate(self, rng):
+        h = StreamingHistogram(max_bins=64).update(rng.normal(size=20000))
+        assert abs(h.quantile(0.5)) < 0.15
+        assert abs(h.quantile(0.975) - 1.96) < 0.3
+
+    def test_merge_monoid(self, rng):
+        a = StreamingHistogram(32).update(rng.normal(size=500))
+        b = StreamingHistogram(32).update(rng.normal(2.0, 1.0, size=700))
+        m = a.merge(b)
+        assert m.total == 1200
+        assert m.centroids.size <= 32
+
+    def test_json_round_trip(self, rng):
+        h = StreamingHistogram(16).update(rng.normal(size=100))
+        h2 = StreamingHistogram.from_json(h.to_json())
+        np.testing.assert_array_equal(h.centroids, h2.centroids)
+
+
+class TestFeatureDistribution:
+    def test_numeric_profile_and_fill(self):
+        col = FeatureColumn.from_values(ft.Real, [1.0, 2.0, None, 4.0])
+        d, = profile_column("x", col)
+        assert d.count == 4 and d.nulls == 1
+        assert d.fill_rate() == pytest.approx(0.75)
+
+    def test_text_profile(self):
+        col = FeatureColumn.from_values(ft.PickList, ["a", "b", None, "a"])
+        d, = profile_column("t", col)
+        assert d.nulls == 1
+        assert d.text_counts.sum() == 3
+
+    def test_map_profile_per_key(self):
+        col = FeatureColumn.from_values(
+            ft.RealMap, [{"a": 1.0, "b": 2.0}, {"a": 3.0}])
+        dists = profile_column("m", col)
+        assert {d.key for d in dists} == {"a", "b"}
+        db = next(d for d in dists if d.key == "b")
+        assert db.nulls == 1
+
+    def test_monoid_add(self, rng):
+        c1 = FeatureColumn.from_values(ft.Real, list(rng.normal(size=50)))
+        c2 = FeatureColumn.from_values(ft.Real, list(rng.normal(size=70)))
+        d = profile_column("x", c1)[0] + profile_column("x", c2)[0]
+        assert d.count == 120
+
+    def test_js_divergence_same_vs_shifted(self, rng):
+        a = profile_column("x", FeatureColumn.from_values(
+            ft.Real, list(rng.normal(size=2000))))[0]
+        b = profile_column("x", FeatureColumn.from_values(
+            ft.Real, list(rng.normal(size=2000))))[0]
+        c = profile_column("x", FeatureColumn.from_values(
+            ft.Real, list(rng.normal(8.0, 0.5, size=2000))))[0]
+        assert a.js_divergence(b) < 0.1
+        assert a.js_divergence(c) > 0.8
+
+
+def _mkdf(n=400, seed=1):
+    rng = np.random.default_rng(seed)
+    label = (rng.random(n) < 0.5).astype(float)
+    good = rng.normal(size=n)
+    # leaky: null exactly when label is 0
+    leaky = np.where(label > 0, rng.normal(size=n), np.nan)
+    sparse = np.full(n, np.nan)
+    sparse[:1] = 1.0  # fill rate ~0.0025 > default 0.001; dropped w/ 0.05
+    return pd.DataFrame({"label": label, "good": good, "leaky": leaky,
+                         "sparse": sparse})
+
+
+class TestRawFeatureFilter:
+    def _features(self):
+        label = FeatureBuilder.RealNN("label").as_response()
+        good = FeatureBuilder.Real("good").as_predictor()
+        leaky = FeatureBuilder.Real("leaky").as_predictor()
+        sparse = FeatureBuilder.Real("sparse").as_predictor()
+        return label, [good, leaky, sparse]
+
+    def test_drops_low_fill_and_leakage(self):
+        df = _mkdf()
+        label, preds = self._features()
+        features = transmogrify(preds)
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(OpLogisticRegression(reg_param=0.01), [{}])])
+        pred = sel.set_input(label, features).get_output()
+        wf = (OpWorkflow().set_result_features(pred)
+              .with_raw_feature_filter(min_fill_rate=0.05)
+              .set_input_data(df))
+        model = wf.train()
+        res = model.raw_feature_filter_results
+        assert "sparse" in res.dropped_features
+        assert "leaky" in res.dropped_features
+        assert "good" not in res.dropped_features
+        # pruned stages: the fitted vectorizer saw only the surviving input
+        scored = model.score(df)
+        assert pred.name in scored
+
+    def test_train_score_divergence(self, rng):
+        df = _mkdf()
+        score_df = df.copy()
+        score_df["good"] = rng.normal(50.0, 1.0, len(df))  # shifted at serve
+        label, preds = self._features()
+        features = transmogrify(preds)
+        wf = (OpWorkflow().set_result_features(features)
+              .with_raw_feature_filter(min_fill_rate=0.0,
+                                       max_correlation=1.1,
+                                       max_js_divergence=0.5,
+                                       scoring_data=score_df)
+              .set_input_data(df))
+        model = wf.train()
+        res = model.raw_feature_filter_results
+        assert "good" in res.dropped_features
+
+    def test_protected_features_kept(self):
+        df = _mkdf()
+        label, preds = self._features()
+        features = transmogrify(preds)
+        wf = (OpWorkflow().set_result_features(features)
+              .with_raw_feature_filter(
+                  min_fill_rate=0.05,
+                  protected_features=["sparse", "leaky"])
+              .set_input_data(df))
+        model = wf.train()
+        assert model.raw_feature_filter_results.dropped_features == []
+
+    def test_all_inputs_dropped_raises(self):
+        df = _mkdf()
+        label = FeatureBuilder.RealNN("label").as_response()
+        sparse = FeatureBuilder.Real("sparse").as_predictor()
+        features = transmogrify([sparse])
+        wf = (OpWorkflow().set_result_features(features)
+              .with_raw_feature_filter(min_fill_rate=0.05)
+              .set_input_data(df))
+        with pytest.raises(ValueError, match="protect"):
+            wf.train()
+
+    def test_results_json(self):
+        df = _mkdf()
+        label, preds = self._features()
+        features = transmogrify(preds)
+        wf = (OpWorkflow().set_result_features(features)
+              .with_raw_feature_filter(min_fill_rate=0.05)
+              .set_input_data(df))
+        model = wf.train()
+        doc = model.raw_feature_filter_results.to_json()
+        assert doc["droppedFeatures"]
+        assert doc["config"]["minFillRate"] == 0.05
+        assert len(doc["exclusionReasons"]) == 3

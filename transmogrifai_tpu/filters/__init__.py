@@ -1,0 +1,8 @@
+"""Raw-feature QA filters (reference core/.../filters/, SURVEY §2.6)."""
+from .feature_distribution import FeatureDistribution, profile_column
+from .raw_feature_filter import (
+    ExclusionReasons, RawFeatureFilter, RawFeatureFilterResults,
+)
+
+__all__ = ["FeatureDistribution", "profile_column", "RawFeatureFilter",
+           "RawFeatureFilterResults", "ExclusionReasons"]

@@ -1,0 +1,184 @@
+"""Per-raw-feature distribution profiles for RawFeatureFilter.
+
+Reference: ``FeatureDistribution`` (core/.../filters/FeatureDistribution.scala
+:58,235) — count / nulls / histogram per raw feature (and per map key), built
+as a monoid so Spark can map-reduce it over partitions (:187-192); numerics
+profile through the streaming histogram, text through hashed token counts.
+
+Here columns are profiled in one vectorized pass; the monoid ``+`` remains so
+distributions reduce across data shards (the mesh/host-shard analogue of the
+reference's partition reduce).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..types.columns import FeatureColumn
+from ..utils.hashing import murmur3_32
+from ..utils.streaming_histogram import StreamingHistogram
+
+__all__ = ["FeatureDistribution", "profile_column"]
+
+TEXT_BINS = 255          # hashed token buckets for text (reference default)
+NUMERIC_BINS = 100
+#: cells for train-vs-score density comparison — coarser than the histogram
+#: so per-cell mass is well estimated (keeps JS of identical dists near 0)
+JS_GRID = 20
+
+
+@dataclasses.dataclass
+class FeatureDistribution:
+    name: str
+    key: Optional[str] = None          # map key (None for scalar features)
+    count: int = 0
+    nulls: int = 0
+    hist: Optional[StreamingHistogram] = None     # numeric profile
+    text_counts: Optional[np.ndarray] = None      # hashed text profile
+    moments_n: float = 0.0
+    moments_sum: float = 0.0
+    moments_sum2: float = 0.0
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.name}[{self.key}]" if self.key is not None else self.name
+
+    def fill_rate(self) -> float:
+        return (self.count - self.nulls) / self.count if self.count else 0.0
+
+    def relative_fill_rate(self, other: "FeatureDistribution") -> float:
+        return abs(self.fill_rate() - other.fill_rate())
+
+    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
+        a, b = self.fill_rate(), other.fill_rate()
+        lo, hi = min(a, b), max(a, b)
+        return float("inf") if lo == 0 else hi / lo
+
+    def __add__(self, other: "FeatureDistribution") -> "FeatureDistribution":
+        assert (self.name, self.key) == (other.name, other.key)
+        hist = (self.hist.merge(other.hist)
+                if self.hist is not None and other.hist is not None
+                else self.hist or other.hist)
+        tc = None
+        if self.text_counts is not None or other.text_counts is not None:
+            a = self.text_counts if self.text_counts is not None else 0
+            b = other.text_counts if other.text_counts is not None else 0
+            tc = a + b
+        return FeatureDistribution(
+            self.name, self.key, self.count + other.count,
+            self.nulls + other.nulls, hist, tc,
+            self.moments_n + other.moments_n,
+            self.moments_sum + other.moments_sum,
+            self.moments_sum2 + other.moments_sum2)
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        """Jensen-Shannon divergence between two profiles of the same feature
+        (FeatureDistribution.jsDivergence) — in [0, 1] with log base 2."""
+        p, q = self._density_pair(other)
+        if p is None:
+            return 0.0
+        m = 0.5 * (p + q)
+
+        def kl(a, b):
+            mask = a > 0
+            return float(np.sum(a[mask] * np.log2(a[mask] / b[mask])))
+
+        return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+
+    def _density_pair(self, other):
+        if self.hist is not None and other.hist is not None:
+            lo1, hi1 = self.hist.bounds
+            lo2, hi2 = other.hist.bounds
+            if np.isnan(lo1) or np.isnan(lo2):
+                return None, None
+            lo, hi = min(lo1, lo2), max(hi1, hi2)
+            if lo == hi:
+                return None, None
+            grid = np.linspace(lo, hi, JS_GRID)
+            return self.hist.density(grid), other.hist.density(grid)
+        if self.text_counts is not None and other.text_counts is not None:
+            ts, to = self.text_counts.sum(), other.text_counts.sum()
+            if ts == 0 or to == 0:
+                return None, None
+            return self.text_counts / ts, other.text_counts / to
+        return None, None
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name, "key": self.key, "count": self.count,
+            "nulls": self.nulls, "fillRate": self.fill_rate(),
+            "moments": {"n": self.moments_n, "sum": self.moments_sum,
+                        "sum2": self.moments_sum2},
+            "histogram": self.hist.to_json() if self.hist else None,
+            "textCounts": (self.text_counts.tolist()
+                           if self.text_counts is not None else None),
+        }
+
+
+def _profile_numeric(name, key, vals: np.ndarray, mask: np.ndarray):
+    d = FeatureDistribution(name, key, count=len(vals),
+                            nulls=int((~mask).sum()))
+    finite = vals[mask & np.isfinite(vals)]
+    d.hist = StreamingHistogram(NUMERIC_BINS).update(finite)
+    d.moments_n = float(finite.size)
+    d.moments_sum = float(finite.sum())
+    d.moments_sum2 = float((finite ** 2).sum())
+    return d
+
+
+def _profile_text(name, key, values) -> FeatureDistribution:
+    d = FeatureDistribution(name, key, count=len(values))
+    counts = np.zeros(TEXT_BINS, np.float64)
+    nulls = 0
+    for v in values:
+        if v is None:
+            nulls += 1
+        else:
+            counts[murmur3_32(str(v)) % TEXT_BINS] += 1
+    d.nulls = nulls
+    d.text_counts = counts
+    return d
+
+
+def profile_column(name: str, col: FeatureColumn) -> List[FeatureDistribution]:
+    """Profile one raw column into distributions (one per map key for maps)."""
+    st = col.ftype.storage
+    if st in ("real", "integral", "binary", "date"):
+        vals = np.asarray(col.values, np.float64)
+        return [_profile_numeric(name, None, vals, np.asarray(col.mask))]
+    if st == "text":
+        return [_profile_text(name, None, list(col.values))]
+    if st in ("text_list", "multi_pick_list", "date_list"):
+        flat = [" ".join(map(str, sorted(v))) if v else None
+                for v in col.values]
+        return [_profile_text(name, None, flat)]
+    if st == "geolocation":
+        vals = np.asarray(col.values, np.float64)
+        mask = np.asarray(col.mask)
+        return [_profile_numeric(name, None, vals[:, 0], mask)]
+    if st == "map":
+        keys = sorted({k for row in col.values for k in row})
+        out = []
+        for k in keys:
+            sample = next((row[k] for row in col.values if k in row), None)
+            if isinstance(sample, (int, float, bool)) and not isinstance(
+                    sample, bool):
+                try:
+                    vals, mask = [], []
+                    for row in col.values:
+                        v = row.get(k)
+                        mask.append(v is not None)
+                        vals.append(float(v) if v is not None else np.nan)
+                    out.append(_profile_numeric(
+                        name, k, np.asarray(vals), np.asarray(mask)))
+                    continue
+                except (TypeError, ValueError):
+                    pass  # heterogeneous values — profile as text below
+            out.append(_profile_text(
+                name, k, [None if row.get(k) is None else str(row.get(k))
+                          for row in col.values]))
+        return out
+    # vectors and unknowns: count-only profile
+    return [FeatureDistribution(name, None, count=len(col))]

@@ -1,0 +1,205 @@
+"""RawFeatureFilter — workflow-level raw-feature QA before the DAG runs.
+
+Reference: ``RawFeatureFilter`` (core/.../filters/RawFeatureFilter.scala:90):
+profiles every raw feature (and map key) on the training and (optionally)
+scoring readers, then drops features whose training fill rate is too low,
+whose train/score fill rates diverge (absolute difference or ratio), whose
+train/score distributions diverge (Jensen-Shannon), or whose null-indicator
+correlates with the label (leakage) — decision logic at :445-486; cleaned
+data + dropped lists returned by ``generateFilteredRaw`` :486-575; results
+recorded as ``RawFeatureFilterResults`` (filters/RawFeatureFilterResults.scala).
+Defaults mirror ``OpWorkflow.withRawFeatureFilter`` (OpWorkflow.scala:541-545).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..types.columns import ColumnarDataset, FeatureColumn
+from .feature_distribution import FeatureDistribution, profile_column
+
+__all__ = ["RawFeatureFilter", "RawFeatureFilterResults", "ExclusionReasons"]
+
+
+@dataclasses.dataclass
+class ExclusionReasons:
+    """Why a feature/key was (or wasn't) dropped (ExclusionReasons parity)."""
+    name: str
+    key: Optional[str]
+    train_fill_rate: float
+    low_fill: bool = False
+    fill_difference: bool = False
+    fill_ratio: bool = False
+    js_divergence: bool = False
+    null_label_leakage: bool = False
+
+    @property
+    def excluded(self) -> bool:
+        return (self.low_fill or self.fill_difference or self.fill_ratio
+                or self.js_divergence or self.null_label_leakage)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self) | {"excluded": self.excluded}
+
+
+@dataclasses.dataclass
+class RawFeatureFilterResults:
+    """Config + distributions + decisions (RawFeatureFilterResults parity)."""
+    config: Dict[str, Any]
+    train_distributions: List[FeatureDistribution]
+    score_distributions: List[FeatureDistribution]
+    exclusion_reasons: List[ExclusionReasons]
+    dropped_features: List[str]
+    dropped_map_keys: Dict[str, List[str]]
+
+    def to_json(self) -> dict:
+        return {
+            "config": self.config,
+            "trainDistributions": [d.to_json() for d in self.train_distributions],
+            "scoreDistributions": [d.to_json() for d in self.score_distributions],
+            "exclusionReasons": [r.to_json() for r in self.exclusion_reasons],
+            "droppedFeatures": self.dropped_features,
+            "droppedMapKeys": self.dropped_map_keys,
+        }
+
+
+class RawFeatureFilter:
+    def __init__(self,
+                 min_fill_rate: float = 0.001,
+                 max_fill_difference: float = 0.90,
+                 max_fill_ratio_diff: float = 20.0,
+                 max_js_divergence: float = 0.90,
+                 max_correlation: float = 0.95,
+                 protected_features: Sequence[str] = (),
+                 js_divergence_protected_features: Sequence[str] = (),
+                 scoring_data=None):
+        if not 0.0 <= min_fill_rate <= 1.0:
+            raise ValueError(f"invalid min_fill_rate {min_fill_rate}")
+        if not 0.0 <= max_fill_difference <= 1.0:
+            raise ValueError(f"invalid max_fill_difference {max_fill_difference}")
+        if max_fill_ratio_diff < 0:
+            raise ValueError(f"invalid max_fill_ratio_diff {max_fill_ratio_diff}")
+        if not 0.0 <= max_js_divergence <= 1.0:
+            raise ValueError(f"invalid max_js_divergence {max_js_divergence}")
+        self.min_fill_rate = min_fill_rate
+        self.max_fill_difference = max_fill_difference
+        self.max_fill_ratio_diff = max_fill_ratio_diff
+        self.max_js_divergence = max_js_divergence
+        self.max_correlation = max_correlation
+        self.protected_features: Set[str] = set(protected_features)
+        self.js_protected: Set[str] = set(js_divergence_protected_features)
+        self.scoring_data = scoring_data
+
+    # -- profiling ----------------------------------------------------------
+
+    def _profiles(self, data: ColumnarDataset, names: Sequence[str]):
+        out: List[FeatureDistribution] = []
+        for n in names:
+            if n in data:
+                out.extend(profile_column(n, data[n]))
+        return out
+
+    def _null_label_corr(self, data: ColumnarDataset, name: str,
+                         key: Optional[str], label: np.ndarray) -> float:
+        col = data[name]
+        if key is not None:
+            null = np.array([key not in row or row.get(key) is None
+                             for row in col.values], np.float64)
+        elif col.mask is not None:
+            null = (~np.asarray(col.mask)).astype(np.float64)
+        else:
+            null = np.array([v is None for v in col.values], np.float64)
+        if null.std() == 0 or np.std(label) == 0:
+            return 0.0
+        return float(np.corrcoef(null, label)[0, 1])
+
+    # -- decision + data cleaning ------------------------------------------
+
+    def filter_raw_data(self, data: ColumnarDataset,
+                        raw_features) -> Tuple[ColumnarDataset,
+                                               RawFeatureFilterResults]:
+        predictors = [f for f in raw_features if not f.is_response]
+        responses = [f for f in raw_features if f.is_response]
+        pred_names = [f.name for f in predictors]
+
+        train_dists = self._profiles(data, pred_names)
+        score_data = None
+        score_dists: List[FeatureDistribution] = []
+        if self.scoring_data is not None:
+            from ..readers.base import reader_for
+
+            score_data = reader_for(self.scoring_data).generate_dataset(
+                predictors)
+            score_dists = self._profiles(score_data, pred_names)
+        score_by_key = {(d.name, d.key): d for d in score_dists}
+
+        label = None
+        if responses and responses[0].name in data:
+            label = np.nan_to_num(
+                np.asarray(data[responses[0].name].values, np.float64))
+
+        reasons: List[ExclusionReasons] = []
+        for d in train_dists:
+            r = ExclusionReasons(d.name, d.key, d.fill_rate())
+            if d.name not in self.protected_features:
+                r.low_fill = d.fill_rate() < self.min_fill_rate
+                s = score_by_key.get((d.name, d.key))
+                if s is not None and s.count > 0:
+                    r.fill_difference = (d.relative_fill_rate(s)
+                                         > self.max_fill_difference)
+                    r.fill_ratio = (d.relative_fill_ratio(s)
+                                    > self.max_fill_ratio_diff)
+                    if d.name not in self.js_protected:
+                        r.js_divergence = (d.js_divergence(s)
+                                           > self.max_js_divergence)
+                if label is not None:
+                    corr = self._null_label_corr(data, d.name, d.key, label)
+                    r.null_label_leakage = abs(corr) > self.max_correlation
+            reasons.append(r)
+
+        dropped_features: List[str] = []
+        dropped_map_keys: Dict[str, List[str]] = {}
+        by_feature: Dict[str, List[ExclusionReasons]] = {}
+        for r in reasons:
+            by_feature.setdefault(r.name, []).append(r)
+        for name, rs in by_feature.items():
+            keyed = [r for r in rs if r.key is not None]
+            if keyed:
+                bad = [r.key for r in keyed if r.excluded]
+                if bad:
+                    if len(bad) == len(keyed):
+                        dropped_features.append(name)
+                    else:
+                        dropped_map_keys[name] = bad
+            elif any(r.excluded for r in rs):
+                dropped_features.append(name)
+
+        cleaned = data.copy()
+        for name in dropped_features:
+            if name in cleaned:
+                cleaned = cleaned.drop([name])
+        for name, keys in dropped_map_keys.items():
+            col = cleaned[name]
+            vals = np.empty(len(col.values), dtype=object)
+            bad = set(keys)
+            for i, row in enumerate(col.values):
+                vals[i] = {k: v for k, v in row.items() if k not in bad}
+            cleaned.set(name, FeatureColumn(col.ftype, vals))
+
+        results = RawFeatureFilterResults(
+            config={
+                "minFillRate": self.min_fill_rate,
+                "maxFillDifference": self.max_fill_difference,
+                "maxFillRatioDiff": self.max_fill_ratio_diff,
+                "maxJSDivergence": self.max_js_divergence,
+                "maxCorrelation": self.max_correlation,
+            },
+            train_distributions=train_dists,
+            score_distributions=score_dists,
+            exclusion_reasons=reasons,
+            dropped_features=dropped_features,
+            dropped_map_keys=dropped_map_keys,
+        )
+        return cleaned, results

@@ -1,0 +1,8 @@
+"""Model + record insights (reference ModelInsights / RecordInsightsLOCO)."""
+from .model_insights import (
+    ModelInsights, extract_model_insights, feature_importances,
+)
+from .record_insights import RecordInsightsLOCO, parse_insights
+
+__all__ = ["ModelInsights", "extract_model_insights", "feature_importances",
+           "RecordInsightsLOCO", "parse_insights"]

@@ -1,0 +1,82 @@
+"""RecordInsightsLOCO — per-row leave-one-column-out explanations.
+
+Reference: ``RecordInsightsLOCO`` (core/.../impl/insights/RecordInsightsLOCO
+.scala:100): for each vector slot, zero it and measure the prediction change;
+aggregate slots per raw feature via the vector column metadata
+(OpVectorColumnHistory, :186-246); keep the top-K positive/negative
+(:282).  Parser: ``RecordInsightsParser``.
+
+TPU note: the reference computes LOCO per row inside a row-UDF; here the
+whole batch is scored per zeroed slot (one vectorized predict per slot),
+which batches naturally on device — SURVEY §7 step 7 ("LOCO is trivially
+batched: vmap over zeroed slots").
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..stages.base import UnaryTransformer
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import OPVector, TextMap
+
+__all__ = ["RecordInsightsLOCO", "parse_insights"]
+
+
+class RecordInsightsLOCO(UnaryTransformer):
+    """Input: the model's feature vector; output: TextMap of per-feature
+    insight JSON for each row."""
+
+    def __init__(self, model, top_k: int = 20,
+                 aggregate_by_feature: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="recordInsightsLOCO",
+                         output_type=TextMap, uid=uid)
+        self.model = model            # fitted PredictorModel
+        self.top_k = top_k
+        self.aggregate_by_feature = aggregate_by_feature
+
+    def _score(self, X: np.ndarray) -> np.ndarray:
+        batch = self.model.predict_batch(X)
+        if batch.probability is not None:
+            return np.asarray(batch.probability, np.float64)
+        return np.asarray(batch.prediction, np.float64)[:, None]
+
+    def transform_columns(self, features_col: FeatureColumn) -> FeatureColumn:
+        X = np.asarray(features_col.values, np.float32)
+        n, d = X.shape
+        vmeta = features_col.vmeta
+        base = self._score(X)                     # (N, K)
+
+        # diffs per slot: score with slot j zeroed, minus base
+        diffs = np.zeros((d, n, base.shape[1]), np.float64)
+        for j in range(d):
+            if not np.any(X[:, j]):
+                continue
+            Xz = X.copy()
+            Xz[:, j] = 0.0
+            diffs[j] = self._score(Xz) - base
+
+        names = (vmeta.column_names() if vmeta is not None
+                 and vmeta.size == d else [f"f_{j}" for j in range(d)])
+        parents = ([c.parent_feature for c in vmeta.columns]
+                   if vmeta is not None and vmeta.size == d else names)
+
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            per: Dict[str, np.ndarray] = {}
+            for j in range(d):
+                key = parents[j] if self.aggregate_by_feature else names[j]
+                per[key] = per.get(key, 0.0) + diffs[j, i]
+            scored: List[Tuple[str, List[float]]] = [
+                (k, list(np.atleast_1d(v))) for k, v in per.items()]
+            scored.sort(key=lambda t: -max(abs(x) for x in t[1]))
+            out[i] = {k: json.dumps(v) for k, v in scored[: self.top_k]}
+        return FeatureColumn(TextMap, out)
+
+
+def parse_insights(row_map: Dict[str, str]) -> Dict[str, List[float]]:
+    """RecordInsightsParser.parseInsights parity."""
+    return {k: json.loads(v) for k, v in row_map.items()}

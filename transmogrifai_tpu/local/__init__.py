@@ -1,0 +1,4 @@
+"""Spark-free local scoring (reference local/ module, SURVEY §2.15)."""
+from .scorer import load_model_local, score_function, score_function_batch
+
+__all__ = ["score_function", "score_function_batch", "load_model_local"]

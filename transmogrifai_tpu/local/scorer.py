@@ -1,0 +1,80 @@
+"""Spark-free ("local") scoring of a trained workflow model.
+
+Reference: ``OpWorkflowModelLocal.scoreFunction`` — load the persisted model
+once, then score plain ``Map[String, Any]`` rows with no cluster runtime at
+all (local/OpWorkflowModelLocal.scala:43-120, loaded via
+``OpWorkflowModel.load(path, asSpark=false)`` OpWorkflowModel.scala:470).
+The reference needs a second execution path (MLeap + row-level
+``transformKeyValue``); here the columnar stages simply run on a batch of
+one (or a micro-batch) — same code path as training, no drift risk, and no
+device requirement (numpy on host; JAX CPU backend for the model kernels).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..models.prediction import PredictionBatch
+from ..stages.generator import FeatureGeneratorStage
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..workflow.dag import transform_dag
+
+__all__ = ["score_function", "score_function_batch", "load_model_local"]
+
+
+def score_function(model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """Build ``row_map -> score_map`` from a fitted/loaded workflow model.
+
+    The returned function accepts one record (dict of raw feature values)
+    and returns ``{result_feature_name: value}`` with ``Prediction`` values
+    expanded to the reference's reserved-key map
+    (prediction / probability_i / rawPrediction_i — Maps.scala:339-394).
+    """
+    batch = score_function_batch(model)
+
+    def score_one(row: Dict[str, Any]) -> Dict[str, Any]:
+        return batch([row])[0]
+
+    return score_one
+
+
+def score_function_batch(model) -> Callable[[Sequence[Dict[str, Any]]],
+                                            List[Dict[str, Any]]]:
+    """Micro-batch variant: list of records in, list of score maps out."""
+    dag = model._scoring_dag()
+    raw_feats = model.raw_features()
+    result_names = [f.name for f in model.result_features]
+
+    def score_batch(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        data = ColumnarDataset()
+        for f in raw_feats:
+            stage = f.origin_stage
+            if isinstance(stage, FeatureGeneratorStage) and not f.is_response:
+                data.set(f.name, stage.extract_column(rows))
+            elif isinstance(stage, FeatureGeneratorStage):
+                # response may be absent at scoring time
+                vals = [r.get(f.name) if isinstance(r, dict) else None
+                        for r in rows]
+                data.set(f.name, FeatureColumn.from_values(f.ftype, vals))
+        scored = transform_dag(dag, data)
+        out: List[Dict[str, Any]] = [dict() for _ in rows]
+        for name in result_names:
+            if name not in scored:
+                continue
+            col = scored[name]
+            if isinstance(col.values, PredictionBatch):
+                for i in range(len(rows)):
+                    out[i][name] = col.values.row(i)
+            else:
+                vals = col.to_list()
+                for i in range(len(rows)):
+                    out[i][name] = vals[i]
+        return out
+
+    return score_batch
+
+
+def load_model_local(path: str):
+    """Load a saved model for host-only scoring (load(path, asSpark=false))."""
+    from ..workflow.persistence import load_workflow_model
+
+    return load_workflow_model(path)

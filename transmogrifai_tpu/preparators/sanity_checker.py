@@ -191,7 +191,19 @@ class SanityChecker(BinaryEstimator):
         return model
 
 
-class SanityCheckerModel(BinaryModel):
+class _VmetaExtraState:
+    """Shared persistence of the filtered vector metadata (_new_vmeta)."""
+
+    def extra_state(self):
+        return ({"new_vmeta": self._new_vmeta.to_json()}
+                if self._new_vmeta is not None else {})
+
+    def set_extra_state(self, state):
+        if "new_vmeta" in state:
+            self._new_vmeta = VectorMetadata.from_json(state["new_vmeta"])
+
+
+class SanityCheckerModel(_VmetaExtraState, BinaryModel):
     """Index-filter on the feature vector (SanityChecker.scala:544-560)."""
 
     def __init__(self, keep_indices: List[int], uid: Optional[str] = None):
@@ -242,7 +254,7 @@ class MinVarianceFilter(BinaryEstimator):
         return model
 
 
-class MinVarianceFilterModel(BinaryModel):
+class MinVarianceFilterModel(_VmetaExtraState, BinaryModel):
     input_arity = (1, 2)
 
     def __init__(self, keep_indices: List[int], uid: Optional[str] = None):

@@ -1,2 +1,3 @@
 from .base import Reader, DataFrameReader, RecordsReader, reader_for  # noqa: F401
 from .files import CSVReader, CSVAutoReader, ParquetReader, JSONLinesReader, DataReaders  # noqa: F401
+from .aggregates import AggregateDataReader, ConditionalDataReader, JoinedDataReader  # noqa: F401

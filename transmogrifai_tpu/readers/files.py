@@ -77,6 +77,29 @@ class JSONLinesReader(Reader):
 class DataReaders:
     """Factory catalogue (DataReaders.scala:44-270)."""
 
+    class Aggregate:
+        @staticmethod
+        def records(source, key_fn, time_fn, cutoff=None,
+                    predictor_window_ms=None, response_window_ms=None):
+            from .aggregates import AggregateDataReader
+
+            return AggregateDataReader(source, key_fn, time_fn, cutoff,
+                                       predictor_window_ms,
+                                       response_window_ms)
+
+    class Conditional:
+        @staticmethod
+        def records(source, key_fn, time_fn, target_condition,
+                    drop_if_no_target=True, predictor_window_ms=None,
+                    response_window_ms=None):
+            from .aggregates import ConditionalDataReader
+
+            return ConditionalDataReader(source, key_fn, time_fn,
+                                         target_condition,
+                                         drop_if_no_target,
+                                         predictor_window_ms,
+                                         response_window_ms)
+
     class Simple:
         @staticmethod
         def csv(path: str, column_names: Optional[List[str]] = None,

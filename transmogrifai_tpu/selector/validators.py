@@ -118,10 +118,18 @@ class OpTrainValidationSplit(_ValidatorBase):
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
                  larger_better=True):
         n = X.shape[0]
-        folds = make_folds(n, 2, y=y, stratify=self.stratify, seed=self.seed)
-        # fold 0 with probability train_ratio
         rng = np.random.default_rng(self.seed)
-        in_train = rng.random(n) < self.train_ratio
+        if self.stratify:
+            # per-class permutation keeps label ratios on both sides, so an
+            # imbalanced eval slice can't end up without positives
+            in_train = np.zeros(n, bool)
+            for cls in np.unique(y[np.isfinite(y)]):
+                idx = np.where(y == cls)[0]
+                perm = rng.permutation(idx)
+                in_train[perm[: max(1, int(round(
+                    len(idx) * self.train_ratio)))]] = True
+        else:
+            in_train = rng.random(n) < self.train_ratio
         results: List[ValidationResult] = []
         for name, params, fitter in candidates:
             w_train = base_weights * in_train

@@ -156,6 +156,15 @@ class PipelineStage:
                 params[name] = getattr(self, name)
         return type(self)(**params)
 
+    def extra_state(self) -> Dict[str, Any]:
+        """Fitted state not captured by constructor params — persistence hook
+        (analogue of a custom ``@ReaderWriter`` serializer, SURVEY §2.3).
+        Values must be JSON-able or numpy arrays."""
+        return {}
+
+    def set_extra_state(self, state: Dict[str, Any]) -> None:
+        pass
+
     def __repr__(self):
         return f"{type(self).__name__}(uid={self.uid!r})"
 

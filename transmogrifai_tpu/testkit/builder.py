@@ -1,0 +1,42 @@
+"""TestFeatureBuilder — in-memory typed datasets + features for tests.
+
+Reference: ``TestFeatureBuilder.apply(Seq[FeatureType]...)`` builds a
+DataFrame plus typed features from in-memory values
+(testkit/.../test/TestFeatureBuilder.scala:50-265, ``random`` :298).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple, Type
+
+from ..features.builder import FeatureBuilder
+from ..features.feature import Feature
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import FeatureType
+
+__all__ = ["TestFeatureBuilder"]
+
+
+class TestFeatureBuilder:
+    @staticmethod
+    def build(*named_columns: Tuple[str, Type[FeatureType], Sequence[Any]],
+              response: str = "") -> Tuple[ColumnarDataset, List[Feature]]:
+        """``build(("age", Real, [1, None, 3]), ...)`` ->
+        (ColumnarDataset, [features])."""
+        data = ColumnarDataset()
+        feats: List[Feature] = []
+        for name, ftype, values in named_columns:
+            data.set(name, FeatureColumn.from_values(ftype, list(values)))
+            builder = getattr(FeatureBuilder, ftype.type_name())(name)
+            f = (builder.as_response() if name == response
+                 else builder.as_predictor())
+            feats.append(f)
+        return data, feats
+
+    @staticmethod
+    def random(n: int, *named_generators, response: str = "",
+               types=None) -> Tuple[ColumnarDataset, List[Feature]]:
+        """``random(100, ("x", Real, RandomReal.normal()), ...)``."""
+        cols = []
+        for name, ftype, gen in named_generators:
+            cols.append((name, ftype, gen.take(n)))
+        return TestFeatureBuilder.build(*cols, response=response)

@@ -1,0 +1,250 @@
+"""Workflow-model persistence — one JSON artifact + one array bundle.
+
+Reference: ``OpWorkflowModelWriter.toJson`` writes a single ``op-model.json``
+holding the feature-DAG JSON and per-stage JSON (ctor args recovered by
+reflection, ``DefaultOpPipelineStageReaderWriter``), with Spark/MLeap model
+binaries saved beside it (OpWorkflowModelWriter.scala:54-150,
+OpPipelineStageReaderWriter.scala); ``OpWorkflowModelReader`` reconstructs
+stages → features → model (OpWorkflowModelReader.scala).
+
+TPU-native layout (directory):
+  op-model.json   — version, result features, feature DAG, stage records
+  arrays.npz      — every ndarray-valued stage param, keyed "<uid>.<param>"
+
+Stage record = dotted class path + JSON params (arrays externalized, nested
+stages recursed, feature-type classes by name) + ``extra_state`` hook payload
++ fitted metadata.  Stages reconstruct by calling their constructor with the
+round-tripped kwargs — the same ctor-args contract the reference enforces.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..ops.vector_metadata import VectorMetadata
+from ..stages.base import PipelineStage
+from ..stages.generator import FeatureGeneratorStage
+from ..types.feature_types import FeatureType, type_by_name
+
+__all__ = ["save_workflow_model", "load_workflow_model", "MODEL_JSON",
+           "FORMAT_VERSION"]
+
+MODEL_JSON = "op-model.json"
+ARRAYS_NPZ = "arrays.npz"
+FORMAT_VERSION = 1
+
+try:  # jax arrays serialize like numpy
+    import jax
+
+    _ARRAY_TYPES: Tuple[type, ...] = (np.ndarray, jax.Array)
+except Exception:  # pragma: no cover
+    _ARRAY_TYPES = (np.ndarray,)
+
+
+# ---------------------------------------------------------------------------
+# encoding
+# ---------------------------------------------------------------------------
+
+class _ArrayStore:
+    def __init__(self):
+        self.arrays: Dict[str, np.ndarray] = {}
+
+    def put(self, key: str, arr) -> Dict[str, str]:
+        k = key
+        i = 0
+        while k in self.arrays:
+            i += 1
+            k = f"{key}#{i}"
+        self.arrays[k] = np.asarray(arr)
+        return {"__array__": k}
+
+
+def _encode(value: Any, key: str, store: _ArrayStore) -> Any:
+    if isinstance(value, _ARRAY_TYPES):
+        return store.put(key, value)
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    if isinstance(value, np.bool_):
+        return bool(value)
+    if isinstance(value, PipelineStage):
+        return {"__stage__": _stage_record(value, store)}
+    if isinstance(value, VectorMetadata):
+        return {"__vmeta__": value.to_json()}
+    if isinstance(value, type) and issubclass(value, FeatureType):
+        return {"__ftype__": value.type_name()}
+    if isinstance(value, dict):
+        return {k: _encode(v, f"{key}.{k}", store) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v, f"{key}[{i}]", store) for i, v in enumerate(value)]
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if callable(value):
+        # extract lambdas etc. — not serializable (the reference captures
+        # macro source; we fall back to by-name extraction on load).
+        # Warn at save time: the stage will reconstruct with fn=None.
+        warnings.warn(
+            f"non-serializable callable at {key!r} saved as a stub; the "
+            f"loaded stage falls back to default behavior (by-name column "
+            f"extraction) or fails if the callable is required",
+            stacklevel=2)
+        return {"__callable__": getattr(value, "__name__", "<fn>")}
+    return {"__repr__": repr(value)}
+
+
+def _decode(value: Any, arrays) -> Any:
+    if isinstance(value, dict):
+        if "__array__" in value:
+            return arrays[value["__array__"]]
+        if "__stage__" in value:
+            return _load_stage(value["__stage__"], arrays)
+        if "__vmeta__" in value:
+            return VectorMetadata.from_json(value["__vmeta__"])
+        if "__ftype__" in value:
+            return type_by_name(value["__ftype__"])
+        if "__callable__" in value or "__repr__" in value:
+            return None
+        return {k: _decode(v, arrays) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_decode(v, arrays) for v in value]
+    return value
+
+
+def _stage_record(stage: PipelineStage, store: _ArrayStore) -> Dict[str, Any]:
+    params = {
+        k: _encode(v, f"{stage.uid}.{k}", store)
+        for k, v in stage.get_params().items()
+    }
+    rec: Dict[str, Any] = {
+        "className": f"{type(stage).__module__}.{type(stage).__qualname__}",
+        "uid": stage.uid,
+        "operationName": stage.operation_name,
+        "outputType": stage.output_type.type_name() if stage.output_type else None,
+        "params": params,
+        "inputFeatures": [f.name for f in stage.input_features],
+        "outputFeature": (stage._output_feature.name
+                          if stage._output_feature else None),
+    }
+    extra = stage.extra_state()
+    if extra:
+        rec["extraState"] = {
+            k: _encode(v, f"{stage.uid}.extra.{k}", store)
+            for k, v in extra.items()
+        }
+    if stage.metadata:
+        rec["metadata"] = _encode(stage.metadata, f"{stage.uid}.meta", store)
+    return rec
+
+
+def _load_stage(rec: Dict[str, Any], arrays) -> PipelineStage:
+    import inspect
+
+    mod_name, _, cls_name = rec["className"].rpartition(".")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    params = {k: _decode(v, arrays) for k, v in rec["params"].items()}
+    params["uid"] = rec["uid"]
+    # required ctor args excluded from get_params (e.g. LambdaTransformer's
+    # output_type) — recovered from the record where possible
+    sig = inspect.signature(cls.__init__)
+    if ("output_type" in sig.parameters and "output_type" not in params
+            and rec.get("outputType")):
+        params["output_type"] = type_by_name(rec["outputType"])
+    if ("operation_name" in sig.parameters and "operation_name" not in params
+            and rec.get("operationName")):
+        params["operation_name"] = rec["operationName"]
+    stage = cls(**params)
+    stage.operation_name = rec.get("operationName", stage.operation_name)
+    if rec.get("extraState"):
+        stage.set_extra_state(
+            {k: _decode(v, arrays) for k, v in rec["extraState"].items()})
+    if rec.get("metadata"):
+        stage.metadata = _decode(rec["metadata"], arrays)
+    return stage
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+def save_workflow_model(model, path: str, overwrite: bool = True) -> None:
+    from .workflow import OpWorkflowModel  # cycle guard
+
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        else:
+            os.remove(path)
+    os.makedirs(path)
+
+    store = _ArrayStore()
+    # stages in scoring-DAG order: raw generators first, then fitted stages
+    dag = model._scoring_dag()
+    gen_stages: List[FeatureGeneratorStage] = [
+        f.origin_stage for f in model.raw_features()
+        if isinstance(f.origin_stage, FeatureGeneratorStage)
+    ]
+    stage_records = [_stage_record(s, store) for s in gen_stages]
+    for layer in dag.layers:
+        for s in layer:
+            if not isinstance(s, FeatureGeneratorStage):
+                stage_records.append(_stage_record(s, store))
+
+    rff = model.raw_feature_filter_results
+    doc = {
+        "version": FORMAT_VERSION,
+        "resultFeatures": [f.name for f in model.result_features],
+        "stages": stage_records,
+        # structured results persist via their own JSON form; loaded models
+        # carry the dict (consumers accept either — see model_insights)
+        "rawFeatureFilterResults": (rff.to_json() if hasattr(rff, "to_json")
+                                    else rff),
+    }
+    with open(os.path.join(path, MODEL_JSON), "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+    np.savez_compressed(os.path.join(path, ARRAYS_NPZ), **store.arrays)
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+def load_workflow_model(path: str):
+    from .workflow import OpWorkflowModel  # cycle guard
+
+    with open(os.path.join(path, MODEL_JSON)) as f:
+        doc = json.load(f)
+    if doc.get("version", 0) > FORMAT_VERSION:  # pragma: no cover
+        warnings.warn(f"model format v{doc['version']} newer than v{FORMAT_VERSION}")
+    npz_path = os.path.join(path, ARRAYS_NPZ)
+    arrays = np.load(npz_path, allow_pickle=False) if os.path.exists(npz_path) else {}
+
+    features: Dict[str, Feature] = {}
+    stages: List[PipelineStage] = []
+    for rec in doc["stages"]:
+        stage = _load_stage(rec, arrays)
+        if isinstance(stage, FeatureGeneratorStage):
+            features[stage.name] = stage.get_output()
+        else:
+            parents = [features[n] for n in rec["inputFeatures"]]
+            stage.set_input(*parents)
+            out = stage.get_output()
+            saved_name = rec.get("outputFeature")
+            if saved_name and saved_name != out.name:
+                out.name = saved_name
+            features[out.name] = out
+            stages.append(stage)
+
+    result = [features[n] for n in doc["resultFeatures"]]
+    model = OpWorkflowModel(result_features=result, stages=stages)
+    model.raw_feature_filter_results = doc.get("rawFeatureFilterResults")
+    return model

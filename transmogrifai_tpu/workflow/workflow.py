@@ -104,12 +104,44 @@ class OpWorkflow(_WorkflowCore):
                 if key in self.parameters:
                     stage.set_params(**self.parameters[key])
 
+    def _apply_blocklist(self, dropped: Sequence[str]) -> None:
+        """Prune dropped raw features out of stage inputs
+        (OpWorkflow.setBlocklist semantics): variadic stages simply lose the
+        input; a stage whose inputs all drop propagates the drop; a result
+        feature that becomes unreachable is an error."""
+        if not dropped:
+            return
+        self.blocklisted = list(dropped)
+        gone = set(dropped)
+        dag = compute_dag(self.result_features)
+        for layer in dag.layers:
+            for stage in layer:
+                if isinstance(stage, FeatureGeneratorStage):
+                    continue
+                remaining = [f for f in stage.input_features
+                             if f.name not in gone]
+                if len(remaining) == len(stage.input_features):
+                    continue
+                lo, _ = stage.input_arity
+                out = stage.get_output()
+                if remaining and len(remaining) >= max(lo, 1):
+                    stage.input_features = remaining
+                    out.parents = list(remaining)
+                else:
+                    gone.add(out.name)
+        bad = [f.name for f in self.result_features if f.name in gone]
+        if bad:
+            raise ValueError(
+                f"RawFeatureFilter dropped features required by result "
+                f"features {bad}; protect them via protected_features")
+
     def train(self) -> "OpWorkflowModel":
         data = self.generate_raw_data()
         filter_results = None
         if self._raw_feature_filter is not None:
             data, filter_results = self._raw_feature_filter.filter_raw_data(
                 data, self.raw_features())
+            self._apply_blocklist(filter_results.dropped_features)
         dag = compute_dag(self.result_features)
         self._validate_stages(dag)
         self._inject_params(dag)
