@@ -1,0 +1,113 @@
+"""Feature DSL — the fluent per-type methods of the reference's Rich* classes.
+
+Reference: ``core/.../dsl/`` (~3.9k LoC of implicit extension classes):
+``RichNumericFeature`` (incl. ``sanityCheck`` :469), ``RichTextFeature``,
+``RichMapFeature``, ``RichListFeature``, ``RichSetFeature``,
+``RichVectorFeature``, ``RichFeaturesCollection`` (``transmogrify``
+dsl/RichFeaturesCollection.scala:69).
+
+Python redesign: instead of Scala implicits, the methods are installed
+directly on ``Feature`` when this module is imported (it is, by the package
+``__init__``), with operator overloads for feature arithmetic.  Every method
+returns a new Feature wired through the corresponding stage.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from .features.feature import Feature
+from .ops.dsl_transformers import (
+    AliasTransformer, DropIndicesByTransformer, ExistsTransformer,
+    FilterTransformer, JaccardSimilarity, MathBinaryTransformer,
+    MathScalarTransformer, NGramSimilarity, ReplaceTransformer,
+    SubstringTransformer, ToOccurTransformer,
+)
+from .ops.numeric import (
+    DecisionTreeNumericBucketizer, FillMissingWithMean, NumericBucketizer,
+    OpScalarStandardScaler, PercentileCalibrator,
+)
+from .ops.text import (
+    OpHashingTF, OpNGram, OpStopWordsRemover, OpStringIndexer,
+    TextLenTransformer, TextTokenizer,
+)
+
+__all__ = ["install_dsl"]
+
+
+def _binary_math(op: str):
+    def method(self: Feature, other) -> Feature:
+        if isinstance(other, Feature):
+            return MathBinaryTransformer(op).set_input(self, other).get_output()
+        return MathScalarTransformer(op, float(other)).set_input(
+            self).get_output()
+
+    return method
+
+
+def _unary(stage_factory: Callable[..., Any]):
+    def method(self: Feature, *args, **kwargs) -> Feature:
+        return stage_factory(*args, **kwargs).set_input(self).get_output()
+
+    return method
+
+
+def _binary(stage_factory: Callable[..., Any]):
+    def method(self: Feature, other: Feature, *args, **kwargs) -> Feature:
+        return stage_factory(*args, **kwargs).set_input(
+            self, other).get_output()
+
+    return method
+
+
+def _sanity_check(self: Feature, label: Feature, **kwargs) -> Feature:
+    """RichNumericFeature.sanityCheck (dsl/RichNumericFeature.scala:469)."""
+    from .preparators.sanity_checker import SanityChecker
+
+    return SanityChecker(**kwargs).set_input(label, self).get_output()
+
+
+def _vectorize(self: Feature, **kwargs) -> Feature:
+    """Single-feature transmogrify (RichFeature vectorize)."""
+    from .ops.transmogrify import transmogrify
+
+    return transmogrify([self], **kwargs)
+
+
+def install_dsl() -> None:
+    F = Feature
+    F.__add__ = _binary_math("plus")
+    F.__sub__ = _binary_math("minus")
+    F.__mul__ = _binary_math("multiply")
+    F.__truediv__ = _binary_math("divide")
+    F.alias = lambda self, name: AliasTransformer(name).set_input(
+        self).get_output()
+    F.filter_values = _unary(FilterTransformer)
+    F.replace_value = lambda self, a, b: ReplaceTransformer(a, b).set_input(
+        self).get_output()
+    F.to_occur = _unary(ToOccurTransformer)
+    F.exists = _unary(ExistsTransformer)
+    F.contains = _binary(SubstringTransformer)
+    F.jaccard_similarity = _binary(JaccardSimilarity)
+    F.ngram_similarity = _binary(NGramSimilarity)
+    F.drop_indices_by = _unary(DropIndicesByTransformer)
+    # text
+    F.tokenize = _unary(TextTokenizer)
+    F.ngrams = _unary(OpNGram)
+    F.remove_stop_words = _unary(OpStopWordsRemover)
+    F.hashing_tf = _unary(OpHashingTF)
+    F.index_string = _unary(OpStringIndexer)
+    F.text_len = _unary(TextLenTransformer)
+    # numeric
+    F.bucketize = _unary(NumericBucketizer)
+    F.auto_bucketize = (
+        lambda self, label, **kw:
+        DecisionTreeNumericBucketizer(**kw).set_input(
+            label, self).get_output())
+    F.fill_missing_with_mean = _unary(FillMissingWithMean)
+    F.zscore = _unary(OpScalarStandardScaler)
+    F.calibrate_percentile = _unary(PercentileCalibrator)
+    F.sanity_check = _sanity_check
+    F.vectorize = _vectorize
+
+
+install_dsl()

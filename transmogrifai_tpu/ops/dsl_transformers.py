@@ -1,0 +1,235 @@
+"""Algebra / utility transformers behind the feature DSL.
+
+Reference (core/.../impl/feature/, SURVEY §2.5 "Algebra/DSL ops"):
+``MathTransformers`` (+,-,*,/ on features), ``AliasTransformer``,
+``FilterTransformer``, ``SubstringTransformer``, ``JaccardSimilarity``,
+``NGramSimilarity``, ``ToOccurTransformer``, ``ExistsTransformer``,
+``ReplaceTransformer``, ``DropIndicesByTransformer``
+(DropIndicesByTransformer.scala).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..stages.base import BinaryTransformer, UnaryTransformer
+from ..types.columns import FeatureColumn
+from ..types.feature_types import (
+    Binary, OPVector, Real, RealNN, Text,
+)
+from .vector_metadata import VectorColumnMetadata
+
+__all__ = [
+    "MathBinaryTransformer", "MathScalarTransformer", "AliasTransformer",
+    "FilterTransformer", "SubstringTransformer", "JaccardSimilarity",
+    "NGramSimilarity", "ToOccurTransformer", "ExistsTransformer",
+    "ReplaceTransformer", "DropIndicesByTransformer",
+]
+
+_BIN_OPS = {
+    "plus": lambda a, b: a + b,
+    "minus": lambda a, b: a - b,
+    "multiply": lambda a, b: a * b,
+    "divide": lambda a, b: np.divide(a, np.where(b == 0, np.nan, b)),
+}
+
+
+class MathBinaryTransformer(BinaryTransformer):
+    """Elementwise arithmetic of two numeric features (MathTransformers.scala);
+    missing in either side -> missing out."""
+
+    def __init__(self, op: str, uid: Optional[str] = None):
+        super().__init__(operation_name=op, output_type=Real, uid=uid)
+        if op not in _BIN_OPS:
+            raise ValueError(f"unknown op {op!r}")
+        self.op = op
+
+    def transform_columns(self, a: FeatureColumn, b: FeatureColumn) -> FeatureColumn:
+        va = np.nan_to_num(np.asarray(a.values, np.float64))
+        vb = np.nan_to_num(np.asarray(b.values, np.float64))
+        out = _BIN_OPS[self.op](va, vb)
+        mask = np.asarray(a.mask) & np.asarray(b.mask) & np.isfinite(out)
+        return FeatureColumn(Real, np.where(mask, out, np.nan), mask)
+
+
+class MathScalarTransformer(UnaryTransformer):
+    """feature <op> scalar (MathTransformers.scala scalar variants)."""
+
+    def __init__(self, op: str, scalar: float, uid: Optional[str] = None):
+        super().__init__(operation_name=f"{op}Scalar", output_type=Real,
+                         uid=uid)
+        if op not in _BIN_OPS:
+            raise ValueError(f"unknown op {op!r}")
+        self.op = op
+        self.scalar = scalar
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        v = np.nan_to_num(np.asarray(col.values, np.float64))
+        out = _BIN_OPS[self.op](v, np.float64(self.scalar))
+        mask = np.asarray(col.mask) & np.isfinite(out)
+        return FeatureColumn(Real, np.where(mask, out, np.nan), mask)
+
+
+class AliasTransformer(UnaryTransformer):
+    """Rename-only pass-through (AliasTransformer.scala)."""
+
+    def __init__(self, name: str, uid: Optional[str] = None):
+        super().__init__(operation_name="alias", output_type=Real, uid=uid)
+        self.name = name
+
+    def on_set_input(self) -> None:
+        self.output_type = self.input_features[0].ftype
+
+    def make_output_name(self) -> str:
+        return self.name
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        return col
+
+
+class FilterTransformer(UnaryTransformer):
+    """Keep values passing a predicate, else missing (FilterTransformer)."""
+
+    def __init__(self, predicate: Callable[[Any], bool],
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="filter", output_type=Real, uid=uid)
+        self.predicate = predicate
+
+    def on_set_input(self) -> None:
+        self.output_type = self.input_features[0].ftype
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        vals = col.to_list()
+        kept = [v if v is not None and self.predicate(v) else None
+                for v in vals]
+        return FeatureColumn.from_values(self.output_type, kept)
+
+
+class SubstringTransformer(BinaryTransformer):
+    """Binary(text2 is substring of text1) (SubstringTransformer.scala)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="substring", output_type=Binary,
+                         uid=uid)
+
+    def transform_columns(self, a: FeatureColumn, b: FeatureColumn) -> FeatureColumn:
+        out, mask = [], []
+        for x, y in zip(a.values, b.values):
+            if x is None or y is None:
+                out.append(False)
+                mask.append(False)
+            else:
+                out.append(str(y).lower() in str(x).lower())
+                mask.append(True)
+        return FeatureColumn(Binary, np.asarray(out, np.float64),
+                             np.asarray(mask))
+
+
+def _jaccard(s1, s2) -> float:
+    a, b = set(s1), set(s2)
+    if not a and not b:
+        return 1.0
+    return len(a & b) / len(a | b)
+
+
+class JaccardSimilarity(BinaryTransformer):
+    """Jaccard similarity of two sets/lists (JaccardSimilarity.scala,
+    utils/stats/JaccardSim.scala)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="jaccardSim", output_type=RealNN,
+                         uid=uid)
+
+    def transform_columns(self, a: FeatureColumn, b: FeatureColumn) -> FeatureColumn:
+        out = np.array([_jaccard(x or (), y or ())
+                        for x, y in zip(a.values, b.values)], np.float64)
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
+
+
+class NGramSimilarity(BinaryTransformer):
+    """Character n-gram Jaccard similarity of two texts
+    (NGramSimilarity.scala)."""
+
+    def __init__(self, n: int = 3, uid: Optional[str] = None):
+        super().__init__(operation_name="ngramSim", output_type=RealNN,
+                         uid=uid)
+        self.n = n
+
+    def _grams(self, v) -> set:
+        if v is None:
+            return set()
+        if isinstance(v, (tuple, list, set, frozenset)):
+            v = " ".join(map(str, v))
+        s = str(v).lower()
+        return {s[i:i + self.n] for i in range(max(len(s) - self.n + 1, 0))}
+
+    def transform_columns(self, a: FeatureColumn, b: FeatureColumn) -> FeatureColumn:
+        out = np.array([_jaccard(self._grams(x), self._grams(y))
+                        for x, y in zip(a.values, b.values)], np.float64)
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
+
+
+class ToOccurTransformer(UnaryTransformer):
+    """Any feature -> RealNN(0/1) presence/truthiness (ToOccurTransformer)."""
+
+    def __init__(self, matches: Optional[Callable[[Any], bool]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="toOccur", output_type=RealNN,
+                         uid=uid)
+        self.matches = matches
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        fn = self.matches or (lambda v: bool(v) or v == 0.0)
+        out = np.array([1.0 if (v is not None and fn(v)) else 0.0
+                        for v in col.to_list()], np.float64)
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
+
+
+class ExistsTransformer(UnaryTransformer):
+    """Binary(value is present) (ExistsTransformer.scala)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="exists", output_type=Binary, uid=uid)
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        out = np.array([v is not None for v in col.to_list()], np.float64)
+        return FeatureColumn(Binary, out, np.ones(len(out), bool))
+
+
+class ReplaceTransformer(UnaryTransformer):
+    """Replace matching values (ReplaceTransformer.scala)."""
+
+    def __init__(self, replace: Any, with_value: Any,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="replace", output_type=Text, uid=uid)
+        self.replace = replace
+        self.with_value = with_value
+
+    def on_set_input(self) -> None:
+        self.output_type = self.input_features[0].ftype
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        vals = [self.with_value if v == self.replace else v
+                for v in col.to_list()]
+        return FeatureColumn.from_values(self.output_type, vals)
+
+
+class DropIndicesByTransformer(UnaryTransformer):
+    """Drop vector slots whose metadata matches a predicate
+    (DropIndicesByTransformer.scala)."""
+
+    def __init__(self, predicate: Callable[[VectorColumnMetadata], bool],
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="dropIndicesBy",
+                         output_type=OPVector, uid=uid)
+        self.predicate = predicate
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        if col.vmeta is None:
+            raise ValueError("input vector has no metadata to filter by")
+        keep = [j for j, c in enumerate(col.vmeta.columns)
+                if not self.predicate(c)]
+        X = np.asarray(col.values)[:, keep]
+        return FeatureColumn(OPVector, X.astype(np.float32),
+                             vmeta=col.vmeta.select(keep))

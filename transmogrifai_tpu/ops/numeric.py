@@ -1,0 +1,319 @@
+"""Numeric preparation stages.
+
+Reference (core/.../impl/feature/, SURVEY §2.5):
+ * ``NumericBucketizer`` — fixed split points -> one-hot buckets
+ * ``DecisionTreeNumericBucketizer`` — supervised split points from a
+   single-feature decision tree (DecisionTreeNumericBucketizer.scala:60);
+   reuses the histogram tree kernel (models/gbdt_kernels) — SURVEY §7 step 6
+ * ``FillMissingWithMean`` (FillMissingWithMean.scala)
+ * ``OpScalarStandardScaler`` (OpScalarStandardScaler.scala:49)
+ * ``ScalerTransformer``/``DescalerTransformer`` (ScalerTransformer.scala)
+ * ``PercentileCalibrator`` (PercentileCalibrator.scala)
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..stages.base import (
+    BinaryEstimator, BinaryModel, UnaryEstimator, UnaryModel,
+    UnaryTransformer,
+)
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import OPVector, Real, RealNN
+from .vector_metadata import VectorColumnMetadata, VectorMetadata, NULL_INDICATOR
+from .vectorizers import _vec_column
+
+__all__ = [
+    "NumericBucketizer", "DecisionTreeNumericBucketizer",
+    "FillMissingWithMean", "OpScalarStandardScaler", "ScalerTransformer",
+    "DescalerTransformer", "PercentileCalibrator",
+]
+
+
+def _bucketize(vals: np.ndarray, mask: np.ndarray, splits: Sequence[float],
+               parent: str, ptype: str, track_nulls: bool,
+               track_invalid: bool) -> FeatureColumn:
+    """One-hot bucket membership + optional null/invalid indicators."""
+    splits = np.asarray(sorted(splits), np.float64)
+    nb = len(splits) - 1
+    idx = np.clip(np.searchsorted(splits, vals, side="right") - 1, 0, nb - 1)
+    valid = mask & (vals >= splits[0]) & (vals <= splits[-1])
+    parts = np.zeros((len(vals), nb), np.float32)
+    parts[np.arange(len(vals))[valid], idx[valid]] = 1.0
+    meta = [VectorColumnMetadata(parent, ptype, grouping=parent,
+                                 indicator_value=f"{splits[i]}-{splits[i+1]}")
+            for i in range(nb)]
+    blocks = [parts]
+    if track_invalid:
+        blocks.append((mask & ~valid).astype(np.float32)[:, None])
+        meta.append(VectorColumnMetadata(parent, ptype, grouping=parent,
+                                         indicator_value="OutOfBounds"))
+    if track_nulls:
+        blocks.append((~mask).astype(np.float32)[:, None])
+        meta.append(VectorColumnMetadata(parent, ptype, grouping=parent,
+                                         indicator_value=NULL_INDICATOR))
+    return _vec_column(np.concatenate(blocks, axis=1),
+                       VectorMetadata(f"{parent}_buckets", meta))
+
+
+class NumericBucketizer(UnaryTransformer):
+    """Fixed split points (NumericBucketizer.scala)."""
+
+    def __init__(self, split_points: Sequence[float],
+                 track_nulls: bool = True, track_invalid: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="numericBucketizer",
+                         output_type=OPVector, uid=uid)
+        if len(split_points) < 2 or list(split_points) != sorted(split_points):
+            raise ValueError("split_points must be sorted with >= 2 entries")
+        self.split_points = list(split_points)
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        f = self.input_features[0]
+        vals = np.nan_to_num(np.asarray(col.values, np.float64))
+        return _bucketize(vals, np.asarray(col.mask), self.split_points,
+                          f.name, f.ftype.type_name(), self.track_nulls,
+                          self.track_invalid)
+
+
+class _BucketizerModel(BinaryModel):
+    def __init__(self, split_points: List[float], track_nulls: bool = True,
+                 track_invalid: bool = False, uid: Optional[str] = None):
+        super().__init__(operation_name="dtBucketizer", output_type=OPVector,
+                         uid=uid)
+        self.split_points = list(split_points)
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+
+    def transform_columns(self, label_col, col) -> FeatureColumn:
+        f = self.input_features[1]
+        vals = np.nan_to_num(np.asarray(col.values, np.float64))
+        if len(self.split_points) < 2:  # no informative splits found
+            n = len(vals)
+            meta = []
+            blocks = np.zeros((n, 0), np.float32)
+            if self.track_nulls:
+                blocks = (~np.asarray(col.mask)).astype(np.float32)[:, None]
+                meta = [VectorColumnMetadata(f.name, f.ftype.type_name(),
+                                             grouping=f.name,
+                                             indicator_value=NULL_INDICATOR)]
+            return _vec_column(np.atleast_2d(blocks),
+                               VectorMetadata(f"{f.name}_buckets", meta))
+        return _bucketize(vals, np.asarray(col.mask), self.split_points,
+                          f.name, f.ftype.type_name(), self.track_nulls,
+                          self.track_invalid)
+
+
+class DecisionTreeNumericBucketizer(BinaryEstimator):
+    """Supervised bucketization: split points = the thresholds a shallow
+    single-feature decision tree picks by info gain
+    (DecisionTreeNumericBucketizer.scala:60).  Inputs (label, numeric)."""
+
+    def __init__(self, max_splits: int = 16, max_depth: int = 4,
+                 min_info_gain: float = 0.01, min_instances_per_node: int = 1,
+                 track_nulls: bool = True, track_invalid: bool = False,
+                 max_bins: int = 32, uid: Optional[str] = None):
+        super().__init__(operation_name="dtBucketizer", output_type=OPVector,
+                         uid=uid)
+        self.max_splits = max_splits
+        self.max_depth = max_depth
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        self.max_bins = max_bins
+
+    def fit_columns(self, data: ColumnarDataset, label_col, col):
+        from ..models.gbdt_kernels import apply_bins, grow_tree, quantile_bins
+
+        mask = np.asarray(col.mask)
+        vals = np.asarray(col.values, np.float64)
+        y = np.nan_to_num(np.asarray(label_col.values, np.float64))
+        X = vals[mask][:, None]
+        yv = y[mask]
+        splits: List[float] = []
+        if X.size >= 2 and np.unique(X).size > 1:
+            classes = np.unique(yv)
+            k = len(classes) if len(classes) <= 20 else 1
+            if k > 1:
+                Y = np.equal(yv[:, None], classes[None, :]).astype(np.float32)
+            else:
+                Y = yv[:, None].astype(np.float32)
+            edges = quantile_bins(X.astype(np.float32), self.max_bins)
+            binned = apply_bins(jnp.asarray(X, jnp.float32),
+                                jnp.asarray(edges))
+            w = jnp.ones(len(yv), jnp.float32)
+            G = jnp.asarray(Y)
+            H = jnp.broadcast_to(w[:, None], Y.shape)
+            feat, thresh, _ = grow_tree(
+                binned, G, H, w, max_depth=self.max_depth,
+                n_bins=self.max_bins, lam=1e-3,
+                min_info_gain=self.min_info_gain,
+                min_instances=float(self.min_instances_per_node),
+                newton_leaf=False)
+            th = np.asarray(thresh)
+            used_bins = sorted({int(t) for t in th if t < self.max_bins - 1})
+            finite_edges = np.asarray(edges)[0]
+            splits = [float(finite_edges[b]) for b in used_bins
+                      if np.isfinite(finite_edges[b])][: self.max_splits]
+        if splits:
+            lo = float(np.nanmin(vals[mask])) if mask.any() else 0.0
+            hi = float(np.nanmax(vals[mask])) if mask.any() else 1.0
+            points = [min(lo, splits[0]) - 1e-9] + splits + [hi + 1e-9]
+        else:
+            points = []
+        self.metadata["summary"] = {"splits": points,
+                                    "foundSplits": bool(splits)}
+        return _BucketizerModel(points, self.track_nulls, self.track_invalid)
+
+
+class FillMissingWithMean(UnaryEstimator):
+    """Impute missing with the training mean (FillMissingWithMean.scala);
+    output RealNN."""
+
+    def __init__(self, default_value: float = 0.0, uid: Optional[str] = None):
+        super().__init__(operation_name="fillWithMean", output_type=RealNN,
+                         uid=uid)
+        self.default_value = default_value
+
+    def fit_columns(self, data: ColumnarDataset, col: FeatureColumn):
+        vals = np.asarray(col.values, np.float64)
+        mask = np.asarray(col.mask)
+        mean = float(vals[mask].mean()) if mask.any() else self.default_value
+        return _FillModel(fill=mean)
+
+
+class _FillModel(UnaryModel):
+    def __init__(self, fill: float, uid: Optional[str] = None):
+        super().__init__(operation_name="fillWithMean", output_type=RealNN,
+                         uid=uid)
+        self.fill = fill
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        vals = np.nan_to_num(np.asarray(col.values, np.float64), nan=self.fill)
+        out = np.where(np.asarray(col.mask), vals, self.fill)
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
+
+
+class OpScalarStandardScaler(UnaryEstimator):
+    """z-score a single numeric feature (OpScalarStandardScaler.scala:49)."""
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="stdScaler", output_type=RealNN,
+                         uid=uid)
+        self.with_mean = with_mean
+        self.with_std = with_std
+
+    def fit_columns(self, data: ColumnarDataset, col: FeatureColumn):
+        vals = np.asarray(col.values, np.float64)
+        mask = np.asarray(col.mask)
+        mean = float(vals[mask].mean()) if mask.any() else 0.0
+        std = float(vals[mask].std()) if mask.any() else 1.0
+        return _ScalerModel(mean=mean if self.with_mean else 0.0,
+                            scale=(std if std > 0 else 1.0)
+                            if self.with_std else 1.0)
+
+
+class _ScalerModel(UnaryModel):
+    def __init__(self, mean: float, scale: float, uid: Optional[str] = None):
+        super().__init__(operation_name="stdScaler", output_type=RealNN,
+                         uid=uid)
+        self.mean = mean
+        self.scale = scale
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        vals = np.nan_to_num(np.asarray(col.values, np.float64))
+        out = (vals - self.mean) / self.scale
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
+
+
+_SCALERS = {
+    "linear": (lambda v, a, b: a * v + b, lambda v, a, b: (v - b) / a),
+    "log": (lambda v, a, b: np.log(np.maximum(v, 1e-12)),
+            lambda v, a, b: np.exp(v)),
+}
+
+
+class ScalerTransformer(UnaryTransformer):
+    """Declarative scaling with an invertible family (ScalerTransformer.scala);
+    records scaler args in metadata so ``DescalerTransformer`` can undo it."""
+
+    def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
+                 intercept: float = 0.0, uid: Optional[str] = None):
+        super().__init__(operation_name="scaler", output_type=Real, uid=uid)
+        if scaling_type not in _SCALERS:
+            raise ValueError(f"unknown scaling_type {scaling_type!r}")
+        self.scaling_type = scaling_type
+        self.slope = slope
+        self.intercept = intercept
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        fwd, _ = _SCALERS[self.scaling_type]
+        vals = np.asarray(col.values, np.float64)
+        self.metadata["scaler"] = {"type": self.scaling_type,
+                                   "slope": self.slope,
+                                   "intercept": self.intercept}
+        return FeatureColumn(Real, fwd(vals, self.slope, self.intercept),
+                             col.mask)
+
+
+class DescalerTransformer(BinaryModel):
+    """Invert a ``ScalerTransformer`` applied upstream: inputs
+    (scaled value, scaled source carrying scaler metadata)."""
+
+    def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
+                 intercept: float = 0.0, uid: Optional[str] = None):
+        super().__init__(operation_name="descaler", output_type=Real, uid=uid)
+        self.scaling_type = scaling_type
+        self.slope = slope
+        self.intercept = intercept
+
+    def transform_columns(self, col: FeatureColumn, *_rest) -> FeatureColumn:
+        _, inv = _SCALERS[self.scaling_type]
+        vals = np.asarray(col.values, np.float64)
+        return FeatureColumn(Real, inv(vals, self.slope, self.intercept),
+                             col.mask)
+
+    input_arity = (1, 2)
+
+
+class PercentileCalibrator(UnaryEstimator):
+    """Map a numeric score to its training percentile bucket 0..buckets-1
+    (PercentileCalibrator.scala)."""
+
+    def __init__(self, buckets: int = 100, uid: Optional[str] = None):
+        super().__init__(operation_name="percentileCalibrator",
+                         output_type=RealNN, uid=uid)
+        self.buckets = buckets
+
+    def fit_columns(self, data: ColumnarDataset, col: FeatureColumn):
+        vals = np.asarray(col.values, np.float64)
+        mask = np.asarray(col.mask)
+        qs = np.linspace(0, 1, self.buckets + 1)[1:-1]
+        splits = (np.quantile(vals[mask], qs) if mask.any()
+                  else np.zeros(len(qs)))
+        model = _PercentileModel(splits=list(map(float, splits)),
+                                 buckets=self.buckets)
+        self.metadata["summary"] = {"splits": model.splits}
+        return model
+
+
+class _PercentileModel(UnaryModel):
+    def __init__(self, splits: List[float], buckets: int = 100,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="percentileCalibrator",
+                         output_type=RealNN, uid=uid)
+        self.splits = list(splits)
+        self.buckets = buckets
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        vals = np.nan_to_num(np.asarray(col.values, np.float64))
+        out = np.searchsorted(np.asarray(self.splits), vals,
+                              side="right").astype(np.float64)
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))

@@ -1,0 +1,271 @@
+"""Text-processing stages.
+
+Reference (core/.../impl/feature/, SURVEY §2.5):
+ * ``OpTextTokenizer``/``TextTokenizer`` (TextTokenizer.scala:125) — the
+   Lucene analyzer chain becomes a unicode-aware regex tokenizer with
+   lowercasing and min-length filtering (utils/text/LuceneTextAnalyzer.scala)
+ * ``OpNGram`` (OpNGram.scala), ``OpStopWordsRemover``
+   (OpStopWordsRemover.scala), ``OpCountVectorizer`` (OpCountVectorizer
+   .scala:44), ``OpHashingTF`` (OpHashingTF.scala:50)
+ * ``OpStringIndexer``/``OpStringIndexerNoFilter`` (OpStringIndexer.scala),
+   ``OpIndexToString``/``NoFilter`` (OpIndexToString.scala)
+ * ``TextLenTransformer`` (TextLenTransformer.scala)
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..stages.base import (
+    SequenceEstimator, SequenceModel, UnaryEstimator, UnaryModel,
+    UnaryTransformer,
+)
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..types.feature_types import (
+    OPVector, Real, RealNN, Text, TextList,
+)
+from ..utils.hashing import murmur3_32
+from .vector_metadata import VectorColumnMetadata, VectorMetadata
+from .vectorizers import _vec_column
+
+__all__ = [
+    "TextTokenizer", "OpNGram", "OpStopWordsRemover", "OpCountVectorizer",
+    "OpHashingTF", "OpStringIndexer", "OpStringIndexerNoFilter",
+    "OpIndexToString", "TextLenTransformer", "ENGLISH_STOP_WORDS",
+]
+
+_TOKEN_RE = re.compile(r"[\w']+", re.UNICODE)
+
+ENGLISH_STOP_WORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such
+    that the their then there these they this to was will with""".split())
+
+
+class TextTokenizer(UnaryTransformer):
+    """Text -> TextList of tokens (TextTokenizer.scala:125)."""
+
+    def __init__(self, to_lowercase: bool = True, min_token_length: int = 1,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="textTokenizer",
+                         output_type=TextList, uid=uid)
+        self.to_lowercase = to_lowercase
+        self.min_token_length = min_token_length
+
+    def tokenize(self, v: Optional[str]) -> List[str]:
+        if v is None:
+            return []
+        s = v.lower() if self.to_lowercase else v
+        return [t for t in _TOKEN_RE.findall(s)
+                if len(t) >= self.min_token_length]
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col.values):
+            out[i] = tuple(self.tokenize(v))
+        return FeatureColumn(TextList, out)
+
+
+class OpNGram(UnaryTransformer):
+    """TextList -> TextList of n-grams (OpNGram.scala)."""
+
+    def __init__(self, n: int = 2, uid: Optional[str] = None):
+        super().__init__(operation_name="ngram", output_type=TextList, uid=uid)
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        self.n = n
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        out = np.empty(len(col), dtype=object)
+        for i, toks in enumerate(col.values):
+            toks = list(toks or ())
+            out[i] = tuple(" ".join(toks[j:j + self.n])
+                           for j in range(len(toks) - self.n + 1))
+        return FeatureColumn(TextList, out)
+
+
+class OpStopWordsRemover(UnaryTransformer):
+    """Drop stop words from a TextList (OpStopWordsRemover.scala)."""
+
+    def __init__(self, stop_words: Optional[Sequence[str]] = None,
+                 case_sensitive: bool = False, uid: Optional[str] = None):
+        super().__init__(operation_name="stopWordsRemover",
+                         output_type=TextList, uid=uid)
+        self.stop_words = list(stop_words if stop_words is not None
+                               else ENGLISH_STOP_WORDS)
+        self.case_sensitive = case_sensitive
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        stops = (set(self.stop_words) if self.case_sensitive
+                 else {w.lower() for w in self.stop_words})
+        out = np.empty(len(col), dtype=object)
+        for i, toks in enumerate(col.values):
+            out[i] = tuple(
+                t for t in (toks or ())
+                if (t if self.case_sensitive else t.lower()) not in stops)
+        return FeatureColumn(TextList, out)
+
+
+class OpCountVectorizer(SequenceEstimator):
+    """TextList(s) -> bag-of-words counts over a learned vocabulary
+    (OpCountVectorizer.scala:44)."""
+
+    def __init__(self, vocab_size: int = 512, min_df: int = 1,
+                 binary: bool = False, uid: Optional[str] = None):
+        super().__init__(operation_name="countVec", output_type=OPVector,
+                         uid=uid)
+        self.vocab_size = vocab_size
+        self.min_df = min_df
+        self.binary = binary
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        df_counts: Counter = Counter()
+        for c in cols:
+            for toks in c.values:
+                df_counts.update(set(toks or ()))
+        vocab = [w for w, n in df_counts.most_common()
+                 if n >= self.min_df][: self.vocab_size]
+        return OpCountVectorizerModel(vocab=sorted(vocab), binary=self.binary)
+
+
+class OpCountVectorizerModel(SequenceModel):
+    def __init__(self, vocab: List[str], binary: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="countVec", output_type=OPVector,
+                         uid=uid)
+        self.vocab = list(vocab)
+        self.binary = binary
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        index = {w: i for i, w in enumerate(self.vocab)}
+        n = len(cols[0])
+        v = len(self.vocab)
+        parts, meta = [], []
+        for f, c in zip(self.input_features, cols):
+            block = np.zeros((n, v), np.float32)
+            for i, toks in enumerate(c.values):
+                for t in toks or ():
+                    j = index.get(t)
+                    if j is not None:
+                        block[i, j] = 1.0 if self.binary else block[i, j] + 1
+            parts.append(block)
+            meta.extend(VectorColumnMetadata(f.name, f.ftype.type_name(),
+                                             indicator_value=w)
+                        for w in self.vocab)
+        return _vec_column(np.concatenate(parts, axis=1),
+                           VectorMetadata("count_vec", meta))
+
+
+class OpHashingTF(UnaryTransformer):
+    """TextList -> hashed term frequencies (OpHashingTF.scala:50)."""
+
+    def __init__(self, num_features: int = 512, binary: bool = False,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(operation_name="hashingTF", output_type=OPVector,
+                         uid=uid)
+        self.num_features = num_features
+        self.binary = binary
+        self.seed = seed
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        f = self.input_features[0]
+        n = len(col)
+        block = np.zeros((n, self.num_features), np.float32)
+        for i, toks in enumerate(col.values):
+            for t in toks or ():
+                j = murmur3_32(t, self.seed) % self.num_features
+                block[i, j] = 1.0 if self.binary else block[i, j] + 1
+        meta = [VectorColumnMetadata(f.name, f.ftype.type_name(),
+                                     descriptor_value=f"hash_{b}")
+                for b in range(self.num_features)]
+        return _vec_column(block, VectorMetadata("hash_tf", meta))
+
+
+class OpStringIndexer(UnaryEstimator):
+    """Text -> frequency-ranked index (OpStringIndexer.scala); unseen labels
+    error ('error') or map to an extra index ('keep') per handle_invalid."""
+
+    def __init__(self, handle_invalid: str = "error",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="stringIndexer", output_type=RealNN,
+                         uid=uid)
+        if handle_invalid not in ("error", "keep", "skip"):
+            raise ValueError(handle_invalid)
+        self.handle_invalid = handle_invalid
+
+    def fit_columns(self, data: ColumnarDataset, col: FeatureColumn):
+        counts = Counter(v for v in col.values if v is not None)
+        labels = [w for w, _ in counts.most_common()]
+        return OpStringIndexerModel(labels=labels,
+                                    handle_invalid=self.handle_invalid)
+
+
+class OpStringIndexerNoFilter(OpStringIndexer):
+    """Unseen values map to an extra bucket (OpStringIndexerNoFilter)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(handle_invalid="keep", uid=uid)
+
+
+class OpStringIndexerModel(UnaryModel):
+    def __init__(self, labels: List[str], handle_invalid: str = "error",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="stringIndexer", output_type=RealNN,
+                         uid=uid)
+        self.labels = list(labels)
+        self.handle_invalid = handle_invalid
+        self.metadata["labels"] = list(labels)
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        index = {w: float(i) for i, w in enumerate(self.labels)}
+        unseen = float(len(self.labels))
+        out = np.zeros(len(col), np.float64)
+        for i, v in enumerate(col.values):
+            j = index.get(v)
+            if j is None:
+                if self.handle_invalid == "error" and v is not None:
+                    raise ValueError(f"unseen label {v!r}")
+                out[i] = unseen
+            else:
+                out[i] = j
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
+
+
+class OpIndexToString(UnaryTransformer):
+    """Index -> label text (OpIndexToString.scala)."""
+
+    def __init__(self, labels: Sequence[str], unseen_name: str = "UnseenLabel",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="indexToString", output_type=Text,
+                         uid=uid)
+        self.labels = list(labels)
+        self.unseen_name = unseen_name
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        out = np.empty(len(col), dtype=object)
+        vals = np.asarray(col.values)
+        for i, v in enumerate(vals):
+            j = int(v) if np.isfinite(v) else -1
+            out[i] = (self.labels[j] if 0 <= j < len(self.labels)
+                      else self.unseen_name)
+        return FeatureColumn(Text, out)
+
+
+class TextLenTransformer(UnaryTransformer):
+    """Text/TextList -> total character length (TextLenTransformer.scala)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="textLen", output_type=RealNN, uid=uid)
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        out = np.zeros(len(col), np.float64)
+        for i, v in enumerate(col.values):
+            if v is None:
+                continue
+            if isinstance(v, (tuple, list, frozenset, set)):
+                out[i] = float(sum(len(t) for t in v))
+            else:
+                out[i] = float(len(v))
+        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
