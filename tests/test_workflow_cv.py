@@ -1,0 +1,113 @@
+"""Workflow-level cross-validation (OpWorkflow.withWorkflowCV parity).
+
+Reference: OpWorkflowCVTest — the DAG is cut at the ModelSelector
+(FitStagesUtil.cutDAG FitStagesUtil.scala:302-355), label-aware
+feature-engineering estimators (SanityChecker) refit inside every fold
+(OpValidator.applyDAG OpValidator.scala:250), and the selector skips
+validation on the final fit because the best estimator is already chosen
+(ModelSelector.findBestEstimator ModelSelector.scala:116).
+"""
+import numpy as np
+import pandas as pd
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector, grid
+from transmogrifai_tpu.workflow.dag import compute_dag, cut_dag_cv
+
+
+def synthetic_binary(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    logits = 1.5 * x1 - 1.0 * x2 + (cat == "a") * 0.8
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(float)
+    return pd.DataFrame({"label": y, "x1": x1, "x2": x2, "cat": cat})
+
+
+def build_dag():
+    label = FeatureBuilder.RealNN("label").as_response()
+    x1 = FeatureBuilder.Real("x1").as_predictor()
+    x2 = FeatureBuilder.Real("x2").as_predictor()
+    cat = FeatureBuilder.PickList("cat").as_predictor()
+    features = transmogrify([x1, x2, cat])
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        label, features).get_output()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
+        ])
+    prediction = selector.set_input(label, checked).get_output()
+    return label, prediction, selector
+
+
+class TestCutDagCV:
+    def test_cut_puts_sanity_checker_in_during(self):
+        _, prediction, selector = build_dag()
+        dag = compute_dag([prediction])
+        cut = cut_dag_cv(dag)
+        assert cut.selector is selector
+        during_names = [type(s).__name__ for l in cut.during.layers for s in l]
+        assert "SanityChecker" in during_names
+        # the unsupervised vectorizers stay in the before-DAG
+        before_names = [type(s).__name__ for l in cut.before.layers for s in l]
+        assert "SanityChecker" not in before_names
+        assert any("Vector" in n or "Combiner" in n for n in before_names)
+        assert not cut.after.layers
+
+    def test_at_most_one_selector(self):
+        label, prediction, _ = build_dag()
+        _, prediction2, _ = build_dag()
+        dag = compute_dag([prediction, prediction2])
+        try:
+            cut_dag_cv(dag)
+            assert False, "expected ValueError for two selectors"
+        except ValueError as e:
+            assert "at most 1" in str(e)
+
+
+class TestWorkflowCV:
+    def test_train_with_workflow_cv(self):
+        df = synthetic_binary()
+        label, prediction, selector = build_dag()
+        wf = (OpWorkflow()
+              .set_result_features(prediction)
+              .set_input_data(df)
+              .with_workflow_cv())
+        model = wf.train()
+
+        # the selector went through findBestEstimator, not inline validation
+        assert selector.best_estimator is not None
+        best_name, best_params, results = selector.best_estimator
+        assert best_name == "OpLogisticRegression"
+        assert len(results) == 2  # one per grid point
+        assert all(len(r.fold_values) == 3 for r in results)
+
+        scored, metrics = model.score_and_evaluate(
+            Evaluators.BinaryClassification.auPR())
+        assert metrics["AuPR"] > 0.7, metrics
+
+        # summary metadata records the fold-validated results
+        summ = model.summary()
+        sel_meta = next(v for v in summ.values()
+                        if "model_selector_summary" in v)
+        assert sel_meta["model_selector_summary"]["bestModelType"] \
+            == "OpLogisticRegression"
+
+    def test_cv_and_plain_train_agree_on_quality(self):
+        df = synthetic_binary(seed=3)
+        _, prediction, _ = build_dag()
+        plain = (OpWorkflow().set_result_features(prediction)
+                 .set_input_data(df).train())
+        _, prediction_cv, _ = build_dag()
+        cv = (OpWorkflow().set_result_features(prediction_cv)
+              .set_input_data(df).with_workflow_cv().train())
+        ev = Evaluators.BinaryClassification.auPR()
+        _, m_plain = plain.score_and_evaluate(ev)
+        ev2 = Evaluators.BinaryClassification.auPR()
+        _, m_cv = cv.score_and_evaluate(ev2)
+        assert abs(m_plain["AuPR"] - m_cv["AuPR"]) < 0.1

@@ -12,3 +12,4 @@ __version__ = "0.1.0"
 from .features import Feature, FeatureBuilder  # noqa: F401
 from .ops.transmogrify import transmogrify  # noqa: F401
 from .workflow.workflow import OpWorkflow, OpWorkflowModel  # noqa: F401
+from . import dsl  # noqa: F401  installs the fluent Feature methods

@@ -193,7 +193,11 @@ class ExistsTransformer(UnaryTransformer):
         super().__init__(operation_name="exists", output_type=Binary, uid=uid)
 
     def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
-        out = np.array([v is not None for v in col.to_list()], np.float64)
+        # missing collection values are stored as empty containers, so
+        # presence = isEmpty semantics, not just None-ness
+        out = np.array(
+            [v is not None and (not hasattr(v, "__len__") or len(v) > 0)
+             for v in col.to_list()], np.float64)
         return FeatureColumn(Binary, out, np.ones(len(out), bool))
 
 

@@ -162,9 +162,10 @@ class DecisionTreeNumericBucketizer(BinaryEstimator):
             splits = [float(finite_edges[b]) for b in used_bins
                       if np.isfinite(finite_edges[b])][: self.max_splits]
         if splits:
-            lo = float(np.nanmin(vals[mask])) if mask.any() else 0.0
-            hi = float(np.nanmax(vals[mask])) if mask.any() else 1.0
-            points = [min(lo, splits[0]) - 1e-9] + splits + [hi + 1e-9]
+            # infinite outer bounds, as the reference tree bucketizer uses:
+            # scoring-time values beyond the training range still land in the
+            # first/last bucket instead of silently vanishing
+            points = [-np.inf] + splits + [np.inf]
         else:
             points = []
         self.metadata["summary"] = {"splits": points,
@@ -228,7 +229,10 @@ class _ScalerModel(UnaryModel):
         self.scale = scale
 
     def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
-        vals = np.nan_to_num(np.asarray(col.values, np.float64))
+        mask = np.asarray(col.mask)
+        vals = np.nan_to_num(np.asarray(col.values, np.float64), nan=self.mean)
+        # missing rows z-score to 0 (mean imputation), not (0-mean)/scale
+        vals = np.where(mask, vals, self.mean)
         out = (vals - self.mean) / self.scale
         return FeatureColumn(RealNN, out, np.ones(len(out), bool))
 

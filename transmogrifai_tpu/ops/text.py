@@ -222,15 +222,20 @@ class OpStringIndexerModel(UnaryModel):
         index = {w: float(i) for i, w in enumerate(self.labels)}
         unseen = float(len(self.labels))
         out = np.zeros(len(col), np.float64)
+        mask = np.ones(len(col), bool)
         for i, v in enumerate(col.values):
             j = index.get(v)
             if j is None:
                 if self.handle_invalid == "error" and v is not None:
                     raise ValueError(f"unseen label {v!r}")
+                if self.handle_invalid == "skip":
+                    # columnar datasets can't drop rows mid-DAG, so 'skip'
+                    # marks the row missing instead (masked out downstream)
+                    mask[i] = False
                 out[i] = unseen
             else:
                 out[i] = j
-        return FeatureColumn(RealNN, out, np.ones(len(out), bool))
+        return FeatureColumn(RealNN, out, mask)
 
 
 class OpIndexToString(UnaryTransformer):

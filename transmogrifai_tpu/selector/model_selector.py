@@ -129,6 +129,11 @@ class ModelSelector(PredictorEstimator):
             "binary": "AuPR", "multiclass": "F1",
             "regression": "RootMeanSquaredError"}[problem_type]
         self.holdout_evaluators = list(holdout_evaluators)
+        # set by find_best_estimator (workflow-level CV): when present,
+        # fit_columns skips validation and refits this winner directly
+        # (reference BestEstimator, ModelSelector.scala:116-145)
+        self.best_estimator: Optional[Tuple[str, Dict[str, Any],
+                                            List[ValidationResult]]] = None
 
     # -- validation plumbing -------------------------------------------------
 
@@ -171,6 +176,46 @@ class ModelSelector(PredictorEstimator):
                 out.append((type(proto).__name__, params, fitter))
         return out
 
+    def _resolved_splitter(self):
+        if self.splitter is not None:
+            return self.splitter
+        return {"binary": DataBalancer(),
+                "multiclass": DataCutter(),
+                "regression": DataSplitter()}[self.problem_type]
+
+    def find_best_estimator(self, data: ColumnarDataset,
+                            during_dag) -> Tuple[str, Dict[str, Any]]:
+        """Workflow-level CV (ModelSelector.findBestEstimator
+        ModelSelector.scala:116): validate candidates with the
+        feature-engineering ``during_dag`` refit inside every fold, and
+        remember the winner so the subsequent ``fit`` skips validation."""
+        label_name = self.label_feature.name
+        if label_name not in data:
+            raise RuntimeError(
+                f"label column {label_name!r} not materialized before the "
+                f"CV cut — it must be produced by the before-DAG")
+        y = np.nan_to_num(np.asarray(data[label_name].values,
+                                     dtype=np.float32))
+        n = len(y)
+        splitter = self._resolved_splitter()
+        train_idx, _ = splitter.split_indices(n, y)
+        train_mask = np.zeros(n, dtype=bool)
+        train_mask[train_idx] = True
+        base_w = splitter.train_weights(y, train_mask)
+
+        sub = data.take(train_idx)
+        candidates = self._candidates()
+        best_i, results = self.validator.validate_with_dag(
+            candidates, sub, during_dag,
+            label_name=label_name,
+            features_name=self.features_feature.name,
+            y=y[train_idx], base_weights=base_w[train_idx],
+            eval_fn=self._metric, metric_name=self.validation_metric,
+            larger_better=self.larger_better)
+        best_name, best_params, _ = candidates[best_i]
+        self.best_estimator = (best_name, best_params, results)
+        return best_name, best_params
+
     # -- fit -----------------------------------------------------------------
 
     def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
@@ -178,22 +223,21 @@ class ModelSelector(PredictorEstimator):
         X = np.asarray(features_col.values, dtype=np.float32)
         y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
         n = len(y)
-        splitter = self.splitter
-        if splitter is None:
-            splitter = {"binary": DataBalancer(),
-                        "multiclass": DataCutter(),
-                        "regression": DataSplitter()}[self.problem_type]
+        splitter = self._resolved_splitter()
         train_idx, holdout_idx = splitter.split_indices(n, y)
         train_mask = np.zeros(n, dtype=bool)
         train_mask[train_idx] = True
         base_w = splitter.train_weights(y, train_mask)
 
-        candidates = self._candidates()
-        best_i, results = self.validator.validate(
-            candidates, X, y, base_w,
-            eval_fn=self._metric, metric_name=self.validation_metric,
-            larger_better=self.larger_better)
-        best_name, best_params, _ = candidates[best_i]
+        if self.best_estimator is not None:
+            best_name, best_params, results = self.best_estimator
+        else:
+            candidates = self._candidates()
+            best_i, results = self.validator.validate(
+                candidates, X, y, base_w,
+                eval_fn=self._metric, metric_name=self.validation_metric,
+                larger_better=self.larger_better)
+            best_name, best_params, _ = candidates[best_i]
 
         # refit best on the full training split (ModelSelector.fit :180)
         best_proto = next(p for p, _ in self.models_and_params

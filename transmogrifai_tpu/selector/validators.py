@@ -72,6 +72,44 @@ class _ValidatorBase:
     ) -> Tuple[int, List[ValidationResult]]:
         raise NotImplementedError
 
+    def validate_with_dag(
+        self,
+        candidates,
+        data,
+        during_dag,
+        label_name: str,
+        features_name: str,
+        y: np.ndarray,
+        base_weights: np.ndarray,
+        eval_fn,
+        metric_name: str,
+        larger_better: bool = True,
+    ) -> Tuple[int, List[ValidationResult]]:
+        """Workflow-level CV (OpValidator.applyDAG OpValidator.scala:250):
+        the feature-engineering ``during_dag`` is refit on every fold's train
+        split and applied to its eval split, so label-aware estimators
+        (SanityChecker, supervised bucketizers) cannot leak fold labels."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _fold_matrices(data, during_dag, label_name, features_name,
+                       tr_idx: np.ndarray, ev_idx: np.ndarray):
+        """Refit during_dag on the fold's train rows, apply to eval rows,
+        and extract the (X, y) matrices for both sides."""
+        from ..workflow.dag import fit_and_transform_dag
+
+        train_ds = data.take(tr_idx)
+        eval_ds = data.take(ev_idx)
+        _, train_t, eval_t = fit_and_transform_dag(
+            during_dag, train_ds, apply_to=eval_ds)
+        X_tr = np.asarray(train_t[features_name].values, dtype=np.float32)
+        X_ev = np.asarray(eval_t[features_name].values, dtype=np.float32)
+        y_tr = np.nan_to_num(
+            np.asarray(train_t[label_name].values, dtype=np.float32))
+        y_ev = np.nan_to_num(
+            np.asarray(eval_t[label_name].values, dtype=np.float32))
+        return X_tr, y_tr, X_ev, y_ev
+
 
 class OpCrossValidation(_ValidatorBase):
     def __init__(self, num_folds: int = 3, seed: int = 42,
@@ -106,6 +144,38 @@ class OpCrossValidation(_ValidatorBase):
         best = _argbest([r.metric_value for r in results], larger_better)
         return best, results
 
+    def validate_with_dag(self, candidates, data, during_dag, label_name,
+                          features_name, y, base_weights, eval_fn,
+                          metric_name, larger_better=True):
+        n = len(y)
+        folds = make_folds(n, self.num_folds, y=y, stratify=self.stratify,
+                           seed=self.seed)
+        # one DAG refit per fold, shared across every candidate (the
+        # reference refits per fold too — OpCrossValidation.scala:87-148)
+        per_fold = []
+        for k in range(self.num_folds):
+            tr_idx = np.where(folds != k)[0]
+            ev_idx = np.where(folds == k)[0]
+            if not len(tr_idx) or not len(ev_idx):
+                continue
+            X_tr, y_tr, X_ev, y_ev = self._fold_matrices(
+                data, during_dag, label_name, features_name, tr_idx, ev_idx)
+            per_fold.append((X_tr, y_tr, base_weights[tr_idx],
+                             X_ev, y_ev, base_weights[ev_idx]))
+        results: List[ValidationResult] = []
+        for name, params, fitter in candidates:
+            fold_vals: List[float] = []
+            for X_tr, y_tr, w_tr, X_ev, y_ev, w_ev in per_fold:
+                if w_tr.sum() == 0 or w_ev.sum() == 0:
+                    continue
+                predict = fitter(X_tr, y_tr, w_tr, params)
+                fold_vals.append(float(eval_fn(y_ev, predict(X_ev), w_ev)))
+            mean = float(np.mean(fold_vals)) if fold_vals else float("-inf")
+            results.append(ValidationResult(name, params, metric_name, mean,
+                                            fold_vals))
+        best = _argbest([r.metric_value for r in results], larger_better)
+        return best, results
+
 
 class OpTrainValidationSplit(_ValidatorBase):
     def __init__(self, train_ratio: float = 0.75, seed: int = 42,
@@ -115,9 +185,7 @@ class OpTrainValidationSplit(_ValidatorBase):
         self.stratify = stratify
         self.parallelism = parallelism
 
-    def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
-                 larger_better=True):
-        n = X.shape[0]
+    def _split_mask(self, n: int, y: np.ndarray) -> np.ndarray:
         rng = np.random.default_rng(self.seed)
         if self.stratify:
             # per-class permutation keeps label ratios on both sides, so an
@@ -130,6 +198,12 @@ class OpTrainValidationSplit(_ValidatorBase):
                     len(idx) * self.train_ratio)))]] = True
         else:
             in_train = rng.random(n) < self.train_ratio
+        return in_train
+
+    def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
+                 larger_better=True):
+        n = X.shape[0]
+        in_train = self._split_mask(n, y)
         results: List[ValidationResult] = []
         for name, params, fitter in candidates:
             w_train = base_weights * in_train
@@ -137,6 +211,25 @@ class OpTrainValidationSplit(_ValidatorBase):
             predict = fitter(X, y, w_train, params)
             scores = predict(X)
             val = float(eval_fn(y, scores, w_eval))
+            results.append(ValidationResult(name, params, metric_name, val,
+                                            [val]))
+        best = _argbest([r.metric_value for r in results], larger_better)
+        return best, results
+
+    def validate_with_dag(self, candidates, data, during_dag, label_name,
+                          features_name, y, base_weights, eval_fn,
+                          metric_name, larger_better=True):
+        n = len(y)
+        in_train = self._split_mask(n, y)
+        tr_idx = np.where(in_train)[0]
+        ev_idx = np.where(~in_train)[0]
+        X_tr, y_tr, X_ev, y_ev = self._fold_matrices(
+            data, during_dag, label_name, features_name, tr_idx, ev_idx)
+        w_tr, w_ev = base_weights[tr_idx], base_weights[ev_idx]
+        results: List[ValidationResult] = []
+        for name, params, fitter in candidates:
+            predict = fitter(X_tr, y_tr, w_tr, params)
+            val = float(eval_fn(y_ev, predict(X_ev), w_ev))
             results.append(ValidationResult(name, params, metric_name, val,
                                             [val]))
         best = _argbest([r.metric_value for r in results], larger_better)

@@ -12,6 +12,7 @@ the fusion the reference got from Catalyst.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..features.feature import Feature, FeatureCycleError
@@ -19,7 +20,8 @@ from ..stages.base import Estimator, Model, PipelineStage, Transformer
 from ..stages.generator import FeatureGeneratorStage
 from ..types.columns import ColumnarDataset
 
-__all__ = ["StagesDAG", "compute_dag", "fit_and_transform_dag", "transform_dag", "cut_dag"]
+__all__ = ["StagesDAG", "compute_dag", "fit_and_transform_dag", "transform_dag",
+           "CutDAG", "cut_dag_cv"]
 
 
 class StagesDAG:
@@ -97,14 +99,16 @@ def fit_and_transform_dag(
     train: ColumnarDataset,
     apply_to: Optional[ColumnarDataset] = None,
     fitted_substitutes: Optional[Dict[str, Model]] = None,
-) -> Tuple[List[PipelineStage], ColumnarDataset]:
+) -> Tuple[List[PipelineStage], ColumnarDataset, Optional[ColumnarDataset]]:
     """Fit estimators layer by layer, transforming as we go.
 
     Port of FitStagesUtil.fitAndTransformDAG/fitAndTransformLayer
     (FitStagesUtil.scala:212-300).  Returns (fitted stages in topo order,
-    transformed train data).  ``fitted_substitutes`` allows warm-start
-    (OpWorkflow.withModelStages parity): estimators whose uid appears there
-    are skipped and the fitted model used directly.
+    transformed train data, transformed ``apply_to`` data or None — the
+    reference's FittedDAG(trainData, testData, transformers)).
+    ``fitted_substitutes`` allows warm-start (OpWorkflow.withModelStages
+    parity): estimators whose uid appears there are skipped and the fitted
+    model used directly.
     """
     fitted_substitutes = fitted_substitutes or {}
     fitted: List[PipelineStage] = []
@@ -124,7 +128,7 @@ def fit_and_transform_dag(
                     apply_to = stage.transform(apply_to)
             else:
                 raise TypeError(f"cannot execute stage {stage!r}")
-    return fitted, data
+    return fitted, data, apply_to
 
 
 def transform_dag(
@@ -147,24 +151,43 @@ def transform_dag(
     return data
 
 
-def cut_dag(dag: StagesDAG, at_stage_uid: str) -> Tuple[StagesDAG, PipelineStage, StagesDAG]:
-    """Split the DAG at a stage (the ModelSelector) for workflow-level CV.
+@dataclasses.dataclass
+class CutDAG:
+    """The DAG split for workflow-level CV (FitStagesUtil.CutDAG parity):
+    ``before`` fits once on the full training data (leakage-free stages),
+    ``during`` refits inside every CV fold, ``after`` fits after the
+    selector has chosen its model."""
 
-    Port of FitStagesUtil.cutDAG (FitStagesUtil.scala:302): returns
-    (before-DAG, the stage itself, after-DAG).  Layers containing only the
-    target stage's ancestors go 'before'; the rest after.
+    selector: Optional[PipelineStage]
+    before: StagesDAG
+    during: StagesDAG
+    after: StagesDAG
+
+
+def cut_dag_cv(dag: StagesDAG) -> CutDAG:
+    """Split the DAG at the ModelSelector for workflow-level CV.
+
+    Port of FitStagesUtil.cutDAG (FitStagesUtil.scala:302-355).  The
+    reference's rule: within the selector's ancestor DAG, the first layer
+    containing a stage whose inputs mix response and predictor features
+    (a potential label-leaking estimator, e.g. SanityChecker or a supervised
+    bucketizer) marks the start of the "during" DAG — those stages must be
+    refit inside each fold.  Everything upstream of that point is "before";
+    stages that do not feed the selector are "after".  At most one
+    ModelSelector is allowed in a workflow.
     """
-    before: List[List[PipelineStage]] = []
-    after: List[List[PipelineStage]] = []
-    target: Optional[PipelineStage] = None
-    # ancestor stage uids of the target
-    target_stage = None
-    for layer in dag.layers:
-        for s in layer:
-            if s.uid == at_stage_uid:
-                target_stage = s
-    if target_stage is None:
-        raise ValueError(f"stage {at_stage_uid} not in DAG")
+    from ..selector.model_selector import ModelSelector
+
+    selectors = [s for layer in dag.layers for s in layer
+                 if isinstance(s, ModelSelector)]
+    if not selectors:
+        return CutDAG(None, StagesDAG([]), StagesDAG([]), dag)
+    if len(selectors) > 1:
+        raise ValueError(
+            f"workflow can contain at most 1 ModelSelector, found "
+            f"{len(selectors)}: {[s.uid for s in selectors]}")
+    selector = selectors[0]
+
     ancestors: Set[str] = set()
 
     def collect(s: PipelineStage):
@@ -174,13 +197,28 @@ def cut_dag(dag: StagesDAG, at_stage_uid: str) -> Tuple[StagesDAG, PipelineStage
                 ancestors.add(p.uid)
                 collect(p)
 
-    collect(target_stage)
+    collect(selector)
 
-    for layer in dag.layers:
-        b = [s for s in layer if s.uid in ancestors]
-        a = [s for s in layer if s.uid not in ancestors and s.uid != at_stage_uid]
-        if b:
-            before.append(b)
-        if a:
-            after.append(a)
-    return StagesDAG(before), target_stage, StagesDAG(after)
+    def mixes_response(s: PipelineStage) -> bool:
+        ins = s.input_features
+        return (any(f.is_response for f in ins)
+                and any(not f.is_response for f in ins))
+
+    # ancestor layers in topological order
+    anc_layers = [[s for s in layer if s.uid in ancestors]
+                  for layer in dag.layers]
+    anc_layers = [l for l in anc_layers if l]
+    first_cv = next((i for i, layer in enumerate(anc_layers)
+                     if any(mixes_response(s) for s in layer)), None)
+    if first_cv is None:
+        before_layers, during_layers = anc_layers, []
+    else:
+        before_layers = anc_layers[:first_cv]
+        during_layers = anc_layers[first_cv:]
+
+    after_layers = [[s for s in layer
+                     if s.uid not in ancestors and s is not selector]
+                    for layer in dag.layers]
+    after_layers = [l for l in after_layers if l]
+    return CutDAG(selector, StagesDAG(before_layers),
+                  StagesDAG(during_layers), StagesDAG(after_layers))

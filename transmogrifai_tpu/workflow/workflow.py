@@ -22,7 +22,8 @@ from ..readers.base import Reader, reader_for
 from ..stages.base import Estimator, Model, PipelineStage, Transformer
 from ..stages.generator import FeatureGeneratorStage
 from ..types.columns import ColumnarDataset
-from .dag import StagesDAG, compute_dag, fit_and_transform_dag, transform_dag
+from .dag import (StagesDAG, compute_dag, cut_dag_cv, fit_and_transform_dag,
+                  transform_dag)
 
 __all__ = ["OpWorkflow", "OpWorkflowModel"]
 
@@ -66,6 +67,7 @@ class OpWorkflow(_WorkflowCore):
         super().__init__()
         self._raw_feature_filter = None
         self._model_stages: Dict[str, Model] = {}
+        self._workflow_cv = False
 
     # -- wiring -------------------------------------------------------------
 
@@ -84,6 +86,13 @@ class OpWorkflow(_WorkflowCore):
         from ..filters.raw_feature_filter import RawFeatureFilter
 
         self._raw_feature_filter = RawFeatureFilter(**kwargs)
+        return self
+
+    def with_workflow_cv(self) -> "OpWorkflow":
+        """Move label-aware feature-engineering estimators inside the CV
+        loop (OpWorkflow.withWorkflowCV; SURVEY §3.2): the DAG is cut at the
+        ModelSelector and the leakage-prone segment refits per fold."""
+        self._workflow_cv = True
         return self
 
     def with_model_stages(self, model: "OpWorkflowModel") -> "OpWorkflow":
@@ -145,8 +154,21 @@ class OpWorkflow(_WorkflowCore):
         dag = compute_dag(self.result_features)
         self._validate_stages(dag)
         self._inject_params(dag)
-        fitted, transformed = fit_and_transform_dag(
-            dag, data, fitted_substitutes=self._model_stages)
+        substitutes = dict(self._model_stages)
+        if self._workflow_cv:
+            # OpWorkflow.fitStages CV path (OpWorkflow.scala:403-453):
+            # fit the leakage-free prefix once, run fold-refitting validation
+            # to pick the winner, then fit the full DAG (the selector skips
+            # validation because its best_estimator is already set).
+            cut = cut_dag_cv(dag)
+            if cut.selector is not None and cut.during.layers:
+                before_fitted, before_data, _ = fit_and_transform_dag(
+                    cut.before, data, fitted_substitutes=substitutes)
+                cut.selector.find_best_estimator(before_data, cut.during)
+                substitutes.update(
+                    {m.uid: m for m in before_fitted if isinstance(m, Model)})
+        fitted, transformed, _ = fit_and_transform_dag(
+            dag, data, fitted_substitutes=substitutes)
         model = OpWorkflowModel(
             result_features=self.result_features,
             stages=fitted,
@@ -172,7 +194,7 @@ class OpWorkflow(_WorkflowCore):
             self.set_input_data(data)
         raw = self.generate_raw_data()
         dag = compute_dag([feature])
-        fitted, transformed = fit_and_transform_dag(dag, raw)
+        _, transformed, _ = fit_and_transform_dag(dag, raw)
         return transformed
 
     def load_model(self, path: str) -> "OpWorkflowModel":
