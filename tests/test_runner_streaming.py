@@ -1,0 +1,179 @@
+"""Runner modes, streaming score, and profiling metrics.
+
+Reference: OpWorkflowRunnerTest (run-mode dispatch, metrics writing),
+StreamingReaders (micro-batch scoring), OpSparkListener/JobGroupUtil
+(per-step metrics).
+"""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.readers import StreamingReaders, AsyncBatcher
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector, grid
+from transmogrifai_tpu.utils import MetricsCollector, OpStep, with_job_group
+from transmogrifai_tpu.workflow import (OpApp, OpParams, OpWorkflowRunner,
+                                        RunType)
+
+
+def make_df(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.2 * x1 - x2)))).astype(float)
+    return pd.DataFrame({"label": y, "x1": x1, "x2": x2})
+
+
+def build_workflow(df):
+    label = FeatureBuilder.RealNN("label").as_response()
+    x1 = FeatureBuilder.Real("x1").as_predictor()
+    x2 = FeatureBuilder.Real("x2").as_predictor()
+    features = transmogrify([x1, x2])
+    selector = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01]))])
+    prediction = selector.set_input(label, features).get_output()
+    return OpWorkflow().set_result_features(prediction).set_input_data(df)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner")
+    df = make_df()
+    wf = build_workflow(df)
+    runner = OpWorkflowRunner(wf)
+    params = OpParams(model_location=str(tmp / "model"),
+                      metrics_location=str(tmp / "metrics"))
+    result = runner.run(RunType.Train, params)
+    return tmp, df, result
+
+
+class TestRunnerModes:
+    def test_train_writes_model_and_metrics(self, trained):
+        tmp, df, result = trained
+        assert result.run_type == "train"
+        assert result.summary
+        assert os.path.isdir(tmp / "model")
+        metrics = json.load(open(tmp / "metrics" / "op_metrics.json"))
+        steps = {m["step"] for m in metrics["app"]["stepMetrics"]}
+        assert "DataReadingAndFiltering" in steps
+        assert "FeatureEngineering" in steps
+        assert "ModelIO" in steps
+
+    def test_score_mode(self, trained, tmp_path):
+        tmp, df, _ = trained
+        wf2 = build_workflow(df)
+        runner = OpWorkflowRunner(wf2, score_reader=df)
+        params = OpParams(model_location=str(tmp / "model"),
+                          write_location=str(tmp_path / "scores"))
+        result = runner.run(RunType.Score, params)
+        assert result.n_rows == len(df)
+        scores = pd.read_csv(result.scores_location)
+        assert len(scores) == len(df)
+
+    def test_evaluate_mode(self, trained):
+        tmp, df, _ = trained
+        wf2 = build_workflow(df)
+        runner = OpWorkflowRunner(
+            wf2, evaluation_reader=df,
+            evaluator=Evaluators.BinaryClassification.auPR())
+        params = OpParams(model_location=str(tmp / "model"))
+        result = runner.run(RunType.Evaluate, params)
+        assert result.metrics["AuPR"] > 0.6
+
+    def test_streaming_score_mode(self, trained, tmp_path):
+        tmp, df, _ = trained
+        batches = [df.iloc[:100], df.iloc[100:200], df.iloc[200:]]
+        wf2 = build_workflow(df)
+        runner = OpWorkflowRunner(
+            wf2,
+            streaming_score_reader=StreamingReaders.Simple.iterator(batches))
+        params = OpParams(model_location=str(tmp / "model"),
+                          write_location=str(tmp_path / "stream"))
+        result = runner.run(RunType.StreamingScore, params)
+        assert result.n_batches == 3
+        assert result.n_rows == len(df)
+        files = sorted(os.listdir(tmp_path / "stream"))
+        assert len(files) == 3
+
+    def test_file_streaming_reader(self, trained, tmp_path):
+        tmp, df, _ = trained
+        watch = tmp_path / "incoming"
+        watch.mkdir()
+        df.iloc[:150].to_csv(watch / "a.csv", index=False)
+        df.iloc[150:].to_csv(watch / "b.csv", index=False)
+        wf2 = build_workflow(df)
+        runner = OpWorkflowRunner(
+            wf2, streaming_score_reader=StreamingReaders.Simple.files(
+                str(watch), max_polls=1))
+        params = OpParams(model_location=str(tmp / "model"))
+        result = runner.run(RunType.StreamingScore, params)
+        assert result.n_batches == 2
+        assert result.n_rows == len(df)
+
+    def test_app_end_handler_and_tags(self, trained):
+        tmp, df, _ = trained
+        seen = {}
+        wf2 = build_workflow(df)
+        runner = OpWorkflowRunner(
+            wf2, evaluation_reader=df,
+            evaluator=Evaluators.BinaryClassification.auROC())
+        runner.add_application_end_handler(
+            lambda m: seen.setdefault("metrics", m))
+        params = OpParams(model_location=str(tmp / "model"),
+                          custom_tag_name="team", custom_tag_value="ml")
+        runner.run(RunType.Evaluate, params)
+        assert seen["metrics"].custom_tags == {"team": "ml"}
+        assert seen["metrics"].app_duration > 0
+
+    def test_op_app_cli(self, trained, tmp_path):
+        tmp, df, _ = trained
+
+        class App(OpApp):
+            def runner(self_inner):
+                return OpWorkflowRunner(
+                    build_workflow(df), evaluation_reader=df,
+                    evaluator=Evaluators.BinaryClassification.auPR())
+
+        result = App().main([
+            "--run-type", "evaluate",
+            "--model-location", str(tmp / "model"),
+            "--metrics-location", str(tmp_path / "m")])
+        assert result.metrics["AuPR"] > 0.6
+        assert os.path.exists(tmp_path / "m" / "op_metrics.json")
+
+
+class TestAsyncBatcher:
+    def test_prefetch_and_order(self):
+        items = list(range(20))
+        out = list(AsyncBatcher(iter(items), depth=3))
+        assert out == items
+
+    def test_error_propagates(self):
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        it = iter(AsyncBatcher(gen()))
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+
+class TestJobGroups:
+    def test_nested_groups_accumulate(self):
+        coll = MetricsCollector()
+        with with_job_group(OpStep.Other, coll):
+            with with_job_group(OpStep.Scoring):
+                pass
+            with with_job_group(OpStep.Scoring):
+                pass
+        m = coll.finish()
+        assert m.step_metrics["Scoring"].count == 2
+        assert m.step_metrics["Other"].count == 1
+        assert json.dumps(m.to_json())

@@ -81,11 +81,13 @@ class TestWorkflowCV:
         model = wf.train()
 
         # the selector went through findBestEstimator, not inline validation
-        assert selector.best_estimator is not None
-        best_name, best_params, results = selector.best_estimator
-        assert best_name == "OpLogisticRegression"
+        # (the winner is consumed by the final fit; the fold-refit results
+        # stay introspectable in metadata)
+        assert selector.best_estimator is None
+        results = selector.metadata["workflow_cv_results"]
         assert len(results) == 2  # one per grid point
-        assert all(len(r.fold_values) == 3 for r in results)
+        assert all(len(r["foldValues"]) == 3 for r in results)
+        assert all(r["modelType"] == "OpLogisticRegression" for r in results)
 
         scored, metrics = model.score_and_evaluate(
             Evaluators.BinaryClassification.auPR())

@@ -194,10 +194,16 @@ class ExistsTransformer(UnaryTransformer):
 
     def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
         # missing collection values are stored as empty containers, so
-        # presence = isEmpty semantics, not just None-ness
-        out = np.array(
-            [v is not None and (not hasattr(v, "__len__") or len(v) > 0)
-             for v in col.to_list()], np.float64)
+        # presence = isEmpty semantics there; an empty *string* is still a
+        # present Text value (reference Text(Some("")).nonEmpty)
+        def present(v):
+            if v is None:
+                return False
+            if isinstance(v, (list, tuple, set, frozenset, dict)):
+                return len(v) > 0
+            return True
+
+        out = np.array([present(v) for v in col.to_list()], np.float64)
         return FeatureColumn(Binary, out, np.ones(len(out), bool))
 
 

@@ -1,3 +1,6 @@
 from .base import Reader, DataFrameReader, RecordsReader, reader_for  # noqa: F401
+from .streaming import (AsyncBatcher, FileStreamingReader,  # noqa: F401
+                        IteratorStreamingReader, StreamingReader,
+                        StreamingReaders)
 from .files import CSVReader, CSVAutoReader, ParquetReader, JSONLinesReader, DataReaders  # noqa: F401
 from .aggregates import AggregateDataReader, ConditionalDataReader, JoinedDataReader  # noqa: F401

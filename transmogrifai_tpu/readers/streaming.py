@@ -1,0 +1,181 @@
+"""Streaming readers — micro-batched scoring input.
+
+Reference: ``StreamingReaders.Simple.avro`` (readers/StreamingReaders.scala:43-59)
+builds a DStream of new files in a directory; ``OpWorkflowRunner.streamingScore``
+(OpWorkflowRunner.scala:232-247) scores each micro-batch.
+
+TPU redesign (SURVEY §2.12 streaming row): no Spark Streaming — a host-side
+async batcher (background thread + bounded queue) prefetches and columnarizes
+micro-batches while the device scores the previous one, keeping the compiled
+score function fed.  Sources: any iterable of pandas DataFrames / record
+lists, or a watched directory of CSV/parquet/json files (new-files-only like
+the reference's ``FileStreamingAvroReader``).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+from ..features.feature import Feature
+from ..types.columns import ColumnarDataset
+from .base import DataFrameReader, Reader, RecordsReader
+
+__all__ = ["StreamingReader", "IteratorStreamingReader",
+           "FileStreamingReader", "AsyncBatcher", "StreamingReaders"]
+
+
+class StreamingReader:
+    """Yields ``ColumnarDataset`` micro-batches for raw features."""
+
+    def stream(self, raw_features: Sequence[Feature]
+               ) -> Iterator[ColumnarDataset]:
+        raise NotImplementedError
+
+
+class IteratorStreamingReader(StreamingReader):
+    """Wraps any iterable of pandas DataFrames or record-lists."""
+
+    def __init__(self, batches: Iterable[Any]):
+        self.batches = batches
+
+    def stream(self, raw_features):
+        for batch in self.batches:
+            if isinstance(batch, ColumnarDataset):
+                yield batch
+            elif isinstance(batch, (list, tuple)):
+                yield RecordsReader(batch).generate_dataset(raw_features)
+            else:
+                yield DataFrameReader(batch).generate_dataset(raw_features)
+
+
+class FileStreamingReader(StreamingReader):
+    """Watch a directory, scoring each new data file as one micro-batch
+    (FileStreamingAvroReader parity: path filter + newFilesOnly).
+
+    ``poll_interval``/``max_polls`` bound the watch loop so batch jobs and
+    tests terminate; a service would pass ``max_polls=None`` and cancel via
+    ``stop()``.
+    """
+
+    def __init__(self, directory: str,
+                 path_filter: Optional[Callable[[str], bool]] = None,
+                 new_files_only: bool = False,
+                 poll_interval: float = 1.0,
+                 max_polls: Optional[int] = 1,
+                 column_names: Optional[List[str]] = None):
+        self.directory = directory
+        self.path_filter = path_filter or (lambda p: not os.path.basename(
+            p).startswith((".", "_")))
+        self.new_files_only = new_files_only
+        self.poll_interval = poll_interval
+        self.max_polls = max_polls
+        self.column_names = column_names
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _list_files(self) -> List[str]:
+        try:
+            names = sorted(os.listdir(self.directory))
+        except FileNotFoundError:
+            return []
+        paths = [os.path.join(self.directory, n) for n in names]
+        return [p for p in paths if os.path.isfile(p) and self.path_filter(p)]
+
+    def _read_file(self, path: str, raw_features):
+        import pandas as pd
+
+        if path.endswith(".parquet"):
+            df = pd.read_parquet(path)
+        elif path.endswith((".json", ".jsonl")):
+            df = pd.read_json(path, lines=path.endswith(".jsonl"))
+        else:
+            df = (pd.read_csv(path, header=None, names=self.column_names)
+                  if self.column_names else pd.read_csv(path))
+        return DataFrameReader(df).generate_dataset(raw_features)
+
+    def stream(self, raw_features):
+        seen = set(self._list_files()) if self.new_files_only else set()
+        polls = 0
+        while not self._stop.is_set():
+            for path in self._list_files():
+                if path in seen:
+                    continue
+                seen.add(path)
+                yield self._read_file(path, raw_features)
+            polls += 1
+            if self.max_polls is not None and polls >= self.max_polls:
+                return
+            self._stop.wait(self.poll_interval)
+
+
+class AsyncBatcher:
+    """Bounded-queue prefetcher: a background thread columnarizes upcoming
+    micro-batches while the device scores the current one — the host/device
+    pipelining that replaces Spark Streaming's receiver."""
+
+    _DONE = object()
+
+    def __init__(self, source: Iterator[ColumnarDataset], depth: int = 2):
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._err: Optional[BaseException] = None
+        self._closed = threading.Event()
+
+        # the pump must not block forever on a full queue once the consumer
+        # is gone (early break / scoring error), so puts poll the closed flag
+        def pump():
+            try:
+                for item in source:
+                    while not self._closed.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._closed.is_set():
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                self._err = e
+            finally:
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(self._DONE, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        """Release the pump thread; safe to call any time."""
+        self._closed.set()
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._DONE:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            self.close()
+
+
+class StreamingReaders:
+    """Factory catalogue (StreamingReaders.Simple parity)."""
+
+    class Simple:
+        @staticmethod
+        def iterator(batches: Iterable[Any]) -> IteratorStreamingReader:
+            return IteratorStreamingReader(batches)
+
+        @staticmethod
+        def files(directory: str, **kwargs) -> FileStreamingReader:
+            return FileStreamingReader(directory, **kwargs)

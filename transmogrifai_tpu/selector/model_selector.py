@@ -214,6 +214,9 @@ class ModelSelector(PredictorEstimator):
             larger_better=self.larger_better)
         best_name, best_params, _ = candidates[best_i]
         self.best_estimator = (best_name, best_params, results)
+        # introspectable record of the fold-refit validation (survives the
+        # consume-on-fit of best_estimator)
+        self.metadata["workflow_cv_results"] = [r.to_json() for r in results]
         return best_name, best_params
 
     # -- fit -----------------------------------------------------------------
@@ -230,7 +233,10 @@ class ModelSelector(PredictorEstimator):
         base_w = splitter.train_weights(y, train_mask)
 
         if self.best_estimator is not None:
+            # consume the workflow-CV winner: a later fit on new data must
+            # validate afresh, not reuse a stale selection
             best_name, best_params, results = self.best_estimator
+            self.best_estimator = None
         else:
             candidates = self._candidates()
             best_i, results = self.validator.validate(

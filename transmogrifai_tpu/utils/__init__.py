@@ -1,0 +1,2 @@
+from .profiling import (AppMetrics, MetricsCollector, OpStep,  # noqa: F401
+                        profile_to, with_job_group)

@@ -1,0 +1,164 @@
+"""Tracing / profiling — phase markers and run metrics.
+
+Reference: ``OpStep`` job-group labels (utils/spark/OpStep.scala:38-46),
+``JobGroupUtil.withJobGroup`` (core/.../utils/spark/JobGroupUtil.scala),
+``OpSparkListener`` per-stage/app metrics collection
+(utils/spark/OpSparkListener.scala:62-148, AppMetrics :173).
+
+TPU redesign: there is no Spark scheduler to listen to — phases are explicit
+context managers that accumulate wall-clock into a per-run
+``MetricsCollector``, and the deep profile comes from XLA itself via
+``jax.profiler`` (trace files viewable in TensorBoard/Perfetto), which
+replaces the Spark UI.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["OpStep", "MetricsCollector", "AppMetrics", "StepMetrics",
+           "with_job_group", "current_collector", "install_collector",
+           "profile_to"]
+
+
+class OpStep(enum.Enum):
+    """Phases of a workflow run (OpStep.scala:38-46 parity)."""
+
+    CrossValidation = "Cross-validation"
+    DataReadingAndFiltering = "Data reading and filtering"
+    FeatureEngineering = "Feature engineering"
+    ModelIO = "Model loading / saving"
+    Other = "Other"
+    ResultsSaving = "Results saving"
+    Scoring = "Scoring"  # TPU addition: batched/streaming score phases
+
+
+@dataclass
+class StepMetrics:
+    step: str
+    duration_secs: float
+    count: int = 1
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"step": self.step, "durationSecs": self.duration_secs,
+                "count": self.count}
+
+
+@dataclass
+class AppMetrics:
+    """Aggregate run metrics (OpSparkListener.AppMetrics parity)."""
+
+    app_name: str = "transmogrifai_tpu"
+    run_type: Optional[str] = None
+    app_start_time: float = field(default_factory=time.time)
+    app_end_time: Optional[float] = None
+    step_metrics: Dict[str, StepMetrics] = field(default_factory=dict)
+    custom_tags: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def app_duration(self) -> float:
+        end = self.app_end_time if self.app_end_time is not None else time.time()
+        return end - self.app_start_time
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "appName": self.app_name,
+            "runType": self.run_type,
+            "appDurationSecs": self.app_duration,
+            "stepMetrics": [m.to_json() for m in self.step_metrics.values()],
+            "customTags": dict(self.custom_tags),
+        }
+
+
+class MetricsCollector:
+    """Accumulates per-step wall-clock for one run; thread-safe."""
+
+    def __init__(self, app_name: str = "transmogrifai_tpu",
+                 run_type: Optional[str] = None):
+        self.metrics = AppMetrics(app_name=app_name, run_type=run_type)
+        self._lock = threading.Lock()
+        self._end_handlers: List[Callable[[AppMetrics], None]] = []
+
+    def record(self, step: OpStep, duration_secs: float) -> None:
+        with self._lock:
+            cur = self.metrics.step_metrics.get(step.name)
+            if cur is None:
+                self.metrics.step_metrics[step.name] = StepMetrics(
+                    step.name, duration_secs)
+            else:
+                cur.duration_secs += duration_secs
+                cur.count += 1
+
+    def add_application_end_handler(
+            self, fn: Callable[[AppMetrics], None]) -> None:
+        """OpWorkflowRunner.addApplicationEndHandler (:145) parity."""
+        self._end_handlers.append(fn)
+
+    def finish(self) -> AppMetrics:
+        self.metrics.app_end_time = time.time()
+        for fn in self._end_handlers:
+            try:
+                fn(self.metrics)
+            except Exception:  # handlers must not break the run
+                pass
+        return self.metrics
+
+
+_local = threading.local()
+
+
+def current_collector() -> Optional[MetricsCollector]:
+    return getattr(_local, "collector", None)
+
+
+@contextlib.contextmanager
+def install_collector(collector: MetricsCollector):
+    """Make ``collector`` the thread-current one for the enclosed block
+    WITHOUT recording a step for the block itself (the run's total lives in
+    AppMetrics.app_duration; steps are for attributed time only)."""
+    prev = current_collector()
+    _local.collector = collector
+    try:
+        yield collector
+    finally:
+        _local.collector = prev
+
+
+@contextlib.contextmanager
+def with_job_group(step: OpStep, collector: Optional[MetricsCollector] = None):
+    """Label a phase of the run (JobGroupUtil.withJobGroup parity).
+
+    The first entered group installs its collector as the thread-current one
+    so nested library code can record into the same run.
+    """
+    coll = collector or current_collector()
+    installed = False
+    if coll is not None and current_collector() is None:
+        _local.collector = coll
+        installed = True
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if coll is not None:
+            coll.record(step, dt)
+        if installed:
+            _local.collector = None
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str):
+    """Capture an XLA device trace for the enclosed block (the TPU analogue
+    of the Spark UI): view with TensorBoard's profile plugin or Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,2 +1,5 @@
 from .workflow import OpWorkflow, OpWorkflowModel  # noqa: F401
-from .dag import compute_dag, fit_and_transform_dag, transform_dag  # noqa: F401
+from .dag import (compute_dag, cut_dag_cv, fit_and_transform_dag,  # noqa: F401
+                  transform_dag)
+from .runner import (OpApp, OpParams, OpWorkflowRunner,  # noqa: F401
+                     OpWorkflowRunnerResult, RunType)

@@ -145,12 +145,16 @@ class OpWorkflow(_WorkflowCore):
                 f"features {bad}; protect them via protected_features")
 
     def train(self) -> "OpWorkflowModel":
-        data = self.generate_raw_data()
-        filter_results = None
-        if self._raw_feature_filter is not None:
-            data, filter_results = self._raw_feature_filter.filter_raw_data(
-                data, self.raw_features())
-            self._apply_blocklist(filter_results.dropped_features)
+        from ..utils.profiling import OpStep, with_job_group
+
+        with with_job_group(OpStep.DataReadingAndFiltering):
+            data = self.generate_raw_data()
+            filter_results = None
+            if self._raw_feature_filter is not None:
+                data, filter_results = (
+                    self._raw_feature_filter.filter_raw_data(
+                        data, self.raw_features()))
+                self._apply_blocklist(filter_results.dropped_features)
         dag = compute_dag(self.result_features)
         self._validate_stages(dag)
         self._inject_params(dag)
@@ -162,13 +166,16 @@ class OpWorkflow(_WorkflowCore):
             # validation because its best_estimator is already set).
             cut = cut_dag_cv(dag)
             if cut.selector is not None and cut.during.layers:
-                before_fitted, before_data, _ = fit_and_transform_dag(
-                    cut.before, data, fitted_substitutes=substitutes)
-                cut.selector.find_best_estimator(before_data, cut.during)
-                substitutes.update(
-                    {m.uid: m for m in before_fitted if isinstance(m, Model)})
-        fitted, transformed, _ = fit_and_transform_dag(
-            dag, data, fitted_substitutes=substitutes)
+                with with_job_group(OpStep.CrossValidation):
+                    before_fitted, before_data, _ = fit_and_transform_dag(
+                        cut.before, data, fitted_substitutes=substitutes)
+                    cut.selector.find_best_estimator(before_data, cut.during)
+                    substitutes.update(
+                        {m.uid: m for m in before_fitted
+                         if isinstance(m, Model)})
+        with with_job_group(OpStep.FeatureEngineering):
+            fitted, transformed, _ = fit_and_transform_dag(
+                dag, data, fitted_substitutes=substitutes)
         model = OpWorkflowModel(
             result_features=self.result_features,
             stages=fitted,
