@@ -1,0 +1,219 @@
+"""Tests for derived-type detector stages and smart map vectorization.
+
+Parity model: reference MimeTypeDetectorTest, PhoneNumberParserTest,
+ValidEmailTransformerTest, LangDetectorTest, HumanNameDetectorTest,
+NameEntityRecognizerTest, SmartTextMapVectorizerTest
+(core/src/test/scala/com/salesforce/op/stages/impl/feature/).
+"""
+import base64
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.detectors import (
+    EmailToPickListMapTransformer, FilterMap, HumanNameDetector,
+    IsValidPhoneDefaultCountry, IsValidPhoneMapDefaultCountry,
+    IsValidPhoneNumber, LangDetector, MimeTypeDetector, MimeTypeMapDetector,
+    NameEntityRecognizer, ParsePhoneDefaultCountry, ParsePhoneNumber,
+    UrlMapToPickListMapTransformer, ValidEmailTransformer,
+)
+from transmogrifai_tpu.ops.map_vectorizers import SmartTextMapVectorizer
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import ColumnarDataset, FeatureColumn
+
+
+def _col(ftype, values):
+    return FeatureColumn.from_values(ftype, values)
+
+
+class TestMimeType:
+    def test_detects_common_types(self):
+        pdf = base64.b64encode(b"%PDF-1.4 whatever").decode()
+        png = base64.b64encode(b"\x89PNG\r\n\x1a\n0000").decode()
+        txt = base64.b64encode(b"hello plain text").decode()
+        col = _col(ft.Base64, [pdf, png, txt, None])
+        out = MimeTypeDetector().transform_columns(col)
+        assert out.to_list() == [
+            "application/pdf", "image/png", "text/plain", None]
+
+    def test_type_hint_short_circuits(self):
+        pdf = base64.b64encode(b"%PDF-1.4").decode()
+        col = _col(ft.Base64, [pdf])
+        out = MimeTypeDetector(type_hint="application/x-custom")
+        assert out.transform_columns(col).to_list() == ["application/x-custom"]
+
+    def test_mime_line_wrapped_base64(self):
+        wrapped = base64.encodebytes(b"%PDF-1.7 " + b"x" * 2000).decode()
+        col = _col(ft.Base64, [wrapped])
+        out = MimeTypeDetector().transform_columns(col)
+        assert out.to_list() == ["application/pdf"]
+
+    def test_map_variant(self):
+        pdf = base64.b64encode(b"%PDF-1.4").decode()
+        col = _col(ft.Base64Map, [{"a": pdf, "b": None}, {}])
+        out = MimeTypeMapDetector().transform_columns(col)
+        assert out.to_list()[0] == {"a": "application/pdf"}
+        assert out.to_list()[1] == {}
+
+
+class TestLangDetector:
+    def test_latin_languages(self):
+        col = _col(ft.Text, [
+            "the quick brown fox jumps over the lazy dog and it was good",
+            "le chat est sur la table et il est dans la maison pour le jour",
+            None,
+        ])
+        out = LangDetector().transform_columns(col).to_list()
+        assert max(out[0], key=out[0].get) == "en"
+        assert max(out[1], key=out[1].get) == "fr"
+        assert out[2] == {}
+
+    def test_scripts(self):
+        col = _col(ft.Text, ["Привет как дела", "こんにちは世界", "مرحبا بالعالم"])
+        out = LangDetector().transform_columns(col).to_list()
+        assert max(out[0], key=out[0].get) == "ru"
+        assert max(out[1], key=out[1].get) == "ja"
+        assert max(out[2], key=out[2].get) == "ar"
+
+
+class TestPhone:
+    def test_valid_default_country(self):
+        col = _col(ft.Phone, ["(555) 234-1234", "555-234-1234", "1234", None])
+        out = IsValidPhoneDefaultCountry().transform_columns(col)
+        assert out.to_list() == [True, True, False, None]
+
+    def test_parse_e164(self):
+        col = _col(ft.Phone, ["(555) 234-1234", "+447911123456", "bad"])
+        out = ParsePhoneDefaultCountry().transform_columns(col)
+        assert out.to_list() == ["+15552341234", "+447911123456", None]
+
+    def test_nanp_rules(self):
+        # area code starting with 1 is invalid in NANP
+        col = _col(ft.Phone, ["155-234-1234"])
+        assert IsValidPhoneDefaultCountry().transform_columns(col).to_list() \
+            == [False]
+
+    def test_binary_region_arg(self):
+        phone = _col(ft.Phone, ["01 42 68 53 00", "(555) 234-1234"])
+        region = _col(ft.Text, ["FRANCE", "UNITED STATES"])
+        out = IsValidPhoneNumber().transform_columns(phone, region)
+        assert out.to_list() == [True, True]
+        parsed = ParsePhoneNumber().transform_columns(phone, region)
+        assert parsed.to_list()[0] == "+33142685300"
+
+    def test_phone_map(self):
+        col = _col(ft.PhoneMap, [{"home": "555-234-1234", "bad": "12"}])
+        out = IsValidPhoneMapDefaultCountry().transform_columns(col)
+        assert out.to_list() == [{"home": True, "bad": False}]
+
+
+class TestEmailUrl:
+    def test_valid_email(self):
+        col = _col(ft.Email, ["a@b.com", "not-an-email", "x@y", None])
+        out = ValidEmailTransformer().transform_columns(col)
+        assert out.to_list() == [True, False, False, None]
+
+    def test_email_map_domains(self):
+        col = _col(ft.EmailMap, [{"w": "jo@Example.COM", "bad": "nope"}])
+        out = EmailToPickListMapTransformer().transform_columns(col)
+        assert out.to_list() == [{"w": "example.com"}]
+
+    def test_url_map_hosts(self):
+        col = _col(ft.URLMap, [
+            {"a": "https://Sub.Example.com/path?q=1", "b": "example.org/x",
+             "c": "example.org/x?next=//other"}])
+        out = UrlMapToPickListMapTransformer().transform_columns(col)
+        assert out.to_list() == [{"a": "sub.example.com", "b": "example.org",
+                                  "c": "example.org"}]
+
+
+class TestFilterMap:
+    def test_key_and_value_filters(self):
+        ds, (f,) = TestFeatureBuilder.build(
+            ("m", ft.TextMap, [{"a": "x", "b": "y", "c": "drop"}]))
+        stage = FilterMap(allow_keys=["a", "b", "c"], block_keys=["b"],
+                          block_values=["drop"])
+        stage.set_input(f)
+        out = stage.transform_columns(ds[f.name])
+        assert out.to_list() == [{"a": "x"}]
+        assert stage.get_output().ftype is ft.TextMap
+
+
+class TestHumanName:
+    def test_name_column_detected(self):
+        vals = ["Michael Jordan", "Sarah Connor", "James T Kirk",
+                "Maria Garcia", None]
+        ds, (f,) = TestFeatureBuilder.build(("n", ft.Text, vals))
+        col = ds[f.name]
+        est = HumanNameDetector(threshold=0.5)
+        est.set_input(f)
+        model = est.fit(ds)
+        assert model.treat_as_name
+        assert est.metadata["name_fraction"] == 1.0
+        out = model.transform_columns(col).to_list()
+        assert out[0]["IsName"] == "true"
+        assert out[0]["FirstName"] == "Michael"
+        assert out[0]["LastName"] == "Jordan"
+        assert out[0]["Gender"] == "Male"
+        assert out[3]["Gender"] == "Female"
+        assert out[4] == {}
+
+    def test_non_name_column(self):
+        vals = ["the total is 42 dollars", "shipping delayed again",
+                "ok", "asdf qwer zxcv uiop"]
+        col = _col(ft.Text, vals)
+        model = HumanNameDetector(threshold=0.5).fit_columns(
+            ColumnarDataset({"n": col}), col)
+        assert not model.treat_as_name
+        assert model.transform_columns(col).to_list() == [{}] * 4
+
+    def test_ner_tags_person(self):
+        col = _col(ft.Text, ["I met Sarah Connor at the station", None])
+        out = NameEntityRecognizer().transform_columns(col).to_list()
+        assert out[0].get("Sarah") == frozenset({"Person"})
+        assert out[0].get("Connor") == frozenset({"Person"})
+        assert out[1] == {}
+
+
+class TestSmartTextMapVectorizer:
+    def test_pivot_hash_ignore_per_key(self):
+        n = 40
+        maps = []
+        for i in range(n):
+            maps.append({
+                "cat": "a" if i % 2 == 0 else "b",      # low card -> pivot
+                "freeform": f"unique text value {i}",   # high card -> hash
+                # "empty" never present -> ignored
+            })
+        ds, (f,) = TestFeatureBuilder.build(("m", ft.TextMap, maps))
+        est = SmartTextMapVectorizer(max_cardinality=10, top_k=5,
+                                     min_support=2, num_hash_features=16)
+        est.set_input(f)
+        model = est.fit_columns(ds, ds[f.name])
+        model.set_input(f)
+        strat = est.metadata["text_strategies"]["m"]
+        assert strat["cat"] == "pivot"
+        assert strat["freeform"] == "hash"
+        out = model.transform_columns(ds[f.name])
+        arr = np.asarray(out.values)
+        # pivot block: a, b, OTHER + null  -> 4; hash block: 16 + null -> 17
+        assert arr.shape == (n, 4 + 17)
+        groupings = {c.grouping for c in out.vmeta.columns}
+        assert groupings == {"cat", "freeform"}
+
+    def test_roundtrip_persistence(self):
+        from transmogrifai_tpu.workflow.persistence import (
+            _ArrayStore, _load_stage, _stage_record,
+        )
+        maps = [{"k": "v%d" % (i % 3)} for i in range(30)]
+        ds, (f,) = TestFeatureBuilder.build(("m", ft.TextMap, maps))
+        est = SmartTextMapVectorizer(min_support=1)
+        model = est.fit_columns(ds, ds[f.name])
+        model.set_input(f)
+        expected = np.asarray(model.transform_columns(ds[f.name]).values)
+        store = _ArrayStore()
+        clone = _load_stage(_stage_record(model, store), store.arrays)
+        clone.set_input(f)
+        got = np.asarray(clone.transform_columns(ds[f.name]).values)
+        np.testing.assert_allclose(got, expected)

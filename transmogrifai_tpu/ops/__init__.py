@@ -6,3 +6,14 @@ from .vectorizers import (  # noqa: F401
     VectorsCombiner,
 )
 from .date_geo import DateToUnitCircleVectorizer, GeolocationVectorizer  # noqa: F401
+from .map_vectorizers import (  # noqa: F401
+    NumericMapVectorizer, TextMapPivotVectorizer, MultiPickListMapVectorizer,
+    SmartTextMapVectorizer, GeoMapVectorizer,
+)
+from .detectors import (  # noqa: F401
+    MimeTypeDetector, MimeTypeMapDetector, LangDetector,
+    ParsePhoneNumber, ParsePhoneDefaultCountry, IsValidPhoneNumber,
+    IsValidPhoneDefaultCountry, IsValidPhoneMapDefaultCountry,
+    ValidEmailTransformer, HumanNameDetector, NameEntityRecognizer,
+    EmailToPickListMapTransformer, UrlMapToPickListMapTransformer, FilterMap,
+)

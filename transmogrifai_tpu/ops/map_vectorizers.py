@@ -28,6 +28,7 @@ from .vectorizers import _vec_column
 __all__ = ["NumericMapVectorizer", "NumericMapVectorizerModel",
            "TextMapPivotVectorizer", "TextMapPivotVectorizerModel",
            "MultiPickListMapVectorizer", "MultiPickListMapVectorizerModel",
+           "SmartTextMapVectorizer", "SmartTextMapVectorizerModel",
            "transmogrify_map_group"]
 
 
@@ -267,7 +268,8 @@ def transmogrify_map_group(feats: List[Feature], top_k: int, min_support: int,
         s.set_input(*numeric)
         out.append(s.get_output())
     if text:
-        s = TextMapPivotVectorizer(top_k=top_k, min_support=min_support,
+        s = SmartTextMapVectorizer(top_k=top_k, min_support=min_support,
+                                   num_hash_features=num_hash_features,
                                    track_nulls=track_nulls)
         s.set_input(*text)
         out.append(s.get_output())
@@ -335,3 +337,140 @@ class GeoMapVectorizerModel(SequenceModel):
         return _vec_column(np.concatenate(parts, axis=1) if parts
                            else np.zeros((n, 0), np.float32),
                            VectorMetadata("geo_map_vec", meta))
+
+
+# ---------------------------------------------------------------------------
+# SmartTextMapVectorizer
+# ---------------------------------------------------------------------------
+
+class SmartTextMapVectorizer(SequenceEstimator):
+    """Per-key cardinality-driven text strategy for TextMap-family features.
+
+    Reference ``SmartTextMapVectorizer`` (core/.../impl/feature/
+    SmartTextMapVectorizer.scala) — the map analogue of SmartTextVectorizer:
+    computes ``TextStats`` per (map feature, key), then per key picks
+    categorical pivot (cardinality <= max_cardinality), murmur3 hashing, or
+    ignore (fill rate below min_fill_rate); emits per-key null indicators.
+    """
+
+    PIVOT, HASH, IGNORE = "pivot", "hash", "ignore"
+
+    def __init__(self, max_cardinality: int = 100, top_k: int = 20,
+                 min_support: int = 10, num_hash_features: int = 512,
+                 min_fill_rate: float = 0.001, track_nulls: bool = True,
+                 seed: int = 42,
+                 allow_keys: Optional[List[str]] = None,
+                 block_keys: List[str] = (), uid: Optional[str] = None):
+        super().__init__(operation_name="smartTxtMapVec",
+                         output_type=OPVector, uid=uid)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_hash_features = num_hash_features
+        self.min_fill_rate = min_fill_rate
+        self.track_nulls = track_nulls
+        self.seed = seed
+        self.allow_keys = list(allow_keys) if allow_keys else None
+        self.block_keys = list(block_keys)
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        from .vectorizers import TextStats
+        keysets, strategies, vocabs = [], [], []
+        for c in cols:
+            keys = _discover_keys(c, self.allow_keys, self.block_keys)
+            keysets.append(keys)
+            strat: Dict[str, str] = {}
+            vocab: Dict[str, List[str]] = {}
+            n = len(c)
+            for k in keys:
+                stats = TextStats(self.max_cardinality)
+                for m in c.values:
+                    v = m.get(k) if m else None
+                    stats.update(None if v is None else str(v))
+                fill = (stats.n - stats.n_null) / max(n, 1)
+                if fill < self.min_fill_rate:
+                    strat[k] = self.IGNORE
+                    vocab[k] = []
+                elif (not stats.saturated
+                      and stats.cardinality <= self.max_cardinality):
+                    strat[k] = self.PIVOT
+                    vocab[k] = [
+                        v for v, cnt in stats.value_counts.most_common(self.top_k)
+                        if cnt >= self.min_support
+                    ]
+                else:
+                    strat[k] = self.HASH
+                    vocab[k] = []
+            strategies.append(strat)
+            vocabs.append(vocab)
+        self.metadata["text_strategies"] = {
+            f.name: s for f, s in zip(self.input_features, strategies)}
+        return SmartTextMapVectorizerModel(
+            keysets=keysets, strategies=strategies, vocabs=vocabs,
+            num_hash_features=self.num_hash_features,
+            track_nulls=self.track_nulls, seed=self.seed)
+
+
+class SmartTextMapVectorizerModel(SequenceModel):
+    def __init__(self, keysets: List[List[str]],
+                 strategies: List[Dict[str, str]],
+                 vocabs: List[Dict[str, List[str]]],
+                 num_hash_features: int = 512, track_nulls: bool = True,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(operation_name="smartTxtMapVec",
+                         output_type=OPVector, uid=uid)
+        self.keysets = keysets
+        self.strategies = strategies
+        self.vocabs = vocabs
+        self.num_hash_features = num_hash_features
+        self.track_nulls = track_nulls
+        self.seed = seed
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        from .vectorizers import _hash_rows
+        n = len(cols[0])
+        nf = self.num_hash_features
+        parts, meta = [], []
+        for f, keys, strat, kv, c in zip(self.input_features, self.keysets,
+                                         self.strategies, self.vocabs, cols):
+            tname = f.ftype.type_name()
+            for k in keys:
+                s = strat.get(k, SmartTextMapVectorizer.IGNORE)
+                if s == SmartTextMapVectorizer.IGNORE:
+                    continue
+                key_vals = [m.get(k) if m else None for m in c.values]
+                key_vals = [None if v is None else str(v) for v in key_vals]
+                if s == SmartTextMapVectorizer.PIVOT:
+                    vocab = kv.get(k, [])
+                    index = {v: i for i, v in enumerate(vocab)}
+                    block = np.zeros((n, len(vocab) + 1), dtype=np.float32)
+                    for row, v in enumerate(key_vals):
+                        if v is None:
+                            continue
+                        j = index.get(v)
+                        block[row, len(vocab) if j is None else j] = 1.0
+                    parts.append(block)
+                    for v in vocab:
+                        meta.append(VectorColumnMetadata(
+                            f.name, tname, grouping=k, indicator_value=v))
+                    meta.append(VectorColumnMetadata(
+                        f.name, tname, grouping=k,
+                        indicator_value=OTHER_INDICATOR))
+                elif s == SmartTextMapVectorizer.HASH:
+                    block = np.zeros((n, nf), dtype=np.float32)
+                    _hash_rows(key_vals, block, 0, nf, self.seed)
+                    parts.append(block)
+                    for b in range(nf):
+                        meta.append(VectorColumnMetadata(
+                            f.name, tname, grouping=k,
+                            descriptor_value=f"hash_{b}"))
+                if self.track_nulls:
+                    nulls = np.array([v is None for v in key_vals],
+                                     dtype=np.float32)[:, None]
+                    parts.append(nulls)
+                    meta.append(VectorColumnMetadata(
+                        f.name, tname, grouping=k,
+                        indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1) if parts
+                           else np.zeros((n, 0), np.float32),
+                           VectorMetadata("smart_text_map_vec", meta))
