@@ -131,8 +131,20 @@ class LogisticRegressionModel(PredictorModel):
         self.intercept = intercept
 
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
+        from .. import native
         coef = jnp.asarray(self.coef, jnp.float32)
         if coef.ndim == 1:
+            # small-batch serving: native C kernel skips JAX dispatch latency
+            if native.AVAILABLE and len(X) <= 4096:
+                beta = np.append(np.asarray(self.coef, np.float32),
+                                 np.float32(self.intercept))
+                z = native.linear_margin(np.asarray(X, np.float32), beta)
+                p1 = native.sigmoid(z)
+                proba = np.stack([1.0 - p1, p1], axis=1)
+                return PredictionBatch(
+                    prediction=(p1 >= 0.5).astype(np.float64),
+                    raw_prediction=np.stack([-z, z], axis=1),
+                    probability=proba)
             proba, raw = logreg_predict_proba(
                 coef, jnp.float32(self.intercept), X)
             proba = np.asarray(proba)
@@ -187,8 +199,14 @@ class LinearSVCModel(PredictorModel):
         self.intercept = intercept
 
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
-        z = np.asarray(svc_decision(jnp.asarray(self.coef, jnp.float32),
-                                    jnp.float32(self.intercept), X))
+        from .. import native
+        if native.AVAILABLE and len(X) <= 4096:
+            beta = np.append(np.asarray(self.coef, np.float32),
+                             np.float32(self.intercept))
+            z = native.linear_margin(np.asarray(X, np.float32), beta)
+        else:
+            z = np.asarray(svc_decision(jnp.asarray(self.coef, jnp.float32),
+                                        jnp.float32(self.intercept), X))
         raw = np.stack([-z, z], axis=1)
         return PredictionBatch(prediction=(z >= 0).astype(np.float64),
                                raw_prediction=raw)

@@ -65,9 +65,15 @@ class LinearRegressionModel(PredictorModel):
         self.intercept = intercept
 
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
-        pred = np.asarray(linear_predict(
-            jnp.asarray(self.coef, jnp.float32),
-            jnp.float32(self.intercept), X))
+        from .. import native
+        if native.AVAILABLE and len(X) <= 4096:
+            beta = np.append(np.asarray(self.coef, np.float32),
+                             np.float32(self.intercept))
+            pred = native.linear_margin(np.asarray(X, np.float32), beta)
+        else:
+            pred = np.asarray(linear_predict(
+                jnp.asarray(self.coef, jnp.float32),
+                jnp.float32(self.intercept), X))
         return PredictionBatch(prediction=pred.astype(np.float64))
 
 

@@ -1,0 +1,253 @@
+// tmog_native — native runtime kernels for the host-side paths of
+// transmogrifai_tpu.
+//
+// Parity rationale (SURVEY §2.11): the reference's only native components
+// are the XGBoost C++ core (tree-ensemble training/eval behind
+// OpXGBoostClassifier/Regressor via JNI) and the in-tree Java
+// StreamingHistogram (utils/.../stats/StreamingHistogram.java:36) used for
+// raw-feature profiling.  The TPU build keeps tree *training* on device
+// (JAX/XLA, models/gbdt_kernels.py) and makes the serving/profiling paths
+// native:
+//   * batched tree-ensemble + linear scoring (the local/ Spark-free scorer's
+//     hot loop — reference uses MLeap on the JVM, local/MLeapModelConverter
+//     .scala:40)
+//   * feature binning (quantile-sketch application)
+//   * Ben-Haim/Tom-Tov streaming histogram (RawFeatureFilter profiling)
+//
+// Data layouts match models/gbdt_kernels.py exactly so fitted arrays are
+// shared with the device path with no conversion:
+//   binned  (N, D)   int32   bin ids in [0, B)
+//   feat    (T, 2^depth - 1) int32   heap-indexed internal nodes
+//   thresh  (T, 2^depth - 1) int32
+//   leaf    (T, 2^depth, K)  float32
+// Routing rule per level (predict_tree, gbdt_kernels.py:289-305):
+//   node <- 2*node + (binned[row, feat[heap]] > thresh[heap])
+//
+// Plain C ABI (ctypes-consumed; no pybind11 in this environment).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Tree-ensemble scoring
+// ---------------------------------------------------------------------------
+
+static void predict_rows(const int32_t* binned, int64_t row0, int64_t row1,
+                         int64_t d, const int32_t* feat, const int32_t* thresh,
+                         const float* leaf, int64_t n_trees, int depth,
+                         int64_t k, float* out) {
+  const int64_t n_internal = (int64_t(1) << depth) - 1;
+  const int64_t n_leaves = int64_t(1) << depth;
+  for (int64_t r = row0; r < row1; ++r) {
+    const int32_t* xrow = binned + r * d;
+    float* orow = out + r * k;
+    for (int64_t t = 0; t < n_trees; ++t) {
+      const int32_t* tf = feat + t * n_internal;
+      const int32_t* tt = thresh + t * n_internal;
+      int64_t node = 0;
+      for (int l = 0; l < depth; ++l) {
+        const int64_t heap = (int64_t(1) << l) - 1 + node;
+        node = 2 * node + (xrow[tf[heap]] > tt[heap] ? 1 : 0);
+      }
+      const float* lf = leaf + (t * n_leaves + node) * k;
+      for (int64_t c = 0; c < k; ++c) orow[c] += lf[c];
+    }
+  }
+}
+
+// out (N, K) must be zero-initialised by the caller.
+void tmog_predict_ensemble(const int32_t* binned, int64_t n, int64_t d,
+                           const int32_t* feat, const int32_t* thresh,
+                           const float* leaf, int64_t n_trees, int32_t depth,
+                           int64_t k, float* out, int32_t n_threads) {
+  if (n_threads <= 1 || n < 4096) {
+    predict_rows(binned, 0, n, d, feat, thresh, leaf, n_trees, depth, k, out);
+    return;
+  }
+  std::vector<std::thread> pool;
+  const int64_t block = (n + n_threads - 1) / n_threads;
+  for (int32_t i = 0; i < n_threads; ++i) {
+    const int64_t lo = i * block, hi = std::min(n, lo + block);
+    if (lo >= hi) break;
+    pool.emplace_back(predict_rows, binned, lo, hi, d, feat, thresh, leaf,
+                      n_trees, depth, k, out);
+  }
+  for (auto& th : pool) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// Binning (apply_bins parity: bin = #edges with x > edge; +inf edges unused)
+// ---------------------------------------------------------------------------
+
+void tmog_apply_bins(const float* X, int64_t n, int64_t d, const float* edges,
+                     int32_t n_edges, int32_t* out) {
+  for (int64_t r = 0; r < n; ++r) {
+    const float* xrow = X + r * d;
+    int32_t* orow = out + r * d;
+    for (int64_t j = 0; j < d; ++j) {
+      const float* e = edges + j * n_edges;
+      const float x = xrow[j];
+      int32_t b = 0;
+      for (int32_t q = 0; q < n_edges; ++q) b += (x > e[q]) ? 1 : 0;
+      orow[j] = b;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Linear / logistic scoring
+// ---------------------------------------------------------------------------
+
+// margin[i] = X[i] . beta[0:d] + beta[d]
+void tmog_linear_margin(const float* X, int64_t n, int64_t d,
+                        const float* beta, float* out) {
+  for (int64_t r = 0; r < n; ++r) {
+    const float* xrow = X + r * d;
+    double acc = beta[d];
+    for (int64_t j = 0; j < d; ++j) acc += double(xrow[j]) * beta[j];
+    out[r] = float(acc);
+  }
+}
+
+void tmog_sigmoid(const float* x, int64_t n, float* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = 1.0f / (1.0f + std::exp(-x[i]));
+}
+
+// row-wise softmax over (N, K)
+void tmog_softmax(const float* x, int64_t n, int64_t k, float* out) {
+  for (int64_t r = 0; r < n; ++r) {
+    const float* xr = x + r * k;
+    float* orow = out + r * k;
+    float m = xr[0];
+    for (int64_t c = 1; c < k; ++c) m = std::max(m, xr[c]);
+    double s = 0;
+    for (int64_t c = 0; c < k; ++c) {
+      orow[c] = std::exp(xr[c] - m);
+      s += orow[c];
+    }
+    for (int64_t c = 0; c < k; ++c) orow[c] = float(orow[c] / s);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Ben-Haim / Tom-Tov streaming histogram
+// (StreamingHistogram.java:36,120-280 behavioral parity: bounded bins,
+//  count-weighted centroid merge of the closest adjacent pair, trapezoidal
+//  cumulative sum)
+// ---------------------------------------------------------------------------
+
+struct TmogHist {
+  int32_t max_bins;
+  std::vector<double> centers;
+  std::vector<double> counts;
+};
+
+void* tmog_hist_new(int32_t max_bins) {
+  auto* h = new TmogHist();
+  h->max_bins = max_bins < 2 ? 2 : max_bins;
+  return h;
+}
+
+void tmog_hist_free(void* hp) { delete static_cast<TmogHist*>(hp); }
+
+static void hist_insert_sorted(TmogHist* h, double c, double cnt) {
+  auto it = std::lower_bound(h->centers.begin(), h->centers.end(), c);
+  const size_t idx = size_t(it - h->centers.begin());
+  if (it != h->centers.end() && *it == c) {
+    h->counts[idx] += cnt;
+    return;
+  }
+  h->centers.insert(it, c);
+  h->counts.insert(h->counts.begin() + idx, cnt);
+}
+
+static void hist_shrink(TmogHist* h) {
+  while (int32_t(h->centers.size()) > h->max_bins) {
+    // merge the closest adjacent pair (count-weighted mean)
+    size_t best = 0;
+    double best_gap = h->centers[1] - h->centers[0];
+    for (size_t i = 1; i + 1 < h->centers.size(); ++i) {
+      const double gap = h->centers[i + 1] - h->centers[i];
+      if (gap < best_gap) {
+        best_gap = gap;
+        best = i;
+      }
+    }
+    const double c1 = h->counts[best], c2 = h->counts[best + 1];
+    h->centers[best] = (h->centers[best] * c1 + h->centers[best + 1] * c2) /
+                       (c1 + c2);
+    h->counts[best] = c1 + c2;
+    h->centers.erase(h->centers.begin() + best + 1);
+    h->counts.erase(h->counts.begin() + best + 1);
+  }
+}
+
+// bulk-load weighted bins (seeding from an existing histogram state);
+// caller must hold counts conservation — no shrink until the next update
+void tmog_hist_load(void* hp, const double* centers, const double* counts,
+                    int64_t n) {
+  auto* h = static_cast<TmogHist*>(hp);
+  for (int64_t i = 0; i < n; ++i)
+    hist_insert_sorted(h, centers[i], counts[i]);
+  hist_shrink(h);
+}
+
+void tmog_hist_update(void* hp, const double* xs, int64_t n) {
+  auto* h = static_cast<TmogHist*>(hp);
+  for (int64_t i = 0; i < n; ++i) {
+    if (!std::isfinite(xs[i])) continue;
+    hist_insert_sorted(h, xs[i], 1.0);
+    hist_shrink(h);
+  }
+}
+
+void tmog_hist_merge(void* ap, const void* bp) {
+  auto* a = static_cast<TmogHist*>(ap);
+  const auto* b = static_cast<const TmogHist*>(bp);
+  for (size_t i = 0; i < b->centers.size(); ++i)
+    hist_insert_sorted(a, b->centers[i], b->counts[i]);
+  hist_shrink(a);
+}
+
+int32_t tmog_hist_size(const void* hp) {
+  return int32_t(static_cast<const TmogHist*>(hp)->centers.size());
+}
+
+void tmog_hist_get(const void* hp, double* centers, double* counts) {
+  const auto* h = static_cast<const TmogHist*>(hp);
+  std::memcpy(centers, h->centers.data(), h->centers.size() * sizeof(double));
+  std::memcpy(counts, h->counts.data(), h->counts.size() * sizeof(double));
+}
+
+// estimated number of points <= x (trapezoidal interpolation, the Java
+// sum() at StreamingHistogram.java:200-240)
+double tmog_hist_sum(const void* hp, double x) {
+  const auto* h = static_cast<const TmogHist*>(hp);
+  const auto& p = h->centers;
+  const auto& m = h->counts;
+  const size_t nb = p.size();
+  if (nb == 0) return 0.0;
+  if (x < p.front()) return 0.0;
+  if (x >= p.back()) {
+    double s = 0;
+    for (double c : m) s += c;
+    return s;
+  }
+  size_t i = size_t(std::upper_bound(p.begin(), p.end(), x) - p.begin()) - 1;
+  double s = 0;
+  for (size_t j = 0; j < i; ++j) s += m[j];
+  s += m[i] / 2.0;
+  const double pi = p[i], pj = p[i + 1], mi = m[i], mj = m[i + 1];
+  const double frac = (x - pi) / (pj - pi);
+  const double mx = mi + (mj - mi) * frac;
+  s += (mi + mx) * frac / 2.0;
+  return s;
+}
+
+}  // extern "C"

@@ -109,6 +109,7 @@ def _sniff_mime(raw: bytes) -> str:
 
 
 _B64_WS_RE = re.compile(r"\s+")
+_B64_ALPHABET_RE = re.compile(r"[A-Za-z0-9+/]+={0,2}")
 
 
 def _detect_mime(v: Optional[str], type_hint: str,
@@ -122,9 +123,13 @@ def _detect_mime(v: Optional[str], type_hint: str,
     n_chars = ((max_bytes_to_parse + 2) // 3) * 4
     chunk = _B64_WS_RE.sub("", v[: n_chars * 2])[:n_chars]
     chunk = chunk[: len(chunk) - len(chunk) % 4]
+    if not chunk or not _B64_ALPHABET_RE.fullmatch(chunk):
+        return None
     try:
-        raw = base64.b64decode(chunk, validate=False)
+        raw = base64.b64decode(chunk, validate=True)
     except (binascii.Error, ValueError):
+        return None
+    if not raw:
         return None
     return _sniff_mime(raw[:max_bytes_to_parse])
 
@@ -245,21 +250,34 @@ class LangDetector(UnaryTransformer):
                     script_hits[lang] = script_hits.get(lang, 0) + 1
                     break
         if script_hits:
-            total = sum(script_hits.values())
             # Japanese text mixes kana + CJK ideographs: kana presence wins
             if "ja" in script_hits and "zh" in script_hits:
                 script_hits["ja"] += script_hits.pop("zh")
-            return {k: c / total for k, c in script_hits.items()}
-        words = [w.lower() for w in _WORD_RE.findall(v)]
-        if not words:
+        n_script = sum(script_hits.values())
+        # Latin-script languages scored by stop-word profile hit rate
+        latin_scores: Dict[str, float] = {}
+        if n_alpha:
+            words = [w.lower() for w in _WORD_RE.findall(v)]
+            for lang, profile in _LANG_PROFILES.items():
+                hits = sum(1 for w in words if w in profile)
+                if hits:
+                    latin_scores[lang] = hits / len(words)
+        # blend the two families by their share of alphabetic characters so a
+        # stray non-Latin char cannot override a mostly-Latin text
+        total_chars = n_script + n_alpha
+        lt = sum(latin_scores.values())
+        out: Dict[str, float] = {}
+        if total_chars == 0:
             return {}
-        scores = {}
-        for lang, profile in _LANG_PROFILES.items():
-            hits = sum(1 for w in words if w in profile)
-            if hits:
-                scores[lang] = hits / len(words)
-        total = sum(scores.values())
-        return {k: s / total for k, s in scores.items()} if total else {}
+        if n_script:
+            w_script = n_script / total_chars if lt else 1.0
+            for k, c in script_hits.items():
+                out[k] = w_script * c / n_script
+        if lt:
+            w_latin = 1.0 - sum(out.values()) if out else 1.0
+            for k, sc in latin_scores.items():
+                out[k] = out.get(k, 0.0) + w_latin * sc / lt
+        return out
 
     def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
         out = np.empty(len(col), dtype=object)
@@ -462,8 +480,8 @@ def _email_domain(v: Optional[str]) -> Optional[str]:
     return v.rsplit("@", 1)[1].lower() or None
 
 
-_URL_HOST_RE = re.compile(r"^(?:[a-z][a-z0-9+.-]*:)?//([^/?#:]+)",
-                          re.IGNORECASE)
+_URL_HOST_RE = re.compile(
+    r"^(?:[a-z][a-z0-9+.-]*:)?//(?:[^/?#@]*@)?([^/?#:@]+)", re.IGNORECASE)
 
 
 def _url_host(v: Optional[str]) -> Optional[str]:
@@ -529,12 +547,10 @@ class FilterMap(UnaryTransformer):
         self.block_keys = list(block_keys)
         self.block_values = list(block_values)
 
-    def set_input(self, *features):
-        # output keeps the concrete input map type
-        res = super().set_input(*features)
-        self.output_type = features[0].ftype
-        self._output_feature.ftype = features[0].ftype
-        return res
+    def on_set_input(self) -> None:
+        # output keeps the concrete input map type; this hook runs before the
+        # base class constructs the output feature from self.output_type
+        self.output_type = self.input_features[0].ftype
 
     def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
         allow = set(self.allow_keys) if self.allow_keys else None

@@ -376,17 +376,29 @@ class SmartTextMapVectorizer(SequenceEstimator):
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         from .vectorizers import TextStats
         keysets, strategies, vocabs = [], [], []
+        allow = set(self.allow_keys) if self.allow_keys else None
+        block = set(self.block_keys)
         for c in cols:
-            keys = _discover_keys(c, self.allow_keys, self.block_keys)
+            # single pass: one TextStats per key encountered (present values
+            # only; null counts derive from per-key presence vs row count)
+            n = len(c)
+            stats_by_key: Dict[str, TextStats] = {}
+            for m in c.values:
+                if not m:
+                    continue
+                for k, v in m.items():
+                    if k in block or (allow is not None and k not in allow):
+                        continue
+                    st = stats_by_key.get(k)
+                    if st is None:
+                        st = stats_by_key[k] = TextStats(self.max_cardinality)
+                    st.update(None if v is None else str(v))
+            keys = sorted(stats_by_key)
             keysets.append(keys)
             strat: Dict[str, str] = {}
             vocab: Dict[str, List[str]] = {}
-            n = len(c)
             for k in keys:
-                stats = TextStats(self.max_cardinality)
-                for m in c.values:
-                    v = m.get(k) if m else None
-                    stats.update(None if v is None else str(v))
+                stats = stats_by_key[k]
                 fill = (stats.n - stats.n_null) / max(n, 1)
                 if fill < self.min_fill_rate:
                     strat[k] = self.IGNORE
@@ -427,50 +439,74 @@ class SmartTextMapVectorizerModel(SequenceModel):
         self.seed = seed
 
     def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
-        from .vectorizers import _hash_rows
+        from .vectorizers import _row_tokens
+        from ..utils.hashing import murmur3_32
         n = len(cols[0])
         nf = self.num_hash_features
-        parts, meta = [], []
+        blocks, meta = [], []
         for f, keys, strat, kv, c in zip(self.input_features, self.keysets,
                                          self.strategies, self.vocabs, cols):
             tname = f.ftype.type_name()
+            # lay out the output block per key, then fill in ONE pass over the
+            # rows (sparse maps touch only their present keys)
+            layout: Dict[str, tuple] = {}   # key -> (strategy, offset, index)
+            width = 0
             for k in keys:
                 s = strat.get(k, SmartTextMapVectorizer.IGNORE)
                 if s == SmartTextMapVectorizer.IGNORE:
                     continue
-                key_vals = [m.get(k) if m else None for m in c.values]
-                key_vals = [None if v is None else str(v) for v in key_vals]
                 if s == SmartTextMapVectorizer.PIVOT:
                     vocab = kv.get(k, [])
                     index = {v: i for i, v in enumerate(vocab)}
-                    block = np.zeros((n, len(vocab) + 1), dtype=np.float32)
-                    for row, v in enumerate(key_vals):
-                        if v is None:
-                            continue
-                        j = index.get(v)
-                        block[row, len(vocab) if j is None else j] = 1.0
-                    parts.append(block)
+                    layout[k] = (s, width, index)
                     for v in vocab:
                         meta.append(VectorColumnMetadata(
                             f.name, tname, grouping=k, indicator_value=v))
                     meta.append(VectorColumnMetadata(
                         f.name, tname, grouping=k,
                         indicator_value=OTHER_INDICATOR))
-                elif s == SmartTextMapVectorizer.HASH:
-                    block = np.zeros((n, nf), dtype=np.float32)
-                    _hash_rows(key_vals, block, 0, nf, self.seed)
-                    parts.append(block)
+                    width += len(vocab) + 1
+                else:  # HASH
+                    layout[k] = (s, width, None)
                     for b in range(nf):
                         meta.append(VectorColumnMetadata(
                             f.name, tname, grouping=k,
                             descriptor_value=f"hash_{b}"))
+                    width += nf
                 if self.track_nulls:
-                    nulls = np.array([v is None for v in key_vals],
-                                     dtype=np.float32)[:, None]
-                    parts.append(nulls)
                     meta.append(VectorColumnMetadata(
                         f.name, tname, grouping=k,
                         indicator_value=NULL_INDICATOR))
-        return _vec_column(np.concatenate(parts, axis=1) if parts
+                    # null indicator sits right after the key's value slots
+                    layout[k] = (*layout[k][:2], layout[k][2], width)
+                    width += 1
+            block = np.zeros((n, width), dtype=np.float32)
+            if self.track_nulls:
+                for k, lay in layout.items():
+                    block[:, lay[3]] = 1.0     # default null; cleared if seen
+            hash_cache: Dict[str, int] = {}
+            for row, m in enumerate(c.values):
+                if not m:
+                    continue
+                for k, v in m.items():
+                    lay = layout.get(k)
+                    if lay is None or v is None:
+                        continue
+                    skind, off, index = lay[0], lay[1], lay[2]
+                    if self.track_nulls:
+                        block[row, lay[3]] = 0.0
+                    sv = str(v)
+                    if skind == SmartTextMapVectorizer.PIVOT:
+                        j = index.get(sv)
+                        block[row, off + (len(index) if j is None else j)] = 1.0
+                    else:
+                        for tok in _row_tokens(sv):
+                            b = hash_cache.get(tok)
+                            if b is None:
+                                b = murmur3_32(tok, self.seed) % nf
+                                hash_cache[tok] = b
+                            block[row, off + b] += 1.0
+            blocks.append(block)
+        return _vec_column(np.concatenate(blocks, axis=1) if blocks
                            else np.zeros((n, 0), np.float32),
                            VectorMetadata("smart_text_map_vec", meta))
