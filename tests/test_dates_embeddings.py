@@ -1,0 +1,255 @@
+"""Time-period transformers, DateListVectorizer, word2vec and LDA.
+
+Parity model: reference TimePeriodTransformerTest, DateListVectorizerTest,
+OpWord2VecTest, OpLDATest
+(core/src/test/scala/com/salesforce/op/stages/impl/feature/).
+"""
+import datetime as _dt
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.date_geo import (
+    DateListVectorizer, TimePeriodMapTransformer, TimePeriodTransformer,
+    extract_time_period,
+)
+from transmogrifai_tpu.ops.embeddings import (
+    OpLDA, OpLDAModel, OpWord2Vec, OpWord2VecModel,
+)
+from transmogrifai_tpu.ops.text import OpCountVectorizer
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _ms(y, mo, d, h=0, mi=0):
+    return int(_dt.datetime(y, mo, d, h, mi,
+                            tzinfo=_dt.timezone.utc).timestamp() * 1000)
+
+
+class TestTimePeriod:
+    def test_known_date(self):
+        # 2018-06-03 was a Sunday
+        ms = np.array([_ms(2018, 6, 3, 13, 0)])
+        assert extract_time_period(ms, "DayOfWeek")[0] == 7
+        assert extract_time_period(ms, "DayOfMonth")[0] == 3
+        assert extract_time_period(ms, "MonthOfYear")[0] == 6
+        assert extract_time_period(ms, "HourOfDay")[0] == 13
+        assert extract_time_period(ms, "DayOfYear")[0] == 154
+        assert extract_time_period(ms, "WeekOfMonth")[0] == 1
+        assert extract_time_period(ms, "WeekOfYear")[0] == 22
+
+    def test_epoch_and_pre_epoch(self):
+        ms = np.array([0, _ms(1969, 12, 31, 23, 0)])
+        assert extract_time_period(ms, "DayOfWeek")[0] == 4  # Thursday
+        assert extract_time_period(ms, "DayOfWeek")[1] == 3  # Wednesday
+        assert extract_time_period(ms, "HourOfDay")[1] == 23
+
+    def test_transformer_preserves_mask(self):
+        ds, (f,) = TestFeatureBuilder.build(
+            ("d", ft.Date, [_ms(2020, 2, 29), None]))
+        t = TimePeriodTransformer(period="DayOfMonth")
+        t.set_input(f)
+        out = t.transform_columns(ds[f.name])
+        assert out.ftype is ft.Integral
+        assert out.to_list() == [29, None]
+
+    def test_map_variant(self):
+        ds, (f,) = TestFeatureBuilder.build(
+            ("dm", ft.DateMap,
+             [{"a": _ms(2021, 1, 4), "b": _ms(2021, 12, 25)}, {}]))
+        t = TimePeriodMapTransformer(period="MonthOfYear")
+        t.set_input(f)
+        out = t.transform_columns(ds[f.name])
+        assert out.to_list() == [{"a": 1, "b": 12}, {}]
+
+    def test_rejects_unknown_period(self):
+        with pytest.raises(ValueError):
+            TimePeriodTransformer(period="Fortnight")
+
+    def test_map_variant_skips_none_values(self):
+        ds, (f,) = TestFeatureBuilder.build(
+            ("dm", ft.DateMap, [{"a": _ms(2021, 1, 4), "b": None}]))
+        t = TimePeriodMapTransformer(period="MonthOfYear")
+        t.set_input(f)
+        assert t.transform_columns(ds[f.name]).to_list() == [{"a": 1}]
+
+
+class TestDateListVectorizer:
+    def _ds(self):
+        lists = [
+            (_ms(2020, 1, 1), _ms(2020, 1, 11)),
+            (_ms(2020, 1, 6),),
+            (),
+        ]
+        return TestFeatureBuilder.build(("dl", ft.DateList, lists))
+
+    def test_since_first_and_last(self):
+        ds, (f,) = self._ds()
+        ref = _ms(2020, 1, 21)
+        v = DateListVectorizer(pivot="SinceFirst", reference_ms=ref)
+        v.set_input(f)
+        out = v.fit(ds).transform_columns(ds[f.name])
+        vals = np.asarray(out.values)
+        # days since first event; empty list -> fill 0 + null indicator
+        assert vals[:, 0].tolist() == [20.0, 15.0, 0.0]
+        assert vals[:, 1].tolist() == [0.0, 0.0, 1.0]
+
+        v2 = DateListVectorizer(pivot="SinceLast", reference_ms=ref)
+        v2.set_input(f)
+        out2 = v2.fit(ds).transform_columns(ds[f.name])
+        assert np.asarray(out2.values)[:, 0].tolist() == [10.0, 15.0, 0.0]
+
+    def test_default_reference_captured_at_fit(self):
+        ds, (f,) = self._ds()
+        v = DateListVectorizer(pivot="SinceLast", track_nulls=False)
+        v.set_input(f)
+        model = v.fit(ds)
+        assert model.reference_ms == _ms(2020, 1, 11)
+        vals = np.asarray(model.transform_columns(ds[f.name]).values)
+        assert vals.min() >= 0.0 and vals[0, 0] == 0.0
+        # scoring a NEW batch reuses the train-time reference: a more recent
+        # single event must still measure against the fitted reference
+        ds2, (f2,) = TestFeatureBuilder.build(
+            ("dl", ft.DateList, [(_ms(2020, 1, 9),)]))
+        vals2 = np.asarray(model.transform_columns(ds2[f2.name]).values)
+        assert vals2[0, 0] == 2.0
+
+    def test_mode_day_pivot(self):
+        # 2020-01-01 Wed, 2020-01-11 Sat, 2020-01-06 Mon
+        ds, (f,) = self._ds()
+        v = DateListVectorizer(pivot="ModeDay")
+        v.set_input(f)
+        out = v.fit(ds).transform_columns(ds[f.name])
+        vals = np.asarray(out.values)
+        assert vals.shape == (3, 8)  # 7 days + null indicator
+        assert vals[0, 2] == 1.0     # Wed (first modal day on tie)
+        assert vals[1, 0] == 1.0     # Mon
+        assert vals[2, :7].sum() == 0.0 and vals[2, 7] == 1.0
+        names = [m.indicator_value for m in out.vmeta.columns[:7]]
+        assert names == ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+
+    def test_none_events_dropped(self):
+        lists = [(_ms(2020, 1, 1), None), (None,)]
+        ds, (f,) = TestFeatureBuilder.build(("dl", ft.DateList, lists))
+        v = DateListVectorizer(pivot="SinceLast")
+        v.set_input(f)
+        model = v.fit(ds)
+        assert model.reference_ms == _ms(2020, 1, 1)
+        vals = np.asarray(model.transform_columns(ds[f.name]).values)
+        # all-None list counts as empty: fill value + null indicator
+        assert vals[1].tolist() == [0.0, 1.0]
+        assert vals[0].tolist() == [0.0, 0.0]
+
+    def test_mode_hour_and_month(self):
+        lists = [(_ms(2020, 5, 1, 9), _ms(2020, 5, 2, 9), _ms(2020, 5, 2, 14))]
+        ds, (f,) = TestFeatureBuilder.build(("dl", ft.DateTimeList, lists))
+        v = DateListVectorizer(pivot="ModeHour", track_nulls=False)
+        v.set_input(f)
+        vals = np.asarray(v.fit(ds).transform_columns(ds[f.name]).values)
+        assert vals.shape == (1, 24) and vals[0, 9] == 1.0
+        v2 = DateListVectorizer(pivot="ModeMonth", track_nulls=False)
+        v2.set_input(f)
+        vals2 = np.asarray(v2.fit(ds).transform_columns(ds[f.name]).values)
+        assert vals2.shape == (1, 12) and vals2[0, 4] == 1.0
+
+
+class TestWord2Vec:
+    DOCS = [("king", "queen", "royal"), ("king", "royal", "crown"),
+            ("cat", "dog", "pet"), ("dog", "pet", "furry"),
+            ("queen", "crown", "royal"), ("cat", "furry", "pet")] * 5
+
+    def test_fit_transform_shapes(self):
+        ds, (f,) = TestFeatureBuilder.build(("t", ft.TextList, self.DOCS))
+        est = OpWord2Vec(vector_size=8, min_count=1, max_iter=2,
+                         batch_size=64, seed=0)
+        est.set_input(f)
+        model = est.fit(ds)
+        assert isinstance(model, OpWord2VecModel)
+        assert model.vectors.shape == (8, 8)  # 8 distinct tokens
+        out = model.transform_columns(ds[f.name])
+        assert np.asarray(out.values).shape == (len(self.DOCS), 8)
+        # embedding of a doc = mean of its token vectors
+        idx = {w: i for i, w in enumerate(model.vocab)}
+        want = model.vectors[[idx[t] for t in self.DOCS[0]]].mean(axis=0)
+        np.testing.assert_allclose(np.asarray(out.values)[0], want, rtol=1e-5)
+
+    def test_embeddings_capture_cooccurrence(self):
+        ds, (f,) = TestFeatureBuilder.build(("t", ft.TextList, self.DOCS))
+        est = OpWord2Vec(vector_size=16, min_count=1, max_iter=120,
+                         step_size=0.15, batch_size=64, seed=1)
+        est.set_input(f)
+        model = est.fit(ds)
+        idx = {w: i for i, w in enumerate(model.vocab)}
+        vec = model.vectors / np.linalg.norm(model.vectors, axis=1,
+                                             keepdims=True)
+
+        def sim(a, b):
+            return float(vec[idx[a]] @ vec[idx[b]])
+
+        # words sharing contexts should be closer than cross-cluster pairs
+        assert sim("king", "queen") > sim("king", "dog")
+        assert sim("cat", "dog") > sim("cat", "crown")
+
+    def test_min_count_filters_vocab(self):
+        docs = [("rare", "common", "common"), ("common", "usual", "usual")]
+        ds, (f,) = TestFeatureBuilder.build(("t", ft.TextList, docs))
+        est = OpWord2Vec(vector_size=4, min_count=2, max_iter=1, seed=0)
+        est.set_input(f)
+        model = est.fit(ds)
+        assert "rare" not in model.vocab
+        assert set(model.vocab) == {"common", "usual"}
+
+    def test_empty_vocab(self):
+        ds, (f,) = TestFeatureBuilder.build(("t", ft.TextList, [(), ()]))
+        est = OpWord2Vec(min_count=1)
+        est.set_input(f)
+        model = est.fit(ds)
+        out = model.transform_columns(ds[f.name])
+        assert np.asarray(out.values).shape[0] == 2
+
+
+class TestLDA:
+    def _counts(self):
+        rng = np.random.default_rng(7)
+        # two clear topics over a 12-term vocabulary
+        topic_a = np.array([5, 5, 5, 5, 5, 5, 0, 0, 0, 0, 0, 0], float)
+        topic_b = topic_a[::-1].copy()
+        rows = [rng.poisson(topic_a) for _ in range(20)]
+        rows += [rng.poisson(topic_b) for _ in range(20)]
+        return np.asarray(rows, np.float64)
+
+    def test_topic_distribution(self):
+        counts = self._counts()
+        ds, (f,) = TestFeatureBuilder.build(("v", ft.OPVector, counts))
+        est = OpLDA(k=2, max_iter=30, seed=3)
+        est.set_input(f)
+        model = est.fit(ds)
+        assert isinstance(model, OpLDAModel)
+        assert model.topic_word.shape == (2, 12)
+        out = model.transform_columns(ds[f.name])
+        theta = np.asarray(out.values)
+        assert theta.shape == (40, 2)
+        np.testing.assert_allclose(theta.sum(axis=1), 1.0, atol=1e-5)
+        # docs from the same generative topic get the same argmax,
+        # docs from different topics get different ones
+        first, second = theta[:20].argmax(1), theta[20:].argmax(1)
+        assert (first == first[0]).mean() > 0.9
+        assert (second == 1 - first[0]).mean() > 0.9
+
+    def test_k_validation(self):
+        with pytest.raises(ValueError):
+            OpLDA(k=1)
+
+    def test_pipeline_from_count_vectorizer(self):
+        docs = [("apple", "banana"), ("apple", "apple"), ("car", "truck")]
+        ds, (f,) = TestFeatureBuilder.build(("t", ft.TextList, docs))
+        cv = OpCountVectorizer(min_df=1)
+        cv.set_input(f)
+        cv_model = cv.fit(ds)
+        vec = cv_model.transform_columns(ds[f.name])
+        ds2, (fv,) = TestFeatureBuilder.build(
+            ("v", ft.OPVector, np.asarray(vec.values)))
+        est = OpLDA(k=2, max_iter=5)
+        est.set_input(fv)
+        out = est.fit(ds2).transform_columns(ds2[fv.name])
+        assert np.asarray(out.values).shape == (3, 2)

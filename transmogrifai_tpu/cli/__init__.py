@@ -1,0 +1,17 @@
+"""Project-generator CLI — ``python -m transmogrifai_tpu.cli gen ...``.
+
+Reference parity: the ``op gen`` codegen tool
+(cli/src/main/scala/com/salesforce/op/cli/ — CommandParser, CliParameters,
+CliExec; gen/ProblemSchema.scala, gen/ProblemKind.scala, gen/Ops.scala,
+templates rendered into templates/simple/).  Given a sample dataset, a
+response field and an id field, it infers the ML problem kind and every
+column's semantic feature type, then generates a runnable Python project:
+feature declarations, an ``OpApp`` wiring transmogrify → SanityChecker →
+the right ModelSelector, and a smoke test.
+"""
+from .schema import ProblemKind, ProblemSchema, infer_problem_kind  # noqa: F401
+from .generator import generate_project  # noqa: F401
+from .main import main  # noqa: F401
+
+__all__ = ["ProblemKind", "ProblemSchema", "infer_problem_kind",
+           "generate_project", "main"]

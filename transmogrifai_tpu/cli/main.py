@@ -1,0 +1,72 @@
+"""CLI argument parsing and dispatch.
+
+Reference: ``cli/CommandParser.scala:82-124`` (gen command: --input, --id,
+--response, --schema/--auto, --overwrite, project name) and ``CliExec.scala``.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, Optional, Sequence
+
+from .generator import generate_project
+from .schema import ProblemKind, ProblemSchema
+
+__all__ = ["main"]
+
+
+def _parse_overrides(pairs) -> Dict[str, str]:
+    out = {}
+    for p in pairs or ():
+        if "=" not in p:
+            raise SystemExit(f"--feature-type expects col=Type, got {p!r}")
+        col, tname = p.split("=", 1)
+        out[col] = tname
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "transmogrifai_tpu", description="TransmogrifAI-TPU command line")
+    sub = p.add_subparsers(dest="command", required=True)
+    gen = sub.add_parser("gen", help="generate a new AutoML project")
+    gen.add_argument("name", help="project name (e.g. Titanic)")
+    gen.add_argument("--input", required=True,
+                     help="sample CSV/Parquet/JSONL used to infer the schema")
+    gen.add_argument("--id", required=True, dest="id_field",
+                     help="id column name")
+    gen.add_argument("--response", required=True,
+                     help="response column name")
+    gen.add_argument("--kind", choices=[k.value for k in ProblemKind],
+                     default=None,
+                     help="override the inferred problem kind")
+    gen.add_argument("--feature-type", action="append", metavar="COL=TYPE",
+                     help="override an inferred semantic type "
+                          "(e.g. Age=Real); repeatable")
+    gen.add_argument("--columns", default=None,
+                     help="comma-separated column names for headerless CSVs "
+                          "(the reference derives these from --schema)")
+    gen.add_argument("--dest", default=".", help="output directory")
+    gen.add_argument("--overwrite", action="store_true")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "gen":
+        schema = ProblemSchema.from_file(
+            args.name, args.input, args.response, args.id_field,
+            overrides=_parse_overrides(args.feature_type), kind=args.kind,
+            columns=args.columns.split(",") if args.columns else None)
+        written = generate_project(schema, args.dest,
+                                   overwrite=args.overwrite)
+        print(f"{schema.kind.value} project {schema.name!r}: "
+              f"{len(written)} files")
+        for rel in sorted(written):
+            print(f"  {written[rel]}")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""Problem-schema inference from a sample dataset.
+
+Reference: ``gen/ProblemSchema.scala:51-99`` (schema + response/id fields →
+ProblemSchema), ``gen/ProblemKind.scala:36-66`` (Binary/Multi/Regression),
+``gen/AvroField.scala`` (field → feature type).  The reference reads an Avro
+schema or asks interactively (``--auto``); here inference is automatic from a
+pandas-readable file, with the same override knobs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Type
+
+from ..features.builder import infer_schema_from_pandas
+from ..types import feature_types as ft
+
+__all__ = ["ProblemKind", "ProblemSchema", "infer_problem_kind"]
+
+
+class ProblemKind(enum.Enum):
+    BinaryClassification = "BinaryClassification"
+    MultiClassification = "MultiClassification"
+    Regression = "Regression"
+
+
+def infer_problem_kind(series) -> ProblemKind:
+    """Classify the response column (ProblemKind.scala semantics, auto mode):
+    ≤2 distinct values → binary; integers/strings with few distinct values →
+    multiclass; continuous numerics → regression."""
+    vals = series.dropna()
+    nunique = vals.nunique()
+    if nunique <= 2:
+        return ProblemKind.BinaryClassification
+    kind = vals.dtype.kind
+    if kind == "f" and (vals != vals.astype("int64", errors="ignore")).any():
+        return ProblemKind.Regression
+    if kind in ("i", "u", "f"):
+        return (ProblemKind.MultiClassification if nunique <= 30
+                else ProblemKind.Regression)
+    return ProblemKind.MultiClassification
+
+
+@dataclasses.dataclass
+class ProblemSchema:
+    """Everything codegen needs (gen/ProblemSchema.scala:51-60)."""
+
+    name: str
+    kind: ProblemKind
+    response: str
+    id_field: str
+    #: column name -> semantic feature type, response/id excluded
+    features: Dict[str, Type[ft.FeatureType]]
+    input_path: Optional[str] = None
+
+    #: column names for headerless CSVs (the reference names columns from an
+    #: Avro schema file instead — SchemaSource.scala)
+    columns: Optional[List[str]] = None
+
+    @classmethod
+    def from_file(cls, name: str, path: str, response: str, id_field: str,
+                  overrides: Optional[Dict[str, str]] = None,
+                  kind: Optional[str] = None,
+                  columns: Optional[List[str]] = None) -> "ProblemSchema":
+        import pandas as pd
+
+        if path.endswith(".parquet"):
+            df = pd.read_parquet(path)
+        elif path.endswith((".json", ".jsonl")):
+            df = pd.read_json(path, lines=path.endswith(".jsonl"))
+        elif columns:
+            df = pd.read_csv(path, header=None, names=list(columns))
+        else:
+            df = pd.read_csv(path)
+        for col in (response, id_field):
+            if col not in df.columns:
+                raise ValueError(f"column {col!r} not in {sorted(df.columns)}")
+        schema = infer_schema_from_pandas(df)
+        if overrides:
+            by_lower = {t.__name__.lower(): t for t in ft.all_feature_types()}
+            for col, tname in overrides.items():
+                try:
+                    schema[col] = by_lower[tname.replace("_", "").lower()]
+                except KeyError:
+                    raise ValueError(
+                        f"unknown feature type {tname!r} for column {col!r}")
+        features = {c: t for c, t in schema.items()
+                    if c not in (response, id_field)}
+        problem = (ProblemKind(kind) if kind
+                   else infer_problem_kind(df[response]))
+        return cls(name=name, kind=problem, response=response,
+                   id_field=id_field, features=features, input_path=path,
+                   columns=list(columns) if columns else None)
+
+    @property
+    def feature_lines(self) -> List[str]:
+        return [f'    "{c}": ft.{t.__name__},'
+                for c, t in sorted(self.features.items())]

@@ -22,6 +22,11 @@ from .ops.dsl_transformers import (
     MathScalarTransformer, NGramSimilarity, ReplaceTransformer,
     SubstringTransformer, ToOccurTransformer,
 )
+from .ops.date_geo import (
+    DateListVectorizer, DateToUnitCircleVectorizer, TimePeriodMapTransformer,
+    TimePeriodTransformer,
+)
+from .ops.embeddings import OpLDA, OpWord2Vec
 from .ops.numeric import (
     DecisionTreeNumericBucketizer, FillMissingWithMean, NumericBucketizer,
     OpScalarStandardScaler, PercentileCalibrator,
@@ -97,6 +102,14 @@ def install_dsl() -> None:
     F.hashing_tf = _unary(OpHashingTF)
     F.index_string = _unary(OpStringIndexer)
     F.text_len = _unary(TextLenTransformer)
+    F.word2vec = _unary(OpWord2Vec)
+    F.lda = _unary(OpLDA)
+    # dates (RichDateFeature: toUnitCircle, toTimePeriod; RichListFeature
+    # vectorize for DateList)
+    F.to_unit_circle = _unary(DateToUnitCircleVectorizer)
+    F.to_time_period = _unary(TimePeriodTransformer)
+    F.map_to_time_period = _unary(TimePeriodMapTransformer)
+    F.vectorize_date_list = _unary(DateListVectorizer)
     # numeric
     F.bucketize = _unary(NumericBucketizer)
     F.auto_bucketize = (

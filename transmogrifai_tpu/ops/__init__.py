@@ -5,7 +5,11 @@ from .vectorizers import (  # noqa: F401
     TextHashingVectorizer, SmartTextVectorizer, MultiPickListVectorizer,
     VectorsCombiner,
 )
-from .date_geo import DateToUnitCircleVectorizer, GeolocationVectorizer  # noqa: F401
+from .date_geo import (  # noqa: F401
+    DateToUnitCircleVectorizer, GeolocationVectorizer, DateListVectorizer,
+    TimePeriodTransformer, TimePeriodMapTransformer, extract_time_period,
+)
+from .embeddings import OpWord2Vec, OpWord2VecModel, OpLDA, OpLDAModel  # noqa: F401
 from .map_vectorizers import (  # noqa: F401
     NumericMapVectorizer, TextMapPivotVectorizer, MultiPickListMapVectorizer,
     SmartTextMapVectorizer, GeoMapVectorizer,

@@ -2,7 +2,10 @@
 
 Reference: ``DateToUnitCircleTransformer`` (impl/feature/DateToUnitCircleTransformer.scala)
 — projects a timestamp onto sin/cos of the chosen period(s) so cyclic time is
-linearly separable; ``DateListVectorizer`` modes; ``GeolocationVectorizer``
+linearly separable; ``TimePeriodTransformer`` (impl/feature/TimePeriodTransformer.scala)
+and ``TimePeriodMapTransformer`` — extract a calendar period as an integer;
+``DateListVectorizer`` (impl/feature/DateListVectorizer.scala) — SinceFirst/
+SinceLast/ModeDay/ModeMonth/ModeHour pivots; ``GeolocationVectorizer``
 (impl/feature/GeolocationVectorizer.scala) — fill with mean coordinates +
 null indicator.
 """
@@ -13,14 +16,18 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from ..stages.base import SequenceEstimator, SequenceModel, SequenceTransformer
+from ..stages.base import (
+    SequenceEstimator, SequenceModel, SequenceTransformer, UnaryTransformer,
+)
 from ..types.columns import ColumnarDataset, FeatureColumn
-from ..types.feature_types import OPVector
+from ..types.feature_types import Integral, IntegralMap, OPVector
 from .vector_metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
 from .vectorizers import _vec_column
 
 __all__ = ["DateToUnitCircleVectorizer", "GeolocationVectorizer",
-           "GeolocationVectorizerModel", "TIME_PERIODS"]
+           "GeolocationVectorizerModel", "TIME_PERIODS", "TIME_PERIOD_NAMES",
+           "extract_time_period", "TimePeriodTransformer",
+           "TimePeriodMapTransformer", "DateListVectorizer"]
 
 _MS_PER_DAY = 86400000.0
 # period name -> ms wavelength
@@ -30,6 +37,186 @@ TIME_PERIODS = {
     "DayOfMonth": _MS_PER_DAY * 30.4375,
     "DayOfYear": _MS_PER_DAY * 365.25,
 }
+
+
+TIME_PERIOD_NAMES = ("DayOfMonth", "DayOfWeek", "DayOfYear", "HourOfDay",
+                     "MonthOfYear", "WeekOfMonth", "WeekOfYear")
+
+
+def extract_time_period(ms: np.ndarray, period: str) -> np.ndarray:
+    """Vectorized calendar-period extraction from epoch-millisecond arrays.
+
+    Mirrors the reference's ``TimePeriod`` enum
+    (features/.../impl/feature/TimePeriod.scala:54-60): DayOfMonth 1-31,
+    DayOfWeek ISO 1-7 (Mon=1), DayOfYear 1-366, HourOfDay 0-23, MonthOfYear
+    1-12, WeekOfMonth 1-6, WeekOfYear 1-53.  Weeks are aligned to the first
+    day of the month/year (the reference delegates to locale-dependent Java
+    ``WeekFields``; this framework pins the locale-free alignment so results
+    are reproducible across hosts).
+    """
+    ms = np.asarray(ms, dtype=np.int64)
+    dt = ms.astype("datetime64[ms]")
+    days = dt.astype("datetime64[D]")
+    if period == "DayOfWeek":
+        return (days.astype(np.int64) + 3) % 7 + 1  # 1970-01-01 = Thursday
+    if period == "HourOfDay":
+        return (ms // 3_600_000) % 24
+    if period == "MonthOfYear":
+        return dt.astype("datetime64[M]").astype(np.int64) % 12 + 1
+    dom = (days - dt.astype("datetime64[M]").astype("datetime64[D]")
+           ).astype(np.int64) + 1
+    if period == "DayOfMonth":
+        return dom
+    if period == "WeekOfMonth":
+        return (dom - 1) // 7 + 1
+    doy = (days - dt.astype("datetime64[Y]").astype("datetime64[D]")
+           ).astype(np.int64) + 1
+    if period == "DayOfYear":
+        return doy
+    if period == "WeekOfYear":
+        return (doy - 1) // 7 + 1
+    raise ValueError(f"unknown time period {period!r}; "
+                     f"one of {TIME_PERIOD_NAMES}")
+
+
+class TimePeriodTransformer(UnaryTransformer):
+    """Date -> Integral calendar period (TimePeriodTransformer.scala:46-56)."""
+
+    def __init__(self, period: str = "HourOfDay", uid: Optional[str] = None):
+        super().__init__(operation_name="dateToTimePeriod",
+                         output_type=Integral, uid=uid)
+        if period not in TIME_PERIOD_NAMES:
+            raise ValueError(f"unknown time period {period!r}")
+        self.period = period
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        mask = np.asarray(col.mask)
+        ms = np.nan_to_num(np.asarray(col.values, dtype=np.float64))
+        out = extract_time_period(ms.astype(np.int64), self.period)
+        return FeatureColumn(Integral, out.astype(np.float64), mask.copy())
+
+
+class TimePeriodMapTransformer(UnaryTransformer):
+    """DateMap -> IntegralMap of the period per key
+    (TimePeriodMapTransformer.scala:53-56)."""
+
+    def __init__(self, period: str = "HourOfDay", uid: Optional[str] = None):
+        super().__init__(operation_name="dateMapToTimePeriod",
+                         output_type=IntegralMap, uid=uid)
+        if period not in TIME_PERIOD_NAMES:
+            raise ValueError(f"unknown time period {period!r}")
+        self.period = period
+
+    def transform_columns(self, col: FeatureColumn) -> FeatureColumn:
+        out = np.empty(len(col), dtype=object)
+        for i, d in enumerate(col.values):
+            keys = [k for k, ms in (d or {}).items() if ms is not None]
+            vals = extract_time_period(
+                np.asarray([d[k] for k in keys], dtype=np.int64), self.period
+            ) if keys else np.empty(0, np.int64)
+            out[i] = {k: int(v) for k, v in zip(keys, vals)}
+        return FeatureColumn(IntegralMap, out)
+
+
+def _clean_events(v) -> List[int]:
+    """Event list with None entries dropped (None survives from_values)."""
+    return [t for t in (v or ()) if t is not None]
+
+
+_DATE_LIST_PIVOTS = {
+    "SinceFirst": None, "SinceLast": None,
+    "ModeDay": ("DayOfWeek", 7, 1,
+                ("Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun")),
+    "ModeMonth": ("MonthOfYear", 12, 1,
+                  ("Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug",
+                   "Sep", "Oct", "Nov", "Dec")),
+    "ModeHour": ("HourOfDay", 24, 0,
+                 tuple(f"{h:02d}" for h in range(24))),
+}
+
+
+class DateListVectorizer(SequenceEstimator):
+    """DateList(s) -> OPVector by pivot (DateListVectorizer.scala:60-95).
+
+    Pivots: ``SinceFirst``/``SinceLast`` — days between the first/last event
+    and a reference date (one slot + optional null indicator per feature);
+    ``ModeDay``/``ModeMonth``/``ModeHour`` — one-hot of the modal day-of-week
+    / month / hour over the list's events.
+
+    The reference pins ``referenceDate`` at pipeline-construction wall-clock
+    time (Transmogrifier.scala:58).  Here, when ``reference_ms`` is not given,
+    fit captures the latest training event instead — deterministic, and the
+    same reference is reused at scoring so the feature is train/score stable.
+    """
+
+    def __init__(self, pivot: str = "SinceFirst",
+                 reference_ms: Optional[int] = None, fill_value: float = 0.0,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecDateList", output_type=OPVector,
+                         uid=uid)
+        if pivot not in _DATE_LIST_PIVOTS:
+            raise ValueError(f"unknown pivot {pivot!r}; "
+                             f"one of {sorted(_DATE_LIST_PIVOTS)}")
+        self.pivot = pivot
+        self.reference_ms = reference_ms
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        ref = self.reference_ms
+        if ref is None:
+            ref = max((max(ev) for c in cols for v in c.values
+                       for ev in [_clean_events(v)] if ev), default=0)
+        return DateListVectorizerModel(
+            pivot=self.pivot, reference_ms=int(ref),
+            fill_value=self.fill_value, track_nulls=self.track_nulls)
+
+
+class DateListVectorizerModel(SequenceModel):
+    def __init__(self, pivot: str = "SinceFirst", reference_ms: int = 0,
+                 fill_value: float = 0.0, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecDateList", output_type=OPVector,
+                         uid=uid)
+        self.pivot = pivot
+        self.reference_ms = reference_ms
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
+        parts, meta = [], []
+        for f, c in zip(self.input_features, cols):
+            tname = f.ftype.type_name()
+            events = [_clean_events(v) for v in c.values]
+            empty = np.array([not v for v in events], bool)
+            if self.pivot in ("SinceFirst", "SinceLast"):
+                pick = min if self.pivot == "SinceFirst" else max
+                days = np.array(
+                    [(self.reference_ms - pick(v)) / _MS_PER_DAY if v
+                     else self.fill_value for v in events], np.float64)
+                parts.append(days[:, None])
+                meta.append(VectorColumnMetadata(
+                    f.name, tname, descriptor_value=self.pivot))
+            else:
+                period, width, lo, names = _DATE_LIST_PIVOTS[self.pivot]
+                block = np.zeros((len(c), width), np.float64)
+                for i, v in enumerate(events):
+                    if not v:
+                        continue
+                    vals = extract_time_period(
+                        np.asarray(v, dtype=np.int64), period) - lo
+                    block[i, np.bincount(vals, minlength=width).argmax()] = 1.0
+                parts.append(block)
+                meta.extend(VectorColumnMetadata(f.name, tname,
+                                                 indicator_value=nm)
+                            for nm in names)
+            if self.track_nulls:
+                parts.append(empty[:, None].astype(np.float64))
+                meta.append(VectorColumnMetadata(f.name, tname,
+                                                 grouping=f.name,
+                                                 indicator_value=NULL_INDICATOR))
+        return _vec_column(np.concatenate(parts, axis=1),
+                           VectorMetadata("date_list_vec", meta))
 
 
 class DateToUnitCircleVectorizer(SequenceTransformer):

@@ -15,7 +15,9 @@ from typing import Dict, List, Optional, Sequence, Type
 
 from ..features.feature import Feature
 from ..types import feature_types as ft
-from .date_geo import DateToUnitCircleVectorizer, GeolocationVectorizer
+from .date_geo import (
+    DateListVectorizer, DateToUnitCircleVectorizer, GeolocationVectorizer,
+)
 from .map_vectorizers import transmogrify_map_group
 from .vectorizers import (
     BinaryVectorizer, IntegralVectorizer, MultiPickListVectorizer,
@@ -58,8 +60,9 @@ def transmogrify(
         groups.setdefault(_group_of(f.ftype), []).append(f)
 
     vectors: List[Feature] = []
-    order = ["real", "integral", "binary", "date", "pivot_text", "smart_text",
-             "multi_pick_list", "text_list", "geolocation", "vector", "map"]
+    order = ["real", "integral", "binary", "date", "date_list", "pivot_text",
+             "smart_text", "multi_pick_list", "text_list", "geolocation",
+             "vector", "map"]
     for g in order:
         feats = groups.pop(g, [])
         if not feats:
@@ -72,6 +75,10 @@ def transmogrify(
             stage = BinaryVectorizer(track_nulls=track_nulls)
         elif g == "date":
             stage = DateToUnitCircleVectorizer(track_nulls=track_nulls)
+        elif g == "date_list":
+            # reference default pivot: SinceLast (Transmogrifier.scala:57)
+            stage = DateListVectorizer(pivot="SinceLast",
+                                       track_nulls=track_nulls)
         elif g == "pivot_text":
             stage = OneHotVectorizer(top_k=top_k, min_support=min_support,
                                      track_nulls=track_nulls)
@@ -120,7 +127,7 @@ def _group_of(t: Type[ft.FeatureType]) -> str:
     if issubclass(t, ft.TextList):
         return "text_list"
     if issubclass(t, ft.DateList):
-        return "text_list"
+        return "date_list"
     if issubclass(t, ft.Binary):
         return "binary"
     if issubclass(t, (ft.Date, ft.DateTime)):

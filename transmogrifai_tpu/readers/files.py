@@ -100,6 +100,14 @@ class DataReaders:
                                          predictor_window_ms,
                                          response_window_ms)
 
+    @staticmethod
+    def dataframe(df, key_col: Optional[str] = None):
+        """Wrap an in-memory pandas DataFrame (setInputDataset analogue,
+        OpWorkflowCore.scala:147)."""
+        from .base import DataFrameReader
+
+        return DataFrameReader(df, key_col)
+
     class Simple:
         @staticmethod
         def csv(path: str, column_names: Optional[List[str]] = None,
