@@ -36,8 +36,21 @@ COLS = ["PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
         "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked"]
 
 
+def _phase_logger():
+    import time as _time
+    start = _time.perf_counter()
+
+    def log(msg):
+        print(f"[bench {_time.perf_counter()-start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    return log
+
+
 def main():
     import pandas as pd
+
+    log = _phase_logger()
 
     from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
     from transmogrifai_tpu.evaluators import Evaluators
@@ -84,12 +97,15 @@ def main():
           .set_result_features(prediction)
           .set_input_data(df))
 
+    log("workflow built; training")
     t0 = time.perf_counter()
     model = wf.train()
     train_s = time.perf_counter() - t0
 
+    log(f"trained in {train_s:.1f}s; evaluating")
     _, metrics = model.score_and_evaluate(
         Evaluators.BinaryClassification.auPR())
+    log("evaluated")
 
     print(json.dumps({
         "metric": "titanic_automl_train_wall_clock",

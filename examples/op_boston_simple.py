@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Boston housing regression demo — parity with the reference's
+OpBostonSimple (helloworld/src/main/scala/com/salesforce/hw/
+OpBostonSimple.scala:84-150): typed features -> transmogrify -> sanity
+check -> RegressionModelSelector (train/validation split, linear
+regression) -> evaluate.
+
+Run: python examples/op_boston_simple.py [path/to/housingData.csv]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+DEFAULT_CSV = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
+               "housingData.csv")
+COLS = ["rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age", "dis",
+        "rad", "tax", "ptratio", "b", "lstat", "medv"]
+
+
+def build(csv_path: str = DEFAULT_CSV):
+    import pandas as pd
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import RegressionModelSelector, grid
+    from transmogrifai_tpu.models import OpLinearRegression
+    from transmogrifai_tpu.types import feature_types as ft
+
+    df = pd.read_csv(csv_path, header=None, names=COLS)
+    df["chas"] = df["chas"].astype(str)  # categorical 0/1 river indicator
+
+    label = FeatureBuilder.RealNN("medv").as_response()
+    predictors = [
+        FeatureBuilder.of(ft.PickList, "chas").as_predictor()
+        if c == "chas" else
+        FeatureBuilder.of(ft.Integral, c).as_predictor()
+        if c == "rad" else
+        FeatureBuilder.RealNN(c).as_predictor()
+        for c in COLS[1:-1]
+    ]
+
+    features = transmogrify(predictors)
+    checked = SanityChecker().set_input(label, features).get_output()
+    prediction = RegressionModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLinearRegression(), grid(reg_param=[0.0, 0.01])),
+        ],
+    ).set_input(label, checked).get_output()
+
+    wf = OpWorkflow().set_result_features(prediction).set_input_data(df)
+    return wf, prediction, label
+
+
+def main(argv=None):
+    from transmogrifai_tpu.evaluators import Evaluators
+
+    argv = argv if argv is not None else sys.argv[1:]
+    wf, prediction, label = build(argv[0] if argv else DEFAULT_CSV)
+    model = wf.train()
+    print(model.summary_pretty())
+    scored, metrics = model.score_and_evaluate(Evaluators.Regression.rmse())
+    print({k: round(float(v), 4) for k, v in metrics.items()
+           if isinstance(v, (int, float))})
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Titanic binary classification demo — parity with the reference's headline
+OpTitanicSimple app (helloworld/src/main/scala/com/salesforce/hw/
+OpTitanicSimple.scala:75-117): typed features incl. derived ones ->
+transmogrify -> sanity check -> BinaryClassificationModelSelector over an
+LR+RF grid with 3-fold CV -> evaluate (AuPR; reference range 0.675-0.810).
+
+Run: python examples/op_titanic_simple.py [path/to/PassengerDataAll.csv]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+DEFAULT_CSV = "/root/reference/test-data/PassengerDataAll.csv"
+COLS = ["PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
+        "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked"]
+
+
+def build(csv_path: str = DEFAULT_CSV):
+    import pandas as pd
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, grid,
+    )
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpRandomForestClassifier,
+    )
+
+    df = pd.read_csv(csv_path, header=None, names=COLS)
+
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    pclass = FeatureBuilder.PickList("Pclass").as_predictor()
+    name = FeatureBuilder.Text("Name").as_predictor()
+    sex = FeatureBuilder.PickList("Sex").as_predictor()
+    age = FeatureBuilder.Real("Age").as_predictor()
+    sibsp = FeatureBuilder.Integral("SibSp").as_predictor()
+    parch = FeatureBuilder.Integral("Parch").as_predictor()
+    ticket = FeatureBuilder.PickList("Ticket").as_predictor()
+    fare = FeatureBuilder.Real("Fare").as_predictor()
+    cabin = FeatureBuilder.PickList("Cabin").as_predictor()
+    embarked = FeatureBuilder.PickList("Embarked").as_predictor()
+
+    # derived features, as in the reference demo (OpTitanicSimple.scala:90-97)
+    family_size = sibsp + parch + 1.0
+    estimated_cost = family_size * fare
+    pivoted_sex = sex.vectorize(top_k=2)
+
+    features = transmogrify([pclass, name, age, sibsp, parch, ticket,
+                             fare, cabin, embarked, family_size,
+                             estimated_cost, pivoted_sex])
+    checked = SanityChecker(remove_bad_features=True).set_input(
+        survived, features).get_output()
+    prediction = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(
+                reg_param=[0.01, 0.1, 0.3], elastic_net_param=[0.0])),
+            (OpRandomForestClassifier(), grid(
+                num_trees=[50], max_depth=[6, 12], min_info_gain=[0.001])),
+        ],
+    ).set_input(survived, checked).get_output()
+
+    wf = OpWorkflow().set_result_features(prediction).set_input_data(df)
+    return wf, prediction, survived
+
+
+def main(argv=None):
+    from transmogrifai_tpu.evaluators import Evaluators
+
+    argv = argv if argv is not None else sys.argv[1:]
+    wf, prediction, label = build(argv[0] if argv else DEFAULT_CSV)
+    model = wf.train()
+    print(model.summary_pretty())
+    scored, metrics = model.score_and_evaluate(
+        Evaluators.BinaryClassification.auPR())
+    print({k: round(float(v), 4) for k, v in metrics.items()
+           if isinstance(v, (int, float))})
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

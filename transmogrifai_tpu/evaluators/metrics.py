@@ -1,4 +1,4 @@
-"""Metric kernels (JAX, sort-based, static shapes).
+"""Metric kernels — host numpy below a size threshold, JAX above it.
 
 Reference: OpBinaryClassificationEvaluator (AuROC, AuPR, precision/recall/F1,
 Brier, threshold metrics — core/.../evaluators/OpBinaryClassificationEvaluator.scala:56,192-223),
@@ -8,6 +8,12 @@ OpMultiClassificationEvaluator, OpRegressionEvaluator, OpForecastEvaluator
 All binary metrics are computed from one descending sort of the scores —
 the TPU-friendly replacement for Spark's `BinaryClassificationMetrics`
 thresholded RDD sweeps.  Weighted variants support the CV fold-mask design.
+
+Dispatch: metrics are O(N log N) scalar reductions, so for host-resident
+inputs under ``HOST_METRIC_MAX`` rows the numpy path runs directly — an XLA
+metric program costs 1-10 s to compile (per shape!) through a remote-compile
+tunnel for microseconds of math.  Device-resident or at-scale inputs use the
+jitted sort-based kernels.
 """
 from __future__ import annotations
 
@@ -24,6 +30,16 @@ __all__ = [
     "regression_metrics", "forecast_metrics", "threshold_curves",
 ]
 
+#: inputs with at most this many rows take the host numpy path
+HOST_METRIC_MAX = 200_000
+
+
+def _on_host(*arrays) -> bool:
+    return all(a is None or isinstance(a, np.ndarray) or np.isscalar(a)
+               or isinstance(a, (list, tuple)) for a in arrays) and all(
+        a is None or np.isscalar(a) or np.size(a) <= HOST_METRIC_MAX
+        for a in arrays)
+
 
 def _weights(y, w):
     y = jnp.asarray(y, jnp.float32)
@@ -34,10 +50,34 @@ def _weights(y, w):
     return y, w
 
 
+def _np_weights(y, w):
+    y = np.asarray(y, np.float64)
+    w = np.ones_like(y) if w is None else np.asarray(w, np.float64)
+    return y, w
+
+
+def auroc(y_true, y_score, sample_weight=None):
+    """Weighted AUC = P(s+ > s-) + 0.5 P(s+ = s-) over score tie groups."""
+    if _on_host(y_true, y_score, sample_weight):
+        y, w = _np_weights(y_true, sample_weight)
+        s = np.asarray(y_score, np.float64)
+        order = np.argsort(s, kind="stable")
+        s_sorted = s[order]
+        wy = (w * y)[order]
+        wn = (w * (1 - y))[order]
+        is_new = np.concatenate([[True], s_sorted[1:] != s_sorted[:-1]])
+        starts = np.flatnonzero(is_new)
+        pos_g = np.add.reduceat(wy, starts)
+        neg_g = np.add.reduceat(wn, starts)
+        neg_below = np.cumsum(neg_g) - neg_g
+        num = float(np.sum(pos_g * (neg_below + 0.5 * neg_g)))
+        denom = max(float(wy.sum()) * float(wn.sum()), 1e-12)
+        return float(np.clip(num / denom, 0.0, 1.0))
+    return _auroc_dev(y_true, y_score, sample_weight)
+
+
 @jax.jit
-def auroc(y_true, y_score, sample_weight=None) -> jnp.ndarray:
-    """Weighted AUC = P(s+ > s-) + 0.5 P(s+ = s-), computed over score tie
-    groups with segment sums (one device sort, static shapes)."""
+def _auroc_dev(y_true, y_score, sample_weight=None) -> jnp.ndarray:
     y, w = _weights(y_true, sample_weight)
     s = jnp.asarray(y_score, jnp.float32)
     n = s.shape[0]
@@ -56,10 +96,30 @@ def auroc(y_true, y_score, sample_weight=None) -> jnp.ndarray:
     return jnp.clip(num / jnp.maximum(w_pos * w_neg, 1e-12), 0.0, 1.0)
 
 
+def aupr(y_true, y_score, sample_weight=None):
+    """Area under precision-recall via descending-score sweep (average-
+    precision style, matches sklearn/Spark)."""
+    if _on_host(y_true, y_score, sample_weight):
+        y, w = _np_weights(y_true, sample_weight)
+        s = np.asarray(y_score, np.float64)
+        order = np.argsort(-s, kind="stable")
+        s_sorted = s[order]
+        wy = (w * y)[order]
+        ww = w[order]
+        is_new = np.concatenate([[True], s_sorted[1:] != s_sorted[:-1]])
+        starts = np.flatnonzero(is_new)
+        pos_g = np.add.reduceat(wy, starts)
+        tot_g = np.add.reduceat(ww, starts)
+        tp = np.cumsum(pos_g)
+        all_pred = np.cumsum(tot_g)
+        pos = max(float(wy.sum()), 1e-12)
+        precision = tp / np.maximum(all_pred, 1e-12)
+        return float(np.clip(np.sum((pos_g / pos) * precision), 0.0, 1.0))
+    return _aupr_dev(y_true, y_score, sample_weight)
+
+
 @jax.jit
-def aupr(y_true, y_score, sample_weight=None) -> jnp.ndarray:
-    """Area under precision-recall via descending-score sweep, linear
-    interpolation in recall (matches sklearn/Spark average-precision style)."""
+def _aupr_dev(y_true, y_score, sample_weight=None) -> jnp.ndarray:
     y, w = _weights(y_true, sample_weight)
     s = jnp.asarray(y_score, jnp.float32)
     n = s.shape[0]
@@ -80,9 +140,28 @@ def aupr(y_true, y_score, sample_weight=None) -> jnp.ndarray:
     return jnp.clip(jnp.sum(dr * precision), 0.0, 1.0)
 
 
-@jax.jit
 def binary_metrics_at_threshold(y_true, y_score, threshold=0.5,
                                 sample_weight=None):
+    if _on_host(y_true, y_score, sample_weight):
+        y, w = _np_weights(y_true, sample_weight)
+        s = np.asarray(y_score, np.float64)
+        pred = (s >= threshold).astype(np.float64)
+        tp = float(np.sum(w * pred * y))
+        fp = float(np.sum(w * pred * (1 - y)))
+        fn = float(np.sum(w * (1 - pred) * y))
+        tn = float(np.sum(w * (1 - pred) * (1 - y)))
+        precision = tp / max(tp + fp, 1e-12)
+        recall = tp / max(tp + fn, 1e-12)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+        error = (fp + fn) / max(tp + fp + fn + tn, 1e-12)
+        return {"Precision": precision, "Recall": recall, "F1": f1,
+                "Error": error, "TP": tp, "TN": tn, "FP": fp, "FN": fn}
+    return _binary_at_threshold_dev(y_true, y_score, threshold, sample_weight)
+
+
+@jax.jit
+def _binary_at_threshold_dev(y_true, y_score, threshold=0.5,
+                             sample_weight=None):
     y, w = _weights(y_true, sample_weight)
     s = jnp.asarray(y_score, jnp.float32)
     pred = (s >= threshold).astype(jnp.float32)
@@ -98,15 +177,32 @@ def binary_metrics_at_threshold(y_true, y_score, threshold=0.5,
             "Error": error, "TP": tp, "TN": tn, "FP": fp, "FN": fn}
 
 
-@jax.jit
 def brier_score(y_true, y_prob, sample_weight=None):
+    if _on_host(y_true, y_prob, sample_weight):
+        y, w = _np_weights(y_true, sample_weight)
+        p = np.asarray(y_prob, np.float64)
+        return float(np.sum(w * (p - y) ** 2) / max(np.sum(w), 1e-12))
+    return _brier_dev(y_true, y_prob, sample_weight)
+
+
+@jax.jit
+def _brier_dev(y_true, y_prob, sample_weight=None):
     y, w = _weights(y_true, sample_weight)
     p = jnp.asarray(y_prob, jnp.float32)
     return jnp.sum(w * (p - y) ** 2) / jnp.maximum(jnp.sum(w), 1e-12)
 
 
-@jax.jit
 def log_loss(y_true, y_prob, sample_weight=None, eps: float = 1e-15):
+    if _on_host(y_true, y_prob, sample_weight):
+        y, w = _np_weights(y_true, sample_weight)
+        p = np.clip(np.asarray(y_prob, np.float64), eps, 1 - eps)
+        ll = -(y * np.log(p) + (1 - y) * np.log1p(-p))
+        return float(np.sum(w * ll) / max(np.sum(w), 1e-12))
+    return _log_loss_dev(y_true, y_prob, sample_weight, eps)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def _log_loss_dev(y_true, y_prob, sample_weight=None, eps: float = 1e-15):
     y, w = _weights(y_true, sample_weight)
     p = jnp.clip(jnp.asarray(y_prob, jnp.float32), eps, 1 - eps)
     ll = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
@@ -131,8 +227,15 @@ def threshold_curves(y_true, y_prob, n_thresholds: int = 100,
                      sample_weight=None) -> Dict[str, np.ndarray]:
     """Precision/recall/F1 across a threshold sweep (thresholdMetrics parity)."""
     ts = np.linspace(0.0, 1.0, n_thresholds)
+    if _on_host(y_true, y_prob, sample_weight):
+        rows = [binary_metrics_at_threshold(y_true, y_prob, t, sample_weight)
+                for t in ts]
+        return {"thresholds": ts,
+                "precisionByThreshold": np.asarray([r["Precision"] for r in rows]),
+                "recallByThreshold": np.asarray([r["Recall"] for r in rows]),
+                "f1ByThreshold": np.asarray([r["F1"] for r in rows])}
     f = jax.jit(jax.vmap(
-        lambda t: binary_metrics_at_threshold(y_true, y_prob, t, sample_weight)
+        lambda t: _binary_at_threshold_dev(y_true, y_prob, t, sample_weight)
     ))
     res = f(jnp.asarray(ts, jnp.float32))
     return {"thresholds": ts,
@@ -170,6 +273,30 @@ def _multiclass_core(y_true, y_pred, n_classes, sample_weight=None):
 
 def multiclass_metrics(y_true, y_pred, n_classes: int,
                        sample_weight=None) -> Dict[str, float]:
+    if _on_host(y_true, y_pred, sample_weight):
+        y = np.asarray(y_true, np.int64)
+        p = np.asarray(y_pred, np.int64)
+        w = (np.ones(len(y)) if sample_weight is None
+             else np.asarray(sample_weight, np.float64))
+        # drop out-of-range labels (e.g. factorize's -1 for NaN) the same way
+        # the device kernel's mode="drop" scatter does
+        ok = (y >= 0) & (y < n_classes) & (p >= 0) & (p < n_classes)
+        y, p, w = y[ok], p[ok], w[ok]
+        wsum = max(w.sum(), 1e-12)
+        acc = float(np.sum(w * (y == p)) / wsum)
+        conf = np.zeros((n_classes, n_classes))
+        np.add.at(conf, (y, p), w)
+        tp = np.diag(conf)
+        support = conf.sum(axis=1)
+        pred_count = conf.sum(axis=0)
+        prec_k = tp / np.maximum(pred_count, 1e-12)
+        rec_k = tp / np.maximum(support, 1e-12)
+        f1_k = 2 * prec_k * rec_k / np.maximum(prec_k + rec_k, 1e-12)
+        wts = support / wsum
+        return {"Accuracy": acc, "Error": 1.0 - acc,
+                "Precision": float(np.sum(wts * prec_k)),
+                "Recall": float(np.sum(wts * rec_k)),
+                "F1": float(np.sum(wts * f1_k)), "confusion": conf}
     res = _multiclass_core(y_true, y_pred, n_classes, sample_weight)
     return {k: (float(v) if k != "confusion" else np.asarray(v))
             for k, v in res.items()}
@@ -192,6 +319,18 @@ def _regression_core(y_true, y_pred, sample_weight=None):
 
 
 def regression_metrics(y_true, y_pred, sample_weight=None) -> Dict[str, float]:
+    if _on_host(y_true, y_pred, sample_weight):
+        y, w = _np_weights(y_true, sample_weight)
+        p = np.asarray(y_pred, np.float64)
+        wsum = max(w.sum(), 1e-12)
+        err = p - y
+        mse = float(np.sum(w * err ** 2) / wsum)
+        mae = float(np.sum(w * np.abs(err)) / wsum)
+        ym = np.sum(w * y) / wsum
+        ss_tot = float(np.sum(w * (y - ym) ** 2))
+        r2 = 1.0 - float(np.sum(w * err ** 2)) / max(ss_tot, 1e-12)
+        return {"RootMeanSquaredError": float(np.sqrt(mse)),
+                "MeanSquaredError": mse, "MeanAbsoluteError": mae, "R2": r2}
     return {k: float(v) for k, v in _regression_core(y_true, y_pred, sample_weight).items()}
 
 

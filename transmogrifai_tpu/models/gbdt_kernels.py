@@ -91,86 +91,133 @@ def apply_bins(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
 def _grow_tree_traced(binned, G, H, C, feat_mask, max_depth: int,
                       n_bins: int, lam, min_child_weight, min_info_gain,
                       min_instances, newton_leaf, learning_rate):
-    """One whole tree under trace: ``lax.fori_loop`` over levels with the
-    histogram buffer padded to the deepest level's node count.
+    """One whole tree under trace: Python-unrolled loop over levels.
 
     This is the dispatch-collapsing design: the per-level kernel approach
     costs depth×trees device round-trips (ruinous through a remote TPU
     tunnel — measured ~12-17 s per 50-tree fit from launch overhead alone);
     here a full tree (and, via vmap, a whole chunk of trees) is ONE XLA
-    program.  Shallow levels waste some zero-slot cumsum work in the padded
-    buffer, but that's HBM-bandwidth-cheap next to eliminating hundreds of
-    launches.
+    program.  Two scaling decisions keep deep trees cheap:
+
+    * **Node compaction**: a level has at most ``min(2^level, N)`` populated
+      nodes, so when ``2^level`` exceeds the row count the level's node ids
+      are compacted (sort + first-occurrence ranks) into ``next_pow2(N)``
+      slots.  Histogram/split work therefore scales with the DATA, not with
+      ``2^depth`` — a depth-12 tree on 891 rows does 1024-slot levels, not
+      2048-slot ones, and depth 16+ stays flat.
+    * **Tile-friendly layout**: per-channel histograms are shaped
+      ``(slots, bins, features)`` so the minor axis is the wide feature
+      dimension (pads to the 128-lane tile at ~1.2×), not the 32-bin axis
+      (which pads 4×, and OOMed a 6-tree chunk at depth 12).
+    * **MXU histograms**: the histogram is two one-hot matmuls —
+      ``(slots, N) @ (N, bins·features)`` — instead of a scatter-add.  XLA
+      lowers TPU scatters to sorts (measured ~5 ms per (N, D) scatter; ~1800
+      of them per 50-tree depth-12 fit ≈ 8 s), while the matmul form rides
+      the systolic array and the bin one-hot is built once per chunk.
     """
     n, d = binned.shape
     k = G.shape[1]
-    nch = 2 * k + 1
-    M = 2 ** (max_depth - 1)            # node slots (deepest level's count)
     B = n_bins
-    n_internal = 2 ** max_depth - 1
-    chans = jnp.concatenate([G, H, C[:, None]], axis=1)  # (N, 2K+1)
+    n_cap = 1 << int(np.ceil(np.log2(max(n, 2))))   # static pow2 ≥ N
+    chans = [G[:, i] for i in range(k)] + [H[:, i] for i in range(k)] + [C]
 
-    heap_feat0 = jnp.zeros(n_internal, jnp.int32)
-    heap_thresh0 = jnp.full(n_internal, B, jnp.int32)    # B => always-left
+    # (N, B·D) one-hot of each row's bin per feature, minor axis = features
+    onehot_bins = (binned[:, None, :] == jnp.arange(B)[None, :, None]
+                   ).astype(jnp.float32).reshape(n, B * d)
 
-    def level_body(level, carry):
-        node, heap_feat, heap_thresh = carry
-        n_nodes = 2 ** level  # traced value — used as data, never as a shape
+    node = jnp.zeros(n, jnp.int32)
+    heap_feat_levels, heap_thresh_levels = [], []
 
-        flat_idx = (node[:, None] * (d * B)
-                    + jnp.arange(d)[None, :] * B + binned)   # (N, D)
-        hist = jnp.zeros((M * d * B, nch), jnp.float32)
-        hist = hist.at[flat_idx].add(chans[:, None, :])
-        hist = hist.reshape(M, d, B, nch)
+    for level in range(max_depth):
+        level_nodes = 2 ** level
+        compact = level_nodes > n_cap
+        M = n_cap if compact else level_nodes        # static slot count
 
-        Gh, Hh, Ch = hist[..., :k], hist[..., k:2 * k], hist[..., 2 * k]
-        GL = jnp.cumsum(Gh, axis=2)
-        HL = jnp.cumsum(Hh, axis=2)
-        CL = jnp.cumsum(Ch, axis=2)
-        Gtot = GL[:, :1, -1:, :]
-        Htot = HL[:, :1, -1:, :]
-        Ctot = CL[:, :1, -1:]
-        GR, HR, CR = Gtot - GL, Htot - HL, Ctot - CL
+        if compact:
+            # rows occupy ≤ N distinct nodes: rank their sorted ids
+            sorted_ids = jnp.sort(node)
+            first = jnp.concatenate(
+                [jnp.ones(1, bool), sorted_ids[1:] != sorted_ids[:-1]])
+            uniq = jnp.sort(jnp.where(first, sorted_ids, jnp.int32(2**31 - 1)))
+            # (M,) padded with INT32_MAX (n ≤ M = next_pow2(n) by construction)
+            uniq = jnp.full(M, jnp.int32(2**31 - 1)).at[:n].set(uniq)
+            slot = jnp.searchsorted(uniq, node).astype(jnp.int32)
+        else:
+            uniq = jnp.arange(M, dtype=jnp.int32)
+            slot = node
 
-        def score(Gs, Hs):
-            return jnp.sum(Gs ** 2 / (Hs + lam), axis=-1)
+        onehot_node = (slot[:, None] == jnp.arange(M)[None, :]
+                       ).astype(jnp.float32)          # (N, M)
+        hists = [jax.lax.dot(
+                     (onehot_node * ch[:, None]).T, onehot_bins,
+                     precision=jax.lax.Precision.HIGHEST,
+                 ).reshape(M, B, d)
+                 for ch in chans]                     # 2K+1 × (M, B, D)
+        GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
+        HLs = [jnp.cumsum(h, axis=1) for h in hists[k:2 * k]]
+        CL = jnp.cumsum(hists[2 * k], axis=1)
 
-        gain = score(GL, HL) + score(GR, HR) - score(Gtot, Htot)  # (M, D, B)
-        valid = ((jnp.min(HL, axis=-1) >= min_child_weight)
-                 & (jnp.min(HR, axis=-1) >= min_child_weight)
+        gain = 0.0
+        HLmin = jnp.inf
+        HRmin = jnp.inf
+        for GL, HL in zip(GLs, HLs):
+            Gtot = GL[:, -1:, :1]
+            Htot = HL[:, -1:, :1]
+            GR, HR = Gtot - GL, Htot - HL
+            gain = gain + (GL ** 2 / (HL + lam) + GR ** 2 / (HR + lam)
+                           - Gtot ** 2 / (Htot + lam))
+            HLmin = jnp.minimum(HLmin, HL)
+            HRmin = jnp.minimum(HRmin, HR)
+        Ctot = CL[:, -1:, :1]
+        CR = Ctot - CL
+
+        valid = ((HLmin >= min_child_weight) & (HRmin >= min_child_weight)
                  & (CL >= min_instances) & (CR >= min_instances)
-                 & (jnp.arange(B)[None, None, :] < B - 1)
-                 & feat_mask[None, :, None])
-        node_w = jnp.maximum(Ctot[..., 0], 1e-12)
-        gain = jnp.where(valid, gain, -jnp.inf)
+                 & (jnp.arange(B)[None, :, None] < B - 1)
+                 & feat_mask[None, None, :])
+        node_w = jnp.maximum(Ctot[:, 0, 0], 1e-12)
+        gain = jnp.where(valid, gain, -jnp.inf)      # (M, B, D)
 
-        flat_gain = gain.reshape(M, d * B)
+        flat_gain = gain.reshape(M, B * d)
         best = jnp.argmax(flat_gain, axis=1)
         best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
-        ok = ((best_gain > 0) & (best_gain / node_w[:, 0] >= min_info_gain)
+        ok = ((best_gain > 0) & (best_gain / node_w >= min_info_gain)
               & jnp.isfinite(best_gain))
-        feat_l = jnp.where(ok, best // B, 0).astype(jnp.int32)
-        thresh_l = jnp.where(ok, best % B, B).astype(jnp.int32)
+        feat_l = jnp.where(ok, best % d, 0).astype(jnp.int32)
+        thresh_l = jnp.where(ok, best // d, B).astype(jnp.int32)
 
-        # write this level's slots into the heap; phantom slots (>= n_nodes)
-        # belong to other levels — route them out of bounds and drop
-        slot = jnp.arange(M)
-        heap_idx = jnp.where(slot < n_nodes, n_nodes - 1 + slot, n_internal)
-        heap_feat = heap_feat.at[heap_idx].set(feat_l, mode="drop")
-        heap_thresh = heap_thresh.at[heap_idx].set(thresh_l, mode="drop")
+        if compact:
+            # write per-slot results back to the level's heap segment at the
+            # slots' true node ids; INT32_MAX padding slots drop out of range
+            seg_feat = jnp.zeros(level_nodes, jnp.int32)
+            seg_thresh = jnp.full(level_nodes, B, jnp.int32)
+            seg_feat = seg_feat.at[uniq].set(feat_l, mode="drop")
+            seg_thresh = seg_thresh.at[uniq].set(thresh_l, mode="drop")
+        else:
+            seg_feat, seg_thresh = feat_l, thresh_l
+        heap_feat_levels.append(seg_feat)
+        heap_thresh_levels.append(seg_thresh)
 
-        x_row = jnp.take_along_axis(binned, feat_l[node][:, None], 1)[:, 0]
-        node = 2 * node + (x_row > thresh_l[node]).astype(jnp.int32)
-        return node, heap_feat, heap_thresh
+        x_row = jnp.take_along_axis(binned, feat_l[slot][:, None], 1)[:, 0]
+        node = 2 * node + (x_row > thresh_l[slot]).astype(jnp.int32)
 
-    node, heap_feat, heap_thresh = lax.fori_loop(
-        0, max_depth, level_body, (jnp.zeros(n, jnp.int32),
-                                   heap_feat0, heap_thresh0))
+    # heap layout: level l occupies slots [2^l - 1, 2^{l+1} - 1)
+    heap_feat = jnp.concatenate(heap_feat_levels)
+    heap_thresh = jnp.concatenate(heap_thresh_levels)
 
     n_leaves = 2 ** max_depth
-    Gs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(G)
-    Hs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(H)
-    Cs = jnp.zeros((n_leaves,), jnp.float32).at[node].add(C)
+    if n * n_leaves <= (64 << 20):
+        # leaf sums as one-hot matmuls (same scatter-avoidance as histograms)
+        onehot_leaf = (node[:, None] == jnp.arange(n_leaves)[None, :]
+                       ).astype(jnp.float32)          # (N, 2^d)
+        stacked = jnp.concatenate([G, H, C[:, None]], axis=1)  # (N, 2K+1)
+        sums = jax.lax.dot(onehot_leaf.T, stacked,
+                           precision=jax.lax.Precision.HIGHEST)
+        Gs, Hs, Cs = sums[:, :k], sums[:, k:2 * k], sums[:, 2 * k]
+    else:  # one-hot too large for very deep trees; scatter scales with N
+        Gs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(G)
+        Hs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(H)
+        Cs = jnp.zeros((n_leaves,), jnp.float32).at[node].add(C)
     newton_val = -learning_rate * Gs / (Hs + lam)
     mean_val = Gs / jnp.maximum(Cs, 1e-12)[:, None]
     leaf = jnp.where(newton_leaf, newton_val, mean_val)
@@ -212,13 +259,26 @@ def _grow_chunk_bagged(binned, Y, BW, feat_mask, max_depth: int,
     return jax.vmap(fn)(G, H, BW, feat_mask)
 
 
-#: HBM budget for a chunk's histogram buffers — bounds vmap width
-HIST_BYTES_BUDGET = 512 << 20
+#: HBM budget for a chunk's histogram buffers — bounds vmap width.  Sized for
+#: a 16 GB v5e chip: deep trees must still batch several per launch, because
+#: each launch pays the host↔device dispatch round trip (expensive through a
+#: remote tunnel) — launches, not FLOPs, dominate small-data deep forests.
+HIST_BYTES_BUDGET = 4 << 30
 
 
 def forest_chunk_size(n_trees: int, max_depth: int, d: int, n_bins: int,
-                      k: int, budget: int = HIST_BYTES_BUDGET) -> int:
-    per_tree = (2 ** (max_depth - 1)) * d * n_bins * (2 * k + 1) * 4
+                      k: int, budget: int = HIST_BYTES_BUDGET,
+                      n_rows: Optional[int] = None) -> int:
+    # node compaction caps a level's histogram slots at next_pow2(n_rows);
+    # 1.3x covers the 128-lane padding of the minor (feature) axis
+    slots = 2 ** (max_depth - 1)
+    if n_rows is not None:
+        slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))
+    per_tree = int(slots * d * n_bins * (2 * k + 1) * 4 * 1.3)
+    if n_rows is not None:
+        # matmul-histogram operands: the per-tree (N, slots) node one-hot and
+        # its (slots, B·D) product partner are live together under vmap
+        per_tree += int(n_rows * slots * 4 * 1.3)
     return int(np.clip(budget // max(per_tree, 1), 1, n_trees))
 
 
@@ -227,7 +287,7 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
                 n_bins: int, lam: float = 1.0,
                 min_child_weight: float = 0.0, min_info_gain: float = 0.0,
                 min_instances: float = 1.0, newton_leaf: bool = False,
-                learning_rate: float = 1.0,
+                learning_rate: float = 1.0, as_numpy: bool = True,
                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow ``T`` independent bagged trees in ceil(T/chunk) XLA launches.
 
@@ -241,7 +301,7 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
     d = binned.shape[1]
     Yj = jnp.asarray(Y, jnp.float32)
     k = Yj.shape[1]
-    chunk = forest_chunk_size(T, max_depth, d, n_bins, k)
+    chunk = forest_chunk_size(T, max_depth, d, n_bins, k, n_rows=n)
     args = (jnp.float32(lam), jnp.float32(min_child_weight),
             jnp.float32(min_info_gain), jnp.float32(min_instances),
             jnp.bool_(newton_leaf), jnp.float32(learning_rate))
@@ -255,9 +315,17 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
         Mc = jnp.asarray(np.pad(feat_mask[s:e], ((0, pad), (0, 0))))
         f, t, lf = _grow_chunk_bagged(binned, Yj, BWc, Mc, max_depth,
                                       n_bins, *args)
+        if as_numpy:
+            f, t, lf = np.asarray(f), np.asarray(t), np.asarray(lf)
         feats.append(f[:e - s])
         threshs.append(t[:e - s])
         leaves.append(lf[:e - s])
+    if as_numpy:
+        # host-side concat: a device concatenate costs a ~5 s remote compile
+        return (np.concatenate(feats), np.concatenate(threshs),
+                np.concatenate(leaves))
+    if len(feats) == 1:
+        return feats[0], threshs[0], leaves[0]
     return (jnp.concatenate(feats), jnp.concatenate(threshs),
             jnp.concatenate(leaves))
 

@@ -61,12 +61,16 @@ class TreeEnsembleModel(PredictorModel):
         self.n_classes = n_classes
 
     def _raw(self, X: np.ndarray) -> np.ndarray:
-        depth = int(np.log2(np.asarray(self.feat).shape[1] + 1))
+        depth = int(np.log2(self.feat.shape[1] + 1))
         from .. import native
         # small-batch serving (the local scorer's case): the C++ kernels skip
         # JAX dispatch + device transfer — measured ~240x lower 1-row latency.
-        # Large batches stay on XLA, whose vectorized tree walk wins there.
-        if native.AVAILABLE and len(X) <= 4096:
+        # Only when the ensemble is already host-resident, though: a freshly
+        # fitted model keeps its trees on device so CV never downloads the
+        # ~3 MB ensemble per candidate just to score it; XLA predicts and only
+        # the (N, K) scores come back.  Large batches stay on XLA either way.
+        host_trees = isinstance(self.feat, np.ndarray)
+        if native.AVAILABLE and host_trees and len(X) <= 4096:
             binned = native.apply_bins(np.asarray(X, np.float32),
                                        np.asarray(self.edges, np.float32))
             return native.predict_ensemble(
@@ -82,7 +86,7 @@ class TreeEnsembleModel(PredictorModel):
 
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
         raw = self._raw(X)
-        t = np.asarray(self.feat).shape[0]
+        t = self.feat.shape[0]
         if self.mode == "rf_cls":
             proba = raw / t
             proba = np.clip(proba, 1e-9, 1.0)
@@ -181,11 +185,13 @@ class _RandomForestBase(PredictorEstimator):
             max_depth=self.max_depth, n_bins=self.max_bins, lam=1e-3,
             min_info_gain=self.min_info_gain,
             min_instances=float(self.min_instances_per_node),
-            newton_leaf=False)
+            newton_leaf=False, as_numpy=False)
+        # ensemble stays device-resident: during model selection only the
+        # scores come back to host; the winning ensemble downloads lazily at
+        # persistence/native-serving time (TreeEnsembleModel._raw)
         mode = "rf_cls" if self._classification else "rf_reg"
         return TreeEnsembleModel(
-            mode=mode, edges=edges, feat=np.asarray(f),
-            thresh=np.asarray(th), leaf=np.asarray(lf),
+            mode=mode, edges=edges, feat=f, thresh=th, leaf=lf,
             n_classes=k if self._classification else 2)
 
 
