@@ -97,3 +97,39 @@ class TestRecordInsightsLOCO:
             top_k=3, aggregate_by_feature=False)
         out = loco.transform_columns(scored[checked.name])
         assert all(len(v) <= 3 for v in out.values)
+
+
+class TestRecordInsightsCorr:
+    def _fit(self, norm_type="minMax", correlation_type="pearson"):
+        from transmogrifai_tpu.insights import RecordInsightsCorr
+        model, df, pred, checked = _train()
+        scored = model.score(df, keep_intermediate_features=True,
+                             keep_raw_features=True)
+        pred_col, feat_col = scored[pred.name], scored[checked.name]
+        est = RecordInsightsCorr(norm_type=norm_type,
+                                 correlation_type=correlation_type, top_k=5)
+        fitted = est.fit_columns(None, pred_col, feat_col)
+        return fitted, pred_col, feat_col
+
+    def test_corr_ranks_informative_feature(self):
+        fitted, pred_col, feat_col = self._fit()
+        out = fitted.transform_columns(pred_col, feat_col)
+        assert len(out.values) == len(feat_col)
+        tops = []
+        for i in range(50):
+            p = parse_insights(out.values[i])
+            assert all(len(v) >= 1 and len(v[0]) == 2
+                       for v in p.values())  # [[pred_idx, importance], ...]
+            top = max(p.items(),
+                      key=lambda kv: max(abs(x[1]) for x in kv[1]))
+            tops.append(top[0])
+        assert sum(t.startswith("strong") for t in tops) > 25
+
+    def test_norm_and_corr_variants(self):
+        for nt in ("zNorm", "minMaxCentered"):
+            fitted, pred_col, feat_col = self._fit(norm_type=nt)
+            out = fitted.transform_columns(pred_col, feat_col)
+            assert all(len(v) <= 5 for v in out.values)
+        fitted, pred_col, feat_col = self._fit(correlation_type="spearman")
+        out = fitted.transform_columns(pred_col, feat_col)
+        assert parse_insights(out.values[0])

@@ -1,0 +1,33 @@
+"""SensitiveFeatureInformation + VersionInfo (reference
+SensitiveFeatureInformationTest, VersionInfo.scala coverage)."""
+from transmogrifai_tpu.utils import (
+    GenderDetectionResults, SensitiveFeatureInformation,
+    SensitiveNameInformation, VersionInfo, sensitive_map_from_json,
+    sensitive_map_to_json, version_info,
+)
+
+
+class TestSensitiveFeatureInformation:
+    def test_name_info_round_trip(self):
+        info = SensitiveNameInformation(
+            name="name", key="first", action_taken=True, prob_name=0.92,
+            gender_detect_strats=[GenderDetectionResults("ByIndex", 0.1)],
+            prob_male=0.4, prob_female=0.5, prob_other=0.1)
+        m = {"name": [info]}
+        back = sensitive_map_from_json(sensitive_map_to_json(m))
+        got = back["name"][0]
+        assert isinstance(got, SensitiveNameInformation)
+        assert got.prob_name == 0.92 and got.action_taken
+        assert got.gender_detect_strats[0].strategy == "ByIndex"
+
+    def test_base_info_round_trip(self):
+        m = {"f": [SensitiveFeatureInformation(name="f")]}
+        back = sensitive_map_from_json(sensitive_map_to_json(m))
+        assert back["f"][0].name == "f" and not back["f"][0].action_taken
+
+
+class TestVersionInfo:
+    def test_version_info_stamped(self):
+        vi = version_info()
+        assert vi.version and vi.python_version
+        assert VersionInfo.from_json(vi.to_json()) == vi

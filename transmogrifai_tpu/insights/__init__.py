@@ -2,7 +2,11 @@
 from .model_insights import (
     ModelInsights, extract_model_insights, feature_importances,
 )
-from .record_insights import RecordInsightsLOCO, parse_insights
+from .record_insights import (
+    NormType, RecordInsightsCorr, RecordInsightsCorrModel, RecordInsightsLOCO,
+    parse_insights,
+)
 
 __all__ = ["ModelInsights", "extract_model_insights", "feature_importances",
-           "RecordInsightsLOCO", "parse_insights"]
+           "RecordInsightsLOCO", "RecordInsightsCorr",
+           "RecordInsightsCorrModel", "NormType", "parse_insights"]

@@ -18,11 +18,12 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..stages.base import UnaryTransformer
+from ..stages.base import BinaryEstimator, BinaryModel, UnaryTransformer
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types.feature_types import OPVector, TextMap
 
-__all__ = ["RecordInsightsLOCO", "parse_insights"]
+__all__ = ["RecordInsightsLOCO", "RecordInsightsCorr",
+           "RecordInsightsCorrModel", "NormType", "parse_insights"]
 
 
 class RecordInsightsLOCO(UnaryTransformer):
@@ -80,3 +81,144 @@ class RecordInsightsLOCO(UnaryTransformer):
 def parse_insights(row_map: Dict[str, str]) -> Dict[str, List[float]]:
     """RecordInsightsParser.parseInsights parity."""
     return {k: json.loads(v) for k, v in row_map.items()}
+
+
+# ---------------------------------------------------------------------------
+# RecordInsightsCorr — correlation-based record insights
+# ---------------------------------------------------------------------------
+
+class NormType:
+    """Feature scaling applied before computing importances.
+
+    Reference ``NormType`` (core/.../impl/insights/RecordInsightsCorr
+    .scala:166-204): minMax (x-min)/range, zNorm (x-mean)/std,
+    minMaxCentered 2*(x-min)/range - 1.
+    """
+
+    MIN_MAX = "minMax"
+    Z_NORM = "zNorm"
+    MIN_MAX_CENTERED = "minMaxCentered"
+
+
+def _pred_matrix(col: FeatureColumn) -> np.ndarray:
+    """Prediction input -> (N, P) score matrix.
+
+    Accepts an OPVector column, a PredictionBatch-valued column, or an
+    object column of prediction row-maps (probability_* preferred,
+    else prediction) — the reference requires callers to pre-convert
+    regression outputs to a one-column vector (RecordInsightsCorr.scala:52).
+    """
+    v = col.values
+    if hasattr(v, "probability"):          # PredictionBatch
+        if v.probability is not None:
+            return np.asarray(v.probability, np.float64)
+        return np.asarray(v.prediction, np.float64)[:, None]
+    arr = np.asarray(v)
+    if arr.dtype == object:                # row maps
+        rows = []
+        for m in arr:
+            pk = sorted((k for k in m if k.startswith("probability_")),
+                        key=lambda k: int(k.rsplit("_", 1)[1]))
+            rows.append([m[k] for k in pk] if pk else [m["prediction"]])
+        return np.asarray(rows, np.float64)
+    return arr.astype(np.float64).reshape(len(arr), -1)
+
+
+class RecordInsightsCorr(BinaryEstimator):
+    """Correlation-based per-record insights.
+
+    Reference ``RecordInsightsCorr`` (core/.../impl/insights/
+    RecordInsightsCorr.scala:55-121): inputs (predictions, feature vector);
+    fit computes the correlation of every feature slot with every prediction
+    column plus normalization stats; the model scores a row as
+    ``corr[pred, slot] * normalized(x[slot])`` and keeps the top-K slots by
+    absolute importance, keyed by vector-metadata column name.
+
+    TPU note: the correlation is one standardized X^T @ P matmul over the
+    batch (MXU-friendly) instead of Spark's ``Statistics.corr`` pass.
+    """
+
+    def __init__(self, norm_type: str = NormType.MIN_MAX,
+                 correlation_type: str = "pearson", top_k: int = 20,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="recordInsightsCorr",
+                         output_type=TextMap, uid=uid)
+        self.norm_type = norm_type
+        self.correlation_type = correlation_type
+        self.top_k = top_k
+
+    def fit_columns(self, data: ColumnarDataset, pred_col: FeatureColumn,
+                    feat_col: FeatureColumn):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.stats import ranks
+
+        P = _pred_matrix(pred_col)                       # (N, p)
+        X = np.asarray(feat_col.values, np.float64)      # (N, d)
+        if self.correlation_type == "spearman":
+            col_ranks = jax.vmap(ranks, in_axes=1, out_axes=1)
+            X_c = np.asarray(col_ranks(jnp.asarray(X)), np.float64)
+            P_c = np.asarray(col_ranks(jnp.asarray(P)), np.float64)
+        else:
+            X_c, P_c = X, P
+        n = max(len(X), 1)
+        Xs = X_c - X_c.mean(axis=0)
+        Ps = P_c - P_c.mean(axis=0)
+        xsd = Xs.std(axis=0)
+        psd = Ps.std(axis=0)
+        denom = np.outer(psd, xsd) * n
+        with np.errstate(invalid="ignore", divide="ignore"):
+            corr = (Ps.T @ Xs) / np.where(denom == 0, np.nan, denom)
+
+        if self.norm_type == NormType.Z_NORM:
+            shift, scale, offset = X.mean(axis=0), X.std(axis=0), 0.0
+        else:
+            if len(X):
+                mn, rng = X.min(axis=0), np.ptp(X, axis=0)
+            else:
+                mn = rng = np.zeros(X.shape[1])
+            if self.norm_type == NormType.MIN_MAX_CENTERED:
+                shift, scale, offset = mn, rng / 2.0, 1.0
+            else:
+                shift, scale, offset = mn, rng, 0.0
+        return RecordInsightsCorrModel(
+            score_corr=np.nan_to_num(corr), shift=shift, scale=scale,
+            offset=float(offset), top_k=self.top_k)
+
+
+class RecordInsightsCorrModel(BinaryModel):
+    def __init__(self, score_corr: np.ndarray, shift: np.ndarray,
+                 scale: np.ndarray, offset: float = 0.0, top_k: int = 20,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="recordInsightsCorr",
+                         output_type=TextMap, uid=uid)
+        self.score_corr = np.asarray(score_corr, np.float64)
+        self.shift = np.asarray(shift, np.float64)
+        self.scale = np.asarray(scale, np.float64)
+        self.offset = float(offset)
+        self.top_k = top_k
+
+    def transform_columns(self, pred_col: FeatureColumn,
+                          feat_col: FeatureColumn) -> FeatureColumn:
+        X = np.asarray(feat_col.values, np.float64)
+        n, d = X.shape
+        vmeta = feat_col.vmeta
+        names = (vmeta.column_names() if vmeta is not None
+                 and vmeta.size == d else [f"f_{j}" for j in range(d)])
+        with np.errstate(invalid="ignore", divide="ignore"):
+            normed = np.where(self.scale == 0, 0.0,
+                              (X - self.shift) / self.scale) - self.offset
+        # (N, p, d): per-row importance of each slot for each prediction col
+        imp = self.score_corr[None, :, :] * normed[:, None, :]
+        out = np.empty(n, dtype=object)
+        p = self.score_corr.shape[0]
+        for i in range(n):
+            best = np.max(np.abs(imp[i]), axis=0)       # (d,)
+            order = np.argsort(-best)[: self.top_k]
+            out[i] = {
+                names[j]: json.dumps([[k, float(imp[i, k, j])]
+                                      for k in range(p)])
+                for j in order
+            }
+        return FeatureColumn(TextMap, out)

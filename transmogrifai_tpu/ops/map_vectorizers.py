@@ -29,6 +29,8 @@ __all__ = ["NumericMapVectorizer", "NumericMapVectorizerModel",
            "TextMapPivotVectorizer", "TextMapPivotVectorizerModel",
            "MultiPickListMapVectorizer", "MultiPickListMapVectorizerModel",
            "SmartTextMapVectorizer", "SmartTextMapVectorizerModel",
+           "GeoMapVectorizer", "GeoMapVectorizerModel",
+           "GeolocationMapVectorizer", "GeolocationMapVectorizerModel",
            "transmogrify_map_group"]
 
 
@@ -337,6 +339,11 @@ class GeoMapVectorizerModel(SequenceModel):
         return _vec_column(np.concatenate(parts, axis=1) if parts
                            else np.zeros((n, 0), np.float32),
                            VectorMetadata("geo_map_vec", meta))
+
+
+# reference names (core/.../impl/feature/GeolocationMapVectorizer.scala)
+GeolocationMapVectorizer = GeoMapVectorizer
+GeolocationMapVectorizerModel = GeoMapVectorizerModel
 
 
 # ---------------------------------------------------------------------------

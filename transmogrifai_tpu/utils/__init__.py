@@ -1,2 +1,6 @@
 from .profiling import (AppMetrics, MetricsCollector, OpStep,  # noqa: F401
                         profile_to, with_job_group)
+from .sensitive import (GenderDetectionResults,  # noqa: F401
+                        SensitiveFeatureInformation, SensitiveNameInformation,
+                        sensitive_map_from_json, sensitive_map_to_json)
+from .version import VERSION, VersionInfo, version_info  # noqa: F401

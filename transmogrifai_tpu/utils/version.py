@@ -1,0 +1,62 @@
+"""Build/version stamping for model artifacts.
+
+Reference: ``VersionInfo`` (utils/src/main/scala/com/salesforce/op/utils/
+version/VersionInfo.scala:50-89): a properties-backed record (version, build
+time, git branch/commit, toolchain versions) attached to saved models and
+logs. Here the toolchain is Python/JAX and the git commit is read lazily
+from the repo if present.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import platform
+import subprocess
+from typing import Optional
+
+__all__ = ["VersionInfo", "version_info", "VERSION"]
+
+VERSION = "0.1.0"
+
+
+@dataclasses.dataclass(frozen=True)
+class VersionInfo:
+    version: str
+    python_version: str
+    jax_version: Optional[str] = None
+    git_branch: Optional[str] = None
+    git_commit: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "VersionInfo":
+        fields = {f.name for f in dataclasses.fields(VersionInfo)}
+        return VersionInfo(**{k: v for k, v in d.items() if k in fields})
+
+
+def _git(*args: str) -> Optional[str]:
+    import os
+    try:
+        out = subprocess.run(["git", *args], capture_output=True, text=True,
+                             timeout=5, cwd=os.path.dirname(__file__))
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except (OSError, subprocess.TimeoutExpired):  # pragma: no cover
+        return None
+
+
+@functools.lru_cache(maxsize=1)
+def version_info() -> VersionInfo:
+    try:
+        import jax
+        jax_version = jax.__version__
+    except Exception:  # pragma: no cover
+        jax_version = None
+    return VersionInfo(
+        version=VERSION,
+        python_version=platform.python_version(),
+        jax_version=jax_version,
+        git_branch=_git("rev-parse", "--abbrev-ref", "HEAD"),
+        git_commit=_git("rev-parse", "--short", "HEAD"),
+    )

@@ -199,9 +199,12 @@ def save_workflow_model(model, path: str, overwrite: bool = True) -> None:
             if not isinstance(s, FeatureGeneratorStage):
                 stage_records.append(_stage_record(s, store))
 
+    from ..utils.version import version_info
+
     rff = model.raw_feature_filter_results
     doc = {
         "version": FORMAT_VERSION,
+        "versionInfo": version_info().to_json(),
         "resultFeatures": [f.name for f in model.result_features],
         "stages": stage_records,
         # structured results persist via their own JSON form; loaded models
