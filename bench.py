@@ -25,10 +25,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # persistent XLA compilation cache: first-compile cost (~20-40 s per program
 # through the remote-compile tunnel) is paid once, not per bench run
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+from transmogrifai_tpu.utils.compile_cache import enable_persistent_cache
+enable_persistent_cache()
 
 SPARK_LOCAL_BASELINE_S = 180.0
 TITANIC = "/root/reference/test-data/PassengerDataAll.csv"

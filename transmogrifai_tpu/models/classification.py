@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,6 +36,11 @@ def _extract_xy(label_col: FeatureColumn, features_col: FeatureColumn):
     X = np.asarray(features_col.values, dtype=np.float32)
     y = np.asarray(label_col.values, dtype=np.float32)
     return X, np.nan_to_num(y)
+
+
+@jax.jit
+def _device_sigmoid_score(X, coef, intercept):
+    return jax.nn.sigmoid(X @ coef + intercept)
 
 
 class OpLogisticRegression(PredictorEstimator):
@@ -65,6 +71,26 @@ class OpLogisticRegression(PredictorEstimator):
         if self.sample_weight_col and self.sample_weight_col in data:
             w = np.asarray(data[self.sample_weight_col].values, np.float32)
         return self.fit_raw(X, y, w)
+
+    def fit_device(self, X, y, w, problem_type: str):
+        """Sweep path: Newton-IRLS fit and sigmoid scores stay on device
+        (binary only) — no coefficient fetch per candidate."""
+        if problem_type != "binary" or (len(y) and np.nanmax(y) > 1):
+            return None
+        mu, sigma = (_standardize_stats(X, w) if self.standardization
+                     else (None, None))
+        fit = fit_logistic_regression(
+            _apply_standardize(X, mu, sigma), y, sample_weight=w,
+            reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param,
+            max_iter=self.max_iter, tol=self.tol,
+            fit_intercept=self.fit_intercept)
+
+        def score(Xe):
+            Xes = _apply_standardize(np.asarray(Xe, np.float32), mu, sigma)
+            return _device_sigmoid_score(jnp.asarray(Xes), fit.coef,
+                                         fit.intercept)
+        return score
 
     def fit_raw(self, X: np.ndarray, y: np.ndarray,
                 w: Optional[np.ndarray] = None):

@@ -27,6 +27,7 @@ Design (gpu_hist-style, adapted to XLA):
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import NamedTuple, Optional, Tuple
 
@@ -36,8 +37,34 @@ import numpy as np
 from jax import lax
 
 __all__ = ["TreeEnsemble", "quantile_bins", "apply_bins", "grow_tree",
-           "grow_forest", "forest_chunk_size", "predict_tree",
-           "predict_ensemble"]
+           "grow_forest", "grow_forest_rf", "forest_chunk_size",
+           "predict_tree", "predict_ensemble", "compile_depth_hint"]
+
+# Shared compile-depth hint: a model-selection sweep compiles ONE tree-growth
+# program at the grid's deepest max_depth and runs every candidate through it
+# with a traced per-tree depth_limit, instead of one ~5-16 s XLA compile per
+# distinct depth (the depth sets the static heap shapes).  Set via the
+# ``compile_depth_hint`` context manager (ModelSelector does this around its
+# candidate sweep).
+_COMPILE_DEPTH_HINT: Optional[int] = None
+
+
+@contextlib.contextmanager
+def compile_depth_hint(depth: Optional[int]):
+    """Grow trees with heap shapes sized for ``depth`` within the context."""
+    global _COMPILE_DEPTH_HINT
+    prev = _COMPILE_DEPTH_HINT
+    _COMPILE_DEPTH_HINT = depth
+    try:
+        yield
+    finally:
+        _COMPILE_DEPTH_HINT = prev
+
+
+def _resolve_compile_depth(max_depth: int) -> int:
+    if _COMPILE_DEPTH_HINT is not None and _COMPILE_DEPTH_HINT >= max_depth:
+        return _COMPILE_DEPTH_HINT
+    return max_depth
 
 
 class TreeEnsemble(NamedTuple):
@@ -88,9 +115,10 @@ def apply_bins(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(X[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int32)
 
 
-def _grow_tree_traced(binned, G, H, C, feat_mask, max_depth: int,
-                      n_bins: int, lam, min_child_weight, min_info_gain,
-                      min_instances, newton_leaf, learning_rate):
+def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
+                      max_depth: int, n_bins: int, lam, min_child_weight,
+                      min_info_gain, min_instances, newton_leaf,
+                      learning_rate, hist_bf16: bool = False):
     """One whole tree under trace: Python-unrolled loop over levels.
 
     This is the dispatch-collapsing design: the per-level kernel approach
@@ -120,6 +148,13 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, max_depth: int,
     B = n_bins
     n_cap = 1 << int(np.ceil(np.log2(max(n, 2))))   # static pow2 ≥ N
     chans = [G[:, i] for i in range(k)] + [H[:, i] for i in range(k)] + [C]
+    # RF grad/hess are bag-weight × one-hot class values — exact in bf16
+    # for integer weights, ≲1e-3 relative under fractional balancer weights,
+    # either way immaterial to split selection; DEFAULT precision (bf16 in,
+    # f32 accumulate) runs the histogram dots at ~2x MXU throughput.  GBT
+    # gradients are continuous and compound across rounds: keep HIGHEST.
+    dot_prec = (jax.lax.Precision.DEFAULT if hist_bf16
+                else jax.lax.Precision.HIGHEST)
 
     # (N, B·D) one-hot of each row's bin per feature, minor axis = features
     onehot_bins = (binned[:, None, :] == jnp.arange(B)[None, :, None]
@@ -141,7 +176,10 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, max_depth: int,
             uniq = jnp.sort(jnp.where(first, sorted_ids, jnp.int32(2**31 - 1)))
             # (M,) padded with INT32_MAX (n ≤ M = next_pow2(n) by construction)
             uniq = jnp.full(M, jnp.int32(2**31 - 1)).at[:n].set(uniq)
-            slot = jnp.searchsorted(uniq, node).astype(jnp.int32)
+            # compare_all: the default 'scan' method lowers to a sequential
+            # log(M) loop — poor fit for the TPU's wide vector units
+            slot = jnp.searchsorted(uniq, node,
+                                    method="compare_all").astype(jnp.int32)
         else:
             uniq = jnp.arange(M, dtype=jnp.int32)
             slot = node
@@ -150,7 +188,8 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, max_depth: int,
                        ).astype(jnp.float32)          # (N, M)
         hists = [jax.lax.dot(
                      (onehot_node * ch[:, None]).T, onehot_bins,
-                     precision=jax.lax.Precision.HIGHEST,
+                     precision=dot_prec,
+                     preferred_element_type=jnp.float32,
                  ).reshape(M, B, d)
                  for ch in chans]                     # 2K+1 × (M, B, D)
         GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
@@ -181,8 +220,11 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, max_depth: int,
         flat_gain = gain.reshape(M, B * d)
         best = jnp.argmax(flat_gain, axis=1)
         best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
+        # depth_limit is a TRACED scalar: trees of different requested depths
+        # share one compiled program (one XLA compile per sweep, not one per
+        # distinct max_depth); levels at/past the limit emit no splits
         ok = ((best_gain > 0) & (best_gain / node_w >= min_info_gain)
-              & jnp.isfinite(best_gain))
+              & jnp.isfinite(best_gain) & (level < depth_limit))
         feat_l = jnp.where(ok, best % d, 0).astype(jnp.int32)
         thresh_l = jnp.where(ok, best // d, B).astype(jnp.int32)
 
@@ -224,27 +266,33 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, max_depth: int,
     return heap_feat, heap_thresh, leaf
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins"))
-def _grow_chunk(binned, G, H, C, feat_mask, max_depth: int, n_bins: int,
-                lam, min_child_weight, min_info_gain, min_instances,
-                newton_leaf, learning_rate):
+@functools.partial(jax.jit,
+                   static_argnames=("max_depth", "n_bins", "hist_bf16"))
+def _grow_chunk(binned, G, H, C, feat_mask, depth_limit, max_depth: int,
+                n_bins: int, lam, min_child_weight, min_info_gain,
+                min_instances, newton_leaf, learning_rate,
+                hist_bf16: bool = False):
     """Grow a chunk of trees in one XLA program.
 
-    binned (N, D) shared; G/H (T, N, K), C (T, N), feat_mask (T, D).
+    binned (N, D) shared; G/H (T, N, K), C (T, N), feat_mask (T, D),
+    depth_limit (T,) traced per-tree effective depth.
     Returns (feat (T, 2^d-1), thresh (T, 2^d-1), leaf (T, 2^d, K)).
     """
     fn = functools.partial(
         _grow_tree_traced, binned, max_depth=max_depth, n_bins=n_bins,
         lam=lam, min_child_weight=min_child_weight,
         min_info_gain=min_info_gain, min_instances=min_instances,
-        newton_leaf=newton_leaf, learning_rate=learning_rate)
-    return jax.vmap(fn)(G, H, C, feat_mask)
+        newton_leaf=newton_leaf, learning_rate=learning_rate,
+        hist_bf16=hist_bf16)
+    return jax.vmap(fn)(G, H, C, feat_mask, depth_limit)
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins"))
-def _grow_chunk_bagged(binned, Y, BW, feat_mask, max_depth: int,
+@functools.partial(jax.jit,
+                   static_argnames=("max_depth", "n_bins", "hist_bf16"))
+def _grow_chunk_bagged(binned, Y, BW, feat_mask, depth_limit, max_depth: int,
                        n_bins: int, lam, min_child_weight, min_info_gain,
-                       min_instances, newton_leaf, learning_rate):
+                       min_instances, newton_leaf, learning_rate,
+                       hist_bf16: bool = False):
     """Bagged-forest chunk: G/H derived from the (C, N) bag weights and the
     shared (N, K) targets *inside* the jit, so the (C, N, K) gradient
     tensors exist only transiently per launch (fused by XLA), never as
@@ -255,8 +303,9 @@ def _grow_chunk_bagged(binned, Y, BW, feat_mask, max_depth: int,
         _grow_tree_traced, binned, max_depth=max_depth, n_bins=n_bins,
         lam=lam, min_child_weight=min_child_weight,
         min_info_gain=min_info_gain, min_instances=min_instances,
-        newton_leaf=newton_leaf, learning_rate=learning_rate)
-    return jax.vmap(fn)(G, H, BW, feat_mask)
+        newton_leaf=newton_leaf, learning_rate=learning_rate,
+        hist_bf16=hist_bf16)
+    return jax.vmap(fn)(G, H, BW, feat_mask, depth_limit)
 
 
 #: HBM budget for a chunk's histogram buffers — bounds vmap width.  Sized for
@@ -301,19 +350,21 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
     d = binned.shape[1]
     Yj = jnp.asarray(Y, jnp.float32)
     k = Yj.shape[1]
-    chunk = forest_chunk_size(T, max_depth, d, n_bins, k, n_rows=n)
+    heap_depth = _resolve_compile_depth(max_depth)
+    chunk = forest_chunk_size(T, heap_depth, d, n_bins, k, n_rows=n)
     args = (jnp.float32(lam), jnp.float32(min_child_weight),
             jnp.float32(min_info_gain), jnp.float32(min_instances),
             jnp.bool_(newton_leaf), jnp.float32(learning_rate))
     BW = np.asarray(BW, np.float32)
     feat_mask = np.asarray(feat_mask, bool)
+    limit = jnp.full((chunk,), max_depth, jnp.int32)
     feats, threshs, leaves = [], [], []
     for s in range(0, T, chunk):
         e = min(s + chunk, T)
         pad = chunk - (e - s)
         BWc = jnp.asarray(np.pad(BW[s:e], ((0, pad), (0, 0))))
         Mc = jnp.asarray(np.pad(feat_mask[s:e], ((0, pad), (0, 0))))
-        f, t, lf = _grow_chunk_bagged(binned, Yj, BWc, Mc, max_depth,
+        f, t, lf = _grow_chunk_bagged(binned, Yj, BWc, Mc, limit, heap_depth,
                                       n_bins, *args)
         if as_numpy:
             f, t, lf = np.asarray(f), np.asarray(t), np.asarray(lf)
@@ -324,6 +375,73 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
         # host-side concat: a device concatenate costs a ~5 s remote compile
         return (np.concatenate(feats), np.concatenate(threshs),
                 np.concatenate(leaves))
+    if len(feats) == 1:
+        return feats[0], threshs[0], leaves[0]
+    return (jnp.concatenate(feats), jnp.concatenate(threshs),
+            jnp.concatenate(leaves))
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "msub", "max_depth",
+                                             "n_bins"))
+def _grow_chunk_rf(binned, Y, base_w, seed, start, n_trees, depth_limit_val,
+                   subsample_rate, chunk: int, msub: int, max_depth: int,
+                   n_bins: int, lam, min_child_weight, min_info_gain,
+                   min_instances, learning_rate):
+    """RF chunk with ON-DEVICE bag-weight + feature-mask generation.
+
+    Through a remote-TPU tunnel, uploading per-tree (T, N) Poisson weights
+    and (T, D) masks per fit dominates the sweep; here the caller ships only
+    ``seed``/``start`` scalars and the memoized fold data, and each tree
+    derives its bag from ``fold_in(seed, tree_id)`` inside the program.
+    """
+    n, d = binned.shape
+    tree_ids = start + jnp.arange(chunk)
+
+    def gen(tid):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), tid)
+        kb, km = jax.random.split(key)
+        bw = jax.random.poisson(kb, subsample_rate, (n,)).astype(jnp.float32)
+        r = jax.random.uniform(km, (d,))
+        kth = jnp.sort(r)[msub - 1]
+        return bw, r <= kth
+
+    BWr, masks = jax.vmap(gen)(tree_ids)
+    BW = base_w[None, :] * BWr * (tree_ids < n_trees)[:, None]
+    limit = jnp.full((chunk,), depth_limit_val, jnp.int32)
+    return _grow_chunk_bagged(
+        binned, Y, BW, masks, limit, max_depth, n_bins, lam,
+        min_child_weight, min_info_gain, min_instances,
+        jnp.bool_(False), learning_rate, hist_bf16=True)
+
+
+def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
+                   subsample_rate: float, max_depth: int, n_bins: int,
+                   lam: float = 1e-3, min_child_weight: float = 0.0,
+                   min_info_gain: float = 0.0, min_instances: float = 1.0):
+    """Bagged random forest, bags generated on device (see _grow_chunk_rf).
+
+    Returns device (T, 2^hd-1) feat/thresh and (T, 2^hd, K) leaves, where hd
+    is the shared compile depth (``compile_depth_hint``)."""
+    n, d = binned.shape
+    k = Y.shape[1]
+    heap_depth = _resolve_compile_depth(max_depth)
+    chunk = forest_chunk_size(n_trees, heap_depth, d, n_bins, k, n_rows=n)
+    args = (jnp.float32(lam), jnp.float32(min_child_weight),
+            jnp.float32(min_info_gain), jnp.float32(min_instances),
+            jnp.float32(1.0))
+    feats, threshs, leaves = [], [], []
+    for s in range(0, n_trees, chunk):
+        f, t, lf = _grow_chunk_rf(
+            binned, Y, base_w, jnp.int32(seed), jnp.int32(s),
+            jnp.int32(n_trees), jnp.int32(max_depth),
+            jnp.float32(subsample_rate), chunk, msub, heap_depth, n_bins,
+            *args)
+        e = min(s + chunk, n_trees)
+        if e - s < chunk:
+            f, t, lf = f[:e - s], t[:e - s], lf[:e - s]
+        feats.append(f)
+        threshs.append(t)
+        leaves.append(lf)
     if len(feats) == 1:
         return feats[0], threshs[0], leaves[0]
     return (jnp.concatenate(feats), jnp.concatenate(threshs),
@@ -341,9 +459,11 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     d = binned.shape[1]
     if feat_mask is None:
         feat_mask = jnp.ones(d, bool)
+    heap_depth = _resolve_compile_depth(max_depth)
+    limit = jnp.full((1,), max_depth, jnp.int32)
     f, t, lf = _grow_chunk(
-        binned, G[None], H[None], C[None], feat_mask[None], max_depth,
-        n_bins, jnp.float32(lam), jnp.float32(min_child_weight),
+        binned, G[None], H[None], C[None], feat_mask[None], limit,
+        heap_depth, n_bins, jnp.float32(lam), jnp.float32(min_child_weight),
         jnp.float32(min_info_gain), jnp.float32(min_instances),
         jnp.bool_(newton_leaf), jnp.float32(learning_rate))
     return f[0], t[0], lf[0]
@@ -378,15 +498,30 @@ def predict_ensemble(binned: jnp.ndarray, feat: jnp.ndarray,
                      max_depth: int) -> jnp.ndarray:
     """Sum of all trees' outputs: feat/thresh (T, 2^d-1), leaf (T, 2^d, K).
 
-    scan over trees (static T unrolled by XLA where profitable).
+    All trees route in parallel — ``max_depth`` sequential steps of one
+    (T, N) gather each, instead of a scan over trees (T × depth serial
+    steps, which left the TPU idle between tiny kernels).
     """
-
-    def body(acc, tree):
-        f, t, lf = tree
-        return acc + predict_tree(binned, f, t, lf, max_depth), None
-
     n = binned.shape[0]
+    T = feat.shape[0]
+    node = jnp.zeros((T, n), jnp.int32)
+    rows = jnp.arange(n)[None, :]
+
+    def level(l, node):
+        heap = (2 ** l - 1) + node                       # (T, N)
+        f = jnp.take_along_axis(feat, heap, axis=1)
+        t = jnp.take_along_axis(thresh, heap, axis=1)
+        x = binned[rows, f]                              # (T, N)
+        return 2 * node + (x > t).astype(jnp.int32)
+
+    node = lax.fori_loop(0, max_depth, level, node)
+    # leaf-sum in tree chunks: one (T, N, K) gather would cost T·N·K·4 bytes
+    # of HBM (4 GB for 512 trees × 1M rows); chunks bound it at ~32 MB
     k = leaf.shape[2]
-    acc0 = jnp.zeros((n, k), jnp.float32)
-    out, _ = lax.scan(body, acc0, (feat, thresh, leaf))
+    chunk = max(1, min(T, (32 << 20) // max(n * k * 4, 1)))
+    out = jnp.zeros((n, k), jnp.float32)
+    tree_idx = jnp.arange(T)[:, None]
+    for s in range(0, T, chunk):
+        e = min(s + chunk, T)
+        out = out + leaf[tree_idx[s:e], node[s:e]].sum(axis=0)
     return out

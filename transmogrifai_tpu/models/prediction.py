@@ -86,6 +86,17 @@ class PredictorEstimator(BinaryEstimator):
     def features_feature(self) -> Feature:
         return self.input_features[1]
 
+    def fit_device(self, X: np.ndarray, y: np.ndarray, w,
+                   problem_type: str):
+        """Device-resident fit for validation sweeps.
+
+        Returns ``score(X_eval) -> jax.Array`` (the validation score vector,
+        see ``PredictorModel.score_device``) or None to fall back to
+        ``fit_raw`` + host scoring.  Implementations must not materialize
+        device values on host (each sync costs a ~0.6 s tunnel round trip).
+        """
+        return None
+
 
 class PredictorModel(BinaryModel):
     """Base for fitted predictors; subclasses implement predict(X)."""
@@ -99,6 +110,18 @@ class PredictorModel(BinaryModel):
 
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
         raise NotImplementedError
+
+    def score_device(self, X: np.ndarray, problem_type: str):
+        """Validation score vector as a DEVICE array, or None if unsupported.
+
+        binary -> P(class 1); regression/multiclass -> prediction.  Sweeps
+        use this to keep fit→score→metric on device: through a remote-TPU
+        tunnel every host materialization costs a ~0.6 s round trip, so the
+        selector fetches one stacked metric array per sweep instead of one
+        score vector per candidate×fold (see OpValidator's thread-pool
+        analogue, OpCrossValidation.scala:113-138).
+        """
+        return None
 
     def transform_columns(self, label_col, features_col) -> FeatureColumn:
         X = np.asarray(features_col.values, dtype=np.float32)

@@ -56,6 +56,29 @@ class OpLinearRegression(PredictorEstimator):
             np.asarray(fit.coef), float(np.asarray(fit.intercept)), mu, sigma)
         return LinearRegressionModel(coef=coef.tolist(), intercept=float(intercept))
 
+    def fit_device(self, X, y, w, problem_type: str):
+        """Sweep path: fit + linear predict stay on device (no coef fetch)."""
+        if problem_type != "regression":
+            return None
+        mu, sigma = (_standardize_stats(X, w) if self.standardization
+                     else (None, None))
+        fit = fit_linear_regression(
+            _apply_standardize(X, mu, sigma), y, sample_weight=w,
+            reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param, max_iter=self.max_iter,
+            tol=self.tol, fit_intercept=self.fit_intercept)
+
+        def score(Xe):
+            Xes = _apply_standardize(np.asarray(Xe, np.float32), mu, sigma)
+            return _device_linear_score(jnp.asarray(Xes), fit.coef,
+                                        fit.intercept)
+        return score
+
+
+@jax.jit
+def _device_linear_score(X, coef, intercept):
+    return X @ coef + intercept
+
 
 class LinearRegressionModel(PredictorModel):
     def __init__(self, coef: List[float], intercept: float,

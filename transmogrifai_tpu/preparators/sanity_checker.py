@@ -111,12 +111,19 @@ class SanityChecker(BinaryEstimator):
         vmeta = features_col.vmeta or VectorMetadata(
             "features", [])
 
+        import jax.numpy as jnp
+
         stats = col_stats(X)
-        variance = np.asarray(stats.variance)
-        if self.correlation_type == "spearman":
-            corr = np.asarray(spearman_with_label(X, y))
-        else:
-            corr = np.asarray(pearson_with_label(X, y))
+        corr_dev = (spearman_with_label(X, y)
+                    if self.correlation_type == "spearman"
+                    else pearson_with_label(X, y))
+        # ONE stacked fetch for all per-column stats + correlations — each
+        # separate np.asarray costs a full device round trip
+        packed = np.asarray(jnp.stack([
+            jnp.asarray(stats.mean), jnp.asarray(stats.variance),
+            jnp.asarray(stats.min), jnp.asarray(stats.max),
+            jnp.asarray(corr_dev)]))
+        mean_h, variance, min_h, max_h, corr = packed
         corr = np.nan_to_num(corr)
 
         # label categorical? -> Cramér's V per categorical group
@@ -165,9 +172,9 @@ class SanityChecker(BinaryEstimator):
         col_stats_out = [
             ColumnStat(
                 name=col_names[j], parent_feature=parents[j],
-                mean=float(np.asarray(stats.mean)[j]), variance=float(variance[j]),
-                min=float(np.asarray(stats.min)[j]),
-                max=float(np.asarray(stats.max)[j]),
+                mean=float(mean_h[j]), variance=float(variance[j]),
+                min=float(min_h[j]),
+                max=float(max_h[j]),
                 corr_label=float(corr[j]),
                 cramers_v=(group_cv.get((vmeta.columns[j].parent_feature,
                                          vmeta.columns[j].grouping))

@@ -137,7 +137,10 @@ class ModelSelector(PredictorEstimator):
 
     # -- validation plumbing -------------------------------------------------
 
-    def _score_fn(self, model: PredictorModel, X: np.ndarray) -> np.ndarray:
+    def _score_fn(self, model: PredictorModel, X: np.ndarray):
+        dev = model.score_device(X, self.problem_type)
+        if dev is not None:
+            return dev                     # device array; metric stays lazy
         batch = model.predict_batch(X)
         if self.problem_type == "binary":
             if batch.probability is not None:
@@ -145,8 +148,18 @@ class ModelSelector(PredictorEstimator):
             return np.asarray(batch.raw_prediction)[:, 1]
         return np.asarray(batch.prediction)
 
-    def _metric(self, y, scores, w) -> float:
+    def _metric(self, y, scores, w):
+        """Fold metric; returns a DEVICE scalar when scores are device-
+        resident and the metric has a device kernel (validators fetch all
+        fold scalars in one stacked transfer), else a host float."""
+        import jax
+
         m = self.validation_metric
+        if isinstance(scores, jax.Array):
+            dev = self._metric_device(y, scores, w, m)
+            if dev is not None:
+                return dev
+            scores = np.asarray(scores)
         if self.problem_type == "binary":
             if m == "AuPR":
                 return float(aupr(y, scores, w))
@@ -158,6 +171,32 @@ class ModelSelector(PredictorEstimator):
             return multiclass_metrics(y.astype(int), scores.astype(int),
                                       n_classes, w)[m]
         return regression_metrics(y, scores, w)[m]
+
+    def _metric_device(self, y, scores, w, m: str):
+        import jax.numpy as jnp
+
+        from ..evaluators.metrics import _aupr_dev, _auroc_dev
+
+        if self.problem_type == "binary":
+            if m == "AuPR":
+                return _aupr_dev(y, scores, w)
+            if m == "AuROC":
+                return _auroc_dev(y, scores, w)
+            return None
+        if self.problem_type == "regression":
+            yj = jnp.asarray(y, jnp.float32)
+            wj = (jnp.ones_like(yj) if w is None
+                  else jnp.asarray(w, jnp.float32))
+            ws = jnp.maximum(wj.sum(), 1e-12)
+            err = scores - yj
+            if m == "RootMeanSquaredError":
+                return jnp.sqrt((wj * err ** 2).sum() / ws)
+            if m == "MeanSquaredError":
+                return (wj * err ** 2).sum() / ws
+            if m == "MeanAbsoluteError":
+                return (wj * jnp.abs(err)).sum() / ws
+            return None
+        return None
 
     @property
     def larger_better(self) -> bool:
@@ -171,6 +210,9 @@ class ModelSelector(PredictorEstimator):
             for params in grid_points:
                 def fitter(X, y, w, p, proto=proto):
                     est = proto.copy(**p)
+                    dev_score = est.fit_device(X, y, w, self.problem_type)
+                    if dev_score is not None:
+                        return dev_score   # device fit+score (no host sync)
                     model = est.fit_raw(X, y, w)
                     return lambda Xe: self._score_fn(model, Xe)
                 out.append((type(proto).__name__, params, fitter))
@@ -182,6 +224,20 @@ class ModelSelector(PredictorEstimator):
         return {"binary": DataBalancer(),
                 "multiclass": DataCutter(),
                 "regression": DataSplitter()}[self.problem_type]
+
+    def _depth_hint(self):
+        """Deepest tree depth across the grid: the whole sweep (and the final
+        refit) then shares ONE compiled tree-growth program, with each
+        candidate's true max_depth applied as a traced depth limit
+        (gbdt_kernels.compile_depth_hint)."""
+        depths = []
+        for proto, grid_points in self.models_and_params:
+            proto_d = getattr(proto, "max_depth", None)
+            for params in grid_points:
+                d = params.get("max_depth", proto_d)
+                if d is not None:
+                    depths.append(int(d))
+        return max(depths) if depths else None
 
     def find_best_estimator(self, data: ColumnarDataset,
                             during_dag) -> Tuple[str, Dict[str, Any]]:
@@ -203,15 +259,18 @@ class ModelSelector(PredictorEstimator):
         train_mask[train_idx] = True
         base_w = splitter.train_weights(y, train_mask)
 
+        from ..models.gbdt_kernels import compile_depth_hint
+
         sub = data.take(train_idx)
         candidates = self._candidates()
-        best_i, results = self.validator.validate_with_dag(
-            candidates, sub, during_dag,
-            label_name=label_name,
-            features_name=self.features_feature.name,
-            y=y[train_idx], base_weights=base_w[train_idx],
-            eval_fn=self._metric, metric_name=self.validation_metric,
-            larger_better=self.larger_better)
+        with compile_depth_hint(self._depth_hint()):
+            best_i, results = self.validator.validate_with_dag(
+                candidates, sub, during_dag,
+                label_name=label_name,
+                features_name=self.features_feature.name,
+                y=y[train_idx], base_weights=base_w[train_idx],
+                eval_fn=self._metric, metric_name=self.validation_metric,
+                larger_better=self.larger_better)
         best_name, best_params, _ = candidates[best_i]
         self.best_estimator = (best_name, best_params, results)
         # introspectable record of the fold-refit validation (survives the
@@ -232,24 +291,27 @@ class ModelSelector(PredictorEstimator):
         train_mask[train_idx] = True
         base_w = splitter.train_weights(y, train_mask)
 
-        if self.best_estimator is not None:
-            # consume the workflow-CV winner: a later fit on new data must
-            # validate afresh, not reuse a stale selection
-            best_name, best_params, results = self.best_estimator
-            self.best_estimator = None
-        else:
-            candidates = self._candidates()
-            best_i, results = self.validator.validate(
-                candidates, X, y, base_w,
-                eval_fn=self._metric, metric_name=self.validation_metric,
-                larger_better=self.larger_better)
-            best_name, best_params, _ = candidates[best_i]
+        from ..models.gbdt_kernels import compile_depth_hint
 
-        # refit best on the full training split (ModelSelector.fit :180)
-        best_proto = next(p for p, _ in self.models_and_params
-                          if type(p).__name__ == best_name)
-        best_est = best_proto.copy(**best_params)
-        best_model = best_est.fit_raw(X, y, base_w)
+        with compile_depth_hint(self._depth_hint()):
+            if self.best_estimator is not None:
+                # consume the workflow-CV winner: a later fit on new data must
+                # validate afresh, not reuse a stale selection
+                best_name, best_params, results = self.best_estimator
+                self.best_estimator = None
+            else:
+                candidates = self._candidates()
+                best_i, results = self.validator.validate(
+                    candidates, X, y, base_w,
+                    eval_fn=self._metric, metric_name=self.validation_metric,
+                    larger_better=self.larger_better)
+                best_name, best_params, _ = candidates[best_i]
+
+            # refit best on the full training split (ModelSelector.fit :180)
+            best_proto = next(p for p, _ in self.models_and_params
+                              if type(p).__name__ == best_name)
+            best_est = best_proto.copy(**best_params)
+            best_model = best_est.fit_raw(X, y, base_w)
 
         train_metrics = self._full_metrics(best_model, X, y, train_mask)
         holdout_metrics = (

@@ -127,9 +127,9 @@ class OpCrossValidation(_ValidatorBase):
         n = X.shape[0]
         folds = make_folds(n, self.num_folds, y=y, stratify=self.stratify,
                            seed=self.seed)
-        results: List[ValidationResult] = []
+        all_vals: List[List[Any]] = []
         for name, params, fitter in candidates:
-            fold_vals: List[float] = []
+            fold_vals: List[Any] = []
             for k in range(self.num_folds):
                 w_train = base_weights * (folds != k)
                 w_eval = base_weights * (folds == k)
@@ -137,7 +137,11 @@ class OpCrossValidation(_ValidatorBase):
                     continue
                 predict = fitter(X, y, w_train, params)
                 scores = predict(X)
-                fold_vals.append(float(eval_fn(y, scores, w_eval)))
+                fold_vals.append(eval_fn(y, scores, w_eval))
+            all_vals.append(fold_vals)
+        results: List[ValidationResult] = []
+        for (name, params, _), fold_vals in zip(candidates,
+                                                _materialize(all_vals)):
             mean = float(np.mean(fold_vals)) if fold_vals else float("-inf")
             results.append(ValidationResult(name, params, metric_name, mean,
                                             fold_vals))
@@ -162,14 +166,18 @@ class OpCrossValidation(_ValidatorBase):
                 data, during_dag, label_name, features_name, tr_idx, ev_idx)
             per_fold.append((X_tr, y_tr, base_weights[tr_idx],
                              X_ev, y_ev, base_weights[ev_idx]))
-        results: List[ValidationResult] = []
+        all_vals: List[List[Any]] = []
         for name, params, fitter in candidates:
-            fold_vals: List[float] = []
+            fold_vals: List[Any] = []
             for X_tr, y_tr, w_tr, X_ev, y_ev, w_ev in per_fold:
                 if w_tr.sum() == 0 or w_ev.sum() == 0:
                     continue
                 predict = fitter(X_tr, y_tr, w_tr, params)
-                fold_vals.append(float(eval_fn(y_ev, predict(X_ev), w_ev)))
+                fold_vals.append(eval_fn(y_ev, predict(X_ev), w_ev))
+            all_vals.append(fold_vals)
+        results: List[ValidationResult] = []
+        for (name, params, _), fold_vals in zip(candidates,
+                                                _materialize(all_vals)):
             mean = float(np.mean(fold_vals)) if fold_vals else float("-inf")
             results.append(ValidationResult(name, params, metric_name, mean,
                                             fold_vals))
@@ -204,15 +212,18 @@ class OpTrainValidationSplit(_ValidatorBase):
                  larger_better=True):
         n = X.shape[0]
         in_train = self._split_mask(n, y)
-        results: List[ValidationResult] = []
+        all_vals: List[List[Any]] = []
         for name, params, fitter in candidates:
             w_train = base_weights * in_train
             w_eval = base_weights * (~in_train)
             predict = fitter(X, y, w_train, params)
             scores = predict(X)
-            val = float(eval_fn(y, scores, w_eval))
-            results.append(ValidationResult(name, params, metric_name, val,
-                                            [val]))
+            all_vals.append([eval_fn(y, scores, w_eval)])
+        results: List[ValidationResult] = []
+        for (name, params, _), vals in zip(candidates,
+                                           _materialize(all_vals)):
+            results.append(ValidationResult(name, params, metric_name,
+                                            vals[0], vals))
         best = _argbest([r.metric_value for r in results], larger_better)
         return best, results
 
@@ -226,12 +237,15 @@ class OpTrainValidationSplit(_ValidatorBase):
         X_tr, y_tr, X_ev, y_ev = self._fold_matrices(
             data, during_dag, label_name, features_name, tr_idx, ev_idx)
         w_tr, w_ev = base_weights[tr_idx], base_weights[ev_idx]
-        results: List[ValidationResult] = []
+        all_vals: List[List[Any]] = []
         for name, params, fitter in candidates:
             predict = fitter(X_tr, y_tr, w_tr, params)
-            val = float(eval_fn(y_ev, predict(X_ev), w_ev))
-            results.append(ValidationResult(name, params, metric_name, val,
-                                            [val]))
+            all_vals.append([eval_fn(y_ev, predict(X_ev), w_ev)])
+        results: List[ValidationResult] = []
+        for (name, params, _), vals in zip(candidates,
+                                           _materialize(all_vals)):
+            results.append(ValidationResult(name, params, metric_name,
+                                            vals[0], vals))
         best = _argbest([r.metric_value for r in results], larger_better)
         return best, results
 
@@ -242,3 +256,42 @@ def _argbest(vals: List[float], larger_better: bool) -> int:
         arr = -arr
     arr = np.where(np.isnan(arr), -np.inf, arr)
     return int(np.argmax(arr))
+
+
+def _materialize(nested: List[List[Any]]) -> List[List[float]]:
+    """Fetch all fold metric values in ONE device transfer.
+
+    ``eval_fn`` returns device scalars on the device-resident sweep path
+    (ModelSelector._metric); through a remote-TPU tunnel every host sync is a
+    ~0.6 s round trip, so the whole candidates×folds sweep is dispatched
+    async and this single stacked fetch replaces per-fold ``float()`` calls.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+        dev = [v for vals in nested for v in vals
+               if isinstance(v, jax.Array)]
+    except Exception:  # pragma: no cover
+        dev = []
+    if not dev:
+        return [[float(v) for v in vals] for vals in nested]
+    # jitted stack: un-jitted jnp.stack dispatches one expand_dims per
+    # scalar (~30 ms tunnel dispatch each); jitted it is ONE launch
+    stacked = _stack_jit(*dev)
+    host = iter(np.asarray(stacked, np.float64))
+    return [[float(next(host)) if isinstance(v, jax.Array) else float(v)
+             for v in vals] for vals in nested]
+
+
+def _stack_jit(*xs):
+    # module-level jit so the executable caches per arity (a fresh lambda
+    # per call would re-trace and re-compile every validate)
+    global _STACK_JIT
+    if _STACK_JIT is None:
+        import jax
+        import jax.numpy as jnp
+        _STACK_JIT = jax.jit(lambda *ys: jnp.stack(ys))
+    return _STACK_JIT(*xs)
+
+
+_STACK_JIT = None

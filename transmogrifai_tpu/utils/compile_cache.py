@@ -1,0 +1,62 @@
+"""Persistent XLA compilation cache enablement.
+
+The AutoML sweep's wall-clock on a tunneled TPU is dominated by XLA
+compile time (the Titanic sweep compiles ~28 programs, ~50 s). JAX's
+persistent compilation cache eliminates that on every run after the first,
+but two things stand in the way on this backend:
+
+* the cache dir config is only honored via ``jax.config.update`` (the
+  ``JAX_COMPILATION_CACHE_DIR`` env var is not read by this jax version), and
+* the experimental tunneled-TPU platform is not in JAX's platform allowlist,
+  so the cache silently disables itself even though the backend supports
+  executable serialization (verified: serialized executables round-trip and
+  deserialized programs produce identical results).
+
+``enable_persistent_cache`` handles both. Spark-analogue: the reference has
+no equivalent (the JVM JITs per process); this is TPU-specific plumbing.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["enable_persistent_cache"]
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+_enabled = False
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            min_compile_secs: float = 0.15) -> bool:
+    """Turn on the persistent compilation cache; safe to call repeatedly.
+
+    Returns True if the cache is (now) enabled. Call before the first
+    compilation for full effect; programs compiled earlier in the process
+    are not retroactively cached.
+    """
+    global _enabled
+    if _enabled:
+        return True
+    try:
+        import jax
+        import jax._src.compilation_cache as cc
+
+        path = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                           _DEFAULT_DIR)
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        # Platforms outside JAX's allowlist (e.g. the tunneled-TPU plugin)
+        # disable the cache during the first compile; pre-mark it usable.
+        # Correctness still depends on executable serialization, which the
+        # put/get path verifies per entry.
+        with cc._cache_initialized_mutex:
+            cc._cache_checked = True
+            cc._cache_used = True
+        _enabled = True
+    except Exception:  # pragma: no cover - cache is an optimization only
+        return False
+    return True

@@ -38,9 +38,20 @@ class VersionInfo:
 
 def _git(*args: str) -> Optional[str]:
     import os
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], capture_output=True,
+            text=True, timeout=5, cwd=pkg_dir)
+        # only stamp git info for a development checkout of THIS framework —
+        # a pip install inside someone else's repo (./venv under a project
+        # root) would otherwise resolve the user's repo HEAD
+        if (top.returncode != 0 or not top.stdout.strip() or not
+                os.path.isdir(os.path.join(top.stdout.strip(),
+                                           "transmogrifai_tpu"))):
+            return None
         out = subprocess.run(["git", *args], capture_output=True, text=True,
-                             timeout=5, cwd=os.path.dirname(__file__))
+                             timeout=5, cwd=pkg_dir)
         return out.stdout.strip() or None if out.returncode == 0 else None
     except (OSError, subprocess.TimeoutExpired):  # pragma: no cover
         return None
