@@ -1,0 +1,100 @@
+"""Evaluator metric golden values + host/device parity.
+
+Reference: OpBinaryClassificationEvaluatorTest / OpRegressionEvaluatorTest
+coverage (SURVEY §4); values below are hand-computed.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.metrics import (
+    _aupr_dev, _auroc_dev, aupr, auroc, binary_classification_metrics,
+    brier_score, log_loss, multiclass_metrics, regression_metrics,
+)
+
+
+class TestBinaryGolden:
+    def test_perfect_separation(self):
+        y = np.array([0.0, 0, 1, 1])
+        s = np.array([0.1, 0.2, 0.8, 0.9])
+        assert auroc(y, s) == pytest.approx(1.0)
+        assert aupr(y, s) == pytest.approx(1.0)
+
+    def test_reversed_scores(self):
+        y = np.array([0.0, 1])
+        s = np.array([0.9, 0.1])
+        assert auroc(y, s) == pytest.approx(0.0)
+
+    def test_known_auroc(self):
+        # 1 positive above 1 of 2 negatives: P(s+ > s-) = 0.5
+        y = np.array([0.0, 1, 0])
+        s = np.array([0.3, 0.5, 0.7])
+        assert auroc(y, s) == pytest.approx(0.5)
+
+    def test_ties_half_credit(self):
+        y = np.array([0.0, 1])
+        s = np.array([0.5, 0.5])
+        assert auroc(y, s) == pytest.approx(0.5)
+
+    def test_weighted_auroc(self):
+        # weight-2 negative below the positive, weight-1 negative above:
+        # num = 1*2 /(1*3) = 2/3
+        y = np.array([0.0, 1, 0])
+        s = np.array([0.1, 0.5, 0.9])
+        w = np.array([2.0, 1.0, 1.0])
+        assert auroc(y, s, w) == pytest.approx(2 / 3)
+
+    def test_aupr_average_precision(self):
+        # order by score desc: y=1,0,1 -> precision at positives: 1, 2/3
+        # AP = (1 + 2/3)/2
+        y = np.array([1.0, 0, 1])
+        s = np.array([0.9, 0.8, 0.7])
+        assert aupr(y, s) == pytest.approx((1 + 2 / 3) / 2)
+
+    def test_brier_and_logloss(self):
+        y = np.array([1.0, 0.0])
+        p = np.array([0.8, 0.4])
+        assert brier_score(y, p) == pytest.approx((0.04 + 0.16) / 2)
+        assert log_loss(y, p) == pytest.approx(
+            -(np.log(0.8) + np.log(0.6)) / 2)
+
+    def test_full_metric_dict(self):
+        y = np.array([0.0, 0, 1, 1, 1, 0])
+        p = np.array([0.2, 0.6, 0.7, 0.9, 0.3, 0.1])
+        m = binary_classification_metrics(y, p)
+        # threshold 0.5: TP=2 FP=1 FN=1 TN=2
+        assert m["Precision"] == pytest.approx(2 / 3)
+        assert m["Recall"] == pytest.approx(2 / 3)
+        assert m["Error"] == pytest.approx(2 / 6)
+
+
+class TestHostDeviceParity:
+    def test_aupr_auroc_parity_random(self):
+        rng = np.random.default_rng(3)
+        for n in (10, 257):
+            y = (rng.random(n) < 0.3).astype(np.float64)
+            s = np.round(rng.random(n), 2)          # force ties
+            w = rng.integers(1, 4, n).astype(np.float64)
+            assert float(_auroc_dev(y, s, w)) == pytest.approx(
+                auroc(y, s, w), abs=1e-5)
+            assert float(_aupr_dev(y, s, w)) == pytest.approx(
+                aupr(y, s, w), abs=1e-5)
+
+
+class TestRegressionMulticlassGolden:
+    def test_regression_values(self):
+        y = np.array([1.0, 2.0, 3.0])
+        p = np.array([1.5, 2.0, 2.5])
+        m = regression_metrics(y, p)
+        assert m["RootMeanSquaredError"] == pytest.approx(
+            np.sqrt(0.25 / 1.5))
+        assert m["MeanAbsoluteError"] == pytest.approx(1 / 3)
+        assert m["R2"] == pytest.approx(1 - 0.5 / 2.0)
+
+    def test_multiclass_f1(self):
+        y = np.array([0, 0, 1, 1, 2, 2])
+        p = np.array([0, 1, 1, 1, 2, 0])
+        m = multiclass_metrics(y, p, 3)
+        assert m["Error"] == pytest.approx(2 / 6)
+        # per-class precision: c0 1/2, c1 2/3, c2 1/1
+        assert m["Precision"] == pytest.approx(
+            (0.5 * 2 + 2 / 3 * 2 + 1.0 * 2) / 6)

@@ -1,0 +1,157 @@
+"""Property-based tests over testkit random generators.
+
+Reference: the testkit ``Random*`` generators + "property-based tests for
+regression model selection" (CHANGELOG.md:16; SURVEY §4).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import OpWorkflow, transmogrify
+from transmogrifai_tpu.aggregators import default_aggregator
+from transmogrifai_tpu.models import OpLinearRegression, OpLogisticRegression
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, RegressionModelSelector, grid,
+)
+from transmogrifai_tpu.testkit import (
+    RandomBinary, RandomIntegral, RandomMap, RandomPickList, RandomReal,
+    RandomText, TestFeatureBuilder,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+SEEDS = [1, 7, 13]
+
+
+class TestTransmogrifyProperties:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_mixed_random_data_vectorizes_finite(self, seed):
+        n = 80
+        data, feats = TestFeatureBuilder.random(
+            n,
+            ("r", ft.Real, RandomReal.normal(seed=seed)
+             .with_probability_of_empty(0.2)),
+            ("i", ft.Integral, RandomIntegral(0, 9, seed=seed)
+             .with_probability_of_empty(0.1)),
+            ("b", ft.Binary, RandomBinary(0.4, seed=seed)),
+            ("p", ft.PickList,
+             RandomPickList(["a", "b", "c"], seed=seed)
+             .with_probability_of_empty(0.3)),
+            ("t", ft.Text, RandomText(seed=seed)
+             .with_probability_of_empty(0.2)),
+            ("m", ft.RealMap,
+             RandomMap(RandomReal.normal(seed=seed), ["k1", "k2"],
+                       seed=seed).with_probability_of_empty(0.2)),
+        )
+        vec = transmogrify(feats)
+        wf_data = data
+        stage = vec.origin_stage
+        # fit the whole transmogrify sub-DAG by materializing through a
+        # workflow-less direct evaluation
+        from transmogrifai_tpu.workflow.dag import (
+            compute_dag, fit_and_transform_dag,
+        )
+        dag = compute_dag([vec])
+        _, out, _ = fit_and_transform_dag(dag, wf_data)
+        col = out[vec.name]
+        X = np.asarray(col.values, np.float32)
+        assert X.shape[0] == n and X.shape[1] > 0
+        assert np.isfinite(X).all(), "vectorized matrix must be finite"
+        assert col.vmeta is not None and col.vmeta.size == X.shape[1], \
+            "every slot must carry column metadata"
+        parents = {c.parent_feature for c in col.vmeta.columns}
+        assert {"r", "i", "b", "p", "t", "m"} <= parents
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_null_tracking_matches_input_nulls(self, seed):
+        n = 60
+        vals = RandomReal.normal(seed=seed).with_probability_of_empty(0.4).take(n)
+        data, (f,) = TestFeatureBuilder.build(("r", ft.Real, vals))
+        from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+        v = RealVectorizer(track_nulls=True)
+        v.set_input(f)
+        out = v.fit(data).transform_columns(data["r"])
+        X = np.asarray(out.values, np.float32)
+        null_col = next(i for i, c in enumerate(out.vmeta.columns)
+                        if c.is_null_indicator)
+        expect = np.array([1.0 if x is None else 0.0 for x in vals])
+        np.testing.assert_allclose(X[:, null_col], expect)
+
+
+class TestModelSelectionProperties:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_regression_recovers_linear_signal(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 250
+        x1, x2 = rng.normal(size=n), rng.normal(size=n)
+        y = 2.0 * x1 - 1.0 * x2 + 0.05 * rng.normal(size=n)
+        data, feats = TestFeatureBuilder.build(
+            ("y", ft.RealNN, list(y)), ("x1", ft.Real, list(x1)),
+            ("x2", ft.Real, list(x2)), response="y")
+        resp, preds = feats[0], feats[1:]
+        vec = transmogrify(preds)
+        sel = RegressionModelSelector.with_train_validation_split(
+            models_and_parameters=[
+                (OpLinearRegression(), grid(reg_param=[0.0, 0.1]))])
+        pred = sel.set_input(resp, vec).get_output()
+        import pandas as pd
+        df = pd.DataFrame({"y": y, "x1": x1, "x2": x2})
+        model = OpWorkflow().set_result_features(pred).set_input_data(df).train()
+        summary = next(s.metadata["model_selector_summary"]
+                       for s in model.stages
+                       if "model_selector_summary" in s.metadata)
+        rmse = summary["holdoutMetrics"].get("RootMeanSquaredError", 99.0)
+        assert rmse < 0.5, f"seed {seed}: rmse {rmse}"
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_binary_beats_chance_on_signal(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 250
+        x = rng.normal(size=n)
+        noise = rng.normal(size=n)
+        label = ((x + 0.3 * noise) > 0).astype(float)
+        import pandas as pd
+        df = pd.DataFrame({"label": label, "x": x, "noise": noise})
+        from transmogrifai_tpu import FeatureBuilder
+        resp = FeatureBuilder.RealNN("label").as_response()
+        preds = [FeatureBuilder.Real("x").as_predictor(),
+                 FeatureBuilder.Real("noise").as_predictor()]
+        vec = transmogrify(preds)
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[
+                (OpLogisticRegression(), grid(reg_param=[0.01]))])
+        pred = sel.set_input(resp, vec).get_output()
+        model = OpWorkflow().set_result_features(pred).set_input_data(df).train()
+        summary = next(s.metadata["model_selector_summary"]
+                       for s in model.stages
+                       if "model_selector_summary" in s.metadata)
+        auroc = summary["holdoutMetrics"].get("AuROC", 0.0)
+        assert auroc > 0.8, f"seed {seed}: auroc {auroc}"
+
+
+class TestAggregatorProperties:
+    @pytest.mark.parametrize("ftype", [ft.Real, ft.Integral, ft.Binary,
+                                       ft.Text, ft.TextList, ft.MultiPickList,
+                                       ft.RealMap, ft.Date])
+    def test_monoid_associativity(self, ftype):
+        agg = default_aggregator(ftype)
+        gens = {
+            ft.Real: RandomReal.normal(seed=5),
+            ft.Integral: RandomIntegral(0, 9, seed=5),
+            ft.Binary: RandomBinary(0.5, seed=5),
+            ft.Text: RandomText(seed=5),
+            ft.TextList: None, ft.MultiPickList: None, ft.RealMap: None,
+            ft.Date: RandomIntegral(1, 10**9, seed=5),
+        }
+        gen = gens[ftype]
+        if gen is not None:
+            vals = [v for v in gen.take(9) if v is not None]
+        elif ftype is ft.TextList:
+            vals = [["a"], ["b", "c"], ["d"]] * 3
+        elif ftype is ft.MultiPickList:
+            vals = [{"a"}, {"b"}, {"a", "c"}] * 3
+        else:
+            vals = [{"k": 1.0}, {"k": 2.0}, {"j": 3.0}] * 3
+        prepared = [agg.prepare(v) for v in vals]
+        a = agg.plus(agg.plus(prepared[0], prepared[1]), prepared[2])
+        b = agg.plus(prepared[0], agg.plus(prepared[1], prepared[2]))
+        assert a == b or (isinstance(a, float)
+                          and a == pytest.approx(b)), ftype
