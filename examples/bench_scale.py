@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Scale benchmark — BASELINE.md config 4: synthetic wide tabular binary
+AutoML sweep (default 1M rows x 100 features; --full for the 1M x 500
+headline shape).
+
+Reproduces the reference's BinaryClassificationModelSelector sweep (LR + RF
+grids, 3-fold CV, AuPR) on synthetic data with planted signal, end to end
+through OpWorkflow.train() — feature engineering, SanityChecker, CV sweep,
+final refit.
+
+Prints ONE JSON line like bench.py.  Baseline: 32-core Spark-local runs of
+the same selector on 1M rows take tens of minutes (no published number —
+SURVEY §6); the 1800 s figure below is our recorded assumption, stated in
+the output.
+
+Usage: python examples/bench_scale.py [--rows N] [--cols D] [--full]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from transmogrifai_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+SPARK_LOCAL_BASELINE_S = 1800.0
+
+
+def make_data(rows: int, cols: int, seed: int = 11):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    beta = np.zeros(cols, np.float32)
+    informative = rng.choice(cols, max(3, cols // 20), replace=False)
+    beta[informative] = rng.normal(size=len(informative)) * 1.5
+    z = X @ beta + 0.5 * rng.normal(size=rows).astype(np.float32)
+    y = (1 / (1 + np.exp(-z)) > rng.random(rows)).astype(np.float32)
+    df = pd.DataFrame(X, columns=[f"f{j}" for j in range(cols)])
+    df.insert(0, "label", y)
+    return df
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--cols", type=int, default=100)
+    ap.add_argument("--full", action="store_true",
+                    help="BASELINE config 4 headline shape (1M x 500)")
+    ap.add_argument("--folds", type=int, default=3)
+    args = ap.parse_args()
+    if args.full:
+        args.rows, args.cols = 1_000_000, 500
+
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpRandomForestClassifier,
+    )
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, grid,
+    )
+
+    t0 = time.perf_counter()
+    df = make_data(args.rows, args.cols)
+    gen_s = time.perf_counter() - t0
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    preds = [FeatureBuilder.Real(c).as_predictor() for c in df.columns[1:]]
+    features = transmogrify(preds)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        label, features).get_output()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=args.folds,
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
+            (OpRandomForestClassifier(num_trees=20),
+             grid(max_depth=[4, 6], min_instances_per_node=[10, 100])),
+        ])
+    prediction = selector.set_input(label, checked).get_output()
+    wf = OpWorkflow().set_result_features(prediction).set_input_data(df)
+
+    t0 = time.perf_counter()
+    model = wf.train()
+    train_s = time.perf_counter() - t0
+
+    _, metrics = model.score_and_evaluate(
+        Evaluators.BinaryClassification.auPR())
+    print(json.dumps({
+        "metric": "scale_automl_train_wall_clock",
+        "rows": args.rows, "cols": args.cols,
+        "value": round(train_s, 1), "unit": "s",
+        "vs_baseline": round(SPARK_LOCAL_BASELINE_S / train_s, 2),
+        "aupr": round(float(metrics["AuPR"]), 4),
+        "auroc": round(float(metrics["AuROC"]), 4),
+        "datagen_s": round(gen_s, 1),
+        "baseline_s_assumed": SPARK_LOCAL_BASELINE_S,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,16 @@ def _device_sigmoid_score(X, coef, intercept):
     return jax.nn.sigmoid(X @ coef + intercept)
 
 
+@jax.jit
+def _device_standardize(X, mu, sigma):
+    return (X - mu) / sigma
+
+
+@jax.jit
+def _device_std_sigmoid_score(X, mu, sigma, coef, intercept):
+    return jax.nn.sigmoid(((X - mu) / sigma) @ coef + intercept)
+
+
 class OpLogisticRegression(PredictorEstimator):
     """L2/elastic-net logistic regression trained by jitted Newton-IRLS.
 
@@ -74,22 +84,31 @@ class OpLogisticRegression(PredictorEstimator):
 
     def fit_device(self, X, y, w, problem_type: str):
         """Sweep path: Newton-IRLS fit and sigmoid scores stay on device
-        (binary only) — no coefficient fetch per candidate."""
+        (binary only) — no coefficient fetch per candidate, and the feature
+        matrix uploads ONCE (content-memoized); per-fold standardization is
+        a device elementwise op, not a fresh host matrix + upload."""
         if problem_type != "binary" or (len(y) and np.nanmax(y) > 1):
             return None
+        from .trees import _dev_memo
+
         mu, sigma = (_standardize_stats(X, w) if self.standardization
                      else (None, None))
+        X_dev = _dev_memo(np.asarray(X, np.float32), "lr_X")
+        Xs = (_device_standardize(X_dev, jnp.asarray(mu), jnp.asarray(sigma))
+              if mu is not None else X_dev)
         fit = fit_logistic_regression(
-            _apply_standardize(X, mu, sigma), y, sample_weight=w,
-            reg_param=self.reg_param,
+            Xs, y, sample_weight=w, reg_param=self.reg_param,
             elastic_net_param=self.elastic_net_param,
             max_iter=self.max_iter, tol=self.tol,
             fit_intercept=self.fit_intercept)
 
         def score(Xe):
-            Xes = _apply_standardize(np.asarray(Xe, np.float32), mu, sigma)
-            return _device_sigmoid_score(jnp.asarray(Xes), fit.coef,
-                                         fit.intercept)
+            Xe_dev = _dev_memo(np.asarray(Xe, np.float32), "lr_X")
+            if mu is None:
+                return _device_sigmoid_score(Xe_dev, fit.coef, fit.intercept)
+            return _device_std_sigmoid_score(
+                Xe_dev, jnp.asarray(mu), jnp.asarray(sigma), fit.coef,
+                fit.intercept)
         return score
 
     def fit_raw(self, X: np.ndarray, y: np.ndarray,

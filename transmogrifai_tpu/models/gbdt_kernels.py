@@ -67,6 +67,12 @@ def _resolve_compile_depth(max_depth: int) -> int:
     return max_depth
 
 
+#: rows per histogram block in the streamed build; the per-block bins
+#: one-hot is ROW_BLOCK × B·D f32 per tree under vmap — 2.1 GB at 500
+#: features × 32 bins, 0.4 GB at 100 features (forest_chunk_size budgets it)
+ROW_BLOCK = 32768
+
+
 class TreeEnsemble(NamedTuple):
     """Stacked trees: feat (T, 2^d-1) int32, thresh (T, 2^d-1) int32,
     leaf (T, 2^d, K) float32.  Heap layout: node i children 2i+1, 2i+2."""
@@ -156,9 +162,25 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     dot_prec = (jax.lax.Precision.DEFAULT if hist_bf16
                 else jax.lax.Precision.HIGHEST)
 
-    # (N, B·D) one-hot of each row's bin per feature, minor axis = features
-    onehot_bins = (binned[:, None, :] == jnp.arange(B)[None, :, None]
-                   ).astype(jnp.float32).reshape(n, B * d)
+    # Row-blocked histogram build: the bins one-hot is (rows, B·D) f32 — at
+    # 1M×500×32 bins that is 64 GB if materialized whole, so rows stream
+    # through in blocks with the (M, B·D) accumulators carried by lax.scan.
+    # Small inputs keep the single hoisted one-hot (no scan overhead).
+    blocked = n > ROW_BLOCK
+    if blocked:
+        n_blocks = -(-n // ROW_BLOCK)
+        n_pad = n_blocks * ROW_BLOCK
+        pad = n_pad - n
+        binned_blk = jnp.pad(binned, ((0, pad), (0, 0))).reshape(
+            n_blocks, ROW_BLOCK, d)
+        # padded rows carry zero channel weight: they land in slot 0 bin 0
+        # and contribute nothing
+        chans_blk = jnp.pad(jnp.stack(chans, 1), ((0, pad), (0, 0))).reshape(
+            n_blocks, ROW_BLOCK, 2 * k + 1)
+    else:
+        # (N, B·D) one-hot, minor axis = features (128-lane tile friendly)
+        onehot_bins = (binned[:, None, :] == jnp.arange(B)[None, :, None]
+                       ).astype(jnp.float32).reshape(n, B * d)
 
     node = jnp.zeros(n, jnp.int32)
     heap_feat_levels, heap_thresh_levels = [], []
@@ -184,14 +206,36 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
             uniq = jnp.arange(M, dtype=jnp.int32)
             slot = node
 
-        onehot_node = (slot[:, None] == jnp.arange(M)[None, :]
-                       ).astype(jnp.float32)          # (N, M)
-        hists = [jax.lax.dot(
-                     (onehot_node * ch[:, None]).T, onehot_bins,
-                     precision=dot_prec,
-                     preferred_element_type=jnp.float32,
-                 ).reshape(M, B, d)
-                 for ch in chans]                     # 2K+1 × (M, B, D)
+        if blocked:
+            slot_blk = jnp.pad(slot, (0, n_pad - n)).reshape(
+                n_blocks, ROW_BLOCK)
+
+            def hist_block(acc, xs):
+                slot_b, binned_b, ch_b = xs
+                oh_bins = (binned_b[:, None, :] == jnp.arange(B)[None, :, None]
+                           ).astype(jnp.float32).reshape(ROW_BLOCK, B * d)
+                oh_node = (slot_b[:, None] == jnp.arange(M)[None, :]
+                           ).astype(jnp.float32)       # (RB, M)
+                part = jnp.stack([
+                    jax.lax.dot((oh_node * ch_b[:, c][:, None]).T, oh_bins,
+                                precision=dot_prec,
+                                preferred_element_type=jnp.float32)
+                    for c in range(2 * k + 1)])        # (2K+1, M, B·D)
+                return acc + part, None
+
+            acc0 = jnp.zeros((2 * k + 1, M, B * d), jnp.float32)
+            hist_stack, _ = lax.scan(
+                hist_block, acc0, (slot_blk, binned_blk, chans_blk))
+            hists = [hist_stack[c].reshape(M, B, d) for c in range(2 * k + 1)]
+        else:
+            onehot_node = (slot[:, None] == jnp.arange(M)[None, :]
+                           ).astype(jnp.float32)      # (N, M)
+            hists = [jax.lax.dot(
+                         (onehot_node * ch[:, None]).T, onehot_bins,
+                         precision=dot_prec,
+                         preferred_element_type=jnp.float32,
+                     ).reshape(M, B, d)
+                     for ch in chans]                 # 2K+1 × (M, B, D)
         GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
         HLs = [jnp.cumsum(h, axis=1) for h in hists[k:2 * k]]
         CL = jnp.cumsum(hists[2 * k], axis=1)
@@ -325,9 +369,13 @@ def forest_chunk_size(n_trees: int, max_depth: int, d: int, n_bins: int,
         slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))
     per_tree = int(slots * d * n_bins * (2 * k + 1) * 4 * 1.3)
     if n_rows is not None:
-        # matmul-histogram operands: the per-tree (N, slots) node one-hot and
-        # its (slots, B·D) product partner are live together under vmap
-        per_tree += int(n_rows * slots * 4 * 1.3)
+        # matmul-histogram operands live per tree under vmap: the per-block
+        # (rows, slots) node one-hot and (rows, B·D) bins one-hot (rows
+        # streamed in ROW_BLOCK chunks past that size)
+        rows = min(n_rows, ROW_BLOCK)
+        per_tree += int(rows * slots * 4 * 1.3)
+        if n_rows > ROW_BLOCK:
+            per_tree += int(rows * n_bins * d * 4 * 1.3)
     return int(np.clip(budget // max(per_tree, 1), 1, n_trees))
 
 

@@ -57,21 +57,29 @@ class OpLinearRegression(PredictorEstimator):
         return LinearRegressionModel(coef=coef.tolist(), intercept=float(intercept))
 
     def fit_device(self, X, y, w, problem_type: str):
-        """Sweep path: fit + linear predict stay on device (no coef fetch)."""
+        """Sweep path: fit + linear predict stay on device (no coef fetch;
+        matrix uploads once, standardization is a device op)."""
         if problem_type != "regression":
             return None
+        from .classification import _device_standardize
+        from .trees import _dev_memo
+
         mu, sigma = (_standardize_stats(X, w) if self.standardization
                      else (None, None))
+        X_dev = _dev_memo(np.asarray(X, np.float32), "lin_X")
+        Xs = (_device_standardize(X_dev, jnp.asarray(mu), jnp.asarray(sigma))
+              if mu is not None else X_dev)
         fit = fit_linear_regression(
-            _apply_standardize(X, mu, sigma), y, sample_weight=w,
-            reg_param=self.reg_param,
+            Xs, y, sample_weight=w, reg_param=self.reg_param,
             elastic_net_param=self.elastic_net_param, max_iter=self.max_iter,
             tol=self.tol, fit_intercept=self.fit_intercept)
 
         def score(Xe):
-            Xes = _apply_standardize(np.asarray(Xe, np.float32), mu, sigma)
-            return _device_linear_score(jnp.asarray(Xes), fit.coef,
-                                        fit.intercept)
+            Xe_dev = _dev_memo(np.asarray(Xe, np.float32), "lin_X")
+            Xes = (_device_standardize(Xe_dev, jnp.asarray(mu),
+                                       jnp.asarray(sigma))
+                   if mu is not None else Xe_dev)
+            return _device_linear_score(Xes, fit.coef, fit.intercept)
         return score
 
 

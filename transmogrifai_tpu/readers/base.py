@@ -53,7 +53,13 @@ class DataFrameReader(Reader):
             gen = f.origin_stage
             assert isinstance(gen, FeatureGeneratorStage)
             if gen.extract_fn is None:
-                vals = self.df[f.name].tolist()
+                series = self.df[f.name]
+                # ndarray fast path for numeric dtypes; object/string columns
+                # go through the per-value converter (None handling)
+                if series.dtype.kind in "fiub":
+                    vals = series.to_numpy()
+                else:
+                    vals = series.tolist()
                 cols[f.name] = FeatureColumn.from_values(f.ftype, vals)
             else:
                 if records is None:
