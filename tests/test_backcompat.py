@@ -1,0 +1,67 @@
+"""Backwards-compatibility: load a checked-in v1 model artifact and score.
+
+Reference parity: OpWorkflowModelReaderWriterTest loads committed
+OldModelVersion op-model.json fixtures (SURVEY §4) so format changes can't
+silently orphan saved models.  The fixture under tests/fixtures/model_v1
+was produced by format v1 (transmogrify + SanityChecker + selected model)
+with its expected scores frozen beside it.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+
+from transmogrifai_tpu.local import load_model_local, score_function
+from transmogrifai_tpu.preparators import MinVarianceFilter
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import OpWorkflowModel
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class TestModelBackCompat:
+    def test_v1_artifact_loads_and_reproduces_scores(self):
+        model = OpWorkflowModel.load(os.path.join(FIXTURES, "model_v1"))
+        df = pd.read_csv(os.path.join(FIXTURES, "model_v1_input.csv"))
+        expected = np.load(os.path.join(FIXTURES, "model_v1_expected.npy"))
+        pred_name = model.result_features[0].name
+        scored = model.score(df)
+        got = np.asarray(scored[pred_name].values.probability[:, 1])
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+    def test_v1_artifact_scores_locally(self):
+        model = load_model_local(os.path.join(FIXTURES, "model_v1"))
+        df = pd.read_csv(os.path.join(FIXTURES, "model_v1_input.csv"))
+        expected = np.load(os.path.join(FIXTURES, "model_v1_expected.npy"))
+        # local scorer returns the prediction map; compare probability_1
+        score_fn = score_function(model)
+        for i, row in enumerate(df.to_dict("records")[:5]):
+            out = score_fn(row)
+            (pred_map,) = out.values()
+            assert abs(pred_map["probability_1"] - expected[i]) < 1e-5
+
+
+class TestMinVarianceFilter:
+    def test_drops_constant_keeps_varying(self):
+        from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+        data, feats = TestFeatureBuilder.build(
+            ("varying", ft.Real, [1.0, 5.0, 3.0, 8.0, 2.0, 9.0]),
+            ("constant", ft.Real, [2.0, 2.0, 2.0, 2.0, 2.0, 2.0]),
+            ("label", ft.RealNN, [0.0, 1.0, 0.0, 1.0, 0.0, 1.0]),
+            response="label")
+        label_f = feats[2]
+        vec_stage = RealVectorizer(track_nulls=False)
+        vec_stage.set_input(feats[0], feats[1])
+        vec_model = vec_stage.fit(data)
+        vec_col = vec_model.transform_columns(data["varying"],
+                                              data["constant"])
+        mvf = MinVarianceFilter(min_variance=1e-3)
+        mvf.set_input(label_f, feats[0])    # label unused by the filter
+        model = mvf.fit_columns(data, data["label"], vec_col)
+        out = model.transform_columns(data["label"], vec_col)
+        X = np.asarray(out.values, np.float32)
+        assert X.shape[1] == 1              # constant slot dropped
+        kept = [c.parent_feature for c in out.vmeta.columns]
+        assert kept == ["varying"]

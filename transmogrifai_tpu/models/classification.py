@@ -177,28 +177,42 @@ class LogisticRegressionModel(PredictorModel):
 
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
         from .. import native
-        coef = jnp.asarray(self.coef, jnp.float32)
-        if coef.ndim == 1:
-            # small-batch serving: native C kernel skips JAX dispatch latency
-            if native.AVAILABLE and len(X) <= 4096:
-                beta = np.append(np.asarray(self.coef, np.float32),
-                                 np.float32(self.intercept))
-                z = native.linear_margin(np.asarray(X, np.float32), beta)
-                p1 = native.sigmoid(z)
+        coef = np.asarray(self.coef, np.float32)
+        if isinstance(X, np.ndarray):
+            # host path: a dot + sigmoid is host-BLAS territory — shipping a
+            # 1M-row matrix to the device just to predict costs ~70 s of
+            # tunnel upload (device scoring is for device-resident inputs)
+            if coef.ndim == 1:
+                if native.AVAILABLE and len(X) <= 4096:
+                    beta = np.append(coef, np.float32(self.intercept))
+                    z = native.linear_margin(np.asarray(X, np.float32), beta)
+                else:
+                    z = np.asarray(X, np.float32) @ coef + np.float32(
+                        self.intercept)
+                with np.errstate(over="ignore"):
+                    p1 = 1.0 / (1.0 + np.exp(-z))
                 proba = np.stack([1.0 - p1, p1], axis=1)
                 return PredictionBatch(
                     prediction=(p1 >= 0.5).astype(np.float64),
                     raw_prediction=np.stack([-z, z], axis=1),
                     probability=proba)
+            Z = (np.asarray(X, np.float32) @ coef.T
+                 + np.asarray(self.intercept, np.float32))
+            e = np.exp(Z - Z.max(axis=1, keepdims=True))
+            proba = e / e.sum(axis=1, keepdims=True)
+            return PredictionBatch(
+                prediction=proba.argmax(axis=1).astype(np.float64),
+                raw_prediction=Z, probability=proba)
+        if coef.ndim == 1:
             proba, raw = logreg_predict_proba(
-                coef, jnp.float32(self.intercept), X)
+                jnp.asarray(coef), jnp.float32(self.intercept), X)
             proba = np.asarray(proba)
             return PredictionBatch(
                 prediction=(proba[:, 1] >= 0.5).astype(np.float64),
                 raw_prediction=np.asarray(raw),
                 probability=proba)
         proba, raw = softmax_predict_proba(
-            coef, jnp.asarray(self.intercept, jnp.float32), X)
+            jnp.asarray(coef), jnp.asarray(self.intercept, jnp.float32), X)
         proba = np.asarray(proba)
         return PredictionBatch(
             prediction=proba.argmax(axis=1).astype(np.float64),

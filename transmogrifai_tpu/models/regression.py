@@ -101,6 +101,11 @@ class LinearRegressionModel(PredictorModel):
             beta = np.append(np.asarray(self.coef, np.float32),
                              np.float32(self.intercept))
             pred = native.linear_margin(np.asarray(X, np.float32), beta)
+        elif isinstance(X, np.ndarray):
+            # host BLAS: don't ship a large host matrix to the device for
+            # one dot (see LogisticRegressionModel.predict_batch)
+            pred = (np.asarray(X, np.float32) @ np.asarray(
+                self.coef, np.float32) + np.float32(self.intercept))
         else:
             pred = np.asarray(linear_predict(
                 jnp.asarray(self.coef, jnp.float32),

@@ -77,8 +77,8 @@ class TreeEnsembleModel(PredictorModel):
             return native.predict_ensemble(
                 binned, np.asarray(self.feat), np.asarray(self.thresh),
                 np.asarray(self.leaf), depth)
-        binned = apply_bins(jnp.asarray(X, jnp.float32),
-                            jnp.asarray(self.edges, jnp.float32))
+        # memoized binning: big matrices quantize on host and upload int8
+        binned = _binned_for_edges(X, self.edges)
         feat = jnp.asarray(self.feat, jnp.int32)
         thresh = jnp.asarray(self.thresh, jnp.int32)
         leaf = jnp.asarray(self.leaf, jnp.float32)
@@ -177,25 +177,51 @@ def _memo(key, build):
     return val
 
 
-def _content_hash(a: np.ndarray) -> str:
-    """md5 of the array bytes, cached per array object.
+_BIG_ARRAY_BYTES = 64 << 20
+_ID_TOKENS = 0
 
-    The sweep probes the memo with the SAME fold matrix object for every
-    candidate; re-hashing 400 MB per probe costs ~0.5 s of host CPU each.
-    id() keys are safe because a weakref finalizer drops the entry when the
-    array dies (before its id can be reused).
+
+def _sample_digest(a: np.ndarray) -> str:
+    """Cheap per-call digest over a strided sample of the array bytes.
+
+    Guards the per-object hash cache against IN-PLACE mutation: any
+    realistic batch overwrite perturbs the sampled bytes, changing the memo
+    key even though the cached base hash is stale.  ~32 KB of work
+    regardless of array size.
+    """
+    flat = a.reshape(-1)
+    step = max(1, flat.size // 8192)
+    return hashlib.md5(np.ascontiguousarray(flat[::step]).tobytes()
+                       ).hexdigest()[:16]
+
+
+def _content_hash(a: np.ndarray) -> str:
+    """Memo key component for an array: content md5, or an identity token.
+
+    The sweep usually probes the memo with the SAME matrix object for every
+    candidate, and the per-object cache makes those probes free.  For arrays
+    past 64 MB a cache MISS (fresh object each call, e.g. a fancy-indexed
+    holdout slice) would still pay ~1 s/GB of md5, so big arrays key by
+    object identity instead — losing cross-object dedup, which only costs a
+    re-upload in the rare same-bytes-different-object case.  A per-call
+    sampled digest is appended so in-place mutation changes the key.
     """
     import weakref
+    global _ID_TOKENS
     k = id(a)
     h = _HASH_BY_ID.get(k)
     if h is None:
-        h = hashlib.md5(a.tobytes()).hexdigest()
+        if a.nbytes > _BIG_ARRAY_BYTES:
+            _ID_TOKENS += 1
+            h = f"obj-{_ID_TOKENS}"
+        else:
+            h = hashlib.md5(a.tobytes()).hexdigest()
         _HASH_BY_ID[k] = h
         try:
             weakref.finalize(a, _HASH_BY_ID.pop, k, None)
         except TypeError:  # pragma: no cover - non-weakrefable view
             _HASH_BY_ID.pop(k, None)
-    return h
+    return f"{h}-{_sample_digest(a)}"
 
 
 def _as_f32(X) -> np.ndarray:
@@ -239,9 +265,13 @@ def _host_bins(Xf: np.ndarray, edges: np.ndarray) -> np.ndarray:
     out = np.empty((n, d), np.int8)
     for j in range(d):
         # apply_bins counts edges < x; searchsorted(left) on sorted edges
-        # (dedup +inf sentinels sort to the end) gives the same count
-        out[:, j] = np.searchsorted(np.sort(edges[j]), Xf[:, j],
-                                    side="left").astype(np.int8)
+        # (dedup +inf sentinels sort to the end) gives the same count.
+        # NaN sorts past +inf in searchsorted but compares False against
+        # every edge on device — pin it to bin 0 to match.
+        col = Xf[:, j]
+        b = np.searchsorted(np.sort(edges[j]), col,
+                            side="left").astype(np.int8)
+        out[:, j] = np.where(np.isnan(col), np.int8(0), b)
     return out
 
 

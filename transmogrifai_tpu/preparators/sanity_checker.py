@@ -111,20 +111,36 @@ class SanityChecker(BinaryEstimator):
         vmeta = features_col.vmeta or VectorMetadata(
             "features", [])
 
-        import jax.numpy as jnp
+        if X.size > (1 << 24) and self.correlation_type != "spearman":
+            # big host matrices: means/variance/Pearson are one BLAS pass on
+            # host (~1 s/GB); shipping the matrix to the device first costs
+            # ~70 s of tunnel upload per GB
+            mean_h = X.mean(axis=0, dtype=np.float64)
+            variance = X.var(axis=0, ddof=1, dtype=np.float64)
+            min_h, max_h = X.min(axis=0), X.max(axis=0)
+            yc = (y - y.mean()).astype(np.float64)
+            # center X before the dot: an uncentered f32 product cancels
+            # catastrophically for large-offset columns (e.g. timestamps)
+            num = yc @ (X - mean_h)
+            den = (np.sqrt(np.maximum(variance, 1e-30) * (n - 1))
+                   * np.sqrt(max(float(yc @ yc), 1e-30)))
+            with np.errstate(invalid="ignore", divide="ignore"):
+                corr = np.nan_to_num(num / den)
+        else:
+            import jax.numpy as jnp
 
-        stats = col_stats(X)
-        corr_dev = (spearman_with_label(X, y)
-                    if self.correlation_type == "spearman"
-                    else pearson_with_label(X, y))
-        # ONE stacked fetch for all per-column stats + correlations — each
-        # separate np.asarray costs a full device round trip
-        packed = np.asarray(jnp.stack([
-            jnp.asarray(stats.mean), jnp.asarray(stats.variance),
-            jnp.asarray(stats.min), jnp.asarray(stats.max),
-            jnp.asarray(corr_dev)]))
-        mean_h, variance, min_h, max_h, corr = packed
-        corr = np.nan_to_num(corr)
+            stats = col_stats(X)
+            corr_dev = (spearman_with_label(X, y)
+                        if self.correlation_type == "spearman"
+                        else pearson_with_label(X, y))
+            # ONE stacked fetch for all per-column stats + correlations —
+            # each separate np.asarray costs a full device round trip
+            packed = np.asarray(jnp.stack([
+                jnp.asarray(stats.mean), jnp.asarray(stats.variance),
+                jnp.asarray(stats.min), jnp.asarray(stats.max),
+                jnp.asarray(corr_dev)]))
+            mean_h, variance, min_h, max_h, corr = packed
+            corr = np.nan_to_num(corr)
 
         # label categorical? -> Cramér's V per categorical group
         uniq = np.unique(y)

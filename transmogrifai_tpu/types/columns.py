@@ -88,7 +88,9 @@ class FeatureColumn:
             if st == "binary":
                 vals = np.where(mask, vals != 0, False).astype(np.float64)
             elif st == "integral":
-                vals = np.where(mask, np.floor(np.nan_to_num(vals)), 0.0)
+                # trunc, not floor: the slow path coerces via int() which
+                # truncates toward zero
+                vals = np.where(mask, np.trunc(np.nan_to_num(vals)), 0.0)
             else:
                 vals = np.where(mask, vals, np.nan)
             return FeatureColumn(ftype, vals, mask)
