@@ -75,3 +75,36 @@ def test_graft_dryrun_multichip(n):
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(n)
+
+
+class TestShardedForest:
+    def test_sharded_equals_single_device(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from transmogrifai_tpu.models import gbdt_kernels as gk
+        from transmogrifai_tpu.parallel import make_mesh
+        from transmogrifai_tpu.parallel.sharded import grow_forest_sharded
+
+        rng = np.random.default_rng(0)
+        n, d, T = 512, 8, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[y.astype(int)]
+        edges = gk.quantile_bins(X, 16)
+        binned = np.asarray(gk.apply_bins(jnp.asarray(X),
+                                          jnp.asarray(edges, np.float32)))
+        BW = rng.poisson(1.0, (T, n)).astype(np.float32)
+        mask = np.ones((T, d), bool)
+
+        mesh = make_mesh(8, model_parallelism=2)
+        f_s, t_s, l_s = grow_forest_sharded(binned, Y, BW, mask, mesh,
+                                            max_depth=4, n_bins=16)
+        limit = jnp.full((T,), 4, jnp.int32)
+        f_1, t_1, l_1 = gk._grow_chunk_bagged(
+            jnp.asarray(binned), jnp.asarray(Y), jnp.asarray(BW),
+            jnp.asarray(mask), limit, 4, 16, jnp.float32(1e-3),
+            jnp.float32(0.0), jnp.float32(0.0), jnp.float32(1.0),
+            jnp.bool_(False), jnp.float32(1.0))
+        assert bool(jnp.all(f_s == f_1)) and bool(jnp.all(t_s == t_1))
+        assert float(jnp.max(jnp.abs(l_s - l_1))) < 1e-4

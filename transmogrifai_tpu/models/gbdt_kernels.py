@@ -124,7 +124,8 @@ def apply_bins(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
 def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                       max_depth: int, n_bins: int, lam, min_child_weight,
                       min_info_gain, min_instances, newton_leaf,
-                      learning_rate, hist_bf16: bool = False):
+                      learning_rate, hist_bf16: bool = False,
+                      all_reduce=None):
     """One whole tree under trace: Python-unrolled loop over levels.
 
     This is the dispatch-collapsing design: the per-level kernel approach
@@ -153,6 +154,11 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     k = G.shape[1]
     B = n_bins
     n_cap = 1 << int(np.ceil(np.log2(max(n, 2))))   # static pow2 ≥ N
+    if all_reduce is not None:
+        # sharded growth: shards see different rows, so shard-local node
+        # compaction would produce inconsistent slot<->node mappings; grow
+        # with the full 2^level slot layout and psum the histograms
+        n_cap = 1 << 62
     chans = [G[:, i] for i in range(k)] + [H[:, i] for i in range(k)] + [C]
     # RF grad/hess are bag-weight × one-hot class values — exact in bf16
     # for integer weights, ≲1e-3 relative under fractional balancer weights,
@@ -236,6 +242,9 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                          preferred_element_type=jnp.float32,
                      ).reshape(M, B, d)
                      for ch in chans]                 # 2K+1 × (M, B, D)
+        if all_reduce is not None:
+            # ICI collective replaces Spark's treeAggregate / Rabit allreduce
+            hists = [all_reduce(h) for h in hists]
         GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
         HLs = [jnp.cumsum(h, axis=1) for h in hists[k:2 * k]]
         CL = jnp.cumsum(hists[2 * k], axis=1)
@@ -304,6 +313,8 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
         Gs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(G)
         Hs = jnp.zeros((n_leaves, k), jnp.float32).at[node].add(H)
         Cs = jnp.zeros((n_leaves,), jnp.float32).at[node].add(C)
+    if all_reduce is not None:
+        Gs, Hs, Cs = all_reduce(Gs), all_reduce(Hs), all_reduce(Cs)
     newton_val = -learning_rate * Gs / (Hs + lam)
     mean_val = Gs / jnp.maximum(Cs, 1e-12)[:, None]
     leaf = jnp.where(newton_leaf, newton_val, mean_val)
