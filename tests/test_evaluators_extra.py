@@ -1,0 +1,61 @@
+"""BinScore + Forecast evaluators (reference OpBinScoreEvaluatorTest /
+OpForecastEvaluatorTest coverage)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.evaluators import (
+    OpBinScoreEvaluator, OpForecastEvaluator,
+)
+from transmogrifai_tpu.evaluators.metrics import forecast_metrics
+from transmogrifai_tpu.models.prediction import PredictionBatch
+from transmogrifai_tpu.types.columns import ColumnarDataset, FeatureColumn
+from transmogrifai_tpu.types.feature_types import Prediction, RealNN
+
+
+def _dataset(y, pred_batch):
+    ds = ColumnarDataset()
+    ds.set("label", FeatureColumn(RealNN, np.asarray(y, np.float64),
+                                  np.ones(len(y), bool)))
+    ds.set("pred", FeatureColumn(Prediction, pred_batch))
+    return ds
+
+
+class TestBinScore:
+    def test_calibration_bins(self):
+        y = np.array([0.0, 0, 1, 1])
+        p1 = np.array([0.1, 0.3, 0.7, 0.9])
+        batch = PredictionBatch(prediction=(p1 >= 0.5).astype(float),
+                                probability=np.stack([1 - p1, p1], 1))
+        ev = OpBinScoreEvaluator(label_col="label", prediction_col="pred",
+                                 num_bins=4)
+        m = ev.evaluate(_dataset(y, batch))
+        assert m["BrierScore"] == pytest.approx(
+            np.mean((p1 - y) ** 2))
+        assert m["numberOfDataPoints"] == [1, 1, 1, 1]
+        # a perfectly-calibrated-ish spread: bin avg scores = the scores
+        assert m["averageScore"][0] == pytest.approx(0.1)
+        assert m["averageConversionRate"][3] == pytest.approx(1.0)
+
+
+class TestForecast:
+    def test_smape_and_mase_golden(self):
+        y = np.array([10.0, 12.0, 14.0, 16.0])
+        p = np.array([11.0, 11.0, 15.0, 15.0])
+        m = forecast_metrics(y, p, seasonal_period=1)
+        expected_smape = np.mean(2 * np.abs(p - y) / (np.abs(p) + np.abs(y)))
+        assert m["SMAPE"] == pytest.approx(expected_smape)
+        # naive seasonal diffs all 2.0; MAE = 1.0 -> MASE 0.5
+        assert m["MASE"] == pytest.approx(0.5)
+
+    def test_evaluator_wiring(self):
+        y = np.array([1.0, 2.0, 3.0, 4.0])
+        batch = PredictionBatch(prediction=np.array([1.5, 2.5, 3.5, 4.5]))
+        ev = OpForecastEvaluator(label_col="label", prediction_col="pred")
+        m = ev.evaluate(_dataset(y, batch))
+        assert 0 < m["SMAPE"] < 1 and m["MASE"] == pytest.approx(0.5)
+
+    def test_perfect_forecast(self):
+        y = np.array([5.0, 6.0, 7.0])
+        m = forecast_metrics(y, y.copy())
+        assert m["SMAPE"] == pytest.approx(0.0)
+        assert m["MASE"] == pytest.approx(0.0)

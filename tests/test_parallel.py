@@ -108,3 +108,19 @@ class TestShardedForest:
             jnp.bool_(False), jnp.float32(1.0))
         assert bool(jnp.all(f_s == f_1)) and bool(jnp.all(t_s == t_1))
         assert float(jnp.max(jnp.abs(l_s - l_1))) < 1e-4
+
+    def test_rf_estimator_with_mesh_trains_and_predicts(self):
+        import numpy as np
+
+        from transmogrifai_tpu.models import OpRandomForestClassifier
+        from transmogrifai_tpu.parallel import make_mesh
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(500, 6)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+        mesh = make_mesh(8, model_parallelism=1)
+        m = OpRandomForestClassifier(num_trees=16, max_depth=5,
+                                     seed=5).with_mesh(mesh).fit_raw(X, y)
+        proba = np.asarray(m.predict_batch(X).probability)
+        acc = ((proba[:, 1] > 0.5) == y).mean()
+        assert acc > 0.85

@@ -314,6 +314,14 @@ class _RandomForestBase(PredictorEstimator):
         self.subsample_rate = subsample_rate
         self.feature_subset_strategy = feature_subset_strategy
         self.seed = seed
+        #: optional jax.sharding.Mesh: rows shard over the mesh's data axis
+        #: and per-level histograms psum over ICI (grow_forest_sharded);
+        #: runtime-only (not a persisted ctor param)
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "_RandomForestBase":
+        self.mesh = mesh
+        return self
 
     _op_name = "randomForest"
     _classification = True
@@ -336,17 +344,20 @@ class _RandomForestBase(PredictorEstimator):
             Y = y[:, None].astype(np.float32)
         msub = _feature_subset_size(self.feature_subset_strategy, d,
                                     self._classification)
-        # bootstrap bags (Poisson weights) + feature subsets generate ON
-        # DEVICE from the seed (grow_forest_rf); the fold data uploads once
-        # (memoized), so each candidate fit is a couple of scalar-arg
-        # launches — no per-tree weight matrices cross the tunnel
-        f, th, lf = grow_forest_rf(
-            binned, _dev_memo(Y, "rf_Y"), _dev_memo(base_w, "rf_w"),
-            seed=self.seed, n_trees=self.num_trees, msub=msub,
-            subsample_rate=self.subsample_rate,
-            max_depth=self.max_depth, n_bins=self.max_bins, lam=1e-3,
-            min_info_gain=self.min_info_gain,
-            min_instances=float(self.min_instances_per_node))
+        if self.mesh is not None:
+            f, th, lf = self._fit_sharded(binned, Y, base_w, msub)
+        else:
+            # bootstrap bags (Poisson weights) + feature subsets generate ON
+            # DEVICE from the seed (grow_forest_rf); the fold data uploads
+            # once (memoized), so each candidate fit is a couple of
+            # scalar-arg launches — no per-tree weights cross the tunnel
+            f, th, lf = grow_forest_rf(
+                binned, _dev_memo(Y, "rf_Y"), _dev_memo(base_w, "rf_w"),
+                seed=self.seed, n_trees=self.num_trees, msub=msub,
+                subsample_rate=self.subsample_rate,
+                max_depth=self.max_depth, n_bins=self.max_bins, lam=1e-3,
+                min_info_gain=self.min_info_gain,
+                min_instances=float(self.min_instances_per_node))
         # ensemble stays device-resident: during model selection only the
         # scores come back to host; the winning ensemble downloads lazily at
         # persistence/native-serving time (TreeEnsembleModel._raw)
@@ -354,6 +365,31 @@ class _RandomForestBase(PredictorEstimator):
         return TreeEnsembleModel(
             mode=mode, edges=edges, feat=f, thresh=th, leaf=lf,
             n_classes=k if self._classification else 2)
+
+
+    def _fit_sharded(self, binned, Y, base_w, msub: int):
+        """Multi-chip fit: pad rows to tile the mesh's data axis (padded
+        rows carry zero bag weight) and grow with psum'd histograms."""
+        from ..parallel.mesh import pad_to_multiple
+        from ..parallel.sharded import grow_forest_sharded
+
+        n, d = binned.shape
+        T = self.num_trees
+        rng = np.random.default_rng(self.seed)
+        BW = np.asarray(base_w, np.float32)[None, :] * rng.poisson(
+            self.subsample_rate, (T, n)).astype(np.float32)
+        masks = np.zeros((T, d), bool)
+        for t in range(T):
+            masks[t, rng.choice(d, msub, replace=False)] = True
+        ndata = self.mesh.shape[self.mesh.axis_names[0]]
+        binned_h, _ = pad_to_multiple(np.asarray(binned), ndata, axis=0)
+        BW, _ = pad_to_multiple(BW, ndata, axis=1)   # zero weight on pad
+        Y_h, _ = pad_to_multiple(np.asarray(Y, np.float32), ndata, axis=0)
+        return grow_forest_sharded(
+            binned_h, Y_h, BW, masks, self.mesh,
+            max_depth=self.max_depth, n_bins=self.max_bins, lam=1e-3,
+            min_info_gain=self.min_info_gain,
+            min_instances=float(self.min_instances_per_node))
 
 
 class OpRandomForestClassifier(_RandomForestBase):
