@@ -196,6 +196,13 @@ class ModelSelector(PredictorEstimator):
             if m == "MeanAbsoluteError":
                 return (wj * jnp.abs(err)).sum() / ws
             return None
+        if self.problem_type == "multiclass":
+            from ..evaluators.metrics import _multiclass_core
+
+            n_classes = max(int(np.nanmax(y)) + 1, 2)
+            res = _multiclass_core(np.asarray(y, np.int32), scores,
+                                   n_classes, w)
+            return res.get(m)
         return None
 
     @property
