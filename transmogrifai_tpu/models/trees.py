@@ -157,6 +157,12 @@ _BIN_CACHE_CAPACITY = 32
 _HASH_BY_ID: dict = {}
 
 
+def clear_sweep_caches() -> None:
+    """Release the sweep memos' device buffers (end-of-train housekeeping)."""
+    _BIN_CACHE.clear()
+    _HASH_BY_ID.clear()
+
+
 def _memo(key, build):
     """Content-keyed sweep memo with LRU eviction.
 

@@ -132,7 +132,7 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
     replicated (T, 2^d-1) feat/thresh and (T, 2^d, K) leaves — identical to
     single-device ``grow_forest`` output for the same inputs.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from ..models.gbdt_kernels import _grow_tree_traced
 
@@ -160,7 +160,7 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
         in_specs=(P(data_axis, None), P(data_axis, None), P(None, data_axis),
                   P(None, None), P(None)),
         out_specs=(P(None, None), P(None, None), P(None, None, None)),
-        check_rep=False)
+        check_vma=False)
     limit = jnp.full((T,), max_depth, jnp.int32)
     with mesh:
         return jax.jit(fn)(jnp.asarray(binned), jnp.asarray(Y, jnp.float32),
