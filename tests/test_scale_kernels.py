@@ -83,3 +83,26 @@ class TestHostBinning:
         m = OpRandomForestClassifier(num_trees=5, max_depth=3,
                                      seed=3).fit_raw(X, y)
         assert np.isfinite(np.asarray(m.predict_batch(X).probability)).all()
+
+
+class TestXGBoostGammaSemantics:
+    def test_default_gamma_still_splits(self):
+        """XGBoost's gamma thresholds RAW loss-reduction; mapping it onto
+        Spark's per-node-weight minInfoGain silently produced all-leaf trees
+        (regression guard)."""
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+        from transmogrifai_tpu.evaluators.metrics import aupr
+
+        rng = np.random.default_rng(4)
+        n, d = 2000, 30
+        X = np.where(rng.random((n, d)) < 0.2,
+                     rng.normal(size=(n, d)), 0.0).astype(np.float32)
+        beta = np.zeros(d)
+        beta[rng.choice(d, 5, replace=False)] = rng.normal(size=5) * 3
+        y = (1 / (1 + np.exp(-(X @ beta))) > rng.random(n)).astype(np.float32)
+        m = OpXGBoostClassifier(num_round=30, max_depth=4, eta=0.2,
+                                early_stopping_rounds=0).fit_raw(X, y)
+        # default gamma=0.8: trees must actually split and learn
+        assert int((np.asarray(m.thresh) < m.edges.shape[1] + 1).sum()) > 0
+        p = np.asarray(m.predict_batch(X).probability)[:, 1]
+        assert aupr(y, p) > 0.75

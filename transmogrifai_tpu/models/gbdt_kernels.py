@@ -125,7 +125,7 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                       max_depth: int, n_bins: int, lam, min_child_weight,
                       min_info_gain, min_instances, newton_leaf,
                       learning_rate, hist_bf16: bool = False,
-                      all_reduce=None):
+                      all_reduce=None, min_gain_raw=None):
     """One whole tree under trace: Python-unrolled loop over levels.
 
     This is the dispatch-collapsing design: the per-level kernel approach
@@ -278,6 +278,10 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
         # distinct max_depth); levels at/past the limit emit no splits
         ok = ((best_gain > 0) & (best_gain / node_w >= min_info_gain)
               & jnp.isfinite(best_gain) & (level < depth_limit))
+        if min_gain_raw is not None:
+            # XGBoost's gamma thresholds the RAW loss-reduction, unlike
+            # Spark's per-node-weight minInfoGain
+            ok = ok & (best_gain >= min_gain_raw)
         feat_l = jnp.where(ok, best % d, 0).astype(jnp.int32)
         thresh_l = jnp.where(ok, best // d, B).astype(jnp.int32)
 
@@ -326,7 +330,7 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
 def _grow_chunk(binned, G, H, C, feat_mask, depth_limit, max_depth: int,
                 n_bins: int, lam, min_child_weight, min_info_gain,
                 min_instances, newton_leaf, learning_rate,
-                hist_bf16: bool = False):
+                hist_bf16: bool = False, min_gain_raw=0.0):
     """Grow a chunk of trees in one XLA program.
 
     binned (N, D) shared; G/H (T, N, K), C (T, N), feat_mask (T, D),
@@ -338,7 +342,7 @@ def _grow_chunk(binned, G, H, C, feat_mask, depth_limit, max_depth: int,
         lam=lam, min_child_weight=min_child_weight,
         min_info_gain=min_info_gain, min_instances=min_instances,
         newton_leaf=newton_leaf, learning_rate=learning_rate,
-        hist_bf16=hist_bf16)
+        hist_bf16=hist_bf16, min_gain_raw=min_gain_raw)
     return jax.vmap(fn)(G, H, C, feat_mask, depth_limit)
 
 
@@ -513,6 +517,7 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               min_info_gain: float = 0.0, min_instances: float = 1.0,
               feat_mask: Optional[jnp.ndarray] = None,
               newton_leaf: bool = True, learning_rate: float = 1.0,
+              min_gain_raw: float = 0.0,
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow one tree (single-tree view of ``grow_forest``): one XLA launch."""
     d = binned.shape[1]
@@ -524,7 +529,8 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
         binned, G[None], H[None], C[None], feat_mask[None], limit,
         heap_depth, n_bins, jnp.float32(lam), jnp.float32(min_child_weight),
         jnp.float32(min_info_gain), jnp.float32(min_instances),
-        jnp.bool_(newton_leaf), jnp.float32(learning_rate))
+        jnp.bool_(newton_leaf), jnp.float32(learning_rate),
+        min_gain_raw=jnp.float32(min_gain_raw))
     return f[0], t[0], lf[0]
 
 

@@ -9,7 +9,8 @@ Reference wrappers being re-implemented natively (no JNI, no Spark):
    OpXGBoostRegressor.scala:48) — the reference's only C++ component
    (xgboost4j, SURVEY §2.11); here the histogram GBDT runs as jitted XLA
    kernels (models.gbdt_kernels) with XGBoost's parameterisation (eta,
-   num_round, gamma via min_info_gain, min_child_weight, early stopping on a
+   num_round, gamma as RAW loss-reduction threshold, min_child_weight,
+   early stopping on a
    validation slice, aucpr eval — DefaultSelectorParams.scala XGB block).
 
 All training happens on the quantized (N, D) int matrix resident on device;
@@ -474,6 +475,7 @@ class _GBTBase(PredictorEstimator):
                  early_stopping_rounds: int = 0,
                  validation_fraction: float = 0.2,
                  min_instances_per_node: int = 1,
+                 min_split_gain_raw: float = 0.0,
                  seed: int = 42, uid: Optional[str] = None):
         super().__init__(operation_name=self._op_name, uid=uid)
         self.max_iter = max_iter
@@ -488,6 +490,9 @@ class _GBTBase(PredictorEstimator):
         self.early_stopping_rounds = early_stopping_rounds
         self.validation_fraction = validation_fraction
         self.min_instances_per_node = min_instances_per_node
+        #: XGBoost's gamma: RAW loss-reduction threshold (not Spark's
+        #: per-node-weight minInfoGain)
+        self.min_split_gain_raw = min_split_gain_raw
         self.seed = seed
 
     def fit_columns(self, data: ColumnarDataset, label_col, features_col):
@@ -553,7 +558,8 @@ class _GBTBase(PredictorEstimator):
                 min_info_gain=self.min_info_gain,
                 min_instances=float(self.min_instances_per_node),
                 feat_mask=jnp.asarray(mask), newton_leaf=True,
-                learning_rate=self.step_size)
+                learning_rate=self.step_size,
+                min_gain_raw=self.min_split_gain_raw)
             from .gbdt_kernels import predict_tree
 
             heap_depth = int(np.log2(f.shape[0] + 1))
@@ -645,7 +651,7 @@ class OpXGBoostClassifier(_GBTBase):
             max_iter=num_round, max_depth=max_depth, step_size=eta,
             max_bins=max_bins, reg_lambda=reg_lambda,
             min_child_weight=min_child_weight,
-            min_info_gain=gamma, subsample_rate=subsample,
+            min_split_gain_raw=gamma, subsample_rate=subsample,
             colsample=colsample_bytree,
             early_stopping_rounds=early_stopping_rounds, seed=seed, uid=uid)
         self.num_round = num_round
