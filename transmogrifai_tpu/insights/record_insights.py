@@ -213,12 +213,21 @@ class RecordInsightsCorrModel(BinaryModel):
         imp = self.score_corr[None, :, :] * normed[:, None, :]
         out = np.empty(n, dtype=object)
         p = self.score_corr.shape[0]
+        # Reference semantics (RecordInsightsCorr.scala:146-154): rank top-K
+        # per PREDICTION COLUMN, then merge the per-column maps — a slot's
+        # entry lists only the prediction indices where it made that
+        # column's top-K, and the merged map holds up to K·P keys.
+        kk = min(self.top_k, imp.shape[2])
+        # (N, p, K) slot indices of the per-column top-K by |importance|
+        # (argpartition: O(d) per column, no full sort of the slot axis)
+        order = (np.argpartition(-np.abs(imp), kk - 1, axis=2)[:, :, :kk]
+                 if kk < imp.shape[2] else
+                 np.broadcast_to(np.arange(kk), imp.shape[:2] + (kk,)))
         for i in range(n):
-            best = np.max(np.abs(imp[i]), axis=0)       # (d,)
-            order = np.argsort(-best)[: self.top_k]
-            out[i] = {
-                names[j]: json.dumps([[k, float(imp[i, k, j])]
-                                      for k in range(p)])
-                for j in order
-            }
+            entries: dict = {}
+            for c in range(p):
+                for j in order[i, c]:
+                    entries.setdefault(int(j), []).append(
+                        [c, float(imp[i, c, j])])
+            out[i] = {names[j]: json.dumps(v) for j, v in entries.items()}
         return FeatureColumn(TextMap, out)

@@ -376,21 +376,26 @@ HIST_BYTES_BUDGET = 4 << 30
 
 def forest_chunk_size(n_trees: int, max_depth: int, d: int, n_bins: int,
                       k: int, budget: int = HIST_BYTES_BUDGET,
-                      n_rows: Optional[int] = None) -> int:
+                      n_rows: Optional[int] = None,
+                      compact: bool = True) -> int:
     # node compaction caps a level's histogram slots at next_pow2(n_rows);
-    # 1.3x covers the 128-lane padding of the minor (feature) axis
+    # 1.3x covers the 128-lane padding of the minor (feature) axis.
+    # compact=False is the all-reduce (mesh-sharded) path, which keeps the
+    # full 2^level slot layout so every shard agrees on histogram indices.
     slots = 2 ** (max_depth - 1)
-    if n_rows is not None:
+    if n_rows is not None and compact:
         slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))
     per_tree = int(slots * d * n_bins * (2 * k + 1) * 4 * 1.3)
     if n_rows is not None:
         # matmul-histogram operands live per tree under vmap: the per-block
         # (rows, slots) node one-hot and (rows, B·D) bins one-hot (rows
-        # streamed in ROW_BLOCK chunks past that size)
+        # streamed in ROW_BLOCK chunks past that size), plus the (rows, K)
+        # G/H gradient channels and bag-weight row derived per tree
         rows = min(n_rows, ROW_BLOCK)
         per_tree += int(rows * slots * 4 * 1.3)
         if n_rows > ROW_BLOCK:
             per_tree += int(rows * n_bins * d * 4 * 1.3)
+        per_tree += int(n_rows * (2 * k + 1) * 4)
     return int(np.clip(budget // max(per_tree, 1), 1, n_trees))
 
 

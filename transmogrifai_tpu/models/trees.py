@@ -185,44 +185,58 @@ def _memo(key, build):
 
 
 _BIG_ARRAY_BYTES = 64 << 20
-_ID_TOKENS = 0
 
 
 def _sample_digest(a: np.ndarray) -> str:
-    """Cheap per-call digest over a strided sample of the array bytes.
+    """Cheap per-call digest over a strided sample + both array ends.
 
-    Guards the per-object hash cache against IN-PLACE mutation: any
-    realistic batch overwrite perturbs the sampled bytes, changing the memo
-    key even though the cached base hash is stale.  ~32 KB of work
-    regardless of array size.
+    Guards the per-object hash cache of NON-frozen (view) arrays against
+    IN-PLACE mutation: any realistic batch overwrite perturbs the sampled
+    bytes, changing the memo key even though the cached base hash is stale.
+    ~40 KB of work regardless of array size.
     """
     flat = a.reshape(-1)
     step = max(1, flat.size // 8192)
-    return hashlib.md5(np.ascontiguousarray(flat[::step]).tobytes()
-                       ).hexdigest()[:16]
+    parts = (np.ascontiguousarray(flat[::step]).tobytes()
+             + flat[:1024].tobytes() + flat[-1024:].tobytes())
+    return hashlib.md5(parts).hexdigest()[:16]
+
+
+def _full_hash(a: np.ndarray) -> str:
+    """Full-bytes content hash: md5 up to 64 MB, crc32+adler32 (each ~GB/s
+    in C) beyond, where md5's ~1 s/GB would show up in sweep latency.  The
+    weaker big-array checksum pair is never used alone — ``_content_hash``
+    always appends the per-call md5 sample digest to the memo key."""
+    if a.nbytes > _BIG_ARRAY_BYTES:
+        import zlib
+        mv = memoryview(np.ascontiguousarray(a)).cast("B")
+        return f"crc{zlib.crc32(mv):08x}a{zlib.adler32(mv):08x}n{len(mv)}"
+    return hashlib.md5(a.tobytes()).hexdigest()
+
+
+_SMALL_REHASH_BYTES = 1 << 20
 
 
 def _content_hash(a: np.ndarray) -> str:
-    """Memo key component for an array: content md5, or an identity token.
+    """Memo key component for an array: full-bytes content hash + per-call
+    mutation guard.
 
     The sweep usually probes the memo with the SAME matrix object for every
-    candidate, and the per-object cache makes those probes free.  For arrays
-    past 64 MB a cache MISS (fresh object each call, e.g. a fancy-indexed
-    holdout slice) would still pay ~1 s/GB of md5, so big arrays key by
-    object identity instead — losing cross-object dedup, which only costs a
-    re-upload in the rare same-bytes-different-object case.  A per-call
-    sampled digest is appended so in-place mutation changes the key.
+    candidate; a per-object cache makes those probes free.  Arrays up to
+    1 MB are fully re-hashed on every probe (sub-ms — exact, no staleness).
+    Bigger arrays hash their full bytes ONCE per object (ADVICE r1: big
+    arrays previously keyed by identity only) and append a per-call sampled
+    digest (strided sample + both ends) so realistic in-place overwrites
+    change the key even though the cached base hash is stale.  In-place
+    batch reuse of a fitted matrix therefore stays supported.
     """
+    if a.nbytes <= _SMALL_REHASH_BYTES:
+        return hashlib.md5(a.tobytes()).hexdigest()
     import weakref
-    global _ID_TOKENS
     k = id(a)
     h = _HASH_BY_ID.get(k)
     if h is None:
-        if a.nbytes > _BIG_ARRAY_BYTES:
-            _ID_TOKENS += 1
-            h = f"obj-{_ID_TOKENS}"
-        else:
-            h = hashlib.md5(a.tobytes()).hexdigest()
+        h = _full_hash(a)
         _HASH_BY_ID[k] = h
         try:
             weakref.finalize(a, _HASH_BY_ID.pop, k, None)

@@ -131,6 +131,12 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
     Rows must tile the data axis (pad with zero bag weights).  Returns
     replicated (T, 2^d-1) feat/thresh and (T, 2^d, K) leaves — identical to
     single-device ``grow_forest`` output for the same inputs.
+
+    Trees are grown in HBM-budgeted chunks: the all-reduce path disables
+    node compaction (full 2^level histogram slots so every shard agrees on
+    slot layout), so the per-tree working set is 2^depth × bins × features —
+    ``forest_chunk_size(compact=False)`` with this shard's row count bounds
+    how many trees one launch vmaps over (ADVICE r1).
     """
     from jax import shard_map
 
@@ -138,6 +144,8 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
 
     data_axis = mesh.axis_names[0]
     T, n = BW.shape
+    d = binned.shape[1]
+    k = Y.shape[1]
     psum = functools.partial(lax.psum, axis_name=data_axis)
 
     def shard_fn(binned_s, Y_s, BW_s, mask_r, limit_r):
@@ -154,18 +162,43 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
             all_reduce=psum)
         return jax.vmap(fn)(G, H, BW_s, mask_r, limit_r)
 
-    P_data = P(data_axis)
     fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(data_axis, None), P(data_axis, None), P(None, data_axis),
                   P(None, None), P(None)),
         out_specs=(P(None, None), P(None, None), P(None, None, None)),
         check_vma=False)
-    limit = jnp.full((T,), max_depth, jnp.int32)
+    # compact=False: the all-reduce path keeps the full 2^level slot layout
+    # (no node compaction — shards must agree on histogram indices), so the
+    # budget uses the uncompacted slot count with this shard's row count.
+    from ..models.gbdt_kernels import forest_chunk_size
+    n_shard = max(n // mesh.shape[data_axis], 1)
+    chunk = forest_chunk_size(T, max_depth, d, n_bins, k,
+                              n_rows=n_shard, compact=False)
+    jfn = jax.jit(fn)
+    binned_d = jnp.asarray(binned)
+    Y_d = jnp.asarray(Y, jnp.float32)
+    BW_h = np.asarray(BW, np.float32)
+    mask_h = np.asarray(feat_mask, bool)
+    limit = jnp.full((chunk,), max_depth, jnp.int32)
+    fs, ts, ls = [], [], []
     with mesh:
-        return jax.jit(fn)(jnp.asarray(binned), jnp.asarray(Y, jnp.float32),
-                           jnp.asarray(BW, jnp.float32),
-                           jnp.asarray(feat_mask, bool), limit)
+        for s in range(0, T, chunk):
+            e = min(s + chunk, T)
+            BWc, Mc = BW_h[s:e], mask_h[s:e]
+            if e - s < chunk:  # zero-weight pad keeps one compiled shape
+                pad = chunk - (e - s)
+                BWc = np.concatenate(
+                    [BWc, np.zeros((pad, n), np.float32)], axis=0)
+                Mc = np.concatenate([Mc, np.ones((pad, d), bool)], axis=0)
+            f, t, lf = jfn(binned_d, Y_d, jnp.asarray(BWc),
+                           jnp.asarray(Mc), limit)
+            fs.append(f[: e - s])
+            ts.append(t[: e - s])
+            ls.append(lf[: e - s])
+    if len(fs) == 1:
+        return fs[0], ts[0], ls[0]
+    return (jnp.concatenate(fs), jnp.concatenate(ts), jnp.concatenate(ls))
 
 
 def fit_logreg_sharded(X: np.ndarray, y: np.ndarray, mesh: Mesh,

@@ -25,6 +25,13 @@ __all__ = ["enable_persistent_cache"]
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
 
+#: Platforms JAX itself allows persistent caching on (no poke needed).
+_ALLOWLISTED_PLATFORMS = ("tpu", "gpu", "cuda", "rocm", "cpu")
+#: Off-allowlist platforms where executable (de)serialization was verified
+#: to round-trip with identical results, per jax version prefix.
+_VALIDATED_POKE_PLATFORMS = ("axon",)
+_VALIDATED_JAX_PREFIXES = ("0.9.",)
+
 _enabled = False
 
 
@@ -50,12 +57,38 @@ def enable_persistent_cache(cache_dir: Optional[str] = None,
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_secs)
         # Platforms outside JAX's allowlist (e.g. the tunneled-TPU plugin)
-        # disable the cache during the first compile; pre-mark it usable.
-        # Correctness still depends on executable serialization, which the
-        # put/get path verifies per entry.
-        with cc._cache_initialized_mutex:
-            cc._cache_checked = True
-            cc._cache_used = True
+        # disable the cache during the first compile; pre-mark it usable —
+        # but ONLY for the (platform, jax-version) combos where executable
+        # serialization was actually verified to round-trip.  The poke
+        # touches jax-internal state that renames freely across versions,
+        # and a backend whose serialization is unsafe would silently load
+        # wrong executables; unknown combos keep the upstream gate.
+        # Resolve the platform WITHOUT initializing the backend when the
+        # user has pinned it via config/env — enable_persistent_cache is
+        # documented as safe to call at import time, before platform
+        # selection would otherwise be latched.  Only fall back to
+        # default_backend() (which does initialize) when nothing is pinned.
+        pinned = (getattr(jax.config, "jax_platforms", None)
+                  or os.environ.get("JAX_PLATFORMS") or "")
+        platform = (pinned.split(",")[0].strip().lower() if pinned
+                    else jax.default_backend())
+        validated = (platform in _VALIDATED_POKE_PLATFORMS
+                     and any(jax.__version__.startswith(v)
+                             for v in _VALIDATED_JAX_PREFIXES))
+        if platform not in _ALLOWLISTED_PLATFORMS:
+            if not validated:
+                import warnings
+                warnings.warn(
+                    "persistent compile cache NOT force-enabled: platform "
+                    f"{platform!r} on jax {jax.__version__} is outside the "
+                    "validated set "
+                    f"{_VALIDATED_POKE_PLATFORMS}×{_VALIDATED_JAX_PREFIXES};"
+                    " re-verify executable round-trip before extending",
+                    RuntimeWarning, stacklevel=2)
+                return False  # not latched: a fixed env can retry
+            with cc._cache_initialized_mutex:
+                cc._cache_checked = True
+                cc._cache_used = True
         _enabled = True
     except Exception:  # pragma: no cover - cache is an optimization only
         return False
