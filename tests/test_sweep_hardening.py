@@ -1,0 +1,218 @@
+"""Sweep robustness: per-candidate failure isolation, max_wait budget, and
+XGBoost early stopping (reference parity: OpValidator.scala:94-214 isolates
+candidate fits in Futures bounded by maxWait; XGBoost early stopping per
+DefaultSelectorParams NumRound/EarlyStopping)."""
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models.classification import OpLogisticRegression
+from transmogrifai_tpu.models.trees import (
+    OpXGBoostClassifier, OpRandomForestClassifier,
+)
+from transmogrifai_tpu.selector.model_selector import ModelSelector, grid
+from transmogrifai_tpu.selector.validators import (
+    OpCrossValidation, OpTrainValidationSplit,
+)
+from transmogrifai_tpu.selector.splitters import DataSplitter
+
+
+def _binary_data(n=300, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    logits = X[:, 0] * 2.0 - X[:, 1]
+    y = (logits + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+class _ExplodingLR(OpLogisticRegression):
+    """Candidate that diverges: raises during fit (singular-Hessian stand-in)."""
+
+    def fit_device(self, X, y, w, problem_type):
+        raise FloatingPointError("synthetic divergence")
+
+    def fit_raw(self, X, y, w=None):
+        raise FloatingPointError("synthetic divergence")
+
+
+class _SlowLR(OpLogisticRegression):
+    """Candidate whose fit burns wall-clock (hung-fit stand-in)."""
+
+    sleep_s = 0.15
+
+    def fit_device(self, X, y, w, problem_type):
+        time.sleep(self.sleep_s)
+        return super().fit_device(X, y, w, problem_type)
+
+    def fit_raw(self, X, y, w=None):
+        time.sleep(self.sleep_s)
+        return super().fit_raw(X, y, w)
+
+
+def _selector(models_and_params, validator):
+    return ModelSelector(models_and_params=models_and_params,
+                         problem_type="binary", validator=validator,
+                         splitter=DataSplitter(reserve_test_fraction=0.0),
+                         validation_metric="AuPR")
+
+
+class TestFailureIsolation:
+    def test_diverging_candidate_scores_neg_inf_and_records_error(self):
+        X, y = _binary_data()
+        sel = _selector(
+            [(_ExplodingLR(), grid(reg_param=[0.01])),
+             (OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))],
+            OpCrossValidation(num_folds=3, stratify=True))
+        best_i, results = sel.validator.validate(
+            sel._candidates(), X, y, np.ones(len(y), np.float32),
+            eval_fn=sel._metric, metric_name="AuPR")
+        assert results[0].error is not None
+        assert "divergence" in results[0].error
+        assert results[0].metric_value == float("-inf")
+        assert best_i in (1, 2)
+        assert results[best_i].error is None
+        assert np.isfinite(results[best_i].metric_value)
+
+    def test_error_recorded_in_selector_summary(self):
+        from transmogrifai_tpu.types.columns import FeatureColumn
+        from transmogrifai_tpu.types.feature_types import OPVector, RealNN
+
+        X, y = _binary_data()
+        sel = _selector(
+            [(_ExplodingLR(), grid(reg_param=[0.01])),
+             (OpLogisticRegression(), grid(reg_param=[0.01]))],
+            OpCrossValidation(num_folds=3, stratify=True))
+        label_col = FeatureColumn(RealNN, y)
+        feat_col = FeatureColumn(OPVector, X)
+        sel.fit_columns(None, label_col, feat_col)
+        summ = sel.metadata["model_selector_summary"]
+        errs = [r.get("error") for r in summ["validationResults"]]
+        assert any(e and "divergence" in e for e in errs)
+        assert summ["bestModelType"] == "OpLogisticRegression"
+
+    def test_minimize_metric_never_selects_failed_candidate(self):
+        # regression sweep (RMSE: smaller better): an errored candidate must
+        # sentinel to +inf, not -inf, or argbest would crown the failure
+        from transmogrifai_tpu.models.regression import OpLinearRegression
+
+        class _ExplodingLin(OpLinearRegression):
+            def fit_device(self, X, y, w, problem_type):
+                raise FloatingPointError("boom")
+
+            def fit_raw(self, X, y, w=None):
+                raise FloatingPointError("boom")
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (X[:, 0] * 3 + 0.1 * rng.normal(size=200)).astype(np.float32)
+        sel = ModelSelector(
+            models_and_params=[(_ExplodingLin(), grid(reg_param=[0.0])),
+                               (OpLinearRegression(), grid(reg_param=[0.0]))],
+            problem_type="regression",
+            validator=OpCrossValidation(num_folds=3),
+            splitter=DataSplitter(reserve_test_fraction=0.0),
+            validation_metric="RootMeanSquaredError")
+        best_i, results = sel.validator.validate(
+            sel._candidates(), X, y, np.ones(len(y), np.float32),
+            eval_fn=sel._metric, metric_name="RootMeanSquaredError",
+            larger_better=sel.larger_better)
+        assert results[0].error is not None
+        assert results[0].metric_value == float("inf")
+        assert best_i == 1
+
+    def test_all_candidates_failing_raises_clear_error(self):
+        X, y = _binary_data()
+        sel = _selector(
+            [(_ExplodingLR(), grid(reg_param=[0.01, 0.1]))],
+            OpCrossValidation(num_folds=3, stratify=True))
+        with pytest.raises(RuntimeError, match="every candidate errored"):
+            sel.validator.validate(
+                sel._candidates(), X, y, np.ones(len(y), np.float32),
+                eval_fn=sel._metric, metric_name="AuPR")
+
+
+class TestMaxWaitBudget:
+    def test_budget_exceeded_skips_remaining_candidates(self):
+        X, y = _binary_data(n=200)
+        sel = _selector(
+            [(_SlowLR(), grid(reg_param=[0.01, 0.1, 0.3]))],
+            OpCrossValidation(num_folds=2, stratify=True, max_wait=0.05))
+        best_i, results = sel.validator.validate(
+            sel._candidates(), X, y, np.ones(len(y), np.float32),
+            eval_fn=sel._metric, metric_name="AuPR")
+        # first candidate always runs (sweep guarantees one result);
+        # the slow fits blow the 50 ms budget so the rest are skipped
+        assert results[0].error is None
+        skipped = [r for r in results[1:] if r.error
+                   and "max_wait" in r.error]
+        assert skipped, [r.error for r in results]
+        assert all(r.metric_value == float("-inf") for r in skipped)
+        assert best_i == 0
+
+    def test_no_budget_runs_every_candidate(self):
+        X, y = _binary_data(n=200)
+        sel = _selector(
+            [(OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))],
+            OpTrainValidationSplit(stratify=True))
+        _, results = sel.validator.validate(
+            sel._candidates(), X, y, np.ones(len(y), np.float32),
+            eval_fn=sel._metric, metric_name="AuPR")
+        assert all(r.error is None for r in results)
+        assert all(np.isfinite(r.metric_value) for r in results)
+
+
+class TestXGBEarlyStopping:
+    def _n_trees(self, model):
+        return int(np.asarray(model.feat).shape[0])
+
+    def test_early_stopping_truncates_to_best_iteration(self):
+        # trivially separable: validation AuPR saturates after few rounds,
+        # stall counter fires and the ensemble truncates at best_len
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(400, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        est = OpXGBoostClassifier(num_round=60, eta=0.3, max_depth=3,
+                                  early_stopping_rounds=3,
+                                  gamma=0.0, seed=3)
+        est.validation_fraction = 0.25
+        model = est.fit_raw(X, y)
+        n_trees = self._n_trees(model)
+        assert n_trees < 60, "early stopping never fired"
+        # truncation drops the stalled tail: len == best iteration, which is
+        # at most (rounds observed) - early_stopping_rounds
+        assert n_trees <= 60 - 3
+
+        ref = OpXGBoostClassifier(num_round=12, eta=0.3, max_depth=3,
+                                  early_stopping_rounds=0, seed=3)
+        full = ref.fit_raw(X, y)
+        assert self._n_trees(full) == 12, "rounds=0 must disable stopping"
+
+    def test_early_stopping_keeps_quality(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(500, 5)).astype(np.float32)
+        y = ((X[:, 0] + X[:, 1]) > 0).astype(np.float32)
+        est = OpXGBoostClassifier(num_round=80, eta=0.2, max_depth=3,
+                                  early_stopping_rounds=5, gamma=0.0, seed=4)
+        model = est.fit_raw(X, y)
+        batch = model.predict_batch(X)
+        from transmogrifai_tpu.evaluators.metrics import auroc
+        score = np.asarray(batch.probability)[:, 1]
+        assert auroc(y, score) > 0.95
+        assert self._n_trees(model) < 80
+
+    def test_es_metric_is_validation_aupr_device_scalar(self):
+        import jax
+
+        est = OpXGBoostClassifier(num_round=5, early_stopping_rounds=2)
+        rng = np.random.default_rng(5)
+        n = 64
+        F = jax.numpy.asarray(rng.normal(size=(n, 1)).astype(np.float32))
+        yj = jax.numpy.asarray((rng.random(n) > 0.5).astype(np.float32))
+        val_idx = np.arange(0, n, 2)
+        m = est._eval_metric_dev(F, yj, val_idx)
+        assert isinstance(m, jax.Array)
+        from transmogrifai_tpu.evaluators.metrics import aupr
+        expect = aupr(np.asarray(yj)[val_idx],
+                      1 / (1 + np.exp(-np.asarray(F)[val_idx, 0])))
+        assert abs(float(m) - float(expect)) < 1e-4

@@ -433,13 +433,15 @@ class BinaryClassificationModelSelector:
         num_folds: int = 3, validation_metric: str = "AuPR",
         splitter=None, seed: int = 42,
         models_and_parameters=None, parallelism: int = 8,
+        max_wait: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
             problem_type="binary",
             validator=OpCrossValidation(num_folds=num_folds, seed=seed,
                                         stratify=True,
-                                        parallelism=parallelism),
+                                        parallelism=parallelism,
+                                        max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             validation_metric=validation_metric)
 
@@ -448,13 +450,15 @@ class BinaryClassificationModelSelector:
         train_ratio: float = 0.75, validation_metric: str = "AuPR",
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
+        max_wait: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
             problem_type="binary",
             validator=OpTrainValidationSplit(train_ratio=train_ratio,
                                              seed=seed, stratify=True,
-                                             parallelism=parallelism),
+                                             parallelism=parallelism,
+                                             max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             validation_metric=validation_metric)
 
@@ -465,13 +469,15 @@ class MultiClassificationModelSelector:
         num_folds: int = 3, validation_metric: str = "F1",
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
+        max_wait: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
             problem_type="multiclass",
             validator=OpCrossValidation(num_folds=num_folds, seed=seed,
                                         stratify=True,
-                                        parallelism=parallelism),
+                                        parallelism=parallelism,
+                                        max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             validation_metric=validation_metric)
 
@@ -480,13 +486,15 @@ class MultiClassificationModelSelector:
         train_ratio: float = 0.75, validation_metric: str = "F1",
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
+        max_wait: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
             problem_type="multiclass",
             validator=OpTrainValidationSplit(train_ratio=train_ratio,
                                              seed=seed, stratify=True,
-                                             parallelism=parallelism),
+                                             parallelism=parallelism,
+                                             max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             validation_metric=validation_metric)
 
@@ -497,12 +505,14 @@ class RegressionModelSelector:
         num_folds: int = 3, validation_metric: str = "RootMeanSquaredError",
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
+        max_wait: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
             problem_type="regression",
             validator=OpCrossValidation(num_folds=num_folds, seed=seed,
-                                        parallelism=parallelism),
+                                        parallelism=parallelism,
+                                        max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             validation_metric=validation_metric)
 
@@ -512,13 +522,15 @@ class RegressionModelSelector:
         validation_metric: str = "RootMeanSquaredError",
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
+        max_wait: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
             problem_type="regression",
             validator=OpTrainValidationSplit(train_ratio=train_ratio,
                                              seed=seed,
-                                             parallelism=parallelism),
+                                             parallelism=parallelism,
+                                             max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             validation_metric=validation_metric)
 

@@ -28,12 +28,19 @@ class ValidationResult:
     metric_name: str
     metric_value: float
     fold_values: List[float]
+    #: fit/eval failure or budget-skip note; a failed candidate scores -inf
+    #: instead of aborting the sweep (OpValidator.scala:94-214 isolates
+    #: candidates in Futures bounded by maxWait)
+    error: Optional[str] = None
 
     def to_json(self):
-        return {"modelType": self.model_name, "params": self.params,
-                "metricName": self.metric_name,
-                "metricValue": self.metric_value,
-                "foldValues": self.fold_values}
+        out = {"modelType": self.model_name, "params": self.params,
+               "metricName": self.metric_name,
+               "metricValue": self.metric_value,
+               "foldValues": self.fold_values}
+        if self.error is not None:
+            out["error"] = self.error
+        return out
 
 
 def make_folds(n: int, num_folds: int, y: Optional[np.ndarray] = None,
@@ -113,7 +120,8 @@ class _ValidatorBase:
 
 class OpCrossValidation(_ValidatorBase):
     def __init__(self, num_folds: int = 3, seed: int = 42,
-                 stratify: bool = False, parallelism: int = 8):
+                 stratify: bool = False, parallelism: int = 8,
+                 max_wait: Optional[float] = None):
         self.num_folds = num_folds
         self.seed = seed
         self.stratify = stratify
@@ -121,32 +129,32 @@ class OpCrossValidation(_ValidatorBase):
         # runs as sequential launches of one cached compiled program (or
         # vmapped where the trainer supports it) — no thread pool needed.
         self.parallelism = parallelism
+        # wall-clock sweep budget in seconds (reference maxWait,
+        # OpValidator.scala:108): candidates not yet started when the budget
+        # runs out are skipped with a recorded error instead of hanging the
+        # train. None = unbounded.
+        self.max_wait = max_wait
 
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
                  larger_better=True):
         n = X.shape[0]
         folds = make_folds(n, self.num_folds, y=y, stratify=self.stratify,
                            seed=self.seed)
-        all_vals: List[List[Any]] = []
-        for name, params, fitter in candidates:
-            fold_vals: List[Any] = []
-            for k in range(self.num_folds):
-                w_train = base_weights * (folds != k)
-                w_eval = base_weights * (folds == k)
-                if w_train.sum() == 0 or w_eval.sum() == 0:
-                    continue
-                predict = fitter(X, y, w_train, params)
-                scores = predict(X)
-                fold_vals.append(eval_fn(y, scores, w_eval))
-            all_vals.append(fold_vals)
-        results: List[ValidationResult] = []
-        for (name, params, _), fold_vals in zip(candidates,
-                                                _materialize(all_vals)):
-            mean = float(np.mean(fold_vals)) if fold_vals else float("-inf")
-            results.append(ValidationResult(name, params, metric_name, mean,
-                                            fold_vals))
-        best = _argbest([r.metric_value for r in results], larger_better)
-        return best, results
+        fold_ctxs = []
+        for k in range(self.num_folds):
+            w_train = base_weights * (folds != k)
+            w_eval = base_weights * (folds == k)
+            if w_train.sum() == 0 or w_eval.sum() == 0:
+                continue
+            fold_ctxs.append((w_train, w_eval))
+
+        def run_fold(fitter, params, ctx):
+            w_train, w_eval = ctx
+            predict = fitter(X, y, w_train, params)
+            return eval_fn(y, predict(X), w_eval)
+
+        return _run_sweep(candidates, fold_ctxs, run_fold, metric_name,
+                          larger_better, self.max_wait)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -164,34 +172,30 @@ class OpCrossValidation(_ValidatorBase):
                 continue
             X_tr, y_tr, X_ev, y_ev = self._fold_matrices(
                 data, during_dag, label_name, features_name, tr_idx, ev_idx)
-            per_fold.append((X_tr, y_tr, base_weights[tr_idx],
-                             X_ev, y_ev, base_weights[ev_idx]))
-        all_vals: List[List[Any]] = []
-        for name, params, fitter in candidates:
-            fold_vals: List[Any] = []
-            for X_tr, y_tr, w_tr, X_ev, y_ev, w_ev in per_fold:
-                if w_tr.sum() == 0 or w_ev.sum() == 0:
-                    continue
-                predict = fitter(X_tr, y_tr, w_tr, params)
-                fold_vals.append(eval_fn(y_ev, predict(X_ev), w_ev))
-            all_vals.append(fold_vals)
-        results: List[ValidationResult] = []
-        for (name, params, _), fold_vals in zip(candidates,
-                                                _materialize(all_vals)):
-            mean = float(np.mean(fold_vals)) if fold_vals else float("-inf")
-            results.append(ValidationResult(name, params, metric_name, mean,
-                                            fold_vals))
-        best = _argbest([r.metric_value for r in results], larger_better)
-        return best, results
+            w_tr = base_weights[tr_idx]
+            w_ev = base_weights[ev_idx]
+            if w_tr.sum() == 0 or w_ev.sum() == 0:
+                continue
+            per_fold.append((X_tr, y_tr, w_tr, X_ev, y_ev, w_ev))
+
+        def run_fold(fitter, params, ctx):
+            X_tr, y_tr, w_tr, X_ev, y_ev, w_ev = ctx
+            predict = fitter(X_tr, y_tr, w_tr, params)
+            return eval_fn(y_ev, predict(X_ev), w_ev)
+
+        return _run_sweep(candidates, per_fold, run_fold, metric_name,
+                          larger_better, self.max_wait)
 
 
 class OpTrainValidationSplit(_ValidatorBase):
     def __init__(self, train_ratio: float = 0.75, seed: int = 42,
-                 stratify: bool = False, parallelism: int = 8):
+                 stratify: bool = False, parallelism: int = 8,
+                 max_wait: Optional[float] = None):
         self.train_ratio = train_ratio
         self.seed = seed
         self.stratify = stratify
         self.parallelism = parallelism
+        self.max_wait = max_wait
 
     def _split_mask(self, n: int, y: np.ndarray) -> np.ndarray:
         rng = np.random.default_rng(self.seed)
@@ -212,20 +216,15 @@ class OpTrainValidationSplit(_ValidatorBase):
                  larger_better=True):
         n = X.shape[0]
         in_train = self._split_mask(n, y)
-        all_vals: List[List[Any]] = []
-        for name, params, fitter in candidates:
-            w_train = base_weights * in_train
-            w_eval = base_weights * (~in_train)
+        w_train = base_weights * in_train
+        w_eval = base_weights * (~in_train)
+
+        def run_fold(fitter, params, ctx):
             predict = fitter(X, y, w_train, params)
-            scores = predict(X)
-            all_vals.append([eval_fn(y, scores, w_eval)])
-        results: List[ValidationResult] = []
-        for (name, params, _), vals in zip(candidates,
-                                           _materialize(all_vals)):
-            results.append(ValidationResult(name, params, metric_name,
-                                            vals[0], vals))
-        best = _argbest([r.metric_value for r in results], larger_better)
-        return best, results
+            return eval_fn(y, predict(X), w_eval)
+
+        return _run_sweep(candidates, [None], run_fold, metric_name,
+                          larger_better, self.max_wait)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -237,17 +236,76 @@ class OpTrainValidationSplit(_ValidatorBase):
         X_tr, y_tr, X_ev, y_ev = self._fold_matrices(
             data, during_dag, label_name, features_name, tr_idx, ev_idx)
         w_tr, w_ev = base_weights[tr_idx], base_weights[ev_idx]
-        all_vals: List[List[Any]] = []
-        for name, params, fitter in candidates:
+
+        def run_fold(fitter, params, ctx):
             predict = fitter(X_tr, y_tr, w_tr, params)
-            all_vals.append([eval_fn(y_ev, predict(X_ev), w_ev)])
-        results: List[ValidationResult] = []
-        for (name, params, _), vals in zip(candidates,
-                                           _materialize(all_vals)):
-            results.append(ValidationResult(name, params, metric_name,
-                                            vals[0], vals))
-        best = _argbest([r.metric_value for r in results], larger_better)
-        return best, results
+            return eval_fn(y_ev, predict(X_ev), w_ev)
+
+        return _run_sweep(candidates, [None], run_fold, metric_name,
+                          larger_better, self.max_wait)
+
+
+def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
+               larger_better: bool, max_wait: Optional[float],
+               ) -> Tuple[int, List[ValidationResult]]:
+    """Shared candidates×folds loop with per-candidate failure isolation.
+
+    The reference runs each (model, fold) fit in its own Future and bounds
+    the await with ``maxWait`` (OpCrossValidation.scala:113-138,
+    OpValidator.scala:108); a failed or timed-out candidate loses, it does
+    not kill the sweep.  Here fits are sequential XLA launches, so the
+    equivalents are: exceptions confined to the raising candidate (scored
+    -inf, error recorded in the summary) and a wall-clock budget checked
+    before each candidate dispatch (an already-dispatched XLA program
+    cannot be interrupted, but the sweep is guaranteed to stop enqueuing
+    and return partial results).  Raises only when EVERY candidate failed —
+    there is no model to select.
+    """
+    import time
+
+    t0 = time.monotonic()
+    all_vals: List[List[Any]] = []
+    errors: List[Optional[str]] = []
+    for name, params, fitter in candidates:
+        elapsed = time.monotonic() - t0
+        if max_wait is not None and elapsed > max_wait and all_vals:
+            all_vals.append([])
+            errors.append(f"skipped: validation budget max_wait={max_wait}s "
+                          f"exceeded after {elapsed:.1f}s")
+            continue
+        fold_vals: List[Any] = []
+        err: Optional[str] = None
+        try:
+            for ctx in fold_ctxs:
+                fold_vals.append(run_fold(fitter, params, ctx))
+        except Exception as e:  # noqa: BLE001 - candidate isolation
+            fold_vals = []
+            err = f"{type(e).__name__}: {e}"
+        all_vals.append(fold_vals)
+        errors.append(err)
+    # the losing sentinel depends on the metric direction: -inf only loses
+    # when larger is better; minimize metrics (RMSE, LogLoss) need +inf
+    worst = float("-inf") if larger_better else float("inf")
+    results: List[ValidationResult] = []
+    for (name, params, _), fold_vals, err in zip(
+            candidates, _materialize(all_vals), errors):
+        # mean over FINITE folds only: a single faulted fold (NaN from the
+        # per-value _materialize fallback) should not zero out the folds
+        # that did complete — the reference likewise averages whichever
+        # fold Futures finished
+        finite = [v for v in fold_vals if np.isfinite(v)]
+        if fold_vals and not finite and err is None:
+            err = "all fold metrics non-finite"
+        mean = float(np.mean(finite)) if finite and err is None else worst
+        results.append(ValidationResult(name, params, metric_name, mean,
+                                        fold_vals, error=err))
+    if all(r.error is not None for r in results):
+        raise RuntimeError(
+            "model selection failed: every candidate errored; first error: "
+            f"{results[0].error}")
+    best = _argbest([r.metric_value if r.error is None else worst
+                     for r in results], larger_better)
+    return best, results
 
 
 def _argbest(vals: List[float], larger_better: bool) -> int:
@@ -277,10 +335,22 @@ def _materialize(nested: List[List[Any]]) -> List[List[float]]:
         return [[float(v) for v in vals] for vals in nested]
     # jitted stack: un-jitted jnp.stack dispatches one expand_dims per
     # scalar (~30 ms tunnel dispatch each); jitted it is ONE launch
-    stacked = _stack_jit(*dev)
-    host = iter(np.asarray(stacked, np.float64))
-    return [[float(next(host)) if isinstance(v, jax.Array) else float(v)
-             for v in vals] for vals in nested]
+    try:
+        stacked = _stack_jit(*dev)
+        host = iter(np.asarray(stacked, np.float64))
+        return [[float(next(host)) if isinstance(v, jax.Array) else float(v)
+                 for v in vals] for vals in nested]
+    except Exception:
+        # an async device error (e.g. a diverging candidate whose metric
+        # program faults at execution time) poisons the stacked fetch;
+        # fall back to per-value fetches so only the faulty values go NaN
+        def fetch(v):
+            try:
+                return float(np.asarray(v)) if isinstance(v, jax.Array) \
+                    else float(v)
+            except Exception:
+                return float("nan")
+        return [[fetch(v) for v in vals] for vals in nested]
 
 
 def _stack_jit(*xs):
