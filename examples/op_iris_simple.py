@@ -26,7 +26,9 @@ def build(csv_path: str = DEFAULT_CSV):
     from transmogrifai_tpu.selector import (
         MultiClassificationModelSelector, grid,
     )
-    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpMultilayerPerceptronClassifier,
+    )
 
     df = pd.read_csv(csv_path, header=None, names=COLS)
     # label indexing (irisClass.indexed() in the reference); the DSL's
@@ -45,6 +47,11 @@ def build(csv_path: str = DEFAULT_CSV):
     prediction = MultiClassificationModelSelector.with_train_validation_split(
         models_and_parameters=[
             (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
+            # MLP over a small layer grid (the reference's Iris demo uses
+            # layers [4, 5, 4, 3] — OpIrisSimple sets the Spark MLP up the
+            # same way via OpMultilayerPerceptronClassifier.scala:48)
+            (OpMultilayerPerceptronClassifier(max_iter=300, step_size=0.1),
+             grid(hidden_layers=[[5, 4], [10]])),
         ],
     ).set_input(label, checked).get_output()
 

@@ -1,0 +1,138 @@
+"""OpMultilayerPerceptronClassifier — fit quality, selector integration,
+persistence (reference: OpMultilayerPerceptronClassifier.scala:48)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import (
+    OpLogisticRegression, OpMultilayerPerceptronClassifier,
+)
+
+
+def _xor_data(n=400, seed=0):
+    """XOR-ish: linearly inseparable, easy for one hidden layer."""
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = ((X[:, 0] * X[:, 1]) > 0).astype(np.float32)
+    return X, y
+
+
+class TestMLPFit:
+    def test_beats_lr_on_nonlinear_data(self):
+        X, y = _xor_data()
+        mlp = OpMultilayerPerceptronClassifier(
+            hidden_layers=[16], max_iter=400, step_size=0.1, seed=1)
+        lr = OpLogisticRegression()
+        acc_mlp = (np.asarray(mlp.fit_raw(X, y).predict_batch(X).prediction)
+                   == y).mean()
+        acc_lr = (np.asarray(lr.fit_raw(X, y).predict_batch(X).prediction)
+                  == y).mean()
+        assert acc_mlp > 0.9
+        assert acc_mlp > acc_lr + 0.2
+
+    def test_multiclass_softmax_head(self):
+        rng = np.random.default_rng(2)
+        k = 3
+        X = (rng.normal(size=(300, 4))
+             + np.repeat(np.eye(k, 4) * 3.0, 100, axis=0)).astype(np.float32)
+        y = np.repeat(np.arange(k), 100).astype(np.float32)
+        mlp = OpMultilayerPerceptronClassifier(hidden_layers=[8],
+                                               max_iter=300, step_size=0.1)
+        model = mlp.fit_raw(X, y)
+        batch = model.predict_batch(X)
+        assert batch.probability.shape == (300, 3)
+        assert np.allclose(batch.probability.sum(axis=1), 1.0, atol=1e-5)
+        assert (np.asarray(batch.prediction) == y).mean() > 0.95
+
+    def test_spark_style_layers_spec_validated(self):
+        X, y = _xor_data(100)
+        ok = OpMultilayerPerceptronClassifier(layers=[2, 5, 2], max_iter=20)
+        ok.fit_raw(X, y)
+        bad = OpMultilayerPerceptronClassifier(layers=[3, 5, 2], max_iter=20)
+        with pytest.raises(ValueError, match="layers"):
+            bad.fit_raw(X, y)
+        # labels exceeding the declared head is a genuine mismatch
+        tiny_head = OpMultilayerPerceptronClassifier(layers=[2, 5, 2],
+                                                     max_iter=20)
+        with pytest.raises(ValueError, match="classes"):
+            tiny_head.fit_raw(X, (y + 1.0) + (y == 0) * 1.0)  # classes {1,2}
+
+    def test_layers_spec_tolerates_fold_missing_top_class(self):
+        # a CV train fold with only classes {0,1} must not shrink a
+        # 3-class head declared via the Spark-style spec
+        X, y = _xor_data(100)
+        est = OpMultilayerPerceptronClassifier(layers=[2, 5, 3], max_iter=30)
+        model = est.fit_raw(X, y)  # y only has {0,1}
+        assert model.predict_batch(X).probability.shape == (100, 3)
+
+    def test_tol_early_exit(self):
+        from transmogrifai_tpu.models.mlp import fit_mlp
+        X, y = _xor_data(200)
+        Y = np.eye(2, dtype=np.float32)[y.astype(int)]
+        w = np.ones(len(y), np.float32)
+        _, n_iter_loose, _ = fit_mlp(X, Y, w, (2, 8, 2), max_iter=500,
+                                     tol=1e-2, step_size=0.1)
+        _, n_iter_tight, _ = fit_mlp(X, Y, w, (2, 8, 2), max_iter=500,
+                                     tol=0.0, step_size=0.1)
+        assert int(n_iter_loose) < int(n_iter_tight) == 500
+
+
+class TestMLPSelectorIntegration:
+    def test_mlp_in_multiclass_selector(self):
+        from transmogrifai_tpu.selector import (
+            MultiClassificationModelSelector, grid,
+        )
+        from transmogrifai_tpu.types.columns import FeatureColumn
+        from transmogrifai_tpu.types.feature_types import OPVector, RealNN
+
+        rng = np.random.default_rng(3)
+        k = 3
+        X = (rng.normal(size=(240, 4))
+             + np.repeat(np.eye(k, 4) * 2.5, 80, axis=0)).astype(np.float32)
+        y = np.repeat(np.arange(k), 80).astype(np.float32)
+        sel = MultiClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[
+                (OpMultilayerPerceptronClassifier(max_iter=200,
+                                                  step_size=0.1),
+                 grid(hidden_layers=[[4], [8]])),
+                (OpLogisticRegression(), grid(reg_param=[0.1])),
+            ])
+        selected = sel.fit_columns(None, FeatureColumn(RealNN, y),
+                                   FeatureColumn(OPVector, X))
+        summ = sel.metadata["model_selector_summary"]
+        names = {r["modelType"] for r in summ["validationResults"]}
+        assert "OpMultilayerPerceptronClassifier" in names
+        assert all(r.get("error") is None for r in summ["validationResults"])
+        acc = (np.asarray(selected.predict_batch(X).prediction) == y).mean()
+        assert acc > 0.9
+
+    def test_mlp_workflow_persistence_roundtrip(self, tmp_path):
+        import pandas as pd
+
+        from transmogrifai_tpu import (
+            FeatureBuilder, OpWorkflow, OpWorkflowModel, transmogrify,
+        )
+        from transmogrifai_tpu.selector import (
+            MultiClassificationModelSelector, grid,
+        )
+
+        X, y = _xor_data(240, seed=5)
+        df = pd.DataFrame({"a": X[:, 0], "b": X[:, 1],
+                           "label": y.astype(float)})
+        label, preds = FeatureBuilder.from_dataframe(df, response="label")
+        vec = transmogrify(preds)
+        pred = MultiClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[
+                (OpMultilayerPerceptronClassifier(hidden_layers=[8],
+                                                  max_iter=300,
+                                                  step_size=0.1),
+                 grid(seed=[1])),
+            ]).set_input(label, vec).get_output()
+        model = (OpWorkflow().set_result_features(pred)
+                 .set_input_data(df).train())
+        path = str(tmp_path / "mlp-model")
+        model.save(path)
+        loaded = OpWorkflowModel.load(path)
+        s1 = [r["prediction"] for r in model.score(df)[pred.name].values]
+        s2 = [r["prediction"] for r in loaded.score(df)[pred.name].values]
+        assert np.allclose(s1, s2)
+        assert (np.asarray(s1) == y).mean() > 0.9

@@ -1,6 +1,9 @@
 from .classification import (  # noqa: F401
     OpLogisticRegression, OpLinearSVC, OpNaiveBayes,
 )
+from .mlp import (  # noqa: F401
+    OpMultilayerPerceptronClassifier, MLPClassificationModel,
+)
 from .regression import (  # noqa: F401
     OpLinearRegression, OpGeneralizedLinearRegression,
     IsotonicRegressionCalibrator,
