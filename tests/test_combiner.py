@@ -1,0 +1,174 @@
+"""SelectedModelCombiner — strategy weights, metadata merge, workflow e2e
+(reference: SelectedModelCombiner.scala)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import (
+    OpLogisticRegression, OpRandomForestClassifier,
+)
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, SelectedModelCombiner,
+    SelectedCombinerModel, grid,
+)
+from transmogrifai_tpu.selector.splitters import DataSplitter
+from transmogrifai_tpu.evaluators.metrics import aupr
+
+
+def _blend_data(n=900, seed=0):
+    """Linear + interaction signal: LR captures the first, RF the second —
+    their errors decorrelate, so a blend should beat both."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    logits = 1.5 * X[:, 0] + 2.5 * np.sign(X[:, 1] * X[:, 2])
+    p = 1 / (1 + np.exp(-logits))
+    y = (rng.random(n) < p).astype(np.float32)
+    return X, y
+
+
+def _fit_two_selectors(df, label, checked):
+    lr_sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))],
+        splitter=DataSplitter(reserve_test_fraction=0.0),
+    ).set_input(label, checked)
+    rf_sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpRandomForestClassifier(), grid(num_trees=[60],
+                                              max_depth=[5]))],
+        splitter=DataSplitter(reserve_test_fraction=0.0),
+    ).set_input(label, checked)
+    return lr_sel, rf_sel
+
+
+class TestCombinerWeights:
+    def _summaries(self, m1, m2, metric="AuPR"):
+        def summ(m, name):
+            return {"problemType": "binary",
+                    "bestModelType": name, "bestModelParams": {"p": 1},
+                    "validationResults": [
+                        {"modelType": name, "params": {"p": 1},
+                         "metricName": metric, "metricValue": m}],
+                    "trainEvaluationMetrics": {metric: m},
+                    "validationType": "OpTrainValidationSplit"}
+        return summ(m1, "A"), summ(m2, "B")
+
+    def _combiner_with(self, s1, s2, strategy):
+        from transmogrifai_tpu.features.feature import Feature
+        from transmogrifai_tpu.stages.base import UnaryTransformer
+        from transmogrifai_tpu.types.feature_types import (
+            Prediction, RealNN,
+        )
+
+        class _Stub(UnaryTransformer):
+            def __init__(self, summ):
+                super().__init__(operation_name="stub",
+                                 output_type=Prediction)
+                self.metadata = {"model_selector_summary": summ}
+
+        c = SelectedModelCombiner(combination_strategy=strategy)
+        label = Feature("y", RealNN, is_response=True)
+        f1 = Feature("p1", Prediction, origin_stage=_Stub(s1))
+        f2 = Feature("p2", Prediction, origin_stage=_Stub(s2))
+        c.input_features = [label, f1, f2]
+        return c
+
+    def test_best_picks_higher_for_maximize_metric(self):
+        s1, s2 = self._summaries(0.7, 0.9)
+        model = self._combiner_with(s1, s2, "best").fit_columns(
+            None, None, None, None)
+        assert (model.weight1, model.weight2) == (0.0, 1.0)
+
+    def test_best_picks_lower_for_minimize_metric(self):
+        s1, s2 = self._summaries(1.2, 3.4, metric="RootMeanSquaredError")
+        model = self._combiner_with(s1, s2, "best").fit_columns(
+            None, None, None, None)
+        assert (model.weight1, model.weight2) == (1.0, 0.0)
+
+    def test_weighted_direction_corrected(self):
+        s1, s2 = self._summaries(0.6, 0.2)
+        model = self._combiner_with(s1, s2, "weighted").fit_columns(
+            None, None, None, None)
+        assert model.weight1 == pytest.approx(0.75)
+        s1, s2 = self._summaries(1.0, 3.0, metric="LogLoss")
+        model = self._combiner_with(s1, s2, "weighted").fit_columns(
+            None, None, None, None)
+        assert model.weight1 == pytest.approx(0.75)  # smaller loss wins
+
+    def test_problem_type_mismatch_rejected(self):
+        s1, s2 = self._summaries(0.7, 0.9)
+        s2["problemType"] = "regression"
+        with pytest.raises(RuntimeError, match="problem types"):
+            self._combiner_with(s1, s2, "best").fit_columns(
+                None, None, None, None)
+
+    def test_best_copies_winner_summary_merged_otherwise(self):
+        s1, s2 = self._summaries(0.7, 0.9)
+        c = self._combiner_with(s1, s2, "best")
+        c.fit_columns(None, None, None, None)
+        assert c.metadata["model_selector_summary"]["bestModelType"] == "B"
+        c2 = self._combiner_with(s1, s2, "equal")
+        c2.fit_columns(None, None, None, None)
+        merged = c2.metadata["model_selector_summary"]
+        assert merged["bestModelType"] == "A B"
+        assert len(merged["validationResults"]) == 2
+        assert "p_1" in merged["bestModelParams"]
+
+
+class TestCombinerWorkflow:
+    def _train(self, strategy):
+        import pandas as pd
+
+        from transmogrifai_tpu import (
+            FeatureBuilder, OpWorkflow, transmogrify,
+        )
+
+        X, y = _blend_data()
+        df = pd.DataFrame({f"x{i}": X[:, i] for i in range(X.shape[1])})
+        df["y"] = y.astype(float)
+        train_df, hold_df = df.iloc[:700], df.iloc[700:]
+        label, preds = FeatureBuilder.from_dataframe(train_df, response="y")
+        vec = transmogrify(preds)
+        lr_sel, rf_sel = _fit_two_selectors(train_df, label, vec)
+        p1, p2 = lr_sel.get_output(), rf_sel.get_output()
+        combined = SelectedModelCombiner(
+            combination_strategy=strategy).set_input(
+            label, p1, p2).get_output()
+        wf = OpWorkflow().set_result_features(combined, p1, p2)
+        model = wf.set_input_data(train_df).train()
+        return model, combined, p1, p2, hold_df, train_df
+
+    def _holdout_aupr(self, scored, feat, y):
+        from transmogrifai_tpu.selector.combiner import _as_batch
+        batch = _as_batch(scored[feat.name])
+        return aupr(y, batch.probability[:, 1])
+
+    def test_ensemble_beats_both_members_on_holdout(self):
+        model, combined, p1, p2, hold_df, _ = self._train("equal")
+        scored = model.score(hold_df)
+        y = hold_df["y"].to_numpy()
+        a_comb = self._holdout_aupr(scored, combined, y)
+        a_lr = self._holdout_aupr(scored, p1, y)
+        a_rf = self._holdout_aupr(scored, p2, y)
+        assert a_comb > a_lr and a_comb > a_rf, (a_comb, a_lr, a_rf)
+
+    def test_best_strategy_matches_winner(self):
+        model, combined, p1, p2, hold_df, _ = self._train("best")
+        scored = model.score(hold_df)
+        y = hold_df["y"].to_numpy()
+        a_comb = self._holdout_aupr(scored, combined, y)
+        a_members = [self._holdout_aupr(scored, p1, y),
+                     self._holdout_aupr(scored, p2, y)]
+        assert a_comb == pytest.approx(max(a_members), abs=1e-9)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        from transmogrifai_tpu import OpWorkflowModel
+
+        model, combined, p1, p2, hold_df, _ = self._train("weighted")
+        path = str(tmp_path / "combo")
+        model.save(path)
+        loaded = OpWorkflowModel.load(path)
+        s1 = [r["prediction"]
+              for r in model.score(hold_df)[combined.name].values]
+        s2 = [r["prediction"]
+              for r in loaded.score(hold_df)[combined.name].values]
+        assert np.allclose(s1, s2)

@@ -54,7 +54,7 @@ class TestMLPFit:
         tiny_head = OpMultilayerPerceptronClassifier(layers=[2, 5, 2],
                                                      max_iter=20)
         with pytest.raises(ValueError, match="classes"):
-            tiny_head.fit_raw(X, (y + 1.0) + (y == 0) * 1.0)  # classes {1,2}
+            tiny_head.fit_raw(X, y + 1.0)  # classes {1,2} exceed 2-class head
 
     def test_layers_spec_tolerates_fold_missing_top_class(self):
         # a CV train fold with only classes {0,1} must not shrink a

@@ -24,7 +24,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+#: Metrics where SMALLER is better — the single source of truth for
+#: selection direction (ModelSelector.larger_better, SelectedModelCombiner).
+MINIMIZE_METRICS = (
+    "RootMeanSquaredError", "MeanSquaredError", "MeanAbsoluteError",
+    "Error", "LogLoss", "BrierScore", "SMAPE", "MASE", "SeasonalError",
+)
+
 __all__ = [
+    "MINIMIZE_METRICS",
     "auroc", "aupr", "binary_metrics_at_threshold", "brier_score", "log_loss",
     "binary_classification_metrics", "multiclass_metrics",
     "regression_metrics", "forecast_metrics", "threshold_curves",

@@ -3,5 +3,6 @@ from .model_selector import (  # noqa: F401
     MultiClassificationModelSelector, RegressionModelSelector,
     DefaultSelectorParams, RandomParamBuilder, grid,
 )
+from .combiner import SelectedModelCombiner, SelectedCombinerModel  # noqa: F401
 from .splitters import DataSplitter, DataBalancer, DataCutter  # noqa: F401
 from .validators import OpCrossValidation, OpTrainValidationSplit  # noqa: F401

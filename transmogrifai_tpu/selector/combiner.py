@@ -1,0 +1,280 @@
+"""SelectedModelCombiner — ensemble the predictions of two ModelSelectors.
+
+Reference: ``SelectedModelCombiner`` / ``SelectedCombinerModel``
+(core/.../impl/selector/SelectedModelCombiner.scala) with strategies from
+``CombinationStrategy`` (features/.../impl/feature/CombinationStrategy.scala):
+
+* ``best``     — all weight on the selector whose winning model validated
+                 better (direction-aware, SelectedModelCombiner.scala:140-146);
+* ``weighted`` — weights proportional to each selector's winning-model
+                 metric.  Deviation from the reference, by design: for
+                 minimize metrics (RMSE, LogLoss) the reference's
+                 ``m1/(m1+m2)`` weighs the WORSE model higher
+                 (SelectedModelCombiner.scala:147-148); here weights are
+                 direction-corrected so the better model always dominates;
+* ``equal``    — 0.5/0.5.
+
+Metric resolution mirrors the reference (SelectedModelCombiner.scala:120-134):
+same validation metric → each selector's winning validation value; different
+metrics → overlap through the other selector's training metrics; no overlap
+→ error.  The combined model transforms row predictions as
+``raw = w1·raw1 + w2·raw2``, ``prob = w1·p1 + w2·p2``, prediction = argmax of
+combined probabilities (weighted prediction when no probabilities exist,
+SelectedModelCombiner.scala:230-237).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.prediction import PredictionBatch
+from ..stages.base import TernaryEstimator, TernaryModel
+from ..types.columns import FeatureColumn
+from ..types.feature_types import Prediction
+
+__all__ = ["SelectedModelCombiner", "SelectedCombinerModel"]
+
+def _larger_better(metric: str) -> bool:
+    from ..evaluators.metrics import MINIMIZE_METRICS
+    return metric not in MINIMIZE_METRICS
+
+
+def _as_batch(col: FeatureColumn) -> PredictionBatch:
+    """Prediction column -> PredictionBatch (handles the row-dict form the
+    local scorer and persistence paths produce)."""
+    v = col.values
+    if isinstance(v, PredictionBatch):
+        return v
+    rows = list(v)
+    pred = np.asarray([0.0 if r is None else r.get("prediction", 0.0)
+                       for r in rows], np.float64)
+
+    def collect(prefix):
+        ks: List[str] = sorted(
+            {k for r in rows if r for k in r if k.startswith(prefix)},
+            key=lambda k: int(k.rsplit("_", 1)[1]))
+        if not ks:
+            return None
+        return np.asarray([[0.0 if r is None else r.get(k, 0.0) for k in ks]
+                           for r in rows], np.float64)
+
+    return PredictionBatch(prediction=pred,
+                           raw_prediction=collect("rawPrediction_"),
+                           probability=collect("probability_"))
+
+
+class SelectedModelCombiner(TernaryEstimator):
+    """Inputs: (label RealNN, prediction1, prediction2) where both prediction
+    features come from ModelSelector stages (their fitted summaries supply
+    the winning-model metrics that set the combination weights)."""
+
+    def __init__(self, combination_strategy: str = "best",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="combineModels",
+                         output_type=Prediction, uid=uid)
+        if combination_strategy not in ("best", "weighted", "equal"):
+            raise ValueError(
+                f"unknown combination_strategy {combination_strategy!r} "
+                "(expected 'best', 'weighted' or 'equal')")
+        self.combination_strategy = combination_strategy
+
+    def output_is_response(self) -> bool:
+        return False
+
+    # -- summary plumbing ----------------------------------------------------
+
+    def _selector_summaries(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        out = []
+        for feat in self.input_features[1:3]:
+            stage = feat.origin_stage
+            summ = (stage.metadata or {}).get("model_selector_summary")
+            if summ is None:
+                raise RuntimeError(
+                    "SelectedModelCombiner inputs must be predictions from "
+                    f"fitted ModelSelectors; {feat.name!r} (stage "
+                    f"{type(stage).__name__}) carries no "
+                    "model_selector_summary")
+            out.append(summ)
+        return out[0], out[1]
+
+    @staticmethod
+    def _winning_metric(summ: Dict[str, Any]) -> Tuple[float, str]:
+        """Validation metric value of the selector's winning model
+        (SelectedModelCombiner.getWinningModelMetric)."""
+        results = summ.get("validationResults") or []
+        metric_name = results[0]["metricName"] if results else ""
+        for r in results:
+            if (r.get("modelType") == summ.get("bestModelType")
+                    and r.get("params") == summ.get("bestModelParams")):
+                return float(r["metricValue"]), metric_name
+        vals = [float(r["metricValue"]) for r in results
+                if np.isfinite(r["metricValue"])]
+        if not vals:
+            raise RuntimeError("selector summary has no finite validation "
+                               "metric for the winning model")
+        return (max(vals) if _larger_better(metric_name) else min(vals),
+                metric_name)
+
+    @staticmethod
+    def _train_metric(summ: Dict[str, Any], name: str) -> Optional[float]:
+        metrics = summ.get("trainEvaluationMetrics") or {}
+        # exact key first: substring fallback alone would hit
+        # RootMeanSquaredError when asked for MeanSquaredError
+        if name in metrics and isinstance(metrics[name], (int, float)):
+            return float(metrics[name])
+        for k, v in metrics.items():
+            if name and (name in k or k in name) and isinstance(
+                    v, (int, float)):
+                return float(v)
+        return None
+
+    def _resolve_metrics(self, s1, s2) -> Tuple[float, float, str]:
+        m1, n1 = self._winning_metric(s1)
+        m2, n2 = self._winning_metric(s2)
+        if n1 == n2:
+            return m1, m2, n1
+        # different decision metrics: overlap through training metrics
+        # (SelectedModelCombiner.scala:125-134)
+        m2e1 = self._train_metric(s2, n1)
+        if m2e1 is not None:
+            t1 = self._train_metric(s1, n1)
+            return (t1 if t1 is not None else m1), m2e1, n1
+        m1e2 = self._train_metric(s1, n2)
+        if m1e2 is not None:
+            t2 = self._train_metric(s2, n2)
+            return m1e2, (t2 if t2 is not None else m2), n2
+        raise RuntimeError(
+            "evaluation metrics for the two model selectors are "
+            f"non-overlapping ({n1!r} vs {n2!r})")
+
+    # -- fit -----------------------------------------------------------------
+
+    def fit_columns(self, data, label_col: FeatureColumn,
+                    p1_col: FeatureColumn, p2_col: FeatureColumn):
+        s1, s2 = self._selector_summaries()
+        if s1.get("problemType") not in (None, s2.get("problemType")):
+            raise RuntimeError(
+                "cannot combine selectors for different problem types: "
+                f"{s1.get('problemType')} vs {s2.get('problemType')}")
+        m1, m2, metric = self._resolve_metrics(s1, s2)
+        strategy = self.combination_strategy
+        lb = _larger_better(metric)
+        if strategy == "best":
+            first_wins = (m1 > m2) if lb else (m1 < m2)
+            w1, w2 = (1.0, 0.0) if first_wins else (0.0, 1.0)
+        elif strategy == "weighted":
+            # maximize metrics can be negative (R2): clamp at 0 so weights
+            # interpolate — a negative weight would extrapolate away from
+            # the better model
+            c1, c2 = max(m1, 0.0), max(m2, 0.0)
+            tot = c1 + c2
+            if tot <= 0 or not np.isfinite(tot):
+                w1 = w2 = 0.5
+            elif lb:
+                w1, w2 = c1 / tot, c2 / tot
+            else:  # minimize: better (smaller) metric gets the bigger weight
+                w1, w2 = c2 / tot, c1 / tot
+        else:
+            w1 = w2 = 0.5
+
+        if strategy == "best":
+            # winner's summary verbatim (SelectedModelCombiner.scala:163-167)
+            self.metadata["model_selector_summary"] = dict(
+                s1 if w1 > 0.5 else s2)
+        else:
+            self.metadata["model_selector_summary"] = {
+                "validationType": s1.get("validationType"),
+                "bestModelType": f"{s1.get('bestModelType')} "
+                                 f"{s2.get('bestModelType')}",
+                "bestModelParams": {
+                    **{f"{k}_1": v for k, v in
+                       (s1.get("bestModelParams") or {}).items()},
+                    **{f"{k}_2": v for k, v in
+                       (s2.get("bestModelParams") or {}).items()}},
+                "validationResults": list(s1.get("validationResults") or [])
+                + list(s2.get("validationResults") or []),
+                "holdoutMetrics": {},
+                "trainEvaluationMetrics": {},
+                "dataPrepResults": (s1.get("dataPrepResults")
+                                    or s2.get("dataPrepResults")),
+            }
+        self.metadata["combiner"] = {
+            "strategy": strategy, "metricName": metric,
+            "metricValue1": m1, "metricValue2": m2,
+            "weight1": w1, "weight2": w2,
+        }
+        model = SelectedCombinerModel(weight1=w1, weight2=w2,
+                                      strategy=strategy, metric=metric)
+        # rerun train evaluation on the COMBINED predictions for non-best
+        # strategies (SelectedModelCombiner.scala:168-183)
+        if strategy != "best" and label_col is not None:
+            combined = model.transform_columns(label_col, p1_col, p2_col)
+            self.metadata["model_selector_summary"][
+                "trainEvaluationMetrics"] = _evaluate_combined(
+                    label_col, combined.values)
+        return model
+
+
+def _evaluate_combined(label_col: FeatureColumn,
+                       batch: PredictionBatch) -> Dict[str, float]:
+    from ..evaluators.metrics import (
+        binary_classification_metrics, multiclass_metrics,
+        regression_metrics,
+    )
+
+    y = np.nan_to_num(np.asarray(label_col.values, np.float64))
+    proba = batch.probability
+    if proba is not None and proba.shape[1] == 2:
+        return binary_classification_metrics(y, proba[:, 1])
+    if proba is not None:
+        pred = np.asarray(batch.prediction).astype(int)
+        out = multiclass_metrics(y.astype(int), pred, proba.shape[1])
+        out.pop("confusion", None)
+        return out
+    return regression_metrics(y, np.asarray(batch.prediction))
+
+
+class SelectedCombinerModel(TernaryModel):
+    """Row combiner: weighted raw/probability sums, argmax prediction
+    (SelectedModelCombiner.scala transformFn :230-237)."""
+
+    def __init__(self, weight1: float, weight2: float, strategy: str = "best",
+                 metric: str = "", uid: Optional[str] = None):
+        super().__init__(operation_name="combineModels",
+                         output_type=Prediction, uid=uid)
+        self.weight1 = float(weight1)
+        self.weight2 = float(weight2)
+        self.strategy = strategy
+        self.metric = metric
+
+    def output_is_response(self) -> bool:
+        return False
+
+    def transform_columns(self, label_col, p1_col, p2_col) -> FeatureColumn:
+        b1, b2 = _as_batch(p1_col), _as_batch(p2_col)
+        w1, w2 = self.weight1, self.weight2
+
+        def comb(a1, a2):
+            if a1 is None or a2 is None:
+                return None
+            if np.shape(a1) != np.shape(a2):
+                # two classification heads of different widths cannot be
+                # blended; averaging their class INDICES instead would
+                # produce a class neither model predicted
+                raise ValueError(
+                    "cannot combine predictions of different shapes "
+                    f"{np.shape(a1)} vs {np.shape(a2)} (mismatched class "
+                    "counts between the two selectors)")
+            return w1 * np.asarray(a1, np.float64) + w2 * np.asarray(
+                a2, np.float64)
+
+        raw = comb(b1.raw_prediction, b2.raw_prediction)
+        proba = comb(b1.probability, b2.probability)
+        if proba is not None:
+            pred = proba.argmax(axis=1).astype(np.float64)
+        else:
+            pred = w1 * np.asarray(b1.prediction) + w2 * np.asarray(
+                b2.prediction)
+        return FeatureColumn(Prediction, PredictionBatch(
+            prediction=pred, raw_prediction=raw, probability=proba))

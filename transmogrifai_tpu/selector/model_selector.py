@@ -82,7 +82,8 @@ class ModelSelectorSummary:
                  best_model_name: str, best_params: Dict[str, Any],
                  validation_type: str, holdout_metrics: Dict[str, float],
                  train_metrics: Dict[str, float],
-                 splitter_summary: Optional[dict]):
+                 splitter_summary: Optional[dict],
+                 problem_type: Optional[str] = None):
         self.validation_results = validation_results
         self.best_model_name = best_model_name
         self.best_params = best_params
@@ -90,10 +91,12 @@ class ModelSelectorSummary:
         self.holdout_metrics = holdout_metrics
         self.train_metrics = train_metrics
         self.splitter_summary = splitter_summary
+        self.problem_type = problem_type
 
     def to_json(self):
         return {
             "validationType": self.validation_type,
+            "problemType": self.problem_type,
             "validationResults": [r.to_json() for r in self.validation_results],
             "bestModelType": self.best_model_name,
             "bestModelParams": self.best_params,
@@ -207,9 +210,8 @@ class ModelSelector(PredictorEstimator):
 
     @property
     def larger_better(self) -> bool:
-        return self.validation_metric not in (
-            "RootMeanSquaredError", "MeanSquaredError", "MeanAbsoluteError",
-            "Error", "LogLoss", "BrierScore")
+        from ..evaluators.metrics import MINIMIZE_METRICS
+        return self.validation_metric not in MINIMIZE_METRICS
 
     def _candidates(self):
         out = []
@@ -331,7 +333,8 @@ class ModelSelector(PredictorEstimator):
             validation_type=type(self.validator).__name__,
             holdout_metrics=holdout_metrics, train_metrics=train_metrics,
             splitter_summary=(splitter.summary.to_json()
-                              if splitter.summary else None))
+                              if splitter.summary else None),
+            problem_type=self.problem_type)
         self.metadata["model_selector_summary"] = summary.to_json()
         selected = SelectedModel(inner=best_model, best_name=best_name,
                                  best_params=best_params)
