@@ -1,0 +1,145 @@
+"""Multiclass ThresholdMetrics — golden values vs hand-computed counts
+(reference: OpMultiClassificationEvaluator.calculateThresholdMetrics,
+core/.../evaluators/OpMultiClassificationEvaluator.scala:153-240)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.metrics import (
+    multiclass_threshold_metrics,
+)
+
+
+class TestGoldenCounts:
+    # 4 rows, 3 classes; thresholds 0.0/0.5/0.9; hand-derived below
+    P = np.array([
+        [0.6, 0.3, 0.1],   # y=0: true=0.6 rank0 ; top=0.6
+        [0.2, 0.5, 0.3],   # y=0: true=0.2 rank2 ; top=0.5
+        [0.1, 0.45, 0.45], # y=2: true=0.45 rank1 (tie, idx1 first); top=0.45
+        [0.05, 0.05, 0.9], # y=2: true=0.9 rank0 ; top=0.9
+    ])
+    Y = np.array([0, 0, 2, 2])
+    THR = [0.0, 0.5, 0.9]
+
+    def _run(self, top_ns=(1, 3)):
+        return multiclass_threshold_metrics(self.Y, self.P, top_ns=top_ns,
+                                            thresholds=self.THR)
+
+    def test_top1_counts(self):
+        m = self._run()
+        # top1 membership: rows 0 (rank0), 3 (rank0); row2 loses tie to idx1
+        # correct@thr: row0 true=.6 (≥0,≥.5), row3 true=.9 (all)
+        assert m["correctCounts"][1] == [2, 2, 1]
+        # incorrect: rows1,2 top≥thr (both .5/.45): thr0→2, thr.5→1(row1),
+        # thr.9→0 ; rows0,3 in-top1 contribute where top≥thr>true: none
+        assert m["incorrectCounts"][1] == [2, 1, 0]
+        assert m["noPredictionCounts"][1] == [0, 1, 3]
+
+    def test_top3_counts(self):
+        m = self._run()
+        # top3 contains every class: correct = true≥thr
+        assert m["correctCounts"][3] == [4, 2, 1]
+        # incorrect = top≥thr but true<thr
+        assert m["incorrectCounts"][3] == [0, 1, 0]
+        assert m["noPredictionCounts"][3] == [0, 1, 3]
+
+    def test_counts_partition_rows(self):
+        m = self._run(top_ns=(1, 2, 3))
+        n = len(self.Y)
+        for t in (1, 2, 3):
+            for j in range(len(self.THR)):
+                total = (m["correctCounts"][t][j]
+                         + m["incorrectCounts"][t][j]
+                         + m["noPredictionCounts"][t][j])
+                assert total == n, (t, j)
+
+    def test_tie_goes_to_earlier_index(self):
+        # row2: true class 2 ties class 1 at 0.45 — the reference's stable
+        # descending sort places index 1 first, so top1 misses class 2
+        m = self._run(top_ns=(1,))
+        # with top2 the tied true class IS included
+        m2 = self._run(top_ns=(2,))
+        assert m["correctCounts"][1][0] == 2
+        assert m2["correctCounts"][2][0] == 3
+
+    def test_unseen_label_counts_incorrect(self):
+        # label index beyond the probability width: score treated as 0
+        m = multiclass_threshold_metrics(
+            np.array([5]), np.array([[0.7, 0.3]]), top_ns=(1,),
+            thresholds=[0.0, 0.5])
+        assert m["correctCounts"][1] == [0, 0]
+        assert m["incorrectCounts"][1] == [1, 1]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="thresholds"):
+            multiclass_threshold_metrics(self.Y, self.P, thresholds=[1.5])
+        with pytest.raises(ValueError, match="top_ns"):
+            multiclass_threshold_metrics(self.Y, self.P, top_ns=())
+
+    def test_device_path_matches_host(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        P = rng.dirichlet(np.ones(4), size=500)
+        y = rng.integers(0, 4, size=500)
+        host = multiclass_threshold_metrics(y, P, top_ns=(1, 2))
+        dev = multiclass_threshold_metrics(jnp.asarray(y),
+                                           jnp.asarray(P), top_ns=(1, 2))
+        for key in ("correctCounts", "incorrectCounts",
+                    "noPredictionCounts"):
+            for t in (1, 2):
+                assert host[key][t] == dev[key][t], (key, t)
+
+
+class TestEvaluatorIntegration:
+    def test_evaluator_emits_threshold_metrics(self):
+        from transmogrifai_tpu.evaluators.evaluators import (
+            OpMultiClassificationEvaluator,
+        )
+        from transmogrifai_tpu.models.prediction import (
+            PredictionBatch, prediction_column,
+        )
+        from transmogrifai_tpu.types.columns import (
+            ColumnarDataset, FeatureColumn,
+        )
+        from transmogrifai_tpu.types.feature_types import RealNN
+
+        rng = np.random.default_rng(1)
+        n, k = 200, 3
+        proba = rng.dirichlet(np.ones(k), size=n)
+        y = rng.integers(0, k, size=n).astype(float)
+        ds = ColumnarDataset({
+            "y": FeatureColumn(RealNN, y),
+            "p": prediction_column(proba.argmax(axis=1).astype(float),
+                                   probability=proba),
+        })
+        ev = OpMultiClassificationEvaluator(label_col="y",
+                                            prediction_col="p")
+        out = ev.evaluate(ds)
+        tm = out["ThresholdMetrics"]
+        assert tm["topNs"] == [1, 3]
+        assert len(tm["thresholds"]) == 101
+        # at threshold 0.0 every row has a prediction; top-3 of 3 classes
+        # always contains the true class
+        assert tm["correctCounts"][3][0] == n
+        assert tm["noPredictionCounts"][1][0] == 0
+
+    def test_n_classes_from_probability_width(self):
+        # eval slice missing the top class must not shrink the class space
+        from transmogrifai_tpu.evaluators.evaluators import (
+            OpMultiClassificationEvaluator,
+        )
+        from transmogrifai_tpu.models.prediction import prediction_column
+        from transmogrifai_tpu.types.columns import (
+            ColumnarDataset, FeatureColumn,
+        )
+        from transmogrifai_tpu.types.feature_types import RealNN
+
+        y = np.array([0.0, 1.0, 0.0, 1.0])  # class 2 absent from the slice
+        proba = np.array([[0.8, 0.1, 0.1]] * 4)
+        ds = ColumnarDataset({
+            "y": FeatureColumn(RealNN, y),
+            "p": prediction_column(np.zeros(4), probability=proba),
+        })
+        out = OpMultiClassificationEvaluator(
+            label_col="y", prediction_col="p").evaluate(ds)
+        assert len(out["confusionMatrix"]) == 3

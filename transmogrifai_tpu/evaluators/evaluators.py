@@ -15,7 +15,7 @@ import numpy as np
 from ..types.columns import ColumnarDataset, FeatureColumn
 from .metrics import (
     binary_classification_metrics, forecast_metrics, multiclass_metrics,
-    regression_metrics, threshold_curves,
+    multiclass_threshold_metrics, regression_metrics, threshold_curves,
 )
 
 __all__ = [
@@ -85,21 +85,45 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
 
 
 class OpMultiClassificationEvaluator(OpEvaluatorBase):
+    """Multiclass metrics + topN/threshold histograms
+    (OpMultiClassificationEvaluator.scala: topNs default (1,3), thresholds
+    default 0.00..1.00 step 0.01, calculateThresholdMetrics :153-240).
+
+    ``num_classes``: authoritative class count (from the label indexer /
+    selector metadata).  When absent it is inferred from the data AND the
+    probability width — never from the label max alone, so an eval slice
+    missing the top class cannot silently shrink the class space.
+    """
+
     default_metric = "F1"
+
+    def __init__(self, label_col=None, prediction_col=None,
+                 top_ns=(1, 3), thresholds=None,
+                 num_classes: Optional[int] = None):
+        super().__init__(label_col, prediction_col)
+        self.top_ns = tuple(top_ns)
+        self.thresholds = thresholds
+        self.num_classes = num_classes
 
     def evaluate(self, data, sample_weight=None):
         y, batch = _label_scores(data, self.label_col, self.prediction_col)
         pred = np.asarray(batch.prediction, np.float64)
-        n_classes = int(max(y.max(), pred.max())) + 1
+        proba = getattr(batch, "probability", None)
+        n_classes = self.num_classes or int(max(
+            y.max(), pred.max(),
+            (proba.shape[1] - 1) if proba is not None else 0)) + 1
         out = multiclass_metrics(y.astype(int), pred.astype(int), n_classes,
                                  sample_weight)
         conf = out.pop("confusion")
         out["confusionMatrix"] = np.asarray(conf).tolist()
-        if getattr(batch, "probability", None) is not None:
-            p = np.clip(np.asarray(batch.probability), 1e-15, 1.0)
+        if proba is not None:
+            p = np.clip(np.asarray(proba), 1e-15, 1.0)
             idx = np.clip(y.astype(int), 0, p.shape[1] - 1)
             out["LogLoss"] = float(
                 -np.mean(np.log(p[np.arange(len(y)), idx])))
+            out["ThresholdMetrics"] = multiclass_threshold_metrics(
+                y.astype(int), np.asarray(proba), top_ns=self.top_ns,
+                thresholds=self.thresholds)
         return out
 
 

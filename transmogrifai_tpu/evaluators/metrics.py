@@ -35,6 +35,7 @@ __all__ = [
     "MINIMIZE_METRICS",
     "auroc", "aupr", "binary_metrics_at_threshold", "brier_score", "log_loss",
     "binary_classification_metrics", "multiclass_metrics",
+    "multiclass_threshold_metrics",
     "regression_metrics", "forecast_metrics", "threshold_curves",
 ]
 
@@ -250,6 +251,71 @@ def threshold_curves(y_true, y_prob, n_thresholds: int = 100,
             "precisionByThreshold": np.asarray(res["Precision"]),
             "recallByThreshold": np.asarray(res["Recall"]),
             "f1ByThreshold": np.asarray(res["F1"])}
+
+
+def multiclass_threshold_metrics(y_true, proba, top_ns=(1, 3),
+                                 thresholds=None) -> Dict:
+    """Top-N / confidence-threshold histograms for multiclass predictions.
+
+    Parity with ``OpMultiClassificationEvaluator.calculateThresholdMetrics``
+    (core/.../evaluators/OpMultiClassificationEvaluator.scala:153-240): for
+    every topN value and every threshold, counts of rows whose TRUE class
+    score is in the row's top-N and above threshold (``correct``), rows
+    whose top score clears the threshold but the true class misses the top-N
+    or falls below threshold (``incorrect``), and the remainder
+    (``noPrediction``); the three sum to N at every threshold.
+
+    TPU redesign of the reference's per-row sort + treeAggregate: the true
+    class RANK is two masked reductions (no sort), and each count array is
+    one (N,)x(N,T) masked-comparison matmul — the whole computation is a
+    handful of fused reductions on device for at-scale inputs.
+    """
+    thr = (np.arange(0, 101) / 100.0 if thresholds is None
+           else np.asarray(thresholds, np.float64))
+    if thr.size == 0 or not np.all((thr >= 0) & (thr <= 1)):
+        raise ValueError("thresholds must be a non-empty sequence in [0, 1]")
+    tns = list(dict.fromkeys(int(t) for t in top_ns))  # order-keeping dedupe
+    if not tns or any(t <= 0 for t in tns):
+        raise ValueError("top_ns must be a non-empty sequence of positive "
+                         "integers")
+    on_host = _on_host(y_true, None) and not isinstance(proba, jax.Array) \
+        and np.size(proba) <= HOST_METRIC_MAX
+    xp = np if on_host else jnp
+    P = xp.asarray(proba, xp.float32 if xp is jnp else np.float64)
+    y = xp.asarray(y_true, xp.int32 if xp is jnp else np.int64)
+    n, k = P.shape
+    lbl = xp.clip(y, 0, k - 1)
+    seen = (y >= 0) & (y < k)  # unseen classes score 0 (reference :192)
+    rows = xp.arange(n)
+    true_score = xp.where(seen, P[rows, lbl], 0.0)
+    top_score = P.max(axis=1)
+    # stable-descending rank of the true class: scores strictly greater,
+    # plus equal scores at earlier indices (matches the reference's stable
+    # sortBy(-score) take(t) membership)
+    gt = (P > true_score[:, None]).sum(axis=1)
+    eq_before = ((P == true_score[:, None])
+                 & (xp.arange(k)[None, :] < lbl[:, None])).sum(axis=1)
+    rank = xp.where(seen, gt + eq_before, k)
+    thr_x = xp.asarray(thr, P.dtype)
+    # (N, T): does the true/top score clear each threshold
+    true_ge = true_score[:, None] >= thr_x[None, :]
+    top_ge = top_score[:, None] >= thr_x[None, :]
+    out = {"topNs": tns, "thresholds": [float(t) for t in thr],
+           "correctCounts": {}, "incorrectCounts": {},
+           "noPredictionCounts": {}}
+    for t in tns:
+        in_top = (rank < t)
+        correct = (in_top[:, None] & true_ge).sum(axis=0)
+        incorrect = ((in_top[:, None] & top_ge & ~true_ge)
+                     | (~in_top[:, None] & top_ge)).sum(axis=0)
+        if xp is jnp:
+            correct = np.asarray(correct)
+            incorrect = np.asarray(incorrect)
+        out["correctCounts"][t] = [int(c) for c in correct]
+        out["incorrectCounts"][t] = [int(c) for c in incorrect]
+        out["noPredictionCounts"][t] = [int(n - c - i) for c, i
+                                        in zip(correct, incorrect)]
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("n_classes",))

@@ -170,10 +170,28 @@ class ModelSelector(PredictorEstimator):
                 return float(auroc(y, scores, w))
             return binary_classification_metrics(y, scores, w)[m]
         if self.problem_type == "multiclass":
-            n_classes = int(max(y.max(), scores.max())) + 1
+            n_classes = self._class_count(y, scores)
             return multiclass_metrics(y.astype(int), scores.astype(int),
                                       n_classes, w)[m]
         return regression_metrics(y, scores, w)[m]
+
+    def _capture_class_space(self, y) -> None:
+        """Record the class space from the FULL labels before any split —
+        validation folds missing the top class must not shrink it."""
+        if self.problem_type == "multiclass" and len(y):
+            self._n_classes = max(int(np.nanmax(y)) + 1, 2)
+
+    def _class_count(self, y, pred=None) -> int:
+        """Class space size: the FULL-training-label count captured at fit
+        time wins — a validation fold missing the top class must not shrink
+        the class space (the reference reads it from the label indexer
+        metadata; here fit captures it before any split)."""
+        n = getattr(self, "_n_classes", 0)
+        if y is not None and len(y):
+            n = max(n, int(np.nanmax(y)) + 1)
+        if pred is not None and len(pred):
+            n = max(n, int(np.nanmax(np.asarray(pred))) + 1)
+        return max(n, 2)
 
     def _metric_device(self, y, scores, w, m: str):
         import jax.numpy as jnp
@@ -202,7 +220,7 @@ class ModelSelector(PredictorEstimator):
         if self.problem_type == "multiclass":
             from ..evaluators.metrics import _multiclass_core
 
-            n_classes = max(int(np.nanmax(y)) + 1, 2)
+            n_classes = self._class_count(y)
             res = _multiclass_core(np.asarray(y, np.int32), scores,
                                    n_classes, w)
             return res.get(m)
@@ -262,6 +280,7 @@ class ModelSelector(PredictorEstimator):
         y = np.nan_to_num(np.asarray(data[label_name].values,
                                      dtype=np.float32))
         n = len(y)
+        self._capture_class_space(y)
         splitter = self._resolved_splitter()
         train_idx, _ = splitter.split_indices(n, y)
         train_mask = np.zeros(n, dtype=bool)
@@ -294,6 +313,7 @@ class ModelSelector(PredictorEstimator):
         X = np.asarray(features_col.values, dtype=np.float32)
         y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
         n = len(y)
+        self._capture_class_space(y)
         splitter = self._resolved_splitter()
         train_idx, holdout_idx = splitter.split_indices(n, y)
         train_mask = np.zeros(n, dtype=bool)
@@ -354,7 +374,7 @@ class ModelSelector(PredictorEstimator):
             return binary_classification_metrics(yy, score)
         if self.problem_type == "multiclass":
             pred = np.asarray(batch.prediction).astype(int)
-            n_classes = int(max(yy.max(), pred.max())) + 1
+            n_classes = self._class_count(yy, pred)
             out = multiclass_metrics(yy.astype(int), pred, n_classes)
             out.pop("confusion", None)
             return out
