@@ -3,4 +3,8 @@ from .streaming import (AsyncBatcher, FileStreamingReader,  # noqa: F401
                         IteratorStreamingReader, StreamingReader,
                         StreamingReaders)
 from .files import CSVReader, CSVAutoReader, ParquetReader, JSONLinesReader, DataReaders  # noqa: F401
-from .aggregates import AggregateDataReader, ConditionalDataReader, JoinedDataReader  # noqa: F401
+from .aggregates import (AggregateDataReader, ConditionalDataReader,  # noqa: F401
+                         JoinedDataReader, JoinedAggregateDataReader,
+                         TimeBasedFilter)
+from .avro import (AvroReader, AvroSchemaCSVReader, read_avro,  # noqa: F401
+                   write_avro, schema_feature_types)

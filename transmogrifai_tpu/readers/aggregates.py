@@ -16,6 +16,7 @@ import numpy as np
 
 from ..aggregators import (
     AGGREGATOR_REGISTRY, CutOffTime, Event, FeatureAggregator,
+    default_aggregator,
 )
 from ..features.feature import Feature
 from ..stages.generator import FeatureGeneratorStage
@@ -24,7 +25,8 @@ from ..types.feature_types import ID
 from .base import DataFrameReader, Reader, RecordsReader, reader_for
 
 __all__ = ["AggregateDataReader", "ConditionalDataReader",
-           "JoinedDataReader"]
+           "JoinedDataReader", "JoinedAggregateDataReader",
+           "TimeBasedFilter"]
 
 
 def _records_of(source) -> List[dict]:
@@ -122,15 +124,72 @@ class ConditionalDataReader(AggregateDataReader):
         return min(matching) if matching else None
 
 
+class TimeBasedFilter:
+    """Window spec for post-join aggregation (JoinedDataReader.scala:69-74):
+    keep a child row when its ``condition`` time falls inside ``window_ms``
+    before the entity's ``primary`` time."""
+
+    def __init__(self, condition: str, primary: str, window_ms: int,
+                 keep_condition: bool = False, keep_primary: bool = False):
+        self.condition = condition
+        self.primary = primary
+        self.window_ms = int(window_ms)
+        self.keep_condition = keep_condition
+        self.keep_primary = keep_primary
+
+
+_EMPTY_BY_STORAGE = {"text_list": (), "date_list": (), "map": {},
+                     "multi_pick_list": frozenset()}
+
+
+def _gather(col: FeatureColumn, idx: np.ndarray) -> FeatureColumn:
+    """Vectorized gather with -1 = missing (masked / empty per storage).
+
+    Missing object-storage rows get the SAME empty value ``from_values``
+    uses ((), {}, frozenset(), None for text) so downstream vectorizers
+    keep their iteration invariants.
+    """
+    missing = idx < 0
+    if missing.all() or len(col.values) == 0:
+        # one join side empty (or no matches at all): synthesize an
+        # all-missing column without touching the empty source array
+        return FeatureColumn.from_values(col.ftype, [None] * len(idx))
+    safe = np.where(missing, 0, idx)
+    out = col.take(safe)
+    if missing.any():
+        vals = out.values
+        if isinstance(vals, np.ndarray) and vals.dtype == object:
+            vals = vals.copy()
+            empty = _EMPTY_BY_STORAGE.get(col.ftype.storage)
+            for i in np.where(missing)[0]:
+                # fresh dict per row (a shared mutable empty would alias)
+                vals[i] = dict() if isinstance(empty, dict) else empty
+        elif isinstance(vals, np.ndarray) and vals.dtype.kind == "f":
+            vals = vals.copy()
+            vals[missing] = np.nan
+        mask = (out.mask if out.mask is not None
+                else np.ones(len(idx), bool)) & ~missing
+        return FeatureColumn(col.ftype, vals, mask, col.vmeta)
+    return out
+
+
 class JoinedDataReader(Reader):
     """Join two readers' datasets on key columns
-    (JoinedDataReader.scala:119-223)."""
+    (JoinedDataReader.scala:119-223, JoinTypes.scala).
+
+    The join is vectorized: per-side positional indices are matched with a
+    pandas hash merge (duplicate right keys fan out like a SQL join) and
+    every feature column is materialized with one ``take`` gather — feature
+    materialization does no per-row Python work; only key stringification
+    is one host pass per key column.  ``left_key`` / ``right_key`` accept a
+    single name or a sequence (multi-key joins).
+    """
 
     def __init__(self, left: Reader, right: Reader,
                  left_features: Sequence[Feature],
                  right_features: Sequence[Feature],
                  join_type: str = "outer",
-                 left_key: str = "key", right_key: str = "key"):
+                 left_key="key", right_key="key"):
         if join_type not in ("inner", "left", "outer"):
             raise ValueError(f"unknown join type {join_type!r}")
         self.left = left
@@ -138,44 +197,144 @@ class JoinedDataReader(Reader):
         self.left_features = list(left_features)
         self.right_features = list(right_features)
         self.join_type = join_type
-        self.left_key = left_key
-        self.right_key = right_key
+        self.left_key = ([left_key] if isinstance(left_key, str)
+                         else list(left_key))
+        self.right_key = ([right_key] if isinstance(right_key, str)
+                          else list(right_key))
+        if len(self.left_key) != len(self.right_key):
+            raise ValueError("left_key and right_key must have the same "
+                             "number of columns")
+
+    def with_secondary_aggregation(
+            self, time_filter: TimeBasedFilter) -> "JoinedAggregateDataReader":
+        """Post-join aggregation (JoinedDataReader.scala:225-236)."""
+        return JoinedAggregateDataReader(
+            self.left, self.right, self.left_features, self.right_features,
+            join_type=self.join_type, left_key=self.left_key,
+            right_key=self.right_key, time_filter=time_filter)
 
     @staticmethod
     def _with_key(reader: Reader, features: Sequence[Feature],
-                  key: str) -> ColumnarDataset:
+                  keys: Sequence[str]) -> ColumnarDataset:
         data = reader.generate_dataset(list(features))
-        if key not in data:
-            from ..features.builder import FeatureBuilder
+        for key in keys:
+            if key not in data:
+                from ..features.builder import FeatureBuilder
 
-            key_f = FeatureBuilder.ID(key).as_predictor()
-            data.set(key, reader.generate_dataset([key_f])[key])
+                key_f = FeatureBuilder.ID(key).as_predictor()
+                data.set(key, reader.generate_dataset([key_f])[key])
         return data
+
+    def _join_indices(self, ldata: ColumnarDataset, rdata: ColumnarDataset):
+        """(left_idx, right_idx, key strings) — -1 marks a missing side."""
+        import pandas as pd
+
+        def key_frame(data, keys, idx_name):
+            cols = {f"k{i}": [str(v) for v in data[k].to_list()]
+                    for i, k in enumerate(keys)}
+            df = pd.DataFrame(cols)
+            df[idx_name] = np.arange(len(df), dtype=np.int64)
+            return df
+
+        lf = key_frame(ldata, self.left_key, "_il")
+        rf = key_frame(rdata, self.right_key, "_ir")
+        on = [c for c in lf.columns if c != "_il"]
+        merged = lf.merge(rf, on=on, how=self.join_type, sort=False)
+        li = merged["_il"].fillna(-1).to_numpy(np.int64)
+        ri = merged["_ir"].fillna(-1).to_numpy(np.int64)
+        # composite keys join on \x1f (unit separator) — a printable
+        # separator like '|' would let distinct tuples collide, silently
+        # merging entities in the post-join aggregation
+        keys = merged[on[0]].astype(str).to_numpy() if len(on) == 1 else \
+            np.asarray(["\x1f".join(t) for t in
+                        merged[on].astype(str).itertuples(index=False)])
+        return li, ri, keys
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         lnames = {f.name for f in self.left_features}
         ldata = self._with_key(self.left, self.left_features, self.left_key)
         rdata = self._with_key(self.right, self.right_features,
                                self.right_key)
-        lkeys = [str(v) for v in ldata[self.left_key].to_list()]
-        rkeys = [str(v) for v in rdata[self.right_key].to_list()]
-        lidx = {k: i for i, k in enumerate(lkeys)}
-        ridx = {k: i for i, k in enumerate(rkeys)}
-        if self.join_type == "inner":
-            keys = [k for k in lkeys if k in ridx]
-        elif self.join_type == "left":
-            keys = list(lkeys)
-        else:
-            keys = list(lkeys) + [k for k in rkeys if k not in lidx]
-
+        li, ri, keys = self._join_indices(ldata, rdata)
         out = ColumnarDataset()
         for f in raw_features:
-            src, idx = ((ldata, lidx) if f.name in lnames else (rdata, ridx))
-            vals = src[f.name].to_list() if f.name in src else []
-            joined = [vals[idx[k]] if k in idx and idx[k] < len(vals) else None
-                      for k in keys]
-            out.set(f.name, FeatureColumn.from_values(f.ftype, joined))
-        out.set("key", FeatureColumn.from_values(ID, keys))
+            src, idx = ((ldata, li) if f.name in lnames else (rdata, ri))
+            if f.name not in src:
+                raise KeyError(f"feature {f.name!r} not produced by either "
+                               "side of the join")
+            out.set(f.name, _gather(src[f.name], idx))
+        out.set("key", FeatureColumn.from_values(ID, list(keys)))
+        return out
+
+
+class JoinedAggregateDataReader(JoinedDataReader):
+    """Join then aggregate back to one row per key
+    (JoinedAggregateDataReader, JoinedDataReader.scala:240-330): left
+    (parent) features keep one copy per key; right (child) features
+    monoid-aggregate over the rows whose ``time_filter.condition`` time
+    falls within ``window_ms`` before the key's ``primary`` time."""
+
+    def __init__(self, left, right, left_features, right_features,
+                 join_type="outer", left_key="key", right_key="key",
+                 time_filter: Optional[TimeBasedFilter] = None):
+        super().__init__(left, right, left_features, right_features,
+                         join_type=join_type, left_key=left_key,
+                         right_key=right_key)
+        if time_filter is None:
+            raise ValueError("JoinedAggregateDataReader requires a "
+                             "TimeBasedFilter")
+        self.time_filter = time_filter
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        tf = self.time_filter
+        feats = list(raw_features)
+        names = {f.name for f in feats}
+        extra = []
+        for f in self.left_features + self.right_features:
+            if f.name in (tf.condition, tf.primary) and f.name not in names:
+                extra.append(f)
+        joined = super().generate_dataset(feats + extra)
+        keys = np.asarray(joined["key"].to_list())
+        cond_t = joined[tf.condition].masked_values(fill=np.nan)
+        prim_t = joined[tf.primary].masked_values(fill=np.nan)
+        # entity primary time = max per key (the parent row's timestamp is
+        # replicated by the join; max also covers duplicate parents)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        prim_per_key = np.full(len(uniq), -np.inf)
+        np.maximum.at(prim_per_key, inv, np.nan_to_num(prim_t, nan=-np.inf))
+        prim_row = prim_per_key[inv]
+        in_window = (np.nan_to_num(cond_t, nan=np.inf) <= prim_row) & (
+            np.nan_to_num(cond_t, nan=-np.inf)
+            > prim_row - tf.window_ms)
+        lnames = {f.name for f in self.left_features}
+        out = ColumnarDataset()
+        for f in feats:
+            if f.name == tf.condition and not tf.keep_condition:
+                continue
+            if f.name == tf.primary and not tf.keep_primary:
+                continue
+            col_vals = joined[f.name].to_list()
+            if f.name in lnames:
+                # parent: first non-missing copy per key (dummy aggregator,
+                # JoinedDataReader.scala:285-292)
+                vals = [None] * len(uniq)
+                for g, v in zip(inv, col_vals):
+                    if vals[g] is None and v is not None:
+                        vals[g] = v
+            else:
+                gen = f.origin_stage
+                agg = getattr(gen, "aggregator", None)
+                if isinstance(agg, str):
+                    agg = AGGREGATOR_REGISTRY[agg]
+                agg = agg or default_aggregator(f.ftype)
+                groups: Dict[int, List] = {}
+                for g, v, ok in zip(inv, col_vals, in_window):
+                    if ok and v is not None:
+                        groups.setdefault(g, []).append(v)
+                vals = [agg.reduce(groups.get(g, [])) if groups.get(g)
+                        else None for g in range(len(uniq))]
+            out.set(f.name, FeatureColumn.from_values(f.ftype, vals))
+        out.set("key", FeatureColumn.from_values(ID, list(uniq)))
         return out
 
 

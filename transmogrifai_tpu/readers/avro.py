@@ -1,0 +1,519 @@
+"""Avro ingestion — the reference's canonical data format.
+
+Reference: ``AvroReaders.scala`` (simple/aggregate/conditional Avro readers),
+``utils/io/avro/AvroInOut.scala`` (read/write helpers), and
+``CSVReaders.scala`` (CSV rows TYPED via an Avro schema — the reference's
+CSV path round-trips through Avro records, ``CSVToAvro.scala``).
+
+The environment has no Avro package, so this module implements the Avro 1.x
+Object Container File format directly (spec: binary zig-zag varint
+primitives, blocked records between 16-byte sync markers, null/deflate
+codecs).  This is host-side IO — the device pipeline starts after columns
+are extracted — so pure Python mirrors the reference's JVM Avro lib role.
+
+Supported schema surface: null, boolean, int, long, float, double, bytes,
+string, fixed, enum, array, map, union, record (with named-type references).
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..types import feature_types as ft
+from ..types.columns import ColumnarDataset, FeatureColumn
+from .base import Reader, RecordsReader
+
+__all__ = ["read_avro", "write_avro", "AvroReader", "AvroSchemaCSVReader",
+           "avro_to_feature_type", "schema_feature_types"]
+
+_MAGIC = b"Obj\x01"
+_PRIMITIVES = ("null", "boolean", "int", "long", "float", "double",
+               "bytes", "string")
+
+
+# ---------------------------------------------------------------------------
+# binary decoder / encoder (Avro spec §Binary Encoding)
+# ---------------------------------------------------------------------------
+
+class _Decoder:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise EOFError("truncated avro data")
+        self.pos += n
+        return b
+
+    def read_long(self) -> int:
+        shift, acc = 0, 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # zig-zag
+
+    def read_null(self):
+        return None
+
+    def read_int(self) -> int:
+        return self.read_long()  # same zig-zag varint wire format
+
+    def read_boolean(self) -> bool:
+        return self.read(1) != b"\x00"
+
+    def read_float(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def read_double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+    def read_bytes(self) -> bytes:
+        return self.read(self.read_long())
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+
+class _Encoder:
+    def __init__(self):
+        self.out = io.BytesIO()
+
+    def write(self, b: bytes):
+        self.out.write(b)
+
+    def write_long(self, v: int):
+        v = (v << 1) ^ (v >> 63) if v >= 0 else ((-v - 1) << 1 | 1)
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                self.out.write(bytes([b | 0x80]))
+            else:
+                self.out.write(bytes([b]))
+                break
+
+    def write_boolean(self, v: bool):
+        self.out.write(b"\x01" if v else b"\x00")
+
+    def write_float(self, v: float):
+        self.out.write(struct.pack("<f", v))
+
+    def write_double(self, v: float):
+        self.out.write(struct.pack("<d", v))
+
+    def write_bytes(self, v: bytes):
+        self.write_long(len(v))
+        self.out.write(v)
+
+    def write_string(self, v: str):
+        self.write_bytes(v.encode("utf-8"))
+
+    def getvalue(self) -> bytes:
+        return self.out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# schema-driven (de)serialization
+# ---------------------------------------------------------------------------
+
+def _register_named(schema, named: Dict[str, Any]):
+    if isinstance(schema, dict) and schema.get("type") in ("record", "enum",
+                                                           "fixed"):
+        name = schema.get("name", "")
+        ns = schema.get("namespace", "")
+        named[name] = schema
+        if ns:
+            named[f"{ns}.{name}"] = schema
+        for f in schema.get("fields", []) or []:
+            _register_named(f.get("type"), named)
+    elif isinstance(schema, dict) and schema.get("type") in ("array", "map"):
+        _register_named(schema.get("items") or schema.get("values"), named)
+    elif isinstance(schema, list):
+        for s in schema:
+            _register_named(s, named)
+
+
+def _decode(schema, dec: _Decoder, named: Dict[str, Any]):
+    if isinstance(schema, str):
+        if schema in _PRIMITIVES:
+            return getattr(dec, f"read_{schema}")()
+        return _decode(named[schema], dec, named)  # named-type reference
+    if isinstance(schema, list):  # union: long index then value
+        return _decode(schema[dec.read_long()], dec, named)
+    t = schema["type"]
+    if t in _PRIMITIVES:
+        return getattr(dec, f"read_{t}")()
+    if t == "record":
+        return {f["name"]: _decode(f["type"], dec, named)
+                for f in schema["fields"]}
+    if t == "enum":
+        return schema["symbols"][dec.read_long()]
+    if t == "fixed":
+        return dec.read(schema["size"])
+    if t == "array":
+        out = []
+        while True:
+            n = dec.read_long()
+            if n == 0:
+                break
+            if n < 0:  # block with byte size prefix
+                n = -n
+                dec.read_long()
+            for _ in range(n):
+                out.append(_decode(schema["items"], dec, named))
+        return out
+    if t == "map":
+        out = {}
+        while True:
+            n = dec.read_long()
+            if n == 0:
+                break
+            if n < 0:
+                n = -n
+                dec.read_long()
+            for _ in range(n):
+                # key must decode BEFORE the value (subscript assignment
+                # would evaluate the RHS first)
+                k = dec.read_string()
+                out[k] = _decode(schema["values"], dec, named)
+        return out
+    if isinstance(t, (dict, list)):  # nested {"type": {...}} wrapper
+        return _decode(t, dec, named)
+    raise ValueError(f"unsupported avro type {t!r}")
+
+
+def _union_branch(schema_list, value):
+    """Index of the union branch matching a Python value (writer side)."""
+    def matches(s, v):
+        base = s if isinstance(s, str) else s.get("type")
+        if v is None:
+            return base == "null"
+        if isinstance(v, bool):
+            return base == "boolean"
+        if isinstance(v, (int, np.integer)):
+            return base in ("int", "long", "double", "float")
+        if isinstance(v, (float, np.floating)):
+            return base in ("double", "float")
+        if isinstance(v, str):
+            return base in ("string", "enum")
+        if isinstance(v, bytes):
+            return base in ("bytes", "fixed")
+        if isinstance(v, dict):
+            return base in ("record", "map")
+        if isinstance(v, (list, tuple)):
+            return base == "array"
+        return False
+    for i, s in enumerate(schema_list):
+        if matches(s, value):
+            return i
+    raise ValueError(f"no union branch in {schema_list} for {value!r}")
+
+
+def _encode(schema, enc: _Encoder, value, named: Dict[str, Any]):
+    if isinstance(schema, str):
+        if schema in _PRIMITIVES:
+            if schema == "null":
+                return
+            if schema in ("int", "long"):
+                return enc.write_long(int(value))
+            return getattr(enc, f"write_{schema}")(value)
+        return _encode(named[schema], enc, value, named)
+    if isinstance(schema, list):
+        i = _union_branch(schema, value)
+        enc.write_long(i)
+        return _encode(schema[i], enc, value, named)
+    t = schema["type"]
+    if t in _PRIMITIVES or isinstance(t, (dict, list)):
+        return _encode(t, enc, value, named)
+    if t == "record":
+        for f in schema["fields"]:
+            v = value.get(f["name"]) if isinstance(value, dict) else None
+            if v is None and "default" in f and not isinstance(
+                    f["type"], list):
+                v = f["default"]
+            _encode(f["type"], enc, v, named)
+        return
+    if t == "enum":
+        return enc.write_long(schema["symbols"].index(value))
+    if t == "fixed":
+        return enc.write(value)
+    if t == "array":
+        if value:
+            enc.write_long(len(value))
+            for v in value:
+                _encode(schema["items"], enc, v, named)
+        return enc.write_long(0)
+    if t == "map":
+        if value:
+            enc.write_long(len(value))
+            for k, v in value.items():
+                enc.write_string(k)
+                _encode(schema["values"], enc, v, named)
+        return enc.write_long(0)
+    raise ValueError(f"unsupported avro type {t!r}")
+
+
+def _snappy_decompress(data: bytes) -> bytes:
+    """Raw-snappy decompressor (decode only — written blocks use deflate).
+
+    Format: varint uncompressed length, then tagged elements — 2-bit type:
+    00 literal, 01/10/11 back-references with 1/2/4-byte offsets.
+    """
+    pos, shift, n = 0, 0, 0
+    while True:
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                nb = ln - 59
+                ln = int.from_bytes(data[pos:pos + nb], "little")
+                pos += nb
+            ln += 1
+            out += data[pos:pos + ln]
+            pos += ln
+            continue
+        if kind == 1:
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise ValueError("corrupt snappy stream (bad offset)")
+        start = len(out) - off
+        for i in range(ln):  # may self-overlap: copy byte-wise
+            out.append(out[start + i])
+    if len(out) != n:
+        raise ValueError("corrupt snappy stream (length mismatch)")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# object container files
+# ---------------------------------------------------------------------------
+
+def read_avro(path: str) -> Tuple[Dict[str, Any], List[dict]]:
+    """Read an Avro OCF: returns (writer schema, records)."""
+    raw = open(path, "rb").read()
+    dec = _Decoder(raw)
+    if dec.read(4) != _MAGIC:
+        raise ValueError(f"{path}: not an Avro object container file")
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = dec.read_long()
+        if n == 0:
+            break
+        if n < 0:
+            n = -n
+            dec.read_long()
+        for _ in range(n):
+            k = dec.read_string()
+            meta[k] = dec.read_bytes()
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    if codec not in ("null", "deflate", "snappy"):
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    sync = dec.read(16)
+    named: Dict[str, Any] = {}
+    _register_named(schema, named)
+    records: List[dict] = []
+    while dec.pos < len(raw):
+        count = dec.read_long()
+        size = dec.read_long()
+        block = dec.read(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec == "snappy":
+            crc = int.from_bytes(block[-4:], "big")
+            block = _snappy_decompress(block[:-4])
+            if zlib.crc32(block) & 0xFFFFFFFF != crc:
+                raise ValueError(f"{path}: snappy block CRC mismatch")
+        bdec = _Decoder(block)
+        for _ in range(count):
+            records.append(_decode(schema, bdec, named))
+        if dec.read(16) != sync:
+            raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
+    return schema, records
+
+
+def write_avro(path: str, schema: Dict[str, Any], records: Sequence[dict],
+               codec: str = "deflate", sync: bytes = b"\x07" * 16,
+               block_records: int = 4096) -> None:
+    """Write records as an Avro OCF (null or deflate codec)."""
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    named: Dict[str, Any] = {}
+    _register_named(schema, named)
+    enc = _Encoder()
+    enc.write(_MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    enc.write_long(len(meta))
+    for k, v in meta.items():
+        enc.write_string(k)
+        enc.write_bytes(v)
+    enc.write_long(0)
+    enc.write(sync)
+    for s in range(0, len(records), block_records):
+        chunk = records[s:s + block_records]
+        benc = _Encoder()
+        for r in chunk:
+            _encode(schema, benc, r, named)
+        payload = benc.getvalue()
+        if codec == "deflate":
+            co = zlib.compressobj(9, zlib.DEFLATED, -15)
+            payload = co.compress(payload) + co.flush()
+        enc.write_long(len(chunk))
+        enc.write_long(len(payload))
+        enc.write(payload)
+        enc.write(sync)
+    with open(path, "wb") as f:
+        f.write(enc.getvalue())
+
+
+# ---------------------------------------------------------------------------
+# avro types -> feature types (cli/gen/AvroField.scala analogue)
+# ---------------------------------------------------------------------------
+
+def _unwrap_union(t):
+    """['null', T] / [T, 'null'] -> T (nullability lives in the feature
+    type); multi-branch unions fall back to text."""
+    if isinstance(t, list):
+        branches = [b for b in t if b != "null"]
+        return branches[0] if len(branches) == 1 else "string"
+    return t
+
+
+def avro_to_feature_type(avro_type) -> Type[ft.FeatureType]:
+    t = _unwrap_union(avro_type)
+    if isinstance(t, dict):
+        inner = t.get("type")
+        if inner == "enum":
+            return ft.PickList
+        if inner == "fixed":
+            return ft.Base64
+        if inner == "array":
+            item = _unwrap_union(t.get("items"))
+            if item in ("int", "long"):
+                return ft.DateList if "date" in str(
+                    t.get("name", "")).lower() else ft.TextList
+            return ft.TextList
+        if inner == "map":
+            val = _unwrap_union(t.get("values"))
+            if val in ("float", "double"):
+                return ft.RealMap
+            if val in ("int", "long"):
+                return ft.IntegralMap
+            if val == "boolean":
+                return ft.BinaryMap
+            return ft.TextMap
+        return avro_to_feature_type(inner)
+    return {
+        "boolean": ft.Binary,
+        "int": ft.Integral, "long": ft.Integral,
+        "float": ft.Real, "double": ft.Real,
+        "string": ft.Text, "bytes": ft.Base64,
+    }.get(t, ft.Text)
+
+
+def schema_feature_types(schema: Dict[str, Any]) -> Dict[str, Type[ft.FeatureType]]:
+    """Record schema -> {field name: feature type} (the typing contract the
+    reference gets from Avro schemas, cli/gen/AvroField.scala)."""
+    if schema.get("type") != "record":
+        raise ValueError("expected a record schema")
+    return {f["name"]: avro_to_feature_type(f["type"])
+            for f in schema["fields"]}
+
+
+# ---------------------------------------------------------------------------
+# readers
+# ---------------------------------------------------------------------------
+
+class AvroReader(Reader):
+    """Simple Avro reader (AvroReaders.scala CSVAutoReader analogue)."""
+
+    def __init__(self, path: str, key_field: Optional[str] = None):
+        self.path = path
+        self.key_field = key_field
+        self._cache: Optional[Tuple[Dict, List[dict]]] = None
+
+    def _load(self) -> Tuple[Dict, List[dict]]:
+        if self._cache is None:
+            self._cache = read_avro(self.path)
+        return self._cache
+
+    @property
+    def schema(self) -> Dict[str, Any]:
+        return self._load()[0]
+
+    @property
+    def records(self) -> List[dict]:
+        return self._load()[1]
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        key_fn = ((lambda r: str(r.get(self.key_field)))
+                  if self.key_field else None)
+        return RecordsReader(self.records,
+                             key_fn=key_fn).generate_dataset(raw_features)
+
+
+class AvroSchemaCSVReader(Reader):
+    """CSV typed by an Avro schema (CSVReaders.scala: headerless CSV rows are
+    named AND typed via the .avsc, matching ``CSVToAvro.scala``)."""
+
+    def __init__(self, csv_path: str, schema_path: str,
+                 key_field: Optional[str] = None):
+        self.csv_path = csv_path
+        self.schema_path = schema_path
+        self.key_field = key_field
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        import pandas as pd
+
+        schema = json.loads(open(self.schema_path).read())
+        if schema.get("type") != "record":
+            raise ValueError(f"{self.schema_path}: expected a record schema")
+        names = [f["name"] for f in schema["fields"]]
+        df = pd.read_csv(self.csv_path, header=None, names=names,
+                         skipinitialspace=True)
+        out = ColumnarDataset()
+        ftypes = schema_feature_types(schema)
+        for f in raw_features:
+            if f.name not in df.columns:
+                raise KeyError(f"{f.name!r} not in avro schema fields "
+                               f"{names}")
+            out.set(f.name, FeatureColumn.from_values(
+                f.ftype, df[f.name].tolist()))
+        if self.key_field and self.key_field in df.columns:
+            out.set("key", FeatureColumn.from_values(
+                ft.ID, [str(v) for v in df[self.key_field].tolist()]))
+        self.feature_types = ftypes  # introspection (codegen uses this)
+        return out

@@ -76,12 +76,18 @@ class RecordsReader(Reader):
         self.key_fn = key_fn
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+        from ..types.feature_types import ID
+
         cols = {}
         for f in raw_features:
             gen = f.origin_stage
             assert isinstance(gen, FeatureGeneratorStage)
             cols[f.name] = gen.extract_column(self.records)
-        return ColumnarDataset(cols)
+        ds = ColumnarDataset(cols)
+        if self.key_fn is not None:
+            ds.set("key", FeatureColumn.from_values(
+                ID, [str(self.key_fn(r)) for r in self.records]))
+        return ds
 
 
 def reader_for(data) -> Reader:

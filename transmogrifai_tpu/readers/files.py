@@ -87,6 +87,18 @@ class DataReaders:
                                        predictor_window_ms,
                                        response_window_ms)
 
+        @staticmethod
+        def avro(path, key_fn, time_fn, cutoff=None,
+                 predictor_window_ms=None, response_window_ms=None):
+            """Aggregate reader over Avro records (DataReaders.Aggregate.avro,
+            DataReaders.scala:108-130)."""
+            from .aggregates import AggregateDataReader
+            from .avro import read_avro
+
+            return AggregateDataReader(read_avro(path)[1], key_fn, time_fn,
+                                       cutoff, predictor_window_ms,
+                                       response_window_ms)
+
     class Conditional:
         @staticmethod
         def records(source, key_fn, time_fn, target_condition,
@@ -97,6 +109,20 @@ class DataReaders:
             return ConditionalDataReader(source, key_fn, time_fn,
                                          target_condition,
                                          drop_if_no_target,
+                                         predictor_window_ms,
+                                         response_window_ms)
+
+        @staticmethod
+        def avro(path, key_fn, time_fn, target_condition,
+                 drop_if_no_target=True, predictor_window_ms=None,
+                 response_window_ms=None):
+            """Conditional reader over Avro records
+            (DataReaders.Conditional.avro, DataReaders.scala:214-248)."""
+            from .aggregates import ConditionalDataReader
+            from .avro import read_avro
+
+            return ConditionalDataReader(read_avro(path)[1], key_fn, time_fn,
+                                         target_condition, drop_if_no_target,
                                          predictor_window_ms,
                                          response_window_ms)
 
@@ -121,3 +147,20 @@ class DataReaders:
         @staticmethod
         def json_lines(path: str, key_col: Optional[str] = None) -> JSONLinesReader:
             return JSONLinesReader(path, key_col)
+
+        @staticmethod
+        def avro(path: str, key_field: Optional[str] = None):
+            """Simple Avro reader (DataReaders.Simple.avro,
+            DataReaders.scala:75-88)."""
+            from .avro import AvroReader
+
+            return AvroReader(path, key_field)
+
+        @staticmethod
+        def csv_with_schema(csv_path: str, schema_path: str,
+                            key_field: Optional[str] = None):
+            """CSV typed via an Avro schema (CSVReaders.scala — the
+            reference's canonical CSV path)."""
+            from .avro import AvroSchemaCSVReader
+
+            return AvroSchemaCSVReader(csv_path, schema_path, key_field)
