@@ -1,0 +1,179 @@
+"""Mesh-sharded training path — equivalence with single-device runs.
+
+The conftest fakes an 8-device CPU mesh (the reference's local-mode Spark
+"fake cluster" strategy); every test trains the SAME thing with and without
+the mesh and asserts the results agree.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8, model_parallelism=2)
+
+
+def _binary_df(n=240, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    logits = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logits + 0.3 * rng.normal(size=n) > 0).astype(float)
+    df = pd.DataFrame({f"x{i}": X[:, i] for i in range(5)})
+    df["cat"] = np.where(X[:, 4] > 0, "hot", "cold")
+    df["y"] = y
+    return df
+
+
+class TestStageMeshParity:
+    def test_sanity_checker_stats_match_host(self, mesh):
+        from transmogrifai_tpu.parallel.sharded import colstats_corr_sharded
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(101, 7)).astype(np.float32) * 3 + 1
+        y = rng.random(101).astype(np.float32)
+        mean, var, mn, mx, corr = colstats_corr_sharded(X, y, mesh)
+        np.testing.assert_allclose(mean, X.mean(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(var, X.var(axis=0, ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(mn, X.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(mx, X.max(axis=0), rtol=1e-6)
+        yc = y - y.mean()
+        expect = (yc @ (X - X.mean(axis=0))) / (
+            np.sqrt(X.var(axis=0, ddof=1) * 100) * np.sqrt(yc @ yc))
+        np.testing.assert_allclose(corr, expect, atol=1e-4)
+
+    def test_logreg_mesh_matches_single_device(self, mesh):
+        from transmogrifai_tpu.models import OpLogisticRegression
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(200, 6)).astype(np.float32)
+        y = (X[:, 0] - X[:, 1] + 0.2 * rng.normal(size=200) > 0).astype(
+            np.float32)
+        m1 = OpLogisticRegression(reg_param=0.01).fit_raw(X, y)
+        m2 = OpLogisticRegression(reg_param=0.01).with_mesh(mesh).fit_raw(
+            X, y)
+        np.testing.assert_allclose(np.asarray(m1.coef),
+                                   np.asarray(m2.coef), atol=1e-3)
+        p1 = m1.predict_batch(X).probability[:, 1]
+        p2 = m2.predict_batch(X).probability[:, 1]
+        np.testing.assert_allclose(p1, p2, atol=1e-3)
+
+    def test_gbt_mesh_matches_single_device(self, mesh):
+        from transmogrifai_tpu.models import OpGBTClassifier
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(150, 5)).astype(np.float32)
+        y = ((X[:, 0] * X[:, 1]) > 0).astype(np.float32)
+        kw = dict(max_iter=8, max_depth=3, step_size=0.3, seed=5)
+        m1 = OpGBTClassifier(**kw).fit_raw(X, y)
+        m2 = OpGBTClassifier(**kw).with_mesh(mesh).fit_raw(X, y)
+        p1 = m1.predict_batch(X).probability[:, 1]
+        p2 = m2.predict_batch(X).probability[:, 1]
+        np.testing.assert_allclose(p1, p2, atol=1e-4)
+
+    def test_xgb_mesh_matches_single_device(self, mesh):
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(160, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        kw = dict(num_round=6, eta=0.3, max_depth=3,
+                  early_stopping_rounds=0, seed=7)
+        p1 = OpXGBoostClassifier(**kw).fit_raw(X, y).predict_batch(
+            X).probability[:, 1]
+        p2 = OpXGBoostClassifier(**kw).with_mesh(mesh).fit_raw(
+            X, y).predict_batch(X).probability[:, 1]
+        np.testing.assert_allclose(p1, p2, atol=1e-4)
+
+
+class TestWorkflowMeshEquivalence:
+    def _build(self, df):
+        from transmogrifai_tpu import (
+            FeatureBuilder, OpWorkflow, transmogrify,
+        )
+        from transmogrifai_tpu.models import (
+            OpLogisticRegression, OpRandomForestClassifier,
+        )
+        from transmogrifai_tpu.preparators import SanityChecker
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, grid,
+        )
+
+        label = FeatureBuilder.RealNN("y").as_response()
+        preds = [FeatureBuilder.Real(f"x{i}").as_predictor()
+                 for i in range(5)]
+        preds.append(FeatureBuilder.PickList("cat").as_predictor())
+        vec = transmogrify(preds)
+        checked = SanityChecker(remove_bad_features=True).set_input(
+            label, vec).get_output()
+        pred = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models_and_parameters=[
+                (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
+                (OpRandomForestClassifier(), grid(num_trees=[8],
+                                                  max_depth=[4])),
+            ],
+        ).set_input(label, checked).get_output()
+        wf = OpWorkflow().set_result_features(pred).set_input_data(df)
+        return wf, pred
+
+    def test_full_workflow_train_on_mesh_matches_single_device(self, mesh):
+        df = _binary_df()
+        wf1, p1 = self._build(df)
+        model1 = wf1.train()
+        wf2, p2 = self._build(df)
+        model2 = wf2.with_mesh(mesh).train()
+
+        s1 = next(s for s in model1.stages
+                  if s.metadata.get("model_selector_summary"))
+        s2 = next(s for s in model2.stages
+                  if s.metadata.get("model_selector_summary"))
+        sum1 = s1.metadata["model_selector_summary"]
+        sum2 = s2.metadata["model_selector_summary"]
+        assert sum1["bestModelType"] == sum2["bestModelType"]
+        assert sum1["bestModelParams"] == sum2["bestModelParams"]
+
+        scored1 = model1.score(df)[p1.name].values
+        scored2 = model2.score(df)[p2.name].values
+        pr1 = np.asarray([r["probability_1"] for r in scored1])
+        pr2 = np.asarray([r["probability_1"] for r in scored2])
+        np.testing.assert_allclose(pr1, pr2, atol=2e-3)
+
+    def test_mesh_scoped_to_train_and_restored(self, mesh, monkeypatch):
+        from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+        from transmogrifai_tpu.selector.model_selector import ModelSelector
+        from transmogrifai_tpu.workflow.dag import compute_dag
+
+        df = _binary_df(120)
+        wf, pred = self._build(df)
+        wf.with_mesh(mesh)
+        # record which stage types actually carried the mesh DURING fit
+        seen = set()
+        orig_sc, orig_ms = SanityChecker.fit_columns, ModelSelector.fit_columns
+
+        def spy_sc(self_, *a, **k):
+            if self_.mesh is mesh:
+                seen.add("SanityChecker")
+            return orig_sc(self_, *a, **k)
+
+        def spy_ms(self_, *a, **k):
+            if self_.mesh is mesh:
+                seen.add("ModelSelector")
+            return orig_ms(self_, *a, **k)
+
+        monkeypatch.setattr(SanityChecker, "fit_columns", spy_sc)
+        monkeypatch.setattr(ModelSelector, "fit_columns", spy_ms)
+        model = wf.train()
+        assert seen == {"SanityChecker", "ModelSelector"}
+        # ...and the mesh is cleared afterwards: stages are user-owned
+        # objects shared across workflows (a later single-device train must
+        # not silently reuse a stale mesh)
+        assert all(getattr(s, "mesh", None) is None
+                   for s in compute_dag([pred]).all_stages())
+        selector_stage = next(
+            s for s in model.stages
+            if s.metadata.get("model_selector_summary"))
+        assert selector_stage.metadata["model_selector_summary"][
+            "bestModelType"]

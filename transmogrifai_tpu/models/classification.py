@@ -74,6 +74,15 @@ class OpLogisticRegression(PredictorEstimator):
         self.fit_intercept = fit_intercept
         self.standardization = standardization
         self.sample_weight_col = sample_weight_col
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "OpLogisticRegression":
+        """Multi-chip fit: rows shard over the mesh's data axis and GSPMD
+        psums the per-iteration IRLS Gram products over ICI
+        (parallel/sharded.fit_logreg_sharded).  Binary only — the
+        multinomial path stays single-device."""
+        self.mesh = mesh
+        return self
 
     def fit_columns(self, data: ColumnarDataset, label_col, features_col):
         X, y = _extract_xy(label_col, features_col)
@@ -118,11 +127,21 @@ class OpLogisticRegression(PredictorEstimator):
         mu, sigma = _standardize_stats(X, w) if self.standardization else (None, None)
         Xs = _apply_standardize(X, mu, sigma)
         if n_classes <= 2:
-            fit = fit_logistic_regression(
-                Xs, y, sample_weight=w, reg_param=self.reg_param,
-                elastic_net_param=self.elastic_net_param,
-                max_iter=self.max_iter, tol=self.tol,
-                fit_intercept=self.fit_intercept)
+            if self.mesh is not None:
+                from ..parallel.sharded import fit_logreg_sharded
+
+                fit = fit_logreg_sharded(
+                    np.asarray(Xs, np.float32), y, self.mesh, w,
+                    reg_param=self.reg_param,
+                    elastic_net_param=self.elastic_net_param,
+                    max_iter=self.max_iter, tol=self.tol,
+                    fit_intercept=self.fit_intercept)
+            else:
+                fit = fit_logistic_regression(
+                    Xs, y, sample_weight=w, reg_param=self.reg_param,
+                    elastic_net_param=self.elastic_net_param,
+                    max_iter=self.max_iter, tol=self.tol,
+                    fit_intercept=self.fit_intercept)
             coef, intercept = _unstandardize(
                 np.asarray(fit.coef), float(np.asarray(fit.intercept)), mu, sigma)
             return LogisticRegressionModel(

@@ -508,6 +508,17 @@ class _GBTBase(PredictorEstimator):
         #: per-node-weight minInfoGain)
         self.min_split_gain_raw = min_split_gain_raw
         self.seed = seed
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "_GBTBase":
+        """Multi-chip boosting: the binned matrix, labels and per-row state
+        (margins, gradients) live row-sharded on the mesh's data axis and
+        every boosting iteration's histogram/gradient programs run under
+        GSPMD, which inserts the ICI reductions (the XLA analogue of
+        XGBoost's Rabit allreduce, SURVEY §2.11-2.12).  Padded rows carry
+        zero training weight, so results match the single-device fit."""
+        self.mesh = mesh
+        return self
 
     def fit_columns(self, data: ColumnarDataset, label_col, features_col):
         X = np.asarray(features_col.values, dtype=np.float32)
@@ -545,10 +556,34 @@ class _GBTBase(PredictorEstimator):
             k = 1
             base = np.float32((base_w @ y) / max(base_w.sum(), 1e-9))
 
-        yj = jnp.asarray(y, jnp.float32)
-        Yj = jnp.asarray(Y) if obj == "multiclass" else None
-        twj = jnp.asarray(train_w)
-        F = jnp.full((n, k), base, jnp.float32)
+        if self.mesh is not None:
+            # row-shard the boosting state over the mesh's data axis; zero
+            # weight on padded rows keeps histograms identical
+            from ..parallel.mesh import data_sharding, pad_to_multiple
+
+            ndata = self.mesh.shape[self.mesh.axis_names[0]]
+            binned_h, _ = pad_to_multiple(np.asarray(binned), ndata, axis=0)
+            y_h, _ = pad_to_multiple(np.asarray(y, np.float32), ndata)
+            tw_h, _ = pad_to_multiple(np.asarray(train_w, np.float32), ndata)
+            n_pad = binned_h.shape[0]
+            ds = data_sharding(self.mesh)
+            binned = jax.device_put(binned_h, ds)
+            yj = jax.device_put(y_h, ds)
+            twj = jax.device_put(tw_h, ds)
+            if obj == "multiclass":
+                Y_h, _ = pad_to_multiple(Y, ndata, axis=0)
+                Yj = jax.device_put(Y_h, ds)
+            else:
+                Yj = None
+            # no explicit mesh context needed: the committed shardings on
+            # these inputs propagate through every jitted program below and
+            # GSPMD inserts the cross-device reductions
+            F = jax.device_put(np.full((n_pad, k), base, np.float32), ds)
+        else:
+            yj = jnp.asarray(y, jnp.float32)
+            Yj = jnp.asarray(Y) if obj == "multiclass" else None
+            twj = jnp.asarray(train_w)
+            F = jnp.full((n, k), base, jnp.float32)
 
         feats, threshs, leaves = [], [], []
         best_metric, best_len, stall = -np.inf, 0, 0
@@ -557,7 +592,11 @@ class _GBTBase(PredictorEstimator):
             G, H = _grad_hess(obj, F, yj, Yj, twj)
             bw = twj
             if self.subsample_rate < 1.0:
+                # draw over the REAL rows (same rng stream as the
+                # single-device fit), then pad for the sharded state
                 sub = (rng.random(n) < self.subsample_rate).astype(np.float32)
+                if len(sub) < int(twj.shape[0]):
+                    sub = np.pad(sub, (0, int(twj.shape[0]) - len(sub)))
                 bw = twj * jnp.asarray(sub)
                 G, H = _grad_hess(obj, F, yj, Yj, bw)
             mask = np.ones(d, bool)

@@ -4,12 +4,13 @@ from .mesh import (
     pad_to_multiple, replicated, shard_dataset,
 )
 from .sharded import (
-    TrainStepState, fit_logreg_sharded, full_train_step, make_train_step,
+    TrainStepState, colstats_corr_sharded, fit_logreg_sharded,
+    full_train_step, grow_forest_sharded, make_train_step,
 )
 
 __all__ = [
     "make_mesh", "data_sharding", "feature_sharding", "matrix_sharding",
     "replicated", "shard_dataset", "pad_to_multiple",
     "TrainStepState", "full_train_step", "make_train_step",
-    "fit_logreg_sharded",
+    "fit_logreg_sharded", "grow_forest_sharded", "colstats_corr_sharded",
 ]

@@ -29,7 +29,8 @@ from .mesh import (
 )
 
 __all__ = ["TrainStepState", "full_train_step", "make_train_step",
-           "fit_logreg_sharded", "grow_forest_sharded"]
+           "fit_logreg_sharded", "grow_forest_sharded",
+           "colstats_corr_sharded"]
 
 
 class TrainStepState(NamedTuple):
@@ -37,8 +38,8 @@ class TrainStepState(NamedTuple):
     beta: jnp.ndarray       # (D+1,) logreg coefficients + intercept
     col_mean: jnp.ndarray   # (D,)
     col_var: jnp.ndarray    # (D,)
-    tree_feat: jnp.ndarray  # (n_nodes,) int32 — split feature per node
-    tree_thresh: jnp.ndarray  # (n_nodes,) int32
+    tree_feat: jnp.ndarray  # (2^depth - 1,) int32 — split feature per node
+    tree_thresh: jnp.ndarray  # (2^depth - 1,) int32
 
 
 def _colstats(X, w):
@@ -65,39 +66,34 @@ def _newton_step(X, y, w, beta, l2=1e-3):
     return _finite_or(beta - _damped_solve(H, grad), beta)
 
 
-def _tree_level(binned, g, h, w, node, n_nodes, n_bins, lam=1.0):
-    n, d = binned.shape
-    chans = jnp.stack([g * w, h * w, w], axis=1)          # (N, 3)
-    flat_idx = (node[:, None] * (d * n_bins)
-                + jnp.arange(d)[None, :] * n_bins + binned)
-    hist = jnp.zeros((n_nodes * d * n_bins, 3), jnp.float32)
-    hist = hist.at[flat_idx].add(chans[:, None, :])
-    hist = hist.reshape(n_nodes, d, n_bins, 3)
-    GL = jnp.cumsum(hist[..., 0], axis=2)
-    HL = jnp.cumsum(hist[..., 1], axis=2)
-    Gt, Ht = GL[:, :1, -1:], HL[:, :1, -1:]
-    gain = (GL ** 2 / (HL + lam) + (Gt - GL) ** 2 / (Ht - HL + lam)
-            - Gt ** 2 / (Ht + lam))
-    gain = jnp.where(jnp.arange(n_bins)[None, None, :] < n_bins - 1,
-                     gain, -jnp.inf)
-    best = jnp.argmax(gain.reshape(n_nodes, d * n_bins), axis=1)
-    feat = (best // n_bins).astype(jnp.int32)
-    thresh = (best % n_bins).astype(jnp.int32)
-    x_row = jnp.take_along_axis(binned, feat[node][:, None], 1)[:, 0]
-    new_node = 2 * node + (x_row > thresh[node]).astype(jnp.int32)
-    return feat, thresh, new_node
-
-
 def full_train_step(X, binned, y, w, state: TrainStepState, *,
                     n_bins: int = 32) -> TrainStepState:
-    """One AutoML macro-step over sharded data (see module docstring)."""
+    """One AutoML macro-step over sharded data (see module docstring).
+
+    The tree component runs the REAL matmul-histogram kernel
+    (``gbdt_kernels._grow_tree_traced`` — the exact program production fits
+    compile), not a simplified stand-in: GSPMD partitions its histogram
+    matmuls over the mesh just like the logreg Gram products.
+    """
+    from ..models.gbdt_kernels import _grow_tree_traced
+
     mean, var = _colstats(X, w)
     beta = _newton_step(X, y, w, state.beta)
     g = jax.nn.sigmoid(X @ beta[:-1] + beta[-1]) - y     # logloss grads
     h = jnp.maximum(g + y, 1e-6) * jnp.maximum(1.0 - g - y, 1e-6)
-    node = jnp.zeros(X.shape[0], jnp.int32)
-    feat, thresh, _ = _tree_level(binned, g, h, w, node,
-                                  state.tree_feat.shape[0], n_bins)
+    n_nodes = state.tree_feat.shape[0]
+    if n_nodes & (n_nodes + 1):
+        raise ValueError(
+            f"tree_feat must hold a full heap (2^depth - 1 nodes), got "
+            f"{n_nodes}")
+    depth = int(np.log2(n_nodes + 1))
+    feat, thresh, _leaf = _grow_tree_traced(
+        binned, (g * w)[:, None], (h * w)[:, None], w,
+        jnp.ones(binned.shape[1], bool), jnp.int32(depth),
+        max_depth=depth, n_bins=n_bins, lam=jnp.float32(1.0),
+        min_child_weight=jnp.float32(0.0), min_info_gain=jnp.float32(0.0),
+        min_instances=jnp.float32(1.0), newton_leaf=jnp.bool_(False),
+        learning_rate=jnp.float32(1.0))
     return TrainStepState(beta, mean, var, feat, thresh)
 
 
@@ -199,6 +195,50 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
     if len(fs) == 1:
         return fs[0], ts[0], ls[0]
     return (jnp.concatenate(fs), jnp.concatenate(ts), jnp.concatenate(ls))
+
+
+@jax.jit
+def _colstats_corr_jit(X, y, w):
+    """Weighted column stats + Pearson-with-label, formulas matching the
+    SanityChecker host path exactly (variance ddof=1, label centered over
+    real rows) so mesh and single-device runs drop the same features."""
+    wsum = jnp.maximum(w.sum(), 2.0)
+    mean = (w @ X) / wsum
+    var = (w @ ((X - mean) ** 2)) / (wsum - 1.0)
+    big = jnp.float32(3.0e38)
+    mn = jnp.min(jnp.where(w[:, None] > 0, X, big), axis=0)
+    mx = jnp.max(jnp.where(w[:, None] > 0, X, -big), axis=0)
+    ymean = (w @ y) / wsum
+    yc = (y - ymean) * w
+    num = yc @ (X - mean)
+    den = (jnp.sqrt(jnp.maximum(var, 1e-30) * (wsum - 1.0))
+           * jnp.sqrt(jnp.maximum(yc @ yc, 1e-30)))
+    corr = jnp.nan_to_num(num / den)
+    return mean, var, mn, mx, corr
+
+
+def colstats_corr_sharded(X: np.ndarray, y: np.ndarray, mesh: Mesh):
+    """SanityChecker statistics over a row-sharded matrix: one jitted
+    program whose column reductions GSPMD psums over ICI — the TPU
+    replacement for the reference's executor-distributed
+    ``Statistics.colStats``/``corr`` (SanityChecker.scala:380-470).
+
+    Returns host (mean, variance, min, max, corr_with_label) numpy arrays;
+    padded rows carry zero weight so results match the host formulas.
+    """
+    from .mesh import data_sharding, pad_to_multiple
+
+    n = X.shape[0]
+    ndata = mesh.shape[mesh.axis_names[0]]
+    Xp, _ = pad_to_multiple(np.asarray(X, np.float32), ndata, axis=0)
+    yp, _ = pad_to_multiple(np.asarray(y, np.float32), ndata)
+    w = np.zeros(Xp.shape[0], np.float32)
+    w[:n] = 1.0
+    ds = data_sharding(mesh)
+    out = _colstats_corr_jit(jax.device_put(Xp, ds),
+                             jax.device_put(yp, ds), jax.device_put(w, ds))
+    packed = np.asarray(jnp.stack(out))  # one host fetch
+    return tuple(packed)
 
 
 def fit_logreg_sharded(X: np.ndarray, y: np.ndarray, mesh: Mesh,

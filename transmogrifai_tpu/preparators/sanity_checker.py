@@ -97,6 +97,16 @@ class SanityChecker(BinaryEstimator):
         self.remove_feature_group = remove_feature_group
         self.categorical_label = categorical_label
         self.max_label_classes = max_label_classes
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "SanityChecker":
+        """Multi-chip stats: colStats + label correlations run as one
+        row-sharded program with GSPMD ICI reductions
+        (parallel/sharded.colstats_corr_sharded) — the reference distributes
+        exactly these over executors (SanityChecker.scala:380-470).
+        Spearman needs a global rank sort and stays single-device."""
+        self.mesh = mesh
+        return self
 
     def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
                     features_col: FeatureColumn):
@@ -111,7 +121,13 @@ class SanityChecker(BinaryEstimator):
         vmeta = features_col.vmeta or VectorMetadata(
             "features", [])
 
-        if X.size > (1 << 24) and self.correlation_type != "spearman":
+        if self.mesh is not None and self.correlation_type != "spearman":
+            from ..parallel.sharded import colstats_corr_sharded
+
+            mean_h, variance, min_h, max_h, corr = colstats_corr_sharded(
+                X, y, self.mesh)
+            corr = np.nan_to_num(corr)
+        elif X.size > (1 << 24) and self.correlation_type != "spearman":
             # big host matrices: means/variance/Pearson are one BLAS pass on
             # host (~1 s/GB); shipping the matrix to the device first costs
             # ~70 s of tunnel upload per GB

@@ -32,6 +32,8 @@ __all__ = ["AggregateDataReader", "ConditionalDataReader",
 def _records_of(source) -> List[dict]:
     if hasattr(source, "to_dict"):          # pandas
         return source.to_dict("records")
+    if hasattr(source, "records"):          # AvroReader-like: lazy + cached
+        return list(source.records)
     return list(source)
 
 
@@ -217,12 +219,16 @@ class JoinedDataReader(Reader):
     def _with_key(reader: Reader, features: Sequence[Feature],
                   keys: Sequence[str]) -> ColumnarDataset:
         data = reader.generate_dataset(list(features))
-        for key in keys:
-            if key not in data:
-                from ..features.builder import FeatureBuilder
+        missing = [k for k in keys if k not in data]
+        if missing:
+            from ..features.builder import FeatureBuilder
 
-                key_f = FeatureBuilder.ID(key).as_predictor()
-                data.set(key, reader.generate_dataset([key_f])[key])
+            # one batched pass for ALL missing key columns (each extra
+            # generate_dataset can be a full file re-parse)
+            key_data = reader.generate_dataset(
+                [FeatureBuilder.ID(k).as_predictor() for k in missing])
+            for k in missing:
+                data.set(k, key_data[k])
         return data
 
     def _join_indices(self, ldata: ColumnarDataset, rdata: ColumnarDataset):

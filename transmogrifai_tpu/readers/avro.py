@@ -486,26 +486,34 @@ class AvroReader(Reader):
 
 
 class AvroSchemaCSVReader(Reader):
-    """CSV typed by an Avro schema (CSVReaders.scala: headerless CSV rows are
-    named AND typed via the .avsc, matching ``CSVToAvro.scala``)."""
+    """CSV columns NAMED by an Avro schema (CSVReaders.scala /
+    ``CSVToAvro.scala``: headerless CSV rows are addressed via the .avsc).
+
+    The schema's field→feature-type mapping is exposed as
+    ``feature_types`` (available at construction — the CLI codegen derives
+    typed FeatureBuilders from it, cli/gen/AvroField.scala); a feature's
+    DECLARED type stays authoritative for column materialization, exactly
+    as the reference's FeatureBuilder declarations override raw Avro types.
+    """
 
     def __init__(self, csv_path: str, schema_path: str,
                  key_field: Optional[str] = None):
         self.csv_path = csv_path
         self.schema_path = schema_path
         self.key_field = key_field
+        self.schema = json.loads(open(schema_path).read())
+        if self.schema.get("type") != "record":
+            raise ValueError(f"{schema_path}: expected a record schema")
+        #: {field name: feature type} per the .avsc (codegen introspection)
+        self.feature_types = schema_feature_types(self.schema)
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         import pandas as pd
 
-        schema = json.loads(open(self.schema_path).read())
-        if schema.get("type") != "record":
-            raise ValueError(f"{self.schema_path}: expected a record schema")
-        names = [f["name"] for f in schema["fields"]]
+        names = [f["name"] for f in self.schema["fields"]]
         df = pd.read_csv(self.csv_path, header=None, names=names,
                          skipinitialspace=True)
         out = ColumnarDataset()
-        ftypes = schema_feature_types(schema)
         for f in raw_features:
             if f.name not in df.columns:
                 raise KeyError(f"{f.name!r} not in avro schema fields "
@@ -515,5 +523,4 @@ class AvroSchemaCSVReader(Reader):
         if self.key_field and self.key_field in df.columns:
             out.set("key", FeatureColumn.from_values(
                 ft.ID, [str(v) for v in df[self.key_field].tolist()]))
-        self.feature_types = ftypes  # introspection (codegen uses this)
         return out

@@ -91,11 +91,12 @@ class DataReaders:
         def avro(path, key_fn, time_fn, cutoff=None,
                  predictor_window_ms=None, response_window_ms=None):
             """Aggregate reader over Avro records (DataReaders.Aggregate.avro,
-            DataReaders.scala:108-130)."""
+            DataReaders.scala:108-130).  The file decodes lazily at the
+            first dataset generation, not at factory time."""
             from .aggregates import AggregateDataReader
-            from .avro import read_avro
+            from .avro import AvroReader
 
-            return AggregateDataReader(read_avro(path)[1], key_fn, time_fn,
+            return AggregateDataReader(AvroReader(path), key_fn, time_fn,
                                        cutoff, predictor_window_ms,
                                        response_window_ms)
 
@@ -117,11 +118,12 @@ class DataReaders:
                  drop_if_no_target=True, predictor_window_ms=None,
                  response_window_ms=None):
             """Conditional reader over Avro records
-            (DataReaders.Conditional.avro, DataReaders.scala:214-248)."""
+            (DataReaders.Conditional.avro, DataReaders.scala:214-248);
+            decodes lazily at the first dataset generation."""
             from .aggregates import ConditionalDataReader
-            from .avro import read_avro
+            from .avro import AvroReader
 
-            return ConditionalDataReader(read_avro(path)[1], key_fn, time_fn,
+            return ConditionalDataReader(AvroReader(path), key_fn, time_fn,
                                          target_condition, drop_if_no_target,
                                          predictor_window_ms,
                                          response_window_ms)

@@ -137,6 +137,16 @@ class ModelSelector(PredictorEstimator):
         # (reference BestEstimator, ModelSelector.scala:116-145)
         self.best_estimator: Optional[Tuple[str, Dict[str, Any],
                                             List[ValidationResult]]] = None
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "ModelSelector":
+        """Multi-chip selection: every candidate fit in the sweep AND the
+        final refit run mesh-sharded (each estimator's own ``with_mesh``
+        path).  The single-chip device-resident sweep shortcut
+        (``fit_device``) is bypassed — its programs are compiled for one
+        chip's memory space."""
+        self.mesh = mesh
+        return self
 
     # -- validation plumbing -------------------------------------------------
 
@@ -237,9 +247,14 @@ class ModelSelector(PredictorEstimator):
             for params in grid_points:
                 def fitter(X, y, w, p, proto=proto):
                     est = proto.copy(**p)
-                    dev_score = est.fit_device(X, y, w, self.problem_type)
-                    if dev_score is not None:
-                        return dev_score   # device fit+score (no host sync)
+                    if self.mesh is not None:
+                        if hasattr(est, "with_mesh"):
+                            est.with_mesh(self.mesh)
+                    else:
+                        dev_score = est.fit_device(X, y, w,
+                                                   self.problem_type)
+                        if dev_score is not None:
+                            return dev_score  # device fit+score, no sync
                     model = est.fit_raw(X, y, w)
                     return lambda Xe: self._score_fn(model, Xe)
                 out.append((type(proto).__name__, params, fitter))
@@ -340,6 +355,8 @@ class ModelSelector(PredictorEstimator):
             best_proto = next(p for p, _ in self.models_and_params
                               if type(p).__name__ == best_name)
             best_est = best_proto.copy(**best_params)
+            if self.mesh is not None and hasattr(best_est, "with_mesh"):
+                best_est.with_mesh(self.mesh)
             best_model = best_est.fit_raw(X, y, base_w)
 
         train_metrics = self._full_metrics(best_model, X, y, train_mask)

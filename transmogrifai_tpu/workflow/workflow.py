@@ -68,6 +68,16 @@ class OpWorkflow(_WorkflowCore):
         self._raw_feature_filter = None
         self._model_stages: Dict[str, Model] = {}
         self._workflow_cv = False
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "OpWorkflow":
+        """Train the WHOLE workflow on a device mesh: every mesh-capable
+        stage in the DAG (SanityChecker stats, the ModelSelector sweep and
+        refit, each tree/linear trainer) receives the mesh at train time —
+        the equivalent of the reference distributing every fit over Spark
+        executors (SURVEY §2.12 row 1)."""
+        self.mesh = mesh
+        return self
 
     # -- wiring -------------------------------------------------------------
 
@@ -158,6 +168,24 @@ class OpWorkflow(_WorkflowCore):
         dag = compute_dag(self.result_features)
         self._validate_stages(dag)
         self._inject_params(dag)
+        # hand the mesh to every mesh-capable stage for THIS train only —
+        # stages are user-owned objects shared across workflows, so the
+        # previous mesh (usually None) is restored afterwards
+        meshed_stages = []
+        if self.mesh is not None:
+            for s in dag.all_stages():
+                if hasattr(s, "with_mesh"):
+                    meshed_stages.append((s, getattr(s, "mesh", None)))
+                    s.with_mesh(self.mesh)
+        try:
+            return self._train_inner(data, dag, filter_results)
+        finally:
+            for s, prev in meshed_stages:
+                s.with_mesh(prev)
+
+    def _train_inner(self, data, dag, filter_results) -> "OpWorkflowModel":
+        from ..utils.profiling import OpStep, with_job_group
+
         substitutes = dict(self._model_stages)
         if self._workflow_cv:
             # OpWorkflow.fitStages CV path (OpWorkflow.scala:403-453):
