@@ -46,20 +46,9 @@ def make_data(rows: int, cols: int, seed: int = 11):
     return df
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=1_000_000)
-    ap.add_argument("--cols", type=int, default=100)
-    ap.add_argument("--full", action="store_true",
-                    help="BASELINE config 4 headline shape (1M x 500)")
-    ap.add_argument("--folds", type=int, default=3)
-    ap.add_argument("--warmup", action="store_true",
-                    help="train once untimed first (exclude compile costs)")
-    args = ap.parse_args()
-    if args.full:
-        args.rows, args.cols = 1_000_000, 500
-
-    import numpy as np
+def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
+        baseline_s: float = SPARK_LOCAL_BASELINE_S) -> dict:
+    """One measured sweep at (rows, cols); importable by bench.py."""
 
     from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
     from transmogrifai_tpu.evaluators import Evaluators
@@ -72,7 +61,7 @@ def main():
     )
 
     t0 = time.perf_counter()
-    df = make_data(args.rows, args.cols)
+    df = make_data(rows, cols)
     gen_s = time.perf_counter() - t0
 
     label = FeatureBuilder.RealNN("label").as_response()
@@ -81,7 +70,7 @@ def main():
     checked = SanityChecker(max_correlation=0.99).set_input(
         label, features).get_output()
     selector = BinaryClassificationModelSelector.with_cross_validation(
-        num_folds=args.folds,
+        num_folds=folds,
         models_and_parameters=[
             (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
             (OpRandomForestClassifier(num_trees=20),
@@ -91,7 +80,7 @@ def main():
     wf = OpWorkflow().set_result_features(prediction).set_input_data(df)
 
     warmup_s = 0.0
-    if args.warmup:
+    if warmup:
         t0 = time.perf_counter()
         wf.train()
         warmup_s = time.perf_counter() - t0
@@ -101,17 +90,33 @@ def main():
 
     _, metrics = model.score_and_evaluate(
         Evaluators.BinaryClassification.auPR())
-    print(json.dumps({
+    return {
         "metric": "scale_automl_train_wall_clock",
-        "rows": args.rows, "cols": args.cols,
+        "rows": rows, "cols": cols,
         "value": round(train_s, 1), "unit": "s",
-        "vs_baseline": round(SPARK_LOCAL_BASELINE_S / train_s, 2),
+        "vs_baseline": round(baseline_s / train_s, 2),
         "aupr": round(float(metrics["AuPR"]), 4),
         "auroc": round(float(metrics["AuROC"]), 4),
         "datagen_s": round(gen_s, 1),
-        "baseline_s_assumed": SPARK_LOCAL_BASELINE_S,
+        "baseline_s_assumed": baseline_s,
         "warmup_s": round(warmup_s, 1),
-    }))
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--cols", type=int, default=100)
+    ap.add_argument("--full", action="store_true",
+                    help="BASELINE config 4 headline shape (1M x 500)")
+    ap.add_argument("--folds", type=int, default=3)
+    ap.add_argument("--warmup", action="store_true",
+                    help="train once untimed first (exclude compile costs)")
+    args = ap.parse_args()
+    if args.full:
+        args.rows, args.cols = 1_000_000, 500
+    print(json.dumps(run(args.rows, args.cols, folds=args.folds,
+                         warmup=args.warmup)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""XGBoost-parity benchmark — BASELINE.md config 5: wide sparse binary
+classification stressing the GBDT histogram build.
+
+Synthetic stand-in for the Criteo sample (the real data is not in the
+image): wide, mostly-zero features with planted signal.  One
+``OpXGBoostClassifier`` fit at the reference's default selector
+parameterisation (DefaultSelectorParams.scala: NumRound=200, Eta=0.02,
+MaxDepth=10, Gamma=0.8, aucpr early stopping after 20 rounds).
+
+Prints ONE JSON line like bench.py.  ``--cpu-extrapolate`` measures the
+same fit on N-times-smaller data to derive the CPU-baseline bound used in
+``benchmarks/baselines.json`` (see that file for the method).
+
+Usage: python examples/bench_xgb_wide.py [--rows N] [--cols D]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from transmogrifai_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+
+def make_sparse_data(rows: int, cols: int, density: float = 0.05,
+                     seed: int = 17):
+    """Wide mostly-zero matrix with signal in a few dense-ish columns."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    X = np.zeros((rows, cols), np.float32)
+    nnz_per_row = max(1, int(cols * density))
+    cols_idx = rng.integers(0, cols, size=(rows, nnz_per_row))
+    vals = rng.exponential(1.0, size=(rows, nnz_per_row)).astype(np.float32)
+    rows_idx = np.repeat(np.arange(rows), nnz_per_row)
+    X[rows_idx, cols_idx.ravel()] = vals.ravel()
+    informative = rng.choice(cols, 25, replace=False)
+    z = X[:, informative] @ rng.normal(size=25).astype(np.float32)
+    y = (z + 0.5 * rng.normal(size=rows) > np.median(z)).astype(np.float32)
+    return X, y
+
+
+def run(rows: int = 250_000, cols: int = 1000, density: float = 0.05,
+        num_round: int = 200, max_depth: int = 10,
+        warmup: bool = False) -> dict:
+    """One measured wide-sparse XGB fit; importable by bench.py."""
+    import numpy as np
+
+    from transmogrifai_tpu.evaluators.metrics import aupr
+    from transmogrifai_tpu.models import OpXGBoostClassifier
+
+    t0 = time.perf_counter()
+    X, y = make_sparse_data(rows, cols, density)
+    gen_s = time.perf_counter() - t0
+
+    def fit_once():
+        # reference XGB defaults for binary selection
+        # (DefaultSelectorParams.scala:36-75)
+        est = OpXGBoostClassifier(
+            num_round=num_round, eta=0.02, max_depth=max_depth,
+            min_child_weight=1.0, gamma=0.8, early_stopping_rounds=20,
+            seed=13)
+        t0 = time.perf_counter()
+        model = est.fit_raw(X, y)
+        fit_s = time.perf_counter() - t0
+        return model, fit_s
+
+    warmup_s = 0.0
+    if warmup:
+        from transmogrifai_tpu.models.trees import clear_sweep_caches
+        _, warmup_s = fit_once()
+        clear_sweep_caches()
+    model, fit_s = fit_once()
+
+    n_trees = int(np.asarray(model.feat).shape[0])
+    score = model.predict_batch(X).probability[:, 1]
+    quality = float(aupr(y, score))
+
+    return {
+        "metric": "xgb_wide_sparse_fit_wall_clock",
+        "rows": rows, "cols": cols, "density": density,
+        "value": round(fit_s, 1), "unit": "s",
+        "boosted_rounds": n_trees,
+        "train_aupr": round(quality, 4),
+        "datagen_s": round(gen_s, 1),
+        "warmup_s": round(warmup_s, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=250_000)
+    ap.add_argument("--cols", type=int, default=1000)
+    ap.add_argument("--density", type=float, default=0.05)
+    ap.add_argument("--num-round", type=int, default=200)
+    ap.add_argument("--max-depth", type=int, default=10)
+    ap.add_argument("--warmup", action="store_true",
+                    help="fit once untimed first (exclude compile costs)")
+    args = ap.parse_args()
+    print(json.dumps(run(args.rows, args.cols, args.density, args.num_round,
+                         args.max_depth, args.warmup)))
+
+
+if __name__ == "__main__":
+    main()

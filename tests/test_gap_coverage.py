@@ -1,0 +1,253 @@
+"""Coverage for previously thin paths: SmartText per-field strategies,
+Word2Vec/LDA quality, GBT/XGB multiclass objectives, streaming-score
+equivalence (VERDICT r1 item 9)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import ColumnarDataset, FeatureColumn
+
+
+class TestSmartTextStrategies:
+    def _cols(self, n=60):
+        rng = np.random.default_rng(0)
+        low_card = [f"cat_{i % 3}" for i in range(n)]           # -> pivot
+        high_card = [f"tok_{rng.integers(1e9)}" for _ in range(n)]  # -> hash
+        empty = [None] * n                                       # -> ignore
+        return low_card, high_card, empty
+
+    def _fit(self, **kw):
+        from transmogrifai_tpu.ops.vectorizers import SmartTextVectorizer
+
+        low, high, empty = self._cols()
+        cols = [FeatureColumn.from_values(ft.Text, v)
+                for v in (low, high, empty)]
+        est = SmartTextVectorizer(max_cardinality=10, top_k=5, min_support=1,
+                                  num_hash_features=16, **kw)
+        from transmogrifai_tpu.features.feature import Feature
+        est.input_features = [Feature(f"t{i}", ft.Text) for i in range(3)]
+        model = est.fit_columns(None, *cols)
+        model.input_features = est.input_features
+        return est, model, cols
+
+    def test_per_field_strategy_selection(self):
+        est, model, _ = self._fit()
+        assert model.strategies == [est.PIVOT, est.HASH, est.IGNORE]
+        assert sorted(model.vocabs[0]) == ["cat_0", "cat_1", "cat_2"]
+        assert model.vocabs[1] == []
+
+    def test_pivot_branch_emits_indicators(self):
+        est, model, cols = self._fit(track_nulls=False)
+        out = np.asarray(model.transform_columns(*cols).values)
+        # first field: one indicator column per vocab value; row 0 is cat_0
+        v0 = model.vocabs[0]
+        row0 = out[0, : len(v0)]
+        assert row0[v0.index("cat_0")] == 1.0
+        assert row0.sum() == 1.0
+
+    def test_hash_branch_spreads_tokens(self):
+        est, model, cols = self._fit(track_nulls=False)
+        out = np.asarray(model.transform_columns(*cols).values)
+        n_pivot = len(model.vocabs[0]) + 1  # vocab + Other indicator
+        hash_block = out[:, n_pivot:n_pivot + 16]
+        # high-cardinality field hashes into >1 bucket and every row has
+        # at least one nonzero
+        assert (hash_block != 0).any(axis=1).all()
+        assert (hash_block != 0).any(axis=0).sum() > 1
+
+    def test_ignore_branch_contributes_no_value_columns(self):
+        est, model, cols = self._fit(track_nulls=False)
+        out = np.asarray(model.transform_columns(*cols).values)
+        # pivot block (+Other) + hash block and NOTHING for the ignored field
+        assert out.shape[1] == len(model.vocabs[0]) + 1 + 16
+
+    def test_null_tracking_adds_indicator_per_tracked_field(self):
+        est, model, cols = self._fit(track_nulls=True)
+        out_nt = np.asarray(model.transform_columns(*cols).values)
+        est2, model2, cols2 = self._fit(track_nulls=False)
+        out = np.asarray(model2.transform_columns(*cols2).values)
+        assert out_nt.shape[1] > out.shape[1]
+        # the ignored (all-null) field's null indicator is 1 everywhere
+        assert (out_nt[:, -1] == 1.0).all()
+
+
+class TestEmbeddingQuality:
+    def test_word2vec_cooccurrence_similarity(self):
+        from transmogrifai_tpu.features.feature import Feature
+        from transmogrifai_tpu.ops.embeddings import OpWord2Vec
+
+        rng = np.random.default_rng(1)
+        docs = []
+        for _ in range(300):
+            if rng.random() < 0.5:
+                docs.append(["cat", "dog", "pet"] * 2)
+            else:
+                docs.append(["car", "road", "drive"] * 2)
+        # tiny corpus needs a bigger budget than the Spark-parity defaults
+        # (max_iter=1 assumes corpus-scale pair counts)
+        est = OpWord2Vec(vector_size=16, min_count=1, max_iter=30,
+                         step_size=0.1, batch_size=512, window_size=2,
+                         seed=3)
+        est.input_features = [Feature("toks", ft.TextList)]
+        col = FeatureColumn.from_values(ft.TextList, docs)
+        model = est.fit_columns(None, col)
+        model.input_features = est.input_features
+
+        def vec(w):
+            return model.vectors[model.vocab.index(w)]
+
+        def cos(a, b):
+            return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)
+                                  + 1e-12))
+
+        # co-occurring words must embed closer than cross-topic words on
+        # average (individual pairs are noisy at this tiny training budget)
+        within = np.mean([cos(vec("cat"), vec("dog")),
+                          cos(vec("cat"), vec("pet")),
+                          cos(vec("car"), vec("road")),
+                          cos(vec("car"), vec("drive"))])
+        across = np.mean([cos(vec("cat"), vec("road")),
+                          cos(vec("dog"), vec("car")),
+                          cos(vec("pet"), vec("drive"))])
+        assert within > across, (within, across)
+
+    def test_lda_separates_topics(self):
+        from transmogrifai_tpu.features.feature import Feature
+        from transmogrifai_tpu.ops.embeddings import OpLDA
+
+        rng = np.random.default_rng(2)
+        vocab = 20
+        docs = np.zeros((80, vocab), np.float32)
+        for i in range(80):
+            half = slice(0, 10) if i % 2 == 0 else slice(10, 20)
+            docs[i, half] = rng.integers(1, 6, size=10)
+        est = OpLDA(k=2, max_iter=15, seed=4)
+        est.input_features = [Feature("counts", ft.OPVector)]
+        col = FeatureColumn(ft.OPVector, docs)
+        model = est.fit_columns(None, col)
+        model.input_features = est.input_features
+        theta = np.asarray(model.transform_columns(col).values)
+        assert theta.shape == (80, 2)
+        np.testing.assert_allclose(theta.sum(axis=1), 1.0, atol=1e-3)
+        # dominant topic must agree within a group and differ across groups
+        even_dom = np.argmax(theta[0::2].mean(axis=0))
+        odd_dom = np.argmax(theta[1::2].mean(axis=0))
+        assert even_dom != odd_dom
+        assert (np.argmax(theta[0::2], axis=1) == even_dom).mean() > 0.9
+        assert (np.argmax(theta[1::2], axis=1) == odd_dom).mean() > 0.9
+
+
+class TestTreeMulticlass:
+    def _blobs(self, k=3, per=120, seed=5):
+        rng = np.random.default_rng(seed)
+        X = (rng.normal(size=(k * per, 4)).astype(np.float32)
+             + np.repeat(np.eye(k, 4) * 3.0, per, axis=0).astype(np.float32))
+        y = np.repeat(np.arange(k), per).astype(np.float32)
+        return X, y
+
+    def test_xgb_multiclass_softmax(self):
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+
+        X, y = self._blobs()
+        est = OpXGBoostClassifier(num_round=25, eta=0.3, max_depth=3,
+                                  early_stopping_rounds=0, num_class=3)
+        model = est.fit_raw(X, y)
+        assert model.mode == "gbdt_multi"
+        batch = model.predict_batch(X)
+        assert batch.probability.shape == (len(y), 3)
+        np.testing.assert_allclose(batch.probability.sum(axis=1), 1.0,
+                                   atol=1e-4)
+        assert (np.asarray(batch.prediction) == y).mean() > 0.95
+
+    def test_xgb_multiclass_autodetected_from_labels(self):
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+
+        X, y = self._blobs()
+        model = OpXGBoostClassifier(num_round=15, eta=0.3, max_depth=3,
+                                    early_stopping_rounds=0).fit_raw(X, y)
+        assert model.mode == "gbdt_multi"
+        assert model.n_classes == 3
+
+    def test_xgb_multiclass_early_stopping(self):
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+
+        X, y = self._blobs()
+        est = OpXGBoostClassifier(num_round=60, eta=0.4, max_depth=3,
+                                  early_stopping_rounds=3, num_class=3,
+                                  seed=9)
+        est.validation_fraction = 0.25
+        model = est.fit_raw(X, y)
+        # multiclass ES metric is validation accuracy — saturates fast here
+        assert int(np.asarray(model.feat).shape[0]) < 60
+
+    def test_rf_multiclass(self):
+        from transmogrifai_tpu.models import OpRandomForestClassifier
+
+        X, y = self._blobs()
+        model = OpRandomForestClassifier(num_trees=20, max_depth=5).fit_raw(
+            X, y)
+        batch = model.predict_batch(X)
+        assert batch.probability.shape[1] == 3
+        assert (np.asarray(batch.prediction) == y).mean() > 0.95
+
+
+class TestStreamingScoreEquivalence:
+    def test_streamed_scores_match_batch_scores(self, tmp_path):
+        import pandas as pd
+
+        from transmogrifai_tpu import (
+            FeatureBuilder, OpWorkflow, transmogrify,
+        )
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.readers.streaming import StreamingReaders
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, grid,
+        )
+        from transmogrifai_tpu.workflow.runner import (
+            OpParams, OpWorkflowRunner, RunType,
+        )
+
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(200, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(float)
+        df = pd.DataFrame({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+                           "y": y})
+        label, preds = FeatureBuilder.from_dataframe(df, response="y")
+        vec = transmogrify(preds)
+        pred = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(OpLogisticRegression(),
+                                    grid(reg_param=[0.01]))],
+        ).set_input(label, vec).get_output()
+        wf = OpWorkflow().set_result_features(pred).set_input_data(df)
+        model = wf.train()
+
+        # batch scores
+        batch_scores = [r["probability_1"]
+                        for r in model.score(df)[pred.name].values]
+
+        model_dir = str(tmp_path / "model")
+        model.save(model_dir)
+
+        # streamed in 7 uneven batches through the async batcher
+        batches = [df.iloc[i:i + 31] for i in range(0, len(df), 31)]
+        runner = OpWorkflowRunner(
+            wf, streaming_score_reader=StreamingReaders.Simple.iterator(
+                batches))
+        params = OpParams(model_location=model_dir,
+                          write_location=str(tmp_path / "scores"))
+        result = runner.run(RunType.StreamingScore, params)
+        assert result.n_rows == len(df)
+        assert result.n_batches == len(batches)
+
+        import ast
+        import glob
+
+        streamed = []
+        for p in sorted(glob.glob(str(tmp_path / "scores" / "scores*"))):
+            out = pd.read_csv(p)
+            col = next(c for c in out.columns if "probability_1" in
+                       str(out[c].iloc[0]))
+            streamed.extend(ast.literal_eval(v)["probability_1"]
+                            for v in out[col])
+        assert len(streamed) == len(batch_scores)
+        np.testing.assert_allclose(streamed, batch_scores, atol=1e-6)

@@ -125,7 +125,8 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                       max_depth: int, n_bins: int, lam, min_child_weight,
                       min_info_gain, min_instances, newton_leaf,
                       learning_rate, hist_bf16: bool = False,
-                      all_reduce=None, min_gain_raw=None):
+                      all_reduce=None, min_gain_raw=None,
+                      bag_mode: str = "none", feat_idx=None):
     """One whole tree under trace: Python-unrolled loop over levels.
 
     This is the dispatch-collapsing design: the per-level kernel approach
@@ -150,6 +151,15 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
       of them per 50-tree depth-12 fit ≈ 8 s), while the matmul form rides
       the systolic array and the bin one-hot is built once per chunk.
     """
+    # Feature-subset fast path (RF's featureSubsetStrategy): when the tree
+    # uses only ``msub`` of D features, gather those columns ONCE and build
+    # histograms at width msub instead of D.  The per-level (rows, B·D)
+    # bins one-hot is the kernel's bandwidth bottleneck (measured: per-level
+    # cost is flat in slot count and linear in D at 100k×500), so sqrt-D
+    # subsetting cuts the histogram traffic ~D/msub (≈23x at D=500).
+    if feat_idx is not None:
+        binned = jnp.take(binned, feat_idx.astype(jnp.int32), axis=1)
+        feat_mask = jnp.ones(feat_idx.shape[0], bool)
     n, d = binned.shape
     k = G.shape[1]
     B = n_bins
@@ -159,7 +169,22 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
         # compaction would produce inconsistent slot<->node mappings; grow
         # with the full 2^level slot layout and psum the histograms
         n_cap = 1 << 62
-    chans = [G[:, i] for i in range(k)] + [H[:, i] for i in range(k)] + [C]
+    # Bagged forests have structurally redundant channels: H_i == C (hessian
+    # IS the bag weight), and for one-hot classification targets the class
+    # gradients sum to the counts (Σ_i G_i == C).  Building only the
+    # irreducible channels cuts the histogram matmul count from 2K+1 to K
+    # ("onehot": K-1 grads + counts) or K+1 ("bagged" regression: K grads +
+    # counts) — a 2.5x FLOP cut for binary RF, the sweep's hot op.  The
+    # dropped histograms are reconstructed exactly below (same partial sums,
+    # one extra subtraction of rounding-level error).
+    if bag_mode == "onehot":
+        chans = [G[:, i] for i in range(k - 1)] + [C]
+    elif bag_mode == "bagged":
+        chans = [G[:, i] for i in range(k)] + [C]
+    else:
+        chans = [G[:, i] for i in range(k)] \
+            + [H[:, i] for i in range(k)] + [C]
+    nchan = len(chans)
     # RF grad/hess are bag-weight × one-hot class values — exact in bf16
     # for integer weights, ≲1e-3 relative under fractional balancer weights,
     # either way immaterial to split selection; DEFAULT precision (bf16 in,
@@ -182,7 +207,7 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
         # padded rows carry zero channel weight: they land in slot 0 bin 0
         # and contribute nothing
         chans_blk = jnp.pad(jnp.stack(chans, 1), ((0, pad), (0, 0))).reshape(
-            n_blocks, ROW_BLOCK, 2 * k + 1)
+            n_blocks, ROW_BLOCK, nchan)
     else:
         # (N, B·D) one-hot, minor axis = features (128-lane tile friendly)
         onehot_bins = (binned[:, None, :] == jnp.arange(B)[None, :, None]
@@ -226,13 +251,13 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                     jax.lax.dot((oh_node * ch_b[:, c][:, None]).T, oh_bins,
                                 precision=dot_prec,
                                 preferred_element_type=jnp.float32)
-                    for c in range(2 * k + 1)])        # (2K+1, M, B·D)
+                    for c in range(nchan)])            # (nchan, M, B·D)
                 return acc + part, None
 
-            acc0 = jnp.zeros((2 * k + 1, M, B * d), jnp.float32)
+            acc0 = jnp.zeros((nchan, M, B * d), jnp.float32)
             hist_stack, _ = lax.scan(
                 hist_block, acc0, (slot_blk, binned_blk, chans_blk))
-            hists = [hist_stack[c].reshape(M, B, d) for c in range(2 * k + 1)]
+            hists = [hist_stack[c].reshape(M, B, d) for c in range(nchan)]
         else:
             onehot_node = (slot[:, None] == jnp.arange(M)[None, :]
                            ).astype(jnp.float32)      # (N, M)
@@ -244,10 +269,19 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                      for ch in chans]                 # 2K+1 × (M, B, D)
         if all_reduce is not None:
             # ICI collective replaces Spark's treeAggregate / Rabit allreduce
+            # (channel reduction also means fewer collectives per level)
             hists = [all_reduce(h) for h in hists]
-        GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
-        HLs = [jnp.cumsum(h, axis=1) for h in hists[k:2 * k]]
-        CL = jnp.cumsum(hists[2 * k], axis=1)
+        CL = jnp.cumsum(hists[-1], axis=1)
+        if bag_mode == "onehot":
+            GLs = [jnp.cumsum(h, axis=1) for h in hists[: k - 1]]
+            GLs.append(CL - sum(GLs) if GLs else CL)
+            HLs = [CL] * k
+        elif bag_mode == "bagged":
+            GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
+            HLs = [CL] * k
+        else:
+            GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
+            HLs = [jnp.cumsum(h, axis=1) for h in hists[k:2 * k]]
 
         gain = 0.0
         HLmin = jnp.inf
@@ -303,6 +337,11 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     # heap layout: level l occupies slots [2^l - 1, 2^{l+1} - 1)
     heap_feat = jnp.concatenate(heap_feat_levels)
     heap_thresh = jnp.concatenate(heap_thresh_levels)
+    if feat_idx is not None:
+        # map subset-local feature ids back to the full feature space
+        # (no-split nodes keep thresh == B, which routes every row left
+        # regardless of the mapped feature id)
+        heap_feat = feat_idx.astype(jnp.int32)[heap_feat]
 
     n_leaves = 2 ** max_depth
     if n * n_leaves <= (64 << 20):
@@ -347,23 +386,32 @@ def _grow_chunk(binned, G, H, C, feat_mask, depth_limit, max_depth: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_depth", "n_bins", "hist_bf16"))
+                   static_argnames=("max_depth", "n_bins", "hist_bf16",
+                                    "onehot_targets"))
 def _grow_chunk_bagged(binned, Y, BW, feat_mask, depth_limit, max_depth: int,
                        n_bins: int, lam, min_child_weight, min_info_gain,
                        min_instances, newton_leaf, learning_rate,
-                       hist_bf16: bool = False):
+                       hist_bf16: bool = False,
+                       onehot_targets: bool = False, feat_idx=None):
     """Bagged-forest chunk: G/H derived from the (C, N) bag weights and the
     shared (N, K) targets *inside* the jit, so the (C, N, K) gradient
     tensors exist only transiently per launch (fused by XLA), never as
-    host-built arrays — peak memory stays bounded by the chunk budget."""
+    host-built arrays — peak memory stays bounded by the chunk budget.
+    ``onehot_targets`` (classification) activates the reduced-channel
+    histogram path (see _grow_tree_traced bag_mode)."""
     G = BW[:, :, None] * Y[None, :, :]
     H = jnp.broadcast_to(BW[:, :, None], G.shape)
-    fn = functools.partial(
-        _grow_tree_traced, binned, max_depth=max_depth, n_bins=n_bins,
-        lam=lam, min_child_weight=min_child_weight,
-        min_info_gain=min_info_gain, min_instances=min_instances,
-        newton_leaf=newton_leaf, learning_rate=learning_rate,
-        hist_bf16=hist_bf16)
+    kw = dict(max_depth=max_depth, n_bins=n_bins,
+              lam=lam, min_child_weight=min_child_weight,
+              min_info_gain=min_info_gain, min_instances=min_instances,
+              newton_leaf=newton_leaf, learning_rate=learning_rate,
+              hist_bf16=hist_bf16,
+              bag_mode="onehot" if onehot_targets else "bagged")
+    if feat_idx is not None:
+        return jax.vmap(lambda g, h, c, m, lim, fi: _grow_tree_traced(
+            binned, g, h, c, m, lim, feat_idx=fi, **kw))(
+            G, H, BW, feat_mask, depth_limit, feat_idx)
+    fn = functools.partial(_grow_tree_traced, binned, **kw)
     return jax.vmap(fn)(G, H, BW, feat_mask, depth_limit)
 
 
@@ -377,15 +425,22 @@ HIST_BYTES_BUDGET = 4 << 30
 def forest_chunk_size(n_trees: int, max_depth: int, d: int, n_bins: int,
                       k: int, budget: int = HIST_BYTES_BUDGET,
                       n_rows: Optional[int] = None,
-                      compact: bool = True) -> int:
+                      compact: bool = True,
+                      n_channels: Optional[int] = None,
+                      d_full: Optional[int] = None) -> int:
     # node compaction caps a level's histogram slots at next_pow2(n_rows);
     # 1.3x covers the 128-lane padding of the minor (feature) axis.
     # compact=False is the all-reduce (mesh-sharded) path, which keeps the
     # full 2^level slot layout so every shard agrees on histogram indices.
+    # ``d`` is the HISTOGRAM width (= msub on the feature-subset path);
+    # ``n_channels`` overrides the default 2K+1 when the reduced-channel
+    # bagged path is active; ``d_full`` adds the per-tree gathered binned
+    # copy the subset path materializes.
+    nchan = n_channels if n_channels is not None else 2 * k + 1
     slots = 2 ** (max_depth - 1)
     if n_rows is not None and compact:
         slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))
-    per_tree = int(slots * d * n_bins * (2 * k + 1) * 4 * 1.3)
+    per_tree = int(slots * d * n_bins * nchan * 4 * 1.3)
     if n_rows is not None:
         # matmul-histogram operands live per tree under vmap: the per-block
         # (rows, slots) node one-hot and (rows, B·D) bins one-hot (rows
@@ -396,6 +451,9 @@ def forest_chunk_size(n_trees: int, max_depth: int, d: int, n_bins: int,
         if n_rows > ROW_BLOCK:
             per_tree += int(rows * n_bins * d * 4 * 1.3)
         per_tree += int(n_rows * (2 * k + 1) * 4)
+        if d_full is not None and d_full != d:
+            # the per-tree (rows, msub) int32 gather of the binned matrix
+            per_tree += int(n_rows * d * 4)
     return int(np.clip(budget // max(per_tree, 1), 1, n_trees))
 
 
@@ -405,6 +463,7 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
                 min_child_weight: float = 0.0, min_info_gain: float = 0.0,
                 min_instances: float = 1.0, newton_leaf: bool = False,
                 learning_rate: float = 1.0, as_numpy: bool = True,
+                onehot_targets: bool = False,
                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow ``T`` independent bagged trees in ceil(T/chunk) XLA launches.
 
@@ -433,7 +492,8 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
         BWc = jnp.asarray(np.pad(BW[s:e], ((0, pad), (0, 0))))
         Mc = jnp.asarray(np.pad(feat_mask[s:e], ((0, pad), (0, 0))))
         f, t, lf = _grow_chunk_bagged(binned, Yj, BWc, Mc, limit, heap_depth,
-                                      n_bins, *args)
+                                      n_bins, *args,
+                                      onehot_targets=onehot_targets)
         if as_numpy:
             f, t, lf = np.asarray(f), np.asarray(t), np.asarray(lf)
         feats.append(f[:e - s])
@@ -449,12 +509,40 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
             jnp.concatenate(leaves))
 
 
+def _rf_bag_and_features(tid, seed, n: int, d: int, msub: int,
+                         subsample_rate):
+    """Per-tree Poisson bag weights + feature-subset indices from
+    ``fold_in(seed, tree_id)`` — THE single definition of RF randomness,
+    shared by the single-device on-device generator and the mesh path so
+    both grow identical forests."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), tid)
+    kb, km = jax.random.split(key)
+    bw = jax.random.poisson(kb, subsample_rate, (n,)).astype(jnp.float32)
+    r = jax.random.uniform(km, (d,))
+    # the msub smallest ranks — the same SET as the mask form (r <= kth),
+    # as indices so the histogram runs at width msub
+    idx = jnp.argsort(r)[:msub].astype(jnp.int32)
+    return bw, idx
+
+
+def rf_bags_and_features(seed: int, n_trees: int, n: int, d: int, msub: int,
+                         subsample_rate: float):
+    """Host copies of every tree's bag weights and feature subset (the mesh
+    path shards precomputed bags; identical to the on-device generator)."""
+    BW, idx = jax.jit(jax.vmap(
+        lambda tid: _rf_bag_and_features(tid, jnp.int32(seed), n, d, msub,
+                                         jnp.float32(subsample_rate))))(
+        jnp.arange(n_trees))
+    return np.asarray(BW), np.asarray(idx)
+
+
 @functools.partial(jax.jit, static_argnames=("chunk", "msub", "max_depth",
-                                             "n_bins"))
+                                             "n_bins", "onehot_targets"))
 def _grow_chunk_rf(binned, Y, base_w, seed, start, n_trees, depth_limit_val,
                    subsample_rate, chunk: int, msub: int, max_depth: int,
                    n_bins: int, lam, min_child_weight, min_info_gain,
-                   min_instances, learning_rate):
+                   min_instances, learning_rate,
+                   onehot_targets: bool = False):
     """RF chunk with ON-DEVICE bag-weight + feature-mask generation.
 
     Through a remote-TPU tunnel, uploading per-tree (T, N) Poisson weights
@@ -464,28 +552,24 @@ def _grow_chunk_rf(binned, Y, base_w, seed, start, n_trees, depth_limit_val,
     """
     n, d = binned.shape
     tree_ids = start + jnp.arange(chunk)
-
-    def gen(tid):
-        key = jax.random.fold_in(jax.random.PRNGKey(seed), tid)
-        kb, km = jax.random.split(key)
-        bw = jax.random.poisson(kb, subsample_rate, (n,)).astype(jnp.float32)
-        r = jax.random.uniform(km, (d,))
-        kth = jnp.sort(r)[msub - 1]
-        return bw, r <= kth
-
-    BWr, masks = jax.vmap(gen)(tree_ids)
+    BWr, feat_idx = jax.vmap(
+        lambda tid: _rf_bag_and_features(tid, seed, n, d, msub,
+                                         subsample_rate))(tree_ids)
     BW = base_w[None, :] * BWr * (tree_ids < n_trees)[:, None]
+    masks = jnp.ones((chunk, d), bool)  # unused on the feat_idx path
     limit = jnp.full((chunk,), depth_limit_val, jnp.int32)
     return _grow_chunk_bagged(
         binned, Y, BW, masks, limit, max_depth, n_bins, lam,
         min_child_weight, min_info_gain, min_instances,
-        jnp.bool_(False), learning_rate, hist_bf16=True)
+        jnp.bool_(False), learning_rate, hist_bf16=True,
+        onehot_targets=onehot_targets, feat_idx=feat_idx)
 
 
 def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
                    subsample_rate: float, max_depth: int, n_bins: int,
                    lam: float = 1e-3, min_child_weight: float = 0.0,
-                   min_info_gain: float = 0.0, min_instances: float = 1.0):
+                   min_info_gain: float = 0.0, min_instances: float = 1.0,
+                   onehot_targets: bool = False):
     """Bagged random forest, bags generated on device (see _grow_chunk_rf).
 
     Returns device (T, 2^hd-1) feat/thresh and (T, 2^hd, K) leaves, where hd
@@ -493,7 +577,11 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
     n, d = binned.shape
     k = Y.shape[1]
     heap_depth = _resolve_compile_depth(max_depth)
-    chunk = forest_chunk_size(n_trees, heap_depth, d, n_bins, k, n_rows=n)
+    # feat_idx path: histograms at width msub with the reduced channel
+    # count (K for one-hot classification, K+1 for bagged regression)
+    chunk = forest_chunk_size(
+        n_trees, heap_depth, msub, n_bins, k, n_rows=n,
+        n_channels=(k if onehot_targets else k + 1), d_full=d)
     args = (jnp.float32(lam), jnp.float32(min_child_weight),
             jnp.float32(min_info_gain), jnp.float32(min_instances),
             jnp.float32(1.0))
@@ -503,7 +591,7 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
             binned, Y, base_w, jnp.int32(seed), jnp.int32(s),
             jnp.int32(n_trees), jnp.int32(max_depth),
             jnp.float32(subsample_rate), chunk, msub, heap_depth, n_bins,
-            *args)
+            *args, onehot_targets=onehot_targets)
         e = min(s + chunk, n_trees)
         if e - s < chunk:
             f, t, lf = f[:e - s], t[:e - s], lf[:e - s]

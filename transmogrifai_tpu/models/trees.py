@@ -259,6 +259,17 @@ def _dev_memo(arr, tag: str = "up"):
     return _memo(key, lambda: jnp.asarray(a))
 
 
+def _dev_memo_sharded(arr, sharding, tag: str = "up"):
+    """Upload a host array ONCE per (content, sharding) — the mesh sweep
+    probes with the same fold matrices for every grid candidate, and each
+    redundant sharded upload costs seconds of tunnel transfer."""
+    import jax
+
+    a = np.ascontiguousarray(np.asarray(arr))
+    key = (tag, _content_hash(a), a.shape, str(a.dtype), str(sharding))
+    return _memo(key, lambda: jax.device_put(a, sharding))
+
+
 def _binned_for_edges(X, edges):
     """Device-binned matrix for given edges (scoring path)."""
     Xf = _as_f32(X)
@@ -378,7 +389,8 @@ class _RandomForestBase(PredictorEstimator):
                 subsample_rate=self.subsample_rate,
                 max_depth=self.max_depth, n_bins=self.max_bins, lam=1e-3,
                 min_info_gain=self.min_info_gain,
-                min_instances=float(self.min_instances_per_node))
+                min_instances=float(self.min_instances_per_node),
+                onehot_targets=self._classification)
         # ensemble stays device-resident: during model selection only the
         # scores come back to host; the winning ensemble downloads lazily at
         # persistence/native-serving time (TreeEnsembleModel._raw)
@@ -390,18 +402,21 @@ class _RandomForestBase(PredictorEstimator):
 
     def _fit_sharded(self, binned, Y, base_w, msub: int):
         """Multi-chip fit: pad rows to tile the mesh's data axis (padded
-        rows carry zero bag weight) and grow with psum'd histograms."""
+        rows carry zero bag weight) and grow with psum'd histograms.
+        Bags/feature subsets come from the SAME on-device generator as the
+        single-device path (gbdt_kernels._rf_bag_and_features), so the mesh
+        grows the identical forest."""
         from ..parallel.mesh import pad_to_multiple
         from ..parallel.sharded import grow_forest_sharded
+        from .gbdt_kernels import rf_bags_and_features
 
         n, d = binned.shape
         T = self.num_trees
-        rng = np.random.default_rng(self.seed)
-        BW = np.asarray(base_w, np.float32)[None, :] * rng.poisson(
-            self.subsample_rate, (T, n)).astype(np.float32)
+        BWr, feat_idx = rf_bags_and_features(
+            self.seed, T, n, d, msub, self.subsample_rate)
+        BW = np.asarray(base_w, np.float32)[None, :] * BWr
         masks = np.zeros((T, d), bool)
-        for t in range(T):
-            masks[t, rng.choice(d, msub, replace=False)] = True
+        np.put_along_axis(masks, feat_idx, True, axis=1)
         ndata = self.mesh.shape[self.mesh.axis_names[0]]
         binned_h, _ = pad_to_multiple(np.asarray(binned), ndata, axis=0)
         BW, _ = pad_to_multiple(BW, ndata, axis=1)   # zero weight on pad
@@ -410,7 +425,8 @@ class _RandomForestBase(PredictorEstimator):
             binned_h, Y_h, BW, masks, self.mesh,
             max_depth=self.max_depth, n_bins=self.max_bins, lam=1e-3,
             min_info_gain=self.min_info_gain,
-            min_instances=float(self.min_instances_per_node))
+            min_instances=float(self.min_instances_per_node),
+            onehot_targets=self._classification)
 
 
 class OpRandomForestClassifier(_RandomForestBase):
@@ -567,12 +583,14 @@ class _GBTBase(PredictorEstimator):
             tw_h, _ = pad_to_multiple(np.asarray(train_w, np.float32), ndata)
             n_pad = binned_h.shape[0]
             ds = data_sharding(self.mesh)
-            binned = jax.device_put(binned_h, ds)
-            yj = jax.device_put(y_h, ds)
-            twj = jax.device_put(tw_h, ds)
+            # content-memoized sharded uploads: a sweep probes with the same
+            # fold matrices for every grid candidate
+            binned = _dev_memo_sharded(binned_h, ds, "gbt_binned")
+            yj = _dev_memo_sharded(y_h, ds, "gbt_y")
+            twj = _dev_memo_sharded(tw_h, ds, "gbt_w")
             if obj == "multiclass":
                 Y_h, _ = pad_to_multiple(Y, ndata, axis=0)
-                Yj = jax.device_put(Y_h, ds)
+                Yj = _dev_memo_sharded(Y_h, ds, "gbt_Y")
             else:
                 Yj = None
             # no explicit mesh context needed: the committed shardings on

@@ -104,6 +104,8 @@ def shard_dataset(X: np.ndarray, y: Optional[np.ndarray], mesh: Mesh,
     contribute nothing to matmuls and get zero weights back).
     Returns (X_dev, y_dev, w_dev) committed device arrays.
     """
+    from ..models.trees import _dev_memo_sharded
+
     ndata = mesh.shape[mesh.axis_names[0]]
     nmodel = mesh.shape[mesh.axis_names[1]]
     n_rows = X.shape[0]
@@ -112,10 +114,13 @@ def shard_dataset(X: np.ndarray, y: Optional[np.ndarray], mesh: Mesh,
     X, _ = pad_to_multiple(np.asarray(X, np.float32), ndata, axis=0)
     X, _ = pad_to_multiple(X, nmodel, axis=1)
     w, _ = pad_to_multiple(np.asarray(w, np.float32), ndata, axis=0)
-    X_dev = jax.device_put(X, matrix_sharding(mesh))
-    w_dev = jax.device_put(w, data_sharding(mesh))
+    # content-memoized: the selector sweep re-shards the same fold matrices
+    # for every grid candidate, and each redundant sharded upload costs
+    # seconds of tunnel transfer
+    X_dev = _dev_memo_sharded(X, matrix_sharding(mesh), "shard_X")
+    w_dev = _dev_memo_sharded(w, data_sharding(mesh), "shard_w")
     y_dev = None
     if y is not None:
         y_pad, _ = pad_to_multiple(np.asarray(y, np.float32), ndata, axis=0)
-        y_dev = jax.device_put(y_pad, data_sharding(mesh))
+        y_dev = _dev_memo_sharded(y_pad, data_sharding(mesh), "shard_y")
     return X_dev, y_dev, w_dev

@@ -115,7 +115,8 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
                         min_info_gain: float = 0.0,
                         min_instances: float = 1.0,
                         newton_leaf: bool = False,
-                        learning_rate: float = 1.0):
+                        learning_rate: float = 1.0,
+                        onehot_targets: bool = False):
     """Bagged forest growth with rows sharded over the mesh's data axis.
 
     Each shard builds partial gradient/hessian/count histograms on its rows;
@@ -155,7 +156,8 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
             min_instances=jnp.float32(min_instances),
             newton_leaf=jnp.bool_(newton_leaf),
             learning_rate=jnp.float32(learning_rate),
-            all_reduce=psum)
+            all_reduce=psum,
+            bag_mode="onehot" if onehot_targets else "bagged")
         return jax.vmap(fn)(G, H, BW_s, mask_r, limit_r)
 
     fn = shard_map(

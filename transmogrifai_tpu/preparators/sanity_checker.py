@@ -121,7 +121,13 @@ class SanityChecker(BinaryEstimator):
         vmeta = features_col.vmeta or VectorMetadata(
             "features", [])
 
-        if self.mesh is not None and self.correlation_type != "spearman":
+        if (self.mesh is not None and self.correlation_type != "spearman"
+                and X.size <= (1 << 24)):
+            # mesh stats for data that is not yet past the host-BLAS
+            # threshold; above it, host-resident matrices stay on the host
+            # path below — shipping GBs to the device for a one-pass stat
+            # costs more than the stat (a genuinely multi-host deployment
+            # would feed device-resident shards instead)
             from ..parallel.sharded import colstats_corr_sharded
 
             mean_h, variance, min_h, max_h, corr = colstats_corr_sharded(
