@@ -8,9 +8,10 @@ image): wide, mostly-zero features with planted signal.  One
 parameterisation (DefaultSelectorParams.scala: NumRound=200, Eta=0.02,
 MaxDepth=10, Gamma=0.8, aucpr early stopping after 20 rounds).
 
-Prints ONE JSON line like bench.py.  ``--cpu-extrapolate`` measures the
-same fit on N-times-smaller data to derive the CPU-baseline bound used in
-``benchmarks/baselines.json`` (see that file for the method).
+Prints ONE JSON line like bench.py.  The CPU reference figures in
+``benchmarks/baselines.json`` come from running this same script at a
+subscale ``--rows`` under ``JAX_PLATFORMS=cpu`` (see
+benchmarks/BASELINE_DERIVATION.md).
 
 Usage: python examples/bench_xgb_wide.py [--rows N] [--cols D]
 """

@@ -9,11 +9,11 @@ All binary metrics are computed from one descending sort of the scores —
 the TPU-friendly replacement for Spark's `BinaryClassificationMetrics`
 thresholded RDD sweeps.  Weighted variants support the CV fold-mask design.
 
-Dispatch: metrics are O(N log N) scalar reductions, so for host-resident
-inputs under ``HOST_METRIC_MAX`` rows the numpy path runs directly — an XLA
-metric program costs 1-10 s to compile (per shape!) through a remote-compile
-tunnel for microseconds of math.  Device-resident or at-scale inputs use the
-jitted sort-based kernels.
+Dispatch: metrics are O(N log N) scalar reductions, so HOST-RESIDENT inputs
+always take the numpy path — an XLA metric program costs an upload + a
+per-shape compile (1-10 s through a remote-compile tunnel) + a fetch for
+milliseconds of math.  Device-resident inputs (the sweep's score vectors)
+use the jitted sort-based kernels so nothing is fetched per candidate.
 """
 from __future__ import annotations
 
@@ -40,14 +40,17 @@ __all__ = [
 ]
 
 #: inputs with at most this many rows take the host numpy path
-HOST_METRIC_MAX = 200_000
 
 
 def _on_host(*arrays) -> bool:
+    """Host numpy metrics for HOST-RESIDENT inputs of any size: a 1M-row
+    numpy sort is ~0.2 s, while routing host data through the device costs
+    an upload + a per-shape XLA compile + a fetch (measured ~30 s per
+    metric call at 300k through the remote tunnel).  The jitted kernels are
+    for inputs that ALREADY live on device (sweep score vectors), where the
+    fetch is the expensive side."""
     return all(a is None or isinstance(a, np.ndarray) or np.isscalar(a)
-               or isinstance(a, (list, tuple)) for a in arrays) and all(
-        a is None or np.isscalar(a) or np.size(a) <= HOST_METRIC_MAX
-        for a in arrays)
+               or isinstance(a, (list, tuple)) for a in arrays)
 
 
 def _weights(y, w):
@@ -278,8 +281,7 @@ def multiclass_threshold_metrics(y_true, proba, top_ns=(1, 3),
     if not tns or any(t <= 0 for t in tns):
         raise ValueError("top_ns must be a non-empty sequence of positive "
                          "integers")
-    on_host = _on_host(y_true, None) and not isinstance(proba, jax.Array) \
-        and np.size(proba) <= HOST_METRIC_MAX
+    on_host = _on_host(y_true, None) and not isinstance(proba, jax.Array)
     xp = np if on_host else jnp
     P = xp.asarray(proba, xp.float32 if xp is jnp else np.float64)
     y = xp.asarray(y_true, xp.int32 if xp is jnp else np.int64)

@@ -528,11 +528,23 @@ def _rf_bag_and_features(tid, seed, n: int, d: int, msub: int,
 def rf_bags_and_features(seed: int, n_trees: int, n: int, d: int, msub: int,
                          subsample_rate: float):
     """Host copies of every tree's bag weights and feature subset (the mesh
-    path shards precomputed bags; identical to the on-device generator)."""
-    BW, idx = jax.jit(jax.vmap(
+    path shards precomputed bags; same generator as the on-device path).
+
+    Generated on the CPU backend: running this on a remote accelerator
+    would round-trip the (T, N) Poisson matrix through the tunnel (~200 MB
+    at 50 trees × 1M rows) just to re-upload it sharded."""
+    try:
+        dev = jax.devices("cpu")[0]
+    except RuntimeError:  # pragma: no cover - cpu backend always exists
+        dev = None
+    gen = jax.jit(jax.vmap(
         lambda tid: _rf_bag_and_features(tid, jnp.int32(seed), n, d, msub,
-                                         jnp.float32(subsample_rate))))(
-        jnp.arange(n_trees))
+                                         jnp.float32(subsample_rate))))
+    if dev is not None:
+        with jax.default_device(dev):
+            BW, idx = gen(jnp.arange(n_trees))
+    else:
+        BW, idx = gen(jnp.arange(n_trees))
     return np.asarray(BW), np.asarray(idx)
 
 

@@ -403,9 +403,10 @@ class _RandomForestBase(PredictorEstimator):
     def _fit_sharded(self, binned, Y, base_w, msub: int):
         """Multi-chip fit: pad rows to tile the mesh's data axis (padded
         rows carry zero bag weight) and grow with psum'd histograms.
-        Bags/feature subsets come from the SAME on-device generator as the
-        single-device path (gbdt_kernels._rf_bag_and_features), so the mesh
-        grows the identical forest."""
+        Bags/feature subsets come from the SAME generator as the
+        single-device path (gbdt_kernels._rf_bag_and_features) so both grow
+        from identical randomness; split decisions can still differ at
+        rounding margins (bf16 subset histograms vs f32 full-width)."""
         from ..parallel.mesh import pad_to_multiple
         from ..parallel.sharded import grow_forest_sharded
         from .gbdt_kernels import rf_bags_and_features
