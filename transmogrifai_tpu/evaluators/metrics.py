@@ -1,4 +1,4 @@
-"""Metric kernels — host numpy below a size threshold, JAX above it.
+"""Metric kernels — numpy for host-resident inputs, JAX for device-resident.
 
 Reference: OpBinaryClassificationEvaluator (AuROC, AuPR, precision/recall/F1,
 Brier, threshold metrics — core/.../evaluators/OpBinaryClassificationEvaluator.scala:56,192-223),
@@ -38,8 +38,6 @@ __all__ = [
     "multiclass_threshold_metrics",
     "regression_metrics", "forecast_metrics", "threshold_curves",
 ]
-
-#: inputs with at most this many rows take the host numpy path
 
 
 def _on_host(*arrays) -> bool:

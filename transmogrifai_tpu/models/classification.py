@@ -49,6 +49,20 @@ def _device_standardize(X, mu, sigma):
 
 
 @jax.jit
+def _device_standardize_stats(X, w=None):
+    """Weighted column mean/std on device, matching ``_standardize_stats``
+    (sigma floored to 1.0 below 1e-12)."""
+    if w is None:
+        mu = X.mean(axis=0)
+        sigma = X.std(axis=0)
+    else:
+        ws = jnp.maximum(w.sum(), 1e-12)
+        mu = (w[:, None] * X).sum(axis=0) / ws
+        sigma = jnp.sqrt((w[:, None] * (X - mu) ** 2).sum(axis=0) / ws)
+    return mu, jnp.where(sigma < 1e-12, 1.0, sigma)
+
+
+@jax.jit
 def _device_std_sigmoid_score(X, mu, sigma, coef, intercept):
     return jax.nn.sigmoid(((X - mu) / sigma) @ coef + intercept)
 
@@ -100,30 +114,59 @@ class OpLogisticRegression(PredictorEstimator):
             return None
         from .trees import _dev_memo
 
-        mu, sigma = (_standardize_stats(X, w) if self.standardization
-                     else (None, None))
-        X_dev = _dev_memo(np.asarray(X, np.float32), "lr_X")
-        Xs = (_device_standardize(X_dev, jnp.asarray(mu), jnp.asarray(sigma))
-              if mu is not None else X_dev)
-        fit = fit_logistic_regression(
-            Xs, y, sample_weight=w, reg_param=self.reg_param,
-            elastic_net_param=self.elastic_net_param,
-            max_iter=self.max_iter, tol=self.tol,
-            fit_intercept=self.fit_intercept)
+        fit, mu, sigma = self._fit_binary_on_device(X, y, w)
 
         def score(Xe):
             Xe_dev = _dev_memo(np.asarray(Xe, np.float32), "lr_X")
             if mu is None:
                 return _device_sigmoid_score(Xe_dev, fit.coef, fit.intercept)
             return _device_std_sigmoid_score(
-                Xe_dev, jnp.asarray(mu), jnp.asarray(sigma), fit.coef,
-                fit.intercept)
+                Xe_dev, mu, sigma, fit.coef, fit.intercept)
         return score
+
+    #: past this element count the refit standardizes + fits on device from
+    #: the (memoized) uploaded matrix — host mean/std/copy passes over a
+    #: multi-GB matrix cost tens of seconds on a 1-core host
+    _DEVICE_FIT_ELEMS = 1 << 24
+
+    def _fit_binary_on_device(self, X, y, w):
+        """Memoized upload + device standardization stats + IRLS fit —
+        the ONE binary device-fit path shared by the CV sweep
+        (``fit_device``) and the big-matrix refit, so the two cannot
+        diverge.  Stats on DEVICE: a host mean/std pass over a 2 GB matrix
+        costs ~17 s per candidate on a 1-core host; on device it is two
+        fused reductions over the already-resident matrix."""
+        from .trees import _dev_memo
+
+        X_dev = _dev_memo(np.asarray(X, np.float32), "lr_X")
+        if self.standardization:
+            mu, sigma = _device_standardize_stats(
+                X_dev, None if w is None else jnp.asarray(w, jnp.float32))
+            Xs = _device_standardize(X_dev, mu, sigma)
+        else:
+            mu = sigma = None
+            Xs = X_dev
+        fit = fit_logistic_regression(
+            Xs, y, sample_weight=w, reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param,
+            max_iter=self.max_iter, tol=self.tol,
+            fit_intercept=self.fit_intercept)
+        return fit, mu, sigma
 
     def fit_raw(self, X: np.ndarray, y: np.ndarray,
                 w: Optional[np.ndarray] = None):
         classes = np.unique(y[~np.isnan(y)]).astype(int)
         n_classes = max(int(classes.max()) + 1 if len(classes) else 2, 2)
+        if (n_classes <= 2 and self.mesh is None
+                and np.size(X) > self._DEVICE_FIT_ELEMS):
+            fit, mu_d, sigma_d = self._fit_binary_on_device(X, y, w)
+            mu = None if mu_d is None else np.asarray(mu_d)
+            sigma = None if sigma_d is None else np.asarray(sigma_d)
+            coef, intercept = _unstandardize(
+                np.asarray(fit.coef), float(np.asarray(fit.intercept)),
+                mu, sigma)
+            return LogisticRegressionModel(
+                coef=coef.tolist(), intercept=float(intercept))
         mu, sigma = _standardize_stats(X, w) if self.standardization else (None, None)
         Xs = _apply_standardize(X, mu, sigma)
         if n_classes <= 2:
