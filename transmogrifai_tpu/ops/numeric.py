@@ -263,8 +263,13 @@ class ScalerTransformer(UnaryTransformer):
         self.metadata["scaler"] = {"type": self.scaling_type,
                                    "slope": self.slope,
                                    "intercept": self.intercept}
-        return FeatureColumn(Real, fwd(vals, self.slope, self.intercept),
-                             col.mask)
+        with np.errstate(over="ignore", invalid="ignore", divide="ignore"):
+            out = fwd(vals, self.slope, self.intercept)
+        # non-finite results (e.g. log of a non-positive value) fold into the
+        # mask — downstream vectorizers rely on NaN-implies-masked
+        mask = (np.isfinite(out) if col.mask is None
+                else np.asarray(col.mask) & np.isfinite(out))
+        return FeatureColumn(Real, out, mask)
 
 
 class DescalerTransformer(BinaryModel):
@@ -281,8 +286,12 @@ class DescalerTransformer(BinaryModel):
     def transform_columns(self, col: FeatureColumn, *_rest) -> FeatureColumn:
         _, inv = _SCALERS[self.scaling_type]
         vals = np.asarray(col.values, np.float64)
-        return FeatureColumn(Real, inv(vals, self.slope, self.intercept),
-                             col.mask)
+        with np.errstate(over="ignore", invalid="ignore", divide="ignore"):
+            out = inv(vals, self.slope, self.intercept)
+        # e.g. exp-descale overflow or slope=0 division: mask, don't emit inf
+        mask = (np.isfinite(out) if col.mask is None
+                else np.asarray(col.mask) & np.isfinite(out))
+        return FeatureColumn(Real, out, mask)
 
     input_arity = (1, 2)
 

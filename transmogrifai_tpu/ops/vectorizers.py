@@ -90,22 +90,47 @@ class RealVectorizerModel(SequenceModel):
     def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
         n = len(cols[0])
         width = len(cols) * (2 if self.track_nulls else 1)
-        # fill a preallocated matrix: np.stack of many 1M-row columns copies
-        # the batch twice (measured ~10 s/GB at the 1M-row bench)
+        # Build through a small TRANSPOSED group buffer: writing column j of
+        # a C-order (n, width) matrix directly strides `width` floats per
+        # element — 500 wide columns at 1M rows turn into all-cache-miss
+        # writes (measured 67 s host time at the 1M×500 bench).  Contiguous
+        # buffer-row writes + grouped transpose flushes (destination runs of
+        # GROUP floats per row) are ~10x faster, and the buffer bounds the
+        # extra peak memory to ~128 MB instead of a full second matrix.
         out = np.empty((n, width), dtype=np.float32)
+        group = int(np.clip((128 << 20) // max(n * 4, 1), 1, width))
+        buf = np.empty((group, n), dtype=np.float32)
         meta = []
         j = 0
-        for f, fill, c in zip(self.input_features, self.fills, cols):
-            vals = np.nan_to_num(np.asarray(c.values, dtype=np.float32))
-            m = np.asarray(c.mask)
-            np.copyto(out[:, j], np.where(m, vals, np.float32(fill)))
-            meta.append(VectorColumnMetadata(f.name, f.ftype.type_name()))
+        flushed = 0
+
+        def flush(upto):
+            nonlocal flushed
+            if upto > flushed:
+                out[:, flushed:upto] = buf[: upto - flushed].T
+                flushed = upto
+
+        def put(row_vals):
+            nonlocal j
+            if j - flushed == group:
+                flush(j)
+            np.copyto(buf[j - flushed], row_vals)
             j += 1
+
+        for f, fill, c in zip(self.input_features, self.fills, cols):
+            vals = np.asarray(c.values, dtype=np.float32)
+            m = np.asarray(c.mask)
+            row = np.where(m, vals, np.float32(fill))
+            # clamp non-finite survivors (producers that don't fold isfinite
+            # into the mask, or float32-cast overflow): NaN -> 0, inf -> max
+            np.nan_to_num(row, copy=False)
+            put(row)
+            meta.append(VectorColumnMetadata(f.name, f.ftype.type_name()))
             if self.track_nulls:
-                np.copyto(out[:, j], ~m)
+                put(~m)
                 meta.append(VectorColumnMetadata(
                     f.name, f.ftype.type_name(), indicator_value=NULL_INDICATOR))
-                j += 1
+        flush(j)
         return _vec_column(out, VectorMetadata(self.get_output().name if self._output_feature else "real_vec", meta))
 
 
