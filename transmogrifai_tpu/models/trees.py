@@ -613,6 +613,10 @@ class _GBTBase(PredictorEstimator):
         # chunk, so best_len (and the truncated model) is unchanged — at
         # most chunk-1 extra rounds of compute are grown then discarded
         es_chunk = max(1, min(8, self.early_stopping_rounds))
+        # hoisted: re-uploading the index vector every round is a per-round
+        # transfer the chunked sync is meant to remove
+        vi_dev = (jnp.asarray(val_idx, jnp.int32)
+                  if use_es and len(val_idx) else None)
         pending: list = []
         stop = False
         for it in range(self.max_iter):
@@ -651,7 +655,7 @@ class _GBTBase(PredictorEstimator):
             leaves.append(lf)
             if use_es and len(val_idx):
                 pending.append((len(feats),
-                                self._eval_metric_dev(F, yj, val_idx)))
+                                self._eval_metric_dev(F, yj, vi_dev)))
                 if len(pending) >= es_chunk or it == self.max_iter - 1:
                     vals = np.asarray(jnp.stack([m for _, m in pending]))
                     for (n_at, _), m in zip(pending, vals):
@@ -680,7 +684,8 @@ class _GBTBase(PredictorEstimator):
         """Early-stopping metric as a device scalar (sync is the caller's)."""
         from ..evaluators.metrics import _aupr_dev
 
-        vi = jnp.asarray(val_idx, jnp.int32)
+        vi = (val_idx if isinstance(val_idx, jax.Array)
+              else jnp.asarray(val_idx, jnp.int32))
         if self._objective == "binary":
             return _aupr_dev(yj[vi], jax.nn.sigmoid(F[vi, 0]))
         if self._objective == "multiclass":
