@@ -30,6 +30,18 @@ class TestModelBackCompat:
         got = np.asarray(scored[pred_name].values.probability[:, 1])
         np.testing.assert_allclose(got, expected, atol=1e-5)
 
+    def test_v2_artifact_loads_and_reproduces_scores(self):
+        # v2 era: MLP candidate in the sweep + SelectedModelCombiner
+        # (weighted two-selector ensemble) — format changes must keep
+        # loading both generations of artifacts
+        model = OpWorkflowModel.load(os.path.join(FIXTURES, "model_v2"))
+        df = pd.read_csv(os.path.join(FIXTURES, "model_v2_input.csv"))
+        expected = np.load(os.path.join(FIXTURES, "model_v2_expected.npy"))
+        pred_name = model.result_features[0].name
+        scored = model.score(df)
+        got = np.asarray(scored[pred_name].values.probability[:, 1])
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
     def test_v1_artifact_scores_locally(self):
         model = load_model_local(os.path.join(FIXTURES, "model_v1"))
         df = pd.read_csv(os.path.join(FIXTURES, "model_v1_input.csv"))
