@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Titanic from AVRO with a two-selector ensemble.
+
+Demonstrates the reference's canonical ingestion format plus
+``SelectedModelCombiner`` (SelectedModelCombiner.scala): the training data
+comes straight from ``PassengerDataAll.avro`` (read by the in-tree Avro OCF
+codec — readers/avro.py), a linear selector and a tree selector each pick
+their best candidate, and the combiner blends the two predictions weighted
+by their validation AuPR.
+
+Run: python examples/op_titanic_avro_combined.py [path/to/data.avro]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+DEFAULT_AVRO = "/root/reference/test-data/PassengerDataAll.avro"
+
+
+def build(avro_path: str = DEFAULT_AVRO):
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpMultilayerPerceptronClassifier,
+        OpRandomForestClassifier,
+    )
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.readers import AvroReader
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, SelectedModelCombiner, grid,
+    )
+
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    predictors = [
+        FeatureBuilder.PickList("Pclass").as_predictor(),
+        FeatureBuilder.PickList("Sex").as_predictor(),
+        FeatureBuilder.Real("Age").as_predictor(),
+        FeatureBuilder.Integral("SibSp").as_predictor(),
+        FeatureBuilder.Integral("Parch").as_predictor(),
+        FeatureBuilder.Real("Fare").as_predictor(),
+        FeatureBuilder.PickList("Embarked").as_predictor(),
+    ]
+    features = transmogrify(predictors)
+    checked = SanityChecker(remove_bad_features=True).set_input(
+        survived, features).get_output()
+
+    linear = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
+            (OpMultilayerPerceptronClassifier(max_iter=200, step_size=0.1),
+             grid(hidden_layers=[[8]])),
+        ]).set_input(survived, checked)
+    trees = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpRandomForestClassifier(),
+             grid(num_trees=[50], max_depth=[6, 12],
+                  min_info_gain=[0.001])),
+        ]).set_input(survived, checked)
+
+    combined = SelectedModelCombiner(
+        combination_strategy="weighted").set_input(
+        survived, linear.get_output(), trees.get_output()).get_output()
+
+    wf = (OpWorkflow()
+          .set_result_features(combined, linear.get_output(),
+                               trees.get_output())
+          .set_reader(AvroReader(avro_path)))
+    return wf, combined, linear.get_output(), trees.get_output()
+
+
+def main(argv=None):
+    import numpy as np
+
+    from transmogrifai_tpu.evaluators import Evaluators
+
+    argv = argv if argv is not None else sys.argv[1:]
+    wf, combined, p_lin, p_tree = build(argv[0] if argv else DEFAULT_AVRO)
+    model = wf.train()
+
+    stage = next(s for s in model.stages
+                 if s.metadata.get("combiner"))
+    info = stage.metadata["combiner"]
+    print(f"weights: linear={info['weight1']:.3f} "
+          f"trees={info['weight2']:.3f} "
+          f"(validation {info['metricName']}: "
+          f"{info['metricValue1']:.4f} vs {info['metricValue2']:.4f})")
+
+    scored = model.score()
+    from transmogrifai_tpu.evaluators.metrics import aupr
+    y = np.nan_to_num(np.asarray(scored["Survived"].values, np.float64))
+    for name, feat in [("linear", p_lin), ("trees", p_tree),
+                       ("combined", combined)]:
+        batch = scored[feat.name].values
+        print(f"{name:>9} train AuPR: "
+              f"{aupr(y, np.asarray(batch.probability)[:, 1]):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,7 @@ with its expected scores frozen beside it.
 import os
 
 import numpy as np
+import pytest
 import pandas as pd
 
 from transmogrifai_tpu.local import load_model_local, score_function
@@ -21,22 +22,16 @@ FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
 
 class TestModelBackCompat:
-    def test_v1_artifact_loads_and_reproduces_scores(self):
-        model = OpWorkflowModel.load(os.path.join(FIXTURES, "model_v1"))
-        df = pd.read_csv(os.path.join(FIXTURES, "model_v1_input.csv"))
-        expected = np.load(os.path.join(FIXTURES, "model_v1_expected.npy"))
-        pred_name = model.result_features[0].name
-        scored = model.score(df)
-        got = np.asarray(scored[pred_name].values.probability[:, 1])
-        np.testing.assert_allclose(got, expected, atol=1e-5)
-
-    def test_v2_artifact_loads_and_reproduces_scores(self):
-        # v2 era: MLP candidate in the sweep + SelectedModelCombiner
-        # (weighted two-selector ensemble) — format changes must keep
-        # loading both generations of artifacts
-        model = OpWorkflowModel.load(os.path.join(FIXTURES, "model_v2"))
-        df = pd.read_csv(os.path.join(FIXTURES, "model_v2_input.csv"))
-        expected = np.load(os.path.join(FIXTURES, "model_v2_expected.npy"))
+    # v1: transmogrify + SanityChecker + selected model.
+    # v2 era adds an MLP candidate in the sweep + SelectedModelCombiner
+    # (weighted two-selector ensemble) — format changes must keep loading
+    # every committed artifact generation.
+    @pytest.mark.parametrize("gen", ["v1", "v2"])
+    def test_artifact_loads_and_reproduces_scores(self, gen):
+        model = OpWorkflowModel.load(os.path.join(FIXTURES, f"model_{gen}"))
+        df = pd.read_csv(os.path.join(FIXTURES, f"model_{gen}_input.csv"))
+        expected = np.load(
+            os.path.join(FIXTURES, f"model_{gen}_expected.npy"))
         pred_name = model.result_features[0].name
         scored = model.score(df)
         got = np.asarray(scored[pred_name].values.probability[:, 1])
