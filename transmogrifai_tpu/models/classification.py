@@ -112,12 +112,12 @@ class OpLogisticRegression(PredictorEstimator):
         a device elementwise op, not a fresh host matrix + upload."""
         if problem_type != "binary" or (len(y) and np.nanmax(y) > 1):
             return None
-        from .trees import _dev_memo
+        from .trees import _dev_f32
 
         fit, mu, sigma = self._fit_binary_on_device(X, y, w)
 
         def score(Xe):
-            Xe_dev = _dev_memo(np.asarray(Xe, np.float32), "lr_X")
+            Xe_dev = _dev_f32(Xe)
             if mu is None:
                 return _device_sigmoid_score(Xe_dev, fit.coef, fit.intercept)
             return _device_std_sigmoid_score(
@@ -136,9 +136,9 @@ class OpLogisticRegression(PredictorEstimator):
         diverge.  Stats on DEVICE: a host mean/std pass over a 2 GB matrix
         costs ~17 s per candidate on a 1-core host; on device it is two
         fused reductions over the already-resident matrix."""
-        from .trees import _dev_memo
+        from .trees import _dev_f32
 
-        X_dev = _dev_memo(np.asarray(X, np.float32), "lr_X")
+        X_dev = _dev_f32(X)
         if self.standardization:
             mu, sigma = _device_standardize_stats(
                 X_dev, None if w is None else jnp.asarray(w, jnp.float32))

@@ -62,11 +62,11 @@ class OpLinearRegression(PredictorEstimator):
         if problem_type != "regression":
             return None
         from .classification import _device_standardize
-        from .trees import _dev_memo
+        from .trees import _dev_f32
 
         mu, sigma = (_standardize_stats(X, w) if self.standardization
                      else (None, None))
-        X_dev = _dev_memo(np.asarray(X, np.float32), "lin_X")
+        X_dev = _dev_f32(X)
         Xs = (_device_standardize(X_dev, jnp.asarray(mu), jnp.asarray(sigma))
               if mu is not None else X_dev)
         fit = fit_linear_regression(
@@ -75,7 +75,7 @@ class OpLinearRegression(PredictorEstimator):
             tol=self.tol, fit_intercept=self.fit_intercept)
 
         def score(Xe):
-            Xe_dev = _dev_memo(np.asarray(Xe, np.float32), "lin_X")
+            Xe_dev = _dev_f32(Xe)
             Xes = (_device_standardize(Xe_dev, jnp.asarray(mu),
                                        jnp.asarray(sigma))
                    if mu is not None else Xe_dev)

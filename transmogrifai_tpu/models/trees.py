@@ -162,6 +162,15 @@ def clear_sweep_caches() -> None:
     """Release the sweep memos' device buffers (end-of-train housekeeping)."""
     _BIN_CACHE.clear()
     _HASH_BY_ID.clear()
+    _CONTIG_BY_ID.clear()
+
+
+def _memo_peek(key):
+    """Memo probe without building (None on miss)."""
+    hit = _BIN_CACHE.get(key)
+    if hit is not None:
+        _BIN_CACHE.move_to_end(key)
+    return hit
 
 
 def _memo(key, build):
@@ -245,18 +254,66 @@ def _content_hash(a: np.ndarray) -> str:
     return f"{h}-{_sample_digest(a)}"
 
 
+_CONTIG_BY_ID: dict = {}
+
+
+def _view_digest(Xf: np.ndarray) -> str:
+    """Cheap mutation guard for a possibly-strided array: strided row sample
+    + both ends, no full reshape (reshape(-1) of a non-contiguous matrix
+    would copy the whole thing)."""
+    if Xf.ndim == 0 or Xf.size == 0:
+        return hashlib.md5(Xf.tobytes()).hexdigest()[:16]
+    step = max(1, Xf.shape[0] // 256)
+    parts = (np.ascontiguousarray(Xf[::step]).tobytes()
+             + np.ascontiguousarray(Xf[:1]).tobytes()
+             + np.ascontiguousarray(Xf[-1:]).tobytes())
+    return hashlib.md5(parts).hexdigest()[:16]
+
+
 def _as_f32(X) -> np.ndarray:
     """float32 C-contiguous view; returns X itself when already so (keeps
-    object identity stable for the per-object hash cache)."""
+    object identity stable for the per-object hash cache).
+
+    A non-contiguous input (e.g. the SanityChecker's column-filtered matrix)
+    is copied ONCE per object and memoized — the selector sweep probes with
+    the same matrix for every candidate, and re-copying a GB-scale matrix
+    per probe measured ~17 s of a 200k-row sweep.  A sampled digest guards
+    the cache against in-place mutation of the source."""
     Xf = np.asarray(X, np.float32)
-    return Xf if Xf.flags.c_contiguous else np.ascontiguousarray(Xf)
+    if Xf.flags.c_contiguous:
+        return Xf
+    k = id(X)
+    digest = _view_digest(Xf)
+    hit = _CONTIG_BY_ID.get(k)
+    if hit is not None and hit[0] == digest:
+        return hit[1]
+    Xc = np.ascontiguousarray(Xf)
+    _CONTIG_BY_ID[k] = (digest, Xc)
+    try:
+        import weakref
+        weakref.finalize(X, _CONTIG_BY_ID.pop, k, None)
+    except TypeError:  # pragma: no cover - non-weakrefable input
+        _CONTIG_BY_ID.pop(k, None)
+    return Xc
 
 
 def _dev_memo(arr, tag: str = "up"):
     """Upload a host array once per distinct content."""
-    a = np.ascontiguousarray(np.asarray(arr))
+    a = np.asarray(arr)
+    if not a.flags.c_contiguous:
+        a = _as_f32(arr) if a.dtype == np.float32 else np.ascontiguousarray(a)
     key = (tag, _content_hash(a), a.shape, str(a.dtype))
     return _memo(key, lambda: jnp.asarray(a))
+
+
+def _dev_f32(X, tag: str = "X_f32"):
+    """THE shared f32 device upload of a host matrix.
+
+    Every consumer of the full-precision matrix (linear-model fits, device
+    standardization stats, on-device quantile binning, SanityChecker-scale
+    stats) goes through this one memo, so a selector sweep uploads the
+    2 GB-scale matrix across the tunnel exactly once per train."""
+    return _dev_memo(_as_f32(X), tag)
 
 
 def _dev_memo_sharded(arr, sharding, tag: str = "up"):
@@ -270,14 +327,35 @@ def _dev_memo_sharded(arr, sharding, tag: str = "up"):
     return _memo(key, lambda: jax.device_put(a, sharding))
 
 
+@jax.jit
+def _apply_bins_i8(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """On-device quantization to int8 (B <= 127), for when the f32 matrix is
+    already device-resident: skips the host binning pass AND the int8 upload."""
+    return jnp.sum(X[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int8)
+
+
 def _binned_for_edges(X, edges):
-    """Device-binned matrix for given edges (scoring path)."""
+    """Device-binned matrix for given edges (scoring path).
+
+    Shares one memo entry with the fit path (``_prep_tree_inputs``), keyed by
+    (matrix, edges) content — scoring the training matrix re-binned it from
+    scratch before (measured 2x the whole binning cost per sweep)."""
     Xf = _as_f32(X)
+    return _binned_cached(Xf, _content_hash(Xf), edges)
+
+
+def _binned_cached(Xf: np.ndarray, hx: str, edges):
     ef = np.ascontiguousarray(np.asarray(edges, np.float32))
-    key = ("score", _content_hash(Xf), _content_hash(ef), Xf.shape)
+    key = ("bins", hx, _content_hash(ef), Xf.shape)
 
     def build():
-        if Xf.size > _HOST_BIN_ELEMS and ef.shape[1] < 127:
+        big = Xf.size > _HOST_BIN_ELEMS and ef.shape[1] < 127
+        if big:
+            # reuse the sweep's shared f32 upload when present: device
+            # binning is one launch vs a ~10 s/1M-row host pass + upload
+            xdev = _memo_peek(("X_f32", hx, Xf.shape, "float32"))
+            if xdev is not None:
+                return _apply_bins_i8(xdev, jnp.asarray(ef))
             return jnp.asarray(_host_bins(Xf, ef))
         return apply_bins(jnp.asarray(Xf), jnp.asarray(ef))
     return _memo(key, build)
@@ -308,17 +386,13 @@ def _host_bins(Xf: np.ndarray, edges: np.ndarray) -> np.ndarray:
 
 
 def _prep_tree_inputs(X, max_bins):
-    """Quantile-sketch + binning (fit path); big inputs bin on host."""
+    """Quantile-sketch + binning (fit path); shares the binned-matrix memo
+    with the scoring path (same (matrix, edges) key)."""
     Xf = _as_f32(X)
-    key = ("fit", _content_hash(Xf), Xf.shape, max_bins)
-
-    def build():
-        edges = quantile_bins(Xf, max_bins)
-        if Xf.size > _HOST_BIN_ELEMS and max_bins <= 127:
-            return edges, jnp.asarray(_host_bins(Xf, edges))
-        return edges, apply_bins(jnp.asarray(Xf),
-                                 jnp.asarray(edges, jnp.float32))
-    return _memo(key, build)
+    hx = _content_hash(Xf)
+    edges = _memo(("edges", hx, Xf.shape, max_bins),
+                  lambda: quantile_bins(Xf, max_bins))
+    return edges, _binned_cached(Xf, hx, edges)
 
 
 def _feature_subset_size(strategy: str, d: int, is_classification: bool) -> int:

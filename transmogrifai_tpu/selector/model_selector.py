@@ -323,9 +323,36 @@ class ModelSelector(PredictorEstimator):
 
     # -- fit -----------------------------------------------------------------
 
+    def _grid_has_linear(self) -> bool:
+        """True when a candidate will consume the full-precision device
+        matrix (the binary-LR / linear-regression device fit paths)."""
+        from ..models.classification import OpLogisticRegression
+        from ..models.regression import OpLinearRegression
+
+        if self.problem_type == "binary":
+            return any(isinstance(p, OpLogisticRegression)
+                       for p, _ in self.models_and_params)
+        if self.problem_type == "regression":
+            return any(isinstance(p, OpLinearRegression)
+                       for p, _ in self.models_and_params)
+        return False
+
+    def _prepare_matrix(self, values) -> np.ndarray:
+        """One C-contiguous f32 matrix for the whole sweep (every candidate
+        probes the upload/binning memos with this same object), plus the
+        shared f32 device upload up front when a linear-family candidate
+        will need full precision anyway — tree candidates then quantize
+        on device from it instead of a host binning pass."""
+        from ..models.trees import _as_f32, _dev_f32
+
+        X = _as_f32(np.asarray(values))
+        if self.mesh is None and self._grid_has_linear() and X.size > (1 << 24):
+            _dev_f32(X)
+        return X
+
     def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
                     features_col: FeatureColumn):
-        X = np.asarray(features_col.values, dtype=np.float32)
+        X = self._prepare_matrix(features_col.values)
         y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
         n = len(y)
         self._capture_class_space(y)
@@ -359,9 +386,13 @@ class ModelSelector(PredictorEstimator):
                 best_est.with_mesh(self.mesh)
             best_model = best_est.fit_raw(X, y, base_w)
 
-        train_metrics = self._full_metrics(best_model, X, y, train_mask)
+        # ONE batched predict over the full matrix (hits the sweep's binning
+        # and upload memos) — slicing rows first would re-bin and re-upload
+        # a fresh holdout matrix per metric set
+        full_batch = best_model.predict_batch(X)
+        train_metrics = self._full_metrics(full_batch, y, train_mask)
         holdout_metrics = (
-            self._full_metrics(best_model, X, y, ~train_mask)
+            self._full_metrics(full_batch, y, ~train_mask)
             if len(holdout_idx) else {})
 
         summary = ModelSelectorSummary(
@@ -377,25 +408,25 @@ class ModelSelector(PredictorEstimator):
                                  best_params=best_params)
         return selected
 
-    def _full_metrics(self, model: PredictorModel, X, y,
+    def _full_metrics(self, full_batch: PredictionBatch, y,
                       mask: np.ndarray) -> Dict[str, float]:
+        """Metrics over the masked rows of a full-matrix prediction batch."""
         idx = np.where(mask)[0]
         if not len(idx):
             return {}
-        batch = model.predict_batch(X[idx])
         yy = y[idx]
         if self.problem_type == "binary":
-            score = (np.asarray(batch.probability)[:, 1]
-                     if batch.probability is not None
-                     else np.asarray(batch.prediction))
+            score = (np.asarray(full_batch.probability)[idx, 1]
+                     if full_batch.probability is not None
+                     else np.asarray(full_batch.prediction)[idx])
             return binary_classification_metrics(yy, score)
         if self.problem_type == "multiclass":
-            pred = np.asarray(batch.prediction).astype(int)
+            pred = np.asarray(full_batch.prediction)[idx].astype(int)
             n_classes = self._class_count(yy, pred)
             out = multiclass_metrics(yy.astype(int), pred, n_classes)
             out.pop("confusion", None)
             return out
-        return regression_metrics(yy, np.asarray(batch.prediction))
+        return regression_metrics(yy, np.asarray(full_batch.prediction)[idx])
 
 
 class SelectedModel(PredictorModel):

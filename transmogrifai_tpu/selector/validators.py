@@ -109,8 +109,10 @@ class _ValidatorBase:
         eval_ds = data.take(ev_idx)
         _, train_t, eval_t = fit_and_transform_dag(
             during_dag, train_ds, apply_to=eval_ds)
-        X_tr = np.asarray(train_t[features_name].values, dtype=np.float32)
-        X_ev = np.asarray(eval_t[features_name].values, dtype=np.float32)
+        X_tr = np.ascontiguousarray(
+            np.asarray(train_t[features_name].values, dtype=np.float32))
+        X_ev = np.ascontiguousarray(
+            np.asarray(eval_t[features_name].values, dtype=np.float32))
         y_tr = np.nan_to_num(
             np.asarray(train_t[label_name].values, dtype=np.float32))
         y_ev = np.nan_to_num(
