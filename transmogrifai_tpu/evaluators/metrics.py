@@ -150,6 +150,50 @@ def _aupr_dev(y_true, y_score, sample_weight=None) -> jnp.ndarray:
     return jnp.clip(jnp.sum(dr * precision), 0.0, 1.0)
 
 
+def binary_metric_grid(y_true, scores, weights, metric: str):
+    """Batched device metric for a validation sweep: ``scores`` (F, C, N)
+    per-(fold, candidate) score rows and ``weights`` (F, N) per-fold eval
+    weights (broadcast over candidates — never replicated) against one
+    shared label vector -> (F, C) device metric values, or None when
+    ``metric`` has no device kernel (callers fall back to per-candidate
+    host metrics)."""
+    fn = {"AuPR": _aupr_dev, "AuROC": _auroc_dev}.get(metric)
+    if fn is None:
+        return None
+    y = jnp.asarray(y_true, jnp.float32)
+    return jax.vmap(lambda s_f, w_f:
+                    jax.vmap(lambda s: fn(y, s, w_f))(s_f))(scores, weights)
+
+
+def _regression_metric_dev(y, p, w, metric: str):
+    """THE weighted regression metric kernel — shared by the sequential
+    sweep path (ModelSelector._metric_device) and the batched grid."""
+    ws = jnp.maximum(w.sum(), 1e-12)
+    err = p - y
+    if metric == "MeanAbsoluteError":
+        return (w * jnp.abs(err)).sum() / ws
+    mse = (w * err ** 2).sum() / ws
+    if metric == "MeanSquaredError":
+        return mse
+    if metric == "RootMeanSquaredError":
+        return jnp.sqrt(mse)
+    mean = (w * y).sum() / ws
+    var = (w * (y - mean) ** 2).sum() / ws
+    return 1.0 - mse / jnp.maximum(var, 1e-12)
+
+
+def regression_metric_grid(y_true, preds, weights, metric: str):
+    """Batched device regression metric: (F, C, N) predictions + (F, N)
+    weights -> (F, C) device values; None when unsupported."""
+    if metric not in ("RootMeanSquaredError", "MeanSquaredError",
+                     "MeanAbsoluteError", "R2"):
+        return None
+    y = jnp.asarray(y_true, jnp.float32)
+    return jax.vmap(lambda p_f, w_f: jax.vmap(
+        lambda p: _regression_metric_dev(y, p, w_f, metric))(p_f))(
+            preds, weights)
+
+
 def binary_metrics_at_threshold(y_true, y_score, threshold=0.5,
                                 sample_weight=None):
     if _on_host(y_true, y_score, sample_weight):

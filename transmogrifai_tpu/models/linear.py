@@ -159,6 +159,137 @@ def logreg_predict_proba(coef, intercept, X):
 
 
 # ---------------------------------------------------------------------------
+# Grid-batched binary logistic regression — the WHOLE folds x candidates
+# sweep as one XLA program (SURVEY §2.12 row 2's concurrency axis)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "standardization"))
+def fit_logreg_grid(
+    X: jnp.ndarray,          # (N, D) shared matrix
+    y: jnp.ndarray,          # (N,)
+    W_tr: jnp.ndarray,       # (F, N) per-fold training weights
+    regs: jnp.ndarray,       # (C,) regParam per candidate
+    alphas: jnp.ndarray,     # (C,) elasticNetParam per candidate
+    max_iter: int = 50,
+    tol: float = 1e-5,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Every (fold, candidate) binary-LR fit in ONE launch.
+
+    Returns ``(scores, iters)`` where ``scores`` is the (F, C, N) sigmoid
+    score matrix over ALL rows (validators mask train/eval via weights).
+
+    Solver: proximal majorization with Nesterov momentum.  The logistic
+    Hessian obeys X'diag(w p(1-p))X <= X'diag(w)X / 4 (Böhning-Lindsay), so
+    one weighted Gram per FOLD — computed once, shared by every candidate —
+    yields a fixed majorizing metric; each iteration is then two (N, D)
+    matvecs batched over the whole grid instead of a fresh (D, N)@(N, D)
+    Hessian per candidate per iteration (the Newton-IRLS cost that made
+    per-candidate fits the sweep's dominant term).  Monotone convergence to
+    the same optimum as Newton-IRLS; the winning candidate's final refit
+    still uses ``fit_logistic_regression``.  Standardization is folded in
+    algebraically (mean/scale corrections on the Gram and gradient), so the
+    standardized matrix is never materialized per fold.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    n, d = X.shape
+    F = W_tr.shape[0]
+    C = regs.shape[0]
+    wsum = jnp.maximum(W_tr.sum(axis=1), 1.0)              # (F,)
+    l2 = regs[None, :] * (1.0 - alphas[None, :])           # (F->, C)
+    l1 = regs[None, :] * alphas[None, :]
+
+    # per-fold moments and weighted Gram (the one O(N D^2) cost, F launches'
+    # worth inside this program)
+    mu = (W_tr @ X) / wsum[:, None]                        # (F, D)
+    if standardization:
+        ex2 = (W_tr @ (X * X)) / wsum[:, None]
+        sig = jnp.sqrt(jnp.maximum(ex2 - mu ** 2, 0.0))
+        sig = jnp.where(sig < 1e-12, 1.0, sig)
+    else:
+        sig = jnp.ones((F, d), X.dtype)
+    cen = mu if fit_intercept else jnp.zeros_like(mu)
+
+    def fold_gram(w_f):
+        # lax.map, not vmap: a batched Gram would materialize the (F, N, D)
+        # weighted matrices at once.  HIGH precision (bf16_3x, ~f32 quality):
+        # DEFAULT on this stack runs batched f32 gemms in single-pass bf16,
+        # whose ~3e-3 noise would corrupt the majorizing metric
+        return jax.lax.dot((X * w_f[:, None]).T, X,
+                           precision=jax.lax.Precision.HIGH,
+                           preferred_element_type=jnp.float32)
+    Q = lax.map(fold_gram, W_tr) / wsum[:, None, None]     # (F, D, D)
+    # standardized covariance Gram: S^-1 (Q - mu mu') S^-1 (centered only
+    # when fitting an intercept)
+    Qs = Q - (cen[:, :, None] * cen[:, None, :])
+    Qs = Qs / (sig[:, :, None] * sig[:, None, :])
+
+    # fixed majorizer per (f, c): Qs/4 + l2 I — inverted ONCE: the per-
+    # iteration solve is then a TPU-friendly matvec (a triangular solve in
+    # the loop is latency-bound: ~200 sequential substitution steps each)
+    eye = jnp.eye(d, dtype=X.dtype)
+    # damping relative to the standardized diag (0.25): reg_param=0 over
+    # pivoted one-hot blocks makes Qs exactly singular, and an absolute
+    # 1e-7 jitter is below f32 resolution there (_damped_solve rationale)
+    H = (Qs[:, None] / 4.0
+         + (l2[:, :, None, None] + 2.5e-6) * eye[None, None])  # (F, C, D, D)
+    H_inv = jax.vmap(jax.vmap(jnp.linalg.inv))(H)
+
+    def z_of(b, b0, precision=jax.lax.Precision.DEFAULT):
+        """(F, C, N) standardized-space logits against the RAW matrix:
+        Xs@b + b0 = X@(b/sig) - cen@(b/sig) + b0.  In-loop calls run at
+        DEFAULT (bf16) — the gradient tolerates it and it is the per-
+        iteration cost — while the final scoring pass runs at HIGH."""
+        u = b / sig[:, None, :]
+        z = jnp.einsum("nd,fcd->fcn", X, u, precision=precision)
+        return z - jnp.einsum("fd,fcd->fc", cen, u)[..., None] + b0[..., None]
+
+    def grad(b, b0):
+        p = jax.nn.sigmoid(z_of(b, b0))
+        r = (W_tr[:, None, :] * (p - y[None, None, :])
+             / wsum[:, None, None])                         # (F, C, N)
+        g_raw = jnp.einsum("fcn,nd->fcd", r, X,
+                           precision=jax.lax.Precision.DEFAULT)
+        rsum = r.sum(axis=2)
+        g = (g_raw - cen[:, None, :] * rsum[..., None]) / sig[:, None, :]
+        return g + l2[..., None] * b, rsum                  # grad_b, grad_b0
+
+    def mm_solve(g):
+        """delta = H^-1 g via the precomputed per-(f, c) inverse."""
+        return jnp.einsum("fcde,fce->fcd", H_inv, g)
+
+    def step(state):
+        b, b0, pb, pb0, tm, _, it = state
+        # Nesterov: gradient at the extrapolated point
+        gb, g0 = grad(b, b0)
+        nb = b - mm_solve(gb)
+        nb = jnp.sign(nb) * jnp.maximum(jnp.abs(nb) - l1[..., None], 0.0)
+        n0 = b0 - 4.0 * g0 if fit_intercept else b0
+        ntm = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tm * tm))
+        mom = (tm - 1.0) / ntm
+        yb_ = nb + mom * (nb - pb)
+        y0_ = n0 + mom * (n0 - pb0)
+        dn = jnp.maximum(jnp.max(jnp.abs(nb - pb)),
+                         jnp.max(jnp.abs(n0 - pb0)))
+        return yb_, y0_, nb, n0, ntm, dn, it + 1
+
+    def cond(state):
+        *_, dn, it = state
+        return (dn > tol) & (it < max_iter)
+
+    b0_init = jnp.zeros((F, C), X.dtype)
+    binit = jnp.zeros((F, C, d), X.dtype)
+    state = (binit, b0_init, binit, b0_init, jnp.float32(1.0),
+             jnp.float32(jnp.inf), jnp.int32(0))
+    final = lax.while_loop(cond, step, state)
+    b, b0, iters = final[2], final[3], final[6]
+    return jax.nn.sigmoid(z_of(b, b0, jax.lax.Precision.HIGH)), iters
+
+
+# ---------------------------------------------------------------------------
 # Multinomial (softmax) logistic regression — damped Newton on block-diagonal
 # Hessian approximation (per-class), good convergence for tabular K<=~50
 # ---------------------------------------------------------------------------
@@ -307,6 +438,109 @@ def fit_linear_regression(
 
 def linear_predict(coef, intercept, X):
     return jnp.asarray(X, jnp.float32) @ coef + intercept
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "standardization"))
+def fit_linreg_grid(
+    X: jnp.ndarray,          # (N, D)
+    y: jnp.ndarray,          # (N,)
+    W_tr: jnp.ndarray,       # (F, N)
+    regs: jnp.ndarray,       # (C,)
+    alphas: jnp.ndarray,     # (C,)
+    max_iter: int = 200,
+    tol: float = 1e-7,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+) -> jnp.ndarray:
+    """Every (fold, candidate) linear-regression fit in one launch.
+
+    One weighted Gram per fold (shared across candidates), then per-(f, c)
+    ridge solves — or FISTA iterations entirely on the (D, D) Gram when any
+    candidate carries L1 — with zero further passes over the data.  The
+    penalty applies in standardized space (Spark parity), folded into the
+    Gram algebraically.  Returns the (F, C, N) prediction matrix.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    n, d = X.shape
+    F, C = W_tr.shape[0], regs.shape[0]
+    wsum = jnp.maximum(W_tr.sum(axis=1), 1.0)
+    l2 = regs[None, :] * (1.0 - alphas[None, :])           # (1, C)
+    l1 = regs[None, :] * alphas[None, :]
+
+    xm = (W_tr @ X) / wsum[:, None] if fit_intercept else \
+        jnp.zeros((F, d), X.dtype)
+    ym = (W_tr @ y) / wsum if fit_intercept else jnp.zeros(F, X.dtype)
+
+    def fold_parts(w_f):
+        A = jax.lax.dot((X * w_f[:, None]).T, X,
+                        precision=jax.lax.Precision.HIGH,
+                        preferred_element_type=jnp.float32)
+        bv = jax.lax.dot((X * w_f[:, None]).T, y[:, None],
+                         precision=jax.lax.Precision.HIGH)[:, 0]
+        return A, bv
+    A_raw, b_raw = lax.map(fold_parts, W_tr)               # (F,D,D), (F,D)
+    A = A_raw / wsum[:, None, None] - xm[:, :, None] * xm[:, None, :]
+    bv = b_raw / wsum[:, None] - xm * ym[:, None]          # centered
+    if standardization:
+        sig = jnp.sqrt(jnp.maximum(
+            jnp.diagonal(A, axis1=1, axis2=2), 0.0))
+        sig = jnp.where(sig < 1e-12, 1.0, sig)
+        A = A / (sig[:, :, None] * sig[:, None, :])
+        bv = bv / sig
+    else:
+        sig = jnp.ones((F, d), X.dtype)
+
+    eye = jnp.eye(d, dtype=X.dtype)
+
+    def solve_fc(A_f, b_f, l2_c, l1_c):
+        # relative damping on the unit-diagonal standardized Gram:
+        # reg_param=0 candidates over collinear blocks are exactly singular
+        M = A_f + (l2_c + 1e-5) * eye
+
+        def ridge(_):
+            return jax.scipy.linalg.solve(M, b_f, assume_a="pos")
+
+        def fista(_):
+            # Lipschitz bound via power iteration (trace is ~d/λmax too loose
+            # on a standardized Gram and would stall the FISTA steps)
+            def pow_it(i, v):
+                v = A_f @ v
+                return v / (jnp.linalg.norm(v) + 1e-12)
+            v = lax.fori_loop(0, 16, pow_it,
+                              jnp.ones(d, X.dtype) / jnp.sqrt(d))
+            Lc = jnp.vdot(v, A_f @ v) * 1.01 + l2_c + 1e-6
+
+            def stp(st):
+                beta, z, t, _, it = st
+                g = A_f @ z - b_f + l2_c * z
+                nb = z - g / Lc
+                nb = jnp.sign(nb) * jnp.maximum(jnp.abs(nb) - l1_c / Lc, 0.0)
+                nt = 0.5 * (1 + jnp.sqrt(1 + 4 * t * t))
+                nz = nb + (t - 1) / nt * (nb - beta)
+                return nb, nz, nt, jnp.max(jnp.abs(nb - beta)), it + 1
+
+            def cnd(st):
+                _, _, _, dn, it = st
+                return (dn > tol) & (it < max_iter)
+
+            b0 = jnp.zeros(d, X.dtype)
+            out = lax.while_loop(cnd, stp,
+                                 (b0, b0, jnp.float32(1.0),
+                                  jnp.float32(jnp.inf), jnp.int32(0)))
+            return out[0]
+
+        return lax.cond(l1_c > 0, fista, ridge, operand=None)
+
+    coef_s = jax.vmap(lambda A_f, b_f: jax.vmap(
+        lambda l2_c, l1_c: solve_fc(A_f, b_f, l2_c, l1_c))(
+            l2[0], l1[0]))(A, bv)                          # (F, C, D) std space
+    coef = coef_s / sig[:, None, :]
+    icpt = ym[:, None] - jnp.einsum("fd,fcd->fc", xm, coef)
+    preds = jnp.einsum("nd,fcd->fcn", X, coef,
+                       precision=jax.lax.Precision.HIGH)
+    return preds + icpt[..., None]
 
 
 # ---------------------------------------------------------------------------

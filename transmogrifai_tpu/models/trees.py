@@ -306,14 +306,44 @@ def _dev_memo(arr, tag: str = "up"):
     return _memo(key, lambda: jnp.asarray(a))
 
 
-def _dev_f32(X, tag: str = "X_f32"):
-    """THE shared f32 device upload of a host matrix.
+#: past this element count the shared matrix uploads as bf16 (half the
+#: tunnel bytes; measured upload bandwidth is ~10-20 MB/s and byte-
+#: proportional, so a 1M x 500 f32 matrix costs ~2 minutes vs ~1 as bf16).
+#: bf16 keeps f32's exponent range (no overflow on large-magnitude
+#: features); matmul consumers accumulate in f32 either way.
+_BF16_UPLOAD_ELEMS = 1 << 25
 
-    Every consumer of the full-precision matrix (linear-model fits, device
+
+def _dev_f32(X, tag: str = "X_f32"):
+    """THE shared device upload of a host matrix.
+
+    Every consumer of the full matrix (linear-model fits, device
     standardization stats, on-device quantile binning, SanityChecker-scale
     stats) goes through this one memo, so a selector sweep uploads the
-    2 GB-scale matrix across the tunnel exactly once per train."""
-    return _dev_memo(_as_f32(X), tag)
+    GB-scale matrix across the tunnel exactly once per train.  Large
+    matrices (``_BF16_UPLOAD_ELEMS``) upload as bf16 — the tunnel is the
+    sweep's dominant cost at headline shapes — and consumers upcast on
+    device; small ones stay exact f32.
+
+    This applies to the sweep AND to big-matrix refits/scoring of the
+    winning linear model — a deliberate trade (bf16 keeps f32's exponent
+    range; coefficient noise is ~1e-3 relative and measured AuPR-neutral)
+    because a second full-precision upload would cost another ~2 minutes at
+    1M x 500.  Set ``TMOG_MATRIX_PRECISION=f32`` to force exact uploads.
+    """
+    import os
+
+    Xf = _as_f32(X)
+    force_f32 = os.environ.get("TMOG_MATRIX_PRECISION", "auto") == "f32"
+    if tag == "X_f32" and Xf.size > _BF16_UPLOAD_ELEMS and not force_f32:
+        hx = _content_hash(Xf)
+        key = ("X_bf16", hx, Xf.shape)
+
+        def build():
+            import ml_dtypes
+            return jnp.asarray(Xf.astype(ml_dtypes.bfloat16))
+        return _memo(key, build)
+    return _dev_memo(Xf, tag)
 
 
 def _dev_memo_sharded(arr, sharding, tag: str = "up"):
@@ -329,8 +359,9 @@ def _dev_memo_sharded(arr, sharding, tag: str = "up"):
 
 @jax.jit
 def _apply_bins_i8(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
-    """On-device quantization to int8 (B <= 127), for when the f32 matrix is
+    """On-device quantization to int8 (B <= 127), for when the matrix is
     already device-resident: skips the host binning pass AND the int8 upload."""
+    X = X.astype(jnp.float32)
     return jnp.sum(X[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int8)
 
 
@@ -351,9 +382,12 @@ def _binned_cached(Xf: np.ndarray, hx: str, edges):
     def build():
         big = Xf.size > _HOST_BIN_ELEMS and ef.shape[1] < 127
         if big:
-            # reuse the sweep's shared f32 upload when present: device
-            # binning is one launch vs a ~10 s/1M-row host pass + upload
-            xdev = _memo_peek(("X_f32", hx, Xf.shape, "float32"))
+            # reuse the sweep's shared upload when present: device binning
+            # is one launch vs a ~10 s/1M-row host pass + a second upload.
+            # (Binning the bf16 copy can flip values that sit within bf16
+            # rounding of an edge — immaterial to quantile-bin trees.)
+            xdev = (_memo_peek(("X_bf16", hx, Xf.shape))
+                    or _memo_peek(("X_f32", hx, Xf.shape, "float32")))
             if xdev is not None:
                 return _apply_bins_i8(xdev, jnp.asarray(ef))
             return jnp.asarray(_host_bins(Xf, ef))

@@ -1,0 +1,170 @@
+"""Grid-batched candidate groups — the sweep's concurrency axis.
+
+The reference runs its (model, fold) fits on a JVM thread pool
+(``OpCrossValidation.scala:113-138``); the TPU equivalent is batching: a run
+of candidates from the same estimator family fits as ONE XLA program over a
+(folds, candidates) grid of traced hyperparameters, and the per-fold
+validation metrics come back as one (C, F) device array.  ``_run_sweep``
+consumes groups transparently — a group that declines (returns None) or
+raises falls back to the per-candidate fitter path, which keeps the
+reference's per-candidate failure isolation.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["GridGroup", "LogRegGridGroup", "LinRegGridGroup",
+           "make_grid_group"]
+
+
+class GridGroup:
+    """Base: one batched fit+score+metric program for C candidates.
+
+    ``run(X, y, weight_ctxs)`` returns a device/host (C, F) metric matrix —
+    row order matching the group's ``grid_points`` — or None to decline
+    (callers then fit those candidates sequentially).
+    """
+
+    def __init__(self, proto, grid_points: Sequence[Dict[str, Any]],
+                 metric: str):
+        self.proto = proto
+        self.grid_points = list(grid_points)
+        self.metric = metric
+
+    def run(self, X: np.ndarray, y: np.ndarray,
+            weight_ctxs: Sequence[Tuple[np.ndarray, np.ndarray]]):
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------------
+
+    def _param(self, params: Dict[str, Any], name: str):
+        return params.get(name, getattr(self.proto, name))
+
+    def _uniform(self, names: Sequence[str]) -> bool:
+        """True when every candidate agrees on each of ``names`` (those
+        params are static in the batched program)."""
+        for n in names:
+            vals = {self._param(p, n) for p in self.grid_points}
+            if len(vals) > 1:
+                return False
+        return True
+
+    @staticmethod
+    def _stack_weights(weight_ctxs):
+        W_tr = np.ascontiguousarray(
+            np.stack([np.asarray(w, np.float32) for w, _ in weight_ctxs]))
+        W_ev = np.ascontiguousarray(
+            np.stack([np.asarray(w, np.float32) for _, w in weight_ctxs]))
+        return W_tr, W_ev
+
+
+class _LinearGridGroup(GridGroup):
+    """Shared plumbing for the linear-family groups."""
+
+    _batchable = ("reg_param", "elastic_net_param")
+    _static = ("max_iter", "tol", "fit_intercept", "standardization")
+
+    def _regs_alphas(self):
+        import jax.numpy as jnp
+
+        regs = jnp.asarray([float(self._param(p, "reg_param"))
+                            for p in self.grid_points], jnp.float32)
+        alphas = jnp.asarray([float(self._param(p, "elastic_net_param"))
+                              for p in self.grid_points], jnp.float32)
+        return regs, alphas
+
+    def _batchable_params(self) -> bool:
+        allowed = set(self._batchable) | set(self._static)
+        if any(set(p) - allowed for p in self.grid_points):
+            return False
+        return self._uniform(self._static)
+
+    def _metric_rows(self, y, scores, W_ev, binary: bool):
+        """(F, C, N) device scores + (F, N) eval weights -> (C, F) device
+        metrics (weights broadcast over candidates, never replicated), or
+        None when the metric lacks a device kernel."""
+        import jax.numpy as jnp
+
+        from ..evaluators.metrics import (binary_metric_grid,
+                                          regression_metric_grid)
+
+        fn = binary_metric_grid if binary else regression_metric_grid
+        m = fn(y, scores, jnp.asarray(W_ev), self.metric)
+        if m is None:
+            return None
+        return m.T
+
+
+class LogRegGridGroup(_LinearGridGroup):
+    """All binary-LR (fold x candidate) fits in one majorization program
+    (``linear.fit_logreg_grid``)."""
+
+    def run(self, X, y, weight_ctxs):
+        if not self._batchable_params():
+            return None
+        if len(y) and np.nanmax(y) > 1:          # binary device path only
+            return None
+        from ..models.linear import fit_logreg_grid
+        from ..models.trees import _dev_f32
+
+        W_tr, W_ev = self._stack_weights(weight_ctxs)
+        regs, alphas = self._regs_alphas()
+        max_iter = int(self._param(self.grid_points[0], "max_iter"))
+        tol = float(self._param(self.grid_points[0], "tol"))
+        scores, _ = fit_logreg_grid(
+            _dev_f32(X), np.nan_to_num(np.asarray(y, np.float32)),
+            _dev_f32(W_tr, tag="W_tr"), regs, alphas,
+            # majorization steps are ~D^2/N cheaper than Newton steps;
+            # give the solver a proportionally larger budget at a metric-
+            # sufficient tolerance
+            max_iter=max(150, 4 * max_iter), tol=max(tol, 1e-5),
+            fit_intercept=bool(self._param(self.grid_points[0],
+                                           "fit_intercept")),
+            standardization=bool(self._param(self.grid_points[0],
+                                             "standardization")))
+        return self._metric_rows(y, scores, W_ev, binary=True)
+
+
+class LinRegGridGroup(_LinearGridGroup):
+    """All linear-regression (fold x candidate) fits in one Gram-sharing
+    program (``linear.fit_linreg_grid``)."""
+
+    def run(self, X, y, weight_ctxs):
+        if not self._batchable_params():
+            return None
+        from ..models.linear import fit_linreg_grid
+        from ..models.trees import _dev_f32
+
+        W_tr, W_ev = self._stack_weights(weight_ctxs)
+        regs, alphas = self._regs_alphas()
+        preds = fit_linreg_grid(
+            _dev_f32(X), np.nan_to_num(np.asarray(y, np.float32)),
+            _dev_f32(W_tr, tag="W_tr"), regs, alphas,
+            max_iter=int(self._param(self.grid_points[0], "max_iter")),
+            tol=float(self._param(self.grid_points[0], "tol")),
+            fit_intercept=bool(self._param(self.grid_points[0],
+                                           "fit_intercept")),
+            standardization=bool(self._param(self.grid_points[0],
+                                             "standardization")))
+        return self._metric_rows(y, preds, W_ev, binary=False)
+
+
+def make_grid_group(proto, grid_points, problem_type: str,
+                    metric: str) -> Optional[GridGroup]:
+    """Group factory: returns a batched group when the estimator family,
+    problem type, and metric support one — else None (sequential fits)."""
+    if len(grid_points) == 0:
+        return None
+    from ..models.classification import OpLogisticRegression
+    from ..models.regression import OpLinearRegression
+
+    if problem_type == "binary" and type(proto) is OpLogisticRegression \
+            and metric in ("AuPR", "AuROC"):
+        return LogRegGridGroup(proto, grid_points, metric)
+    if problem_type == "regression" and type(proto) is OpLinearRegression \
+            and metric in ("RootMeanSquaredError", "MeanSquaredError",
+                           "MeanAbsoluteError", "R2"):
+        return LinRegGridGroup(proto, grid_points, metric)
+    return None

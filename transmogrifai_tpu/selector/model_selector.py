@@ -242,8 +242,16 @@ class ModelSelector(PredictorEstimator):
         return self.validation_metric not in MINIMIZE_METRICS
 
     def _candidates(self):
+        from .grid_groups import make_grid_group
+
         out = []
         for proto, grid_points in self.models_and_params:
+            # one batched program for the whole (folds x grid) product when
+            # the family supports it; single-chip only (the mesh path runs
+            # each candidate's own sharded fit)
+            group = (make_grid_group(proto, grid_points, self.problem_type,
+                                     self.validation_metric)
+                     if self.mesh is None else None)
             for params in grid_points:
                 def fitter(X, y, w, p, proto=proto):
                     est = proto.copy(**p)
@@ -257,7 +265,7 @@ class ModelSelector(PredictorEstimator):
                             return dev_score  # device fit+score, no sync
                     model = est.fit_raw(X, y, w)
                     return lambda Xe: self._score_fn(model, Xe)
-                out.append((type(proto).__name__, params, fitter))
+                out.append((type(proto).__name__, params, fitter, group))
         return out
 
     def _resolved_splitter(self):
@@ -314,7 +322,7 @@ class ModelSelector(PredictorEstimator):
                 y=y[train_idx], base_weights=base_w[train_idx],
                 eval_fn=self._metric, metric_name=self.validation_metric,
                 larger_better=self.larger_better)
-        best_name, best_params, _ = candidates[best_i]
+        best_name, best_params, *_ = candidates[best_i]
         self.best_estimator = (best_name, best_params, results)
         # introspectable record of the fold-refit validation (survives the
         # consume-on-fit of best_estimator)
@@ -340,9 +348,11 @@ class ModelSelector(PredictorEstimator):
     def _prepare_matrix(self, values) -> np.ndarray:
         """One C-contiguous f32 matrix for the whole sweep (every candidate
         probes the upload/binning memos with this same object), plus the
-        shared f32 device upload up front when a linear-family candidate
-        will need full precision anyway — tree candidates then quantize
-        on device from it instead of a host binning pass."""
+        shared device upload up front when a linear-family candidate will
+        consume the full matrix — tree candidates then quantize on device
+        from it instead of a host binning pass.  Large matrices upload as
+        bf16 (see ``trees._dev_f32``; TMOG_MATRIX_PRECISION=f32 forces
+        exact uploads at ~2x the tunnel cost)."""
         from ..models.trees import _as_f32, _dev_f32
 
         X = _as_f32(np.asarray(values))
@@ -376,7 +386,7 @@ class ModelSelector(PredictorEstimator):
                     candidates, X, y, base_w,
                     eval_fn=self._metric, metric_name=self.validation_metric,
                     larger_better=self.larger_better)
-                best_name, best_params, _ = candidates[best_i]
+                best_name, best_params, *_ = candidates[best_i]
 
             # refit best on the full training split (ModelSelector.fit :180)
             best_proto = next(p for p, _ in self.models_and_params

@@ -155,8 +155,11 @@ class OpCrossValidation(_ValidatorBase):
             predict = fitter(X, y, w_train, params)
             return eval_fn(y, predict(X), w_eval)
 
+        def run_group(group):
+            return group.run(X, y, fold_ctxs)
+
         return _run_sweep(candidates, fold_ctxs, run_fold, metric_name,
-                          larger_better, self.max_wait)
+                          larger_better, self.max_wait, run_group=run_group)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -225,8 +228,11 @@ class OpTrainValidationSplit(_ValidatorBase):
             predict = fitter(X, y, w_train, params)
             return eval_fn(y, predict(X), w_eval)
 
+        def run_group(group):
+            return group.run(X, y, [(w_train, w_eval)])
+
         return _run_sweep(candidates, [None], run_fold, metric_name,
-                          larger_better, self.max_wait)
+                          larger_better, self.max_wait, run_group=run_group)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -249,31 +255,60 @@ class OpTrainValidationSplit(_ValidatorBase):
 
 def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
                larger_better: bool, max_wait: Optional[float],
-               ) -> Tuple[int, List[ValidationResult]]:
+               run_group=None) -> Tuple[int, List[ValidationResult]]:
     """Shared candidates×folds loop with per-candidate failure isolation.
 
     The reference runs each (model, fold) fit in its own Future and bounds
     the await with ``maxWait`` (OpCrossValidation.scala:113-138,
     OpValidator.scala:108); a failed or timed-out candidate loses, it does
-    not kill the sweep.  Here fits are sequential XLA launches, so the
-    equivalents are: exceptions confined to the raising candidate (scored
-    -inf, error recorded in the summary) and a wall-clock budget checked
-    before each candidate dispatch (an already-dispatched XLA program
-    cannot be interrupted, but the sweep is guaranteed to stop enqueuing
-    and return partial results).  Raises only when EVERY candidate failed —
-    there is no model to select.
+    not kill the sweep.  Here fits are XLA launches, so the equivalents
+    are: exceptions confined to the raising candidate (scored -inf, error
+    recorded in the summary) and a wall-clock budget checked before each
+    dispatch (an already-dispatched XLA program cannot be interrupted, but
+    the sweep is guaranteed to stop enqueuing and return partial results).
+    Raises only when EVERY candidate failed — there is no model to select.
+
+    Candidates may carry a 4th element — a ``GridGroup`` shared by a run of
+    consecutive candidates — in which case the whole run fits as ONE
+    batched device program (``run_group``); a group that declines or raises
+    falls back to the sequential per-candidate path, preserving isolation.
     """
     import time
 
     t0 = time.monotonic()
-    all_vals: List[List[Any]] = []
+    cands = [tuple(c) if len(c) == 4 else (*c, None) for c in candidates]
+    all_vals: List[Any] = []
     errors: List[Optional[str]] = []
-    for name, params, fitter in candidates:
+    i = 0
+    while i < len(cands):
+        name, params, fitter, group = cands[i]
         elapsed = time.monotonic() - t0
         if max_wait is not None and elapsed > max_wait and all_vals:
             all_vals.append([])
             errors.append(f"skipped: validation budget max_wait={max_wait}s "
                           f"exceeded after {elapsed:.1f}s")
+            i += 1
+            continue
+        if group is not None and run_group is not None:
+            j = i
+            while j < len(cands) and cands[j][3] is group:
+                j += 1
+            M = None
+            try:
+                M = run_group(group)       # (C_g, F) device/host matrix
+            except Exception:  # noqa: BLE001 - fall back to per-candidate
+                M = None
+            if M is not None:
+                for r in range(j - i):
+                    # deferred row marker: fetched once per group matrix in
+                    # _materialize (no per-row device slicing launches)
+                    all_vals.append(_GroupRow(M, r))
+                    errors.append(None)
+                i = j
+                continue
+            # declined/failed: strip the group so members fit sequentially
+            for k in range(i, j):
+                cands[k] = (*cands[k][:3], None)
             continue
         fold_vals: List[Any] = []
         err: Optional[str] = None
@@ -285,12 +320,13 @@ def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
             err = f"{type(e).__name__}: {e}"
         all_vals.append(fold_vals)
         errors.append(err)
+        i += 1
     # the losing sentinel depends on the metric direction: -inf only loses
     # when larger is better; minimize metrics (RMSE, LogLoss) need +inf
     worst = float("-inf") if larger_better else float("inf")
     results: List[ValidationResult] = []
-    for (name, params, _), fold_vals, err in zip(
-            candidates, _materialize(all_vals), errors):
+    for (name, params, *_), fold_vals, err in zip(
+            cands, _materialize(all_vals), errors):
         # mean over FINITE folds only: a single faulted fold (NaN from the
         # per-value _materialize fallback) should not zero out the folds
         # that did complete — the reference likewise averages whichever
@@ -318,14 +354,44 @@ def _argbest(vals: List[float], larger_better: bool) -> int:
     return int(np.argmax(arr))
 
 
-def _materialize(nested: List[List[Any]]) -> List[List[float]]:
+class _GroupRow:
+    """Deferred row of a grid group's (C, F) metric matrix — resolved in
+    ``_materialize`` with one fetch per matrix."""
+
+    __slots__ = ("matrix", "row")
+
+    def __init__(self, matrix, row: int):
+        self.matrix = matrix
+        self.row = row
+
+
+def _materialize(nested: List[Any]) -> List[List[float]]:
     """Fetch all fold metric values in ONE device transfer.
 
     ``eval_fn`` returns device scalars on the device-resident sweep path
     (ModelSelector._metric); through a remote-TPU tunnel every host sync is a
     ~0.6 s round trip, so the whole candidates×folds sweep is dispatched
     async and this single stacked fetch replaces per-fold ``float()`` calls.
+    Grid-group rows (``_GroupRow``) resolve with one fetch per group matrix.
     """
+    # resolve group matrices first (one transfer each, NaN rows on failure)
+    mats: dict = {}
+    for v in nested:
+        if isinstance(v, _GroupRow) and id(v.matrix) not in mats:
+            try:
+                mats[id(v.matrix)] = np.asarray(v.matrix, np.float64)
+            except Exception:  # async device fault inside the group program
+                mats[id(v.matrix)] = None
+    if mats:
+        resolved: List[Any] = []
+        for v in nested:
+            if not isinstance(v, _GroupRow):
+                resolved.append(v)
+            elif mats[id(v.matrix)] is None:
+                resolved.append([float("nan")] * int(v.matrix.shape[1]))
+            else:
+                resolved.append([float(x) for x in mats[id(v.matrix)][v.row]])
+        nested = resolved
     try:
         import jax
         import jax.numpy as jnp
