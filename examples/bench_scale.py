@@ -84,13 +84,28 @@ def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
         t0 = time.perf_counter()
         wf.train()
         warmup_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    model = wf.train()
-    train_s = time.perf_counter() - t0
+
+    from transmogrifai_tpu.utils import profiling
+
+    profiling.reset_counters()
+    collector = profiling.MetricsCollector(run_type="bench_scale")
+    with profiling.install_collector(collector):
+        t0 = time.perf_counter()
+        model = wf.train()
+        train_s = time.perf_counter() - t0
+    steps = {m.step: round(m.duration_secs, 1)
+             for m in collector.metrics.step_metrics.values()}
+    steps.update(collector.metrics.custom_tags)
 
     _, metrics = model.score_and_evaluate(
         Evaluators.BinaryClassification.auPR())
+    summ = next((s.metadata["model_selector_summary"] for s in model.stages
+                 if "model_selector_summary" in s.metadata), {})
+    n_err = sum(1 for rrow in summ.get("validationResults", [])
+                if rrow.get("error"))
     return {
+        "candidates": len(summ.get("validationResults", [])),
+        "candidate_errors": n_err,
         "metric": "scale_automl_train_wall_clock",
         "rows": rows, "cols": cols,
         "value": round(train_s, 1), "unit": "s",
@@ -100,6 +115,8 @@ def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
         "datagen_s": round(gen_s, 1),
         "baseline_s_assumed": baseline_s,
         "warmup_s": round(warmup_s, 1),
+        "phases": steps,
+        "transfers": profiling.COUNTERS.to_json(),
     }
 
 

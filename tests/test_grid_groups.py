@@ -1,0 +1,199 @@
+"""Grid-batched sweep groups vs the sequential per-candidate path.
+
+The batched programs must reproduce the sequential path's selection: RF
+grids share the exact bag/feature-subset randomness (fold_in(seed, t)), so
+their metrics match to float tolerance; the LR group's majorization solver
+converges to the same optimum as Newton-IRLS, so metrics agree to ~1e-3 and
+the winner agrees.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models.classification import OpLogisticRegression
+from transmogrifai_tpu.models.regression import OpLinearRegression
+from transmogrifai_tpu.models.trees import (
+    OpRandomForestClassifier, OpRandomForestRegressor,
+)
+from transmogrifai_tpu.selector import grid
+from transmogrifai_tpu.selector.grid_groups import make_grid_group
+from transmogrifai_tpu.selector.model_selector import ModelSelector
+from transmogrifai_tpu.selector.validators import OpCrossValidation
+
+
+def _binary_data(n=3000, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d) * (rng.random(d) < 0.5)
+    y = (1 / (1 + np.exp(-(X @ beta))) > rng.random(n)).astype(np.float32)
+    return X, y
+
+
+def _run_selector(models_and_params, problem, X, y, metric=None):
+    sel = ModelSelector(
+        models_and_params, problem_type=problem,
+        validator=OpCrossValidation(num_folds=3, seed=7,
+                                    stratify=problem != "regression"),
+        validation_metric=metric)
+    candidates = sel._candidates()
+    best_i, results = sel.validator.validate(
+        candidates, X, y, np.ones(len(y), np.float32),
+        eval_fn=sel._metric, metric_name=sel.validation_metric,
+        larger_better=sel.larger_better)
+    return best_i, results
+
+
+class TestGroupConstruction:
+    def test_factory_matches_families(self):
+        assert make_grid_group(OpLogisticRegression(),
+                               grid(reg_param=[0.1]), "binary",
+                               "AuPR") is not None
+        assert make_grid_group(OpRandomForestClassifier(),
+                               grid(max_depth=[3]), "binary",
+                               "AuPR") is not None
+        assert make_grid_group(OpLinearRegression(), grid(reg_param=[0.1]),
+                               "regression",
+                               "RootMeanSquaredError") is not None
+        assert make_grid_group(OpRandomForestRegressor(),
+                               grid(max_depth=[3]), "regression",
+                               "RootMeanSquaredError") is not None
+        # unsupported metric / problem -> no group
+        assert make_grid_group(OpLogisticRegression(), grid(reg_param=[0.1]),
+                               "binary", "F1") is None
+        assert make_grid_group(OpRandomForestClassifier(),
+                               grid(max_depth=[3]), "multiclass",
+                               "F1") is None
+
+    def test_non_batchable_params_decline(self):
+        X, y = _binary_data(400, 6)
+        g = make_grid_group(OpRandomForestClassifier(),
+                            grid(max_depth=[3], subsample_rate=[0.5, 1.0]),
+                            "binary", "AuPR")
+        # subsample_rate differs across candidates -> declines at run time
+        assert g.run(X, y, [(np.ones(len(y), np.float32),
+                             np.ones(len(y), np.float32))]) is None
+
+
+class TestRFGridParity:
+    def test_rf_group_matches_sequential(self, monkeypatch):
+        X, y = _binary_data()
+        mp = [(OpRandomForestClassifier(num_trees=8),
+               grid(max_depth=[3, 5], min_instances_per_node=[1, 20]))]
+        best_g, res_g = _run_selector(mp, "binary", X, y)
+
+        # disable groups -> sequential fitter path
+        import transmogrifai_tpu.selector.model_selector as ms
+        monkeypatch.setattr(ms, "__grids_off", True, raising=False)
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "binary", X, y)
+
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            assert rg.error is None and rs.error is None
+            # identical bags + identical depth masking -> float-level match
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    abs=2e-3)
+
+    def test_rf_regression_group(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(1500, 8)).astype(np.float32)
+        yr = (X @ rng.normal(size=8) + 0.1 * rng.normal(size=1500)
+              ).astype(np.float32)
+        mp = [(OpRandomForestRegressor(num_trees=6),
+               grid(max_depth=[3, 4]))]
+        best, res = _run_selector(mp, "regression", X, yr)
+        assert all(r.error is None for r in res)
+        assert all(np.isfinite(r.metric_value) for r in res)
+
+
+class TestLinearGridParity:
+    def test_logreg_group_matches_sequential_winner(self, monkeypatch):
+        X, y = _binary_data(4000, 20, seed=3)
+        mp = [(OpLogisticRegression(),
+               grid(reg_param=[0.001, 0.1, 0.5],
+                    elastic_net_param=[0.1]))]
+        best_g, res_g = _run_selector(mp, "binary", X, y)
+
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "binary", X, y)
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    abs=5e-3)
+
+    def test_linreg_group_matches_sequential(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(3000, 15)).astype(np.float32)
+        yr = (X @ rng.normal(size=15) + 0.05 * rng.normal(size=3000)
+              ).astype(np.float32)
+        mp = [(OpLinearRegression(),
+               grid(reg_param=[0.0, 0.01, 0.1], elastic_net_param=[0.0]))]
+        best_g, res_g = _run_selector(mp, "regression", X, yr)
+
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "regression", X, yr)
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    rel=2e-2)
+
+
+class TestGBTChainParity:
+    def test_gbt_chains_match_sequential(self, monkeypatch):
+        X, y = _binary_data(2500, 10, seed=9)
+        from transmogrifai_tpu.models.trees import OpGBTClassifier
+        mp = [(OpGBTClassifier(max_iter=6),
+               grid(max_depth=[3, 4], step_size=[0.1, 0.3]))]
+        best_g, res_g = _run_selector(mp, "binary", X, y)
+
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "binary", X, y)
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    abs=2e-3)
+
+    def test_xgb_early_stopping_chains(self, monkeypatch):
+        X, y = _binary_data(2000, 8, seed=11)
+        from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+        mp = [(OpXGBoostClassifier(num_round=12, eta=0.3, max_depth=3,
+                                   early_stopping_rounds=3),
+               grid(min_child_weight=[1.0, 10.0]))]
+        best_g, res_g = _run_selector(mp, "binary", X, y)
+
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "binary", X, y)
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    abs=3e-3)
+
+
+class TestGroupFailureIsolation:
+    def test_group_exception_falls_back(self, monkeypatch):
+        """A raising group must not kill the sweep — members refit
+        sequentially (reference per-candidate Future isolation)."""
+        X, y = _binary_data(500, 6)
+        mp = [(OpRandomForestClassifier(num_trees=4), grid(max_depth=[3]))]
+        from transmogrifai_tpu.selector import grid_groups
+
+        class Boom(grid_groups.GridGroup):
+            def run(self, *a):
+                raise RuntimeError("group exploded")
+
+        monkeypatch.setattr(
+            grid_groups, "make_grid_group",
+            lambda proto, pts, pt, m: Boom(proto, pts, m))
+        import transmogrifai_tpu.selector.model_selector as ms
+        best, res = _run_selector(mp, "binary", X, y)
+        assert res[0].error is None
+        assert np.isfinite(res[0].metric_value)

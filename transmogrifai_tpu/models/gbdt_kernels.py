@@ -486,7 +486,10 @@ def grow_forest(binned: jnp.ndarray, Y: np.ndarray, BW: np.ndarray,
     feat_mask = np.asarray(feat_mask, bool)
     limit = jnp.full((chunk,), max_depth, jnp.int32)
     feats, threshs, leaves = [], [], []
+    from ..utils.profiling import count_launch
+
     for s in range(0, T, chunk):
+        count_launch("forest_chunk")
         e = min(s + chunk, T)
         pad = chunk - (e - s)
         BWc = jnp.asarray(np.pad(BW[s:e], ((0, pad), (0, 0))))
@@ -577,6 +580,97 @@ def _grow_chunk_rf(binned, Y, base_w, seed, start, n_trees, depth_limit_val,
         onehot_targets=onehot_targets, feat_idx=feat_idx)
 
 
+@functools.partial(jax.jit, static_argnames=("chunk", "msub", "max_depth",
+                                             "n_bins", "onehot_targets",
+                                             "t_per"))
+def _grow_chunk_rf_grid(binned, Y, W_tr, seed, flat_start, total,
+                        pair_fold, pair_min_ig, pair_min_inst, pair_depth,
+                        subsample_rate, chunk: int, msub: int,
+                        max_depth: int, n_bins: int, lam,
+                        min_child_weight, t_per: int,
+                        onehot_targets: bool = False):
+    """RF chunk spanning the WHOLE (candidate x fold) grid.
+
+    Flat tree index i = pair * t_per + t: tree t of grid pair ``i // t_per``
+    draws the SAME on-device bag/feature-subset stream as a sequential
+    per-candidate fit (``fold_in(seed, t)``), trains against that pair's
+    fold weights (``W_tr[pair_fold]``) and its traced (min_info_gain,
+    min_instances, depth_limit) — so one launch stream grows every
+    candidate's forest for every fold with results identical to the
+    per-candidate path (same randomness, same split masking).
+    """
+    n, d = binned.shape
+    flat = flat_start + jnp.arange(chunk)
+    t_loc = (flat % t_per).astype(jnp.int32)
+    p_idx = jnp.minimum(flat // t_per, pair_fold.shape[0] - 1)
+    BWr, feat_idx = jax.vmap(
+        lambda tid: _rf_bag_and_features(tid, seed, n, d, msub,
+                                         subsample_rate))(t_loc)
+    base_w = W_tr[pair_fold[p_idx]]                       # (chunk, N)
+    BW = base_w * BWr * (flat < total)[:, None]
+    kw = dict(max_depth=max_depth, n_bins=n_bins, lam=lam,
+              min_child_weight=min_child_weight, newton_leaf=jnp.bool_(False),
+              learning_rate=jnp.float32(1.0), hist_bf16=True,
+              bag_mode="onehot" if onehot_targets else "bagged")
+
+    def one(bw_row, mig, mins, lim, fi):
+        g = bw_row[:, None] * Y
+        h = jnp.broadcast_to(bw_row[:, None], g.shape)
+        return _grow_tree_traced(
+            binned, g, h, bw_row, jnp.ones(d, bool), lim,
+            min_info_gain=mig, min_instances=mins, feat_idx=fi, **kw)
+
+    return jax.vmap(one)(BW, pair_min_ig[p_idx], pair_min_inst[p_idx],
+                         pair_depth[p_idx], feat_idx)
+
+
+def grow_rf_grid(binned, Y, W_tr, seed: int, n_trees: int,
+                 pair_fold: np.ndarray, pair_min_ig: np.ndarray,
+                 pair_min_inst: np.ndarray, pair_depth: np.ndarray,
+                 msub: int, subsample_rate: float, n_bins: int,
+                 lam: float = 1e-3, min_child_weight: float = 0.0,
+                 onehot_targets: bool = False):
+    """Grow every (candidate x fold) pair's forest as one chunked launch
+    stream; returns device (P, T, nodes...) stacked ensembles."""
+    n, d = binned.shape
+    k = Y.shape[1]
+    P = int(pair_fold.shape[0])
+    heap_depth = _resolve_compile_depth(int(pair_depth.max()))
+    chunk = forest_chunk_size(
+        n_trees * P, heap_depth, msub, n_bins, k, n_rows=n,
+        n_channels=(k if onehot_targets else k + 1), d_full=d)
+    total = n_trees * P
+    pf = jnp.asarray(pair_fold, jnp.int32)
+    pg = jnp.asarray(pair_min_ig, jnp.float32)
+    pi = jnp.asarray(pair_min_inst, jnp.float32)
+    pd_ = jnp.asarray(pair_depth, jnp.int32)
+    from ..utils.profiling import count_launch
+
+    feats, threshs, leaves = [], [], []
+    for s in range(0, total, chunk):
+        count_launch("rf_grid_chunk")
+        f, t, lf = _grow_chunk_rf_grid(
+            binned, Y, W_tr, jnp.int32(seed), jnp.int32(s), jnp.int32(total),
+            pf, pg, pi, pd_, jnp.float32(subsample_rate), chunk, msub,
+            heap_depth, n_bins, jnp.float32(lam),
+            jnp.float32(min_child_weight), n_trees,
+            onehot_targets=onehot_targets)
+        e = min(s + chunk, total)
+        feats.append(f[:e - s])
+        threshs.append(t[:e - s])
+        leaves.append(lf[:e - s])
+    if len(feats) > 1:
+        feats = jnp.concatenate(feats)
+        threshs = jnp.concatenate(threshs)
+        leaves = jnp.concatenate(leaves)
+    else:
+        feats, threshs, leaves = feats[0], threshs[0], leaves[0]
+    nodes = feats.shape[1]
+    return (feats.reshape(P, n_trees, nodes),
+            threshs.reshape(P, n_trees, nodes),
+            leaves.reshape(P, n_trees, *leaves.shape[1:]))
+
+
 def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
                    subsample_rate: float, max_depth: int, n_bins: int,
                    lam: float = 1e-3, min_child_weight: float = 0.0,
@@ -597,8 +691,11 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
     args = (jnp.float32(lam), jnp.float32(min_child_weight),
             jnp.float32(min_info_gain), jnp.float32(min_instances),
             jnp.float32(1.0))
+    from ..utils.profiling import count_launch
+
     feats, threshs, leaves = [], [], []
     for s in range(0, n_trees, chunk):
+        count_launch("rf_chunk")
         f, t, lf = _grow_chunk_rf(
             binned, Y, base_w, jnp.int32(seed), jnp.int32(s),
             jnp.int32(n_trees), jnp.int32(max_depth),
@@ -614,6 +711,72 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
         return feats[0], threshs[0], leaves[0]
     return (jnp.concatenate(feats), jnp.concatenate(threshs),
             jnp.concatenate(leaves))
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "obj"))
+def _gbt_chain_round_jit(binned, y, W, Fm, depth_lim, lams, mcws, migs,
+                         mins_, lrs, mgrs, max_depth: int, n_bins: int,
+                         obj: str):
+    """One boosting round for a chunk of chains: gradients from each
+    chain's margins + ONE vmapped growth (the bins one-hot is chain-
+    invariant, so XLA builds it once per row block for every chain's
+    histogram dots)."""
+    n, d = binned.shape
+    if obj == "binary":
+        P = jax.nn.sigmoid(Fm)                       # (S, N)
+        G = W * (P - y[None, :])
+        H = W * jnp.maximum(P * (1 - P), 1e-6)
+    else:
+        G = W * (Fm - y[None, :])
+        H = W
+    mask = jnp.ones(d, bool)
+
+    def one(g, h, c, lim, lam, mcw, mig, mi, lr, mgr):
+        return _grow_tree_traced(
+            binned, g[:, None], h[:, None], c, mask, lim,
+            max_depth=max_depth, n_bins=n_bins, lam=lam,
+            min_child_weight=mcw, min_info_gain=mig, min_instances=mi,
+            newton_leaf=jnp.bool_(True), learning_rate=lr,
+            min_gain_raw=mgr)
+
+    return jax.vmap(one)(G, H, W, depth_lim, lams, mcws, migs, mins_,
+                         lrs, mgrs)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _predict_round_jit(binned, feat, thresh, leaf, max_depth: int):
+    """(S, N) margin increments for one round's chain trees."""
+    out = jax.vmap(lambda f, t, lf: predict_tree(binned, f, t, lf,
+                                                 max_depth))(
+        feat, thresh, leaf)
+    return out[:, :, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("obj",))
+def _chain_es_metric_jit(Fm, y, vi, obj: str):
+    """Per-chain early-stopping metric on the validation rows (device)."""
+    yv = y[vi]
+    Z = Fm[:, vi]
+    if obj == "binary":
+        from ..evaluators.metrics import _aupr_dev
+
+        return jax.vmap(lambda z: _aupr_dev(yv, jax.nn.sigmoid(z)))(Z)
+    return -jnp.mean((Z - yv[None, :]) ** 2, axis=1)
+
+
+def gbt_chain_chunk(n_chains: int, max_depth: int, d: int, n_bins: int,
+                    n_rows: int, budget: int = HIST_BYTES_BUDGET) -> int:
+    """Chains per round launch: the (ROW_BLOCK, B*D) bins one-hot is shared
+    (counted once), per-chain terms are the slot one-hot + the 3-channel
+    histogram accumulator."""
+    slots = 2 ** (max_depth - 1)
+    if n_rows is not None:
+        slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))
+    shared = int(min(n_rows, ROW_BLOCK) * n_bins * d * 4 * 1.3)
+    per_chain = int(slots * n_bins * d * 3 * 4 * 1.3
+                    + min(n_rows, ROW_BLOCK) * slots * 4 * 1.3
+                    + n_rows * 4 * 4)
+    return int(np.clip((budget - shared) // max(per_chain, 1), 1, n_chains))
 
 
 def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,

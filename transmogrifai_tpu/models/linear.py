@@ -93,8 +93,11 @@ def fit_logistic_regression(
     tol: float = 1e-6,
     fit_intercept: bool = True,
 ) -> LinearFit:
-    """Newton-IRLS with ridge-damped Hessian; L1 handled by iterative
-    soft-thresholding of the Newton update (proximal Newton).
+    """Newton-IRLS with ridge-damped Hessian for the smooth (L2) case; L1
+    candidates run exact proximal-gradient (scalar-majorizer FISTA), whose
+    fixed point is the TRUE elastic-net optimum — matching Spark's OWLQN
+    semantics and the batched grid solver (``fit_logreg_grid``), instead of
+    the biased soft-threshold-after-Newton heuristic.
 
     ``reg_param``/``elastic_net_param`` follow Spark's parameterisation
     (regParam, elasticNetParam in DefaultSelectorParams.scala:36-75):
@@ -105,48 +108,76 @@ def fit_logistic_regression(
     wsum = jnp.maximum(w.sum(), 1.0)
     l2 = reg_param * (1.0 - elastic_net_param)
     l1 = reg_param * elastic_net_param
+    da = d + (1 if fit_intercept else 0)
 
-    def nll(beta):
-        z = X @ beta[:d] + (beta[d] if fit_intercept else 0.0)
-        # weighted mean logloss + l2
-        ll = w @ (jnp.logaddexp(0.0, z) - y * z) / wsum
-        return ll + 0.5 * l2 * jnp.sum(beta[:d] ** 2)
+    if fit_intercept:
+        Xa = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
+    else:
+        Xa = X
 
-    def step(state):
-        beta, _, it = state
-        z = X @ beta[:d] + (beta[d] if fit_intercept else 0.0)
+    def smooth_grad(beta):
+        z = Xa @ beta
         p = jax.nn.sigmoid(z)
-        g_z = w * (p - y) / wsum                       # (N,)
-        s = jnp.maximum(w * p * (1 - p) / wsum, 1e-10)  # IRLS weights
-        if fit_intercept:
-            Xa = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
-        else:
-            Xa = X
-        grad = Xa.T @ g_z
-        grad = grad.at[:d].add(l2 * beta[:d])
-        H = (Xa * s[:, None]).T @ Xa
-        H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
-        delta = _damped_solve(H, grad)
-        new_beta = _finite_or(beta - delta, beta)
-        # proximal step for l1 (soft threshold coefficients, not intercept);
-        # a no-op when l1 == 0, so applied unconditionally (keeps the program
-        # hyperparameter-polymorphic — no retrace per grid point)
-        new_beta = jnp.where(
-            jnp.arange(new_beta.shape[0]) < d,
-            jnp.sign(new_beta) * jnp.maximum(jnp.abs(new_beta) - l1, 0.0),
-            new_beta,
-        )
-        delta_norm = jnp.max(jnp.abs(new_beta - beta))
-        return new_beta, delta_norm, it + 1
+        g = Xa.T @ (w * (p - y) / wsum)
+        return g.at[:d].add(l2 * beta[:d]), p
 
-    def cond(state):
-        _, delta_norm, it = state
-        return (delta_norm > tol) & (it < max_iter)
+    def newton_loop(_):
+        def step(state):
+            beta, _, it = state
+            grad, p = smooth_grad(beta)
+            s = jnp.maximum(w * p * (1 - p) / wsum, 1e-10)
+            H = (Xa * s[:, None]).T @ Xa
+            H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
+            new_beta = _finite_or(beta - _damped_solve(H, grad), beta)
+            return new_beta, jnp.max(jnp.abs(new_beta - beta)), it + 1
 
-    beta0 = jnp.zeros(d + (1 if fit_intercept else 0), jnp.float32)
-    beta, delta_norm, it = lax.while_loop(
-        cond, step, (beta0, jnp.float32(jnp.inf), jnp.int32(0))
-    )
+        def cond(state):
+            _, dn, it = state
+            return (dn > tol) & (it < max_iter)
+
+        beta0 = jnp.zeros(da, jnp.float32)
+        return lax.while_loop(
+            cond, step, (beta0, jnp.float32(jnp.inf), jnp.int32(0)))
+
+    def fista_loop(_):
+        # Lipschitz bound via matvec power iteration on X'WX/(4 wsum)
+        def pow_it(i, v):
+            v = Xa.T @ (w * (Xa @ v)) / (4.0 * wsum)
+            return v / (jnp.linalg.norm(v) + 1e-12)
+        v = lax.fori_loop(0, 16, pow_it, jnp.ones(da, X.dtype)
+                          / jnp.sqrt(da))
+        L = jnp.vdot(v, Xa.T @ (w * (Xa @ v)) / (4.0 * wsum)) * 1.01 \
+            + l2 + 1e-6
+        thr = l1 / L
+        coef_dims = jnp.arange(da) < d
+
+        def step(state):
+            beta, zb, t_m, _, it = state
+            grad, _ = smooth_grad(zb)
+            nb = zb - grad / L
+            nb = jnp.where(coef_dims,
+                           jnp.sign(nb) * jnp.maximum(jnp.abs(nb) - thr,
+                                                      0.0),
+                           nb)
+            nb = _finite_or(nb, beta)
+            nt = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t_m * t_m))
+            nz = nb + (t_m - 1.0) / nt * (nb - beta)
+            return nb, nz, nt, jnp.max(jnp.abs(nb - beta)), it + 1
+
+        def cond(state):
+            _, _, _, dn, it = state
+            # proximal steps are ~D/N cheaper than Newton steps: scale the
+            # iteration budget so max_iter keeps its "solver effort" meaning
+            return (dn > tol) & (it < 8 * max_iter)
+
+        beta0 = jnp.zeros(da, jnp.float32)
+        beta, _, _, dn, it = lax.while_loop(
+            cond, step, (beta0, beta0, jnp.float32(1.0),
+                         jnp.float32(jnp.inf), jnp.int32(0)))
+        return beta, dn, it
+
+    beta, delta_norm, it = lax.cond(l1 > 0, fista_loop, newton_loop,
+                                    operand=None)
     coef = beta[:d]
     intercept = beta[d] if fit_intercept else jnp.float32(0.0)
     return LinearFit(coef, intercept, it, delta_norm <= tol)
@@ -187,9 +218,13 @@ def fit_logreg_grid(
     yields a fixed majorizing metric; each iteration is then two (N, D)
     matvecs batched over the whole grid instead of a fresh (D, N)@(N, D)
     Hessian per candidate per iteration (the Newton-IRLS cost that made
-    per-candidate fits the sweep's dominant term).  Monotone convergence to
-    the same optimum as Newton-IRLS; the winning candidate's final refit
-    still uses ``fit_logistic_regression``.  Standardization is folded in
+    per-candidate fits the sweep's dominant term).  Pure-L2 candidates
+    converge to the same optimum as Newton-IRLS; L1 candidates run exact
+    proximal-gradient (scalar-majorizer FISTA), whose fixed point is the
+    TRUE elastic-net optimum — the sequential IRLS's after-step threshold
+    is itself an approximate prox, so the two paths agree to metric level
+    (<~2e-3 AuPR) rather than per-coefficient.  The winning candidate's
+    final refit still uses ``fit_logistic_regression``.  Standardization is folded in
     algebraically (mean/scale corrections on the Gram and gradient), so the
     standardized matrix is never materialized per fold.
     """
@@ -261,12 +296,31 @@ def fit_logreg_grid(
         """delta = H^-1 g via the precomputed per-(f, c) inverse."""
         return jnp.einsum("fcde,fce->fcd", H_inv, g)
 
+    # scalar majorizer for the L1 candidates: FISTA with step 1/L and
+    # threshold l1/L is the EXACT proximal-gradient method, whose fixed
+    # point is the true elastic-net optimum (a plain soft-threshold after a
+    # dense H^-1 step is NOT the prox under that metric — its fixed point
+    # is biased on correlated features, measured up to 0.022 in p)
+    def lmax_fold(Qs_f):
+        def pow_it(i, v):
+            v = Qs_f @ v
+            return v / (jnp.linalg.norm(v) + 1e-12)
+        v = lax.fori_loop(0, 16, pow_it, jnp.ones(d, X.dtype) / jnp.sqrt(d))
+        return jnp.vdot(v, Qs_f @ v) * 1.01
+    Lf = jax.vmap(lmax_fold)(Qs)                           # (F,)
+    L_fc = Lf[:, None] / 4.0 + l2 + 1e-6                   # (F, C)
+    has_l1 = l1[..., None] > 0
+
     def step(state):
         b, b0, pb, pb0, tm, _, it = state
         # Nesterov: gradient at the extrapolated point
         gb, g0 = grad(b, b0)
-        nb = b - mm_solve(gb)
-        nb = jnp.sign(nb) * jnp.maximum(jnp.abs(nb) - l1[..., None], 0.0)
+        nb_mm = b - mm_solve(gb)
+        nb_prox = b - gb / L_fc[..., None]
+        thr = l1[..., None] / L_fc[..., None]
+        nb_prox = jnp.sign(nb_prox) * jnp.maximum(jnp.abs(nb_prox) - thr,
+                                                  0.0)
+        nb = jnp.where(has_l1, nb_prox, nb_mm)
         n0 = b0 - 4.0 * g0 if fit_intercept else b0
         ntm = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tm * tm))
         mom = (tm - 1.0) / ntm

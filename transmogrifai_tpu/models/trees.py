@@ -297,13 +297,24 @@ def _as_f32(X) -> np.ndarray:
     return Xc
 
 
+def _upload_timed(a):
+    """jnp.asarray with transfer accounting (bytes + enqueue-blocking time)."""
+    import time as _time
+
+    from ..utils.profiling import count_upload
+    t0 = _time.perf_counter()
+    out = jnp.asarray(a)
+    count_upload(a.nbytes, _time.perf_counter() - t0)
+    return out
+
+
 def _dev_memo(arr, tag: str = "up"):
     """Upload a host array once per distinct content."""
     a = np.asarray(arr)
     if not a.flags.c_contiguous:
         a = _as_f32(arr) if a.dtype == np.float32 else np.ascontiguousarray(a)
     key = (tag, _content_hash(a), a.shape, str(a.dtype))
-    return _memo(key, lambda: jnp.asarray(a))
+    return _memo(key, lambda: _upload_timed(a))
 
 
 #: past this element count the shared matrix uploads as bf16 (half the
@@ -341,7 +352,7 @@ def _dev_f32(X, tag: str = "X_f32"):
 
         def build():
             import ml_dtypes
-            return jnp.asarray(Xf.astype(ml_dtypes.bfloat16))
+            return _upload_timed(Xf.astype(ml_dtypes.bfloat16))
         return _memo(key, build)
     return _dev_memo(Xf, tag)
 
@@ -386,11 +397,15 @@ def _binned_cached(Xf: np.ndarray, hx: str, edges):
             # is one launch vs a ~10 s/1M-row host pass + a second upload.
             # (Binning the bf16 copy can flip values that sit within bf16
             # rounding of an edge — immaterial to quantile-bin trees.)
-            xdev = (_memo_peek(("X_bf16", hx, Xf.shape))
-                    or _memo_peek(("X_f32", hx, Xf.shape, "float32")))
+            # explicit None test: `or` would ask the device array for truth
+            xdev = _memo_peek(("X_bf16", hx, Xf.shape))
+            if xdev is None:
+                xdev = _memo_peek(("X_f32", hx, Xf.shape, "float32"))
             if xdev is not None:
+                from ..utils.profiling import count_launch
+                count_launch("device_bin")
                 return _apply_bins_i8(xdev, jnp.asarray(ef))
-            return jnp.asarray(_host_bins(Xf, ef))
+            return _upload_timed(_host_bins(Xf, ef))
         return apply_bins(jnp.asarray(Xf), jnp.asarray(ef))
     return _memo(key, build)
 

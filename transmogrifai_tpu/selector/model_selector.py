@@ -215,18 +215,15 @@ class ModelSelector(PredictorEstimator):
                 return _auroc_dev(y, scores, w)
             return None
         if self.problem_type == "regression":
+            if m not in ("RootMeanSquaredError", "MeanSquaredError",
+                         "MeanAbsoluteError", "R2"):
+                return None
+            from ..evaluators.metrics import _regression_metric_dev
+
             yj = jnp.asarray(y, jnp.float32)
             wj = (jnp.ones_like(yj) if w is None
                   else jnp.asarray(w, jnp.float32))
-            ws = jnp.maximum(wj.sum(), 1e-12)
-            err = scores - yj
-            if m == "RootMeanSquaredError":
-                return jnp.sqrt((wj * err ** 2).sum() / ws)
-            if m == "MeanSquaredError":
-                return (wj * err ** 2).sum() / ws
-            if m == "MeanAbsoluteError":
-                return (wj * jnp.abs(err)).sum() / ws
-            return None
+            return _regression_metric_dev(yj, scores, wj, m)
         if self.problem_type == "multiclass":
             from ..evaluators.metrics import _multiclass_core
 

@@ -296,7 +296,12 @@ def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
             M = None
             try:
                 M = run_group(group)       # (C_g, F) device/host matrix
-            except Exception:  # noqa: BLE001 - fall back to per-candidate
+            except Exception as e:  # noqa: BLE001 - fall back per-candidate
+                import warnings
+                warnings.warn(
+                    f"grid group {type(group).__name__} failed "
+                    f"({type(e).__name__}: {e}); falling back to "
+                    f"sequential candidate fits", RuntimeWarning)
                 M = None
             if M is not None:
                 for r in range(j - i):
@@ -375,11 +380,18 @@ def _materialize(nested: List[Any]) -> List[List[float]]:
     Grid-group rows (``_GroupRow``) resolve with one fetch per group matrix.
     """
     # resolve group matrices first (one transfer each, NaN rows on failure)
+    import time as _time
+
+    from ..utils.profiling import count_fetch
+
     mats: dict = {}
     for v in nested:
         if isinstance(v, _GroupRow) and id(v.matrix) not in mats:
             try:
-                mats[id(v.matrix)] = np.asarray(v.matrix, np.float64)
+                t0 = _time.perf_counter()
+                m = np.asarray(v.matrix, np.float64)
+                count_fetch(m.nbytes, _time.perf_counter() - t0)
+                mats[id(v.matrix)] = m
             except Exception:  # async device fault inside the group program
                 mats[id(v.matrix)] = None
     if mats:
@@ -405,7 +417,10 @@ def _materialize(nested: List[Any]) -> List[List[float]]:
     # scalar (~30 ms tunnel dispatch each); jitted it is ONE launch
     try:
         stacked = _stack_jit(*dev)
-        host = iter(np.asarray(stacked, np.float64))
+        t0 = _time.perf_counter()
+        fetched = np.asarray(stacked, np.float64)
+        count_fetch(fetched.nbytes, _time.perf_counter() - t0)
+        host = iter(fetched)
         return [[float(next(host)) if isinstance(v, jax.Array) else float(v)
                  for v in vals] for vals in nested]
     except Exception:

@@ -115,22 +115,27 @@ class PipelineStage:
     _NON_PARAMS = frozenset({"uid", "operation_name", "output_type"})
 
     def get_params(self) -> Dict[str, Any]:
-        """Hyperparameters = constructor kwargs, read back from attributes."""
+        """Hyperparameters = constructor kwargs, read back from attributes.
+
+        Only the RESOLVED constructor signature counts: a subclass that
+        re-parameterises its base (OpXGBoostClassifier's num_round/eta over
+        _GBTBase's max_iter/step_size) must not report the base's kwargs —
+        ``copy()`` feeds these back into ``__init__``, and base-only names
+        made every XGBoost ``copy()`` (hence every XGB selector candidate)
+        raise TypeError."""
         out = {}
-        for klass in type(self).__mro__:
-            if klass is object:
+        try:
+            sig = inspect.signature(type(self).__init__)
+        except (TypeError, ValueError):  # pragma: no cover - builtin init
+            return out
+        for name, p in sig.parameters.items():
+            if name in ("self",) or p.kind in (p.VAR_POSITIONAL,
+                                               p.VAR_KEYWORD):
                 continue
-            try:
-                sig = inspect.signature(klass.__init__)
-            except (TypeError, ValueError):
+            if name in self._NON_PARAMS:
                 continue
-            for name, p in sig.parameters.items():
-                if name in ("self",) or p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
-                    continue
-                if name in self._NON_PARAMS or name in out:
-                    continue
-                if hasattr(self, name):
-                    out[name] = getattr(self, name)
+            if hasattr(self, name):
+                out[name] = getattr(self, name)
         return out
 
     def set_params(self, **params) -> "PipelineStage":
@@ -206,8 +211,21 @@ class Estimator(PipelineStage):
         raise NotImplementedError
 
     def fit(self, data: ColumnarDataset) -> Model:
+        import time as _time
+
+        from ..utils.profiling import current_collector
+
         cols = [data[n] for n in self.input_names]
+        coll = current_collector()
+        t0 = _time.perf_counter()
         model = self.fit_columns(data, *cols)
+        if coll is not None:
+            # per-stage fit attribution (the Spark listener's per-stage
+            # metrics analogue) — custom tags, not OpStep enum entries
+            name = f"fit:{type(self).__name__}"
+            prev = float(coll.metrics.custom_tags.get(name, 0.0) or 0.0)
+            coll.metrics.custom_tags[name] = round(
+                prev + _time.perf_counter() - t0, 3)
         # the model answers for the same output feature / uid
         model.uid = self.uid
         model.operation_name = self.operation_name

@@ -22,7 +22,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["OpStep", "MetricsCollector", "AppMetrics", "StepMetrics",
            "with_job_group", "current_collector", "install_collector",
-           "profile_to"]
+           "profile_to", "RunCounters", "COUNTERS", "reset_counters",
+           "count_upload", "count_fetch", "count_launch"]
 
 
 class OpStep(enum.Enum):
@@ -149,6 +150,69 @@ def with_job_group(step: OpStep, collector: Optional[MetricsCollector] = None):
             coll.record(step, dt)
         if installed:
             _local.collector = None
+
+
+@dataclass
+class RunCounters:
+    """Transfer / dispatch accounting for one run.
+
+    Uploads and fetches are counted at the framework's own transfer sites
+    (``trees._dev_memo`` builds, ``validators._materialize``, binned-matrix
+    uploads); ``upload_s``/``fetch_s`` time the enqueuing call — through a
+    remote-device tunnel that call blocks for most of the wire time, so
+    these are honest lower bounds on transfer cost.  ``launches`` counts
+    explicit kernel dispatches at our call sites (tree-growth chunks,
+    grid-solver programs, scoring programs) — a design-level dispatch
+    count, not an XLA op count.
+    """
+
+    upload_bytes: int = 0
+    upload_s: float = 0.0
+    uploads: int = 0
+    fetch_bytes: int = 0
+    fetch_s: float = 0.0
+    fetches: int = 0
+    launches: int = 0
+    launch_tags: Dict[str, int] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "uploadBytes": self.upload_bytes,
+            "uploadSecs": round(self.upload_s, 3),
+            "uploads": self.uploads,
+            "fetchBytes": self.fetch_bytes,
+            "fetchSecs": round(self.fetch_s, 3),
+            "fetches": self.fetches,
+            "launches": self.launches,
+            "launchTags": dict(self.launch_tags),
+        }
+
+
+COUNTERS = RunCounters()
+
+
+def reset_counters() -> RunCounters:
+    """Zero the global transfer/dispatch counters; returns the new object."""
+    global COUNTERS
+    COUNTERS = RunCounters()
+    return COUNTERS
+
+
+def count_upload(nbytes: int, seconds: float) -> None:
+    COUNTERS.upload_bytes += int(nbytes)
+    COUNTERS.upload_s += seconds
+    COUNTERS.uploads += 1
+
+
+def count_fetch(nbytes: int, seconds: float) -> None:
+    COUNTERS.fetch_bytes += int(nbytes)
+    COUNTERS.fetch_s += seconds
+    COUNTERS.fetches += 1
+
+
+def count_launch(tag: str, n: int = 1) -> None:
+    COUNTERS.launches += n
+    COUNTERS.launch_tags[tag] = COUNTERS.launch_tags.get(tag, 0) + n
 
 
 @contextlib.contextmanager
