@@ -1,30 +1,34 @@
 #!/usr/bin/env python
-"""Benchmark suite — BASELINE.md configs 1, 4 and 5.
+"""Benchmark suite — BASELINE.md configs 1, 4 and 5 + device capability.
 
-Output contract: the LAST complete JSON line on stdout is the result.  In
-the default (full-suite) mode a Titanic-only fallback line is flushed
-before the long scale configs so an externally-truncated run still leaves
-a parseable result; the final line carries the full suite.
+Output contract: the LAST complete JSON line on stdout is the result, and a
+fresh headline line is RE-FLUSHED after EVERY config — an externally
+truncated run still leaves the latest complete suite state parseable
+(rc=124 loses at most the config that was mid-flight).
 
-Configs:
-  1. Titanic AutoML sweep (the reference's headline demo,
-     OpTitanicSimple.scala:75-117) — cold AND warm train reported.
-  4. 1M×500 synthetic tabular, full BinaryClassificationModelSelector
-     sweep, 3-fold CV (examples/bench_scale.py) — the north-star shape.
-  5. XGBoost-parity fit on wide sparse data (examples/bench_xgb_wide.py).
+Configs (run in rising-cost order under a wall-clock budget):
+  1        Titanic AutoML sweep (the reference's headline demo,
+           OpTitanicSimple.scala:75-117) — cold AND warm train.
+  kernels  Device-capability microbenchmarks: histogram-kernel effective
+           bandwidth + LR Gram MFU vs chip peaks (examples/bench_kernels).
+  4d       The reference's TRUE default BinaryClassificationModelSelector
+           grid — 28 candidates: LR 8, RF 18 @ numTrees=50 depth<=12,
+           XGB 2 @ NumRound=200 (BinaryClassificationModelSelector.scala:
+           54-108) — at 100k x 500, 3-fold CV.  Compared against this
+           framework's own measured 1-core XLA-CPU backend at the same
+           shape (extrapolated from subscale, benchmarks/baselines.json).
+  5        XGBoost-parity fit on wide sparse data (synthetic Criteo
+           stand-in), 250k x 1000 @ 200 rounds (examples/bench_xgb_wide).
+  4        1M x 500 light grid (6 candidates) — the r1/r2 longitudinal
+           headline shape, labeled as such.
 
-The headline metric/value/vs_baseline is config 4; per-config details nest
-under "configs".  Baselines come from benchmarks/baselines.json: configs 1
-and 4 compare against LABELLED conservative Spark-local estimates (no
-Spark exists in this image to measure), config 5 against this framework's
-own measured 1-core XLA-CPU backend extrapolated linearly in rows; config
-4 additionally reports vs_cpu_1core against that CPU reference.  Method,
-measurements, and the honest tunnel-latency finding:
-benchmarks/BASELINE_DERIVATION.md.
-
-Env knobs: TMOG_BENCH_SCALE=0 skips configs 4-5 (Titanic-only quick line);
-TMOG_BENCH_SCALE_WARM=1 adds an untimed warmup train before config 4's
-timed train (~doubles runtime).
+Env knobs:
+  TMOG_BENCH_SCALE=0       Titanic-only quick line.
+  TMOG_BENCH_BUDGET_S=N    wall-clock budget (default 1800); configs whose
+                           rough cost estimate exceeds the remaining budget
+                           are skipped with a recorded reason.
+  TMOG_BENCH_SCALE_WARM=1  untimed warmup train before config 4's timed
+                           train (~doubles its runtime).
 """
 import json
 import os
@@ -43,13 +47,16 @@ TITANIC = "/root/reference/test-data/PassengerDataAll.csv"
 COLS = ["PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
         "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked"]
 
+_T0 = time.perf_counter()
+
 
 def _log(msg):
     print(f"[bench {time.perf_counter()-_T0:7.1f}s] {msg}",
           file=sys.stderr, flush=True)
 
 
-_T0 = time.perf_counter()
+def _elapsed():
+    return time.perf_counter() - _T0
 
 
 def _baselines():
@@ -125,54 +132,101 @@ def run_titanic() -> dict:
 
 
 def main():
+    budget = float(os.environ.get("TMOG_BENCH_BUDGET_S", "1800"))
     results = {"titanic": run_titanic()}
     headline = dict(results["titanic"])
 
-    if os.environ.get("TMOG_BENCH_SCALE", "1") != "0":
-        # fallback line, flushed NOW: if the scale configs are killed by an
-        # external timeout, the last complete JSON line on stdout is still a
-        # valid result (a tail-parser picks up whichever line is final)
-        print(json.dumps(headline), flush=True)
+    def flush():
+        line = dict(headline)
+        line["configs"] = results
+        line["elapsed_s"] = round(_elapsed(), 1)
+        print(json.dumps(line), flush=True)
 
+    flush()
+    if os.environ.get("TMOG_BENCH_SCALE", "1") == "0":
+        return
+
+    base = _baselines()
+
+    def over_budget(name: str, estimate_s: float) -> bool:
+        if _elapsed() + estimate_s > budget:
+            results[name] = {
+                "skipped": f"estimated {estimate_s:.0f}s exceeds remaining "
+                           f"budget ({budget - _elapsed():.0f}s of "
+                           f"{budget:.0f}s)"}
+            _log(f"{name}: SKIPPED (budget)")
+            return True
+        return False
+
+    # -- device capability ---------------------------------------------------
+    if not over_budget("kernels", 120):
+        import bench_kernels
+        _log("kernels: device-capability microbench")
+        results["kernels"] = bench_kernels.run()
+        flush()
+
+    # -- config 4d: the reference's true default grid ------------------------
+    if not over_budget("default_grid_100k_x_500", 600):
         import bench_scale
-        import bench_xgb_wide
-
-        base = _baselines()
-        sb = base["scale_1m_x_500"]
-        _log("scale: 1M x 500 full selector sweep")
-        scale = bench_scale.run(
-            1_000_000, 500, folds=3,
-            warmup=os.environ.get("TMOG_BENCH_SCALE_WARM") == "1",
-            baseline_s=sb["baseline_s"])
-        scale["baseline_kind"] = sb["kind"]
-        cpu_ref = sb.get("cpu_1core_measured", {}).get("extrapolated_1m_s")
+        db = base.get("default_grid_100k_x_500", {})
+        _log("default grid: 28 candidates @ 100k x 500")
+        d = bench_scale.run(100_000, 500, folds=3, which_grid="default",
+                            baseline_s=db.get("baseline_s", 1800.0))
+        d["baseline_kind"] = db.get("kind", "assumed")
+        cpu_ref = db.get("cpu_1core_measured", {}).get("extrapolated_100k_s")
         if cpu_ref:
-            # same framework on 1-core XLA-CPU (see BASELINE_DERIVATION.md)
-            scale["cpu_1core_ref_s"] = cpu_ref
-            scale["vs_cpu_1core"] = round(cpu_ref / scale["value"], 2)
-        results["scale_1m_x_500"] = scale
-        _log(f"scale: {scale['value']}s ({scale['vs_baseline']}x); "
-             "xgb wide-sparse fit")
+            d["cpu_1core_ref_s"] = cpu_ref
+            d["vs_cpu_1core"] = round(cpu_ref / d["value"], 2)
+        results["default_grid_100k_x_500"] = d
+        headline = {
+            "metric": "automl_default_grid_100k_x_500_wall_clock",
+            "value": d["value"], "unit": "s",
+            "vs_baseline": d.get("vs_cpu_1core", d["vs_baseline"]),
+            "aupr": d["aupr"],
+            "candidates": d["candidates"],
+            "candidate_errors": d["candidate_errors"],
+            "baseline_kind": ("measured 1-core XLA-CPU, same shape "
+                              "(extrapolated from subscale)"
+                              if cpu_ref else d["baseline_kind"]),
+        }
+        _log(f"default grid: {d['value']}s, {d['candidates']} candidates, "
+             f"{d['candidate_errors']} errors")
+        flush()
 
-        xgb = bench_xgb_wide.run()
+    # -- config 5: XGB wide-sparse -------------------------------------------
+    if not over_budget("xgb_wide", 500):
+        import bench_xgb_wide
         xb = base["xgb_wide"]
+        _log("xgb: wide-sparse fit 250k x 1000 @ 200 rounds")
+        xgb = bench_xgb_wide.run()
         if xb.get("baseline_s"):
             xgb["vs_baseline"] = round(xb["baseline_s"] / xgb["value"], 2)
             xgb["baseline_s"] = xb["baseline_s"]
             xgb["baseline_kind"] = xb["kind"]
         results["xgb_wide"] = xgb
         _log(f"xgb: {xgb['value']}s")
+        flush()
 
-        headline = {
-            "metric": "automl_1m_x_500_selector_sweep_wall_clock",
-            "value": scale["value"], "unit": "s",
-            "vs_baseline": scale["vs_baseline"],
-            "aupr": scale["aupr"],
-            "baseline_kind": scale["baseline_kind"],
-        }
+    # -- config 4: the longitudinal 1M x 500 light grid ----------------------
+    if not over_budget("scale_1m_x_500", 600):
+        import bench_scale
+        sb = base["scale_1m_x_500"]
+        _log("scale: 1M x 500 light grid (r1/r2-comparable)")
+        scale = bench_scale.run(
+            1_000_000, 500, folds=3, which_grid="light",
+            warmup=os.environ.get("TMOG_BENCH_SCALE_WARM") == "1",
+            baseline_s=sb["baseline_s"])
+        scale["baseline_kind"] = sb["kind"]
+        cpu_ref = sb.get("cpu_1core_measured", {}).get("extrapolated_1m_s")
+        if cpu_ref:
+            scale["cpu_1core_ref_s"] = cpu_ref
+            scale["vs_cpu_1core"] = round(cpu_ref / scale["value"], 2)
+        results["scale_1m_x_500"] = scale
+        _log(f"scale: {scale['value']}s ({scale.get('vs_cpu_1core', '?')}x "
+             "vs 1-core CPU)")
+        flush()
 
-    headline["configs"] = results
-    print(json.dumps(headline), flush=True)
+    flush()
 
 
 if __name__ == "__main__":

@@ -46,19 +46,49 @@ def make_data(rows: int, cols: int, seed: int = 11):
     return df
 
 
-def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
-        baseline_s: float = SPARK_LOCAL_BASELINE_S) -> dict:
-    """One measured sweep at (rows, cols); importable by bench.py."""
+def default_grid_models():
+    """The reference's ACTUAL default binary grid — 28 candidates: the
+    library's own LR+RF defaults (model_selector._binary_defaults, the one
+    source of truth) plus the XGB block the reference's modelTypesToUse
+    enables (BinaryClassificationModelSelector.scala:54-108,
+    DefaultSelectorParams.scala:36-75; NumRound=200 x 2 minChildWeight)."""
+    from transmogrifai_tpu.models import OpXGBoostClassifier
+    from transmogrifai_tpu.selector import DefaultSelectorParams as D
+    from transmogrifai_tpu.selector import grid
+    from transmogrifai_tpu.selector.model_selector import _binary_defaults
 
-    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
-    from transmogrifai_tpu.evaluators import Evaluators
+    return _binary_defaults() + [
+        (OpXGBoostClassifier(), grid(
+            min_child_weight=D.MIN_CHILD_WEIGHT_XGB)),
+    ]
+
+
+def light_grid_models():
+    """The r1/r2 longitudinal light grid (6 candidates, 20-tree RF)."""
     from transmogrifai_tpu.models import (
         OpLogisticRegression, OpRandomForestClassifier,
     )
+    from transmogrifai_tpu.selector import grid
+
+    return [
+        (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
+        (OpRandomForestClassifier(num_trees=20),
+         grid(max_depth=[4, 6], min_instances_per_node=[10, 100])),
+    ]
+
+
+def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
+        baseline_s: float = SPARK_LOCAL_BASELINE_S,
+        which_grid: str = "light") -> dict:
+    """One measured sweep at (rows, cols); importable by bench.py.
+
+    ``which_grid``: 'light' (r1/r2-comparable 6 candidates) or 'default'
+    (the reference's true 28-candidate default grid incl. XGB@200)."""
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.evaluators import Evaluators
     from transmogrifai_tpu.preparators import SanityChecker
-    from transmogrifai_tpu.selector import (
-        BinaryClassificationModelSelector, grid,
-    )
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
 
     t0 = time.perf_counter()
     df = make_data(rows, cols)
@@ -71,11 +101,9 @@ def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
         label, features).get_output()
     selector = BinaryClassificationModelSelector.with_cross_validation(
         num_folds=folds,
-        models_and_parameters=[
-            (OpLogisticRegression(), grid(reg_param=[0.01, 0.1])),
-            (OpRandomForestClassifier(num_trees=20),
-             grid(max_depth=[4, 6], min_instances_per_node=[10, 100])),
-        ])
+        models_and_parameters=(default_grid_models()
+                               if which_grid == "default"
+                               else light_grid_models()))
     prediction = selector.set_input(label, checked).get_output()
     wf = OpWorkflow().set_result_features(prediction).set_input_data(df)
 
@@ -106,6 +134,7 @@ def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
     return {
         "candidates": len(summ.get("validationResults", [])),
         "candidate_errors": n_err,
+        "grid": which_grid,
         "metric": "scale_automl_train_wall_clock",
         "rows": rows, "cols": cols,
         "value": round(train_s, 1), "unit": "s",
@@ -129,11 +158,15 @@ def main():
     ap.add_argument("--folds", type=int, default=3)
     ap.add_argument("--warmup", action="store_true",
                     help="train once untimed first (exclude compile costs)")
+    ap.add_argument("--grid", default="light",
+                    choices=["light", "default"],
+                    help="light (r1/r2-comparable 6 candidates) or the "
+                         "reference's true 28-candidate default grid")
     args = ap.parse_args()
     if args.full:
         args.rows, args.cols = 1_000_000, 500
     print(json.dumps(run(args.rows, args.cols, folds=args.folds,
-                         warmup=args.warmup)))
+                         warmup=args.warmup, which_grid=args.grid)))
 
 
 if __name__ == "__main__":

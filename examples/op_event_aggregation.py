@@ -86,7 +86,10 @@ def main():
                                 grid(reg_param=[0.01, 0.1]))])
         .set_input(label, checked).get_output())
 
-    model = (OpWorkflow().set_result_features(pred)
+    # lambda extractors cannot survive a save/load round trip; the train-time
+    # serializability gate rejects them unless explicitly allowed — this
+    # demo never persists its model
+    model = (OpWorkflow().allow_non_serializable().set_result_features(pred)
              .set_reader(reader).train())
     _, metrics = model.score_and_evaluate(
         Evaluators.BinaryClassification.auROC())

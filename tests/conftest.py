@@ -36,3 +36,9 @@ def _reset_uids():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running scale tests (run in CI, skippable "
+        "locally with -m 'not slow')")

@@ -112,3 +112,51 @@ class TestLocalScoring:
         row = {"age": 33.0, "income": 50000.0, "color": "red"}
         out = fn(row)
         assert 0.0 <= out[pred.name]["probability_1"] <= 1.0
+
+
+class TestSerializabilityGate:
+    """Train-time serializability gate (OpWorkflow.scala:280 parity)."""
+
+    def _wf(self):
+        import numpy as np
+        import pandas as pd
+
+        from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+        from transmogrifai_tpu.features.builder import FeatureBuilder as FB
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, grid,
+        )
+        from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+        rng = np.random.default_rng(0)
+        df = pd.DataFrame({"label": (rng.random(300) < 0.5).astype(float),
+                           "a": rng.normal(size=300),
+                           "b": rng.normal(size=300)})
+        label = FeatureBuilder.RealNN("label").as_response()
+        # lambda extract: must NOT survive a save/load round trip
+        a = FeatureBuilder.Real("a").extract(lambda r: r["a"]) \
+            .as_predictor()
+        b = FeatureBuilder.Real("b").as_predictor()
+        vec = RealVectorizer().set_input(a, b).get_output()
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(OpLogisticRegression(),
+                                    grid(reg_param=[0.1]))])
+        pred = sel.set_input(label, vec).get_output()
+        from transmogrifai_tpu import OpWorkflow
+        return OpWorkflow().set_result_features(pred).set_input_data(df)
+
+    def test_lambda_extract_fails_train_with_actionable_error(self):
+        import pytest
+
+        wf = self._wf()
+        with pytest.raises(ValueError) as e:
+            wf.train()
+        msg = str(e.value)
+        assert "extract_fn" in msg
+        assert "allow_non_serializable" in msg
+
+    def test_opt_out_trains(self):
+        wf = self._wf().allow_non_serializable()
+        model = wf.train()
+        assert model is not None

@@ -765,10 +765,12 @@ def _chain_es_metric_jit(Fm, y, vi, obj: str):
 
 
 def gbt_chain_chunk(n_chains: int, max_depth: int, d: int, n_bins: int,
-                    n_rows: int, budget: int = HIST_BYTES_BUDGET) -> int:
+                    n_rows: int, budget: int = 2 * HIST_BYTES_BUDGET) -> int:
     """Chains per round launch: the (ROW_BLOCK, B*D) bins one-hot is shared
     (counted once), per-chain terms are the slot one-hot + the 3-channel
-    histogram accumulator."""
+    histogram accumulator.  The budget is deliberately larger than the
+    forest chunker's — splitting a round across launches re-materializes
+    the shared one-hot stream, the round's dominant cost."""
     slots = 2 ** (max_depth - 1)
     if n_rows is not None:
         slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))

@@ -10,12 +10,14 @@ Reference model wrappers these back:
 TPU-first design decisions:
  * Full-batch second-order solvers: tabular designs are (N large, D moderate),
    so one Newton/IRLS step = one (D,N)@(N,D) matmul on the MXU + a (D,D)
-   Cholesky solve — far fewer passes over HBM than SGD.  Elastic net adds a
-   proximal step (ISTA-style) around the Newton direction.
+   Cholesky solve — far fewer passes over HBM than SGD.  Elastic net runs
+   exact proximal-gradient (scalar-majorizer FISTA) to the true composite
+   optimum.
  * Everything is ``jax.jit``-compiled with static shapes and
-   ``lax.while_loop``/``fori_loop`` control flow, so the same compiled
-   program serves every fold × hyperparameter via ``vmap`` (no re-tracing
-   per grid point — SURVEY §7 hard part c).
+   ``lax.while_loop``/``fori_loop`` control flow; the grid trainers
+   (``fit_logreg_grid``, ``fit_linreg_grid``) run the WHOLE folds ×
+   hyperparameter product as one program with traced reg/alpha vectors
+   (no re-tracing per grid point — SURVEY §7 hard part c).
  * Sample weights everywhere: cross-validation folds are expressed as 0/1
    weight masks over one resident feature matrix, so fold training never
    reshapes or copies data (static shapes on device).

@@ -741,6 +741,7 @@ class _GBTBase(PredictorEstimator):
         vi_dev = (jnp.asarray(val_idx, jnp.int32)
                   if use_es and len(val_idx) else None)
         pending: list = []
+        lagged: list = []
         stop = False
         for it in range(self.max_iter):
             G, H = _grad_hess(obj, F, yj, Yj, twj)
@@ -779,19 +780,22 @@ class _GBTBase(PredictorEstimator):
             if use_es and len(val_idx):
                 pending.append((len(feats),
                                 self._eval_metric_dev(F, yj, vi_dev)))
-                if len(pending) >= es_chunk or it == self.max_iter - 1:
-                    vals = np.asarray(jnp.stack([m for _, m in pending]))
-                    for (n_at, _), m in zip(pending, vals):
-                        if float(m) > best_metric + 1e-9:
-                            best_metric, best_len, stall = float(m), n_at, 0
-                        else:
-                            stall += 1
-                            if stall >= self.early_stopping_rounds:
-                                stop = True
-                                break
-                    pending = []
+                if len(pending) >= es_chunk:
+                    # LAGGED fetch: materialize the chunk enqueued one chunk
+                    # ago (finished ~es_chunk rounds back — near-free sync)
+                    # instead of blocking on the fresh one, which would
+                    # serialize the boosting pipeline on the fetch round trip
+                    best_metric, best_len, stall, stop = _es_patience(
+                        _materialize_es(lagged), best_metric, best_len,
+                        stall, self.early_stopping_rounds)
+                    lagged, pending = pending, []
                     if stop:
                         break
+        if use_es and len(val_idx) and not stop:
+            # drain the in-flight chunks so best_len is exact
+            best_metric, best_len, stall, _ = _es_patience(
+                _materialize_es(lagged + pending), best_metric, best_len,
+                stall, self.early_stopping_rounds)
         if use_es and best_len:
             feats, threshs, leaves = (feats[:best_len], threshs[:best_len],
                                       leaves[:best_len])
@@ -815,6 +819,29 @@ class _GBTBase(PredictorEstimator):
             return jnp.mean((jnp.argmax(F[vi], axis=1)
                              == yj[vi].astype(jnp.int32)).astype(jnp.float32))
         return -jnp.mean((F[vi, 0] - yj[vi]) ** 2)
+
+
+def _materialize_es(chunk_rows):
+    """Fetch a chunk of (round, device-metric) pairs as (round, float)."""
+    if not chunk_rows:
+        return []
+    vals = np.asarray(jnp.stack([m for _, m in chunk_rows]))
+    return [(n_at, float(m)) for (n_at, _), m in zip(chunk_rows, vals)]
+
+
+def _es_patience(rows, best_metric, best_len, stall, patience):
+    """THE single-chain early-stopping patience rule (improve/stall/stop),
+    shared by the in-loop lagged replay and the post-loop drain."""
+    stop = False
+    for n_at, m in rows:
+        if m > best_metric + 1e-9:
+            best_metric, best_len, stall = m, n_at, 0
+        else:
+            stall += 1
+            if stall >= patience:
+                stop = True
+                break
+    return best_metric, best_len, stall, stop
 
 
 def _grad_hess(obj, F, y, Y, w):

@@ -195,18 +195,29 @@ def _decode(schema, dec: _Decoder, named: Dict[str, Any]):
 
 
 def _union_branch(schema_list, value):
-    """Index of the union branch matching a Python value (writer side)."""
-    def matches(s, v):
+    """Index of the union branch matching a Python value (writer side).
+
+    Two passes — exact type matches first (int -> int/long, str -> string,
+    enum only when the symbol is a member), widening matches second (int
+    under a ['double'] union) — so the written branch index agrees with a
+    reference Avro writer's choice instead of whichever loose match comes
+    first."""
+    def matches(s, v, exact):
         base = s if isinstance(s, str) else s.get("type")
         if v is None:
             return base == "null"
         if isinstance(v, bool):
             return base == "boolean"
         if isinstance(v, (int, np.integer)):
-            return base in ("int", "long", "double", "float")
+            return (base in ("int", "long") if exact
+                    else base in ("int", "long", "double", "float"))
         if isinstance(v, (float, np.floating)):
             return base in ("double", "float")
         if isinstance(v, str):
+            if exact:
+                return base == "string" or (
+                    base == "enum" and not isinstance(s, str)
+                    and v in s.get("symbols", ()))
             return base in ("string", "enum")
         if isinstance(v, bytes):
             return base in ("bytes", "fixed")
@@ -215,9 +226,10 @@ def _union_branch(schema_list, value):
         if isinstance(v, (list, tuple)):
             return base == "array"
         return False
-    for i, s in enumerate(schema_list):
-        if matches(s, value):
-            return i
+    for exact in (True, False):
+        for i, s in enumerate(schema_list):
+            if matches(s, value, exact):
+                return i
     raise ValueError(f"no union branch in {schema_list} for {value!r}")
 
 

@@ -351,7 +351,8 @@ class GBTGridGroup(GridGroup):
               if use_es and val.any() else None)
 
         feats_r, threshs_r, leaves_r = [], [], []
-        pending = []
+        pending: list = []
+        lagged: list = []
         best_metric = np.full(S, -np.inf)
         best_len = np.zeros(S, np.int32)
         stall = np.zeros(S, np.int32)
@@ -388,19 +389,25 @@ class GBTGridGroup(GridGroup):
             n_rounds = it + 1
             if use_es and vi is not None:
                 pending.append((n_rounds, _chain_es_metric(Fm, yj, vi, obj)))
-                if len(pending) >= es_chunk or it == e0.max_iter - 1:
-                    vals = np.asarray(jnp.stack([m for _, m in pending]))
-                    for (n_at, _), mrow in zip(pending, vals):
-                        live = ~stopped
-                        better = live & (mrow > best_metric + 1e-9)
-                        best_metric[better] = mrow[better]
-                        best_len[better] = n_at
-                        stall[better] = 0
-                        stall[live & ~better] += 1
-                        stopped |= stall >= e0.early_stopping_rounds
-                    pending = []
-                    if stopped.all():
+                if len(pending) >= es_chunk:
+                    # LAGGED fetch: materialize the chunk enqueued ONE chunk
+                    # ago (its device values finished ~es_chunk rounds back,
+                    # so the sync is ~free) — blocking on the fresh chunk
+                    # every 8 rounds serialized the whole pipeline (measured
+                    # ~0.9 s/round, fetch-bound).  ES decisions lag one
+                    # chunk; at most 2*es_chunk extra rounds grow and are
+                    # trimmed, exactly like the in-chunk replay.
+                    if _replay_es(lagged, stopped, best_metric, best_len,
+                                  stall, e0.early_stopping_rounds):
                         break
+                    lagged = pending
+                    pending = []
+        if use_es and vi is not None and not stopped.all():
+            # drain the in-flight chunks so the final best_len is exact
+            for tail in (lagged, pending):
+                if _replay_es(tail, stopped, best_metric, best_len, stall,
+                              e0.early_stopping_rounds):
+                    break
         if not use_es:
             best_len[:] = n_rounds
         else:
@@ -422,6 +429,26 @@ class GBTGridGroup(GridGroup):
         if m is None:
             return None
         return m.T
+
+
+def _replay_es(chunk_rows, stopped, best_metric, best_len, stall,
+               patience: int) -> bool:
+    """Replay one fetched chunk of per-chain ES metrics against the
+    host-side patience state (in place); True when every chain stopped."""
+    if not chunk_rows:
+        return bool(stopped.all())
+    import jax.numpy as jnp
+
+    vals = np.asarray(jnp.stack([m for _, m in chunk_rows]))
+    for (n_at, _), mrow in zip(chunk_rows, vals):
+        live = ~stopped
+        better = live & (mrow > best_metric + 1e-9)
+        best_metric[better] = mrow[better]
+        best_len[better] = n_at
+        stall[better] = 0
+        stall[live & ~better] += 1
+        stopped |= stall >= patience
+    return bool(stopped.all())
 
 
 def _grow_gbt_chain_round(binned, yj, Wj, Fm, depth_lim, lams, mcws, migs,

@@ -7,8 +7,10 @@ Reference: ``OpValidator`` (impl/tuning/OpValidator.scala:94,214,363),
 TPU redesign of the reference's folds×models JVM thread pool: every fold is a
 0/1 *weight mask* over the single device-resident matrix (no per-fold copies),
 so one XLA-compiled trainer program serves all folds × all hyperparameter
-points; candidates with identical structure are additionally batched with
-``vmap`` (grid axis) by trainers that support it (SURVEY §2.12 row 2).
+points; runs of same-family candidates additionally fit as ONE batched
+program over the (folds, candidates) grid via ``selector.grid_groups``
+(LR majorization grid, RF tree streams, GBT lockstep chains — SURVEY
+§2.12 row 2), with transparent per-candidate fallback.
 """
 from __future__ import annotations
 

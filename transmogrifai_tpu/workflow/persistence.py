@@ -34,7 +34,7 @@ from ..stages.generator import FeatureGeneratorStage
 from ..types.feature_types import FeatureType, type_by_name
 
 __all__ = ["save_workflow_model", "load_workflow_model", "MODEL_JSON",
-           "FORMAT_VERSION"]
+           "FORMAT_VERSION", "check_serializable"]
 
 MODEL_JSON = "op-model.json"
 ARRAYS_NPZ = "arrays.npz"
@@ -98,6 +98,65 @@ def _encode(value: Any, key: str, store: _ArrayStore) -> Any:
             stacklevel=2)
         return {"__callable__": getattr(value, "__name__", "<fn>")}
     return {"__repr__": repr(value)}
+
+
+def _find_unserializable(value: Any, path: str, out: List[str]) -> None:
+    """Collect param paths whose values ``_encode`` would stub (callables).
+
+    Mirrors ``_encode``'s dispatch order — feature-type classes, stages,
+    arrays etc. all round-trip and are skipped."""
+    if isinstance(value, _ARRAY_TYPES) or value is None \
+            or isinstance(value, (bool, int, float, str, np.generic)):
+        return
+    if isinstance(value, PipelineStage):
+        for n in _find_unserializable_stage(value):
+            out.append(f"{path}.{n}")
+        return
+    if isinstance(value, VectorMetadata):
+        return
+    if isinstance(value, type) and issubclass(value, FeatureType):
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _find_unserializable(v, f"{path}.{k}", out)
+        return
+    if isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            _find_unserializable(v, f"{path}[{i}]", out)
+        return
+    if callable(value):
+        out.append(path)
+
+
+def _find_unserializable_stage(stage: PipelineStage) -> List[str]:
+    out: List[str] = []
+    for name, value in stage.get_params().items():
+        _find_unserializable(value, name, out)
+    return out
+
+
+def check_serializable(stages) -> None:
+    """Train-time serializability gate (``OpWorkflow.checkSerializable``,
+    OpWorkflow.scala:280): fail FAST — naming the stage and param — when a
+    stage parameter would not survive a save/load round trip, instead of
+    silently stubbing it at save time (a model trained from
+    lambda-extracted features would otherwise lose its extractors on load;
+    raw features are covered through their generator stages in the DAG).
+    Named module-level functions do not round-trip either (the persistence
+    format records ctor kwargs, not code), so the remedy is by-name
+    extraction (extract_fn=None) or ``OpWorkflow.allow_non_serializable()``.
+    """
+    problems: List[str] = []
+    for s in stages:
+        for p in _find_unserializable_stage(s):
+            problems.append(f"stage {type(s).__name__}[{s.uid}] param {p!r}")
+    if problems:
+        raise ValueError(
+            "workflow contains state that cannot survive a save/load round "
+            "trip:\n  - " + "\n  - ".join(problems) +
+            "\nUse by-name extraction / serializable params, or opt out "
+            "with OpWorkflow.allow_non_serializable() to train anyway "
+            "(saving will stub these values).")
 
 
 def _decode(value: Any, arrays) -> Any:

@@ -68,7 +68,15 @@ class OpWorkflow(_WorkflowCore):
         self._raw_feature_filter = None
         self._model_stages: Dict[str, Model] = {}
         self._workflow_cv = False
+        self._allow_non_serializable = False
         self.mesh = None
+
+    def allow_non_serializable(self) -> "OpWorkflow":
+        """Opt out of the train-time serializability gate: train with
+        lambda/callable stage params anyway (saving will stub them with a
+        warning; the loaded model falls back to default behavior)."""
+        self._allow_non_serializable = True
+        return self
 
     def with_mesh(self, mesh) -> "OpWorkflow":
         """Train the WHOLE workflow on a device mesh: every mesh-capable
@@ -219,12 +227,18 @@ class OpWorkflow(_WorkflowCore):
         return model
 
     def _validate_stages(self, dag: StagesDAG) -> None:
-        """Distinct-uid check (OpWorkflow.scala:280-338 analogue)."""
+        """Distinct-uid + serializability checks (the reference fails fast
+        at train time too — OpWorkflow.checkSerializable,
+        OpWorkflow.scala:280-338)."""
         seen = set()
         for s in dag.all_stages():
             if s.uid in seen:
                 raise ValueError(f"duplicate stage uid {s.uid}")
             seen.add(s.uid)
+        if not self._allow_non_serializable:
+            from .persistence import check_serializable
+
+            check_serializable(dag.all_stages())
 
     def compute_data_up_to(self, feature: Feature,
                            data=None) -> ColumnarDataset:
