@@ -208,7 +208,8 @@ def main():
         flush()
 
     # -- config 4: the longitudinal 1M x 500 light grid ----------------------
-    if not over_budget("scale_1m_x_500", 600):
+    scale_warm = os.environ.get("TMOG_BENCH_SCALE_WARM") == "1"
+    if not over_budget("scale_1m_x_500", 1200 if scale_warm else 600):
         import bench_scale
         sb = base["scale_1m_x_500"]
         _log("scale: 1M x 500 light grid (r1/r2-comparable)")

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Per-group wall-clock profile of the default-grid sweep's components.
+
+Times each grid group's ``run`` (LR 8, RF 18 @ 50 trees depth<=12, XGB 2 @
+200 rounds) separately on the same fold weights, so the 28-candidate bench
+number decomposes into attributable parts.  Usage:
+    python examples/profile_default_grid.py [--rows N] [--cols D]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from transmogrifai_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--cols", type=int, default=500)
+    ap.add_argument("--folds", type=int, default=3)
+    ap.add_argument("--skip", default="")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from bench_scale import make_data
+    from transmogrifai_tpu.models import OpXGBoostClassifier
+    from transmogrifai_tpu.models.gbdt_kernels import compile_depth_hint
+    from transmogrifai_tpu.selector import DefaultSelectorParams as D
+    from transmogrifai_tpu.selector import grid
+    from transmogrifai_tpu.selector.grid_groups import make_grid_group
+    from transmogrifai_tpu.selector.model_selector import _binary_defaults
+    from transmogrifai_tpu.utils import profiling
+
+    df = make_data(args.rows, args.cols)
+    y = df["label"].to_numpy(np.float32)
+    X = df.iloc[:, 1:].to_numpy(np.float32)
+    n = len(y)
+
+    rng = np.random.default_rng(7)
+    fold = rng.integers(0, args.folds, n)
+    ctxs = []
+    for f in range(args.folds):
+        w_tr = (fold != f).astype(np.float32)
+        w_ev = (fold == f).astype(np.float32)
+        ctxs.append((w_tr, w_ev))
+
+    mps = _binary_defaults() + [
+        (OpXGBoostClassifier(), grid(min_child_weight=D.MIN_CHILD_WEIGHT_XGB)),
+    ]
+    skip = set(args.skip.split(",")) if args.skip else set()
+    depths = [int(p.get("max_depth", getattr(proto, "max_depth", 5) or 5))
+              for proto, pts in mps for p in pts
+              if hasattr(proto, "max_depth")]
+    with compile_depth_hint(max(depths)):
+        for proto, pts in mps:
+            name = type(proto).__name__
+            if name in skip:
+                continue
+            g = make_grid_group(proto, pts, "binary", "AuPR")
+            if g is None:
+                print(f"{name}: NO GROUP")
+                continue
+            profiling.reset_counters()
+            t0 = time.perf_counter()
+            M = g.run(X, y, ctxs)
+            if M is not None:
+                M = np.asarray(M)
+            dt = time.perf_counter() - t0
+            c = profiling.COUNTERS.to_json()
+            print(f"{name}: {len(pts)} cands x {args.folds} folds = "
+                  f"{dt:.1f}s  launches={c.get('launches')} "
+                  f"tags={c.get('launchTags')} "
+                  f"best={float(np.nanmax(M)) if M is not None else None}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

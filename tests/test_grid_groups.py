@@ -56,12 +56,18 @@ class TestGroupConstruction:
         assert make_grid_group(OpRandomForestRegressor(),
                                grid(max_depth=[3]), "regression",
                                "RootMeanSquaredError") is not None
+        # multiclass families batch too (round-3 softmax/argmax groups)
+        assert make_grid_group(OpLogisticRegression(), grid(reg_param=[0.1]),
+                               "multiclass", "F1", n_classes=3) is not None
+        assert make_grid_group(OpRandomForestClassifier(),
+                               grid(max_depth=[3]), "multiclass",
+                               "F1", n_classes=3) is not None
         # unsupported metric / problem -> no group
         assert make_grid_group(OpLogisticRegression(), grid(reg_param=[0.1]),
                                "binary", "F1") is None
         assert make_grid_group(OpRandomForestClassifier(),
                                grid(max_depth=[3]), "multiclass",
-                               "F1") is None
+                               "LogLoss") is None
 
     def test_non_batchable_params_decline(self):
         X, y = _binary_data(400, 6)
@@ -105,6 +111,67 @@ class TestRFGridParity:
         best, res = _run_selector(mp, "regression", X, yr)
         assert all(r.error is None for r in res)
         assert all(np.isfinite(r.metric_value) for r in res)
+
+
+def _multiclass_data(n=3000, d=10, k=3, seed=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    B = rng.normal(size=(d, k)) * 1.5
+    Z = X @ B + rng.gumbel(size=(n, k))
+    y = Z.argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+class TestMulticlassGridParity:
+    def test_softmax_group_matches_sequential(self, monkeypatch):
+        X, y = _multiclass_data()
+        mp = [(OpLogisticRegression(),
+               grid(reg_param=[0.001, 0.1], elastic_net_param=[0.0, 0.5]))]
+        best_g, res_g = _run_selector(mp, "multiclass", X, y)
+        assert all(r.error is None for r in res_g)
+
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "multiclass", X, y)
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    abs=1e-2)
+
+    def test_rf_multiclass_group_matches_sequential(self, monkeypatch):
+        X, y = _multiclass_data(2000, 8, 4, seed=9)
+        mp = [(OpRandomForestClassifier(num_trees=8),
+               grid(max_depth=[3, 5]))]
+        best_g, res_g = _run_selector(mp, "multiclass", X, y)
+        assert all(r.error is None for r in res_g)
+
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "multiclass", X, y)
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            # identical bags + identical depth masking -> float-level match
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    abs=2e-3)
+
+    def test_multiclass_metric_grid_matches_host(self):
+        from transmogrifai_tpu.evaluators.metrics import (
+            multiclass_metric_grid, multiclass_metrics,
+        )
+        rng = np.random.default_rng(4)
+        y = rng.integers(0, 3, 500)
+        preds = rng.integers(0, 3, (2, 3, 500)).astype(np.float32)
+        W = rng.random((2, 500)).astype(np.float32)
+        for metric in ("F1", "Error", "Accuracy", "Precision", "Recall"):
+            M = np.asarray(multiclass_metric_grid(y, preds, W, 3, metric))
+            for f in range(2):
+                for c in range(3):
+                    ref = multiclass_metrics(
+                        y, preds[f, c].astype(int), 3,
+                        sample_weight=W[f])[metric]
+                    assert M[f, c] == pytest.approx(ref, abs=1e-5)
 
 
 class TestLinearGridParity:
@@ -192,7 +259,7 @@ class TestGroupFailureIsolation:
 
         monkeypatch.setattr(
             grid_groups, "make_grid_group",
-            lambda proto, pts, pt, m: Boom(proto, pts, m))
+            lambda proto, pts, pt, m, **kw: Boom(proto, pts, m))
         import transmogrifai_tpu.selector.model_selector as ms
         best, res = _run_selector(mp, "binary", X, y)
         assert res[0].error is None

@@ -194,6 +194,52 @@ def regression_metric_grid(y_true, preds, weights, metric: str):
             preds, weights)
 
 
+_MULTI_GRID_METRICS = ("F1", "Error", "Accuracy", "Precision", "Recall")
+
+
+def _multiclass_metric_dev(y, p, w, n_classes: int, metric: str):
+    """Weighted multiclass metric from int-valued label/prediction vectors —
+    confusion matrix as one one-hot matmul (no scatter), shared by the
+    batched grid below."""
+    ok = ((y >= 0) & (y < n_classes) & (p >= 0) & (p < n_classes)
+          ).astype(jnp.float32)
+    wk = w * ok
+    wsum = jnp.maximum(wk.sum(), 1e-12)
+    if metric in ("Accuracy", "Error"):
+        acc = jnp.sum(wk * (y == p)) / wsum
+        return acc if metric == "Accuracy" else 1.0 - acc
+    yo = jax.nn.one_hot(y, n_classes, dtype=jnp.float32)
+    po = jax.nn.one_hot(p, n_classes, dtype=jnp.float32)
+    conf = jax.lax.dot((yo * wk[:, None]).T, po,
+                       precision=jax.lax.Precision.HIGHEST)  # (K, K)
+    tp = jnp.diagonal(conf)
+    support = conf.sum(axis=1)
+    pred_count = conf.sum(axis=0)
+    prec_k = tp / jnp.maximum(pred_count, 1e-12)
+    rec_k = tp / jnp.maximum(support, 1e-12)
+    wts = support / wsum
+    if metric == "Precision":
+        return jnp.sum(wts * prec_k)
+    if metric == "Recall":
+        return jnp.sum(wts * rec_k)
+    f1_k = 2 * prec_k * rec_k / jnp.maximum(prec_k + rec_k, 1e-12)
+    return jnp.sum(wts * f1_k)
+
+
+def multiclass_metric_grid(y_true, preds, weights, n_classes: int,
+                           metric: str):
+    """Batched device multiclass metric: (F, C, N) predicted labels (float
+    or int) + (F, N) eval weights against one shared label vector ->
+    (F, C) device values; None when ``metric`` has no device kernel."""
+    if metric not in _MULTI_GRID_METRICS:
+        return None
+    y = jnp.asarray(y_true, jnp.int32)
+    return jax.vmap(lambda p_f, w_f: jax.vmap(
+        lambda p: _multiclass_metric_dev(
+            y, jnp.asarray(p, jnp.int32), w_f, n_classes, metric))(p_f))(
+            preds, weights)
+
+
 def binary_metrics_at_threshold(y_true, y_score, threshold=0.5,
                                 sample_weight=None):
     if _on_host(y_true, y_score, sample_weight):

@@ -196,6 +196,52 @@ def logreg_predict_proba(coef, intercept, X):
 # sweep as one XLA program (SURVEY §2.12 row 2's concurrency axis)
 # ---------------------------------------------------------------------------
 
+def _grid_fold_stats(X, W_tr, wsum, fit_intercept: bool,
+                     standardization: bool):
+    """Per-fold weighted centering/scale vectors, shared by every grid
+    solver (standardization folds in algebraically — the standardized
+    matrix is never materialized per fold)."""
+    mu = (W_tr @ X) / wsum[:, None]                        # (F, D)
+    if standardization:
+        ex2 = (W_tr @ (X * X)) / wsum[:, None]
+        sig = jnp.sqrt(jnp.maximum(ex2 - mu ** 2, 0.0))
+        sig = jnp.where(sig < 1e-12, 1.0, sig)
+    else:
+        sig = jnp.ones_like(mu)
+    cen = mu if fit_intercept else jnp.zeros_like(mu)
+    return cen, sig
+
+
+def _grid_fold_grams(X, W_tr, wsum, cen, sig):
+    """Standardized per-fold weighted covariance Grams — the one O(N D²)
+    cost of a grid solve.  lax.map, not vmap: a batched Gram would
+    materialize the (F, N, D) weighted matrices at once.  HIGH precision
+    (bf16_3x, ~f32 quality): DEFAULT on this stack runs batched f32 gemms
+    in single-pass bf16, whose ~3e-3 noise would corrupt a majorizing
+    metric."""
+    def fold_gram(w_f):
+        return jax.lax.dot((X * w_f[:, None]).T, X,
+                           precision=jax.lax.Precision.HIGH,
+                           preferred_element_type=jnp.float32)
+    Q = lax.map(fold_gram, W_tr) / wsum[:, None, None]     # (F, D, D)
+    Qs = Q - (cen[:, :, None] * cen[:, None, :])
+    return Qs / (sig[:, :, None] * sig[:, None, :])
+
+
+def _grid_lmax(Qs):
+    """Per-fold top Gram eigenvalue (power iteration) — the scalar-majorizer
+    Lipschitz bound for the grid solvers' L1/FISTA paths."""
+    d = Qs.shape[-1]
+
+    def lmax_fold(Qs_f):
+        def pow_it(i, v):
+            v = Qs_f @ v
+            return v / (jnp.linalg.norm(v) + 1e-12)
+        v = lax.fori_loop(0, 16, pow_it,
+                          jnp.ones(d, Qs.dtype) / jnp.sqrt(d))
+        return jnp.vdot(v, Qs_f @ v) * 1.01
+    return jax.vmap(lmax_fold)(Qs)
+
 @functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
                                              "standardization"))
 def fit_logreg_grid(
@@ -239,30 +285,9 @@ def fit_logreg_grid(
     l2 = regs[None, :] * (1.0 - alphas[None, :])           # (F->, C)
     l1 = regs[None, :] * alphas[None, :]
 
-    # per-fold moments and weighted Gram (the one O(N D^2) cost, F launches'
-    # worth inside this program)
-    mu = (W_tr @ X) / wsum[:, None]                        # (F, D)
-    if standardization:
-        ex2 = (W_tr @ (X * X)) / wsum[:, None]
-        sig = jnp.sqrt(jnp.maximum(ex2 - mu ** 2, 0.0))
-        sig = jnp.where(sig < 1e-12, 1.0, sig)
-    else:
-        sig = jnp.ones((F, d), X.dtype)
-    cen = mu if fit_intercept else jnp.zeros_like(mu)
-
-    def fold_gram(w_f):
-        # lax.map, not vmap: a batched Gram would materialize the (F, N, D)
-        # weighted matrices at once.  HIGH precision (bf16_3x, ~f32 quality):
-        # DEFAULT on this stack runs batched f32 gemms in single-pass bf16,
-        # whose ~3e-3 noise would corrupt the majorizing metric
-        return jax.lax.dot((X * w_f[:, None]).T, X,
-                           precision=jax.lax.Precision.HIGH,
-                           preferred_element_type=jnp.float32)
-    Q = lax.map(fold_gram, W_tr) / wsum[:, None, None]     # (F, D, D)
-    # standardized covariance Gram: S^-1 (Q - mu mu') S^-1 (centered only
-    # when fitting an intercept)
-    Qs = Q - (cen[:, :, None] * cen[:, None, :])
-    Qs = Qs / (sig[:, :, None] * sig[:, None, :])
+    cen, sig = _grid_fold_stats(X, W_tr, wsum, fit_intercept,
+                                standardization)
+    Qs = _grid_fold_grams(X, W_tr, wsum, cen, sig)
 
     # fixed majorizer per (f, c): Qs/4 + l2 I — inverted ONCE: the per-
     # iteration solve is then a TPU-friendly matvec (a triangular solve in
@@ -303,13 +328,7 @@ def fit_logreg_grid(
     # point is the true elastic-net optimum (a plain soft-threshold after a
     # dense H^-1 step is NOT the prox under that metric — its fixed point
     # is biased on correlated features, measured up to 0.022 in p)
-    def lmax_fold(Qs_f):
-        def pow_it(i, v):
-            v = Qs_f @ v
-            return v / (jnp.linalg.norm(v) + 1e-12)
-        v = lax.fori_loop(0, 16, pow_it, jnp.ones(d, X.dtype) / jnp.sqrt(d))
-        return jnp.vdot(v, Qs_f @ v) * 1.01
-    Lf = jax.vmap(lmax_fold)(Qs)                           # (F,)
+    Lf = _grid_lmax(Qs)                                    # (F,)
     L_fc = Lf[:, None] / 4.0 + l2 + 1e-6                   # (F, C)
     has_l1 = l1[..., None] > 0
 
@@ -345,6 +364,112 @@ def fit_logreg_grid(
     return jax.nn.sigmoid(z_of(b, b0, jax.lax.Precision.HIGH)), iters
 
 
+@functools.partial(jax.jit, static_argnames=("n_classes", "max_iter",
+                                             "fit_intercept",
+                                             "standardization"))
+def fit_softmax_grid(
+    X: jnp.ndarray,          # (N, D) shared matrix
+    y: jnp.ndarray,          # (N,) int labels
+    n_classes: int,
+    W_tr: jnp.ndarray,       # (F, N) per-fold training weights
+    regs: jnp.ndarray,       # (C,) regParam per candidate
+    alphas: jnp.ndarray,     # (C,) elasticNetParam per candidate
+    max_iter: int = 200,
+    tol: float = 1e-5,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Every (fold, candidate) softmax-LR fit in ONE launch — the multiclass
+    sibling of ``fit_logreg_grid`` (MultiClassificationModelSelector default
+    grid, DefaultSelectorParams.scala:36-75).
+
+    Returns ``(logits, iters)``: ``logits`` is the (F, C, K, N) class-score
+    matrix over ALL rows — callers argmax over axis 2 for predicted labels
+    (softmax is argmax-invariant, so it is never materialized here).
+    Solver: proximal majorization with Nesterov momentum
+    under Böhning's multinomial bound  H ⪯ ½·(I_K − 11ᵀ/K) ⊗ XᵀWX ⪯
+    ½·I_K ⊗ XᵀWX — one weighted Gram per fold shared by every candidate and
+    class, inverted once; each iteration is matvecs batched over the whole
+    (fold, candidate, class) grid.  L1 candidates run scalar-majorizer FISTA
+    (exact prox), as in the binary solver.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    yi = jnp.asarray(y, jnp.int32)
+    K = n_classes
+    n, d = X.shape
+    F = W_tr.shape[0]
+    C = regs.shape[0]
+    Y = jax.nn.one_hot(yi, K, dtype=jnp.float32)            # (N, K)
+    wsum = jnp.maximum(W_tr.sum(axis=1), 1.0)               # (F,)
+    l2 = regs[None, :] * (1.0 - alphas[None, :])            # (1, C)
+    l1 = regs[None, :] * alphas[None, :]
+
+    cen, sig = _grid_fold_stats(X, W_tr, wsum, fit_intercept,
+                                standardization)
+    Qs = _grid_fold_grams(X, W_tr, wsum, cen, sig)
+
+    eye = jnp.eye(d, dtype=X.dtype)
+    # Böhning majorizer ½·Qs + l2 I, inverted once per (f, c)
+    H = (Qs[:, None] / 2.0
+         + (l2[:, :, None, None] + 2.5e-6) * eye[None, None])  # (F, C, D, D)
+    H_inv = jax.vmap(jax.vmap(jnp.linalg.inv))(H)
+
+    def z_of(B, B0, precision=jax.lax.Precision.DEFAULT):
+        """(F, C, K, N) standardized-space logits against the RAW matrix."""
+        u = B / sig[:, None, None, :]                        # (F, C, K, D)
+        z = jnp.einsum("nd,fckd->fckn", X, u, precision=precision)
+        return (z - jnp.einsum("fd,fckd->fck", cen, u)[..., None]
+                + B0[..., None])
+
+    def grad(B, B0):
+        P = jax.nn.softmax(z_of(B, B0), axis=2)              # (F, C, K, N)
+        r = (W_tr[:, None, None, :]
+             * (P - Y.T[None, None, :, :]) / wsum[:, None, None, None])
+        g_raw = jnp.einsum("fckn,nd->fckd", r, X,
+                           precision=jax.lax.Precision.DEFAULT)
+        rsum = r.sum(axis=3)                                 # (F, C, K)
+        g = (g_raw - cen[:, None, None, :] * rsum[..., None]) \
+            / sig[:, None, None, :]
+        return g + l2[..., None, None] * B, rsum
+
+    def mm_solve(g):
+        return jnp.einsum("fcde,fcke->fckd", H_inv, g)
+
+    Lf = _grid_lmax(Qs)                                     # (F,)
+    L_fc = Lf[:, None] / 2.0 + l2 + 1e-6                    # (F, C)
+    has_l1 = l1[..., None, None] > 0
+
+    def step(state):
+        B, B0, pB, pB0, tm, _, it = state
+        gB, g0 = grad(B, B0)
+        nB_mm = B - mm_solve(gB)
+        nB_prox = B - gB / L_fc[..., None, None]
+        thr = l1[..., None, None] / L_fc[..., None, None]
+        nB_prox = jnp.sign(nB_prox) * jnp.maximum(jnp.abs(nB_prox) - thr,
+                                                  0.0)
+        nB = jnp.where(has_l1, nB_prox, nB_mm)
+        n0 = B0 - 2.0 * g0 if fit_intercept else B0
+        ntm = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tm * tm))
+        mom = (tm - 1.0) / ntm
+        yB_ = nB + mom * (nB - pB)
+        y0_ = n0 + mom * (n0 - pB0)
+        dn = jnp.maximum(jnp.max(jnp.abs(nB - pB)),
+                         jnp.max(jnp.abs(n0 - pB0)))
+        return yB_, y0_, nB, n0, ntm, dn, it + 1
+
+    def cond(state):
+        *_, dn, it = state
+        return (dn > tol) & (it < max_iter)
+
+    B0_init = jnp.zeros((F, C, K), X.dtype)
+    Binit = jnp.zeros((F, C, K, d), X.dtype)
+    state = (Binit, B0_init, Binit, B0_init, jnp.float32(1.0),
+             jnp.float32(jnp.inf), jnp.int32(0))
+    final = lax.while_loop(cond, step, state)
+    B, B0, iters = final[2], final[3], final[6]
+    return z_of(B, B0, jax.lax.Precision.HIGH), iters        # (F, C, K, N)
+
+
 # ---------------------------------------------------------------------------
 # Multinomial (softmax) logistic regression — damped Newton on block-diagonal
 # Hessian approximation (per-class), good convergence for tabular K<=~50
@@ -377,38 +502,78 @@ def fit_multinomial_logreg(
         Xa = X
     da = Xa.shape[1]
 
-    def step(state):
-        B, _, it = state  # (da, K)
-        Z = Xa @ B
-        P = jax.nn.softmax(Z, axis=1)
+    def smooth_grad(B):
+        P = jax.nn.softmax(Xa @ B, axis=1)
         G = Xa.T @ (w[:, None] * (P - Y)) / wsum  # (da, K)
-        G = G.at[:d].add(l2 * B[:d])
+        return G.at[:d].add(l2 * B[:d]), P
 
-        # per-class block-diagonal Hessian: H_k = X^T diag(w p_k(1-p_k)) X
-        def solve_class(g_k, p_k, b_k):
-            s = jnp.maximum(w * p_k * (1 - p_k) / wsum, 1e-10)
-            H = (Xa * s[:, None]).T @ Xa
-            H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
-            return _damped_solve(H, g_k)
+    def newton_loop(_):
+        def step(state):
+            B, _, it = state  # (da, K)
+            G, P = smooth_grad(B)
 
-        delta = jax.vmap(solve_class, in_axes=(1, 1, 1), out_axes=1)(G, P, B)
-        # damping for stability of blockwise Newton
-        newB = _finite_or(B - 0.9 * delta, B)
-        mask = (jnp.arange(da) < d)[:, None]
-        newB = jnp.where(
-            mask,
-            jnp.sign(newB) * jnp.maximum(jnp.abs(newB) - l1, 0.0),
-            newB,
-        )
-        dn = jnp.max(jnp.abs(newB - B))
-        return newB, dn, it + 1
+            # per-class block-diagonal Hessian:
+            # H_k = X^T diag(w p_k(1-p_k)) X
+            def solve_class(g_k, p_k):
+                s = jnp.maximum(w * p_k * (1 - p_k) / wsum, 1e-10)
+                H = (Xa * s[:, None]).T @ Xa
+                H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
+                return _damped_solve(H, g_k)
 
-    def cond(state):
-        _, dn, it = state
-        return (dn > tol) & (it < max_iter)
+            delta = jax.vmap(solve_class, in_axes=(1, 1), out_axes=1)(G, P)
+            # damping for stability of blockwise Newton
+            newB = _finite_or(B - 0.9 * delta, B)
+            dn = jnp.max(jnp.abs(newB - B))
+            return newB, dn, it + 1
 
-    B0 = jnp.zeros((da, n_classes), jnp.float32)
-    B, dn, it = lax.while_loop(cond, step, (B0, jnp.float32(jnp.inf), jnp.int32(0)))
+        def cond(state):
+            _, dn, it = state
+            return (dn > tol) & (it < max_iter)
+
+        B0 = jnp.zeros((da, n_classes), jnp.float32)
+        return lax.while_loop(cond, step,
+                              (B0, jnp.float32(jnp.inf), jnp.int32(0)))
+
+    def fista_loop(_):
+        # exact proximal-gradient under Böhning's multinomial bound
+        # H ⪯ ½ XᵀWX — same elastic-net fixed point as the batched grid
+        # solver (fit_softmax_grid), replacing the biased
+        # soft-threshold-after-Newton heuristic
+        def pow_it(i, v):
+            v = Xa.T @ (w * (Xa @ v)) / (2.0 * wsum)
+            return v / (jnp.linalg.norm(v) + 1e-12)
+        v = lax.fori_loop(0, 16, pow_it,
+                          jnp.ones(da, X.dtype) / jnp.sqrt(da))
+        L = jnp.vdot(v, Xa.T @ (w * (Xa @ v)) / (2.0 * wsum)) * 1.01 \
+            + l2 + 1e-6
+        thr = l1 / L
+        coef_dims = (jnp.arange(da) < d)[:, None]
+
+        def step(state):
+            B, zB, t_m, _, it = state
+            G, _ = smooth_grad(zB)
+            nB = zB - G / L
+            nB = jnp.where(coef_dims,
+                           jnp.sign(nB) * jnp.maximum(jnp.abs(nB) - thr,
+                                                      0.0),
+                           nB)
+            nB = _finite_or(nB, B)
+            nt = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t_m * t_m))
+            nz = nB + (t_m - 1.0) / nt * (nB - B)
+            return nB, nz, nt, jnp.max(jnp.abs(nB - B)), it + 1
+
+        def cond(state):
+            _, _, _, dn, it = state
+            # proximal steps are ~D/N cheaper than Newton steps
+            return (dn > tol) & (it < 8 * max_iter)
+
+        B0 = jnp.zeros((da, n_classes), jnp.float32)
+        B, _, _, dn, it = lax.while_loop(
+            cond, step, (B0, B0, jnp.float32(1.0), jnp.float32(jnp.inf),
+                         jnp.int32(0)))
+        return B, dn, it
+
+    B, dn, it = lax.cond(l1 > 0, fista_loop, newton_loop, operand=None)
     coef = B[:d].T  # (K, D)
     intercept = B[d] if fit_intercept else jnp.zeros(n_classes, jnp.float32)
     return LinearFit(coef, intercept, it, dn <= tol)

@@ -822,26 +822,43 @@ class _GBTBase(PredictorEstimator):
 
 
 def _materialize_es(chunk_rows):
-    """Fetch a chunk of (round, device-metric) pairs as (round, float)."""
+    """Fetch a chunk of (round, device-metric) pairs in ONE sync — THE
+    chunk-fetch idiom for both ES paths: metrics may be scalars (single
+    chain) or (S,) chain vectors (the batched GBT grid group)."""
     if not chunk_rows:
         return []
     vals = np.asarray(jnp.stack([m for _, m in chunk_rows]))
-    return [(n_at, float(m)) for (n_at, _), m in zip(chunk_rows, vals)]
+    return [(n_at, m) for (n_at, _), m in zip(chunk_rows, vals)]
+
+
+def es_patience_vec(rows, stopped, best_metric, best_len, stall,
+                    patience: int) -> bool:
+    """THE early-stopping patience rule (improve/stall/stop), vectorized
+    over chains: single-estimator fits are the 1-chain case
+    (``_es_patience``) and the batched GBT grid group replays whole chain
+    chunks through it, so the two paths cannot desynchronize.  ``rows`` is
+    a list of (round, metric-vector) pairs; the state arrays mutate in
+    place.  Returns True when every chain has stopped."""
+    for n_at, mrow in rows:
+        live = ~stopped
+        better = live & (mrow > best_metric + 1e-9)
+        best_metric[better] = mrow[better]
+        best_len[better] = n_at
+        stall[better] = 0
+        stall[live & ~better] += 1
+        stopped |= stall >= patience
+    return bool(stopped.all())
 
 
 def _es_patience(rows, best_metric, best_len, stall, patience):
-    """THE single-chain early-stopping patience rule (improve/stall/stop),
-    shared by the in-loop lagged replay and the post-loop drain."""
-    stop = False
-    for n_at, m in rows:
-        if m > best_metric + 1e-9:
-            best_metric, best_len, stall = m, n_at, 0
-        else:
-            stall += 1
-            if stall >= patience:
-                stop = True
-                break
-    return best_metric, best_len, stall, stop
+    """Single-chain view of ``es_patience_vec`` (same rule, scalar state)."""
+    bm = np.asarray([best_metric], np.float64)
+    bl = np.asarray([best_len], np.int64)
+    st = np.asarray([stall], np.int64)
+    stopped = np.zeros(1, bool)
+    es_patience_vec([(n, np.asarray([m])) for n, m in rows],
+                    stopped, bm, bl, st, patience)
+    return float(bm[0]), int(bl[0]), int(st[0]), bool(stopped[0])
 
 
 def _grad_hess(obj, F, y, Y, w):

@@ -20,7 +20,7 @@ their partitions sequentially (a single controller cannot execute two
 meshes concurrently); in a true multi-slice deployment each slice's
 controller runs ``run_slice_partition`` on its own share and the
 coordinator merges with ``merge_slice_results`` — the partition/merge
-semantics (round-robin by cost, original candidate order restored,
+semantics (round-robin by candidate index, original candidate order restored,
 single argbest) are identical either way, which is what the dryrun and the
 CPU tests pin down.
 """

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["GridGroup", "LogRegGridGroup", "LinRegGridGroup",
+           "SoftmaxGridGroup", "RFGridGroup", "GBTGridGroup",
            "make_grid_group"]
 
 
@@ -151,15 +152,70 @@ class LinRegGridGroup(_LinearGridGroup):
         return self._metric_rows(y, preds, W_ev, binary=False)
 
 
+class SoftmaxGridGroup(_LinearGridGroup):
+    """All multiclass-LR (fold x candidate) fits in one Böhning-majorization
+    program (``linear.fit_softmax_grid``); metrics via the argmax-label
+    multiclass grid kernel."""
+
+    #: decline above this many (F, C, K, N) logit elements — the solver
+    #: holds ~3 such tensors transiently (16 GB HBM headroom)
+    MAX_LOGIT_ELEMS = 2e8
+
+    def __init__(self, proto, grid_points, metric, n_classes: int = 2):
+        super().__init__(proto, grid_points, metric)
+        self.n_classes = n_classes
+
+    def run(self, X, y, weight_ctxs):
+        if not self._batchable_params():
+            return None
+        n_classes = self.n_classes
+        if len(y):
+            n_classes = max(n_classes, int(np.nanmax(y)) + 1)
+        F, C, n = len(weight_ctxs), len(self.grid_points), len(y)
+        if F * C * n * n_classes > self.MAX_LOGIT_ELEMS:
+            return None
+        import jax.numpy as jnp
+
+        from ..evaluators.metrics import multiclass_metric_grid
+        from ..models.linear import fit_softmax_grid
+        from ..models.trees import _dev_f32
+
+        W_tr, W_ev = self._stack_weights(weight_ctxs)
+        regs, alphas = self._regs_alphas()
+        max_iter = int(self._param(self.grid_points[0], "max_iter"))
+        tol = float(self._param(self.grid_points[0], "tol"))
+        yi = np.nan_to_num(np.asarray(y, np.float32)).astype(np.int32)
+        logits, _ = fit_softmax_grid(
+            _dev_f32(X), yi, n_classes, _dev_f32(W_tr, tag="W_tr"),
+            regs, alphas,
+            max_iter=max(150, 4 * max_iter), tol=max(tol, 1e-5),
+            fit_intercept=bool(self._param(self.grid_points[0],
+                                           "fit_intercept")),
+            standardization=bool(self._param(self.grid_points[0],
+                                             "standardization")))
+        preds = jnp.argmax(logits, axis=2)                 # (F, C, N)
+        m = multiclass_metric_grid(yi, preds, jnp.asarray(W_ev),
+                                   n_classes, self.metric)
+        if m is None:
+            return None
+        return m.T
+
+
 class RFGridGroup(GridGroup):
     """Every (candidate x fold) random-forest fit as ONE chunked tree
     stream (``gbdt_kernels.grow_rf_grid``): per-tree traced
     (min_info_gain, min_instances, depth_limit) + fold-weight selection,
-    identical randomness to the sequential per-candidate fits."""
+    identical randomness to the sequential per-candidate fits.  Covers
+    binary, multiclass (one-hot targets, argmax scores against the
+    multiclass metric grid) and regression sweeps."""
 
     _batchable = ("max_depth", "min_info_gain", "min_instances_per_node")
     _static = ("num_trees", "max_bins", "subsample_rate",
                "feature_subset_strategy", "seed")
+
+    def __init__(self, proto, grid_points, metric, n_classes: int = 2):
+        super().__init__(proto, grid_points, metric)
+        self.n_classes = n_classes
 
     def _batchable_params(self) -> bool:
         allowed = set(self._batchable) | set(self._static)
@@ -170,27 +226,38 @@ class RFGridGroup(GridGroup):
     def run(self, X, y, weight_ctxs):
         if not self._batchable_params():
             return None
-        binary = self.proto._classification
-        if binary and len(y) and np.nanmax(y) > 1:
-            return None                     # multiclass RF: sequential path
         import jax.numpy as jnp
 
-        from ..evaluators.metrics import (binary_metric_grid,
+        from ..evaluators.metrics import (_MULTI_GRID_METRICS,
+                                          binary_metric_grid,
+                                          multiclass_metric_grid,
                                           regression_metric_grid)
         from ..models.gbdt_kernels import grow_rf_grid
         from ..models.trees import (_dev_memo, _feature_subset_size,
                                     _prep_tree_inputs, _score_ensemble_jit)
 
+        cls = self.proto._classification
+        n_classes = self.n_classes
+        if cls and len(y):
+            n_classes = max(n_classes, int(np.nanmax(y)) + 1)
+        multiclass = cls and n_classes > 2
+        # decline BEFORE growing anything when the observed label space and
+        # the metric family disagree (e.g. problem_type='binary'/AuPR with a
+        # stray label > 1) — the forest sweep is the dominant cost
+        if multiclass and self.metric not in _MULTI_GRID_METRICS:
+            return None
+        if cls and not multiclass and self.metric not in ("AuPR", "AuROC"):
+            return None
+
         proto = self.proto
         y = np.nan_to_num(np.asarray(y, np.float32))
         edges, binned = _prep_tree_inputs(X, proto.max_bins)
         n, d = X.shape
-        if binary:
-            Y = np.eye(2, dtype=np.float32)[y.astype(int)]
+        if cls:
+            Y = np.eye(n_classes, dtype=np.float32)[y.astype(int)]
         else:
             Y = y[:, None].astype(np.float32)
-        msub = _feature_subset_size(proto.feature_subset_strategy, d,
-                                    binary)
+        msub = _feature_subset_size(proto.feature_subset_strategy, d, cls)
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         F = W_tr.shape[0]
         C = len(self.grid_points)
@@ -213,15 +280,20 @@ class RFGridGroup(GridGroup):
             subsample_rate=float(self._param(self.grid_points[0],
                                              "subsample_rate")),
             n_bins=int(self._param(self.grid_points[0], "max_bins")),
-            onehot_targets=binary)
+            onehot_targets=cls)
         heap_depth = int(np.log2(feats.shape[2] + 1))
-        mode = "rf_cls" if binary else "rf_reg"
-        ptype = "binary" if binary else "regression"
+        mode = "rf_cls" if cls else "rf_reg"
+        ptype = ("multiclass" if multiclass
+                 else "binary" if cls else "regression")
         scores = _score_pairs_jit(binned, feats, threshs, leaves,
                                   heap_depth, mode, ptype)  # (C*F, N)
         scores = scores.reshape(C, F, n).transpose(1, 0, 2)  # (F, C, N)
-        fn = binary_metric_grid if binary else regression_metric_grid
-        m = fn(y, scores, jnp.asarray(W_ev), self.metric)
+        if multiclass:
+            m = multiclass_metric_grid(y, scores, jnp.asarray(W_ev),
+                                       n_classes, self.metric)
+        else:
+            fn = binary_metric_grid if cls else regression_metric_grid
+            m = fn(y, scores, jnp.asarray(W_ev), self.metric)
         if m is None:
             return None
         return m.T
@@ -434,21 +506,15 @@ class GBTGridGroup(GridGroup):
 def _replay_es(chunk_rows, stopped, best_metric, best_len, stall,
                patience: int) -> bool:
     """Replay one fetched chunk of per-chain ES metrics against the
-    host-side patience state (in place); True when every chain stopped."""
+    host-side patience state (in place); True when every chain stopped.
+    The rule itself is ``trees.es_patience_vec`` — the same code the
+    sequential single-chain fits run."""
     if not chunk_rows:
         return bool(stopped.all())
-    import jax.numpy as jnp
+    from ..models.trees import _materialize_es, es_patience_vec
 
-    vals = np.asarray(jnp.stack([m for _, m in chunk_rows]))
-    for (n_at, _), mrow in zip(chunk_rows, vals):
-        live = ~stopped
-        better = live & (mrow > best_metric + 1e-9)
-        best_metric[better] = mrow[better]
-        best_len[better] = n_at
-        stall[better] = 0
-        stall[live & ~better] += 1
-        stopped |= stall >= patience
-    return bool(stopped.all())
+    return es_patience_vec(_materialize_es(chunk_rows), stopped,
+                           best_metric, best_len, stall, patience)
 
 
 def _grow_gbt_chain_round(binned, yj, Wj, Fm, depth_lim, lams, mcws, migs,
@@ -474,11 +540,14 @@ def _chain_es_metric(Fm, yj, vi, obj: str):
 
 
 def make_grid_group(proto, grid_points, problem_type: str,
-                    metric: str) -> Optional[GridGroup]:
+                    metric: str, n_classes: int = 2) -> Optional[GridGroup]:
     """Group factory: returns a batched group when the estimator family,
-    problem type, and metric support one — else None (sequential fits)."""
+    problem type, and metric support one — else None (sequential fits).
+    ``n_classes`` is the selector's fit-time-captured class-space size
+    (multiclass groups take the max of it and the observed labels)."""
     if len(grid_points) == 0:
         return None
+    from ..evaluators.metrics import _MULTI_GRID_METRICS
     from ..models.classification import OpLogisticRegression
     from ..models.regression import OpLinearRegression
 
@@ -490,13 +559,19 @@ def make_grid_group(proto, grid_points, problem_type: str,
     if problem_type == "binary" and type(proto) is OpLogisticRegression \
             and metric in ("AuPR", "AuROC"):
         return LogRegGridGroup(proto, grid_points, metric)
+    if problem_type == "multiclass" \
+            and type(proto) is OpLogisticRegression \
+            and metric in _MULTI_GRID_METRICS:
+        return SoftmaxGridGroup(proto, grid_points, metric,
+                                n_classes=n_classes)
     if problem_type == "regression" and type(proto) is OpLinearRegression \
             and metric in _REG_METRICS:
         return LinRegGridGroup(proto, grid_points, metric)
-    if problem_type == "binary" \
+    if problem_type in ("binary", "multiclass") \
             and type(proto) is OpRandomForestClassifier \
-            and metric in ("AuPR", "AuROC"):
-        return RFGridGroup(proto, grid_points, metric)
+            and metric in (("AuPR", "AuROC") if problem_type == "binary"
+                           else _MULTI_GRID_METRICS):
+        return RFGridGroup(proto, grid_points, metric, n_classes=n_classes)
     if problem_type == "regression" \
             and type(proto) is OpRandomForestRegressor \
             and metric in _REG_METRICS:

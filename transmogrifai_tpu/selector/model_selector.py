@@ -247,7 +247,8 @@ class ModelSelector(PredictorEstimator):
             # the family supports it; single-chip only (the mesh path runs
             # each candidate's own sharded fit)
             group = (make_grid_group(proto, grid_points, self.problem_type,
-                                     self.validation_metric)
+                                     self.validation_metric,
+                                     n_classes=self._class_count(None))
                      if self.mesh is None else None)
             for params in grid_points:
                 def fitter(X, y, w, p, proto=proto):
