@@ -31,7 +31,6 @@ def main():
 
     from bench_scale import make_data
     from transmogrifai_tpu.models import OpXGBoostClassifier
-    from transmogrifai_tpu.models.gbdt_kernels import compile_depth_hint
     from transmogrifai_tpu.selector import DefaultSelectorParams as D
     from transmogrifai_tpu.selector import grid
     from transmogrifai_tpu.selector.grid_groups import make_grid_group
@@ -55,10 +54,7 @@ def main():
         (OpXGBoostClassifier(), grid(min_child_weight=D.MIN_CHILD_WEIGHT_XGB)),
     ]
     skip = set(args.skip.split(",")) if args.skip else set()
-    depths = [int(p.get("max_depth", getattr(proto, "max_depth", 5) or 5))
-              for proto, pts in mps for p in pts
-              if hasattr(proto, "max_depth")]
-    with compile_depth_hint(max(depths)):
+    if True:  # groups size their own heap depth (per-family hints)
         for proto, pts in mps:
             name = type(proto).__name__
             if name in skip:

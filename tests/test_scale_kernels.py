@@ -58,6 +58,40 @@ class TestStreamedHistograms:
         assert acc > 0.85
 
 
+class TestSiblingSubtraction:
+    def test_sibling_matches_direct_histograms(self, monkeypatch):
+        """Left-child-only histograms + (parent − left) derivation must
+        reproduce the direct per-node build EXACTLY: RF channels are
+        integer-valued (bag weights × one-hot targets), so f32 (and the
+        f32-accumulated bf16 dots) is exact arithmetic on both paths."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        n, d, T = 900, 8, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        Y = jnp.asarray(np.eye(2, dtype=np.float32)[
+            (X[:, 0] + X[:, 1] > 0).astype(int)])
+        bw = jnp.asarray(np.ones(n, np.float32))
+        edges = gk.quantile_bins(X, 16)
+        binned = gk.apply_bins(jnp.asarray(X), jnp.asarray(edges, np.float32))
+
+        def grow():
+            gk._grow_chunk_rf._clear_cache()
+            jax.clear_caches()
+            return gk.grow_forest_rf(binned, Y, bw, seed=11, n_trees=T,
+                                     msub=d, subsample_rate=1.0,
+                                     max_depth=6, n_bins=16)
+
+        monkeypatch.setattr(gk, "SIBLING_MIN_SLOTS", 4)   # engage at lvl 2+
+        f_sib, t_sib, l_sib = [np.asarray(a) for a in grow()]
+        monkeypatch.setattr(gk, "SIBLING_MIN_SLOTS", 1 << 30)  # disabled
+        f_dir, t_dir, l_dir = [np.asarray(a) for a in grow()]
+        assert (f_sib == f_dir).all()
+        assert (t_sib == t_dir).all()
+        assert np.max(np.abs(l_sib - l_dir)) < 1e-5
+
+
 class TestHostBinning:
     def test_host_equals_device_binning(self):
         import jax.numpy as jnp

@@ -72,6 +72,11 @@ def _resolve_compile_depth(max_depth: int) -> int:
 #: features × 32 bins, 0.4 GB at 100 features (forest_chunk_size budgets it)
 ROW_BLOCK = 32768
 
+#: engage sibling subtraction (left-child histograms only; right = parent −
+#: left) at levels with at least this many slots — below it the bins one-hot
+#: stream dominates and halving the node term buys nothing
+SIBLING_MIN_SLOTS = 1024
+
 
 class TreeEnsemble(NamedTuple):
     """Stacked trees: feat (T, 2^d-1) int32, thresh (T, 2^d-1) int32,
@@ -193,8 +198,14 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     dot_prec = (jax.lax.Precision.DEFAULT if hist_bf16
                 else jax.lax.Precision.HIGHEST)
 
-    # Row-blocked histogram build: the bins one-hot is (rows, B·D) f32 — at
-    # 1M×500×32 bins that is 64 GB if materialized whole, so rows stream
+    # One-hot operands materialize in bf16 under ``hist_bf16`` — the 0/1
+    # one-hots are exact in bf16 and the stream (the kernel's bandwidth
+    # floor) halves; channel values ride the already-accepted hist_bf16
+    # precision contract.
+    hdt = jnp.bfloat16 if hist_bf16 else jnp.float32
+
+    # Row-blocked histogram build: the bins one-hot is (rows, B·D) — at
+    # 1M×500×32 bins that is 64 GB f32 if materialized whole, so rows stream
     # through in blocks with the (M, B·D) accumulators carried by lax.scan.
     # Small inputs keep the single hoisted one-hot (no scan overhead).
     blocked = n > ROW_BLOCK
@@ -211,15 +222,27 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     else:
         # (N, B·D) one-hot, minor axis = features (128-lane tile friendly)
         onehot_bins = (binned[:, None, :] == jnp.arange(B)[None, :, None]
-                       ).astype(jnp.float32).reshape(n, B * d)
+                       ).astype(hdt).reshape(n, B * d)
 
     node = jnp.zeros(n, jnp.int32)
     heap_feat_levels, heap_thresh_levels = [], []
+    prev_cums = None   # previous level's per-channel bin cumsums (M, B, d)
 
     for level in range(max_depth):
         level_nodes = 2 ** level
         compact = level_nodes > n_cap
         M = n_cap if compact else level_nodes        # static slot count
+
+        # Sibling subtraction: at wide non-compact levels build histograms
+        # for LEFT children only (slot 2j -> column j; right-child rows
+        # contribute zero) and derive the right child's cumsums from the
+        # retained parent cumsums (right = parent − left) — halves the
+        # (rows, M) node one-hot stream and the histogram dots exactly
+        # where M makes them dominant.  Non-compact level l implies
+        # non-compact l−1, so the parent cumsums are always full-layout.
+        sib = (level >= 1 and not compact and M >= SIBLING_MIN_SLOTS
+               and prev_cums is not None)
+        Mh = M // 2 if sib else M
 
         if compact:
             # rows occupy ≤ N distinct nodes: rank their sorted ids
@@ -237,6 +260,15 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
             uniq = jnp.arange(M, dtype=jnp.int32)
             slot = node
 
+        def node_onehot(slot_v, rows: int):
+            """(rows, Mh) one-hot — full slots, or left children only."""
+            if sib:
+                oh = (((slot_v // 2)[:, None] == jnp.arange(Mh)[None, :])
+                      & (slot_v % 2 == 0)[:, None])
+            else:
+                oh = slot_v[:, None] == jnp.arange(Mh)[None, :]
+            return oh.astype(hdt)
+
         if blocked:
             slot_blk = jnp.pad(slot, (0, n_pad - n)).reshape(
                 n_blocks, ROW_BLOCK)
@@ -244,44 +276,60 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
             def hist_block(acc, xs):
                 slot_b, binned_b, ch_b = xs
                 oh_bins = (binned_b[:, None, :] == jnp.arange(B)[None, :, None]
-                           ).astype(jnp.float32).reshape(ROW_BLOCK, B * d)
-                oh_node = (slot_b[:, None] == jnp.arange(M)[None, :]
-                           ).astype(jnp.float32)       # (RB, M)
-                part = jnp.stack([
-                    jax.lax.dot((oh_node * ch_b[:, c][:, None]).T, oh_bins,
-                                precision=dot_prec,
-                                preferred_element_type=jnp.float32)
-                    for c in range(nchan)])            # (nchan, M, B·D)
-                return acc + part, None
+                           ).astype(hdt).reshape(ROW_BLOCK, B * d)
+                oh_node = node_onehot(slot_b, ROW_BLOCK)   # (RB, Mh)
+                ch_h = ch_b.astype(hdt)
+                # all channels in ONE dot: separate per-channel dots re-read
+                # the (RB, B·D) bins one-hot — the stream that IS the
+                # kernel's bandwidth floor — nchan times from HBM
+                wnode = jnp.concatenate(
+                    [oh_node * ch_h[:, c][:, None] for c in range(nchan)],
+                    axis=1)                            # (RB, nchan·Mh)
+                part = jax.lax.dot(wnode.T, oh_bins,
+                                   precision=dot_prec,
+                                   preferred_element_type=jnp.float32)
+                return acc + part.reshape(nchan, Mh, B * d), None
 
-            acc0 = jnp.zeros((nchan, M, B * d), jnp.float32)
+            acc0 = jnp.zeros((nchan, Mh, B * d), jnp.float32)
             hist_stack, _ = lax.scan(
                 hist_block, acc0, (slot_blk, binned_blk, chans_blk))
-            hists = [hist_stack[c].reshape(M, B, d) for c in range(nchan)]
+            hists = [hist_stack[c].reshape(Mh, B, d) for c in range(nchan)]
         else:
-            onehot_node = (slot[:, None] == jnp.arange(M)[None, :]
-                           ).astype(jnp.float32)      # (N, M)
-            hists = [jax.lax.dot(
-                         (onehot_node * ch[:, None]).T, onehot_bins,
-                         precision=dot_prec,
-                         preferred_element_type=jnp.float32,
-                     ).reshape(M, B, d)
-                     for ch in chans]                 # 2K+1 × (M, B, D)
+            onehot_node = node_onehot(slot, n)            # (N, Mh)
+            wnode = jnp.concatenate(
+                [onehot_node * ch.astype(hdt)[:, None] for ch in chans],
+                axis=1)                               # (N, nchan·Mh)
+            hist_all = jax.lax.dot(
+                wnode.T, onehot_bins, precision=dot_prec,
+                preferred_element_type=jnp.float32)   # (nchan·Mh, B·D)
+            hists = [hist_all[c * Mh:(c + 1) * Mh].reshape(Mh, B, d)
+                     for c in range(nchan)]           # 2K+1 × (Mh, B, D)
         if all_reduce is not None:
             # ICI collective replaces Spark's treeAggregate / Rabit allreduce
             # (channel reduction also means fewer collectives per level)
             hists = [all_reduce(h) for h in hists]
-        CL = jnp.cumsum(hists[-1], axis=1)
+        cums_h = [jnp.cumsum(h, axis=1) for h in hists]
+        if sib:
+            # interleave left cumsums with (parent − left) right cumsums
+            cums = [jnp.stack([lc, pc - lc], axis=1).reshape(M, B, d)
+                    for lc, pc in zip(cums_h, prev_cums)]
+        else:
+            cums = cums_h
+        # retain for the next level only when it will engage the sibling path
+        prev_cums = cums if (level + 1 < max_depth
+                             and 2 * level_nodes <= n_cap
+                             and 2 * M >= SIBLING_MIN_SLOTS) else None
+        CL = cums[-1]
         if bag_mode == "onehot":
-            GLs = [jnp.cumsum(h, axis=1) for h in hists[: k - 1]]
+            GLs = list(cums[: k - 1])
             GLs.append(CL - sum(GLs) if GLs else CL)
             HLs = [CL] * k
         elif bag_mode == "bagged":
-            GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
+            GLs = list(cums[:k])
             HLs = [CL] * k
         else:
-            GLs = [jnp.cumsum(h, axis=1) for h in hists[:k]]
-            HLs = [jnp.cumsum(h, axis=1) for h in hists[k:2 * k]]
+            GLs = list(cums[:k])
+            HLs = list(cums[k:2 * k])
 
         gain = 0.0
         HLmin = jnp.inf
@@ -427,7 +475,8 @@ def forest_chunk_size(n_trees: int, max_depth: int, d: int, n_bins: int,
                       n_rows: Optional[int] = None,
                       compact: bool = True,
                       n_channels: Optional[int] = None,
-                      d_full: Optional[int] = None) -> int:
+                      d_full: Optional[int] = None,
+                      onehot_bytes: int = 4) -> int:
     # node compaction caps a level's histogram slots at next_pow2(n_rows);
     # 1.3x covers the 128-lane padding of the minor (feature) axis.
     # compact=False is the all-reduce (mesh-sharded) path, which keeps the
@@ -435,21 +484,25 @@ def forest_chunk_size(n_trees: int, max_depth: int, d: int, n_bins: int,
     # ``d`` is the HISTOGRAM width (= msub on the feature-subset path);
     # ``n_channels`` overrides the default 2K+1 when the reduced-channel
     # bagged path is active; ``d_full`` adds the per-tree gathered binned
-    # copy the subset path materializes.
+    # copy the subset path materializes; ``onehot_bytes`` is 2 when the
+    # one-hot operands materialize bf16 (hist_bf16).
     nchan = n_channels if n_channels is not None else 2 * k + 1
     slots = 2 ** (max_depth - 1)
     if n_rows is not None and compact:
         slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))
-    per_tree = int(slots * d * n_bins * nchan * 4 * 1.3)
+    # sibling subtraction retains the parent level's cumsums alongside the
+    # current level's: ~1.5x the histogram-buffer peak at engaged depths
+    sib_factor = 1.5 if slots >= SIBLING_MIN_SLOTS else 1.0
+    per_tree = int(slots * d * n_bins * nchan * 4 * 1.3 * sib_factor)
     if n_rows is not None:
         # matmul-histogram operands live per tree under vmap: the per-block
         # (rows, slots) node one-hot and (rows, B·D) bins one-hot (rows
         # streamed in ROW_BLOCK chunks past that size), plus the (rows, K)
         # G/H gradient channels and bag-weight row derived per tree
         rows = min(n_rows, ROW_BLOCK)
-        per_tree += int(rows * slots * 4 * 1.3)
+        per_tree += int(rows * slots * onehot_bytes * 1.3)
         if n_rows > ROW_BLOCK:
-            per_tree += int(rows * n_bins * d * 4 * 1.3)
+            per_tree += int(rows * n_bins * d * onehot_bytes * 1.3)
         per_tree += int(n_rows * (2 * k + 1) * 4)
         if d_full is not None and d_full != d:
             # the per-tree (rows, msub) int32 gather of the binned matrix
@@ -638,7 +691,8 @@ def grow_rf_grid(binned, Y, W_tr, seed: int, n_trees: int,
     heap_depth = _resolve_compile_depth(int(pair_depth.max()))
     chunk = forest_chunk_size(
         n_trees * P, heap_depth, msub, n_bins, k, n_rows=n,
-        n_channels=(k if onehot_targets else k + 1), d_full=d)
+        n_channels=(k if onehot_targets else k + 1), d_full=d,
+        onehot_bytes=2)
     total = n_trees * P
     pf = jnp.asarray(pair_fold, jnp.int32)
     pg = jnp.asarray(pair_min_ig, jnp.float32)
@@ -687,7 +741,8 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
     # count (K for one-hot classification, K+1 for bagged regression)
     chunk = forest_chunk_size(
         n_trees, heap_depth, msub, n_bins, k, n_rows=n,
-        n_channels=(k if onehot_targets else k + 1), d_full=d)
+        n_channels=(k if onehot_targets else k + 1), d_full=d,
+        onehot_bytes=2)
     args = (jnp.float32(lam), jnp.float32(min_child_weight),
             jnp.float32(min_info_gain), jnp.float32(min_instances),
             jnp.float32(1.0))
@@ -713,10 +768,11 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
             jnp.concatenate(leaves))
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "obj"))
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "obj",
+                                             "hist_bf16"))
 def _gbt_chain_round_jit(binned, y, W, Fm, depth_lim, lams, mcws, migs,
                          mins_, lrs, mgrs, max_depth: int, n_bins: int,
-                         obj: str):
+                         obj: str, hist_bf16: bool = False):
     """One boosting round for a chunk of chains: gradients from each
     chain's margins + ONE vmapped growth (the bins one-hot is chain-
     invariant, so XLA builds it once per row block for every chain's
@@ -737,10 +793,74 @@ def _gbt_chain_round_jit(binned, y, W, Fm, depth_lim, lams, mcws, migs,
             max_depth=max_depth, n_bins=n_bins, lam=lam,
             min_child_weight=mcw, min_info_gain=mig, min_instances=mi,
             newton_leaf=jnp.bool_(True), learning_rate=lr,
-            min_gain_raw=mgr)
+            hist_bf16=hist_bf16, min_gain_raw=mgr)
 
     return jax.vmap(one)(G, H, W, depth_lim, lams, mcws, migs, mins_,
                          lrs, mgrs)
+
+
+@functools.partial(jax.jit, static_argnames=("n_rounds", "max_depth",
+                                             "n_bins", "obj", "hist_bf16",
+                                             "use_es"))
+def _gbt_chain_rounds_jit(binned, y, W, Fm0, vi, depth_lim, lams, mcws,
+                          migs, mins_, lrs, mgrs, n_rounds: int,
+                          max_depth: int, n_bins: int, obj: str,
+                          hist_bf16: bool = False, use_es: bool = False):
+    """``n_rounds`` boosting rounds for a chunk of chains in ONE launch.
+
+    ``lax.scan`` over rounds (body compiled once) carries the (S, N)
+    margins and stacks each round's trees + per-chain ES metric — through a
+    remote-device tunnel the per-round dispatch was the dominant cost
+    (measured ~390 ms/round vs ~120 ms device compute at 100k x 500), and
+    the scan leaves ONE dispatch (and one lagged metric fetch) per
+    ``es_chunk`` of rounds.  Returns (Fm_end, feats (R, S, nodes), threshs,
+    leaves (R, S, L, K), metrics (R, S))."""
+    n, d = binned.shape
+    mask = jnp.ones(d, bool)
+
+    def round_step(Fm, _):
+        if obj == "binary":
+            P = jax.nn.sigmoid(Fm)                   # (S, N)
+            G = W * (P - y[None, :])
+            H = W * jnp.maximum(P * (1 - P), 1e-6)
+        else:
+            G = W * (Fm - y[None, :])
+            H = W
+
+        def one(g, h, c, lim, lam, mcw, mig, mi, lr, mgr):
+            return _grow_tree_traced(
+                binned, g[:, None], h[:, None], c, mask, lim,
+                max_depth=max_depth, n_bins=n_bins, lam=lam,
+                min_child_weight=mcw, min_info_gain=mig, min_instances=mi,
+                newton_leaf=jnp.bool_(True), learning_rate=lr,
+                hist_bf16=hist_bf16, min_gain_raw=mgr)
+
+        f, t, lf = jax.vmap(one)(G, H, W, depth_lim, lams, mcws, migs,
+                                 mins_, lrs, mgrs)
+        inc = jax.vmap(lambda ff, tt, ll: predict_tree(
+            binned, ff, tt, ll, max_depth))(f, t, lf)[:, :, 0]
+        Fm = Fm + inc
+        if use_es:
+            m = _chain_es_metric(Fm, y, vi, obj)
+        else:
+            m = jnp.zeros(Fm.shape[0], jnp.float32)
+        return Fm, (f, t, lf, m)
+
+    Fm_end, (fs, ts, lfs, ms) = lax.scan(round_step, Fm0, None,
+                                         length=n_rounds)
+    return Fm_end, fs, ts, lfs, ms
+
+
+def _chain_es_metric(Fm, y, vi, obj: str):
+    """Per-chain early-stopping metric on the validation rows (trace-safe:
+    shared by the standalone jit below and the in-scan round body)."""
+    yv = y[vi]
+    Z = Fm[:, vi]
+    if obj == "binary":
+        from ..evaluators.metrics import _aupr_dev
+
+        return jax.vmap(lambda z: _aupr_dev(yv, jax.nn.sigmoid(z)))(Z)
+    return -jnp.mean((Z - yv[None, :]) ** 2, axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -752,16 +872,8 @@ def _predict_round_jit(binned, feat, thresh, leaf, max_depth: int):
     return out[:, :, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("obj",))
-def _chain_es_metric_jit(Fm, y, vi, obj: str):
-    """Per-chain early-stopping metric on the validation rows (device)."""
-    yv = y[vi]
-    Z = Fm[:, vi]
-    if obj == "binary":
-        from ..evaluators.metrics import _aupr_dev
-
-        return jax.vmap(lambda z: _aupr_dev(yv, jax.nn.sigmoid(z)))(Z)
-    return -jnp.mean((Z - yv[None, :]) ** 2, axis=1)
+_chain_es_metric_jit = jax.jit(_chain_es_metric,
+                               static_argnames=("obj",))
 
 
 def gbt_chain_chunk(n_chains: int, max_depth: int, d: int, n_bins: int,
@@ -787,7 +899,7 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               min_info_gain: float = 0.0, min_instances: float = 1.0,
               feat_mask: Optional[jnp.ndarray] = None,
               newton_leaf: bool = True, learning_rate: float = 1.0,
-              min_gain_raw: float = 0.0,
+              min_gain_raw: float = 0.0, hist_bf16: bool = False,
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow one tree (single-tree view of ``grow_forest``): one XLA launch."""
     d = binned.shape[1]
@@ -800,7 +912,7 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
         heap_depth, n_bins, jnp.float32(lam), jnp.float32(min_child_weight),
         jnp.float32(min_info_gain), jnp.float32(min_instances),
         jnp.bool_(newton_leaf), jnp.float32(learning_rate),
-        min_gain_raw=jnp.float32(min_gain_raw))
+        hist_bf16=hist_bf16, min_gain_raw=jnp.float32(min_gain_raw))
     return f[0], t[0], lf[0]
 
 
@@ -836,27 +948,46 @@ def predict_ensemble(binned: jnp.ndarray, feat: jnp.ndarray,
     All trees route in parallel — ``max_depth`` sequential steps of one
     (T, N) gather each, instead of a scan over trees (T × depth serial
     steps, which left the TPU idle between tiny kernels).
+
+    Every gather is expressed over FLATTENED operands with explicit row/
+    tree offsets: the 2-D advanced-indexing forms (``feat[tree, heap]``,
+    ``binned[row, f]``) MISCOMPILE on the tunneled TPU backend at some
+    (T, N) shapes — deterministically wrong routing at T=166/200 × 100k
+    rows while T ≤ 128 and T = 180 are fine — and the flat formulation is
+    correct at every probed shape (same per-tree results as the scalar
+    ``predict_tree`` and a host reference implementation).
     """
     n = binned.shape[0]
-    T = feat.shape[0]
+    d = binned.shape[1]
+    T, nodes = feat.shape
+    if n * d >= 2 ** 31:
+        raise ValueError(
+            f"binned matrix of {n}x{d} elements overflows the int32 flat-"
+            f"gather offsets; chunk rows before calling predict_ensemble")
     node = jnp.zeros((T, n), jnp.int32)
-    rows = jnp.arange(n)[None, :]
+    feat_f = feat.reshape(-1)
+    thresh_f = thresh.reshape(-1)
+    binned_f = binned.reshape(-1)
+    tree_off = (jnp.arange(T, dtype=jnp.int32) * nodes)[:, None]
+    row_off = (jnp.arange(n, dtype=jnp.int32) * jnp.int32(d))[None, :]
 
     def level(l, node):
-        heap = (2 ** l - 1) + node                       # (T, N)
-        f = jnp.take_along_axis(feat, heap, axis=1)
-        t = jnp.take_along_axis(thresh, heap, axis=1)
-        x = binned[rows, f]                              # (T, N)
+        heap = (2 ** l - 1) + node + tree_off            # (T, N) flat ids
+        f = feat_f[heap]
+        t = thresh_f[heap]
+        x = binned_f[row_off + f]                        # (T, N)
         return 2 * node + (x > t).astype(jnp.int32)
 
     node = lax.fori_loop(0, max_depth, level, node)
     # leaf-sum in tree chunks: one (T, N, K) gather would cost T·N·K·4 bytes
     # of HBM (4 GB for 512 trees × 1M rows); chunks bound it at ~32 MB
     k = leaf.shape[2]
+    n_leaves = leaf.shape[1]
+    leaf_f = leaf.reshape(T * n_leaves, k)
+    leaf_off = (jnp.arange(T, dtype=jnp.int32) * n_leaves)[:, None]
     chunk = max(1, min(T, (32 << 20) // max(n * k * 4, 1)))
     out = jnp.zeros((n, k), jnp.float32)
-    tree_idx = jnp.arange(T)[:, None]
     for s in range(0, T, chunk):
         e = min(s + chunk, T)
-        out = out + leaf[tree_idx[s:e], node[s:e]].sum(axis=0)
+        out = out + leaf_f[node[s:e] + leaf_off[s:e]].sum(axis=0)
     return out

@@ -630,7 +630,8 @@ class _GBTBase(PredictorEstimator):
                  validation_fraction: float = 0.2,
                  min_instances_per_node: int = 1,
                  min_split_gain_raw: float = 0.0,
-                 seed: int = 42, uid: Optional[str] = None):
+                 seed: int = 42, hist_precision: str = "f32",
+                 uid: Optional[str] = None):
         super().__init__(operation_name=self._op_name, uid=uid)
         self.max_iter = max_iter
         self.max_depth = max_depth
@@ -648,6 +649,11 @@ class _GBTBase(PredictorEstimator):
         #: per-node-weight minInfoGain)
         self.min_split_gain_raw = min_split_gain_raw
         self.seed = seed
+        #: 'f32' (default) or 'bf16': histogram one-hot/dot precision.
+        #: bf16 halves the (rows, bins·features) stream — RF always runs it
+        #: (integer channels, exact) — but GBT gradients are continuous and
+        #: compound across rounds, so it is opt-in pending the quality gate.
+        self.hist_precision = hist_precision
         self.mesh = None
 
     def with_mesh(self, mesh) -> "_GBTBase":
@@ -727,6 +733,17 @@ class _GBTBase(PredictorEstimator):
             twj = jnp.asarray(train_w)
             F = jnp.full((n, k), base, jnp.float32)
 
+        if (self.mesh is None and self.subsample_rate >= 1.0
+                and self.colsample >= 1.0
+                and obj in ("binary", "regression")):
+            # no per-round host RNG: the whole fit runs as scan-chunked
+            # launches (the 1-chain case of the grid group's kernel) —
+            # per-round dispatch through a remote tunnel costs ~3x the
+            # round's device compute
+            return self._fit_scan_chunks(binned, edges, yj, twj, obj,
+                                         float(base), use_es,
+                                         np.where(val)[0])
+
         feats, threshs, leaves = [], [], []
         best_metric, best_len, stall = -np.inf, 0, 0
         val_idx = np.where(val)[0]
@@ -767,7 +784,8 @@ class _GBTBase(PredictorEstimator):
                 min_instances=float(self.min_instances_per_node),
                 feat_mask=jnp.asarray(mask), newton_leaf=True,
                 learning_rate=self.step_size,
-                min_gain_raw=self.min_split_gain_raw)
+                min_gain_raw=self.min_split_gain_raw,
+                hist_bf16=self.hist_precision == "bf16")
             from .gbdt_kernels import predict_tree
 
             heap_depth = int(np.log2(f.shape[0] + 1))
@@ -806,6 +824,74 @@ class _GBTBase(PredictorEstimator):
             thresh=jnp.stack(threshs), leaf=jnp.stack(leaves),
             base_score=float(base) if k == 1 else 0.0,
             n_classes=(k if obj == "multiclass" else 2))
+
+    def _fit_scan_chunks(self, binned, edges, yj, twj, obj: str,
+                         base: float, use_es: bool, val_idx):
+        """Whole-fit scan-chunked boosting: es_chunk rounds per launch via
+        ``_gbt_chain_rounds_jit`` with S=1 — the same kernel, patience rule
+        and masked trimming as the batched GBT grid group, so the two paths
+        cannot diverge.  Requires subsample/colsample == 1 (no per-round
+        host RNG) and a single device."""
+        from ..utils.profiling import count_launch
+        from .gbdt_kernels import _gbt_chain_rounds_jit
+
+        n = int(binned.shape[0])
+        es_chunk = max(1, min(8, self.early_stopping_rounds or 8))
+        run_es = use_es and len(val_idx) > 0
+        vi_arr = (jnp.asarray(val_idx, jnp.int32) if run_es
+                  else jnp.zeros(1, jnp.int32))
+        Fm = jnp.full((1, n), base, jnp.float32)
+        W1 = twj[None, :]
+
+        def one(v):
+            return jnp.full((1,), v, jnp.float32)
+
+        depth1 = jnp.full((1,), self.max_depth, jnp.int32)
+        lagged: list = []
+        best_metric = np.full(1, -np.inf)
+        best_len_a = np.zeros(1, np.int32)
+        stall_a = np.zeros(1, np.int32)
+        stopped = np.zeros(1, bool)
+        fb, tb, lb = [], [], []
+        n_rounds = 0
+        for ci in range(-(-self.max_iter // es_chunk)):
+            count_launch("gbt_rounds")
+            Fm, fs, ts, lfs, ms = _gbt_chain_rounds_jit(
+                binned, yj, W1, Fm, vi_arr, depth1,
+                one(self.reg_lambda), one(self.min_child_weight),
+                one(self.min_info_gain),
+                one(self.min_instances_per_node),
+                one(self.step_size), one(self.min_split_gain_raw),
+                es_chunk, self.max_depth, self.max_bins, obj,
+                self.hist_precision == "bf16", run_es)
+            fb.append(fs)
+            tb.append(ts)
+            lb.append(lfs)
+            start = n_rounds
+            n_rounds += es_chunk
+            if run_es:
+                pending = [(start + j + 1, ms[j]) for j in range(es_chunk)
+                           if start + j + 1 <= self.max_iter]
+                if es_patience_vec(_materialize_es(lagged), stopped,
+                                   best_metric, best_len_a, stall_a,
+                                   self.early_stopping_rounds):
+                    break
+                lagged = pending
+        if run_es and not stopped.all():
+            es_patience_vec(_materialize_es(lagged), stopped, best_metric,
+                            best_len_a, stall_a, self.early_stopping_rounds)
+        if run_es and best_len_a[0]:
+            best_len = int(best_len_a[0])
+        else:
+            best_len = n_rounds
+        best_len = min(best_len, self.max_iter)
+        feat = jnp.concatenate(fb)[:best_len, 0]
+        thresh = jnp.concatenate(tb)[:best_len, 0]
+        leaf = jnp.concatenate(lb)[:best_len, 0]
+        mode = "gbdt_binary" if obj == "binary" else "gbdt_reg"
+        return TreeEnsembleModel(
+            mode=mode, edges=edges, feat=feat, thresh=thresh, leaf=leaf,
+            base_score=base, n_classes=2)
 
     def _eval_metric_dev(self, F, yj, val_idx):
         """Early-stopping metric as a device scalar (sync is the caller's)."""

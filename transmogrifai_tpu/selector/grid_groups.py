@@ -351,8 +351,7 @@ class GBTGridGroup(GridGroup):
 
         from ..evaluators.metrics import (_aupr_dev, binary_metric_grid,
                                           regression_metric_grid)
-        from ..models.gbdt_kernels import (_resolve_compile_depth,
-                                           predict_ensemble, predict_tree)
+        from ..models.gbdt_kernels import predict_ensemble, predict_tree
         from ..models.trees import _dev_memo, _prep_tree_inputs
         from ..utils.profiling import count_launch
 
@@ -366,7 +365,7 @@ class GBTGridGroup(GridGroup):
         # static across chains; decline otherwise (sequential fallback)
         for attr in ("max_iter", "max_bins", "early_stopping_rounds",
                      "validation_fraction", "seed", "subsample_rate",
-                     "colsample"):
+                     "colsample", "hist_precision"):
             if len({getattr(e, attr) for e in ests}) > 1:
                 return None
         if e0.subsample_rate < 1.0 or e0.colsample < 1.0:
@@ -395,8 +394,10 @@ class GBTGridGroup(GridGroup):
             np.float32))
         lrs = vec("step_size")
         mgrs = vec("min_split_gain_raw")
-        heap_depth = _resolve_compile_depth(int(max(
-            e.max_depth for e in ests)))
+        # heap shapes sized to THIS group's deepest chain — never an outer
+        # sweep-wide hint (a depth-12 RF grid elsewhere in the sweep would
+        # inflate these depth-6 chains' compacted-slot histograms ~20x)
+        heap_depth = int(max(e.max_depth for e in ests))
 
         use_es = e0.early_stopping_rounds > 0
         rng = np.random.default_rng(e0.seed)
@@ -422,77 +423,94 @@ class GBTGridGroup(GridGroup):
         vi = (jnp.asarray(np.where(val)[0], jnp.int32)
               if use_es and val.any() else None)
 
-        feats_r, threshs_r, leaves_r = [], [], []
-        pending: list = []
         lagged: list = []
         best_metric = np.full(S, -np.inf)
         best_len = np.zeros(S, np.int32)
         stall = np.zeros(S, np.int32)
         stopped = np.zeros(S, bool)
-        es_chunk = max(1, min(8, e0.early_stopping_rounds or 1))
-        from ..models.gbdt_kernels import gbt_chain_chunk
+        es_chunk = max(1, min(8, e0.early_stopping_rounds or 8))
+        from ..models.gbdt_kernels import (_gbt_chain_rounds_jit,
+                                           gbt_chain_chunk)
 
         chunk = gbt_chain_chunk(S, heap_depth, X.shape[1],
                                 int(e0.max_bins), n)
+        run_es = use_es and vi is not None
+        vi_arr = vi if vi is not None else jnp.zeros(1, jnp.int32)
+        bf16 = e0.hist_precision == "bf16"
+        # es_chunk rounds per LAUNCH (lax.scan over rounds): through a
+        # remote tunnel the per-round dispatch dominated device compute
+        # (measured ~390 ms vs ~120 ms per round at 100k x 500).  Chunks
+        # always run full length — the ≤ es_chunk-1 overshoot rounds past
+        # max_iter or past a chain's stop are masked out of the final
+        # scoring, exactly like the ES trim; patience replay only ever sees
+        # rounds ≤ max_iter, so selection matches the per-round loop.
+        feats_b, threshs_b, leaves_b = [], [], []
         n_rounds = 0
-        for it in range(e0.max_iter):
+        for ci in range(-(-e0.max_iter // es_chunk)):
             if chunk >= S:
-                count_launch("gbt_chain_round")
-                f, t, lf = _grow_gbt_chain_round(
-                    binned, yj, Wj, Fm, depth_lim, lams, mcws, migs, mins_,
-                    lrs, mgrs, heap_depth, int(e0.max_bins), obj)
+                count_launch("gbt_chain_rounds")
+                Fm, fs, ts, lfs, ms = _gbt_chain_rounds_jit(
+                    binned, yj, Wj, Fm, vi_arr, depth_lim, lams, mcws, migs,
+                    mins_, lrs, mgrs, es_chunk, heap_depth,
+                    int(e0.max_bins), obj, bf16, run_es)
             else:
                 parts = []
                 for s0 in range(0, S, chunk):
                     s1 = min(s0 + chunk, S)
-                    count_launch("gbt_chain_round")
-                    parts.append(_grow_gbt_chain_round(
-                        binned, yj, Wj[s0:s1], Fm[s0:s1],
+                    count_launch("gbt_chain_rounds")
+                    parts.append(_gbt_chain_rounds_jit(
+                        binned, yj, Wj[s0:s1], Fm[s0:s1], vi_arr,
                         depth_lim[s0:s1], lams[s0:s1], mcws[s0:s1],
                         migs[s0:s1], mins_[s0:s1], lrs[s0:s1],
-                        mgrs[s0:s1], heap_depth, int(e0.max_bins), obj))
-                f = jnp.concatenate([p[0] for p in parts])
-                t = jnp.concatenate([p[1] for p in parts])
-                lf = jnp.concatenate([p[2] for p in parts])
-            Fm = Fm + _predict_round(binned, f, t, lf, heap_depth)
-            feats_r.append(f)
-            threshs_r.append(t)
-            leaves_r.append(lf)
-            n_rounds = it + 1
-            if use_es and vi is not None:
-                pending.append((n_rounds, _chain_es_metric(Fm, yj, vi, obj)))
-                if len(pending) >= es_chunk:
-                    # LAGGED fetch: materialize the chunk enqueued ONE chunk
-                    # ago (its device values finished ~es_chunk rounds back,
-                    # so the sync is ~free) — blocking on the fresh chunk
-                    # every 8 rounds serialized the whole pipeline (measured
-                    # ~0.9 s/round, fetch-bound).  ES decisions lag one
-                    # chunk; at most 2*es_chunk extra rounds grow and are
-                    # trimmed, exactly like the in-chunk replay.
-                    if _replay_es(lagged, stopped, best_metric, best_len,
-                                  stall, e0.early_stopping_rounds):
-                        break
-                    lagged = pending
-                    pending = []
-        if use_es and vi is not None and not stopped.all():
-            # drain the in-flight chunks so the final best_len is exact
-            for tail in (lagged, pending):
-                if _replay_es(tail, stopped, best_metric, best_len, stall,
-                              e0.early_stopping_rounds):
+                        mgrs[s0:s1], es_chunk, heap_depth,
+                        int(e0.max_bins), obj, bf16, run_es))
+                Fm = jnp.concatenate([p[0] for p in parts])
+                fs = jnp.concatenate([p[1] for p in parts], axis=1)
+                ts = jnp.concatenate([p[2] for p in parts], axis=1)
+                lfs = jnp.concatenate([p[3] for p in parts], axis=1)
+                ms = jnp.concatenate([p[4] for p in parts], axis=1)
+            feats_b.append(fs)
+            threshs_b.append(ts)
+            leaves_b.append(lfs)
+            start = n_rounds
+            n_rounds += es_chunk
+            if run_es:
+                # LAGGED fetch: replay the chunk enqueued ONE launch ago
+                # (its device values are long since finished, so the sync
+                # is ~free); decisions lag one chunk, the extra rounds are
+                # trimmed by the masked scoring below.
+                pending = [(start + j + 1, ms[j]) for j in range(es_chunk)
+                           if start + j + 1 <= e0.max_iter]
+                if _replay_es(lagged, stopped, best_metric, best_len,
+                              stall, e0.early_stopping_rounds):
                     break
+                lagged = pending
+        if run_es and not stopped.all():
+            # drain the in-flight chunk so the final best_len is exact
+            _replay_es(lagged, stopped, best_metric, best_len, stall,
+                       e0.early_stopping_rounds)
         if not use_es:
-            best_len[:] = n_rounds
+            best_len[:] = e0.max_iter
         else:
-            best_len[best_len == 0] = n_rounds
+            best_len[best_len == 0] = min(n_rounds, e0.max_iter)
 
-        # final per-chain scores over ALL rows from the trimmed ensembles
+        # final per-chain scores over ALL rows: ONE (rounds, chains) restack
+        # + per-chain masked-leaf predicts.  Trimming by zeroing the leaves
+        # of rounds >= best_len keeps every chain on the SAME (R, nodes)
+        # shapes — per-chain trimmed stacks meant up to S distinct
+        # predict_ensemble compiles plus R*S per-round device slices
+        R = n_rounds
+        feats_all = jnp.concatenate(feats_b).transpose(1, 0, 2)  # (S, R, nd)
+        threshs_all = jnp.concatenate(threshs_b).transpose(1, 0, 2)
+        leaves_all = jnp.concatenate(leaves_b).transpose(1, 0, 2, 3)
+        keep = (jnp.arange(R)[None, :]
+                < jnp.asarray(best_len)[:, None])               # (S, R)
+        leaves_m = leaves_all * keep[:, :, None, None]
         scores = []
         for s in range(S):
-            T_s = int(best_len[s])
-            fs = jnp.stack([feats_r[r][s] for r in range(T_s)])
-            ts = jnp.stack([threshs_r[r][s] for r in range(T_s)])
-            ls = jnp.stack([leaves_r[r][s] for r in range(T_s)])
-            raw = predict_ensemble(binned, fs, ts, ls, heap_depth)[:, 0]
+            count_launch("gbt_chain_score")
+            raw = predict_ensemble(binned, feats_all[s], threshs_all[s],
+                                   leaves_m[s], heap_depth)[:, 0]
             z = raw + base_j[s]
             scores.append(jax.nn.sigmoid(z) if obj == "binary" else z)
         scores = jnp.stack(scores).reshape(C, F, n).transpose(1, 0, 2)
@@ -515,28 +533,6 @@ def _replay_es(chunk_rows, stopped, best_metric, best_len, stall,
 
     return es_patience_vec(_materialize_es(chunk_rows), stopped,
                            best_metric, best_len, stall, patience)
-
-
-def _grow_gbt_chain_round(binned, yj, Wj, Fm, depth_lim, lams, mcws, migs,
-                          mins_, lrs, mgrs, heap_depth: int, n_bins: int,
-                          obj: str):
-    from ..models.gbdt_kernels import _gbt_chain_round_jit
-
-    return _gbt_chain_round_jit(binned, yj, Wj, Fm, depth_lim, lams, mcws,
-                                migs, mins_, lrs, mgrs, heap_depth, n_bins,
-                                obj)
-
-
-def _predict_round(binned, f, t, lf, heap_depth: int):
-    from ..models.gbdt_kernels import _predict_round_jit
-
-    return _predict_round_jit(binned, f, t, lf, heap_depth)
-
-
-def _chain_es_metric(Fm, yj, vi, obj: str):
-    from ..models.gbdt_kernels import _chain_es_metric_jit
-
-    return _chain_es_metric_jit(Fm, yj, vi, obj)
 
 
 def make_grid_group(proto, grid_points, problem_type: str,

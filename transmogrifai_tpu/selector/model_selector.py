@@ -239,6 +239,7 @@ class ModelSelector(PredictorEstimator):
         return self.validation_metric not in MINIMIZE_METRICS
 
     def _candidates(self):
+        from ..models.gbdt_kernels import compile_depth_hint
         from .grid_groups import make_grid_group
 
         out = []
@@ -250,18 +251,24 @@ class ModelSelector(PredictorEstimator):
                                      self.validation_metric,
                                      n_classes=self._class_count(None))
                      if self.mesh is None else None)
+            fam_depth = self._family_depth(proto, grid_points)
             for params in grid_points:
-                def fitter(X, y, w, p, proto=proto):
-                    est = proto.copy(**p)
-                    if self.mesh is not None:
-                        if hasattr(est, "with_mesh"):
-                            est.with_mesh(self.mesh)
-                    else:
-                        dev_score = est.fit_device(X, y, w,
-                                                   self.problem_type)
-                        if dev_score is not None:
-                            return dev_score  # device fit+score, no sync
-                    model = est.fit_raw(X, y, w)
+                def fitter(X, y, w, p, proto=proto, fam_depth=fam_depth):
+                    # heap shapes sized to THIS family's deepest candidate —
+                    # a sweep-wide hint made shallow families (XGB depth 6)
+                    # pay the deep family's (RF depth 12) compacted-slot
+                    # histogram cost, ~20x on the default grid
+                    with compile_depth_hint(fam_depth):
+                        est = proto.copy(**p)
+                        if self.mesh is not None:
+                            if hasattr(est, "with_mesh"):
+                                est.with_mesh(self.mesh)
+                        else:
+                            dev_score = est.fit_device(X, y, w,
+                                                       self.problem_type)
+                            if dev_score is not None:
+                                return dev_score  # device fit+score, no sync
+                        model = est.fit_raw(X, y, w)
                     return lambda Xe: self._score_fn(model, Xe)
                 out.append((type(proto).__name__, params, fitter, group))
         return out
@@ -273,18 +280,18 @@ class ModelSelector(PredictorEstimator):
                 "multiclass": DataCutter(),
                 "regression": DataSplitter()}[self.problem_type]
 
-    def _depth_hint(self):
-        """Deepest tree depth across the grid: the whole sweep (and the final
-        refit) then shares ONE compiled tree-growth program, with each
-        candidate's true max_depth applied as a traced depth limit
-        (gbdt_kernels.compile_depth_hint)."""
-        depths = []
-        for proto, grid_points in self.models_and_params:
-            proto_d = getattr(proto, "max_depth", None)
-            for params in grid_points:
-                d = params.get("max_depth", proto_d)
-                if d is not None:
-                    depths.append(int(d))
+    @staticmethod
+    def _family_depth(proto, grid_points):
+        """Deepest tree depth within ONE estimator family's grid: that
+        family's sequential fits then share ONE compiled tree-growth
+        program, each candidate's true max_depth applied as a traced depth
+        limit (gbdt_kernels.compile_depth_hint).  Per FAMILY, not sweep-
+        wide: families never share growth programs, so a global hint only
+        inflates the shallow family's heap shapes."""
+        proto_d = getattr(proto, "max_depth", None)
+        depths = [int(params.get("max_depth", proto_d))
+                  for params in grid_points
+                  if params.get("max_depth", proto_d) is not None]
         return max(depths) if depths else None
 
     def find_best_estimator(self, data: ColumnarDataset,
@@ -308,18 +315,15 @@ class ModelSelector(PredictorEstimator):
         train_mask[train_idx] = True
         base_w = splitter.train_weights(y, train_mask)
 
-        from ..models.gbdt_kernels import compile_depth_hint
-
         sub = data.take(train_idx)
         candidates = self._candidates()
-        with compile_depth_hint(self._depth_hint()):
-            best_i, results = self.validator.validate_with_dag(
-                candidates, sub, during_dag,
-                label_name=label_name,
-                features_name=self.features_feature.name,
-                y=y[train_idx], base_weights=base_w[train_idx],
-                eval_fn=self._metric, metric_name=self.validation_metric,
-                larger_better=self.larger_better)
+        best_i, results = self.validator.validate_with_dag(
+            candidates, sub, during_dag,
+            label_name=label_name,
+            features_name=self.features_feature.name,
+            y=y[train_idx], base_weights=base_w[train_idx],
+            eval_fn=self._metric, metric_name=self.validation_metric,
+            larger_better=self.larger_better)
         best_name, best_params, *_ = candidates[best_i]
         self.best_estimator = (best_name, best_params, results)
         # introspectable record of the fold-refit validation (survives the
@@ -370,29 +374,28 @@ class ModelSelector(PredictorEstimator):
         train_mask[train_idx] = True
         base_w = splitter.train_weights(y, train_mask)
 
-        from ..models.gbdt_kernels import compile_depth_hint
+        if self.best_estimator is not None:
+            # consume the workflow-CV winner: a later fit on new data must
+            # validate afresh, not reuse a stale selection
+            best_name, best_params, results = self.best_estimator
+            self.best_estimator = None
+        else:
+            candidates = self._candidates()
+            best_i, results = self.validator.validate(
+                candidates, X, y, base_w,
+                eval_fn=self._metric, metric_name=self.validation_metric,
+                larger_better=self.larger_better)
+            best_name, best_params, *_ = candidates[best_i]
 
-        with compile_depth_hint(self._depth_hint()):
-            if self.best_estimator is not None:
-                # consume the workflow-CV winner: a later fit on new data must
-                # validate afresh, not reuse a stale selection
-                best_name, best_params, results = self.best_estimator
-                self.best_estimator = None
-            else:
-                candidates = self._candidates()
-                best_i, results = self.validator.validate(
-                    candidates, X, y, base_w,
-                    eval_fn=self._metric, metric_name=self.validation_metric,
-                    larger_better=self.larger_better)
-                best_name, best_params, *_ = candidates[best_i]
-
-            # refit best on the full training split (ModelSelector.fit :180)
-            best_proto = next(p for p, _ in self.models_and_params
-                              if type(p).__name__ == best_name)
-            best_est = best_proto.copy(**best_params)
-            if self.mesh is not None and hasattr(best_est, "with_mesh"):
-                best_est.with_mesh(self.mesh)
-            best_model = best_est.fit_raw(X, y, base_w)
+        # refit best on the full training split (ModelSelector.fit :180) at
+        # the winner's OWN depth (family hints live in the fitters; nothing
+        # outside the winner's family shares its growth program)
+        best_proto = next(p for p, _ in self.models_and_params
+                          if type(p).__name__ == best_name)
+        best_est = best_proto.copy(**best_params)
+        if self.mesh is not None and hasattr(best_est, "with_mesh"):
+            best_est.with_mesh(self.mesh)
+        best_model = best_est.fit_raw(X, y, base_w)
 
         # ONE batched predict over the full matrix (hits the sweep's binning
         # and upload memos) — slicing rows first would re-bin and re-upload
