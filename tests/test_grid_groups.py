@@ -245,6 +245,151 @@ class TestGBTChainParity:
                                                     abs=3e-3)
 
 
+class TestDepthTruncation:
+    """Depth-truncation sharing (round 4): one base forest per
+    (min_info_gain, min_instances) group at the group's max depth must
+    reproduce every shallower max_depth candidate EXACTLY — splits at a
+    level never depend on deeper levels, and the snapshot leaves are the
+    level's own histogram totals."""
+
+    def test_truncation_equals_native_depth_growth(self):
+        import jax.numpy as jnp
+
+        from transmogrifai_tpu.models.gbdt_kernels import (
+            grow_rf_grid, predict_ensemble,
+        )
+        from transmogrifai_tpu.models.trees import _prep_tree_inputs
+
+        X, y = _binary_data(1200, 8, seed=3)
+        _, binned = _prep_tree_inputs(X, 32)
+        Y = np.eye(2, dtype=np.float32)[y.astype(int)]
+        W = np.ones((1, len(y)), np.float32)     # one fold, unit weights
+        kw = dict(seed=42, n_trees=5, msub=8, subsample_rate=1.0,
+                  n_bins=32, onehot_targets=True)
+        # native growth: two pairs with the same gates, depths 3 and 6
+        f_n, t_n, l_n = grow_rf_grid(
+            binned, jnp.asarray(Y), jnp.asarray(W),
+            pair_fold=np.zeros(2, np.int32),
+            pair_min_ig=np.array([0.01, 0.01], np.float32),
+            pair_min_inst=np.array([5.0, 5.0], np.float32),
+            pair_depth=np.array([3, 6], np.int32), **kw)
+        # shared growth: ONE base pair at depth 6, snapshot at level 3
+        f_s, t_s, l_s, snaps = grow_rf_grid(
+            binned, jnp.asarray(Y), jnp.asarray(W),
+            pair_fold=np.zeros(1, np.int32),
+            pair_min_ig=np.array([0.01], np.float32),
+            pair_min_inst=np.array([5.0], np.float32),
+            pair_depth=np.array([6], np.int32), leaf_levels=(3,), **kw)
+        # the deep pair is bit-identical to the base pair
+        np.testing.assert_array_equal(np.asarray(f_s[0]), np.asarray(f_n[1]))
+        np.testing.assert_array_equal(np.asarray(t_s[0]), np.asarray(t_n[1]))
+        np.testing.assert_allclose(np.asarray(l_s[0]), np.asarray(l_n[1]))
+        # the base trees' first 3 levels ARE the depth-3 pair's splits
+        np.testing.assert_array_equal(np.asarray(f_s[0][:, :7]),
+                                      np.asarray(f_n[0][:, :7]))
+        np.testing.assert_array_equal(np.asarray(t_s[0][:, :7]),
+                                      np.asarray(t_n[0][:, :7]))
+        # truncated prediction (sliced heap + level-3 snapshot leaves)
+        # == the natively grown depth-3 pair's prediction (integer bag
+        # weights -> exact histogram sums in both paths)
+        p_native = np.asarray(predict_ensemble(
+            binned, f_n[0], t_n[0], l_n[0], 6))
+        p_trunc = np.asarray(predict_ensemble(
+            binned, f_s[0][:, :7], t_s[0][:, :7], snaps[3][0], 3))
+        np.testing.assert_allclose(p_trunc, p_native, atol=1e-6)
+
+    def test_shared_group_matches_sequential_three_depths(self, monkeypatch):
+        """End-to-end: a depth-varying RF grid through the shared group
+        must select the same winner with the same metrics as the
+        sequential per-candidate path."""
+        X, y = _binary_data(2000, 8, seed=5)
+        mp = [(OpRandomForestClassifier(num_trees=6),
+               grid(max_depth=[2, 4, 6], min_info_gain=[0.0, 0.05]))]
+        best_g, res_g = _run_selector(mp, "binary", X, y)
+
+        from transmogrifai_tpu.selector import grid_groups
+        monkeypatch.setattr(grid_groups, "make_grid_group",
+                            lambda *a, **k: None)
+        best_s, res_s = _run_selector(mp, "binary", X, y)
+        assert best_g == best_s
+        for rg, rs in zip(res_g, res_s):
+            assert rg.error is None and rs.error is None
+            assert rg.metric_value == pytest.approx(rs.metric_value,
+                                                    abs=2e-3)
+
+
+class TestWinnerRefitReuse:
+    """Round-4 refit reuse: groups solve an appended full-train weight row,
+    so the winner's refit model comes from the sweep program itself
+    (ModelSelector.scala:145-209 refits from scratch instead)."""
+
+    @staticmethod
+    def _fold_ctxs(y, num_folds=3, seed=7):
+        from transmogrifai_tpu.selector.validators import make_folds
+        folds = make_folds(len(y), num_folds, y=y, stratify=True, seed=seed)
+        return [((folds != k).astype(np.float32),
+                 (folds == k).astype(np.float32)) for k in range(num_folds)]
+
+    def test_lr_group_refit_matches_sequential(self):
+        X, y = _binary_data(2500, 10, seed=8)
+        Xh, yh = _binary_data(800, 10, seed=9)
+        pts = grid(reg_param=[0.01, 0.1])
+        g = make_grid_group(OpLogisticRegression(), pts, "binary", "AuPR")
+        assert g.run(X, y, self._fold_ctxs(y)) is not None
+        for row, p in enumerate(pts):
+            model = g.refit_model(row)
+            assert model is not None
+            seq = OpLogisticRegression(**p).fit_raw(
+                X, y, np.ones(len(y), np.float32))
+            pg = model.predict_batch(Xh).probability[:, 1]
+            ps = seq.predict_batch(Xh).probability[:, 1]
+            # majorization vs Newton-IRLS: same optimum, solver-level tol
+            np.testing.assert_allclose(pg, ps, atol=2e-2)
+            assert np.corrcoef(pg, ps)[0, 1] > 0.999
+
+    def test_gbt_group_declines_refit_reuse(self):
+        """GBT groups deliberately do NOT append refit chains (the extra
+        chains cost ~C/(C·F) of the whole sweep unconditionally, while the
+        sequential refit they replace is paid only when GBT wins) — the
+        selector must fall back to the sequential refit path."""
+        from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+        X, y = _binary_data(1200, 8, seed=10)
+        proto = OpXGBoostClassifier(num_round=5, eta=0.2, max_depth=3,
+                                    gamma=0.0, early_stopping_rounds=0)
+        pts = grid(min_child_weight=[1.0, 10.0])
+        g = make_grid_group(proto, pts, "binary", "AuPR")
+        assert g.run(X, y, self._fold_ctxs(y)) is not None
+        assert g.refit_model(0) is None
+
+    def test_selector_uses_group_refit(self, monkeypatch):
+        """fit_columns must consume the group's refit model (no sequential
+        fit_raw call for the winner when the group holds one)."""
+        import transmogrifai_tpu.models.classification as cls_mod
+        from transmogrifai_tpu.types.columns import FeatureColumn
+        from transmogrifai_tpu.types.feature_types import OPVector, RealNN
+
+        X, y = _binary_data(2000, 8, seed=12)
+        calls = {"n": 0}
+        orig = cls_mod.OpLogisticRegression.fit_raw
+
+        def counting_fit_raw(self, *a, **k):
+            calls["n"] += 1
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(cls_mod.OpLogisticRegression, "fit_raw",
+                            counting_fit_raw)
+        sel = ModelSelector(
+            [(OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))],
+            problem_type="binary",
+            validator=OpCrossValidation(num_folds=3, seed=7, stratify=True))
+        model = sel.fit_columns(None, FeatureColumn(RealNN, y),
+                                FeatureColumn(OPVector, X))
+        assert calls["n"] == 0, (
+            "winner refit should reuse the group's full-train solve, not "
+            "call fit_raw")
+        assert model is not None
+
+
 class TestGroupFailureIsolation:
     def test_group_exception_falls_back(self, monkeypatch):
         """A raising group must not kill the sweep — members refit

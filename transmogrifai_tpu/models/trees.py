@@ -444,6 +444,55 @@ def _prep_tree_inputs(X, max_bins):
     return edges, _binned_cached(Xf, hx, edges)
 
 
+#: sampled zero fraction at/above which the tree fit takes the sparse path
+#: (nonzero-aware sketch + CSR histogram build)
+_SPARSE_ZERO_FRAC = 0.75
+#: below this element count the dense kernel is fast enough that CSR
+#: build cost isn't worth it
+_SPARSE_MIN_ELEMS = 1 << 24
+
+
+def _prep_tree_inputs_sparse(X, max_bins):
+    """Like ``_prep_tree_inputs`` but detects wide mostly-zero matrices and
+    returns an additional CSR device triple for the sparse histogram path
+    (gbdt_kernels._sparse_level_hists); csr is None for dense inputs.
+
+    Sparse inputs also sketch their bin edges over the NONZERO values
+    (quantile_bins_sparse_aware): an all-values sketch of a 95%-zero
+    feature collapses to ~2 usable bins — XGBoost's sketch is
+    sparsity-aware (SURVEY §2.11), and matching it is both a quality and
+    a parity fix.
+    """
+    from .gbdt_kernels import (
+        build_feature_csr, quantile_bins_sparse_aware,
+    )
+
+    Xf = _as_f32(X)
+    n, d = Xf.shape
+    if Xf.size < _SPARSE_MIN_ELEMS:
+        e, b = _prep_tree_inputs(Xf, max_bins)
+        return e, b, None
+    step = max(1, n // 4096)
+    if float((Xf[::step] == 0).mean()) < _SPARSE_ZERO_FRAC:
+        e, b = _prep_tree_inputs(Xf, max_bins)
+        return e, b, None
+    hx = _content_hash(Xf)
+    edges = _memo(("edges_sp", hx, Xf.shape, max_bins),
+                  lambda: quantile_bins_sparse_aware(Xf, max_bins))
+    binned = _binned_cached(Xf, hx, edges)
+
+    def build():
+        host = build_feature_csr(Xf, edges)
+        if host is None:
+            return ()          # non-qualifying: memoized as empty, not None
+        rows, bins, zero_bin = host
+        zb_oh = np.eye(max_bins, dtype=np.float32)[zero_bin]   # (D, B)
+        return (_upload_timed(rows), _upload_timed(bins),
+                _upload_timed(zb_oh))
+    csr = _memo(("csr", hx, Xf.shape, max_bins), build)
+    return edges, binned, (csr if csr else None)
+
+
 def _feature_subset_size(strategy: str, d: int, is_classification: bool) -> int:
     if strategy == "all":
         return d
@@ -630,7 +679,7 @@ class _GBTBase(PredictorEstimator):
                  validation_fraction: float = 0.2,
                  min_instances_per_node: int = 1,
                  min_split_gain_raw: float = 0.0,
-                 seed: int = 42, hist_precision: str = "f32",
+                 seed: int = 42, hist_precision: str = "bf16",
                  uid: Optional[str] = None):
         super().__init__(operation_name=self._op_name, uid=uid)
         self.max_iter = max_iter
@@ -649,10 +698,13 @@ class _GBTBase(PredictorEstimator):
         #: per-node-weight minInfoGain)
         self.min_split_gain_raw = min_split_gain_raw
         self.seed = seed
-        #: 'f32' (default) or 'bf16': histogram one-hot/dot precision.
-        #: bf16 halves the (rows, bins·features) stream — RF always runs it
-        #: (integer channels, exact) — but GBT gradients are continuous and
-        #: compound across rounds, so it is opt-in pending the quality gate.
+        #: 'bf16' (default) or 'f32': histogram one-hot/dot precision.
+        #: bf16 halves the (rows, bins·features) one-hot stream — the
+        #: kernel's bandwidth floor — and runs the dots at ~2x MXU
+        #: throughput.  RF always ran it (integer channels, exact); for
+        #: GBT's continuous compounding gradients the default is backed by
+        #: the measured quality gate in tests/test_bf16_gate.py (holdout
+        #: AuPR/RMSE deltas inside seed noise).  Set 'f32' to opt out.
         self.hist_precision = hist_precision
         self.mesh = None
 
@@ -673,7 +725,14 @@ class _GBTBase(PredictorEstimator):
 
     def fit_raw(self, X: np.ndarray, y: np.ndarray, w=None):
         n, d = X.shape
-        edges, binned = _prep_tree_inputs(X, self.max_bins)
+        if self.mesh is None:
+            # wide mostly-zero matrices take the sparse histogram path
+            # (nonzero-aware sketch + CSR build over the ~density·N·D
+            # nonzero entries; XGBoost-core parity, SURVEY §2.11)
+            edges, binned, csr = _prep_tree_inputs_sparse(X, self.max_bins)
+        else:
+            edges, binned = _prep_tree_inputs(X, self.max_bins)
+            csr = None
         rng = np.random.default_rng(self.seed)
         base_w = (np.ones(n, np.float32) if w is None
                   else np.asarray(w, np.float32))
@@ -742,7 +801,10 @@ class _GBTBase(PredictorEstimator):
             # round's device compute
             return self._fit_scan_chunks(binned, edges, yj, twj, obj,
                                          float(base), use_es,
-                                         np.where(val)[0])
+                                         np.where(val)[0], csr=csr,
+                                         integer_weights=bool(
+                                             (train_w == np.floor(train_w))
+                                             .all()))
 
         feats, threshs, leaves = [], [], []
         best_metric, best_len, stall = -np.inf, 0, 0
@@ -785,7 +847,7 @@ class _GBTBase(PredictorEstimator):
                 feat_mask=jnp.asarray(mask), newton_leaf=True,
                 learning_rate=self.step_size,
                 min_gain_raw=self.min_split_gain_raw,
-                hist_bf16=self.hist_precision == "bf16")
+                hist_bf16=self.hist_precision == "bf16", csr=csr)
             from .gbdt_kernels import predict_tree
 
             heap_depth = int(np.log2(f.shape[0] + 1))
@@ -826,16 +888,31 @@ class _GBTBase(PredictorEstimator):
             n_classes=(k if obj == "multiclass" else 2))
 
     def _fit_scan_chunks(self, binned, edges, yj, twj, obj: str,
-                         base: float, use_es: bool, val_idx):
+                         base: float, use_es: bool, val_idx, csr=None,
+                         integer_weights: bool = True):
         """Whole-fit scan-chunked boosting: es_chunk rounds per launch via
         ``_gbt_chain_rounds_jit`` with S=1 — the same kernel, patience rule
         and masked trimming as the batched GBT grid group, so the two paths
         cannot diverge.  Requires subsample/colsample == 1 (no per-round
         host RNG) and a single device."""
         from ..utils.profiling import count_launch
-        from .gbdt_kernels import _gbt_chain_rounds_jit
+        from .gbdt_kernels import _gbt_chain_rounds_jit, _resolve_compile_depth
 
         n = int(binned.shape[0])
+        # family compile-depth hint: sequential-fallback candidates of
+        # differing max_depth share ONE compiled scan program (their own
+        # depth rides the traced depth limit) instead of recompiling the
+        # whole n-rounds scan per distinct depth (ADVICE r3)
+        heap_depth = _resolve_compile_depth(self.max_depth)
+        # XGB-style gating (min_child_weight + gamma) with no count-based
+        # gates: the count histogram channel is inert — drop it (1/3 off
+        # the per-chain histogram cost; gbdt_kernels bag_mode='newton').
+        # Integer weights only: the count channel is WEIGHTED, so with
+        # fractional sample weights 'CL >= 1' can gate a split that
+        # dropping the channel would allow (code-review r4)
+        skip_counts = (float(self.min_instances_per_node) <= 1
+                       and float(self.min_info_gain) == 0.0
+                       and integer_weights)
         es_chunk = max(1, min(8, self.early_stopping_rounds or 8))
         run_es = use_es and len(val_idx) > 0
         vi_arr = (jnp.asarray(val_idx, jnp.int32) if run_es
@@ -862,8 +939,9 @@ class _GBTBase(PredictorEstimator):
                 one(self.min_info_gain),
                 one(self.min_instances_per_node),
                 one(self.step_size), one(self.min_split_gain_raw),
-                es_chunk, self.max_depth, self.max_bins, obj,
-                self.hist_precision == "bf16", run_es)
+                es_chunk, heap_depth, self.max_bins, obj,
+                self.hist_precision == "bf16", run_es, csr=csr,
+                skip_counts=skip_counts)
             fb.append(fs)
             tb.append(ts)
             lb.append(lfs)
@@ -910,10 +988,12 @@ class _GBTBase(PredictorEstimator):
 def _materialize_es(chunk_rows):
     """Fetch a chunk of (round, device-metric) pairs in ONE sync — THE
     chunk-fetch idiom for both ES paths: metrics may be scalars (single
-    chain) or (S,) chain vectors (the batched GBT grid group)."""
+    chain) or (S,) chain vectors (the batched GBT grid group).  The sync
+    books queue-drain separately from the byte transfer (fetch_timed)."""
     if not chunk_rows:
         return []
-    vals = np.asarray(jnp.stack([m for _, m in chunk_rows]))
+    from ..utils.profiling import fetch_timed
+    vals = fetch_timed(jnp.stack([m for _, m in chunk_rows]))
     return [(n_at, m) for (n_at, _), m in zip(chunk_rows, vals)]
 
 
@@ -991,6 +1071,7 @@ class OpXGBoostClassifier(_GBTBase):
                  subsample: float = 1.0, colsample_bytree: float = 1.0,
                  max_bins: int = 32, early_stopping_rounds: int = 20,
                  num_class: int = 0, seed: int = 42,
+                 hist_precision: str = "bf16",
                  uid: Optional[str] = None):
         super().__init__(
             max_iter=num_round, max_depth=max_depth, step_size=eta,
@@ -998,7 +1079,8 @@ class OpXGBoostClassifier(_GBTBase):
             min_child_weight=min_child_weight,
             min_split_gain_raw=gamma, subsample_rate=subsample,
             colsample=colsample_bytree,
-            early_stopping_rounds=early_stopping_rounds, seed=seed, uid=uid)
+            early_stopping_rounds=early_stopping_rounds, seed=seed,
+            hist_precision=hist_precision, uid=uid)
         self.num_round = num_round
         self.eta = eta
         self.gamma = gamma

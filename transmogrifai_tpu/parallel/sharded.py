@@ -87,7 +87,7 @@ def full_train_step(X, binned, y, w, state: TrainStepState, *,
             f"tree_feat must hold a full heap (2^depth - 1 nodes), got "
             f"{n_nodes}")
     depth = int(np.log2(n_nodes + 1))
-    feat, thresh, _leaf = _grow_tree_traced(
+    feat, thresh, _leaf, _ = _grow_tree_traced(
         binned, (g * w)[:, None], (h * w)[:, None], w,
         jnp.ones(binned.shape[1], bool), jnp.int32(depth),
         max_depth=depth, n_bins=n_bins, lam=jnp.float32(1.0),
@@ -158,7 +158,8 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
             learning_rate=jnp.float32(learning_rate),
             all_reduce=psum,
             bag_mode="onehot" if onehot_targets else "bagged")
-        return jax.vmap(fn)(G, H, BW_s, mask_r, limit_r)
+        f, t, lf, _ = jax.vmap(fn)(G, H, BW_s, mask_r, limit_r)
+        return f, t, lf
 
     fn = shard_map(
         shard_fn, mesh=mesh,

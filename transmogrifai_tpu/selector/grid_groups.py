@@ -38,7 +38,26 @@ class GridGroup:
             weight_ctxs: Sequence[Tuple[np.ndarray, np.ndarray]]):
         raise NotImplementedError
 
+    def refit_model(self, row: int):
+        """Fitted full-train model for candidate ``row``, or None.
+
+        Groups that solve an appended full-train weight row alongside the
+        folds hold every candidate's refit artifacts on device after
+        ``run`` — the selector asks for the WINNER's here instead of paying
+        a fresh sequential fit (the reference refits from scratch,
+        ModelSelector.scala:145-209)."""
+        return None
+
     # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _full_weights(weight_ctxs) -> np.ndarray:
+        """Full-train weights from any one fold context: fold train + eval
+        masks partition the selector's base weights, so w_tr + w_ev is the
+        refit weighting for CV folds and TVS splits alike."""
+        w_tr, w_ev = weight_ctxs[0]
+        return (np.asarray(w_tr, np.float32)
+                + np.asarray(w_ev, np.float32))
 
     def _param(self, params: Dict[str, Any], name: str):
         return params.get(name, getattr(self.proto, name))
@@ -112,11 +131,17 @@ class LogRegGridGroup(_LinearGridGroup):
 
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         regs, alphas = self._regs_alphas()
+        F = W_tr.shape[0]
+        # appended full-train row: the winner's refit coefficients come out
+        # of the SAME program (+1/F of the solve; saves the sequential
+        # Newton refit over the full matrix)
+        W_aug = np.ascontiguousarray(
+            np.vstack([W_tr, self._full_weights(weight_ctxs)[None]]))
         max_iter = int(self._param(self.grid_points[0], "max_iter"))
         tol = float(self._param(self.grid_points[0], "tol"))
-        scores, _ = fit_logreg_grid(
+        scores, _, coef, icpt = fit_logreg_grid(
             _dev_f32(X), np.nan_to_num(np.asarray(y, np.float32)),
-            _dev_f32(W_tr, tag="W_tr"), regs, alphas,
+            _dev_f32(W_aug, tag="W_tr"), regs, alphas,
             # majorization steps are ~D^2/N cheaper than Newton steps;
             # give the solver a proportionally larger budget at a metric-
             # sufficient tolerance
@@ -125,7 +150,17 @@ class LogRegGridGroup(_LinearGridGroup):
                                            "fit_intercept")),
             standardization=bool(self._param(self.grid_points[0],
                                              "standardization")))
-        return self._metric_rows(y, scores, W_ev, binary=True)
+        self._refit_coef, self._refit_icpt = coef[F], icpt[F]  # device (C, D)
+        return self._metric_rows(y, scores[:F], W_ev, binary=True)
+
+    def refit_model(self, row: int):
+        if getattr(self, "_refit_coef", None) is None:
+            return None
+        from ..models.classification import LogisticRegressionModel
+
+        return LogisticRegressionModel(
+            coef=np.asarray(self._refit_coef[row]).tolist(),
+            intercept=float(np.asarray(self._refit_icpt[row])))
 
 
 class LinRegGridGroup(_LinearGridGroup):
@@ -140,16 +175,29 @@ class LinRegGridGroup(_LinearGridGroup):
 
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         regs, alphas = self._regs_alphas()
-        preds = fit_linreg_grid(
+        F = W_tr.shape[0]
+        W_aug = np.ascontiguousarray(
+            np.vstack([W_tr, self._full_weights(weight_ctxs)[None]]))
+        preds, coef, icpt = fit_linreg_grid(
             _dev_f32(X), np.nan_to_num(np.asarray(y, np.float32)),
-            _dev_f32(W_tr, tag="W_tr"), regs, alphas,
+            _dev_f32(W_aug, tag="W_tr"), regs, alphas,
             max_iter=int(self._param(self.grid_points[0], "max_iter")),
             tol=float(self._param(self.grid_points[0], "tol")),
             fit_intercept=bool(self._param(self.grid_points[0],
                                            "fit_intercept")),
             standardization=bool(self._param(self.grid_points[0],
                                              "standardization")))
-        return self._metric_rows(y, preds, W_ev, binary=False)
+        self._refit_coef, self._refit_icpt = coef[F], icpt[F]
+        return self._metric_rows(y, preds[:F], W_ev, binary=False)
+
+    def refit_model(self, row: int):
+        if getattr(self, "_refit_coef", None) is None:
+            return None
+        from ..models.regression import LinearRegressionModel
+
+        return LinearRegressionModel(
+            coef=np.asarray(self._refit_coef[row]).tolist(),
+            intercept=float(np.asarray(self._refit_icpt[row])))
 
 
 class SoftmaxGridGroup(_LinearGridGroup):
@@ -261,18 +309,44 @@ class RFGridGroup(GridGroup):
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         F = W_tr.shape[0]
         C = len(self.grid_points)
-        # pair p = c * F + f
-        pair_fold = np.tile(np.arange(F, dtype=np.int32), C)
-        pair_depth = np.repeat(
-            [int(self._param(p, "max_depth")) for p in self.grid_points], F)
-        pair_ig = np.repeat(
-            [float(self._param(p, "min_info_gain"))
-             for p in self.grid_points], F)
-        pair_inst = np.repeat(
-            [float(self._param(p, "min_instances_per_node"))
-             for p in self.grid_points], F)
         T = int(self._param(self.grid_points[0], "num_trees"))
-        feats, threshs, leaves = grow_rf_grid(
+
+        # Depth-truncation sharing: candidates that differ ONLY in max_depth
+        # share bags/folds by construction (bags key on tree id), and for
+        # level-wise greedy growth a shallower candidate is exactly the
+        # deeper tree truncated at its depth (splits at level l never depend
+        # on deeper levels).  Grow ONE base forest per distinct
+        # (min_info_gain, min_instances) group at that group's max depth and
+        # read every shallower candidate off the base trees' leaf snapshots
+        # — the r3 default grid (3 depths x 6 gate combos) grew 3x the
+        # trees this needs.  The reference pays the full redundancy on its
+        # thread pool (OpCrossValidation.scala:113-138).
+        cand_depth = [int(self._param(p, "max_depth"))
+                      for p in self.grid_points]
+        cand_key = [(float(self._param(p, "min_info_gain")),
+                     float(self._param(p, "min_instances_per_node")))
+                    for p in self.grid_points]
+        base_keys: List[Tuple[float, float]] = []
+        key2base: Dict[Tuple[float, float], int] = {}
+        for key in cand_key:
+            if key not in key2base:
+                key2base[key] = len(base_keys)
+                base_keys.append(key)
+        Cb = len(base_keys)
+        base_depth = [0] * Cb
+        for ci in range(C):
+            bi = key2base[cand_key[ci]]
+            base_depth[bi] = max(base_depth[bi], cand_depth[ci])
+        leaf_levels = tuple(sorted({
+            cand_depth[ci] for ci in range(C)
+            if cand_depth[ci] < base_depth[key2base[cand_key[ci]]]}))
+
+        # base pair p = bi * F + f
+        pair_fold = np.tile(np.arange(F, dtype=np.int32), Cb)
+        pair_ig = np.repeat([k[0] for k in base_keys], F)
+        pair_inst = np.repeat([k[1] for k in base_keys], F)
+        pair_depth = np.repeat(base_depth, F)
+        grown = grow_rf_grid(
             binned, _dev_memo(Y, "rf_Y"), _dev_memo(W_tr, "rf_Wtr"),
             seed=int(proto.seed), n_trees=T, pair_fold=pair_fold,
             pair_min_ig=pair_ig, pair_min_inst=pair_inst,
@@ -280,13 +354,46 @@ class RFGridGroup(GridGroup):
             subsample_rate=float(self._param(self.grid_points[0],
                                              "subsample_rate")),
             n_bins=int(self._param(self.grid_points[0], "max_bins")),
-            onehot_targets=cls)
+            onehot_targets=cls, leaf_levels=leaf_levels)
+        feats, threshs, leaves = grown[:3]
+        snap_map = grown[3] if leaf_levels else {}
         heap_depth = int(np.log2(feats.shape[2] + 1))
         mode = "rf_cls" if cls else "rf_reg"
         ptype = ("multiclass" if multiclass
                  else "binary" if cls else "regression")
-        scores = _score_pairs_jit(binned, feats, threshs, leaves,
-                                  heap_depth, mode, ptype)  # (C*F, N)
+
+        # candidate-pair cp = c * F + f -> base pair + truncation depth
+        cp_base = np.asarray(
+            [key2base[cand_key[c]] * F + f
+             for c in range(C) for f in range(F)], np.int32)
+        cp_depth = np.repeat(cand_depth, F)
+        cp_full = np.asarray(
+            [cand_depth[c] == base_depth[key2base[cand_key[c]]]
+             for c in range(C) for f in range(F)], bool)
+        order: List[int] = []
+        parts = []
+        full_idx = np.where(cp_full)[0]
+        if len(full_idx):
+            sel = jnp.asarray(cp_base[full_idx])
+            parts.append(_score_pairs_jit(
+                binned, feats[sel], threshs[sel], leaves[sel],
+                heap_depth, mode, ptype))
+            order.extend(full_idx.tolist())
+        for dt in sorted(set(cp_depth[~cp_full].tolist())):
+            idx = np.where(~cp_full & (cp_depth == dt))[0]
+            sel = jnp.asarray(cp_base[idx])
+            nd = 2 ** dt - 1
+            # the base trees' first dt levels ARE the depth-dt candidate's
+            # splits; its leaves are the level-dt histogram-total snapshot
+            parts.append(_score_pairs_jit(
+                binned, feats[sel][:, :, :nd], threshs[sel][:, :, :nd],
+                snap_map[dt][sel], dt, mode, ptype))
+            order.extend(idx.tolist())
+        scores = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        if order != list(range(C * F)):
+            inv = np.empty(C * F, np.int32)
+            inv[np.asarray(order, np.int32)] = np.arange(C * F, dtype=np.int32)
+            scores = scores[jnp.asarray(inv)]
         scores = scores.reshape(C, F, n).transpose(1, 0, 2)  # (F, C, N)
         if multiclass:
             m = multiclass_metric_grid(y, scores, jnp.asarray(W_ev),
@@ -352,7 +459,7 @@ class GBTGridGroup(GridGroup):
         from ..evaluators.metrics import (_aupr_dev, binary_metric_grid,
                                           regression_metric_grid)
         from ..models.gbdt_kernels import predict_ensemble, predict_tree
-        from ..models.trees import _dev_memo, _prep_tree_inputs
+        from ..models.trees import _dev_memo, _prep_tree_inputs_sparse
         from ..utils.profiling import count_launch
 
         ests = self._chains()
@@ -373,11 +480,18 @@ class GBTGridGroup(GridGroup):
 
         y = np.nan_to_num(np.asarray(y, np.float32))
         n = len(y)
-        edges, binned = _prep_tree_inputs(X, e0.max_bins)
+        edges, binned, csr = _prep_tree_inputs_sparse(X, e0.max_bins)
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         F = W_tr.shape[0]
         C = len(ests)
-        S = C * F
+        # No appended full-train refit chains here, deliberately: measured
+        # per-round cost is ~(shared one-hot + per-chain histogram dots),
+        # so +C chains cost ~C/(C·F) of the whole sweep UNCONDITIONALLY,
+        # while the sequential refit they would replace is paid only when
+        # a GBT candidate actually wins — negative expected value for the
+        # default grid (LR groups, whose extra row is ~free, do reuse).
+        S_val = C * F
+        S = S_val
         chain_fold = np.tile(np.arange(F, dtype=np.int32), C)
         chain_est = np.repeat(np.arange(C), F)
 
@@ -437,6 +551,12 @@ class GBTGridGroup(GridGroup):
         run_es = use_es and vi is not None
         vi_arr = vi if vi is not None else jnp.zeros(1, jnp.int32)
         bf16 = e0.hist_precision == "bf16"
+        # count channel inert under pure XGB gating -> 2-channel
+        # histograms; integer fold/train weights only (the count channel
+        # is weighted — fractional weights could make 'CL >= 1' bite)
+        skip_counts = (all(float(e.min_instances_per_node) <= 1
+                           and float(e.min_info_gain) == 0.0 for e in ests)
+                       and bool((W_train == np.floor(W_train)).all()))
         # es_chunk rounds per LAUNCH (lax.scan over rounds): through a
         # remote tunnel the per-round dispatch dominated device compute
         # (measured ~390 ms vs ~120 ms per round at 100k x 500).  Chunks
@@ -452,7 +572,8 @@ class GBTGridGroup(GridGroup):
                 Fm, fs, ts, lfs, ms = _gbt_chain_rounds_jit(
                     binned, yj, Wj, Fm, vi_arr, depth_lim, lams, mcws, migs,
                     mins_, lrs, mgrs, es_chunk, heap_depth,
-                    int(e0.max_bins), obj, bf16, run_es)
+                    int(e0.max_bins), obj, bf16, run_es, csr=csr,
+                    skip_counts=skip_counts)
             else:
                 parts = []
                 for s0 in range(0, S, chunk):
@@ -463,7 +584,8 @@ class GBTGridGroup(GridGroup):
                         depth_lim[s0:s1], lams[s0:s1], mcws[s0:s1],
                         migs[s0:s1], mins_[s0:s1], lrs[s0:s1],
                         mgrs[s0:s1], es_chunk, heap_depth,
-                        int(e0.max_bins), obj, bf16, run_es))
+                        int(e0.max_bins), obj, bf16, run_es, csr=csr,
+                        skip_counts=skip_counts))
                 Fm = jnp.concatenate([p[0] for p in parts])
                 fs = jnp.concatenate([p[1] for p in parts], axis=1)
                 ts = jnp.concatenate([p[2] for p in parts], axis=1)
@@ -507,7 +629,7 @@ class GBTGridGroup(GridGroup):
                 < jnp.asarray(best_len)[:, None])               # (S, R)
         leaves_m = leaves_all * keep[:, :, None, None]
         scores = []
-        for s in range(S):
+        for s in range(S_val):
             count_launch("gbt_chain_score")
             raw = predict_ensemble(binned, feats_all[s], threshs_all[s],
                                    leaves_m[s], heap_depth)[:, 0]

@@ -374,6 +374,7 @@ class ModelSelector(PredictorEstimator):
         train_mask[train_idx] = True
         base_w = splitter.train_weights(y, train_mask)
 
+        best_group = None
         if self.best_estimator is not None:
             # consume the workflow-CV winner: a later fit on new data must
             # validate afresh, not reuse a stale selection
@@ -385,17 +386,32 @@ class ModelSelector(PredictorEstimator):
                 candidates, X, y, base_w,
                 eval_fn=self._metric, metric_name=self.validation_metric,
                 larger_better=self.larger_better)
-            best_name, best_params, *_ = candidates[best_i]
+            best_name, best_params, *rest = candidates[best_i]
+            best_group = rest[1] if len(rest) > 1 else None
 
-        # refit best on the full training split (ModelSelector.fit :180) at
-        # the winner's OWN depth (family hints live in the fitters; nothing
-        # outside the winner's family shares its growth program)
-        best_proto = next(p for p, _ in self.models_and_params
-                          if type(p).__name__ == best_name)
-        best_est = best_proto.copy(**best_params)
-        if self.mesh is not None and hasattr(best_est, "with_mesh"):
-            best_est.with_mesh(self.mesh)
-        best_model = best_est.fit_raw(X, y, base_w)
+        # refit best on the full training split (ModelSelector.fit :180).
+        # Grid groups that solved an appended full-train weight row hold the
+        # winner's refit model already (refit_model) — sweep artifacts are
+        # reused instead of paying a fresh sequential fit (the reference
+        # refits from scratch, ModelSelector.scala:145-209).  Fallback: a
+        # sequential fit at the winner's OWN depth (family hints live in
+        # the fitters; nothing outside the winner's family shares its
+        # growth program).
+        best_model = None
+        if best_group is not None:
+            try:
+                row = best_group.grid_points.index(best_params)
+            except ValueError:
+                row = None
+            if row is not None:
+                best_model = best_group.refit_model(row)
+        if best_model is None:
+            best_proto = next(p for p, _ in self.models_and_params
+                              if type(p).__name__ == best_name)
+            best_est = best_proto.copy(**best_params)
+            if self.mesh is not None and hasattr(best_est, "with_mesh"):
+                best_est.with_mesh(self.mesh)
+            best_model = best_est.fit_raw(X, y, base_w)
 
         # ONE batched predict over the full matrix (hits the sweep's binning
         # and upload memos) — slicing rows first would re-bin and re-upload

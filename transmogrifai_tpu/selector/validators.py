@@ -381,19 +381,15 @@ def _materialize(nested: List[Any]) -> List[List[float]]:
     async and this single stacked fetch replaces per-fold ``float()`` calls.
     Grid-group rows (``_GroupRow``) resolve with one fetch per group matrix.
     """
-    # resolve group matrices first (one transfer each, NaN rows on failure)
-    import time as _time
-
-    from ..utils.profiling import count_fetch
+    # resolve group matrices first (one transfer each, NaN rows on failure);
+    # fetch_timed books queue-drain separately from the byte transfer
+    from ..utils.profiling import fetch_timed
 
     mats: dict = {}
     for v in nested:
         if isinstance(v, _GroupRow) and id(v.matrix) not in mats:
             try:
-                t0 = _time.perf_counter()
-                m = np.asarray(v.matrix, np.float64)
-                count_fetch(m.nbytes, _time.perf_counter() - t0)
-                mats[id(v.matrix)] = m
+                mats[id(v.matrix)] = fetch_timed(v.matrix, np.float64)
             except Exception:  # async device fault inside the group program
                 mats[id(v.matrix)] = None
     if mats:
@@ -419,9 +415,7 @@ def _materialize(nested: List[Any]) -> List[List[float]]:
     # scalar (~30 ms tunnel dispatch each); jitted it is ONE launch
     try:
         stacked = _stack_jit(*dev)
-        t0 = _time.perf_counter()
-        fetched = np.asarray(stacked, np.float64)
-        count_fetch(fetched.nbytes, _time.perf_counter() - t0)
+        fetched = fetch_timed(stacked, np.float64)
         host = iter(fetched)
         return [[float(next(host)) if isinstance(v, jax.Array) else float(v)
                  for v in vals] for vals in nested]
