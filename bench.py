@@ -210,7 +210,7 @@ def main():
 
     # -- config 4d: the reference's true default grid at 100k ----------------
     d = grid_config("default_grid_100k_x_500", 100_000, 500, "default",
-                    400, "extrapolated_100k_s")
+                    500, "extrapolated_100k_s")
     if d:
         headline = grid_headline(
             "automl_default_grid_100k_x_500_wall_clock", d)

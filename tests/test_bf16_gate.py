@@ -35,6 +35,16 @@ def _fit_aupr(est, X, y, Xh, yh) -> float:
     return float(aupr(yh, p))
 
 
+@pytest.fixture(autouse=True)
+def _force_bf16_numerics(monkeypatch):
+    """CPU execution normally gates hist bf16 off (XLA-CPU emulates bf16
+    dots ~30x slower); force it on so this suite actually exercises the
+    bf16 NUMERICS the accelerator default relies on."""
+    import transmogrifai_tpu.models.gbdt_kernels as gk
+
+    monkeypatch.setattr(gk, "_accel_bf16", lambda: True)
+
+
 class TestBf16HistogramGate:
     def test_binary_aupr_delta_is_noise(self):
         """Holdout AuPR at bf16 vs f32 histograms within noise (the seed-

@@ -113,8 +113,10 @@ class TestSparseEndToEnd:
         from transmogrifai_tpu.evaluators.metrics import aupr
         from transmogrifai_tpu.models.trees import OpXGBoostClassifier
 
-        # drop the size floor so the small test matrix qualifies
+        # drop the size floor so the small test matrix qualifies; opt into
+        # the CSR histogram path (default off — see _prep_tree_inputs_sparse)
         monkeypatch.setattr(trees_mod, "_SPARSE_MIN_ELEMS", 1)
+        monkeypatch.setenv("TMOG_SPARSE_HIST", "1")
         X, y = _sparse_data(6000, 50, density=0.08, seed=9)
         edges, binned, csr = trees_mod._prep_tree_inputs_sparse(X, 32)
         assert csr is not None, "sparse path should engage on 92%-zero data"

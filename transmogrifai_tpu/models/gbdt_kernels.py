@@ -67,6 +67,17 @@ def _resolve_compile_depth(max_depth: int) -> int:
     return max_depth
 
 
+@functools.lru_cache(maxsize=1)
+def _accel_bf16() -> bool:
+    """bf16 histogram operands only help on accelerators: XLA-CPU emulates
+    bf16 dots scalar-slow (measured ~30x on the config-5 fit — 78.7 s f32
+    vs 2556 s bf16 at 25k×1000 on one core), so CPU execution keeps f32
+    regardless of the requested hist precision."""
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
 #: rows per histogram block in the streamed build; the per-block bins
 #: one-hot is ROW_BLOCK × B·D f32 per tree under vmap — 2.1 GB at 500
 #: features × 32 bins, 0.4 GB at 100 features (forest_chunk_size budgets it)
@@ -189,6 +200,8 @@ def build_feature_csr(X: np.ndarray, edges: np.ndarray
     """
     X = np.asarray(X)
     n, d = X.shape
+    if edges.shape[1] + 1 > 127:
+        return None   # bins/zero_bin are int8; decline rather than wrap
     mask = X != 0
     nnz = mask.sum(axis=0)
     total = int(nnz.sum())
@@ -334,6 +347,7 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     # bins one-hot is the kernel's bandwidth bottleneck (measured: per-level
     # cost is flat in slot count and linear in D at 100k×500), so sqrt-D
     # subsetting cuts the histogram traffic ~D/msub (≈23x at D=500).
+    hist_bf16 = hist_bf16 and _accel_bf16()
     if feat_idx is not None:
         binned = jnp.take(binned, feat_idx.astype(jnp.int32), axis=1)
         feat_mask = jnp.ones(feat_idx.shape[0], bool)

@@ -151,26 +151,43 @@ def _score_ensemble_jit(binned, feat, thresh, leaf, base_score, depth: int,
     return raw[:, 0] + base_score  # gbdt_reg
 
 
+import threading
 from collections import OrderedDict
 
 _BIN_CACHE: "OrderedDict" = OrderedDict()
 _BIN_CACHE_CAPACITY = 32
 _HASH_BY_ID: dict = {}
+_MEMO_LOCK = threading.Lock()
+#: key -> Event for builds in flight (the sketch-prefetch thread and the
+#: sweep's tree group may race to the same prep; second caller waits)
+_MEMO_INFLIGHT: dict = {}
+#: bumped by clear_sweep_caches: an in-flight build that started before a
+#: clear must not repopulate the cache after it (device buffers would
+#: outlive the end-of-train housekeeping)
+_MEMO_GEN = 0
 
 
 def clear_sweep_caches() -> None:
-    """Release the sweep memos' device buffers (end-of-train housekeeping)."""
-    _BIN_CACHE.clear()
-    _HASH_BY_ID.clear()
-    _CONTIG_BY_ID.clear()
+    """Release the sweep memos' device buffers (end-of-train housekeeping).
+
+    Takes the memo lock (a prefetch thread may be mutating the cache) and
+    bumps the generation so in-flight builds that started before the clear
+    do not repopulate it afterwards."""
+    global _MEMO_GEN
+    with _MEMO_LOCK:
+        _MEMO_GEN += 1
+        _BIN_CACHE.clear()
+        _HASH_BY_ID.clear()
+        _CONTIG_BY_ID.clear()
 
 
 def _memo_peek(key):
     """Memo probe without building (None on miss)."""
-    hit = _BIN_CACHE.get(key)
-    if hit is not None:
-        _BIN_CACHE.move_to_end(key)
-    return hit
+    with _MEMO_LOCK:
+        hit = _BIN_CACHE.get(key)
+        if hit is not None:
+            _BIN_CACHE.move_to_end(key)
+        return hit
 
 
 def _memo(key, build):
@@ -181,15 +198,45 @@ def _memo(key, build):
     tens of milliseconds (seconds at 1M rows), so device uploads deduplicate
     by content hash.  Eviction is oldest-first — a wholesale clear would
     re-upload the sweep's hot fold matrices mid-run.
+
+    Thread-aware: concurrent builders of the SAME key deduplicate (the
+    selector's sketch-prefetch thread overlaps host prep with the sweep's
+    queued device work; when the tree group arrives it waits for the
+    in-flight build instead of re-sketching a GB-scale matrix).
     """
-    hit = _BIN_CACHE.get(key)
-    if hit is not None:
-        _BIN_CACHE.move_to_end(key)
-        return hit
-    val = build()
-    while len(_BIN_CACHE) >= _BIN_CACHE_CAPACITY:
-        _BIN_CACHE.popitem(last=False)
-    _BIN_CACHE[key] = val
+    with _MEMO_LOCK:
+        hit = _BIN_CACHE.get(key)
+        if hit is not None:
+            _BIN_CACHE.move_to_end(key)
+            return hit
+        ev = _MEMO_INFLIGHT.get(key)
+        owner = ev is None
+        if owner:
+            ev = threading.Event()
+            _MEMO_INFLIGHT[key] = ev
+        gen = _MEMO_GEN
+    if not owner:
+        ev.wait()
+        with _MEMO_LOCK:
+            hit = _BIN_CACHE.get(key)
+        if hit is not None:
+            return hit
+        # the owning build failed (or a clear raced it): build here too —
+        # concurrent rebuilds on this rare path are benign (same content)
+    try:
+        val = build()
+        with _MEMO_LOCK:
+            # insert BEFORE waking waiters (they re-probe the cache on
+            # wake); skip if clear_sweep_caches ran since the build began
+            if _MEMO_GEN == gen:
+                while len(_BIN_CACHE) >= _BIN_CACHE_CAPACITY:
+                    _BIN_CACHE.popitem(last=False)
+                _BIN_CACHE[key] = val
+    finally:
+        if owner:
+            with _MEMO_LOCK:
+                _MEMO_INFLIGHT.pop(key, None)
+            ev.set()
     return val
 
 
@@ -344,8 +391,12 @@ def _dev_f32(X, tag: str = "X_f32"):
     """
     import os
 
+    from .gbdt_kernels import _accel_bf16
+
     Xf = _as_f32(X)
-    force_f32 = os.environ.get("TMOG_MATRIX_PRECISION", "auto") == "f32"
+    force_f32 = (os.environ.get("TMOG_MATRIX_PRECISION", "auto") == "f32"
+                 or not _accel_bf16())   # no tunnel to save on CPU, and
+    #                                      XLA-CPU bf16 matmuls are emulated
     if tag == "X_f32" and Xf.size > _BF16_UPLOAD_ELEMS and not force_f32:
         hx = _content_hash(Xf)
         key = ("X_bf16", hx, Xf.shape)
@@ -453,16 +504,26 @@ _SPARSE_MIN_ELEMS = 1 << 24
 
 
 def _prep_tree_inputs_sparse(X, max_bins):
-    """Like ``_prep_tree_inputs`` but detects wide mostly-zero matrices and
-    returns an additional CSR device triple for the sparse histogram path
-    (gbdt_kernels._sparse_level_hists); csr is None for dense inputs.
+    """Like ``_prep_tree_inputs`` but detects wide mostly-zero matrices:
+    their bin edges sketch over the NONZERO values
+    (quantile_bins_sparse_aware) — an all-values sketch of a 95%-zero
+    feature collapses to ~2 usable bins, while XGBoost's sketch is
+    sparsity-aware (SURVEY §2.11); matching it measured +0.016 train AuPR
+    on the config-5 shape at the same round budget.
 
-    Sparse inputs also sketch their bin edges over the NONZERO values
-    (quantile_bins_sparse_aware): an all-values sketch of a 95%-zero
-    feature collapses to ~2 usable bins — XGBoost's sketch is
-    sparsity-aware (SURVEY §2.11), and matching it is both a quality and
-    a parity fix.
+    The third return element is the CSR device triple for the sparse
+    HISTOGRAM path (gbdt_kernels._sparse_level_hists) — opt-in via
+    ``TMOG_SPARSE_HIST=1``, default OFF: measured at 250k×1000×5% the
+    per-feature-batched CSR matmuls ((D, M, E)@(D, E, B·nchan), ~tens of
+    rows/cols per batch element) run ~2.2× SLOWER per round than the
+    dense bf16 one-hot stream at every slot width (1185-1353 ms vs 557 ms
+    per depth-10 round) — the MXU wants the dense formulation's big
+    tiles; the sparse win needs a Pallas accumulation kernel, not a
+    matmul reshuffle.  The build stays for that work (parity-tested in
+    tests/test_sparse_path.py).
     """
+    import os
+
     from .gbdt_kernels import (
         build_feature_csr, quantile_bins_sparse_aware,
     )
@@ -480,6 +541,8 @@ def _prep_tree_inputs_sparse(X, max_bins):
     edges = _memo(("edges_sp", hx, Xf.shape, max_bins),
                   lambda: quantile_bins_sparse_aware(Xf, max_bins))
     binned = _binned_cached(Xf, hx, edges)
+    if os.environ.get("TMOG_SPARSE_HIST", "0") != "1":
+        return edges, binned, None
 
     def build():
         host = build_feature_csr(Xf, edges)

@@ -258,3 +258,61 @@ def fit_logreg_sharded(X: np.ndarray, y: np.ndarray, mesh: Mesh,
     fit = fit_logistic_regression(X_dev, y_dev, w_dev, **kwargs)
     coef = fit.coef[..., :d] if fit.coef.shape[-1] != d else fit.coef
     return LinearFit(coef, fit.intercept, fit.n_iter, fit.converged)
+
+
+def quantile_bins_sharded(X: np.ndarray, mesh: Mesh, max_bins: int = 32,
+                          sample_rows: int = 200_000) -> np.ndarray:
+    """Mesh-sharded quantile sketch — the distributed analogue of
+    ``gbdt_kernels.quantile_bins`` (the reference computes its feature
+    distributions executor-distributed, RawFeatureFilter.scala:489-545;
+    XGBoost sketches with Rabit allreduce).
+
+    Each shard stride-samples its local rows, the per-shard samples
+    ``all_gather`` over ICI into one pooled (S·k, D) sample, and the
+    per-feature quantiles compute replicated on every device — one
+    program, one collective, no host pass over the matrix.  With
+    ``sample_rows >= N`` the pooled sample is exactly the whole matrix, so
+    the edges match the host sketch bit-for-bit (same linear-interpolation
+    quantiles); under sampling they agree to sketch tolerance.
+    """
+    from .mesh import data_sharding, pad_to_multiple
+
+    X = np.asarray(X, np.float32)
+    n, d = X.shape
+    data_axis = mesh.axis_names[0]
+    n_shards = mesh.shape[data_axis]
+    Xp, _ = pad_to_multiple(X, n_shards, axis=0)
+    rows_valid = np.zeros(Xp.shape[0], np.float32)
+    rows_valid[:n] = 1.0
+    local = Xp.shape[0] // n_shards
+    k = max(1, min(local, -(-min(sample_rows, n) // n_shards)))
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1].astype(np.float32)
+
+    from jax import shard_map
+
+    def shard_fn(X_s, valid_s):
+        # stride-sample k local rows; pad rows re-sample row 0 of the
+        # shard but carry weight 0 via +inf sentinel replacement below
+        stride = max(1, X_s.shape[0] // k)
+        idx = (jnp.arange(k) * stride) % X_s.shape[0]
+        samp = X_s[idx]                                  # (k, D)
+        ok = valid_s[idx] > 0
+        # invalid (padding) rows -> NaN, excluded by nanquantile
+        samp = jnp.where(ok[:, None], samp, jnp.nan)
+        pooled = lax.all_gather(samp, data_axis).reshape(-1, samp.shape[1])
+        return jnp.nanquantile(pooled, jnp.asarray(qs), axis=0).T  # (D, B-1)
+
+    ds = data_sharding(mesh)
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(data_axis, None), P(data_axis)),
+                   out_specs=P(None, None), check_vma=False)
+    edges = np.array(fn(jax.device_put(Xp, ds),
+                        jax.device_put(rows_valid, ds)),
+                     np.float32)   # np.array: writable host copy
+    # same dedup rule as the host sketch: collapse non-increasing edges
+    eps = 1e-7
+    for j in range(d):
+        e = edges[j]
+        dup = np.concatenate([[False], np.diff(e) <= eps])
+        edges[j] = np.where(dup, np.inf, e)
+    return edges

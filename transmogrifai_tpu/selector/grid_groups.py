@@ -282,7 +282,8 @@ class RFGridGroup(GridGroup):
                                           regression_metric_grid)
         from ..models.gbdt_kernels import grow_rf_grid
         from ..models.trees import (_dev_memo, _feature_subset_size,
-                                    _prep_tree_inputs, _score_ensemble_jit)
+                                    _prep_tree_inputs_sparse,
+                                    _score_ensemble_jit)
 
         cls = self.proto._classification
         n_classes = self.n_classes
@@ -299,7 +300,11 @@ class RFGridGroup(GridGroup):
 
         proto = self.proto
         y = np.nan_to_num(np.asarray(y, np.float32))
-        edges, binned = _prep_tree_inputs(X, proto.max_bins)
+        # sparse-aware prep: same sketch/memo keys as the GBT group and
+        # the selector's prefetch thread, so one host sketch serves the
+        # whole sweep (the CSR triple is unused here — RF histograms run
+        # at feature-subset width)
+        edges, binned, _ = _prep_tree_inputs_sparse(X, proto.max_bins)
         n, d = X.shape
         if cls:
             Y = np.eye(n_classes, dtype=np.float32)[y.astype(int)]

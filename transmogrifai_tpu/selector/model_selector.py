@@ -362,6 +362,50 @@ class ModelSelector(PredictorEstimator):
             _dev_f32(X)
         return X
 
+    #: below this element count prefetching the tree prep in a thread buys
+    #: nothing (the sketch is sub-second)
+    _PREFETCH_MIN_ELEMS = 1 << 24
+
+    def _start_tree_prep_prefetch(self, X: np.ndarray):
+        """Overlap the host quantile sketch / binning with the sweep's
+        queued device work (VERDICT r3 Missing #5): the linear groups
+        dispatch async and only sync at the stacked metric fetch, so a
+        daemon thread can run the tree families' ~seconds of host prep in
+        that shadow.  The memo's in-flight dedup (trees._memo) hands the
+        result to the tree group — or blocks it until ready — so there is
+        no duplicated sketch work."""
+        import threading
+        import time as _time
+
+        from ..models.trees import _prep_tree_inputs_sparse
+
+        if self.mesh is not None or X.size < self._PREFETCH_MIN_ELEMS:
+            return None
+        bins = sorted({int(getattr(p, "max_bins", 0))
+                       for p, _ in self.models_and_params
+                       if getattr(p, "max_bins", None)})
+        if not bins:
+            return None
+
+        from ..utils.profiling import current_collector
+        coll = current_collector()   # collector is thread-local: capture now
+
+        def work():
+            t0 = _time.perf_counter()
+            for mb in bins:
+                try:
+                    _prep_tree_inputs_sparse(X, mb)
+                except Exception:   # prep errors surface on the sweep path
+                    return
+            if coll is not None:
+                coll.metrics.custom_tags["prefetchTreePrepSecs"] = round(
+                    _time.perf_counter() - t0, 3)
+
+        t = threading.Thread(target=work, name="tree-prep-prefetch",
+                             daemon=True)
+        t.start()
+        return t
+
     def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
                     features_col: FeatureColumn):
         X = self._prepare_matrix(features_col.values)
@@ -381,6 +425,9 @@ class ModelSelector(PredictorEstimator):
             best_name, best_params, results = self.best_estimator
             self.best_estimator = None
         else:
+            # host tree-prep (sketch/binning/CSR) overlaps the linear
+            # groups' async device work in a daemon thread
+            self._start_tree_prep_prefetch(X)
             candidates = self._candidates()
             best_i, results = self.validator.validate(
                 candidates, X, y, base_w,
