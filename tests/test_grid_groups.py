@@ -317,6 +317,20 @@ class TestDepthTruncation:
             assert rg.metric_value == pytest.approx(rs.metric_value,
                                                     abs=2e-3)
 
+    def test_stump_candidate_in_depth_grid(self):
+        """max_depth=0 (stump) candidates must not be truncation-shared off
+        a deeper base: grow_rf_grid filters non-positive snapshot levels
+        out of its snap map, so the group grows stumps as their own base
+        (ADVICE r4 — this used to KeyError in the scoring loop)."""
+        X, y = _binary_data(1500, 6, seed=9)
+        g = make_grid_group(OpRandomForestClassifier(num_trees=4),
+                            grid(max_depth=[0, 4], min_info_gain=[0.01]),
+                            "binary", "AuPR")
+        w = np.ones(len(y), np.float32)
+        m = g.run(X, y, [(w, w)])
+        assert m is not None and tuple(m.shape) == (2, 1)
+        assert np.isfinite(np.asarray(m)).all()
+
 
 class TestWinnerRefitReuse:
     """Round-4 refit reuse: groups solve an appended full-train weight row,

@@ -347,7 +347,11 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     # bins one-hot is the kernel's bandwidth bottleneck (measured: per-level
     # cost is flat in slot count and linear in D at 100k×500), so sqrt-D
     # subsetting cuts the histogram traffic ~D/msub (≈23x at D=500).
-    hist_bf16 = hist_bf16 and _accel_bf16()
+    # (hist_bf16 is resolved by the non-jitted callers — grow_tree,
+    # grow_forest_rf, grow_rf_grid, the GBT fitters — as
+    # ``requested and _accel_bf16()`` so the backend gate participates in
+    # the jit cache key; resolving it here at trace time let a CPU-traced
+    # f32 executable be silently reused under a bf16 key and vice versa.)
     if feat_idx is not None:
         binned = jnp.take(binned, feat_idx.astype(jnp.int32), axis=1)
         feat_mask = jnp.ones(feat_idx.shape[0], bool)
@@ -835,12 +839,13 @@ def rf_bags_and_features(seed: int, n_trees: int, n: int, d: int, msub: int,
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "msub", "max_depth",
-                                             "n_bins", "onehot_targets"))
+                                             "n_bins", "onehot_targets",
+                                             "hist_bf16"))
 def _grow_chunk_rf(binned, Y, base_w, seed, start, n_trees, depth_limit_val,
                    subsample_rate, chunk: int, msub: int, max_depth: int,
                    n_bins: int, lam, min_child_weight, min_info_gain,
                    min_instances, learning_rate,
-                   onehot_targets: bool = False):
+                   onehot_targets: bool = False, hist_bf16: bool = False):
     """RF chunk with ON-DEVICE bag-weight + feature-mask generation.
 
     Through a remote-TPU tunnel, uploading per-tree (T, N) Poisson weights
@@ -859,20 +864,22 @@ def _grow_chunk_rf(binned, Y, base_w, seed, start, n_trees, depth_limit_val,
     return _grow_chunk_bagged(
         binned, Y, BW, masks, limit, max_depth, n_bins, lam,
         min_child_weight, min_info_gain, min_instances,
-        jnp.bool_(False), learning_rate, hist_bf16=True,
+        jnp.bool_(False), learning_rate, hist_bf16=hist_bf16,
         onehot_targets=onehot_targets, feat_idx=feat_idx)
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "msub", "max_depth",
                                              "n_bins", "onehot_targets",
-                                             "t_per", "leaf_levels"))
+                                             "t_per", "leaf_levels",
+                                             "hist_bf16"))
 def _grow_chunk_rf_grid(binned, Y, W_tr, seed, flat_start, total,
                         pair_fold, pair_min_ig, pair_min_inst, pair_depth,
                         subsample_rate, chunk: int, msub: int,
                         max_depth: int, n_bins: int, lam,
                         min_child_weight, t_per: int,
                         onehot_targets: bool = False,
-                        leaf_levels: Tuple[int, ...] = ()):
+                        leaf_levels: Tuple[int, ...] = (),
+                        hist_bf16: bool = False):
     """RF chunk spanning the WHOLE (candidate x fold) grid.
 
     Flat tree index i = pair * t_per + t: tree t of grid pair ``i // t_per``
@@ -899,7 +906,7 @@ def _grow_chunk_rf_grid(binned, Y, W_tr, seed, flat_start, total,
     BW = base_w * BWr * (flat < total)[:, None]
     kw = dict(max_depth=max_depth, n_bins=n_bins, lam=lam,
               min_child_weight=min_child_weight, newton_leaf=jnp.bool_(False),
-              learning_rate=jnp.float32(1.0), hist_bf16=True,
+              learning_rate=jnp.float32(1.0), hist_bf16=hist_bf16,
               bag_mode="onehot" if onehot_targets else "bagged",
               leaf_levels=leaf_levels)
 
@@ -933,13 +940,16 @@ def grow_rf_grid(binned, Y, W_tr, seed: int, n_trees: int,
     n, d = binned.shape
     k = Y.shape[1]
     P = int(pair_fold.shape[0])
-    heap_depth = _resolve_compile_depth(int(pair_depth.max()))
+    # >= 1: an all-stump grid (every max_depth <= 0) still needs one heap
+    # level to emit leaf arrays (depth_limit 0 keeps the trees split-free)
+    heap_depth = _resolve_compile_depth(max(int(pair_depth.max()), 1))
     leaf_levels = tuple(sorted(set(int(v) for v in leaf_levels
                                    if 0 < int(v) < heap_depth)))
+    hist_bf16 = _accel_bf16()
     chunk = forest_chunk_size(
         n_trees * P, heap_depth, msub, n_bins, k, n_rows=n,
         n_channels=(k if onehot_targets else k + 1), d_full=d,
-        onehot_bytes=2)
+        onehot_bytes=2 if hist_bf16 else 4)
     total = n_trees * P
     pf = jnp.asarray(pair_fold, jnp.int32)
     pg = jnp.asarray(pair_min_ig, jnp.float32)
@@ -956,7 +966,8 @@ def grow_rf_grid(binned, Y, W_tr, seed: int, n_trees: int,
             pf, pg, pi, pd_, jnp.float32(subsample_rate), chunk, msub,
             heap_depth, n_bins, jnp.float32(lam),
             jnp.float32(min_child_weight), n_trees,
-            onehot_targets=onehot_targets, leaf_levels=leaf_levels)
+            onehot_targets=onehot_targets, leaf_levels=leaf_levels,
+            hist_bf16=hist_bf16)
         e = min(s + chunk, total)
         feats.append(f[:e - s])
         threshs.append(t[:e - s])
@@ -994,12 +1005,13 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
     n, d = binned.shape
     k = Y.shape[1]
     heap_depth = _resolve_compile_depth(max_depth)
+    hist_bf16 = _accel_bf16()
     # feat_idx path: histograms at width msub with the reduced channel
     # count (K for one-hot classification, K+1 for bagged regression)
     chunk = forest_chunk_size(
         n_trees, heap_depth, msub, n_bins, k, n_rows=n,
         n_channels=(k if onehot_targets else k + 1), d_full=d,
-        onehot_bytes=2)
+        onehot_bytes=2 if hist_bf16 else 4)
     args = (jnp.float32(lam), jnp.float32(min_child_weight),
             jnp.float32(min_info_gain), jnp.float32(min_instances),
             jnp.float32(1.0))
@@ -1012,7 +1024,7 @@ def grow_forest_rf(binned, Y, base_w, seed: int, n_trees: int, msub: int,
             binned, Y, base_w, jnp.int32(seed), jnp.int32(s),
             jnp.int32(n_trees), jnp.int32(max_depth),
             jnp.float32(subsample_rate), chunk, msub, heap_depth, n_bins,
-            *args, onehot_targets=onehot_targets)
+            *args, onehot_targets=onehot_targets, hist_bf16=hist_bf16)
         e = min(s + chunk, n_trees)
         if e - s < chunk:
             f, t, lf = f[:e - s], t[:e - s], lf[:e - s]
@@ -1166,6 +1178,7 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     if feat_mask is None:
         feat_mask = jnp.ones(d, bool)
     heap_depth = _resolve_compile_depth(max_depth)
+    hist_bf16 = hist_bf16 and _accel_bf16()
     limit = jnp.full((1,), max_depth, jnp.int32)
     f, t, lf = _grow_chunk(
         binned, G[None], H[None], C[None], feat_mask[None], limit,

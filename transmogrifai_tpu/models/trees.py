@@ -600,7 +600,12 @@ class _RandomForestBase(PredictorEstimator):
 
     def fit_raw(self, X: np.ndarray, y: np.ndarray, w=None):
         n, d = X.shape
-        edges, binned = _prep_tree_inputs(X, self.max_bins)
+        # sparse-aware sketch (CSR unused — RF histograms run at feature-
+        # subset width): the SAME edges/memo keys as RFGridGroup's sweep, so
+        # a winner refit on a qualifying sparse matrix trains with the bin
+        # edges the candidate won selection on (ADVICE r4 medium) and reuses
+        # the sweep's host sketch + binned-matrix upload
+        edges, binned, _ = _prep_tree_inputs_sparse(X, self.max_bins)
         base_w = (np.ones(n, np.float32) if w is None
                   else np.asarray(w, np.float32))
         if self._classification:
@@ -771,6 +776,16 @@ class _GBTBase(PredictorEstimator):
         self.hist_precision = hist_precision
         self.mesh = None
 
+    def _hist_bf16(self) -> bool:
+        """The STATIC hist-precision flag handed to the jitted growth
+        programs: requested precision AND the backend gate, resolved here
+        so it participates in the jit cache key (resolving inside the
+        traced body let a CPU-traced f32 executable be reused under a bf16
+        key — ADVICE r4)."""
+        from .gbdt_kernels import _accel_bf16
+
+        return self.hist_precision == "bf16" and _accel_bf16()
+
     def with_mesh(self, mesh) -> "_GBTBase":
         """Multi-chip boosting: the binned matrix, labels and per-row state
         (margins, gradients) live row-sharded on the mesh's data axis and
@@ -910,7 +925,7 @@ class _GBTBase(PredictorEstimator):
                 feat_mask=jnp.asarray(mask), newton_leaf=True,
                 learning_rate=self.step_size,
                 min_gain_raw=self.min_split_gain_raw,
-                hist_bf16=self.hist_precision == "bf16", csr=csr)
+                hist_bf16=self._hist_bf16(), csr=csr)
             from .gbdt_kernels import predict_tree
 
             heap_depth = int(np.log2(f.shape[0] + 1))
@@ -1003,7 +1018,7 @@ class _GBTBase(PredictorEstimator):
                 one(self.min_instances_per_node),
                 one(self.step_size), one(self.min_split_gain_raw),
                 es_chunk, heap_depth, self.max_bins, obj,
-                self.hist_precision == "bf16", run_es, csr=csr,
+                self._hist_bf16(), run_es, csr=csr,
                 skip_counts=skip_counts)
             fb.append(fs)
             tb.append(ts)

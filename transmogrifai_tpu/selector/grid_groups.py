@@ -300,11 +300,15 @@ class RFGridGroup(GridGroup):
 
         proto = self.proto
         y = np.nan_to_num(np.asarray(y, np.float32))
+        # the CANDIDATES' max_bins (uniform across the grid — _static), not
+        # the proto's: a grid overriding max_bins must bin with the value it
+        # grows with, or bins past n_bins silently vanish from histograms
+        mb = int(self._param(self.grid_points[0], "max_bins"))
         # sparse-aware prep: same sketch/memo keys as the GBT group and
         # the selector's prefetch thread, so one host sketch serves the
         # whole sweep (the CSR triple is unused here — RF histograms run
         # at feature-subset width)
-        edges, binned, _ = _prep_tree_inputs_sparse(X, proto.max_bins)
+        edges, binned, _ = _prep_tree_inputs_sparse(X, mb)
         n, d = X.shape
         if cls:
             Y = np.eye(n_classes, dtype=np.float32)[y.astype(int)]
@@ -326,13 +330,27 @@ class RFGridGroup(GridGroup):
         # — the r3 default grid (3 depths x 6 gate combos) grew 3x the
         # trees this needs.  The reference pays the full redundancy on its
         # thread pool (OpCrossValidation.scala:113-138).
-        cand_depth = [int(self._param(p, "max_depth"))
+        # clamp at 0: any non-positive requested depth IS a stump (and the
+        # base_depth accumulator below starts at 0, so an unclamped -1
+        # would read as "truncated below its base" and KeyError)
+        cand_depth = [max(0, int(self._param(p, "max_depth")))
                       for p in self.grid_points]
+        # depth <= 0 (stump) candidates get their OWN base: grow_rf_grid
+        # filters non-positive levels out of its snapshot map (0 < v <
+        # heap_depth), so truncation-sharing them off a deeper base would
+        # KeyError in the scoring loop (ADVICE r4) — and a stump needs no
+        # sharing anyway (depth_limit=0 grows it directly)
         cand_key = [(float(self._param(p, "min_info_gain")),
                      float(self._param(p, "min_instances_per_node")))
-                    for p in self.grid_points]
-        base_keys: List[Tuple[float, float]] = []
-        key2base: Dict[Tuple[float, float], int] = {}
+                    if cand_depth[i] > 0 else
+                    (float(self._param(p, "min_info_gain")),
+                     float(self._param(p, "min_instances_per_node")),
+                     cand_depth[i])
+                    for i, p in enumerate(self.grid_points)]
+        # keys are (ig, inst) 2-tuples, or (ig, inst, depth) 3-tuples for
+        # stump candidates — consumers below read k[0]/k[1] only
+        base_keys: List[tuple] = []
+        key2base: Dict[tuple, int] = {}
         for key in cand_key:
             if key not in key2base:
                 key2base[key] = len(base_keys)
@@ -555,7 +573,7 @@ class GBTGridGroup(GridGroup):
                                 int(e0.max_bins), n)
         run_es = use_es and vi is not None
         vi_arr = vi if vi is not None else jnp.zeros(1, jnp.int32)
-        bf16 = e0.hist_precision == "bf16"
+        bf16 = e0._hist_bf16()   # backend-resolved: part of the jit key
         # count channel inert under pure XGB gating -> 2-channel
         # histograms; integer fold/train weights only (the count channel
         # is weighted — fractional weights could make 'CL >= 1' bite)

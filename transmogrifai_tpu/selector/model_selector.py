@@ -440,7 +440,14 @@ class ModelSelector(PredictorEstimator):
         # Grid groups that solved an appended full-train weight row hold the
         # winner's refit model already (refit_model) — sweep artifacts are
         # reused instead of paying a fresh sequential fit (the reference
-        # refits from scratch, ModelSelector.scala:145-209).  Fallback: a
+        # refits from scratch, ModelSelector.scala:145-209).  Known
+        # divergence (ADVICE r4, intentional): for the LINEAR groups the
+        # deployed coefficients come from the batched majorization/prox
+        # solver's full-train row, which agrees with a sequential
+        # Newton/IRLS refit to METRIC level (~2e-3 AuPR; parity-tested in
+        # test_lr_group_refit_matches_sequential) but not per-coefficient —
+        # tighten the solver tol if exact reference refit-from-scratch
+        # coefficient parity is ever required.  Fallback: a
         # sequential fit at the winner's OWN depth (family hints live in
         # the fitters; nothing outside the winner's family shares its
         # growth program).

@@ -166,7 +166,10 @@ class RunCounters:
     stacked metric fetch after an async sweep blocks first on the enqueued
     device work finishing, and booking that wait as "fetch" misdirected
     round-3's optimization targeting (VERDICT r3 Weak #6) — drain is
-    compute-to-wait-for, fetch is bytes-on-the-wire.  ``launches`` counts
+    compute-to-wait-for, fetch is bytes-on-the-wire.  On backends where
+    ``block_until_ready`` returns early (the tunneled axon TPU — see
+    ``fetch_timed``), ``drain_s`` under-attributes and ``fetch_s`` may
+    still include drain: read the split as a lower bound on drain.  ``launches`` counts
     explicit kernel dispatches at our call sites (tree-growth chunks,
     grid-solver programs, scoring programs) — a design-level dispatch
     count, not an XLA op count.
@@ -237,7 +240,14 @@ def fetch_timed(x, dtype=None):
     queue finishing its enqueued compute), then the actual ``np.asarray``
     copy (booked as ``fetch_s`` against the fetched bytes).  Plain
     ``np.asarray`` conflated the two, which at r3's default grid booked
-    ~42 s of sweep compute as "fetch time"."""
+    ~42 s of sweep compute as "fetch time".
+
+    Platform caveat (ADVICE r4): on the tunneled axon TPU backend,
+    ``block_until_ready`` has been observed to return EARLY — the
+    subsequent ``np.asarray`` then still blocks for queue drain.  There
+    ``drain_s`` is a LOWER bound and ``fetch_s`` may still include drain;
+    treat the split as directional, not definitive, when targeting
+    optimizations."""
     import numpy as np
 
     t0 = time.perf_counter()
