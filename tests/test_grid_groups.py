@@ -361,6 +361,40 @@ class TestWinnerRefitReuse:
             np.testing.assert_allclose(pg, ps, atol=2e-2)
             assert np.corrcoef(pg, ps)[0, 1] > 0.999
 
+    def test_rf_group_refit_matches_direct_full_train(self):
+        """RF winner refit reuses the sweep's grid program + randomness:
+        at the base depth the refit forest is BIT-IDENTICAL to a direct
+        full-train fit_raw; a truncated (shallower) winner matches the
+        directly grown shallow forest at prediction level (histogram-
+        snapshot leaves vs final leaf dots; exact for integer weights)."""
+        X, y = _binary_data(2000, 8, seed=11)
+        ctxs = self._fold_ctxs(y)
+        full_w = ctxs[0][0] + ctxs[0][1]
+        proto = OpRandomForestClassifier(num_trees=5)
+        pts = grid(max_depth=[3, 6], min_info_gain=[0.0, 0.05])
+        g = make_grid_group(proto, pts, "binary", "AuPR")
+        assert g.run(X, y, ctxs) is not None
+
+        row = pts.index({"max_depth": 6, "min_info_gain": 0.05})
+        rm = g.refit_model(row)
+        assert rm is not None
+        direct = proto.copy(max_depth=6, min_info_gain=0.05).fit_raw(
+            X, y, w=full_w)
+        np.testing.assert_array_equal(np.asarray(rm.feat),
+                                      np.asarray(direct.feat))
+        np.testing.assert_array_equal(np.asarray(rm.thresh),
+                                      np.asarray(direct.thresh))
+        np.testing.assert_allclose(np.asarray(rm.leaf),
+                                   np.asarray(direct.leaf), atol=1e-6)
+
+        row3 = pts.index({"max_depth": 3, "min_info_gain": 0.05})
+        rm3 = g.refit_model(row3)
+        direct3 = proto.copy(max_depth=3, min_info_gain=0.05).fit_raw(
+            X, y, w=full_w)
+        p1 = rm3.predict_batch(X).probability[:, 1]
+        p3 = direct3.predict_batch(X).probability[:, 1]
+        np.testing.assert_allclose(p1, p3, atol=1e-5)
+
     def test_gbt_group_declines_refit_reuse(self):
         """GBT groups deliberately do NOT append refit chains (the extra
         chains cost ~C/(C·F) of the whole sweep unconditionally, while the

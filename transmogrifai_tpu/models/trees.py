@@ -974,9 +974,11 @@ class _GBTBase(PredictorEstimator):
         cannot diverge.  Requires subsample/colsample == 1 (no per-round
         host RNG) and a single device."""
         from ..utils.profiling import count_launch
-        from .gbdt_kernels import _gbt_chain_rounds_jit, _resolve_compile_depth
+        from .gbdt_kernels import (_gbt_chain_rounds_jit,
+                                   _resolve_compile_depth, seg_hist_auto)
 
         n = int(binned.shape[0])
+        seg = seg_hist_auto(n, n_chains=1)
         # family compile-depth hint: sequential-fallback candidates of
         # differing max_depth share ONE compiled scan program (their own
         # depth rides the traced depth limit) instead of recompiling the
@@ -1019,7 +1021,7 @@ class _GBTBase(PredictorEstimator):
                 one(self.step_size), one(self.min_split_gain_raw),
                 es_chunk, heap_depth, self.max_bins, obj,
                 self._hist_bf16(), run_es, csr=csr,
-                skip_counts=skip_counts)
+                skip_counts=skip_counts, seg_hist=seg)
             fb.append(fs)
             tb.append(ts)
             lb.append(lfs)

@@ -418,6 +418,19 @@ class RFGridGroup(GridGroup):
             inv[np.asarray(order, np.int32)] = np.arange(C * F, dtype=np.int32)
             scores = scores[jnp.asarray(inv)]
         scores = scores.reshape(C, F, n).transpose(1, 0, 2)  # (F, C, N)
+        # context for refit_model: the winner's full-train forest grows as
+        # ONE more base pair through the same (cached) grid program, with
+        # identical randomness to a sequential full fit
+        self._refit_ctx = dict(
+            binned=binned, Y=Y, edges=edges, msub=msub, mb=mb, T=T,
+            cls=cls, k=Y.shape[1], heap_depth=heap_depth,
+            key2base=key2base, cand_key=cand_key, cand_depth=cand_depth,
+            base_depth=base_depth, base_keys=base_keys,
+            leaf_levels=leaf_levels,
+            full_w=self._full_weights(weight_ctxs),
+            seed=int(proto.seed),
+            subsample=float(self._param(self.grid_points[0],
+                                        "subsample_rate")))
         if multiclass:
             m = multiclass_metric_grid(y, scores, jnp.asarray(W_ev),
                                        n_classes, self.metric)
@@ -427,6 +440,54 @@ class RFGridGroup(GridGroup):
         if m is None:
             return None
         return m.T
+
+    def refit_model(self, row: int):
+        """Full-train refit of candidate ``row`` as ONE extra base pair.
+
+        Reuses the sweep's compiled grid program (``compile_depth_hint``
+        pins the sweep's heap depth), its binned-matrix/target memos, and
+        the SAME per-tree randomness as a sequential full fit
+        (``fold_in(seed, t)`` keys on tree id, not on fold) — so the
+        deployed forest is what ``fit_raw`` on the full split would grow,
+        at ~1/(bases x folds) of the sweep's cost instead of a fresh
+        sequential fit + compile (ModelSelector.scala:145-209 refits from
+        scratch).  Shallower-than-base winners come off the base pair's
+        depth-truncation snapshot (exact for level-wise growth)."""
+        ctx = getattr(self, "_refit_ctx", None)
+        if ctx is None:
+            return None
+        import jax.numpy as jnp
+
+        from ..models.gbdt_kernels import compile_depth_hint, grow_rf_grid
+        from ..models.trees import TreeEnsembleModel, _dev_memo
+
+        key = ctx["cand_key"][row]
+        bi = ctx["key2base"][key]
+        dt = ctx["cand_depth"][row]
+        bd = ctx["base_depth"][bi]
+        with compile_depth_hint(ctx["heap_depth"]):
+            grown = grow_rf_grid(
+                ctx["binned"], _dev_memo(ctx["Y"], "rf_Y"),
+                _dev_memo(ctx["full_w"][None], "rf_Wfull"),
+                seed=ctx["seed"], n_trees=ctx["T"],
+                pair_fold=np.zeros(1, np.int32),
+                pair_min_ig=np.asarray([key[0]], np.float32),
+                pair_min_inst=np.asarray([key[1]], np.float32),
+                pair_depth=np.asarray([bd], np.int32), msub=ctx["msub"],
+                subsample_rate=ctx["subsample"], n_bins=ctx["mb"],
+                onehot_targets=ctx["cls"], leaf_levels=ctx["leaf_levels"])
+        feats, threshs, leaves = grown[:3]
+        snap_map = grown[3] if ctx["leaf_levels"] else {}
+        if dt < bd:
+            nd = 2 ** dt - 1
+            feat, thresh, leaf = (feats[0][:, :nd], threshs[0][:, :nd],
+                                  snap_map[dt][0])
+        else:
+            feat, thresh, leaf = feats[0], threshs[0], leaves[0]
+        return TreeEnsembleModel(
+            mode="rf_cls" if ctx["cls"] else "rf_reg", edges=ctx["edges"],
+            feat=feat, thresh=thresh, leaf=leaf,
+            n_classes=ctx["k"] if ctx["cls"] else 2)
 
 
 def _score_pairs_jit(binned, feats, threshs, leaves, heap_depth: int,
@@ -567,10 +628,18 @@ class GBTGridGroup(GridGroup):
         stopped = np.zeros(S, bool)
         es_chunk = max(1, min(8, e0.early_stopping_rounds or 8))
         from ..models.gbdt_kernels import (_gbt_chain_rounds_jit,
-                                           gbt_chain_chunk)
+                                           gbt_chain_chunk, seg_hist_auto)
 
-        chunk = gbt_chain_chunk(S, heap_depth, X.shape[1],
-                                int(e0.max_bins), n)
+        # segmented histograms at headline row counts (statically resolved
+        # so it keys the jit cache).  Chain count matters: dense shares its
+        # bins one-hot across vmapped chains, so seg only wins when the
+        # HBM budget (or the grid) leaves <= SEG_MAX_CHAINS per launch
+        chunk_dense = gbt_chain_chunk(S, heap_depth, X.shape[1],
+                                      int(e0.max_bins), n)
+        seg = seg_hist_auto(n, n_chains=min(chunk_dense, S))
+        chunk = (gbt_chain_chunk(S, heap_depth, X.shape[1],
+                                 int(e0.max_bins), n, seg_hist=True)
+                 if seg else chunk_dense)
         run_es = use_es and vi is not None
         vi_arr = vi if vi is not None else jnp.zeros(1, jnp.int32)
         bf16 = e0._hist_bf16()   # backend-resolved: part of the jit key
@@ -596,7 +665,7 @@ class GBTGridGroup(GridGroup):
                     binned, yj, Wj, Fm, vi_arr, depth_lim, lams, mcws, migs,
                     mins_, lrs, mgrs, es_chunk, heap_depth,
                     int(e0.max_bins), obj, bf16, run_es, csr=csr,
-                    skip_counts=skip_counts)
+                    skip_counts=skip_counts, seg_hist=seg)
             else:
                 parts = []
                 for s0 in range(0, S, chunk):
@@ -608,7 +677,7 @@ class GBTGridGroup(GridGroup):
                         migs[s0:s1], mins_[s0:s1], lrs[s0:s1],
                         mgrs[s0:s1], es_chunk, heap_depth,
                         int(e0.max_bins), obj, bf16, run_es, csr=csr,
-                        skip_counts=skip_counts))
+                        skip_counts=skip_counts, seg_hist=seg))
                 Fm = jnp.concatenate([p[0] for p in parts])
                 fs = jnp.concatenate([p[1] for p in parts], axis=1)
                 ts = jnp.concatenate([p[2] for p in parts], axis=1)
