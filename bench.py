@@ -83,11 +83,16 @@ def _cost_history() -> dict:
         return {}
 
 
-def _record_cost(name: str, measured_s: float, cold: bool) -> None:
-    """Self-updating measured-cost history (the next run's estimates)."""
+def _record_cost(name: str, measured_s: float, cold: bool,
+                 sig: str = "") -> None:
+    """Self-updating measured-cost history (the next run's estimates).
+    ``sig`` encodes the workload shape/params: a history entry recorded
+    under a different signature is IGNORED by ``_estimate`` (a config
+    growth like r5's 8x xgb_wide bump must not inherit the small-shape
+    measurement)."""
     hist = _cost_history()
     hist[name] = {"measured_s": round(measured_s, 1), "cold": cold,
-                  "recorded_unix": int(time.time())}
+                  "sig": sig, "recorded_unix": int(time.time())}
     try:
         with open(COST_HISTORY, "w") as f:
             json.dump(hist, f, indent=2, sort_keys=True)
@@ -95,11 +100,11 @@ def _record_cost(name: str, measured_s: float, cold: bool) -> None:
         pass
 
 
-def _estimate(name: str, fallback_s: float) -> tuple:
-    """(estimate_s, source) — measured history of the same config if
-    present, else the stated fallback."""
+def _estimate(name: str, fallback_s: float, sig: str = "") -> tuple:
+    """(estimate_s, source) — measured history of the same config AND the
+    same workload signature if present, else the stated fallback."""
     h = _cost_history().get(name)
-    if h and "measured_s" in h:
+    if h and "measured_s" in h and h.get("sig", "") == sig:
         return float(h["measured_s"]), "measured_history"
     return fallback_s, "assumed"
 
@@ -189,8 +194,9 @@ def main():
 
     base = _baselines()
 
-    def over_budget(name: str, fallback_estimate_s: float) -> bool:
-        est, src = _estimate(name, fallback_estimate_s)
+    def over_budget(name: str, fallback_estimate_s: float,
+                    sig: str = "") -> bool:
+        est, src = _estimate(name, fallback_estimate_s, sig)
         if _elapsed() + est > budget:
             results[name] = {
                 "skipped": f"estimated {est:.0f}s ({src}) exceeds remaining "
@@ -207,14 +213,15 @@ def main():
         comparison attached.  ``unconditional`` (the 1M default-grid
         headline): never skipped — a projection overrunning the budget is
         printed as a hard alarm and the config runs regardless."""
+        sig = f"{rows}x{cols}:{which_grid}"
         if unconditional:
-            est, src = _estimate(name, fallback_estimate_s)
+            est, src = _estimate(name, fallback_estimate_s, sig)
             if _elapsed() + est > budget:
                 _log(f"{name}: HARD ALARM — projection {est:.0f}s ({src}) "
                      f"exceeds remaining budget "
                      f"({budget - _elapsed():.0f}s of {budget:.0f}s); "
                      f"RUNNING ANYWAY (headline is never skipped)")
-        elif over_budget(name, fallback_estimate_s):
+        elif over_budget(name, fallback_estimate_s, sig):
             return None
         import bench_scale
         sb = base.get(name, {})
@@ -230,7 +237,7 @@ def main():
             _log(f"{name}: FAILED after {time.perf_counter()-t0:.0f}s: {e}")
             flush()
             return None
-        _record_cost(name, time.perf_counter() - t0, cold=False)
+        _record_cost(name, time.perf_counter() - t0, cold=False, sig=sig)
         d["baseline_kind"] = sb.get("kind", "assumed")
         cpu_ref = sb.get("cpu_1core_measured", {}).get(cpu_key)
         if cpu_ref:
@@ -284,14 +291,15 @@ def main():
         headline_is_grid = True
         flush()
 
-    # -- config 5: XGB wide-sparse -------------------------------------------
-    if not over_budget("xgb_wide", 240):
+    # -- config 5: XGB wide-sparse (1M x 2000 @ 5% since r5) -----------------
+    if not over_budget("xgb_wide", 900, sig="1000000x2000x200"):
         import bench_xgb_wide
         xb = base["xgb_wide"]
         _log("xgb: wide-sparse fit (examples/bench_xgb_wide)")
         t0 = time.perf_counter()
         xgb = bench_xgb_wide.run()
-        _record_cost("xgb_wide", time.perf_counter() - t0, cold=False)
+        _record_cost("xgb_wide", time.perf_counter() - t0, cold=False,
+                     sig="1000000x2000x200")
         if xb.get("baseline_s"):
             xgb["vs_baseline"] = round(xb["baseline_s"] / xgb["value"], 2)
             xgb["baseline_s"] = xb["baseline_s"]

@@ -91,14 +91,66 @@ class RawFeatureFilter:
         self.protected_features: Set[str] = set(protected_features)
         self.js_protected: Set[str] = set(js_divergence_protected_features)
         self.scoring_data = scoring_data
+        #: optional jax.sharding.Mesh — numeric distribution passes then run
+        #: as ONE row-sharded psum program (with_mesh); runtime-only
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "RawFeatureFilter":
+        """Profile numeric columns mesh-sharded: the TPU analogue of the
+        reference's executor-distributed per-partition profile + monoid
+        reduce (RawFeatureFilter.scala:489-545).  Text/map columns keep the
+        host profiling pass (hash-token loops are host work in both
+        implementations)."""
+        self.mesh = mesh
+        return self
 
     # -- profiling ----------------------------------------------------------
 
+    _MESH_NUMERIC = ("real", "integral", "binary", "date")
+
     def _profiles(self, data: ColumnarDataset, names: Sequence[str]):
         out: List[FeatureDistribution] = []
+        mesh_cols: List[str] = []
         for n in names:
-            if n in data:
+            if n not in data:
+                continue
+            if (self.mesh is not None
+                    and data[n].ftype.storage in self._MESH_NUMERIC):
+                mesh_cols.append(n)
+            else:
                 out.extend(profile_column(n, data[n]))
+        if mesh_cols:
+            out.extend(self._profiles_numeric_sharded(data, mesh_cols))
+        return out
+
+    def _profiles_numeric_sharded(self, data: ColumnarDataset,
+                                  names: Sequence[str]):
+        """All scalar-numeric columns in one sharded device pass; the
+        fixed-grid histogram loads into the same StreamingHistogram
+        estimator the host pass builds (grid centers as centroids)."""
+        from ..parallel.sharded import profile_numeric_sharded
+        from ..utils.streaming_histogram import StreamingHistogram
+        from .feature_distribution import NUMERIC_BINS
+
+        X = np.stack([np.asarray(data[n].values, np.float64)
+                      for n in names], axis=1)
+        mask = np.stack([np.asarray(data[n].mask) for n in names], axis=1)
+        nulls, valid, s, s2, mn, mx, hist, edges = profile_numeric_sharded(
+            X.astype(np.float32), mask, self.mesh, n_bins=NUMERIC_BINS)
+        out = []
+        for j, name in enumerate(names):
+            d = FeatureDistribution(name, None, count=X.shape[0],
+                                    nulls=int(nulls[j]))
+            h = StreamingHistogram(NUMERIC_BINS)
+            centers = 0.5 * (edges[:-1, j] + edges[1:, j])
+            nz = hist[:, j] > 0
+            h.centroids = centers[nz].astype(np.float64)
+            h.counts = hist[nz, j].astype(np.float64)
+            d.hist = h
+            d.moments_n = float(valid[j])
+            d.moments_sum = float(s[j])
+            d.moments_sum2 = float(s2[j])
+            out.append(d)
         return out
 
     def _null_label_corr(self, data: ColumnarDataset, name: str,

@@ -465,6 +465,29 @@ def _sparse_level_hists(csr_rows, csr_bins, zero_b_oh, slot, chans,
             for c in range(nchan)]
 
 
+def default_dir_mask(edges) -> np.ndarray:
+    """(D,) bool: features whose bin 0 is a GENUINE missing/absent bucket —
+    their smallest finite bin edge is the sparse-aware sketch's pinned 0.0
+    (zeros and NaNs land in bin 0, real values in bins >= 1).  Only these
+    features may learn a default direction: on a dense feature bin 0 is
+    merely the lowest quantile."""
+    e = np.asarray(edges, np.float64)
+    first = np.where(np.isfinite(e), e, np.inf).min(axis=1)
+    return first == 0.0
+
+
+def _route_right(x, t):
+    """THE split routing rule, shared by growth and prediction.
+
+    ``t`` in [0, B-1): go right iff bin > t.  ``t == B``: no-split
+    sentinel (always left).  ``t < 0``: default-direction split (XGBoost
+    missing-value semantics) — effective threshold -t-1, and the bin-0
+    (missing/absent) bucket routes RIGHT instead of left."""
+    dr = t < 0
+    te = jnp.where(dr, -t - 1, t)
+    return (x > te) | (dr & (x == 0))
+
+
 def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                       max_depth: int, n_bins: int, lam, min_child_weight,
                       min_info_gain, min_instances, newton_leaf,
@@ -472,7 +495,8 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                       all_reduce=None, min_gain_raw=None,
                       bag_mode: str = "none", feat_idx=None,
                       leaf_levels: Tuple[int, ...] = (), csr=None,
-                      seg_hist: bool = False):
+                      seg_hist: bool = False, default_dir: bool = False,
+                      dd_mask=None):
     """One whole tree under trace: Python-unrolled loop over levels.
 
     ``csr``: optional (rows (D, NZ) int32, bins (D, NZ) int8,
@@ -794,8 +818,50 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                  & feat_mask[None, None, :])
         node_w = jnp.maximum(Ctot[:, 0, 0], 1e-12)
         gain = jnp.where(valid, gain, -jnp.inf)      # (M, B, D)
-
         flat_gain = gain.reshape(M, B * d)
+
+        if default_dir:
+            # XGBoost default-direction (missing/sparse) splits: variant b
+            # routes the bin-0 (missing/absent) mass RIGHT — its cumsums
+            # are the plain ones minus the bin-0 row — a per-(node, t,
+            # feature) 2-way gain compare, exactly the C++ core's
+            # enumerate-both-directions loop (OpXGBoostClassifier.scala:47
+            # wraps those semantics).  Encoded as a NEGATIVE threshold
+            # -(t+1) so heap shapes/persistence are unchanged.  ``dd_mask``
+            # (from the caller's bin edges) limits variant b to features
+            # whose bin 0 IS a genuine missing/zero bucket (first edge
+            # pinned at 0.0 by the sparse-aware sketch): on a dense
+            # feature, bin 0 is just the lowest quantile, and routing it
+            # with the high side would fabricate non-contiguous splits real
+            # XGBoost cannot produce (code-review r5).
+            gain_b = 0.0
+            HLbmin = jnp.inf
+            HRbmin = jnp.inf
+            for GL, HL in zip(GLs, HLs):
+                Gtot = GL[:, -1:, :1]
+                Htot = HL[:, -1:, :1]
+                GLb, HLb = GL - GL[:, 0:1, :], HL - HL[:, 0:1, :]
+                GRb, HRb = Gtot - GLb, Htot - HLb
+                gain_b = gain_b + (GLb ** 2 / (HLb + lam)
+                                   + GRb ** 2 / (HRb + lam)
+                                   - Gtot ** 2 / (Htot + lam))
+                HLbmin = jnp.minimum(HLbmin, HLb)
+                HRbmin = jnp.minimum(HRbmin, HRb)
+            c0 = CL[:, 0:1, :]
+            CLb = CL - c0
+            CRb = Ctot - CLb
+            valid_b = ((HLbmin >= min_child_weight)
+                       & (HRbmin >= min_child_weight)
+                       & (CLb >= min_instances) & (CRb >= min_instances)
+                       & (jnp.arange(B)[None, :, None] < B - 1)
+                       & feat_mask[None, None, :]
+                       & (c0 > 0))        # no bin-0 mass -> b duplicates a
+            if dd_mask is not None:
+                valid_b = valid_b & dd_mask[None, None, :]
+            gain_b = jnp.where(valid_b, gain_b, -jnp.inf)
+            flat_gain = jnp.concatenate(
+                [flat_gain, gain_b.reshape(M, B * d)], axis=1)  # (M, 2Bd)
+
         best = jnp.argmax(flat_gain, axis=1)
         best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
         # depth_limit is a TRACED scalar: trees of different requested depths
@@ -807,8 +873,17 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
             # XGBoost's gamma thresholds the RAW loss-reduction, unlike
             # Spark's per-node-weight minInfoGain
             ok = ok & (best_gain >= min_gain_raw)
-        feat_l = jnp.where(ok, best % d, 0).astype(jnp.int32)
-        thresh_l = jnp.where(ok, best // d, B).astype(jnp.int32)
+        if default_dir:
+            is_b = best >= B * d
+            bloc = best - jnp.where(is_b, B * d, 0)
+            t_raw = (bloc // d).astype(jnp.int32)
+            feat_l = jnp.where(ok, bloc % d, 0).astype(jnp.int32)
+            thresh_l = jnp.where(
+                ok, jnp.where(is_b, -(t_raw + 1), t_raw), B
+            ).astype(jnp.int32)
+        else:
+            feat_l = jnp.where(ok, best % d, 0).astype(jnp.int32)
+            thresh_l = jnp.where(ok, best // d, B).astype(jnp.int32)
 
         if compact:
             # write per-slot results back to the level's heap segment at the
@@ -826,7 +901,8 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
         # through feat_idx (no msub-wide gathered copy exists anymore)
         fid = feat_idx[feat_l] if feat_idx is not None else feat_l
         x_row = jnp.take_along_axis(binned_full, fid[slot][:, None], 1)[:, 0]
-        node = 2 * node + (x_row > thresh_l[slot]).astype(jnp.int32)
+        tv = thresh_l[slot]
+        node = 2 * node + _route_right(x_row, tv).astype(jnp.int32)
 
     # heap layout: level l occupies slots [2^l - 1, 2^{l+1} - 1)
     heap_feat = jnp.concatenate(heap_feat_levels)
@@ -860,12 +936,13 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
 
 @functools.partial(jax.jit,
                    static_argnames=("max_depth", "n_bins", "hist_bf16",
-                                    "seg_hist"))
+                                    "seg_hist", "default_dir"))
 def _grow_chunk(binned, G, H, C, feat_mask, depth_limit, max_depth: int,
                 n_bins: int, lam, min_child_weight, min_info_gain,
                 min_instances, newton_leaf, learning_rate,
                 hist_bf16: bool = False, min_gain_raw=0.0, csr=None,
-                seg_hist: bool = False):
+                seg_hist: bool = False, default_dir: bool = False,
+                dd_mask=None):
     """Grow a chunk of trees in one XLA program.
 
     binned (N, D) shared; G/H (T, N, K), C (T, N), feat_mask (T, D),
@@ -878,7 +955,7 @@ def _grow_chunk(binned, G, H, C, feat_mask, depth_limit, max_depth: int,
         min_info_gain=min_info_gain, min_instances=min_instances,
         newton_leaf=newton_leaf, learning_rate=learning_rate,
         hist_bf16=hist_bf16, min_gain_raw=min_gain_raw, csr=csr,
-        seg_hist=seg_hist)
+        seg_hist=seg_hist, default_dir=default_dir, dd_mask=dd_mask)
     f, t, lf, _ = jax.vmap(fn)(G, H, C, feat_mask, depth_limit)
     return f, t, lf
 
@@ -1289,13 +1366,14 @@ def _gbt_chain_round_jit(binned, y, W, Fm, depth_lim, lams, mcws, migs,
 @functools.partial(jax.jit, static_argnames=("n_rounds", "max_depth",
                                              "n_bins", "obj", "hist_bf16",
                                              "use_es", "skip_counts",
-                                             "seg_hist"))
+                                             "seg_hist", "default_dir"))
 def _gbt_chain_rounds_jit(binned, y, W, Fm0, vi, depth_lim, lams, mcws,
                           migs, mins_, lrs, mgrs, n_rounds: int,
                           max_depth: int, n_bins: int, obj: str,
                           hist_bf16: bool = False, use_es: bool = False,
                           csr=None, skip_counts: bool = False,
-                          seg_hist: bool = False):
+                          seg_hist: bool = False, default_dir: bool = False,
+                          dd_mask=None):
     """``n_rounds`` boosting rounds for a chunk of chains in ONE launch.
 
     ``lax.scan`` over rounds (body compiled once) carries the (S, N)
@@ -1325,7 +1403,8 @@ def _gbt_chain_rounds_jit(binned, y, W, Fm0, vi, depth_lim, lams, mcws,
                 newton_leaf=jnp.bool_(True), learning_rate=lr,
                 hist_bf16=hist_bf16, min_gain_raw=mgr, csr=csr,
                 bag_mode="newton" if skip_counts else "none",
-                seg_hist=seg_hist)[:3]
+                seg_hist=seg_hist, default_dir=default_dir,
+                dd_mask=dd_mask)[:3]
 
         f, t, lf = jax.vmap(one)(G, H, W, depth_lim, lams, mcws, migs,
                                  mins_, lrs, mgrs)
@@ -1406,6 +1485,7 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               newton_leaf: bool = True, learning_rate: float = 1.0,
               min_gain_raw: float = 0.0, hist_bf16: bool = False,
               csr=None, seg_hist: Optional[bool] = None,
+              default_dir: bool = False, dd_mask=None,
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow one tree (single-tree view of ``grow_forest``): one XLA launch."""
     n, d = binned.shape
@@ -1422,7 +1502,8 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
         jnp.float32(min_info_gain), jnp.float32(min_instances),
         jnp.bool_(newton_leaf), jnp.float32(learning_rate),
         hist_bf16=hist_bf16, min_gain_raw=jnp.float32(min_gain_raw),
-        csr=csr, seg_hist=seg_hist)
+        csr=csr, seg_hist=seg_hist, default_dir=default_dir,
+        dd_mask=dd_mask)
     return f[0], t[0], lf[0]
 
 
@@ -1443,7 +1524,7 @@ def predict_tree(binned: jnp.ndarray, feat: jnp.ndarray, thresh: jnp.ndarray,
         f = feat[heap]
         t = thresh[heap]
         x = jnp.take_along_axis(binned, f[:, None], 1)[:, 0]
-        return 2 * node + (x > t).astype(jnp.int32)
+        return 2 * node + _route_right(x, t).astype(jnp.int32)
 
     node = lax.fori_loop(0, max_depth, level, node)
     return leaf[node]
@@ -1494,7 +1575,7 @@ def predict_ensemble(binned: jnp.ndarray, feat: jnp.ndarray,
         f = feat_f[heap]
         t = thresh_f[heap]
         x = binned_f[row_off + f]                        # (T, N)
-        return 2 * node + (x > t).astype(jnp.int32)
+        return 2 * node + _route_right(x, t).astype(jnp.int32)
 
     node = lax.fori_loop(0, max_depth, level, node)
     # leaf-sum in tree chunks: one (T, N, K) gather would cost T·N·K·4 bytes

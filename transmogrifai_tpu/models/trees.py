@@ -495,6 +495,34 @@ def _prep_tree_inputs(X, max_bins):
     return edges, _binned_cached(Xf, hx, edges)
 
 
+def _prep_tree_inputs_mesh(X, max_bins, mesh):
+    """Quantile sketch + binning with the sketch MESH-SHARDED: each shard
+    samples its rows, the samples all_gather over ICI, quantiles compute
+    replicated (parallel.sharded.quantile_bins_sharded — the analogue of
+    the reference's executor-distributed sketch, RawFeatureFilter.scala:
+    489-545 / XGBoost's Rabit sketch).  Same memo keys per (matrix, mesh
+    topology) so a sweep sketches once.
+
+    Mostly-zero matrices keep the HOST sparse-aware sketch (pinned 0.0
+    edge, full resolution on the nonzeros): the sharded sketch has no
+    nonzero-aware variant yet, and an all-values sketch of a 95%-zero
+    feature collapses to ~2 usable bins (code-review r5)."""
+    from ..parallel.sharded import quantile_bins_sharded
+
+    Xf = _as_f32(X)
+    n = Xf.shape[0]
+    step = max(1, n // 4096)
+    if (Xf.size >= _SPARSE_MIN_ELEMS
+            and float((Xf[::step] == 0).mean()) >= _SPARSE_ZERO_FRAC):
+        e, b, _ = _prep_tree_inputs_sparse(Xf, max_bins)
+        return e, b
+    hx = _content_hash(Xf)
+    mesh_key = tuple(sorted(mesh.shape.items()))
+    edges = _memo(("edges_mesh", hx, Xf.shape, max_bins, mesh_key),
+                  lambda: quantile_bins_sharded(Xf, mesh, max_bins))
+    return edges, _binned_cached(Xf, hx, edges)
+
+
 #: sampled zero fraction at/above which the tree fit takes the sparse path
 #: (nonzero-aware sketch + CSR histogram build)
 _SPARSE_ZERO_FRAC = 0.75
@@ -600,12 +628,19 @@ class _RandomForestBase(PredictorEstimator):
 
     def fit_raw(self, X: np.ndarray, y: np.ndarray, w=None):
         n, d = X.shape
-        # sparse-aware sketch (CSR unused — RF histograms run at feature-
-        # subset width): the SAME edges/memo keys as RFGridGroup's sweep, so
-        # a winner refit on a qualifying sparse matrix trains with the bin
-        # edges the candidate won selection on (ADVICE r4 medium) and reuses
-        # the sweep's host sketch + binned-matrix upload
-        edges, binned, _ = _prep_tree_inputs_sparse(X, self.max_bins)
+        if self.mesh is not None:
+            # mesh-sharded sketch (all_gather'd per-shard samples) — the
+            # executor-distributed sketch of the reference (VERDICT r4 #5)
+            edges, binned = _prep_tree_inputs_mesh(X, self.max_bins,
+                                                   self.mesh)
+        else:
+            # sparse-aware sketch (CSR unused — RF histograms run at
+            # feature-subset width): the SAME edges/memo keys as
+            # RFGridGroup's sweep, so a winner refit on a qualifying sparse
+            # matrix trains with the bin edges the candidate won selection
+            # on (ADVICE r4 medium) and reuses the sweep's host sketch +
+            # binned-matrix upload
+            edges, binned, _ = _prep_tree_inputs_sparse(X, self.max_bins)
         base_w = (np.ones(n, np.float32) if w is None
                   else np.asarray(w, np.float32))
         if self._classification:
@@ -748,6 +783,7 @@ class _GBTBase(PredictorEstimator):
                  min_instances_per_node: int = 1,
                  min_split_gain_raw: float = 0.0,
                  seed: int = 42, hist_precision: str = "bf16",
+                 sparse_default_direction: bool = False,
                  uid: Optional[str] = None):
         super().__init__(operation_name=self._op_name, uid=uid)
         self.max_iter = max_iter
@@ -766,6 +802,13 @@ class _GBTBase(PredictorEstimator):
         #: per-node-weight minInfoGain)
         self.min_split_gain_raw = min_split_gain_raw
         self.seed = seed
+        #: XGBoost missing-value semantics: each split also learns a
+        #: default direction for the bin-0 (missing/absent) bucket by
+        #: trying both routings in the gain search — the actual sparsity
+        #: feature of the C++ core (OpXGBoostClassifier.scala:47 wraps it).
+        #: Default ON for the XGB-parameterised estimators, OFF for the
+        #: Spark-GBT-parity ones (MLlib has no default direction).
+        self.sparse_default_direction = sparse_default_direction
         #: 'bf16' (default) or 'f32': histogram one-hot/dot precision.
         #: bf16 halves the (rows, bins·features) one-hot stream — the
         #: kernel's bandwidth floor — and runs the dots at ~2x MXU
@@ -809,7 +852,9 @@ class _GBTBase(PredictorEstimator):
             # nonzero entries; XGBoost-core parity, SURVEY §2.11)
             edges, binned, csr = _prep_tree_inputs_sparse(X, self.max_bins)
         else:
-            edges, binned = _prep_tree_inputs(X, self.max_bins)
+            # mesh-sharded sketch over ICI (VERDICT r4 #5)
+            edges, binned = _prep_tree_inputs_mesh(X, self.max_bins,
+                                                   self.mesh)
             csr = None
         rng = np.random.default_rng(self.seed)
         base_w = (np.ones(n, np.float32) if w is None
@@ -887,6 +932,13 @@ class _GBTBase(PredictorEstimator):
         feats, threshs, leaves = [], [], []
         best_metric, best_len, stall = -np.inf, 0, 0
         val_idx = np.where(val)[0]
+        from .gbdt_kernels import default_dir_mask, seg_hist_auto
+        # default-direction eligibility from the bin edges (pinned-zero
+        # features only); segmented histograms never on the mesh path (the
+        # Pallas kernel has no GSPMD partitioning rule — code-review r5)
+        dd = (jnp.asarray(default_dir_mask(edges))
+              if self.sparse_default_direction else None)
+        seg_seq = seg_hist_auto(n, 1) if self.mesh is None else False
         # early-stopping metrics fetch in CHUNKS: a per-round host sync
         # costs a ~0.3-0.65 s tunnel round trip (200 rounds = minutes);
         # the stall decision replays per-round on host from the fetched
@@ -925,7 +977,9 @@ class _GBTBase(PredictorEstimator):
                 feat_mask=jnp.asarray(mask), newton_leaf=True,
                 learning_rate=self.step_size,
                 min_gain_raw=self.min_split_gain_raw,
-                hist_bf16=self._hist_bf16(), csr=csr)
+                hist_bf16=self._hist_bf16(), csr=csr,
+                seg_hist=seg_seq,
+                default_dir=self.sparse_default_direction, dd_mask=dd)
             from .gbdt_kernels import predict_tree
 
             heap_depth = int(np.log2(f.shape[0] + 1))
@@ -975,10 +1029,13 @@ class _GBTBase(PredictorEstimator):
         host RNG) and a single device."""
         from ..utils.profiling import count_launch
         from .gbdt_kernels import (_gbt_chain_rounds_jit,
-                                   _resolve_compile_depth, seg_hist_auto)
+                                   _resolve_compile_depth, default_dir_mask,
+                                   seg_hist_auto)
 
         n = int(binned.shape[0])
         seg = seg_hist_auto(n, n_chains=1)
+        dd = (jnp.asarray(default_dir_mask(edges))
+              if self.sparse_default_direction else None)
         # family compile-depth hint: sequential-fallback candidates of
         # differing max_depth share ONE compiled scan program (their own
         # depth rides the traced depth limit) instead of recompiling the
@@ -1021,7 +1078,8 @@ class _GBTBase(PredictorEstimator):
                 one(self.step_size), one(self.min_split_gain_raw),
                 es_chunk, heap_depth, self.max_bins, obj,
                 self._hist_bf16(), run_es, csr=csr,
-                skip_counts=skip_counts, seg_hist=seg)
+                skip_counts=skip_counts, seg_hist=seg,
+                default_dir=self.sparse_default_direction, dd_mask=dd)
             fb.append(fs)
             tb.append(ts)
             lb.append(lfs)
@@ -1152,6 +1210,7 @@ class OpXGBoostClassifier(_GBTBase):
                  max_bins: int = 32, early_stopping_rounds: int = 20,
                  num_class: int = 0, seed: int = 42,
                  hist_precision: str = "bf16",
+                 sparse_default_direction: bool = True,
                  uid: Optional[str] = None):
         super().__init__(
             max_iter=num_round, max_depth=max_depth, step_size=eta,
@@ -1160,7 +1219,8 @@ class OpXGBoostClassifier(_GBTBase):
             min_split_gain_raw=gamma, subsample_rate=subsample,
             colsample=colsample_bytree,
             early_stopping_rounds=early_stopping_rounds, seed=seed,
-            hist_precision=hist_precision, uid=uid)
+            hist_precision=hist_precision,
+            sparse_default_direction=sparse_default_direction, uid=uid)
         self.num_round = num_round
         self.eta = eta
         self.gamma = gamma

@@ -20,8 +20,11 @@
 //   feat    (T, 2^depth - 1) int32   heap-indexed internal nodes
 //   thresh  (T, 2^depth - 1) int32
 //   leaf    (T, 2^depth, K)  float32
-// Routing rule per level (predict_tree, gbdt_kernels.py:289-305):
-//   node <- 2*node + (binned[row, feat[heap]] > thresh[heap])
+// Routing rule per level (gbdt_kernels._route_right):
+//   t >= 0: node <- 2*node + (x > t); t == B is the no-split sentinel
+//   t < 0:  default-direction split (XGBoost missing-value semantics) —
+//           effective threshold -t-1, and bin 0 (the missing/absent
+//           bucket) routes RIGHT instead of left
 //
 // Plain C ABI (ctypes-consumed; no pybind11 in this environment).
 
@@ -53,7 +56,15 @@ static void predict_rows(const int32_t* binned, int64_t row0, int64_t row1,
       int64_t node = 0;
       for (int l = 0; l < depth; ++l) {
         const int64_t heap = (int64_t(1) << l) - 1 + node;
-        node = 2 * node + (xrow[tf[heap]] > tt[heap] ? 1 : 0);
+        const int32_t tv = tt[heap];
+        const int32_t x = xrow[tf[heap]];
+        int right;
+        if (tv < 0) {
+          right = (x > -tv - 1 || x == 0) ? 1 : 0;
+        } else {
+          right = (x > tv) ? 1 : 0;
+        }
+        node = 2 * node + right;
       }
       const float* lf = leaf + (t * n_leaves + node) * k;
       for (int64_t c = 0; c < k; ++c) orow[c] += lf[c];

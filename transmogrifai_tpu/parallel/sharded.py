@@ -244,6 +244,91 @@ def colstats_corr_sharded(X: np.ndarray, y: np.ndarray, mesh: Mesh):
     return tuple(packed)
 
 
+#: row block for the sharded numeric-profile histogram build (bounds the
+#: transient (rows, bins, D) one-hot)
+_PROFILE_ROW_BLOCK = 32768
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def _profile_numeric_jit(X, m, n_bins: int):
+    """Per-column count/nulls/moments/min/max + fixed-grid histogram in ONE
+    program; on sharded inputs GSPMD psums every reduction over ICI.
+
+    Moments are accumulated about a per-column ANCHOR (the column's
+    midrange): raw f32 sums of e.g. ms-epoch date values (~1.7e12) are
+    pure rounding noise, while centered deviations keep full relative
+    precision — the host reconstructs the raw f64 moments from (anchor,
+    centered sums)."""
+    n, d = X.shape
+    mf = m & jnp.isfinite(X)
+    cnt = m.sum(axis=0).astype(jnp.float32)
+    valid = mf.sum(axis=0).astype(jnp.float32)
+    big = jnp.float32(3.0e38)
+    mn = jnp.min(jnp.where(mf, X, big), axis=0)
+    mx = jnp.max(jnp.where(mf, X, -big), axis=0)
+    anchor = jnp.where(valid > 0, 0.5 * (mn + mx), 0.0)
+    Xc = jnp.where(mf, X - anchor[None, :], 0.0)
+    s = Xc.sum(axis=0)
+    s2 = (Xc * Xc).sum(axis=0)
+    w = jnp.maximum(mx - mn, 1e-30)
+    b = jnp.clip(((X - mn[None, :]) / w[None, :] * n_bins).astype(jnp.int32),
+                 0, n_bins - 1)
+    n_blk = -(-n // _PROFILE_ROW_BLOCK)
+    pad = n_blk * _PROFILE_ROW_BLOCK - n
+    b_p = jnp.pad(b, ((0, pad), (0, 0))).reshape(n_blk, -1, d)
+    m_p = jnp.pad(mf, ((0, pad), (0, 0))).reshape(n_blk, -1, d)
+
+    def block(acc, xs):
+        bb, mm = xs
+        oh = ((bb[:, None, :] == jnp.arange(n_bins)[None, :, None])
+              & mm[:, None, :]).astype(jnp.float32)
+        return acc + oh.sum(axis=0), None
+
+    hist, _ = lax.scan(block, jnp.zeros((n_bins, d), jnp.float32),
+                       (b_p, m_p))
+    return cnt, valid, s, s2, mn, mx, hist, anchor
+
+
+def profile_numeric_sharded(X: np.ndarray, mask: np.ndarray, mesh: Mesh,
+                            n_bins: int = 100):
+    """RawFeatureFilter's numeric distribution pass over a row-sharded
+    matrix: ONE jitted program whose column reductions (counts, moments,
+    min/max, fixed-grid histogram) GSPMD psums over ICI — the TPU analogue
+    of the reference's executor-distributed per-partition profile +
+    monoid reduce (RawFeatureFilter.scala:489-545,
+    FeatureDistribution.scala:187-192).
+
+    Returns host arrays (nulls, valid, sum, sum2, min, max,
+    hist (n_bins, D), edges (n_bins+1, D)); padded rows carry mask=False
+    so results match an unsharded pass."""
+    from .mesh import data_sharding, pad_to_multiple
+
+    n = X.shape[0]
+    ndata = mesh.shape[mesh.axis_names[0]]
+    Xp, _ = pad_to_multiple(np.asarray(X, np.float32), ndata, axis=0)
+    mp = np.zeros(Xp.shape, bool)
+    mp[:n] = np.asarray(mask, bool)
+    ds = data_sharding(mesh)
+    out = _profile_numeric_jit(jax.device_put(Xp, ds),
+                               jax.device_put(mp, ds), n_bins)
+    nonnull, valid, s_c, s2_c, mn, mx = (np.asarray(v, np.float64)
+                                         for v in out[:6])
+    hist = np.asarray(out[6])
+    anchor = np.asarray(out[7], np.float64)
+    nulls = n - nonnull
+    # all-null/non-finite columns keep the +-big sentinels: collapse to 0
+    # so the edge grid below stays finite (their histograms are all-zero)
+    empty = valid == 0
+    mn = np.where(empty, 0.0, mn)
+    mx = np.where(empty, 0.0, mx)
+    # reconstruct raw f64 moments from the anchor-centered device sums:
+    # sum(x) = sum(x-a) + n*a ; sum(x^2) = sum((x-a)^2) + 2a*sum(x-a) + n*a^2
+    s = s_c + valid * anchor
+    s2 = s2_c + 2.0 * anchor * s_c + valid * anchor * anchor
+    edges = np.linspace(mn, mx, n_bins + 1)          # (n_bins+1, D)
+    return nulls, valid, s, s2, mn, mx, hist, edges
+
+
 def fit_logreg_sharded(X: np.ndarray, y: np.ndarray, mesh: Mesh,
                        w: Optional[np.ndarray] = None, **kwargs):
     """Data/model-parallel logistic regression: shard inputs on the mesh and

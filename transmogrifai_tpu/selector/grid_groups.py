@@ -556,7 +556,8 @@ class GBTGridGroup(GridGroup):
         # static across chains; decline otherwise (sequential fallback)
         for attr in ("max_iter", "max_bins", "early_stopping_rounds",
                      "validation_fraction", "seed", "subsample_rate",
-                     "colsample", "hist_precision"):
+                     "colsample", "hist_precision",
+                     "sparse_default_direction"):
             if len({getattr(e, attr) for e in ests}) > 1:
                 return None
         if e0.subsample_rate < 1.0 or e0.colsample < 1.0:
@@ -628,7 +629,13 @@ class GBTGridGroup(GridGroup):
         stopped = np.zeros(S, bool)
         es_chunk = max(1, min(8, e0.early_stopping_rounds or 8))
         from ..models.gbdt_kernels import (_gbt_chain_rounds_jit,
-                                           gbt_chain_chunk, seg_hist_auto)
+                                           default_dir_mask, gbt_chain_chunk,
+                                           seg_hist_auto)
+
+        # default-direction splits only on features whose bin 0 is a real
+        # missing/zero bucket (sparse-aware pinned edge)
+        dd = (jnp.asarray(default_dir_mask(edges))
+              if e0.sparse_default_direction else None)
 
         # segmented histograms at headline row counts (statically resolved
         # so it keys the jit cache).  Chain count matters: dense shares its
@@ -665,7 +672,8 @@ class GBTGridGroup(GridGroup):
                     binned, yj, Wj, Fm, vi_arr, depth_lim, lams, mcws, migs,
                     mins_, lrs, mgrs, es_chunk, heap_depth,
                     int(e0.max_bins), obj, bf16, run_es, csr=csr,
-                    skip_counts=skip_counts, seg_hist=seg)
+                    skip_counts=skip_counts, seg_hist=seg,
+                    default_dir=e0.sparse_default_direction, dd_mask=dd)
             else:
                 parts = []
                 for s0 in range(0, S, chunk):
@@ -677,7 +685,9 @@ class GBTGridGroup(GridGroup):
                         migs[s0:s1], mins_[s0:s1], lrs[s0:s1],
                         mgrs[s0:s1], es_chunk, heap_depth,
                         int(e0.max_bins), obj, bf16, run_es, csr=csr,
-                        skip_counts=skip_counts, seg_hist=seg))
+                        skip_counts=skip_counts, seg_hist=seg,
+                        default_dir=e0.sparse_default_direction,
+                        dd_mask=dd))
                 Fm = jnp.concatenate([p[0] for p in parts])
                 fs = jnp.concatenate([p[1] for p in parts], axis=1)
                 ts = jnp.concatenate([p[2] for p in parts], axis=1)

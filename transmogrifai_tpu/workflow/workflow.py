@@ -169,9 +169,17 @@ class OpWorkflow(_WorkflowCore):
             data = self.generate_raw_data()
             filter_results = None
             if self._raw_feature_filter is not None:
-                data, filter_results = (
-                    self._raw_feature_filter.filter_raw_data(
-                        data, self.raw_features()))
+                prev_mesh = self._raw_feature_filter.mesh
+                if self.mesh is not None:
+                    # numeric distribution passes run row-sharded (psum) —
+                    # the executor-distributed profile of the reference
+                    self._raw_feature_filter.with_mesh(self.mesh)
+                try:
+                    data, filter_results = (
+                        self._raw_feature_filter.filter_raw_data(
+                            data, self.raw_features()))
+                finally:
+                    self._raw_feature_filter.with_mesh(prev_mesh)
                 self._apply_blocklist(filter_results.dropped_features)
         dag = compute_dag(self.result_features)
         self._validate_stages(dag)
