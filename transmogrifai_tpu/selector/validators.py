@@ -390,7 +390,11 @@ def _materialize(nested: List[Any]) -> List[List[float]]:
         if isinstance(v, _GroupRow) and id(v.matrix) not in mats:
             try:
                 mats[id(v.matrix)] = fetch_timed(v.matrix, np.float64)
-            except Exception:  # async device fault inside the group program
+            except Exception as e:  # async device fault in the group program
+                import warnings
+                warnings.warn(
+                    f"group metric fetch failed ({type(e).__name__}: "
+                    f"{str(e)[:300]}); recording NaN rows", RuntimeWarning)
                 mats[id(v.matrix)] = None
     if mats:
         resolved: List[Any] = []
