@@ -1,0 +1,157 @@
+"""bench.py driver contract: config order, headline priority, crash
+resilience, and measured-cost-history estimates.
+
+The driver invokes ``python bench.py`` blind and parses the LAST complete
+JSON line; these tests pin that contract with the heavy configs mocked.
+"""
+import importlib
+import json
+import sys
+import types
+
+def _load_bench(tmp_path, monkeypatch, scale_behavior, xgb_behavior=None):
+    """Import a fresh bench module wired to mock workloads.
+
+    ``scale_behavior(rows, cols, which_grid)`` returns a result dict or
+    raises; titanic + kernels are stubbed cheap.
+    """
+    import bench as bench_mod
+
+    bench = importlib.reload(bench_mod)
+    monkeypatch.setattr(bench, "COST_HISTORY",
+                        str(tmp_path / "cost_history.json"))
+
+    def fake_titanic():
+        return {"metric": "titanic_automl_train_wall_clock", "value": 1.0,
+                "unit": "s", "cold_s": 1.0, "warm_s": 1.0,
+                "vs_baseline": 2.0, "aupr": 0.8, "auroc": 0.85,
+                "reference_aupr_range": [0.675, 0.810],
+                "baseline_s": 180.0, "baseline_kind": "spark_estimate"}
+
+    monkeypatch.setattr(bench, "run_titanic", fake_titanic)
+
+    calls = []
+
+    fake_scale = types.ModuleType("bench_scale")
+
+    def scale_run(rows, cols, folds=3, which_grid="light", warmup=False,
+                  baseline_s=1800.0):
+        calls.append((rows, cols, which_grid))
+        out = scale_behavior(rows, cols, which_grid)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    fake_scale.run = scale_run
+    monkeypatch.setitem(sys.modules, "bench_scale", fake_scale)
+
+    fake_xgb = types.ModuleType("bench_xgb_wide")
+
+    def xgb_run():
+        calls.append(("xgb",))
+        if xgb_behavior is not None:
+            out = xgb_behavior()
+            if isinstance(out, Exception):
+                raise out
+            return out
+        return {"metric": "xgb_wide_sparse_fit_wall_clock", "value": 5.0,
+                "unit": "s"}
+
+    fake_xgb.run = xgb_run
+    monkeypatch.setitem(sys.modules, "bench_xgb_wide", fake_xgb)
+
+    fake_kern = types.ModuleType("bench_kernels")
+    fake_kern.run = lambda: (calls.append(("kernels",))
+                             or {"hist_mfu": 0.01})
+    monkeypatch.setitem(sys.modules, "bench_kernels", fake_kern)
+    return bench, calls
+
+
+def _grid_result(rows, cols, which_grid, value=10.0):
+    return {"candidates": 6, "candidate_errors": 0, "grid": which_grid,
+            "metric": "scale_automl_train_wall_clock", "rows": rows,
+            "cols": cols, "value": value, "unit": "s", "vs_baseline": 2.0,
+            "aupr": 0.9, "auroc": 0.95, "datagen_s": 1.0,
+            "baseline_s_assumed": 1800.0, "warmup_s": 0.0, "phases": {},
+            "transfers": {}}
+
+
+def _run_main(bench, capsys):
+    bench.main()
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    return json.loads(lines[-1])
+
+
+class TestBenchContract:
+    def test_order_and_headline_when_all_pass(self, tmp_path, monkeypatch,
+                                              capsys):
+        bench, calls = _load_bench(
+            tmp_path, monkeypatch,
+            lambda r, c, g: _grid_result(r, c, g))
+        monkeypatch.setenv("TMOG_BENCH_BUDGET_S", "100000")
+        monkeypatch.delenv("TMOG_BENCH_SKIP_1M_DEFAULT", raising=False)
+        last = _run_main(bench, capsys)
+        grid_calls = [c for c in calls if len(c) == 3]
+        # light 1M, 100k default, then the quarantined 1M default LAST
+        assert grid_calls == [(1_000_000, 500, "light"),
+                              (100_000, 500, "default"),
+                              (1_000_000, 500, "default")]
+        assert calls.index(("xgb",)) < calls.index(
+            (1_000_000, 500, "default"))
+        # a COMPLETED 1M default grid is the headline
+        assert last["metric"] == "automl_default_grid_1m_x_500_wall_clock"
+        assert set(last["configs"]) >= {"titanic", "scale_1m_x_500",
+                                        "default_grid_1m_x_500",
+                                        "xgb_wide", "kernels"}
+
+    def test_headline_priority_when_default_1m_crashes(self, tmp_path,
+                                                       monkeypatch, capsys):
+        def behavior(rows, cols, grid):
+            if rows == 1_000_000 and grid == "default":
+                return RuntimeError("TPU worker crashed")
+            return _grid_result(rows, cols, grid)
+
+        bench, _ = _load_bench(tmp_path, monkeypatch, behavior)
+        monkeypatch.setenv("TMOG_BENCH_BUDGET_S", "100000")
+        monkeypatch.delenv("TMOG_BENCH_SKIP_1M_DEFAULT", raising=False)
+        last = _run_main(bench, capsys)
+        # the 1M LIGHT grid headlines (not the 100k diagnostic), and the
+        # crash is recorded — never silently skipped
+        assert last["metric"] == "automl_1m_x_500_light_grid_wall_clock"
+        assert "error" in last["configs"]["default_grid_1m_x_500"]
+        assert "xgb_wide" in last["configs"]
+
+    def test_100k_headlines_only_without_any_1m_result(self, tmp_path,
+                                                       monkeypatch, capsys):
+        def behavior(rows, cols, grid):
+            if rows == 1_000_000:
+                return RuntimeError("boom")
+            return _grid_result(rows, cols, grid)
+
+        bench, _ = _load_bench(tmp_path, monkeypatch, behavior)
+        monkeypatch.setenv("TMOG_BENCH_BUDGET_S", "100000")
+        monkeypatch.delenv("TMOG_BENCH_SKIP_1M_DEFAULT", raising=False)
+        last = _run_main(bench, capsys)
+        assert last["metric"] == "automl_default_grid_100k_x_500_wall_clock"
+
+    def test_cost_history_sig_mismatch_falls_back(self, tmp_path,
+                                                  monkeypatch):
+        bench, _ = _load_bench(tmp_path, monkeypatch,
+                               lambda r, c, g: _grid_result(r, c, g))
+        bench._record_cost("cfg", 123.0, cold=False, sig="old-shape")
+        est, src = bench._estimate("cfg", 50.0, sig="new-shape")
+        assert (est, src) == (50.0, "assumed")
+        est, src = bench._estimate("cfg", 50.0, sig="old-shape")
+        assert (est, src) == (123.0, "measured_history")
+
+    def test_diagnostic_skip_knob_records_reason(self, tmp_path,
+                                                 monkeypatch, capsys):
+        bench, calls = _load_bench(
+            tmp_path, monkeypatch, lambda r, c, g: _grid_result(r, c, g))
+        monkeypatch.setenv("TMOG_BENCH_BUDGET_S", "100000")
+        monkeypatch.setenv("TMOG_BENCH_SKIP_1M_DEFAULT", "1")
+        last = _run_main(bench, capsys)
+        assert (1_000_000, 500, "default") not in calls
+        assert "skipped" in last["configs"]["default_grid_1m_x_500"]
+        assert "diagnostic" in str(
+            last["configs"]["default_grid_1m_x_500"]["skipped"])
