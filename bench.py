@@ -59,6 +59,12 @@ COLS = ["PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
         "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked"]
 COST_HISTORY = os.path.join(_ROOT, "benchmarks", "cost_history.json")
 
+#: THE north-star headline config (single source for the budget reserve
+#: and the unconditional attempt itself)
+HEADLINE_NAME = "default_grid_1m_x_500"
+HEADLINE_ROWS, HEADLINE_COLS = 1_000_000, 500
+HEADLINE_FALLBACK_S = 2600
+
 _T0 = time.perf_counter()
 
 
@@ -202,11 +208,14 @@ def main():
     # still yields SOME diagnostics alongside the headline attempt
     # (code-review r5: without this, diagnostics could individually pass
     # the check and leave the mandatory headline to be killed mid-flight).
+    # HEADLINE_* are the single source for both the reserve and the
+    # actual config call below.
     if os.environ.get("TMOG_BENCH_SKIP_1M_DEFAULT") == "1":
         headline_reserve = 0.0
     else:
-        est_4d, _src = _estimate("default_grid_1m_x_500", 2600,
-                                 "1000000x500:default")
+        est_4d, _src = _estimate(
+            HEADLINE_NAME, HEADLINE_FALLBACK_S,
+            f"{HEADLINE_ROWS}x{HEADLINE_COLS}:default")
         headline_reserve = min(est_4d, 0.5 * budget)
 
     def over_budget(name: str, fallback_estimate_s: float,
@@ -215,7 +224,8 @@ def main():
         if _elapsed() + est > budget - headline_reserve:
             results[name] = {
                 "skipped": f"estimated {est:.0f}s ({src}) exceeds remaining "
-                           f"budget ({budget - headline_reserve - _elapsed():.0f}s "
+                           f"budget "
+                           f"({max(0.0, budget - headline_reserve - _elapsed()):.0f}s "
                            f"of {budget:.0f}s after reserving "
                            f"{headline_reserve:.0f}s for the unconditional "
                            f"1M default-grid headline)"}
@@ -360,9 +370,9 @@ def main():
         _log("default_grid_1m_x_500: UNCONDITIONAL headline attempt "
              "(known risk: deterministic TPU worker crash mid-sweep — "
              "all prior configs are already flushed)")
-        d = grid_config("default_grid_1m_x_500", 1_000_000, 500,
-                        "default", 2600, "extrapolated_1m_s",
-                        unconditional=True)
+        d = grid_config(HEADLINE_NAME, HEADLINE_ROWS, HEADLINE_COLS,
+                        "default", HEADLINE_FALLBACK_S,
+                        "extrapolated_1m_s", unconditional=True)
         if d:
             headline = grid_headline(
                 "automl_default_grid_1m_x_500_wall_clock", d)

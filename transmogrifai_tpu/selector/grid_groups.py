@@ -418,6 +418,11 @@ class RFGridGroup(GridGroup):
             inv[np.asarray(order, np.int32)] = np.arange(C * F, dtype=np.int32)
             scores = scores[jnp.asarray(inv)]
         scores = scores.reshape(C, F, n).transpose(1, 0, 2)  # (F, C, N)
+        # release the grown forests and per-part score buffers before the
+        # metric grid dispatches: at 1M-row sweeps the groups run back to
+        # back and holding every phase's device intermediates to the end
+        # of the sweep needlessly raises cumulative HBM pressure
+        del grown, feats, threshs, leaves, snap_map, parts
         # context for refit_model: the winner's full-train forest grows as
         # ONE more base pair through the same (cached) grid program, with
         # identical randomness to a sequential full fit
@@ -738,6 +743,12 @@ class GBTGridGroup(GridGroup):
             z = raw + base_j[s]
             scores.append(jax.nn.sigmoid(z) if obj == "binary" else z)
         scores = jnp.stack(scores).reshape(C, F, n).transpose(1, 0, 2)
+        # release the per-round tree stacks, margins and masked leaves
+        # before the metric grid runs (see RFGridGroup.run note); the last
+        # chunk's loop locals pin device buffers too
+        del feats_all, threshs_all, leaves_all, leaves_m, keep, Fm
+        del feats_b, threshs_b, leaves_b
+        fs = ts = lfs = ms = None  # noqa: F841 — drop last chunk's buffers
         fn = binary_metric_grid if obj == "binary" else regression_metric_grid
         m = fn(y, scores, jnp.asarray(W_ev), self.metric)
         if m is None:
