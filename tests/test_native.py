@@ -113,6 +113,17 @@ class TestFallback:
 import os
 os.environ["TMOG_DISABLE_NATIVE"] = "1"
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Under pytest the parent conftest exports JAX_PLATFORMS=cpu, which this
+# subprocess inherits at startup; the config.update + assert are
+# fail-fast defense for standalone invocation, where only variables in
+# the INHERITED environment (not ones set inside this -c script, which
+# run after sitecustomize has already imported jax) reach the platform
+# choice — without it a standalone run tunnels to the real TPU and HANGS
+# when the tunnel is down.  (For new subprocess tests prefer the env=
+# pattern of test_cli.py.)
+import jax
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu"
 import numpy as np
 from transmogrifai_tpu import native
 from transmogrifai_tpu.models.gbdt_kernels import (
