@@ -162,11 +162,17 @@ def main():
                     choices=["light", "default"],
                     help="light (r1/r2-comparable 6 candidates) or the "
                          "reference's true 28-candidate default grid")
+    ap.add_argument("--baseline-s", type=float,
+                    default=SPARK_LOCAL_BASELINE_S,
+                    help="baseline seconds for the vs_baseline ratio "
+                         "(bench.py passes benchmarks/baselines.json's "
+                         "value when it runs this as the headline child)")
     args = ap.parse_args()
     if args.full:
         args.rows, args.cols = 1_000_000, 500
     print(json.dumps(run(args.rows, args.cols, folds=args.folds,
-                         warmup=args.warmup, which_grid=args.grid)))
+                         warmup=args.warmup, which_grid=args.grid,
+                         baseline_s=args.baseline_s)))
 
 
 if __name__ == "__main__":

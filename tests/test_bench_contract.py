@@ -64,6 +64,17 @@ def _load_bench(tmp_path, monkeypatch, scale_behavior, xgb_behavior=None):
     fake_kern.run = lambda: (calls.append(("kernels",))
                              or {"hist_mfu": 0.01})
     monkeypatch.setitem(sys.modules, "bench_kernels", fake_kern)
+
+    def headline_runner(timeout_s):
+        calls.append((1_000_000, 500, "default"))
+        out = scale_behavior(1_000_000, 500, "default")
+        if isinstance(out, Exception):
+            return None, {"error": f"headline subprocess rc=1; "
+                                   f"stderr tail: {out}",
+                          "elapsed_s": 1.0}
+        return out, None
+
+    monkeypatch.setattr(bench, "_HEADLINE_RUNNER", headline_runner)
     return bench, calls
 
 

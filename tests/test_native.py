@@ -113,14 +113,12 @@ class TestFallback:
 import os
 os.environ["TMOG_DISABLE_NATIVE"] = "1"
 os.environ["JAX_PLATFORMS"] = "cpu"
-# Under pytest the parent conftest exports JAX_PLATFORMS=cpu, which this
-# subprocess inherits at startup; the config.update + assert are
-# fail-fast defense for standalone invocation, where only variables in
-# the INHERITED environment (not ones set inside this -c script, which
-# run after sitecustomize has already imported jax) reach the platform
-# choice — without it a standalone run tunnels to the real TPU and HANGS
-# when the tunnel is down.  (For new subprocess tests prefer the env=
-# pattern of test_cli.py.)
+# MEASURED (r5): the image's sitecustomize imports jax before any user
+# code, so the JAX_PLATFORMS env var is ignored in a child process
+# whether inherited OR set in-script (a child with the inherited var
+# still tunneled to the real TPU and hung during the r5 outage).  Only
+# an explicit config.update in the CHILD forces the platform; the
+# assert fails fast instead of hanging.
 import jax
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu"
