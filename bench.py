@@ -76,8 +76,11 @@ def _run_headline_subprocess(timeout_s: float):
     The sweep has crashed the tunneled TPU WORKER deterministically (r5,
     twice), and a worker crash poisons the crashing process's JAX client
     (and can wedge the tunnel).  A subprocess confines the blast radius:
-    the parent keeps a working record either way.  Returns
-    (result_dict_or_None, error_record_or_None)."""
+    the parent keeps a working record either way.  Known residual risk:
+    the parent still holds ITS client (and residual HBM buffers) on the
+    single tunneled chip while the child initializes its own — if that
+    contention ever fails the child, the recorded rc/stderr will say so.
+    Returns (result_dict_or_None, error_record_or_None)."""
     import subprocess
 
     if _HEADLINE_RUNNER is not None:
@@ -434,7 +437,8 @@ def main():
             results[HEADLINE_NAME] = d
             _log(f"{HEADLINE_NAME}: {d['value']}s "
                  f"({d.get('vs_cpu_1core', '?')}x vs 1-core CPU), "
-                 f"AuPR {d['aupr']}")
+                 f"AuPR {d['aupr']}, "
+                 f"{d.get('candidate_errors', '?')} errors")
             headline = grid_headline(
                 "automl_default_grid_1m_x_500_wall_clock", d)
             flush()

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Bisect the 1M x 500 default-grid TPU worker crash (round-6 job #1).
+
+Round-5 evidence (benchmarks/results_r5.json): the full default-grid
+sweep at 1M x 500 crashed the tunneled TPU WORKER twice ("kernel
+fault", ~2800-3800 s in), while every component program is stable in
+isolation.  This harness runs each sweep phase — and then cumulative
+prefixes of phases — in SEPARATE subprocesses, so a crash names its
+phase without wedging the parent, and a wedged tunnel is bounded by a
+per-phase timeout.
+
+Usage:  python examples/bisect_1m_crash.py [--rows N] [--timeout S]
+Phases:
+  lr        the 8-candidate LR majorization grid (3 folds + refit row)
+  rf        the 18-candidate RF depth-truncation grid (3 folds)
+  xgb       the 2-candidate XGB@200 lockstep chains (3 folds, ES)
+  lr+rf, lr+rf+xgb   cumulative prefixes (tests cross-phase HBM pressure)
+  full      the whole workflow sweep (bench_scale --grid default)
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import sys, time
+sys.path.insert(0, {root!r})
+from transmogrifai_tpu.utils.compile_cache import enable_persistent_cache
+enable_persistent_cache()
+import numpy as np
+sys.path.insert(0, {root!r} + "/examples")
+from bench_scale import make_data, default_grid_models
+
+import pandas as pd
+df = make_data({rows}, 500)
+y = df["label"].to_numpy(np.float32)
+X = df.drop(columns=["label"]).to_numpy(np.float32)
+
+from transmogrifai_tpu.selector.validators import make_folds
+from transmogrifai_tpu.selector.grid_groups import make_grid_group
+from transmogrifai_tpu.selector.model_selector import _binary_defaults
+from transmogrifai_tpu.models import OpXGBoostClassifier
+from transmogrifai_tpu.selector import DefaultSelectorParams as D
+from transmogrifai_tpu.selector import grid
+
+folds = make_folds(len(y), 3, y=y, stratify=True, seed=7)
+ctxs = [((folds != k).astype(np.float32), (folds == k).astype(np.float32))
+        for k in range(3)]
+mp = _binary_defaults() + [
+    (OpXGBoostClassifier(), grid(min_child_weight=D.MIN_CHILD_WEIGHT_XGB))]
+fam = dict(zip(("lr", "rf", "xgb"), mp))
+
+for name in {phases!r}:
+    proto, pts = fam[name]
+    g = make_grid_group(proto, pts, "binary", "AuPR")
+    assert g is not None, name
+    t0 = time.perf_counter()
+    m = g.run(X, y, ctxs)
+    m_host = np.asarray(m)
+    assert np.isfinite(m_host).any(), (name, m_host)
+    print(f"PHASE_OK {{name}} {{time.perf_counter()-t0:.0f}}s "
+          f"best={{float(np.nanmax(m_host)):.4f}}", flush=True)
+print("ALL_OK", flush=True)
+"""
+
+
+def run_phases(phases, rows, timeout):
+    code = _CHILD.format(root=_ROOT, rows=rows, phases=tuple(phases))
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"phases": phases, "outcome": "TIMEOUT (wedged tunnel?)",
+                "elapsed_s": round(time.perf_counter() - t0)}
+    out = proc.stdout.strip().splitlines()
+    return {"phases": phases,
+            "outcome": "ok" if proc.returncode == 0 else
+                       f"rc={proc.returncode}",
+            "elapsed_s": round(time.perf_counter() - t0),
+            "stdout": out[-4:],
+            "stderr_tail": (proc.stderr or "")[-300:]
+            if proc.returncode else ""}
+
+
+def run_full(rows, timeout):
+    """The whole workflow sweep via bench_scale (its own subprocess)."""
+    cmd = [sys.executable, os.path.join(_ROOT, "examples", "bench_scale.py"),
+           "--rows", str(rows), "--cols", "500", "--grid", "default",
+           "--folds", "3"]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"phases": ["full"], "outcome": "TIMEOUT (wedged tunnel?)",
+                "elapsed_s": round(time.perf_counter() - t0)}
+    return {"phases": ["full"],
+            "outcome": "ok" if proc.returncode == 0 else
+                       f"rc={proc.returncode}",
+            "elapsed_s": round(time.perf_counter() - t0),
+            "stdout": proc.stdout.strip().splitlines()[-2:],
+            "stderr_tail": (proc.stderr or "")[-300:]
+            if proc.returncode else ""}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--timeout", type=float, default=3600)
+    ap.add_argument("--steps", default="lr,rf,xgb,lr+rf,lr+rf+xgb",
+                    help="comma-separated phase combos to try in order; "
+                         "'full' runs the whole workflow sweep")
+    args = ap.parse_args()
+    for combo in args.steps.split(","):
+        print(f"=== {combo} @ {args.rows} rows ===", flush=True)
+        if combo == "full":
+            rec = run_full(args.rows, args.timeout)
+        else:
+            rec = run_phases(combo.split("+"), args.rows, args.timeout)
+        print(json.dumps(rec), flush=True)
+        if rec["outcome"] != "ok":
+            print(f"CRASH ISOLATED AT: {combo}", flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -166,3 +166,60 @@ class TestBenchContract:
         assert "skipped" in last["configs"]["default_grid_1m_x_500"]
         assert "diagnostic" in str(
             last["configs"]["default_grid_1m_x_500"]["skipped"])
+
+
+class TestHeadlineSubprocessParsing:
+    """The real _run_headline_subprocess parse/classify logic (below the
+    _HEADLINE_RUNNER seam) — subprocess.run is faked."""
+
+    def _bench_with_proc(self, tmp_path, monkeypatch, returncode, stdout,
+                         stderr="", timeout_raises=False):
+        import subprocess as sp
+
+        import bench as bench_mod
+        bench = importlib.reload(bench_mod)
+        monkeypatch.setattr(bench, "COST_HISTORY",
+                            str(tmp_path / "ch.json"))
+
+        class FakeProc:
+            def __init__(self):
+                self.returncode = returncode
+                self.stdout = stdout
+                self.stderr = stderr
+
+        def fake_run(cmd, capture_output, text, timeout):
+            assert "--baseline-s" in cmd       # baselines.json wiring
+            if timeout_raises:
+                raise sp.TimeoutExpired(cmd, timeout)
+            return FakeProc()
+
+        monkeypatch.setattr(bench.subprocess if hasattr(bench, "subprocess")
+                            else sp, "run", fake_run)
+        return bench
+
+    def test_success_parses_last_json_line(self, tmp_path, monkeypatch):
+        good = json.dumps({"value": 9.0, "aupr": 0.9})
+        bench = self._bench_with_proc(
+            tmp_path, monkeypatch, 0, f"noise\n{good}\n")
+        d, err = bench._run_headline_subprocess(60)
+        assert err is None and d["value"] == 9.0
+
+    def test_nonzero_rc_records_stderr_tail(self, tmp_path, monkeypatch):
+        bench = self._bench_with_proc(
+            tmp_path, monkeypatch, 1, "", stderr="x" * 600 + "BOOM")
+        d, err = bench._run_headline_subprocess(60)
+        assert d is None and "rc=1" in err["error"]
+        assert "BOOM" in err["error"]
+
+    def test_unparseable_stdout_names_the_parse_failure(self, tmp_path,
+                                                        monkeypatch):
+        bench = self._bench_with_proc(
+            tmp_path, monkeypatch, 0, '{"value": 9.0, "aup')
+        d, err = bench._run_headline_subprocess(60)
+        assert d is None and "failed to parse" in err["error"]
+
+    def test_timeout_is_classified(self, tmp_path, monkeypatch):
+        bench = self._bench_with_proc(
+            tmp_path, monkeypatch, 0, "", timeout_raises=True)
+        d, err = bench._run_headline_subprocess(60)
+        assert d is None and "cap" in err["error"]
