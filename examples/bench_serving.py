@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Serving-path benchmark — throughput and tail latency vs concurrency.
+
+Trains a small model once, persists it, serves it through the full
+``serving/`` stack (registry -> admission -> micro-batcher -> shape-bucketed
+executor), then drives single-row requests at 1/8/64-way concurrency —
+the serving question is precisely how much the micro-batcher wins as
+concurrency grows, since per-dispatch overhead amortizes across coalesced
+requests while the per-request deadline stays bounded.
+
+Emits a BENCH-style JSON record (last stdout line) and writes the same
+summary to ``benchmarks/serving_latest.json`` (or argv[1]) so the serving
+trajectory joins benchmarks/.  Runs on the CPU backend in well under 60 s.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_REQUESTS = 192          # per concurrency level
+CONCURRENCY = (1, 8, 64)
+
+
+def train_and_save(path: str) -> None:
+    import numpy as np
+    import pandas as pd
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, grid)
+
+    rng = np.random.default_rng(7)
+    n = 400
+    age = rng.normal(40, 12, n).round(1)
+    income = rng.lognormal(10, 1, n).round(2)
+    color = rng.choice(["red", "green", "blue"], n)
+    z = 0.08 * (age - 40) + 0.9 * (color == "red") - 0.4
+    label = (1 / (1 + np.exp(-z)) > rng.random(n)).astype(float)
+    df = pd.DataFrame({"label": label, "age": age, "income": income,
+                       "color": color})
+
+    label_f = FeatureBuilder.RealNN("label").as_response()
+    feats = transmogrify([FeatureBuilder.Real("age").as_predictor(),
+                          FeatureBuilder.Currency("income").as_predictor(),
+                          FeatureBuilder.PickList("color").as_predictor()])
+    checked = SanityChecker().set_input(label_f, feats).get_output()
+    selector = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01]))])
+    pred = selector.set_input(label_f, checked).get_output()
+    model = OpWorkflow().set_result_features(pred).set_input_data(df).train()
+    model.save(path)
+
+
+def drive(server, rows, workers: int) -> dict:
+    lat = []
+
+    def one(i):
+        t0 = time.perf_counter()
+        out = server.score([rows[i % len(rows)]])
+        lat.append(time.perf_counter() - t0)
+        return out
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        list(pool.map(one, range(N_REQUESTS)))
+    wall = time.perf_counter() - t0
+    lat.sort()
+
+    def q(p):
+        return lat[min(len(lat) - 1, int(round(p * (len(lat) - 1))))]
+
+    return {
+        "concurrency": workers,
+        "requests": N_REQUESTS,
+        "wall_s": round(wall, 3),
+        "rows_per_s": round(N_REQUESTS / wall, 1),
+        "p50_ms": round(q(0.50) * 1000, 3),
+        "p95_ms": round(q(0.95) * 1000, 3),
+        "p99_ms": round(q(0.99) * 1000, 3),
+    }
+
+
+def run(out_path: str) -> dict:
+    from transmogrifai_tpu.serving import ModelServer
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_path = os.path.join(tmp, "model")
+        t0 = time.perf_counter()
+        train_and_save(model_path)
+        train_s = time.perf_counter() - t0
+
+        import numpy as np  # request rows from the training distribution
+        rng = np.random.default_rng(11)
+        rows = [{"age": float(rng.normal(40, 12)),
+                 "income": float(rng.lognormal(10, 1)),
+                 "color": str(rng.choice(["red", "green", "blue"]))}
+                for _ in range(256)]
+
+        server = ModelServer.from_path(
+            model_path, name="bench", max_batch=64, max_latency_ms=5.0,
+            max_queue_rows=4096, warmup_row=dict(rows[0]))
+        t0 = time.perf_counter()
+        with server:
+            warmup_s = time.perf_counter() - t0
+            levels = [drive(server, rows, c) for c in CONCURRENCY]
+            snap = server.snapshot()
+
+    top = max(levels, key=lambda r: r["rows_per_s"])
+    record = {
+        "metric": "serving_throughput_rows_per_s",
+        "value": top["rows_per_s"],
+        "unit": "rows/s",
+        "p95_ms_at_best": top["p95_ms"],
+        "train_s": round(train_s, 3),
+        "warmup_s": round(warmup_s, 3),
+        "levels": levels,
+        "batches": snap["batches"],
+        "batchSizeHistogram": snap["batchSizeHistogram"],
+        "paddedRows": snap["paddedRows"],
+        "shed": snap["shed"],
+        "hostFallbacks": snap["hostFallbacks"],
+        "compiles": snap["compileCache"]["totals"]["compiles"],
+        "compileHits": snap["compileCache"]["totals"]["hits"],
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+    return record
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "benchmarks", "serving_latest.json")
+    record = run(out_path)
+    for lvl in record["levels"]:
+        print(f"  c={lvl['concurrency']:<3d} {lvl['rows_per_s']:>8.1f} rows/s"
+              f"  p50={lvl['p50_ms']:.1f}ms  p95={lvl['p95_ms']:.1f}ms",
+              file=sys.stderr)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""local/scorer.py contract tests — micro-batch agreement, reserved-key
+expansion, absent-response scoring, edge cases, and DAG memoization.
+
+Reference parity: OpWorkflowModelLocalTest (score-function vs batch-score
+agreement) plus the Prediction reserved-key map of Maps.scala:339-394.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.local import (load_model_local, score_function,
+                                     score_function_batch)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return load_model_local(os.path.join(FIXTURES, "model_v1"))
+
+
+@pytest.fixture(scope="module")
+def rows():
+    df = pd.read_csv(os.path.join(FIXTURES, "model_v1_input.csv"))
+    return df.to_dict("records")
+
+
+class TestScoreFunctionBatch:
+    def test_empty_rows_returns_empty_list(self, model):
+        assert score_function_batch(model)([]) == []
+        assert score_function_batch(model)(iter(())) == []
+
+    def test_non_dict_row_raises_clear_type_error(self, model):
+        with pytest.raises(TypeError, match="row 1 is 'tuple'"):
+            score_function_batch(model)([{"x": 1.0}, (1.0, 2.0)])
+
+    def test_micro_batch_agrees_with_batch_of_one(self, model, rows):
+        batch_fn = score_function_batch(model)
+        one_fn = score_function(model)
+        batched = batch_fn(rows[:16])
+        for row, got in zip(rows[:16], batched):
+            assert got == one_fn(row)
+
+    def test_prediction_reserved_key_expansion(self, model, rows):
+        (result,) = score_function_batch(model)(rows[:1])
+        (pred_map,) = result.values()
+        # binary classifier: prediction + per-class probability_i and
+        # rawPrediction_i (Maps.scala reserved keys)
+        assert "prediction" in pred_map
+        assert {"probability_0", "probability_1"} <= set(pred_map)
+        assert all(isinstance(v, float) for v in pred_map.values())
+        p0, p1 = pred_map["probability_0"], pred_map["probability_1"]
+        assert abs(p0 + p1 - 1.0) < 1e-6
+
+    def test_scores_without_response_present(self, model, rows):
+        batch_fn = score_function_batch(model)
+        with_label = batch_fn(rows[:8])
+        stripped = [{k: v for k, v in r.items() if k != "label"}
+                    for r in rows[:8]]
+        without_label = batch_fn(stripped)
+        assert with_label == without_label
+
+    def test_scores_match_frozen_expectations(self, model, rows):
+        expected = np.load(os.path.join(FIXTURES, "model_v1_expected.npy"))
+        out = score_function_batch(model)(rows)
+        got = np.array([next(iter(r.values()))["probability_1"]
+                        for r in out])
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+class TestScoringDagMemoization:
+    def test_scoring_dag_cached_on_model(self, model):
+        assert model._scoring_dag() is model._scoring_dag()
+
+    def test_invalidate_drops_cache(self, model):
+        dag = model._scoring_dag()
+        model.invalidate_scoring_dag()
+        fresh = model._scoring_dag()
+        assert fresh is not dag
+        assert fresh is model._scoring_dag()
+
+    def test_repeated_score_function_builds_share_dag(self, model):
+        model.invalidate_scoring_dag()
+        score_function_batch(model)
+        dag = model._scoring_dag()
+        score_function(model)
+        assert model._scoring_dag() is dag

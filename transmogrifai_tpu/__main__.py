@@ -1,0 +1,7 @@
+"""``python -m transmogrifai_tpu`` — package-level CLI entrypoint
+(gen/serve subcommands; same dispatch as ``python -m transmogrifai_tpu.cli``)."""
+import sys
+
+from .cli.main import main
+
+sys.exit(main())

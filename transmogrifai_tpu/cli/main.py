@@ -48,7 +48,69 @@ def build_parser() -> argparse.ArgumentParser:
                           "(the reference derives these from --schema)")
     gen.add_argument("--dest", default=".", help="output directory")
     gen.add_argument("--overwrite", action="store_true")
+
+    srv = sub.add_parser(
+        "serve", help="serve a persisted model (micro-batched scoring)")
+    srv.add_argument("--model", required=True,
+                     help="persisted model directory (OpWorkflowModel.save)")
+    srv.add_argument("--name", default="default", help="registry model name")
+    srv.add_argument("--host", default="127.0.0.1")
+    srv.add_argument("--port", type=int, default=8080)
+    srv.add_argument("--max-batch", type=int, default=64,
+                     help="micro-batch row cap (largest shape bucket)")
+    srv.add_argument("--max-latency-ms", type=float, default=5.0,
+                     help="coalescing window before a partial batch runs")
+    srv.add_argument("--max-queue-rows", type=int, default=1024,
+                     help="bounded queue depth; beyond it requests shed 503")
+    srv.add_argument("--deadline-ms", type=float, default=None,
+                     help="default per-request deadline while queued")
+    srv.add_argument("--warmup-json", default=None, metavar="JSON",
+                     help="one raw row as JSON used to pre-compile every "
+                          "shape bucket at startup")
+    srv.add_argument("--score-jsonl", default=None, metavar="FILE",
+                     help="offline mode: score a JSONL file of rows, print "
+                          "one JSON result per line, and exit (no HTTP)")
     return p
+
+
+def _run_serve(args) -> int:
+    import json as _json
+
+    from ..serving import ModelServer, ShedResult
+
+    warmup_row = (_json.loads(args.warmup_json)
+                  if args.warmup_json else None)
+    rows = None
+    if args.score_jsonl:
+        with open(args.score_jsonl) as f:
+            rows = [_json.loads(line) for line in f if line.strip()]
+        if rows and warmup_row is None:
+            warmup_row = dict(rows[0])
+    server = ModelServer.from_path(
+        args.model, name=args.name, max_batch=args.max_batch,
+        max_latency_ms=args.max_latency_ms,
+        max_queue_rows=args.max_queue_rows,
+        default_deadline_ms=args.deadline_ms, warmup_row=warmup_row)
+    if rows is not None:
+        with server:
+            for i in range(0, len(rows), args.max_batch):
+                for res in server.score(rows[i:i + args.max_batch]):
+                    if isinstance(res, ShedResult):
+                        res = res.to_json()
+                    print(_json.dumps(res, default=str))
+            print(_json.dumps(server.snapshot(), default=str),
+                  file=sys.stderr)
+        return 0
+    from ..serving.http import serve_forever
+
+    server.start()
+    print(f"serving {args.name!r} ({args.model}) on "
+          f"http://{args.host}:{args.port} — POST /score, GET /metrics")
+    try:
+        serve_forever(server, args.host, args.port)
+    finally:
+        server.stop()
+    return 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -65,6 +127,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for rel in sorted(written):
             print(f"  {written[rel]}")
         return 0
+    if args.command == "serve":
+        return _run_serve(args)
     return 2
 
 

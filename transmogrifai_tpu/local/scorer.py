@@ -45,6 +45,18 @@ def score_function_batch(model) -> Callable[[Sequence[Dict[str, Any]]],
     result_names = [f.name for f in model.result_features]
 
     def score_batch(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        rows = list(rows)
+        if not rows:
+            # nothing to score: skip dataset construction entirely (stages
+            # may assume non-empty batches) and honor the list-in/list-out
+            # contract
+            return []
+        for i, r in enumerate(rows):
+            if not isinstance(r, dict):
+                raise TypeError(
+                    f"score_function_batch expects dict rows "
+                    f"(raw feature name -> value); row {i} is "
+                    f"{type(r).__name__!r}")
         data = ColumnarDataset()
         for f in raw_feats:
             stage = f.origin_stage

@@ -1,0 +1,178 @@
+"""Online model serving — micro-batched, shape-bucketed, backpressured.
+
+The production inference path the ROADMAP north star asks for: a persisted
+workflow model behind a long-lived server that coalesces concurrent
+requests into padded power-of-2 micro-batches (warm compiled program per
+bucket — zero steady-state recompiles), sheds load with structured 503s
+when the bounded queue fills, and degrades to the numpy host scorer when
+the device path errors.  See docs/serving.md for the architecture and the
+degradation ladder.
+
+    from transmogrifai_tpu.serving import ModelServer
+
+    server = ModelServer.from_path("/models/titanic", name="titanic")
+    with server:                      # warms every shape bucket
+        out = server.score([{"age": 31.0, "sex": "male", ...}])
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+from .admission import AdmissionController, CircuitBreaker, ShedResult
+from .batcher import MicroBatcher
+from .executor import BucketedExecutor, bucket_for, bucket_sizes
+from .metrics import ServingMetrics
+from .registry import ModelEntry, ModelRegistry
+
+__all__ = ["ModelServer", "ModelRegistry", "ModelEntry", "MicroBatcher",
+           "BucketedExecutor", "AdmissionController", "CircuitBreaker",
+           "ShedResult", "ServingMetrics", "bucket_sizes", "bucket_for"]
+
+
+class ModelServer:
+    """Ties registry + batcher + bucketed executor + breaker together.
+
+    One server serves one registry name; the entry (and its executor) is
+    re-resolved per batch, so a registry hot-swap atomically redirects
+    traffic to the new version after its buckets are warmed.
+    """
+
+    def __init__(self, registry: ModelRegistry, name: str,
+                 max_batch: int = 64, max_latency_ms: float = 5.0,
+                 max_queue_rows: int = 1024,
+                 default_deadline_ms: Optional[float] = None,
+                 failure_threshold: int = 3, breaker_reset_s: float = 30.0,
+                 warmup_row: Optional[Dict[str, Any]] = None):
+        self.registry = registry
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.metrics = ServingMetrics()
+        self.admission = AdmissionController(
+            max_queue_rows=max_queue_rows,
+            default_deadline_ms=default_deadline_ms)
+        self.breaker = CircuitBreaker(
+            failure_threshold=failure_threshold,
+            reset_after_s=breaker_reset_s)
+        self.batcher = MicroBatcher(
+            self._execute, max_batch=max_batch,
+            max_latency_ms=max_latency_ms,
+            admission=self.admission, metrics=self.metrics)
+        self.warmup_row = warmup_row
+        self._executors: Dict[int, BucketedExecutor] = {}  # entry version -> executor
+        self._exec_lock = threading.Lock()
+        registry.on_swap(self._on_swap)
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def from_path(cls, path: str, name: str = "default",
+                  registry: Optional[ModelRegistry] = None,
+                  **kwargs) -> "ModelServer":
+        """Load a persisted model directory and build a server around it."""
+        registry = registry or ModelRegistry()
+        server = cls(registry, name, **kwargs)
+        registry.load(name, path)
+        return server
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ModelServer":
+        """Warm every shape bucket for the current model, then accept
+        traffic.  Warmup happens BEFORE the dispatch thread starts so no
+        request can race a cold program."""
+        if self.warmup_row is not None:
+            self._executor_for(self.registry.get(self.name)).warmup(
+                self.warmup_row)
+        self.batcher.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        self.batcher.close(drain=drain)
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- scoring -------------------------------------------------------------
+
+    def submit(self, rows: Sequence[Dict[str, Any]],
+               timeout_ms: Optional[float] = None) -> "Future[List[Any]]":
+        return self.batcher.submit(rows, timeout_ms=timeout_ms)
+
+    def score(self, rows: Sequence[Dict[str, Any]],
+              timeout_ms: Optional[float] = None,
+              wait_s: Optional[float] = 60.0) -> List[Any]:
+        """Synchronous convenience: submit + wait.  Each element is either
+        a score map or a ``ShedResult``."""
+        return self.submit(rows, timeout_ms=timeout_ms).result(timeout=wait_s)
+
+    # -- model lifecycle -----------------------------------------------------
+
+    def swap(self, path: str) -> ModelEntry:
+        """Hot-swap the served model from a persisted directory; buckets of
+        the incoming version are warmed (via the registry swap listener)
+        before the entry becomes current."""
+        return self.registry.load(self.name, path)
+
+    def _on_swap(self, entry: ModelEntry) -> None:
+        if entry.name != self.name:
+            return
+        self.metrics.record_hot_swap()
+        if self.warmup_row is not None:
+            try:
+                self._executor_for(entry).warmup(self.warmup_row)
+            except Exception:
+                pass  # cold buckets compile lazily on first hit instead
+
+    def _executor_for(self, entry: ModelEntry) -> BucketedExecutor:
+        with self._exec_lock:
+            ex = self._executors.get(entry.version)
+            if ex is None:
+                ex = BucketedExecutor(
+                    entry.scorer, max_batch=self.max_batch,
+                    cache_key_prefix=f"serving.{entry.name}.v{entry.version}")
+                self._executors = {entry.version: ex}  # evict stale versions
+            return ex
+
+    # -- execution (called by the batcher's dispatch thread) -----------------
+
+    def _execute(self, rows: List[Dict[str, Any]]) -> List[Any]:
+        entry = self.registry.get(self.name)
+        executor = self._executor_for(entry)
+        bucket = bucket_for(len(rows), executor.buckets) \
+            if len(rows) <= executor.max_batch else executor.max_batch
+        if self.breaker.allow_device():
+            t0 = time.perf_counter()
+            try:
+                out = executor.score(rows)
+                self.breaker.record_success()
+                self.metrics.record_batch(
+                    len(rows), bucket, time.perf_counter() - t0)
+                return out
+            except Exception:
+                self.metrics.record_device_error()
+                if self.breaker.record_failure():
+                    self.metrics.record_breaker_open()
+        # degradation ladder rung 4: numpy host path, exact batch size —
+        # slower, but it answers (the device worker-crash mode must degrade
+        # a replica, not take it down)
+        self.metrics.record_host_fallback(len(rows))
+        t0 = time.perf_counter()
+        out = entry.scorer(rows)
+        self.metrics.record_batch(len(rows), bucket,
+                                  time.perf_counter() - t0)
+        return out
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()
+        snap["model"] = self.registry.get(self.name).describe() \
+            if self.registry.maybe_get(self.name) else None
+        snap["breakerState"] = self.breaker.state
+        return snap

@@ -1,0 +1,197 @@
+"""Admission queue + adaptive micro-batcher.
+
+Concurrent callers submit small row lists; a single dispatch thread
+coalesces them into one micro-batch up to ``max_batch`` rows or until the
+oldest waiting request has waited ``max_latency_ms`` — the classic
+serving trade: a request never waits more than the coalescing deadline,
+and under load batches fill to the cap so per-dispatch overhead (host↔
+device round trip, program launch) amortizes across requests.
+
+The batcher is transport-agnostic: ``execute`` is any
+``rows -> score maps`` callable (the server wires in the circuit-breaker +
+bucketed executor).  Results come back on per-request futures; shed and
+expired requests resolve to ``ShedResult``s, not exceptions.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .admission import AdmissionController, ShedResult
+from .metrics import ServingMetrics
+
+__all__ = ["MicroBatcher"]
+
+
+class _Pending:
+    __slots__ = ("rows", "future", "deadline", "enqueued_at")
+
+    def __init__(self, rows: List[Dict[str, Any]],
+                 deadline: Optional[float]):
+        self.rows = rows
+        self.future: "Future[List[Any]]" = Future()
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+
+
+class MicroBatcher:
+    def __init__(self, execute: Callable[[List[Dict[str, Any]]], List[Any]],
+                 max_batch: int = 64, max_latency_ms: float = 5.0,
+                 admission: Optional[AdmissionController] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self.execute = execute
+        self.max_batch = int(max_batch)
+        self.max_latency_s = float(max_latency_ms) / 1000.0
+        self.admission = admission or AdmissionController()
+        self.metrics = metrics or ServingMetrics()
+        self._queue: List[_Pending] = []
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None or not self._thread.is_alive():
+            self._closed = False
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="op-serving-batcher",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop the dispatch thread; by default drain queued work first."""
+        if drain and self._thread is not None and self._thread.is_alive():
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._queue:
+                        break
+                time.sleep(0.001)
+        with self._work:
+            self._closed = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, rows: Sequence[Dict[str, Any]],
+               timeout_ms: Optional[float] = None) -> "Future[List[Any]]":
+        """Enqueue ``rows`` for coalesced scoring.
+
+        Always returns a future.  Overload resolves it IMMEDIATELY with one
+        ``ShedResult`` per row; otherwise it resolves with the score maps
+        (or ``ShedResult``s if the deadline expires while queued).
+        """
+        rows = list(rows)
+        fut: "Future[List[Any]]" = Future()
+        if not rows:
+            fut.set_result([])
+            return fut
+        if self._closed:
+            fut.set_result([ShedResult(reason="shutting_down")
+                            for _ in rows])
+            self.metrics.record_shed(len(rows))
+            return fut
+        shed = self.admission.try_admit(
+            len(rows), est_drain_ms=self._est_drain_ms())
+        if shed is not None:
+            self.metrics.record_shed(len(rows))
+            fut.set_result([shed for _ in rows])
+            return fut
+        self.metrics.record_admitted(len(rows))
+        pending = _Pending(rows, self.admission.deadline_for(timeout_ms))
+        with self._work:
+            self._queue.append(pending)
+            self.metrics.set_queue_depth(
+                sum(len(p.rows) for p in self._queue))
+            self._work.notify()
+        return pending.future
+
+    def _est_drain_ms(self) -> Optional[float]:
+        """Rough retry-after hint: one coalescing window per queued batch."""
+        with self._lock:
+            queued = sum(len(p.rows) for p in self._queue)
+        if queued == 0:
+            return None
+        batches = (queued + self.max_batch - 1) // self.max_batch
+        return batches * self.max_latency_s * 1000.0
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _take_batch_locked(self) -> List[_Pending]:
+        """Pop requests FIFO until the row budget is hit.  A request is
+        never split across batches (its rows stay one contiguous slice)."""
+        batch: List[_Pending] = []
+        rows = 0
+        while self._queue:
+            nxt = self._queue[0]
+            if batch and rows + len(nxt.rows) > self.max_batch:
+                break
+            batch.append(self._queue.pop(0))
+            rows += len(nxt.rows)
+            if rows >= self.max_batch:
+                break
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._queue and not self._closed:
+                    self._work.wait(timeout=0.1)
+                if self._closed and not self._queue:
+                    return
+                # coalescing window: wait for more arrivals until the
+                # OLDEST request has waited max_latency or the batch fills
+                oldest = self._queue[0].enqueued_at
+                while (sum(len(p.rows) for p in self._queue) < self.max_batch
+                       and not self._closed):
+                    remaining = self.max_latency_s - (time.monotonic() - oldest)
+                    if remaining <= 0:
+                        break
+                    self._work.wait(timeout=remaining)
+                    if not self._queue:
+                        break
+                batch = self._take_batch_locked()
+                self.metrics.set_queue_depth(
+                    sum(len(p.rows) for p in self._queue))
+            if batch:
+                self._run_batch(batch)
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        now = time.monotonic()
+        live: List[_Pending] = []
+        n_released = 0
+        for p in batch:
+            n_released += len(p.rows)
+            if p.deadline is not None and now > p.deadline:
+                self.metrics.record_deadline_expired(len(p.rows))
+                p.future.set_result(
+                    [ShedResult(reason="deadline_expired")
+                     for _ in p.rows])
+            else:
+                live.append(p)
+        self.admission.release(n_released)
+        if not live:
+            return
+        rows: List[Dict[str, Any]] = []
+        for p in live:
+            rows.extend(p.rows)
+        try:
+            results = self.execute(rows)
+        except Exception as exc:  # last-resort: executor+fallback both died
+            for p in live:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        off = 0
+        for p in live:
+            p.future.set_result(results[off:off + len(p.rows)])
+            off += len(p.rows)
+            self.metrics.record_request_latency(
+                time.monotonic() - p.enqueued_at)

@@ -1,0 +1,133 @@
+"""Shape-bucketed batch executor — warm compiled programs, zero steady-state
+recompiles.
+
+Why buckets: every distinct batch size N is a distinct XLA program shape —
+the model kernels under the scoring DAG are jitted on ``(N, D)`` arrays, so
+serving raw request sizes would compile a fresh multi-second program for
+every new N (docs/performance.md).  Padding each micro-batch up to a
+power-of-2 bucket caps the program count at ``log2(max_batch)+1``, all of
+which are compiled ONCE at warmup; after that the device only ever sees
+shapes it has already compiled.
+
+Padding discipline: pad rows are copies of a real row (never synthetic
+zeros — a synthetic row could take host-side code paths a real row never
+takes), and results are sliced back to the true row count before anyone
+sees them, so padding cannot leak into responses.  Scoring is row-wise
+independent (columnar transforms + per-row model predictions), which the
+serving parity test pins: bucketed scores must be byte-identical to the
+unpadded host scorer's.
+
+Accounting: each bucket's first execution records a ``compile`` in
+``utils/compile_cache``; every reuse records a ``hit`` — the counters the
+zero-recompile acceptance test asserts on.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..utils import compile_cache
+
+__all__ = ["BucketedExecutor", "bucket_sizes", "bucket_for"]
+
+
+def bucket_sizes(max_batch: int, min_bucket: int = 1) -> List[int]:
+    """Power-of-2 ladder ``[min_bucket, ..., max_batch]`` (max included
+    even when not a power of 2 — the coalescer's cap must be servable)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out: List[int] = []
+    b = max(1, int(min_bucket))
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (buckets ascending; n <= buckets[-1])."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} rows exceeds max bucket {buckets[-1]}")
+
+
+class BucketedExecutor:
+    """Pads micro-batches to warm shape buckets and runs the score program.
+
+    ``score_fn`` is a ``rows -> score maps`` callable (normally
+    ``local.scorer.score_function_batch(model)``); the executor owns the
+    shape discipline around it.
+    """
+
+    def __init__(self, score_fn: Callable[[List[Dict[str, Any]]],
+                                          List[Dict[str, Any]]],
+                 max_batch: int = 64, min_bucket: int = 1,
+                 cache_key_prefix: str = "serving"):
+        self.score_fn = score_fn
+        self.buckets = bucket_sizes(max_batch, min_bucket)
+        self.max_batch = self.buckets[-1]
+        self.cache_key_prefix = cache_key_prefix
+        self._warm: Dict[int, bool] = {}
+        # best effort: cross-process persistent cache on top of the
+        # in-process warm set (first warmup of a fresh replica reuses the
+        # previous replica's XLA programs where the platform allows it)
+        compile_cache.enable_persistent_cache()
+
+    # -- warmup -------------------------------------------------------------
+
+    def warmup(self, sample_row: Dict[str, Any],
+               buckets: Optional[Sequence[int]] = None) -> Dict[int, float]:
+        """Compile every bucket's program up front by scoring a padded batch
+        of copies of ``sample_row``; returns {bucket: seconds}.
+
+        Done at server start / hot-swap so no live request ever pays a
+        compile.  Warming largest-first would also work; smallest-first
+        keeps time-to-first-servable-bucket minimal.
+        """
+        timings: Dict[int, float] = {}
+        for b in (buckets if buckets is not None else self.buckets):
+            t0 = time.perf_counter()
+            self._run_bucket([dict(sample_row)] * b, b)
+            timings[b] = time.perf_counter() - t0
+        return timings
+
+    @property
+    def warm_buckets(self) -> List[int]:
+        return sorted(self._warm)
+
+    # -- execution ----------------------------------------------------------
+
+    def _cache_key(self, bucket: int) -> str:
+        return f"{self.cache_key_prefix}.bucket{bucket}"
+
+    def _run_bucket(self, padded_rows: List[Dict[str, Any]],
+                    bucket: int) -> List[Dict[str, Any]]:
+        first = bucket not in self._warm
+        out = self.score_fn(padded_rows)
+        # count only AFTER success: a failed first execution must stay a
+        # cold bucket (and must not skew the zero-recompile assertion)
+        if first:
+            self._warm[bucket] = True
+            compile_cache.record_compile(self._cache_key(bucket))
+        else:
+            compile_cache.record_hit(self._cache_key(bucket))
+        return out
+
+    def score(self, rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        """Score ``rows`` (1..max_batch of them) through the bucketed path."""
+        rows = list(rows)
+        n = len(rows)
+        if n == 0:
+            return []
+        if n > self.max_batch:
+            # callers (the micro-batcher) never exceed max_batch; a direct
+            # caller gets chunking rather than an error
+            out: List[Dict[str, Any]] = []
+            for i in range(0, n, self.max_batch):
+                out.extend(self.score(rows[i:i + self.max_batch]))
+            return out
+        bucket = bucket_for(n, self.buckets)
+        padded = rows + [dict(rows[-1]) for _ in range(bucket - n)]
+        return self._run_bucket(padded, bucket)[:n]

@@ -1,0 +1,125 @@
+"""Minimal stdlib HTTP front end for a ``ModelServer``.
+
+No framework dependency (the container bakes none in): a threaded
+``http.server`` is plenty, because every request handler thread just parks
+on a batcher future while the single dispatch thread does the real work —
+the concurrency model IS the micro-batcher, not the HTTP layer.
+
+Endpoints:
+  POST /score    {"rows": [{...}, ...], "timeoutMs": 50}  -> {"scores": [...]}
+                 (rows shed by backpressure come back as their ShedResult
+                 JSON and flip the response to 503)
+  GET  /metrics  serving metrics snapshot (queue depth, batch histogram,
+                 latency quantiles, shed/fallback counts, compile counters)
+  GET  /healthz  {"status": "ok", "model": {...}}
+  POST /swap     {"path": "/models/titanic_v2"}           -> new entry info
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+from .admission import ShedResult
+
+__all__ = ["make_http_server", "serve_forever"]
+
+
+def _jsonable_scores(results) -> Tuple[list, bool]:
+    out, any_shed = [], False
+    for r in results:
+        if isinstance(r, ShedResult):
+            out.append(r.to_json())
+            any_shed = True
+        else:
+            out.append(r)
+    return out, any_shed
+
+
+def make_http_server(server, host: str = "127.0.0.1",
+                     port: int = 8080) -> ThreadingHTTPServer:
+    """Build (not start) an HTTP server wrapping ``ModelServer`` ``server``."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _reply(self, code: int, payload: Any) -> None:
+            body = json.dumps(payload, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> Optional[Any]:
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                return None
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                entry = server.registry.maybe_get(server.name)
+                self._reply(200 if entry else 503, {
+                    "status": "ok" if entry else "no_model",
+                    "model": entry.describe() if entry else None,
+                    "breakerState": server.breaker.state,
+                })
+            elif self.path == "/metrics":
+                self._reply(200, server.snapshot())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            doc = self._read_json()
+            if doc is None or not isinstance(doc, dict):
+                return self._reply(400, {"error": "invalid JSON body"})
+            if self.path == "/score":
+                rows = doc.get("rows")
+                if not isinstance(rows, list):
+                    return self._reply(
+                        400, {"error": "body must be {'rows': [...]}"})
+                try:
+                    results = server.score(
+                        rows, timeout_ms=doc.get("timeoutMs"))
+                except TypeError as exc:  # non-dict rows etc.
+                    return self._reply(400, {"error": str(exc)})
+                scores, any_shed = _jsonable_scores(results)
+                self._reply(503 if any_shed else 200, {"scores": scores})
+            elif self.path == "/swap":
+                path = doc.get("path")
+                if not path:
+                    return self._reply(
+                        400, {"error": "body must be {'path': ...}"})
+                try:
+                    entry = server.swap(path)
+                except Exception as exc:
+                    return self._reply(500, {"error": str(exc)})
+                self._reply(200, {"swapped": entry.describe()})
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_forever(server, host: str = "127.0.0.1", port: int = 8080,
+                  background: bool = False):
+    """Start serving HTTP; returns the httpd (after start when background)."""
+    httpd = make_http_server(server, host, port)
+    if background:
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="op-serving-http", daemon=True)
+        t.start()
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        httpd.server_close()
+    return httpd

@@ -1,0 +1,167 @@
+"""Serving metrics — queue depth, batch-size histogram, latency quantiles.
+
+The serving-side analogue of ``utils/profiling.py``'s per-run
+``MetricsCollector``: a long-lived server has no "run end", so metrics are
+a live snapshot API instead of an application-end handler.  Wall-clock per
+executed batch is still attributed through the existing profiling hooks
+(``OpStep.Serving`` into the thread-current collector, ``count_launch``
+into the global ``RunCounters``) so serving time shows up in the same
+ledgers as training/scoring time.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.profiling import (MetricsCollector, OpStep, count_launch,
+                               current_collector)
+
+__all__ = ["LatencyReservoir", "ServingMetrics"]
+
+
+class LatencyReservoir:
+    """Fixed-capacity ring of recent latency observations (seconds).
+
+    Quantiles are computed over the retained window — recent behavior, not
+    process-lifetime behavior, which is what an operator watching p95 wants
+    from a long-lived server.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._ring: List[float] = []
+        self._pos = 0
+        self.count = 0
+
+    def observe(self, seconds: float) -> None:
+        if len(self._ring) < self.capacity:
+            self._ring.append(seconds)
+        else:
+            self._ring[self._pos] = seconds
+            self._pos = (self._pos + 1) % self.capacity
+        self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self._ring:
+            return None
+        vals = sorted(self._ring)
+        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+        return vals[idx]
+
+
+class ServingMetrics:
+    """Thread-safe counters + histograms for one model server.
+
+    Everything an operator needs to see the degradation ladder working:
+    how deep the queue is, what batch sizes the coalescer actually forms,
+    how much padding the bucketer adds, end-to-end latency quantiles, and
+    how many requests were shed / deadline-expired / degraded to the host
+    path.
+    """
+
+    def __init__(self, reservoir_capacity: int = 4096,
+                 collector: Optional[MetricsCollector] = None):
+        self._lock = threading.Lock()
+        self._latency = LatencyReservoir(reservoir_capacity)
+        self._batch_hist: Dict[int, int] = {}
+        self.collector = collector
+        self.started_at = time.time()
+        self.queue_depth = 0
+        self.queue_depth_peak = 0
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.padded_rows = 0
+        self.shed = 0
+        self.deadline_expired = 0
+        self.device_errors = 0
+        self.host_fallbacks = 0
+        self.breaker_opens = 0
+        self.hot_swaps = 0
+
+    # -- recording ----------------------------------------------------------
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+            self.queue_depth_peak = max(self.queue_depth_peak, depth)
+
+    def record_admitted(self, n_rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += n_rows
+
+    def record_batch(self, n_rows: int, bucket: int, seconds: float) -> None:
+        """One executed micro-batch: ``n_rows`` real rows padded to
+        ``bucket``."""
+        with self._lock:
+            self.batches += 1
+            self.padded_rows += max(0, bucket - n_rows)
+            self._batch_hist[bucket] = self._batch_hist.get(bucket, 0) + 1
+        coll = self.collector or current_collector()
+        if coll is not None:
+            coll.record(OpStep.Serving, seconds)
+        count_launch("serving.batch")
+
+    def record_request_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latency.observe(seconds)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.shed += n
+
+    def record_deadline_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_expired += n
+
+    def record_device_error(self) -> None:
+        with self._lock:
+            self.device_errors += 1
+
+    def record_host_fallback(self, n_rows: int = 0) -> None:
+        with self._lock:
+            self.host_fallbacks += 1
+
+    def record_breaker_open(self) -> None:
+        with self._lock:
+            self.breaker_opens += 1
+
+    def record_hot_swap(self) -> None:
+        with self._lock:
+            self.hot_swaps += 1
+
+    # -- reading ------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time JSON-able view (the /metrics payload)."""
+        from ..utils.compile_cache import cache_stats
+
+        with self._lock:
+            lat_ms = {
+                f"p{int(q * 100)}": (None if v is None
+                                     else round(v * 1000.0, 3))
+                for q, v in ((q, self._latency.quantile(q))
+                             for q in (0.50, 0.95, 0.99))
+            }
+            snap = {
+                "uptimeSecs": round(time.time() - self.started_at, 3),
+                "queueDepth": self.queue_depth,
+                "queueDepthPeak": self.queue_depth_peak,
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "paddedRows": self.padded_rows,
+                "batchSizeHistogram": dict(sorted(self._batch_hist.items())),
+                "latencyMs": lat_ms,
+                "latencyObservations": self._latency.count,
+                "shed": self.shed,
+                "deadlineExpired": self.deadline_expired,
+                "deviceErrors": self.device_errors,
+                "hostFallbacks": self.host_fallbacks,
+                "breakerOpens": self.breaker_opens,
+                "hotSwaps": self.hot_swaps,
+            }
+        snap["compileCache"] = cache_stats()
+        return snap

@@ -1,0 +1,128 @@
+"""Model registry — versioned, hot-swappable persisted workflow models.
+
+Loads models through ``workflow/persistence.py`` (the same artifact a
+training run saves), builds the host score function ONCE per load (the
+scoring DAG is memoized on the model, so registry reloads never redo DAG
+construction), and exposes an atomic get/swap surface: scoring threads
+resolve a ``ModelEntry`` by name and keep using that immutable entry for
+the whole batch even while a newer version is being swapped in — no lock
+is held across scoring.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ModelEntry", "ModelRegistry"]
+
+
+class ModelEntry:
+    """One immutable (name, version) of a servable model."""
+
+    def __init__(self, name: str, version: int, model: Any,
+                 path: Optional[str] = None):
+        from ..local.scorer import score_function_batch
+
+        self.name = name
+        self.version = version
+        self.model = model
+        self.path = path
+        self.loaded_at = time.time()
+        #: host score function (rows -> score maps); built once per entry
+        self.scorer = score_function_batch(model)
+        self.result_features = [f.name for f in model.result_features]
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "path": self.path,
+            "loadedAt": self.loaded_at,
+            "resultFeatures": list(self.result_features),
+        }
+
+    def __repr__(self):
+        return f"ModelEntry({self.name!r} v{self.version})"
+
+
+class ModelRegistry:
+    """Thread-safe name -> ModelEntry map with versioned atomic swaps."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, ModelEntry] = {}
+        self._versions: Dict[str, int] = {}
+        self._swap_listeners: List[Callable[[ModelEntry], None]] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def load(self, name: str, path: str) -> ModelEntry:
+        """Load (or hot-swap) ``name`` from a persisted model directory.
+
+        The expensive work — artifact parse, stage reconstruction, scoring
+        DAG + score-function build — happens OUTSIDE the lock; only the
+        final dict swap is locked, so in-flight scoring against the old
+        entry is never blocked and either sees the old version or the new
+        one, never a half-built state.
+        """
+        from ..workflow.persistence import load_workflow_model
+
+        model = load_workflow_model(path)
+        return self.register(name, model, path=path)
+
+    def register(self, name: str, model: Any,
+                 path: Optional[str] = None) -> ModelEntry:
+        """Register an in-memory model (tests / freshly-trained hot swaps)."""
+        with self._lock:
+            version = self._versions.get(name, 0) + 1
+            self._versions[name] = version
+        # expensive: scoring DAG + score-function build (no lock held)
+        entry = ModelEntry(name, version, model, path=path)
+        with self._lock:
+            current = self._entries.get(name)
+            if current is not None and current.version > entry.version:
+                # a concurrent newer load finished first; keep it
+                return current
+            swapped = current is not None
+            self._entries[name] = entry
+            listeners = list(self._swap_listeners)
+        if swapped:
+            for fn in listeners:
+                try:
+                    fn(entry)
+                except Exception:  # listeners must not break the swap
+                    pass
+        return entry
+
+    def evict(self, name: str) -> bool:
+        """Drop ``name`` from the registry; in-flight batches holding the
+        entry finish unaffected.  Returns True if something was evicted."""
+        with self._lock:
+            return self._entries.pop(name, None) is not None
+
+    # -- resolution ---------------------------------------------------------
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(
+                f"no model {name!r} in registry "
+                f"(have: {sorted(self._entries) or 'none'})")
+        return entry
+
+    def maybe_get(self, name: str) -> Optional[ModelEntry]:
+        with self._lock:
+            return self._entries.get(name)
+
+    def models(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            entries = list(self._entries.values())
+        return [e.describe() for e in entries]
+
+    def on_swap(self, fn: Callable[[ModelEntry], None]) -> None:
+        """Register a hot-swap listener (the server re-warms shape buckets
+        for the incoming version before routing traffic to it)."""
+        with self._lock:
+            self._swap_listeners.append(fn)

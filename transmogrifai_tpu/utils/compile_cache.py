@@ -18,9 +18,11 @@ no equivalent (the JVM JITs per process); this is TPU-specific plumbing.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import threading
+from typing import Dict, Optional
 
-__all__ = ["enable_persistent_cache"]
+__all__ = ["enable_persistent_cache", "record_compile", "record_hit",
+           "cache_stats", "reset_cache_stats"]
 
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
@@ -93,3 +95,52 @@ def enable_persistent_cache(cache_dir: Optional[str] = None,
     except Exception:  # pragma: no cover - cache is an optimization only
         return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# in-process compile accounting
+# ---------------------------------------------------------------------------
+#
+# The persistent cache above removes *cross-process* recompiles; serving
+# additionally needs to PROVE that its steady state never compiles at all
+# (docs/performance.md: a cold XLA compile is multi-second — two orders of
+# magnitude over a serving deadline).  These counters are the ledger: every
+# warm-program site (the serving executor's shape buckets) records a
+# ``compile`` when it builds/first-executes a program for a key and a
+# ``hit`` when it reuses one, so tests can assert "N requests, zero new
+# compiles after warmup" instead of trusting timing.
+
+_stats_lock = threading.Lock()
+_compiles: Dict[str, int] = {}
+_hits: Dict[str, int] = {}
+
+
+def record_compile(key: str, n: int = 1) -> None:
+    """Count a program build (first execution at a new shape) for ``key``."""
+    with _stats_lock:
+        _compiles[key] = _compiles.get(key, 0) + n
+
+
+def record_hit(key: str, n: int = 1) -> None:
+    """Count a warm reuse of the already-compiled program for ``key``."""
+    with _stats_lock:
+        _hits[key] = _hits.get(key, 0) + n
+
+
+def cache_stats() -> Dict[str, Dict[str, int]]:
+    """Snapshot: {'compiles': {key: n}, 'hits': {key: n}, 'totals': ...}."""
+    with _stats_lock:
+        compiles = dict(_compiles)
+        hits = dict(_hits)
+    return {
+        "compiles": compiles,
+        "hits": hits,
+        "totals": {"compiles": sum(compiles.values()),
+                   "hits": sum(hits.values())},
+    }
+
+
+def reset_cache_stats() -> None:
+    with _stats_lock:
+        _compiles.clear()
+        _hits.clear()

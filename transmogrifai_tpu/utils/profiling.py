@@ -37,6 +37,7 @@ class OpStep(enum.Enum):
     Other = "Other"
     ResultsSaving = "Results saving"
     Scoring = "Scoring"  # TPU addition: batched/streaming score phases
+    Serving = "Serving"  # TPU addition: online micro-batch serving (serving/)
 
 
 @dataclass

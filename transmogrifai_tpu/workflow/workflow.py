@@ -274,12 +274,24 @@ class OpWorkflowModel(_WorkflowCore):
         self.stages = list(stages)
         self.train_data = train_data
         self.raw_feature_filter_results = None
+        self._scoring_dag_memo: Optional[StagesDAG] = None
 
     def _scoring_dag(self) -> StagesDAG:
-        # rebuild feature DAG over fitted stages (copyWithNewStages parity)
-        stage_map = {s.uid: s for s in self.stages}
-        feats = [f.copy_with_new_stages(stage_map) for f in self.result_features]
-        return compute_dag(feats)
+        # rebuild feature DAG over fitted stages (copyWithNewStages parity);
+        # memoized: the stage list is fixed after construction, and callers
+        # (score_function per call site, save, serving-registry hot-swaps)
+        # would otherwise redo DAG construction per call
+        if self._scoring_dag_memo is None:
+            stage_map = {s.uid: s for s in self.stages}
+            feats = [f.copy_with_new_stages(stage_map)
+                     for f in self.result_features]
+            self._scoring_dag_memo = compute_dag(feats)
+        return self._scoring_dag_memo
+
+    def invalidate_scoring_dag(self) -> None:
+        """Drop the memoized scoring DAG (only needed if ``stages`` is
+        mutated in place after construction)."""
+        self._scoring_dag_memo = None
 
     def score(self, data=None,
               keep_raw_features: bool = False,
