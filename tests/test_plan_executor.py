@@ -1,0 +1,302 @@
+"""Execution-plan engine specs (workflow/plan.py).
+
+The three tentpole guarantees: (1) layer-parallel execution is
+byte-identical to the sequential pre-plan executor, (2) liveness pruning
+strictly reduces the peak resident column count during train, (3)
+``transform`` is copy-on-write — untouched column buffers share identity
+across a transform and the input dataset is never mutated.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.models.prediction import PredictionBatch
+from transmogrifai_tpu.ops.dsl_transformers import MathScalarTransformer
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.profiling import PlanProfiler
+from transmogrifai_tpu.workflow import plan as plan_mod
+from transmogrifai_tpu.workflow.dag import (compute_dag, fit_and_transform_dag,
+                                            transform_dag)
+from transmogrifai_tpu.workflow.plan import plan_for
+
+
+def _mixed_dataset(n=64, seed=3):
+    rng = np.random.default_rng(seed)
+    return TestFeatureBuilder.build(
+        ("y", ft.RealNN, (rng.random(n) > 0.5).astype(float).tolist()),
+        ("x1", ft.Real, np.where(rng.random(n) < 0.1, np.nan,
+                                 rng.normal(size=n)).tolist()),
+        ("x2", ft.Real, rng.normal(size=n).tolist()),
+        ("i", ft.Integral, rng.integers(0, 9, n).tolist()),
+        ("b", ft.Binary, (rng.random(n) > 0.4).tolist()),
+        ("p", ft.PickList, [["a", "b", "c", None][j % 4] for j in range(n)]),
+        ("t", ft.Text, [f"w{j % 7} tok{j % 3} common" for j in range(n)]),
+        response="y")
+
+
+def _prediction_dag(feats):
+    y = feats[0]
+    vec = transmogrify(feats[1:], top_k=4, min_support=1,
+                       num_hash_features=16)
+    checked = SanityChecker(max_correlation=0.999, min_variance=1e-9
+                            ).set_input(y, vec).get_output()
+    pred = OpLogisticRegression().set_input(y, checked).get_output()
+    return pred, checked
+
+
+def _assert_col_identical(c1, c2, label):
+    v1, v2 = c1.values, c2.values
+    if isinstance(v1, PredictionBatch):
+        assert isinstance(v2, PredictionBatch), label
+        for attr in ("prediction", "raw_prediction", "probability"):
+            a1, a2 = getattr(v1, attr), getattr(v2, attr)
+            assert (a1 is None) == (a2 is None), (label, attr)
+            if a1 is not None:
+                assert np.asarray(a1).tobytes() == np.asarray(a2).tobytes(), \
+                    (label, attr)
+    else:
+        a1, a2 = np.asarray(v1), np.asarray(v2)
+        assert a1.shape == a2.shape, label
+        if a1.dtype == object or a2.dtype == object:
+            for r1, r2 in zip(a1, a2):
+                assert r1 == r2 or (r1 is None and r2 is None), (label, r1, r2)
+        else:
+            # byte-identical, not merely allclose
+            assert a1.tobytes() == a2.tobytes(), label
+    m1 = None if c1.mask is None else np.asarray(c1.mask)
+    m2 = None if c2.mask is None else np.asarray(c2.mask)
+    assert (m1 is None) == (m2 is None), label
+    if m1 is not None:
+        assert m1.tobytes() == m2.tobytes(), label
+
+
+class TestDeterminism:
+    def test_layer_parallel_byte_identical_to_sequential(self, monkeypatch):
+        # force the thread pool on even for tiny layers/rows/1-core hosts
+        monkeypatch.setattr(plan_mod, "_PARALLEL_ROW_THRESHOLD", 0)
+        monkeypatch.setattr(plan_mod, "_POOL_AVAILABLE", True)
+        data, feats = _mixed_dataset()
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+
+        f_seq, d_seq, _ = fit_and_transform_dag(dag, data.copy(),
+                                                sequential=True)
+        f_par, d_par, _ = fit_and_transform_dag(dag, data.copy())
+
+        assert [s.uid for s in f_seq] == [s.uid for s in f_par]
+        assert set(d_seq.names()) == set(d_par.names())
+        for name in d_seq.names():
+            _assert_col_identical(d_seq[name], d_par[name], name)
+
+    def test_pruned_run_matches_on_kept_columns(self, monkeypatch):
+        monkeypatch.setattr(plan_mod, "_PARALLEL_ROW_THRESHOLD", 0)
+        monkeypatch.setattr(plan_mod, "_POOL_AVAILABLE", True)
+        data, feats = _mixed_dataset()
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+        keep = [pred.name, checked.name, "y"]
+
+        _, d_seq, _ = fit_and_transform_dag(dag, data.copy(),
+                                            sequential=True)
+        _, d_kept, _ = fit_and_transform_dag(dag, data.copy(), keep=keep)
+
+        assert set(d_kept.names()) == set(keep)
+        for name in keep:
+            _assert_col_identical(d_seq[name], d_kept[name], name)
+
+    def test_apply_to_lazy_pass_matches_eager(self, monkeypatch):
+        monkeypatch.setattr(plan_mod, "_PARALLEL_ROW_THRESHOLD", 0)
+        monkeypatch.setattr(plan_mod, "_POOL_AVAILABLE", True)
+        data, feats = _mixed_dataset(n=80)
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+        idx_tr = np.arange(0, 60)
+        idx_ev = np.arange(60, 80)
+        train, holdout = data.take(idx_tr), data.take(idx_ev)
+        keep = [pred.name, checked.name, "y"]
+
+        _, _, ev_seq = fit_and_transform_dag(
+            dag, train.copy(), apply_to=holdout.copy(), sequential=True)
+        _, _, ev_lazy = fit_and_transform_dag(
+            dag, train.copy(), apply_to=holdout.copy(), keep=keep)
+
+        for name in keep:
+            _assert_col_identical(ev_seq[name], ev_lazy[name], name)
+
+
+class TestLiveness:
+    def test_peak_resident_columns_strictly_below_baseline(self):
+        data, feats = _mixed_dataset()
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+
+        _, d_seq, _ = fit_and_transform_dag(dag, data.copy(),
+                                            sequential=True)
+        baseline_peak = len(d_seq.columns)  # accumulates every intermediate
+
+        prof = PlanProfiler()
+        _, d_kept, _ = fit_and_transform_dag(
+            dag, data.copy(), keep=[pred.name, "y"], profiler=prof)
+        assert prof.peak_columns > 0
+        assert prof.peak_columns < baseline_peak
+        assert len(d_kept.columns) <= prof.peak_columns
+
+    def test_drops_never_touch_unknown_columns(self):
+        from transmogrifai_tpu.types.columns import FeatureColumn
+
+        data, feats = _mixed_dataset()
+        data.set("key", FeatureColumn.from_values(
+            ft.ID, [str(i) for i in range(len(data))]))
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+        _, out, _ = fit_and_transform_dag(dag, data, keep=[pred.name])
+        assert "key" in out  # plan-unknown columns survive pruning
+
+    def test_required_input_columns(self):
+        data, feats = _mixed_dataset()
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+        req = plan_for(dag, keep=[pred.name]).required_input_columns()
+        # every raw predictor + the response are read by some stage
+        for name in ("y", "x1", "x2", "i", "b", "p", "t"):
+            assert name in req
+        assert "key" not in req
+
+
+class TestCopyOnWrite:
+    def test_untouched_buffers_share_identity_across_transform(self):
+        data, feats = _mixed_dataset()
+        stage = MathScalarTransformer(op="multiply", scalar=2.0)
+        stage.set_input(feats[1])  # x1
+        out = stage.transform(data)
+
+        assert out is not data
+        out_name = stage.get_output().name
+        assert out_name in out and out_name not in data  # input not mutated
+        for name in data.names():
+            assert out[name] is data[name]               # column identity
+            assert out[name].values is data[name].values  # buffer identity
+
+    def test_select_and_drop_share_buffers(self):
+        data, _ = _mixed_dataset()
+        sel = data.select(["x1", "x2"])
+        assert sel["x1"] is data["x1"]
+        dropped = data.drop(["x1"])
+        assert "x1" not in dropped and "x1" in data
+        assert dropped["x2"] is data["x2"]
+
+
+class TestPlanReuseAndExplain:
+    def test_plan_memoized_per_dag_and_keep(self):
+        data, feats = _mixed_dataset()
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+        p1 = plan_for(dag, keep=[pred.name])
+        p2 = plan_for(dag, keep=[pred.name])
+        p3 = plan_for(dag)
+        assert p1 is p2
+        assert p3 is not p1
+
+    def test_explain_reports_layers_and_drops(self):
+        data, feats = _mixed_dataset()
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+        text = plan_for(dag, keep=[pred.name, "y"]).explain()
+        assert "ExecutionPlan" in text
+        assert "layer 0" in text
+        assert "drop after layer" in text
+        assert "projected resident columns" in text
+        # no pruning without a keep-set: no drops announced
+        text_all = plan_for(dag).explain()
+        assert "drop after layer" not in text_all
+
+    def test_transform_dag_scoring_uses_pruned_plan(self):
+        data, feats = _mixed_dataset()
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([pred])
+        fitted, _, _ = fit_and_transform_dag(dag, data.copy())
+        stage_map = {s.uid: s for s in fitted}
+        feats_scoring = [pred.copy_with_new_stages(stage_map)]
+        sdag = compute_dag(feats_scoring)
+
+        full = transform_dag(sdag, data.copy())
+        pruned = transform_dag(sdag, data.copy(), keep=[pred.name])
+        assert pred.name in pruned
+        assert len(pruned.columns) < len(full.columns)
+        _assert_col_identical(full[pred.name], pruned[pred.name], pred.name)
+
+
+class TestFoldRefitPlanDriven:
+    def test_fold_matrices_match_pre_plan_executor(self, monkeypatch):
+        from transmogrifai_tpu.selector.validators import OpCrossValidation
+        from transmogrifai_tpu.workflow.dag import SEQUENTIAL_EXECUTOR_ENV
+
+        data, feats = _mixed_dataset(n=90)
+        pred, checked = _prediction_dag(feats)
+        dag = compute_dag([checked])
+        tr_idx = np.arange(0, 60)
+        ev_idx = np.arange(60, 90)
+
+        monkeypatch.setenv(SEQUENTIAL_EXECUTOR_ENV, "1")
+        ref = OpCrossValidation._fold_matrices(
+            data, dag, "y", checked.name, tr_idx, ev_idx)
+        monkeypatch.delenv(SEQUENTIAL_EXECUTOR_ENV)
+        got = OpCrossValidation._fold_matrices(
+            data, dag, "y", checked.name, tr_idx, ev_idx)
+        for a, b, label in zip(ref, got, ("X_tr", "y_tr", "X_ev", "y_ev")):
+            assert a.tobytes() == b.tobytes(), label
+
+
+class TestTrainProfile:
+    def _df(self, n=120, seed=5):
+        import pandas as pd
+
+        rng = np.random.default_rng(seed)
+        return pd.DataFrame({
+            "label": (rng.random(n) > 0.5).astype(float),
+            "a": rng.normal(size=n),
+            "c": [["u", "v", "w"][j % 3] for j in range(n)],
+        })
+
+    def _workflow(self, df):
+        label = FeatureBuilder.RealNN("label").as_response()
+        a = FeatureBuilder.Real("a").as_predictor()
+        c = FeatureBuilder.PickList("c").as_predictor()
+        vec = transmogrify([a, c], top_k=3, min_support=1)
+        pred = OpLogisticRegression().set_input(label, vec).get_output()
+        return (OpWorkflow().set_result_features(pred)
+                .set_input_data(df)), pred
+
+    def test_train_profile_records_stages_and_peak(self):
+        wf, pred = self._workflow(self._df())
+        model = wf.train(profile=True)
+        prof = model.train_profile
+        assert prof is not None
+        j = prof.to_json()
+        assert j["peakColumns"] > 0
+        assert len(j["stages"]) >= 3
+        kinds = {s["kind"] for s in j["stages"]}
+        assert "fit" in kinds
+        assert "plan execution" in prof.format()
+
+    def test_train_without_profile_is_default(self):
+        wf, pred = self._workflow(self._df())
+        model = wf.train()
+        assert model.train_profile is None
+        # liveness pruning applied: train_data holds the keep-set only,
+        # not every intermediate
+        assert pred.name in model.train_data
+        assert "label" in model.train_data
+
+    def test_scoring_parity_after_pruned_train(self):
+        df = self._df()
+        wf, pred = self._workflow(df)
+        model = wf.train()
+        scored = model.score()
+        pb = scored[pred.name].values
+        assert isinstance(pb, PredictionBatch)
+        assert len(scored) == len(df)

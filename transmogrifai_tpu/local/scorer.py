@@ -67,7 +67,11 @@ def score_function_batch(model) -> Callable[[Sequence[Dict[str, Any]]],
                 vals = [r.get(f.name) if isinstance(r, dict) else None
                         for r in rows]
                 data.set(f.name, FeatureColumn.from_values(f.ftype, vals))
-        scored = transform_dag(dag, data)
+        # keep only the result columns alive: the memoized plan prunes every
+        # intermediate as soon as its last consumer stage has run (serving
+        # micro-batches score thousands of times per model, so the pruned
+        # plan is derived once and shared)
+        scored = transform_dag(dag, data, keep=result_names)
         out: List[Dict[str, Any]] = [dict() for _ in rows]
         for name in result_names:
             if name not in scored:

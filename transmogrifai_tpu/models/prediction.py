@@ -71,6 +71,10 @@ def prediction_column(prediction, raw_prediction=None, probability=None) -> Feat
 class PredictorEstimator(BinaryEstimator):
     """Base for model estimators: inputs (response RealNN, features OPVector)."""
 
+    # model fits dispatch XLA programs: the execution plan (workflow/plan.py)
+    # serializes these in stable layer order instead of pooling them
+    device_heavy = True
+
     def __init__(self, operation_name: str, uid: Optional[str] = None):
         super().__init__(operation_name=operation_name, output_type=Prediction,
                          uid=uid)
@@ -100,6 +104,8 @@ class PredictorEstimator(BinaryEstimator):
 
 class PredictorModel(BinaryModel):
     """Base for fitted predictors; subclasses implement predict(X)."""
+
+    device_heavy = True  # batch predicts are jitted device programs
 
     def __init__(self, operation_name: str, uid: Optional[str] = None):
         super().__init__(operation_name=operation_name, output_type=Prediction,

@@ -68,8 +68,23 @@ class SanityCheckerSummary:
         }
 
 
+def _matrix_f32(values) -> np.ndarray:
+    """The feature matrix as float32 WITHOUT re-packing when the upstream
+    vectorizer already produced a float32 ndarray (VectorsCombiner emits
+    C-contiguous float32); everything else (float64, device arrays, lists)
+    still converts.  Callers must treat the result as read-only — it may
+    alias the live column buffer."""
+    if isinstance(values, np.ndarray) and values.dtype == np.float32:
+        return values
+    return np.asarray(values, dtype=np.float32)
+
+
 class SanityChecker(BinaryEstimator):
     """Inputs: (label RealNN, features OPVector) -> cleaned OPVector."""
+
+    # the stats pass is a big BLAS/XLA program; the execution plan
+    # (workflow/plan.py) runs it serially, not on the host stage pool
+    device_heavy = True
 
     def __init__(self,
                  check_sample: float = 1.0,
@@ -110,7 +125,7 @@ class SanityChecker(BinaryEstimator):
 
     def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
                     features_col: FeatureColumn):
-        X = np.asarray(features_col.values, dtype=np.float32)
+        X = _matrix_f32(features_col.values)
         y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
         n, d = X.shape
         if self.check_sample < 1.0:
@@ -283,7 +298,7 @@ class MinVarianceFilter(BinaryEstimator):
 
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         features_col = cols[-1]
-        X = np.asarray(features_col.values, dtype=np.float32)
+        X = _matrix_f32(features_col.values)
         variance = np.asarray(col_stats(X).variance)
         keep = [j for j in range(X.shape[1])
                 if variance[j] >= self.min_variance]

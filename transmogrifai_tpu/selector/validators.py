@@ -104,13 +104,35 @@ class _ValidatorBase:
     def _fold_matrices(data, during_dag, label_name, features_name,
                        tr_idx: np.ndarray, ev_idx: np.ndarray):
         """Refit during_dag on the fold's train rows, apply to eval rows,
-        and extract the (X, y) matrices for both sides."""
-        from ..workflow.dag import fit_and_transform_dag
+        and extract the (X, y) matrices for both sides.
 
-        train_ds = data.take(tr_idx)
-        eval_ds = data.take(ev_idx)
-        _, train_t, eval_t = fit_and_transform_dag(
-            during_dag, train_ds, apply_to=eval_ds)
+        The keep-set names exactly what this function reads afterwards, so
+        the DAG's memoized ExecutionPlan (derived once, reused by every
+        fold — plan_for caches on the dag object) liveness-prunes all other
+        intermediates per fold, and the eval side rides the lazy
+        plan-driven ``apply_to`` pass.  The per-fold row gather is also
+        plan-bounded: only columns the during-DAG actually reads are
+        ``take``-copied, instead of fancy-indexing every raw/intermediate
+        column (object columns cost ~µs/row to gather) twice per fold."""
+        from ..workflow.dag import (fit_and_transform_dag,
+                                    sequential_executor_forced)
+        from ..workflow.plan import plan_for
+
+        if sequential_executor_forced():
+            # pre-plan behavior: gather every column, refit sequentially
+            train_ds = data.take(tr_idx)
+            eval_ds = data.take(ev_idx)
+            _, train_t, eval_t = fit_and_transform_dag(
+                during_dag, train_ds, apply_to=eval_ds, sequential=True)
+        else:
+            keep = [features_name, label_name]
+            plan = plan_for(during_dag, keep=keep)
+            req = plan.required_input_columns()
+            base = data.select([n for n in data.names() if n in req])
+            train_ds = base.take(tr_idx)
+            eval_ds = base.take(ev_idx)
+            _, train_t, eval_t = fit_and_transform_dag(
+                during_dag, train_ds, apply_to=eval_ds, keep=keep)
         X_tr = np.ascontiguousarray(
             np.asarray(train_t[features_name].values, dtype=np.float32))
         X_ev = np.ascontiguousarray(

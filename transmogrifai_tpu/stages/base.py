@@ -69,6 +69,13 @@ class PipelineStage:
     #: (min, max) allowed number of inputs; None = unbounded
     input_arity: Tuple[int, Optional[int]] = (1, None)
 
+    #: Stages whose fit/transform dispatches XLA programs (models, the
+    #: selector sweep, SanityChecker's stats pass).  The execution plan
+    #: (workflow/plan.py) serializes these in stable layer order — one
+    #: jit dispatch stream, deterministic compile-cache accounting — while
+    #: host-side stages in the same layer run on the thread pool.
+    device_heavy: bool = False
+
     def check_input_length(self, features: Sequence[Feature]) -> None:
         lo, hi = self.input_arity
         if len(features) < lo or (hi is not None and len(features) > hi):
@@ -180,15 +187,28 @@ class Transformer(PipelineStage):
     def transform_columns(self, *cols: FeatureColumn) -> FeatureColumn:
         raise NotImplementedError
 
-    def transform(self, data: ColumnarDataset) -> ColumnarDataset:
+    def transform_output(self, data: ColumnarDataset
+                         ) -> Tuple[str, FeatureColumn]:
+        """Compute this stage's output column WITHOUT touching the dataset.
+
+        The execution-plan seam: the layer-parallel executor
+        (workflow/plan.py) calls this concurrently for independent stages
+        and merges the columns itself in stable stage order.
+        """
         cols = [data[n] for n in self.input_names]
         out = self.transform_columns(*cols)
         if out.ftype is not self.output_type and not issubclass(
             out.ftype, self.output_type
         ):
             out = FeatureColumn(self.output_type, out.values, out.mask)
-        data.set(self.get_output().name, out)
-        return data
+        return self.get_output().name, out
+
+    def transform(self, data: ColumnarDataset) -> ColumnarDataset:
+        """Copy-on-write transform: returns a NEW dataset view sharing every
+        untouched column buffer with ``data`` (which is never mutated),
+        with this stage's output appended/overridden."""
+        name, out = self.transform_output(data)
+        return data.with_columns({name: out})
 
     def transform_values(self, *rows: Any) -> Any:
         """Row-level transform via a batch of one (local-scoring parity)."""

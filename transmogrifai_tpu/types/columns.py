@@ -198,11 +198,13 @@ class ColumnarDataset:
     stages read input columns and attach new output columns.
     """
 
-    def __init__(self, columns: Optional[Dict[str, FeatureColumn]] = None):
+    def __init__(self, columns: Optional[Dict[str, FeatureColumn]] = None,
+                 *, _validated: bool = False):
         self.columns: Dict[str, FeatureColumn] = dict(columns or {})
-        lengths = {len(c) for c in self.columns.values()}
-        if len(lengths) > 1:
-            raise ValueError(f"ragged dataset: column lengths {lengths}")
+        if not _validated:
+            lengths = {len(c) for c in self.columns.values()}
+            if len(lengths) > 1:
+                raise ValueError(f"ragged dataset: column lengths {lengths}")
 
     # -- basic container ----------------------------------------------------
 
@@ -227,20 +229,43 @@ class ColumnarDataset:
     def names(self) -> List[str]:
         return list(self.columns.keys())
 
+    def with_columns(self, new: Dict[str, FeatureColumn]) -> "ColumnarDataset":
+        """Copy-on-write append/override: a NEW dataset sharing every existing
+        ``FeatureColumn`` buffer by reference, with ``new`` layered on top.
+
+        This is what ``Transformer.transform`` returns — the analogue of the
+        reference's immutable ``DataFrame.select(...)`` chaining, without
+        Spark's plan machinery: untouched column buffers keep their identity
+        (no O(rows) array copies; only O(columns) pointer copies), and the
+        input dataset is never mutated, so the layer-parallel executor can
+        hand the same dataset to concurrent stages safely.
+        """
+        n = len(self)
+        for name, col in new.items():
+            if self.columns and len(col) != n:
+                raise ValueError(
+                    f"column {name!r} length {len(col)} != dataset length {n}"
+                )
+        merged = dict(self.columns)
+        merged.update(new)
+        return ColumnarDataset(merged, _validated=True)
+
     def select(self, names: Iterable[str]) -> "ColumnarDataset":
-        return ColumnarDataset({n: self.columns[n] for n in names})
+        return ColumnarDataset({n: self.columns[n] for n in names},
+                               _validated=True)
 
     def drop(self, names: Iterable[str]) -> "ColumnarDataset":
         dropset = set(names)
         return ColumnarDataset(
-            {n: c for n, c in self.columns.items() if n not in dropset}
+            {n: c for n, c in self.columns.items() if n not in dropset},
+            _validated=True,
         )
 
     def take(self, idx: np.ndarray) -> "ColumnarDataset":
         return ColumnarDataset({n: c.take(idx) for n, c in self.columns.items()})
 
     def copy(self) -> "ColumnarDataset":
-        return ColumnarDataset(dict(self.columns))
+        return ColumnarDataset(dict(self.columns), _validated=True)
 
     # -- pandas bridge ------------------------------------------------------
 

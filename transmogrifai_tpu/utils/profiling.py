@@ -24,7 +24,7 @@ __all__ = ["OpStep", "MetricsCollector", "AppMetrics", "StepMetrics",
            "with_job_group", "current_collector", "install_collector",
            "profile_to", "RunCounters", "COUNTERS", "reset_counters",
            "count_upload", "count_fetch", "count_drain", "count_launch",
-           "fetch_timed"]
+           "fetch_timed", "StageProfile", "PlanProfiler"]
 
 
 class OpStep(enum.Enum):
@@ -262,6 +262,92 @@ def fetch_timed(x, dtype=None):
     count_drain(t1 - t0)
     count_fetch(out.nbytes, t2 - t1)
     return out
+
+
+@dataclass
+class StageProfile:
+    """One executed DAG stage, as recorded by the execution plan
+    (workflow/plan.py) — the per-stage analogue of the reference's
+    OpSparkListener stage metrics, with TPU-relevant extras: device
+    launches dispatched (from ``RunCounters``) and the dataset's column
+    delta (liveness accounting)."""
+
+    uid: str
+    op: str
+    output: str
+    layer: int
+    kind: str            # "fit" | "transform" | "substitute"
+    device_heavy: bool
+    wall_s: float
+    rows: int
+    cols_added: int = 0
+    cols_dropped: int = 0   # columns freed after this stage's layer
+    launches: int = 0       # device dispatches attributed (serial stages only)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"uid": self.uid, "op": self.op, "output": self.output,
+                "layer": self.layer, "kind": self.kind,
+                "deviceHeavy": self.device_heavy,
+                "wallSecs": round(self.wall_s, 4), "rows": self.rows,
+                "colsAdded": self.cols_added,
+                "colsDropped": self.cols_dropped, "launches": self.launches}
+
+
+class PlanProfiler:
+    """Accumulates StageProfile entries for one plan execution; thread-safe
+    (host-side stages record from pool threads).  Also tracks the peak
+    resident column count — the number liveness pruning exists to bound."""
+
+    def __init__(self):
+        self.stages: List[StageProfile] = []
+        self.peak_columns: int = 0
+        self.final_columns: int = 0
+        self.wall_s: float = 0.0
+        self.layer_drops: Dict[int, List[str]] = {}
+        self._lock = threading.Lock()
+
+    def record_stage(self, sp: StageProfile) -> None:
+        with self._lock:
+            self.stages.append(sp)
+
+    def note_columns(self, count: int) -> None:
+        with self._lock:
+            self.peak_columns = max(self.peak_columns, count)
+            self.final_columns = count
+
+    def note_drops(self, layer: int, names: List[str]) -> None:
+        with self._lock:
+            self.layer_drops.setdefault(layer, []).extend(names)
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            stages = sorted(self.stages, key=lambda s: (s.layer, s.output))
+            return {
+                "wallSecs": round(self.wall_s, 4),
+                "peakColumns": self.peak_columns,
+                "finalColumns": self.final_columns,
+                "layerDrops": {str(k): list(v) for k, v in
+                               sorted(self.layer_drops.items())},
+                "stages": [s.to_json() for s in stages],
+            }
+
+    def format(self, top_k: int = 20) -> str:
+        """Human-readable per-stage summary (workflow.train(profile=True))."""
+        with self._lock:
+            stages = list(self.stages)
+            peak, final, wall = (self.peak_columns, self.final_columns,
+                                 self.wall_s)
+        lines = [f"plan execution: {len(stages)} stages, "
+                 f"{wall:.3f}s wall, peak {peak} resident columns "
+                 f"(final {final})"]
+        by_cost = sorted(stages, key=lambda s: -s.wall_s)[:top_k]
+        for s in by_cost:
+            lines.append(
+                f"  [{s.layer}] {s.kind:<9} {s.op:<24} {s.wall_s*1e3:8.1f} ms"
+                f"  rows={s.rows}  +{s.cols_added}/-{s.cols_dropped} cols"
+                + (f"  launches={s.launches}" if s.launches else "")
+                + ("  [device]" if s.device_heavy else ""))
+        return "\n".join(lines)
 
 
 @contextlib.contextmanager

@@ -1,5 +1,6 @@
 from .workflow import OpWorkflow, OpWorkflowModel  # noqa: F401
 from .dag import (compute_dag, cut_dag_cv, fit_and_transform_dag,  # noqa: F401
                   transform_dag)
+from .plan import ExecutionPlan, plan_for  # noqa: F401
 from .runner import (OpApp, OpParams, OpWorkflowRunner,  # noqa: F401
                      OpWorkflowRunnerResult, RunType)

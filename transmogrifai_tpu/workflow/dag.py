@@ -13,6 +13,7 @@ the fusion the reference got from Catalyst.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..features.feature import Feature, FeatureCycleError
@@ -22,6 +23,16 @@ from ..types.columns import ColumnarDataset
 
 __all__ = ["StagesDAG", "compute_dag", "fit_and_transform_dag", "transform_dag",
            "CutDAG", "cut_dag_cv"]
+
+#: operational kill-switch: set to "1" to revert every DAG execution to the
+#: pre-plan strictly-sequential executor (no pruning, eager apply_to, full
+#: per-fold column gathers).  Also the honest A/B lever for
+#: examples/bench_pipeline.py.
+SEQUENTIAL_EXECUTOR_ENV = "TMOG_SEQUENTIAL_EXECUTOR"
+
+
+def sequential_executor_forced() -> bool:
+    return os.environ.get(SEQUENTIAL_EXECUTOR_ENV) == "1"
 
 
 class StagesDAG:
@@ -99,6 +110,9 @@ def fit_and_transform_dag(
     train: ColumnarDataset,
     apply_to: Optional[ColumnarDataset] = None,
     fitted_substitutes: Optional[Dict[str, Model]] = None,
+    keep: Optional[Sequence[str]] = None,
+    profiler=None,
+    sequential: Optional[bool] = None,
 ) -> Tuple[List[PipelineStage], ColumnarDataset, Optional[ColumnarDataset]]:
     """Fit estimators layer by layer, transforming as we go.
 
@@ -109,7 +123,38 @@ def fit_and_transform_dag(
     ``fitted_substitutes`` allows warm-start (OpWorkflow.withModelStages
     parity): estimators whose uid appears there are skipped and the fitted
     model used directly.
+
+    Execution goes through the memoized ``ExecutionPlan`` (workflow/plan.py):
+    liveness pruning when ``keep`` names the columns the caller needs
+    (``keep=None`` retains every intermediate, the historical behavior),
+    intra-layer host parallelism, lazy plan-driven ``apply_to``, and
+    per-stage profiling into ``profiler`` (a ``PlanProfiler``).
+    ``sequential=True`` forces the plain stage-by-stage loop — the
+    pre-plan executor, kept for determinism tests and benchmarks
+    (``TMOG_SEQUENTIAL_EXECUTOR=1`` forces it process-wide).
     """
+    if sequential is None:
+        sequential = sequential_executor_forced()
+    if sequential:
+        return _fit_and_transform_sequential(
+            dag, train, apply_to, fitted_substitutes)
+    from .plan import plan_for
+
+    return plan_for(dag, keep=keep).fit_and_transform(
+        train, apply_to=apply_to, fitted_substitutes=fitted_substitutes,
+        profiler=profiler)
+
+
+def _fit_and_transform_sequential(
+    dag: StagesDAG,
+    train: ColumnarDataset,
+    apply_to: Optional[ColumnarDataset] = None,
+    fitted_substitutes: Optional[Dict[str, Model]] = None,
+) -> Tuple[List[PipelineStage], ColumnarDataset, Optional[ColumnarDataset]]:
+    """The pre-plan executor: strictly sequential, eager ``apply_to``, no
+    pruning.  The determinism baseline the plan executor is asserted
+    byte-identical against (tests/test_plan_executor.py) and the
+    comparison executor for ``examples/bench_pipeline.py``."""
     fitted_substitutes = fitted_substitutes or {}
     fitted: List[PipelineStage] = []
     data = train
@@ -132,23 +177,34 @@ def fit_and_transform_dag(
 
 
 def transform_dag(
-    dag: StagesDAG, data: ColumnarDataset, up_to_feature: Optional[str] = None
+    dag: StagesDAG, data: ColumnarDataset,
+    up_to_feature: Optional[str] = None,
+    keep: Optional[Sequence[str]] = None,
+    profiler=None,
 ) -> ColumnarDataset:
     """Apply an already-fitted DAG (scoring path; OpWorkflowCore.applyTransformationsDAG).
 
     ``up_to_feature`` stops once the named feature is materialized
-    (OpWorkflow.computeDataUpTo parity).
+    (OpWorkflow.computeDataUpTo parity) and keeps the historical
+    sequential semantics (every stage before the stopping point runs).
+    Otherwise execution reuses the DAG's memoized ExecutionPlan — the same
+    pruned plan serving/scoring callers share — with ``keep`` bounding the
+    resident columns.
     """
-    for layer in dag.non_generator_layers():
-        for stage in layer:
-            if isinstance(stage, Estimator):
-                raise RuntimeError(
-                    f"unfitted estimator {stage.uid} in scoring DAG"
-                )
-            data = stage.transform(data)
-            if up_to_feature is not None and up_to_feature in data:
-                return data
-    return data
+    if up_to_feature is not None or sequential_executor_forced():
+        for layer in dag.non_generator_layers():
+            for stage in layer:
+                if isinstance(stage, Estimator):
+                    raise RuntimeError(
+                        f"unfitted estimator {stage.uid} in scoring DAG"
+                    )
+                data = stage.transform(data)
+                if up_to_feature is not None and up_to_feature in data:
+                    return data
+        return data
+    from .plan import plan_for
+
+    return plan_for(dag, keep=keep).transform(data, profiler=profiler)
 
 
 @dataclasses.dataclass

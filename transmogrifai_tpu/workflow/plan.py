@@ -1,0 +1,411 @@
+"""Execution planning for the feature DAG — liveness, COW, layer parallelism.
+
+The reference gets intra-layer fusion and column pruning for free from
+Spark's Catalyst optimizer: ``FitStagesUtil.fitAndTransformLayer`` bulk-
+applies each layer as one ``select`` and the unused columns never
+materialize.  This module is the TPU port's equivalent, computed ONCE per
+DAG and memoized on it:
+
+* **Column liveness** — every DAG column's last consumer layer is known
+  statically, so each intermediate is dropped from the dataset immediately
+  after that layer, bounding peak host/device memory instead of
+  accumulating every intermediate for the whole run.  Pruning only engages
+  when the caller states what it needs (``keep``); with ``keep=None`` the
+  executor is a drop-in for the old accumulate-everything loop.
+* **Copy-on-write datasets** — stages never mutate the flowing dataset
+  (``Transformer.transform`` returns a view sharing untouched column
+  buffers), so concurrent stages can read the same dataset safely and a
+  layer's outputs merge in one ``with_columns`` call.
+* **Layer parallelism** — stages within a topological layer are
+  independent by construction (layering is by longest path from the raw
+  generators, so every input comes from an earlier layer).  Host-side
+  stages run concurrently on a bounded thread pool; ``device_heavy``
+  stages (models, the selector sweep, SanityChecker) are submitted
+  serially in stable layer order so the jit dispatch stream and
+  compile-cache accounting stay deterministic.  Results are byte-identical
+  to sequential execution because each stage writes exactly one column and
+  merge order is the stable layer order (asserted by test).
+* **Per-stage profiling** — wall time, rows, columns added/dropped and
+  device-launch deltas (``utils/profiling.RunCounters``) per stage, plus
+  the peak resident column count, exposed via ``ExecutionPlan.explain()``
+  and ``OpWorkflow.train(profile=True)``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..stages.base import Estimator, Model, PipelineStage, Transformer
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..utils.profiling import (COUNTERS, PlanProfiler, StageProfile,
+                               current_collector, install_collector)
+
+__all__ = ["ExecutionPlan", "plan_for"]
+
+#: rows below which intra-layer threading is not worth the dispatch overhead
+_PARALLEL_ROW_THRESHOLD = int(os.environ.get(
+    "TMOG_PLAN_PARALLEL_MIN_ROWS", "4096"))
+
+
+def _detect_pool_available() -> bool:
+    """Intra-layer threading needs >1 usable core (on a single-core host
+    pooling GIL-bound stage work is pure context-switch overhead); an
+    explicit TMOG_PLAN_WORKERS always wins."""
+    if os.environ.get("TMOG_PLAN_WORKERS"):
+        return True
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-Linux
+        cores = os.cpu_count() or 1
+    return cores > 1
+
+
+_POOL_AVAILABLE = _detect_pool_available()
+
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def _pool() -> ThreadPoolExecutor:
+    """Shared bounded pool for host-side stage work (created lazily).
+
+    Stage tasks are leaves (they never submit further pool work), so a
+    single process-wide pool cannot deadlock on itself.
+    """
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            workers = int(os.environ.get("TMOG_PLAN_WORKERS", "0")) or \
+                min(8, max(2, (os.cpu_count() or 4) - 1))
+            _POOL = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="tmog-plan")
+        return _POOL
+
+
+def plan_for(dag, keep: Optional[Sequence[str]] = None) -> "ExecutionPlan":
+    """The memoized ExecutionPlan for ``dag`` with the given keep-set.
+
+    Cached on the DAG object itself, so every consumer of the same DAG —
+    ``train()``, ``transform_dag`` scoring/serving, and each CV fold's
+    refit in ``validators.validate_with_dag`` — reuses one plan instead of
+    re-deriving liveness per call.
+    """
+    cache = dag.__dict__.setdefault("_plan_cache", {})
+    key = frozenset(keep) if keep is not None else None
+    plan = cache.get(key)
+    if plan is None:
+        plan = cache[key] = ExecutionPlan(dag, keep=keep)
+    return plan
+
+
+class ExecutionPlan:
+    """A per-DAG schedule: exec layers, liveness drops, host/device split."""
+
+    def __init__(self, dag, keep: Optional[Sequence[str]] = None):
+        self.dag = dag
+        self.keep: Optional[frozenset] = (
+            frozenset(keep) if keep is not None else None)
+        self.layers: List[List[PipelineStage]] = [
+            l for l in dag.non_generator_layers() if l]
+        self._analyze()
+
+    # -- static analysis -----------------------------------------------------
+
+    def _analyze(self) -> None:
+        from ..stages.generator import FeatureGeneratorStage
+
+        # every column name the DAG knows about (raw generator outputs are
+        # produced at "layer -1", i.e. present in the input dataset);
+        # columns the plan does NOT know (e.g. a reader's "key") are never
+        # touched by liveness drops.
+        produced_at: Dict[str, int] = {}
+        for layer in self.dag.layers:
+            for s in layer:
+                if isinstance(s, FeatureGeneratorStage):
+                    produced_at[s.get_output().name] = -1
+        for li, layer in enumerate(self.layers):
+            for s in layer:
+                produced_at[s.get_output().name] = li
+        self.known: Set[str] = set(produced_at)
+
+        # backward closure of stages needed to materialize the keep-set
+        # (with keep=None everything is needed)
+        out_stage: Dict[str, PipelineStage] = {
+            s.get_output().name: s for layer in self.layers for s in layer}
+        if self.keep is None:
+            self.needed_uids: Set[str] = {
+                s.uid for layer in self.layers for s in layer}
+        else:
+            needed: Set[str] = set()
+            frontier = [out_stage[n] for n in self.keep if n in out_stage]
+            while frontier:
+                s = frontier.pop()
+                if s.uid in needed:
+                    continue
+                needed.add(s.uid)
+                for f in s.input_features:
+                    p = out_stage.get(f.name)
+                    if p is not None:
+                        frontier.append(p)
+            self.needed_uids = needed
+
+        # last consumer layer per column, in two variants: the fit path
+        # executes EVERY stage (all consumers pin their inputs), while the
+        # pure-transform path skips non-needed stages.
+        def last_use(uids: Optional[Set[str]]) -> Dict[str, int]:
+            lu: Dict[str, int] = {}
+            for li, layer in enumerate(self.layers):
+                for s in layer:
+                    if uids is not None and s.uid not in uids:
+                        continue
+                    for n in s.input_names:
+                        lu[n] = li
+            return lu
+
+        self._produced_at = produced_at
+        self._drops_fit = self._drop_schedule(produced_at, last_use(None))
+        self._drops_transform = self._drop_schedule(
+            produced_at,
+            last_use(self.needed_uids if self.keep is not None else None))
+
+    def _drop_schedule(self, produced_at: Dict[str, int],
+                       last_use: Dict[str, int]
+                       ) -> Tuple[List[str], List[List[str]]]:
+        """(initial_drops, drops_after_layer[i]) for one execution mode.
+
+        A known column not in ``keep`` dies after its last consumer layer;
+        a column nobody (executed) consumes dies as soon as it exists —
+        raw inputs before layer 0, stage outputs right after their layer.
+        No pruning at all when ``keep`` is None.
+        """
+        n_layers = len(self.layers)
+        initial: List[str] = []
+        after: List[List[str]] = [[] for _ in range(n_layers)]
+        if self.keep is None:
+            return initial, after
+        for name, pl in produced_at.items():
+            if name in self.keep:
+                continue
+            die = last_use.get(name, pl)
+            if die < 0:
+                initial.append(name)
+            else:
+                after[die].append(name)
+        initial.sort()
+        for l in after:
+            l.sort()
+        return initial, after
+
+    def required_input_columns(self) -> frozenset:
+        """Input-dataset columns the fit path actually reads: every
+        executed stage's generator-level (or plan-unknown) inputs plus the
+        keep-set.  Callers that copy/slice a dataset before running the
+        plan (e.g. per-fold ``take`` in validators) can restrict the copy
+        to these instead of gathering every column."""
+        req = set(self.keep or ())
+        for layer in self.layers:
+            for s in layer:
+                for n in s.input_names:
+                    if self._produced_at.get(n, -1) < 0:
+                        req.add(n)
+        return frozenset(req)
+
+    # -- reporting -----------------------------------------------------------
+
+    def explain(self) -> str:
+        """Static plan report: per-layer stages, host/device split, liveness
+        drops, and the projected peak resident column count."""
+        initial, after = self._drops_fit
+        lines = [
+            f"ExecutionPlan: {sum(len(l) for l in self.layers)} stages over "
+            f"{len(self.layers)} layers"
+            + (f", keep={len(self.keep)} columns" if self.keep is not None
+               else ", keep=ALL (no pruning)")]
+        # simulate resident-column count: raw inputs enter at the start,
+        # each layer's outputs append, liveness drops retire
+        resident = sum(1 for pl in self._produced_at.values() if pl < 0) \
+            - len(initial)
+        peak = resident
+        if initial:
+            lines.append(f"  drop before layer 0: {initial}")
+        for li, layer in enumerate(self.layers):
+            host = [s for s in layer if not s.device_heavy]
+            dev = [s for s in layer if s.device_heavy]
+            resident += len(layer)
+            peak = max(peak, resident)
+            desc = ", ".join(
+                f"{type(s).__name__}->{s.get_output().name}" for s in layer)
+            par = (f"{len(host)} host-parallel"
+                   + (f" + {len(dev)} device-serial" if dev else "")
+                   if len(host) > 1 else
+                   ("device-serial" if dev and not host else "serial"))
+            lines.append(f"  layer {li} [{par}]: {desc}")
+            drops = after[li]
+            if drops:
+                resident -= len(drops)
+                lines.append(f"    drop after layer {li}: {drops}")
+        lines.append(f"  projected resident columns: peak {peak}, "
+                     f"final {resident}")
+        return "\n".join(lines)
+
+    # -- execution -----------------------------------------------------------
+
+    def fit_and_transform(
+        self,
+        data: ColumnarDataset,
+        apply_to: Optional[ColumnarDataset] = None,
+        fitted_substitutes: Optional[Dict[str, Model]] = None,
+        profiler: Optional[PlanProfiler] = None,
+    ) -> Tuple[List[PipelineStage], ColumnarDataset,
+               Optional[ColumnarDataset]]:
+        """Fit estimators layer by layer, transforming as we go.
+
+        The ``apply_to`` pass is LAZY/plan-driven: instead of eagerly
+        pushing the holdout through every stage as it fits, the fitted
+        stages are replayed over ``apply_to`` afterwards through the same
+        plan — pruned, skipping stages the keep-set doesn't need.
+        """
+        subs = fitted_substitutes or {}
+        prof = profiler or PlanProfiler()
+        t_start = time.perf_counter()
+        fitted: List[PipelineStage] = []
+        fitted_by_uid: Dict[str, PipelineStage] = {}
+        initial, drops_after = self._drops_fit
+        if initial:
+            data = data.drop(initial)
+        prof.note_columns(len(data.columns))
+
+        for li, layer in enumerate(self.layers):
+            results = self._run_layer(li, layer, data, subs, prof)
+            new_cols: Dict[str, FeatureColumn] = {}
+            for stage in layer:
+                rs, name, col = results[stage.uid]
+                fitted.append(rs)
+                fitted_by_uid[stage.uid] = rs
+                new_cols[name] = col
+            data = data.with_columns(new_cols)
+            prof.note_columns(len(data.columns))
+            if drops_after[li]:
+                data = data.drop(drops_after[li])
+                prof.note_drops(li, drops_after[li])
+                prof.note_columns(len(data.columns))
+        apply_out = None
+        if apply_to is not None:
+            apply_out = self._transform_with(apply_to, fitted_by_uid, prof)
+        prof.wall_s += time.perf_counter() - t_start
+        return fitted, data, apply_out
+
+    def transform(self, data: ColumnarDataset,
+                  profiler: Optional[PlanProfiler] = None) -> ColumnarDataset:
+        """Apply an already-fitted DAG (scoring path), pruned + parallel."""
+        for layer in self.layers:
+            for stage in layer:
+                if isinstance(stage, Estimator):
+                    raise RuntimeError(
+                        f"unfitted estimator {stage.uid} in scoring DAG")
+        prof = profiler or PlanProfiler()
+        t_start = time.perf_counter()
+        out = self._transform_with(data, None, prof)
+        prof.wall_s += time.perf_counter() - t_start
+        return out
+
+    def _transform_with(self, data: ColumnarDataset,
+                        fitted_by_uid: Optional[Dict[str, PipelineStage]],
+                        prof: PlanProfiler) -> ColumnarDataset:
+        initial, drops_after = self._drops_transform
+        if initial:
+            data = data.drop(initial)
+        prof.note_columns(len(data.columns))
+        for li, layer in enumerate(self.layers):
+            run = [s for s in layer if s.uid in self.needed_uids]
+            if fitted_by_uid is not None:
+                run = [fitted_by_uid[s.uid] for s in run]
+            if run:
+                results = self._run_layer(li, run, data, _TRANSFORM_ONLY,
+                                          prof)
+                new_cols = {name: col for _, name, col in
+                            (results[s.uid] for s in run)}
+                data = data.with_columns(new_cols)
+                prof.note_columns(len(data.columns))
+            if drops_after[li]:
+                data = data.drop(drops_after[li])
+                prof.note_columns(len(data.columns))
+        return data
+
+    # -- layer executor ------------------------------------------------------
+
+    def _run_layer(self, li: int, layer: List[PipelineStage],
+                   data: ColumnarDataset, subs, prof: PlanProfiler
+                   ) -> Dict[str, Tuple[PipelineStage, str, FeatureColumn]]:
+        """Run one layer's stages: host-side concurrently on the bounded
+        pool, device-heavy serially in stable order.  Deterministic: each
+        stage computes exactly one column from earlier-layer inputs, and
+        the caller merges in stable layer order."""
+        n_rows = len(data)
+        host = [s for s in layer if not s.device_heavy]
+        dev = [s for s in layer if s.device_heavy]
+        use_pool = (_POOL_AVAILABLE and len(host) > 1
+                    and n_rows >= _PARALLEL_ROW_THRESHOLD)
+        results: Dict[str, Tuple[PipelineStage, str, FeatureColumn]] = {}
+
+        futures = []
+        if use_pool:
+            coll = current_collector()
+            pool = _pool()
+            for stage in host:
+                futures.append((stage, pool.submit(
+                    self._run_stage, stage, data, subs, li, n_rows, prof,
+                    coll, False)))
+        else:
+            # no pool: run host stages inline, in stable order
+            for stage in host:
+                results[stage.uid] = self._run_stage(
+                    stage, data, subs, li, n_rows, prof, None, True)
+        for stage in dev:
+            results[stage.uid] = self._run_stage(
+                stage, data, subs, li, n_rows, prof, None, True)
+        for stage, fut in futures:
+            results[stage.uid] = fut.result()
+        return results
+
+    def _run_stage(self, stage: PipelineStage, data: ColumnarDataset,
+                   subs, li: int, n_rows: int, prof: PlanProfiler,
+                   coll, serial: bool
+                   ) -> Tuple[PipelineStage, str, FeatureColumn]:
+        t0 = time.perf_counter()
+        launches0 = COUNTERS.launches if serial else 0
+        ctx = install_collector(coll) if coll is not None else None
+        if ctx is not None:
+            ctx.__enter__()
+        try:
+            if subs is _TRANSFORM_ONLY or not isinstance(stage, Estimator):
+                if not isinstance(stage, Transformer):
+                    raise TypeError(f"cannot execute stage {stage!r}")
+                kind = "transform"
+                result_stage = stage
+            else:
+                sub = subs.get(stage.uid)
+                if sub is not None:
+                    kind = "substitute"
+                    result_stage = sub
+                else:
+                    kind = "fit"
+                    result_stage = stage.fit(data)
+            name, col = result_stage.transform_output(data)
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+        dt = time.perf_counter() - t0
+        prof.record_stage(StageProfile(
+            uid=stage.uid, op=type(stage).__name__, output=name, layer=li,
+            kind=kind, device_heavy=stage.device_heavy, wall_s=dt,
+            rows=n_rows, cols_added=1,
+            launches=(COUNTERS.launches - launches0) if serial else 0))
+        return result_stage, name, col
+
+
+#: sentinel: _run_layer/_run_stage execute already-fitted transformers only
+_TRANSFORM_ONLY = object()

@@ -151,11 +151,17 @@ class OpWorkflowRunner:
     def _train(self, params: OpParams) -> OpWorkflowRunnerResult:
         if self.train_reader is not None:
             self.workflow.set_reader(self.train_reader)
-        model = self.workflow.train()
+        # custom_params.profile=true turns on the execution plan's per-stage
+        # profile; it rides along in the train summary (and thence the
+        # metrics_location JSON) as "executionProfile"
+        profile = bool(params.custom_params.get("profile"))
+        model = self.workflow.train(profile=profile)
         if params.model_location:
             with with_job_group(OpStep.ModelIO):
                 model.save(params.model_location)
         summary = model.summary()
+        if profile and model.train_profile is not None:
+            summary["executionProfile"] = model.train_profile.to_json()
         return OpWorkflowRunnerResult(run_type="train", summary=summary)
 
     def _load_model(self, params: OpParams) -> OpWorkflowModel:

@@ -162,7 +162,27 @@ class OpWorkflow(_WorkflowCore):
                 f"RawFeatureFilter dropped features required by result "
                 f"features {bad}; protect them via protected_features")
 
-    def train(self) -> "OpWorkflowModel":
+    def _train_keep_columns(self) -> List[str]:
+        """Columns ``train()`` must retain through the DAG run — everything
+        else is liveness-pruned by the execution plan as soon as its last
+        consumer stage has run.  Kept: the result features, the raw
+        response(s) (evaluation + ModelInsights label summary), and the
+        result stages' direct inputs (the selector's feature vector backs
+        ModelInsights/train_data introspection)."""
+        keep = {f.name for f in self.result_features}
+        keep |= {f.name for f in self.raw_features() if f.is_response}
+        for f in self.result_features:
+            s = f.origin_stage
+            if s is not None:
+                keep |= {ff.name for ff in s.input_features}
+        return sorted(keep)
+
+    def train(self, profile: bool = False) -> "OpWorkflowModel":
+        """Fit the workflow.  ``profile=True`` additionally records a
+        per-stage execution profile (wall time, rows, columns
+        added/dropped, device launches) on the returned model as
+        ``train_profile`` (a PlanProfiler; ``.format()`` for the summary,
+        ``.to_json()`` for the raw numbers)."""
         from ..utils.profiling import OpStep, with_job_group
 
         with with_job_group(OpStep.DataReadingAndFiltering):
@@ -194,14 +214,17 @@ class OpWorkflow(_WorkflowCore):
                     meshed_stages.append((s, getattr(s, "mesh", None)))
                     s.with_mesh(self.mesh)
         try:
-            return self._train_inner(data, dag, filter_results)
+            return self._train_inner(data, dag, filter_results,
+                                     profile=profile)
         finally:
             for s, prev in meshed_stages:
                 s.with_mesh(prev)
 
-    def _train_inner(self, data, dag, filter_results) -> "OpWorkflowModel":
-        from ..utils.profiling import OpStep, with_job_group
+    def _train_inner(self, data, dag, filter_results,
+                     profile: bool = False) -> "OpWorkflowModel":
+        from ..utils.profiling import OpStep, PlanProfiler, with_job_group
 
+        profiler = PlanProfiler() if profile else None
         substitutes = dict(self._model_stages)
         if self._workflow_cv:
             # OpWorkflow.fitStages CV path (OpWorkflow.scala:403-453):
@@ -211,6 +234,8 @@ class OpWorkflow(_WorkflowCore):
             cut = cut_dag_cv(dag)
             if cut.selector is not None and cut.during.layers:
                 with with_job_group(OpStep.CrossValidation):
+                    # no keep-set here: before_data must retain every column
+                    # the during-DAG and selector read downstream
                     before_fitted, before_data, _ = fit_and_transform_dag(
                         cut.before, data, fitted_substitutes=substitutes)
                     cut.selector.find_best_estimator(before_data, cut.during)
@@ -219,7 +244,8 @@ class OpWorkflow(_WorkflowCore):
                          if isinstance(m, Model)})
         with with_job_group(OpStep.FeatureEngineering):
             fitted, transformed, _ = fit_and_transform_dag(
-                dag, data, fitted_substitutes=substitutes)
+                dag, data, fitted_substitutes=substitutes,
+                keep=self._train_keep_columns(), profiler=profiler)
         model = OpWorkflowModel(
             result_features=self.result_features,
             stages=fitted,
@@ -227,6 +253,7 @@ class OpWorkflow(_WorkflowCore):
         )
         model.reader = self.reader
         model.raw_feature_filter_results = filter_results
+        model.train_profile = profiler
         # drop the sweep's upload/binning memos: their device buffers are
         # only useful within one train and holding them pressures HBM on
         # subsequent trains (measured a 6x slowdown at 1M rows)
@@ -274,6 +301,8 @@ class OpWorkflowModel(_WorkflowCore):
         self.stages = list(stages)
         self.train_data = train_data
         self.raw_feature_filter_results = None
+        #: PlanProfiler from ``OpWorkflow.train(profile=True)`` else None
+        self.train_profile = None
         self._scoring_dag_memo: Optional[StagesDAG] = None
 
     def _scoring_dag(self) -> StagesDAG:
@@ -301,7 +330,19 @@ class OpWorkflowModel(_WorkflowCore):
         if data is not None:
             self.set_input_data(data)
         raw = self.generate_raw_data()
-        scored = transform_dag(self._scoring_dag(), raw.copy())
+        # the memoized per-DAG execution plan prunes intermediates as soon
+        # as their last consumer stage has run (transform() is COW — raw is
+        # never mutated, so no defensive copy needed)
+        plan_keep = None
+        if not keep_intermediate_features:
+            plan_keep = {f.name for f in self.result_features}
+            plan_keep |= {f.name for f in self.raw_features()
+                          if f.is_response}
+            if keep_raw_features:
+                plan_keep |= {f.name for f in self.raw_features()}
+        scored = transform_dag(self._scoring_dag(), raw,
+                               keep=sorted(plan_keep)
+                               if plan_keep is not None else None)
         if keep_raw_features and keep_intermediate_features:
             return scored
         keep = [f.name for f in self.result_features if f.name in scored]
