@@ -120,6 +120,15 @@ def _run_headline_subprocess(timeout_s: float):
 _T0 = time.perf_counter()
 
 
+def _peak_rss_mb() -> float:
+    """Lifetime peak host resident set of this process, in MB — the memory
+    axis of the trajectory (ru_maxrss is KB on Linux)."""
+    import resource
+
+    return round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
 def _backend_name() -> str:
     """The backend actually serving this run (recorded in every emitted
     JSON line so trajectories on different backends stay comparable)."""
@@ -281,6 +290,7 @@ def main():
     def flush():
         line = dict(headline)
         line["backend"] = backend
+        line["peak_rss_mb"] = _peak_rss_mb()
         line["configs"] = results
         line["elapsed_s"] = round(_elapsed(), 1)
         print(json.dumps(line), flush=True)

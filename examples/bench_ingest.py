@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Out-of-core ingestion benchmark — monolithic vs chunked train.
+
+Measures the two-pass chunked ingestion path (workflow/streaming.py,
+``OpWorkflow.train(chunk_rows=k)``) against the in-core path on the
+titanic-shaped pipeline at 1x/10x/100x rows, from an actual CSV file:
+
+* ``wall_s`` — end-to-end train wall clock.
+* ``peak_rss_mb`` / ``rss_delta_mb`` — lifetime peak host resident set
+  (``resource.getrusage``) and its delta over the post-import baseline.
+  ru_maxrss is a process-lifetime high-water mark, so EACH MODE RUNS IN
+  ITS OWN SUBPROCESS — the number cannot be polluted by the other mode.
+  The headline ratio uses the delta (the workload's memory, excluding the
+  ~constant interpreter+jax baseline both modes pay identically).
+* ``overlap_efficiency`` — how much of chunk parsing the prefetch thread
+  hid behind transform compute (from the IngestProfiler counters).
+
+Writes ``benchmarks/ingest_latest.json``.  ``--smoke`` runs the 1x scale
+only and writes nothing (the scripts/tier1.sh wiring).
+
+Usage:
+  python examples/bench_ingest.py [--scales 1,10,100] [--chunk-rows 4096]
+  python examples/bench_ingest.py --smoke
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # CPU-comparable by contract
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+BASE_ROWS = 891  # the reference demo's PassengerDataAll.csv row count
+
+
+def _rss_mb() -> float:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def make_csv(path: str, rows: int, seed: int = 7) -> None:
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    pd.DataFrame({
+        "Survived": (rng.random(rows) > 0.62).astype(float),
+        "Pclass": rng.choice(["1", "2", "3"], rows, p=[0.24, 0.21, 0.55]),
+        "Name": [f"Passenger {i % 5000} von Name{i % 97}"
+                 for i in range(rows)],
+        "Sex": rng.choice(["male", "female"], rows, p=[0.65, 0.35]),
+        "Age": np.where(rng.random(rows) < 0.2, np.nan,
+                        rng.normal(30, 13, rows).clip(0.4, 80)),
+        "SibSp": rng.integers(0, 6, rows).astype(float),
+        "Parch": rng.integers(0, 5, rows).astype(float),
+        "Ticket": rng.choice([f"T{i}" for i in range(681)], rows),
+        "Fare": rng.lognormal(3.0, 1.0, rows),
+        "Cabin": np.where(rng.random(rows) < 0.77, None,
+                          rng.choice([f"C{i}" for i in range(147)], rows)),
+        "Embarked": rng.choice(["S", "C", "Q"], rows,
+                               p=[0.72, 0.19, 0.09]),
+    }).to_csv(path, index=False)
+
+
+def child(csv_path: str, mode: str, chunk_rows: int) -> None:
+    """One measured train in THIS process; prints one JSON line.
+
+    The pipeline is the reference demo's feature set through transmogrify
+    + SanityChecker into NaiveBayes — a model whose fit itself streams
+    (per-class sufficient statistics), so the WHOLE train runs out-of-core
+    on the chunked path and the comparison isolates ingestion +
+    featurization memory rather than a tail solver's working set.
+    """
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import OpNaiveBayes
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.readers.files import CSVReader
+
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    predictors = [
+        FeatureBuilder.PickList("Pclass").as_predictor(),
+        FeatureBuilder.Text("Name").as_predictor(),
+        FeatureBuilder.PickList("Sex").as_predictor(),
+        FeatureBuilder.Real("Age").as_predictor(),
+        FeatureBuilder.Integral("SibSp").as_predictor(),
+        FeatureBuilder.Integral("Parch").as_predictor(),
+        FeatureBuilder.PickList("Ticket").as_predictor(),
+        FeatureBuilder.Real("Fare").as_predictor(),
+        FeatureBuilder.PickList("Cabin").as_predictor(),
+        FeatureBuilder.PickList("Embarked").as_predictor(),
+    ]
+    features = transmogrify(predictors)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        survived, features).get_output()
+    prediction = OpNaiveBayes().set_input(
+        survived, checked).get_output()
+    wf = (OpWorkflow().set_result_features(prediction)
+          .set_reader(CSVReader(csv_path)))
+
+    baseline_mb = _rss_mb()
+    t0 = time.perf_counter()
+    model = wf.train(chunk_rows=chunk_rows if mode == "chunked" else None)
+    wall_s = time.perf_counter() - t0
+    peak_mb = _rss_mb()
+    out = {
+        "mode": mode, "wall_s": round(wall_s, 3),
+        "rows": len(model.train_data),
+        "baseline_rss_mb": round(baseline_mb, 1),
+        "peak_rss_mb": round(peak_mb, 1),
+        "rss_delta_mb": round(peak_mb - baseline_mb, 1),
+    }
+    if model.ingest_profile is not None:
+        ip = model.ingest_profile
+        out["chunk_rows"] = chunk_rows
+        out["bytes_read"] = ip.total_bytes
+        out["spilled_mb"] = round(ip.spilled_bytes / 1e6, 1)
+        out["passes"] = len(ip.passes)
+        out["overlap_efficiency"] = round(
+            max(p.overlap_efficiency for p in ip.passes), 3)
+        out["rows_per_s"] = round(
+            min(p.rows_per_s for p in ip.passes if p.rows_per_s > 0), 1)
+    print(json.dumps(out), flush=True)
+
+
+def run_child(csv_path: str, mode: str, chunk_rows: int,
+              trials: int = 3) -> dict:
+    """Median-of-``trials`` child runs (each its own process: honest
+    ru_maxrss, cold allocator, stable wall medians)."""
+    import statistics
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--run-child",
+           "--csv", csv_path, "--mode", mode,
+           "--chunk-rows", str(chunk_rows)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if mode == "chunked":
+        # engage the retained-block disk spill at bench scale — the
+        # out-of-core path should be bounded by its packed OUTPUT
+        env.setdefault("TMOG_STREAM_RETAIN_MB", "64")
+    runs = []
+    for _ in range(trials):
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=3600)
+        lines = [l for l in (proc.stdout or "").splitlines()
+                 if l.strip().startswith("{")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"{mode} child failed rc={proc.returncode}: "
+                f"{(proc.stderr or '')[-400:]}")
+        runs.append(json.loads(lines[-1]))
+    out = dict(runs[0])
+    out["wall_s"] = round(statistics.median(r["wall_s"] for r in runs), 3)
+    out["rss_delta_mb"] = round(
+        statistics.median(r["rss_delta_mb"] for r in runs), 1)
+    out["peak_rss_mb"] = round(
+        statistics.median(r["peak_rss_mb"] for r in runs), 1)
+    out["trials"] = {"wall_s": [r["wall_s"] for r in runs],
+                     "rss_delta_mb": [r["rss_delta_mb"] for r in runs]}
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scales", default="1,10,100")
+    ap.add_argument("--chunk-rows", type=int, default=4096)
+    ap.add_argument("--smoke", action="store_true",
+                    help="1x only, no json written (tier1 wiring)")
+    ap.add_argument("--run-child", action="store_true")
+    ap.add_argument("--csv")
+    ap.add_argument("--mode", choices=["monolithic", "chunked"])
+    args = ap.parse_args()
+
+    if args.run_child:
+        child(args.csv, args.mode, args.chunk_rows)
+        return
+
+    scales = [1] if args.smoke else [int(s) for s in args.scales.split(",")]
+    configs = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for mult in scales:
+            rows = BASE_ROWS * mult
+            csv_path = os.path.join(tmp, f"titanic_{mult}x.csv")
+            make_csv(csv_path, rows)
+            print(f"[bench_ingest] {mult}x ({rows} rows, "
+                  f"{os.path.getsize(csv_path)} bytes)...",
+                  file=sys.stderr, flush=True)
+            trials = 1 if args.smoke else 3
+            mono = run_child(csv_path, "monolithic", args.chunk_rows,
+                             trials)
+            chunked = run_child(csv_path, "chunked", args.chunk_rows,
+                                trials)
+            cfg = {
+                "rows": rows,
+                "monolithic": mono,
+                "chunked": chunked,
+                "rss_delta_ratio": round(
+                    chunked["rss_delta_mb"] / max(mono["rss_delta_mb"], 1e-9),
+                    3),
+                "peak_rss_ratio": round(
+                    chunked["peak_rss_mb"] / max(mono["peak_rss_mb"], 1e-9),
+                    3),
+                "wall_ratio": round(
+                    chunked["wall_s"] / max(mono["wall_s"], 1e-9), 3),
+            }
+            configs[f"{mult}x"] = cfg
+            print(f"[bench_ingest] {mult}x: rss delta "
+                  f"{chunked['rss_delta_mb']:.0f}MB vs "
+                  f"{mono['rss_delta_mb']:.0f}MB "
+                  f"({cfg['rss_delta_ratio']}x), wall "
+                  f"{chunked['wall_s']:.1f}s vs {mono['wall_s']:.1f}s "
+                  f"({cfg['wall_ratio']}x), overlap "
+                  f"{chunked.get('overlap_efficiency', 0):.0%}",
+                  file=sys.stderr, flush=True)
+
+    import jax
+
+    top = configs[f"{max(scales)}x"]
+    out = {
+        "metric": "ingest_chunked_vs_monolithic_peak_rss_delta",
+        "value": top["rss_delta_ratio"],
+        "unit": "x",
+        "wall_ratio": top["wall_ratio"],
+        "overlap_efficiency": top["chunked"].get("overlap_efficiency"),
+        "chunk_rows": args.chunk_rows,
+        "backend": jax.default_backend(),
+        "rows_1x": BASE_ROWS,
+        "configs": configs,
+    }
+    print(json.dumps(out), flush=True)
+    if not args.smoke:
+        dest = os.path.join(_ROOT, "benchmarks", "ingest_latest.json")
+        with open(dest, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,4 +8,12 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 _t1_end=$(date +%s)
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo TIER1_WALL_S=$((_t1_end - _t1_start))
+# fast out-of-core ingest smoke (1x scale, no json written): catches
+# chunked-train breakage that unit tests with in-memory readers can miss
+if timeout -k 10 240 env JAX_PLATFORMS=cpu python examples/bench_ingest.py --smoke > /tmp/_t1_ingest.log 2>&1; then
+  echo "INGEST_SMOKE=ok $(grep -ao '"wall_ratio": [0-9.]*' /tmp/_t1_ingest.log | tail -1)"
+else
+  echo "INGEST_SMOKE=FAILED (see /tmp/_t1_ingest.log)"
+  rc=1
+fi
 exit $rc

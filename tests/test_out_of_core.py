@@ -1,0 +1,697 @@
+"""Out-of-core training: chunked ingestion, streaming two-pass fit,
+prefetch overlap (ISSUE 3).
+
+Covers: reader ``iter_chunks`` parity for every format, AsyncBatcher
+producer-exception propagation, the np.unique vectorizer fits, each
+streaming fitter's equivalence to its in-core fit (exact for
+vocabs/modes/decisions, documented float tolerance for moments), the
+streaming histogram bin-edge sketch, and the chunked-vs-monolithic train
+parity suite at chunk_rows in {7, 64, N} on the titanic-shaped fixture
+(odd chunk size catches off-by-one tail handling).
+"""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.readers.avro import AvroReader, write_avro
+from transmogrifai_tpu.readers.base import DataFrameReader, RecordsReader
+from transmogrifai_tpu.readers.files import (CSVReader, JSONLinesReader,
+                                             ParquetReader)
+from transmogrifai_tpu.readers.streaming import AsyncBatcher
+from transmogrifai_tpu.types.columns import ColumnarDataset, FeatureColumn
+from transmogrifai_tpu.types import feature_types as ft
+
+BASE_ROWS = 891
+
+
+def make_titanic_like(rows: int, seed: int = 7) -> pd.DataFrame:
+    """Synthetic frame with the reference demo's column shapes
+    (OpTitanicSimple.scala:75-117); the real CSV is not shipped here."""
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "Survived": (rng.random(rows) > 0.62).astype(float),
+        "Pclass": rng.choice(["1", "2", "3"], rows, p=[0.24, 0.21, 0.55]),
+        "Name": [f"Passenger {i % 5000} von Name{i % 97}"
+                 for i in range(rows)],
+        "Sex": rng.choice(["male", "female"], rows, p=[0.65, 0.35]),
+        "Age": np.where(rng.random(rows) < 0.2, np.nan,
+                        rng.normal(30, 13, rows).clip(0.4, 80)),
+        "SibSp": rng.integers(0, 6, rows).astype(float),
+        "Parch": rng.integers(0, 5, rows).astype(float),
+        "Ticket": rng.choice([f"T{i}" for i in range(681)], rows),
+        "Fare": rng.lognormal(3.0, 1.0, rows),
+        "Cabin": np.where(rng.random(rows) < 0.77, None,
+                          rng.choice([f"C{i}" for i in range(147)], rows)),
+        "Embarked": rng.choice(["S", "C", "Q"], rows, p=[0.72, 0.19, 0.09]),
+    })
+
+
+def titanic_raw_features():
+    return [
+        FeatureBuilder.RealNN("Survived").as_response(),
+        FeatureBuilder.PickList("Pclass").as_predictor(),
+        FeatureBuilder.Text("Name").as_predictor(),
+        FeatureBuilder.Real("Age").as_predictor(),
+        FeatureBuilder.Integral("SibSp").as_predictor(),
+        FeatureBuilder.PickList("Cabin").as_predictor(),
+    ]
+
+
+def build_titanic_pipeline():
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    predictors = [
+        FeatureBuilder.PickList("Pclass").as_predictor(),
+        FeatureBuilder.Text("Name").as_predictor(),
+        FeatureBuilder.PickList("Sex").as_predictor(),
+        FeatureBuilder.Real("Age").as_predictor(),
+        FeatureBuilder.Integral("SibSp").as_predictor(),
+        FeatureBuilder.Integral("Parch").as_predictor(),
+        FeatureBuilder.PickList("Ticket").as_predictor(),
+        FeatureBuilder.Real("Fare").as_predictor(),
+        FeatureBuilder.PickList("Cabin").as_predictor(),
+        FeatureBuilder.PickList("Embarked").as_predictor(),
+    ]
+    features = transmogrify(predictors)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        survived, features).get_output()
+    prediction = OpLogisticRegression(reg_param=0.01).set_input(
+        survived, checked).get_output()
+    return prediction
+
+
+def _columns_equal(a: FeatureColumn, chunks, name: str) -> bool:
+    va = np.asarray(a.values, dtype=object).tolist()
+    vb = np.concatenate([np.asarray(c[name].values, dtype=object)
+                         for c in chunks]).tolist()
+    if len(va) != len(vb):
+        return False
+    for x, y in zip(va, vb):
+        same_nan = (isinstance(x, float) and isinstance(y, float)
+                    and np.isnan(x) and np.isnan(y))
+        if not (x == y or same_nan):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Readers: iter_chunks parity + byte counters
+# ---------------------------------------------------------------------------
+
+class TestChunkedReaders:
+    @pytest.fixture(scope="class")
+    def df(self):
+        return make_titanic_like(101)
+
+    def _assert_parity(self, reader, raw, chunk_rows=7, expect_bytes=True):
+        mono = reader.generate_dataset(raw)
+        stream = reader.iter_chunks(raw, chunk_rows)
+        chunks = list(stream)
+        assert sum(len(c) for c in chunks) == len(mono)
+        # odd chunk size: the tail chunk is a partial one
+        assert len(chunks[-1]) == len(mono) % chunk_rows or \
+            len(mono) % chunk_rows == 0
+        for name in mono.names():
+            assert _columns_equal(mono[name], chunks, name), name
+        if expect_bytes:
+            assert stream.bytes_read > 0
+        return chunks
+
+    def test_csv(self, df, tmp_path):
+        path = str(tmp_path / "t.csv")
+        df.to_csv(path, index=False)
+        self._assert_parity(CSVReader(path), titanic_raw_features())
+
+    def test_parquet(self, df, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        df.to_parquet(path)
+        self._assert_parity(ParquetReader(path), titanic_raw_features())
+
+    def test_jsonl(self, df, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        with open(path, "w") as f:
+            for r in df.to_dict("records"):
+                f.write(json.dumps(
+                    {k: (None if isinstance(v, float) and np.isnan(v) else v)
+                     for k, v in r.items()}) + "\n")
+        self._assert_parity(JSONLinesReader(path), titanic_raw_features())
+
+    def test_avro_block_streaming(self, tmp_path):
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "x", "type": "double"},
+            {"name": "label", "type": ["null", "string"]}]}
+        recs = [{"x": float(i),
+                 "label": None if i % 5 == 0 else f"v{i % 13}"}
+                for i in range(500)]
+        path = str(tmp_path / "r.avro")
+        # block size deliberately co-prime with chunk_rows: chunks must
+        # regroup records across container-block boundaries
+        write_avro(path, schema, recs, codec="deflate", block_records=97)
+        raw = [FeatureBuilder.Real("x").as_predictor(),
+               FeatureBuilder.PickList("label").as_predictor()]
+        chunks = self._assert_parity(AvroReader(path), raw, chunk_rows=61)
+        assert len(chunks) == 9  # ceil(500/61)
+
+    def test_dataframe_and_records_readers(self, df):
+        raw = titanic_raw_features()
+        self._assert_parity(DataFrameReader(df), raw, expect_bytes=False)
+        recs = df.to_dict("records")
+        self._assert_parity(RecordsReader(recs), raw, expect_bytes=False)
+
+    def test_chunk_rows_validation(self, df):
+        with pytest.raises(ValueError):
+            DataFrameReader(df).iter_chunks(titanic_raw_features(), 0)
+
+
+# ---------------------------------------------------------------------------
+# AsyncBatcher: producer exceptions reach the consumer (satellite)
+# ---------------------------------------------------------------------------
+
+class TestAsyncBatcherErrors:
+    def test_mid_stream_exception_reraised_after_good_items(self):
+        def source():
+            yield "a"
+            yield "b"
+            raise RuntimeError("reader blew up mid-stream")
+
+        batcher = AsyncBatcher(source(), depth=2)
+        got = []
+        with pytest.raises(RuntimeError, match="mid-stream"):
+            for item in batcher:
+                got.append(item)
+        # items before the failure were all delivered, then the error
+        assert got == ["a", "b"]
+        # after the re-raise the stream is exhausted, not looping
+        assert list(batcher) == []
+
+    def test_clean_stream_unchanged(self):
+        assert list(AsyncBatcher(iter([1, 2, 3]), depth=1)) == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Vectorizer fits: np.unique rewrite parity (satellite) + streaming fits
+# ---------------------------------------------------------------------------
+
+def _counter_vocab(values, top_k, min_support):
+    """The replaced per-row loop, kept as the test oracle."""
+    from collections import Counter
+
+    counts = Counter(values)
+    return [v for v, n in counts.most_common(top_k) if n >= min_support]
+
+
+class TestVectorizerFits:
+    def _text_col(self, rng, n=500, card=30, p_null=0.15):
+        vals = [None if rng.random() < p_null
+                else f"v{int(rng.integers(card))}" for _ in range(n)]
+        return FeatureColumn.from_values(ft.PickList, vals)
+
+    def test_onehot_np_unique_matches_counter_with_ties(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import OneHotVectorizer
+
+        # engineered ties: many values sharing a count — tie order must be
+        # first occurrence, exactly like Counter.most_common
+        vals = (["b"] * 3 + ["a"] * 3 + ["z"] * 5 + ["m"] * 3 + ["q"] * 2)
+        col = FeatureColumn.from_values(ft.PickList, vals)
+        f = FeatureBuilder.PickList("c").as_predictor()
+        stage = OneHotVectorizer(top_k=4, min_support=3).set_input(f)
+        model = stage.fit_columns(ColumnarDataset({"c": col}), col)
+        expected = _counter_vocab([v for v in vals], 4, 3)
+        assert model.vocabs == [expected] == [["z", "b", "a", "m"]]
+
+    def test_onehot_random_parity(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import OneHotVectorizer
+
+        col = self._text_col(rng)
+        f = FeatureBuilder.PickList("c").as_predictor()
+        stage = OneHotVectorizer(top_k=10, min_support=2).set_input(f)
+        model = stage.fit_columns(ColumnarDataset({"c": col}), col)
+        oracle = _counter_vocab([v for v in col.values if v is not None],
+                                10, 2)
+        assert model.vocabs == [oracle]
+
+    def test_multipicklist_np_unique_matches_counter(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import MultiPickListVectorizer
+
+        vals = [frozenset(f"t{int(v)}" for v in
+                          rng.integers(0, 12, rng.integers(0, 4)))
+                for _ in range(400)]
+        col = FeatureColumn.from_values(ft.MultiPickList, vals)
+        f = FeatureBuilder.MultiPickList("s").as_predictor()
+        stage = MultiPickListVectorizer(top_k=8, min_support=2).set_input(f)
+        model = stage.fit_columns(ColumnarDataset({"s": col}), col)
+        from collections import Counter
+
+        counts = Counter()
+        for s in col.values:
+            counts.update(s)
+        oracle = [v for v, n in counts.most_common(8) if n >= 2]
+        assert model.vocabs == [oracle]
+
+    def _chunks_of(self, ds: ColumnarDataset, k: int):
+        n = len(ds)
+        return [ds.slice(s, min(s + k, n)) for s in range(0, n, k)]
+
+    def test_streaming_onehot_exact(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import OneHotVectorizer
+
+        col = self._text_col(rng)
+        ds = ColumnarDataset({"c": col})
+        f = FeatureBuilder.PickList("c").as_predictor()
+        incore = OneHotVectorizer(top_k=10, min_support=2).set_input(f)
+        m0 = incore.fit(ds)
+        streaming = OneHotVectorizer(top_k=10, min_support=2).set_input(f)
+        m1 = streaming.fit_streaming(self._chunks_of(ds, 7))
+        assert m0.vocabs == m1.vocabs
+        assert m1.uid == streaming.uid
+
+    def test_streaming_merge_states_exact(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import OneHotVectorizer
+
+        col = self._text_col(rng)
+        ds = ColumnarDataset({"c": col})
+        f = FeatureBuilder.PickList("c").as_predictor()
+        est = OneHotVectorizer(top_k=10, min_support=2).set_input(f)
+        chunks = self._chunks_of(ds, 50)
+        half = len(chunks) // 2
+        a = est.begin_fit()
+        for c in chunks[:half]:
+            a = est.update_chunk(a, c, c["c"])
+        b = est.begin_fit()
+        for c in chunks[half:]:
+            b = est.update_chunk(b, c, c["c"])
+        merged = est.finish_fit(est.merge_states(a, b))
+        assert merged.vocabs == est.fit_columns(ds, col).vocabs
+
+    def test_streaming_real_fills_within_tolerance(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+        vals = np.where(rng.random(1000) < 0.2, np.nan,
+                        rng.normal(50, 9, 1000))
+        col = FeatureColumn.from_values(ft.Real, vals)
+        ds = ColumnarDataset({"x": col})
+        f = FeatureBuilder.Real("x").as_predictor()
+        m0 = RealVectorizer().set_input(f).fit_columns(ds, col)
+        m1 = RealVectorizer().set_input(f).fit_streaming(
+            self._chunks_of(ds, 7))
+        # documented tolerance: chunked float64 accumulation vs numpy's
+        # pairwise sum — last-ulp territory
+        assert m1.fills[0] == pytest.approx(m0.fills[0], rel=1e-12)
+
+    def test_streaming_integral_mode_exact(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import IntegralVectorizer
+
+        vals = [None if rng.random() < 0.1 else int(rng.integers(0, 7))
+                for _ in range(500)]
+        col = FeatureColumn.from_values(ft.Integral, vals)
+        ds = ColumnarDataset({"x": col})
+        f = FeatureBuilder.Integral("x").as_predictor()
+        m0 = IntegralVectorizer().set_input(f).fit_columns(ds, col)
+        m1 = IntegralVectorizer().set_input(f).fit_streaming(
+            self._chunks_of(ds, 13))
+        assert m1.fills == m0.fills
+
+    def test_streaming_smart_text_exact(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import SmartTextVectorizer
+
+        low = [f"cat{int(rng.integers(8))}" for _ in range(300)]
+        high = [f"free text {int(rng.integers(10000))} x" for _ in range(300)]
+        ds = ColumnarDataset({
+            "low": FeatureColumn.from_values(ft.Text, low),
+            "high": FeatureColumn.from_values(ft.Text, high)})
+        fl = FeatureBuilder.Text("low").as_predictor()
+        fh = FeatureBuilder.Text("high").as_predictor()
+        m0 = SmartTextVectorizer(max_cardinality=50, min_support=2).set_input(
+            fl, fh).fit_columns(ds, ds["low"], ds["high"])
+        m1 = SmartTextVectorizer(max_cardinality=50, min_support=2).set_input(
+            fl, fh).fit_streaming(self._chunks_of(ds, 7))
+        assert m0.strategies == m1.strategies == ["pivot", "hash"]
+        assert m0.vocabs == m1.vocabs
+
+
+# ---------------------------------------------------------------------------
+# SanityChecker + MinVarianceFilter streaming fit
+# ---------------------------------------------------------------------------
+
+class TestStreamingSanityChecker:
+    def _dataset(self, rng, n=600):
+        from transmogrifai_tpu.ops.vector_metadata import (
+            VectorColumnMetadata, VectorMetadata)
+
+        y = (rng.random(n) > 0.5).astype(np.float64)
+        X = np.concatenate([
+            rng.normal(0, 1, (n, 4)),
+            (rng.random((n, 3)) < 0.3).astype(np.float64),  # indicators
+            np.zeros((n, 1)),                               # dead column
+            y[:, None] + rng.normal(0, 1e-4, (n, 1)),       # leakage
+        ], axis=1).astype(np.float32)
+        meta = ([VectorColumnMetadata("num", "Real",
+                                      descriptor_value=f"d{i}")
+                 for i in range(4)]
+                + [VectorColumnMetadata("cat", "PickList", grouping="cat",
+                                        indicator_value=f"v{i}")
+                   for i in range(3)]
+                + [VectorColumnMetadata("num", "Real",
+                                        descriptor_value="dead"),
+                   VectorColumnMetadata("leak", "Real",
+                                        descriptor_value="leak")])
+        vmeta = VectorMetadata("features", meta)
+        return ColumnarDataset({
+            "label": FeatureColumn.from_values(ft.RealNN, y),
+            "features": FeatureColumn(ft.OPVector, X, vmeta=vmeta)})
+
+    def _est(self):
+        label = FeatureBuilder.RealNN("label").as_response()
+        vec = FeatureBuilder.OPVector("features").as_predictor()
+        return SanityChecker(max_correlation=0.95).set_input(label, vec)
+
+    def test_streaming_matches_incore_decisions_and_stats(self, rng):
+        ds = self._dataset(rng)
+        m0 = self._est().fit(ds)
+        chunks = [ds.slice(s, min(s + 37, len(ds)))
+                  for s in range(0, len(ds), 37)]
+        m1 = self._est().fit_streaming(chunks)
+        assert m0.keep_indices == m1.keep_indices
+        s0 = m0.metadata["summary"]
+        s1 = m1.metadata["summary"]
+        assert s0["dropped"] == s1["dropped"]
+        for c0, c1 in zip(s0["columnStats"], s1["columnStats"]):
+            assert c1["mean"] == pytest.approx(c0["mean"], abs=1e-5)
+            assert c1["variance"] == pytest.approx(c0["variance"],
+                                                   rel=1e-4, abs=1e-6)
+            assert c1["corr_label"] == pytest.approx(c0["corr_label"],
+                                                     abs=1e-4)
+            if c0["cramers_v"] is not None:
+                assert c1["cramers_v"] == pytest.approx(c0["cramers_v"],
+                                                        abs=1e-5)
+
+    def test_spearman_declares_not_streamable(self):
+        label = FeatureBuilder.RealNN("label").as_response()
+        vec = FeatureBuilder.OPVector("features").as_predictor()
+        est = SanityChecker(correlation_type="spearman").set_input(label, vec)
+        assert not est.supports_streaming_fit
+        with pytest.raises(ValueError, match="spearman"):
+            est.begin_fit()
+
+    def test_min_variance_filter_streaming(self, rng):
+        from transmogrifai_tpu.preparators.sanity_checker import (
+            MinVarianceFilter)
+
+        ds = self._dataset(rng)
+        label = FeatureBuilder.RealNN("label").as_response()
+        vec = FeatureBuilder.OPVector("features").as_predictor()
+        m0 = MinVarianceFilter().set_input(label, vec).fit(ds)
+        chunks = [ds.slice(s, min(s + 41, len(ds)))
+                  for s in range(0, len(ds), 41)]
+        m1 = MinVarianceFilter().set_input(label, vec).fit_streaming(chunks)
+        assert m0.keep_indices == m1.keep_indices
+
+
+# ---------------------------------------------------------------------------
+# GBDT bin edges from the streaming histogram sketch
+# ---------------------------------------------------------------------------
+
+class TestStreamingBinEdges:
+    def test_edges_within_quantile_rank_tolerance(self, rng):
+        from transmogrifai_tpu.models.gbdt_kernels import (
+            quantile_bins, quantile_bins_streaming, streaming_histograms_for)
+
+        X = np.column_stack([
+            rng.normal(0, 1, 20000),
+            rng.lognormal(0, 1, 20000),
+            np.repeat(np.arange(4.0), 5000),  # low cardinality
+        ]).astype(np.float32)
+        max_bins = 32
+        exact = quantile_bins(X, max_bins)
+        chunks = [X[s:s + 1024] for s in range(0, len(X), 1024)]
+        hists = streaming_histograms_for(chunks, hist_bins=8 * max_bins)
+        sketch = quantile_bins_streaming(hists, max_bins)
+        assert sketch.shape == exact.shape
+        # documented tolerance: each finite sketched edge sits within 0.05
+        # quantile RANK of its target (arXiv:1806.11248's eps argument)
+        qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+        for j in range(X.shape[1]):
+            col = np.sort(X[:, j])
+            for q, e in zip(qs, sketch[j]):
+                if not np.isfinite(e):
+                    continue
+                rank = np.searchsorted(col, e) / len(col)
+                assert abs(rank - q) < 0.05, (j, q, e, rank)
+        # low-cardinality column: duplicate edges collapsed to +inf in both
+        assert np.isinf(sketch[2]).sum() > 0
+
+    def test_gbt_estimator_streaming_bin_edges(self, rng):
+        from transmogrifai_tpu.models.gbdt_kernels import quantile_bins
+        from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+
+        X = rng.normal(0, 1, (8000, 5)).astype(np.float32)
+        est = OpXGBoostClassifier(max_bins=16)
+        sketch = est.streaming_bin_edges(
+            X[s:s + 512] for s in range(0, len(X), 512))
+        exact = quantile_bins(X, 16)
+        assert sketch.shape == exact.shape
+        qs = np.linspace(0, 1, 17)[1:-1]
+        for j in range(X.shape[1]):
+            col = np.sort(X[:, j])
+            for q, e in zip(qs, sketch[j]):
+                if np.isfinite(e):
+                    assert abs(np.searchsorted(col, e) / len(col) - q) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: chunked train parity at chunk_rows in {7, 64, N}
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def titanic_df():
+    return make_titanic_like(BASE_ROWS)
+
+
+@pytest.fixture(scope="module")
+def incore_model(titanic_df):
+    prediction = build_titanic_pipeline()
+    wf = OpWorkflow().set_result_features(prediction).set_input_data(
+        titanic_df)
+    model = wf.train()
+    return model, model.score()
+
+
+def _probs(scored):
+    name = next(n for n in scored.names()
+                if issubclass(scored[n].ftype, ft.Prediction))
+    return np.array([d["probability_1"] for d in scored[name].to_list()])
+
+
+def _stage_by_type(model, type_name):
+    return next(s for s in model.stages if type(s).__name__ == type_name)
+
+
+class TestChunkedTrainParity:
+    @pytest.mark.parametrize("chunk_rows", [7, 64, BASE_ROWS])
+    def test_same_params_scores_and_decisions(self, titanic_df,
+                                              incore_model, chunk_rows):
+        m0, s0 = incore_model
+        prediction = build_titanic_pipeline()
+        wf = OpWorkflow().set_result_features(prediction).set_input_data(
+            titanic_df)
+        mk = wf.train(chunk_rows=chunk_rows)
+        # same stage types in the same order
+        assert ([type(s).__name__ for s in mk.stages]
+                == [type(s).__name__ for s in m0.stages])
+        # identical vocabularies (exact counting)
+        for tn in ("OneHotVectorizerModel", "SmartTextVectorizerModel"):
+            assert (_stage_by_type(mk, tn).vocabs
+                    == _stage_by_type(m0, tn).vocabs), tn
+        # fills within the documented streaming-moments tolerance
+        f0 = _stage_by_type(m0, "RealVectorizerModel").fills
+        f1 = _stage_by_type(mk, "RealVectorizerModel").fills
+        assert f1 == pytest.approx(f0, rel=1e-9, abs=1e-9)
+        # identical SanityChecker keep decisions
+        assert (_stage_by_type(mk, "SanityCheckerModel").keep_indices
+                == _stage_by_type(m0, "SanityCheckerModel").keep_indices)
+        # same scores (model fit is float32; fills differ in the last ulps)
+        sk = mk.score()
+        assert _probs(sk) == pytest.approx(_probs(s0), abs=1e-4)
+        # ingest counters: plain fit passes, then the fused
+        # fit+materialize pass and the block-wise assemble phase
+        labels = [p.label for p in mk.ingest_profile.passes]
+        assert any(l.startswith("fit[") for l in labels)
+        assert any(l.startswith("fit+materialize[") for l in labels)
+        assert labels[-1] == "assemble"
+        assert mk.ingest_profile.total_rows == BASE_ROWS
+
+    def test_final_dataset_matches_keep_semantics(self, titanic_df,
+                                                  incore_model):
+        m0, _ = incore_model
+        prediction = build_titanic_pipeline()
+        wf = OpWorkflow().set_result_features(prediction).set_input_data(
+            titanic_df)
+        mk = wf.train(chunk_rows=64)
+        # in-core liveness keeps exactly the keep-set; chunked must agree
+        # on column COUNT and on the packed feature matrix shape (names
+        # embed per-run stage uids, so compare structurally)
+        assert len(mk.train_data.columns) == len(m0.train_data.columns)
+        vec0 = next(c for c in m0.train_data.columns.values()
+                    if c.ftype is ft.OPVector)
+        veck = next(c for c in mk.train_data.columns.values()
+                    if c.ftype is ft.OPVector)
+        assert veck.values.shape == vec0.values.shape
+        assert veck.values.dtype == np.float32
+
+    def test_profile_records_streaming_stages(self, titanic_df):
+        prediction = build_titanic_pipeline()
+        wf = OpWorkflow().set_result_features(prediction).set_input_data(
+            titanic_df)
+        mk = wf.train(chunk_rows=128, profile=True)
+        prof = mk.train_profile
+        assert prof is not None and prof.ingest is mk.ingest_profile
+        kinds = {s.kind for s in prof.stages}
+        assert "fit-stream" in kinds
+        js = prof.to_json()
+        assert js["ingest"]["chunkRows"] == 128
+        assert js["ingest"]["passes"]
+        for p in js["ingest"]["passes"]:
+            assert p["rows"] == BASE_ROWS
+            assert p["wallSecs"] >= 0
+        assert mk.ingest_profile.format()
+
+    def test_chunked_csv_train_matches_dataframe_train(self, titanic_df,
+                                                       incore_model,
+                                                       tmp_path):
+        """Out-of-core from an actual file: CSV chunks -> same model."""
+        m0, s0 = incore_model
+        path = str(tmp_path / "titanic.csv")
+        titanic_df.to_csv(path, index=False)
+        prediction = build_titanic_pipeline()
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_reader(CSVReader(path)))
+        mk = wf.train(chunk_rows=100)
+        assert (_stage_by_type(mk, "SanityCheckerModel").keep_indices
+                == _stage_by_type(m0, "SanityCheckerModel").keep_indices)
+        sk = mk.score(data=titanic_df)
+        assert _probs(sk) == pytest.approx(_probs(s0), abs=1e-4)
+        assert mk.ingest_profile.total_bytes > 0
+
+    def test_naive_bayes_streams_whole_train(self, titanic_df):
+        """With NaiveBayes the WHOLE train streams (no in-core tail): the
+        cascade fits the model from per-class sums over retained blocks
+        and scores block-wise into the packed output."""
+        from transmogrifai_tpu.models import OpNaiveBayes
+
+        def build_nb():
+            survived = FeatureBuilder.RealNN("Survived").as_response()
+            predictors = [
+                FeatureBuilder.PickList("Pclass").as_predictor(),
+                FeatureBuilder.PickList("Sex").as_predictor(),
+                FeatureBuilder.Real("Age").as_predictor(),
+                FeatureBuilder.Real("Fare").as_predictor(),
+                FeatureBuilder.PickList("Embarked").as_predictor(),
+            ]
+            features = transmogrify(predictors)
+            checked = SanityChecker(max_correlation=0.99).set_input(
+                survived, features).get_output()
+            return OpNaiveBayes().set_input(survived, checked).get_output()
+
+        wf0 = OpWorkflow().set_result_features(build_nb()).set_input_data(
+            titanic_df)
+        m0 = wf0.train()
+        wfk = OpWorkflow().set_result_features(build_nb()).set_input_data(
+            titanic_df)
+        mk = wfk.train(chunk_rows=97)
+        # the streamed NB fit matches the in-core device fit (documented
+        # tolerance: float64 chunk sums vs float32 one-hot matmul)
+        nb0 = _stage_by_type(m0, "NaiveBayesModel")
+        nbk = _stage_by_type(mk, "NaiveBayesModel")
+        assert np.asarray(nbk.log_prior) == pytest.approx(
+            np.asarray(nb0.log_prior), abs=1e-4)
+        assert np.asarray(nbk.log_lik) == pytest.approx(
+            np.asarray(nb0.log_lik), abs=1e-4)
+        assert _probs(mk.score()) == pytest.approx(
+            _probs(m0.score()), abs=1e-4)
+        labels = [p.label for p in mk.ingest_profile.passes]
+        assert any(l.startswith("fit-blocks[") for l in labels)
+
+    def test_unsupported_combinations_raise(self, titanic_df):
+        prediction = build_titanic_pipeline()
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(titanic_df).with_workflow_cv())
+        with pytest.raises(ValueError, match="workflow-level CV"):
+            wf.train(chunk_rows=64)
+
+    def test_block_spill_parity_and_cleanup(self, titanic_df, incore_model,
+                                            monkeypatch, tmp_path):
+        """A tiny retain budget forces the fused pass's retained blocks to
+        disk; results must be identical and the spill file removed."""
+        m0, s0 = incore_model
+        monkeypatch.setenv("TMOG_STREAM_RETAIN_MB", "0.01")
+        monkeypatch.setenv("TMPDIR", str(tmp_path))
+        import tempfile
+        tempfile.tempdir = None  # re-read TMPDIR
+        try:
+            prediction = build_titanic_pipeline()
+            wf = OpWorkflow().set_result_features(
+                prediction).set_input_data(titanic_df)
+            mk = wf.train(chunk_rows=64)
+        finally:
+            tempfile.tempdir = None
+        assert mk.ingest_profile.spilled_bytes > 0
+        assert mk.ingest_profile.to_json()["spilledBytes"] > 0
+        assert (_stage_by_type(mk, "SanityCheckerModel").keep_indices
+                == _stage_by_type(m0, "SanityCheckerModel").keep_indices)
+        assert _probs(mk.score()) == pytest.approx(_probs(s0), abs=1e-4)
+        assert not list(tmp_path.glob("tmog_spill_*"))  # cleaned up
+
+    def test_chunk_rows_none_is_default_path(self, titanic_df):
+        """train(chunk_rows=None) goes through the unchanged in-core
+        executor: no ingest profile exists."""
+        prediction = build_titanic_pipeline()
+        wf = OpWorkflow().set_result_features(prediction).set_input_data(
+            titanic_df)
+        model = wf.train(chunk_rows=None)
+        assert model.ingest_profile is None
+
+
+# ---------------------------------------------------------------------------
+# TopKSketch unit behavior
+# ---------------------------------------------------------------------------
+
+class TestTopKSketch:
+    def test_exact_matches_counter_with_ties(self):
+        from collections import Counter
+
+        from transmogrifai_tpu.utils.sketches import TopKSketch
+
+        vals = ["b", "a", "b", "c", "a", "d", "c", "b", "e"]
+        sk = TopKSketch()
+        for s in range(0, len(vals), 2):
+            sk.add_chunk(vals[s:s + 2])
+        oracle = [v for v, _ in Counter(vals).most_common(4)]
+        assert sk.top_k(4) == oracle
+
+    def test_bounded_capacity_keeps_heavy_hitters(self, rng):
+        from transmogrifai_tpu.utils.sketches import TopKSketch
+
+        # two heavy keys among a long tail; capacity far below cardinality
+        tail = [f"t{int(v)}" for v in rng.integers(0, 500, 2000)]
+        vals = ["HOT"] * 800 + ["WARM"] * 400 + tail
+        rng.shuffle(vals)
+        sk = TopKSketch(capacity=64)
+        for s in range(0, len(vals), 97):
+            sk.add_chunk(vals[s:s + 97])
+        top2 = sk.top_k(2)
+        assert top2 == ["HOT", "WARM"]
+        assert sk.error > 0  # evictions happened and were accounted
+
+    def test_merge_shifts_first_seen(self):
+        from transmogrifai_tpu.utils.sketches import TopKSketch
+
+        a = TopKSketch().add_chunk(["x", "y"])
+        b = TopKSketch().add_chunk(["z", "x"])
+        merged = a.merge(b)
+        # x:2 first, then ties y/z break by global first occurrence
+        assert merged.top_k(3) == ["x", "y", "z"]

@@ -829,6 +829,22 @@ class _GBTBase(PredictorEstimator):
 
         return self.hist_precision == "bf16" and _accel_bf16()
 
+    def streaming_bin_edges(self, chunks, hist_bins: int = 0) -> np.ndarray:
+        """Quantile bin edges from CHUNKED feature matrices — the sketch
+        half of an external-memory tree fit (arXiv:1806.11248): per-feature
+        ``StreamingHistogram`` sketches absorb (n, D) chunks, then edges
+        come from the sketch quantiles (``gbdt_kernels.
+        quantile_bins_streaming``; documented rank tolerance ~0.05 at the
+        default ``8 * max_bins`` sketch budget).  The tree growth itself
+        consumes the materialized packed matrix (the two-pass driver's
+        output), exactly like the paper's split."""
+        from .gbdt_kernels import (quantile_bins_streaming,
+                                   streaming_histograms_for)
+
+        hists = streaming_histograms_for(
+            chunks, hist_bins=hist_bins or 8 * self.max_bins)
+        return quantile_bins_streaming(hists, self.max_bins)
+
     def with_mesh(self, mesh) -> "_GBTBase":
         """Multi-chip boosting: the binned matrix, labels and per-row state
         (margins, gradients) live row-sharded on the mesh's data axis and

@@ -50,6 +50,30 @@ def _vec_column(arr: np.ndarray, meta: VectorMetadata) -> FeatureColumn:
     return FeatureColumn(OPVector, np.asarray(arr, dtype=np.float32), vmeta=meta)
 
 
+def _pivot_vocab(values, top_k: int, min_support: int) -> List:
+    """TopK pivot vocabulary via ONE vectorized ``np.unique`` pass.
+
+    Replaces the per-row Python ``Counter`` loop (the hot part of the
+    OneHot/MultiPickList fit at scale) while reproducing
+    ``Counter.most_common(top_k)`` EXACTLY, including its tie order: keys
+    tie-break by insertion order = first occurrence, so rank by
+    ``(-count, first_index)``.  Falls back to the Counter loop for values
+    ``np.unique`` cannot sort (mixed/unhashable-by-comparison cells).
+    """
+    arr = np.asarray(list(values) if not isinstance(values, np.ndarray)
+                     else values, dtype=object)
+    if arr.size == 0:
+        return []
+    try:
+        uniq, first, cnt = np.unique(arr, return_index=True,
+                                     return_counts=True)
+    except TypeError:  # non-comparable mix: keep the legacy loop semantics
+        counts = Counter(arr.tolist())
+        return [v for v, n in counts.most_common(top_k) if n >= min_support]
+    order = np.lexsort((first, -cnt))[:top_k]
+    return [uniq[i] for i in order if cnt[i] >= min_support]
+
+
 # ---------------------------------------------------------------------------
 # Numerics
 # ---------------------------------------------------------------------------
@@ -77,6 +101,31 @@ class RealVectorizer(SequenceEstimator):
                 fills.append(float(np.nan_to_num(vals)[m].mean()) if m.any() else self.fill_value)
             else:
                 fills.append(float(self.fill_value))
+        return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+
+    # -- streaming fit: Welford moments per column (mean fill) --------------
+    # Chunked means match the in-core fit to ~1e-12 relative (documented:
+    # chunked float64 summation order vs numpy's pairwise sum).
+
+    supports_streaming_fit = True
+
+    def begin_fit(self):
+        from ..utils.sketches import WelfordMoments
+
+        return [WelfordMoments() for _ in self.input_features]
+
+    def update_chunk(self, state, data, *cols):
+        for mom, c in zip(state, cols):
+            vals = np.nan_to_num(np.asarray(c.values, dtype=np.float64))
+            mom.update(vals[np.asarray(c.mask)])
+        return state
+
+    def merge_states(self, a, b):
+        return [ma.merge(mb) for ma, mb in zip(a, b)]
+
+    def finish_fit(self, state):
+        fills = [float(mom.mean) if self.fill_with_mean and mom.n > 0
+                 else float(self.fill_value) for mom in state]
         return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
 
 
@@ -158,6 +207,40 @@ class IntegralVectorizer(SequenceEstimator):
                 fills.append(float(self.fill_value))
         return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
 
+    # -- streaming fit: mergeable value counts per column (mode fill) -------
+    # EXACT vs in-core: the in-core argmax over ascending-sorted uniques
+    # picks the smallest value among tied modes, replicated in finish_fit.
+
+    supports_streaming_fit = True
+
+    def begin_fit(self):
+        return [dict() for _ in self.input_features]
+
+    def update_chunk(self, state, data, *cols):
+        for counts, c in zip(state, cols):
+            vals = np.asarray(c.values)[np.asarray(c.mask)]
+            if len(vals):
+                uniq, cnt = np.unique(vals, return_counts=True)
+                for v, n in zip(uniq, cnt):
+                    counts[float(v)] = counts.get(float(v), 0) + int(n)
+        return state
+
+    def merge_states(self, a, b):
+        for ca, cb in zip(a, b):
+            for v, n in cb.items():
+                ca[v] = ca.get(v, 0) + n
+        return a
+
+    def finish_fit(self, state):
+        fills = []
+        for counts in state:
+            if self.fill_with_mode and counts:
+                best = max(counts.items(), key=lambda kv: (kv[1], -kv[0]))
+                fills.append(float(best[0]))
+            else:
+                fills.append(float(self.fill_value))
+        return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+
 
 class BinaryVectorizer(SequenceTransformer):
     """Binary -> {0,1} with fill + null tracking (stateless)."""
@@ -206,13 +289,33 @@ class OneHotVectorizer(SequenceEstimator):
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         vocabs: List[List[str]] = []
         for c in cols:
-            vals = [v for v in c.values if v is not None]
-            counts = Counter(vals)
-            top = [
-                v for v, n in counts.most_common(self.top_k)
-                if n >= self.min_support
-            ]
-            vocabs.append(top)
+            # vectorized count (one np.unique) instead of the per-row
+            # Counter loop; _pivot_vocab reproduces most_common exactly
+            vals = c.values[np.not_equal(c.values, None)]
+            vocabs.append(_pivot_vocab(vals, self.top_k, self.min_support))
+        return OneHotVectorizerModel(
+            vocabs=vocabs, track_nulls=self.track_nulls,
+            unseen_to_other=self.unseen_to_other)
+
+    # -- streaming fit: mergeable top-k counting per column -----------------
+
+    supports_streaming_fit = True
+
+    def begin_fit(self):
+        from ..utils.sketches import TopKSketch
+
+        return [TopKSketch() for _ in self.input_features]
+
+    def update_chunk(self, state, data, *cols):
+        for sk, c in zip(state, cols):
+            sk.add_chunk(c.values[np.not_equal(c.values, None)])
+        return state
+
+    def merge_states(self, a, b):
+        return [sa.merge(sb) for sa, sb in zip(a, b)]
+
+    def finish_fit(self, state):
+        vocabs = [sk.top_k(self.top_k, self.min_support) for sk in state]
         return OneHotVectorizerModel(
             vocabs=vocabs, track_nulls=self.track_nulls,
             unseen_to_other=self.unseen_to_other)
@@ -270,14 +373,34 @@ class MultiPickListVectorizer(SequenceEstimator):
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         vocabs = []
         for c in cols:
-            counts: Counter = Counter()
-            for s in c.values:
-                counts.update(s)
-            vocabs.append([
-                v for v, n in counts.most_common(self.top_k)
-                if n >= self.min_support
-            ])
+            # multi-valued cells: flatten once, then one vectorized
+            # np.unique — the flattened order equals Counter.update(s)'s
+            # insertion order, so ties still break identically
+            flat = [v for s in c.values for v in s]
+            vocabs.append(_pivot_vocab(flat, self.top_k, self.min_support))
         return MultiPickListVectorizerModel(vocabs=vocabs, track_nulls=self.track_nulls)
+
+    # -- streaming fit: mergeable top-k over flattened set elements ---------
+
+    supports_streaming_fit = True
+
+    def begin_fit(self):
+        from ..utils.sketches import TopKSketch
+
+        return [TopKSketch() for _ in self.input_features]
+
+    def update_chunk(self, state, data, *cols):
+        for sk, c in zip(state, cols):
+            sk.add_chunk([v for s in c.values for v in s])
+        return state
+
+    def merge_states(self, a, b):
+        return [sa.merge(sb) for sa, sb in zip(a, b)]
+
+    def finish_fit(self, state):
+        vocabs = [sk.top_k(self.top_k, self.min_support) for sk in state]
+        return MultiPickListVectorizerModel(vocabs=vocabs,
+                                            track_nulls=self.track_nulls)
 
 
 class MultiPickListVectorizerModel(SequenceModel):
@@ -491,12 +614,12 @@ class SmartTextVectorizer(SequenceEstimator):
         self.track_text_len = track_text_len
         self.seed = seed
 
-    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+    def _decide(self, stats_list: List[TextStats]):
+        """Strategy + vocab per field from fitted TextStats (shared by the
+        in-core fit and the streaming finish — TextStats is already a
+        mergeable monoid, SmartTextVectorizer.scala:207-247)."""
         strategies, vocabs = [], []
-        for c in cols:
-            stats = TextStats(self.max_cardinality)
-            for v in c.values:
-                stats.update(v)
+        for stats in stats_list:
             fill = (stats.n - stats.n_null) / max(stats.n, 1)
             if fill < self.min_fill_rate:
                 strategies.append(self.IGNORE)
@@ -517,6 +640,40 @@ class SmartTextVectorizer(SequenceEstimator):
             num_hash_features=self.num_hash_features,
             track_nulls=self.track_nulls, track_text_len=self.track_text_len,
             seed=self.seed)
+
+    def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
+        stats_list = []
+        for c in cols:
+            stats = TextStats(self.max_cardinality)
+            for v in c.values:
+                stats.update(v)
+            stats_list.append(stats)
+        return self._decide(stats_list)
+
+    # -- streaming fit: per-chunk TextStats merged left-to-right ------------
+    # Exact vs in-core: saturation/decision logic only consults complete
+    # counts (any chunk that saturates forces HASH in both paths), and
+    # Counter.__add__ preserves global first-occurrence tie order.
+
+    supports_streaming_fit = True
+
+    def begin_fit(self):
+        return [TextStats(self.max_cardinality) for _ in self.input_features]
+
+    def update_chunk(self, state, data, *cols):
+        new = []
+        for stats, c in zip(state, cols):
+            chunk_stats = TextStats(self.max_cardinality)
+            for v in c.values:
+                chunk_stats.update(v)
+            new.append(stats.merge(chunk_stats))
+        return new
+
+    def merge_states(self, a, b):
+        return [sa.merge(sb) for sa, sb in zip(a, b)]
+
+    def finish_fit(self, state):
+        return self._decide(state)
 
 
 class SmartTextVectorizerModel(SequenceModel):

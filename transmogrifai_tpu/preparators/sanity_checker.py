@@ -187,15 +187,26 @@ class SanityChecker(BinaryEstimator):
         group_cv: Dict[Tuple[str, Optional[str]], float] = {}
         if is_cat_label and vmeta.size == d:
             labels_int = np.searchsorted(uniq, y)
-            groups: Dict[Tuple[str, Optional[str]], List[int]] = {}
-            for i, c in enumerate(vmeta.columns):
-                if c.indicator_value is not None:
-                    groups.setdefault((c.parent_feature, c.grouping), []).append(i)
-            for key, idxs in groups.items():
+            for key, idxs in self._indicator_groups(vmeta).items():
                 res = cramers_v(labels_int, X[:, idxs], len(uniq))
                 group_cv[key] = res["cramersV"]
 
-        # drop rules (DerivedFeatureFilterUtils.getFeaturesToDrop parity)
+        return self._finalize(mean_h, variance, min_h, max_h, corr,
+                              group_cv, vmeta, n, d)
+
+    @staticmethod
+    def _indicator_groups(vmeta) -> Dict[Tuple[str, Optional[str]], List[int]]:
+        groups: Dict[Tuple[str, Optional[str]], List[int]] = {}
+        for i, c in enumerate(vmeta.columns):
+            if c.indicator_value is not None:
+                groups.setdefault((c.parent_feature, c.grouping), []).append(i)
+        return groups
+
+    def _finalize(self, mean_h, variance, min_h, max_h, corr, group_cv,
+                  vmeta, n: int, d: int) -> "SanityCheckerModel":
+        """Drop rules + summary + model from computed column statistics
+        (DerivedFeatureFilterUtils.getFeaturesToDrop parity) — shared by
+        the in-core fit and the streaming finish_fit."""
         to_drop = np.zeros(d, dtype=bool)
         reasons: List[List[str]] = [[] for _ in range(d)]
         for j in range(d):
@@ -249,6 +260,126 @@ class SanityChecker(BinaryEstimator):
         model = SanityCheckerModel(keep_indices=keep)
         model._new_vmeta = new_meta
         return model
+
+    # -- streaming fit: moment + co-moment + contingency accumulators -------
+    #
+    # Column stats and label correlation accumulate via PearsonSketch
+    # (Chan-merged float64 moments: matches in-core to ~1e-6, limited by the
+    # in-core float32 stat paths; KEEP decisions are threshold comparisons
+    # and match exactly on non-degenerate data).  Cramér's V contingency
+    # sums are exact (integer-valued one-hot sums).  Spearman needs a
+    # global rank sort and cannot stream — supports_streaming_fit is False
+    # then and the two-pass driver materializes instead.
+
+    @property
+    def supports_streaming_fit(self) -> bool:  # type: ignore[override]
+        return self.correlation_type != "spearman"
+
+    class _StreamState:
+        __slots__ = ("pearson", "label_values", "label_sums", "vmeta",
+                     "d", "rng")
+
+        def __init__(self, rng):
+            from ..utils.sketches import PearsonSketch
+
+            self.pearson = PearsonSketch()
+            self.label_values = np.zeros(0, np.float64)
+            self.label_sums: Optional[Dict[float, np.ndarray]] = {}
+            self.vmeta = None
+            self.d: Optional[int] = None
+            self.rng = rng
+
+    def begin_fit(self):
+        if self.correlation_type == "spearman":
+            raise ValueError(
+                "SanityChecker streaming fit requires a streamable "
+                "correlation (spearman needs a global rank sort)")
+        rng = (np.random.default_rng(self.sample_seed)
+               if self.check_sample < 1.0 else None)
+        return SanityChecker._StreamState(rng)
+
+    #: streaming Cramér's V tracks per-label column sums; past this many
+    #: distinct label values the label cannot be categorical for any
+    #: reasonable config and the contingency accumulator is abandoned
+    _STREAM_LABEL_CAP_HARD = 4096
+
+    def update_chunk(self, state, data, label_col, features_col):
+        X = _matrix_f32(features_col.values)
+        y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
+        if state.rng is not None:
+            # the SAME rng stream as the in-core sample: successive
+            # chunk-length draws continue one PCG64 sequence, so the
+            # selected rows match the monolithic fit's row-for-row
+            sel = state.rng.random(len(y)) < self.check_sample
+            X, y = X[sel], y[sel]
+        if state.d is None:
+            state.d = X.shape[1]
+            state.vmeta = features_col.vmeta
+        if len(y) == 0:
+            return state
+        state.pearson.update(X, y)
+        uniq = np.unique(y)
+        state.label_values = np.union1d(state.label_values, uniq)
+        cap = (self._STREAM_LABEL_CAP_HARD if self.categorical_label
+               else self.max_label_classes)
+        if self.categorical_label is False \
+                or len(state.label_values) > cap:
+            state.label_sums = None
+        if state.label_sums is not None:
+            for uv in uniq:
+                # gather stays float32 (no full f64 copy); the per-column
+                # accumulation is float64 and exact for one-hot indicators
+                sums = X[y == uv].sum(axis=0, dtype=np.float64)
+                key = float(uv)
+                prev = state.label_sums.get(key)
+                state.label_sums[key] = (sums if prev is None
+                                         else prev + sums)
+        return state
+
+    def merge_states(self, a, b):
+        if b.d is None:
+            return a
+        if a.d is None:
+            return b
+        a.pearson.merge(b.pearson)
+        a.label_values = np.union1d(a.label_values, b.label_values)
+        if a.label_sums is None or b.label_sums is None:
+            a.label_sums = None
+        else:
+            for k, v in b.label_sums.items():
+                prev = a.label_sums.get(k)
+                a.label_sums[k] = v if prev is None else prev + v
+        return a
+
+    def finish_fit(self, state) -> "SanityCheckerModel":
+        from ..ops.stats import contingency_stats
+
+        d = state.d or 0
+        n = int(state.pearson.x.n) if state.pearson.c is not None else 0
+        if n == 0 or d == 0:
+            raise ValueError("SanityChecker streaming fit saw no rows")
+        vmeta = state.vmeta or VectorMetadata("features", [])
+        mean_h = np.asarray(state.pearson.x.mean)
+        variance = np.asarray(state.pearson.x.variance(ddof=1))
+        min_h = np.asarray(state.pearson.x.min)
+        max_h = np.asarray(state.pearson.x.max)
+        corr = state.pearson.correlation()
+
+        uniq = state.label_values
+        is_cat_label = (self.categorical_label
+                        if self.categorical_label is not None
+                        else len(uniq) <= min(self.max_label_classes,
+                                              n // 2))
+        group_cv: Dict[Tuple[str, Optional[str]], float] = {}
+        if (is_cat_label and vmeta.size == d
+                and state.label_sums is not None):
+            tbl_full = np.stack([state.label_sums[float(v)] for v in uniq])
+            for key, idxs in self._indicator_groups(vmeta).items():
+                group_cv[key] = contingency_stats(
+                    tbl_full[:, idxs])["cramersV"]
+
+        return self._finalize(mean_h, variance, min_h, max_h, corr,
+                              group_cv, vmeta, n, d)
 
 
 class _VmetaExtraState:
@@ -311,6 +442,49 @@ class MinVarianceFilter(BinaryEstimator):
         model = MinVarianceFilterModel(keep_indices=keep)
         model._new_vmeta = (vmeta.select(keep)
                             if vmeta and vmeta.size == X.shape[1] else None)
+        return model
+
+    # -- streaming fit: variance via Welford moments ------------------------
+
+    supports_streaming_fit = True
+
+    def begin_fit(self):
+        from ..utils.sketches import WelfordMoments
+
+        return {"moments": WelfordMoments(), "vmeta": None, "d": None}
+
+    def update_chunk(self, state, data, *cols):
+        features_col = cols[-1]
+        X = _matrix_f32(features_col.values)
+        if state["d"] is None:
+            state["d"] = X.shape[1]
+            state["vmeta"] = features_col.vmeta
+        state["moments"].update(X)
+        return state
+
+    def merge_states(self, a, b):
+        if b["d"] is None:
+            return a
+        if a["d"] is None:
+            return b
+        a["moments"].merge(b["moments"])
+        return a
+
+    def finish_fit(self, state) -> "MinVarianceFilterModel":
+        if state["d"] is None:
+            raise ValueError("MinVarianceFilter streaming fit saw no rows")
+        d = state["d"]
+        variance = np.asarray(state["moments"].variance(ddof=1))
+        keep = [j for j in range(d) if variance[j] >= self.min_variance]
+        vmeta = state["vmeta"]
+        self.metadata["summary"] = {
+            "dropped": ([vmeta.column_names()[j] for j in range(d)
+                         if j not in set(keep)]
+                        if vmeta and vmeta.size == d else []),
+        }
+        model = MinVarianceFilterModel(keep_indices=keep)
+        model._new_vmeta = (vmeta.select(keep)
+                            if vmeta and vmeta.size == d else None)
         return model
 
 

@@ -1,4 +1,5 @@
-from .base import Reader, DataFrameReader, RecordsReader, reader_for  # noqa: F401
+from .base import (Reader, DataFrameReader, RecordsReader,  # noqa: F401
+                   reader_for, ChunkStream)
 from .streaming import (AsyncBatcher, FileStreamingReader,  # noqa: F401
                         IteratorStreamingReader, StreamingReader,
                         StreamingReaders)

@@ -332,10 +332,42 @@ def _snappy_decompress(data: bytes) -> bytes:
 # object container files
 # ---------------------------------------------------------------------------
 
-def read_avro(path: str) -> Tuple[Dict[str, Any], List[dict]]:
-    """Read an Avro OCF: returns (writer schema, records)."""
-    raw = open(path, "rb").read()
-    dec = _Decoder(raw)
+class _FileDecoder:
+    """Varint/bytes primitives over a FILE OBJECT — used for the container
+    header and block framing of the streaming reader, so a chunked read
+    never loads the whole file.  Record payloads still decode through the
+    in-memory ``_Decoder`` hot path, block by block."""
+
+    def __init__(self, fh):
+        self.fh = fh
+
+    def read(self, n: int) -> bytes:
+        b = self.fh.read(n)
+        if len(b) != n:
+            raise EOFError("truncated avro data")
+        return b
+
+    def read_long(self) -> int:
+        shift, acc = 0, 0
+        while True:
+            b = self.fh.read(1)
+            if not b:
+                raise EOFError("truncated avro varint")
+            acc |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # zig-zag
+
+    def read_bytes(self) -> bytes:
+        return self.read(self.read_long())
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+
+def _read_header(dec, path: str):
+    """(schema, codec, sync, named) from an OCF header."""
     if dec.read(4) != _MAGIC:
         raise ValueError(f"{path}: not an Avro object container file")
     meta: Dict[str, bytes] = {}
@@ -356,24 +388,62 @@ def read_avro(path: str) -> Tuple[Dict[str, Any], List[dict]]:
     sync = dec.read(16)
     named: Dict[str, Any] = {}
     _register_named(schema, named)
+    return schema, codec, sync, named
+
+
+def _decode_block(block: bytes, count: int, codec: str, schema, named,
+                  path: str) -> List[dict]:
+    if codec == "deflate":
+        block = zlib.decompress(block, -15)
+    elif codec == "snappy":
+        crc = int.from_bytes(block[-4:], "big")
+        block = _snappy_decompress(block[:-4])
+        if zlib.crc32(block) & 0xFFFFFFFF != crc:
+            raise ValueError(f"{path}: snappy block CRC mismatch")
+    bdec = _Decoder(block)
+    return [_decode(schema, bdec, named) for _ in range(count)]
+
+
+def read_avro(path: str) -> Tuple[Dict[str, Any], List[dict]]:
+    """Read an Avro OCF: returns (writer schema, records)."""
+    raw = open(path, "rb").read()
+    dec = _Decoder(raw)
+    schema, codec, sync, named = _read_header(dec, path)
     records: List[dict] = []
     while dec.pos < len(raw):
         count = dec.read_long()
         size = dec.read_long()
         block = dec.read(size)
-        if codec == "deflate":
-            block = zlib.decompress(block, -15)
-        elif codec == "snappy":
-            crc = int.from_bytes(block[-4:], "big")
-            block = _snappy_decompress(block[:-4])
-            if zlib.crc32(block) & 0xFFFFFFFF != crc:
-                raise ValueError(f"{path}: snappy block CRC mismatch")
-        bdec = _Decoder(block)
-        for _ in range(count):
-            records.append(_decode(schema, bdec, named))
+        records.extend(_decode_block(block, count, codec, schema, named,
+                                     path))
         if dec.read(16) != sync:
             raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
     return schema, records
+
+
+def iter_avro_blocks(path: str, bytes_pos: Optional[dict] = None):
+    """Stream an Avro OCF block by block: yields ``(schema, records)`` per
+    container block without ever holding the whole file or record list.
+    ``bytes_pos["bytes"]``, when a dict is passed, tracks the file position
+    after each yielded block (ingest byte accounting)."""
+    with open(path, "rb") as fh:
+        dec = _FileDecoder(fh)
+        schema, codec, sync, named = _read_header(dec, path)
+        while True:
+            probe = fh.read(1)
+            if not probe:
+                return
+            fh.seek(-1, 1)
+            count = dec.read_long()
+            size = dec.read_long()
+            block = dec.read(size)
+            records = _decode_block(block, count, codec, schema, named, path)
+            if dec.read(16) != sync:
+                raise ValueError(
+                    f"{path}: sync marker mismatch (corrupt block)")
+            if bytes_pos is not None:
+                bytes_pos["bytes"] = fh.tell()
+            yield schema, records
 
 
 def write_avro(path: str, schema: Dict[str, Any], records: Sequence[dict],
@@ -496,6 +566,35 @@ class AvroReader(Reader):
         return RecordsReader(self.records,
                              key_fn=key_fn).generate_dataset(raw_features)
 
+    def iter_chunks(self, raw_features: Sequence[Feature],
+                    chunk_rows: int):
+        """Block-streaming chunked read: container blocks decode one at a
+        time and regroup into ``chunk_rows`` record batches — at most one
+        block plus one chunk of records is ever resident."""
+        from .base import ChunkStream
+
+        if chunk_rows <= 0:
+            raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+        key_fn = ((lambda r: str(r.get(self.key_field)))
+                  if self.key_field else None)
+        pos = {"bytes": 0}
+
+        def gen():
+            pending: List[dict] = []
+            for _schema, records in iter_avro_blocks(self.path,
+                                                     bytes_pos=pos):
+                pending.extend(records)
+                while len(pending) >= chunk_rows:
+                    batch, pending = (pending[:chunk_rows],
+                                      pending[chunk_rows:])
+                    yield RecordsReader(batch, key_fn=key_fn
+                                        ).generate_dataset(raw_features)
+            if pending:
+                yield RecordsReader(pending, key_fn=key_fn
+                                    ).generate_dataset(raw_features)
+
+        return ChunkStream(gen(), bytes_fn=lambda: pos["bytes"])
+
 
 class AvroSchemaCSVReader(Reader):
     """CSV columns NAMED by an Avro schema (CSVReaders.scala /
@@ -536,3 +635,44 @@ class AvroSchemaCSVReader(Reader):
             out.set("key", FeatureColumn.from_values(
                 ft.ID, [str(v) for v in df[self.key_field].tolist()]))
         return out
+
+    def iter_chunks(self, raw_features: Sequence[Feature],
+                    chunk_rows: int):
+        """Chunked schema-typed CSV: pandas' streaming parser with the
+        .avsc field names; feature-declared types drive materialization
+        exactly as in ``generate_dataset``."""
+        import pandas as pd
+
+        from .base import ChunkStream
+
+        if chunk_rows <= 0:
+            raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+        names = [f["name"] for f in self.schema["fields"]]
+        fh = open(self.csv_path, "rb")
+        pos = {"bytes": 0}
+
+        def one(df) -> ColumnarDataset:
+            out = ColumnarDataset()
+            for f in raw_features:
+                if f.name not in df.columns:
+                    raise KeyError(f"{f.name!r} not in avro schema fields "
+                                   f"{names}")
+                out.set(f.name, FeatureColumn.from_values(
+                    f.ftype, df[f.name].tolist()))
+            if self.key_field and self.key_field in df.columns:
+                out.set("key", FeatureColumn.from_values(
+                    ft.ID, [str(v) for v in df[self.key_field].tolist()]))
+            return out
+
+        def gen():
+            try:
+                with pd.read_csv(fh, header=None, names=names,
+                                 skipinitialspace=True,
+                                 chunksize=chunk_rows) as it:
+                    for df in it:
+                        pos["bytes"] = fh.tell()
+                        yield one(df)
+            finally:
+                fh.close()
+
+        return ChunkStream(gen(), bytes_fn=lambda: pos["bytes"])

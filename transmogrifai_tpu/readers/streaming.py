@@ -116,7 +116,14 @@ class FileStreamingReader(StreamingReader):
 class AsyncBatcher:
     """Bounded-queue prefetcher: a background thread columnarizes upcoming
     micro-batches while the device scores the current one — the host/device
-    pipelining that replaces Spark Streaming's receiver."""
+    pipelining that replaces Spark Streaming's receiver.
+
+    A proper iterator (``__iter__``/``__next__``): a producer-thread
+    exception is captured and RE-RAISED from ``__next__`` after the items
+    that preceded it have been consumed — the stream never ends silently
+    on a mid-stream reader failure.  After exhaustion (or the re-raise)
+    every further ``__next__`` raises ``StopIteration``.
+    """
 
     _DONE = object()
 
@@ -124,6 +131,7 @@ class AsyncBatcher:
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
         self._err: Optional[BaseException] = None
         self._closed = threading.Event()
+        self._exhausted = False
 
         # the pump must not block forever on a full queue once the consumer
         # is gone (early break / scoring error), so puts poll the closed flag
@@ -155,17 +163,21 @@ class AsyncBatcher:
         """Release the pump thread; safe to call any time."""
         self._closed.set()
 
-    def __iter__(self):
-        try:
-            while True:
-                item = self._q.get()
-                if item is self._DONE:
-                    if self._err is not None:
-                        raise self._err
-                    return
-                yield item
-        finally:
+    def __iter__(self) -> "AsyncBatcher":
+        return self
+
+    def __next__(self) -> ColumnarDataset:
+        if self._exhausted:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._exhausted = True
             self.close()
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        return item
 
 
 class StreamingReaders:

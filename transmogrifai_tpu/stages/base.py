@@ -227,8 +227,83 @@ class Model(Transformer):
 class Estimator(PipelineStage):
     """A stage that must be fit before it can transform."""
 
+    #: True when the subclass implements the mergeable streaming-fit
+    #: protocol (begin_fit / update_chunk / merge_states / finish_fit) —
+    #: the out-of-core two-pass driver (workflow/streaming.py) fits such
+    #: stages one bounded chunk at a time instead of on a materialized
+    #: dataset.  May be a property (e.g. SanityChecker streams for Pearson
+    #: but not Spearman).
+    supports_streaming_fit: bool = False
+
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn) -> Model:
         raise NotImplementedError
+
+    # -- streaming-fit protocol (XGBoost-style two-pass external memory) ----
+    #
+    # State objects are opaque to callers; the contract is:
+    #   state = est.begin_fit()
+    #   for chunk in chunks:  state = est.update_chunk(state, chunk, *cols)
+    #   state = est.merge_states(a, b)   # associative combine (parallel
+    #                                    # readers); chunk order still
+    #                                    # matters for tie-break ordering
+    #   model = est.finish_fit(state)    # NOT uid-wired; use fit_streaming
+    #                                    # or adopt_model for DAG use
+    # Implementations must be equivalent to ``fit_columns`` on the
+    # concatenated chunks — exact for counting-based fits (vocabs, modes),
+    # within documented float tolerance for moment-based fits.
+
+    def begin_fit(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support streaming fit")
+
+    def update_chunk(self, state, data: ColumnarDataset,
+                     *cols: FeatureColumn):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support streaming fit")
+
+    def merge_states(self, a, b):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support streaming fit")
+
+    def finish_fit(self, state) -> Model:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support streaming fit")
+
+    def fit_streaming(self, chunks) -> Model:
+        """Fit from an iterable of ``ColumnarDataset`` chunks via the
+        streaming protocol; the returned model is uid-wired exactly like
+        ``fit``'s."""
+        import time as _time
+
+        from ..utils.profiling import current_collector
+
+        coll = current_collector()
+        t0 = _time.perf_counter()
+        state = self.begin_fit()
+        for chunk in chunks:
+            cols = [chunk[n] for n in self.input_names]
+            state = self.update_chunk(state, chunk, *cols)
+        model = self.finish_fit(state)
+        self._record_fit_wall(coll, _time.perf_counter() - t0)
+        return self.adopt_model(model)
+
+    def _record_fit_wall(self, coll, dt: float) -> None:
+        if coll is not None:
+            # per-stage fit attribution (the Spark listener's per-stage
+            # metrics analogue) — custom tags, not OpStep enum entries
+            name = f"fit:{type(self).__name__}"
+            prev = float(coll.metrics.custom_tags.get(name, 0.0) or 0.0)
+            coll.metrics.custom_tags[name] = round(prev + dt, 3)
+
+    def adopt_model(self, model: Model) -> Model:
+        """Wire a freshly-built model to answer for this estimator's output
+        feature / uid (shared by ``fit`` and the streaming driver)."""
+        model.uid = self.uid
+        model.operation_name = self.operation_name
+        model.input_features = list(self.input_features)
+        model._output_feature = self._output_feature
+        model.metadata = self.metadata
+        return model
 
     def fit(self, data: ColumnarDataset) -> Model:
         import time as _time
@@ -239,20 +314,9 @@ class Estimator(PipelineStage):
         coll = current_collector()
         t0 = _time.perf_counter()
         model = self.fit_columns(data, *cols)
-        if coll is not None:
-            # per-stage fit attribution (the Spark listener's per-stage
-            # metrics analogue) — custom tags, not OpStep enum entries
-            name = f"fit:{type(self).__name__}"
-            prev = float(coll.metrics.custom_tags.get(name, 0.0) or 0.0)
-            coll.metrics.custom_tags[name] = round(
-                prev + _time.perf_counter() - t0, 3)
+        self._record_fit_wall(coll, _time.perf_counter() - t0)
         # the model answers for the same output feature / uid
-        model.uid = self.uid
-        model.operation_name = self.operation_name
-        model.input_features = list(self.input_features)
-        model._output_feature = self._output_feature
-        model.metadata = self.metadata
-        return model
+        return self.adopt_model(model)
 
 
 # ---------------------------------------------------------------------------

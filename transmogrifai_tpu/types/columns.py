@@ -179,6 +179,13 @@ class FeatureColumn:
         mask = self.mask[idx] if self.mask is not None else None
         return FeatureColumn(self.ftype, self.values[idx], mask, self.vmeta)
 
+    def slice(self, start: int, stop: int) -> "FeatureColumn":
+        """Zero-copy row-range view (the chunked-ingestion fallback path
+        slices a materialized dataset into bounded chunks)."""
+        mask = self.mask[start:stop] if self.mask is not None else None
+        return FeatureColumn(self.ftype, self.values[start:stop], mask,
+                             self.vmeta)
+
 
 def _is_missing(v: Any) -> bool:
     if v is None:
@@ -263,6 +270,12 @@ class ColumnarDataset:
 
     def take(self, idx: np.ndarray) -> "ColumnarDataset":
         return ColumnarDataset({n: c.take(idx) for n, c in self.columns.items()})
+
+    def slice(self, start: int, stop: int) -> "ColumnarDataset":
+        """Zero-copy row-range view over every column."""
+        return ColumnarDataset(
+            {n: c.slice(start, stop) for n, c in self.columns.items()},
+            _validated=True)
 
     def copy(self) -> "ColumnarDataset":
         return ColumnarDataset(dict(self.columns), _validated=True)

@@ -18,13 +18,14 @@ import enum
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 __all__ = ["OpStep", "MetricsCollector", "AppMetrics", "StepMetrics",
            "with_job_group", "current_collector", "install_collector",
            "profile_to", "RunCounters", "COUNTERS", "reset_counters",
            "count_upload", "count_fetch", "count_drain", "count_launch",
-           "fetch_timed", "StageProfile", "PlanProfiler"]
+           "fetch_timed", "StageProfile", "PlanProfiler",
+           "IngestPass", "IngestProfiler"]
 
 
 class OpStep(enum.Enum):
@@ -293,6 +294,123 @@ class StageProfile:
                 "colsDropped": self.cols_dropped, "launches": self.launches}
 
 
+#: per-pass chunk records kept verbatim before aggregate-only accounting
+#: takes over (bounds profiler memory on million-chunk ingests)
+_INGEST_CHUNK_DETAIL_CAP = 512
+
+
+@dataclass
+class IngestPass:
+    """One streaming pass over the chunked reader (fit pass or the final
+    materialize pass of the two-pass out-of-core driver,
+    workflow/streaming.py).
+
+    ``read_s`` is producer-side time (parse/IO on the prefetch thread),
+    ``transform_s`` consumer-side stage time; with prefetch overlap the
+    pass wall should approach max(read_s, transform_s) rather than their
+    sum — ``overlap_efficiency`` reports how much of the smaller phase was
+    hidden (1.0 = fully overlapped, 0.0 = strictly serial)."""
+
+    label: str
+    chunks: int = 0
+    rows: int = 0
+    bytes_read: int = 0
+    read_s: float = 0.0
+    transform_s: float = 0.0
+    wall_s: float = 0.0
+    #: first _INGEST_CHUNK_DETAIL_CAP chunks as (rows, read_s, transform_s)
+    chunk_detail: List[Tuple[int, float, float]] = field(default_factory=list)
+
+    def note_read(self, rows: int, seconds: float, nbytes: int = 0) -> None:
+        self.chunks += 1
+        self.rows += rows
+        self.read_s += seconds
+        self.bytes_read += int(nbytes)
+        if len(self.chunk_detail) < _INGEST_CHUNK_DETAIL_CAP:
+            self.chunk_detail.append([rows, round(seconds, 6), 0.0])
+
+    def note_transform(self, chunk_index: int, seconds: float) -> None:
+        self.transform_s += seconds
+        if chunk_index < len(self.chunk_detail):
+            self.chunk_detail[chunk_index][2] = round(seconds, 6)
+
+    @property
+    def overlap_efficiency(self) -> float:
+        smaller = min(self.read_s, self.transform_s)
+        if smaller <= 0 or self.wall_s <= 0:
+            return 0.0
+        hidden = self.read_s + self.transform_s - self.wall_s
+        return max(0.0, min(1.0, hidden / smaller))
+
+    @property
+    def rows_per_s(self) -> float:
+        return self.rows / self.wall_s if self.wall_s > 0 else 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "label": self.label, "chunks": self.chunks, "rows": self.rows,
+            "bytesRead": self.bytes_read,
+            "readSecs": round(self.read_s, 4),
+            "transformSecs": round(self.transform_s, 4),
+            "wallSecs": round(self.wall_s, 4),
+            "rowsPerSec": round(self.rows_per_s, 1),
+            "overlapEfficiency": round(self.overlap_efficiency, 3),
+            "chunkDetail": [list(c) for c in self.chunk_detail],
+        }
+
+
+class IngestProfiler:
+    """Chunked-ingestion counters for one out-of-core train: one
+    ``IngestPass`` per streaming pass, plus the chunk geometry."""
+
+    def __init__(self, chunk_rows: int = 0):
+        self.chunk_rows = chunk_rows
+        self.passes: List[IngestPass] = []
+        #: bytes of retained blocks the fused pass spilled to disk
+        #: (workflow/streaming._BlockStore; 0 = everything stayed in RAM)
+        self.spilled_bytes: int = 0
+        self._lock = threading.Lock()
+
+    def begin_pass(self, label: str) -> IngestPass:
+        p = IngestPass(label=label)
+        with self._lock:
+            self.passes.append(p)
+        return p
+
+    @property
+    def total_rows(self) -> int:
+        return max((p.rows for p in self.passes), default=0)
+
+    @property
+    def total_bytes(self) -> int:
+        return max((p.bytes_read for p in self.passes), default=0)
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "chunkRows": self.chunk_rows,
+                "rows": self.total_rows,
+                "bytesRead": self.total_bytes,
+                "spilledBytes": self.spilled_bytes,
+                "passes": [p.to_json() for p in self.passes],
+            }
+
+    def format(self) -> str:
+        with self._lock:
+            passes = list(self.passes)
+        lines = [f"chunked ingest: {len(passes)} passes, "
+                 f"chunk_rows={self.chunk_rows}, rows={self.total_rows}, "
+                 f"bytes={self.total_bytes}"]
+        for p in passes:
+            lines.append(
+                f"  {p.label}: {p.chunks} chunks, {p.rows} rows, "
+                f"{p.wall_s:.3f}s wall (read {p.read_s:.3f}s | transform "
+                f"{p.transform_s:.3f}s), {p.rows_per_s:,.0f} rows/s, "
+                f"overlap {p.overlap_efficiency:.0%}"
+                + (f", {p.bytes_read} bytes" if p.bytes_read else ""))
+        return "\n".join(lines)
+
+
 class PlanProfiler:
     """Accumulates StageProfile entries for one plan execution; thread-safe
     (host-side stages record from pool threads).  Also tracks the peak
@@ -304,6 +422,9 @@ class PlanProfiler:
         self.final_columns: int = 0
         self.wall_s: float = 0.0
         self.layer_drops: Dict[int, List[str]] = {}
+        #: IngestProfiler when the run went through the chunked two-pass
+        #: driver (workflow/streaming.py); None for in-core runs
+        self.ingest: Optional[IngestProfiler] = None
         self._lock = threading.Lock()
 
     def record_stage(self, sp: StageProfile) -> None:
@@ -322,7 +443,7 @@ class PlanProfiler:
     def to_json(self) -> Dict[str, Any]:
         with self._lock:
             stages = sorted(self.stages, key=lambda s: (s.layer, s.output))
-            return {
+            out = {
                 "wallSecs": round(self.wall_s, 4),
                 "peakColumns": self.peak_columns,
                 "finalColumns": self.final_columns,
@@ -330,6 +451,9 @@ class PlanProfiler:
                                sorted(self.layer_drops.items())},
                 "stages": [s.to_json() for s in stages],
             }
+        if self.ingest is not None:
+            out["ingest"] = self.ingest.to_json()
+        return out
 
     def format(self, top_k: int = 20) -> str:
         """Human-readable per-stage summary (workflow.train(profile=True))."""
@@ -347,6 +471,8 @@ class PlanProfiler:
                 f"  rows={s.rows}  +{s.cols_added}/-{s.cols_dropped} cols"
                 + (f"  launches={s.launches}" if s.launches else "")
                 + ("  [device]" if s.device_heavy else ""))
+        if self.ingest is not None:
+            lines.append(self.ingest.format())
         return "\n".join(lines)
 
 

@@ -215,9 +215,12 @@ class ExecutionPlan:
 
     # -- reporting -----------------------------------------------------------
 
-    def explain(self) -> str:
+    def explain(self, ingest=None) -> str:
         """Static plan report: per-layer stages, host/device split, liveness
-        drops, and the projected peak resident column count."""
+        drops, and the projected peak resident column count.  Pass an
+        ``IngestProfiler`` (``model.ingest_profile`` after a chunked
+        ``train(chunk_rows=k)``) to append the out-of-core pass counters —
+        per-pass chunks, bytes read, rows/s, overlap efficiency."""
         initial, after = self._drops_fit
         lines = [
             f"ExecutionPlan: {sum(len(l) for l in self.layers)} stages over "
@@ -249,6 +252,8 @@ class ExecutionPlan:
                 lines.append(f"    drop after layer {li}: {drops}")
         lines.append(f"  projected resident columns: peak {peak}, "
                      f"final {resident}")
+        if ingest is not None:
+            lines.append(ingest.format())
         return "\n".join(lines)
 
     # -- execution -----------------------------------------------------------

@@ -153,15 +153,23 @@ class OpWorkflowRunner:
             self.workflow.set_reader(self.train_reader)
         # custom_params.profile=true turns on the execution plan's per-stage
         # profile; it rides along in the train summary (and thence the
-        # metrics_location JSON) as "executionProfile"
+        # metrics_location JSON) as "executionProfile".
+        # custom_params.chunk_rows=k selects the out-of-core chunked train
+        # (workflow/streaming.py); its pass counters ride along as
+        # "ingestProfile".
         profile = bool(params.custom_params.get("profile"))
-        model = self.workflow.train(profile=profile)
+        chunk_rows = params.custom_params.get("chunk_rows")
+        model = self.workflow.train(
+            profile=profile,
+            chunk_rows=int(chunk_rows) if chunk_rows else None)
         if params.model_location:
             with with_job_group(OpStep.ModelIO):
                 model.save(params.model_location)
         summary = model.summary()
         if profile and model.train_profile is not None:
             summary["executionProfile"] = model.train_profile.to_json()
+        if model.ingest_profile is not None:
+            summary["ingestProfile"] = model.ingest_profile.to_json()
         return OpWorkflowRunnerResult(run_type="train", summary=summary)
 
     def _load_model(self, params: OpParams) -> OpWorkflowModel:
@@ -204,13 +212,17 @@ class OpWorkflowRunner:
             self.streaming_score_reader.stream(raw))
         n_batches = n_rows = 0
         path = None
-        for batch in batches:
-            with with_job_group(OpStep.Scoring):
-                scored = model.score(data=batch)
-            p = self._write_scores(scored, params, suffix=f"_{n_batches:05d}")
-            path = path or (params.write_location if p else None)
-            n_batches += 1
-            n_rows += len(scored)
+        try:
+            for batch in batches:
+                with with_job_group(OpStep.Scoring):
+                    scored = model.score(data=batch)
+                p = self._write_scores(scored, params,
+                                       suffix=f"_{n_batches:05d}")
+                path = path or (params.write_location if p else None)
+                n_batches += 1
+                n_rows += len(scored)
+        finally:
+            batches.close()  # releases the pump thread on scoring errors
         return OpWorkflowRunnerResult(run_type="streamingScore",
                                       scores_location=path,
                                       n_batches=n_batches, n_rows=n_rows)

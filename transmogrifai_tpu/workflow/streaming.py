@@ -1,0 +1,679 @@
+"""Out-of-core training — the chunked two-pass fit driver.
+
+Reference: the reference leans on Spark so training data never has to fit
+in one executor's heap; the TPU port's readers instead materialized whole
+files, making host RAM the binding constraint on dataset size.  This
+module decouples them, following the external-memory two-pass design of
+"XGBoost: Scalable GPU Accelerated Learning" (arXiv:1806.11248): sketch
+passes build mergeable fit states chunk by chunk, then the final work
+writes only the columns the rest of the pipeline actually needs.
+
+Shape of a run (``OpWorkflow.train(chunk_rows=k)``):
+
+1. **Streamable prefix** — the longest prefix of DAG layers in which every
+   estimator implements the streaming-fit protocol
+   (``stages/base.Estimator``: begin_fit / update_chunk / merge_states /
+   finish_fit).  Estimator layers fit in sequence; each bounded chunk
+   flows through the already-fitted upstream stages with per-chunk
+   liveness pruning.  No full-dataset intermediate column ever exists.
+2. **Fused retention point** — the reader is re-read only while upstream
+   models are still unfitted (at most two reader passes).  The second
+   estimator-layer pass doubles as the RETENTION pass: while its fit
+   states accumulate, the pass direct-writes every needed column already
+   computable into preallocated full-length buffers and retains, per
+   chunk, exactly the columns the remaining pipeline needs (for the
+   canonical pipeline: the combined pre-SanityChecker matrix) as blocks.
+3. **Block cascade** — every LATER estimator layer and the final assembly
+   run over the retained blocks, never the reader: each block transforms
+   through the stages fitted so far (e.g. the SanityChecker model's
+   index gather), feeds the next layer's fit states, and is re-retained
+   as views over the preallocated packed (N, D) float32 output buffers —
+   each input block is freed as it is consumed, so the input and output
+   matrices never coexist in full.  This extends the execution plan's
+   liveness story (workflow/plan.py, "drop after last consumer") to
+   "never materialize" for every other intermediate, and transforms each
+   row through the expensive featurizers exactly ONCE.
+4. **Tail** — remaining layers (a non-streamable estimator, e.g. the
+   model selector or SanityChecker with Spearman) run in-core on the
+   materialized dataset through the ordinary execution plan — the
+   paper's split: sketchable statistics stream; the trainer consumes the
+   packed matrix.
+
+Chunk parsing overlaps compute: the reader side of each pass runs on the
+``AsyncBatcher`` prefetch thread (readers/streaming.py), parsing chunk
+k+1 while chunk k runs through the transform layers; per-chunk wall,
+bytes read, rows/s and overlap-efficiency counters land in
+``utils/profiling.IngestProfiler`` (surfaced via ``train(profile=True)``
+and ``ExecutionPlan.explain``).
+
+Memory note: block retention totals one pass worth of the downstream
+chain's INPUT columns.  When the retention point's chain is fed directly
+by raw object columns (a DAG with a single estimator layer) the
+retention approaches the raw dataset's size — no worse than in-core, and
+still one reader pass cheaper.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..readers.streaming import AsyncBatcher
+from ..stages.base import Estimator, Model, PipelineStage, Transformer
+from ..types.columns import ColumnarDataset, FeatureColumn
+from ..utils.profiling import (IngestPass, IngestProfiler, PlanProfiler,
+                               StageProfile, current_collector)
+
+__all__ = ["fit_dag_streaming"]
+
+#: retained-block budget (MB) before the fused pass spills blocks to a
+#: temp file — the classic external-memory move: sequential write during
+#: the retention pass, sequential read-back during the cascade, so peak
+#: host memory stays bounded by the packed OUTPUT, not the retained input
+_RETAIN_MB_ENV = "TMOG_STREAM_RETAIN_MB"
+_RETAIN_MB_DEFAULT = 256
+
+
+def _retain_budget_bytes() -> int:
+    try:
+        mb = float(os.environ.get(_RETAIN_MB_ENV, "") or _RETAIN_MB_DEFAULT)
+    except ValueError:
+        mb = _RETAIN_MB_DEFAULT
+    return int(mb * (1 << 20))
+
+
+class _BlockStore:
+    """Retained per-chunk blocks with disk spill past a byte budget.
+
+    Blocks under the budget stay in RAM; once the running total crosses
+    it, every FURTHER block's arrays are appended to one temp file
+    (``np.save`` per column, sequential) and reloaded on ``pop`` — blocks
+    are consumed once, in order, so the read-back is sequential too.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self._budget = budget_bytes
+        self._bytes = 0
+        self._mem: List[Optional[ColumnarDataset]] = []
+        self._meta: List[Optional[List[tuple]]] = []  # spilled block layout
+        self._fh = None
+        self._path: Optional[str] = None
+        self.spilled_blocks = 0
+        self.spilled_bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._mem)
+
+    def _ds_bytes(self, ds: ColumnarDataset) -> int:
+        return sum(np.asarray(c.values).nbytes for c in ds.columns.values())
+
+    def append(self, ds: ColumnarDataset) -> None:
+        nbytes = self._ds_bytes(ds)
+        if self._bytes + nbytes <= self._budget and self._fh is None:
+            self._bytes += nbytes
+            self._mem.append(ds)
+            self._meta.append(None)
+            return
+        if self._fh is None:
+            fd, self._path = tempfile.mkstemp(prefix="tmog_spill_",
+                                              suffix=".npy")
+            self._fh = os.fdopen(fd, "w+b")
+        layout = []
+        for name, col in ds.columns.items():
+            offset = self._fh.tell()
+            np.save(self._fh, np.asarray(col.values), allow_pickle=True)
+            mask_off = None
+            if col.mask is not None:
+                mask_off = self._fh.tell()
+                np.save(self._fh, np.asarray(col.mask))
+            layout.append((name, col.ftype, col.vmeta, offset, mask_off))
+        self._mem.append(None)
+        self._meta.append(layout)
+        self.spilled_blocks += 1
+        self.spilled_bytes += nbytes
+
+    def pop(self, i: int) -> ColumnarDataset:
+        ds = self._mem[i]
+        if ds is not None:
+            self._mem[i] = None
+            return ds
+        layout = self._meta[i]
+        self._meta[i] = None
+        cols: Dict[str, FeatureColumn] = {}
+        for name, ftype, vmeta, offset, mask_off in layout:
+            self._fh.seek(offset)
+            values = np.load(self._fh, allow_pickle=True)
+            mask = None
+            if mask_off is not None:
+                self._fh.seek(mask_off)
+                mask = np.load(self._fh)
+            cols[name] = FeatureColumn(ftype, values, mask, vmeta)
+        return ColumnarDataset(cols, _validated=True)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+            if self._path is not None:
+                try:
+                    os.unlink(self._path)
+                except OSError:  # pragma: no cover
+                    pass
+                self._path = None
+
+
+class _TimedChunks:
+    """Wraps a reader ChunkStream with read-side timing; runs on the
+    prefetch pump thread, so producer time is attributed even while the
+    consumer is busy transforming the previous chunk."""
+
+    def __init__(self, stream, pass_stats: IngestPass):
+        self._stream = iter(stream)
+        self._pass = pass_stats
+        self._last_bytes = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        ds = next(self._stream)
+        dt = time.perf_counter() - t0
+        nb = int(getattr(self._stream, "bytes_read", 0) or 0)
+        delta, self._last_bytes = nb - self._last_bytes, nb
+        self._pass.note_read(len(ds), dt, max(delta, 0))
+        return ds
+
+
+class _ColumnWriter:
+    """Writes per-chunk columns into preallocated full-length buffers.
+
+    With ``total`` known (any earlier pass counted the rows) buffers
+    preallocate once — the packed (N, D) float32 feature matrix path;
+    with unknown N, chunk arrays accumulate and concatenate at finish.
+    ``row_view`` hands back zero-copy row-range views of a written
+    buffer — the block cascade re-retains written columns as views so
+    the bytes are never held twice."""
+
+    def __init__(self, total_rows: Optional[int]):
+        self.total = total_rows
+        self.cols: Dict[str, dict] = {}
+        self.offset = 0
+
+    def append(self, chunk: ColumnarDataset, names: Sequence[str]) -> None:
+        n = len(chunk)
+        for name in names:
+            col = chunk[name]
+            ent = self.cols.get(name)
+            if ent is None:
+                ent = self.cols[name] = {
+                    "ftype": col.ftype, "vmeta": col.vmeta,
+                    "has_mask": col.mask is not None,
+                    "values": None, "mask": None, "parts": [],
+                    "mask_parts": []}
+                if self.total is not None:
+                    v = np.asarray(col.values)
+                    ent["values"] = np.empty((self.total,) + v.shape[1:],
+                                             dtype=v.dtype)
+                    if ent["has_mask"]:
+                        ent["mask"] = np.empty(self.total, dtype=bool)
+            if ent["values"] is not None:
+                ent["values"][self.offset:self.offset + n] = col.values
+                if ent["has_mask"]:
+                    ent["mask"][self.offset:self.offset + n] = col.mask
+            else:
+                ent["parts"].append(np.asarray(col.values))
+                if ent["has_mask"]:
+                    ent["mask_parts"].append(np.asarray(col.mask))
+        self.offset += n
+
+    def row_view(self, name: str, start: int,
+                 stop: int) -> Optional[FeatureColumn]:
+        ent = self.cols.get(name)
+        if ent is None or ent["values"] is None:
+            return None
+        mask = ent["mask"][start:stop] if ent["has_mask"] else None
+        return FeatureColumn(ent["ftype"], ent["values"][start:stop],
+                             mask, ent["vmeta"])
+
+    def finish(self) -> Dict[str, FeatureColumn]:
+        out: Dict[str, FeatureColumn] = {}
+        for name, ent in self.cols.items():
+            values = (ent["values"] if ent["values"] is not None
+                      else np.concatenate(ent["parts"]))
+            mask = None
+            if ent["has_mask"]:
+                mask = (ent["mask"] if ent["mask"] is not None
+                        else np.concatenate(ent["mask_parts"]))
+            out[name] = FeatureColumn(ent["ftype"], values, mask,
+                                      ent["vmeta"])
+        return out
+
+
+def _split_streamable(layers: List[List[PipelineStage]],
+                      subs: Dict[str, Model]) -> int:
+    """Index of the first layer containing an estimator that cannot stream
+    (and is not warm-start substituted) — everything from there on runs
+    in-core on the materialized dataset."""
+    for i, layer in enumerate(layers):
+        for s in layer:
+            if (isinstance(s, Estimator) and s.uid not in subs
+                    and not s.supports_streaming_fit):
+                return i
+    return len(layers)
+
+
+def _closure(targets: Sequence[str],
+             out_stage: Dict[str, PipelineStage]) -> Set[str]:
+    """Uids of stages needed (transitively) to produce ``targets``."""
+    needed: Set[str] = set()
+    frontier = [out_stage[n] for n in targets if n in out_stage]
+    while frontier:
+        s = frontier.pop()
+        if s.uid in needed:
+            continue
+        needed.add(s.uid)
+        for f in s.input_features:
+            p = out_stage.get(f.name)
+            if p is not None:
+                frontier.append(p)
+    return needed
+
+
+def _liveness(ordered: List[PipelineStage],
+              final_needed: Set[str]) -> List[Set[str]]:
+    """needed_after[i]: columns that must survive past ordered[i] — inputs
+    of the remaining stages plus the pass's final targets."""
+    needed_after: List[Set[str]] = [set(final_needed) for _ in ordered]
+    running = set(final_needed)
+    for i in range(len(ordered) - 1, -1, -1):
+        needed_after[i] = set(running)
+        running |= set(ordered[i].input_names)
+    return needed_after
+
+
+def fit_dag_streaming(
+    dag,
+    reader,
+    raw_features,
+    chunk_rows: int,
+    keep: Optional[Sequence[str]] = None,
+    fitted_substitutes: Optional[Dict[str, Model]] = None,
+    profiler: Optional[PlanProfiler] = None,
+    prefetch: int = 2,
+) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler]:
+    """Fit ``dag`` from chunked ingestion; returns (fitted stages in topo
+    order, final dataset equivalent to the in-core executor's with the
+    same ``keep``, ingest counters)."""
+    from .dag import StagesDAG, fit_and_transform_dag
+
+    if chunk_rows <= 0:
+        raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+    subs = dict(fitted_substitutes or {})
+    layers = [l for l in dag.non_generator_layers() if l]
+    split = _split_streamable(layers, subs)
+    prefix, tail = layers[:split], layers[split:]
+    ingest = IngestProfiler(chunk_rows)
+    if profiler is not None:
+        profiler.ingest = ingest
+
+    raw_names = {f.name for f in raw_features}
+    out_stage: Dict[str, PipelineStage] = {
+        s.get_output().name: s for layer in prefix for s in layer}
+    known_universe = raw_names | {
+        s.get_output().name for layer in layers for s in layer}
+    fitted_by_uid: Dict[str, PipelineStage] = {}
+    stage_wall: Dict[str, float] = {}
+    stage_layer: Dict[str, int] = {
+        s.uid: li for li, layer in enumerate(prefix) for s in layer}
+    stage_kind: Dict[str, str] = {}
+    total_rows: Optional[int] = None
+    coll = current_collector()
+    extras: Set[str] = set()  # plan-unknown passthroughs (e.g. "key")
+
+    def fitted_of(stage: PipelineStage) -> PipelineStage:
+        if isinstance(stage, Estimator):
+            got = fitted_by_uid.get(stage.uid) or subs.get(stage.uid)
+            if got is None:  # pragma: no cover - pass ordering guarantees it
+                raise RuntimeError(f"stage {stage.uid} used before fit")
+            return got
+        return stage
+
+    def timed_transform(stage: PipelineStage,
+                        ds: ColumnarDataset) -> ColumnarDataset:
+        f = fitted_of(stage)
+        t0 = time.perf_counter()
+        out = f.transform(ds)
+        stage_wall[stage.uid] = (stage_wall.get(stage.uid, 0.0)
+                                 + time.perf_counter() - t0)
+        return out
+
+    def run_reader_pass(label: str, ordered: List[PipelineStage],
+                        final_needed: Set[str], per_chunk,
+                        keep_unknown: bool) -> int:
+        """One prefetch-overlapped pass over the reader's chunks: transform
+        through ``ordered`` (liveness-pruned), then hand the chunk to
+        ``per_chunk``.  Returns the row count."""
+        pass_stats = ingest.begin_pass(label)
+        needed_after = _liveness(ordered, final_needed)
+        source = _TimedChunks(
+            reader.iter_chunks(raw_features, chunk_rows), pass_stats)
+        batcher = AsyncBatcher(source, depth=prefetch)
+        rows = 0
+        chunk_idx = 0
+        t_pass = time.perf_counter()
+        try:
+            for chunk in batcher:
+                t0 = time.perf_counter()
+                ds = chunk
+                if chunk_idx == 0 and keep_unknown:
+                    extras.update(c for c in ds.names()
+                                  if c not in known_universe)
+                for idx, st in enumerate(ordered):
+                    ds = timed_transform(st, ds)
+                    na = needed_after[idx]
+                    ds = ds.select([c for c in ds.names()
+                                    if c in na or (keep_unknown and
+                                                   c not in known_universe)])
+                per_chunk(ds, chunk_idx)
+                rows += len(chunk)
+                pass_stats.note_transform(chunk_idx,
+                                          time.perf_counter() - t0)
+                chunk_idx += 1
+        finally:
+            batcher.close()
+        pass_stats.wall_s = time.perf_counter() - t_pass
+        if rows == 0:
+            raise ValueError("chunked reader produced no rows")
+        return rows
+
+    def update_states(ests, states, ds: ColumnarDataset) -> None:
+        for est in ests:
+            t0 = time.perf_counter()
+            cols = [ds[n] for n in est.input_names]
+            states[est.uid] = est.update_chunk(states[est.uid], ds, *cols)
+            stage_wall[est.uid] = (stage_wall.get(est.uid, 0.0)
+                                   + time.perf_counter() - t0)
+
+    def finish_layer(ests, states) -> None:
+        for est in ests:
+            t0 = time.perf_counter()
+            model = est.adopt_model(est.finish_fit(states[est.uid]))
+            stage_wall[est.uid] = (stage_wall.get(est.uid, 0.0)
+                                   + time.perf_counter() - t0)
+            est._record_fit_wall(coll, stage_wall[est.uid])
+            fitted_by_uid[est.uid] = model
+            stage_kind[est.uid] = "fit-stream"
+
+    def layer_ests(li: int) -> List[Estimator]:
+        return [s for s in prefix[li]
+                if isinstance(s, Estimator) and s.uid not in subs]
+
+    # -- what must materialize: keep-set + the in-core tail's inputs --------
+    prefix_outputs = set(out_stage)
+    available = raw_names | prefix_outputs
+    tail_inputs: Set[str] = set()
+    for layer in tail:
+        for s in layer:
+            tail_inputs |= {n for n in s.input_names if n in available}
+    mat_cols: Set[str] = set(tail_inputs)
+    if keep is None:
+        mat_cols |= available
+    else:
+        mat_cols |= set(keep) & available
+
+    est_idxs = [li for li in range(len(prefix)) if layer_ests(li)]
+    # everything the whole run must compute: mat_cols plus every fitting
+    # estimator's inputs
+    all_targets: Set[str] = set(mat_cols)
+    for li in est_idxs:
+        for est in layer_ests(li):
+            all_targets |= set(est.input_names)
+    needed_uids = _closure(sorted(all_targets), out_stage)
+
+    writer = _ColumnWriter(total_rows)
+    materialized: Dict[str, FeatureColumn] = {}
+
+    if not est_idxs:
+        # no estimators in the prefix: a single materialize pass
+        ordered = [s for layer in prefix for s in layer
+                   if s.uid in needed_uids]
+
+        def write_only(ds: ColumnarDataset, _idx: int) -> None:
+            writer.append(ds, [c for c in ds.names()
+                               if c in mat_cols or c in extras])
+
+        run_reader_pass("materialize", ordered, set(mat_cols), write_only,
+                        keep_unknown=True)
+        materialized.update(writer.finish())
+    else:
+        # fuse at the SECOND estimator layer when there is one (its pass
+        # can already compute the first layer's model outputs, so the
+        # retained blocks are derived, compact columns); a single
+        # estimator layer fuses on its own pass.
+        fuse_at = est_idxs[1] if len(est_idxs) >= 2 else est_idxs[0]
+
+        # plain reader fit passes for estimator layers before the fuse
+        for li in est_idxs:
+            if li >= fuse_at:
+                break
+            ests = layer_ests(li)
+            target_inputs: Set[str] = set()
+            for est in ests:
+                target_inputs |= set(est.input_names)
+            pass_uids = _closure(sorted(target_inputs), out_stage)
+            ordered = [s for lj in range(li) for s in prefix[lj]
+                       if s.uid in pass_uids]
+            states = {est.uid: est.begin_fit() for est in ests}
+            names = ", ".join(type(e).__name__ for e in ests)
+            rows = run_reader_pass(
+                f"fit[layer {li}: {names}]", ordered, set(target_inputs),
+                lambda ds, _i, e=ests, st=states: update_states(e, st, ds),
+                keep_unknown=False)
+            total_rows = rows if total_rows is None else total_rows
+            finish_layer(ests, states)
+
+        # -- fused retention pass at ``fuse_at`` ---------------------------
+        fuse_ests = layer_ests(fuse_at)
+        fuse_uids = {e.uid for e in fuse_ests}
+        fuse_inputs: Set[str] = set()
+        for est in fuse_ests:
+            fuse_inputs |= set(est.input_names)
+
+        # forward reachability from every not-yet-fitted estimator at or
+        # after the fuse point: those stages form the block-cascade chain
+        pending_est_uids = {e.uid for li in est_idxs if li >= fuse_at
+                            for e in layer_ests(li)}
+        down_out_names = {e.get_output().name for e in fuse_ests}
+        chain_tail: List[PipelineStage] = []
+        for lj in range(fuse_at, len(prefix)):
+            for s in prefix[lj]:
+                if s.uid in fuse_uids or s.uid not in needed_uids:
+                    continue
+                if (s.uid in pending_est_uids
+                        or any(n in down_out_names
+                               for n in s.input_names)):
+                    chain_tail.append(s)
+                    down_out_names.add(s.get_output().name)
+        consumed = set(mat_cols) | {
+            n for s in chain_tail for n in s.input_names}
+        chain: List[PipelineStage] = (
+            [e for e in fuse_ests if e.get_output().name in consumed]
+            + chain_tail)
+        chain_uids = {s.uid for s in chain}
+        chain_outputs = {s.get_output().name for s in chain}
+        block_cols = ({n for s in chain for n in s.input_names}
+                      - chain_outputs)
+        direct_cols = set(mat_cols) - chain_outputs
+
+        run_stages = [s for layer in prefix for s in layer
+                      if s.uid in needed_uids and s.uid not in chain_uids
+                      and s.uid not in fuse_uids]
+        states = {est.uid: est.begin_fit() for est in fuse_ests}
+        store = _BlockStore(_retain_budget_bytes())
+
+        def feed_and_capture(ds: ColumnarDataset, _idx: int) -> None:
+            update_states(fuse_ests, states, ds)
+            writer.append(ds, [c for c in ds.names()
+                               if c in direct_cols or c in extras])
+            if chain:
+                store.append(ds.select([c for c in block_cols
+                                        if c in ds]))
+
+        try:
+            names = ", ".join(type(e).__name__ for e in fuse_ests)
+            rows = run_reader_pass(
+                f"fit+materialize[layer {fuse_at}: {names}]", run_stages,
+                fuse_inputs | direct_cols | block_cols, feed_and_capture,
+                keep_unknown=True)
+            total_rows = rows if total_rows is None else total_rows
+            writer.total = total_rows  # later-touched columns preallocate
+            finish_layer(fuse_ests, states)
+            ingest.spilled_bytes = store.spilled_bytes
+
+            # -- block cascade: later estimator layers + assembly, one
+            #    block at a time; the initial (possibly disk-spilled)
+            #    blocks are consumed once, later segments re-retain
+            #    written columns as zero-copy buffer views -----------------
+            n_blocks = len(store)
+            cur: object = store
+            pos = 0
+            while pos < len(chain):
+                seg_end = pos
+                seg_ests: List[Estimator] = []
+                while seg_end < len(chain):
+                    s = chain[seg_end]
+                    if (isinstance(s, Estimator) and s.uid not in subs
+                            and s.uid not in fitted_by_uid):
+                        if (not seg_ests
+                                or stage_layer[s.uid]
+                                == stage_layer[seg_ests[0].uid]):
+                            seg_ests.append(s)
+                            seg_end += 1
+                            continue
+                        break
+                    if seg_ests:
+                        break
+                    seg_end += 1
+                segment = [s for s in chain[pos:seg_end]
+                           if s not in seg_ests]
+                remaining = chain[seg_end:]
+                seg_inputs: Set[str] = set()
+                for est in seg_ests:
+                    seg_inputs |= set(est.input_names)
+                retain_cols = ({n for s in remaining
+                                for n in s.input_names}
+                               - {s.get_output().name for s in remaining})
+                # estimator outputs are only writable AFTER their fit — a
+                # segment writes the columns its (already fitted) stages
+                # produce; seg_ests' own outputs get written by the NEXT
+                # segment once their models exist
+                seg_write = (set(mat_cols)
+                             & {s.get_output().name for s in segment})
+                needed_after = _liveness(
+                    segment, seg_inputs | retain_cols | seg_write)
+                seg_states = {est.uid: est.begin_fit()
+                              for est in seg_ests}
+                apass = ingest.begin_pass(
+                    "assemble" if not seg_ests else
+                    "fit-blocks[layer "
+                    f"{stage_layer[seg_ests[0].uid]}: "
+                    + ", ".join(type(e).__name__ for e in seg_ests) + "]")
+                t_pass = time.perf_counter()
+                nxt: List[Optional[ColumnarDataset]] = []
+                offset = 0
+                for bi in range(n_blocks):
+                    if isinstance(cur, _BlockStore):
+                        ds_b = cur.pop(bi)
+                    else:
+                        ds_b = cur[bi]
+                        cur[bi] = None
+                    n_b = len(ds_b)
+                    t0 = time.perf_counter()
+                    for idx, st in enumerate(segment):
+                        ds_b = timed_transform(st, ds_b)
+                        ds_b = ds_b.select([c for c in ds_b.names()
+                                            if c in needed_after[idx]])
+                    if seg_ests:
+                        update_states(seg_ests, seg_states, ds_b)
+                    writer.offset = offset
+                    writer.append(ds_b, [c for c in ds_b.names()
+                                         if c in seg_write])
+                    if remaining or seg_ests:
+                        kept: Dict[str, FeatureColumn] = {}
+                        for c in (retain_cols | seg_inputs):
+                            if c not in ds_b:
+                                continue
+                            view = (writer.row_view(c, offset,
+                                                    offset + n_b)
+                                    if c in seg_write else None)
+                            kept[c] = view if view is not None else ds_b[c]
+                        nxt.append(ColumnarDataset(kept, _validated=True))
+                    offset += n_b
+                    apass.note_read(n_b, 0.0, 0)
+                    apass.note_transform(bi, time.perf_counter() - t0)
+                apass.wall_s = time.perf_counter() - t_pass
+                cur = nxt
+                if seg_ests:
+                    finish_layer(seg_ests, seg_states)
+                    # re-visit the just-fitted estimators: their MODELS
+                    # are runnable transforms for the next segment
+                    pos = seg_end - len(seg_ests)
+                else:
+                    pos = seg_end
+        finally:
+            store.close()
+        missing = (set(mat_cols) & chain_outputs) - set(writer.cols)
+        if missing:  # pragma: no cover - cascade covers every chain output
+            raise RuntimeError(
+                f"block cascade failed to materialize {sorted(missing)}")
+        materialized.update(writer.finish())
+
+    data = ColumnarDataset(materialized, _validated=True)
+
+    # fitted stages in topo order: prefix (transformers are their own
+    # fitted stage, matching the in-core executor's returned list)
+    fitted: List[PipelineStage] = []
+    for layer in prefix:
+        for s in layer:
+            if isinstance(s, Estimator):
+                fitted.append(fitted_by_uid.get(s.uid) or subs[s.uid])
+                stage_kind.setdefault(s.uid, "substitute")
+            else:
+                fitted.append(s)
+                stage_kind.setdefault(s.uid, "transform-stream")
+
+    if total_rows is None:
+        total_rows = len(data)
+    if profiler is not None:
+        for s in (st for layer in prefix for st in layer):
+            profiler.record_stage(StageProfile(
+                uid=s.uid, op=type(s).__name__,
+                output=s.get_output().name,
+                layer=stage_layer.get(s.uid, 0),
+                kind=stage_kind.get(s.uid, "transform-stream"),
+                device_heavy=s.device_heavy,
+                wall_s=stage_wall.get(s.uid, 0.0),
+                rows=total_rows or 0, cols_added=1))
+        profiler.note_columns(len(data.columns))
+
+    # -- tail: non-streamable suffix runs in-core on the packed dataset ----
+    if tail:
+        tail_dag = StagesDAG(tail)
+        fitted_tail, data, _ = fit_and_transform_dag(
+            tail_dag, data, fitted_substitutes=subs, keep=keep,
+            profiler=profiler)
+        fitted.extend(fitted_tail)
+
+    if keep is not None:
+        # parity with the in-core plan's final state: keep-set columns plus
+        # plan-unknown passthroughs (e.g. a reader's "key")
+        keep_set = set(keep)
+        data = data.select([c for c in data.names()
+                            if c in keep_set or c not in known_universe])
+    return fitted, data, ingest

@@ -177,14 +177,29 @@ class OpWorkflow(_WorkflowCore):
                 keep |= {ff.name for ff in s.input_features}
         return sorted(keep)
 
-    def train(self, profile: bool = False) -> "OpWorkflowModel":
+    def train(self, profile: bool = False,
+              chunk_rows: Optional[int] = None,
+              prefetch_chunks: int = 2) -> "OpWorkflowModel":
         """Fit the workflow.  ``profile=True`` additionally records a
         per-stage execution profile (wall time, rows, columns
         added/dropped, device launches) on the returned model as
         ``train_profile`` (a PlanProfiler; ``.format()`` for the summary,
-        ``.to_json()`` for the raw numbers)."""
+        ``.to_json()`` for the raw numbers).
+
+        ``chunk_rows=k`` switches to the OUT-OF-CORE path
+        (workflow/streaming.py): the reader streams bounded k-row chunks,
+        streamable estimators fit via mergeable sketch states, and only
+        the keep-set columns (the packed feature matrix, the response)
+        ever materialize full-length — peak host memory stops scaling
+        with the intermediate featurization width.  ``chunk_rows=None``
+        (default) keeps today's in-core path byte-identical.
+        ``prefetch_chunks`` bounds the reader thread's parse-ahead depth
+        (chunk k+1 parses while chunk k transforms).
+        """
         from ..utils.profiling import OpStep, with_job_group
 
+        if chunk_rows is not None:
+            return self._train_chunked(chunk_rows, prefetch_chunks, profile)
         with with_job_group(OpStep.DataReadingAndFiltering):
             data = self.generate_raw_data()
             filter_results = None
@@ -219,6 +234,55 @@ class OpWorkflow(_WorkflowCore):
         finally:
             for s, prev in meshed_stages:
                 s.with_mesh(prev)
+
+    def _train_chunked(self, chunk_rows: int, prefetch: int,
+                       profile: bool) -> "OpWorkflowModel":
+        """The out-of-core train: chunked ingestion + streaming two-pass
+        fit + in-core tail (see workflow/streaming.py)."""
+        from ..utils.profiling import OpStep, PlanProfiler, with_job_group
+        from .streaming import fit_dag_streaming
+
+        if self.reader is None:
+            raise RuntimeError("no reader set — call set_reader/set_input_data")
+        if self._raw_feature_filter is not None:
+            raise ValueError(
+                "chunk_rows is not supported with RawFeatureFilter yet — "
+                "its distribution pass needs a dedicated streaming profile")
+        if self._workflow_cv:
+            raise ValueError(
+                "chunk_rows is not supported with workflow-level CV — the "
+                "fold refit loop needs the materialized feature matrix")
+        dag = compute_dag(self.result_features)
+        self._validate_stages(dag)
+        self._inject_params(dag)
+        meshed_stages = []
+        if self.mesh is not None:
+            for s in dag.all_stages():
+                if hasattr(s, "with_mesh"):
+                    meshed_stages.append((s, getattr(s, "mesh", None)))
+                    s.with_mesh(self.mesh)
+        profiler = PlanProfiler() if profile else None
+        try:
+            with with_job_group(OpStep.FeatureEngineering):
+                fitted, transformed, ingest = fit_dag_streaming(
+                    dag, self.reader, self.raw_features(), chunk_rows,
+                    keep=self._train_keep_columns(),
+                    fitted_substitutes=dict(self._model_stages),
+                    profiler=profiler, prefetch=prefetch)
+        finally:
+            for s, prev in meshed_stages:
+                s.with_mesh(prev)
+        model = OpWorkflowModel(
+            result_features=self.result_features,
+            stages=fitted,
+            train_data=transformed,
+        )
+        model.reader = self.reader
+        model.train_profile = profiler
+        model.ingest_profile = ingest
+        from ..models.trees import clear_sweep_caches
+        clear_sweep_caches()
+        return model
 
     def _train_inner(self, data, dag, filter_results,
                      profile: bool = False) -> "OpWorkflowModel":
@@ -303,6 +367,8 @@ class OpWorkflowModel(_WorkflowCore):
         self.raw_feature_filter_results = None
         #: PlanProfiler from ``OpWorkflow.train(profile=True)`` else None
         self.train_profile = None
+        #: IngestProfiler from ``OpWorkflow.train(chunk_rows=k)`` else None
+        self.ingest_profile = None
         self._scoring_dag_memo: Optional[StagesDAG] = None
 
     def _scoring_dag(self) -> StagesDAG:
