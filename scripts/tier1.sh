@@ -16,4 +16,42 @@ else
   echo "INGEST_SMOKE=FAILED (see /tmp/_t1_ingest.log)"
   rc=1
 fi
+# self-lint: trace-safety over the shipped package + examples, DAG lint of
+# the example pipeline factory — any finding fails the script
+if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m transmogrifai_tpu.lint \
+    transmogrifai_tpu examples \
+    --dag examples/bench_pipeline.py:titanic_features > /tmp/_t1_lint.log 2>&1; then
+  echo "LINT=ok"
+else
+  echo "LINT=FAILED (see /tmp/_t1_lint.log)"
+  cat /tmp/_t1_lint.log
+  rc=1
+fi
+# contract gate: one small e2e train under TMOG_CHECK=1 (COW write
+# protection + determinism probe on every transform) + the streaming-fit
+# conformance audit over every streamable estimator in the pipeline
+if timeout -k 10 240 env JAX_PLATFORMS=cpu TMOG_CHECK=1 python - > /tmp/_t1_check.log 2>&1 <<'PY'
+import sys
+sys.path.insert(0, "examples")
+from bench_pipeline import make_titanic_like, titanic_features
+from transmogrifai_tpu import OpWorkflow
+from transmogrifai_tpu.analysis import check_workflow_contracts
+
+df = make_titanic_like(400)
+survived, checked = titanic_features()
+wf = OpWorkflow().set_result_features(checked).set_input_data(df)
+findings = check_workflow_contracts(wf)
+wf.train()  # every transform runs under the TM020/TM023 guards
+if findings:
+    print(findings.format())
+    sys.exit(1)
+print("contracts clean")
+PY
+then
+  echo "CHECK_MODE=ok"
+else
+  echo "CHECK_MODE=FAILED (see /tmp/_t1_check.log)"
+  cat /tmp/_t1_check.log
+  rc=1
+fi
 exit $rc

@@ -1,0 +1,675 @@
+"""Static analyzer + contract checker tests (analysis/, `tmog lint`).
+
+Layout mirrors the rule catalog: one seeded-violation fixture per rule id
+that must trigger EXACTLY that rule and nothing else, plus a clean
+titanic-shaped pipeline asserting zero findings end to end (the
+self-lint contract scripts/tier1.sh enforces on the shipped code).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.analysis import (
+    ContractViolation, PipelineLintError, RULES, check_streaming_fit,
+    check_workflow_contracts, lint_dag, lint_source, lint_paths,
+    lint_workflow,
+)
+from transmogrifai_tpu.analysis.cli import main as lint_cli
+from transmogrifai_tpu.analysis.contracts import guarded_transform_output
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.stages.base import (
+    Model, SchemaError, UnaryEstimator, UnaryModel, UnaryTransformer,
+)
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.types.columns import ColumnarDataset, FeatureColumn
+from transmogrifai_tpu.types.feature_types import (
+    OPNumeric, Real, RealNN, Text,
+)
+from transmogrifai_tpu.workflow.dag import StagesDAG, compute_dag
+from transmogrifai_tpu.workflow.workflow import OpWorkflow
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixture stages
+# ---------------------------------------------------------------------------
+
+class _PassThrough(UnaryTransformer):
+    """Minimal well-behaved unary transformer (copies its input)."""
+
+    def __init__(self, uid=None):
+        super().__init__(operation_name="passthru", output_type=Real, uid=uid)
+
+    def transform_columns(self, col):
+        return FeatureColumn(Real, np.array(col.values, copy=True),
+                             None if col.mask is None
+                             else np.array(col.mask, copy=True))
+
+
+class _FixedName(_PassThrough):
+    """Transformer whose output column name is forced (collision fixtures)."""
+
+    def __init__(self, forced_name, uid=None):
+        super().__init__(uid=uid)
+        self.forced_name = forced_name
+
+    def make_output_name(self):
+        return self.forced_name
+
+
+def _real_features(*names, response=None):
+    feats = []
+    for n in names:
+        if n == response:
+            feats.append(FeatureBuilder.RealNN(n).as_response())
+        else:
+            feats.append(FeatureBuilder.Real(n).as_predictor())
+    return feats
+
+
+def _gen(feature):
+    return feature.origin_stage
+
+
+# ---------------------------------------------------------------------------
+# TM00x — DAG lint, one rule per fixture
+# ---------------------------------------------------------------------------
+
+def test_tm001_dangling_input():
+    a, b = _real_features("a", "b")
+    s = _PassThrough().set_input(b)
+    # the DAG ships a's generator but NOT b's — b is a dangling wire
+    dag = StagesDAG([[_gen(a)], [s]])
+    f = lint_dag(dag)
+    assert f.rules_fired() == ["TM001"]
+    assert f.by_rule("TM001")[0].stage_uid == s.uid
+    assert "'b'" in f.by_rule("TM001")[0].message
+
+
+def test_tm002_shadowed_raw_column():
+    (a,) = _real_features("a")
+    s = _FixedName("a").set_input(a)  # output clobbers the raw column
+    f = lint_dag(StagesDAG([[_gen(a)], [s]]))
+    assert f.rules_fired() == ["TM002"]
+    assert f.by_rule("TM002")[0].stage_uid == s.uid
+
+
+def test_tm003_duplicate_output():
+    (a,) = _real_features("a")
+    s1 = _FixedName("dup").set_input(a)
+    s2 = _FixedName("dup").set_input(a)
+    f = lint_dag(StagesDAG([[_gen(a)], [s1, s2]]))
+    assert f.rules_fired() == ["TM003"]
+    assert f.by_rule("TM003")[0].stage_uid == s2.uid  # later stage blamed
+
+
+def test_tm004_feature_type_mismatch():
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    (a,) = _real_features("a")
+    t = FeatureBuilder.Text("t").as_predictor()
+    vec = RealVectorizer().set_input(a)
+    # simulate a DAG assembled by other means (deserialization/surgery):
+    # swap in a Text wire behind set_input's back
+    vec.input_features = [t]
+    f = lint_dag(StagesDAG([[_gen(t)], [vec]]))
+    assert f.rules_fired() == ["TM004"]
+    d = f.by_rule("TM004")[0]
+    assert "OPNumeric" in d.message and "Text" in d.message
+
+
+def test_tm005_dead_stage_is_warning():
+    a, b = _real_features("a", "b")
+    sa = _PassThrough().set_input(a)
+    sb = _PassThrough().set_input(b)
+    dag = compute_dag([sa.get_output(), sb.get_output()])
+    # only sa's output is a result feature -> sb is computed but dead
+    f = lint_dag(dag, result_features=[sa.get_output()])
+    assert f.rules_fired() == ["TM005"]
+    assert f.by_rule("TM005")[0].stage_uid == sb.uid
+    assert not f.errors and len(f.warnings) == 1
+
+
+def test_tm006_label_leakage_into_featurizer():
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    survived, age = _real_features("Survived", "Age", response="Survived")
+    leaky = RealVectorizer().set_input(survived, age)
+    f = lint_dag(compute_dag([leaky.get_output()]))
+    assert f.rules_fired() == ["TM006"]
+    assert "'Survived'" in f.by_rule("TM006")[0].message
+
+
+def test_tm006_taint_propagates_through_plain_transforms():
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    survived, = _real_features("Survived", response="Survived")
+    rescaled = _PassThrough().set_input(survived)  # legitimate on its own
+    leaky = RealVectorizer().set_input(rescaled.get_output())
+    f = lint_dag(compute_dag([leaky.get_output()]))
+    assert f.rules_fired() == ["TM006"]
+    assert f.by_rule("TM006")[0].stage_uid == leaky.uid
+
+
+def test_label_slot_absorbs_taint():
+    """The declared label position of a label-aware stage is NOT leakage."""
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+    from transmogrifai_tpu.preparators import SanityChecker
+
+    survived, age = _real_features("Survived", "Age", response="Survived")
+    vec = RealVectorizer().set_input(age)
+    checked = SanityChecker().set_input(survived, vec.get_output())
+    f = lint_dag(compute_dag([checked.get_output()]))
+    assert len(f) == 0
+
+
+def test_suppress_drops_rules():
+    a, b = _real_features("a", "b")
+    s = _PassThrough().set_input(b)
+    dag = StagesDAG([[_gen(a)], [s]])
+    assert len(lint_dag(dag, suppress=["TM001"])) == 0
+
+
+# ---------------------------------------------------------------------------
+# train(validate=True) wiring
+# ---------------------------------------------------------------------------
+
+def _leaky_workflow():
+    import pandas as pd
+
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    survived, age = _real_features("Survived", "Age", response="Survived")
+    leaky = RealVectorizer().set_input(survived, age)
+    df = pd.DataFrame({"Survived": [0.0, 1.0, 1.0, 0.0],
+                       "Age": [20.0, 30.0, 40.0, 50.0]})
+    return (OpWorkflow().set_result_features(leaky.get_output())
+            .set_input_data(df))
+
+
+def test_train_validate_raises_before_fitting():
+    wf = _leaky_workflow()
+    with pytest.raises(PipelineLintError) as ei:
+        wf.train()
+    assert "TM006" in str(ei.value)
+    assert ei.value.findings.rules_fired() == ["TM006"]
+
+
+def test_train_validate_false_opts_out():
+    model = _leaky_workflow().train(validate=False)
+    assert model.lint_snapshot is None
+
+
+def test_train_attaches_lint_snapshot(tmp_path):
+    import pandas as pd
+
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    age, fare = _real_features("Age", "Fare")
+    vec = RealVectorizer().set_input(age, fare)
+    df = pd.DataFrame({"Age": [20.0, 30.0, 40.0, 50.0],
+                       "Fare": [1.0, 2.0, 3.0, 4.0]})
+    wf = (OpWorkflow().set_result_features(vec.get_output())
+          .set_input_data(df))
+    model = wf.train(profile=True)
+    snap = model.lint_snapshot
+    assert snap is not None and snap.rule_counts == {}
+    assert snap.wall_s < 0.1  # pure graph walk; <1% of train by contract
+    assert model.train_profile.lint is snap
+    assert "lint" in model.train_profile.to_json()
+
+
+# ---------------------------------------------------------------------------
+# SchemaError at wiring time
+# ---------------------------------------------------------------------------
+
+def test_schema_error_on_mistyped_wire():
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    t = FeatureBuilder.Text("t").as_predictor()
+    vec = RealVectorizer()
+    with pytest.raises(SchemaError) as ei:
+        vec.set_input(t)
+    msg = str(ei.value)
+    assert vec.uid in msg and "OPNumeric" in msg and "Text" in msg
+
+
+def test_schema_variadic_last_entry_repeats():
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    a, b = _real_features("a", "b")
+    t = FeatureBuilder.Text("t").as_predictor()
+    RealVectorizer().set_input(a, b)  # fine
+    with pytest.raises(SchemaError):
+        RealVectorizer().set_input(a, t)  # repeated entry checks input 1
+
+
+def test_untyped_stages_accept_anything():
+    t = FeatureBuilder.Text("t").as_predictor()
+    _PassThrough().set_input(t)  # no input_types declared -> historical
+
+
+# ---------------------------------------------------------------------------
+# TM02x — runtime contracts (TMOG_CHECK=1)
+# ---------------------------------------------------------------------------
+
+class _InPlaceWriter(_PassThrough):
+    """COW violator: writes into the input buffer during transform."""
+
+    def transform_columns(self, col):
+        vals = np.asarray(col.values)
+        vals[0] = -1.0  # the violation
+        return FeatureColumn(Real, vals, col.mask)
+
+
+class _NonDeterministic(_PassThrough):
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+        self._calls = 0
+
+    def transform_columns(self, col):
+        self._calls += 1
+        return FeatureColumn(
+            Real, np.full(len(col.values), float(self._calls)), None)
+
+
+def _unary_data(values=(1.0, 2.0, 3.0, 4.0)):
+    data, (f,) = TestFeatureBuilder.build(("x", Real, list(values)))
+    return data, f
+
+
+def test_tm020_cow_violation_detected_and_attributed():
+    data, f = _unary_data()
+    bad = _InPlaceWriter().set_input(f)
+    with pytest.raises(ContractViolation) as ei:
+        guarded_transform_output(bad, data)
+    assert ei.value.diagnostic.rule == "TM020"
+    assert ei.value.diagnostic.stage_uid == bad.uid
+    # the guard restores writability afterwards
+    assert np.asarray(data["x"].values).flags.writeable
+
+
+def test_tm020_end_to_end_under_check_env(monkeypatch):
+    import pandas as pd
+
+    monkeypatch.setenv("TMOG_CHECK", "1")
+    (x,) = _real_features("x")
+    bad = _InPlaceWriter().set_input(x)
+    wf = (OpWorkflow().set_result_features(bad.get_output())
+          .set_input_data(pd.DataFrame({"x": [1.0, 2.0, 3.0]})))
+    with pytest.raises(ContractViolation, match="TM020"):
+        wf.train()
+
+
+def test_tm023_nondeterministic_transform():
+    data, f = _unary_data()
+    bad = _NonDeterministic().set_input(f)
+    with pytest.raises(ContractViolation) as ei:
+        guarded_transform_output(bad, data)
+    assert ei.value.diagnostic.rule == "TM023"
+
+
+def test_guard_passes_well_behaved_transform():
+    data, f = _unary_data()
+    ok = _PassThrough().set_input(f)
+    name, col = guarded_transform_output(ok, data)
+    assert name == ok.get_output().name
+    assert np.allclose(col.values, [1.0, 2.0, 3.0, 4.0])
+
+
+class _MeanFillBase(UnaryEstimator):
+    """Streaming mean-fitter scaffold: transform emits a constant column of
+    the fitted mean, making every state bug visible in the output."""
+
+    supports_streaming_fit = True
+
+    def __init__(self, uid=None):
+        super().__init__(operation_name="meanfit", output_type=RealNN,
+                         uid=uid)
+
+    class _M(UnaryModel):
+        def __init__(self, mean, uid=None):
+            super().__init__(operation_name="meanfit", output_type=RealNN,
+                             uid=uid)
+            self.mean = mean
+
+        def transform_columns(self, col):
+            return FeatureColumn(
+                RealNN, np.full(len(col.values), self.mean), None)
+
+    def fit_columns(self, data, col):
+        return self._M(float(np.mean(col.values)))
+
+    def begin_fit(self):
+        return (0.0, 0)
+
+    def update_chunk(self, state, data, col):
+        s, n = state
+        return s + float(np.sum(col.values)), n + len(col.values)
+
+    def merge_states(self, a, b):
+        return a[0] + b[0], a[1] + b[1]
+
+    def finish_fit(self, state):
+        s, n = state
+        return self._M(s / max(n, 1))
+
+
+class _NonAssociativeMerge(_MeanFillBase):
+    """Halving merge: the (sum, count) RATIO is preserved pairwise but the
+    relative chunk weights depend on the merge tree shape."""
+
+    def merge_states(self, a, b):
+        return (a[0] + b[0]) / 2.0, (a[1] + b[1]) / 2.0
+
+
+class _LastChunkWins(_MeanFillBase):
+    """update_chunk drops prior state -> fit_streaming != fit; merge (max)
+    stays associative so only TM022 fires."""
+
+    def update_chunk(self, state, data, col):
+        return float(np.sum(col.values)), len(col.values)
+
+    def merge_states(self, a, b):
+        return max(a, b)
+
+
+def _streaming_data(n=20):
+    rng = np.random.default_rng(3)
+    data, (f,) = TestFeatureBuilder.build(
+        ("x", Real, rng.normal(10.0, 4.0, n).tolist()))
+    return data, f
+
+
+def test_tm021_non_associative_merge():
+    data, f = _streaming_data()
+    est = _NonAssociativeMerge().set_input(f)
+    findings = check_streaming_fit(est, data)
+    assert findings.rules_fired() == ["TM021"]
+
+
+def test_tm022_streaming_diverges_from_fit():
+    data, f = _streaming_data()
+    est = _LastChunkWins().set_input(f)
+    findings = check_streaming_fit(est, data)
+    assert findings.rules_fired() == ["TM022"]
+
+
+def test_conformant_streaming_fitter_is_clean():
+    data, f = _streaming_data()
+    est = _MeanFillBase().set_input(f)
+    assert len(check_streaming_fit(est, data)) == 0
+
+
+def test_all_vectorizer_families_cow_clean():
+    """The ops/ in-place-mutation audit, wide: every transmogrify family
+    (numeric, text, picklist, multipicklist, date, date-list, geo, maps)
+    under the COW + determinism guards and the streaming conformance
+    property checks.  Guards any future transformer regressing to
+    in-place input mutation."""
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    rng = np.random.default_rng(5)
+    n = 60
+
+    def ms():
+        return int(rng.integers(1_500_000_000_000, 1_700_000_000_000))
+
+    data, feats = TestFeatureBuilder.build(
+        ("lbl", ft.RealNN, (rng.random(n) > 0.5).astype(float).tolist()),
+        ("r", ft.Real, [None if rng.random() < .2 else float(rng.normal())
+                        for _ in range(n)]),
+        ("i", ft.Integral, [None if rng.random() < .2
+                            else int(rng.integers(0, 9)) for _ in range(n)]),
+        ("b", ft.Binary, [None if rng.random() < .2
+                          else bool(rng.random() < .5) for _ in range(n)]),
+        ("t", ft.Text, [None if rng.random() < .3
+                        else f"w{rng.integers(0, 40)}" for _ in range(n)]),
+        ("pl", ft.PickList, [f"c{rng.integers(0, 5)}" for _ in range(n)]),
+        ("mpl", ft.MultiPickList,
+         [{f"s{rng.integers(0, 6)}" for _ in range(rng.integers(0, 3))}
+          for _ in range(n)]),
+        ("d", ft.Date, [None if rng.random() < .2 else ms()
+                        for _ in range(n)]),
+        ("dl", ft.DateList,
+         [tuple(ms() for _ in range(rng.integers(0, 3)))
+          for _ in range(n)]),
+        ("geo", ft.Geolocation,
+         [None if rng.random() < .2
+          else (float(rng.uniform(-60, 60)), float(rng.uniform(-170, 170)),
+                5.0) for _ in range(n)]),
+        ("rm", ft.RealMap,
+         [{k: float(rng.normal()) for k in ("a", "b")
+           if rng.random() < .7} for _ in range(n)]),
+        ("tm", ft.TextMap,
+         [{k: f"v{rng.integers(0, 4)}" for k in ("x", "y")
+           if rng.random() < .7} for _ in range(n)]),
+        response="lbl",
+    )
+    vec = transmogrify(feats[1:])
+    wf = OpWorkflow().set_result_features(vec)
+    assert len(lint_workflow(wf)) == 0
+    findings = check_workflow_contracts(wf, data=data)
+    assert len(findings) == 0, findings.format()
+
+
+def test_shipped_streaming_fitters_conform():
+    """Auto-discovered conformance audit over the real featurization DAG:
+    every supports_streaming_fit estimator + every transform under the
+    COW/determinism guards (the ops/ in-place-mutation regression)."""
+    sys.path.insert(0, os.path.join(_ROOT, "examples"))
+    try:
+        from bench_pipeline import make_titanic_like, titanic_features
+    finally:
+        sys.path.pop(0)
+
+    survived, checked = titanic_features()
+    wf = (OpWorkflow().set_result_features(checked)
+          .set_input_data(make_titanic_like(150)))
+    findings = check_workflow_contracts(wf)
+    assert len(findings) == 0, findings.format()
+
+
+# ---------------------------------------------------------------------------
+# TM03x — trace-safety lint
+# ---------------------------------------------------------------------------
+
+def test_tm030_host_sync_in_jit():
+    f = lint_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n")
+    assert f.rules_fired() == ["TM030"]
+    assert f.by_rule("TM030")[0].location.endswith(":4")
+
+
+def test_tm030_taint_flows_through_assignment():
+    f = lint_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = x * 2\n"
+        "    return y.item()\n")
+    assert f.rules_fired() == ["TM030"]
+
+
+def test_tm030_static_metadata_is_clean():
+    f = lint_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    n = int(x.shape[0]) + len(x)\n"
+        "    return x * n\n")
+    assert len(f) == 0
+
+
+def test_tm030_host_constant_cast_is_clean():
+    f = lint_source(
+        "import jax\n"
+        "class A:\n"
+        "    @jax.jit\n"
+        "    def f(self, x):\n"
+        "        lr = float(self.learning_rate)\n"
+        "        return x * lr\n")
+    assert len(f) == 0
+
+
+def test_tm030_static_args_not_tainted():
+    f = lint_source(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def f(x, n):\n"
+        "    return x * int(n)\n")
+    assert len(f) == 0
+
+
+def test_tm031_python_scalar_closure():
+    f = lint_source(
+        "import jax\n"
+        "def outer(xs):\n"
+        "    n = 3\n"
+        "    @jax.jit\n"
+        "    def inner(x):\n"
+        "        return x * n\n"
+        "    return inner(xs)\n")
+    assert f.rules_fired() == ["TM031"]
+    assert not f.errors  # warning severity
+
+
+def test_tm031_array_closure_is_clean():
+    f = lint_source(
+        "import jax\n"
+        "import numpy as np\n"
+        "def outer(xs):\n"
+        "    w = np.ones(3)\n"
+        "    @jax.jit\n"
+        "    def inner(x):\n"
+        "        return x * w\n"
+        "    return inner(xs)\n")
+    assert len(f) == 0
+
+
+def test_tm032_unhashable_static_default():
+    f = lint_source(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def f(x, opts=[1, 2]):\n"
+        "    return x\n")
+    assert f.rules_fired() == ["TM032"]
+
+
+def test_tm032_static_index_out_of_range():
+    f = lint_source(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(5,))\n"
+        "def f(x):\n"
+        "    return x\n")
+    assert f.rules_fired() == ["TM032"]
+
+
+def test_disable_comment_suppresses():
+    f = lint_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # tmog: disable=TM030\n")
+    assert len(f) == 0
+
+
+def test_repo_self_lint_is_clean():
+    """The shipped jit-heavy trees must stay trace-safe (tier1 contract)."""
+    trees = ["models", "serving", "parallel", "ops"]
+    findings = lint_paths(
+        [os.path.join(_ROOT, "transmogrifai_tpu", t) for t in trees])
+    assert len(findings) == 0, findings.format()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_rules_catalog(capsys):
+    assert lint_cli(["--rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+def test_cli_source_findings_exit_nonzero(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return float(x)\n")
+    assert lint_cli([str(bad)]) == 1
+    assert "TM030" in capsys.readouterr().out
+    assert lint_cli([str(bad), "--suppress", "TM030"]) == 0
+
+
+def test_cli_json_report(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    assert lint_cli([str(bad), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["errors"] == 1
+    assert report["findings"][0]["rule"] == "TM030"
+
+
+def test_cli_dag_spec(capsys):
+    spec = os.path.join(_ROOT, "examples",
+                        "bench_pipeline.py") + ":titanic_features"
+    assert lint_cli(["--dag", spec]) == 0
+    assert "no findings" in capsys.readouterr().out
+
+
+def test_module_entry_self_lint():
+    """`python -m transmogrifai_tpu.lint` over the repo: the tier1 gate."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.lint",
+         os.path.join(_ROOT, "transmogrifai_tpu")],
+        capture_output=True, text=True, cwd=_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# clean titanic-shaped pipeline: zero findings, end to end
+# ---------------------------------------------------------------------------
+
+def test_clean_pipeline_zero_findings(monkeypatch):
+    sys.path.insert(0, os.path.join(_ROOT, "examples"))
+    try:
+        from bench_pipeline import make_titanic_like, titanic_features
+    finally:
+        sys.path.pop(0)
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, grid,
+    )
+
+    survived, checked = titanic_features()
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(),
+                                grid(reg_param=[0.1]))],
+    ).set_input(survived, checked).get_output()
+    wf = (OpWorkflow().set_result_features(pred)
+          .set_input_data(make_titanic_like(250)))
+
+    findings = lint_workflow(wf)
+    assert len(findings) == 0, findings.format()
+
+    # the instrumented train: every transform under the COW/determinism
+    # guards; a clean run proves no ops/ transformer mutates its input
+    monkeypatch.setenv("TMOG_CHECK", "1")
+    model = wf.train()
+    assert model.lint_snapshot is not None
+    assert model.lint_snapshot.rule_counts == {}
+    # fitted models lint clean too
+    assert len(lint_workflow(model)) == 0

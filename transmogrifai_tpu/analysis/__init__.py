@@ -1,0 +1,41 @@
+"""Static analysis + contract checking for pipelines (``tmog lint``).
+
+The Scala reference rejects mis-wired feature DAGs at *compile* time — the
+sealed ``FeatureType`` hierarchy and arity-typed stage signatures make a
+dangling column or a label-leaking wire a type error before any data moves
+(PAPER.md §1).  The Python port traded that away; this package wins the
+safety layer back as three rule families, each with stable ``TM0xx`` ids:
+
+* **DAG lint** (``linter``, TM00x) — pure static validation of an
+  ``OpWorkflow``/``StagesDAG``/``ExecutionPlan`` before ``train``/``score``:
+  dangling inputs, shadowed/duplicate output columns, feature-type
+  mismatches at stage boundaries, dead stages, label leakage.
+* **Contract checks** (``contracts``, TM02x) — opt-in ``TMOG_CHECK=1``
+  instrumented mode enforcing the runtime contracts PRs 1-3 introduced:
+  copy-on-write ``transform`` (inputs are frozen ``writeable=False`` and a
+  write is attributed to the offending stage), transform determinism, and
+  mergeable streaming-fit conformance (associativity + ``fit_streaming``
+  vs ``fit`` equivalence within each fitter's documented tolerance).
+* **Trace-safety lint** (``trace_lint``, TM03x) — an AST pass over source
+  files flagging host syncs inside jit-decorated functions, Python-scalar
+  closures that become fresh trace constants (recompile hazards), and
+  unhashable static-argument declarations.
+
+CLI: ``python -m transmogrifai_tpu.lint`` (or ``tmog lint``); library entry
+points: ``lint_dag``, ``lint_workflow``, ``lint_paths``,
+``check_workflow_contracts``.
+"""
+from .diagnostics import (  # noqa: F401
+    Diagnostic, Findings, PipelineLintError, ContractViolation, RULES,
+)
+from .linter import lint_dag, lint_workflow  # noqa: F401
+from .trace_lint import lint_paths, lint_source  # noqa: F401
+from .contracts import (  # noqa: F401
+    checks_enabled, check_streaming_fit, check_workflow_contracts,
+)
+
+__all__ = [
+    "Diagnostic", "Findings", "PipelineLintError", "ContractViolation",
+    "RULES", "lint_dag", "lint_workflow", "lint_paths", "lint_source",
+    "checks_enabled", "check_streaming_fit", "check_workflow_contracts",
+]

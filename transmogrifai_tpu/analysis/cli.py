@@ -1,0 +1,124 @@
+"""``tmog lint`` / ``python -m transmogrifai_tpu.lint`` — the analyzer CLI.
+
+Two kinds of targets, combinable in one invocation:
+
+* **Source paths** (positional) — trace-safety lint (TM03x) over ``.py``
+  files and directory trees.
+* **Pipelines** (``--dag SPEC``, repeatable) — DAG lint (TM00x) of a
+  workflow built by a factory.  ``SPEC`` is ``module.path:callable`` or
+  ``path/to/file.py:callable``; the callable (invoked with no arguments)
+  may return an ``OpWorkflow``/``OpWorkflowModel``, a ``Feature``, or a
+  tuple/list of ``Feature``s (the result features).
+
+Exit status is non-zero when any finding (error or warning) is reported —
+the CI contract ``scripts/tier1.sh`` relies on.  ``--json`` emits a
+machine-readable report; ``--rules`` prints the rule catalog.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from .diagnostics import RULES, Findings
+
+__all__ = ["main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "tmog lint",
+        description="pipeline static analyzer: DAG lint (TM00x) + "
+                    "trace-safety lint (TM03x)")
+    p.add_argument("paths", nargs="*",
+                   help=".py files / directories for the trace-safety lint")
+    p.add_argument("--dag", action="append", default=[], metavar="SPEC",
+                   help="lint a pipeline DAG built by SPEC = "
+                        "module:callable or file.py:callable (repeatable)")
+    p.add_argument("--suppress", default="", metavar="TM001,TM005",
+                   help="comma-separated rule ids to drop from the report")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report instead of text")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _load_factory(spec: str):
+    mod_part, sep, attr = spec.partition(":")
+    if not sep:
+        raise SystemExit(f"--dag expects module:callable, got {spec!r}")
+    if mod_part.endswith(".py"):
+        name = os.path.splitext(os.path.basename(mod_part))[0]
+        loader_spec = importlib.util.spec_from_file_location(name, mod_part)
+        if loader_spec is None or loader_spec.loader is None:
+            raise SystemExit(f"cannot load {mod_part!r}")
+        module = importlib.util.module_from_spec(loader_spec)
+        sys.modules.setdefault(name, module)
+        loader_spec.loader.exec_module(module)
+    else:
+        module = importlib.import_module(mod_part)
+    try:
+        return getattr(module, attr)
+    except AttributeError:
+        raise SystemExit(f"{mod_part!r} has no attribute {attr!r}")
+
+
+def _lint_dag_spec(spec: str, findings: Findings) -> None:
+    from ..features.feature import Feature
+    from ..workflow.dag import compute_dag
+    from .linter import lint_dag, lint_workflow
+
+    obj = _load_factory(spec)
+    if callable(obj) and not isinstance(obj, Feature):
+        obj = obj()
+    if isinstance(obj, Feature):
+        obj = [obj]
+    if isinstance(obj, (tuple, list)) and obj and all(
+            isinstance(f, Feature) for f in obj):
+        findings.extend(lint_dag(compute_dag(list(obj)),
+                                 result_features=list(obj)))
+    elif hasattr(obj, "result_features"):
+        findings.extend(lint_workflow(obj))
+    else:
+        raise SystemExit(
+            f"--dag {spec!r} returned {type(obj).__name__}; expected an "
+            f"OpWorkflow, a Feature, or a sequence of Features")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.rules:
+        for rule, (sev, title) in sorted(RULES.items()):
+            print(f"{rule} [{sev}] {title}")
+        return 0
+    if not args.paths and not args.dag:
+        build_parser().print_usage()
+        return 2
+
+    findings = Findings()
+    if args.paths:
+        from .trace_lint import lint_paths
+
+        findings.extend(lint_paths(args.paths))
+    for spec in args.dag:
+        _lint_dag_spec(spec, findings)
+
+    suppress = {r.strip() for r in args.suppress.split(",") if r.strip()}
+    if suppress:
+        findings.diagnostics = [d for d in findings.diagnostics
+                                if d.rule not in suppress]
+
+    if args.as_json:
+        print(json.dumps(findings.to_json(), indent=2))
+    else:
+        print(findings.format())
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,306 @@
+"""Runtime contract checks (TM02x) — the ``TMOG_CHECK=1`` instrumented mode.
+
+PRs 1-3 made the executor correct only under implicit contracts nothing
+enforced: ``Transformer.transform`` must be copy-on-write (the layer-parallel
+executor hands one dataset to concurrent stages), transforms must be
+deterministic (serving parity and the sequential/plan byte-parity tests
+assume it), and streaming fits must be mergeable and equivalent to in-core
+fits.  With ``TMOG_CHECK=1`` the executor routes every transform through
+:func:`guarded_transform_output`:
+
+* **COW detection** — every input ndarray buffer is flipped
+  ``writeable=False`` for the duration of the stage's transform; an
+  in-place write raises immediately and is attributed to the offending
+  stage as TM020 (instead of corrupting a sibling stage's view three
+  layers later).
+* **Determinism probe** — the transform runs twice on the same frozen
+  input; differing bytes are a TM023.
+
+Streaming-fit conformance (TM021/TM022) is a property check over every
+``supports_streaming_fit`` estimator: chunk-independent states must merge
+associatively, and ``fit_streaming`` at two chunk sizes must match ``fit``
+within the fitter's declared ``streaming_fit_tol``.
+``check_workflow_contracts`` auto-discovers the estimators by walking a
+workflow's DAG the way the sequential executor would.
+
+Checks are enforcing: violations raise :class:`ContractViolation` at the
+exact offending stage.  The property-check entry points instead *collect*
+into ``Findings`` so a full audit reports every violation at once.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .diagnostics import ContractViolation, Diagnostic, Findings
+
+__all__ = ["CHECK_ENV", "checks_enabled", "guarded_transform_output",
+           "columns_equal", "columns_close", "check_streaming_fit",
+           "check_workflow_contracts"]
+
+#: set to "1" to enable the instrumented mode (used by tests and the tier-1
+#: contract gate); any other value disables it with zero overhead beyond one
+#: env lookup per transform
+CHECK_ENV = "TMOG_CHECK"
+
+
+def checks_enabled() -> bool:
+    return os.environ.get(CHECK_ENV) == "1"
+
+
+# ---------------------------------------------------------------------------
+# COW freeze + determinism probe
+# ---------------------------------------------------------------------------
+
+def _column_buffers(col) -> List[np.ndarray]:
+    """The mutable ndarray buffers a FeatureColumn exposes to a stage."""
+    out = []
+    vals = col.values
+    if isinstance(vals, np.ndarray):
+        out.append(vals)
+    else:
+        # PredictionBatch-style composite values
+        for attr in ("prediction", "raw_prediction", "probability"):
+            a = getattr(vals, attr, None)
+            if isinstance(a, np.ndarray):
+                out.append(a)
+    if isinstance(col.mask, np.ndarray):
+        out.append(col.mask)
+    return out
+
+
+@contextlib.contextmanager
+def _frozen(data):
+    """Freeze every input column buffer ``writeable=False``; restore the
+    prior flags on exit (only buffers we actually flipped)."""
+    flipped: List[np.ndarray] = []
+    try:
+        for col in data.columns.values():
+            for arr in _column_buffers(col):
+                if arr.flags.writeable:
+                    try:
+                        arr.setflags(write=False)
+                    except ValueError:  # pragma: no cover - exotic views
+                        continue
+                    flipped.append(arr)
+        yield
+    finally:
+        for arr in flipped:
+            try:
+                arr.setflags(write=True)
+            except ValueError:  # pragma: no cover - base was re-frozen
+                pass
+
+
+def _run_frozen(stage, data):
+    try:
+        return stage.transform_output(data)
+    except ValueError as e:
+        if "read-only" in str(e) or "not writeable" in str(e):
+            raise ContractViolation(Diagnostic(
+                rule="TM020",
+                message=(f"{type(stage).__name__} wrote to an input buffer "
+                         f"during transform (caught under TMOG_CHECK=1 "
+                         f"write-protection): {e}"),
+                stage_uid=stage.uid)) from e
+        raise
+
+
+def guarded_transform_output(stage, data) -> Tuple[str, object]:
+    """``stage.transform_output(data)`` under the TM020/TM023 guards."""
+    with _frozen(data):
+        name, col = _run_frozen(stage, data)
+        name2, col2 = _run_frozen(stage, data)
+    if name != name2 or not columns_equal(col, col2):
+        raise ContractViolation(Diagnostic(
+            rule="TM023",
+            message=(f"{type(stage).__name__} transform is "
+                     f"non-deterministic: two runs over the same input "
+                     f"produced different output for {name!r}"),
+            stage_uid=stage.uid))
+    return name, col
+
+
+# ---------------------------------------------------------------------------
+# Column comparison
+# ---------------------------------------------------------------------------
+
+def _parts(col) -> List[Tuple[str, object]]:
+    vals = col.values
+    if isinstance(vals, np.ndarray):
+        parts = [("values", vals)]
+    else:
+        parts = [(a, getattr(vals, a, None))
+                 for a in ("prediction", "raw_prediction", "probability")]
+    parts.append(("mask", col.mask))
+    return parts
+
+
+def _arrays_match(a, b, rtol: Optional[float]) -> bool:
+    if a is None or b is None:
+        return (a is None) == (b is None)
+    a, b = np.asarray(a), np.asarray(b)
+    if a.shape != b.shape:
+        return False
+    if a.dtype == object or b.dtype == object:
+        return all(_obj_eq(x, y) for x, y in zip(a.ravel(), b.ravel()))
+    if rtol is None:
+        return a.tobytes() == b.tobytes()
+    return bool(np.allclose(a, b, rtol=rtol, atol=rtol, equal_nan=True))
+
+
+def _obj_eq(x, y) -> bool:
+    if isinstance(x, float) and isinstance(y, float):
+        return x == y or (np.isnan(x) and np.isnan(y))
+    try:
+        return bool(x == y)
+    except Exception:  # pragma: no cover - incomparable cells
+        return x is y
+
+
+def columns_equal(a, b) -> bool:
+    """Byte-exact FeatureColumn equality (determinism contract)."""
+    return all(_arrays_match(x, y, None)
+               for (_, x), (_, y) in zip(_parts(a), _parts(b)))
+
+
+def columns_close(a, b, rtol: float) -> bool:
+    """FeatureColumn equality within ``rtol`` on float payloads; masks and
+    object cells must match exactly (streaming-fit contract)."""
+    for (name, x), (_, y) in zip(_parts(a), _parts(b)):
+        tol = None if name == "mask" else rtol
+        if not _arrays_match(x, y, tol):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Streaming-fit conformance
+# ---------------------------------------------------------------------------
+
+def _chunk(data, size: int):
+    n = len(data)
+    return [data.slice(i, min(i + size, n)) for i in range(0, n, size)]
+
+
+def _chunk_state(est, chunk):
+    state = est.begin_fit()
+    cols = [chunk[n] for n in est.input_names]
+    return est.update_chunk(state, chunk, *cols)
+
+
+def _model_output(est, model, data):
+    return est.adopt_model(model).transform_output(data)[1]
+
+
+def check_streaming_fit(est, data, chunk_sizes: Sequence[int] = (7, 64),
+                        findings: Optional[Findings] = None,
+                        ref_model=None) -> Findings:
+    """Property-check one ``supports_streaming_fit`` estimator against
+    ``data`` (a ColumnarDataset holding its input columns).
+
+    TM022: ``fit_streaming`` at each chunk size must reproduce ``fit``'s
+    transform output within ``est.streaming_fit_tol``.  TM021: states built
+    independently per chunk must merge associatively — and, when the
+    estimator declares ``streaming_order_insensitive``, commutatively.
+    Merges run on deep copies because implementations may fold in place.
+    ``ref_model`` (an already-fitted model for ``est``) skips the reference
+    re-fit.
+    """
+    findings = findings if findings is not None else Findings()
+    tol = float(est.streaming_fit_tol)
+    name = type(est).__name__
+    if ref_model is None:
+        ref_model = est.fit(data)
+    ref_out = ref_model.transform_output(data)[1]
+
+    for cs in chunk_sizes:
+        if cs >= len(data):
+            continue
+        m = est.fit_streaming(iter(_chunk(data, cs)))
+        if not columns_close(ref_out, m.transform_output(data)[1], tol):
+            findings.add(
+                "TM022",
+                f"{name}.fit_streaming(chunk_rows={cs}) diverges from fit "
+                f"beyond tol={tol}", stage_uid=est.uid)
+
+    # associativity over three uneven chunks
+    n = len(data)
+    if n >= 6:
+        cuts = [0, n // 4 or 1, n // 2 + 1, n]
+        states = [_chunk_state(est, data.slice(cuts[i], cuts[i + 1]))
+                  for i in range(3)]
+
+        def merged(order, shape) -> object:
+            s = [copy.deepcopy(states[i]) for i in order]
+            if shape == "left":
+                return est.merge_states(est.merge_states(s[0], s[1]), s[2])
+            return est.merge_states(s[0], est.merge_states(s[1], s[2]))
+
+        left = _model_output(est, est.finish_fit(merged((0, 1, 2), "left")),
+                             data)
+        right = _model_output(est, est.finish_fit(merged((0, 1, 2), "right")),
+                              data)
+        if not columns_close(left, right, tol):
+            findings.add(
+                "TM021",
+                f"{name}.merge_states is not associative: "
+                f"(a+b)+c != a+(b+c) beyond tol={tol}", stage_uid=est.uid)
+        if est.streaming_order_insensitive:
+            rev = _model_output(
+                est, est.finish_fit(merged((2, 1, 0), "left")), data)
+            if not columns_close(left, rev, tol):
+                findings.add(
+                    "TM021",
+                    f"{name}.merge_states is order-sensitive but the "
+                    f"estimator declares streaming_order_insensitive",
+                    stage_uid=est.uid)
+    # leave the estimator wired to the reference model for callers that
+    # continue executing the DAG
+    est.adopt_model(ref_model)
+    return findings
+
+
+def check_workflow_contracts(wf, data=None,
+                             chunk_sizes: Sequence[int] = (7, 64),
+                             ) -> Findings:
+    """Walk a workflow's DAG sequentially, property-checking every
+    streaming-capable estimator (TM021/TM022) and running every transform
+    under the COW/determinism guards (TM020/TM023).  Returns the combined
+    ``Findings``; guard violations are converted to findings rather than
+    raised, so one audit reports everything."""
+    from ..stages.base import Estimator, Transformer
+    from ..workflow.dag import compute_dag
+
+    findings = Findings()
+    dag = compute_dag(wf.result_features)
+    if data is None:
+        data = wf.generate_raw_data()
+
+    for layer in dag.non_generator_layers():
+        for stage in layer:
+            if isinstance(stage, Estimator):
+                model = stage.fit(data)
+                if bool(stage.supports_streaming_fit):
+                    try:
+                        check_streaming_fit(stage, data,
+                                            chunk_sizes=chunk_sizes,
+                                            findings=findings,
+                                            ref_model=model)
+                    except ContractViolation as e:
+                        findings.diagnostics.append(e.diagnostic)
+            elif isinstance(stage, Transformer):
+                model = stage
+            else:  # pragma: no cover - unreachable in valid DAGs
+                continue
+            try:
+                name, col = guarded_transform_output(model, data)
+            except ContractViolation as e:
+                findings.diagnostics.append(e.diagnostic)
+                name, col = model.transform_output(data)
+            data = data.with_columns({name: col})
+    return findings

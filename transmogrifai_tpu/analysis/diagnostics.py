@@ -1,0 +1,148 @@
+"""Shared diagnostic machinery for the three lint rule families.
+
+Every finding is a ``Diagnostic`` with a stable rule id (``TM0xx``), a
+severity, and a location — ``file:line`` for source-level (trace) findings,
+a stage uid for DAG/contract findings — so CI output is greppable and
+suppressions are precise.  ``Findings`` is the ordered container all
+analyzers return; the CLI exits non-zero when it is non-empty.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = ["Diagnostic", "Findings", "PipelineLintError",
+           "ContractViolation", "RULES", "ERROR", "WARNING"]
+
+ERROR = "error"
+WARNING = "warning"
+
+#: rule catalog: id -> (default severity, one-line title).  The authoritative
+#: prose catalog (what each rule means, how to fix, how to suppress) lives in
+#: docs/static-analysis.md.
+RULES: Dict[str, Any] = {
+    # -- DAG lint (analysis/linter.py) ----------------------------------
+    "TM001": (ERROR, "dangling input column: no stage in the DAG produces it"),
+    "TM002": (ERROR, "shadowed column: a stage output overwrites an earlier "
+                     "column of the same name"),
+    "TM003": (ERROR, "duplicate output column: two stages emit the same name"),
+    "TM004": (ERROR, "feature-type mismatch at a stage boundary"),
+    "TM005": (WARNING, "dead stage: computed but never consumed by a result "
+                       "feature"),
+    "TM006": (ERROR, "label leakage: response-derived feature wired into a "
+                     "predictor input"),
+    # -- runtime contracts (analysis/contracts.py, TMOG_CHECK=1) --------
+    "TM020": (ERROR, "copy-on-write violation: stage wrote to an input "
+                     "buffer during transform"),
+    "TM021": (ERROR, "merge_states is not associative"),
+    "TM022": (ERROR, "fit_streaming diverges from fit beyond the declared "
+                     "tolerance"),
+    "TM023": (ERROR, "non-deterministic transform: same input produced "
+                     "different bytes"),
+    # -- trace safety (analysis/trace_lint.py) --------------------------
+    "TM030": (ERROR, "host sync on a traced value inside a jit function"),
+    "TM031": (WARNING, "jit closure over an enclosing Python scalar: fresh "
+                       "trace constant per call (recompile hazard)"),
+    "TM032": (ERROR, "static argument declared on a parameter with an "
+                     "unhashable default"),
+}
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    """One finding: stable rule id + where + what."""
+
+    rule: str
+    message: str
+    severity: str = ERROR
+    #: DAG/contract findings: the offending stage's uid
+    stage_uid: Optional[str] = None
+    #: source findings: "path.py:42"
+    location: Optional[str] = None
+
+    def format(self) -> str:
+        where = self.location or (f"stage {self.stage_uid}"
+                                  if self.stage_uid else "<pipeline>")
+        return f"{where}: {self.rule} [{self.severity}] {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "severity": self.severity,
+                "message": self.message, "stageUid": self.stage_uid,
+                "location": self.location}
+
+
+class Findings:
+    """Ordered collection of diagnostics from one analysis run."""
+
+    def __init__(self, diagnostics: Optional[Iterable[Diagnostic]] = None):
+        self.diagnostics: List[Diagnostic] = list(diagnostics or ())
+
+    def add(self, rule: str, message: str, *, stage_uid: Optional[str] = None,
+            location: Optional[str] = None,
+            severity: Optional[str] = None) -> Diagnostic:
+        default_sev = RULES.get(rule, (ERROR, ""))[0]
+        d = Diagnostic(rule=rule, message=message,
+                       severity=severity or default_sev,
+                       stage_uid=stage_uid, location=location)
+        self.diagnostics.append(d)
+        return d
+
+    def extend(self, other: "Findings") -> "Findings":
+        self.diagnostics.extend(other.diagnostics)
+        return self
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == WARNING]
+
+    def rules_fired(self) -> List[str]:
+        return sorted({d.rule for d in self.diagnostics})
+
+    def by_rule(self, rule: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.rule == rule]
+
+    def __len__(self) -> int:
+        return len(self.diagnostics)
+
+    def __bool__(self) -> bool:
+        return bool(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def format(self) -> str:
+        if not self.diagnostics:
+            return "no findings"
+        lines = [d.format() for d in self.diagnostics]
+        lines.append(f"{len(self.errors)} error(s), "
+                     f"{len(self.warnings)} warning(s)")
+        return "\n".join(lines)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"findings": [d.to_json() for d in self.diagnostics],
+                "errors": len(self.errors), "warnings": len(self.warnings)}
+
+
+class PipelineLintError(ValueError):
+    """Raised by ``OpWorkflow.train(validate=True)`` when the DAG lint finds
+    error-severity problems — the fail-fast analogue of the reference's
+    compile-time rejection.  Carries the full ``Findings``."""
+
+    def __init__(self, findings: Findings):
+        self.findings = findings
+        super().__init__(
+            "pipeline failed static validation "
+            f"({len(findings.errors)} error(s)):\n" + findings.format())
+
+
+class ContractViolation(AssertionError):
+    """A runtime contract (TM02x) was broken under ``TMOG_CHECK=1``.
+    Carries the diagnostic so harnesses can aggregate into ``Findings``."""
+
+    def __init__(self, diagnostic: Diagnostic):
+        self.diagnostic = diagnostic
+        super().__init__(diagnostic.format())

@@ -1,0 +1,198 @@
+"""DAG lint — pure static validation of a feature/stage DAG (TM00x).
+
+Runs on an ``OpWorkflow``, ``StagesDAG`` or ``ExecutionPlan`` *before* any
+data moves, the way the Scala reference's type system rejected mis-wired
+DAGs at compile time:
+
+* TM001 — dangling input: a stage reads a column no stage in the DAG
+  produces (origin stage lost by deserialization or manual surgery).
+* TM002 — shadowed column: a stage's output name collides with a raw
+  (generator) column; ``with_columns`` would silently clobber the raw
+  input for every later consumer.
+* TM003 — duplicate output: two stages emit the same column name, so
+  layer merge order decides which survives.
+* TM004 — feature-type mismatch: the wired feature's semantic type does
+  not conform to the consumer stage's declared ``input_types``
+  (``stages/base.py``); the run-time analogue raises ``SchemaError`` at
+  ``set_input`` time, this catches DAGs assembled by other means.
+* TM005 — dead stage (warning): the execution plan would compute the
+  stage, but nothing on the path to the result features consumes it.
+* TM006 — label leakage: a response-derived feature reaches a predictor
+  input.  Taint starts at raw response features and propagates through
+  ordinary stages; ``label_input_positions`` (the declared label slots of
+  label-aware stages like SanityChecker and the model selector) both
+  absorb taint legitimately and mark where tainted *predictor* wires are
+  an error.  Vectorizing a tainted feature is flagged at the vectorizer.
+
+Diagnostics carry the stage uid plus the stage class's ``file:line`` so CI
+output is clickable.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..features.feature import Feature
+from ..stages.base import PipelineStage
+from ..types.feature_types import OPVector
+from .diagnostics import Findings
+
+__all__ = ["lint_dag", "lint_workflow", "lint_plan"]
+
+_CLASS_LOC: Dict[type, Optional[str]] = {}
+
+
+def _stage_location(stage: PipelineStage) -> Optional[str]:
+    cls = type(stage)
+    if cls not in _CLASS_LOC:
+        try:
+            f = inspect.getsourcefile(cls)
+            _, line = inspect.getsourcelines(cls)
+            _CLASS_LOC[cls] = f"{f}:{line}" if f else None
+        except (OSError, TypeError):
+            _CLASS_LOC[cls] = None
+    return _CLASS_LOC[cls]
+
+
+def _is_generator(stage: PipelineStage) -> bool:
+    from ..stages.generator import FeatureGeneratorStage
+
+    return isinstance(stage, FeatureGeneratorStage)
+
+
+def lint_dag(dag, result_features: Optional[Sequence[Feature]] = None,
+             suppress: Iterable[str] = ()) -> Findings:
+    """Lint a ``StagesDAG``.  ``result_features`` enables the dead-stage
+    rule (TM005); ``suppress`` drops listed rule ids from the report."""
+    findings = Findings()
+    suppress = set(suppress)
+
+    # -- column production map -------------------------------------------
+    produced: Dict[str, PipelineStage] = {}
+    for layer in dag.layers:
+        for s in layer:
+            name = s.get_output().name
+            prev = produced.get(name)
+            if prev is None:
+                produced[name] = s
+            elif prev.uid != s.uid:
+                if _is_generator(prev) and not _is_generator(s):
+                    findings.add(
+                        "TM002",
+                        f"output {name!r} of {type(s).__name__} shadows the "
+                        f"raw column produced by generator {prev.uid}",
+                        stage_uid=s.uid, location=_stage_location(s))
+                else:
+                    findings.add(
+                        "TM003",
+                        f"output {name!r} emitted by both {prev.uid} "
+                        f"({type(prev).__name__}) and {s.uid} "
+                        f"({type(s).__name__})",
+                        stage_uid=s.uid, location=_stage_location(s))
+
+    # -- per-stage wiring checks -----------------------------------------
+    for layer in dag.layers:
+        for s in layer:
+            if _is_generator(s):
+                continue
+            for i, f in enumerate(s.input_features):
+                if f.name not in produced:
+                    findings.add(
+                        "TM001",
+                        f"input {i} ({f.name!r}) of {type(s).__name__} is "
+                        f"produced by no stage in the DAG",
+                        stage_uid=s.uid, location=_stage_location(s))
+                exp = s.expected_input_type(i)
+                if exp is not None and not (
+                        isinstance(f.ftype, type)
+                        and issubclass(f.ftype, exp)):
+                    findings.add(
+                        "TM004",
+                        f"input {i} ({f.name!r}) of {type(s).__name__}: "
+                        f"expected {exp.__name__}, got "
+                        f"{getattr(f.ftype, '__name__', f.ftype)!r}",
+                        stage_uid=s.uid, location=_stage_location(s))
+
+    # -- dead stages vs the result features (TM005) ----------------------
+    if result_features is not None:
+        needed: Set[str] = set()
+        frontier: List[PipelineStage] = [
+            produced[f.name] for f in result_features if f.name in produced]
+        while frontier:
+            s = frontier.pop()
+            if s.uid in needed:
+                continue
+            needed.add(s.uid)
+            for f in s.input_features:
+                p = produced.get(f.name)
+                if p is not None:
+                    frontier.append(p)
+        for layer in dag.layers:
+            for s in layer:
+                if not _is_generator(s) and s.uid not in needed:
+                    findings.add(
+                        "TM005",
+                        f"{type(s).__name__} -> {s.get_output().name!r} is "
+                        f"computed but consumed by no result feature",
+                        stage_uid=s.uid, location=_stage_location(s))
+
+    # -- label leakage (TM006) -------------------------------------------
+    findings.extend(_lint_leakage(dag))
+
+    if suppress:
+        findings.diagnostics = [d for d in findings.diagnostics
+                                if d.rule not in suppress]
+    return findings
+
+
+def _lint_leakage(dag) -> Findings:
+    """Taint walk: raw responses taint; ordinary stages propagate; label
+    slots absorb; tainted predictor wires are findings."""
+    findings = Findings()
+    tainted: Set[str] = set()
+    for layer in dag.layers:
+        for s in layer:
+            out_name = s.get_output().name
+            if _is_generator(s):
+                if s.get_output().is_response:
+                    tainted.add(out_name)
+                continue
+            label_pos = set(s.label_input_positions)
+            offending = [
+                (i, f) for i, f in enumerate(s.input_features)
+                if f.name in tainted and i not in label_pos]
+            is_vectorizer = (isinstance(s.output_type, type)
+                             and issubclass(s.output_type, OPVector))
+            if offending and (label_pos or is_vectorizer):
+                names = ", ".join(f"{f.name!r} (input {i})"
+                                  for i, f in offending)
+                kind = ("predictor input of label-aware stage" if label_pos
+                        else "featurizer input")
+                findings.add(
+                    "TM006",
+                    f"response-derived feature(s) {names} wired into a "
+                    f"{kind} of {type(s).__name__}",
+                    stage_uid=s.uid, location=_stage_location(s))
+                continue  # report the root cause once, don't cascade
+            if offending:
+                # plain transform of a response (e.g. label rescaling):
+                # legitimate on its own; keep the taint flowing so a later
+                # predictor-side consumer is still caught
+                tainted.add(out_name)
+    return findings
+
+
+def lint_workflow(wf, suppress: Iterable[str] = ()) -> Findings:
+    """Lint an ``OpWorkflow`` (or fitted ``OpWorkflowModel``) by
+    reconstructing its stage DAG from the result features."""
+    from ..workflow.dag import compute_dag
+
+    return lint_dag(compute_dag(wf.result_features),
+                    result_features=wf.result_features, suppress=suppress)
+
+
+def lint_plan(plan, result_features: Optional[Sequence[Feature]] = None,
+              suppress: Iterable[str] = ()) -> Findings:
+    """Lint an ``ExecutionPlan`` (workflow/plan.py) via its source DAG."""
+    return lint_dag(plan.dag, result_features=result_features,
+                    suppress=suppress)

@@ -1,0 +1,413 @@
+"""Trace-safety lint (TM03x) — an AST pass over jit-heavy source trees.
+
+The zero-recompile counters from PR 1 catch trace-cache churn only *after*
+a deploy has already paid for it; these rules catch the three classic
+causes statically, before the code runs:
+
+* **TM030 — host sync inside jit.**  ``.item()``, ``.tolist()``,
+  ``float()``/``int()``/``bool()``, and ``np.asarray``/``np.array`` applied
+  to a *traced* value inside a jit-compiled function force a device
+  round-trip per call (or a tracer error at runtime).  Traced values are
+  the function's parameters minus declared static arguments, propagated
+  through local assignments (a small intra-function taint analysis keeps
+  ``float(self.learning_rate)``-style host-constant uses clean).
+* **TM031 — Python-scalar closure (warning).**  A jit function defined
+  inside another function that closes over an enclosing *Python scalar*
+  (a local assigned from a numeric literal, ``len()``, ``int()``/
+  ``float()``) bakes that scalar in as a fresh trace constant — a new
+  compile every time the enclosing function runs with a different value.
+  Closures over modules, arrays, and non-scalar locals are not flagged.
+* **TM032 — unhashable static argument.**  ``static_argnums``/
+  ``static_argnames`` naming a parameter whose default is a list/dict/set
+  display will raise ``TypeError: unhashable`` on the first defaulted
+  call; also flags static indices out of the parameter range.
+
+Suppression: a ``# tmog: disable=TM030`` comment (comma-separate several
+ids) on the flagged line or on the enclosing ``def`` line disables the
+rule there.  Entry points: :func:`lint_source`, :func:`lint_paths`.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import Findings
+
+__all__ = ["lint_source", "lint_paths"]
+
+_DISABLE_RE = re.compile(r"#\s*tmog:\s*disable=([A-Z0-9,\s]+)")
+
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_NP_SYNC_FNS = {"asarray", "array", "ascontiguousarray", "asfortranarray"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_SYNC_METHODS = {"item", "tolist"}
+
+#: enclosing-scope assignments considered "Python scalars" for TM031
+_SCALARISH_CALLS = {"len", "int", "float", "round"}
+
+_BUILTIN_NAMES = set(dir(builtins))
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return _dotted(node) in ("jit", "jax.jit")
+
+
+def _jit_call_parts(call: ast.Call) -> Optional[Tuple[List[int], List[str]]]:
+    """``functools.partial(jax.jit, ...)`` / ``jax.jit(...)`` -> declared
+    (static_argnums, static_argnames); None if the call is not jit."""
+    fn = call.func
+    is_partial = _dotted(fn) in ("partial", "functools.partial")
+    if is_partial:
+        if not (call.args and _is_jit_ref(call.args[0])):
+            return None
+    elif not _is_jit_ref(fn):
+        return None
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums.extend(_const_ints(kw.value))
+        elif kw.arg == "static_argnames":
+            names.extend(_const_strs(kw.value))
+    return nums, names
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [n.value for n in elts
+            if isinstance(n, ast.Constant) and isinstance(n.value, int)
+            and not isinstance(n.value, bool)]
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [n.value for n in elts
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in getattr(a, "posonlyargs", [])]
+            + [p.arg for p in a.args])
+
+
+def _target_names(t: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(t)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+
+
+def _load_names(e: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(e)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+#: attribute reads that are static trace-time metadata even on traced
+#: values — deriving a Python int from them is NOT a host sync
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+
+
+def _tainted_loads(e: ast.AST, tainted: Set[str]) -> Set[str]:
+    """Names from ``tainted`` loaded by ``e``, ignoring subtrees that only
+    read static metadata (``x.shape[0]``, ``len(x)``, ``x.dtype``)."""
+    hits: Set[str] = set()
+
+    def rec(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            return
+        if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                and n.id in tainted):
+            hits.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+
+    rec(e)
+    return hits
+
+
+def _scope_walk(scope: ast.AST):
+    """Yield this scope's nodes WITHOUT descending into nested
+    function/lambda/class bodies (those are separate scopes); nested scope
+    nodes themselves are yielded so the caller can recurse."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class _SourceLinter:
+    def __init__(self, code: str, filename: str):
+        self.filename = filename
+        self.findings = Findings()
+        self.suppressed: Dict[int, Set[str]] = {}
+        for i, line in enumerate(code.splitlines(), 1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                self.suppressed[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+        self.tree = ast.parse(code, filename=filename)
+        self.module_names = self._module_scope_names()
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> Findings:
+        self._visit_scope(self.tree, enclosing_fn=None)
+        return self.findings
+
+    def _visit_scope(self, scope: ast.AST, enclosing_fn) -> None:
+        """Lint jit targets belonging to one lexical scope, then recurse.
+
+        ``enclosing_fn`` is the nearest enclosing FunctionDef (None at
+        module/class level) — the scope whose Python-scalar locals a nested
+        jit closure would bake in as trace constants (TM031).
+        """
+        nodes = list(_scope_walk(scope))
+        local_defs = {n.name: n for n in nodes
+                      if isinstance(n, ast.FunctionDef)}
+        # decorated jit defs
+        for node in nodes:
+            if isinstance(node, ast.FunctionDef):
+                parts = self._jit_decorator(node)
+                if parts is not None and not getattr(node, "_tmog_jit", 0):
+                    node._tmog_jit = True
+                    self._lint_jit_function(node, parts, enclosing_fn)
+        # jax.jit(<lambda>) / jax.jit(<local def>) wrapping calls
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _jit_call_parts(node)
+            if parts is None or not node.args:
+                continue
+            target = node.args[0]
+            fnode = None
+            if isinstance(target, ast.Lambda):
+                fnode = target
+            elif isinstance(target, ast.Name):
+                fnode = local_defs.get(target.id)
+            if fnode is not None and not getattr(fnode, "_tmog_jit", 0):
+                fnode._tmog_jit = True
+                self._lint_jit_function(fnode, parts, enclosing_fn)
+        # recurse into nested scopes
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._visit_scope(node, enclosing_fn=node)
+            elif isinstance(node, ast.ClassDef):
+                self._visit_scope(node, enclosing_fn=enclosing_fn)
+
+    def _jit_decorator(self, fn: ast.FunctionDef):
+        for dec in fn.decorator_list:
+            if _is_jit_ref(dec):
+                return [], []
+            if isinstance(dec, ast.Call):
+                parts = _jit_call_parts(dec)
+                if parts is not None:
+                    return parts
+        return None
+
+    # -- reporting ---------------------------------------------------------
+
+    def _emit(self, rule: str, line: int, message: str,
+              def_line: Optional[int] = None) -> None:
+        for ln in (line, def_line):
+            if ln is not None and rule in self.suppressed.get(ln, ()):
+                return
+        self.findings.add(rule, message,
+                          location=f"{self.filename}:{line}")
+
+    # -- per-function analysis ----------------------------------------------
+
+    def _lint_jit_function(self, fn, parts, enclosing_fn) -> None:
+        static_nums, static_names = parts
+        params = _param_names(fn)
+        def_line = fn.lineno
+
+        # TM032: static args must be hashable / in range
+        defaults = getattr(fn.args, "defaults", [])
+        default_of = dict(zip(params[len(params) - len(defaults):], defaults))
+        static = set(static_names)
+        for i in static_nums:
+            if 0 <= i < len(params):
+                static.add(params[i])
+            elif not fn.args.vararg:
+                self._emit("TM032", def_line,
+                           f"static_argnums index {i} out of range for "
+                           f"{len(params)} parameter(s)", def_line)
+        kwonly = {p.arg for p in getattr(fn.args, "kwonlyargs", [])}
+        for nm in static_names:
+            if nm not in params and nm not in kwonly and not fn.args.kwarg:
+                self._emit("TM032", def_line,
+                           f"static_argnames {nm!r} names no parameter",
+                           def_line)
+        for nm in sorted(static):
+            d = default_of.get(nm)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and _dotted(d.func) in ("list", "dict", "set")):
+                self._emit("TM032", d.lineno,
+                           f"static argument {nm!r} has an unhashable "
+                           f"default ({type(d).__name__.lower()}); jit will "
+                           f"raise on the first defaulted call", def_line)
+
+        # TM030: taint params (minus static) through local assignments
+        tainted = set(params) - static - {"self"}
+        for _ in range(4):  # fixpoint over loop-carried assignments
+            grew = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if _tainted_loads(node.value, tainted):
+                        new = set().union(*(_target_names(t)
+                                            for t in node.targets))
+                        grew |= not new <= tainted
+                        tainted |= new
+                elif isinstance(node, ast.AugAssign):
+                    if (_tainted_loads(node.value, tainted)
+                            and isinstance(node.target, ast.Name)):
+                        grew |= node.target.id not in tainted
+                        tainted.add(node.target.id)
+                elif isinstance(node, ast.For):
+                    if _tainted_loads(node.iter, tainted):
+                        new = _target_names(node.target)
+                        grew |= not new <= tainted
+                        tainted |= new
+            if not grew:
+                break
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS
+                    and not node.args
+                    and _tainted_loads(f.value, tainted)):
+                self._emit("TM030", node.lineno,
+                           f".{f.attr}() on traced value "
+                           f"{ast.unparse(f.value)!r} inside jit",
+                           def_line)
+            elif (isinstance(f, ast.Name) and f.id in _HOST_CASTS
+                    and node.args
+                    and _tainted_loads(node.args[0], tainted)):
+                self._emit("TM030", node.lineno,
+                           f"{f.id}() on traced value "
+                           f"{ast.unparse(node.args[0])!r} inside jit",
+                           def_line)
+            elif (isinstance(f, ast.Attribute) and f.attr in _NP_SYNC_FNS
+                    and _dotted(f.value) in _NP_MODULES
+                    and node.args
+                    and _tainted_loads(node.args[0], tainted)):
+                self._emit("TM030", node.lineno,
+                           f"{_dotted(f)}() on traced value "
+                           f"{ast.unparse(node.args[0])!r} inside jit "
+                           f"(device->host copy per call)", def_line)
+
+        # TM031: closure over enclosing Python scalars
+        if enclosing_fn is not None:
+            scalars = self._scalarish_locals(enclosing_fn)
+            free = self._free_names(fn, params)
+            for nm in sorted(free & scalars):
+                self._emit("TM031", def_line,
+                           f"jit function closes over enclosing Python "
+                           f"scalar {nm!r}: becomes a fresh trace constant "
+                           f"(recompile per distinct value); pass it as a "
+                           f"static argument instead", def_line)
+
+    def _free_names(self, fn, params: Sequence[str]) -> Set[str]:
+        bound = set(params)
+        if getattr(fn.args, "vararg", None):
+            bound.add(fn.args.vararg.arg)
+        if getattr(fn.args, "kwarg", None):
+            bound.add(fn.args.kwarg.arg)
+        bound |= {p.arg for p in getattr(fn.args, "kwonlyargs", [])}
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        loads: Set[str] = set()
+        for stmt in body:
+            loads |= _load_names(stmt)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                             ast.Store):
+                    bound.add(node.id)
+        return loads - bound - self.module_names - _BUILTIN_NAMES
+
+    def _scalarish_locals(self, scope) -> Set[str]:
+        out: Set[str] = set()
+        for node in _scope_walk(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            scalar = (isinstance(v, ast.Constant)
+                      and isinstance(v.value, (int, float))
+                      and not isinstance(v.value, bool)) \
+                or (isinstance(v, ast.Call)
+                    and _dotted(v.func) in _SCALARISH_CALLS) \
+                or (isinstance(v, ast.BinOp)
+                    and all(isinstance(s, ast.Constant)
+                            for s in (v.left, v.right)))
+            if scalar:
+                out |= set().union(*(_target_names(t) for t in node.targets))
+        return out
+
+    def _module_scope_names(self) -> Set[str]:
+        names: Set[str] = set()
+        for node in self.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    names.add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    names |= _target_names(t)
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                names.add(node.target.id)
+        return names
+
+
+def lint_source(code: str, filename: str = "<string>") -> Findings:
+    """Trace-safety lint one source string."""
+    try:
+        return _SourceLinter(code, filename).run()
+    except SyntaxError as e:
+        f = Findings()
+        f.add("TM030", f"could not parse: {e}", severity="warning",
+              location=f"{filename}:{e.lineno or 0}")
+        return f
+
+
+def lint_paths(paths: Iterable[str]) -> Findings:
+    """Trace-safety lint files and directory trees of ``.py`` sources."""
+    findings = Findings()
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        full = os.path.join(root, fn)
+                        with open(full, encoding="utf-8") as fh:
+                            findings.extend(lint_source(fh.read(), full))
+        elif path.endswith(".py"):
+            with open(path, encoding="utf-8") as fh:
+                findings.extend(lint_source(fh.read(), path))
+    return findings

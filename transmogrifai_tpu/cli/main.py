@@ -49,6 +49,13 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--dest", default=".", help="output directory")
     gen.add_argument("--overwrite", action="store_true")
 
+    # dispatched before parsing (the analyzer owns its own parser; see
+    # main()) — registered here so `tmog --help` lists it
+    sub.add_parser(
+        "lint", add_help=False,
+        help="pipeline static analyzer: DAG lint + trace-safety lint "
+             "(python -m transmogrifai_tpu.lint)")
+
     srv = sub.add_parser(
         "serve", help="serve a persisted model (micro-batched scoring)")
     srv.add_argument("--model", required=True,
@@ -114,6 +121,13 @@ def _run_serve(args) -> int:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["lint"]:
+        # the analyzer owns its full argument grammar (paths, --dag,
+        # --suppress, --json, --rules) — hand everything after `lint` over
+        from ..analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.command == "gen":
         schema = ProblemSchema.from_file(

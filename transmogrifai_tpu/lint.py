@@ -1,0 +1,11 @@
+"""``python -m transmogrifai_tpu.lint`` — pipeline static analyzer entry.
+
+Thin shim over :mod:`transmogrifai_tpu.analysis.cli`; also reachable as the
+``lint`` subcommand of the package CLI (``tmog lint``).
+"""
+import sys
+
+from .analysis.cli import main  # noqa: F401  re-exported for embedding
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,7 @@ import numpy as np
 from ..features.feature import Feature
 from ..stages.base import BinaryEstimator, BinaryModel
 from ..types.columns import FeatureColumn
-from ..types.feature_types import Prediction
+from ..types.feature_types import OPNumeric, OPVector, Prediction
 
 __all__ = ["PredictionBatch", "prediction_column", "PredictorEstimator",
            "PredictorModel"]
@@ -75,6 +75,11 @@ class PredictorEstimator(BinaryEstimator):
     # serializes these in stable layer order instead of pooling them
     device_heavy = True
 
+    # input schema (SchemaError at wiring, TM004 statically); position 0 is
+    # the label slot for the leakage lint (TM006)
+    input_types = (OPNumeric, OPVector)
+    label_input_positions = (0,)
+
     def __init__(self, operation_name: str, uid: Optional[str] = None):
         super().__init__(operation_name=operation_name, output_type=Prediction,
                          uid=uid)
@@ -106,6 +111,9 @@ class PredictorModel(BinaryModel):
     """Base for fitted predictors; subclasses implement predict(X)."""
 
     device_heavy = True  # batch predicts are jitted device programs
+
+    input_types = (OPNumeric, OPVector)
+    label_input_positions = (0,)
 
     def __init__(self, operation_name: str, uid: Optional[str] = None):
         super().__init__(operation_name=operation_name, output_type=Prediction,

@@ -20,7 +20,8 @@ from ..stages.base import (
     SequenceEstimator, SequenceModel, SequenceTransformer, UnaryTransformer,
 )
 from ..types.columns import ColumnarDataset, FeatureColumn
-from ..types.feature_types import Integral, IntegralMap, OPVector
+from ..types.feature_types import (Date, DateList, DateMap, Geolocation,
+                                   Integral, IntegralMap, OPVector)
 from .vector_metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
 from .vectorizers import _vec_column
 
@@ -82,6 +83,8 @@ def extract_time_period(ms: np.ndarray, period: str) -> np.ndarray:
 class TimePeriodTransformer(UnaryTransformer):
     """Date -> Integral calendar period (TimePeriodTransformer.scala:46-56)."""
 
+    input_types = (Date,)
+
     def __init__(self, period: str = "HourOfDay", uid: Optional[str] = None):
         super().__init__(operation_name="dateToTimePeriod",
                          output_type=Integral, uid=uid)
@@ -99,6 +102,8 @@ class TimePeriodTransformer(UnaryTransformer):
 class TimePeriodMapTransformer(UnaryTransformer):
     """DateMap -> IntegralMap of the period per key
     (TimePeriodMapTransformer.scala:53-56)."""
+
+    input_types = (DateMap,)
 
     def __init__(self, period: str = "HourOfDay", uid: Optional[str] = None):
         super().__init__(operation_name="dateMapToTimePeriod",
@@ -149,6 +154,8 @@ class DateListVectorizer(SequenceEstimator):
     same reference is reused at scoring so the feature is train/score stable.
     """
 
+    input_types = (DateList,)
+
     def __init__(self, pivot: str = "SinceFirst",
                  reference_ms: Optional[int] = None, fill_value: float = 0.0,
                  track_nulls: bool = True, uid: Optional[str] = None):
@@ -173,6 +180,8 @@ class DateListVectorizer(SequenceEstimator):
 
 
 class DateListVectorizerModel(SequenceModel):
+
+    input_types = (DateList,)
     def __init__(self, pivot: str = "SinceFirst", reference_ms: int = 0,
                  fill_value: float = 0.0, track_nulls: bool = True,
                  uid: Optional[str] = None):
@@ -226,6 +235,8 @@ class DateToUnitCircleVectorizer(SequenceTransformer):
     ``DateToUnitCircleTransformer`` default.
     """
 
+    input_types = (Date,)
+
     def __init__(self, time_periods: Sequence[str] = ("HourOfDay",),
                  track_nulls: bool = True, uid: Optional[str] = None):
         super().__init__(operation_name="dateToUnitCircle", output_type=OPVector, uid=uid)
@@ -257,6 +268,8 @@ class DateToUnitCircleVectorizer(SequenceTransformer):
 class GeolocationVectorizer(SequenceEstimator):
     """(lat, lon, accuracy) -> filled triple + null indicator."""
 
+    input_types = (Geolocation,)
+
     def __init__(self, fill_with_mean: bool = True, track_nulls: bool = True,
                  uid: Optional[str] = None):
         super().__init__(operation_name="vecGeo", output_type=OPVector, uid=uid)
@@ -277,6 +290,8 @@ class GeolocationVectorizer(SequenceEstimator):
 
 
 class GeolocationVectorizerModel(SequenceModel):
+
+    input_types = (Geolocation,)
     def __init__(self, fills: List[List[float]], track_nulls: bool = True,
                  uid: Optional[str] = None):
         super().__init__(operation_name="vecGeo", output_type=OPVector, uid=uid)

@@ -19,7 +19,7 @@ from ..features.feature import Feature
 from ..stages.base import SequenceEstimator, SequenceModel
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types import feature_types as ft
-from ..types.feature_types import OPVector
+from ..types.feature_types import OPMap, OPVector
 from .vector_metadata import (
     NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata, VectorMetadata,
 )
@@ -49,6 +49,8 @@ def _discover_keys(col: FeatureColumn, allow: Optional[Sequence[str]],
 class NumericMapVectorizer(SequenceEstimator):
     """RealMap/IntegralMap/BinaryMap... -> per-key fill + null indicators."""
 
+    input_types = (OPMap,)
+
     def __init__(self, fill_with_mean: bool = True, track_nulls: bool = True,
                  allow_keys: Optional[List[str]] = None,
                  block_keys: List[str] = (), uid: Optional[str] = None):
@@ -73,6 +75,8 @@ class NumericMapVectorizer(SequenceEstimator):
 
 
 class NumericMapVectorizerModel(SequenceModel):
+
+    input_types = (OPMap,)
     def __init__(self, keysets: List[List[str]], fills: List[Dict[str, float]],
                  track_nulls: bool = True, uid: Optional[str] = None):
         super().__init__(operation_name="vecNumMap", output_type=OPVector, uid=uid)
@@ -113,6 +117,8 @@ class NumericMapVectorizerModel(SequenceModel):
 class TextMapPivotVectorizer(SequenceEstimator):
     """TextMap/PickListMap -> per-key TopK pivot with OTHER + null columns."""
 
+    input_types = (OPMap,)
+
     def __init__(self, top_k: int = 20, min_support: int = 10,
                  track_nulls: bool = True,
                  allow_keys: Optional[List[str]] = None,
@@ -142,6 +148,8 @@ class TextMapPivotVectorizer(SequenceEstimator):
 
 
 class TextMapPivotVectorizerModel(SequenceModel):
+
+    input_types = (OPMap,)
     def __init__(self, keysets: List[List[str]],
                  vocabs: List[Dict[str, List[str]]],
                  track_nulls: bool = True, uid: Optional[str] = None):
@@ -291,6 +299,8 @@ def transmogrify_map_group(feats: List[Feature], top_k: int, min_support: int,
 class GeoMapVectorizer(SequenceEstimator):
     """GeolocationMap -> per-key (lat, lon, accuracy) + null indicator."""
 
+    input_types = (OPMap,)
+
     def __init__(self, track_nulls: bool = True,
                  allow_keys: Optional[List[str]] = None,
                  block_keys: List[str] = (), uid: Optional[str] = None):
@@ -307,6 +317,8 @@ class GeoMapVectorizer(SequenceEstimator):
 
 
 class GeoMapVectorizerModel(SequenceModel):
+
+    input_types = (OPMap,)
     def __init__(self, keysets: List[List[str]], track_nulls: bool = True,
                  uid: Optional[str] = None):
         super().__init__(operation_name="vecGeoMap", output_type=OPVector, uid=uid)
@@ -359,6 +371,8 @@ class SmartTextMapVectorizer(SequenceEstimator):
     categorical pivot (cardinality <= max_cardinality), murmur3 hashing, or
     ignore (fill rate below min_fill_rate); emits per-key null indicators.
     """
+
+    input_types = (OPMap,)
 
     PIVOT, HASH, IGNORE = "pivot", "hash", "ignore"
 
@@ -431,6 +445,8 @@ class SmartTextMapVectorizer(SequenceEstimator):
 
 
 class SmartTextMapVectorizerModel(SequenceModel):
+
+    input_types = (OPMap,)
     def __init__(self, keysets: List[List[str]],
                  strategies: List[Dict[str, str]],
                  vocabs: List[Dict[str, List[str]]],

@@ -22,7 +22,7 @@ from ..stages.base import (
     UnaryTransformer,
 )
 from ..types.columns import ColumnarDataset, FeatureColumn
-from ..types.feature_types import OPVector, Real, RealNN
+from ..types.feature_types import OPNumeric, OPVector, Real, RealNN
 from .vector_metadata import VectorColumnMetadata, VectorMetadata, NULL_INDICATOR
 from .vectorizers import _vec_column
 
@@ -62,6 +62,8 @@ def _bucketize(vals: np.ndarray, mask: np.ndarray, splits: Sequence[float],
 class NumericBucketizer(UnaryTransformer):
     """Fixed split points (NumericBucketizer.scala)."""
 
+    input_types = (OPNumeric,)
+
     def __init__(self, split_points: Sequence[float],
                  track_nulls: bool = True, track_invalid: bool = False,
                  uid: Optional[str] = None):
@@ -82,6 +84,9 @@ class NumericBucketizer(UnaryTransformer):
 
 
 class _BucketizerModel(BinaryModel):
+    input_types = (OPNumeric, OPNumeric)
+    label_input_positions = (0,)
+
     def __init__(self, split_points: List[float], track_nulls: bool = True,
                  track_invalid: bool = False, uid: Optional[str] = None):
         super().__init__(operation_name="dtBucketizer", output_type=OPVector,
@@ -113,6 +118,9 @@ class DecisionTreeNumericBucketizer(BinaryEstimator):
     """Supervised bucketization: split points = the thresholds a shallow
     single-feature decision tree picks by info gain
     (DecisionTreeNumericBucketizer.scala:60).  Inputs (label, numeric)."""
+
+    input_types = (OPNumeric, OPNumeric)
+    label_input_positions = (0,)
 
     def __init__(self, max_splits: int = 16, max_depth: int = 4,
                  min_info_gain: float = 0.01, min_instances_per_node: int = 1,
@@ -177,6 +185,8 @@ class FillMissingWithMean(UnaryEstimator):
     """Impute missing with the training mean (FillMissingWithMean.scala);
     output RealNN."""
 
+    input_types = (OPNumeric,)
+
     def __init__(self, default_value: float = 0.0, uid: Optional[str] = None):
         super().__init__(operation_name="fillWithMean", output_type=RealNN,
                          uid=uid)
@@ -190,6 +200,8 @@ class FillMissingWithMean(UnaryEstimator):
 
 
 class _FillModel(UnaryModel):
+    input_types = (OPNumeric,)
+
     def __init__(self, fill: float, uid: Optional[str] = None):
         super().__init__(operation_name="fillWithMean", output_type=RealNN,
                          uid=uid)
@@ -203,6 +215,8 @@ class _FillModel(UnaryModel):
 
 class OpScalarStandardScaler(UnaryEstimator):
     """z-score a single numeric feature (OpScalarStandardScaler.scala:49)."""
+
+    input_types = (OPNumeric,)
 
     def __init__(self, with_mean: bool = True, with_std: bool = True,
                  uid: Optional[str] = None):
@@ -222,6 +236,8 @@ class OpScalarStandardScaler(UnaryEstimator):
 
 
 class _ScalerModel(UnaryModel):
+    input_types = (OPNumeric,)
+
     def __init__(self, mean: float, scale: float, uid: Optional[str] = None):
         super().__init__(operation_name="stdScaler", output_type=RealNN,
                          uid=uid)
@@ -247,6 +263,8 @@ _SCALERS = {
 class ScalerTransformer(UnaryTransformer):
     """Declarative scaling with an invertible family (ScalerTransformer.scala);
     records scaler args in metadata so ``DescalerTransformer`` can undo it."""
+
+    input_types = (OPNumeric,)
 
     def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
                  intercept: float = 0.0, uid: Optional[str] = None):
@@ -300,6 +318,8 @@ class PercentileCalibrator(UnaryEstimator):
     """Map a numeric score to its training percentile bucket 0..buckets-1
     (PercentileCalibrator.scala)."""
 
+    input_types = (OPNumeric,)
+
     def __init__(self, buckets: int = 100, uid: Optional[str] = None):
         super().__init__(operation_name="percentileCalibrator",
                          output_type=RealNN, uid=uid)
@@ -318,6 +338,8 @@ class PercentileCalibrator(UnaryEstimator):
 
 
 class _PercentileModel(UnaryModel):
+    input_types = (OPNumeric,)
+
     def __init__(self, splits: List[float], buckets: int = 100,
                  uid: Optional[str] = None):
         super().__init__(operation_name="percentileCalibrator",

@@ -25,7 +25,7 @@ from ..stages.base import (
 )
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types.feature_types import (
-    OPVector, Real, RealNN, Text, TextList,
+    OPNumeric, OPVector, Real, RealNN, Text, TextList,
 )
 from ..utils.hashing import murmur3_32
 from .vector_metadata import VectorColumnMetadata, VectorMetadata
@@ -46,6 +46,8 @@ ENGLISH_STOP_WORDS = frozenset(
 
 class TextTokenizer(UnaryTransformer):
     """Text -> TextList of tokens (TextTokenizer.scala:125)."""
+
+    input_types = (Text,)
 
     def __init__(self, to_lowercase: bool = True, min_token_length: int = 1,
                  uid: Optional[str] = None):
@@ -71,6 +73,8 @@ class TextTokenizer(UnaryTransformer):
 class OpNGram(UnaryTransformer):
     """TextList -> TextList of n-grams (OpNGram.scala)."""
 
+    input_types = (TextList,)
+
     def __init__(self, n: int = 2, uid: Optional[str] = None):
         super().__init__(operation_name="ngram", output_type=TextList, uid=uid)
         if n < 1:
@@ -88,6 +92,8 @@ class OpNGram(UnaryTransformer):
 
 class OpStopWordsRemover(UnaryTransformer):
     """Drop stop words from a TextList (OpStopWordsRemover.scala)."""
+
+    input_types = (TextList,)
 
     def __init__(self, stop_words: Optional[Sequence[str]] = None,
                  case_sensitive: bool = False, uid: Optional[str] = None):
@@ -112,6 +118,8 @@ class OpCountVectorizer(SequenceEstimator):
     """TextList(s) -> bag-of-words counts over a learned vocabulary
     (OpCountVectorizer.scala:44)."""
 
+    input_types = (TextList,)
+
     def __init__(self, vocab_size: int = 512, min_df: int = 1,
                  binary: bool = False, uid: Optional[str] = None):
         super().__init__(operation_name="countVec", output_type=OPVector,
@@ -131,6 +139,8 @@ class OpCountVectorizer(SequenceEstimator):
 
 
 class OpCountVectorizerModel(SequenceModel):
+
+    input_types = (TextList,)
     def __init__(self, vocab: List[str], binary: bool = False,
                  uid: Optional[str] = None):
         super().__init__(operation_name="countVec", output_type=OPVector,
@@ -161,6 +171,8 @@ class OpCountVectorizerModel(SequenceModel):
 class OpHashingTF(UnaryTransformer):
     """TextList -> hashed term frequencies (OpHashingTF.scala:50)."""
 
+    input_types = (TextList,)
+
     def __init__(self, num_features: int = 512, binary: bool = False,
                  seed: int = 42, uid: Optional[str] = None):
         super().__init__(operation_name="hashingTF", output_type=OPVector,
@@ -187,6 +199,8 @@ class OpStringIndexer(UnaryEstimator):
     """Text -> frequency-ranked index (OpStringIndexer.scala); unseen labels
     error ('error') or map to an extra index ('keep') per handle_invalid."""
 
+    input_types = (Text,)
+
     def __init__(self, handle_invalid: str = "error",
                  uid: Optional[str] = None):
         super().__init__(operation_name="stringIndexer", output_type=RealNN,
@@ -210,6 +224,8 @@ class OpStringIndexerNoFilter(OpStringIndexer):
 
 
 class OpStringIndexerModel(UnaryModel):
+
+    input_types = (Text,)
     def __init__(self, labels: List[str], handle_invalid: str = "error",
                  uid: Optional[str] = None):
         super().__init__(operation_name="stringIndexer", output_type=RealNN,
@@ -240,6 +256,8 @@ class OpStringIndexerModel(UnaryModel):
 
 class OpIndexToString(UnaryTransformer):
     """Index -> label text (OpIndexToString.scala)."""
+
+    input_types = (OPNumeric,)
 
     def __init__(self, labels: Sequence[str], unseen_name: str = "UnseenLabel",
                  uid: Optional[str] = None):

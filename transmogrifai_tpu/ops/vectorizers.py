@@ -27,7 +27,7 @@ from ..stages.base import (
 )
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types.feature_types import (
-    Binary, MultiPickList, OPVector, Text, TextList,
+    Binary, MultiPickList, OPNumeric, OPSet, OPVector, Text, TextList,
 )
 from ..utils.hashing import murmur3_32
 from .vector_metadata import (
@@ -85,6 +85,10 @@ class RealVectorizer(SequenceEstimator):
     tracking (Transmogrifier.scala:52-90).
     """
 
+    input_types = (OPNumeric,)
+    # Welford-merged means are order-insensitive up to float noise
+    streaming_order_insensitive = True
+
     def __init__(self, fill_with_mean: bool = True, fill_value: float = 0.0,
                  track_nulls: bool = True, uid: Optional[str] = None):
         super().__init__(operation_name="vecReal", output_type=OPVector, uid=uid)
@@ -130,6 +134,8 @@ class RealVectorizer(SequenceEstimator):
 
 
 class RealVectorizerModel(SequenceModel):
+    input_types = (OPNumeric,)
+
     def __init__(self, fills: List[float], track_nulls: bool = True,
                  uid: Optional[str] = None):
         super().__init__(operation_name="vecReal", output_type=OPVector, uid=uid)
@@ -185,6 +191,10 @@ class RealVectorizerModel(SequenceModel):
 
 class IntegralVectorizer(SequenceEstimator):
     """Fill missing integrals with mode + null tracking (Transmogrifier default)."""
+
+    input_types = (OPNumeric,)
+    # merged mode counts are exact; ties break by smallest value, not order
+    streaming_order_insensitive = True
 
     def __init__(self, fill_with_mode: bool = True, fill_value: int = 0,
                  track_nulls: bool = True, uid: Optional[str] = None):
@@ -245,6 +255,8 @@ class IntegralVectorizer(SequenceEstimator):
 class BinaryVectorizer(SequenceTransformer):
     """Binary -> {0,1} with fill + null tracking (stateless)."""
 
+    input_types = (OPNumeric,)
+
     def __init__(self, fill_value: bool = False, track_nulls: bool = True,
                  uid: Optional[str] = None):
         super().__init__(operation_name="vecBinary", output_type=OPVector, uid=uid)
@@ -276,6 +288,8 @@ class OneHotVectorizer(SequenceEstimator):
     Reference OpOneHotVectorizer.scala; defaults TopK=20, minSupport=10
     (Transmogrifier.scala:55-60).
     """
+
+    input_types = (Text,)
 
     def __init__(self, top_k: int = 20, min_support: int = 10,
                  track_nulls: bool = True, unseen_to_other: bool = True,
@@ -322,6 +336,8 @@ class OneHotVectorizer(SequenceEstimator):
 
 
 class OneHotVectorizerModel(SequenceModel):
+    input_types = (Text,)
+
     def __init__(self, vocabs: List[List[str]], track_nulls: bool = True,
                  unseen_to_other: bool = True, uid: Optional[str] = None):
         super().__init__(operation_name="pivotText", output_type=OPVector, uid=uid)
@@ -362,6 +378,8 @@ class OneHotVectorizerModel(SequenceModel):
 
 class MultiPickListVectorizer(SequenceEstimator):
     """TopK multi-hot pivot of MultiPickList sets (OpSetVectorizer parity)."""
+
+    input_types = (OPSet,)
 
     def __init__(self, top_k: int = 20, min_support: int = 10,
                  track_nulls: bool = True, uid: Optional[str] = None):
@@ -404,6 +422,8 @@ class MultiPickListVectorizer(SequenceEstimator):
 
 
 class MultiPickListVectorizerModel(SequenceModel):
+    input_types = (OPSet,)
+
     def __init__(self, vocabs: List[List[str]], track_nulls: bool = True,
                  uid: Optional[str] = None):
         super().__init__(operation_name="pivotSet", output_type=OPVector, uid=uid)
@@ -595,6 +615,8 @@ class SmartTextVectorizer(SequenceEstimator):
     murmur3 hashing otherwise, ignore when the field is effectively empty.
     """
 
+    input_types = (Text,)
+
     PIVOT, HASH, IGNORE = "pivot", "hash", "ignore"
 
     def __init__(self, max_cardinality: int = 100, top_k: int = 20,
@@ -677,6 +699,8 @@ class SmartTextVectorizer(SequenceEstimator):
 
 
 class SmartTextVectorizerModel(SequenceModel):
+    input_types = (Text,)
+
     def __init__(self, strategies: List[str], vocabs: List[List[str]],
                  num_hash_features: int = 512, track_nulls: bool = True,
                  track_text_len: bool = False, seed: int = 42,
@@ -750,6 +774,8 @@ class SmartTextVectorizerModel(SequenceModel):
 
 class VectorsCombiner(SequenceTransformer):
     """Concatenate OPVector inputs + merge metadata (VectorsCombiner.scala)."""
+
+    input_types = (OPVector,)
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(operation_name="combineVecs", output_type=OPVector, uid=uid)

@@ -26,7 +26,7 @@ from ..ops.stats import (
 from ..ops.vector_metadata import VectorMetadata
 from ..stages.base import BinaryEstimator, BinaryModel
 from ..types.columns import ColumnarDataset, FeatureColumn
-from ..types.feature_types import OPVector
+from ..types.feature_types import OPNumeric, OPVector
 
 __all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary",
            "MinVarianceFilter"]
@@ -85,6 +85,11 @@ class SanityChecker(BinaryEstimator):
     # the stats pass is a big BLAS/XLA program; the execution plan
     # (workflow/plan.py) runs it serially, not on the host stage pool
     device_heavy = True
+
+    # input schema (SchemaError at wiring, TM004 statically); the label
+    # slot is declared for the leakage lint (TM006)
+    input_types = (OPNumeric, OPVector)
+    label_input_positions = (0,)
 
     def __init__(self,
                  check_sample: float = 1.0,
@@ -395,6 +400,9 @@ class _VmetaExtraState:
 
 
 class SanityCheckerModel(_VmetaExtraState, BinaryModel):
+    input_types = (OPNumeric, OPVector)
+    label_input_positions = (0,)
+
     """Index-filter on the feature vector (SanityChecker.scala:544-560)."""
 
     def __init__(self, keep_indices: List[int], uid: Optional[str] = None):
@@ -421,6 +429,9 @@ class MinVarianceFilter(BinaryEstimator):
     """
 
     input_arity = (1, 2)
+    # first input may be anything (ignored, SanityChecker shape parity) and
+    # may legitimately be the label
+    label_input_positions = (0,)
 
     def __init__(self, min_variance: float = 1e-5, uid: Optional[str] = None):
         super().__init__(operation_name="minVariance", output_type=OPVector,
@@ -490,6 +501,7 @@ class MinVarianceFilter(BinaryEstimator):
 
 class MinVarianceFilterModel(_VmetaExtraState, BinaryModel):
     input_arity = (1, 2)
+    label_input_positions = (0,)
 
     def __init__(self, keep_indices: List[int], uid: Optional[str] = None):
         super().__init__(operation_name="minVariance", output_type=OPVector,

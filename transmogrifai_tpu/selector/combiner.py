@@ -31,7 +31,7 @@ import numpy as np
 from ..models.prediction import PredictionBatch
 from ..stages.base import TernaryEstimator, TernaryModel
 from ..types.columns import FeatureColumn
-from ..types.feature_types import Prediction
+from ..types.feature_types import OPNumeric, Prediction
 
 __all__ = ["SelectedModelCombiner", "SelectedCombinerModel"]
 
@@ -68,6 +68,9 @@ class SelectedModelCombiner(TernaryEstimator):
     """Inputs: (label RealNN, prediction1, prediction2) where both prediction
     features come from ModelSelector stages (their fitted summaries supply
     the winning-model metrics that set the combination weights)."""
+
+    input_types = (OPNumeric, Prediction, Prediction)
+    label_input_positions = (0,)
 
     def __init__(self, combination_strategy: str = "best",
                  uid: Optional[str] = None):
@@ -238,6 +241,9 @@ def _evaluate_combined(label_col: FeatureColumn,
 class SelectedCombinerModel(TernaryModel):
     """Row combiner: weighted raw/probability sums, argmax prediction
     (SelectedModelCombiner.scala transformFn :230-237)."""
+
+    input_types = (OPNumeric, Prediction, Prediction)
+    label_input_positions = (0,)
 
     def __init__(self, weight1: float, weight2: float, strategy: str = "best",
                  metric: str = "", uid: Optional[str] = None):

@@ -30,6 +30,7 @@ from ..types.feature_types import FeatureType
 from ..utils.uid import uid_for
 
 __all__ = [
+    "SchemaError",
     "PipelineStage", "Transformer", "Estimator", "Model",
     "UnaryTransformer", "UnaryEstimator", "UnaryModel",
     "BinaryTransformer", "BinaryEstimator", "BinaryModel",
@@ -39,6 +40,17 @@ __all__ = [
     "BinarySequenceTransformer", "BinarySequenceEstimator", "BinarySequenceModel",
     "LambdaTransformer",
 ]
+
+
+class SchemaError(TypeError):
+    """A stage was wired with an input of the wrong feature type.
+
+    Raised at ``set_input`` time — the Python analogue of the reference's
+    compile-time rejection of mis-typed wires — instead of the downstream
+    ``KeyError``/dtype crash the bad column would cause layers later.  The
+    message carries the stage uid plus expected/actual types; the static
+    DAG lint re-checks the same declarations post-hoc as rule TM004.
+    """
 
 
 class PipelineStage:
@@ -69,6 +81,19 @@ class PipelineStage:
     #: (min, max) allowed number of inputs; None = unbounded
     input_arity: Tuple[int, Optional[int]] = (1, None)
 
+    #: declared per-position input feature types (the stage's input schema).
+    #: ``None`` = untyped (accept anything, the historical behavior).  For
+    #: variadic stages the LAST entry repeats for every further input.
+    #: Checked at wiring time (``set_input`` raises ``SchemaError``) and
+    #: statically by the DAG lint (analysis/linter.py rule TM004).
+    input_types: Optional[Tuple[Type[FeatureType], ...]] = None
+
+    #: input positions that legitimately receive the response/label (e.g.
+    #: position 0 of SanityChecker and every model estimator).  The label-
+    #: leakage lint (TM006) lets response-derived features flow into these
+    #: and flags them anywhere else.
+    label_input_positions: Tuple[int, ...] = ()
+
     #: Stages whose fit/transform dispatches XLA programs (models, the
     #: selector sweep, SanityChecker's stats pass).  The execution plan
     #: (workflow/plan.py) serializes these in stable layer order — one
@@ -84,11 +109,29 @@ class PipelineStage:
                 f"got {len(features)}"
             )
 
+    def expected_input_type(self, i: int) -> Optional[Type[FeatureType]]:
+        """Declared feature type for input position ``i`` (None = untyped);
+        for variadic stages the last declared entry repeats."""
+        if not self.input_types:
+            return None
+        return self.input_types[min(i, len(self.input_types) - 1)]
+
+    def check_input_schema(self, features: Sequence[Feature]) -> None:
+        for i, f in enumerate(features):
+            exp = self.expected_input_type(i)
+            if exp is not None and not (isinstance(f.ftype, type)
+                                        and issubclass(f.ftype, exp)):
+                raise SchemaError(
+                    f"{type(self).__name__}({self.uid}): input {i} "
+                    f"({f.name!r}) must be {exp.__name__}, got "
+                    f"{getattr(f.ftype, '__name__', f.ftype)}")
+
     def on_set_input(self) -> None:
         """Hook called after inputs are set (OpPipelineStageBase.onSetInput)."""
 
     def set_input(self, *features: Feature) -> "PipelineStage":
         self.check_input_length(features)
+        self.check_input_schema(features)
         self.input_features = list(features)
         self.on_set_input()
         self._output_feature = Feature(
@@ -203,11 +246,26 @@ class Transformer(PipelineStage):
             out = FeatureColumn(self.output_type, out.values, out.mask)
         return self.get_output().name, out
 
+    def checked_transform_output(self, data: ColumnarDataset
+                                 ) -> Tuple[str, FeatureColumn]:
+        """``transform_output`` routed through the runtime contract guards
+        when ``TMOG_CHECK=1`` (analysis/contracts.py: input buffers frozen
+        ``writeable=False`` to catch COW violations, double-run determinism
+        probe).  The executors call this instead of ``transform_output``
+        directly; disabled mode costs one env lookup."""
+        import os as _os
+
+        if _os.environ.get("TMOG_CHECK") == "1":
+            from ..analysis.contracts import guarded_transform_output
+
+            return guarded_transform_output(self, data)
+        return self.transform_output(data)
+
     def transform(self, data: ColumnarDataset) -> ColumnarDataset:
         """Copy-on-write transform: returns a NEW dataset view sharing every
         untouched column buffer with ``data`` (which is never mutated),
         with this stage's output appended/overridden."""
-        name, out = self.transform_output(data)
+        name, out = self.checked_transform_output(data)
         return data.with_columns({name: out})
 
     def transform_values(self, *rows: Any) -> Any:
@@ -234,6 +292,18 @@ class Estimator(PipelineStage):
     #: dataset.  May be a property (e.g. SanityChecker streams for Pearson
     #: but not Spearman).
     supports_streaming_fit: bool = False
+
+    #: documented |fit_streaming - fit| tolerance on transform outputs (the
+    #: contract checker's TM022 bound): counting-based fits are exact, so
+    #: the default only absorbs float noise; moment-based fitters override
+    #: (e.g. RealVectorizer's chunked Welford summation order).
+    streaming_fit_tol: float = 1e-6
+
+    #: True when merge_states is commutative as well as associative —
+    #: tie-break ordering (e.g. TopK first-seen ranks) makes most counting
+    #: fits order-sensitive, so this is opt-in; the contract checker
+    #: (TM021) only property-checks chunk-order permutations when set.
+    streaming_order_insensitive: bool = False
 
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn) -> Model:
         raise NotImplementedError

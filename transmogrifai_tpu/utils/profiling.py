@@ -25,7 +25,7 @@ __all__ = ["OpStep", "MetricsCollector", "AppMetrics", "StepMetrics",
            "profile_to", "RunCounters", "COUNTERS", "reset_counters",
            "count_upload", "count_fetch", "count_drain", "count_launch",
            "fetch_timed", "StageProfile", "PlanProfiler",
-           "IngestPass", "IngestProfiler"]
+           "IngestPass", "IngestProfiler", "LintSnapshot"]
 
 
 class OpStep(enum.Enum):
@@ -411,6 +411,39 @@ class IngestProfiler:
         return "\n".join(lines)
 
 
+@dataclass
+class LintSnapshot:
+    """The DAG-lint result attached to a trained model
+    (``OpWorkflow.train(validate=True)``, analysis/linter.py): per-rule
+    finding counts, the formatted warnings (errors raise before training
+    starts, so a snapshot on a *trained* model can only carry warnings),
+    and the lint wall time — tracked so the always-on validation stays
+    provably cheap next to train wall (bench contract: <1%)."""
+
+    wall_s: float = 0.0
+    rule_counts: Dict[str, int] = field(default_factory=dict)
+    warnings: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_findings(findings, wall_s: float) -> "LintSnapshot":
+        counts: Dict[str, int] = {}
+        for d in findings:
+            counts[d.rule] = counts.get(d.rule, 0) + 1
+        return LintSnapshot(
+            wall_s=wall_s, rule_counts=counts,
+            warnings=[d.format() for d in findings.warnings])
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"wallSecs": round(self.wall_s, 5),
+                "ruleCounts": dict(self.rule_counts),
+                "warnings": list(self.warnings)}
+
+    def format(self) -> str:
+        head = (f"dag lint: {sum(self.rule_counts.values())} finding(s) "
+                f"in {self.wall_s * 1e3:.1f} ms")
+        return "\n".join([head] + [f"  {w}" for w in self.warnings])
+
+
 class PlanProfiler:
     """Accumulates StageProfile entries for one plan execution; thread-safe
     (host-side stages record from pool threads).  Also tracks the peak
@@ -425,6 +458,8 @@ class PlanProfiler:
         #: IngestProfiler when the run went through the chunked two-pass
         #: driver (workflow/streaming.py); None for in-core runs
         self.ingest: Optional[IngestProfiler] = None
+        #: LintSnapshot when the run came from train(validate=True)
+        self.lint: Optional[LintSnapshot] = None
         self._lock = threading.Lock()
 
     def record_stage(self, sp: StageProfile) -> None:
@@ -453,6 +488,8 @@ class PlanProfiler:
             }
         if self.ingest is not None:
             out["ingest"] = self.ingest.to_json()
+        if self.lint is not None:
+            out["lint"] = self.lint.to_json()
         return out
 
     def format(self, top_k: int = 20) -> str:
@@ -473,6 +510,8 @@ class PlanProfiler:
                 + ("  [device]" if s.device_heavy else ""))
         if self.ingest is not None:
             lines.append(self.ingest.format())
+        if self.lint is not None:
+            lines.append(self.lint.format())
         return "\n".join(lines)
 
 

@@ -352,8 +352,12 @@ class ExecutionPlan:
         n_rows = len(data)
         host = [s for s in layer if not s.device_heavy]
         dev = [s for s in layer if s.device_heavy]
+        # TMOG_CHECK instrumented mode freezes/unfreezes the SHARED input
+        # buffers around each stage (analysis/contracts.py); concurrent
+        # stages would race on the writeable flag, so check mode serializes
         use_pool = (_POOL_AVAILABLE and len(host) > 1
-                    and n_rows >= _PARALLEL_ROW_THRESHOLD)
+                    and n_rows >= _PARALLEL_ROW_THRESHOLD
+                    and os.environ.get("TMOG_CHECK") != "1")
         results: Dict[str, Tuple[PipelineStage, str, FeatureColumn]] = {}
 
         futures = []
@@ -399,7 +403,7 @@ class ExecutionPlan:
                 else:
                     kind = "fit"
                     result_stage = stage.fit(data)
-            name, col = result_stage.transform_output(data)
+            name, col = result_stage.checked_transform_output(data)
         finally:
             if ctx is not None:
                 ctx.__exit__(None, None, None)
