@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Resilience benchmark — checkpoint overhead + crash-resume smoke.
+
+Two questions an operator needs answered before leaving ``checkpoint_dir``
+on for every long fit:
+
+* **What does checkpointing cost?**  The same out-of-core NaiveBayes train
+  as ``bench_ingest`` (the whole fit streams) runs with and without
+  ``checkpoint_dir`` in separate subprocesses; the headline metric is the
+  wall overhead fraction (acceptance: < 5% at the 10x bench_ingest shape).
+* **Does crash-resume actually work outside pytest?**  A child process is
+  SIGKILLed at a checkpoint barrier via the deterministic fault harness
+  (``TMOG_FAULTS``), rerun against the same directory, and its scores are
+  asserted identical to an uninterrupted run's.
+
+Writes ``benchmarks/resilience_latest.json``.  ``--smoke`` runs the 1x
+scale with one trial, asserts the kill/resume parity, and writes nothing
+(the scripts/tier1.sh crash-resume gate).
+
+Usage:
+  python examples/bench_resilience.py [--scale 10] [--chunk-rows 512]
+  python examples/bench_resilience.py --smoke
+"""
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASE_ROWS = 891
+
+
+def child(csv_path: str, chunk_rows: int, checkpoint_dir: str) -> None:
+    """One measured train in THIS process; prints one JSON line with the
+    wall, the checkpoint accounting, and a scores digest for parity."""
+    from bench_ingest import make_csv  # noqa: F401  (shared fixture shape)
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import OpNaiveBayes
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.readers.files import CSVReader
+    from transmogrifai_tpu.types import feature_types as ft
+
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    predictors = [
+        FeatureBuilder.PickList("Pclass").as_predictor(),
+        FeatureBuilder.Text("Name").as_predictor(),
+        FeatureBuilder.PickList("Sex").as_predictor(),
+        FeatureBuilder.Real("Age").as_predictor(),
+        FeatureBuilder.Integral("SibSp").as_predictor(),
+        FeatureBuilder.Integral("Parch").as_predictor(),
+        FeatureBuilder.PickList("Ticket").as_predictor(),
+        FeatureBuilder.Real("Fare").as_predictor(),
+        FeatureBuilder.PickList("Cabin").as_predictor(),
+        FeatureBuilder.PickList("Embarked").as_predictor(),
+    ]
+    features = transmogrify(predictors)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        survived, features).get_output()
+    prediction = OpNaiveBayes().set_input(survived, checked).get_output()
+    wf = (OpWorkflow().set_result_features(prediction)
+          .set_reader(CSVReader(csv_path)))
+
+    t0 = time.perf_counter()
+    model = wf.train(chunk_rows=chunk_rows,
+                     checkpoint_dir=checkpoint_dir or None,
+                     checkpoint_every_chunks=8)
+    wall_s = time.perf_counter() - t0
+    ip = model.ingest_profile
+    scored = model.score(data=__import__("pandas").read_csv(csv_path))
+    name = next(n for n in scored.names()
+                if issubclass(scored[n].ftype, ft.Prediction))
+    probs = [round(d["probability_1"], 9)
+             for d in scored[name].to_list()[:32]]
+    print(json.dumps({
+        "wall_s": round(wall_s, 3),
+        "rows": ip.total_rows,
+        "checkpoint_saves": ip.checkpoint_saves,
+        "checkpoint_wall_s": round(ip.checkpoint_wall_s, 4),
+        "resumed": ip.resumed,
+        "probs_head": probs,
+    }), flush=True)
+
+
+def run_child(csv_path: str, chunk_rows: int, checkpoint_dir: str = "",
+              faults_env: str = "", trials: int = 3):
+    """Median-of-``trials`` child runs (own process: cold state, honest
+    wall).  Returns (median-run dict, returncode of the LAST run)."""
+    import statistics
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--run-child",
+           "--csv", csv_path, "--chunk-rows", str(chunk_rows),
+           "--checkpoint-dir", checkpoint_dir]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TMOG_FAULTS", None)
+    if faults_env:
+        env["TMOG_FAULTS"] = faults_env
+    runs, rc = [], 0
+    for _ in range(trials):
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=3600)
+        rc = proc.returncode
+        lines = [l for l in (proc.stdout or "").splitlines()
+                 if l.strip().startswith("{")]
+        if rc != 0:
+            if faults_env:  # an injected kill is the EXPECTED outcome
+                return None, rc
+            raise RuntimeError(f"child failed rc={rc}: "
+                               f"{(proc.stderr or '')[-400:]}")
+        runs.append(json.loads(lines[-1]))
+    out = dict(runs[0])
+    out["wall_s"] = round(statistics.median(r["wall_s"] for r in runs), 3)
+    out["trials"] = [r["wall_s"] for r in runs]
+    return out, rc
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=10,
+                    help="rows = 891 * scale (bench_ingest's 10x shape)")
+    ap.add_argument("--chunk-rows", type=int, default=512)
+    ap.add_argument("--smoke", action="store_true",
+                    help="1x, single trial, parity assert only (tier1)")
+    ap.add_argument("--run-child", action="store_true")
+    ap.add_argument("--csv")
+    ap.add_argument("--checkpoint-dir", default="")
+    args = ap.parse_args()
+
+    if args.run_child:
+        child(args.csv, args.chunk_rows, args.checkpoint_dir)
+        return
+
+    scale = 1 if args.smoke else args.scale
+    trials = 1 if args.smoke else 3
+    rows = BASE_ROWS * scale
+    chunk_rows = min(args.chunk_rows, 128) if args.smoke else args.chunk_rows
+
+    from bench_ingest import make_csv
+
+    with tempfile.TemporaryDirectory() as tmp:
+        csv_path = os.path.join(tmp, f"titanic_{scale}x.csv")
+        make_csv(csv_path, rows)
+        print(f"[bench_resilience] {scale}x ({rows} rows, "
+              f"chunk_rows={chunk_rows})...", file=sys.stderr, flush=True)
+
+        plain, _ = run_child(csv_path, chunk_rows, trials=trials)
+        ckpt_dir = os.path.join(tmp, "ckpt_overhead")
+        ckpt, _ = run_child(csv_path, chunk_rows, checkpoint_dir=ckpt_dir,
+                            trials=trials)
+        overhead = (ckpt["wall_s"] - plain["wall_s"]) / max(plain["wall_s"],
+                                                            1e-9)
+        print(f"[bench_resilience] wall {plain['wall_s']:.2f}s plain vs "
+              f"{ckpt['wall_s']:.2f}s checkpointed "
+              f"({ckpt['checkpoint_saves']} saves) -> overhead "
+              f"{overhead:+.1%}", file=sys.stderr, flush=True)
+
+        # -- crash-resume smoke: SIGKILL at a checkpoint barrier ------------
+        # (the 2nd at bench scale; smoke's 7 chunks only ever save once)
+        kill_at = 0 if ckpt["checkpoint_saves"] < 2 else 1
+        kill_dir = os.path.join(tmp, "ckpt_kill")
+        faults_env = json.dumps({"faults": [
+            {"point": "checkpoint.barrier", "action": "kill", "at": kill_at}]})
+        _, rc = run_child(csv_path, chunk_rows, checkpoint_dir=kill_dir,
+                          faults_env=faults_env, trials=1)
+        if rc != -9:
+            raise RuntimeError(f"kill child expected SIGKILL rc=-9, "
+                               f"got {rc}")
+        if not os.path.exists(os.path.join(kill_dir, "checkpoint.json")):
+            raise RuntimeError("SIGKILLed child left no checkpoint behind")
+        resumed, _ = run_child(csv_path, chunk_rows,
+                               checkpoint_dir=kill_dir, trials=1)
+        if not resumed["resumed"]:
+            raise RuntimeError("rerun did not resume from the checkpoint")
+        if resumed["probs_head"] != plain["probs_head"]:
+            raise RuntimeError(
+                "RESUME PARITY FAILED: resumed scores differ from the "
+                "uninterrupted run's")
+        print("[bench_resilience] kill -9 -> resume -> parity OK "
+              f"(resumed run matched {len(plain['probs_head'])} scores)",
+              file=sys.stderr, flush=True)
+
+    import jax
+
+    out = {
+        "metric": "checkpoint_overhead_wall_frac",
+        "value": round(overhead, 4),
+        "unit": "frac",
+        "acceptance": "< 0.05 at the 10x bench_ingest shape",
+        "rows": rows,
+        "chunk_rows": chunk_rows,
+        "checkpoint_every_chunks": 8,
+        "checkpoint_saves": ckpt["checkpoint_saves"],
+        "checkpoint_wall_s": ckpt["checkpoint_wall_s"],
+        "plain": plain,
+        "checkpointed": ckpt,
+        "kill_resume_parity": "ok",
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(out), flush=True)
+    if not args.smoke:
+        dest = os.path.join(_ROOT, "benchmarks", "resilience_latest.json")
+        with open(dest, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

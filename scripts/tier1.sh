@@ -16,6 +16,15 @@ else
   echo "INGEST_SMOKE=FAILED (see /tmp/_t1_ingest.log)"
   rc=1
 fi
+# crash-resume smoke: SIGKILL the fit subprocess at a checkpoint barrier,
+# rerun against the same checkpoint_dir, assert scores match the
+# uninterrupted run's (examples/bench_resilience.py --smoke)
+if timeout -k 10 240 env JAX_PLATFORMS=cpu python examples/bench_resilience.py --smoke > /tmp/_t1_resilience.log 2>&1; then
+  echo "RESILIENCE_SMOKE=ok $(grep -ao 'overhead [+-][0-9.]*%' /tmp/_t1_resilience.log | tail -1)"
+else
+  echo "RESILIENCE_SMOKE=FAILED (see /tmp/_t1_resilience.log)"
+  rc=1
+fi
 # self-lint: trace-safety over the shipped package + examples, DAG lint of
 # the example pipeline factory — any finding fails the script
 if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m transmogrifai_tpu.lint \

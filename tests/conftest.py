@@ -42,3 +42,16 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running scale tests (run in CI, skippable "
         "locally with -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection tests that spawn/kill "
+        "subprocesses (tests/test_resilience.py)")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    """No test may leak an armed fault plan into the next one."""
+    from transmogrifai_tpu.utils import faults
+
+    faults.install_faults(None)
+    yield
+    faults.install_faults(None)

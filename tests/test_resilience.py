@@ -1,0 +1,887 @@
+"""Fault-tolerant training (ISSUE 5): the fault matrix.
+
+Every injection point in the deterministic harness (utils/faults.py) either
+RECOVERS (retry / quarantine / checkpoint-resume) or fails with a clean,
+attributed error — never silent data loss:
+
+* reader IO error on chunk k  -> retry/backoff recovers; exhausted budget
+  re-raises; no policy = fail fast
+* unparseable rows (JSONL/CSV) and corrupt Avro blocks -> quarantine
+  sidecar whose counts reconcile EXACTLY with rows dropped, or an
+  attributed BadRecordError/AvroBlockError under the default fail policy
+* process crash mid-fit -> checkpoint/resume with parity to the
+  uninterrupted run (in-process raise AND a real SIGKILL subprocess)
+* transform raise mid-cascade -> error propagates and the _BlockStore
+  spill temp file is cleaned up (regression for the close-in-finally)
+* serving device failure -> breaker state + last-fallback reason surface
+  in /metrics and /healthz
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.readers.avro import (AvroBlockError, AvroRecordError,
+                                            AvroReader, read_avro,
+                                            write_avro)
+from transmogrifai_tpu.readers.files import CSVReader, JSONLinesReader
+from transmogrifai_tpu.readers.resilience import (BadRecordError,
+                                                  QuarantineSink,
+                                                  RetryingChunkStream,
+                                                  RetryPolicy,
+                                                  TooManyBadRecordsError,
+                                                  is_transient_io_error)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import ColumnarDataset, FeatureColumn
+from transmogrifai_tpu.utils import faults
+from transmogrifai_tpu.utils.uid import reset_uids
+from transmogrifai_tpu.workflow.checkpoint import (CheckpointMismatchError,
+                                                   StreamingCheckpointManager,
+                                                   decode_fit_state,
+                                                   encode_fit_state)
+from transmogrifai_tpu.workflow.persistence import _ArrayStore
+
+from test_out_of_core import (build_titanic_pipeline, make_titanic_like,
+                              titanic_raw_features)
+
+ROWS = 300
+
+
+@pytest.fixture(scope="module")
+def df():
+    return make_titanic_like(ROWS)
+
+
+@pytest.fixture(scope="module")
+def csv_path(df, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("resil") / "titanic.csv")
+    df.to_csv(path, index=False)
+    return path
+
+
+def _probs(model, data=None):
+    scored = model.score(data=data)
+    name = next(n for n in scored.names()
+                if issubclass(scored[n].ftype, ft.Prediction))
+    return np.array([d["probability_1"] for d in scored[name].to_list()])
+
+
+def _train(reader_or_df, **kw):
+    """Fresh pipeline (uids reset so checkpoint fingerprints agree across
+    builds within one test) trained out-of-core."""
+    reset_uids()
+    prediction = build_titanic_pipeline()
+    wf = OpWorkflow().set_result_features(prediction)
+    if isinstance(reader_or_df, pd.DataFrame):
+        wf.set_input_data(reader_or_df)
+    else:
+        wf.set_reader(reader_or_df)
+    return wf.train(chunk_rows=32, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fault harness: deterministic by construction
+# ---------------------------------------------------------------------------
+
+class TestFaultHarness:
+    def test_at_and_times_semantics(self):
+        with faults.inject(faults.FaultSpec(point="p", action="raise",
+                                            at=2, times=2)) as plan:
+            fired = []
+            for i in range(6):
+                try:
+                    faults.fire("p", index=i)
+                    fired.append(False)
+                except faults.FaultError:
+                    fired.append(True)
+            # index 2 hits; times=2 lets a REPLAY of index 2 hit again
+            assert fired == [False, False, True, False, False, False]
+            try:
+                faults.fire("p", index=2)
+                replay = False
+            except faults.FaultError:
+                replay = True
+            assert replay
+            assert plan.log[0]["index"] == 2
+
+    def test_seeded_probabilistic_injection_is_reproducible(self):
+        def pattern(seed):
+            plan = faults.FaultPlan(
+                [faults.FaultSpec(point="p", action="raise", p=0.3,
+                                  times=None)], seed=seed)
+            out = []
+            for _ in range(50):
+                try:
+                    plan.fire("p")
+                    out.append(0)
+                except faults.FaultError:
+                    out.append(1)
+            return out
+
+        assert pattern(7) == pattern(7)
+        assert pattern(7) != pattern(8)
+        assert 1 in pattern(7) and 0 in pattern(7)
+
+    def test_env_plan_round_trip(self):
+        doc = {"seed": 3, "faults": [
+            {"point": "reader.chunk", "action": "io_error", "at": 4,
+             "times": 2}]}
+        plan = faults.FaultPlan.from_json(json.dumps(doc))
+        assert plan.to_json()["faults"][0]["at"] == 4
+        with pytest.raises(OSError):
+            plan.fire("reader.chunk", index=4)
+
+    def test_slow_action_sleeps_then_continues(self):
+        import time
+
+        with faults.inject(faults.FaultSpec(point="p", action="slow",
+                                            at=0, delay_s=0.05)):
+            t0 = time.perf_counter()
+            faults.fire("p", index=0)
+            assert time.perf_counter() - t0 >= 0.05
+            faults.fire("p", index=1)  # no further effect
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault action"):
+            faults.FaultSpec(point="p", action="explode")
+
+    def test_tag_scoping(self):
+        with faults.inject(faults.FaultSpec(point="p", action="raise",
+                                            tag="OneHot", at=None,
+                                            times=None)):
+            faults.fire("p", tag="Other")  # no hit
+            with pytest.raises(faults.FaultError):
+                faults.fire("p", tag="OneHot")
+
+
+# ---------------------------------------------------------------------------
+# retry policy + retrying stream
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_backoff_deterministic_and_bounded(self):
+        a = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.5,
+                        jitter=0.2, seed=13)
+        b = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.5,
+                        jitter=0.2, seed=13)
+        sa = [a.backoff_s(i) for i in range(5)]
+        sb = [b.backoff_s(i) for i in range(5)]
+        assert sa == sb  # same seed, same sleeps
+        assert all(s <= 0.5 * 1.2 + 1e-9 for s in sa)
+        assert sa[1] > sa[0]  # exponential growth under the cap
+
+    def test_transient_classification(self):
+        assert is_transient_io_error(OSError("flake"))
+        assert is_transient_io_error(IOError("flake"))
+        assert not is_transient_io_error(FileNotFoundError("gone"))
+        assert not is_transient_io_error(PermissionError("denied"))
+        assert not is_transient_io_error(ValueError("corrupt"))
+        assert not is_transient_io_error(EOFError("truncated"))
+
+
+class TestRetryingChunkStream:
+    def _flaky(self, fail_at, fail_times):
+        """Stream factory yielding 0..9; raises OSError the first
+        ``fail_times`` times chunk ``fail_at`` is produced."""
+        budget = {"left": fail_times}
+
+        def make():
+            def gen():
+                for i in range(10):
+                    if i == fail_at and budget["left"] > 0:
+                        budget["left"] -= 1
+                        raise OSError("flake")
+                    yield i
+            return gen()
+
+        return make
+
+    def test_recovers_and_skips_exactly(self):
+        sleeps = []
+        stream = RetryingChunkStream(
+            self._flaky(4, 2), RetryPolicy(max_attempts=4, seed=0),
+            sleep=sleeps.append)
+        assert list(stream) == list(range(10))  # no dup, no gap
+        assert stream.retries == 2
+        assert len(sleeps) == 2
+
+    def test_attempts_exhausted_reraises(self):
+        stream = RetryingChunkStream(
+            self._flaky(1, 99), RetryPolicy(max_attempts=3, seed=0),
+            sleep=lambda s: None)
+        with pytest.raises(OSError, match="flake"):
+            list(stream)
+        assert stream.retries == 2  # attempts-1 retries, then re-raise
+
+    def test_non_transient_propagates_immediately(self):
+        def make():
+            def gen():
+                yield 0
+                raise ValueError("corrupt data")
+            return gen()
+
+        stream = RetryingChunkStream(make, RetryPolicy(max_attempts=5),
+                                     sleep=lambda s: None)
+        with pytest.raises(ValueError, match="corrupt"):
+            list(stream)
+        assert stream.retries == 0
+
+
+class TestReaderRetryE2E:
+    def test_injected_io_error_recovers_with_parity(self, df, csv_path):
+        m0 = _train(CSVReader(csv_path))
+        reader = CSVReader(csv_path).with_resilience(
+            retry=RetryPolicy(max_attempts=4, base_delay_s=0.01, seed=1))
+        with faults.inject(faults.FaultSpec(
+                point="reader.chunk", action="io_error", at=3, times=2)):
+            mk = _train(reader)
+        ip = mk.ingest_profile
+        assert ip.total_retries == 2
+        assert ip.total_retry_wait_s > 0
+        assert ip.to_json()["retries"] == 2
+        assert "retries" in ip.format()
+        assert _probs(mk, df) == pytest.approx(_probs(m0, df), abs=1e-6)
+
+    def test_retries_exhausted_fail_cleanly(self, csv_path):
+        reader = CSVReader(csv_path).with_resilience(
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.01, seed=1))
+        with faults.inject(faults.FaultSpec(
+                point="reader.chunk", action="io_error", at=3, times=None)):
+            with pytest.raises(OSError, match="injected fault"):
+                _train(reader)
+
+    def test_default_reader_fails_fast(self, csv_path):
+        """No resilience config: first IO error surfaces immediately —
+        the pre-resilience behavior, byte-identical."""
+        reader = CSVReader(csv_path)
+        assert reader.resilience is None
+        with faults.inject(faults.FaultSpec(
+                point="reader.chunk", action="io_error", at=2)):
+            with pytest.raises(OSError, match="reader.chunk"):
+                _train(reader)
+
+
+# ---------------------------------------------------------------------------
+# quarantine: JSONL rows, CSV lines — counts reconcile exactly
+# ---------------------------------------------------------------------------
+
+def _write_jsonl(df, path, bad_at=()):
+    with open(path, "w") as f:
+        for i, rec in enumerate(df.to_dict("records")):
+            if i in bad_at:
+                f.write("{not json at all\n")
+            f.write(json.dumps(
+                {k: (None if isinstance(v, float) and np.isnan(v) else v)
+                 for k, v in rec.items()}) + "\n")
+
+
+class TestQuarantineJSONL:
+    def test_sidecar_reconciles_exactly(self, df, tmp_path):
+        path = str(tmp_path / "rows.jsonl")
+        side = str(tmp_path / "bad.jsonl")
+        _write_jsonl(df, path, bad_at=(5, 17, 100))
+        reader = JSONLinesReader(path).with_resilience(
+            bad_records="quarantine", quarantine_path=side)
+        model = _train(reader)
+        ip = model.ingest_profile
+        # sidecar counts == rows dropped: 3 bad lines, 300 good rows kept
+        assert ip.quarantined_records == 3
+        assert ip.quarantined_rows == 3
+        assert ip.total_rows == ROWS
+        entries = [json.loads(l) for l in open(side)]
+        # de-duplicated across the driver's MULTIPLE reader passes
+        assert len(entries) == 3
+        assert sum(e["rows"] for e in entries) == 3
+        for e in entries:
+            assert e["source"] == path
+            assert "line" in e["location"] and "byte" in e["location"]
+            assert "invalid JSON" in e["reason"]
+            assert e["record"].startswith("{not json")
+        js = ip.to_json()
+        assert js["quarantinedRecords"] == 3 and js["quarantinedRows"] == 3
+        assert "quarantined" in ip.format()
+
+    def test_fail_policy_attributes_line_and_byte(self, df, tmp_path):
+        path = str(tmp_path / "rows.jsonl")
+        _write_jsonl(df, path, bad_at=(7,))
+        with pytest.raises(BadRecordError, match=r"line 8 \(byte \d+\)"):
+            _train(JSONLinesReader(path))
+        # monolithic read path attributes identically
+        with pytest.raises(BadRecordError, match=r"line 8 \(byte \d+\)"):
+            JSONLinesReader(path).generate_dataset(titanic_raw_features())
+
+    def test_max_bad_records_fails_fast(self, df, tmp_path):
+        path = str(tmp_path / "rows.jsonl")
+        side = str(tmp_path / "bad.jsonl")
+        _write_jsonl(df, path, bad_at=tuple(range(0, 40)))
+        reader = JSONLinesReader(path).with_resilience(
+            bad_records="quarantine", quarantine_path=side,
+            max_bad_records=10)
+        with pytest.raises(TooManyBadRecordsError, match="max_bad_records"):
+            _train(reader)
+
+    def test_quarantine_requires_path(self, csv_path):
+        with pytest.raises(ValueError, match="quarantine_path"):
+            CSVReader(csv_path).with_resilience(bad_records="quarantine")
+        with pytest.raises(ValueError, match="'fail' or 'quarantine'"):
+            CSVReader(csv_path).with_resilience(bad_records="drop")
+
+
+class TestQuarantineCSV:
+    def test_bad_lines_quarantined(self, df, tmp_path):
+        path = str(tmp_path / "rows.csv")
+        side = str(tmp_path / "bad.jsonl")
+        lines = df.to_csv(index=False).splitlines()
+        # two rows with extra fields pandas cannot place
+        lines.insert(5, lines[5] + ",EXTRA,EXTRA")
+        lines.insert(60, lines[60] + ",EXTRA,EXTRA,EXTRA")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        reader = CSVReader(path).with_resilience(
+            bad_records="quarantine", quarantine_path=side)
+        model = _train(reader)
+        assert model.ingest_profile.quarantined_records == 2
+        assert model.ingest_profile.total_rows == ROWS
+        entries = [json.loads(l) for l in open(side)]
+        assert len(entries) == 2
+        assert all("malformed CSV row" in e["reason"] for e in entries)
+
+
+# ---------------------------------------------------------------------------
+# Avro corruption: attributed errors, block quarantine
+# ---------------------------------------------------------------------------
+
+def _avro_fixture(tmp_path, codec="deflate"):
+    schema = {"type": "record", "name": "R", "fields": [
+        {"name": "x", "type": "double"},
+        {"name": "label", "type": ["null", "string"]}]}
+    recs = [{"x": float(i), "label": None if i % 5 == 0 else f"v{i % 13}"}
+            for i in range(500)]
+    path = str(tmp_path / "r.avro")
+    write_avro(path, schema, recs, codec=codec, block_records=97)
+    return path, recs
+
+
+def _block_offsets(path):
+    """[(framing_offset, payload_offset, size, count)] via container walk."""
+    from transmogrifai_tpu.readers.avro import _Decoder, _read_header
+
+    raw = open(path, "rb").read()
+    dec = _Decoder(raw)
+    _read_header(dec, path)
+    out = []
+    while dec.pos < len(raw):
+        start = dec.pos
+        count = dec.read_long()
+        size = dec.read_long()
+        out.append((start, dec.pos, size, count))
+        dec.pos += size + 16
+    return out
+
+
+def _corrupt_block(path, block, flips=(10, 11)):
+    raw = bytearray(open(path, "rb").read())
+    payload_at = _block_offsets(path)[block][1]
+    for off in flips:
+        raw[payload_at + off] ^= 0xFF
+    out = path.replace(".avro", "_corrupt.avro")
+    open(out, "wb").write(bytes(raw))
+    return out
+
+
+class TestAvroCorruption:
+    def test_corrupt_block_error_attributed(self, tmp_path):
+        path, _ = _avro_fixture(tmp_path)
+        bad = _corrupt_block(path, block=2)
+        offsets = _block_offsets(path)
+        with pytest.raises(AvroBlockError) as err:
+            read_avro(bad)
+        assert err.value.block_index == 2
+        assert err.value.byte_offset == offsets[2][0]
+        msg = str(err.value)
+        assert "block 2" in msg and f"byte offset {offsets[2][0]}" in msg
+
+    def test_corrupt_block_quarantine_reconciles(self, tmp_path):
+        path, recs = _avro_fixture(tmp_path)
+        bad = _corrupt_block(path, block=2)
+        side = str(tmp_path / "avro_bad.jsonl")
+        raw = [FeatureBuilder.Real("x").as_predictor(),
+               FeatureBuilder.PickList("label").as_predictor()]
+        reader = AvroReader(bad).with_resilience(
+            bad_records="quarantine", quarantine_path=side)
+        chunks = list(reader.iter_chunks(raw, 61))
+        kept = sum(len(c) for c in chunks)
+        entries = [json.loads(l) for l in open(side)]
+        assert len(entries) == 1
+        assert entries[0]["rows"] == 97  # the whole corrupt block
+        assert kept + entries[0]["rows"] == len(recs)  # exact reconcile
+        # the stream RESUMED past the corruption: later blocks' rows kept
+        xs = np.concatenate([np.asarray(c["x"].values) for c in chunks])
+        assert float(xs.max()) == 499.0
+
+    def test_record_level_decode_failure_attributed(self, tmp_path):
+        # null codec: corruption hits the record decoder, not the codec —
+        # the error names the record index and keeps the clean prefix
+        path, _ = _avro_fixture(tmp_path, codec="null")
+        offsets = _block_offsets(path)
+        raw = bytearray(open(path, "rb").read())
+        # a record is (double x, union idx, [string]): stomp a union tag
+        # deep inside block 1's payload with an invalid branch index
+        payload_at = offsets[1][1]
+        raw[payload_at + 200:payload_at + 210] = b"\xff" * 10
+        bad = str(tmp_path / "rec_corrupt.avro")
+        open(bad, "wb").write(bytes(raw))
+        with pytest.raises(AvroRecordError) as err:
+            read_avro(bad)
+        assert err.value.block_index == 1
+        assert err.value.record_index >= 0
+        assert "record" in str(err.value)
+        assert len(err.value.decoded) == err.value.record_index
+
+    def test_truncated_file_attributed(self, tmp_path):
+        path, _ = _avro_fixture(tmp_path)
+        raw = open(path, "rb").read()
+        trunc = str(tmp_path / "trunc.avro")
+        open(trunc, "wb").write(raw[:len(raw) - 40])
+        with pytest.raises(AvroBlockError, match="block"):
+            read_avro(trunc)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint codec: every streamable estimator's state round-trips exactly
+# ---------------------------------------------------------------------------
+
+def _codec_roundtrip(est, state):
+    """export -> encode -> STRICT json -> decode -> import."""
+    store = _ArrayStore()
+    payload = encode_fit_state(est.export_fit_state(state), "s", store)
+    payload = json.loads(json.dumps(payload))  # no default=str escape hatch
+    return est.import_fit_state(decode_fit_state(payload, store.arrays))
+
+
+def _chunks_of(ds, k):
+    n = len(ds)
+    return [ds.slice(s, min(s + k, n)) for s in range(0, n, k)]
+
+
+class TestCheckpointStateCodec:
+    """Fit k chunks -> roundtrip the state through the checkpoint codec ->
+    fit the rest -> the model must EQUAL the uninterrupted streaming fit
+    (this is what makes resume parity exact)."""
+
+    def _run_split(self, est_fn, ds):
+        chunks = _chunks_of(ds, 37)
+        half = len(chunks) // 2
+
+        def fit(roundtrip):
+            est = est_fn()
+            state = est.begin_fit()
+            for i, c in enumerate(chunks):
+                if i == half and roundtrip:
+                    state = _codec_roundtrip(est, state)
+                cols = [c[n] for n in est.input_names]
+                state = est.update_chunk(state, c, *cols)
+            return est.adopt_model(est.finish_fit(state))
+
+        return fit(False), fit(True)
+
+    def test_onehot_topk_sketch(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import OneHotVectorizer
+
+        vals = [None if rng.random() < 0.15 else f"v{int(rng.integers(30))}"
+                for _ in range(400)]
+        ds = ColumnarDataset(
+            {"c": FeatureColumn.from_values(ft.PickList, vals)})
+        f = FeatureBuilder.PickList("c").as_predictor()
+        m0, m1 = self._run_split(
+            lambda: OneHotVectorizer(top_k=10, min_support=2).set_input(f),
+            ds)
+        assert m0.vocabs == m1.vocabs
+
+    def test_real_welford(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+        vals = np.where(rng.random(500) < 0.2, np.nan,
+                        rng.normal(50, 9, 500))
+        ds = ColumnarDataset({"x": FeatureColumn.from_values(ft.Real, vals)})
+        f = FeatureBuilder.Real("x").as_predictor()
+        m0, m1 = self._run_split(
+            lambda: RealVectorizer().set_input(f), ds)
+        assert m1.fills == m0.fills  # bit-exact, not approx
+
+    def test_integral_mode_counts(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import IntegralVectorizer
+
+        vals = [None if rng.random() < 0.1 else int(rng.integers(0, 7))
+                for _ in range(400)]
+        ds = ColumnarDataset(
+            {"x": FeatureColumn.from_values(ft.Integral, vals)})
+        f = FeatureBuilder.Integral("x").as_predictor()
+        m0, m1 = self._run_split(
+            lambda: IntegralVectorizer().set_input(f), ds)
+        assert m1.fills == m0.fills
+
+    def test_smart_text_stats(self, rng):
+        from transmogrifai_tpu.ops.vectorizers import SmartTextVectorizer
+
+        low = [f"cat{int(rng.integers(8))}" for _ in range(300)]
+        high = [f"free text {int(rng.integers(10000))}" for _ in range(300)]
+        ds = ColumnarDataset({
+            "low": FeatureColumn.from_values(ft.Text, low),
+            "high": FeatureColumn.from_values(ft.Text, high)})
+        fl = FeatureBuilder.Text("low").as_predictor()
+        fh = FeatureBuilder.Text("high").as_predictor()
+        m0, m1 = self._run_split(
+            lambda: SmartTextVectorizer(max_cardinality=50, min_support=2)
+            .set_input(fl, fh), ds)
+        assert m0.strategies == m1.strategies
+        assert m0.vocabs == m1.vocabs
+
+    def _vector_ds(self, rng, n=400):
+        from transmogrifai_tpu.ops.vector_metadata import (
+            VectorColumnMetadata, VectorMetadata)
+
+        y = (rng.random(n) > 0.5).astype(np.float64)
+        X = np.concatenate([
+            rng.normal(0, 1, (n, 4)),
+            (rng.random((n, 2)) < 0.3).astype(np.float64),
+            y[:, None] + rng.normal(0, 1e-4, (n, 1)),
+        ], axis=1).astype(np.float32)
+        meta = ([VectorColumnMetadata("num", "Real",
+                                      descriptor_value=f"d{i}")
+                 for i in range(4)]
+                + [VectorColumnMetadata("cat", "PickList", grouping="cat",
+                                        indicator_value=f"v{i}")
+                   for i in range(2)]
+                + [VectorColumnMetadata("leak", "Real",
+                                        descriptor_value="leak")])
+        return ColumnarDataset({
+            "label": FeatureColumn.from_values(ft.RealNN, y),
+            "features": FeatureColumn(ft.OPVector, X,
+                                      vmeta=VectorMetadata("features",
+                                                           meta))})
+
+    def test_sanity_checker_with_sampled_rng(self, rng):
+        """The hardest state: PearsonSketch + contingency sums + vmeta +
+        a LIVE numpy Generator (check_sample < 1 samples rows) — the rng
+        must resume mid-stream, not restart."""
+        from transmogrifai_tpu.preparators import SanityChecker
+
+        ds = self._vector_ds(rng)
+        label = FeatureBuilder.RealNN("label").as_response()
+        vec = FeatureBuilder.OPVector("features").as_predictor()
+        m0, m1 = self._run_split(
+            lambda: SanityChecker(max_correlation=0.95, check_sample=0.8,
+                                  sample_seed=11).set_input(label, vec), ds)
+        assert m0.keep_indices == m1.keep_indices
+        s0, s1 = (m.metadata["summary"] for m in (m0, m1))
+        assert s0["dropped"] == s1["dropped"]
+        for c0, c1 in zip(s0["columnStats"], s1["columnStats"]):
+            assert c1["mean"] == c0["mean"]  # bit-exact resume
+            assert c1["corr_label"] == c0["corr_label"]
+
+    def test_min_variance_filter(self, rng):
+        from transmogrifai_tpu.preparators.sanity_checker import (
+            MinVarianceFilter)
+
+        ds = self._vector_ds(rng)
+        label = FeatureBuilder.RealNN("label").as_response()
+        vec = FeatureBuilder.OPVector("features").as_predictor()
+        m0, m1 = self._run_split(
+            lambda: MinVarianceFilter().set_input(label, vec), ds)
+        assert m0.keep_indices == m1.keep_indices
+
+    def test_naive_bayes_class_sums(self, rng):
+        from transmogrifai_tpu.models import OpNaiveBayes
+
+        ds = self._vector_ds(rng)
+        label = FeatureBuilder.RealNN("label").as_response()
+        vec = FeatureBuilder.OPVector("features").as_predictor()
+        m0, m1 = self._run_split(
+            lambda: OpNaiveBayes().set_input(label, vec), ds)
+        assert np.array_equal(np.asarray(m0.log_prior),
+                              np.asarray(m1.log_prior))
+        assert np.array_equal(np.asarray(m0.log_lik),
+                              np.asarray(m1.log_lik))
+
+    def test_codec_rejects_unknown_types(self):
+        class Opaque:
+            pass
+
+        with pytest.raises(TypeError, match="checkpoint codec"):
+            encode_fit_state({"x": Opaque()}, "s", _ArrayStore())
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager: atomicity, fingerprint gate, cleanup
+# ---------------------------------------------------------------------------
+
+class TestCheckpointManager:
+    def test_fingerprint_mismatch_raises(self, df, csv_path, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        with faults.inject(faults.FaultSpec(
+                point="reader.chunk", action="raise", at=5)):
+            with pytest.raises(faults.FaultError):
+                _train(CSVReader(csv_path), checkpoint_dir=ckpt,
+                       checkpoint_every_chunks=2)
+        assert os.path.exists(os.path.join(ckpt, "checkpoint.json"))
+        # different chunk geometry -> a different run: refuse to resume
+        reset_uids()
+        prediction = build_titanic_pipeline()
+        wf = OpWorkflow().set_result_features(prediction).set_reader(
+            CSVReader(csv_path))
+        with pytest.raises(CheckpointMismatchError, match="different"):
+            wf.train(chunk_rows=64, checkpoint_dir=ckpt)
+
+    def test_atomic_saves_and_generation_cleanup(self, tmp_path):
+        from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+        f = FeatureBuilder.Real("x").as_predictor()
+        est = RealVectorizer().set_input(f)
+        vals = np.arange(100.0)
+        ds = ColumnarDataset({"x": FeatureColumn.from_values(ft.Real, vals)})
+        state = est.begin_fit()
+        state = est.update_chunk(state, ds, ds["x"])
+        mgr = StreamingCheckpointManager(str(tmp_path), {"fp": 1},
+                                         every_chunks=1)
+        for i in range(3):
+            mgr.save_progress(0, "fit", i + 1, (i + 1) * 10, [est],
+                              {est.uid: state})
+            # after every save the manifest parses and is self-consistent
+            doc = json.load(open(tmp_path / "checkpoint.json"))
+            assert doc["current"]["chunks_done"] == i + 1
+        # old npz generations are swept; at most the live one remains
+        npz = [n for n in os.listdir(tmp_path) if n.endswith(".npz")]
+        assert len(npz) <= 1
+        mgr.finish()
+        assert not os.path.exists(tmp_path / "checkpoint.json")
+
+    def test_checkpoint_requires_chunked_path(self, df):
+        reset_uids()
+        wf = OpWorkflow().set_result_features(
+            build_titanic_pipeline()).set_input_data(df)
+        with pytest.raises(ValueError, match="chunk_rows"):
+            wf.train(checkpoint_dir="/tmp/nope")
+
+
+# ---------------------------------------------------------------------------
+# crash -> resume -> parity (in-process and real SIGKILL)
+# ---------------------------------------------------------------------------
+
+class TestCrashResume:
+    def test_midpass_crash_resume_parity(self, df, csv_path, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        m0 = _train(CSVReader(csv_path))  # uninterrupted reference
+        with faults.inject(faults.FaultSpec(
+                point="reader.chunk", action="raise", at=7)):
+            with pytest.raises(faults.FaultError):
+                _train(CSVReader(csv_path), checkpoint_dir=ckpt,
+                       checkpoint_every_chunks=2)
+        mk = _train(CSVReader(csv_path), checkpoint_dir=ckpt,
+                    checkpoint_every_chunks=2)
+        ip = mk.ingest_profile
+        assert ip.resumed
+        # the crashed pass resumed past its checkpointed chunks
+        assert ip.passes[0].chunks_skipped == 6  # last save at chunk 6
+        assert "resumed" in ip.format()
+        # parity: same vocabs, same keep decisions, same scores
+        def by_type(m, tn):
+            return next(s for s in m.stages if type(s).__name__ == tn)
+        assert (by_type(mk, "OneHotVectorizerModel").vocabs
+                == by_type(m0, "OneHotVectorizerModel").vocabs)
+        assert (by_type(mk, "SanityCheckerModel").keep_indices
+                == by_type(m0, "SanityCheckerModel").keep_indices)
+        assert _probs(mk, df) == pytest.approx(_probs(m0, df), abs=1e-6)
+        # success removed the checkpoint: a fresh run will not resume
+        assert not os.path.exists(os.path.join(ckpt, "checkpoint.json"))
+
+    def test_crash_in_fused_pass_resumes_from_boundary(self, df, csv_path,
+                                                       tmp_path):
+        """A crash in the fused fit+materialize pass (whose buffers are
+        deliberately not checkpointed) resumes from the pass boundary:
+        layer-0 models restore, the fused pass re-runs."""
+        ckpt = str(tmp_path / "ckpt")
+        m0 = _train(CSVReader(csv_path))
+        # OneHotVectorizerModel transforms only run once layer 0 is
+        # FITTED, i.e. during the fused pass — crash there
+        with faults.inject(faults.FaultSpec(
+                point="stage.transform", action="raise",
+                tag="OneHotVectorizerModel", skip=8)):
+            with pytest.raises(faults.FaultError):
+                _train(CSVReader(csv_path), checkpoint_dir=ckpt,
+                       checkpoint_every_chunks=2)
+        mk = _train(CSVReader(csv_path), checkpoint_dir=ckpt,
+                    checkpoint_every_chunks=2)
+        ip = mk.ingest_profile
+        assert ip.resumed
+        # layer 0 never re-ran: the resumed run has no "fit[" reader pass
+        labels = [p.label for p in ip.passes]
+        assert not any(l.startswith("fit[") for l in labels)
+        assert any(l.startswith("fit+materialize[") for l in labels)
+        assert _probs(mk, df) == pytest.approx(_probs(m0, df), abs=1e-6)
+
+    def test_restored_models_keep_fitted_metadata(self, df, csv_path,
+                                                  tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        with faults.inject(faults.FaultSpec(
+                point="stage.transform", action="raise",
+                tag="OneHotVectorizerModel", skip=4)):
+            with pytest.raises(faults.FaultError):
+                _train(CSVReader(csv_path), checkpoint_dir=ckpt,
+                       checkpoint_every_chunks=2)
+        mk = _train(CSVReader(csv_path), checkpoint_dir=ckpt)
+        m0 = _train(CSVReader(csv_path))
+        smart_k = next(s for s in mk.stages
+                       if type(s).__name__ == "SmartTextVectorizerModel")
+        smart_0 = next(s for s in m0.stages
+                       if type(s).__name__ == "SmartTextVectorizerModel")
+        assert smart_k.vocabs == smart_0.vocabs
+        assert smart_k.uid == smart_0.uid  # answers for the estimator uid
+
+
+@pytest.mark.faults
+class TestKillResumeE2E:
+    """The acceptance e2e: SIGKILL (-9) the fit mid-pass at a checkpoint
+    barrier, rerun with the same checkpoint_dir, assert model parity with
+    an uninterrupted run — in REAL subprocesses via TMOG_FAULTS."""
+
+    CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r}); sys.path.insert(0, {repo!r} + "/tests")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import conftest  # noqa: F401  (platform pinning)
+import numpy as np, pandas as pd
+from test_out_of_core import build_titanic_pipeline
+from transmogrifai_tpu import OpWorkflow
+from transmogrifai_tpu.readers.files import CSVReader
+from transmogrifai_tpu.types import feature_types as ft
+
+csv, ckpt = sys.argv[1], sys.argv[2]
+wf = OpWorkflow().set_result_features(
+    build_titanic_pipeline()).set_reader(CSVReader(csv))
+m = wf.train(chunk_rows=32, checkpoint_dir=ckpt, checkpoint_every_chunks=2)
+print("RESUMED", m.ingest_profile.resumed)
+s = m.score(data=pd.read_csv(csv))
+name = next(n for n in s.names() if issubclass(s[n].ftype, ft.Prediction))
+p = [round(d["probability_1"], 9) for d in s[name].to_list()]
+print("RESULT", p[:25])
+"""
+
+    def _run_child(self, csv, ckpt, kill_at=None, timeout=420):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("TMOG_FAULTS", None)
+        if kill_at is not None:
+            env["TMOG_FAULTS"] = json.dumps({"faults": [
+                {"point": "checkpoint.barrier", "action": "kill",
+                 "at": kill_at}]})
+        return subprocess.run(
+            [sys.executable, "-c", self.CHILD.format(repo=repo), csv, ckpt],
+            capture_output=True, text=True, env=env, timeout=timeout)
+
+    def test_sigkill_mid_pass_then_resume_parity(self, csv_path, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        killed = self._run_child(csv_path, ckpt, kill_at=2)
+        assert killed.returncode == -9, killed.stderr[-400:]  # SIGKILLed
+        assert os.path.exists(os.path.join(ckpt, "checkpoint.json"))
+        resumed = self._run_child(csv_path, ckpt)
+        assert resumed.returncode == 0, resumed.stderr[-800:]
+        assert "RESUMED True" in resumed.stdout
+        clean = self._run_child(csv_path, str(tmp_path / "ckpt2"))
+        assert clean.returncode == 0, clean.stderr[-800:]
+        assert "RESUMED False" in clean.stdout
+        probs_resumed = [l for l in resumed.stdout.splitlines()
+                         if l.startswith("RESULT")]
+        probs_clean = [l for l in clean.stdout.splitlines()
+                       if l.startswith("RESULT")]
+        assert probs_resumed and probs_resumed == probs_clean
+
+
+# ---------------------------------------------------------------------------
+# satellite: _BlockStore spill cleanup when the cascade raises mid-flight
+# ---------------------------------------------------------------------------
+
+class TestSpillCleanupOnError:
+    def test_spill_file_removed_when_cascade_raises(self, df, tmp_path,
+                                                    monkeypatch):
+        import tempfile
+
+        monkeypatch.setenv("TMOG_STREAM_RETAIN_MB", "0.01")  # force spill
+        monkeypatch.setenv("TMPDIR", str(tmp_path))
+        tempfile.tempdir = None  # re-read TMPDIR
+        try:
+            # SanityCheckerModel transforms run in the BLOCK CASCADE, after
+            # the spill file exists — the raise must still clean it up
+            with faults.inject(faults.FaultSpec(
+                    point="stage.transform", action="raise",
+                    tag="SanityCheckerModel", skip=2)):
+                with pytest.raises(faults.FaultError):
+                    _train(df)
+        finally:
+            tempfile.tempdir = None
+        assert not list(tmp_path.glob("tmog_spill_*"))  # no leftover spill
+
+
+# ---------------------------------------------------------------------------
+# serving: breaker state + last-fallback reason are operator-visible
+# ---------------------------------------------------------------------------
+
+class TestServingFallbackSurfacing:
+    def test_snapshot_and_healthz_surface_fallback_reason(self):
+        from urllib.request import urlopen
+
+        from transmogrifai_tpu.local import load_model_local
+        from transmogrifai_tpu.serving import ModelServer
+        from transmogrifai_tpu.serving.http import make_http_server
+
+        fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
+        model_dir = os.path.join(fixtures, "model_v1")
+        rows = pd.read_csv(os.path.join(
+            fixtures, "model_v1_input.csv")).to_dict("records")
+        srv = ModelServer.from_path(
+            model_dir, name="resil", max_batch=4, max_latency_ms=1.0,
+            failure_threshold=1, breaker_reset_s=60.0,
+            warmup_row=dict(rows[0]))
+        with srv:
+            snap = srv.snapshot()
+            assert snap["lastFallbackReason"] is None  # healthy baseline
+            executor = srv._executor_for(srv.registry.get("resil"))
+
+            def boom(_rows):
+                raise RuntimeError("injected device worker crash")
+
+            executor.score_fn = boom
+            srv.score(rows[:2])  # device fails -> host fallback answers
+            snap = srv.snapshot()
+            assert snap["breakerState"] == "open"
+            assert snap["lastFallbackReason"] == "device_error:RuntimeError"
+            assert snap["lastFallbackAgeSecs"] >= 0
+            srv.score(rows[:2])  # breaker open -> straight to host path
+            assert srv.snapshot()["lastFallbackReason"] == "breaker_open"
+
+            httpd = make_http_server(srv, port=0)
+            port = httpd.server_address[1]
+            import threading
+
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            try:
+                with urlopen(f"http://127.0.0.1:{port}/healthz",
+                             timeout=10) as resp:
+                    health = json.loads(resp.read())
+                assert health["status"] == "degraded"
+                assert health["breakerState"] == "open"
+                assert health["lastFallbackReason"] == "breaker_open"
+                with urlopen(f"http://127.0.0.1:{port}/metrics",
+                             timeout=10) as resp:
+                    metrics = json.loads(resp.read())
+                assert metrics["lastFallbackReason"] == "breaker_open"
+            finally:
+                httpd.shutdown()
+                httpd.server_close()

@@ -606,6 +606,31 @@ class TextStats:
         )
         return out
 
+    # -- checkpoint codec hooks (workflow/checkpoint.py) --------------------
+
+    def to_state(self) -> dict:
+        """Counter insertion order is the ``most_common`` tie order, so
+        keys/counts persist as parallel ordered lists."""
+        return {"max_card": self.max_card,
+                "values": list(self.value_counts.keys()),
+                "value_ns": list(self.value_counts.values()),
+                "lengths": list(self.length_counts.keys()),
+                "length_ns": list(self.length_counts.values()),
+                "n": self.n, "n_null": self.n_null,
+                "saturated": self.saturated}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "TextStats":
+        out = cls(int(state["max_card"]))
+        out.value_counts = Counter(dict(zip(state["values"],
+                                            state["value_ns"])))
+        out.length_counts = Counter(dict(zip(
+            (int(k) for k in state["lengths"]), state["length_ns"])))
+        out.n = int(state["n"])
+        out.n_null = int(state["n_null"])
+        out.saturated = bool(state["saturated"])
+        return out
+
 
 class SmartTextVectorizer(SequenceEstimator):
     """Cardinality-driven text strategy: pivot / hash / ignore per field.

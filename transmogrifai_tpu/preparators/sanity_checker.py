@@ -303,6 +303,32 @@ class SanityChecker(BinaryEstimator):
                if self.check_sample < 1.0 else None)
         return SanityChecker._StreamState(rng)
 
+    # -- checkpoint hooks: _StreamState <-> codec-safe dict -----------------
+    # The rng round-trips through the bit generator's exact state, so a
+    # resumed sampled fit draws the SAME row-selection stream it would
+    # have drawn uninterrupted.
+
+    def export_fit_state(self, state):
+        return {"pearson": state.pearson,
+                "label_values": state.label_values,
+                "label_sums": state.label_sums,
+                "vmeta": state.vmeta,
+                "d": state.d,
+                "rng": state.rng}
+
+    def import_fit_state(self, payload):
+        state = SanityChecker._StreamState(payload["rng"])
+        state.pearson = payload["pearson"]
+        state.label_values = np.asarray(payload["label_values"],
+                                        dtype=np.float64)
+        sums = payload["label_sums"]
+        state.label_sums = (None if sums is None
+                            else {float(k): np.asarray(v, np.float64)
+                                  for k, v in sums.items()})
+        state.vmeta = payload["vmeta"]
+        state.d = None if payload["d"] is None else int(payload["d"])
+        return state
+
     #: streaming Cramér's V tracks per-label column sums; past this many
     #: distinct label values the label cannot be categorical for any
     #: reasonable config and the contingency accumulator is abandoned

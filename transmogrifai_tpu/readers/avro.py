@@ -30,9 +30,41 @@ from ..types.columns import ColumnarDataset, FeatureColumn
 from .base import Reader, RecordsReader
 
 __all__ = ["read_avro", "write_avro", "AvroReader", "AvroSchemaCSVReader",
-           "avro_to_feature_type", "schema_feature_types"]
+           "avro_to_feature_type", "schema_feature_types",
+           "AvroBlockError", "AvroRecordError"]
 
 _MAGIC = b"Obj\x01"
+
+
+class AvroBlockError(ValueError):
+    """A corrupt Avro container block, attributed: the message carries the
+    block index and the block's byte offset in the file, so an operator
+    (or the quarantine sidecar) can point at the exact bytes."""
+
+    def __init__(self, path: str, block_index: int, byte_offset: int,
+                 reason: str):
+        super().__init__(
+            f"{path}: corrupt avro block {block_index} "
+            f"(byte offset {byte_offset}): {reason}")
+        self.path = path
+        self.block_index = block_index
+        self.byte_offset = byte_offset
+        self.reason = reason
+
+
+class AvroRecordError(AvroBlockError):
+    """A record-level decode failure inside an otherwise-framed block —
+    attributable down to the record index.  ``decoded`` holds the records
+    that decoded cleanly BEFORE the failure (binary decoding desyncs at
+    the first bad record, so everything after it in the block is
+    unrecoverable and the quarantine policy drops block remainder)."""
+
+    def __init__(self, path: str, block_index: int, byte_offset: int,
+                 record_index: int, reason: str, decoded=None):
+        super().__init__(path, block_index, byte_offset,
+                         f"record {record_index} failed to decode: {reason}")
+        self.record_index = record_index
+        self.decoded = decoded if decoded is not None else []
 _PRIMITIVES = ("null", "boolean", "int", "long", "float", "double",
                "bytes", "string")
 
@@ -392,57 +424,127 @@ def _read_header(dec, path: str):
 
 
 def _decode_block(block: bytes, count: int, codec: str, schema, named,
-                  path: str) -> List[dict]:
-    if codec == "deflate":
-        block = zlib.decompress(block, -15)
-    elif codec == "snappy":
-        crc = int.from_bytes(block[-4:], "big")
-        block = _snappy_decompress(block[:-4])
-        if zlib.crc32(block) & 0xFFFFFFFF != crc:
-            raise ValueError(f"{path}: snappy block CRC mismatch")
+                  path: str, block_index: int = 0,
+                  byte_offset: int = 0) -> List[dict]:
+    """Decode one container block's records.  Corruption is attributed:
+    codec failures raise :class:`AvroBlockError` (block index + byte
+    offset), per-record decode failures raise :class:`AvroRecordError`
+    (record index too, with the cleanly-decoded prefix attached)."""
+    try:
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec == "snappy":
+            crc = int.from_bytes(block[-4:], "big")
+            block = _snappy_decompress(block[:-4])
+            if zlib.crc32(block) & 0xFFFFFFFF != crc:
+                raise ValueError("snappy block CRC mismatch")
+    except AvroBlockError:
+        raise
+    except Exception as exc:
+        raise AvroBlockError(path, block_index, byte_offset,
+                             f"{codec} decompression failed: {exc}") from exc
     bdec = _Decoder(block)
-    return [_decode(schema, bdec, named) for _ in range(count)]
+    out: List[dict] = []
+    for i in range(count):
+        try:
+            out.append(_decode(schema, bdec, named))
+        except Exception as exc:
+            raise AvroRecordError(path, block_index, byte_offset, i,
+                                  str(exc), decoded=out) from exc
+    return out
 
 
-def read_avro(path: str) -> Tuple[Dict[str, Any], List[dict]]:
-    """Read an Avro OCF: returns (writer schema, records)."""
+def _handle_block_error(exc: AvroBlockError, count: int, resilience):
+    """Quarantine a corrupt block's lost rows (policy permitting) and
+    return the salvageable prefix records; re-raises under ``fail``."""
+    if resilience is None or not resilience.quarantines:
+        raise exc
+    decoded = list(getattr(exc, "decoded", []) or [])
+    lost = count - len(decoded)
+    resilience.handle_bad_record(
+        exc.path, f"block {exc.block_index} (byte {exc.byte_offset})",
+        exc.reason, rows=max(lost, 1))
+    return decoded
+
+
+def read_avro(path: str, resilience=None) -> Tuple[Dict[str, Any],
+                                                   List[dict]]:
+    """Read an Avro OCF: returns (writer schema, records).
+
+    ``resilience`` (a ``readers.resilience.ResilienceConfig`` with the
+    quarantine policy) routes corrupt blocks to the sidecar and keeps
+    going; the default fails fast with an attributed AvroBlockError.  A
+    sync-marker mismatch always raises — past it the block FRAMING is
+    gone, and silently resynchronizing could drop data unaccounted."""
     raw = open(path, "rb").read()
     dec = _Decoder(raw)
     schema, codec, sync, named = _read_header(dec, path)
     records: List[dict] = []
+    block_index = 0
     while dec.pos < len(raw):
-        count = dec.read_long()
-        size = dec.read_long()
-        block = dec.read(size)
-        records.extend(_decode_block(block, count, codec, schema, named,
-                                     path))
+        byte_offset = dec.pos
+        try:
+            count = dec.read_long()
+            size = dec.read_long()
+            block = dec.read(size)
+        except (EOFError, IndexError) as exc:
+            raise AvroBlockError(path, block_index, byte_offset,
+                                 f"truncated block framing: {exc}") from exc
+        try:
+            records.extend(_decode_block(block, count, codec, schema,
+                                         named, path, block_index,
+                                         byte_offset))
+        except AvroBlockError as exc:
+            records.extend(_handle_block_error(exc, count, resilience))
         if dec.read(16) != sync:
-            raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
+            raise AvroBlockError(path, block_index, byte_offset,
+                                 "sync marker mismatch")
+        block_index += 1
     return schema, records
 
 
-def iter_avro_blocks(path: str, bytes_pos: Optional[dict] = None):
+def iter_avro_blocks(path: str, bytes_pos: Optional[dict] = None,
+                     resilience=None):
     """Stream an Avro OCF block by block: yields ``(schema, records)`` per
     container block without ever holding the whole file or record list.
     ``bytes_pos["bytes"]``, when a dict is passed, tracks the file position
-    after each yielded block (ingest byte accounting)."""
+    after each yielded block (ingest byte accounting).  Corrupt blocks are
+    attributed (index + byte offset) and, under a quarantine policy,
+    skipped with their salvageable record prefix kept — the framing
+    (size + sync marker) survives payload corruption, so the stream
+    resumes at the next block."""
+    from ..utils import faults
+
     with open(path, "rb") as fh:
         dec = _FileDecoder(fh)
         schema, codec, sync, named = _read_header(dec, path)
+        block_index = 0
         while True:
             probe = fh.read(1)
             if not probe:
                 return
             fh.seek(-1, 1)
-            count = dec.read_long()
-            size = dec.read_long()
-            block = dec.read(size)
-            records = _decode_block(block, count, codec, schema, named, path)
+            byte_offset = fh.tell()
+            faults.fire("avro.block", index=block_index)
+            try:
+                count = dec.read_long()
+                size = dec.read_long()
+                block = dec.read(size)
+            except EOFError as exc:
+                raise AvroBlockError(path, block_index, byte_offset,
+                                     f"truncated block framing: {exc}"
+                                     ) from exc
+            try:
+                records = _decode_block(block, count, codec, schema, named,
+                                        path, block_index, byte_offset)
+            except AvroBlockError as exc:
+                records = _handle_block_error(exc, count, resilience)
             if dec.read(16) != sync:
-                raise ValueError(
-                    f"{path}: sync marker mismatch (corrupt block)")
+                raise AvroBlockError(path, block_index, byte_offset,
+                                     "sync marker mismatch")
             if bytes_pos is not None:
                 bytes_pos["bytes"] = fh.tell()
+            block_index += 1
             yield schema, records
 
 
@@ -549,7 +651,7 @@ class AvroReader(Reader):
 
     def _load(self) -> Tuple[Dict, List[dict]]:
         if self._cache is None:
-            self._cache = read_avro(self.path)
+            self._cache = read_avro(self.path, resilience=self.resilience)
         return self._cache
 
     @property
@@ -581,8 +683,8 @@ class AvroReader(Reader):
 
         def gen():
             pending: List[dict] = []
-            for _schema, records in iter_avro_blocks(self.path,
-                                                     bytes_pos=pos):
+            for _schema, records in iter_avro_blocks(
+                    self.path, bytes_pos=pos, resilience=self.resilience):
                 pending.extend(records)
                 while len(pending) >= chunk_rows:
                     batch, pending = (pending[:chunk_rows],

@@ -18,6 +18,7 @@ import numpy as np
 from ..features.feature import Feature
 from ..stages.generator import FeatureGeneratorStage
 from ..types.columns import ColumnarDataset, FeatureColumn
+from ..utils import faults
 
 __all__ = ["Reader", "DataFrameReader", "RecordsReader", "reader_for",
            "ChunkStream"]
@@ -31,18 +32,26 @@ class ChunkStream:
     that cannot attribute bytes leave it at 0.  The out-of-core driver
     reads it from the SAME thread that advances the iterator (the prefetch
     pump), so no locking is needed.
+
+    Every chunk production passes the ``reader.chunk`` fault-injection
+    point (utils/faults.py) keyed by chunk index — a no-op unless a test
+    armed a plan; the retry wrapper (readers/resilience.py) sits ABOVE this
+    stream, so injected IO errors exercise the real recovery path.
     """
 
     def __init__(self, gen, bytes_fn=None):
         self._gen = iter(gen)
         self._bytes_fn = bytes_fn
+        self._idx = 0
         self.bytes_read: int = 0
 
     def __iter__(self):
         return self
 
     def __next__(self) -> ColumnarDataset:
+        faults.fire("reader.chunk", index=self._idx)
         ds = next(self._gen)
+        self._idx += 1
         if self._bytes_fn is not None:
             self.bytes_read = int(self._bytes_fn())
         return ds
@@ -50,6 +59,33 @@ class ChunkStream:
 
 class Reader:
     """Produces the raw-feature dataset for a workflow."""
+
+    #: optional ingestion resilience (retry/backoff + bad-record policy);
+    #: ``None`` keeps the historical fail-fast behavior byte-identical
+    resilience = None
+
+    def with_resilience(self, retry=None, bad_records: str = "fail",
+                        quarantine_path: Optional[str] = None,
+                        max_bad_records: int = 1000) -> "Reader":
+        """Attach a :class:`~..readers.resilience.ResilienceConfig`.
+
+        ``retry``: a ``RetryPolicy``, ``True`` for the defaults, or None
+        (no retries).  ``bad_records``: ``"fail"`` (default) or
+        ``"quarantine"`` (requires ``quarantine_path``; unparseable rows
+        land in that JSONL sidecar until ``max_bad_records`` rows, then
+        the read fails fast).
+        """
+        from .resilience import (BadRecordPolicy, ResilienceConfig,
+                                 RetryPolicy)
+
+        if retry is True:
+            retry = RetryPolicy()
+        self.resilience = ResilienceConfig(
+            retry=retry,
+            bad_records=BadRecordPolicy(
+                mode=bad_records, quarantine_path=quarantine_path,
+                max_bad_records=max_bad_records))
+        return self
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         raise NotImplementedError

@@ -48,12 +48,35 @@ class CSVReader(Reader):
         self.has_header = has_header
         self.key_col = key_col
 
+    def _bad_line_kwargs(self) -> dict:
+        """Under the quarantine policy, malformed CSV lines route to the
+        sidecar via pandas' ``on_bad_lines`` callback (python engine) with
+        a deterministic per-file ordinal as the location; the default
+        policy keeps pandas' stock ParserError fail-fast (C engine)."""
+        cfg = self.resilience
+        if cfg is None or not cfg.quarantines:
+            return {}
+        counter = {"n": 0}
+        source = self.path
+
+        def on_bad(fields):
+            loc = f"bad-line#{counter['n']}"
+            counter["n"] += 1
+            cfg.handle_bad_record(source, loc,
+                                  f"malformed CSV row ({len(fields)} fields)",
+                                  record=list(map(str, fields)))
+            return None  # drop the row
+
+        return {"on_bad_lines": on_bad, "engine": "python"}
+
     def _load(self):
         import pandas as pd
 
+        kwargs = self._bad_line_kwargs()
         if self.has_header:
-            return pd.read_csv(self.path)
-        return pd.read_csv(self.path, header=None, names=self.column_names)
+            return pd.read_csv(self.path, **kwargs)
+        return pd.read_csv(self.path, header=None, names=self.column_names,
+                           **kwargs)
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         return DataFrameReader(self._load(), self.key_col).generate_dataset(raw_features)
@@ -72,7 +95,8 @@ class CSVReader(Reader):
 
         def gen():
             try:
-                kwargs = dict(chunksize=chunk_rows, dtype=dtype)
+                kwargs = dict(chunksize=chunk_rows, dtype=dtype,
+                              **self._bad_line_kwargs())
                 if not self.has_header:
                     kwargs.update(header=None, names=self.column_names)
                 with pd.read_csv(fh, **kwargs) as it:
@@ -130,15 +154,37 @@ class JSONLinesReader(Reader):
         self.path = path
         self.key_col = key_col
 
-    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
+    def _parse_line(self, raw: bytes, line_no: int, offset: int):
+        """One JSONL record, or None when the bad line was quarantined.
+        Under the default ``fail`` policy a bad line raises a
+        ``BadRecordError`` naming the line number and byte offset."""
         import json
 
+        try:
+            return json.loads(raw)
+        except (ValueError, UnicodeDecodeError) as exc:
+            cfg = self.resilience
+            reason = f"invalid JSON: {exc}"
+            location = f"line {line_no} (byte {offset})"
+            if cfg is not None and cfg.quarantines:
+                cfg.handle_bad_record(self.path, location, reason,
+                                      record=raw.decode("utf-8", "replace"))
+                return None
+            from .resilience import BadRecordError
+
+            raise BadRecordError(self.path, location, reason) from exc
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         records = []
-        with open(self.path) as fh:
-            for line in fh:
-                line = line.strip()
-                if line:
-                    records.append(json.loads(line))
+        offset = 0
+        with open(self.path, "rb") as fh:
+            for line_no, line in enumerate(fh, start=1):
+                s = line.strip()
+                if s:
+                    rec = self._parse_line(s, line_no, offset)
+                    if rec is not None:
+                        records.append(rec)
+                offset += len(line)
         from .base import RecordsReader
 
         return RecordsReader(records).generate_dataset(raw_features)
@@ -149,21 +195,21 @@ class JSONLinesReader(Reader):
         ever resident; bytes_read tracks raw line bytes consumed."""
         if chunk_rows <= 0:
             raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
-        import json
-
         from .base import RecordsReader
 
         pos = {"bytes": 0}
 
         def gen():
-            records, nbytes = [], 0
+            records, nbytes, line_no = [], 0, 0
             with open(self.path, "rb") as fh:
                 for line in fh:
-                    nbytes += len(line)
+                    line_no += 1
                     s = line.strip()
-                    if not s:
-                        continue
-                    records.append(json.loads(s))
+                    if s:
+                        rec = self._parse_line(s, line_no, nbytes)
+                        if rec is not None:
+                            records.append(rec)
+                    nbytes += len(line)
                     if len(records) >= chunk_rows:
                         pos["bytes"] = nbytes
                         yield RecordsReader(records).generate_dataset(

@@ -146,6 +146,7 @@ class ModelServer:
         executor = self._executor_for(entry)
         bucket = bucket_for(len(rows), executor.buckets) \
             if len(rows) <= executor.max_batch else executor.max_batch
+        fallback_reason = "breaker_open"
         if self.breaker.allow_device():
             t0 = time.perf_counter()
             try:
@@ -154,14 +155,15 @@ class ModelServer:
                 self.metrics.record_batch(
                     len(rows), bucket, time.perf_counter() - t0)
                 return out
-            except Exception:
+            except Exception as exc:
+                fallback_reason = f"device_error:{type(exc).__name__}"
                 self.metrics.record_device_error()
                 if self.breaker.record_failure():
                     self.metrics.record_breaker_open()
         # degradation ladder rung 4: numpy host path, exact batch size —
         # slower, but it answers (the device worker-crash mode must degrade
         # a replica, not take it down)
-        self.metrics.record_host_fallback(len(rows))
+        self.metrics.record_host_fallback(len(rows), reason=fallback_reason)
         t0 = time.perf_counter()
         out = entry.scorer(rows)
         self.metrics.record_batch(len(rows), bucket,

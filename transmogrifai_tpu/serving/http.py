@@ -65,10 +65,16 @@ def make_http_server(server, host: str = "127.0.0.1",
         def do_GET(self):
             if self.path == "/healthz":
                 entry = server.registry.maybe_get(server.name)
+                breaker_state = server.breaker.state
+                status = "ok" if entry else "no_model"
+                if entry and breaker_state != server.breaker.CLOSED:
+                    status = "degraded"  # serving, but from the host path
                 self._reply(200 if entry else 503, {
-                    "status": "ok" if entry else "no_model",
+                    "status": status,
                     "model": entry.describe() if entry else None,
-                    "breakerState": server.breaker.state,
+                    "breakerState": breaker_state,
+                    "lastFallbackReason":
+                        server.metrics.last_fallback_reason,
                 })
             elif self.path == "/metrics":
                 self._reply(200, server.snapshot())

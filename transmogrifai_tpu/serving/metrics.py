@@ -79,6 +79,12 @@ class ServingMetrics:
         self.host_fallbacks = 0
         self.breaker_opens = 0
         self.hot_swaps = 0
+        #: why the LAST host fallback engaged ("breaker_open", or
+        #: "device_error:<ExceptionType>") + when — the operator-facing
+        #: answer to "why is this replica slow": visible in /metrics and
+        #: /healthz, not just a counter that something happened
+        self.last_fallback_reason: Optional[str] = None
+        self.last_fallback_at: Optional[float] = None
 
     # -- recording ----------------------------------------------------------
 
@@ -120,9 +126,13 @@ class ServingMetrics:
         with self._lock:
             self.device_errors += 1
 
-    def record_host_fallback(self, n_rows: int = 0) -> None:
+    def record_host_fallback(self, n_rows: int = 0,
+                             reason: Optional[str] = None) -> None:
         with self._lock:
             self.host_fallbacks += 1
+            if reason is not None:
+                self.last_fallback_reason = reason
+                self.last_fallback_at = time.time()
 
     def record_breaker_open(self) -> None:
         with self._lock:
@@ -162,6 +172,10 @@ class ServingMetrics:
                 "hostFallbacks": self.host_fallbacks,
                 "breakerOpens": self.breaker_opens,
                 "hotSwaps": self.hot_swaps,
+                "lastFallbackReason": self.last_fallback_reason,
+                "lastFallbackAgeSecs": (
+                    None if self.last_fallback_at is None
+                    else round(time.time() - self.last_fallback_at, 3)),
             }
         snap["compileCache"] = cache_stats()
         return snap

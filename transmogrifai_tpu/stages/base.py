@@ -27,6 +27,7 @@ import numpy as np
 from ..features.feature import Feature
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types.feature_types import FeatureType
+from ..utils import faults
 from ..utils.uid import uid_for
 
 __all__ = [
@@ -265,6 +266,7 @@ class Transformer(PipelineStage):
         """Copy-on-write transform: returns a NEW dataset view sharing every
         untouched column buffer with ``data`` (which is never mutated),
         with this stage's output appended/overridden."""
+        faults.fire("stage.transform", tag=type(self).__name__)
         name, out = self.checked_transform_output(data)
         return data.with_columns({name: out})
 
@@ -338,6 +340,25 @@ class Estimator(PipelineStage):
     def finish_fit(self, state) -> Model:
         raise NotImplementedError(
             f"{type(self).__name__} does not support streaming fit")
+
+    # -- checkpoint hooks (workflow/checkpoint.py) --------------------------
+    #
+    # The out-of-core driver periodically persists in-flight streaming-fit
+    # states so a killed process resumes instead of refitting.  The default
+    # hooks hand the state straight to the checkpoint codec, which handles
+    # primitives, ndarrays, (nested) lists/dicts, and the sketch types with
+    # to_state/from_state (WelfordMoments, PearsonSketch, TopKSketch,
+    # TextStats).  Estimators whose state holds anything else override
+    # these to translate to/from codec-safe structures; the round trip
+    # must be EXACT — resume parity is asserted against uninterrupted runs.
+
+    def export_fit_state(self, state):
+        """Streaming-fit state -> checkpoint-codec-safe structure."""
+        return state
+
+    def import_fit_state(self, payload):
+        """Inverse of ``export_fit_state``."""
+        return payload
 
     def fit_streaming(self, chunks) -> Model:
         """Fit from an iterable of ``ColumnarDataset`` chunks via the

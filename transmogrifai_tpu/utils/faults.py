@@ -1,0 +1,260 @@
+"""Deterministic fault injection — the test substrate for the resilience layer.
+
+The reference inherited fault tolerance from Spark and could test it by
+killing executors; this port runs in one process, so recovery paths (reader
+retry, bad-record quarantine, checkpoint/resume — docs/robustness.md) would
+otherwise only ever execute in production.  This module plants named
+injection points on the hot paths and lets tests arm them with a
+deterministic plan: *this* chunk fails with an IO error twice, *that*
+transform raises, the process is SIGKILLed at the k-th checkpoint barrier.
+
+Injection points (each a single ``fire()`` call, a no-op global check when
+no plan is armed):
+
+  ``reader.chunk``       before chunk ``index`` leaves the reader's
+                         ChunkStream (readers/base.py) — an ``io_error``
+                         here exercises retry/backoff
+  ``avro.block``         before Avro container block ``index`` decodes
+                         (readers/avro.py)
+  ``stage.transform``    before a stage transform runs (stages/base.py);
+                         ``tag`` is the stage class name
+  ``checkpoint.barrier`` right after checkpoint save ``index`` hits disk
+                         (workflow/checkpoint.py) — a ``kill`` here is the
+                         canonical crash-resume test
+
+Actions: ``io_error`` (raise OSError — the transient class the reader
+retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``
+(sleep ``delay_s``), ``kill`` (SIGKILL this process; subprocess tests only).
+
+Determinism: a spec matches by explicit call index (``at``/``every``) or by
+a seeded per-point Bernoulli draw (``p`` + plan ``seed``) — same plan, same
+call sequence, same faults, every run.  ``times`` bounds how often a spec
+fires (so a retried chunk can succeed on attempt N+1).
+
+Arming: programmatic (``install_faults`` / the ``inject`` context manager)
+or via the ``TMOG_FAULTS`` env var (JSON, read once at first ``fire``) so a
+kill-target subprocess can be armed from the outside::
+
+    TMOG_FAULTS='{"faults": [{"point": "checkpoint.barrier",
+                              "action": "kill", "at": 0}]}'
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FaultSpec", "FaultPlan", "FaultError", "install_faults",
+           "clear_faults", "current_plan", "inject", "fire", "ENV_VAR"]
+
+ENV_VAR = "TMOG_FAULTS"
+
+_ACTIONS = ("io_error", "raise", "slow", "kill")
+
+
+class FaultError(RuntimeError):
+    """Raised by the ``raise`` action (non-transient by design: the retry
+    policy must NOT swallow it)."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault.
+
+    ``at``: explicit call index (int or list of ints) for the point;
+    ``every``: fire on every n-th call; ``p``: seeded Bernoulli per call.
+    Exactly one selector should be set; ``at`` wins, then ``every``, then
+    ``p``; a bare spec matches every call.  ``tag`` restricts matching to
+    fires carrying the same tag (e.g. a stage class name); ``skip``
+    passes over the first n otherwise-matching calls (the way to target
+    "the 3rd transform of stage X" when the point's call counter is
+    global).  ``times`` caps total firings (None = unlimited).
+    """
+
+    point: str
+    action: str = "io_error"
+    at: Optional[Any] = None
+    every: Optional[int] = None
+    p: Optional[float] = None
+    tag: Optional[str] = None
+    skip: int = 0
+    times: Optional[int] = 1
+    delay_s: float = 0.05
+    message: str = "injected fault"
+    fired: int = field(default=0, compare=False)
+    seen: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}; "
+                             f"expected one of {_ACTIONS}")
+
+    def matches(self, index: int, tag: Optional[str], draw: float) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.tag is not None and tag != self.tag:
+            return False
+        if self.at is not None:
+            ats = self.at if isinstance(self.at, (list, tuple)) else [self.at]
+            hit = index in ats
+        elif self.every is not None:
+            hit = self.every > 0 and index % self.every == 0
+        elif self.p is not None:
+            hit = draw < self.p
+        else:
+            hit = True  # bare point spec: every matching call
+        if not hit:
+            return False
+        if self.seen < self.skip:
+            self.seen += 1
+            return False
+        return True
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"point": self.point, "action": self.action}
+        for k in ("at", "every", "p", "tag", "times"):
+            if getattr(self, k) is not None:
+                out[k] = getattr(self, k)
+        if self.skip:
+            out["skip"] = self.skip
+        if self.action == "slow":
+            out["delay_s"] = self.delay_s
+        return out
+
+
+class FaultPlan:
+    """A set of armed FaultSpecs plus the per-point call counters.
+
+    Call counters advance on EVERY fire of a point (hit or miss), so a
+    spec's ``at=k`` means "the k-th time execution reaches this point"
+    regardless of other specs — deterministic by construction.  The seeded
+    RNG stream for ``p`` specs is per point, keyed independent of call
+    interleaving across points.
+    """
+
+    def __init__(self, faults: List[FaultSpec], seed: int = 0):
+        import numpy as np
+
+        self.faults = list(faults)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}
+        self._rngs: Dict[str, Any] = {}
+        self._np = np
+        self.log: List[Dict[str, Any]] = []  # fired faults, for assertions
+
+    @classmethod
+    def from_json(cls, doc: Any) -> "FaultPlan":
+        if isinstance(doc, str):
+            doc = json.loads(doc)
+        if isinstance(doc, list):
+            doc = {"faults": doc}
+        specs = [FaultSpec(**f) for f in doc.get("faults", [])]
+        return cls(specs, seed=doc.get("seed", 0))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"seed": self.seed,
+                "faults": [f.to_json() for f in self.faults]}
+
+    def _draw(self, point: str) -> float:
+        rng = self._rngs.get(point)
+        if rng is None:
+            # stable per-point stream: plan seed + point-name hash
+            h = sum(ord(c) * 131 ** i for i, c in enumerate(point)) % (1 << 31)
+            rng = self._rngs[point] = self._np.random.default_rng(
+                self.seed ^ h)
+        return float(rng.random())
+
+    def fire(self, point: str, tag: Optional[str] = None,
+             index: Optional[int] = None) -> None:
+        """``index`` overrides the call counter as the match key — sites
+        with a natural coordinate (chunk id, block id) pass it so a spec's
+        ``at=k`` means "the k-th CHUNK" even when retries replay calls."""
+        with self._lock:
+            calls = self._calls.get(point, 0)
+            self._calls[point] = calls + 1
+            if index is None:
+                index = calls
+            draw = self._draw(point)
+            hit: Optional[FaultSpec] = None
+            for spec in self.faults:
+                if spec.point == point and spec.matches(index, tag, draw):
+                    spec.fired += 1
+                    hit = spec
+                    break
+            if hit is not None:
+                self.log.append({"point": point, "index": index, "tag": tag,
+                                 "action": hit.action})
+        if hit is None:
+            return
+        where = f"{point}[{index}]" + (f" tag={tag}" if tag else "")
+        if hit.action == "slow":
+            time.sleep(hit.delay_s)
+        elif hit.action == "io_error":
+            raise OSError(f"{hit.message} ({where})")
+        elif hit.action == "raise":
+            raise FaultError(f"{hit.message} ({where})")
+        elif hit.action == "kill":  # pragma: no cover - dies before report
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def calls(self, point: str) -> int:
+        with self._lock:
+            return self._calls.get(point, 0)
+
+
+#: sentinel: "not yet initialized from the environment"
+_UNSET = object()
+_plan: Any = _UNSET
+_plan_lock = threading.Lock()
+
+
+def install_faults(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Arm ``plan`` process-wide (None disarms); returns the plan."""
+    global _plan
+    with _plan_lock:
+        _plan = plan
+    return plan
+
+
+def clear_faults() -> None:
+    install_faults(None)
+
+
+def current_plan() -> Optional[FaultPlan]:
+    """The armed plan; first call resolves the ``TMOG_FAULTS`` env var."""
+    global _plan
+    if _plan is _UNSET:
+        with _plan_lock:
+            if _plan is _UNSET:
+                raw = os.environ.get(ENV_VAR)
+                _plan = FaultPlan.from_json(raw) if raw else None
+    return _plan
+
+
+@contextlib.contextmanager
+def inject(*specs: FaultSpec, seed: int = 0):
+    """Arm a plan for the enclosed block (tests); restores the previous
+    plan (including the not-yet-loaded env state) on exit."""
+    global _plan
+    with _plan_lock:
+        prev = _plan
+    plan = FaultPlan(list(specs), seed=seed)
+    install_faults(plan)
+    try:
+        yield plan
+    finally:
+        with _plan_lock:
+            _plan = prev
+
+
+def fire(point: str, tag: Optional[str] = None,
+         index: Optional[int] = None) -> None:
+    """Injection-site hook — a single global check when nothing is armed."""
+    plan = current_plan()
+    if plan is not None:
+        plan.fire(point, tag=tag, index=index)

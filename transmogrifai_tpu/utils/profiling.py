@@ -318,6 +318,13 @@ class IngestPass:
     read_s: float = 0.0
     transform_s: float = 0.0
     wall_s: float = 0.0
+    #: transient-IO retry count / backoff wall for this pass (the reader's
+    #: RetryingChunkStream wrapper, readers/resilience.py)
+    retries: int = 0
+    retry_wait_s: float = 0.0
+    #: chunks fast-skipped on a checkpoint resume (read but not
+    #: re-transformed; workflow/checkpoint.py)
+    chunks_skipped: int = 0
     #: first _INGEST_CHUNK_DETAIL_CAP chunks as (rows, read_s, transform_s)
     chunk_detail: List[Tuple[int, float, float]] = field(default_factory=list)
 
@@ -334,6 +341,10 @@ class IngestPass:
         if chunk_index < len(self.chunk_detail):
             self.chunk_detail[chunk_index][2] = round(seconds, 6)
 
+    def note_retry(self, wait_s: float) -> None:
+        self.retries += 1
+        self.retry_wait_s += wait_s
+
     @property
     def overlap_efficiency(self) -> float:
         smaller = min(self.read_s, self.transform_s)
@@ -347,7 +358,7 @@ class IngestPass:
         return self.rows / self.wall_s if self.wall_s > 0 else 0.0
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "label": self.label, "chunks": self.chunks, "rows": self.rows,
             "bytesRead": self.bytes_read,
             "readSecs": round(self.read_s, 4),
@@ -357,6 +368,12 @@ class IngestPass:
             "overlapEfficiency": round(self.overlap_efficiency, 3),
             "chunkDetail": [list(c) for c in self.chunk_detail],
         }
+        if self.retries:
+            out["retries"] = self.retries
+            out["retryWaitSecs"] = round(self.retry_wait_s, 4)
+        if self.chunks_skipped:
+            out["chunksSkipped"] = self.chunks_skipped
+        return out
 
 
 class IngestProfiler:
@@ -369,6 +386,15 @@ class IngestProfiler:
         #: bytes of retained blocks the fused pass spilled to disk
         #: (workflow/streaming._BlockStore; 0 = everything stayed in RAM)
         self.spilled_bytes: int = 0
+        #: quarantined bad records: sidecar entries / data rows dropped
+        #: (readers/resilience.QuarantineSink; 0/0 under the fail policy)
+        self.quarantined_records: int = 0
+        self.quarantined_rows: int = 0
+        #: checkpoint accounting (workflow/checkpoint.py): durable saves,
+        #: time spent writing them, and whether this run resumed
+        self.checkpoint_saves: int = 0
+        self.checkpoint_wall_s: float = 0.0
+        self.resumed: bool = False
         self._lock = threading.Lock()
 
     def begin_pass(self, label: str) -> IngestPass:
@@ -385,6 +411,14 @@ class IngestProfiler:
     def total_bytes(self) -> int:
         return max((p.bytes_read for p in self.passes), default=0)
 
+    @property
+    def total_retries(self) -> int:
+        return sum(p.retries for p in self.passes)
+
+    @property
+    def total_retry_wait_s(self) -> float:
+        return sum(p.retry_wait_s for p in self.passes)
+
     def to_json(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -392,6 +426,13 @@ class IngestProfiler:
                 "rows": self.total_rows,
                 "bytesRead": self.total_bytes,
                 "spilledBytes": self.spilled_bytes,
+                "retries": self.total_retries,
+                "retryWaitSecs": round(self.total_retry_wait_s, 4),
+                "quarantinedRecords": self.quarantined_records,
+                "quarantinedRows": self.quarantined_rows,
+                "checkpointSaves": self.checkpoint_saves,
+                "checkpointWallSecs": round(self.checkpoint_wall_s, 4),
+                "resumed": self.resumed,
                 "passes": [p.to_json() for p in self.passes],
             }
 
@@ -407,7 +448,19 @@ class IngestProfiler:
                 f"{p.wall_s:.3f}s wall (read {p.read_s:.3f}s | transform "
                 f"{p.transform_s:.3f}s), {p.rows_per_s:,.0f} rows/s, "
                 f"overlap {p.overlap_efficiency:.0%}"
-                + (f", {p.bytes_read} bytes" if p.bytes_read else ""))
+                + (f", {p.bytes_read} bytes" if p.bytes_read else "")
+                + (f", {p.retries} retries ({p.retry_wait_s:.2f}s backoff)"
+                   if p.retries else "")
+                + (f", {p.chunks_skipped} chunks resumed-past"
+                   if p.chunks_skipped else ""))
+        if self.quarantined_records:
+            lines.append(f"  quarantined: {self.quarantined_records} "
+                         f"record(s) / {self.quarantined_rows} row(s)")
+        if self.checkpoint_saves:
+            lines.append(
+                f"  checkpoints: {self.checkpoint_saves} save(s), "
+                f"{self.checkpoint_wall_s:.3f}s"
+                + (" (resumed run)" if self.resumed else ""))
         return "\n".join(lines)
 
 

@@ -103,6 +103,25 @@ class WelfordMoments:
                     if self.mean is not None else 0.0)
         return self.m2 / denom
 
+    # -- checkpoint codec hooks (workflow/checkpoint.py) --------------------
+
+    def to_state(self) -> dict:
+        """Loss-free snapshot for checkpointing: every field is a float,
+        ndarray or None, so the persistence array-externalization encoding
+        round-trips it bit-exactly (resume parity depends on this)."""
+        return {"n": self.n, "mean": self.mean, "m2": self.m2,
+                "min": self.min, "max": self.max}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "WelfordMoments":
+        out = cls()
+        out.n = state["n"]
+        out.mean = state["mean"]
+        out.m2 = state["m2"]
+        out.min = state["min"]
+        out.max = state["max"]
+        return out
+
 
 class PearsonSketch:
     """Streaming column-vs-label Pearson: x-moments, y-moments, co-moment."""
@@ -159,6 +178,19 @@ class PearsonSketch:
         self.x.merge(other.x)
         self.y.merge(other.y)
         return self
+
+    def to_state(self) -> dict:
+        """Checkpoint snapshot (see WelfordMoments.to_state)."""
+        return {"x": self.x.to_state(), "y": self.y.to_state(),
+                "c": self.c}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "PearsonSketch":
+        out = cls()
+        out.x = WelfordMoments.from_state(state["x"])
+        out.y = WelfordMoments.from_state(state["y"])
+        out.c = state["c"]
+        return out
 
     def correlation(self) -> np.ndarray:
         """Pearson r per column, mirroring the SanityChecker host path's
@@ -230,6 +262,25 @@ class TopKSketch:
         self.offset += other.offset
         self.error = max(self.error, other.error)
         return self
+
+    def to_state(self) -> dict:
+        """Checkpoint snapshot.  Keys and [count, first_seen] pairs are
+        kept in dict insertion order: the bounded-capacity eviction picks
+        ``min`` over iteration order on ties, so order preservation keeps
+        resumed runs byte-identical to uninterrupted ones."""
+        return {"capacity": self.capacity, "offset": self.offset,
+                "error": self.error,
+                "keys": list(self.counts.keys()),
+                "entries": [list(v) for v in self.counts.values()]}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "TopKSketch":
+        out = cls(capacity=state["capacity"])
+        out.offset = int(state["offset"])
+        out.error = float(state["error"])
+        out.counts = {k: [float(c), float(f)]
+                      for k, (c, f) in zip(state["keys"], state["entries"])}
+        return out
 
     def top_k(self, k: int, min_support: float = 0.0) -> List:
         """The ``Counter.most_common(k)`` analogue: top k keys by count

@@ -1,0 +1,409 @@
+"""Chunk-level checkpoint/resume for the streaming two-pass fit.
+
+Reference: Spark gave the original TransmogrifAI lineage-based recomputation
+— a lost executor replayed its partitions from source.  The TPU port's
+out-of-core driver (workflow/streaming.py) has no lineage, so before this
+module a process kill at hour N of a long fit lost all N hours.  The fix
+exploits what the streaming-fit protocol already guarantees: per-estimator
+states are MERGEABLE MONOIDS (stages/base.py begin_fit/update_chunk/
+merge_states), so the complete progress of a reader fit pass is just
+{per-estimator state, chunks-consumed cursor} — small, serializable, and
+exact.
+
+Layout of ``checkpoint_dir``::
+
+  checkpoint.json   the manifest: format version, run fingerprint,
+                    completed passes (fitted models as persistence stage
+                    records), and the in-flight pass cursor + states
+  state-<seq>.npz   every ndarray, externalized exactly like
+                    workflow/persistence.py's arrays.npz
+
+Atomicity: each save writes a NEW ``state-<seq>.npz``, then the manifest to
+a temp file, then ``os.replace``s it over ``checkpoint.json`` — a crash at
+any byte leaves the previous checkpoint fully intact (the old npz is only
+deleted after the rename lands).
+
+What resumes where (documented in docs/robustness.md):
+
+* **mid-pass** — pure fit passes (the pre-fuse estimator layers, typically
+  the expensive first featurization pass) checkpoint every
+  ``every_chunks`` chunks; resume restores states bit-exactly and
+  fast-skips the consumed chunks (they are re-read but not re-transformed
+  or re-fitted).
+* **pass boundary** — every completed pre-fuse pass persists its fitted
+  models (persistence stage records); resume adopts them and never
+  re-runs the pass.
+* **fused pass onward** — the fused fit+materialize pass writes full-length
+  output buffers that are deliberately NOT checkpointed (they are the
+  size of the dataset); a crash there resumes from the last pass
+  boundary and re-runs the fused pass.
+
+Fingerprinting: the manifest records the reader identity (path/size/mtime
+or in-memory shape), ``chunk_rows``, and the DAG stage list.  A resume
+against a different dataset or pipeline raises
+:class:`CheckpointMismatchError` instead of silently blending two runs.
+
+The ``checkpoint.barrier`` fault-injection point (utils/faults.py) fires
+after every durable save — the crash-resume tests SIGKILL there.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..stages.base import Estimator, Model, PipelineStage
+from ..utils import faults
+from .persistence import _ArrayStore, _load_stage, _stage_record
+
+__all__ = ["StreamingCheckpointManager", "CheckpointMismatchError",
+           "ResumeState", "compute_fingerprint", "encode_fit_state",
+           "decode_fit_state", "adopt_restored_model", "CHECKPOINT_JSON",
+           "CHECKPOINT_VERSION"]
+
+CHECKPOINT_JSON = "checkpoint.json"
+CHECKPOINT_VERSION = 1
+
+
+class CheckpointMismatchError(RuntimeError):
+    """checkpoint_dir holds a checkpoint for a DIFFERENT run (other data,
+    other pipeline, other chunk geometry).  Refusing to resume beats
+    silently merging two trainings; point checkpoint_dir elsewhere or
+    clear it."""
+
+
+# ---------------------------------------------------------------------------
+# state codec — persistence-style array externalization + the small closed
+# set of sketch/accumulator types the streaming fitters use
+# ---------------------------------------------------------------------------
+
+def _stateful_types() -> Dict[str, type]:
+    """Classes with ``to_state``/``from_state`` checkpoint hooks, by name
+    (lazy: vectorizers import jax-adjacent modules)."""
+    from ..ops.vectorizers import TextStats
+    from ..utils.sketches import PearsonSketch, TopKSketch, WelfordMoments
+
+    return {"WelfordMoments": WelfordMoments, "PearsonSketch": PearsonSketch,
+            "TopKSketch": TopKSketch, "TextStats": TextStats}
+
+
+def encode_fit_state(value: Any, key: str, store: _ArrayStore) -> Any:
+    """Recursive JSON-able encoding of a streaming-fit state.
+
+    ndarrays externalize into ``store`` (bit-exact npz round trip — resume
+    parity requires it); registered sketches go through their
+    ``to_state`` hooks; dicts with non-string keys (e.g. the mode-count
+    ``{float: int}`` maps) become tagged ordered item lists.
+    """
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, np.bool_):
+        return bool(value)
+    if isinstance(value, np.integer):
+        return int(value)
+    if isinstance(value, np.floating):
+        return float(value)
+    if isinstance(value, np.ndarray):
+        return store.put(key, value)
+    types = _stateful_types()
+    name = type(value).__name__
+    if name in types and isinstance(value, types[name]):
+        return {"__state__": name,
+                "payload": encode_fit_state(value.to_state(),
+                                            f"{key}.{name}", store)}
+    if isinstance(value, np.random.Generator):
+        # the SanityChecker's row-sample stream must CONTINUE, not restart:
+        # persist the bit generator's exact position
+        return {"__rng__": {"bg": type(value.bit_generator).__name__,
+                            "state": value.bit_generator.state}}
+    from ..ops.vector_metadata import VectorMetadata
+
+    if isinstance(value, VectorMetadata):
+        return {"__vmeta__": value.to_json()}
+    if isinstance(value, dict):
+        if all(isinstance(k, str) for k in value):
+            return {k: encode_fit_state(v, f"{key}.{k}", store)
+                    for k, v in value.items()}
+        return {"__items__": [
+            [encode_fit_state(k, f"{key}.k{i}", store),
+             encode_fit_state(v, f"{key}.v{i}", store)]
+            for i, (k, v) in enumerate(value.items())]}
+    if isinstance(value, (list, tuple)):
+        return [encode_fit_state(v, f"{key}[{i}]", store)
+                for i, v in enumerate(value)]
+    raise TypeError(
+        f"streaming-fit state at {key!r} holds a {type(value).__name__}, "
+        f"which the checkpoint codec cannot persist; give the estimator "
+        f"export_fit_state/import_fit_state hooks (stages/base.py) or the "
+        f"type to_state/from_state")
+
+
+def decode_fit_state(value: Any, arrays) -> Any:
+    if isinstance(value, dict):
+        if "__state__" in value:
+            cls = _stateful_types()[value["__state__"]]
+            return cls.from_state(decode_fit_state(value["payload"], arrays))
+        if "__rng__" in value:
+            spec = value["__rng__"]
+            bg = getattr(np.random, spec["bg"])()
+            bg.state = spec["state"]
+            return np.random.Generator(bg)
+        if "__vmeta__" in value:
+            from ..ops.vector_metadata import VectorMetadata
+
+            return VectorMetadata.from_json(value["__vmeta__"])
+        if "__array__" in value:
+            return arrays[value["__array__"]]
+        if "__items__" in value:
+            return {decode_fit_state(k, arrays): decode_fit_state(v, arrays)
+                    for k, v in value["__items__"]}
+        return {k: decode_fit_state(v, arrays) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode_fit_state(v, arrays) for v in value]
+    return value
+
+
+# ---------------------------------------------------------------------------
+# run fingerprint
+# ---------------------------------------------------------------------------
+
+def _describe_reader(reader) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"class": type(reader).__name__}
+    for attr in ("path", "csv_path"):
+        path = getattr(reader, attr, None)
+        if isinstance(path, str):
+            out["path"] = path
+            try:
+                st = os.stat(path)
+                out["size"] = st.st_size
+                out["mtime"] = int(st.st_mtime)
+            except OSError:
+                pass
+            return out
+    df = getattr(reader, "df", None)
+    if df is not None:
+        out["rows"] = int(len(df))
+        out["columns"] = [str(c) for c in df.columns]
+    recs = getattr(reader, "records", None)
+    if isinstance(recs, list):
+        out["rows"] = len(recs)
+    return out
+
+
+def compute_fingerprint(reader, raw_features, layers,
+                        chunk_rows: int) -> Dict[str, Any]:
+    """Identity of a streaming train: same reader bytes, same chunk
+    geometry, same DAG → same pass/chunk/state sequence, so a checkpoint
+    from one run is exact for the other."""
+    return {
+        "chunkRows": int(chunk_rows),
+        "reader": _describe_reader(reader),
+        "rawFeatures": sorted(f.name for f in raw_features),
+        "stages": [f"{s.uid}:{type(s).__name__}:{s.get_output().name}"
+                   for layer in layers for s in layer],
+    }
+
+
+# ---------------------------------------------------------------------------
+# resume state + manager
+# ---------------------------------------------------------------------------
+
+class ResumeState:
+    """Decoded checkpoint contents handed to the streaming driver."""
+
+    def __init__(self):
+        #: pass index -> {"rows": int, "models": {uid: Model}}
+        self.completed: Dict[int, Dict[str, Any]] = {}
+        #: in-flight pass: {"pass", "label", "chunks_done", "rows_done",
+        #: "states": {uid: encoded payload}}; states decode lazily per
+        #: estimator via ``states_for`` (import hooks need the estimator)
+        self.current: Optional[Dict[str, Any]] = None
+        self._arrays = {}
+
+    def states_for(self, ests: List[Estimator]) -> Dict[str, Any]:
+        """Restore the in-flight states for ``ests`` through each
+        estimator's ``import_fit_state`` hook."""
+        raw = (self.current or {}).get("states", {})
+        out = {}
+        for est in ests:
+            if est.uid not in raw:
+                raise CheckpointMismatchError(
+                    f"checkpoint mid-pass state is missing estimator "
+                    f"{est.uid}")
+            out[est.uid] = est.import_fit_state(
+                decode_fit_state(raw[est.uid], self._arrays))
+        return out
+
+
+class StreamingCheckpointManager:
+    """Owns ``checkpoint_dir`` for one streaming train.
+
+    ``save_progress`` persists the in-flight pass (cursor + states) every
+    call; ``complete_pass`` persists a finished pass's fitted models and
+    clears the in-flight record; ``finish`` removes the checkpoint once
+    the train succeeded (a stale checkpoint must not resurrect into the
+    next run).  All writes are atomic (tmp + rename).
+    """
+
+    def __init__(self, directory: str, fingerprint: Dict[str, Any],
+                 every_chunks: int = 16):
+        if every_chunks < 1:
+            raise ValueError("checkpoint every_chunks must be >= 1")
+        self.directory = directory
+        self.fingerprint = fingerprint
+        self.every_chunks = int(every_chunks)
+        self.saves = 0
+        self._seq = 0
+        self._completed: Dict[int, Dict[str, Any]] = {}  # manifest records
+        self._current: Optional[Dict[str, Any]] = None
+        os.makedirs(directory, exist_ok=True)
+
+    # -- resume -------------------------------------------------------------
+
+    def load(self) -> Optional[ResumeState]:
+        """The previous run's checkpoint, or None on a fresh directory.
+        Also primes this manager's in-memory manifest so subsequent saves
+        carry the restored passes forward."""
+        path = os.path.join(self.directory, CHECKPOINT_JSON)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("version") != CHECKPOINT_VERSION:
+            raise CheckpointMismatchError(
+                f"checkpoint format v{doc.get('version')} != "
+                f"v{CHECKPOINT_VERSION}")
+        if doc.get("fingerprint") != self.fingerprint:
+            raise CheckpointMismatchError(
+                f"checkpoint in {self.directory!r} belongs to a different "
+                f"run (reader/pipeline/chunk_rows changed); clear the "
+                f"directory or point checkpoint_dir elsewhere.\n"
+                f"  saved:   {json.dumps(doc.get('fingerprint'))}\n"
+                f"  current: {json.dumps(self.fingerprint)}")
+        arrays = {}
+        npz = doc.get("arrays")
+        if npz:
+            with np.load(os.path.join(self.directory, npz),
+                         allow_pickle=True) as z:
+                arrays = {k: z[k] for k in z.files}
+        state = ResumeState()
+        state._arrays = arrays
+        for rec in doc.get("completedPasses", []):
+            models = {uid: _load_stage(srec, arrays)
+                      for uid, srec in rec["models"].items()}
+            state.completed[int(rec["pass"])] = {
+                "rows": int(rec["rows"]), "label": rec.get("label"),
+                "models": models}
+            # carry forward as LIVE stages: future saves re-encode them
+            # against their own array store (raw records would dangle
+            # references into the superseded npz generation)
+            self._completed[int(rec["pass"])] = {
+                "pass": int(rec["pass"]), "rows": int(rec["rows"]),
+                "label": rec.get("label"), "live_models": models}
+        state.current = doc.get("current")
+        self._seq = int(doc.get("seq", 0))
+        return state
+
+    # -- save ---------------------------------------------------------------
+
+    def _write(self) -> None:
+        """Re-encode the manifest + arrays and land them atomically."""
+        self._seq += 1
+        store = _ArrayStore()
+        doc: Dict[str, Any] = {
+            "version": CHECKPOINT_VERSION,
+            "fingerprint": self.fingerprint,
+            "seq": self._seq,
+            "completedPasses": [],
+            "current": None,
+        }
+        # completed-pass model records re-encode against the fresh store
+        # (records are small: vocabs, fills, keep-indices)
+        for pi in sorted(self._completed):
+            rec = self._completed[pi]
+            doc["completedPasses"].append({
+                "pass": pi, "rows": rec["rows"], "label": rec.get("label"),
+                "models": {uid: _stage_record(m, store)
+                           for uid, m in rec["live_models"].items()},
+            })
+        if self._current is not None:
+            cur = dict(self._current)
+            cur["states"] = {
+                uid: encode_fit_state(payload, f"cur.{uid}", store)
+                for uid, payload in cur.pop("live_states").items()}
+            doc["current"] = cur
+        npz_name = f"state-{self._seq}.npz"
+        old = [n for n in os.listdir(self.directory)
+               if n.startswith("state-") and n.endswith(".npz")]
+        if store.arrays:
+            np.savez_compressed(os.path.join(self.directory, npz_name),
+                                **store.arrays)
+            doc["arrays"] = npz_name
+        tmp = os.path.join(self.directory, CHECKPOINT_JSON + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.directory, CHECKPOINT_JSON))
+        for n in old:  # previous npz generations, only after the rename
+            if n != npz_name:
+                try:
+                    os.unlink(os.path.join(self.directory, n))
+                except OSError:  # pragma: no cover
+                    pass
+        self.saves += 1
+        faults.fire("checkpoint.barrier", index=self.saves - 1)
+
+    def save_progress(self, pass_index: int, label: str, chunks_done: int,
+                      rows_done: int, ests: List[Estimator],
+                      states: Dict[str, Any]) -> None:
+        """Persist the in-flight pass: cursor + per-estimator states
+        (through each estimator's ``export_fit_state`` hook)."""
+        self._current = {
+            "pass": int(pass_index), "label": label,
+            "chunks_done": int(chunks_done), "rows_done": int(rows_done),
+            "live_states": {est.uid: est.export_fit_state(states[est.uid])
+                            for est in ests},
+        }
+        self._write()
+
+    def complete_pass(self, pass_index: int, label: str, rows: int,
+                      models: Dict[str, Model]) -> None:
+        """Persist a finished pass's fitted models; clears the in-flight
+        record (the cursor is meaningless once the pass is done)."""
+        self._completed[int(pass_index)] = {
+            "pass": int(pass_index), "label": label, "rows": int(rows),
+            "live_models": models,
+        }
+        self._current = None
+        self._write()
+
+    def finish(self) -> None:
+        """The train succeeded: remove the checkpoint so a later run in the
+        same directory starts fresh instead of resuming a finished fit."""
+        for n in (CHECKPOINT_JSON, CHECKPOINT_JSON + ".tmp"):
+            try:
+                os.unlink(os.path.join(self.directory, n))
+            except OSError:
+                pass
+        for n in os.listdir(self.directory):
+            if n.startswith("state-") and n.endswith(".npz"):
+                try:
+                    os.unlink(os.path.join(self.directory, n))
+                except OSError:  # pragma: no cover
+                    pass
+
+
+def adopt_restored_model(est: Estimator, model: PipelineStage) -> Model:
+    """Wire a checkpoint-restored model to answer for ``est`` in the live
+    DAG — the resume analogue of ``Estimator.adopt_model``, except the
+    restored model's fitted METADATA is authoritative (the estimator never
+    ran in this process, so its metadata dict is empty)."""
+    model.uid = est.uid
+    model.operation_name = est.operation_name
+    model.input_features = list(est.input_features)
+    model._output_feature = est._output_feature
+    est.metadata = model.metadata  # summaries travel with the fit
+    return model

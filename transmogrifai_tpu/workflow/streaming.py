@@ -51,6 +51,15 @@ chain's INPUT columns.  When the retention point's chain is fed directly
 by raw object columns (a DAG with a single estimator layer) the
 retention approaches the raw dataset's size — no worse than in-core, and
 still one reader pass cheaper.
+
+Fault tolerance (docs/robustness.md): when the reader carries a
+``ResilienceConfig`` (``reader.with_resilience(...)``), every pass's chunk
+stream is wrapped in the retry/backoff ``RetryingChunkStream`` and
+bad-record quarantine counts land in the ingest profiler; with
+``checkpoint_dir`` set, pure fit passes checkpoint their mergeable states
+every ``checkpoint_every`` chunks and completed passes persist their
+fitted models, so a killed process resumes instead of refitting
+(workflow/checkpoint.py).
 """
 from __future__ import annotations
 
@@ -306,10 +315,18 @@ def fit_dag_streaming(
     fitted_substitutes: Optional[Dict[str, Model]] = None,
     profiler: Optional[PlanProfiler] = None,
     prefetch: int = 2,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 16,
 ) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler]:
     """Fit ``dag`` from chunked ingestion; returns (fitted stages in topo
     order, final dataset equivalent to the in-core executor's with the
-    same ``keep``, ingest counters)."""
+    same ``keep``, ingest counters).
+
+    ``checkpoint_dir`` enables chunk-level checkpoint/resume: pure fit
+    passes persist their mergeable states every ``checkpoint_every``
+    chunks, completed passes persist their fitted models, and a rerun
+    against the same directory resumes from the last durable point
+    (workflow/checkpoint.py has the recovery matrix)."""
     from .dag import StagesDAG, fit_and_transform_dag
 
     if chunk_rows <= 0:
@@ -321,6 +338,29 @@ def fit_dag_streaming(
     ingest = IngestProfiler(chunk_rows)
     if profiler is not None:
         profiler.ingest = ingest
+
+    manager = None
+    resume = None
+    if checkpoint_dir is not None:
+        from .checkpoint import (StreamingCheckpointManager,
+                                 compute_fingerprint)
+
+        manager = StreamingCheckpointManager(
+            checkpoint_dir,
+            compute_fingerprint(reader, raw_features, layers, chunk_rows),
+            every_chunks=checkpoint_every)
+        resume = manager.load()
+        if resume is not None:
+            ingest.resumed = True
+
+    rcfg = getattr(reader, "resilience", None)
+    sink = rcfg.sink() if (rcfg is not None and rcfg.quarantines) else None
+    q0_records = sink.count if sink is not None else 0
+    q0_rows = sink.rows if sink is not None else 0
+
+    def _note_checkpoint(t0: float) -> None:
+        ingest.checkpoint_saves = manager.saves
+        ingest.checkpoint_wall_s += time.perf_counter() - t0
 
     raw_names = {f.name for f in raw_features}
     out_stage: Dict[str, PipelineStage] = {
@@ -355,20 +395,41 @@ def fit_dag_streaming(
 
     def run_reader_pass(label: str, ordered: List[PipelineStage],
                         final_needed: Set[str], per_chunk,
-                        keep_unknown: bool) -> int:
+                        keep_unknown: bool, skip_chunks: int = 0,
+                        on_chunk=None) -> int:
         """One prefetch-overlapped pass over the reader's chunks: transform
         through ``ordered`` (liveness-pruned), then hand the chunk to
-        ``per_chunk``.  Returns the row count."""
+        ``per_chunk``.  Returns the row count.
+
+        With a reader-side retry policy the chunk stream is wrapped in the
+        resilience layer's ``RetryingChunkStream`` (transient IO errors
+        back off and re-read; the wrapper re-skips delivered chunks
+        exactly).  ``skip_chunks`` fast-skips a checkpoint resume's
+        already-consumed chunks — read, counted, but neither transformed
+        nor handed to ``per_chunk``.  ``on_chunk(idx, rows_so_far)`` runs
+        after each consumed chunk (the checkpoint cadence hook)."""
         pass_stats = ingest.begin_pass(label)
         needed_after = _liveness(ordered, final_needed)
-        source = _TimedChunks(
-            reader.iter_chunks(raw_features, chunk_rows), pass_stats)
+        if rcfg is not None and rcfg.retry is not None:
+            from ..readers.resilience import RetryingChunkStream
+
+            stream = RetryingChunkStream(
+                lambda: reader.iter_chunks(raw_features, chunk_rows),
+                rcfg.retry, on_retry=pass_stats.note_retry)
+        else:
+            stream = reader.iter_chunks(raw_features, chunk_rows)
+        source = _TimedChunks(stream, pass_stats)
         batcher = AsyncBatcher(source, depth=prefetch)
         rows = 0
         chunk_idx = 0
         t_pass = time.perf_counter()
         try:
             for chunk in batcher:
+                if chunk_idx < skip_chunks:
+                    rows += len(chunk)
+                    pass_stats.chunks_skipped += 1
+                    chunk_idx += 1
+                    continue
                 t0 = time.perf_counter()
                 ds = chunk
                 if chunk_idx == 0 and keep_unknown:
@@ -384,6 +445,8 @@ def fit_dag_streaming(
                 rows += len(chunk)
                 pass_stats.note_transform(chunk_idx,
                                           time.perf_counter() - t0)
+                if on_chunk is not None:
+                    on_chunk(chunk_idx, rows)
                 chunk_idx += 1
         finally:
             batcher.close()
@@ -458,11 +521,32 @@ def fit_dag_streaming(
         # estimator layer fuses on its own pass.
         fuse_at = est_idxs[1] if len(est_idxs) >= 2 else est_idxs[0]
 
-        # plain reader fit passes for estimator layers before the fuse
-        for li in est_idxs:
-            if li >= fuse_at:
-                break
+        # plain reader fit passes for estimator layers before the fuse —
+        # the checkpointable passes: their whole progress is the mergeable
+        # states + a chunk cursor (workflow/checkpoint.py)
+        prefuse = [li for li in est_idxs if li < fuse_at]
+        for pass_idx, li in enumerate(prefuse):
             ests = layer_ests(li)
+            names = ", ".join(type(e).__name__ for e in ests)
+            label = f"fit[layer {li}: {names}]"
+            if resume is not None and pass_idx in resume.completed:
+                # pass-boundary resume: adopt the persisted models, never
+                # re-read the data for this layer
+                from .checkpoint import (CheckpointMismatchError,
+                                         adopt_restored_model)
+
+                done = resume.completed[pass_idx]
+                for est in ests:
+                    model = done["models"].get(est.uid)
+                    if model is None:
+                        raise CheckpointMismatchError(
+                            f"checkpoint pass {pass_idx} is missing a "
+                            f"model for estimator {est.uid}")
+                    fitted_by_uid[est.uid] = adopt_restored_model(est, model)
+                    stage_kind[est.uid] = "fit-restored"
+                if total_rows is None:
+                    total_rows = done["rows"]
+                continue
             target_inputs: Set[str] = set()
             for est in ests:
                 target_inputs |= set(est.input_names)
@@ -470,13 +554,33 @@ def fit_dag_streaming(
             ordered = [s for lj in range(li) for s in prefix[lj]
                        if s.uid in pass_uids]
             states = {est.uid: est.begin_fit() for est in ests}
-            names = ", ".join(type(e).__name__ for e in ests)
+            skip = 0
+            if (resume is not None and resume.current is not None
+                    and int(resume.current["pass"]) == pass_idx):
+                # mid-pass resume: bit-exact states + fast-skip cursor
+                states = resume.states_for(ests)
+                skip = int(resume.current["chunks_done"])
+            on_chunk = None
+            if manager is not None:
+                def on_chunk(ci, rows_done, _pi=pass_idx, _lb=label,
+                             _e=ests, _st=states):
+                    if (ci + 1) % manager.every_chunks == 0:
+                        t0 = time.perf_counter()
+                        manager.save_progress(_pi, _lb, ci + 1, rows_done,
+                                              _e, _st)
+                        _note_checkpoint(t0)
             rows = run_reader_pass(
-                f"fit[layer {li}: {names}]", ordered, set(target_inputs),
+                label, ordered, set(target_inputs),
                 lambda ds, _i, e=ests, st=states: update_states(e, st, ds),
-                keep_unknown=False)
+                keep_unknown=False, skip_chunks=skip, on_chunk=on_chunk)
             total_rows = rows if total_rows is None else total_rows
             finish_layer(ests, states)
+            if manager is not None:
+                t0 = time.perf_counter()
+                manager.complete_pass(
+                    pass_idx, label, rows,
+                    {est.uid: fitted_by_uid[est.uid] for est in ests})
+                _note_checkpoint(t0)
 
         # -- fused retention pass at ``fuse_at`` ---------------------------
         fuse_ests = layer_ests(fuse_at)
@@ -676,4 +780,11 @@ def fit_dag_streaming(
         keep_set = set(keep)
         data = data.select([c for c in data.names()
                             if c in keep_set or c not in known_universe])
+    if sink is not None:
+        ingest.quarantined_records = sink.count - q0_records
+        ingest.quarantined_rows = sink.rows - q0_rows
+    if manager is not None:
+        # success: a finished train's checkpoint must not resurrect into
+        # the next run in the same directory
+        manager.finish()
     return fitted, data, ingest

@@ -180,7 +180,9 @@ class OpWorkflow(_WorkflowCore):
     def train(self, profile: bool = False,
               chunk_rows: Optional[int] = None,
               prefetch_chunks: int = 2,
-              validate: bool = True) -> "OpWorkflowModel":
+              validate: bool = True,
+              checkpoint_dir: Optional[str] = None,
+              checkpoint_every_chunks: int = 16) -> "OpWorkflowModel":
         """Fit the workflow.  ``profile=True`` additionally records a
         per-stage execution profile (wall time, rows, columns
         added/dropped, device launches) on the returned model as
@@ -205,12 +207,29 @@ class OpWorkflow(_WorkflowCore):
         (default) keeps today's in-core path byte-identical.
         ``prefetch_chunks`` bounds the reader thread's parse-ahead depth
         (chunk k+1 parses while chunk k transforms).
+
+        ``checkpoint_dir`` (out-of-core path only) enables chunk-level
+        checkpoint/resume: streaming-fit states + a chunks-consumed cursor
+        persist atomically every ``checkpoint_every_chunks`` chunks, and
+        re-running the same train against the same directory after a
+        crash resumes from the last durable point instead of refitting
+        (docs/robustness.md; workflow/checkpoint.py for what resumes
+        where).  A checkpoint from a different reader/pipeline/chunk
+        geometry raises ``CheckpointMismatchError`` rather than silently
+        blending runs.
         """
         from ..utils.profiling import OpStep, with_job_group
 
         if chunk_rows is not None:
             return self._train_chunked(chunk_rows, prefetch_chunks, profile,
-                                       validate=validate)
+                                       validate=validate,
+                                       checkpoint_dir=checkpoint_dir,
+                                       checkpoint_every=checkpoint_every_chunks)
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "checkpoint_dir requires the out-of-core path — pass "
+                "chunk_rows=k as well (the in-core fit has no chunk "
+                "boundaries to checkpoint at)")
         with with_job_group(OpStep.DataReadingAndFiltering):
             data = self.generate_raw_data()
             filter_results = None
@@ -271,7 +290,9 @@ class OpWorkflow(_WorkflowCore):
 
     def _train_chunked(self, chunk_rows: int, prefetch: int,
                        profile: bool,
-                       validate: bool = True) -> "OpWorkflowModel":
+                       validate: bool = True,
+                       checkpoint_dir: Optional[str] = None,
+                       checkpoint_every: int = 16) -> "OpWorkflowModel":
         """The out-of-core train: chunked ingestion + streaming two-pass
         fit + in-core tail (see workflow/streaming.py)."""
         from ..utils.profiling import OpStep, PlanProfiler, with_job_group
@@ -304,7 +325,9 @@ class OpWorkflow(_WorkflowCore):
                     dag, self.reader, self.raw_features(), chunk_rows,
                     keep=self._train_keep_columns(),
                     fitted_substitutes=dict(self._model_stages),
-                    profiler=profiler, prefetch=prefetch)
+                    profiler=profiler, prefetch=prefetch,
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every)
         finally:
             for s, prev in meshed_stages:
                 s.with_mesh(prev)
