@@ -178,38 +178,31 @@ def _baselines():
 
 
 def _cost_history() -> dict:
-    try:
-        with open(COST_HISTORY) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return {}
+    from transmogrifai_tpu.utils.jsonio import read_json_tolerant
+    return read_json_tolerant(COST_HISTORY, {})
 
 
 def _record_cost(name: str, measured_s: float, cold: bool,
                  sig: str = "") -> None:
-    """Self-updating measured-cost history (the next run's estimates).
+    """Self-updating measured-cost history (the next run's estimates),
+    written ATOMICALLY (tmp + os.replace — a killed bench can't leave
+    truncated JSON) and preserving the learned cost model's
+    ``stage_observations`` key (tuning/costmodel.py shares this file).
     ``sig`` encodes the workload shape/params: a history entry recorded
     under a different signature is IGNORED by ``_estimate`` (a config
     growth like r5's 8x xgb_wide bump must not inherit the small-shape
     measurement)."""
-    hist = _cost_history()
-    hist[name] = {"measured_s": round(measured_s, 1), "cold": cold,
-                  "sig": sig, "recorded_unix": int(time.time())}
-    try:
-        with open(COST_HISTORY, "w") as f:
-            json.dump(hist, f, indent=2, sort_keys=True)
-            f.write("\n")
-    except OSError:
-        pass
+    from transmogrifai_tpu.tuning.budget import record_measurement
+    record_measurement(COST_HISTORY, name, measured_s, cold, sig)
 
 
 def _estimate(name: str, fallback_s: float, sig: str = "") -> tuple:
     """(estimate_s, source) — measured history of the same config AND the
-    same workload signature if present, else the stated fallback."""
-    h = _cost_history().get(name)
-    if h and "measured_s" in h and h.get("sig", "") == sig:
-        return float(h["measured_s"]), "measured_history"
-    return fallback_s, "assumed"
+    same workload signature if present, else the stated fallback.
+    (Measured-history tier of the BenchBudgeter; kept as a module
+    function for the headline-reserve path and the test contract.)"""
+    from transmogrifai_tpu.tuning.budget import estimate_from_history
+    return estimate_from_history(COST_HISTORY, name, fallback_s, sig)
 
 
 def run_titanic() -> dict:
@@ -314,26 +307,27 @@ def main():
     # the check and leave the mandatory headline to be killed mid-flight).
     # HEADLINE_* are the single source for both the reserve and the
     # actual config call below.
-    if os.environ.get("TMOG_BENCH_SKIP_1M_DEFAULT") == "1":
-        headline_reserve = 0.0
-    else:
-        est_4d, _src = _estimate(
+    # Budget decisions go through the tuning/ BenchBudgeter: estimates are
+    # measured history of the same config+signature first, then the
+    # learned cost model's whole-pipeline prediction at the config's
+    # shape, then the stated assumption — with the source always recorded.
+    from transmogrifai_tpu.tuning.budget import BenchBudgeter
+
+    budgeter = BenchBudgeter(COST_HISTORY, budget, t0=_T0)
+    if os.environ.get("TMOG_BENCH_SKIP_1M_DEFAULT") != "1":
+        est_4d, _src = budgeter.estimate(
             HEADLINE_NAME, HEADLINE_FALLBACK_S,
             f"{HEADLINE_ROWS}x{HEADLINE_COLS}:default")
-        headline_reserve = min(est_4d, 0.5 * budget)
+        budgeter.set_reserve(min(est_4d, 0.5 * budget))
 
     def over_budget(name: str, fallback_estimate_s: float,
                     sig: str = "") -> bool:
-        est, src = _estimate(name, fallback_estimate_s, sig)
-        if _elapsed() + est > budget - headline_reserve:
-            results[name] = {
-                "skipped": f"estimated {est:.0f}s ({src}) exceeds remaining "
-                           f"budget "
-                           f"({max(0.0, budget - headline_reserve - _elapsed()):.0f}s "
-                           f"of {budget:.0f}s after reserving "
-                           f"{headline_reserve:.0f}s for the unconditional "
-                           f"1M default-grid headline)"}
-            _log(f"{name}: SKIPPED (budget; estimate {est:.0f}s from {src})")
+        reason = budgeter.should_skip(name, fallback_estimate_s, sig)
+        if reason is not None:
+            results[name] = {"skipped": reason}
+            d = budgeter.decisions[name]
+            _log(f"{name}: SKIPPED (budget; estimate "
+                 f"{d['estimate_s']:.0f}s from {d['source']})")
             return True
         return False
 
@@ -465,7 +459,7 @@ def main():
         _log("default_grid_1m_x_500: SKIPPED (diagnostic override)")
     else:
         sig = f"{HEADLINE_ROWS}x{HEADLINE_COLS}:default"
-        est, src = _estimate(HEADLINE_NAME, HEADLINE_FALLBACK_S, sig)
+        est, src = budgeter.estimate(HEADLINE_NAME, HEADLINE_FALLBACK_S, sig)
         if _elapsed() + est > budget:
             _log(f"{HEADLINE_NAME}: HARD ALARM — projection {est:.0f}s "
                  f"({src}) exceeds remaining budget "
@@ -499,7 +493,8 @@ def main():
             _log(f"{HEADLINE_NAME}: FAILED — {err['error'][:200]}")
             flush()
 
-
+    # budget audit trail: every run/skip decision + estimate source
+    results["_budget"] = budgeter.to_json()
     flush()
 
 

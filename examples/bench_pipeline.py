@@ -276,9 +276,8 @@ def main():
         "configs": configs,
     }
     dest = os.path.join(_ROOT, "benchmarks", "pipeline_latest.json")
-    with open(dest, "w") as f:
-        json.dump(out, f, indent=2)
-        f.write("\n")
+    from transmogrifai_tpu.utils.jsonio import write_json_atomic
+    write_json_atomic(dest, out)
     print(json.dumps(out), flush=True)
 
 

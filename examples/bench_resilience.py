@@ -207,9 +207,8 @@ def main() -> None:
     print(json.dumps(out), flush=True)
     if not args.smoke:
         dest = os.path.join(_ROOT, "benchmarks", "resilience_latest.json")
-        with open(dest, "w") as f:
-            json.dump(out, f, indent=2)
-            f.write("\n")
+        from transmogrifai_tpu.utils.jsonio import write_json_atomic
+        write_json_atomic(dest, out)
 
 
 if __name__ == "__main__":

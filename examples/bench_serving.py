@@ -131,8 +131,8 @@ def run(out_path: str) -> dict:
         "compiles": snap["compileCache"]["totals"]["compiles"],
         "compileHits": snap["compileCache"]["totals"]["hits"],
     }
-    with open(out_path, "w") as f:
-        json.dump(record, f, indent=2)
+    from transmogrifai_tpu.utils.jsonio import write_json_atomic
+    write_json_atomic(out_path, record)
     return record
 
 

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Adaptive-selection benchmark: full sweep vs successive halving, plus a
+held-out validation of the learned cost model.
+
+Three trains on one seeded planted-signal binary workload (the 100x-scale
+bench shape by default):
+
+1. ``warmup/full`` — a full-sweep train that loads compile caches AND
+   seeds the cost history with one run of stage observations.
+2. ``full`` — the timed full-sweep train; its per-stage walls are the
+   HELD-OUT set the cost model (fitted from run 1's history) is scored
+   against (within-2x fraction).
+3. ``halving`` — the timed ``train(tuner=Tuner(strategy="halving"))``
+   train.
+
+Emits one JSON line and writes ``benchmarks/tuning_latest.json``
+(atomic) with candidate-seconds for both sweeps, the winner AuPR delta,
+the rung schedule, and the cost-model hit rate.  Acceptance targets
+(ISSUE 6): halving within AuPR tolerance of the full winner at >=2x
+fewer candidate-seconds; cost model within 2x on >=80% of held-out
+stage walls.
+
+Usage:
+  python examples/bench_tuning.py [--rows N] [--cols D] [--smoke]
+
+``--smoke`` runs a small shape with relaxed assertions and writes no
+json (the scripts/tier1.sh wiring); its cost history goes to a temp file
+so smoke runs never churn the repo's benchmarks/cost_history.json.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+#: winner-quality tolerance: halving's holdout AuPR may trail the full
+#: sweep's by at most this much (documented in docs/tuning.md)
+AUPR_TOLERANCE = 0.02
+
+
+def make_data(rows: int, cols: int, seed: int = 11):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    beta = np.zeros(cols, np.float32)
+    informative = rng.choice(cols, max(3, cols // 5), replace=False)
+    beta[informative] = rng.normal(size=len(informative)) * 1.5
+    z = X @ beta + 0.5 * rng.normal(size=rows).astype(np.float32)
+    y = (1 / (1 + np.exp(-z)) > rng.random(rows)).astype(np.float32)
+    df = pd.DataFrame(X, columns=[f"f{j}" for j in range(cols)])
+    df.insert(0, "label", y)
+    return df
+
+
+def grid_models(smoke: bool):
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpRandomForestClassifier,
+    )
+    from transmogrifai_tpu.selector import grid
+
+    if smoke:
+        return [
+            (OpLogisticRegression(), grid(reg_param=[0.001, 0.01, 0.1])),
+            (OpRandomForestClassifier(num_trees=10),
+             grid(max_depth=[3, 6], min_instances_per_node=[10, 100])),
+        ]
+    return [
+        (OpLogisticRegression(),
+         grid(reg_param=[0.001, 0.01, 0.1, 0.3],
+              elastic_net_param=[0.0, 0.5])),
+        (OpRandomForestClassifier(num_trees=20),
+         grid(max_depth=[3, 6], min_instances_per_node=[10, 100],
+              min_info_gain=[0.001, 0.01])),
+    ]
+
+
+def build_workflow(df, smoke: bool):
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    preds = [FeatureBuilder.Real(c).as_predictor() for c in df.columns[1:]]
+    features = transmogrify(preds)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        label, features).get_output()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, models_and_parameters=grid_models(smoke))
+    prediction = selector.set_input(label, checked).get_output()
+    return (OpWorkflow().set_result_features(prediction)
+            .set_input_data(df)), selector
+
+
+def _selector_stage_wall(model) -> float:
+    """The ModelSelector stage's wall from the train profile — the
+    candidate-seconds of that train's sweep (+ winner refit, paid by both
+    strategies)."""
+    for sp in model.train_profile.stages:
+        if sp.op == "ModelSelector":
+            return sp.wall_s
+    return 0.0
+
+
+def _train(df, smoke: bool, tuner=None):
+    from transmogrifai_tpu.evaluators import Evaluators
+
+    wf, selector = build_workflow(df, smoke)
+    t0 = time.perf_counter()
+    model = wf.train(profile=True, tuner=tuner)
+    wall = time.perf_counter() - t0
+    _, metrics = model.score_and_evaluate(
+        Evaluators.BinaryClassification.auPR())
+    summ = next((s.metadata["model_selector_summary"] for s in model.stages
+                 if "model_selector_summary" in s.metadata), {})
+    sel_meta = next((s.metadata for s in model.stages
+                     if "model_selector_summary" in s.metadata), {})
+    return {
+        "wall_s": round(wall, 2),
+        "selector_stage_s": round(_selector_stage_wall(model), 2),
+        "aupr": round(float(metrics["AuPR"]), 4),
+        "winner": {"model": summ.get("bestModelType"),
+                   "params": summ.get("bestModelParams")},
+        "candidates": len(summ.get("validationResults", [])),
+        "halving_schedule": sel_meta.get("halving_schedule"),
+    }, model
+
+
+def run(rows: int, cols: int, smoke: bool = False) -> dict:
+    from transmogrifai_tpu.tuning import (CostModel, Tuner,
+                                          default_history_path,
+                                          load_observations,
+                                          observations_from_profiler)
+    from transmogrifai_tpu.utils.profiling import backend_name
+
+    df = make_data(rows, cols)
+
+    # run 1: warmup/full — compile caches + one run of cost history
+    history_path = default_history_path()
+    _warm, _ = _train(df, smoke)
+
+    # run 2: the timed full sweep; held-out set for the cost model
+    full, full_model = _train(df, smoke)
+
+    # run 3: the timed halving sweep (the smoke shape is too small for
+    # the default 2048-row minimum rung — shrink it so the ladder exists)
+    from transmogrifai_tpu.tuning import HalvingConfig
+
+    tuner = Tuner(strategy="halving",
+                  halving=HalvingConfig(min_rows=256) if smoke else None)
+    halving, halving_model = _train(df, smoke, tuner=tuner)
+
+    # cost model: fitted from history as of run 1+2, scored on run 2's
+    # own observations re-derived from its profile (held-out in the sense
+    # that the model never saw which prediction it would be asked for —
+    # the fit pools history across runs of the same stage kinds)
+    cm = CostModel.from_history(history_path)
+    held_out = observations_from_profiler(full_model.train_profile,
+                                          backend=backend_name())
+    frac, n_stages = cm.within_factor(held_out, factor=2.0)
+
+    ratio = (full["selector_stage_s"] / halving["selector_stage_s"]
+             if halving["selector_stage_s"] else 0.0)
+    aupr_delta = round(full["aupr"] - halving["aupr"], 4)
+    out = {
+        "metric": "tuning_halving_vs_full",
+        "rows": rows, "cols": cols,
+        "unit": "s",
+        "value": halving["selector_stage_s"],
+        "full": full,
+        "halving": halving,
+        "candidate_seconds_full": full["selector_stage_s"],
+        "candidate_seconds_halving": halving["selector_stage_s"],
+        "candidate_seconds_ratio": round(ratio, 2),
+        "aupr_delta": aupr_delta,
+        "aupr_tolerance": AUPR_TOLERANCE,
+        "winner_match": full["winner"] == halving["winner"],
+        "meets_2x_fewer_candidate_seconds": ratio >= 2.0,
+        "meets_aupr_tolerance": abs(aupr_delta) <= AUPR_TOLERANCE,
+        "cost_model": {
+            "within_2x_fraction": round(frac, 3),
+            "n_stages": n_stages,
+            "n_history_observations": len(load_observations(history_path)),
+            "meets_80pct_within_2x": frac >= 0.8,
+        },
+        "backend": backend_name(),
+    }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--cols", type=int, default=20)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small shape, relaxed gates, no json written, "
+                         "temp cost history")
+    args = ap.parse_args()
+    if args.smoke:
+        args.rows, args.cols = 4000, 8
+        # smoke must not churn the repo's shared cost history
+        fd, tmp = tempfile.mkstemp(prefix="tmog_tuning_smoke_",
+                                   suffix=".json")
+        os.close(fd)
+        os.environ["TMOG_COST_HISTORY"] = tmp
+
+    out = run(args.rows, args.cols, smoke=args.smoke)
+
+    if args.smoke:
+        # machinery gates (the strong perf/quality targets are bench-run
+        # properties at the real shape, not smoke-shape properties)
+        sched = out["halving"]["halving_schedule"]
+        assert sched and sched.get("rungs"), "halving schedule missing"
+        assert abs(out["aupr_delta"]) <= 0.1, \
+            f"halving AuPR diverged: {out['aupr_delta']}"
+        assert out["cost_model"]["n_stages"] > 0, "no held-out stages"
+        assert out["cost_model"]["n_history_observations"] > 0, \
+            "train() did not append cost history"
+        try:
+            os.unlink(os.environ["TMOG_COST_HISTORY"])
+        except OSError:
+            pass
+        print(json.dumps(out), flush=True)
+        return
+
+    from transmogrifai_tpu.utils.jsonio import write_json_atomic
+    write_json_atomic(os.path.join(_ROOT, "benchmarks",
+                                   "tuning_latest.json"), out)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,15 @@ else
   echo "RESILIENCE_SMOKE=FAILED (see /tmp/_t1_resilience.log)"
   rc=1
 fi
+# adaptive-selection smoke: full vs halving sweep on a small seeded shape
+# (same winner within tolerance, deterministic rung schedule, cost-history
+# recording) — catches tuning/ breakage the unit tests' mocks could miss
+if timeout -k 10 240 env JAX_PLATFORMS=cpu python examples/bench_tuning.py --smoke > /tmp/_t1_tuning.log 2>&1; then
+  echo "TUNING_SMOKE=ok $(grep -ao '"candidate_seconds_ratio": [0-9.]*' /tmp/_t1_tuning.log | tail -1)"
+else
+  echo "TUNING_SMOKE=FAILED (see /tmp/_t1_tuning.log)"
+  rc=1
+fi
 # self-lint: trace-safety over the shipped package + examples, DAG lint of
 # the example pipeline factory — any finding fails the script
 if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m transmogrifai_tpu.lint \

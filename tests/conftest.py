@@ -14,6 +14,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+# every train() appends stage observations to the shared cost history
+# (tuning/costmodel.py); tests must not churn the repo's
+# benchmarks/cost_history.json, so redirect to a throwaway file
+import tempfile as _tempfile
+
+os.environ.setdefault(
+    "TMOG_COST_HISTORY",
+    os.path.join(_tempfile.gettempdir(), "tmog_test_cost_history.json"))
 
 # the image's sitecustomize imports jax at interpreter startup (before this
 # conftest), so the env var alone is too late — force the platform via config.

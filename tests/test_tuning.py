@@ -1,0 +1,476 @@
+"""tuning/ subsystem: cost model, successive halving, budgeter, planner.
+
+ISSUE 6 satellite coverage: seeded grid where ``strategy="halving"``
+returns the same winner as the full sweep within documented AuPR
+tolerance, deterministic rung schedules across runs, the work-queue
+refactor byte-identical to the old sweep under ``strategy="full"``,
+atomic history writes, and the plan/bench decision plumbing.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import (
+    OpLogisticRegression, OpRandomForestClassifier,
+)
+from transmogrifai_tpu.selector.model_selector import ModelSelector, grid
+from transmogrifai_tpu.selector.splitters import DataSplitter
+from transmogrifai_tpu.selector.validators import (
+    OpCrossValidation, SweepUnit, SweepWorkQueue,
+)
+from transmogrifai_tpu.tuning import (
+    BenchBudgeter, CostModel, HalvingConfig, StageObservation, Tuner,
+    advise_plan, append_observations, halving_validate, load_observations,
+    nested_subsample_order, rung_schedule,
+)
+
+#: the documented halving-vs-full winner quality tolerance (docs/tuning.md)
+AUPR_TOL = 0.02
+
+
+def _binary_data(n=3000, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    logits = X[:, 0] * 2.0 - X[:, 1] + 0.3 * X[:, 2]
+    y = (logits + rng.normal(scale=0.7, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def _selector(strategy="full", halving=None, models=None):
+    models = models or [
+        (OpLogisticRegression(), grid(reg_param=[0.001, 0.01, 0.1, 0.3])),
+        (OpRandomForestClassifier(num_trees=10),
+         grid(max_depth=[3, 6], min_instances_per_node=[10, 100])),
+    ]
+    return ModelSelector(
+        models_and_params=models, problem_type="binary",
+        validator=OpCrossValidation(num_folds=3, stratify=True),
+        splitter=DataSplitter(reserve_test_fraction=0.1),
+        validation_metric="AuPR", strategy=strategy, halving=halving)
+
+
+def _fit(sel, X, y):
+    from transmogrifai_tpu.types.columns import FeatureColumn
+    from transmogrifai_tpu.types.feature_types import OPVector, RealNN
+
+    return sel.fit_columns(None, FeatureColumn(RealNN, y),
+                           FeatureColumn(OPVector, X))
+
+
+# ---------------------------------------------------------------------------
+# Rung schedule + subsampling
+# ---------------------------------------------------------------------------
+
+class TestSchedule:
+    def test_schedule_deterministic_across_runs(self):
+        a = rung_schedule(100_000, 12, HalvingConfig())
+        b = rung_schedule(100_000, 12, HalvingConfig())
+        assert [r.to_json() for r in a] == [r.to_json() for r in b]
+
+    def test_schedule_shape(self):
+        sched = rung_schedule(100_000, 12, HalvingConfig(eta=3,
+                                                         min_rows=2048))
+        assert sched[-1].rows == 100_000          # final rung = full data
+        rows = [r.rows for r in sched]
+        assert rows == sorted(rows)               # monotone resource growth
+        survivors = [r.survivors_in for r in sched]
+        assert survivors == sorted(survivors, reverse=True)
+        assert sched[0].survivors_in == 12
+
+    def test_too_small_shapes_yield_no_ladder(self):
+        assert rung_schedule(1000, 12, HalvingConfig(min_rows=2048)) == []
+        assert rung_schedule(100_000, 2, HalvingConfig()) == []
+
+    def test_nested_subsample_is_stratified_and_deterministic(self):
+        y = np.r_[np.zeros(1800), np.ones(200)].astype(np.float32)
+        a = nested_subsample_order(y, seed=7)
+        b = nested_subsample_order(y, seed=7)
+        np.testing.assert_array_equal(a, b)
+        # every reasonable prefix approximates the 10% positive rate
+        for k in (200, 500, 1000):
+            frac = y[a[:k]].mean()
+            assert 0.05 <= frac <= 0.15, (k, frac)
+        # prefixes are nested by construction (one fixed order)
+        assert set(a[:200]) <= set(a[:500])
+
+
+# ---------------------------------------------------------------------------
+# Halving end-to-end vs the full sweep
+# ---------------------------------------------------------------------------
+
+def _holdout_aupr(selector) -> float:
+    summ = selector.metadata["model_selector_summary"]
+    return float(summ["holdoutMetrics"]["AuPR"])
+
+
+class TestHalvingSelection:
+    def test_halving_matches_full_winner_within_tolerance(self):
+        X, y = _binary_data()
+        sel_f = _selector("full")
+        _fit(sel_f, X, y)
+        sel_h = _selector("halving", halving=HalvingConfig(min_rows=256))
+        _fit(sel_h, X, y)
+        # winner quality within the documented tolerance on the holdout
+        fm = _holdout_aupr(sel_f)
+        hm = _holdout_aupr(sel_h)
+        assert abs(fm - hm) <= AUPR_TOL, (fm, hm)
+        sched = sel_h.metadata["halving_schedule"]
+        assert sched["rungs"], "expected a real rung ladder"
+        assert sched["rungs"][-1]["rows"] >= sched["rungs"][0]["rows"]
+
+    def test_halving_deterministic_across_runs(self):
+        X, y = _binary_data(n=2000)
+        cfg = HalvingConfig(min_rows=256)
+        s1 = _selector("halving", halving=cfg)
+        s2 = _selector("halving", halving=cfg)
+        m1, m2 = _fit(s1, X, y), _fit(s2, X, y)
+        assert m1.best_name == m2.best_name
+        assert m1.best_params == m2.best_params
+        j1 = s1.metadata["halving_schedule"]
+        j2 = s2.metadata["halving_schedule"]
+        for a, b in zip(j1["rungs"], j2["rungs"]):
+            assert a["rows"] == b["rows"]
+            assert a["promoted"] == b["promoted"]
+
+    def test_eliminated_candidates_are_annotated(self):
+        X, y = _binary_data(n=2000)
+        sel = _selector("halving", halving=HalvingConfig(min_rows=256))
+        _fit(sel, X, y)
+        summ = sel.metadata["model_selector_summary"]
+        errs = [r.get("error") for r in summ["validationResults"]]
+        assert any(e and "halving: eliminated" in e for e in errs)
+        # the winner's result is full-fidelity (no annotation)
+        best = summ["bestModelType"]
+        winners = [r for r in summ["validationResults"]
+                   if r["modelType"] == best and not r.get("error")]
+        assert winners
+
+    def test_halving_validate_runs_fewer_candidate_fits(self):
+        """Early rungs run everyone on slivers; only survivors pay full
+        fits — total full-data-equivalent candidate fits must be well
+        under the full sweep's."""
+        X, y = _binary_data(n=4000)
+        calls = []
+
+        def fitter_factory(i):
+            def fitter(Xf, yf, wf, p):
+                calls.append((i, len(yf)))
+                mean = Xf[wf > 0].mean(axis=0)
+
+                def predict(Xe):
+                    return Xe @ np.ones(Xe.shape[1]) * (1 + 0.01 * i)
+                return predict
+            return fitter
+
+        cands = [(f"m{i}", {"p": i}, fitter_factory(i)) for i in range(9)]
+        validator = OpCrossValidation(num_folds=2, stratify=True)
+
+        def eval_fn(yy, ss, ww):
+            from transmogrifai_tpu.evaluators.metrics import aupr
+            return float(aupr(yy, np.asarray(ss), ww))
+
+        best, results, sched = halving_validate(
+            validator, cands, X, y, np.ones(len(y), np.float32),
+            eval_fn, "AuPR", True, HalvingConfig(min_rows=256))
+        assert len(results) == 9
+        # row-weighted work: full sweep would be 9 * n * folds
+        work = sum(rows for _, rows in calls)
+        full_work = 9 * len(y) * 2
+        assert work < 0.6 * full_work, (work, full_work)
+        assert sched["rungs"]
+
+    def test_rounds_scaling_floors(self):
+        from transmogrifai_tpu.tuning.halving import _scaled_params
+
+        cfg = HalvingConfig()
+        p = _scaled_params({"max_iter": 50, "reg_param": 0.1}, 0.1, cfg)
+        assert p["max_iter"] == 5 and p["reg_param"] == 0.1
+        p = _scaled_params({"num_round": 200}, 0.01, cfg)
+        assert p["num_round"] == 20          # min_round_frac floor
+        # full fraction: untouched object semantics
+        p0 = {"max_iter": 50}
+        assert _scaled_params(p0, 1.0, cfg) is p0
+
+
+# ---------------------------------------------------------------------------
+# Work-queue refactor: byte-identical full sweep
+# ---------------------------------------------------------------------------
+
+class TestSweepQueueParity:
+    def test_full_strategy_identical_to_default_path(self):
+        X, y = _binary_data(n=1500)
+        s_default = _selector()           # pre-refactor entry: no strategy
+        s_full = _selector("full")
+        m1, m2 = _fit(s_default, X, y), _fit(s_full, X, y)
+        j1 = s_default.metadata["model_selector_summary"]
+        j2 = s_full.metadata["model_selector_summary"]
+        assert json.dumps(j1, sort_keys=True, default=str) == \
+            json.dumps(j2, sort_keys=True, default=str)
+        assert m1.best_name == m2.best_name
+        assert m1.best_params == m2.best_params
+
+    def test_queue_units_and_isolation(self):
+        def ok_fitter(X, y, w, p):
+            return lambda Xe: Xe[:, 0]
+
+        def boom_fitter(X, y, w, p):
+            raise FloatingPointError("boom")
+
+        X = np.random.default_rng(0).normal(size=(50, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+
+        def run_fold(fitter, params, ctx):
+            predict = fitter(X, y, None, params)
+            return float(np.mean(predict(X) * y))
+
+        q = SweepWorkQueue(
+            [("a", {"i": 0}, ok_fitter), ("b", {"i": 1}, boom_fitter)],
+            fold_ctxs=[None, None], run_fold=run_fold)
+        assert [u.index for u in q.units] == [0, 1]
+        vals, err = q.run_unit(q.units[0])
+        assert err is None and len(vals) == 2
+        vals, err = q.run_unit(q.units[1])
+        assert vals == [] and "boom" in err
+        best, results = q.run_all("m", True, None)
+        assert best == 0
+        assert results[1].error and "boom" in results[1].error
+
+    def test_fit_params_override_reported_params(self):
+        seen = []
+
+        def fitter(X, y, w, p):
+            seen.append(dict(p))
+            return lambda Xe: Xe[:, 0]
+
+        unit = SweepUnit(0, "a", {"max_iter": 50}, fitter,
+                         fit_params={"max_iter": 5})
+        assert unit.run_params == {"max_iter": 5}
+        q = SweepWorkQueue([("a", {"max_iter": 50}, fitter, None,
+                             {"max_iter": 5})],
+                           fold_ctxs=[None],
+                           run_fold=lambda f, p, c: (f(None, None, None, p),
+                                                     1.0)[1])
+        _, results = q.run_all("m", True, None)
+        assert seen == [{"max_iter": 5}]
+        assert results[0].params == {"max_iter": 50}   # identity preserved
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+# ---------------------------------------------------------------------------
+
+def _obs(kind, rows, cols, wall, backend="cpu"):
+    return StageObservation(stage_kind=kind, rows=rows, cols=cols,
+                            dtype="float32", backend=backend, wall_s=wall)
+
+
+class TestCostModel:
+    def test_fit_and_predict_scaling_law(self):
+        # wall ~ 1e-8 * rows * cols: the log-space ridge should recover it
+        rng = np.random.default_rng(1)
+        obs = []
+        for _ in range(40):
+            r = int(rng.integers(1000, 1_000_000))
+            c = int(rng.integers(4, 512))
+            obs.append(_obs("X:fit", r, c, 1e-8 * r * c))
+        cm = CostModel().fit(obs)
+        for r, c in ((50_000, 100), (500_000, 20), (2_000_000, 300)):
+            pred = cm.predict("X:fit", r, c)
+            true = 1e-8 * r * c
+            assert true / 2 <= pred <= true * 2, (r, c, pred, true)
+
+    def test_cold_model_uses_analytic_fallback(self):
+        cm = CostModel()
+        assert cm.source("never-seen:fit") == "analytic"
+        p = cm.predict("never-seen:fit", 10_000, 50)
+        assert p > 0
+        assert cm.predict("never-seen:fit", 10_000_000, 500) > p
+
+    def test_backend_bucket_preferred(self):
+        obs = ([_obs("X:fit", 10_000, 10, 1.0, backend="cpu")] * 3
+               + [_obs("X:fit", 10_000, 10, 10.0, backend="tpu")] * 3)
+        cm = CostModel().fit(obs)
+        p_cpu = cm.predict("X:fit", 10_000, 10, backend="cpu")
+        p_tpu = cm.predict("X:fit", 10_000, 10, backend="tpu")
+        assert p_tpu > p_cpu * 3
+
+    def test_within_factor(self):
+        obs = [_obs("X:fit", 10_000, 10, 1.0)] * 4
+        cm = CostModel().fit(obs)
+        frac, n = cm.within_factor(obs)
+        assert n == 4 and frac == 1.0
+        frac, n = cm.within_factor([_obs("X:fit", 10_000, 10, 100.0)])
+        assert frac == 0.0
+
+    def test_history_roundtrip_and_cap(self, tmp_path):
+        path = str(tmp_path / "hist.json")
+        append_observations(path, [_obs("A:fit", 10, 1, 0.5)] * 5)
+        append_observations(path, [_obs("B:fit", 20, 2, 0.7)] * 5, cap=6)
+        got = load_observations(path)
+        assert len(got) == 6                       # FIFO cap
+        assert all(o.stage_kind == "B:fit" for o in got[-5:])
+        # atomic write: no tmp residue, file is valid json
+        assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+        with open(path) as f:
+            json.load(f)
+
+    def test_history_preserves_bench_config_entries(self, tmp_path):
+        path = str(tmp_path / "cost_history.json")
+        with open(path, "w") as f:
+            json.dump({"titanic": {"measured_s": 12.0, "sig": ""}}, f)
+        append_observations(path, [_obs("A:fit", 10, 1, 0.5)])
+        with open(path) as f:
+            hist = json.load(f)
+        assert hist["titanic"]["measured_s"] == 12.0
+        assert len(hist["stage_observations"]) == 1
+
+    def test_train_appends_observations(self, tmp_path, monkeypatch):
+        import pandas as pd
+
+        from transmogrifai_tpu import (FeatureBuilder, OpWorkflow,
+                                       transmogrify)
+
+        path = str(tmp_path / "ch.json")
+        monkeypatch.setenv("TMOG_COST_HISTORY", path)
+        rng = np.random.default_rng(0)
+        df = pd.DataFrame({"label": (rng.random(200) > 0.5).astype(float),
+                           "a": rng.normal(size=200),
+                           "b": rng.normal(size=200)})
+        label = FeatureBuilder.RealNN("label").as_response()
+        feats = transmogrify([FeatureBuilder.Real("a").as_predictor(),
+                              FeatureBuilder.Real("b").as_predictor()])
+        from transmogrifai_tpu.models import OpLogisticRegression as LR
+        pred = LR().set_input(label, feats).get_output()
+        OpWorkflow().set_result_features(pred).set_input_data(df).train()
+        obs = load_observations(path)
+        assert obs, "train() must append stage observations"
+        assert all(o.rows == 200 for o in obs)
+        assert any(":fit" in o.stage_kind for o in obs)
+        assert all(o.backend == "cpu" for o in obs)
+
+    def test_disabled_history_records_nothing(self, tmp_path, monkeypatch):
+        from transmogrifai_tpu.tuning.costmodel import default_history_path
+
+        monkeypatch.setenv("TMOG_COST_HISTORY", "")
+        assert default_history_path() is None
+        monkeypatch.setenv("TMOG_COST_HISTORY", "0")
+        assert default_history_path() is None
+
+
+# ---------------------------------------------------------------------------
+# Budgeter
+# ---------------------------------------------------------------------------
+
+class TestBenchBudgeter:
+    def test_measured_history_wins(self, tmp_path):
+        path = str(tmp_path / "h.json")
+        b = BenchBudgeter(path, budget_s=1000)
+        b.record("cfg", 123.0, cold=False, sig="10x2:light")
+        assert b.estimate("cfg", 50.0, sig="10x2:light") == (
+            123.0, "measured_history")
+        assert b.estimate("cfg", 50.0, sig="other") == (50.0, "assumed")
+
+    def test_cost_model_tier_only_raises_estimates(self, tmp_path):
+        path = str(tmp_path / "h.json")
+        append_observations(path, [_obs("Big:fit", 1_000_000, 500,
+                                        5000.0)] * 4)
+        b = BenchBudgeter(path, budget_s=10_000)
+        est, src = b.estimate("cfg", 10.0, sig="1000000x500:default")
+        assert src == "cost_model" and est > 10.0
+        # prediction below the stated assumption -> assumption stands
+        est, src = b.estimate("cfg", 1e9, sig="1000000x500:default")
+        assert src == "assumed" and est == 1e9
+
+    def test_skip_reason_and_reserve(self, tmp_path):
+        t = [0.0]
+        b = BenchBudgeter(str(tmp_path / "h.json"), budget_s=100,
+                          clock=lambda: t[0])
+        b.set_reserve(60.0)
+        assert b.should_skip("cheap", 10.0) is None
+        reason = b.should_skip("big", 50.0)
+        assert reason and "exceeds remaining budget" in reason
+        assert "reserving 60s" in reason
+        t[0] = 95.0
+        assert b.should_skip("cheap", 10.0) is not None
+        assert "cheap" in b.decisions and "big" in b.decisions
+
+
+# ---------------------------------------------------------------------------
+# Planner + workflow integration
+# ---------------------------------------------------------------------------
+
+class TestPlanner:
+    def test_small_shape_stays_in_core(self):
+        adv = advise_plan(10_000, 50, host_budget_bytes=1 << 30)
+        assert adv.mode == "in-core" and adv.chunk_rows is None
+
+    def test_big_shape_streams_with_geometry(self):
+        adv = advise_plan(10_000_000, 500, host_budget_bytes=1 << 30)
+        assert adv.mode == "stream"
+        assert adv.chunk_rows and adv.chunk_rows >= 1024
+        # chunk target ~64MB of f32 rows
+        assert abs(adv.chunk_rows * 500 * 4 - (64 << 20)) < (8 << 20)
+        assert adv.retain_mb >= 64
+        assert adv.prefetch_chunks >= 2
+        assert "exceeds" in " ".join(adv.reasons)
+
+    def test_deterministic(self):
+        a = advise_plan(1_000_000, 500, host_budget_bytes=1 << 30)
+        b = advise_plan(1_000_000, 500, host_budget_bytes=1 << 30)
+        assert a.to_json() == b.to_json()
+
+    def test_plan_explain_carries_advice(self):
+        import pandas as pd
+
+        from transmogrifai_tpu import (FeatureBuilder, OpWorkflow,
+                                       transmogrify)
+        from transmogrifai_tpu.workflow.dag import compute_dag
+        from transmogrifai_tpu.workflow.plan import plan_for
+
+        rng = np.random.default_rng(0)
+        df = pd.DataFrame({"label": (rng.random(50) > 0.5).astype(float),
+                           "a": rng.normal(size=50)})
+        label = FeatureBuilder.RealNN("label").as_response()
+        feats = transmogrify([FeatureBuilder.Real("a").as_predictor()])
+        from transmogrifai_tpu.models import OpLogisticRegression as LR
+        pred = LR().set_input(label, feats).get_output()
+        dag = compute_dag([pred])
+        plan = plan_for(dag, keep=[pred.name])
+        advice = plan.advise(10_000_000, 500,
+                             host_budget_bytes=1 << 30)
+        text = plan.explain(advice=advice)
+        assert "plan advice: stream" in text
+
+    def test_tuner_strategy_applied_and_restored(self):
+        import pandas as pd
+
+        from transmogrifai_tpu import (FeatureBuilder, OpWorkflow,
+                                       transmogrify)
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector,
+        )
+
+        rng = np.random.default_rng(3)
+        n = 600
+        df = pd.DataFrame({
+            "label": (rng.random(n) > 0.5).astype(float),
+            "a": rng.normal(size=n), "b": rng.normal(size=n),
+            "c": rng.normal(size=n)})
+        label = FeatureBuilder.RealNN("label").as_response()
+        feats = transmogrify([FeatureBuilder.Real(c).as_predictor()
+                              for c in "abc"])
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models_and_parameters=[(OpLogisticRegression(),
+                                    grid(reg_param=[0.01, 0.1, 0.3]))])
+        pred = sel.set_input(label, feats).get_output()
+        wf = OpWorkflow().set_result_features(pred).set_input_data(df)
+        assert sel.strategy == "full"
+        wf.train(tuner=Tuner(strategy="halving",
+                             halving=HalvingConfig(min_rows=64,
+                                                   min_candidates=2)))
+        # applied for the train, restored afterwards
+        assert sel.strategy == "full"
+        assert "halving_schedule" in sel.metadata

@@ -90,6 +90,12 @@ class Reader:
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         raise NotImplementedError
 
+    def estimate_rows(self) -> Optional[int]:
+        """Cheap row-count estimate BEFORE reading (the cost planner's
+        stream-vs-in-core input, tuning/planner.py) — None when the source
+        cannot say without a full parse (file readers)."""
+        return None
+
     def iter_chunks(self, raw_features: Sequence[Feature],
                     chunk_rows: int) -> ChunkStream:
         """Yield the dataset as bounded row chunks (out-of-core ingestion).
@@ -122,6 +128,9 @@ class DataFrameReader(Reader):
     def __init__(self, df, key_col: Optional[str] = None):
         self.df = df
         self.key_col = key_col
+
+    def estimate_rows(self) -> Optional[int]:
+        return len(self.df)
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         records: Optional[List[dict]] = None
@@ -175,6 +184,9 @@ class RecordsReader(Reader):
         self.records = list(records)
         self.key_fn = key_fn
 
+    def estimate_rows(self) -> Optional[int]:
+        return len(self.records)
+
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         from ..types.feature_types import ID
 
@@ -225,6 +237,9 @@ def reader_for(data) -> Reader:
 class _PassthroughReader(Reader):
     def __init__(self, ds: ColumnarDataset):
         self.ds = ds
+
+    def estimate_rows(self) -> Optional[int]:
+        return len(self.ds)
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         missing = [f.name for f in raw_features if f.name not in self.ds]

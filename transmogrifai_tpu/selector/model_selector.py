@@ -121,7 +121,9 @@ class ModelSelector(PredictorEstimator):
                  splitter=None,
                  validation_metric: Optional[str] = None,
                  holdout_evaluators: Sequence = (),
-                 uid: Optional[str] = None):
+                 uid: Optional[str] = None,
+                 strategy: str = "full",
+                 halving=None):
         super().__init__(operation_name="modelSelector", uid=uid)
         self.models_and_params = list(models_and_params)
         self.problem_type = problem_type
@@ -132,6 +134,17 @@ class ModelSelector(PredictorEstimator):
             "binary": "AuPR", "multiclass": "F1",
             "regression": "RootMeanSquaredError"}[problem_type]
         self.holdout_evaluators = list(holdout_evaluators)
+        # sweep scheduling: "full" fits every grid candidate to completion
+        # (the historical path, byte-identical); "halving" runs successive
+        # halving over the candidate grid (tuning/halving.py) — subsampled
+        # rows/rounds for early rungs, full-data final rung.  ``halving``
+        # takes a tuning.HalvingConfig.
+        if strategy not in ("full", "halving"):
+            raise ValueError(
+                f"unknown selector strategy {strategy!r}; expected "
+                f"'full' or 'halving'")
+        self.strategy = strategy
+        self.halving = halving
         # set by find_best_estimator (workflow-level CV): when present,
         # fit_columns skips validation and refits this winner directly
         # (reference BestEstimator, ModelSelector.scala:116-145)
@@ -238,7 +251,7 @@ class ModelSelector(PredictorEstimator):
         from ..evaluators.metrics import MINIMIZE_METRICS
         return self.validation_metric not in MINIMIZE_METRICS
 
-    def _candidates(self):
+    def _candidates(self, with_groups: bool = True):
         from ..models.gbdt_kernels import compile_depth_hint
         from .grid_groups import make_grid_group
 
@@ -246,11 +259,14 @@ class ModelSelector(PredictorEstimator):
         for proto, grid_points in self.models_and_params:
             # one batched program for the whole (folds x grid) product when
             # the family supports it; single-chip only (the mesh path runs
-            # each candidate's own sharded fit)
+            # each candidate's own sharded fit).  ``with_groups=False`` is
+            # the halving scheduler's path: rung subsets fit per-candidate
+            # (a group always computes its WHOLE family grid, which would
+            # pay for eliminated candidates).
             group = (make_grid_group(proto, grid_points, self.problem_type,
                                      self.validation_metric,
                                      n_classes=self._class_count(None))
-                     if self.mesh is None else None)
+                     if (self.mesh is None and with_groups) else None)
             fam_depth = self._family_depth(proto, grid_points)
             for params in grid_points:
                 def fitter(X, y, w, p, proto=proto, fam_depth=fam_depth):
@@ -408,6 +424,10 @@ class ModelSelector(PredictorEstimator):
 
     def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
                     features_col: FeatureColumn):
+        # cost-model bucket refinement (workflow/plan.py reads it): a
+        # halving sweep's wall follows a different law than a full sweep's
+        self._cost_kind = ("fit-halving" if self.strategy == "halving"
+                           else None)
         X = self._prepare_matrix(features_col.values)
         y = np.nan_to_num(np.asarray(label_col.values, dtype=np.float32))
         n = len(y)
@@ -424,6 +444,23 @@ class ModelSelector(PredictorEstimator):
             # validate afresh, not reuse a stale selection
             best_name, best_params, results = self.best_estimator
             self.best_estimator = None
+        elif self.strategy == "halving":
+            # successive halving (tuning/halving.py): early rungs rank
+            # candidates on stratified row subsamples + scaled rounds,
+            # only survivors pay full-data fits.  No grid groups (a group
+            # batches its WHOLE family — eliminated candidates would
+            # still be paid for) and no tree-prep prefetch (sized for the
+            # full matrix, not the rungs).
+            from ..tuning.halving import halving_validate
+
+            candidates = self._candidates(with_groups=False)
+            best_i, results, schedule = halving_validate(
+                self.validator, candidates, X, y, base_w,
+                eval_fn=self._metric, metric_name=self.validation_metric,
+                larger_better=self.larger_better, config=self.halving,
+                stratify=self.problem_type != "regression")
+            self.metadata["halving_schedule"] = schedule
+            best_name, best_params, *_ = candidates[best_i]
         else:
             # host tree-prep (sketch/binning/CSR) overlaps the linear
             # groups' async device work in a daemon thread
@@ -586,6 +623,7 @@ class BinaryClassificationModelSelector:
         splitter=None, seed: int = 42,
         models_and_parameters=None, parallelism: int = 8,
         max_wait: Optional[float] = None,
+        strategy: str = "full", halving=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
@@ -595,7 +633,8 @@ class BinaryClassificationModelSelector:
                                         parallelism=parallelism,
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
-            validation_metric=validation_metric)
+            validation_metric=validation_metric,
+            strategy=strategy, halving=halving)
 
     @staticmethod
     def with_train_validation_split(
@@ -603,6 +642,7 @@ class BinaryClassificationModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
+        strategy: str = "full", halving=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
@@ -612,7 +652,8 @@ class BinaryClassificationModelSelector:
                                              parallelism=parallelism,
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
-            validation_metric=validation_metric)
+            validation_metric=validation_metric,
+            strategy=strategy, halving=halving)
 
 
 class MultiClassificationModelSelector:
@@ -622,6 +663,7 @@ class MultiClassificationModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
+        strategy: str = "full", halving=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
@@ -631,7 +673,8 @@ class MultiClassificationModelSelector:
                                         parallelism=parallelism,
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
-            validation_metric=validation_metric)
+            validation_metric=validation_metric,
+            strategy=strategy, halving=halving)
 
     @staticmethod
     def with_train_validation_split(
@@ -639,6 +682,7 @@ class MultiClassificationModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
+        strategy: str = "full", halving=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
@@ -648,7 +692,8 @@ class MultiClassificationModelSelector:
                                              parallelism=parallelism,
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
-            validation_metric=validation_metric)
+            validation_metric=validation_metric,
+            strategy=strategy, halving=halving)
 
 
 class RegressionModelSelector:
@@ -658,6 +703,7 @@ class RegressionModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
+        strategy: str = "full", halving=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
@@ -666,7 +712,8 @@ class RegressionModelSelector:
                                         parallelism=parallelism,
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
-            validation_metric=validation_metric)
+            validation_metric=validation_metric,
+            strategy=strategy, halving=halving)
 
     @staticmethod
     def with_train_validation_split(
@@ -675,6 +722,7 @@ class RegressionModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
+        strategy: str = "full", halving=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
@@ -684,7 +732,8 @@ class RegressionModelSelector:
                                              parallelism=parallelism,
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
-            validation_metric=validation_metric)
+            validation_metric=validation_metric,
+            strategy=strategy, halving=halving)
 
 
 class RandomParamBuilder:

@@ -20,7 +20,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["ValidationResult", "OpCrossValidation", "OpTrainValidationSplit",
-           "make_folds"]
+           "make_folds", "SweepUnit", "SweepWorkQueue"]
 
 
 @dataclasses.dataclass
@@ -277,102 +277,184 @@ class OpTrainValidationSplit(_ValidatorBase):
                           larger_better, self.max_wait)
 
 
+@dataclasses.dataclass
+class SweepUnit:
+    """One schedulable unit of sweep work: a candidate's (folds x fit)
+    execution.  ``fit_params`` lets a scheduler run the unit with
+    different resources than the candidate's identity (successive-halving
+    rung scaling, tuning/halving.py) — results always report ``params``.
+    """
+
+    index: int                   # position in the original candidate list
+    name: str
+    params: Dict[str, Any]
+    fitter: Any
+    group: Any = None            # shared GridGroup for batched device fits
+    fit_params: Optional[Dict[str, Any]] = None
+
+    @property
+    def run_params(self) -> Dict[str, Any]:
+        return self.fit_params if self.fit_params is not None else self.params
+
+
+class SweepWorkQueue:
+    """The selector sweep as an explicitly schedulable work queue.
+
+    The candidates×folds loop used to be a closed ``while`` inside
+    ``_run_sweep``; it is now a queue of :class:`SweepUnit` whose
+    execution, failure isolation, ``max_wait`` budgeting and grid-group
+    batching live HERE, while schedulers decide which units run — the
+    default full sweep (``run_all``), successive halving
+    (tuning/halving.py, which schedules rung-sized subsets through fresh
+    queues), and the coming sharded-sweep scheduler (ROADMAP item 1) all
+    drive the same unit semantics.
+
+    Semantics (reference parity, OpValidator.scala:94-214): each unit's
+    fits are isolated — an exception scores the unit worst and records the
+    error; the wall-clock budget is checked before each dispatch (an
+    already-dispatched XLA program cannot be interrupted, but the queue
+    stops enqueuing); a run of consecutive units sharing a ``GridGroup``
+    fits as ONE batched device program with transparent per-unit fallback.
+    """
+
+    def __init__(self, candidates, fold_ctxs, run_fold, run_group=None):
+        self.units = [
+            SweepUnit(i, c[0], c[1], c[2],
+                      group=(c[3] if len(c) >= 4 else None),
+                      fit_params=(c[4] if len(c) >= 5 else None))
+            for i, c in enumerate(tuple(c) for c in candidates)]
+        self.fold_ctxs = fold_ctxs
+        self._run_fold = run_fold
+        self._run_group = run_group
+
+    # -- unit execution ------------------------------------------------------
+
+    def run_unit(self, unit: SweepUnit) -> Tuple[List[Any], Optional[str]]:
+        """One candidate across every fold context, failure-isolated."""
+        fold_vals: List[Any] = []
+        try:
+            for ctx in self.fold_ctxs:
+                fold_vals.append(
+                    self._run_fold(unit.fitter, unit.run_params, ctx))
+        except Exception as e:  # noqa: BLE001 - candidate isolation
+            return [], f"{type(e).__name__}: {e}"
+        return fold_vals, None
+
+    def group_span(self, i: int) -> int:
+        """End index (exclusive) of the run of units sharing units[i]'s
+        group."""
+        group = self.units[i].group
+        j = i
+        while j < len(self.units) and self.units[j].group is group:
+            j += 1
+        return j
+
+    def run_group_block(self, i: int, j: int):
+        """Batched fit for units[i:j] (one shared GridGroup): the group's
+        (C_g, F) metric matrix, or None when the group declines/fails —
+        in which case the units are stripped to the sequential path."""
+        group = self.units[i].group
+        try:
+            return self._run_group(group)
+        except Exception as e:  # noqa: BLE001 - fall back per-candidate
+            import warnings
+            warnings.warn(
+                f"grid group {type(group).__name__} failed "
+                f"({type(e).__name__}: {e}); falling back to "
+                f"sequential candidate fits", RuntimeWarning)
+            return None
+
+    def strip_groups(self, i: int, j: int) -> None:
+        for k in range(i, j):
+            self.units[k].group = None
+
+    # -- the default scheduler: full sweep in stable order -------------------
+
+    def run_all(self, metric_name: str, larger_better: bool,
+                max_wait: Optional[float]
+                ) -> Tuple[int, List[ValidationResult]]:
+        """Every unit in stable order — the classic full sweep.
+
+        Raises only when EVERY candidate failed — there is no model to
+        select otherwise."""
+        import time
+
+        t0 = time.monotonic()
+        all_vals: List[Any] = []
+        errors: List[Optional[str]] = []
+        i = 0
+        while i < len(self.units):
+            unit = self.units[i]
+            elapsed = time.monotonic() - t0
+            if max_wait is not None and elapsed > max_wait and all_vals:
+                all_vals.append([])
+                errors.append(
+                    f"skipped: validation budget max_wait={max_wait}s "
+                    f"exceeded after {elapsed:.1f}s")
+                i += 1
+                continue
+            if unit.group is not None and self._run_group is not None:
+                j = self.group_span(i)
+                M = self.run_group_block(i, j)
+                if M is not None:
+                    for r in range(j - i):
+                        # deferred row marker: fetched once per group
+                        # matrix in _materialize (no per-row device
+                        # slicing launches)
+                        all_vals.append(_GroupRow(M, r))
+                        errors.append(None)
+                    i = j
+                    continue
+                # declined/failed: strip so members fit sequentially
+                self.strip_groups(i, j)
+                continue
+            fold_vals, err = self.run_unit(unit)
+            all_vals.append(fold_vals)
+            errors.append(err)
+            i += 1
+        return self.collect(all_vals, errors, metric_name, larger_better)
+
+    # -- result assembly -----------------------------------------------------
+
+    def collect(self, all_vals, errors, metric_name: str,
+                larger_better: bool
+                ) -> Tuple[int, List[ValidationResult]]:
+        # the losing sentinel depends on the metric direction: -inf only
+        # loses when larger is better; minimize metrics (RMSE, LogLoss)
+        # need +inf
+        worst = float("-inf") if larger_better else float("inf")
+        results: List[ValidationResult] = []
+        for unit, fold_vals, err in zip(
+                self.units, _materialize(all_vals), errors):
+            # mean over FINITE folds only: a single faulted fold (NaN from
+            # the per-value _materialize fallback) should not zero out the
+            # folds that did complete — the reference likewise averages
+            # whichever fold Futures finished
+            finite = [v for v in fold_vals if np.isfinite(v)]
+            if fold_vals and not finite and err is None:
+                err = "all fold metrics non-finite"
+            mean = float(np.mean(finite)) if finite and err is None else worst
+            results.append(ValidationResult(unit.name, unit.params,
+                                            metric_name, mean,
+                                            fold_vals, error=err))
+        if all(r.error is not None for r in results):
+            raise RuntimeError(
+                "model selection failed: every candidate errored; "
+                f"first error: {results[0].error}")
+        best = _argbest([r.metric_value if r.error is None else worst
+                         for r in results], larger_better)
+        return best, results
+
+
 def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
                larger_better: bool, max_wait: Optional[float],
                run_group=None) -> Tuple[int, List[ValidationResult]]:
-    """Shared candidates×folds loop with per-candidate failure isolation.
-
-    The reference runs each (model, fold) fit in its own Future and bounds
-    the await with ``maxWait`` (OpCrossValidation.scala:113-138,
-    OpValidator.scala:108); a failed or timed-out candidate loses, it does
-    not kill the sweep.  Here fits are XLA launches, so the equivalents
-    are: exceptions confined to the raising candidate (scored -inf, error
-    recorded in the summary) and a wall-clock budget checked before each
-    dispatch (an already-dispatched XLA program cannot be interrupted, but
-    the sweep is guaranteed to stop enqueuing and return partial results).
-    Raises only when EVERY candidate failed — there is no model to select.
-
-    Candidates may carry a 4th element — a ``GridGroup`` shared by a run of
-    consecutive candidates — in which case the whole run fits as ONE
-    batched device program (``run_group``); a group that declines or raises
-    falls back to the sequential per-candidate path, preserving isolation.
-    """
-    import time
-
-    t0 = time.monotonic()
-    cands = [tuple(c) if len(c) == 4 else (*c, None) for c in candidates]
-    all_vals: List[Any] = []
-    errors: List[Optional[str]] = []
-    i = 0
-    while i < len(cands):
-        name, params, fitter, group = cands[i]
-        elapsed = time.monotonic() - t0
-        if max_wait is not None and elapsed > max_wait and all_vals:
-            all_vals.append([])
-            errors.append(f"skipped: validation budget max_wait={max_wait}s "
-                          f"exceeded after {elapsed:.1f}s")
-            i += 1
-            continue
-        if group is not None and run_group is not None:
-            j = i
-            while j < len(cands) and cands[j][3] is group:
-                j += 1
-            M = None
-            try:
-                M = run_group(group)       # (C_g, F) device/host matrix
-            except Exception as e:  # noqa: BLE001 - fall back per-candidate
-                import warnings
-                warnings.warn(
-                    f"grid group {type(group).__name__} failed "
-                    f"({type(e).__name__}: {e}); falling back to "
-                    f"sequential candidate fits", RuntimeWarning)
-                M = None
-            if M is not None:
-                for r in range(j - i):
-                    # deferred row marker: fetched once per group matrix in
-                    # _materialize (no per-row device slicing launches)
-                    all_vals.append(_GroupRow(M, r))
-                    errors.append(None)
-                i = j
-                continue
-            # declined/failed: strip the group so members fit sequentially
-            for k in range(i, j):
-                cands[k] = (*cands[k][:3], None)
-            continue
-        fold_vals: List[Any] = []
-        err: Optional[str] = None
-        try:
-            for ctx in fold_ctxs:
-                fold_vals.append(run_fold(fitter, params, ctx))
-        except Exception as e:  # noqa: BLE001 - candidate isolation
-            fold_vals = []
-            err = f"{type(e).__name__}: {e}"
-        all_vals.append(fold_vals)
-        errors.append(err)
-        i += 1
-    # the losing sentinel depends on the metric direction: -inf only loses
-    # when larger is better; minimize metrics (RMSE, LogLoss) need +inf
-    worst = float("-inf") if larger_better else float("inf")
-    results: List[ValidationResult] = []
-    for (name, params, *_), fold_vals, err in zip(
-            cands, _materialize(all_vals), errors):
-        # mean over FINITE folds only: a single faulted fold (NaN from the
-        # per-value _materialize fallback) should not zero out the folds
-        # that did complete — the reference likewise averages whichever
-        # fold Futures finished
-        finite = [v for v in fold_vals if np.isfinite(v)]
-        if fold_vals and not finite and err is None:
-            err = "all fold metrics non-finite"
-        mean = float(np.mean(finite)) if finite and err is None else worst
-        results.append(ValidationResult(name, params, metric_name, mean,
-                                        fold_vals, error=err))
-    if all(r.error is not None for r in results):
-        raise RuntimeError(
-            "model selection failed: every candidate errored; first error: "
-            f"{results[0].error}")
-    best = _argbest([r.metric_value if r.error is None else worst
-                     for r in results], larger_better)
-    return best, results
+    """The full-sweep scheduler over the work queue (see SweepWorkQueue
+    for the execution semantics — this wrapper is the historical entry
+    point every validator calls)."""
+    queue = SweepWorkQueue(candidates, fold_ctxs, run_fold,
+                           run_group=run_group)
+    return queue.run_all(metric_name, larger_better, max_wait)
 
 
 def _argbest(vals: List[float], larger_better: bool) -> int:

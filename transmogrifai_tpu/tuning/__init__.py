@@ -1,0 +1,69 @@
+"""tuning/ — learned cost model + adaptive model selection + plan choices.
+
+The repo records rich per-stage/per-candidate telemetry (PlanProfiler,
+IngestProfiler, ``benchmarks/cost_history.json``); this subsystem SPENDS
+it:
+
+* :mod:`costmodel` — a fitted log-space ridge per stage kind over
+  ``(rows, cols, dtype, backend)`` features, trained from the history
+  every ``train()`` appends, with an analytic cold-start fallback.
+* :mod:`halving` — successive-halving model selection over the
+  selector's candidate grid (subsampled rows + scaled boosting rounds,
+  deterministic promotion), driven through the selector's schedulable
+  sweep queue.
+* :mod:`budget` — the BenchBudgeter that replaces bench.py's hand-rolled
+  estimate logic (measured history > cost model > stated assumption).
+* :mod:`planner` — cost-predicted plan-level choices (stream vs in-core,
+  chunk_rows / prefetch depth / spill threshold), surfaced via
+  ``ExecutionPlan.advise`` and ``OpWorkflow.train(tuner=...)``.
+
+Everything is opt-in: ``train(tuner=None)`` and selector
+``strategy="full"`` keep the default paths byte-identical.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .budget import BenchBudgeter
+from .costmodel import (CostModel, StageObservation, append_observations,
+                        default_history_path, load_observations,
+                        observations_from_profiler,
+                        record_train_observations)
+from .halving import (HalvingConfig, Rung, halving_validate,
+                      nested_subsample_order, rung_schedule)
+from .planner import PlanAdvice, advise_plan, default_host_budget_bytes
+
+__all__ = [
+    "Tuner", "HalvingConfig", "Rung", "halving_validate", "rung_schedule",
+    "nested_subsample_order", "CostModel", "StageObservation",
+    "load_observations", "append_observations",
+    "observations_from_profiler", "record_train_observations",
+    "default_history_path", "BenchBudgeter", "PlanAdvice", "advise_plan",
+    "default_host_budget_bytes",
+]
+
+
+@dataclass
+class Tuner:
+    """The ``OpWorkflow.train(tuner=...)`` handle — one object that opts a
+    train into the adaptive machinery.
+
+    ``strategy`` is applied to every ModelSelector stage in the DAG for
+    THIS train only (the stage's own setting is restored afterwards, the
+    same contract as ``with_mesh``).  ``auto_plan=True`` additionally asks
+    the cost planner to choose stream-vs-in-core and the chunk geometry
+    when the reader can estimate its row count and the caller didn't pass
+    ``chunk_rows`` explicitly.
+    """
+
+    strategy: str = "halving"          # "halving" | "full"
+    halving: Optional[HalvingConfig] = None
+    auto_plan: bool = False
+    cost_model: Optional[CostModel] = None
+    host_budget_bytes: Optional[int] = None
+
+    def resolved_cost_model(self) -> CostModel:
+        if self.cost_model is None:
+            self.cost_model = CostModel.from_history()
+        return self.cost_model

@@ -1,0 +1,312 @@
+"""Learned per-stage cost model — fitted log-space ridge over run telemetry.
+
+In the spirit of "A Learned Performance Model for TPUs" and "TpuGraphs"
+(PAPERS.md): a small fitted model over cheap static features —
+``(rows, cols, dtype, backend, stage kind)`` — predicts per-stage wall
+well enough to *decide* things (successive-halving promotion budgets,
+bench budgeting, stream-vs-in-core plan choices) without ever running the
+stage.  The features come from the telemetry the repo already records:
+every ``OpWorkflow.train()`` appends its ``PlanProfiler`` stage profiles
+(which since this PR carry rows/cols/dtype/backend/stage-kind) to
+``benchmarks/cost_history.json`` — atomically, tmp + ``os.replace``.
+
+Model shape: one ridge regression per ``(stage_kind, backend)`` bucket in
+log space — ``log(wall) ~ w · [1, log1p(rows), log1p(cols),
+log1p(rows)·log1p(cols)]`` — with a per-``stage_kind`` bucket as the
+first fallback and an analytic elements-per-second law as the cold-start
+fallback, so predictions are always available and only *sharpen* as
+history accumulates.
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "StageObservation", "CostModel", "load_observations",
+    "append_observations", "observations_from_profiler",
+    "record_train_observations", "default_history_path",
+    "HISTORY_OBSERVATION_CAP",
+]
+
+#: FIFO cap on persisted stage observations — bounds the history file and
+#: keeps the fit weighted toward recent code (old implementations of a
+#: stage kind age out instead of anchoring the regression forever)
+HISTORY_OBSERVATION_CAP = 4000
+
+#: key under which stage observations live inside cost_history.json —
+#: sibling to bench.py's per-config entries (which key by config name and
+#: carry "measured_s"), so both consumers share one atomic file
+HISTORY_STAGES_KEY = "stage_observations"
+
+#: analytic cold-start law: host-side columnar transform throughput in
+#: matrix elements/second (conservative; measured host featurizers run
+#: 1e7-1e9 elem/s depending on dtype).  Only used for stage kinds with no
+#: recorded history at all.
+DEFAULT_ELEMS_PER_S = 5e7
+
+#: no stage dispatch is free — floor on any prediction (seconds)
+PREDICTION_FLOOR_S = 1e-4
+
+
+@dataclass
+class StageObservation:
+    """One observed stage execution — the cost model's training row."""
+
+    stage_kind: str          # "OpClass:kind", e.g. "RealVectorizer:transform"
+    rows: int
+    cols: int                # total scalar width of the stage's inputs
+    dtype: str               # primary input dtype ("float32", "object", ...)
+    backend: str             # jax backend serving the run ("cpu", "tpu", ...)
+    wall_s: float
+    t: int = 0               # unix seconds (0 = unknown)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"stageKind": self.stage_kind, "rows": self.rows,
+                "cols": self.cols, "dtype": self.dtype,
+                "backend": self.backend, "wallSecs": round(self.wall_s, 6),
+                "t": self.t}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "StageObservation":
+        return StageObservation(
+            stage_kind=str(d.get("stageKind", "")),
+            rows=int(d.get("rows", 0)), cols=int(d.get("cols", 0)),
+            dtype=str(d.get("dtype", "")),
+            backend=str(d.get("backend", "")),
+            wall_s=float(d.get("wallSecs", 0.0)), t=int(d.get("t", 0)))
+
+
+def _features(rows: int, cols: int) -> np.ndarray:
+    lr = math.log1p(max(rows, 0))
+    lc = math.log1p(max(cols, 0))
+    return np.array([1.0, lr, lc, lr * lc], dtype=np.float64)
+
+
+class CostModel:
+    """Per-stage-kind fitted wall-clock predictor with analytic fallback.
+
+    ``fit`` is a closed-form ridge solve per bucket (4 coefficients), so
+    training on thousands of observations is microseconds — cheap enough
+    to refit from history at the top of every bench/tuning run.
+    """
+
+    def __init__(self, ridge: float = 1e-3, min_obs: int = 1,
+                 elems_per_s: float = DEFAULT_ELEMS_PER_S):
+        self.ridge = ridge
+        self.min_obs = min_obs
+        self.elems_per_s = elems_per_s
+        #: fitted coefficients keyed by (stage_kind, backend), plus a
+        #: backend-agnostic fallback bucket keyed by (stage_kind, None)
+        self._coef: Dict[Tuple[str, Optional[str]], np.ndarray] = {}
+        self._n_obs: Dict[Tuple[str, Optional[str]], int] = {}
+
+    # -- training ------------------------------------------------------------
+
+    def fit(self, observations: Sequence[StageObservation]) -> "CostModel":
+        buckets: Dict[Tuple[str, Optional[str]],
+                      Dict[Tuple[int, int], float]] = {}
+        for o in observations:
+            if o.wall_s <= 0 or not o.stage_kind:
+                continue
+            # duplicates at the same (kind, backend, shape) collapse to
+            # their MINIMUM wall: a stage's first execution in a process
+            # pays XLA compile, which inflates wall upward only — the
+            # scheduler wants the steady-state cost, and min over repeats
+            # is its unbiased-from-above estimate
+            for key in ((o.stage_kind, o.backend or None),
+                        (o.stage_kind, None)):
+                pts = buckets.setdefault(key, {})
+                loc = (o.rows, o.cols)
+                pts[loc] = min(pts.get(loc, float("inf")), o.wall_s)
+        self._coef.clear()
+        self._n_obs.clear()
+        for key, pts in buckets.items():
+            if len(pts) < self.min_obs:
+                continue
+            A = np.stack([_features(r, c) for r, c in pts])
+            b = np.log(np.array(list(pts.values())) + 1e-6)
+            G = A.T @ A + self.ridge * np.eye(A.shape[1])
+            self._coef[key] = np.linalg.solve(G, A.T @ b)
+            self._n_obs[key] = len(pts)
+        return self
+
+    @property
+    def fitted_kinds(self) -> List[str]:
+        return sorted({k for k, be in self._coef if be is None})
+
+    # -- prediction ----------------------------------------------------------
+
+    def analytic(self, rows: int, cols: int) -> float:
+        """Cold-start fallback: an elements/throughput law."""
+        elems = max(rows, 1) * max(cols, 1)
+        return max(elems / self.elems_per_s, PREDICTION_FLOOR_S)
+
+    def predict(self, stage_kind: str, rows: int, cols: int,
+                dtype: str = "float32",
+                backend: Optional[str] = None) -> float:
+        """Predicted wall seconds; never raises, never returns <= 0."""
+        for key in ((stage_kind, backend or None), (stage_kind, None)):
+            w = self._coef.get(key)
+            if w is not None:
+                pred = float(np.exp(w @ _features(rows, cols))) - 1e-6
+                return max(pred, PREDICTION_FLOOR_S)
+        return self.analytic(rows, cols)
+
+    def source(self, stage_kind: str,
+               backend: Optional[str] = None) -> str:
+        """Which estimator answers for this stage kind: 'fitted' (the
+        backend-specific or kind-level ridge) or 'analytic'."""
+        if ((stage_kind, backend or None) in self._coef
+                or (stage_kind, None) in self._coef):
+            return "fitted"
+        return "analytic"
+
+    def predict_total(self, rows: int, cols: int,
+                      backend: Optional[str] = None) -> float:
+        """Sum of per-stage-kind predictions over every fitted kind — a
+        crude whole-pipeline estimate for budgeting when no same-config
+        measured history exists.  0.0 when the model is fully cold (the
+        caller should fall back to its stated assumption)."""
+        kinds = self.fitted_kinds
+        if not kinds:
+            return 0.0
+        return float(sum(self.predict(k, rows, cols, backend=backend)
+                         for k in kinds))
+
+    # -- evaluation ----------------------------------------------------------
+
+    def within_factor(self, observations: Sequence[StageObservation],
+                      factor: float = 2.0,
+                      noise_floor_s: float = 0.005) -> Tuple[float, int]:
+        """Fraction of held-out observations whose prediction lands within
+        ``factor``x of the observed wall (either direction).  Stages under
+        ``noise_floor_s`` also count as hits when the absolute error is
+        under the floor — sub-5ms stage walls are scheduler noise, not
+        model error.  Returns (fraction, n_evaluated)."""
+        hits, n = 0, 0
+        for o in observations:
+            if o.wall_s <= 0 or not o.stage_kind:
+                continue
+            pred = self.predict(o.stage_kind, o.rows, o.cols,
+                                dtype=o.dtype, backend=o.backend)
+            n += 1
+            ratio = max(pred, o.wall_s) / max(min(pred, o.wall_s), 1e-9)
+            if ratio <= factor or abs(pred - o.wall_s) <= noise_floor_s:
+                hits += 1
+        return (hits / n if n else 0.0), n
+
+    # -- history -------------------------------------------------------------
+
+    @classmethod
+    def from_history(cls, path: Optional[str] = None,
+                     **kwargs) -> "CostModel":
+        path = path or default_history_path()
+        obs = load_observations(path) if path else []
+        return cls(**kwargs).fit(obs)
+
+
+# ---------------------------------------------------------------------------
+# History file plumbing (shared with bench.py's per-config entries)
+# ---------------------------------------------------------------------------
+
+def default_history_path() -> Optional[str]:
+    """Where stage observations accumulate.  ``TMOG_COST_HISTORY`` wins
+    (empty or "0" disables recording entirely); otherwise the repo's
+    ``benchmarks/cost_history.json`` when that directory exists next to
+    the package (site-installed copies without a benchmarks/ dir simply
+    don't record)."""
+    env = os.environ.get("TMOG_COST_HISTORY")
+    if env is not None:
+        return None if env in ("", "0") else env
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    bench_dir = os.path.join(root, "benchmarks")
+    if os.path.isdir(bench_dir):
+        return os.path.join(bench_dir, "cost_history.json")
+    return None
+
+
+def load_observations(path: Optional[str]) -> List[StageObservation]:
+    from ..utils.jsonio import read_json_tolerant
+
+    if not path:
+        return []
+    hist = read_json_tolerant(path, {})
+    if not isinstance(hist, dict):
+        return []
+    raw = hist.get(HISTORY_STAGES_KEY, [])
+    out = []
+    for d in raw if isinstance(raw, list) else []:
+        try:
+            out.append(StageObservation.from_json(d))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def append_observations(path: Optional[str],
+                        observations: Sequence[StageObservation],
+                        cap: int = HISTORY_OBSERVATION_CAP) -> bool:
+    """Append stage observations to the shared cost-history file,
+    FIFO-capped, atomically (tmp + ``os.replace``).  Preserves every other
+    key (bench.py's per-config measured entries).  Returns True when a
+    write happened."""
+    from ..utils.jsonio import read_json_tolerant, write_json_atomic
+
+    if not path or not observations:
+        return False
+    hist = read_json_tolerant(path, {})
+    if not isinstance(hist, dict):
+        hist = {}
+    raw = hist.get(HISTORY_STAGES_KEY, [])
+    if not isinstance(raw, list):
+        raw = []
+    raw.extend(o.to_json() for o in observations)
+    hist[HISTORY_STAGES_KEY] = raw[-cap:]
+    try:
+        write_json_atomic(path, hist, indent=2, sort_keys=True)
+    except OSError:
+        return False
+    return True
+
+
+def observations_from_profiler(profiler,
+                               backend: str = "") -> List[StageObservation]:
+    """StageObservations out of a PlanProfiler's stage records (the
+    rows/cols/dtype/backend/stage-kind feature fields landed on
+    ``StageProfile`` in this PR)."""
+    now = int(time.time())
+    out: List[StageObservation] = []
+    for sp in getattr(profiler, "stages", []):
+        if sp.wall_s <= 0:
+            continue
+        out.append(StageObservation(
+            stage_kind=sp.stage_kind or f"{sp.op}:{sp.kind}",
+            rows=sp.rows, cols=max(getattr(sp, "cols", 0), 1),
+            dtype=getattr(sp, "dtype", "") or "",
+            backend=getattr(sp, "backend", "") or backend,
+            wall_s=sp.wall_s, t=now))
+    return out
+
+
+def record_train_observations(profiler,
+                              path: Optional[str] = None) -> bool:
+    """Called by ``OpWorkflow.train()`` after every fit: persist the run's
+    stage profiles into the cost history.  Never raises — telemetry must
+    not break a train."""
+    try:
+        path = path if path is not None else default_history_path()
+        if not path or profiler is None:
+            return False
+        from ..utils.profiling import backend_name
+
+        obs = observations_from_profiler(profiler, backend=backend_name())
+        return append_observations(path, obs)
+    except Exception:
+        return False

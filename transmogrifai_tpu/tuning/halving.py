@@ -1,0 +1,271 @@
+"""Successive-halving model selection over the selector's candidate grid.
+
+The classic successive-halving/hyperband move (Li et al., JMLR 18(185);
+cf. the scheduling framing of "A Learned Performance Model for TPUs" in
+PAPERS.md): fit EVERY candidate cheaply — on a stratified row subsample
+and proportionally reduced boosting rounds — keep the top ``1/eta``
+fraction, and repeat with ``eta``x the resource until the survivors fit
+on the full data.  The full-data final rung is authoritative, so the
+winner's reported metric is always a full-fidelity number; early rungs
+only decide *who gets to spend* full-fidelity compute.
+
+Built on the selector's schedulable sweep queue (``selector.validators.
+SweepWorkQueue``): each rung is one scheduled ``validator.validate`` call
+over the surviving candidates, so the rung inherits the full sweep's CV
+folds, failure isolation, ``max_wait`` budgeting and device batching
+semantics unchanged.
+
+Everything is deterministic: the rung schedule is a pure function of
+``(n_rows, n_candidates, eta, min_rows)``, the nested subsample order is
+seeded and stratified, and promotion ties break toward the lower
+candidate index — two runs on the same data produce byte-identical rung
+schedules and winners.
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["HalvingConfig", "Rung", "rung_schedule",
+           "nested_subsample_order", "halving_validate"]
+
+
+@dataclass
+class HalvingConfig:
+    """Knobs for the successive-halving scheduler (all deterministic)."""
+
+    #: promotion factor: each rung keeps ceil(k/eta) candidates and grows
+    #: the row budget by ~eta
+    eta: int = 3
+    #: smallest rung row budget — below this, subsample metrics are too
+    #: noisy to rank candidates on
+    min_rows: int = 2048
+    #: subsample-order seed (stratified nested prefixes)
+    seed: int = 7
+    #: scale per-candidate iteration params (max_iter/num_round) with the
+    #: rung's row fraction, flooring at ``min_round_frac``
+    scale_rounds: bool = True
+    min_round_frac: float = 0.1
+    #: iteration-count param names eligible for rung scaling
+    round_keys: Tuple[str, ...] = ("max_iter", "num_round")
+    #: below this many candidates halving cannot save anything — fall back
+    #: to the full sweep
+    min_candidates: int = 3
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"eta": self.eta, "minRows": self.min_rows,
+                "seed": self.seed, "scaleRounds": self.scale_rounds,
+                "minRoundFrac": self.min_round_frac,
+                "minCandidates": self.min_candidates}
+
+
+@dataclass
+class Rung:
+    """One rung of the schedule (static part computed up front)."""
+
+    index: int
+    rows: int
+    fraction: float          # rows / n_rows
+    survivors_in: int        # candidates entering this rung
+    survivors_out: int       # candidates promoted out of this rung
+    # filled in during execution:
+    wall_s: float = 0.0
+    candidate_seconds: float = 0.0
+    promoted: List[int] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rung": self.index, "rows": self.rows,
+                "fraction": round(self.fraction, 6),
+                "survivorsIn": self.survivors_in,
+                "survivorsOut": self.survivors_out,
+                "wallSecs": round(self.wall_s, 4),
+                "candidateSeconds": round(self.candidate_seconds, 4),
+                "promoted": list(self.promoted)}
+
+
+def rung_schedule(n_rows: int, n_candidates: int,
+                  config: Optional[HalvingConfig] = None) -> List[Rung]:
+    """The deterministic rung ladder for (n_rows, n_candidates).
+
+    ``s`` reduction steps where ``s = min(steps the rows allow before
+    hitting min_rows, steps the candidate count needs to reach ~1
+    survivor)``; rung ``i`` runs ``ceil(n / eta^(s-i))`` rows with
+    ``ceil(k / eta^i)`` candidates; the final rung is always the full
+    ``n`` rows.  A schedule of length <= 1 means "just run the full
+    sweep" (the caller falls back)."""
+    cfg = config or HalvingConfig()
+    n, k, eta = int(n_rows), int(n_candidates), max(int(cfg.eta), 2)
+    if k < max(cfg.min_candidates, 2) or n <= 0:
+        return []
+    s_rows = int(math.floor(math.log(max(n / max(cfg.min_rows, 1), 1.0),
+                                     eta)))
+    s_cands = int(math.ceil(math.log(k, eta)))
+    s = max(0, min(s_rows, s_cands))
+    if s == 0:
+        return []
+    rungs: List[Rung] = []
+    alive = k
+    for i in range(s + 1):
+        rows = n if i == s else int(math.ceil(n / eta ** (s - i)))
+        out = 1 if i == s else max(1, int(math.ceil(alive / eta)))
+        rungs.append(Rung(index=i, rows=rows, fraction=rows / n,
+                          survivors_in=alive, survivors_out=out))
+        alive = out
+    return rungs
+
+
+def nested_subsample_order(y: np.ndarray, seed: int,
+                           stratify: bool = True) -> np.ndarray:
+    """A permutation of row indices whose every prefix is (approximately)
+    class-stratified — so rung r+1's rows are a superset of rung r's and
+    each rung sees the full label ratio.  Deterministic for (y, seed)."""
+    n = len(y)
+    rng = np.random.default_rng(seed)
+    if not stratify:
+        return rng.permutation(n)
+    keys = np.empty(n, dtype=np.float64)
+    finite = np.isfinite(y)
+    classes = np.unique(y[finite]) if finite.any() else []
+    seen = np.zeros(n, dtype=bool)
+    for cls in classes:
+        idx = np.where(y == cls)[0]
+        perm = rng.permutation(idx)
+        # fractional within-class rank: sorting by it interleaves classes
+        # proportionally, so any prefix holds ~the global label ratio
+        keys[perm] = (np.arange(len(idx)) + rng.random()) / max(len(idx), 1)
+        seen[idx] = True
+    rest = np.where(~seen)[0]
+    if len(rest):
+        perm = rng.permutation(rest)
+        keys[perm] = (np.arange(len(rest)) + rng.random()) / max(len(rest), 1)
+    return np.argsort(keys, kind="stable")
+
+
+def _scaled_params(params: Dict[str, Any], fraction: float,
+                   cfg: HalvingConfig) -> Dict[str, Any]:
+    """Rung-scaled fit params: iteration counts shrink with the row
+    fraction (floored) so early rungs are cheap in BOTH rows and rounds."""
+    if not cfg.scale_rounds or fraction >= 1.0:
+        return params
+    f = max(fraction, cfg.min_round_frac)
+    out = dict(params)
+    for key in cfg.round_keys:
+        v = out.get(key)
+        if isinstance(v, (int, float)) and v > 1:
+            out[key] = max(int(math.ceil(v * f)), 2)
+    return out
+
+
+def halving_validate(
+    validator,
+    candidates: Sequence[Tuple],
+    X: np.ndarray,
+    y: np.ndarray,
+    base_weights: np.ndarray,
+    eval_fn,
+    metric_name: str,
+    larger_better: bool = True,
+    config: Optional[HalvingConfig] = None,
+    stratify: bool = True,
+) -> Tuple[int, List, Dict[str, Any]]:
+    """Run the candidate sweep under successive halving.
+
+    Returns ``(best_index, results, schedule_json)`` where ``results`` has
+    one ValidationResult per ORIGINAL candidate (eliminated candidates
+    keep their last subsample metric, annotated with an ``error`` note so
+    downstream selection and summaries never mistake a subsample score
+    for a full-fidelity one) and ``best_index`` indexes ``candidates``.
+
+    Falls back to one full ``validator.validate`` sweep (recorded in the
+    schedule json) whenever the shape doesn't admit a useful ladder.
+    """
+    cfg = config or HalvingConfig()
+    n, k = len(y), len(candidates)
+    schedule = rung_schedule(n, k, cfg)
+    sched_json: Dict[str, Any] = {"strategy": "halving",
+                                  "config": cfg.to_json(),
+                                  "nRows": n, "nCandidates": k}
+    if not schedule:
+        t0 = time.perf_counter()
+        best, results = validator.validate(
+            candidates, X, y, base_weights, eval_fn, metric_name,
+            larger_better=larger_better)
+        sched_json.update({
+            "fallback": "full sweep (schedule admits no reduction rung)",
+            "rungs": [], "candidateSeconds":
+                round(time.perf_counter() - t0, 4)})
+        return best, results, sched_json
+
+    order = nested_subsample_order(y, cfg.seed, stratify=stratify)
+    worst = float("-inf") if larger_better else float("inf")
+    alive = list(range(k))
+    last_result: Dict[int, Any] = {}
+    eliminated: Dict[int, Rung] = {}
+    total_cand_s = 0.0
+
+    for rung in schedule:
+        full = rung.rows >= n
+        if full:
+            Xs, ys, ws = X, y, base_weights
+        else:
+            idx = np.sort(order[:rung.rows])
+            Xs, ys, ws = X[idx], y[idx], base_weights[idx]
+        rung_cands = []
+        for i in alive:
+            name, params, fitter, *_ = candidates[i]
+            fit_params = params if full else _scaled_params(
+                params, rung.fraction, cfg)
+            rung_cands.append((name, fit_params, fitter))
+        t0 = time.perf_counter()
+        _, results = validator.validate(
+            rung_cands, Xs, ys, ws, eval_fn, metric_name,
+            larger_better=larger_better)
+        rung.wall_s = time.perf_counter() - t0
+        rung.candidate_seconds = rung.wall_s
+        total_cand_s += rung.wall_s
+        scores: Dict[int, float] = {}
+        for i, r in zip(alive, results):
+            # report under the candidate's ORIGINAL params (rung scaling
+            # is an execution detail, not the candidate's identity)
+            r.params = candidates[i][1]
+            last_result[i] = r
+            scores[i] = r.metric_value if r.error is None else worst
+        if full:
+            rung.promoted = list(alive)
+            break
+        sign = -1.0 if larger_better else 1.0
+        ranked = sorted(alive, key=lambda i: (sign * scores[i], i))
+        promoted = sorted(ranked[:rung.survivors_out])
+        rung.promoted = promoted
+        for i in alive:
+            if i not in promoted:
+                eliminated[i] = rung
+        alive = promoted
+
+    for i, rung in eliminated.items():
+        r = last_result[i]
+        note = (f"halving: eliminated at rung {rung.index} "
+                f"({rung.rows} of {n} rows); metric is the subsample "
+                f"score, not a full-data result")
+        r.error = note if r.error is None else f"{note}; {r.error}"
+
+    # winner: best FULL-rung result (ties -> lowest index)
+    final_alive = [i for i in alive if last_result[i].error is None]
+    pool = final_alive or alive
+    sign = -1.0 if larger_better else 1.0
+    best_i = min(pool, key=lambda i: (sign * (
+        last_result[i].metric_value
+        if last_result[i].error is None else worst), i))
+
+    sched_json.update({
+        "rungs": [r.to_json() for r in schedule],
+        "candidateSeconds": round(total_cand_s, 4),
+        "survivors": list(alive),
+        "bestIndex": best_i,
+    })
+    results_out = [last_result[i] for i in range(k)]
+    return best_i, results_out, sched_json

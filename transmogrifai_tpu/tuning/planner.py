@@ -1,0 +1,167 @@
+"""Cost-predicted plan-level choices: stream vs in-core, chunk geometry.
+
+The execution machinery already HAS every knob — ``train(chunk_rows=k,
+prefetch_chunks=p)`` switches to the out-of-core driver and
+``TMOG_STREAM_RETAIN_MB`` bounds block retention — but until now picking
+them was folklore.  This module turns the knobs into a deterministic
+decision from (rows, cols, host budget) plus, when history exists, the
+learned cost model's read-vs-transform rates for the prefetch depth.
+
+Surfaced via ``ExecutionPlan.advise()`` / ``explain(advice=...)``
+(workflow/plan.py) and consumed by ``OpWorkflow.train(tuner=Tuner(
+auto_plan=True))``, which routes to the streaming driver with the advised
+geometry when the advice says "stream".
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .costmodel import CostModel
+
+__all__ = ["PlanAdvice", "advise_plan", "default_host_budget_bytes"]
+
+#: in-core peak is ~this multiple of the packed (N, D) f32 matrix: the
+#: packed output + full-width raw/intermediate columns + device staging
+#: (measured on the titanic-shaped benches; conservative on purpose)
+IN_CORE_PEAK_MULTIPLIER = 3.0
+
+#: target bytes per streamed chunk — big enough to amortize per-chunk
+#: dispatch, small enough that prefetch depth x chunk stays modest
+CHUNK_TARGET_BYTES = 64 << 20
+
+_MIN_CHUNK_ROWS = 1024
+
+
+def default_host_budget_bytes() -> int:
+    """Host-memory budget for plan decisions: ``TMOG_HOST_BUDGET_MB`` or
+    half of physical RAM (leave room for the OS, the device runtime and
+    the allocator's slack), floored at 1 GB."""
+    env = os.environ.get("TMOG_HOST_BUDGET_MB")
+    if env:
+        try:
+            return max(int(float(env) * (1 << 20)), 1 << 20)
+        except ValueError:
+            pass
+    try:
+        total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):  # pragma: no cover
+        total = 8 << 30
+    return max(total // 2, 1 << 30)
+
+
+@dataclass
+class PlanAdvice:
+    """A deterministic plan recommendation with its arithmetic shown."""
+
+    mode: str                       # "in-core" | "stream"
+    rows: int
+    cols: int
+    est_matrix_bytes: int
+    est_in_core_peak_bytes: int
+    host_budget_bytes: int
+    chunk_rows: Optional[int]       # None for in-core
+    prefetch_chunks: int
+    retain_mb: int
+    predicted_wall_s: Optional[float]   # cost-model total; None when cold
+    reasons: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "mode": self.mode, "rows": self.rows, "cols": self.cols,
+            "estMatrixBytes": self.est_matrix_bytes,
+            "estInCorePeakBytes": self.est_in_core_peak_bytes,
+            "hostBudgetBytes": self.host_budget_bytes,
+            "chunkRows": self.chunk_rows,
+            "prefetchChunks": self.prefetch_chunks,
+            "retainMb": self.retain_mb,
+            "predictedWallSecs": (round(self.predicted_wall_s, 3)
+                                  if self.predicted_wall_s else None),
+            "reasons": list(self.reasons),
+        }
+
+    def format(self) -> str:
+        mb = 1 << 20
+        lines = [
+            f"plan advice: {self.mode} "
+            f"(matrix ~{self.est_matrix_bytes / mb:.0f} MB, in-core peak "
+            f"~{self.est_in_core_peak_bytes / mb:.0f} MB vs host budget "
+            f"{self.host_budget_bytes / mb:.0f} MB)"]
+        if self.mode == "stream":
+            lines.append(
+                f"  chunk_rows={self.chunk_rows}, "
+                f"prefetch_chunks={self.prefetch_chunks}, "
+                f"retain_mb={self.retain_mb}")
+        if self.predicted_wall_s:
+            lines.append(
+                f"  cost-model predicted wall ~{self.predicted_wall_s:.1f}s")
+        for r in self.reasons:
+            lines.append(f"  - {r}")
+        return "\n".join(lines)
+
+
+def advise_plan(rows: int, cols: int, dtype_bytes: int = 4,
+                host_budget_bytes: Optional[int] = None,
+                cost_model: Optional[CostModel] = None,
+                backend: Optional[str] = None) -> PlanAdvice:
+    """Pick stream-vs-in-core and the streaming geometry for a workload of
+    ``rows`` x ``cols`` (the packed feature-matrix shape, or the raw
+    column count as a proxy before featurization).
+
+    Pure and deterministic given its inputs: same shape + same budget →
+    same advice, so plans are reproducible and testable.
+    """
+    rows, cols = max(int(rows), 1), max(int(cols), 1)
+    budget = (int(host_budget_bytes) if host_budget_bytes
+              else default_host_budget_bytes())
+    matrix = rows * cols * dtype_bytes
+    peak = int(matrix * IN_CORE_PEAK_MULTIPLIER)
+    reasons: List[str] = []
+    predicted = None
+    if cost_model is not None:
+        total = cost_model.predict_total(rows, cols, backend=backend)
+        predicted = total or None
+
+    if peak <= budget:
+        reasons.append(
+            f"projected in-core peak {peak >> 20} MB fits the "
+            f"{budget >> 20} MB host budget")
+        return PlanAdvice(
+            mode="in-core", rows=rows, cols=cols,
+            est_matrix_bytes=matrix, est_in_core_peak_bytes=peak,
+            host_budget_bytes=budget, chunk_rows=None, prefetch_chunks=2,
+            retain_mb=0, predicted_wall_s=predicted, reasons=reasons)
+
+    row_bytes = max(cols * dtype_bytes, 1)
+    chunk_rows = max(min(CHUNK_TARGET_BYTES // row_bytes, rows),
+                     _MIN_CHUNK_ROWS)
+    prefetch = 2
+    if cost_model is not None:
+        # read-bound pipelines benefit from deeper parse-ahead: compare
+        # the model's ingest-read kinds against its transform kinds
+        kinds = cost_model.fitted_kinds
+        read_s = sum(cost_model.predict(k, chunk_rows, cols,
+                                        backend=backend)
+                     for k in kinds if "read" in k.lower())
+        tx_s = sum(cost_model.predict(k, chunk_rows, cols, backend=backend)
+                   for k in kinds if "transform" in k.lower())
+        if read_s > 0 and tx_s > 0 and read_s > 1.5 * tx_s:
+            prefetch = 4
+            reasons.append(
+                f"cost model predicts read-bound chunks "
+                f"(read ~{read_s:.3f}s vs transform ~{tx_s:.3f}s) — "
+                f"prefetch depth raised to 4")
+    # spill threshold: retained blocks may use ~a quarter of the budget
+    # before the block store spills to disk
+    retain_mb = max(64, int(budget // 4) >> 20)
+    reasons.append(
+        f"projected in-core peak {peak >> 20} MB exceeds the "
+        f"{budget >> 20} MB host budget — streaming with "
+        f"~{CHUNK_TARGET_BYTES >> 20} MB chunks")
+    return PlanAdvice(
+        mode="stream", rows=rows, cols=cols,
+        est_matrix_bytes=matrix, est_in_core_peak_bytes=peak,
+        host_budget_bytes=budget, chunk_rows=int(chunk_rows),
+        prefetch_chunks=prefetch, retain_mb=retain_mb,
+        predicted_wall_s=predicted, reasons=reasons)

@@ -1,0 +1,40 @@
+"""Atomic JSON file IO — tmp + ``os.replace`` (same pattern as
+workflow/checkpoint.py): a killed process can never leave a truncated
+JSON artifact behind, only the previous complete one.
+
+Used by the self-updating cost history (``benchmarks/cost_history.json``,
+tuning/costmodel.py), the bench drivers' ``benchmarks/*_latest.json``
+snapshots, and anything else that persists run telemetry.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+__all__ = ["write_json_atomic", "read_json_tolerant"]
+
+
+def write_json_atomic(path: str, obj: Any, indent: Optional[int] = 2,
+                      sort_keys: bool = False) -> None:
+    """Serialize ``obj`` to ``path`` via a same-directory temp file and
+    ``os.replace`` — the rename is atomic on POSIX, so concurrent readers
+    (and post-crash readers) only ever see a complete document."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(directory, os.path.basename(path) + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent, sort_keys=sort_keys, default=str)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_json_tolerant(path: str, default: Any = None) -> Any:
+    """Load JSON, returning ``default`` on a missing/corrupt file (a
+    history file is advisory state — never worth crashing a run over)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return default if default is not None else {}

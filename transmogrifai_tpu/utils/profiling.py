@@ -25,7 +25,7 @@ __all__ = ["OpStep", "MetricsCollector", "AppMetrics", "StepMetrics",
            "profile_to", "RunCounters", "COUNTERS", "reset_counters",
            "count_upload", "count_fetch", "count_drain", "count_launch",
            "fetch_timed", "StageProfile", "PlanProfiler",
-           "IngestPass", "IngestProfiler", "LintSnapshot"]
+           "IngestPass", "IngestProfiler", "LintSnapshot", "backend_name"]
 
 
 class OpStep(enum.Enum):
@@ -265,13 +265,37 @@ def fetch_timed(x, dtype=None):
     return out
 
 
+_BACKEND_NAME: Optional[str] = None
+
+
+def backend_name() -> str:
+    """The jax backend serving this process, cached after first use (a
+    cost-model feature on every stage profile — one import per stage
+    would be waste)."""
+    global _BACKEND_NAME
+    if _BACKEND_NAME is None:
+        try:
+            import jax
+
+            _BACKEND_NAME = jax.default_backend()
+        except Exception:  # pragma: no cover - jax must be importable
+            _BACKEND_NAME = "unknown"
+    return _BACKEND_NAME
+
+
 @dataclass
 class StageProfile:
     """One executed DAG stage, as recorded by the execution plan
     (workflow/plan.py) — the per-stage analogue of the reference's
     OpSparkListener stage metrics, with TPU-relevant extras: device
     launches dispatched (from ``RunCounters``) and the dataset's column
-    delta (liveness accounting)."""
+    delta (liveness accounting).
+
+    ``cols``/``dtype``/``backend``/``stage_kind`` are the learned cost
+    model's feature fields (tuning/costmodel.py): total scalar width of
+    the stage's inputs, the primary input dtype, the serving jax backend,
+    and the ``"Op:kind"`` bucket key.  Backward-compatible additions —
+    absent in old profiles, defaulted here."""
 
     uid: str
     op: str
@@ -284,6 +308,10 @@ class StageProfile:
     cols_added: int = 0
     cols_dropped: int = 0   # columns freed after this stage's layer
     launches: int = 0       # device dispatches attributed (serial stages only)
+    cols: int = 0           # total scalar input width (matrix cols count)
+    dtype: str = ""         # primary input dtype
+    backend: str = ""       # jax backend for the run
+    stage_kind: str = ""    # cost-model bucket key, "Op:kind"
 
     def to_json(self) -> Dict[str, Any]:
         return {"uid": self.uid, "op": self.op, "output": self.output,
@@ -291,7 +319,10 @@ class StageProfile:
                 "deviceHeavy": self.device_heavy,
                 "wallSecs": round(self.wall_s, 4), "rows": self.rows,
                 "colsAdded": self.cols_added,
-                "colsDropped": self.cols_dropped, "launches": self.launches}
+                "colsDropped": self.cols_dropped, "launches": self.launches,
+                "cols": self.cols, "dtype": self.dtype,
+                "backend": self.backend,
+                "stageKind": self.stage_kind or f"{self.op}:{self.kind}"}
 
 
 #: per-pass chunk records kept verbatim before aggregate-only accounting
@@ -551,14 +582,17 @@ class PlanProfiler:
             stages = list(self.stages)
             peak, final, wall = (self.peak_columns, self.final_columns,
                                  self.wall_s)
+        backend = next((s.backend for s in stages if s.backend), "")
         lines = [f"plan execution: {len(stages)} stages, "
                  f"{wall:.3f}s wall, peak {peak} resident columns "
-                 f"(final {final})"]
+                 f"(final {final})"
+                 + (f", backend={backend}" if backend else "")]
         by_cost = sorted(stages, key=lambda s: -s.wall_s)[:top_k]
         for s in by_cost:
             lines.append(
                 f"  [{s.layer}] {s.kind:<9} {s.op:<24} {s.wall_s*1e3:8.1f} ms"
                 f"  rows={s.rows}  +{s.cols_added}/-{s.cols_dropped} cols"
+                + (f"  w={s.cols}" if s.cols else "")
                 + (f"  launches={s.launches}" if s.launches else "")
                 + ("  [device]" if s.device_heavy else ""))
         if self.ingest is not None:

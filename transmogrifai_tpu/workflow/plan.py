@@ -41,7 +41,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from ..stages.base import Estimator, Model, PipelineStage, Transformer
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..utils.profiling import (COUNTERS, PlanProfiler, StageProfile,
-                               current_collector, install_collector)
+                               backend_name, current_collector,
+                               install_collector)
 
 __all__ = ["ExecutionPlan", "plan_for"]
 
@@ -215,12 +216,31 @@ class ExecutionPlan:
 
     # -- reporting -----------------------------------------------------------
 
-    def explain(self, ingest=None) -> str:
+    def advise(self, rows: int, cols: int, cost_model=None,
+               host_budget_bytes: Optional[int] = None):
+        """Cost-predicted plan-level choices for this DAG at a workload of
+        ``rows`` x ``cols``: stream vs in-core, chunk_rows, prefetch
+        depth, spill threshold (tuning/planner.py).  ``cost_model`` (a
+        tuning.CostModel; default: fitted from the shared history file)
+        adds a predicted-wall line and read-vs-transform prefetch
+        tuning."""
+        from ..tuning.costmodel import CostModel
+        from ..tuning.planner import advise_plan
+
+        if cost_model is None:
+            cost_model = CostModel.from_history()
+        return advise_plan(rows, cols, cost_model=cost_model,
+                           host_budget_bytes=host_budget_bytes,
+                           backend=backend_name())
+
+    def explain(self, ingest=None, advice=None) -> str:
         """Static plan report: per-layer stages, host/device split, liveness
         drops, and the projected peak resident column count.  Pass an
         ``IngestProfiler`` (``model.ingest_profile`` after a chunked
         ``train(chunk_rows=k)``) to append the out-of-core pass counters —
-        per-pass chunks, bytes read, rows/s, overlap efficiency."""
+        per-pass chunks, bytes read, rows/s, overlap efficiency — and/or a
+        ``PlanAdvice`` (``plan.advise(rows, cols)``) to append the cost
+        planner's stream-vs-in-core recommendation."""
         initial, after = self._drops_fit
         lines = [
             f"ExecutionPlan: {sum(len(l) for l in self.layers)} stages over "
@@ -254,6 +274,8 @@ class ExecutionPlan:
                      f"final {resident}")
         if ingest is not None:
             lines.append(ingest.format())
+        if advice is not None:
+            lines.append(advice.format())
         return "\n".join(lines)
 
     # -- execution -----------------------------------------------------------
@@ -408,12 +430,40 @@ class ExecutionPlan:
             if ctx is not None:
                 ctx.__exit__(None, None, None)
         dt = time.perf_counter() - t0
+        width, dtype = _input_shape(stage, data)
+        op = type(stage).__name__
+        # a stage may refine its cost bucket (e.g. the selector's halving
+        # sweeps cost a different law than full sweeps — mixing them would
+        # poison both buckets' fits)
+        cost_kind = (getattr(stage, "_cost_kind", None)
+                     or getattr(result_stage, "_cost_kind", None) or kind)
         prof.record_stage(StageProfile(
-            uid=stage.uid, op=type(stage).__name__, output=name, layer=li,
+            uid=stage.uid, op=op, output=name, layer=li,
             kind=kind, device_heavy=stage.device_heavy, wall_s=dt,
             rows=n_rows, cols_added=1,
-            launches=(COUNTERS.launches - launches0) if serial else 0))
+            launches=(COUNTERS.launches - launches0) if serial else 0,
+            cols=width, dtype=dtype, backend=backend_name(),
+            stage_kind=f"{op}:{cost_kind}"))
         return result_stage, name, col
+
+
+def _input_shape(stage: PipelineStage, data: ColumnarDataset):
+    """(total scalar width, primary dtype) of a stage's inputs — the cost
+    model's feature view of the stage's workload: a vectorizer reading one
+    raw column reports width 1, the selector reading a packed (N, D)
+    matrix reports D.  Zero-copy: reads only shapes/dtypes."""
+    width, dtype = 0, ""
+    for n in stage.input_names:
+        if n not in data:
+            continue
+        v = data[n].values
+        ndim = getattr(v, "ndim", 1)
+        shape = getattr(v, "shape", None)
+        width += int(shape[1]) if (ndim >= 2 and shape
+                                   and len(shape) > 1) else 1
+        if not dtype:
+            dtype = str(getattr(v, "dtype", "") or type(v).__name__)
+    return max(width, 1), dtype
 
 
 #: sentinel: _run_layer/_run_stage execute already-fitted transformers only

@@ -86,7 +86,12 @@ _RETAIN_MB_ENV = "TMOG_STREAM_RETAIN_MB"
 _RETAIN_MB_DEFAULT = 256
 
 
-def _retain_budget_bytes() -> int:
+def _retain_budget_bytes(retain_mb: Optional[float] = None) -> int:
+    """Block-retention budget: an explicit ``retain_mb`` (the cost
+    planner's spill-threshold advice, tuning/planner.py) wins over the
+    env knob wins over the default."""
+    if retain_mb is not None:
+        return int(float(retain_mb) * (1 << 20))
     try:
         mb = float(os.environ.get(_RETAIN_MB_ENV, "") or _RETAIN_MB_DEFAULT)
     except ValueError:
@@ -317,6 +322,7 @@ def fit_dag_streaming(
     prefetch: int = 2,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 16,
+    retain_mb: Optional[float] = None,
 ) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler]:
     """Fit ``dag`` from chunked ingestion; returns (fitted stages in topo
     order, final dataset equivalent to the in-core executor's with the
@@ -619,7 +625,7 @@ def fit_dag_streaming(
                       if s.uid in needed_uids and s.uid not in chain_uids
                       and s.uid not in fuse_uids]
         states = {est.uid: est.begin_fit() for est in fuse_ests}
-        store = _BlockStore(_retain_budget_bytes())
+        store = _BlockStore(_retain_budget_bytes(retain_mb))
 
         def feed_and_capture(ds: ColumnarDataset, _idx: int) -> None:
             update_states(fuse_ests, states, ds)
@@ -755,15 +761,31 @@ def fit_dag_streaming(
     if total_rows is None:
         total_rows = len(data)
     if profiler is not None:
+        from ..utils.profiling import backend_name
+
         for s in (st for layer in prefix for st in layer):
+            op = type(s).__name__
+            kind = stage_kind.get(s.uid, "transform-stream")
+            width = sum(1 for _ in s.input_names) or 1
+            dtype = ""
+            for n in s.input_names:
+                if n in data:
+                    v = data[n].values
+                    shape = getattr(v, "shape", None)
+                    if getattr(v, "ndim", 1) >= 2 and shape:
+                        width += int(shape[1]) - 1
+                    if not dtype:
+                        dtype = str(getattr(v, "dtype", "") or "")
             profiler.record_stage(StageProfile(
-                uid=s.uid, op=type(s).__name__,
+                uid=s.uid, op=op,
                 output=s.get_output().name,
                 layer=stage_layer.get(s.uid, 0),
-                kind=stage_kind.get(s.uid, "transform-stream"),
+                kind=kind,
                 device_heavy=s.device_heavy,
                 wall_s=stage_wall.get(s.uid, 0.0),
-                rows=total_rows or 0, cols_added=1))
+                rows=total_rows or 0, cols_added=1,
+                cols=width, dtype=dtype, backend=backend_name(),
+                stage_kind=f"{op}:{kind}"))
         profiler.note_columns(len(data.columns))
 
     # -- tail: non-streamable suffix runs in-core on the packed dataset ----

@@ -182,7 +182,8 @@ class OpWorkflow(_WorkflowCore):
               prefetch_chunks: int = 2,
               validate: bool = True,
               checkpoint_dir: Optional[str] = None,
-              checkpoint_every_chunks: int = 16) -> "OpWorkflowModel":
+              checkpoint_every_chunks: int = 16,
+              tuner=None) -> "OpWorkflowModel":
         """Fit the workflow.  ``profile=True`` additionally records a
         per-stage execution profile (wall time, rows, columns
         added/dropped, device launches) on the returned model as
@@ -217,19 +218,86 @@ class OpWorkflow(_WorkflowCore):
         where).  A checkpoint from a different reader/pipeline/chunk
         geometry raises ``CheckpointMismatchError`` rather than silently
         blending runs.
+
+        ``tuner`` (a :class:`transmogrifai_tpu.tuning.Tuner`) opts THIS
+        train into the adaptive machinery (docs/tuning.md): every
+        ModelSelector stage runs under the tuner's sweep ``strategy``
+        ("halving" = successive halving over the candidate grid; the
+        stages' own settings are restored afterwards, the ``with_mesh``
+        contract), and with ``auto_plan=True`` the cost planner picks
+        stream-vs-in-core and the chunk geometry when ``chunk_rows`` is
+        not given and the reader can estimate its rows.  ``tuner=None``
+        (default) keeps today's paths byte-identical.
+
+        Every train additionally appends its per-stage (rows, cols,
+        dtype, backend, stage-kind, wall) observations to the shared cost
+        history (``benchmarks/cost_history.json``; ``TMOG_COST_HISTORY``
+        redirects or disables) — the learned cost model's training data.
         """
         from ..utils.profiling import OpStep, with_job_group
 
-        if chunk_rows is not None:
-            return self._train_chunked(chunk_rows, prefetch_chunks, profile,
-                                       validate=validate,
-                                       checkpoint_dir=checkpoint_dir,
-                                       checkpoint_every=checkpoint_every_chunks)
-        if checkpoint_dir is not None:
-            raise ValueError(
-                "checkpoint_dir requires the out-of-core path — pass "
-                "chunk_rows=k as well (the in-core fit has no chunk "
-                "boundaries to checkpoint at)")
+        retain_mb = None
+        if (tuner is not None and getattr(tuner, "auto_plan", False)
+                and chunk_rows is None and self.reader is not None):
+            advice = self._plan_advice(tuner)
+            if advice is not None and advice.mode == "stream":
+                chunk_rows = advice.chunk_rows
+                prefetch_chunks = advice.prefetch_chunks
+                retain_mb = advice.retain_mb
+        tuned_stages = self._apply_tuner(tuner)
+        try:
+            if chunk_rows is not None:
+                return self._train_chunked(
+                    chunk_rows, prefetch_chunks, profile,
+                    validate=validate, checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every_chunks,
+                    retain_mb=retain_mb)
+            if checkpoint_dir is not None:
+                raise ValueError(
+                    "checkpoint_dir requires the out-of-core path — pass "
+                    "chunk_rows=k as well (the in-core fit has no chunk "
+                    "boundaries to checkpoint at)")
+            return self._train_in_core(profile, validate=validate)
+        finally:
+            for s, prev_strategy, prev_halving in tuned_stages:
+                s.strategy = prev_strategy
+                s.halving = prev_halving
+
+    def _plan_advice(self, tuner):
+        """Cost-planner advice for an auto_plan train, or None when the
+        reader cannot estimate its rows (nothing to decide from)."""
+        rows = self.reader.estimate_rows()
+        if not rows:
+            return None
+        from ..tuning.planner import advise_plan
+
+        cols = max(len(self.raw_features()), 1)
+        return advise_plan(rows, cols,
+                           cost_model=tuner.resolved_cost_model(),
+                           host_budget_bytes=tuner.host_budget_bytes)
+
+    def _apply_tuner(self, tuner):
+        """Set the tuner's sweep strategy on every ModelSelector stage for
+        this train; returns (stage, previous strategy, previous halving)
+        records for the caller's restore."""
+        if tuner is None:
+            return []
+        from ..selector.model_selector import ModelSelector
+
+        dag = compute_dag(self.result_features)
+        tuned = []
+        for s in dag.all_stages():
+            if isinstance(s, ModelSelector):
+                tuned.append((s, s.strategy, s.halving))
+                s.strategy = tuner.strategy
+                if tuner.halving is not None:
+                    s.halving = tuner.halving
+        return tuned
+
+    def _train_in_core(self, profile: bool,
+                       validate: bool = True) -> "OpWorkflowModel":
+        from ..utils.profiling import OpStep, with_job_group
+
         with with_job_group(OpStep.DataReadingAndFiltering):
             data = self.generate_raw_data()
             filter_results = None
@@ -292,7 +360,9 @@ class OpWorkflow(_WorkflowCore):
                        profile: bool,
                        validate: bool = True,
                        checkpoint_dir: Optional[str] = None,
-                       checkpoint_every: int = 16) -> "OpWorkflowModel":
+                       checkpoint_every: int = 16,
+                       retain_mb: Optional[float] = None
+                       ) -> "OpWorkflowModel":
         """The out-of-core train: chunked ingestion + streaming two-pass
         fit + in-core tail (see workflow/streaming.py)."""
         from ..utils.profiling import OpStep, PlanProfiler, with_job_group
@@ -318,7 +388,9 @@ class OpWorkflow(_WorkflowCore):
                 if hasattr(s, "with_mesh"):
                     meshed_stages.append((s, getattr(s, "mesh", None)))
                     s.with_mesh(self.mesh)
-        profiler = PlanProfiler() if profile else None
+        # a profiler always runs (its per-stage timings feed the learned
+        # cost model's history); it lands on the model only when asked for
+        profiler = PlanProfiler()
         try:
             with with_job_group(OpStep.FeatureEngineering):
                 fitted, transformed, ingest = fit_dag_streaming(
@@ -327,7 +399,8 @@ class OpWorkflow(_WorkflowCore):
                     fitted_substitutes=dict(self._model_stages),
                     profiler=profiler, prefetch=prefetch,
                     checkpoint_dir=checkpoint_dir,
-                    checkpoint_every=checkpoint_every)
+                    checkpoint_every=checkpoint_every,
+                    retain_mb=retain_mb)
         finally:
             for s, prev in meshed_stages:
                 s.with_mesh(prev)
@@ -337,20 +410,24 @@ class OpWorkflow(_WorkflowCore):
             train_data=transformed,
         )
         model.reader = self.reader
-        model.train_profile = profiler
+        model.train_profile = profiler if profile else None
         model.ingest_profile = ingest
         model.lint_snapshot = lint_snap
-        if profiler is not None:
-            profiler.lint = lint_snap
+        profiler.lint = lint_snap
         from ..models.trees import clear_sweep_caches
         clear_sweep_caches()
+        from ..tuning.costmodel import record_train_observations
+        record_train_observations(profiler)
         return model
 
     def _train_inner(self, data, dag, filter_results,
                      profile: bool = False) -> "OpWorkflowModel":
         from ..utils.profiling import OpStep, PlanProfiler, with_job_group
 
-        profiler = PlanProfiler() if profile else None
+        # a profiler always runs (the per-stage wall/rows/cols/dtype
+        # records feed the learned cost model's shared history,
+        # tuning/costmodel.py); it lands on the model only when asked for
+        profiler = PlanProfiler()
         substitutes = dict(self._model_stages)
         if self._workflow_cv:
             # OpWorkflow.fitStages CV path (OpWorkflow.scala:403-453):
@@ -379,12 +456,14 @@ class OpWorkflow(_WorkflowCore):
         )
         model.reader = self.reader
         model.raw_feature_filter_results = filter_results
-        model.train_profile = profiler
+        model.train_profile = profiler if profile else None
         # drop the sweep's upload/binning memos: their device buffers are
         # only useful within one train and holding them pressures HBM on
         # subsequent trains (measured a 6x slowdown at 1M rows)
         from ..models.trees import clear_sweep_caches
         clear_sweep_caches()
+        from ..tuning.costmodel import record_train_observations
+        record_train_observations(profiler)
         return model
 
     def _validate_stages(self, dag: StagesDAG) -> None:
