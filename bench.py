@@ -139,6 +139,37 @@ def _backend_name() -> str:
         return f"unavailable({type(e).__name__})"
 
 
+def _is_backend_unavailable(e: BaseException) -> bool:
+    """True when an exception says the accelerator BACKEND is missing/
+    broken — as opposed to a workload failure.  Matches both init-time
+    probes and the mid-train shapes BENCH_r05 hit (``RuntimeError: Unable
+    to initialize backend 'axon'`` escaping from inside ``wf.train()``'s
+    sanity_checker ``col_stats``)."""
+    msg = f"{type(e).__name__}: {e}"
+    needles = ("Unable to initialize backend",
+               "backend setup/compile error",
+               "No visible TPU", "failed to connect to all addresses",
+               "UNAVAILABLE: TPU")
+    return any(s in msg for s in needles)
+
+
+def _backend_failover(e: BaseException, where: str) -> None:
+    """Re-exec this process pinned to ``JAX_PLATFORMS=cpu``.
+
+    Platform choice latches at first jax use, so an in-process switch is
+    not possible — init-time AND mid-train backend losses both land here
+    (the PR 2 failover only guarded init; BENCH_r05 crashed with rc=1
+    when the backend died inside ``wf.train()``).  The retry marker
+    guarantees a single failover, and every JSON line the retried run
+    emits carries ``"backend_fallback": true``."""
+    _log(f"backend unavailable during {where} "
+         f"({type(e).__name__}: {str(e)[:200]}); "
+         f"re-executing with JAX_PLATFORMS=cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["TMOG_BENCH_BACKEND_RETRY"] = "1"
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
 def _ensure_backend() -> None:
     """Fail over to CPU when the configured backend cannot initialize.
 
@@ -146,9 +177,8 @@ def _ensure_backend() -> None:
     UNAVAILABLE: TPU backend setup/compile error`` (rc=1, no JSON line).
     A backend-init failure is an environment fact, not a workload result —
     probe once up front and, on failure, re-exec this process pinned to
-    ``JAX_PLATFORMS=cpu`` (platform choice latches at first jax use, so an
-    in-process switch is not possible).  The retry is marked in the env to
-    guarantee a single failover, and the emitted JSON carries ``backend``.
+    ``JAX_PLATFORMS=cpu``.  ``_guarded`` extends the same failover to
+    backend losses that surface mid-train.
     """
     if os.environ.get("TMOG_BENCH_BACKEND_RETRY") == "1":
         return
@@ -156,11 +186,7 @@ def _ensure_backend() -> None:
         import jax
         jax.devices()
     except Exception as e:
-        _log(f"backend init FAILED ({type(e).__name__}: {str(e)[:200]}); "
-             f"retrying with JAX_PLATFORMS=cpu")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["TMOG_BENCH_BACKEND_RETRY"] = "1"
-        os.execv(sys.executable, [sys.executable] + sys.argv)
+        _backend_failover(e, "backend init")
 
 
 def _log(msg):
@@ -278,16 +304,33 @@ def run_titanic() -> dict:
     }
 
 
+def _guarded(fn, where: str):
+    """Run one config body; a backend-unavailable error raised MID-RUN
+    (not just at init) triggers the same re-exec-to-CPU failover as the
+    init probe — any other exception propagates to the caller's own
+    handling.  No-op guard once already failed over."""
+    try:
+        return fn()
+    except Exception as e:
+        if (_is_backend_unavailable(e)
+                and os.environ.get("TMOG_BENCH_BACKEND_RETRY") != "1"):
+            _backend_failover(e, where)
+        raise
+
+
 def main():
     budget = float(os.environ.get("TMOG_BENCH_BUDGET_S", "1800"))
     _ensure_backend()
     backend = _backend_name()
-    results = {"titanic": run_titanic()}
+    fell_back = os.environ.get("TMOG_BENCH_BACKEND_RETRY") == "1"
+    results = {"titanic": _guarded(run_titanic, "titanic train")}
     headline = dict(results["titanic"])
 
     def flush():
         line = dict(headline)
         line["backend"] = backend
+        if fell_back:
+            line["backend_fallback"] = True
         line["peak_rss_mb"] = _peak_rss_mb()
         line["configs"] = results
         line["elapsed_s"] = round(_elapsed(), 1)
@@ -346,9 +389,12 @@ def main():
         _log(f"{name}: {which_grid} grid @ {rows} x {cols}")
         t0 = time.perf_counter()
         try:
-            d = bench_scale.run(rows, cols, folds=3, which_grid=which_grid,
-                                warmup=warmup,
-                                baseline_s=sb.get("baseline_s", 1800.0))
+            d = _guarded(
+                lambda: bench_scale.run(rows, cols, folds=3,
+                                        which_grid=which_grid, warmup=warmup,
+                                        baseline_s=sb.get("baseline_s",
+                                                          1800.0)),
+                f"{name} train")
         except Exception as e:  # record the failure, keep the suite alive
             results[name] = {"error": f"{type(e).__name__}: {e}"[:500],
                              "elapsed_s": round(time.perf_counter() - t0, 1)}

@@ -34,6 +34,15 @@ else
   echo "TUNING_SMOKE=FAILED (see /tmp/_t1_tuning.log)"
   rc=1
 fi
+# multichip smoke: the sharded selector sweep on 8 forced host devices —
+# tiny shape, winner/metric parity against the single-device sweep
+# asserted inside the script (rc!=0 on parity failure)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python examples/bench_multichip.py --smoke > /tmp/_t1_multichip.log 2>&1; then
+  echo "MULTICHIP_SMOKE=ok $(grep -ao '"parity_ok": true' /tmp/_t1_multichip.log | tail -1)"
+else
+  echo "MULTICHIP_SMOKE=FAILED (see /tmp/_t1_multichip.log)"
+  rc=1
+fi
 # self-lint: trace-safety over the shipped package + examples, DAG lint of
 # the example pipeline factory — any finding fails the script
 if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m transmogrifai_tpu.lint \

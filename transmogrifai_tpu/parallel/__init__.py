@@ -1,16 +1,24 @@
 """Mesh / sharding substrate (SURVEY §2.12, §5.8 — Spark → JAX mapping)."""
+from .ingest import ShardedMatrixWriter, stream_to_mesh
 from .mesh import (
-    data_sharding, feature_sharding, make_mesh, matrix_sharding,
-    pad_to_multiple, replicated, shard_dataset,
+    auto_grid_axis, data_sharding, feature_sharding, fold_weight_sharding,
+    grid_sharding, has_grid_axis, make_mesh, make_sweep_mesh,
+    matrix_sharding, pad_to_multiple, replicated, shard_dataset,
+    shard_sweep_inputs, sweep_matrix_sharding,
 )
 from .sharded import (
-    TrainStepState, colstats_corr_sharded, fit_logreg_sharded,
-    full_train_step, grow_forest_sharded, make_train_step,
+    TrainStepState, colstats_corr_sharded, colstats_psum,
+    fit_logreg_newton_psum, fit_logreg_sharded, full_train_step,
+    grow_forest_sharded, histogram_psum, make_train_step,
 )
 
 __all__ = [
-    "make_mesh", "data_sharding", "feature_sharding", "matrix_sharding",
-    "replicated", "shard_dataset", "pad_to_multiple",
+    "make_mesh", "make_sweep_mesh", "auto_grid_axis", "has_grid_axis",
+    "data_sharding", "feature_sharding", "matrix_sharding",
+    "sweep_matrix_sharding", "grid_sharding", "fold_weight_sharding",
+    "replicated", "shard_dataset", "pad_to_multiple", "shard_sweep_inputs",
     "TrainStepState", "full_train_step", "make_train_step",
     "fit_logreg_sharded", "grow_forest_sharded", "colstats_corr_sharded",
+    "colstats_psum", "fit_logreg_newton_psum", "histogram_psum",
+    "ShardedMatrixWriter", "stream_to_mesh",
 ]

@@ -1,0 +1,190 @@
+"""Streaming ingest into mesh-sharded device buffers.
+
+ROADMAP item 1's memory half: the out-of-core driver (workflow/
+streaming.py) already streams bounded chunks, but the packed (N, D)
+feature matrix still materialized as ONE host buffer before any sharded
+fit could begin — at 10M+ rows the host copy, not HBM, was the binding
+constraint.  This module closes the gap: row chunks are accumulated ONLY
+up to one data-shard slice, each completed slice is ``device_put`` to its
+shard's devices immediately and the host buffer is reused, and the final
+global array is assembled zero-copy from the per-device buffers with
+``jax.make_array_from_single_device_arrays``.  Peak host residency for
+the matrix is one shard (N/ndata rows) plus one in-flight chunk, never
+the full (N, D) — measured in examples/bench_multichip.py.
+
+Rows zero-pad to tile the data axis; callers carry the true row count and
+zero weights for the tail (the standard ``pad_to_multiple`` contract —
+pad rows are inert in every weighted reduction).
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ShardedMatrixWriter", "ShardedMatrix", "stream_to_mesh"]
+
+
+class ShardedMatrix:
+    """A row-sharded device matrix posing as a host array of its TRUE row
+    count.
+
+    The streaming driver hands the packed feature matrix to the rest of
+    the pipeline as a dataset column; this wrapper keeps the device
+    residency (``.x_dev`` — the mesh-padded, row-sharded ``jax.Array``)
+    while reporting the unpadded shape to shape-only consumers and
+    materializing a trimmed host copy for ``np.asarray`` consumers.  The
+    mesh sweep (ModelSelector with a sweep mesh) unwraps ``x_dev``
+    directly and pads labels/weights instead, so the matrix never makes a
+    host round trip on the sharded path.
+    """
+
+    def __init__(self, x_dev, rows: int):
+        self.x_dev = x_dev
+        self._rows = int(rows)
+
+    @property
+    def shape(self):
+        return (self._rows,) + tuple(self.x_dev.shape[1:])
+
+    @property
+    def ndim(self) -> int:
+        return self.x_dev.ndim
+
+    @property
+    def dtype(self):
+        return self.x_dev.dtype
+
+    @property
+    def size(self) -> int:
+        n = self._rows
+        for s in self.x_dev.shape[1:]:
+            n *= int(s)
+        return n
+
+    def __len__(self) -> int:
+        return self._rows
+
+    def __array__(self, dtype=None, copy=None):
+        host = np.asarray(self.x_dev)[:self._rows]
+        return host.astype(dtype) if dtype is not None else host
+
+
+class ShardedMatrixWriter:
+    """Append row chunks; get back a row-sharded global device array.
+
+    The writer targets a (data, ...) mesh's row sharding
+    (``sweep_matrix_sharding`` for 2-D values, ``data_sharding`` for
+    1-D): rows land in the data-shard slice covering their global
+    position, each slice uploads as soon as it fills, and ``finish()``
+    stitches the committed per-device buffers into one global
+    ``jax.Array``.  Appends must be in row order (the streaming driver's
+    chunks are).
+    """
+
+    def __init__(self, mesh, total_rows: int, cols: Optional[int],
+                 dtype=np.float32):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.ndata = mesh.shape[mesh.axis_names[0]]
+        self.rows = int(total_rows)
+        self.cols = cols                       # None -> 1-D vector
+        self.dtype = np.dtype(dtype)
+        pad = (-self.rows) % self.ndata
+        self.padded_rows = self.rows + pad
+        self.shard_rows = self.padded_rows // self.ndata
+        self.n_pad = pad
+        spec = (P(mesh.axis_names[0], None) if cols is not None
+                else P(mesh.axis_names[0]))
+        self.sharding = NamedSharding(mesh, spec)
+        shape = ((self.padded_rows, cols) if cols is not None
+                 else (self.padded_rows,))
+        self.global_shape = shape
+        # device -> global row slice start, from the sharding itself (the
+        # authoritative layout — replicated grid/model lanes map to the
+        # same row range and receive the same host buffer)
+        self._dev_start = {
+            dev: (idx[0].start or 0)
+            for dev, idx in self.sharding.addressable_devices_indices_map(
+                shape).items()}
+        self._buf = np.zeros(
+            (self.shard_rows, cols) if cols is not None
+            else (self.shard_rows,), self.dtype)
+        self._shard_i = 0
+        self._fill = 0
+        self._committed = {}                   # device -> device buffer
+        self._jax = jax
+
+    @property
+    def offset(self) -> int:
+        return self._shard_i * self.shard_rows + self._fill
+
+    def _flush_shard(self) -> None:
+        start = self._shard_i * self.shard_rows
+        for dev, s in self._dev_start.items():
+            if s == start:
+                self._committed[dev] = self._jax.device_put(self._buf, dev)
+        self._shard_i += 1
+        self._fill = 0
+        if self._shard_i < self.ndata:
+            # fresh buffer: the committed device array must not alias the
+            # host memory the next shard overwrites
+            self._buf = np.zeros_like(self._buf)
+
+    def append(self, chunk: np.ndarray) -> None:
+        arr = np.asarray(chunk, self.dtype)
+        k = arr.shape[0]
+        if self.offset + k > self.rows:
+            raise ValueError(
+                f"append past declared total_rows={self.rows} "
+                f"(offset {self.offset} + chunk {k})")
+        pos = 0
+        while pos < k:
+            room = self.shard_rows - self._fill
+            take = min(room, k - pos)
+            self._buf[self._fill:self._fill + take] = arr[pos:pos + take]
+            self._fill += take
+            pos += take
+            if self._fill == self.shard_rows:
+                self._flush_shard()
+
+    def finish(self):
+        """The global row-sharded array (pad rows zero-filled)."""
+        if self.offset != self.rows:
+            raise ValueError(
+                f"finish() at offset {self.offset}, expected "
+                f"{self.rows} rows")
+        if self._shard_i < self.ndata:
+            # zero-fill the pad tail of the last shard(s)
+            self._buf[self._fill:] = 0
+            self._fill = self.shard_rows
+            self._flush_shard()
+            while self._shard_i < self.ndata:
+                self._buf[:] = 0
+                self._fill = self.shard_rows
+                self._flush_shard()
+        devs = list(self.sharding.addressable_devices_indices_map(
+            self.global_shape))
+        arrays = [self._committed[d] for d in devs]
+        out = self._jax.make_array_from_single_device_arrays(
+            self.global_shape, self.sharding, arrays)
+        self._committed = {}
+        self._buf = None
+        return out
+
+
+def stream_to_mesh(chunks: Iterable[np.ndarray], mesh, total_rows: int,
+                   cols: int, dtype=np.float32) -> Tuple[object, np.ndarray]:
+    """Feed an iterator of (k, cols) row chunks straight into per-shard
+    device buffers.  Returns ``(X_dev, valid)`` — the row-sharded global
+    matrix and the host (padded_rows,) 0/1 validity vector callers fold
+    into their sample weights so pad rows stay inert."""
+    w = ShardedMatrixWriter(mesh, total_rows, cols, dtype)
+    for chunk in chunks:
+        w.append(chunk)
+    X_dev = w.finish()
+    valid = np.zeros(w.padded_rows, np.float32)
+    valid[:total_rows] = 1.0
+    return X_dev, valid

@@ -31,31 +31,94 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
-    "make_mesh", "data_sharding", "feature_sharding", "matrix_sharding",
-    "replicated", "shard_dataset", "pad_to_multiple",
+    "make_mesh", "make_sweep_mesh", "auto_grid_axis", "has_grid_axis",
+    "data_sharding", "feature_sharding", "matrix_sharding",
+    "sweep_matrix_sharding", "grid_sharding", "fold_weight_sharding",
+    "replicated", "shard_dataset", "pad_to_multiple", "shard_sweep_inputs",
+    "shard_map_compat",
 ]
 
 
 def make_mesh(n_devices: Optional[int] = None,
               axis_names: Tuple[str, str] = ("data", "model"),
-              model_parallelism: Optional[int] = None) -> Mesh:
-    """Build a 2-D (data, model) mesh over the available devices.
+              model_parallelism: Optional[int] = None,
+              queue_width: Optional[int] = None) -> Mesh:
+    """Build a 2-D mesh over the available devices.
 
-    ``model_parallelism`` defaults to 1 (pure data parallel) unless the
-    device count is not a power-of-two multiple of it.  Tabular workloads
-    are row-dominated; the model axis exists for wide-feature sharding of
-    histogram builds and (D,D) normal-equation work.
+    The default is the (data, model) mesh: ``model_parallelism`` defaults
+    to 1 (pure data parallel) unless the device count is not a
+    power-of-two multiple of it.  Tabular workloads are row-dominated; the
+    model axis exists for wide-feature sharding of histogram builds and
+    (D,D) normal-equation work.
+
+    ``axis_names=("data", "grid")`` builds the SWEEP mesh instead: the
+    second axis packs hyperparameter-grid candidates (vmapped same-family
+    batches, selector.grid_groups) rather than feature columns.
+    ``model_parallelism`` then names the grid-axis size; when omitted it
+    is auto-selected from ``queue_width`` — the number of schedulable
+    sweep units — via :func:`auto_grid_axis`.
     """
     devs = jax.devices()
     n = n_devices if n_devices is not None else len(devs)
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")
     devs = devs[:n]
-    mp = model_parallelism or 1
+    mp = model_parallelism
+    if mp is None:
+        mp = (auto_grid_axis(n, queue_width)
+              if axis_names[1] == "grid" and queue_width is not None else 1)
     if n % mp != 0:
-        raise ValueError(f"n_devices={n} not divisible by model_parallelism={mp}")
+        raise ValueError(
+            f"n_devices={n} not divisible by "
+            f"{axis_names[1]}_parallelism={mp}")
     arr = np.asarray(devs).reshape(n // mp, mp)
     return Mesh(arr, axis_names)
+
+
+def auto_grid_axis(n_devices: int, queue_width: Optional[int]) -> int:
+    """Grid-axis size for a (data, grid) sweep mesh.
+
+    Rows dominate tabular sweep cost, so the data axis keeps at least
+    half the devices; the grid axis takes power-of-two lanes up to the
+    queue width (lanes beyond the candidate count would only hold
+    padding candidates).  Deterministic in (n_devices, queue_width).
+    """
+    if not queue_width or queue_width <= 1 or n_devices <= 1:
+        return 1
+    g = 1
+    while (g * 2 <= max(n_devices // 2, 1) and g * 2 <= queue_width
+           and n_devices % (g * 2) == 0):
+        g *= 2
+    return g
+
+
+def make_sweep_mesh(queue_width: int, n_devices: Optional[int] = None,
+                    grid_parallelism: Optional[int] = None) -> Mesh:
+    """The ("data", "grid") mesh for a selector sweep of ``queue_width``
+    schedulable units (SweepWorkQueue) — shape auto-selected unless
+    ``grid_parallelism`` pins the grid axis."""
+    return make_mesh(n_devices, axis_names=("data", "grid"),
+                     model_parallelism=grid_parallelism,
+                     queue_width=queue_width)
+
+
+def has_grid_axis(mesh) -> bool:
+    """True for a sweep mesh (second axis packs grid candidates)."""
+    return mesh is not None and "grid" in getattr(mesh, "axis_names", ())
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = False):
+    """``shard_map`` across jax versions: >= 0.6 exports it top-level with
+    ``check_vma``; the 0.4.x line ships ``jax.experimental.shard_map``
+    with ``check_rep``.  Semantics are identical for these kernels."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:
@@ -71,6 +134,23 @@ def feature_sharding(mesh: Mesh) -> NamedSharding:
 def matrix_sharding(mesh: Mesh) -> NamedSharding:
     """The (N, D) feature matrix: rows over data axis, columns over model."""
     return NamedSharding(mesh, P(mesh.axis_names[0], mesh.axis_names[1]))
+
+
+def sweep_matrix_sharding(mesh: Mesh) -> NamedSharding:
+    """The (N, D) matrix on a SWEEP mesh: rows over the data axis, columns
+    replicated (the grid axis packs candidates, not features)."""
+    return NamedSharding(mesh, P(mesh.axis_names[0], None))
+
+
+def grid_sharding(mesh: Mesh) -> NamedSharding:
+    """A per-candidate (C, ...) batch sharded over the grid axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[1]))
+
+
+def fold_weight_sharding(mesh: Mesh) -> NamedSharding:
+    """A stacked (F, N) fold-weight matrix: folds replicated, rows over
+    the data axis (matches the row sharding of the matrix it masks)."""
+    return NamedSharding(mesh, P(None, mesh.axis_names[0]))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -107,7 +187,9 @@ def shard_dataset(X: np.ndarray, y: Optional[np.ndarray], mesh: Mesh,
     from ..models.trees import _dev_memo_sharded
 
     ndata = mesh.shape[mesh.axis_names[0]]
-    nmodel = mesh.shape[mesh.axis_names[1]]
+    grid_mesh = has_grid_axis(mesh)
+    # a sweep mesh's second axis packs candidates, never feature columns
+    nmodel = 1 if grid_mesh else mesh.shape[mesh.axis_names[1]]
     n_rows = X.shape[0]
     if w is None:
         w = np.ones(n_rows, np.float32)
@@ -117,10 +199,41 @@ def shard_dataset(X: np.ndarray, y: Optional[np.ndarray], mesh: Mesh,
     # content-memoized: the selector sweep re-shards the same fold matrices
     # for every grid candidate, and each redundant sharded upload costs
     # seconds of tunnel transfer
-    X_dev = _dev_memo_sharded(X, matrix_sharding(mesh), "shard_X")
+    xs = sweep_matrix_sharding(mesh) if grid_mesh else matrix_sharding(mesh)
+    X_dev = _dev_memo_sharded(X, xs, "shard_X")
     w_dev = _dev_memo_sharded(w, data_sharding(mesh), "shard_w")
     y_dev = None
     if y is not None:
         y_pad, _ = pad_to_multiple(np.asarray(y, np.float32), ndata, axis=0)
         y_dev = _dev_memo_sharded(y_pad, data_sharding(mesh), "shard_y")
     return X_dev, y_dev, w_dev
+
+
+def shard_sweep_inputs(X: np.ndarray, y: np.ndarray, mesh: Mesh,
+                       fold_weights: Optional[np.ndarray] = None):
+    """Commit a sweep's shared inputs onto a (data, grid) mesh.
+
+    Rows zero-pad to tile the data axis; the pad rows carry ZERO weight in
+    every stacked fold row, which makes them inert through the weighted
+    column stats, the Newton/majorization Gram products and the histogram
+    builds — sharded sweep results are invariant to the pad amount
+    (property-tested in tests/test_parallel_mesh.py).
+
+    Returns ``(X_dev, y_dev, W_dev)`` where ``W_dev`` is the (F, N_pad)
+    stacked fold-weight matrix (None when ``fold_weights`` is None).
+    """
+    from ..models.trees import _dev_memo_sharded
+
+    ndata = mesh.shape[mesh.axis_names[0]]
+    Xp, _ = pad_to_multiple(np.asarray(X, np.float32), ndata, axis=0)
+    yp, _ = pad_to_multiple(
+        np.nan_to_num(np.asarray(y, np.float32)), ndata, axis=0)
+    X_dev = _dev_memo_sharded(Xp, sweep_matrix_sharding(mesh), "sweep_X")
+    y_dev = _dev_memo_sharded(yp, data_sharding(mesh), "sweep_y")
+    W_dev = None
+    if fold_weights is not None:
+        Wp, _ = pad_to_multiple(
+            np.ascontiguousarray(np.asarray(fold_weights, np.float32)),
+            ndata, axis=1)
+        W_dev = _dev_memo_sharded(Wp, fold_weight_sharding(mesh), "sweep_W")
+    return X_dev, y_dev, W_dev

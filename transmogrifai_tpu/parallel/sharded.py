@@ -30,7 +30,8 @@ from .mesh import (
 
 __all__ = ["TrainStepState", "full_train_step", "make_train_step",
            "fit_logreg_sharded", "grow_forest_sharded",
-           "colstats_corr_sharded"]
+           "colstats_corr_sharded", "colstats_psum",
+           "fit_logreg_newton_psum", "histogram_psum"]
 
 
 class TrainStepState(NamedTuple):
@@ -135,7 +136,7 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
     ``forest_chunk_size(compact=False)`` with this shard's row count bounds
     how many trees one launch vmaps over (ADVICE r1).
     """
-    from jax import shard_map
+    from .mesh import shard_map_compat
 
     from ..models.gbdt_kernels import _grow_tree_traced
 
@@ -161,12 +162,11 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
         f, t, lf, _ = jax.vmap(fn)(G, H, BW_s, mask_r, limit_r)
         return f, t, lf
 
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(data_axis, None), P(data_axis, None), P(None, data_axis),
-                  P(None, None), P(None)),
-        out_specs=(P(None, None), P(None, None), P(None, None, None)),
-        check_vma=False)
+    fn = shard_map_compat(
+        shard_fn, mesh,
+        (P(data_axis, None), P(data_axis, None), P(None, data_axis),
+         P(None, None), P(None)),
+        (P(None, None), P(None, None), P(None, None, None)))
     # compact=False: the all-reduce path keeps the full 2^level slot layout
     # (no node compaction — shards must agree on histogram indices), so the
     # budget uses the uncompacted slot count with this shard's row count.
@@ -198,6 +198,157 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
     if len(fs) == 1:
         return fs[0], ts[0], ls[0]
     return (jnp.concatenate(fs), jnp.concatenate(ts), jnp.concatenate(ls))
+
+
+# ---------------------------------------------------------------------------
+# Explicit-collective rewrites of the sweep's inner steps (ROADMAP item 1):
+# shard_map programs where each device reduces ITS rows and one psum over
+# the data axis replaces the driver-side reduce — the hand-written form of
+# what GSPMD derives for the whole-array paths above, kept explicit so the
+# per-shard partial/psum contract (zero-weight pad rows are inert, results
+# invariant to pad amount) is directly testable.
+# ---------------------------------------------------------------------------
+
+def colstats_psum(X, w, mesh: Mesh):
+    """Weighted per-column (mean, var) with explicit per-shard partials.
+
+    Each shard computes (sum w, w@X, w@X^2) over its rows; one ``psum``
+    over the data axis merges them — the shard_map rewrite of
+    ``_colstats`` (numerically identical: the reduction order over shards
+    is fixed by the mesh).  Zero-weight rows (padding) contribute exactly
+    nothing to every partial.
+    """
+    from .mesh import shard_map_compat
+
+    data_axis = mesh.axis_names[0]
+
+    def shard_fn(X_s, w_s):
+        part = jnp.stack([jnp.concatenate([w_s.sum()[None], w_s @ X_s]),
+                          jnp.concatenate([jnp.zeros((1,), X_s.dtype),
+                                           w_s @ (X_s * X_s)])])
+        tot = lax.psum(part, axis_name=data_axis)
+        wsum = jnp.maximum(tot[0, 0], 1.0)
+        mean = tot[0, 1:] / wsum
+        var = tot[1, 1:] / wsum - mean ** 2
+        return mean, var
+
+    fn = shard_map_compat(shard_fn, mesh,
+                          (P(data_axis, None), P(data_axis)),
+                          (P(None), P(None)))
+    return jax.jit(fn)(X, w)
+
+
+def fit_logreg_newton_psum(X, y, mesh: Mesh, w=None, reg_param: float = 0.0,
+                           max_iter: int = 50, tol: float = 1e-6):
+    """Newton-IRLS logistic regression with per-shard Gram/gradient
+    partials ``psum``-merged over the data axis — the explicit shard_map
+    form of ``models.linear.fit_logistic_regression``'s L2 path (L1
+    callers use the whole-array ``fit_logreg_sharded``).
+
+    Each iteration: every shard computes its rows' (D+1, D+1) weighted
+    Gram and (D+1,) gradient partials, one psum each merges them, and the
+    replicated (D+1) solve runs identically on every device.  Zero-weight
+    pad rows are inert in both partials, so the fit is invariant to the
+    row-padding used to tile the mesh.  Returns host (coef, intercept).
+    """
+    from .mesh import shard_map_compat
+
+    from ..models.linear import _damped_solve, _finite_or
+    from .mesh import data_sharding, pad_to_multiple, sweep_matrix_sharding
+
+    X = np.asarray(X, np.float32)
+    n, d = X.shape
+    if w is None:
+        w = np.ones(n, np.float32)
+    ndata = mesh.shape[mesh.axis_names[0]]
+    Xp, _ = pad_to_multiple(X, ndata, axis=0)
+    yp, _ = pad_to_multiple(np.asarray(y, np.float32), ndata)
+    wp, _ = pad_to_multiple(np.asarray(w, np.float32), ndata)
+    data_axis = mesh.axis_names[0]
+    l2 = float(reg_param)
+
+    def shard_fn(X_s, y_s, w_s):
+        m = X_s.shape[0]
+        Xa = jnp.concatenate([X_s, jnp.ones((m, 1), X_s.dtype)], axis=1)
+        wsum = jnp.maximum(lax.psum(w_s.sum(), axis_name=data_axis), 1.0)
+
+        def step(state):
+            beta, _, it = state
+            z = Xa @ beta
+            p = jax.nn.sigmoid(z)
+            g_part = Xa.T @ (w_s * (p - y_s) / wsum)
+            s = jnp.maximum(w_s * p * (1 - p) / wsum, 1e-10) \
+                * (w_s > 0)                       # pad rows: exactly zero
+            H_part = (Xa * s[:, None]).T @ Xa
+            grad = lax.psum(g_part, axis_name=data_axis)
+            H = lax.psum(H_part, axis_name=data_axis)
+            grad = grad.at[:d].add(l2 * beta[:d])
+            H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
+            nb = _finite_or(beta - _damped_solve(H, grad), beta)
+            return nb, jnp.max(jnp.abs(nb - beta)), it + 1
+
+        def cond(state):
+            _, dn, it = state
+            return (dn > tol) & (it < max_iter)
+
+        beta0 = jnp.zeros(d + 1, jnp.float32)
+        beta, _, _ = lax.while_loop(
+            cond, step, (beta0, jnp.float32(jnp.inf), jnp.int32(0)))
+        return beta
+
+    fn = shard_map_compat(shard_fn, mesh,
+                          (P(data_axis, None), P(data_axis), P(data_axis)),
+                          P(None))
+    xs = sweep_matrix_sharding(mesh)
+    ds = data_sharding(mesh)
+    beta = np.asarray(jax.jit(fn)(jax.device_put(Xp, xs),
+                                  jax.device_put(yp, ds),
+                                  jax.device_put(wp, ds)))
+    return beta[:d], float(beta[d])
+
+
+def histogram_psum(binned, g, h, w, mesh: Mesh, n_bins: int = 32):
+    """Per-feature gradient/hessian/count histograms with per-shard
+    partials ``psum``-merged over the data axis — the standalone form of
+    the histogram build inside the sharded tree grower (the per-level
+    ``all_reduce=psum`` in ``grow_forest_sharded``), exposed so the
+    sweep's histogram step has a directly testable collective contract.
+
+    ``binned``: (N, D) int bin ids; ``g``/``h``/``w``: (N,) per-row
+    gradient / hessian / sample weight.  Returns replicated host
+    (n_bins, D, 3) stacks of [g*w, h*w, w] sums per bin — zero-weight
+    (padding) rows contribute nothing.
+    """
+    from .mesh import shard_map_compat
+
+    from .mesh import data_sharding, pad_to_multiple, sweep_matrix_sharding
+
+    binned = np.asarray(binned)
+    n, d = binned.shape
+    ndata = mesh.shape[mesh.axis_names[0]]
+    bp, _ = pad_to_multiple(binned, ndata, axis=0)
+    gp, _ = pad_to_multiple(np.asarray(g, np.float32), ndata)
+    hp, _ = pad_to_multiple(np.asarray(h, np.float32), ndata)
+    wp, _ = pad_to_multiple(np.asarray(w, np.float32), ndata)
+    data_axis = mesh.axis_names[0]
+
+    def shard_fn(b_s, g_s, h_s, w_s):
+        oh = (b_s[:, None, :] == jnp.arange(n_bins)[None, :, None])
+        oh = oh.astype(jnp.float32)                       # (m, B, D)
+        vals = jnp.stack([g_s * w_s, h_s * w_s, w_s], axis=1)  # (m, 3)
+        part = jnp.einsum("mbd,mk->bdk", oh, vals)
+        return lax.psum(part, axis_name=data_axis)
+
+    fn = shard_map_compat(
+        shard_fn, mesh,
+        (P(data_axis, None), P(data_axis), P(data_axis), P(data_axis)),
+        P(None, None, None))
+    xs = sweep_matrix_sharding(mesh)
+    ds = data_sharding(mesh)
+    out = jax.jit(fn, static_argnames=())(
+        jax.device_put(bp, xs), jax.device_put(gp, ds),
+        jax.device_put(hp, ds), jax.device_put(wp, ds))
+    return np.asarray(out)
 
 
 @jax.jit
@@ -373,7 +524,7 @@ def quantile_bins_sharded(X: np.ndarray, mesh: Mesh, max_bins: int = 32,
     k = max(1, min(local, -(-min(sample_rows, n) // n_shards)))
     qs = np.linspace(0, 1, max_bins + 1)[1:-1].astype(np.float32)
 
-    from jax import shard_map
+    from .mesh import shard_map_compat
 
     def shard_fn(X_s, valid_s):
         # stride-sample k local rows; pad rows re-sample row 0 of the
@@ -388,9 +539,9 @@ def quantile_bins_sharded(X: np.ndarray, mesh: Mesh, max_bins: int = 32,
         return jnp.nanquantile(pooled, jnp.asarray(qs), axis=0).T  # (D, B-1)
 
     ds = data_sharding(mesh)
-    fn = shard_map(shard_fn, mesh=mesh,
-                   in_specs=(P(data_axis, None), P(data_axis)),
-                   out_specs=P(None, None), check_vma=False)
+    fn = shard_map_compat(shard_fn, mesh,
+                          (P(data_axis, None), P(data_axis)),
+                          P(None, None))
     edges = np.array(fn(jax.device_put(Xp, ds),
                         jax.device_put(rows_valid, ds)),
                      np.float32)   # np.array: writable host copy

@@ -26,13 +26,29 @@ class GridGroup:
     ``run(X, y, weight_ctxs)`` returns a device/host (C, F) metric matrix —
     row order matching the group's ``grid_points`` — or None to decline
     (callers then fit those candidates sequentially).
+
+    With a ("data", "grid") sweep mesh attached (``with_mesh``), families
+    that declare ``supports_mesh`` run the SAME batched program with rows
+    sharded over the data axis and the candidate batch sharded over the
+    grid axis (pjit/NamedSharding — GSPMD partitions the (F, C, N) solve
+    and psums the per-shard Gram partials over ICI); families that don't
+    decline, so their units fall back to sequential per-candidate fits
+    whose estimators carry the mesh themselves.
     """
+
+    #: whether this family's batched program partitions over a sweep mesh
+    supports_mesh: bool = False
 
     def __init__(self, proto, grid_points: Sequence[Dict[str, Any]],
                  metric: str):
         self.proto = proto
         self.grid_points = list(grid_points)
         self.metric = metric
+        self.mesh = None
+
+    def with_mesh(self, mesh) -> "GridGroup":
+        self.mesh = mesh
+        return self
 
     def run(self, X: np.ndarray, y: np.ndarray,
             weight_ctxs: Sequence[Tuple[np.ndarray, np.ndarray]]):
@@ -83,8 +99,71 @@ class GridGroup:
 class _LinearGridGroup(GridGroup):
     """Shared plumbing for the linear-family groups."""
 
+    supports_mesh = True
+
     _batchable = ("reg_param", "elastic_net_param")
     _static = ("max_iter", "tol", "fit_intercept", "standardization")
+
+    def _place_sweep(self, X, y_h: np.ndarray, W_solve: np.ndarray,
+                     W_ev: np.ndarray, regs, alphas):
+        """Device placement for the batched solve.
+
+        Single chip (``mesh is None``): the memoized whole-array uploads.
+        Sweep mesh: rows zero-pad to tile the data axis (pad rows carry
+        zero weight in every fold row — inert through the weighted Grams,
+        gradients and metrics, so results are invariant to pad amount),
+        the matrix/fold-weights commit row-sharded, and the candidate
+        vectors commit on the GRID axis (padded to tile it by repeating
+        the last candidate) so GSPMD partitions the (F, C, N) solve over
+        data x grid.  Returns ``(X_in, y_in, W_solve_in, W_ev_in, regs_in,
+        alphas_in, strip)`` where ``strip`` trims candidate padding off an
+        axis-1 candidate-batched array (None when no padding).
+        """
+        if self.mesh is None:
+            from ..models.trees import _dev_f32
+            return (_dev_f32(X), y_h, _dev_f32(W_solve, tag="W_tr"),
+                    W_ev, regs, alphas, None)
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.trees import _dev_memo_sharded
+        from ..parallel.mesh import (fold_weight_sharding, grid_sharding,
+                                     pad_to_multiple, sweep_matrix_sharding)
+
+        mesh = self.mesh
+        ndata = mesh.shape[mesh.axis_names[0]]
+        g = mesh.shape[mesh.axis_names[1]]
+        if isinstance(X, jax.Array) and not isinstance(X, np.ndarray):
+            # already committed row-sharded (the streaming→sharded ingest
+            # hand-off); its rows are pre-padded to tile the data axis,
+            # and the caller pre-padded y/weights to match
+            X_dev = X
+        else:
+            Xp, _ = pad_to_multiple(np.asarray(X, np.float32), ndata,
+                                    axis=0)
+            X_dev = None
+        yp, _ = pad_to_multiple(y_h, ndata)
+        Wsp, _ = pad_to_multiple(np.ascontiguousarray(
+            np.asarray(W_solve, np.float32)), ndata, axis=1)
+        Wep, _ = pad_to_multiple(np.ascontiguousarray(
+            np.asarray(W_ev, np.float32)), ndata, axis=1)
+        C = int(regs.shape[0])
+        c_pad = (-C) % g
+        if c_pad:
+            regs = jnp.concatenate([regs, jnp.repeat(regs[-1:], c_pad)])
+            alphas = jnp.concatenate(
+                [alphas, jnp.repeat(alphas[-1:], c_pad)])
+        gs = grid_sharding(mesh)
+        if X_dev is None:
+            X_dev = _dev_memo_sharded(Xp, sweep_matrix_sharding(mesh),
+                                      "sweep_X")
+        Ws_dev = _dev_memo_sharded(Wsp, fold_weight_sharding(mesh),
+                                   "sweep_Wtr")
+        We_dev = _dev_memo_sharded(Wep, fold_weight_sharding(mesh),
+                                   "sweep_Wev")
+        strip = (lambda a: a[:, :C]) if c_pad else None
+        return (X_dev, yp, Ws_dev, We_dev, jax.device_put(regs, gs),
+                jax.device_put(alphas, gs), strip)
 
     def _regs_alphas(self):
         import jax.numpy as jnp
@@ -127,7 +206,6 @@ class LogRegGridGroup(_LinearGridGroup):
         if len(y) and np.nanmax(y) > 1:          # binary device path only
             return None
         from ..models.linear import fit_logreg_grid
-        from ..models.trees import _dev_f32
 
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         regs, alphas = self._regs_alphas()
@@ -139,9 +217,11 @@ class LogRegGridGroup(_LinearGridGroup):
             np.vstack([W_tr, self._full_weights(weight_ctxs)[None]]))
         max_iter = int(self._param(self.grid_points[0], "max_iter"))
         tol = float(self._param(self.grid_points[0], "tol"))
+        X_in, y_in, W_in, W_ev_in, regs_in, alphas_in, strip = \
+            self._place_sweep(X, np.nan_to_num(np.asarray(y, np.float32)),
+                              W_aug, W_ev, regs, alphas)
         scores, _, coef, icpt = fit_logreg_grid(
-            _dev_f32(X), np.nan_to_num(np.asarray(y, np.float32)),
-            _dev_f32(W_aug, tag="W_tr"), regs, alphas,
+            X_in, y_in, W_in, regs_in, alphas_in,
             # majorization steps are ~D^2/N cheaper than Newton steps;
             # give the solver a proportionally larger budget at a metric-
             # sufficient tolerance
@@ -150,8 +230,10 @@ class LogRegGridGroup(_LinearGridGroup):
                                            "fit_intercept")),
             standardization=bool(self._param(self.grid_points[0],
                                              "standardization")))
+        if strip is not None:
+            scores, coef, icpt = strip(scores), strip(coef), strip(icpt)
         self._refit_coef, self._refit_icpt = coef[F], icpt[F]  # device (C, D)
-        return self._metric_rows(y, scores[:F], W_ev, binary=True)
+        return self._metric_rows(y_in, scores[:F], W_ev_in, binary=True)
 
     def refit_model(self, row: int):
         if getattr(self, "_refit_coef", None) is None:
@@ -171,24 +253,27 @@ class LinRegGridGroup(_LinearGridGroup):
         if not self._batchable_params():
             return None
         from ..models.linear import fit_linreg_grid
-        from ..models.trees import _dev_f32
 
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         regs, alphas = self._regs_alphas()
         F = W_tr.shape[0]
         W_aug = np.ascontiguousarray(
             np.vstack([W_tr, self._full_weights(weight_ctxs)[None]]))
+        X_in, y_in, W_in, W_ev_in, regs_in, alphas_in, strip = \
+            self._place_sweep(X, np.nan_to_num(np.asarray(y, np.float32)),
+                              W_aug, W_ev, regs, alphas)
         preds, coef, icpt = fit_linreg_grid(
-            _dev_f32(X), np.nan_to_num(np.asarray(y, np.float32)),
-            _dev_f32(W_aug, tag="W_tr"), regs, alphas,
+            X_in, y_in, W_in, regs_in, alphas_in,
             max_iter=int(self._param(self.grid_points[0], "max_iter")),
             tol=float(self._param(self.grid_points[0], "tol")),
             fit_intercept=bool(self._param(self.grid_points[0],
                                            "fit_intercept")),
             standardization=bool(self._param(self.grid_points[0],
                                              "standardization")))
+        if strip is not None:
+            preds, coef, icpt = strip(preds), strip(coef), strip(icpt)
         self._refit_coef, self._refit_icpt = coef[F], icpt[F]
-        return self._metric_rows(y, preds[:F], W_ev, binary=False)
+        return self._metric_rows(y_in, preds[:F], W_ev_in, binary=False)
 
     def refit_model(self, row: int):
         if getattr(self, "_refit_coef", None) is None:
@@ -226,23 +311,26 @@ class SoftmaxGridGroup(_LinearGridGroup):
 
         from ..evaluators.metrics import multiclass_metric_grid
         from ..models.linear import fit_softmax_grid
-        from ..models.trees import _dev_f32
 
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         regs, alphas = self._regs_alphas()
         max_iter = int(self._param(self.grid_points[0], "max_iter"))
         tol = float(self._param(self.grid_points[0], "tol"))
-        yi = np.nan_to_num(np.asarray(y, np.float32)).astype(np.int32)
+        y_h = np.nan_to_num(np.asarray(y, np.float32))
+        X_in, y_in, W_in, W_ev_in, regs_in, alphas_in, strip = \
+            self._place_sweep(X, y_h, W_tr, W_ev, regs, alphas)
+        yi = np.asarray(y_in).astype(np.int32)
         logits, _ = fit_softmax_grid(
-            _dev_f32(X), yi, n_classes, _dev_f32(W_tr, tag="W_tr"),
-            regs, alphas,
+            X_in, yi, n_classes, W_in, regs_in, alphas_in,
             max_iter=max(150, 4 * max_iter), tol=max(tol, 1e-5),
             fit_intercept=bool(self._param(self.grid_points[0],
                                            "fit_intercept")),
             standardization=bool(self._param(self.grid_points[0],
                                              "standardization")))
+        if strip is not None:
+            logits = strip(logits)
         preds = jnp.argmax(logits, axis=2)                 # (F, C, N)
-        m = multiclass_metric_grid(yi, preds, jnp.asarray(W_ev),
+        m = multiclass_metric_grid(yi, preds, jnp.asarray(W_ev_in),
                                    n_classes, self.metric)
         if m is None:
             return None
@@ -272,6 +360,13 @@ class RFGridGroup(GridGroup):
         return self._uniform(self._static)
 
     def run(self, X, y, weight_ctxs):
+        if self.mesh is not None:
+            # tree grids decline on a sweep mesh: the chunked vmapped
+            # growth program is compiled for one chip's memory space —
+            # these units fall back to sequential fits whose estimators
+            # carry the mesh themselves (grow_forest_sharded psums
+            # per-shard histograms over the data axis)
+            return None
         if not self._batchable_params():
             return None
         import jax.numpy as jnp
@@ -542,6 +637,10 @@ class GBTGridGroup(GridGroup):
         return [self.proto.copy(**p) for p in self.grid_points]
 
     def run(self, X, y, weight_ctxs):
+        if self.mesh is not None:
+            # lockstep chains decline on a sweep mesh (single-chip
+            # program); units fall back to sequential mesh-sharded fits
+            return None
         import jax
         import jax.numpy as jnp
 
@@ -771,13 +870,26 @@ def _replay_es(chunk_rows, stopped, best_metric, best_len, stall,
 
 
 def make_grid_group(proto, grid_points, problem_type: str,
-                    metric: str, n_classes: int = 2) -> Optional[GridGroup]:
+                    metric: str, n_classes: int = 2,
+                    mesh=None) -> Optional[GridGroup]:
     """Group factory: returns a batched group when the estimator family,
     problem type, and metric support one — else None (sequential fits).
     ``n_classes`` is the selector's fit-time-captured class-space size
-    (multiclass groups take the max of it and the observed labels)."""
+    (multiclass groups take the max of it and the observed labels).
+    ``mesh`` (a ("data", "grid") sweep mesh) runs mesh-capable families'
+    batched programs sharded — rows over data, candidates over grid."""
     if len(grid_points) == 0:
         return None
+    group = _make_grid_group(proto, grid_points, problem_type, metric,
+                             n_classes)
+    if group is not None and mesh is not None:
+        group.with_mesh(mesh)
+    return group
+
+
+def _make_grid_group(proto, grid_points, problem_type: str,
+                     metric: str, n_classes: int = 2
+                     ) -> Optional[GridGroup]:
     from ..evaluators.metrics import _MULTI_GRID_METRICS
     from ..models.classification import OpLogisticRegression
     from ..models.regression import OpLinearRegression

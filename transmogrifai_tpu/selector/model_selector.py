@@ -123,7 +123,8 @@ class ModelSelector(PredictorEstimator):
                  holdout_evaluators: Sequence = (),
                  uid: Optional[str] = None,
                  strategy: str = "full",
-                 halving=None):
+                 halving=None,
+                 parallel=None):
         super().__init__(operation_name="modelSelector", uid=uid)
         self.models_and_params = list(models_and_params)
         self.problem_type = problem_type
@@ -151,15 +152,68 @@ class ModelSelector(PredictorEstimator):
         self.best_estimator: Optional[Tuple[str, Dict[str, Any],
                                             List[ValidationResult]]] = None
         self.mesh = None
+        # pod-scale dispatch (ROADMAP item 1): None = single chip unless
+        # with_mesh was called; an int = that many devices on an
+        # auto-shaped ("data", "grid") sweep mesh; "auto" = let the cost
+        # planner (tuning/planner.advise_mesh) decide from measured
+        # scaling history; a jax Mesh = use it directly.
+        self.parallel = parallel
+        self.sweep_checkpoint_dir: Optional[str] = None
+        self.sweep_checkpoint_every: int = 1
 
     def with_mesh(self, mesh) -> "ModelSelector":
-        """Multi-chip selection: every candidate fit in the sweep AND the
-        final refit run mesh-sharded (each estimator's own ``with_mesh``
-        path).  The single-chip device-resident sweep shortcut
-        (``fit_device``) is bypassed — its programs are compiled for one
-        chip's memory space."""
+        """Multi-chip selection.  With a ("data", "grid") sweep mesh
+        (``parallel.make_sweep_mesh``), runs of same-family candidates
+        batch as ONE pjit/NamedSharding program — rows sharded over the
+        data axis, the candidate batch over the grid axis — and the
+        remaining families fall back to sequential fits that are
+        themselves mesh-sharded (each estimator's own ``with_mesh`` path).
+        With a legacy ("data", "model") mesh every candidate fit runs
+        mesh-sharded sequentially.  The single-chip device-resident sweep
+        shortcut (``fit_device``) is bypassed either way — its programs
+        are compiled for one chip's memory space."""
         self.mesh = mesh
         return self
+
+    def with_sweep_checkpoint(self, directory: str,
+                              every_units: int = 1) -> "ModelSelector":
+        """Mid-sweep checkpoint/resume: completed sweep units' fold
+        metrics (and the halving rung state) persist atomically under
+        ``directory`` as the sweep advances, and a re-run against the
+        same directory resumes at the cursor instead of refitting every
+        candidate (workflow/checkpoint.SweepCheckpointManager)."""
+        self.sweep_checkpoint_dir = directory
+        self.sweep_checkpoint_every = int(every_units)
+        return self
+
+    def _resolve_parallel(self, n_rows: int, n_cols: int,
+                          queue_width: int):
+        """Resolve ``parallel`` into a sweep mesh for THIS fit (an
+        explicit ``with_mesh`` wins; None means single-chip)."""
+        if self.mesh is not None or self.parallel is None:
+            return self.mesh
+        import jax
+
+        from ..parallel.mesh import make_sweep_mesh
+
+        p = self.parallel
+        if hasattr(p, "axis_names"):          # a prebuilt Mesh
+            return p
+        n_avail = len(jax.devices())
+        if p == "auto":
+            from ..tuning.planner import advise_mesh
+
+            adv = advise_mesh(n_rows, n_cols, queue_width=queue_width,
+                              devices_available=n_avail)
+            self.metadata["mesh_advice"] = adv.to_json()
+            if adv.n_devices <= 1:
+                return None
+            return make_sweep_mesh(queue_width, n_devices=adv.n_devices,
+                                   grid_parallelism=adv.grid_axis)
+        n = min(int(p), n_avail)
+        if n <= 1:
+            return None
+        return make_sweep_mesh(queue_width, n_devices=n)
 
     # -- validation plumbing -------------------------------------------------
 
@@ -253,20 +307,29 @@ class ModelSelector(PredictorEstimator):
 
     def _candidates(self, with_groups: bool = True):
         from ..models.gbdt_kernels import compile_depth_hint
+        from ..parallel.mesh import has_grid_axis
         from .grid_groups import make_grid_group
 
+        grid_mesh = has_grid_axis(self.mesh)
         out = []
         for proto, grid_points in self.models_and_params:
             # one batched program for the whole (folds x grid) product when
-            # the family supports it; single-chip only (the mesh path runs
-            # each candidate's own sharded fit).  ``with_groups=False`` is
-            # the halving scheduler's path: rung subsets fit per-candidate
-            # (a group always computes its WHOLE family grid, which would
-            # pay for eliminated candidates).
+            # the family supports it.  Single chip by default; on a
+            # ("data", "grid") sweep mesh the mesh-capable families run
+            # the SAME batched program sharded (rows over data, candidate
+            # batch over grid), while a legacy ("data", "model") mesh
+            # keeps the historical per-candidate sharded fits.
+            # ``with_groups=False`` is the halving scheduler's path: rung
+            # subsets fit per-candidate (a group always computes its WHOLE
+            # family grid, which would pay for eliminated candidates) —
+            # the sharded halving sweep re-batches each rung's survivors
+            # via ``_make_rung_regroup`` instead.
             group = (make_grid_group(proto, grid_points, self.problem_type,
                                      self.validation_metric,
-                                     n_classes=self._class_count(None))
-                     if (self.mesh is None and with_groups) else None)
+                                     n_classes=self._class_count(None),
+                                     mesh=self.mesh if grid_mesh else None)
+                     if ((self.mesh is None or grid_mesh) and with_groups)
+                     else None)
             fam_depth = self._family_depth(proto, grid_points)
             for params in grid_points:
                 def fitter(X, y, w, p, proto=proto, fam_depth=fam_depth):
@@ -295,6 +358,65 @@ class ModelSelector(PredictorEstimator):
         return {"binary": DataBalancer(),
                 "multiclass": DataCutter(),
                 "regression": DataSplitter()}[self.problem_type]
+
+    def _sweep_checkpoint(self, candidates, n_rows: int):
+        """Mid-sweep cursor manager for this fit, or None.  Primed from
+        disk (resume); a checkpoint for a different sweep raises
+        CheckpointMismatchError instead of blending runs."""
+        if self.sweep_checkpoint_dir is None:
+            return None
+        from ..workflow.checkpoint import (SweepCheckpointManager,
+                                           sweep_fingerprint)
+
+        v = self.validator
+        vdesc = (f"{type(v).__name__}("
+                 f"folds={getattr(v, 'num_folds', None)},"
+                 f"ratio={getattr(v, 'train_ratio', None)},"
+                 f"seed={getattr(v, 'seed', None)},"
+                 f"stratify={getattr(v, 'stratify', None)})")
+        fp = sweep_fingerprint(candidates, self.validation_metric, vdesc,
+                               mesh=self.mesh, strategy=self.strategy,
+                               n_rows=n_rows)
+        manager = SweepCheckpointManager(
+            self.sweep_checkpoint_dir, fp,
+            every_units=self.sweep_checkpoint_every)
+        manager.load()
+        return manager
+
+    def _make_rung_regroup(self, candidates):
+        """Per-rung grid-group factory for the SHARDED halving sweep: a
+        rung's surviving same-family candidates re-batch (at their
+        rung-scaled fit params) into one mesh-sharded program packed onto
+        the grid axis.  None on single-chip / legacy meshes — the rungs
+        keep their per-candidate fits."""
+        from ..parallel.mesh import has_grid_axis
+
+        if not has_grid_axis(self.mesh):
+            return None
+        from .grid_groups import make_grid_group
+
+        protos = [proto for proto, pts in self.models_and_params
+                  for _ in pts]
+
+        def regroup(indices, fit_params_list):
+            out = []
+            pos = 0
+            while pos < len(indices):
+                proto = protos[indices[pos]]
+                end = pos
+                while end < len(indices) and protos[indices[end]] is proto:
+                    end += 1
+                pts = [dict(fit_params_list[p]) for p in range(pos, end)]
+                group = make_grid_group(
+                    proto, pts, self.problem_type, self.validation_metric,
+                    n_classes=self._class_count(None), mesh=self.mesh)
+                for p in range(pos, end):
+                    name, _params, fitter, *_ = candidates[indices[p]]
+                    out.append((name, fit_params_list[p], fitter, group))
+                pos = end
+            return out
+
+        return regroup
 
     @staticmethod
     def _family_depth(proto, grid_points):
@@ -370,9 +492,20 @@ class ModelSelector(PredictorEstimator):
         consume the full matrix — tree candidates then quantize on device
         from it instead of a host binning pass.  Large matrices upload as
         bf16 (see ``trees._dev_f32``; TMOG_MATRIX_PRECISION=f32 forces
-        exact uploads at ~2x the tunnel cost)."""
+        exact uploads at ~2x the tunnel cost).
+
+        A mesh-sharded ``jax.Array`` (the streaming→sharded ingest
+        hand-off, ``parallel.ingest``) is kept device-resident when a
+        mesh sweep will consume it; single-chip fits pull it to host."""
+        import jax
+
         from ..models.trees import _as_f32, _dev_f32
 
+        if isinstance(values, jax.Array) and not isinstance(values,
+                                                            np.ndarray):
+            if self.mesh is not None:
+                return values             # committed row-sharded already
+            values = np.asarray(values)
         X = _as_f32(np.asarray(values))
         if self.mesh is None and self._grid_has_linear() and X.size > (1 << 24):
             _dev_f32(X)
@@ -438,6 +571,31 @@ class ModelSelector(PredictorEstimator):
         train_mask[train_idx] = True
         base_w = splitter.train_weights(y, train_mask)
 
+        # ``parallel=`` dispatch: resolve an int/"auto" request into a
+        # ("data", "grid") sweep mesh for THIS fit only (with_mesh wins,
+        # and the attribute is restored on the way out — the same scoping
+        # contract the workflow applies to with_mesh)
+        queue_width = sum(len(g) for _, g in self.models_and_params)
+        prev_mesh = self.mesh
+        self.mesh = self._resolve_parallel(n, int(X.shape[1]), queue_width)
+        try:
+            return self._fit_columns_inner(
+                X, y, n, splitter, train_mask, holdout_idx, base_w)
+        finally:
+            self.mesh = prev_mesh
+
+    def _fit_columns_inner(self, X, y, n, splitter, train_mask,
+                           holdout_idx, base_w):
+        # a mesh-padded device matrix (the streaming→sharded ingest
+        # hand-off) carries pad rows: labels/weights pad with ZEROS so the
+        # pad rows are inert through every weighted fit and metric
+        n_x = int(X.shape[0])
+        if n_x != n:
+            y_v = np.pad(y, (0, n_x - n))
+            base_w_v = np.pad(base_w, (0, n_x - n))
+        else:
+            y_v, base_w_v = y, base_w
+
         best_group = None
         if self.best_estimator is not None:
             # consume the workflow-CV winner: a later fit on new data must
@@ -447,18 +605,25 @@ class ModelSelector(PredictorEstimator):
         elif self.strategy == "halving":
             # successive halving (tuning/halving.py): early rungs rank
             # candidates on stratified row subsamples + scaled rounds,
-            # only survivors pay full-data fits.  No grid groups (a group
-            # batches its WHOLE family — eliminated candidates would
-            # still be paid for) and no tree-prep prefetch (sized for the
+            # only survivors pay full-data fits.  No WHOLE-grid groups (a
+            # group batches its whole family — eliminated candidates
+            # would still be paid for): on a sweep mesh each rung's
+            # survivors re-batch onto the grid axis via the regroup
+            # callback instead.  No tree-prep prefetch (sized for the
             # full matrix, not the rungs).
             from ..tuning.halving import halving_validate
 
             candidates = self._candidates(with_groups=False)
+            ckpt = self._sweep_checkpoint(candidates, n)
             best_i, results, schedule = halving_validate(
-                self.validator, candidates, X, y, base_w,
+                self.validator, candidates, X, y_v, base_w_v,
                 eval_fn=self._metric, metric_name=self.validation_metric,
                 larger_better=self.larger_better, config=self.halving,
-                stratify=self.problem_type != "regression")
+                stratify=self.problem_type != "regression",
+                checkpoint=ckpt,
+                regroup=self._make_rung_regroup(candidates))
+            if ckpt is not None:
+                ckpt.finish()
             self.metadata["halving_schedule"] = schedule
             best_name, best_params, *_ = candidates[best_i]
         else:
@@ -466,10 +631,13 @@ class ModelSelector(PredictorEstimator):
             # groups' async device work in a daemon thread
             self._start_tree_prep_prefetch(X)
             candidates = self._candidates()
+            ckpt = self._sweep_checkpoint(candidates, n)
             best_i, results = self.validator.validate(
-                candidates, X, y, base_w,
+                candidates, X, y_v, base_w_v,
                 eval_fn=self._metric, metric_name=self.validation_metric,
-                larger_better=self.larger_better)
+                larger_better=self.larger_better, checkpoint=ckpt)
+            if ckpt is not None:
+                ckpt.finish()
             best_name, best_params, *rest = candidates[best_i]
             best_group = rest[1] if len(rest) > 1 else None
 
@@ -502,7 +670,7 @@ class ModelSelector(PredictorEstimator):
             best_est = best_proto.copy(**best_params)
             if self.mesh is not None and hasattr(best_est, "with_mesh"):
                 best_est.with_mesh(self.mesh)
-            best_model = best_est.fit_raw(X, y, base_w)
+            best_model = best_est.fit_raw(X, y_v, base_w_v)
 
         # ONE batched predict over the full matrix (hits the sweep's binning
         # and upload memos) — slicing rows first would re-bin and re-upload
@@ -623,7 +791,7 @@ class BinaryClassificationModelSelector:
         splitter=None, seed: int = 42,
         models_and_parameters=None, parallelism: int = 8,
         max_wait: Optional[float] = None,
-        strategy: str = "full", halving=None,
+        strategy: str = "full", halving=None, parallel=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
@@ -634,7 +802,7 @@ class BinaryClassificationModelSelector:
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving)
+            strategy=strategy, halving=halving, parallel=parallel)
 
     @staticmethod
     def with_train_validation_split(
@@ -642,7 +810,7 @@ class BinaryClassificationModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
-        strategy: str = "full", halving=None,
+        strategy: str = "full", halving=None, parallel=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
@@ -653,7 +821,7 @@ class BinaryClassificationModelSelector:
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving)
+            strategy=strategy, halving=halving, parallel=parallel)
 
 
 class MultiClassificationModelSelector:
@@ -663,7 +831,7 @@ class MultiClassificationModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
-        strategy: str = "full", halving=None,
+        strategy: str = "full", halving=None, parallel=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
@@ -674,7 +842,7 @@ class MultiClassificationModelSelector:
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving)
+            strategy=strategy, halving=halving, parallel=parallel)
 
     @staticmethod
     def with_train_validation_split(
@@ -682,7 +850,7 @@ class MultiClassificationModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
-        strategy: str = "full", halving=None,
+        strategy: str = "full", halving=None, parallel=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
@@ -693,7 +861,7 @@ class MultiClassificationModelSelector:
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving)
+            strategy=strategy, halving=halving, parallel=parallel)
 
 
 class RegressionModelSelector:
@@ -703,7 +871,7 @@ class RegressionModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
-        strategy: str = "full", halving=None,
+        strategy: str = "full", halving=None, parallel=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
@@ -713,7 +881,7 @@ class RegressionModelSelector:
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving)
+            strategy=strategy, halving=halving, parallel=parallel)
 
     @staticmethod
     def with_train_validation_split(
@@ -722,7 +890,7 @@ class RegressionModelSelector:
         splitter=None, seed: int = 42, models_and_parameters=None,
         parallelism: int = 8,
         max_wait: Optional[float] = None,
-        strategy: str = "full", halving=None,
+        strategy: str = "full", halving=None, parallel=None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
@@ -733,7 +901,7 @@ class RegressionModelSelector:
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving)
+            strategy=strategy, halving=halving, parallel=parallel)
 
 
 class RandomParamBuilder:

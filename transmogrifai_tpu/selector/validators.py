@@ -44,6 +44,17 @@ class ValidationResult:
             out["error"] = self.error
         return out
 
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ValidationResult":
+        """Inverse of ``to_json`` (sweep checkpoint resume)."""
+        return ValidationResult(
+            model_name=str(d.get("modelType", "")),
+            params=dict(d.get("params", {})),
+            metric_name=str(d.get("metricName", "")),
+            metric_value=float(d.get("metricValue", float("-inf"))),
+            fold_values=list(d.get("foldValues", [])),
+            error=d.get("error"))
+
 
 def make_folds(n: int, num_folds: int, y: Optional[np.ndarray] = None,
                stratify: bool = False, seed: int = 42) -> np.ndarray:
@@ -78,6 +89,7 @@ class _ValidatorBase:
         eval_fn: Callable[[np.ndarray, Any, np.ndarray], float],
         metric_name: str,
         larger_better: bool = True,
+        checkpoint=None,
     ) -> Tuple[int, List[ValidationResult]]:
         raise NotImplementedError
 
@@ -162,7 +174,7 @@ class OpCrossValidation(_ValidatorBase):
         self.max_wait = max_wait
 
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
-                 larger_better=True):
+                 larger_better=True, checkpoint=None):
         n = X.shape[0]
         folds = make_folds(n, self.num_folds, y=y, stratify=self.stratify,
                            seed=self.seed)
@@ -183,7 +195,8 @@ class OpCrossValidation(_ValidatorBase):
             return group.run(X, y, fold_ctxs)
 
         return _run_sweep(candidates, fold_ctxs, run_fold, metric_name,
-                          larger_better, self.max_wait, run_group=run_group)
+                          larger_better, self.max_wait, run_group=run_group,
+                          checkpoint=checkpoint)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -242,7 +255,7 @@ class OpTrainValidationSplit(_ValidatorBase):
         return in_train
 
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
-                 larger_better=True):
+                 larger_better=True, checkpoint=None):
         n = X.shape[0]
         in_train = self._split_mask(n, y)
         w_train = base_weights * in_train
@@ -256,7 +269,8 @@ class OpTrainValidationSplit(_ValidatorBase):
             return group.run(X, y, [(w_train, w_eval)])
 
         return _run_sweep(candidates, [None], run_fold, metric_name,
-                          larger_better, self.max_wait, run_group=run_group)
+                          larger_better, self.max_wait, run_group=run_group,
+                          checkpoint=checkpoint)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -349,6 +363,17 @@ class SweepWorkQueue:
             j += 1
         return j
 
+    def group_start(self, i: int) -> int:
+        """Start index of the run of units sharing units[i]'s group — a
+        checkpoint resume can enter a group MID-SPAN (earlier members
+        restored from the cursor), and the group's metric-matrix rows are
+        indexed from the group's first unit, not from the resume point."""
+        group = self.units[i].group
+        j = i
+        while j > 0 and self.units[j - 1].group is group:
+            j -= 1
+        return j
+
     def run_group_block(self, i: int, j: int):
         """Batched fit for units[i:j] (one shared GridGroup): the group's
         (C_g, F) metric matrix, or None when the group declines/fails —
@@ -371,9 +396,19 @@ class SweepWorkQueue:
     # -- the default scheduler: full sweep in stable order -------------------
 
     def run_all(self, metric_name: str, larger_better: bool,
-                max_wait: Optional[float]
+                max_wait: Optional[float], checkpoint=None
                 ) -> Tuple[int, List[ValidationResult]]:
         """Every unit in stable order — the classic full sweep.
+
+        ``checkpoint`` (a workflow.checkpoint.SweepCheckpointManager view)
+        enables the mid-sweep cursor: units whose fold metrics are already
+        durable are restored instead of re-run, and each finished unit's
+        metrics persist as the sweep advances — an 8-chip sweep killed
+        mid-flight resumes at its cursor.  Checkpointing materializes each
+        unit's device metrics at completion (one stacked fetch per unit or
+        group block) instead of deferring every fetch to the end; that
+        sync is the durability cost and is only paid when a checkpoint is
+        attached.
 
         Raises only when EVERY candidate failed — there is no model to
         select otherwise."""
@@ -385,6 +420,13 @@ class SweepWorkQueue:
         i = 0
         while i < len(self.units):
             unit = self.units[i]
+            if checkpoint is not None:
+                rec = checkpoint.restore(unit.index)
+                if rec is not None:
+                    all_vals.append(rec[0])
+                    errors.append(rec[1])
+                    i += 1
+                    continue
             elapsed = time.monotonic() - t0
             if max_wait is not None and elapsed > max_wait and all_vals:
                 all_vals.append([])
@@ -395,13 +437,26 @@ class SweepWorkQueue:
                 continue
             if unit.group is not None and self._run_group is not None:
                 j = self.group_span(i)
+                # row offset into the group's (C_g, F) metric matrix: the
+                # block may start mid-group after a checkpoint restore
+                base = i - self.group_start(i)
                 M = self.run_group_block(i, j)
                 if M is not None:
+                    if checkpoint is not None:
+                        rows = _materialize(
+                            [_GroupRow(M, base + r) for r in range(j - i)])
+                        for r, vals in enumerate(rows):
+                            all_vals.append(vals)
+                            errors.append(None)
+                            checkpoint.record_unit(self.units[i + r].index,
+                                                   vals, None)
+                        i = j
+                        continue
                     for r in range(j - i):
                         # deferred row marker: fetched once per group
                         # matrix in _materialize (no per-row device
                         # slicing launches)
-                        all_vals.append(_GroupRow(M, r))
+                        all_vals.append(_GroupRow(M, base + r))
                         errors.append(None)
                     i = j
                     continue
@@ -409,6 +464,9 @@ class SweepWorkQueue:
                 self.strip_groups(i, j)
                 continue
             fold_vals, err = self.run_unit(unit)
+            if checkpoint is not None:
+                fold_vals = _materialize([fold_vals])[0]
+                checkpoint.record_unit(unit.index, fold_vals, err)
             all_vals.append(fold_vals)
             errors.append(err)
             i += 1
@@ -448,13 +506,15 @@ class SweepWorkQueue:
 
 def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
                larger_better: bool, max_wait: Optional[float],
-               run_group=None) -> Tuple[int, List[ValidationResult]]:
+               run_group=None, checkpoint=None
+               ) -> Tuple[int, List[ValidationResult]]:
     """The full-sweep scheduler over the work queue (see SweepWorkQueue
     for the execution semantics — this wrapper is the historical entry
     point every validator calls)."""
     queue = SweepWorkQueue(candidates, fold_ctxs, run_fold,
                            run_group=run_group)
-    return queue.run_all(metric_name, larger_better, max_wait)
+    return queue.run_all(metric_name, larger_better, max_wait,
+                         checkpoint=checkpoint)
 
 
 def _argbest(vals: List[float], larger_better: bool) -> int:

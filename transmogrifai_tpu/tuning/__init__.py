@@ -32,7 +32,8 @@ from .costmodel import (CostModel, StageObservation, append_observations,
                         record_train_observations)
 from .halving import (HalvingConfig, Rung, halving_validate,
                       nested_subsample_order, rung_schedule)
-from .planner import PlanAdvice, advise_plan, default_host_budget_bytes
+from .planner import (MeshAdvice, PlanAdvice, advise_mesh, advise_plan,
+                      default_host_budget_bytes)
 
 __all__ = [
     "Tuner", "HalvingConfig", "Rung", "halving_validate", "rung_schedule",
@@ -40,7 +41,7 @@ __all__ = [
     "load_observations", "append_observations",
     "observations_from_profiler", "record_train_observations",
     "default_history_path", "BenchBudgeter", "PlanAdvice", "advise_plan",
-    "default_host_budget_bytes",
+    "MeshAdvice", "advise_mesh", "default_host_budget_bytes",
 ]
 
 

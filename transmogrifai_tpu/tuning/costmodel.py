@@ -65,12 +65,23 @@ class StageObservation:
     backend: str             # jax backend serving the run ("cpu", "tpu", ...)
     wall_s: float
     t: int = 0               # unix seconds (0 = unknown)
+    #: devices the stage ran on (1 = single chip; mesh fits record their
+    #: mesh size so the model can learn measured multi-chip scaling)
+    n_devices: int = 1
+    mesh_shape: str = ""     # e.g. "data=4,grid=2" ("" = no mesh)
 
     def to_json(self) -> Dict[str, Any]:
-        return {"stageKind": self.stage_kind, "rows": self.rows,
-                "cols": self.cols, "dtype": self.dtype,
-                "backend": self.backend, "wallSecs": round(self.wall_s, 6),
-                "t": self.t}
+        out = {"stageKind": self.stage_kind, "rows": self.rows,
+               "cols": self.cols, "dtype": self.dtype,
+               "backend": self.backend, "wallSecs": round(self.wall_s, 6),
+               "t": self.t}
+        # backward-compatible JSON: single-chip records look exactly like
+        # the pre-mesh history (old readers never see the new keys)
+        if self.n_devices != 1:
+            out["nDevices"] = self.n_devices
+        if self.mesh_shape:
+            out["meshShape"] = self.mesh_shape
+        return out
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "StageObservation":
@@ -79,13 +90,20 @@ class StageObservation:
             rows=int(d.get("rows", 0)), cols=int(d.get("cols", 0)),
             dtype=str(d.get("dtype", "")),
             backend=str(d.get("backend", "")),
-            wall_s=float(d.get("wallSecs", 0.0)), t=int(d.get("t", 0)))
+            wall_s=float(d.get("wallSecs", 0.0)), t=int(d.get("t", 0)),
+            n_devices=int(d.get("nDevices", 1)),
+            mesh_shape=str(d.get("meshShape", "")))
 
 
-def _features(rows: int, cols: int) -> np.ndarray:
+def _features(rows: int, cols: int, n_devices: int = 1) -> np.ndarray:
     lr = math.log1p(max(rows, 0))
     lc = math.log1p(max(cols, 0))
-    return np.array([1.0, lr, lc, lr * lc], dtype=np.float64)
+    # log2(n_devices): perfect data-parallel scaling fits a -log(2)
+    # coefficient; measured sub-linear scaling (collective overhead) fits
+    # whatever the telemetry actually shows.  Old histories (all
+    # n_devices=1) contribute 0 here, so the feature is backward-inert.
+    ld = math.log2(max(n_devices, 1))
+    return np.array([1.0, lr, lc, lr * lc, ld], dtype=np.float64)
 
 
 class CostModel:
@@ -122,14 +140,14 @@ class CostModel:
             for key in ((o.stage_kind, o.backend or None),
                         (o.stage_kind, None)):
                 pts = buckets.setdefault(key, {})
-                loc = (o.rows, o.cols)
+                loc = (o.rows, o.cols, max(o.n_devices, 1))
                 pts[loc] = min(pts.get(loc, float("inf")), o.wall_s)
         self._coef.clear()
         self._n_obs.clear()
         for key, pts in buckets.items():
             if len(pts) < self.min_obs:
                 continue
-            A = np.stack([_features(r, c) for r, c in pts])
+            A = np.stack([_features(r, c, nd) for r, c, nd in pts])
             b = np.log(np.array(list(pts.values())) + 1e-6)
             G = A.T @ A + self.ridge * np.eye(A.shape[1])
             self._coef[key] = np.linalg.solve(G, A.T @ b)
@@ -149,12 +167,14 @@ class CostModel:
 
     def predict(self, stage_kind: str, rows: int, cols: int,
                 dtype: str = "float32",
-                backend: Optional[str] = None) -> float:
+                backend: Optional[str] = None,
+                n_devices: int = 1) -> float:
         """Predicted wall seconds; never raises, never returns <= 0."""
         for key in ((stage_kind, backend or None), (stage_kind, None)):
             w = self._coef.get(key)
             if w is not None:
-                pred = float(np.exp(w @ _features(rows, cols))) - 1e-6
+                pred = float(np.exp(
+                    w @ _features(rows, cols, n_devices))) - 1e-6
                 return max(pred, PREDICTION_FLOOR_S)
         return self.analytic(rows, cols)
 
@@ -168,7 +188,8 @@ class CostModel:
         return "analytic"
 
     def predict_total(self, rows: int, cols: int,
-                      backend: Optional[str] = None) -> float:
+                      backend: Optional[str] = None,
+                      n_devices: int = 1) -> float:
         """Sum of per-stage-kind predictions over every fitted kind — a
         crude whole-pipeline estimate for budgeting when no same-config
         measured history exists.  0.0 when the model is fully cold (the
@@ -176,7 +197,8 @@ class CostModel:
         kinds = self.fitted_kinds
         if not kinds:
             return 0.0
-        return float(sum(self.predict(k, rows, cols, backend=backend)
+        return float(sum(self.predict(k, rows, cols, backend=backend,
+                                      n_devices=n_devices)
                          for k in kinds))
 
     # -- evaluation ----------------------------------------------------------
@@ -194,7 +216,8 @@ class CostModel:
             if o.wall_s <= 0 or not o.stage_kind:
                 continue
             pred = self.predict(o.stage_kind, o.rows, o.cols,
-                                dtype=o.dtype, backend=o.backend)
+                                dtype=o.dtype, backend=o.backend,
+                                n_devices=o.n_devices)
             n += 1
             ratio = max(pred, o.wall_s) / max(min(pred, o.wall_s), 1e-9)
             if ratio <= factor or abs(pred - o.wall_s) <= noise_floor_s:
@@ -291,7 +314,9 @@ def observations_from_profiler(profiler,
             rows=sp.rows, cols=max(getattr(sp, "cols", 0), 1),
             dtype=getattr(sp, "dtype", "") or "",
             backend=getattr(sp, "backend", "") or backend,
-            wall_s=sp.wall_s, t=now))
+            wall_s=sp.wall_s, t=now,
+            n_devices=max(int(getattr(sp, "n_devices", 1) or 1), 1),
+            mesh_shape=getattr(sp, "mesh_shape", "") or ""))
     return out
 
 

@@ -171,6 +171,8 @@ def halving_validate(
     larger_better: bool = True,
     config: Optional[HalvingConfig] = None,
     stratify: bool = True,
+    checkpoint=None,
+    regroup=None,
 ) -> Tuple[int, List, Dict[str, Any]]:
     """Run the candidate sweep under successive halving.
 
@@ -182,6 +184,16 @@ def halving_validate(
 
     Falls back to one full ``validator.validate`` sweep (recorded in the
     schedule json) whenever the shape doesn't admit a useful ladder.
+
+    ``checkpoint`` (workflow.checkpoint.SweepCheckpointManager) persists
+    the rung state after every rung and a per-rung unit cursor inside it,
+    so a killed sweep resumes at its rung (everything here is already
+    deterministic in the inputs — the ladder, the nested subsample order
+    and the promotions replay identically).  ``regroup(alive_indices,
+    fit_params_list)`` lets the caller rebuild same-family batched groups
+    over a rung's survivors (the sharded sweep packs each rung's
+    candidates onto the mesh's grid axis); returning None keeps the
+    per-candidate path.
     """
     cfg = config or HalvingConfig()
     n, k = len(y), len(candidates)
@@ -193,7 +205,7 @@ def halving_validate(
         t0 = time.perf_counter()
         best, results = validator.validate(
             candidates, X, y, base_weights, eval_fn, metric_name,
-            larger_better=larger_better)
+            larger_better=larger_better, checkpoint=checkpoint)
         sched_json.update({
             "fallback": "full sweep (schedule admits no reduction rung)",
             "rungs": [], "candidateSeconds":
@@ -204,10 +216,25 @@ def halving_validate(
     worst = float("-inf") if larger_better else float("inf")
     alive = list(range(k))
     last_result: Dict[int, Any] = {}
-    eliminated: Dict[int, Rung] = {}
+    #: original index -> (rung index, rung rows) at elimination
+    eliminated: Dict[int, Tuple[int, int]] = {}
     total_cand_s = 0.0
+    rungs_done: List[Dict[str, Any]] = []
+    start_rung = 0
+    if checkpoint is not None:
+        st = checkpoint.rung_state()
+        if st is not None:
+            from ..selector.validators import ValidationResult
 
-    for rung in schedule:
+            start_rung = int(st.get("nextRung", 0))
+            alive = [int(i) for i in st.get("alive", alive)]
+            last_result = {int(i): ValidationResult.from_json(r)
+                           for i, r in st.get("last", {}).items()}
+            eliminated = {int(i): (int(v[0]), int(v[1]))
+                          for i, v in st.get("eliminated", {}).items()}
+            rungs_done = list(st.get("rungJson", []))
+
+    for rung in schedule[start_rung:]:
         full = rung.rows >= n
         if full:
             Xs, ys, ws = X, y, base_weights
@@ -215,15 +242,23 @@ def halving_validate(
             idx = np.sort(order[:rung.rows])
             Xs, ys, ws = X[idx], y[idx], base_weights[idx]
         rung_cands = []
+        fit_params_list = []
         for i in alive:
             name, params, fitter, *_ = candidates[i]
             fit_params = params if full else _scaled_params(
                 params, rung.fraction, cfg)
+            fit_params_list.append(fit_params)
             rung_cands.append((name, fit_params, fitter))
+        if regroup is not None:
+            regrouped = regroup(list(alive), fit_params_list)
+            if regrouped is not None:
+                rung_cands = regrouped
+        rung_ckpt = (checkpoint.scoped(f"rung{rung.index}")
+                     if checkpoint is not None else None)
         t0 = time.perf_counter()
         _, results = validator.validate(
             rung_cands, Xs, ys, ws, eval_fn, metric_name,
-            larger_better=larger_better)
+            larger_better=larger_better, checkpoint=rung_ckpt)
         rung.wall_s = time.perf_counter() - t0
         rung.candidate_seconds = rung.wall_s
         total_cand_s += rung.wall_s
@@ -236,6 +271,7 @@ def halving_validate(
             scores[i] = r.metric_value if r.error is None else worst
         if full:
             rung.promoted = list(alive)
+            rungs_done.append(rung.to_json())
             break
         sign = -1.0 if larger_better else 1.0
         ranked = sorted(alive, key=lambda i: (sign * scores[i], i))
@@ -243,15 +279,26 @@ def halving_validate(
         rung.promoted = promoted
         for i in alive:
             if i not in promoted:
-                eliminated[i] = rung
+                eliminated[i] = (rung.index, rung.rows)
         alive = promoted
+        rungs_done.append(rung.to_json())
+        if checkpoint is not None:
+            checkpoint.save_rung_state({
+                "nextRung": rung.index + 1,
+                "alive": [int(i) for i in alive],
+                "last": {str(i): r.to_json()
+                         for i, r in last_result.items()},
+                "eliminated": {str(i): [ri, rr]
+                               for i, (ri, rr) in eliminated.items()},
+                "rungJson": rungs_done})
 
-    for i, rung in eliminated.items():
+    for i, (ri, rrows) in eliminated.items():
         r = last_result[i]
-        note = (f"halving: eliminated at rung {rung.index} "
-                f"({rung.rows} of {n} rows); metric is the subsample "
+        note = (f"halving: eliminated at rung {ri} "
+                f"({rrows} of {n} rows); metric is the subsample "
                 f"score, not a full-data result")
-        r.error = note if r.error is None else f"{note}; {r.error}"
+        if r.error is None or not str(r.error).startswith("halving:"):
+            r.error = note if r.error is None else f"{note}; {r.error}"
 
     # winner: best FULL-rung result (ties -> lowest index)
     final_alive = [i for i in alive if last_result[i].error is None]
@@ -262,7 +309,7 @@ def halving_validate(
         if last_result[i].error is None else worst), i))
 
     sched_json.update({
-        "rungs": [r.to_json() for r in schedule],
+        "rungs": rungs_done,
         "candidateSeconds": round(total_cand_s, 4),
         "survivors": list(alive),
         "bestIndex": best_i,

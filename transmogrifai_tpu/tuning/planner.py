@@ -20,7 +20,8 @@ from typing import Any, Dict, List, Optional
 
 from .costmodel import CostModel
 
-__all__ = ["PlanAdvice", "advise_plan", "default_host_budget_bytes"]
+__all__ = ["PlanAdvice", "advise_plan", "default_host_budget_bytes",
+           "MeshAdvice", "advise_mesh"]
 
 #: in-core peak is ~this multiple of the packed (N, D) f32 matrix: the
 #: packed output + full-width raw/intermediate columns + device staging
@@ -66,6 +67,8 @@ class PlanAdvice:
     retain_mb: int
     predicted_wall_s: Optional[float]   # cost-model total; None when cold
     reasons: List[str] = field(default_factory=list)
+    #: optional MeshAdvice (ExecutionPlan.advise(queue_width=...))
+    mesh: Optional["MeshAdvice"] = None
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -79,6 +82,7 @@ class PlanAdvice:
             "predictedWallSecs": (round(self.predicted_wall_s, 3)
                                   if self.predicted_wall_s else None),
             "reasons": list(self.reasons),
+            "mesh": self.mesh.to_json() if self.mesh is not None else None,
         }
 
     def format(self) -> str:
@@ -98,7 +102,114 @@ class PlanAdvice:
                 f"  cost-model predicted wall ~{self.predicted_wall_s:.1f}s")
         for r in self.reasons:
             lines.append(f"  - {r}")
+        if self.mesh is not None:
+            lines.append(
+                f"  mesh advice: {self.mesh.n_devices} device(s) "
+                f"(data={self.mesh.data_axis}, grid={self.mesh.grid_axis})")
+            for r in self.mesh.reasons:
+                lines.append(f"  - {r}")
         return "\n".join(lines)
+
+
+@dataclass
+class MeshAdvice:
+    """A deterministic mesh recommendation for a selector sweep."""
+
+    n_devices: int                 # 1 = stay single-chip
+    data_axis: int
+    grid_axis: int
+    rows: int
+    cols: int
+    queue_width: int
+    #: predicted sweep wall per candidate device count (cost model with
+    #: the n_devices feature); empty when the model is cold
+    predicted_wall_s: Dict[int, float] = field(default_factory=dict)
+    reasons: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"nDevices": self.n_devices, "dataAxis": self.data_axis,
+                "gridAxis": self.grid_axis, "rows": self.rows,
+                "cols": self.cols, "queueWidth": self.queue_width,
+                "predictedWallSecs": {str(k): round(v, 4) for k, v
+                                      in self.predicted_wall_s.items()},
+                "reasons": list(self.reasons)}
+
+
+#: below this many matrix elements a sweep mesh costs more in collective
+#: and padding overhead than it saves (measured: titanic-scale sweeps are
+#: dispatch-bound, not FLOP-bound)
+MESH_MIN_ELEMS = 1 << 22
+
+#: mesh-fit stage kinds the scaling prediction consults
+_MESH_KINDS = ("ModelSelector:fit", "ModelSelector:fit-halving")
+
+
+def advise_mesh(rows: int, cols: int, queue_width: int,
+                devices_available: Optional[int] = None,
+                cost_model: Optional[CostModel] = None,
+                backend: Optional[str] = None) -> MeshAdvice:
+    """Recommend a ("data", "grid") sweep-mesh shape for a sweep of
+    ``queue_width`` candidates over a (rows, cols) matrix.
+
+    Tiers, mirroring the BenchBudgeter's philosophy (measured evidence
+    beats a model beats an assumption):
+
+    1. With a cost model whose selector buckets carry MEASURED multi-chip
+       history (the ``n_devices`` feature), pick the device count with
+       the lowest predicted sweep wall.
+    2. Cold model: a size heuristic — meshes below ``MESH_MIN_ELEMS``
+       matrix elements stay single-chip (dispatch-bound), larger shapes
+       take every available device.
+
+    Deterministic for fixed inputs; the grid axis always comes from
+    :func:`transmogrifai_tpu.parallel.auto_grid_axis`.
+    """
+    import jax
+
+    from ..parallel.mesh import auto_grid_axis
+
+    rows, cols = max(int(rows), 1), max(int(cols), 1)
+    queue_width = max(int(queue_width), 1)
+    n_avail = (int(devices_available) if devices_available
+               else len(jax.devices()))
+    reasons: List[str] = []
+    predicted: Dict[int, float] = {}
+
+    candidates = [1]
+    d = 2
+    while d <= n_avail:
+        candidates.append(d)
+        d *= 2
+    if cost_model is not None:
+        fitted = [k for k in _MESH_KINDS
+                  if cost_model.source(k, backend) == "fitted"]
+        if fitted:
+            for nd in candidates:
+                predicted[nd] = sum(
+                    cost_model.predict(k, rows, cols, backend=backend,
+                                       n_devices=nd) for k in fitted)
+            best = min(predicted, key=lambda nd: (predicted[nd], nd))
+            reasons.append(
+                f"measured scaling history: predicted sweep wall "
+                f"{ {k: round(v, 3) for k, v in predicted.items()} } "
+                f"-> {best} device(s)")
+            n = best
+        else:
+            n = n_avail if rows * cols >= MESH_MIN_ELEMS else 1
+            reasons.append(
+                "cost model has no selector scaling history; size "
+                f"heuristic ({rows * cols} elems vs {MESH_MIN_ELEMS} "
+                f"floor) -> {n} device(s)")
+    else:
+        n = n_avail if rows * cols >= MESH_MIN_ELEMS else 1
+        reasons.append(
+            f"no cost model; size heuristic ({rows * cols} elems vs "
+            f"{MESH_MIN_ELEMS} floor) -> {n} device(s)")
+    n = max(1, min(n, n_avail))
+    g = auto_grid_axis(n, queue_width)
+    return MeshAdvice(n_devices=n, data_axis=n // g, grid_axis=g,
+                      rows=rows, cols=cols, queue_width=queue_width,
+                      predicted_wall_s=predicted, reasons=reasons)
 
 
 def advise_plan(rows: int, cols: int, dtype_bytes: int = 4,

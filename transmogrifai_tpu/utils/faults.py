@@ -21,6 +21,10 @@ no plan is armed):
   ``checkpoint.barrier`` right after checkpoint save ``index`` hits disk
                          (workflow/checkpoint.py) — a ``kill`` here is the
                          canonical crash-resume test
+  ``sweep.checkpoint``   right after mid-sweep cursor save ``index`` hits
+                         disk (workflow/checkpoint.SweepCheckpointManager)
+                         — a ``kill`` here is the mid-SWEEP crash-resume
+                         test (tests/test_parallel_mesh.py)
 
 Actions: ``io_error`` (raise OSError — the transient class the reader
 retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``

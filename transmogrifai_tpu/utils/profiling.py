@@ -25,7 +25,8 @@ __all__ = ["OpStep", "MetricsCollector", "AppMetrics", "StepMetrics",
            "profile_to", "RunCounters", "COUNTERS", "reset_counters",
            "count_upload", "count_fetch", "count_drain", "count_launch",
            "fetch_timed", "StageProfile", "PlanProfiler",
-           "IngestPass", "IngestProfiler", "LintSnapshot", "backend_name"]
+           "IngestPass", "IngestProfiler", "LintSnapshot", "backend_name",
+           "mesh_desc"]
 
 
 class OpStep(enum.Enum):
@@ -312,17 +313,40 @@ class StageProfile:
     dtype: str = ""         # primary input dtype
     backend: str = ""       # jax backend for the run
     stage_kind: str = ""    # cost-model bucket key, "Op:kind"
+    n_devices: int = 1      # devices the stage ran on (mesh size; 1 = chip)
+    mesh_shape: str = ""    # e.g. "data=4,grid=2" ("" = no mesh)
 
     def to_json(self) -> Dict[str, Any]:
-        return {"uid": self.uid, "op": self.op, "output": self.output,
-                "layer": self.layer, "kind": self.kind,
-                "deviceHeavy": self.device_heavy,
-                "wallSecs": round(self.wall_s, 4), "rows": self.rows,
-                "colsAdded": self.cols_added,
-                "colsDropped": self.cols_dropped, "launches": self.launches,
-                "cols": self.cols, "dtype": self.dtype,
-                "backend": self.backend,
-                "stageKind": self.stage_kind or f"{self.op}:{self.kind}"}
+        out = {"uid": self.uid, "op": self.op, "output": self.output,
+               "layer": self.layer, "kind": self.kind,
+               "deviceHeavy": self.device_heavy,
+               "wallSecs": round(self.wall_s, 4), "rows": self.rows,
+               "colsAdded": self.cols_added,
+               "colsDropped": self.cols_dropped, "launches": self.launches,
+               "cols": self.cols, "dtype": self.dtype,
+               "backend": self.backend,
+               "stageKind": self.stage_kind or f"{self.op}:{self.kind}"}
+        # backward-compatible additions: single-chip profiles serialize
+        # exactly as before this field existed
+        if self.n_devices != 1:
+            out["nDevices"] = self.n_devices
+        if self.mesh_shape:
+            out["meshShape"] = self.mesh_shape
+        return out
+
+
+def mesh_desc(mesh) -> tuple:
+    """(n_devices, "axis=size,..." ) of a jax Mesh — (1, "") for None."""
+    if mesh is None:
+        return 1, ""
+    try:
+        shape = {name: int(mesh.shape[name]) for name in mesh.axis_names}
+    except Exception:  # pragma: no cover - exotic mesh-likes
+        return 1, ""
+    n = 1
+    for v in shape.values():
+        n *= v
+    return n, ",".join(f"{k}={v}" for k, v in shape.items())
 
 
 #: per-pass chunk records kept verbatim before aggregate-only accounting

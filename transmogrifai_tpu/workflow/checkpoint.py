@@ -61,10 +61,13 @@ from .persistence import _ArrayStore, _load_stage, _stage_record
 __all__ = ["StreamingCheckpointManager", "CheckpointMismatchError",
            "ResumeState", "compute_fingerprint", "encode_fit_state",
            "decode_fit_state", "adopt_restored_model", "CHECKPOINT_JSON",
-           "CHECKPOINT_VERSION"]
+           "CHECKPOINT_VERSION", "SweepCheckpointManager",
+           "sweep_fingerprint", "SWEEP_CHECKPOINT_JSON"]
 
 CHECKPOINT_JSON = "checkpoint.json"
 CHECKPOINT_VERSION = 1
+SWEEP_CHECKPOINT_JSON = "sweep.json"
+SWEEP_CHECKPOINT_VERSION = 1
 
 
 class CheckpointMismatchError(RuntimeError):
@@ -394,6 +397,160 @@ class StreamingCheckpointManager:
                     os.unlink(os.path.join(self.directory, n))
                 except OSError:  # pragma: no cover
                     pass
+
+
+# ---------------------------------------------------------------------------
+# mid-sweep cursor: selector-sweep checkpoint/resume (ROADMAP item 1)
+# ---------------------------------------------------------------------------
+
+def sweep_fingerprint(candidates, metric_name: str, validator_desc: str,
+                      mesh=None, strategy: str = "full",
+                      n_rows: int = 0) -> Dict[str, Any]:
+    """Identity of one selector sweep: same candidate list (names +
+    identity params in order), same validator geometry, same metric, same
+    mesh shape, same strategy → same unit sequence, so a cursor from one
+    run is exact for the other.  Mesh SHAPE (not device ids) is part of
+    the identity — a resume on a differently-shaped mesh would change the
+    padding and batching geometry mid-sweep."""
+    shape = None
+    if mesh is not None:
+        shape = {name: int(mesh.shape[name]) for name in mesh.axis_names}
+    return {
+        "candidates": [[str(c[0]), json.dumps(c[1], sort_keys=True,
+                                              default=str)]
+                       for c in candidates],
+        "metric": metric_name,
+        "validator": validator_desc,
+        "meshShape": shape,
+        "strategy": strategy,
+        "nRows": int(n_rows),
+    }
+
+
+class SweepCheckpointManager:
+    """Owns the mid-sweep cursor for ONE selector sweep.
+
+    The durable unit is a completed :class:`~transmogrifai_tpu.selector.
+    validators.SweepUnit`'s fold metrics (host floats — recorded after the
+    unit's stacked device fetch) plus, for successive halving, the rung
+    state (alive set, per-candidate last results, elimination records).
+    Saves are atomic (``utils.jsonio.write_json_atomic``: tmp +
+    ``os.replace``) every ``every_units`` records, and at every rung
+    boundary; a SIGKILL at any byte leaves the previous cursor intact.
+
+    ``scoped(tag)`` returns a view namespacing unit indices (the halving
+    scheduler runs each rung through a fresh queue whose local indices
+    would otherwise collide across rungs).
+    """
+
+    def __init__(self, directory: str, fingerprint: Dict[str, Any],
+                 every_units: int = 1):
+        if every_units < 1:
+            raise ValueError("sweep checkpoint every_units must be >= 1")
+        self.directory = directory
+        self.fingerprint = fingerprint
+        self.every_units = int(every_units)
+        self.saves = 0
+        self._units: Dict[str, Dict[str, Any]] = {}
+        self._rung: Optional[Dict[str, Any]] = None
+        self._dirty = 0
+        os.makedirs(directory, exist_ok=True)
+
+    # -- resume -------------------------------------------------------------
+
+    def load(self) -> bool:
+        """Prime the cursor from disk; True when a checkpoint was found.
+        A fingerprint mismatch raises :class:`CheckpointMismatchError`
+        (refusing to resume beats silently blending two sweeps)."""
+        path = os.path.join(self.directory, SWEEP_CHECKPOINT_JSON)
+        if not os.path.exists(path):
+            return False
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("version") != SWEEP_CHECKPOINT_VERSION:
+            raise CheckpointMismatchError(
+                f"sweep checkpoint format v{doc.get('version')} != "
+                f"v{SWEEP_CHECKPOINT_VERSION}")
+        if doc.get("fingerprint") != self.fingerprint:
+            raise CheckpointMismatchError(
+                f"sweep checkpoint in {self.directory!r} belongs to a "
+                f"different sweep (candidates/validator/metric/mesh/"
+                f"strategy changed); clear the directory or point the "
+                f"checkpoint elsewhere")
+        self._units = dict(doc.get("units", {}))
+        self._rung = doc.get("rung")
+        return True
+
+    # -- unit cursor --------------------------------------------------------
+
+    def restore(self, index: int, tag: str = ""):
+        rec = self._units.get(f"{tag}{index}")
+        if rec is None:
+            return None
+        return list(rec.get("foldValues", [])), rec.get("error")
+
+    def record_unit(self, index: int, fold_vals, error: Optional[str],
+                    tag: str = "") -> None:
+        self._units[f"{tag}{index}"] = {
+            "foldValues": [float(v) for v in fold_vals],
+            "error": error}
+        self._dirty += 1
+        if self._dirty >= self.every_units:
+            self._write()
+
+    # -- halving rung state -------------------------------------------------
+
+    def rung_state(self) -> Optional[Dict[str, Any]]:
+        return self._rung
+
+    def save_rung_state(self, state: Dict[str, Any]) -> None:
+        self._rung = state
+        self._write()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _write(self) -> None:
+        from ..utils.jsonio import write_json_atomic
+
+        write_json_atomic(
+            os.path.join(self.directory, SWEEP_CHECKPOINT_JSON),
+            {"version": SWEEP_CHECKPOINT_VERSION,
+             "fingerprint": self.fingerprint,
+             "units": self._units,
+             "rung": self._rung})
+        self._dirty = 0
+        self.saves += 1
+        faults.fire("sweep.checkpoint", index=self.saves - 1)
+
+    def flush(self) -> None:
+        if self._dirty:
+            self._write()
+
+    def scoped(self, tag: str) -> "_ScopedSweepCheckpoint":
+        return _ScopedSweepCheckpoint(self, f"{tag}:")
+
+    def finish(self) -> None:
+        """The sweep completed: remove the cursor so a later sweep in the
+        same directory starts fresh."""
+        try:
+            os.unlink(os.path.join(self.directory, SWEEP_CHECKPOINT_JSON))
+        except OSError:
+            pass
+
+
+class _ScopedSweepCheckpoint:
+    """Namespace view over a SweepCheckpointManager (per-rung cursors)."""
+
+    def __init__(self, manager: SweepCheckpointManager, tag: str):
+        self._m = manager
+        self._tag = tag
+
+    def restore(self, index: int):
+        return self._m.restore(index, tag=self._tag)
+
+    def record_unit(self, index: int, fold_vals,
+                    error: Optional[str]) -> None:
+        self._m.record_unit(index, fold_vals, error, tag=self._tag)
 
 
 def adopt_restored_model(est: Estimator, model: PipelineStage) -> Model:

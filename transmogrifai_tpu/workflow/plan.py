@@ -217,21 +217,31 @@ class ExecutionPlan:
     # -- reporting -----------------------------------------------------------
 
     def advise(self, rows: int, cols: int, cost_model=None,
-               host_budget_bytes: Optional[int] = None):
+               host_budget_bytes: Optional[int] = None,
+               queue_width: Optional[int] = None):
         """Cost-predicted plan-level choices for this DAG at a workload of
         ``rows`` x ``cols``: stream vs in-core, chunk_rows, prefetch
         depth, spill threshold (tuning/planner.py).  ``cost_model`` (a
         tuning.CostModel; default: fitted from the shared history file)
         adds a predicted-wall line and read-vs-transform prefetch
-        tuning."""
+        tuning.  ``queue_width`` (the selector sweep's candidate count)
+        additionally attaches a ``mesh`` recommendation — whether and how
+        to spread the sweep over a ("data", "grid") device mesh, from the
+        cost model's MEASURED ``n_devices`` scaling history when it has
+        one (tuning/planner.advise_mesh)."""
         from ..tuning.costmodel import CostModel
-        from ..tuning.planner import advise_plan
+        from ..tuning.planner import advise_mesh, advise_plan
 
         if cost_model is None:
             cost_model = CostModel.from_history()
-        return advise_plan(rows, cols, cost_model=cost_model,
-                           host_budget_bytes=host_budget_bytes,
-                           backend=backend_name())
+        advice = advise_plan(rows, cols, cost_model=cost_model,
+                             host_budget_bytes=host_budget_bytes,
+                             backend=backend_name())
+        if queue_width is not None:
+            advice.mesh = advise_mesh(rows, cols, queue_width=queue_width,
+                                      cost_model=cost_model,
+                                      backend=backend_name())
+        return advice
 
     def explain(self, ingest=None, advice=None) -> str:
         """Static plan report: per-layer stages, host/device split, liveness
@@ -437,13 +447,16 @@ class ExecutionPlan:
         # poison both buckets' fits)
         cost_kind = (getattr(stage, "_cost_kind", None)
                      or getattr(result_stage, "_cost_kind", None) or kind)
+        from ..utils.profiling import mesh_desc
+        n_dev, mshape = mesh_desc(getattr(stage, "mesh", None))
         prof.record_stage(StageProfile(
             uid=stage.uid, op=op, output=name, layer=li,
             kind=kind, device_heavy=stage.device_heavy, wall_s=dt,
             rows=n_rows, cols_added=1,
             launches=(COUNTERS.launches - launches0) if serial else 0,
             cols=width, dtype=dtype, backend=backend_name(),
-            stage_kind=f"{op}:{cost_kind}"))
+            stage_kind=f"{op}:{cost_kind}",
+            n_devices=n_dev, mesh_shape=mshape))
         return result_stage, name, col
 
 

@@ -212,12 +212,39 @@ class _ColumnWriter:
     with unknown N, chunk arrays accumulate and concatenate at finish.
     ``row_view`` hands back zero-copy row-range views of a written
     buffer — the block cascade re-retains written columns as views so
-    the bytes are never held twice."""
+    the bytes are never held twice.
 
-    def __init__(self, total_rows: Optional[int]):
+    ``shard_onto``/``shard_columns`` is the streaming→sharded hand-off
+    (ROADMAP item 1): a designated 2-D float column's rows stream
+    straight into per-shard DEVICE buffers (``parallel.ingest.
+    ShardedMatrixWriter`` — each completed data-shard slice ``device_put``
+    and the host slice buffer reused), so the packed (N, D) matrix never
+    materializes on the host.  Sharding engages only when the contiguity
+    and shape preconditions hold (known total, maskless 2-D float column,
+    writes starting at row 0); otherwise that column silently takes the
+    host path — correctness never depends on the fast path.
+    """
+
+    def __init__(self, total_rows: Optional[int], shard_onto=None,
+                 shard_columns: Optional[Set[str]] = None):
         self.total = total_rows
         self.cols: Dict[str, dict] = {}
         self.offset = 0
+        self._mesh = shard_onto
+        self._shard_cols = set(shard_columns or ())
+
+    def _maybe_shard_writer(self, name: str, col: FeatureColumn):
+        if (self._mesh is None or name not in self._shard_cols
+                or self.total is None or self.offset != 0
+                or col.mask is not None):
+            return None
+        v = np.asarray(col.values)
+        if v.ndim != 2 or not np.issubdtype(v.dtype, np.floating):
+            return None
+        from ..parallel.ingest import ShardedMatrixWriter
+
+        return ShardedMatrixWriter(self._mesh, self.total,
+                                   int(v.shape[1]), dtype=np.float32)
 
     def append(self, chunk: ColumnarDataset, names: Sequence[str]) -> None:
         n = len(chunk)
@@ -229,14 +256,22 @@ class _ColumnWriter:
                     "ftype": col.ftype, "vmeta": col.vmeta,
                     "has_mask": col.mask is not None,
                     "values": None, "mask": None, "parts": [],
-                    "mask_parts": []}
-                if self.total is not None:
+                    "mask_parts": [], "swriter":
+                        self._maybe_shard_writer(name, col)}
+                if self.total is not None and ent["swriter"] is None:
                     v = np.asarray(col.values)
                     ent["values"] = np.empty((self.total,) + v.shape[1:],
                                              dtype=v.dtype)
                     if ent["has_mask"]:
                         ent["mask"] = np.empty(self.total, dtype=bool)
-            if ent["values"] is not None:
+            sw = ent.get("swriter")
+            if sw is not None:
+                if sw.offset != self.offset:  # pragma: no cover - guarded
+                    raise RuntimeError(
+                        f"sharded column {name!r} written out of order "
+                        f"(writer at {sw.offset}, pass at {self.offset})")
+                sw.append(np.asarray(col.values, np.float32))
+            elif ent["values"] is not None:
                 ent["values"][self.offset:self.offset + n] = col.values
                 if ent["has_mask"]:
                     ent["mask"][self.offset:self.offset + n] = col.mask
@@ -256,8 +291,16 @@ class _ColumnWriter:
                              mask, ent["vmeta"])
 
     def finish(self) -> Dict[str, FeatureColumn]:
+        from ..parallel.ingest import ShardedMatrix
+
         out: Dict[str, FeatureColumn] = {}
         for name, ent in self.cols.items():
+            sw = ent.get("swriter")
+            if sw is not None:
+                values = ShardedMatrix(sw.finish(), self.total)
+                out[name] = FeatureColumn(ent["ftype"], values, None,
+                                          ent["vmeta"])
+                continue
             values = (ent["values"] if ent["values"] is not None
                       else np.concatenate(ent["parts"]))
             mask = None
@@ -323,6 +366,8 @@ def fit_dag_streaming(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 16,
     retain_mb: Optional[float] = None,
+    shard_onto=None,
+    shard_columns: Optional[Sequence[str]] = None,
 ) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler]:
     """Fit ``dag`` from chunked ingestion; returns (fitted stages in topo
     order, final dataset equivalent to the in-core executor's with the
@@ -332,7 +377,13 @@ def fit_dag_streaming(
     passes persist their mergeable states every ``checkpoint_every``
     chunks, completed passes persist their fitted models, and a rerun
     against the same directory resumes from the last durable point
-    (workflow/checkpoint.py has the recovery matrix)."""
+    (workflow/checkpoint.py has the recovery matrix).
+
+    ``shard_onto`` (a device mesh) + ``shard_columns`` stream the named
+    packed float matrices straight into per-shard device buffers instead
+    of one host buffer (the streaming→sharded hand-off; see
+    ``_ColumnWriter`` and ``parallel.ingest``) — the mesh sweep then
+    consumes the committed row-sharded array without a host round trip."""
     from .dag import StagesDAG, fit_and_transform_dag
 
     if chunk_rows <= 0:
@@ -505,7 +556,8 @@ def fit_dag_streaming(
             all_targets |= set(est.input_names)
     needed_uids = _closure(sorted(all_targets), out_stage)
 
-    writer = _ColumnWriter(total_rows)
+    writer = _ColumnWriter(total_rows, shard_onto=shard_onto,
+                           shard_columns=set(shard_columns or ()))
     materialized: Dict[str, FeatureColumn] = {}
 
     if not est_idxs:
@@ -761,7 +813,7 @@ def fit_dag_streaming(
     if total_rows is None:
         total_rows = len(data)
     if profiler is not None:
-        from ..utils.profiling import backend_name
+        from ..utils.profiling import backend_name, mesh_desc
 
         for s in (st for layer in prefix for st in layer):
             op = type(s).__name__
@@ -776,6 +828,7 @@ def fit_dag_streaming(
                         width += int(shape[1]) - 1
                     if not dtype:
                         dtype = str(getattr(v, "dtype", "") or "")
+            n_dev, mshape = mesh_desc(getattr(s, "mesh", None))
             profiler.record_stage(StageProfile(
                 uid=s.uid, op=op,
                 output=s.get_output().name,
@@ -785,7 +838,8 @@ def fit_dag_streaming(
                 wall_s=stage_wall.get(s.uid, 0.0),
                 rows=total_rows or 0, cols_added=1,
                 cols=width, dtype=dtype, backend=backend_name(),
-                stage_kind=f"{op}:{kind}"))
+                stage_kind=f"{op}:{kind}",
+                n_devices=n_dev, mesh_shape=mshape))
         profiler.note_columns(len(data.columns))
 
     # -- tail: non-streamable suffix runs in-core on the packed dataset ----

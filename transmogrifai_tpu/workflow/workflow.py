@@ -209,15 +209,19 @@ class OpWorkflow(_WorkflowCore):
         ``prefetch_chunks`` bounds the reader thread's parse-ahead depth
         (chunk k+1 parses while chunk k transforms).
 
-        ``checkpoint_dir`` (out-of-core path only) enables chunk-level
-        checkpoint/resume: streaming-fit states + a chunks-consumed cursor
-        persist atomically every ``checkpoint_every_chunks`` chunks, and
-        re-running the same train against the same directory after a
-        crash resumes from the last durable point instead of refitting
-        (docs/robustness.md; workflow/checkpoint.py for what resumes
-        where).  A checkpoint from a different reader/pipeline/chunk
-        geometry raises ``CheckpointMismatchError`` rather than silently
-        blending runs.
+        ``checkpoint_dir`` enables checkpoint/resume.  On the out-of-core
+        path (with ``chunk_rows``): chunk-level — streaming-fit states +
+        a chunks-consumed cursor persist atomically every
+        ``checkpoint_every_chunks`` chunks, and re-running the same train
+        against the same directory after a crash resumes from the last
+        durable point instead of refitting (docs/robustness.md;
+        workflow/checkpoint.py for what resumes where).  On the in-core
+        path: sweep-level — the directory routes to every ModelSelector
+        stage as a MID-SWEEP cursor (completed sweep units + halving rung
+        state; docs/multichip.md resume semantics).  A checkpoint from a
+        different reader/pipeline/chunk geometry (or a different sweep)
+        raises ``CheckpointMismatchError`` rather than silently blending
+        runs.
 
         ``tuner`` (a :class:`transmogrifai_tpu.tuning.Tuner`) opts THIS
         train into the adaptive machinery (docs/tuning.md): every
@@ -253,10 +257,32 @@ class OpWorkflow(_WorkflowCore):
                     checkpoint_every=checkpoint_every_chunks,
                     retain_mb=retain_mb)
             if checkpoint_dir is not None:
-                raise ValueError(
-                    "checkpoint_dir requires the out-of-core path — pass "
-                    "chunk_rows=k as well (the in-core fit has no chunk "
-                    "boundaries to checkpoint at)")
+                # in-core path: the checkpointable unit is the SELECTOR
+                # SWEEP — route the directory to every ModelSelector stage
+                # as a mid-sweep cursor (completed SweepUnits + halving
+                # rung state, workflow/checkpoint.SweepCheckpointManager),
+                # so an 8-chip sweep killed mid-flight resumes at its
+                # cursor.  Without a selector there is nothing durable to
+                # cut at, and the historical error stands.
+                from ..selector.model_selector import ModelSelector
+
+                dag = compute_dag(self.result_features)
+                sels = [s for s in dag.all_stages()
+                        if isinstance(s, ModelSelector)]
+                if not sels:
+                    raise ValueError(
+                        "checkpoint_dir requires the out-of-core path — "
+                        "pass chunk_rows=k as well (the in-core fit only "
+                        "checkpoints ModelSelector sweeps, and this DAG "
+                        "has none)")
+                prev = [(s, s.sweep_checkpoint_dir) for s in sels]
+                for s in sels:
+                    s.sweep_checkpoint_dir = checkpoint_dir
+                try:
+                    return self._train_in_core(profile, validate=validate)
+                finally:
+                    for s, d in prev:
+                        s.sweep_checkpoint_dir = d
             return self._train_in_core(profile, validate=validate)
         finally:
             for s, prev_strategy, prev_halving in tuned_stages:
@@ -383,11 +409,24 @@ class OpWorkflow(_WorkflowCore):
         lint_snap = self._lint_dag(dag) if validate else None
         self._inject_params(dag)
         meshed_stages = []
+        shard_cols = None
         if self.mesh is not None:
             for s in dag.all_stages():
                 if hasattr(s, "with_mesh"):
                     meshed_stages.append((s, getattr(s, "mesh", None)))
                     s.with_mesh(self.mesh)
+            from ..parallel.mesh import has_grid_axis
+
+            if has_grid_axis(self.mesh):
+                # streaming→sharded hand-off: each ModelSelector's packed
+                # feature matrix streams straight into per-shard device
+                # buffers (parallel/ingest.py) — the (N, D) matrix never
+                # materializes on one host before the sharded sweep
+                from ..selector.model_selector import ModelSelector
+
+                shard_cols = {s.features_feature.name
+                              for s in dag.all_stages()
+                              if isinstance(s, ModelSelector)}
         # a profiler always runs (its per-stage timings feed the learned
         # cost model's history); it lands on the model only when asked for
         profiler = PlanProfiler()
@@ -400,7 +439,8 @@ class OpWorkflow(_WorkflowCore):
                     profiler=profiler, prefetch=prefetch,
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_every=checkpoint_every,
-                    retain_mb=retain_mb)
+                    retain_mb=retain_mb, shard_onto=self.mesh,
+                    shard_columns=shard_cols)
         finally:
             for s, prev in meshed_stages:
                 s.with_mesh(prev)
