@@ -117,6 +117,51 @@ def run_sweep(X, y, n_devices: int):
     return wall, best, [r.metric_value for r in results]
 
 
+def run_sharding_contracts(X, y, n_devices: int) -> dict:
+    """TMOG_CHECK=1 SPMD contract audit (TM024-TM026) on the smoke shape:
+    pad-invariance and mesh-vs-single-device parity of the LR grid
+    group's batched program, plus the sweep-checkpoint byte round-trip.
+    Returns {"findings": [...], "ok": bool} for the smoke gate."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from transmogrifai_tpu.analysis.contracts import check_sharding_contracts
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import clear_sweep_caches
+    from transmogrifai_tpu.parallel.mesh import make_sweep_mesh
+    from transmogrifai_tpu.selector.grid_groups import make_grid_group
+    from transmogrifai_tpu.workflow.checkpoint import (
+        SweepCheckpointManager, sweep_fingerprint)
+
+    grid = [{"reg_param": r, "elastic_net_param": 0.0}
+            for r in (0.001, 0.01, 0.1, 0.2)]
+    proto = OpLogisticRegression()
+    mesh = make_sweep_mesh(len(grid), n_devices=n_devices)
+    rng = np.random.default_rng(42)
+    in_tr = rng.random(len(y)) < 0.75
+    ctxs = [(in_tr.astype(np.float32), (~in_tr).astype(np.float32))]
+
+    ckpt_dir = tempfile.mkdtemp(prefix="tmog_smoke_ckpt_")
+    try:
+        fp = sweep_fingerprint(
+            [("OpLogisticRegression", g, None) for g in grid],
+            "AuPR", "tvs(0.75)", mesh=mesh, n_rows=len(y))
+        manager = SweepCheckpointManager(ckpt_dir, fp)
+        manager.record_unit(0, [0.5], None)
+        manager.save_rung_state({"alive": list(range(len(grid)))})
+        findings = check_sharding_contracts(
+            lambda: make_grid_group(proto, grid, "binary", "AuPR"),
+            X, y, ctxs, mesh,
+            checkpoint_dir=ckpt_dir, checkpoint_fingerprint=fp)
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        clear_sweep_caches()
+    return {"findings": [d.format() for d in findings],
+            "ok": not len(findings)}
+
+
 def rss_probe(mode: str, rows: int, cols: int) -> dict:
     """Subprocess body: stream chunks into device buffers either through
     one monolithic host (N, D) buffer or shard by shard."""
@@ -251,6 +296,16 @@ def main():
         print(f"[multichip] {n} device(s): {wall:.2f}s best={best}",
               file=sys.stderr, flush=True)
 
+    # SPMD runtime contracts (TM024-TM026) under TMOG_CHECK=1 — the
+    # tier-1 multichip smoke runs with the env set, so pad-invariance /
+    # mesh-parity / checkpoint round-trip regressions fail the gate
+    from transmogrifai_tpu.analysis.contracts import checks_enabled
+    contracts_ok = True
+    if args.smoke and checks_enabled():
+        result["sharding_contracts"] = run_sharding_contracts(
+            X, y, n_devices=min(8, n_avail))
+        contracts_ok = result["sharding_contracts"]["ok"]
+
     if not args.smoke:
         result["streaming_ingest_rss"] = _run_rss_probes(
             args.rows, args.cols)
@@ -259,7 +314,7 @@ def main():
         write_json_atomic(OUT_PATH, result, indent=2, sort_keys=True)
     result["parity_ok"] = parity_ok
     print(json.dumps(result))
-    if not parity_ok:
+    if not (parity_ok and contracts_ok):
         sys.exit(1)
 
 

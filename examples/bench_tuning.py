@@ -203,30 +203,33 @@ def main():
     args = ap.parse_args()
     if args.smoke:
         args.rows, args.cols = 4000, 8
-        # smoke must not churn the repo's shared cost history
+        # smoke must not churn the repo's shared cost history; the temp
+        # file is unlinked in the finally even when a gate fails (TM051)
         fd, tmp = tempfile.mkstemp(prefix="tmog_tuning_smoke_",
                                    suffix=".json")
         os.close(fd)
         os.environ["TMOG_COST_HISTORY"] = tmp
-
-    out = run(args.rows, args.cols, smoke=args.smoke)
-
-    if args.smoke:
-        # machinery gates (the strong perf/quality targets are bench-run
-        # properties at the real shape, not smoke-shape properties)
-        sched = out["halving"]["halving_schedule"]
-        assert sched and sched.get("rungs"), "halving schedule missing"
-        assert abs(out["aupr_delta"]) <= 0.1, \
-            f"halving AuPR diverged: {out['aupr_delta']}"
-        assert out["cost_model"]["n_stages"] > 0, "no held-out stages"
-        assert out["cost_model"]["n_history_observations"] > 0, \
-            "train() did not append cost history"
         try:
-            os.unlink(os.environ["TMOG_COST_HISTORY"])
-        except OSError:
-            pass
+            out = run(args.rows, args.cols, smoke=True)
+            # machinery gates (the strong perf/quality targets are
+            # bench-run properties at the real shape, not smoke-shape
+            # properties)
+            sched = out["halving"]["halving_schedule"]
+            assert sched and sched.get("rungs"), "halving schedule missing"
+            assert abs(out["aupr_delta"]) <= 0.1, \
+                f"halving AuPR diverged: {out['aupr_delta']}"
+            assert out["cost_model"]["n_stages"] > 0, "no held-out stages"
+            assert out["cost_model"]["n_history_observations"] > 0, \
+                "train() did not append cost history"
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         print(json.dumps(out), flush=True)
         return
+
+    out = run(args.rows, args.cols, smoke=False)
 
     from transmogrifai_tpu.utils.jsonio import write_json_atomic
     write_json_atomic(os.path.join(_ROOT, "benchmarks",

@@ -36,17 +36,23 @@ else
 fi
 # multichip smoke: the sharded selector sweep on 8 forced host devices —
 # tiny shape, winner/metric parity against the single-device sweep
-# asserted inside the script (rc!=0 on parity failure)
-if timeout -k 10 300 env JAX_PLATFORMS=cpu python examples/bench_multichip.py --smoke > /tmp/_t1_multichip.log 2>&1; then
+# asserted inside the script (rc!=0 on parity failure).  TMOG_CHECK=1
+# additionally runs the SPMD runtime contracts (TM024 pad-invariance,
+# TM025 mesh-vs-single-device parity, TM026 checkpoint byte round-trip)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu TMOG_CHECK=1 python examples/bench_multichip.py --smoke > /tmp/_t1_multichip.log 2>&1; then
   echo "MULTICHIP_SMOKE=ok $(grep -ao '"parity_ok": true' /tmp/_t1_multichip.log | tail -1)"
 else
   echo "MULTICHIP_SMOKE=FAILED (see /tmp/_t1_multichip.log)"
   rc=1
 fi
-# self-lint: trace-safety over the shipped package + examples, DAG lint of
-# the example pipeline factory — any finding fails the script
+# self-lint: all three source families (trace TM03x, shard TM04x,
+# concurrency TM05x) over the shipped package (incl. parallel/ tuning/
+# serving/ workflow/) + examples, DAG lint of the example pipeline
+# factory, ratcheted against the committed findings baseline — NEW
+# findings fail, vanished findings shrink benchmarks/lint_baseline.json
 if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m transmogrifai_tpu.lint \
     transmogrifai_tpu examples \
+    --baseline benchmarks/lint_baseline.json \
     --dag examples/bench_pipeline.py:titanic_features > /tmp/_t1_lint.log 2>&1; then
   echo "LINT=ok"
 else

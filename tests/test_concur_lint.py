@@ -1,0 +1,273 @@
+"""Concurrency/durability lint tests (analysis/concur_lint.py, TM050-053).
+
+One seeded-violation fixture per rule firing exactly that rule, the
+idiomatic-clean negatives (tmp + os.replace, self-stored spill files,
+locked closures, consistent lock order), and the repo self-lint contract
+satellite: the TM050 rule passes repo-wide with ZERO suppressions after
+the persistence/runner writers moved to write_json_atomic.
+"""
+import os
+
+from transmogrifai_tpu.analysis import concur_lint
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(body: str):
+    return concur_lint.lint_source(
+        "import json\nimport os\nimport tempfile\nimport threading\n"
+        "import shutil\n"
+        "from concurrent.futures import ThreadPoolExecutor\n" + body,
+        "fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# TM050 — non-atomic durable writes
+# ---------------------------------------------------------------------------
+
+def test_tm050_raw_json_dump():
+    f = _lint(
+        "def save(path, doc):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        json.dump(doc, fh)\n")
+    assert f.rules_fired() == ["TM050"]
+
+
+def test_tm050_benchmarks_path_open():
+    f = _lint(
+        "def save(doc):\n"
+        "    with open('benchmarks/foo_latest.json', 'w') as fh:\n"
+        "        fh.write(str(doc))\n")
+    assert f.rules_fired() == ["TM050"]
+
+
+def test_tm050_tmp_replace_pattern_is_clean():
+    """The write_json_atomic / checkpoint._write idiom."""
+    f = _lint(
+        "def save(path, doc):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as fh:\n"
+        "        json.dump(doc, fh)\n"
+        "        fh.flush()\n"
+        "        os.fsync(fh.fileno())\n"
+        "    os.replace(tmp, path)\n")
+    assert len(f) == 0
+
+
+def test_tm050_non_durable_write_is_clean():
+    f = _lint(
+        "def save(path, doc):\n"
+        "    with open('/tmp/scratch.txt', 'w') as fh:\n"
+        "        fh.write(str(doc))\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM051 — leaked tempfiles
+# ---------------------------------------------------------------------------
+
+def test_tm051_bare_mkstemp():
+    f = _lint(
+        "def scratch():\n"
+        "    fd, path = tempfile.mkstemp()\n"
+        "    os.write(fd, b'x')\n"
+        "    return path\n")
+    assert f.rules_fired() == ["TM051"]
+
+
+def test_tm051_finally_cleanup_is_clean():
+    f = _lint(
+        "def scratch():\n"
+        "    fd, path = tempfile.mkstemp()\n"
+        "    try:\n"
+        "        os.write(fd, b'x')\n"
+        "    finally:\n"
+        "        os.close(fd)\n"
+        "        os.unlink(path)\n")
+    assert len(f) == 0
+
+
+def test_tm051_self_stored_is_clean():
+    """The streaming spill store pattern: lifetime managed by the object
+    (close() unlinks), not the creating function."""
+    f = _lint(
+        "class Store:\n"
+        "    def open_spill(self):\n"
+        "        fd, self._path = tempfile.mkstemp(suffix='.npy')\n"
+        "        self._fh = os.fdopen(fd, 'w+b')\n")
+    assert len(f) == 0
+
+
+def test_tm051_context_manager_is_clean():
+    f = _lint(
+        "def scratch():\n"
+        "    with tempfile.NamedTemporaryFile(delete=False) as fh:\n"
+        "        fh.write(b'x')\n")
+    # delete=False inside `with` is still covered by the context manager
+    # closing the handle; only the bare call leaks silently
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM052 — unlocked shared mutation from pool closures
+# ---------------------------------------------------------------------------
+
+def test_tm052_unlocked_append():
+    f = _lint(
+        "def drive(pool, items):\n"
+        "    out = []\n"
+        "    def one(i):\n"
+        "        out.append(i * 2)\n"
+        "    for i in items:\n"
+        "        pool.submit(one, i)\n")
+    assert f.rules_fired() == ["TM052"]
+
+
+def test_tm052_lambda_augassign():
+    f = _lint(
+        "def drive(pool, items):\n"
+        "    total = {}\n"
+        "    for i in items:\n"
+        "        pool.submit(lambda: total.update({i: i}))\n")
+    assert f.rules_fired() == ["TM052"]
+
+
+def test_tm052_locked_mutation_is_clean():
+    f = _lint(
+        "def drive(pool, items):\n"
+        "    out = []\n"
+        "    lock = threading.Lock()\n"
+        "    def one(i):\n"
+        "        with lock:\n"
+        "            out.append(i * 2)\n"
+        "    for i in items:\n"
+        "        pool.submit(one, i)\n")
+    assert len(f) == 0
+
+
+def test_tm052_map_results_are_clean():
+    """The bench_serving fix: collect from map() returns instead of
+    mutating shared state."""
+    f = _lint(
+        "def drive(items):\n"
+        "    def one(i):\n"
+        "        return i * 2\n"
+        "    with ThreadPoolExecutor() as pool:\n"
+        "        out = list(pool.map(one, items))\n"
+        "    return out\n")
+    assert len(f) == 0
+
+
+def test_tm052_local_state_is_clean():
+    f = _lint(
+        "def drive(pool, items):\n"
+        "    def one(i):\n"
+        "        acc = []\n"
+        "        acc.append(i)\n"
+        "        return acc\n"
+        "    for i in items:\n"
+        "        pool.submit(one, i)\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM053 — lock order inversions
+# ---------------------------------------------------------------------------
+
+def test_tm053_inversion_same_file():
+    f = _lint(
+        "class Pair:\n"
+        "    def a_then_b(self):\n"
+        "        with self._reg_lock:\n"
+        "            with self._adm_lock:\n"
+        "                pass\n"
+        "    def b_then_a(self):\n"
+        "        with self._adm_lock:\n"
+        "            with self._reg_lock:\n"
+        "                pass\n")
+    assert f.rules_fired() == ["TM053"]
+    assert "inversion" in f.by_rule("TM053")[0].message
+
+
+def test_tm053_consistent_order_is_clean():
+    f = _lint(
+        "class Pair:\n"
+        "    def a_then_b(self):\n"
+        "        with self._reg_lock:\n"
+        "            with self._adm_lock:\n"
+        "                pass\n"
+        "    def also_a_then_b(self):\n"
+        "        with self._reg_lock:\n"
+        "            with self._adm_lock:\n"
+        "                pass\n")
+    assert len(f) == 0
+
+
+def test_tm053_cross_file_inversion():
+    edges = {}
+    f1 = concur_lint.lint_source(
+        "class Registry:\n"
+        "    def swap(self, adm):\n"
+        "        with self._lock:\n"
+        "            with adm.queue_lock:\n"
+        "                pass\n", "registry.py", _edges=edges)
+    f2 = concur_lint.lint_source(
+        "class Admission:\n"
+        "    def admit(self, reg):\n"
+        "        with self.queue_lock:\n"
+        "            with reg.registry_lock:\n"
+        "                pass\n", "admission.py", _edges=edges)
+    # different attribute names -> no inversion yet
+    assert len(f1) == 0 and len(f2) == 0
+    f3 = concur_lint.lint_source(
+        "class Admission:\n"
+        "    def admit2(self, adm):\n"
+        "        with adm.queue_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n", "admission2.py", _edges=edges)
+    # hmm: self._lock keys on the class name, so this is
+    # Admission._lock vs Registry._lock — construct the true reverse:
+    assert len(f3) == 0
+    f4 = concur_lint.lint_source(
+        "class Registry:\n"
+        "    def swap2(self, adm):\n"
+        "        with adm.queue_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n", "registry2.py", _edges=edges)
+    assert f4.rules_fired() == ["TM053"]
+
+
+# ---------------------------------------------------------------------------
+# suppression + self-lint
+# ---------------------------------------------------------------------------
+
+def test_disable_comment_suppresses():
+    f = _lint(
+        "def save(path, doc):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        json.dump(doc, fh)  # tmog: disable=TM050\n")
+    assert len(f) == 0
+
+
+def test_repo_self_lint_zero_suppressions():
+    """Satellite contract: after the persistence/runner conversion to
+    write_json_atomic, TM050 (and the whole TM05x family) passes
+    repo-wide with zero findings AND zero inline suppressions."""
+    pkg = os.path.join(_ROOT, "transmogrifai_tpu")
+    ex = os.path.join(_ROOT, "examples")
+    f = concur_lint.lint_paths([pkg, ex])
+    assert len(f) == 0, f.format()
+    # zero suppressions: no tmog: disable=TM05x comment anywhere
+    import re
+
+    for base in (pkg, ex):
+        for root, dirs, files in os.walk(base):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            if root.endswith(os.path.join("transmogrifai_tpu", "analysis")):
+                continue  # the lint modules document the syntax itself
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                with open(os.path.join(root, fn), encoding="utf-8") as fh:
+                    assert not re.search(r"tmog:\s*disable=TM05", fh.read()), \
+                        f"TM05x suppression found in {fn}"

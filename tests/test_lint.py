@@ -586,6 +586,40 @@ def test_disable_comment_suppresses():
     assert len(f) == 0
 
 
+def test_disable_comment_on_multiline_statement():
+    """The flagged call spans several lines; the trailing comment sits on
+    a CONTINUATION line, not the statement's first line — suppression
+    must honor any line the statement covers."""
+    f = lint_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(\n"
+        "        x\n"
+        "    )  # tmog: disable=TM030\n")
+    assert len(f) == 0
+
+
+def test_disable_comment_mid_multiline_statement():
+    f = lint_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(  # tmog: disable=TM030\n"
+        "        x)\n")
+    assert len(f) == 0
+
+
+def test_unrelated_rule_on_multiline_statement_still_fires():
+    f = lint_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(\n"
+        "        x)  # tmog: disable=TM031\n")
+    assert f.rules_fired() == ["TM030"]
+
+
 def test_repo_self_lint_is_clean():
     """The shipped jit-heavy trees must stay trace-safe (tier1 contract)."""
     trees = ["models", "serving", "parallel", "ops"]
@@ -618,8 +652,46 @@ def test_cli_json_report(tmp_path, capsys):
     bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
     assert lint_cli([str(bad), "--json"]) == 1
     report = json.loads(capsys.readouterr().out)
+    assert report["schemaVersion"] == 2
     assert report["errors"] == 1
     assert report["findings"][0]["rule"] == "TM030"
+
+
+def test_cli_baseline_ratchet(tmp_path, capsys):
+    """The CI ratchet: baselined findings pass, new findings fail, and
+    findings that stopped firing SHRINK the committed baseline."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return float(x)\n")
+    baseline = tmp_path / "lint_baseline.json"
+    key = f"TM030|{bad}"
+    baseline.write_text(json.dumps(
+        {"schemaVersion": 2, "entries": {key: 1}}))
+
+    # baselined finding -> tolerated, exit 0, baseline unchanged
+    assert lint_cli([str(bad), "--baseline", str(baseline)]) == 0
+    assert json.loads(baseline.read_text())["entries"] == {key: 1}
+    capsys.readouterr()
+
+    # a NEW finding (second violation) still fails
+    bad.write_text("import jax\n@jax.jit\ndef f(x):\n"
+                   "    y = float(x)\n    return float(x) + y\n")
+    assert lint_cli([str(bad), "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "TM030" in out and out.count("TM030") == 1  # only the new one
+
+    # the violation disappears -> the baseline shrinks to empty
+    bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x\n")
+    assert lint_cli([str(bad), "--baseline", str(baseline)]) == 0
+    assert json.loads(baseline.read_text())["entries"] == {}
+    capsys.readouterr()
+
+
+def test_cli_empty_committed_baseline_passes_clean_repo(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("import jax\n@jax.jit\ndef f(x):\n    return x * 2\n")
+    assert lint_cli(
+        [str(ok), "--baseline",
+         os.path.join(_ROOT, "benchmarks", "lint_baseline.json")]) == 0
 
 
 def test_cli_dag_spec(capsys):

@@ -1,0 +1,308 @@
+"""Catalog-completeness contract: every TM rule id registered in
+``analysis/diagnostics.RULES`` has EXACTLY ONE seeded fixture here, and
+each fixture fires exactly that rule and nothing else.
+
+A new rule landing without a fixture (or a fixture drifting to fire a
+neighbour rule) fails this module — the rule catalog and the seeded
+corpus can never desync.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.analysis import RULES, Findings
+from transmogrifai_tpu.analysis import concur_lint, shard_lint
+from transmogrifai_tpu.analysis.contracts import (
+    ContractViolation, check_checkpoint_roundtrip, check_mesh_parity,
+    check_pad_invariance, check_streaming_fit, guarded_transform_output,
+)
+from transmogrifai_tpu.analysis.linter import lint_dag
+from transmogrifai_tpu.analysis.trace_lint import lint_source
+from transmogrifai_tpu.workflow.dag import StagesDAG, compute_dag
+
+import test_lint as TL
+import test_sharding_contracts as TS
+
+_SHARD_PRELUDE = TS and TL and (
+    "import jax\nimport numpy as np\nfrom jax import lax\n"
+    "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+    "from transmogrifai_tpu.parallel.mesh import (make_sweep_mesh, "
+    "shard_map_compat)\n")
+_CONCUR_PRELUDE = (
+    "import json\nimport os\nimport tempfile\n"
+    "from concurrent.futures import ThreadPoolExecutor\n")
+
+
+def _violation(fn) -> Findings:
+    """Run a guard that raises ContractViolation; collect the diagnostic."""
+    try:
+        fn()
+    except ContractViolation as e:
+        return Findings([e.diagnostic])
+    return Findings()
+
+
+# -- TM00x ------------------------------------------------------------------
+
+def _tm001():
+    a, b = TL._real_features("a", "b")
+    s = TL._PassThrough().set_input(b)
+    return lint_dag(StagesDAG([[TL._gen(a)], [s]]))
+
+
+def _tm002():
+    (a,) = TL._real_features("a")
+    s = TL._FixedName("a").set_input(a)
+    return lint_dag(StagesDAG([[TL._gen(a)], [s]]))
+
+
+def _tm003():
+    (a,) = TL._real_features("a")
+    s1 = TL._FixedName("dup").set_input(a)
+    s2 = TL._FixedName("dup").set_input(a)
+    return lint_dag(StagesDAG([[TL._gen(a)], [s1, s2]]))
+
+
+def _tm004():
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    (a,) = TL._real_features("a")
+    t = FeatureBuilder.Text("t").as_predictor()
+    vec = RealVectorizer().set_input(a)
+    vec.input_features = [t]
+    return lint_dag(StagesDAG([[TL._gen(t)], [vec]]))
+
+
+def _tm005():
+    a, b = TL._real_features("a", "b")
+    sa = TL._PassThrough().set_input(a)
+    sb = TL._PassThrough().set_input(b)
+    dag = compute_dag([sa.get_output(), sb.get_output()])
+    return lint_dag(dag, result_features=[sa.get_output()])
+
+
+def _tm006():
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    survived, age = TL._real_features("Survived", "Age",
+                                      response="Survived")
+    leaky = RealVectorizer().set_input(survived, age)
+    return lint_dag(compute_dag([leaky.get_output()]))
+
+
+# -- TM02x ------------------------------------------------------------------
+
+def _tm020():
+    data, f = TL._unary_data()
+    bad = TL._InPlaceWriter().set_input(f)
+    return _violation(lambda: guarded_transform_output(bad, data))
+
+
+def _tm021():
+    data, f = TL._streaming_data()
+    return check_streaming_fit(TL._NonAssociativeMerge().set_input(f), data)
+
+
+def _tm022():
+    data, f = TL._streaming_data()
+    return check_streaming_fit(TL._LastChunkWins().set_input(f), data)
+
+
+def _tm023():
+    data, f = TL._unary_data()
+    bad = TL._NonDeterministic().set_input(f)
+    return _violation(lambda: guarded_transform_output(bad, data))
+
+
+def _tm024():
+    X, y, ctxs = TS._data(200, 4)
+    return check_pad_invariance(lambda: TS._PadLeakyGroup(), X, y, ctxs,
+                                TS._mesh())
+
+
+def _tm025():
+    X, y, ctxs = TS._data(200, 4)
+    return check_mesh_parity(lambda: TS._MeshDivergentGroup(), X, y, ctxs,
+                             TS._mesh())
+
+
+def _tm026():
+    from transmogrifai_tpu.workflow.checkpoint import (
+        SWEEP_CHECKPOINT_JSON, SweepCheckpointManager, sweep_fingerprint)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        fp = sweep_fingerprint([("lr", {"reg_param": 0.1}, None)],
+                               "AuPR", "tvs")
+        m = SweepCheckpointManager(tmp, fp)
+        m.record_unit(0, [0.5], None)
+        path = os.path.join(tmp, SWEEP_CHECKPOINT_JSON)
+        with open(path) as fh:
+            doc = json.load(fh)
+        with open(path, "w") as fh:
+            fh.write(json.dumps(doc, sort_keys=True))
+        return check_checkpoint_roundtrip(tmp, fp)
+
+
+# -- TM03x ------------------------------------------------------------------
+
+def _tm030():
+    return lint_source(
+        "import jax\n@jax.jit\ndef f(x):\n    return float(x)\n")
+
+
+def _tm031():
+    return lint_source(
+        "import jax\n"
+        "def outer(xs):\n"
+        "    n = 3\n"
+        "    @jax.jit\n"
+        "    def inner(x):\n"
+        "        return x * n\n"
+        "    return inner(xs)\n")
+
+
+def _tm032():
+    return lint_source(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def f(x, opts=[1, 2]):\n"
+        "    return x\n")
+
+
+# -- TM04x ------------------------------------------------------------------
+
+def _shard(body):
+    return shard_lint.lint_source(_SHARD_PRELUDE + body, "fixture.py")
+
+
+def _tm040():
+    return _shard(
+        "def total(X, w, mesh):\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        return (w_s * X_s[:, 0]).sum()\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P('data')), P())\n"
+        "    return fn(X, w)\n")
+
+
+def _tm041():
+    return _shard(
+        "def run(X):\n"
+        "    mesh = make_sweep_mesh(4)\n"
+        "    def shard_fn(X_s):\n"
+        "        return lax.psum(X_s, axis_name='data')\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('model', None),), P(None, None))\n"
+        "    return fn(X)\n")
+
+
+def _tm042():
+    return _shard(
+        "def sweep(chunks, n):\n"
+        "    mesh = make_sweep_mesh(n)\n"
+        "    out = []\n"
+        "    for c in chunks:\n"
+        "        out.append(jax.device_put(c))\n"
+        "    return out\n")
+
+
+def _tm043():
+    return _shard(
+        "def step(x):\n"
+        "    f = jax.jit(lambda a: a + 1, donate_argnums=(0,))\n"
+        "    y = f(x)\n"
+        "    return x + y\n")
+
+
+def _tm044():
+    return _shard(
+        "def place(mesh):\n"
+        "    s = NamedSharding(mesh, P('data', None))\n"
+        "    v = np.zeros(8)\n"
+        "    return jax.device_put(v, s)\n")
+
+
+def _tm045():
+    return _shard(
+        "def run(X, w, mesh):\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        return lax.psum(w_s @ X_s, axis_name='data')\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P(None))\n"
+        "    return fn(X, w)\n")
+
+
+# -- TM05x ------------------------------------------------------------------
+
+def _concur(body):
+    return concur_lint.lint_source(_CONCUR_PRELUDE + body, "fixture.py")
+
+
+def _tm050():
+    return _concur(
+        "def save(path, doc):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        json.dump(doc, fh)\n")
+
+
+def _tm051():
+    return _concur(
+        "def scratch():\n"
+        "    fd, path = tempfile.mkstemp()\n"
+        "    return path\n")
+
+
+def _tm052():
+    return _concur(
+        "def drive(pool, items):\n"
+        "    out = []\n"
+        "    def one(i):\n"
+        "        out.append(i)\n"
+        "    for i in items:\n"
+        "        pool.submit(one, i)\n")
+
+
+def _tm053():
+    return _concur(
+        "class Pair:\n"
+        "    def ab(self):\n"
+        "        with self.a_lock:\n"
+        "            with self.b_lock:\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self.b_lock:\n"
+        "            with self.a_lock:\n"
+        "                pass\n")
+
+
+#: rule id -> its ONE seeded fixture
+FIXTURES = {
+    "TM001": _tm001, "TM002": _tm002, "TM003": _tm003, "TM004": _tm004,
+    "TM005": _tm005, "TM006": _tm006,
+    "TM020": _tm020, "TM021": _tm021, "TM022": _tm022, "TM023": _tm023,
+    "TM024": _tm024, "TM025": _tm025, "TM026": _tm026,
+    "TM030": _tm030, "TM031": _tm031, "TM032": _tm032,
+    "TM040": _tm040, "TM041": _tm041, "TM042": _tm042, "TM043": _tm043,
+    "TM044": _tm044, "TM045": _tm045,
+    "TM050": _tm050, "TM051": _tm051, "TM052": _tm052, "TM053": _tm053,
+}
+
+
+def test_every_rule_has_exactly_one_fixture():
+    assert set(FIXTURES) == set(RULES), (
+        f"catalog/fixture desync: missing fixtures for "
+        f"{sorted(set(RULES) - set(FIXTURES))}, stale fixtures for "
+        f"{sorted(set(FIXTURES) - set(RULES))}")
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_fixture_fires_exactly_its_rule(rule):
+    findings = FIXTURES[rule]()
+    assert findings.rules_fired() == [rule], (
+        f"{rule} fixture fired {findings.rules_fired() or 'nothing'}:\n"
+        f"{findings.format()}")

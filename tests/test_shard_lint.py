@@ -1,0 +1,395 @@
+"""Shard-safety lint tests (analysis/shard_lint.py, TM040-TM045).
+
+One seeded-violation fixture per rule id that fires EXACTLY that rule,
+negative fixtures distilled from the real shard_map bodies in
+parallel/sharded.py (the regression corpus for the collective-aware
+taint), and the repo self-lint contract.
+"""
+import os
+
+from transmogrifai_tpu.analysis import shard_lint
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = (
+    "import functools\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "import numpy as np\n"
+    "from jax import lax\n"
+    "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+    "from transmogrifai_tpu.parallel.mesh import (make_mesh, "
+    "make_sweep_mesh, shard_map_compat)\n")
+
+
+def _lint(body: str):
+    return shard_lint.lint_source(_PRELUDE + body, "fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# TM040 — cross-shard reduction with no collective
+# ---------------------------------------------------------------------------
+
+def test_tm040_reduction_without_psum():
+    f = _lint(
+        "def total(X, w, mesh):\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        return (w_s * X_s[:, 0]).sum()\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P('data')), P())\n"
+        "    return fn(X, w)\n")
+    assert f.rules_fired() == ["TM040"]
+
+
+def test_tm040_matmul_without_psum():
+    f = _lint(
+        "def gram(X, mesh):\n"
+        "    def shard_fn(X_s):\n"
+        "        return X_s.T @ X_s\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P(None, None))\n"
+        "    return fn(X)\n")
+    assert f.rules_fired() == ["TM040"]
+
+
+def test_tm040_clean_with_psum():
+    """The colstats_psum shape: per-shard partials + one psum."""
+    f = _lint(
+        "def colstats(X, w, mesh):\n"
+        "    data_axis = mesh.axis_names[0]\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        part = jnp.concatenate([w_s.sum()[None], w_s @ X_s])\n"
+        "        tot = lax.psum(part, axis_name=data_axis)\n"
+        "        return tot[1:] / jnp.maximum(tot[0], 1.0)\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P('data')), P(None))\n"
+        "    return fn(X, w)\n")
+    assert len(f) == 0
+
+
+def test_tm040_partial_bound_collective_is_clean():
+    """grow_forest_sharded shape: the collective rides in via a
+    functools.partial plumbed to a helper — still counts as present."""
+    f = _lint(
+        "def grow(B, W, mesh, helper):\n"
+        "    data_axis = mesh.axis_names[0]\n"
+        "    psum = functools.partial(lax.psum, axis_name=data_axis)\n"
+        "    def shard_fn(B_s, W_s):\n"
+        "        fn = functools.partial(helper, all_reduce=psum)\n"
+        "        return jax.vmap(fn)(B_s, W_s)\n"
+        "    f2 = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P(None, 'data')),\n"
+        "                          P(None, None))\n"
+        "    return f2(B, W)\n")
+    assert len(f) == 0
+
+
+def test_tm040_axis_restricted_reduction_is_clean():
+    """A reduction over an UNSHARDED axis stays per-row local."""
+    f = _lint(
+        "def rowsum(X, mesh):\n"
+        "    def shard_fn(X_s):\n"
+        "        return X_s.sum(axis=1)\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P('data'))\n"
+        "    return fn(X)\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM041 — axis names the mesh does not define
+# ---------------------------------------------------------------------------
+
+def test_tm041_unknown_axis_in_spec():
+    f = _lint(
+        "def run(X):\n"
+        "    mesh = make_sweep_mesh(4)\n"
+        "    def shard_fn(X_s):\n"
+        "        return lax.psum(X_s, axis_name='data')\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('model', None),), P(None, None))\n"
+        "    return fn(X)\n")
+    assert f.rules_fired() == ["TM041"]
+    assert "'model'" in f.by_rule("TM041")[0].message
+
+
+def test_tm041_unknown_axis_in_collective():
+    f = _lint(
+        "def run(X):\n"
+        "    mesh = make_sweep_mesh(4)\n"
+        "    def shard_fn(X_s):\n"
+        "        return lax.psum(X_s.sum(), axis_name='batch')\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P())\n"
+        "    return fn(X)\n")
+    assert f.rules_fired() == ["TM041"]
+
+
+def test_tm041_symbolic_axis_is_clean():
+    """``ax = mesh.axis_names[0]`` resolves to a real axis."""
+    f = _lint(
+        "def run(X):\n"
+        "    mesh = make_mesh(8)\n"
+        "    ax = mesh.axis_names[0]\n"
+        "    def shard_fn(X_s):\n"
+        "        return lax.psum(X_s.sum(), axis_name=ax)\n"
+        "    fn = shard_map_compat(shard_fn, mesh, (P(ax, None),), P())\n"
+        "    return fn(X)\n")
+    assert len(f) == 0
+
+
+def test_tm041_unknown_mesh_skips():
+    """A mesh of unknown provenance (parameter) is never flagged."""
+    f = _lint(
+        "def run(X, mesh):\n"
+        "    def shard_fn(X_s):\n"
+        "        return lax.psum(X_s, axis_name='whatever')\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P(None, None))\n"
+        "    return fn(X)\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM042 — host round-trips inside sweep inner loops
+# ---------------------------------------------------------------------------
+
+def test_tm042_device_put_in_sweep_loop():
+    f = _lint(
+        "def sweep(chunks, n):\n"
+        "    mesh = make_sweep_mesh(n)\n"
+        "    out = []\n"
+        "    for c in chunks:\n"
+        "        out.append(jax.device_put(c))\n"
+        "    return out\n")
+    assert f.rules_fired() == ["TM042"]
+
+
+def test_tm042_block_until_ready_in_sweep_loop():
+    f = _lint(
+        "def sweep(xs, n):\n"
+        "    mesh = make_sweep_mesh(n)\n"
+        "    for x in xs:\n"
+        "        x.block_until_ready()\n")
+    assert f.rules_fired() == ["TM042"]
+
+
+def test_tm042_hoisted_placement_is_clean():
+    f = _lint(
+        "def sweep(X, chunks, n):\n"
+        "    mesh = make_sweep_mesh(n)\n"
+        "    X_dev = jax.device_put(X)\n"
+        "    for c in chunks:\n"
+        "        consume(X_dev, c)\n")
+    assert len(f) == 0
+
+
+def test_tm042_non_sweep_function_is_clean():
+    """Loops with device_put outside a sweep context are fine (the
+    ShardedMatrixWriter's per-shard upload loop is the idiom)."""
+    f = _lint(
+        "def writer(chunks):\n"
+        "    out = []\n"
+        "    for c in chunks:\n"
+        "        out.append(jax.device_put(c))\n"
+        "    return out\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM043 — donated-buffer reuse
+# ---------------------------------------------------------------------------
+
+def test_tm043_donated_reuse():
+    f = _lint(
+        "def step(x):\n"
+        "    f = jax.jit(lambda a: a + 1, donate_argnums=(0,))\n"
+        "    y = f(x)\n"
+        "    return x + y\n")
+    assert f.rules_fired() == ["TM043"]
+
+
+def test_tm043_rebinding_is_clean():
+    f = _lint(
+        "def step(x):\n"
+        "    f = jax.jit(lambda a: a + 1, donate_argnums=(0,))\n"
+        "    x = f(x)\n"
+        "    return x + 1\n")
+    assert len(f) == 0
+
+
+def test_tm043_no_donation_is_clean():
+    f = _lint(
+        "def step(x):\n"
+        "    f = jax.jit(lambda a: a + 1)\n"
+        "    y = f(x)\n"
+        "    return x + y\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM044 — NamedSharding rank mismatch
+# ---------------------------------------------------------------------------
+
+def test_tm044_rank_mismatch():
+    f = _lint(
+        "def place(mesh):\n"
+        "    s = NamedSharding(mesh, P('data', None))\n"
+        "    v = np.zeros(8)\n"
+        "    return jax.device_put(v, s)\n")
+    assert f.rules_fired() == ["TM044"]
+
+
+def test_tm044_matching_rank_is_clean():
+    f = _lint(
+        "def place(mesh):\n"
+        "    s = NamedSharding(mesh, P('data', None))\n"
+        "    m = np.zeros((8, 4))\n"
+        "    return jax.device_put(m, s)\n")
+    assert len(f) == 0
+
+
+def test_tm044_spec_prefix_is_clean():
+    """A spec SHORTER than the operand rank is a legal prefix."""
+    f = _lint(
+        "def place(mesh):\n"
+        "    s = NamedSharding(mesh, P('data'))\n"
+        "    m = np.zeros((8, 4))\n"
+        "    return jax.device_put(m, s)\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM045 — spec arity mismatch
+# ---------------------------------------------------------------------------
+
+def test_tm045_in_specs_arity():
+    f = _lint(
+        "def run(X, w, mesh):\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        return lax.psum(w_s @ X_s, axis_name='data')\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P(None))\n"
+        "    return fn(X, w)\n")
+    assert f.rules_fired() == ["TM045"]
+
+
+def test_tm045_out_specs_arity():
+    f = _lint(
+        "def run(X, mesh):\n"
+        "    def shard_fn(X_s):\n"
+        "        t = lax.psum(X_s.sum(axis=0), axis_name='data')\n"
+        "        return t, t * t\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),),\n"
+        "                          (P(None), P(None), P(None)))\n"
+        "    return fn(X)\n")
+    assert f.rules_fired() == ["TM045"]
+
+
+def test_tm045_matching_arity_is_clean():
+    f = _lint(
+        "def run(X, w, mesh):\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        m = lax.psum(w_s @ X_s, axis_name='data')\n"
+        "        v = lax.psum(w_s @ (X_s * X_s), axis_name='data')\n"
+        "        return m, v\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P('data')),\n"
+        "                          (P(None), P(None)))\n"
+        "    return fn(X, w)\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# TM030 inside shard bodies — collective results are device values
+# (regression corpus: parallel/sharded.py; satellite of PR 8)
+# ---------------------------------------------------------------------------
+
+def test_tm030_host_cast_of_collective_result():
+    f = _lint(
+        "def run(X, w, mesh):\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        tot = lax.psum(w_s.sum(), axis_name='data')\n"
+        "        return X_s / float(tot)\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P('data')),\n"
+        "                          P('data', None))\n"
+        "    return fn(X, w)\n")
+    assert f.rules_fired() == ["TM030"]
+
+
+def test_tm030_axis_index_is_traced():
+    """axis_index has no tainted operand but its result is a device
+    value — casting it is a host sync."""
+    f = _lint(
+        "def run(X, mesh):\n"
+        "    def shard_fn(X_s):\n"
+        "        i = lax.axis_index('data')\n"
+        "        return X_s * int(i)\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P('data', None))\n"
+        "    return fn(X)\n")
+    assert f.rules_fired() == ["TM030"]
+
+
+def test_collective_body_with_host_driver_is_clean():
+    """The host driver around the shard_map call (np.asarray of the
+    jitted result, float() of host metadata) must NOT be misread as
+    traced — the historical false-positive mode on psum/shard_map code."""
+    f = _lint(
+        "def driver(X, w, mesh):\n"
+        "    data_axis = mesh.axis_names[0]\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        part = jnp.stack([w_s.sum(), (w_s * w_s).sum()])\n"
+        "        return lax.psum(part, axis_name=data_axis)\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P('data')), P(None))\n"
+        "    out = jax.jit(fn)(X, w)\n"
+        "    beta = np.asarray(out)\n"
+        "    return beta[0], float(beta[1])\n")
+    assert len(f) == 0
+
+
+def test_shard_body_static_metadata_is_clean():
+    f = _lint(
+        "def run(X, mesh):\n"
+        "    def shard_fn(X_s):\n"
+        "        k = max(1, X_s.shape[0] // 4)\n"
+        "        idx = (jnp.arange(k) * 2) % X_s.shape[0]\n"
+        "        pooled = lax.all_gather(X_s[idx], 'data')\n"
+        "        return pooled.reshape(-1, X_s.shape[1])\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None),), P(None, None))\n"
+        "    return fn(X)\n")
+    assert len(f) == 0
+
+
+# ---------------------------------------------------------------------------
+# suppression + self-lint
+# ---------------------------------------------------------------------------
+
+def test_disable_comment_suppresses():
+    f = _lint(
+        "def total(X, w, mesh):\n"
+        "    def shard_fn(X_s, w_s):\n"
+        "        return (w_s * X_s[:, 0]).sum()  # tmog: disable=TM040\n"
+        "    fn = shard_map_compat(shard_fn, mesh,\n"
+        "                          (P('data', None), P('data')), P())\n"
+        "    return fn(X, w)\n")
+    assert len(f) == 0
+
+
+def test_parallel_sharded_is_the_clean_corpus():
+    """Every real shard_map body (colstats/Newton/histogram/quantile/
+    forest) lints clean — the satellite regression for collective code."""
+    f = shard_lint.lint_paths(
+        [os.path.join(_ROOT, "transmogrifai_tpu", "parallel")])
+    assert len(f) == 0, f.format()
+
+
+def test_repo_self_lint_is_clean():
+    f = shard_lint.lint_paths(
+        [os.path.join(_ROOT, "transmogrifai_tpu"),
+         os.path.join(_ROOT, "examples")])
+    assert len(f) == 0, f.format()

@@ -4,7 +4,7 @@ The Scala reference rejects mis-wired feature DAGs at *compile* time — the
 sealed ``FeatureType`` hierarchy and arity-typed stage signatures make a
 dangling column or a label-leaking wire a type error before any data moves
 (PAPER.md §1).  The Python port traded that away; this package wins the
-safety layer back as three rule families, each with stable ``TM0xx`` ids:
+safety layer back as five rule families, each with stable ``TM0xx`` ids:
 
 * **DAG lint** (``linter``, TM00x) — pure static validation of an
   ``OpWorkflow``/``StagesDAG``/``ExecutionPlan`` before ``train``/``score``:
@@ -12,30 +12,57 @@ safety layer back as three rule families, each with stable ``TM0xx`` ids:
   mismatches at stage boundaries, dead stages, label leakage.
 * **Contract checks** (``contracts``, TM02x) — opt-in ``TMOG_CHECK=1``
   instrumented mode enforcing the runtime contracts PRs 1-3 introduced:
-  copy-on-write ``transform`` (inputs are frozen ``writeable=False`` and a
-  write is attributed to the offending stage), transform determinism, and
-  mergeable streaming-fit conformance (associativity + ``fit_streaming``
-  vs ``fit`` equivalence within each fitter's documented tolerance).
+  copy-on-write ``transform``, transform determinism, mergeable
+  streaming-fit conformance — plus the mesh-era SPMD contracts (TM024
+  pad-invariance, TM025 mesh-vs-single-device parity, TM026 checkpoint
+  round-trip byte equality).
 * **Trace-safety lint** (``trace_lint``, TM03x) — an AST pass over source
   files flagging host syncs inside jit-decorated functions, Python-scalar
-  closures that become fresh trace constants (recompile hazards), and
-  unhashable static-argument declarations.
+  closures that become fresh trace constants, and unhashable
+  static-argument declarations.
+* **Shard-safety lint** (``shard_lint``, TM04x) — shard_map bodies that
+  reduce sharded values with no collective, undefined mesh axis names,
+  host round-trips in sweep inner loops, donated-buffer reuse,
+  NamedSharding rank and spec-arity mismatches.
+* **Concurrency/durability lint** (``concur_lint``, TM05x) — non-atomic
+  JSON/benchmark writes bypassing ``write_json_atomic``, leaked
+  tempfiles, unlocked shared mutation from thread-pool closures, and
+  lock acquisition order inversions.
 
 CLI: ``python -m transmogrifai_tpu.lint`` (or ``tmog lint``); library entry
 points: ``lint_dag``, ``lint_workflow``, ``lint_paths``,
-``check_workflow_contracts``.
+``lint_paths_all``, ``check_workflow_contracts``,
+``check_sharding_contracts``.
 """
 from .diagnostics import (  # noqa: F401
     Diagnostic, Findings, PipelineLintError, ContractViolation, RULES,
+    JSON_SCHEMA_VERSION,
 )
 from .linter import lint_dag, lint_workflow  # noqa: F401
 from .trace_lint import lint_paths, lint_source  # noqa: F401
 from .contracts import (  # noqa: F401
     checks_enabled, check_streaming_fit, check_workflow_contracts,
+    check_pad_invariance, check_mesh_parity, check_checkpoint_roundtrip,
+    check_sharding_contracts,
 )
 
 __all__ = [
     "Diagnostic", "Findings", "PipelineLintError", "ContractViolation",
-    "RULES", "lint_dag", "lint_workflow", "lint_paths", "lint_source",
-    "checks_enabled", "check_streaming_fit", "check_workflow_contracts",
+    "RULES", "JSON_SCHEMA_VERSION", "lint_dag", "lint_workflow",
+    "lint_paths", "lint_source", "lint_paths_all", "checks_enabled",
+    "check_streaming_fit", "check_workflow_contracts",
+    "check_pad_invariance", "check_mesh_parity",
+    "check_checkpoint_roundtrip", "check_sharding_contracts",
 ]
+
+
+def lint_paths_all(paths) -> Findings:
+    """All three source-lint families (trace TM03x, shard TM04x, concur
+    TM05x) over files / directory trees — what the CLI and the tier-1
+    self-lint run."""
+    from . import concur_lint, shard_lint, trace_lint
+
+    findings = trace_lint.lint_paths(paths)
+    findings.extend(shard_lint.lint_paths(paths))
+    findings.extend(concur_lint.lint_paths(paths))
+    return findings

@@ -1,0 +1,86 @@
+"""Shared AST plumbing for the source-level lint families (TM03x/TM04x/TM05x).
+
+Factored out of ``trace_lint`` when the shard-safety (``shard_lint``) and
+concurrency (``concur_lint``) families arrived: all three need dotted-name
+resolution, scope-bounded walks, and ``# tmog: disable=`` suppression with
+identical semantics.
+
+Suppression semantics: a ``# tmog: disable=TM030`` comment (comma-separate
+several ids) disables the rule on that line, on the enclosing ``def`` line,
+or — for a statement spanning several lines — on ANY line the flagged
+node covers (``lineno..end_lineno``), so trailing comments on multi-line
+calls work.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Optional, Set
+
+__all__ = ["Suppressions", "dotted", "scope_walk", "target_names",
+           "load_names", "SCOPE_NODES"]
+
+_DISABLE_RE = re.compile(r"#\s*tmog:\s*disable=([A-Z0-9,\s]+)")
+
+SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+               ast.ClassDef)
+
+
+class Suppressions:
+    """Per-file ``# tmog: disable=`` map: line number -> suppressed ids."""
+
+    def __init__(self, code: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        for i, line in enumerate(code.splitlines(), 1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                self.by_line[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def suppressed(self, rule: str, node: Optional[ast.AST] = None,
+                   extra_lines: Iterable[Optional[int]] = ()) -> bool:
+        """True when ``rule`` is disabled on any line ``node`` covers
+        (multi-line statements honor a trailing comment on any of their
+        lines) or on any of ``extra_lines`` (the enclosing ``def``)."""
+        lines = list(extra_lines)
+        if node is not None:
+            start = getattr(node, "lineno", None)
+            if start is not None:
+                end = getattr(node, "end_lineno", None) or start
+                lines.extend(range(start, end + 1))
+        for ln in lines:
+            if ln is not None and rule in self.by_line.get(ln, ()):
+                return True
+        return False
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def scope_walk(scope: ast.AST):
+    """Yield ``scope``'s nodes WITHOUT descending into nested function /
+    lambda / class bodies (separate scopes); the nested scope nodes
+    themselves are yielded so callers can recurse."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def target_names(t: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(t)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+
+
+def load_names(e: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(e)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}

@@ -2,8 +2,9 @@
 
 Two kinds of targets, combinable in one invocation:
 
-* **Source paths** (positional) — trace-safety lint (TM03x) over ``.py``
-  files and directory trees.
+* **Source paths** (positional) — the three source-lint families over
+  ``.py`` files and directory trees: trace safety (TM03x), shard safety
+  (TM04x), concurrency/durability (TM05x).
 * **Pipelines** (``--dag SPEC``, repeatable) — DAG lint (TM00x) of a
   workflow built by a factory.  ``SPEC`` is ``module.path:callable`` or
   ``path/to/file.py:callable``; the callable (invoked with no arguments)
@@ -12,7 +13,14 @@ Two kinds of targets, combinable in one invocation:
 
 Exit status is non-zero when any finding (error or warning) is reported —
 the CI contract ``scripts/tier1.sh`` relies on.  ``--json`` emits a
-machine-readable report; ``--rules`` prints the rule catalog.
+machine-readable report (``schemaVersion`` gates its shape); ``--rules``
+prints the rule catalog.
+
+``--baseline FILE`` arms the ratchet CI uses: findings recorded in the
+committed baseline are tolerated (not reported, exit stays 0), NEW
+findings still fail, and findings that no longer fire SHRINK the
+baseline file in place — the debt can only go down.  Keys are
+``rule|file`` with per-key counts, so line drift never invalidates it.
 """
 from __future__ import annotations
 
@@ -22,7 +30,7 @@ import importlib.util
 import json
 import os
 import sys
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from .diagnostics import RULES, Findings
 
@@ -33,9 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "tmog lint",
         description="pipeline static analyzer: DAG lint (TM00x) + "
-                    "trace-safety lint (TM03x)")
+                    "trace (TM03x) / shard (TM04x) / concurrency (TM05x) "
+                    "source lint")
     p.add_argument("paths", nargs="*",
-                   help=".py files / directories for the trace-safety lint")
+                   help=".py files / directories for the source lints")
     p.add_argument("--dag", action="append", default=[], metavar="SPEC",
                    help="lint a pipeline DAG built by SPEC = "
                         "module:callable or file.py:callable (repeatable)")
@@ -45,7 +54,44 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit a JSON report instead of text")
     p.add_argument("--rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="JSON findings baseline: baselined findings pass, "
+                        "new ones fail, vanished ones shrink the file "
+                        "(the CI ratchet)")
     return p
+
+
+def _baseline_key(d) -> str:
+    where = d.location or d.stage_uid or "<pipeline>"
+    if d.location and ":" in d.location:
+        where = d.location.rsplit(":", 1)[0]
+    return f"{d.rule}|{where}"
+
+
+def _apply_baseline(findings: Findings, path: str) -> None:
+    """Drop baselined findings in place; shrink the baseline file when
+    entries stopped firing (the ratchet's downward half)."""
+    from ..utils.jsonio import read_json_tolerant, write_json_atomic
+
+    doc = read_json_tolerant(path, default={})
+    entries: Dict[str, int] = {
+        k: int(v) for k, v in (doc.get("entries") or {}).items()}
+    matched: Dict[str, int] = {}
+    budget = dict(entries)
+    kept = []
+    for d in findings.diagnostics:
+        key = _baseline_key(d)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            matched[key] = matched.get(key, 0) + 1
+        else:
+            kept.append(d)
+    findings.diagnostics = kept
+    shrunk = {k: matched.get(k, 0) for k in entries if matched.get(k, 0)}
+    if shrunk != entries and os.path.exists(path):
+        write_json_atomic(path, {
+            "schemaVersion": doc.get("schemaVersion", 2),
+            "entries": shrunk}, sort_keys=True)
 
 
 def _load_factory(spec: str):
@@ -102,9 +148,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     findings = Findings()
     if args.paths:
-        from .trace_lint import lint_paths
+        from . import lint_paths_all
 
-        findings.extend(lint_paths(args.paths))
+        findings.extend(lint_paths_all(args.paths))
     for spec in args.dag:
         _lint_dag_spec(spec, findings)
 
@@ -112,6 +158,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if suppress:
         findings.diagnostics = [d for d in findings.diagnostics
                                 if d.rule not in suppress]
+    if args.baseline:
+        _apply_baseline(findings, args.baseline)
 
     if args.as_json:
         print(json.dumps(findings.to_json(), indent=2))

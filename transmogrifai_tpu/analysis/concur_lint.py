@@ -1,0 +1,338 @@
+"""Concurrency & durability lint (TM05x) — an AST pass over the source
+trees that now carry threads and crash-safe artifacts.
+
+PRs 5–7 made durability a protocol: every benchmark/checkpoint JSON
+artifact lands via ``utils/jsonio.write_json_atomic`` (or the same
+tmp + ``os.replace`` pattern inline), so a killed process can never
+leave a truncated document.  The serving stack and the plan executor
+hold real locks on real threads.  These rules pin those conventions:
+
+* **TM050 — non-atomic JSON/benchmark write.**  A ``json.dump(...)``
+  call — or an ``open(path, "w")`` whose path mentions ``benchmarks``
+  or ``checkpoint`` — in a function that never calls ``os.replace``:
+  a crash mid-write leaves a truncated artifact.  Writing through
+  ``write_json_atomic`` (or the inline tmp + ``os.replace`` pattern,
+  which the rule recognizes by the ``os.replace`` in the same function)
+  is the fix.
+* **TM051 — uncleaned tempfile.**  ``tempfile.mkstemp``/``mkdtemp``/
+  ``NamedTemporaryFile(delete=False)`` outside a ``with`` statement,
+  not stored on ``self`` (object-lifetime management), in a function
+  with no ``finally`` block that unlinks/removes/rmtrees/closes — the
+  temp artifact leaks on any exception.
+* **TM052 — unlocked shared mutation from a pool closure.**  A lambda /
+  local ``def`` submitted to an executor (``.submit(fn, ...)`` /
+  ``.map(fn, ...)``) that mutates state it closes over (append/extend/
+  add/update, subscript or attribute store, augmented assignment on a
+  free name) with no ``with <lock>`` around the mutation.
+* **TM053 — lock order inversion.**  Nested ``with``-lock acquisitions
+  observed in both orders across the linted file set (e.g. registry
+  lock inside admission lock in one path, admission inside registry in
+  another) — the classic deadlock.  Lock identity is the enclosing
+  class + attribute (``ModelRegistry._lock``) so the serving registry /
+  admission queue pair is tracked across files.
+
+Suppression: ``# tmog: disable=TM050`` on the flagged line (any line of
+a multi-line statement, or the enclosing ``def`` line).  Entry points:
+:func:`lint_source`, :func:`lint_paths` (TM053 needs the whole file set
+to see both orders; ``lint_source`` reports only same-file inversions).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import Suppressions, dotted, scope_walk
+from .diagnostics import Findings
+from .trace_lint import iter_py_files
+
+__all__ = ["lint_source", "lint_paths"]
+
+_TEMPFILE_FNS = {"mkstemp", "mkdtemp", "mktemp"}
+_CLEANUP_HINTS = {"unlink", "remove", "rmtree", "cleanup", "close"}
+_MUTATORS = {"append", "extend", "add", "update", "insert", "setdefault",
+             "pop", "popitem", "clear", "remove", "discard", "put"}
+_DURABLE_PATH_HINTS = ("benchmarks", "checkpoint")
+
+
+def _last(name: Optional[str]) -> Optional[str]:
+    return name.split(".")[-1] if name else None
+
+
+def _lock_like(expr: ast.AST) -> bool:
+    name = dotted(expr)
+    if isinstance(expr, ast.Call):
+        name = dotted(expr.func)
+    return bool(name) and "lock" in name.lower()
+
+
+def _string_constants(expr: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(expr)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+class _ConcurLinter:
+    """One file's pass; ``lock_edges`` is shared across files by
+    ``lint_paths`` so TM053 sees both acquisition orders wherever they
+    live."""
+
+    def __init__(self, code: str, filename: str,
+                 lock_edges: Optional[Dict[Tuple[str, str], str]] = None):
+        self.filename = filename
+        self.findings = Findings()
+        self.suppressions = Suppressions(code)
+        self.tree = ast.parse(code, filename=filename)
+        self.lock_edges = lock_edges if lock_edges is not None else {}
+
+    def run(self) -> Findings:
+        self._visit(self.tree, class_name=None, fn=None)
+        return self.findings
+
+    def _emit(self, rule: str, node: ast.AST, message: str,
+              def_line: Optional[int] = None) -> None:
+        if self.suppressions.suppressed(rule, node,
+                                        extra_lines=(def_line,)):
+            return
+        self.findings.add(rule, message,
+                          location=f"{self.filename}:{node.lineno}")
+
+    # -- traversal ---------------------------------------------------------
+
+    def _visit(self, scope: ast.AST, class_name: Optional[str],
+               fn) -> None:
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._check_atomic_writes(scope)
+            self._check_tempfiles(scope)
+            self._check_pool_closures(scope)
+        self._check_lock_order(scope, class_name)
+        for n in scope_walk(scope):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._visit(n, class_name, n)
+            elif isinstance(n, ast.ClassDef):
+                self._visit(n, n.name, fn)
+
+    # -- TM050 ---------------------------------------------------------------
+
+    def _check_atomic_writes(self, fn) -> None:
+        has_replace = any(
+            isinstance(n, ast.Call) and _last(dotted(n.func)) == "replace"
+            and dotted(n.func) in ("os.replace", "replace")
+            for n in ast.walk(fn))
+        if has_replace:
+            return
+        for n in scope_walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = dotted(n.func)
+            if name == "json.dump":
+                self._emit(
+                    "TM050", n,
+                    "json.dump without the tmp + os.replace pattern: a "
+                    "crash mid-write leaves a truncated artifact; use "
+                    "utils.jsonio.write_json_atomic", fn.lineno)
+            elif _last(name) == "open" and len(n.args) >= 2 \
+                    and isinstance(n.args[1], ast.Constant) \
+                    and isinstance(n.args[1].value, str) \
+                    and "w" in n.args[1].value \
+                    and "b" not in n.args[1].value:
+                hay = " ".join(_string_constants(n.args[0])).lower()
+                if any(h in hay for h in _DURABLE_PATH_HINTS):
+                    self._emit(
+                        "TM050", n,
+                        f"non-atomic write to a durable artifact path "
+                        f"({hay.strip()!r}): use write_json_atomic or "
+                        f"tmp + os.replace", fn.lineno)
+
+    # -- TM051 ---------------------------------------------------------------
+
+    def _check_tempfiles(self, fn) -> None:
+        has_finally_cleanup = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Try) and n.finalbody:
+                body_names = {
+                    _last(dotted(c.func)) for b in n.finalbody
+                    for c in ast.walk(b) if isinstance(c, ast.Call)}
+                if body_names & _CLEANUP_HINTS:
+                    has_finally_cleanup = True
+        in_with: Set[int] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.With):
+                for item in n.items:
+                    for c in ast.walk(item.context_expr):
+                        in_with.add(id(c))
+        for n in scope_walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = dotted(n.func) or ""
+            is_tmp = (name.startswith("tempfile.")
+                      and _last(name) in _TEMPFILE_FNS)
+            if _last(name) == "NamedTemporaryFile":
+                is_tmp = any(k.arg == "delete"
+                             and isinstance(k.value, ast.Constant)
+                             and k.value.value is False
+                             for k in n.keywords)
+            if not is_tmp or id(n) in in_with or has_finally_cleanup:
+                continue
+            # stored on self -> lifetime managed by the object (a close()
+            # elsewhere), e.g. the streaming spill store
+            stored_on_self = False
+            for st in ast.walk(fn):
+                if isinstance(st, ast.Assign) and st.value is n:
+                    for t in st.targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Attribute) and \
+                                    isinstance(sub.ctx, ast.Store):
+                                stored_on_self = True
+            if stored_on_self:
+                continue
+            self._emit(
+                "TM051", n,
+                f"{_last(name) or 'NamedTemporaryFile'} outside a context "
+                f"manager and with no finally-block cleanup: the temp "
+                f"artifact leaks on any exception", fn.lineno)
+
+    # -- TM052 ---------------------------------------------------------------
+
+    def _check_pool_closures(self, fn) -> None:
+        for n in scope_walk(fn):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("submit", "map")
+                    and n.args):
+                continue
+            target = n.args[0]
+            closure = None
+            if isinstance(target, ast.Lambda):
+                closure = target
+            elif isinstance(target, ast.Name):
+                for d in scope_walk(fn):
+                    if isinstance(d, ast.FunctionDef) \
+                            and d.name == target.id:
+                        closure = d
+            if closure is None:
+                continue
+            self._check_closure_mutations(closure, n, fn)
+
+    def _check_closure_mutations(self, closure, submit_node, fn) -> None:
+        bound: Set[str] = set()
+        a = closure.args
+        for p in (getattr(a, "posonlyargs", []) + a.args
+                  + getattr(a, "kwonlyargs", [])):
+            bound.add(p.arg)
+        if a.vararg:
+            bound.add(a.vararg.arg)
+        if a.kwarg:
+            bound.add(a.kwarg.arg)
+        body = closure.body if isinstance(closure.body, list) \
+            else [ast.Expr(closure.body)]
+        for st in body:
+            for sub in ast.walk(st):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Store):
+                    bound.add(sub.id)
+
+        locked_ids: Set[int] = set()
+        for w in ast.walk(closure):
+            if isinstance(w, ast.With) and any(
+                    _lock_like(item.context_expr) for item in w.items):
+                for sub in ast.walk(w):
+                    locked_ids.add(id(sub))
+
+        def free_mut(expr_name: ast.AST) -> Optional[str]:
+            """The free-variable root of a mutated expression, or None."""
+            root = expr_name
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id not in bound:
+                return root.id
+            if isinstance(root, ast.Name) and root.id == "self":
+                return "self"
+            return None
+
+        for sub in ast.walk(closure):
+            if id(sub) in locked_ids:
+                continue
+            hit = None
+            if isinstance(sub, ast.AugAssign):
+                hit = free_mut(sub.target)
+            elif isinstance(sub, (ast.Assign,)):
+                for t in sub.targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        hit = hit or free_mut(t)
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in _MUTATORS:
+                hit = free_mut(sub.func.value)
+            if hit is not None:
+                self._emit(
+                    "TM052", sub,
+                    f"thread-pool closure mutates shared state "
+                    f"({hit!r}) without a lock: concurrent submits race",
+                    fn.lineno)
+
+    # -- TM053 ---------------------------------------------------------------
+
+    def _lock_key(self, expr: ast.AST,
+                  class_name: Optional[str]) -> Optional[str]:
+        name = dotted(expr)
+        if not name or "lock" not in name.lower():
+            return None
+        if name.startswith("self.") and class_name:
+            return f"{class_name}.{name[5:]}"
+        return name
+
+    def _check_lock_order(self, scope: ast.AST,
+                          class_name: Optional[str]) -> None:
+        for outer in scope_walk(scope):
+            if not isinstance(outer, ast.With):
+                continue
+            outer_keys = [k for k in (
+                self._lock_key(i.context_expr, class_name)
+                for i in outer.items) if k]
+            if not outer_keys:
+                continue
+            for inner in ast.walk(outer):
+                if inner is outer or not isinstance(inner, ast.With):
+                    continue
+                inner_keys = [k for k in (
+                    self._lock_key(i.context_expr, class_name)
+                    for i in inner.items) if k]
+                for ok in outer_keys:
+                    for ik in inner_keys:
+                        if ok == ik:
+                            continue
+                        edge = (ok, ik)
+                        rev = (ik, ok)
+                        if rev in self.lock_edges:
+                            self._emit(
+                                "TM053", inner,
+                                f"lock order inversion: {ok} -> {ik} "
+                                f"here, but {ik} -> {ok} at "
+                                f"{self.lock_edges[rev]} — concurrent "
+                                f"paths can deadlock")
+                        self.lock_edges.setdefault(
+                            edge, f"{self.filename}:{inner.lineno}")
+
+
+def lint_source(code: str, filename: str = "<string>",
+                _edges: Optional[Dict] = None) -> Findings:
+    """Concurrency/durability lint one source string (TM053 sees only
+    this file's lock orders; use :func:`lint_paths` for the cross-file
+    pass)."""
+    try:
+        return _ConcurLinter(code, filename, lock_edges=_edges).run()
+    except SyntaxError as e:
+        f = Findings()
+        f.add("TM050", f"could not parse: {e}", severity="warning",
+              location=f"{filename}:{e.lineno or 0}")
+        return f
+
+
+def lint_paths(paths: Iterable[str]) -> Findings:
+    """Concurrency/durability lint files and directory trees; lock-order
+    edges (TM053) accumulate across the whole file set."""
+    findings = Findings()
+    edges: Dict[Tuple[str, str], str] = {}
+    for full in iter_py_files(paths):
+        with open(full, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), full, _edges=edges))
+    return findings

@@ -40,7 +40,9 @@ from .diagnostics import ContractViolation, Diagnostic, Findings
 
 __all__ = ["CHECK_ENV", "checks_enabled", "guarded_transform_output",
            "columns_equal", "columns_close", "check_streaming_fit",
-           "check_workflow_contracts"]
+           "check_workflow_contracts", "check_pad_invariance",
+           "check_mesh_parity", "check_checkpoint_roundtrip",
+           "check_sharding_contracts"]
 
 #: set to "1" to enable the instrumented mode (used by tests and the tier-1
 #: contract gate); any other value disables it with zero overhead beyond one
@@ -262,6 +264,161 @@ def check_streaming_fit(est, data, chunk_sizes: Sequence[int] = (7, 64),
     # leave the estimator wired to the reference model for callers that
     # continue executing the DAG
     est.adopt_model(ref_model)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Sharding / SPMD contracts (TM024-TM026) — the mesh-era runtime half of
+# the shard-safety lint (analysis/shard_lint.py).  Like the streaming
+# checks these are property-check entry points that COLLECT into
+# ``Findings``; scripts/tier1.sh runs them on the multichip smoke under
+# TMOG_CHECK=1 (examples/bench_multichip.py --smoke).
+# ---------------------------------------------------------------------------
+
+def _pad_sweep_inputs(X, y, weight_ctxs, extra_rows: int, seed: int = 7):
+    """Append ``extra_rows`` garbage rows carrying ZERO weight in every
+    fold context — the exact contract ``shard_sweep_inputs`` documents
+    (pad rows must be inert through every weighted reduction).  Garbage
+    (not zero) feature values so a pad leak actually moves the metrics."""
+    rng = np.random.default_rng(seed)
+    pad_X = rng.normal(size=(extra_rows, X.shape[1])).astype(X.dtype)
+    Xp = np.concatenate([X, pad_X])
+    yp = np.concatenate([np.asarray(y, np.float32),
+                         np.zeros(extra_rows, np.float32)])
+    zeros = np.zeros(extra_rows, np.float32)
+    ctxs = [(np.concatenate([np.asarray(w_tr, np.float32), zeros]),
+             np.concatenate([np.asarray(w_ev, np.float32), zeros]))
+            for w_tr, w_ev in weight_ctxs]
+    return Xp, yp, ctxs
+
+
+def _run_group(make_group, mesh, X, y, weight_ctxs):
+    group = make_group()
+    if mesh is not None:
+        group.with_mesh(mesh)
+    M = group.run(X, y, weight_ctxs)
+    if M is None:
+        raise ValueError(
+            f"{type(group).__name__} declined the batched program "
+            f"(mesh={'yes' if mesh is not None else 'no'}); pick a "
+            f"mesh-capable group for the sharding contract checks")
+    return np.asarray(M, np.float64)
+
+
+def check_pad_invariance(make_group, X, y, weight_ctxs, mesh, *,
+                         extra_rows: Optional[int] = None,
+                         tol: float = 5e-3,
+                         findings: Optional[Findings] = None) -> Findings:
+    """TM024: a sharded sweep's metrics must be invariant to the row
+    padding used to tile the mesh's data axis.
+
+    Re-runs ``make_group()``'s batched program with ``n_rows`` padded to
+    the next shard multiple (``extra_rows`` garbage rows at zero fold
+    weight — defaults to one full data-axis tile so the internal pad
+    amount provably changes) and asserts the (C, F) metric matrix matches
+    within ``tol`` (bit-level equality is not required: shard boundaries
+    move, so f32 reduction ORDER legitimately changes).
+    """
+    findings = findings if findings is not None else Findings()
+    X = np.asarray(X, np.float32)
+    if extra_rows is None:
+        if mesh is not None:
+            from ..parallel.mesh import next_shard_pad
+
+            extra_rows = next_shard_pad(mesh, X.shape[0])
+        else:
+            extra_rows = 4
+    base = _run_group(make_group, mesh, X, y, weight_ctxs)
+    Xp, yp, ctxs = _pad_sweep_inputs(X, y, weight_ctxs, extra_rows)
+    padded = _run_group(make_group, mesh, Xp, yp, ctxs)
+    if base.shape != padded.shape or not np.allclose(
+            base, padded, rtol=tol, atol=tol, equal_nan=True):
+        delta = (float(np.max(np.abs(base - padded)))
+                 if base.shape == padded.shape else float("inf"))
+        findings.add(
+            "TM024",
+            f"pad-invariance violation: +{extra_rows} zero-weight rows "
+            f"moved the sweep metrics by {delta:.3e} (> tol={tol}); "
+            f"padding rows are reaching a reduction unmasked")
+    return findings
+
+
+def check_mesh_parity(make_group, X, y, weight_ctxs, mesh, *,
+                      sample_rows: int = 512, tol: float = 2e-2,
+                      findings: Optional[Findings] = None) -> Findings:
+    """TM025: the mesh-sharded batched program must agree with the
+    single-device program on a subsampled unit (stride subsample keeps
+    class balance); disagreement beyond ``tol`` means the sharded
+    rewrite changed the math, not just the layout."""
+    findings = findings if findings is not None else Findings()
+    X = np.asarray(X, np.float32)
+    n = X.shape[0]
+    stride = max(1, n // max(1, min(sample_rows, n)))
+    idx = np.arange(0, n, stride)[:sample_rows]
+    Xs = np.ascontiguousarray(X[idx])
+    ys = np.asarray(y, np.float32)[idx]
+    ctxs = [(np.ascontiguousarray(np.asarray(w_tr, np.float32)[idx]),
+             np.ascontiguousarray(np.asarray(w_ev, np.float32)[idx]))
+            for w_tr, w_ev in weight_ctxs]
+    single = _run_group(make_group, None, Xs, ys, ctxs)
+    sharded = _run_group(make_group, mesh, Xs, ys, ctxs)
+    if single.shape != sharded.shape or not np.allclose(
+            single, sharded, rtol=tol, atol=tol, equal_nan=True):
+        delta = (float(np.max(np.abs(single - sharded)))
+                 if single.shape == sharded.shape else float("inf"))
+        findings.add(
+            "TM025",
+            f"mesh-vs-single-device divergence: sharded metrics differ "
+            f"from the single-device program by {delta:.3e} "
+            f"(> tol={tol}) on a {len(idx)}-row subsample")
+    return findings
+
+
+def check_checkpoint_roundtrip(directory: str, fingerprint,
+                               findings: Optional[Findings] = None
+                               ) -> Findings:
+    """TM026: a sweep checkpoint must round-trip byte-exactly — the
+    manifest on disk, imported by a FRESH manager and re-exported
+    through the same canonical writer, must reproduce the original
+    bytes.  Anything less means resume state silently drifts across
+    export/import generations."""
+    from ..utils.jsonio import dumps_canonical
+    from ..workflow.checkpoint import (SWEEP_CHECKPOINT_JSON,
+                                       SweepCheckpointManager)
+
+    findings = findings if findings is not None else Findings()
+    path = os.path.join(directory, SWEEP_CHECKPOINT_JSON)
+    with open(path, encoding="utf-8") as f:
+        raw = f.read()
+    manager = SweepCheckpointManager(directory, fingerprint)
+    if not manager.load():
+        raise ValueError(f"no sweep checkpoint in {directory!r}")
+    re_exported = dumps_canonical(manager.export_doc())
+    if re_exported != raw:
+        findings.add(
+            "TM026",
+            f"checkpoint fingerprint round-trip is not byte-exact: "
+            f"re-export differs from {path} "
+            f"({len(raw)} vs {len(re_exported)} byte(s)); export -> "
+            f"import -> re-export must be the identity")
+    return findings
+
+
+def check_sharding_contracts(make_group, X, y, weight_ctxs, mesh, *,
+                             checkpoint_dir: Optional[str] = None,
+                             checkpoint_fingerprint=None,
+                             findings: Optional[Findings] = None
+                             ) -> Findings:
+    """All three sharding contracts (TM024-TM026) in one audit — the
+    entry point the multichip smoke runs under ``TMOG_CHECK=1``."""
+    findings = findings if findings is not None else Findings()
+    check_pad_invariance(make_group, X, y, weight_ctxs, mesh,
+                         findings=findings)
+    check_mesh_parity(make_group, X, y, weight_ctxs, mesh,
+                      findings=findings)
+    if checkpoint_dir is not None:
+        check_checkpoint_roundtrip(checkpoint_dir, checkpoint_fingerprint,
+                                   findings=findings)
     return findings
 
 

@@ -12,7 +12,8 @@ import dataclasses
 from typing import Any, Dict, Iterable, List, Optional
 
 __all__ = ["Diagnostic", "Findings", "PipelineLintError",
-           "ContractViolation", "RULES", "ERROR", "WARNING"]
+           "ContractViolation", "RULES", "ERROR", "WARNING",
+           "JSON_SCHEMA_VERSION"]
 
 ERROR = "error"
 WARNING = "warning"
@@ -39,13 +40,42 @@ RULES: Dict[str, Any] = {
                      "tolerance"),
     "TM023": (ERROR, "non-deterministic transform: same input produced "
                      "different bytes"),
+    # -- sharding runtime contracts (analysis/contracts.py, TMOG_CHECK=1)
+    "TM024": (ERROR, "pad-invariance violation: sharded sweep metrics "
+                     "change with the row padding used to tile the mesh"),
+    "TM025": (ERROR, "mesh-vs-single-device divergence: the sharded sweep "
+                     "program disagrees with the single-device program"),
+    "TM026": (ERROR, "checkpoint fingerprint round-trip is not byte-exact "
+                     "(export -> import -> re-export)"),
     # -- trace safety (analysis/trace_lint.py) --------------------------
     "TM030": (ERROR, "host sync on a traced value inside a jit function"),
     "TM031": (WARNING, "jit closure over an enclosing Python scalar: fresh "
                        "trace constant per call (recompile hazard)"),
     "TM032": (ERROR, "static argument declared on a parameter with an "
                      "unhashable default"),
+    # -- shard safety (analysis/shard_lint.py) --------------------------
+    "TM040": (ERROR, "cross-shard reduction inside a shard_map body with "
+                     "no psum/pmean collective (pad-invariance hazard)"),
+    "TM041": (ERROR, "axis name not defined by the enclosing mesh"),
+    "TM042": (ERROR, "device_put / host round-trip inside a sweep inner "
+                     "loop (per-iteration transfer)"),
+    "TM043": (ERROR, "donated buffer reused after donation"),
+    "TM044": (ERROR, "NamedSharding spec rank exceeds the operand rank"),
+    "TM045": (ERROR, "shard_map in_specs/out_specs arity disagrees with "
+                     "the wrapped function"),
+    # -- concurrency / durability (analysis/concur_lint.py) -------------
+    "TM050": (ERROR, "non-atomic JSON/benchmark write: bypasses "
+                     "write_json_atomic / the tmp + os.replace pattern"),
+    "TM051": (ERROR, "tempfile created without finally/context-manager "
+                     "cleanup"),
+    "TM052": (ERROR, "shared mutable state touched from a thread-pool "
+                     "closure without a lock"),
+    "TM053": (ERROR, "lock acquisition order inversion (deadlock hazard)"),
 }
+
+#: version of the ``tmog lint --json`` report shape (bumped with any
+#: field addition/removal; consumers gate on it instead of sniffing keys)
+JSON_SCHEMA_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -123,7 +153,8 @@ class Findings:
         return "\n".join(lines)
 
     def to_json(self) -> Dict[str, Any]:
-        return {"findings": [d.to_json() for d in self.diagnostics],
+        return {"schemaVersion": JSON_SCHEMA_VERSION,
+                "findings": [d.to_json() for d in self.diagnostics],
                 "errors": len(self.errors), "warnings": len(self.warnings)}
 
 

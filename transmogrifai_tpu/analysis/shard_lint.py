@@ -1,0 +1,565 @@
+"""Shard-safety lint (TM04x) — an AST pass over the mesh-era source trees.
+
+PR 7 moved the selector sweep onto a ("data", "grid") mesh held together
+by conventions nothing checked statically: every ``shard_map`` body must
+merge its per-shard partials with a collective before asserting a
+replicated output (``shard_map_compat`` runs with ``check=False``, so the
+runtime never verifies it), axis names must exist on the enclosing mesh,
+and the sweep inner loops must not leak per-iteration host round-trips.
+These rules pin those conventions:
+
+* **TM040 — cross-shard reduction without a collective.**  Inside a
+  ``shard_map``-wrapped body whose inputs are sharded, a full reduction
+  (``.sum()``/``.mean()``/``@``/``jnp.dot``…) of a sharded value in a
+  body containing NO collective (``psum``/``pmean``/``all_gather``…)
+  produces a per-shard partial that the replicated out_spec silently
+  mis-labels — the pad-invariance hazard the sharded sweep contract
+  (docs/multichip.md) forbids.
+* **TM041 — undefined axis name.**  A string axis in a ``PartitionSpec``
+  or a collective's ``axis_name=`` that the enclosing mesh does not
+  define.  The axis environment is tracked lightweight-statically: meshes
+  built by ``make_sweep_mesh`` carry ("data", "grid"), ``make_mesh``
+  its ``axis_names`` (default ("data", "model")), ``Mesh(devs, names)``
+  its literal names; ``ax = mesh.axis_names[i]`` resolves symbolically.
+* **TM042 — host round-trip inside a sweep inner loop.**  ``device_put``
+  / ``device_get`` / ``.block_until_ready()`` lexically inside a
+  ``for``/``while`` loop of a function that establishes a sweep context
+  (calls ``make_sweep_mesh`` or ``_place_sweep``) — per-iteration
+  transfers are the classic sweep-scaling leak.
+* **TM043 — donated-buffer reuse.**  An argument passed in a donated
+  position of a ``jax.jit(..., donate_argnums=...)`` function is read
+  again after the call (its buffer may alias the output).
+* **TM044 — NamedSharding rank mismatch.**  ``device_put(x, s)`` where
+  ``s``'s ``PartitionSpec`` has more dimensions than ``x`` (rank known
+  statically from ``np.zeros``-style constructors) — an error at run
+  time, caught before any device is touched.
+* **TM045 — shard_map spec arity mismatch.**  A literal ``in_specs``
+  tuple whose length differs from the wrapped function's parameter
+  count, or a literal ``out_specs`` tuple whose length differs from the
+  body's returned tuple.
+
+Host syncs on traced values inside shard_map bodies are reported as
+TM030 through the shared :func:`~.trace_lint.check_host_syncs` pass —
+with collective results correctly treated as device values, so
+``tot = lax.psum(part, ...)`` stays traced (and a body's host driver
+code around the ``shard_map`` call site is never misread as traced).
+
+Suppression: ``# tmog: disable=TM040`` on the flagged line (or any line
+of a multi-line statement, or the enclosing ``def`` line).  Entry
+points: :func:`lint_source`, :func:`lint_paths`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import Suppressions, dotted, scope_walk, target_names
+from .diagnostics import Findings
+from .trace_lint import COLLECTIVES, check_host_syncs, iter_py_files
+
+__all__ = ["lint_source", "lint_paths"]
+
+#: mesh constructors the axis environment is seeded from
+_SWEEP_MESH_FNS = {"make_sweep_mesh"}
+_MESH_FNS = {"make_mesh"}
+_RAW_MESH = {"Mesh"}
+#: call sites that establish a sweep context for TM042
+_SWEEP_CONTEXT_FNS = {"make_sweep_mesh", "_place_sweep"}
+
+_SPEC_NAMES = {"P", "PartitionSpec"}
+_SHARD_MAP_NAMES = {"shard_map", "shard_map_compat"}
+_REDUCE_METHODS = {"sum", "mean", "dot"}
+_REDUCE_FNS = {"sum", "mean", "dot", "vdot", "matmul", "tensordot",
+               "inner", "einsum"}
+_TRANSFER_FNS = {"device_put", "device_get"}
+
+#: unknown-but-valid axis sentinel (``mesh.axis_names[i]`` with an
+#: unresolvable mesh): never reported
+_VALID = object()
+
+
+def _last(name: Optional[str]) -> Optional[str]:
+    return name.split(".")[-1] if name else None
+
+
+def _const_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+class _Scope:
+    """One lexical scope's name -> value-expression table."""
+
+    def __init__(self, node: ast.AST, parent: Optional["_Scope"]):
+        self.node = node
+        self.parent = parent
+        self.env: Dict[str, ast.AST] = {}
+        self.local_defs: Dict[str, ast.FunctionDef] = {}
+        for n in scope_walk(node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                self.env[n.targets[0].id] = n.value
+            elif isinstance(n, ast.FunctionDef):
+                self.local_defs[n.name] = n
+
+    def lookup(self, name: str) -> Optional[ast.AST]:
+        s: Optional[_Scope] = self
+        while s is not None:
+            if name in s.env:
+                return s.env[name]
+            s = s.parent
+        return None
+
+
+class _ShardLinter:
+    def __init__(self, code: str, filename: str):
+        self.filename = filename
+        self.findings = Findings()
+        self.suppressions = Suppressions(code)
+        self.tree = ast.parse(code, filename=filename)
+
+    def run(self) -> Findings:
+        self._visit(self.tree, None)
+        return self.findings
+
+    # -- reporting ---------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str,
+              def_line: Optional[int] = None) -> None:
+        if self.suppressions.suppressed(rule, node,
+                                        extra_lines=(def_line,)):
+            return
+        self.findings.add(rule, message,
+                          location=f"{self.filename}:{node.lineno}")
+
+    # -- resolution --------------------------------------------------------
+
+    def _resolve(self, expr: ast.AST, scope: _Scope,
+                 depth: int = 0) -> Optional[ast.AST]:
+        while isinstance(expr, ast.Name) and depth < 8:
+            nxt = scope.lookup(expr.id)
+            if nxt is None or nxt is expr:
+                return expr
+            expr, depth = nxt, depth + 1
+        return expr
+
+    def _mesh_axes(self, expr: ast.AST,
+                   scope: _Scope) -> Optional[Tuple[str, ...]]:
+        """Axis names of the mesh ``expr`` evaluates to, or None when
+        statically unknown (a parameter, an attribute)."""
+        expr = self._resolve(expr, scope)
+        if not isinstance(expr, ast.Call):
+            return None
+        name = _last(dotted(expr.func))
+        if name in _SWEEP_MESH_FNS:
+            return ("data", "grid")
+        if name in _MESH_FNS:
+            for kw in expr.keywords:
+                if kw.arg == "axis_names":
+                    return _const_strs(kw.value)
+            return ("data", "model")
+        if name in _RAW_MESH and len(expr.args) >= 2:
+            return _const_strs(expr.args[1])
+        return None
+
+    def _axis_of(self, expr: ast.AST, scope: _Scope):
+        """An axis expression's value: a string, ``_VALID`` (resolves to
+        some mesh axis we cannot name), or None (unknown — skipped)."""
+        expr = self._resolve(expr, scope)
+        if isinstance(expr, ast.Constant):
+            if expr.value is None:
+                return None
+            if isinstance(expr.value, str):
+                return expr.value
+            return None
+        # mesh.axis_names[i]
+        if (isinstance(expr, ast.Subscript)
+                and isinstance(expr.value, ast.Attribute)
+                and expr.value.attr == "axis_names"):
+            axes = self._mesh_axes(expr.value.value, scope)
+            idx = expr.slice
+            if (axes is not None and isinstance(idx, ast.Constant)
+                    and isinstance(idx.value, int)
+                    and 0 <= idx.value < len(axes)):
+                return axes[idx.value]
+            return _VALID
+        return None
+
+    # -- traversal ---------------------------------------------------------
+
+    def _visit(self, node: ast.AST, parent: Optional[_Scope]) -> None:
+        scope = _Scope(node, parent)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._check_sweep_loops(node)
+            self._check_donation(node, scope)
+        self._check_device_put_ranks(node, scope)
+        for n in scope_walk(node):
+            if isinstance(n, ast.Call) and \
+                    _last(dotted(n.func)) in _SHARD_MAP_NAMES:
+                self._check_shard_map(n, scope)
+        for n in scope_walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._visit(n, scope)
+            elif isinstance(n, ast.ClassDef):
+                self._visit(n, scope)
+
+    # -- TM040/TM041/TM045 + TM030: one shard_map site ----------------------
+
+    def _shard_map_parts(self, call: ast.Call):
+        """(fn_expr, mesh_expr, in_specs_expr, out_specs_expr) with
+        positional/keyword normalization; Nones where absent."""
+        args: List[Optional[ast.AST]] = list(call.args[:4])
+        args += [None] * (4 - len(args))
+        kw = {k.arg: k.value for k in call.keywords}
+        return (args[0],
+                kw.get("mesh", args[1]),
+                kw.get("in_specs", args[2]),
+                kw.get("out_specs", args[3]))
+
+    def _spec_elts(self, spec: ast.AST) -> Optional[List[ast.AST]]:
+        """P(...) -> its positional elements; else None (not a literal
+        spec)."""
+        if isinstance(spec, ast.Call) and \
+                _last(dotted(spec.func)) in _SPEC_NAMES:
+            return list(spec.args)
+        return None
+
+    def _check_shard_map(self, call: ast.Call, scope: _Scope) -> None:
+        fn_expr, mesh_expr, in_specs, out_specs = self._shard_map_parts(call)
+        if fn_expr is None:
+            return
+        fn = None
+        if isinstance(fn_expr, ast.Lambda):
+            fn = fn_expr
+        elif isinstance(fn_expr, ast.Name):
+            fn = scope.local_defs.get(fn_expr.id)
+        axes = (self._mesh_axes(mesh_expr, scope)
+                if mesh_expr is not None else None)
+
+        # TM041: literal axis strings in the specs
+        spec_list: List[ast.AST] = []
+        for specs in (in_specs, out_specs):
+            if specs is None:
+                continue
+            if isinstance(specs, (ast.Tuple, ast.List)):
+                spec_list.extend(specs.elts)
+            else:
+                spec_list.append(specs)
+        in_spec_elts = None
+        if isinstance(in_specs, (ast.Tuple, ast.List)):
+            in_spec_elts = in_specs.elts
+        elif in_specs is not None:
+            in_spec_elts = [in_specs]  # single spec broadcasts to all args
+        for spec in spec_list:
+            elts = self._spec_elts(spec)
+            if elts is None:
+                continue
+            for e in elts:
+                ax = self._axis_of(e, scope)
+                if isinstance(ax, str) and axes is not None \
+                        and ax not in axes:
+                    self._emit("TM041", e if hasattr(e, "lineno") else spec,
+                               f"axis {ax!r} is not defined by the "
+                               f"enclosing mesh (axes: {axes})")
+        if fn is None:
+            return
+        def_line = fn.lineno
+        params = [p.arg for p in (getattr(fn.args, "posonlyargs", [])
+                                  + fn.args.args)]
+
+        # TM045: literal in_specs tuple arity vs wrapped params
+        if isinstance(in_specs, (ast.Tuple, ast.List)) \
+                and len(in_specs.elts) != len(params) \
+                and not fn.args.vararg:
+            self._emit("TM045", call,
+                       f"shard_map in_specs has {len(in_specs.elts)} "
+                       f"spec(s) but the wrapped function takes "
+                       f"{len(params)} parameter(s)", def_line)
+        if isinstance(out_specs, (ast.Tuple, ast.List)):
+            for ret in ast.walk(fn):
+                if isinstance(ret, ast.Return) and \
+                        isinstance(ret.value, ast.Tuple) and \
+                        len(ret.value.elts) != len(out_specs.elts):
+                    self._emit(
+                        "TM045", ret,
+                        f"shard_map out_specs has {len(out_specs.elts)} "
+                        f"spec(s) but the body returns "
+                        f"{len(ret.value.elts)} value(s)", def_line)
+
+        if getattr(fn, "_tmog_shard_linted", False):
+            return
+        fn._tmog_shard_linted = True
+
+        # which params are sharded (any non-None spec element)
+        sharded: Set[str] = set()
+        if in_spec_elts is not None:
+            broadcast = len(in_spec_elts) == 1 and len(params) > 1 \
+                and not isinstance(in_specs, (ast.Tuple, ast.List))
+            for i, p in enumerate(params):
+                spec = in_spec_elts[0] if broadcast else (
+                    in_spec_elts[i] if i < len(in_spec_elts) else None)
+                elts = self._spec_elts(spec) if spec is not None else None
+                if elts and any(not (isinstance(e, ast.Constant)
+                                     and e.value is None) for e in elts):
+                    sharded.add(p)
+
+        # TM041 on collectives' axis_name inside the body
+        body_collective = False
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            cname = _last(dotted(n.func))
+            if cname not in COLLECTIVES:
+                continue
+            body_collective = True
+            ax_expr = None
+            for k in n.keywords:
+                if k.arg == "axis_name":
+                    ax_expr = k.value
+            if ax_expr is None and len(n.args) >= 2:
+                ax_expr = n.args[1]
+            elif ax_expr is None and cname == "axis_index" and n.args:
+                ax_expr = n.args[0]
+            if ax_expr is not None:
+                ax = self._axis_of(ax_expr, scope)
+                if isinstance(ax, str) and axes is not None \
+                        and ax not in axes:
+                    self._emit("TM041", n,
+                               f"collective {cname} reduces over axis "
+                               f"{ax!r}, not defined by the enclosing "
+                               f"mesh (axes: {axes})", def_line)
+        # partial-bound collectives (all_reduce=psum plumbing) count too
+        if not body_collective:
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Name) and n.id in COLLECTIVES:
+                    body_collective = True
+                    break
+                if isinstance(n, ast.Attribute) and n.attr in COLLECTIVES:
+                    body_collective = True
+                    break
+
+        # TM040: sharded full reduction with no collective anywhere
+        if sharded and not body_collective:
+            self._check_cross_shard_reductions(fn, sharded, def_line)
+
+        # TM030 host syncs on traced values (collective-result aware)
+        check_host_syncs(
+            fn, set(), lambda rule, node, msg: self._emit(
+                rule, node, msg, def_line),
+            context="shard_map")
+
+    def _check_cross_shard_reductions(self, fn, sharded: Set[str],
+                                      def_line: int) -> None:
+        from .trace_lint import _tainted_loads
+
+        tainted = set(sharded)
+        for _ in range(4):
+            grew = False
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign) and \
+                        _tainted_loads(n.value, tainted):
+                    new = set().union(*(target_names(t) for t in n.targets))
+                    grew |= not new <= tainted
+                    tainted |= new
+            if not grew:
+                break
+
+        def full_reduce(call: ast.Call) -> bool:
+            """No axis restriction -> reduces over the sharded dim too."""
+            return not any(k.arg == "axis" for k in call.keywords)
+
+        for n in ast.walk(fn):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.MatMult) \
+                    and (_tainted_loads(n.left, tainted)
+                         or _tainted_loads(n.right, tainted)):
+                self._emit("TM040", n,
+                           f"matmul over a sharded operand "
+                           f"({ast.unparse(n)!r}) with no psum/pmean in "
+                           f"the shard_map body: the contraction "
+                           f"produces per-shard partials", def_line)
+            elif isinstance(n, ast.Call):
+                f = n.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _REDUCE_METHODS and not n.args
+                        and full_reduce(n)
+                        and _tainted_loads(f.value, tainted)):
+                    self._emit("TM040", n,
+                               f".{f.attr}() over sharded value "
+                               f"{ast.unparse(f.value)!r} with no "
+                               f"psum/pmean in the shard_map body",
+                               def_line)
+                elif (isinstance(f, ast.Attribute)
+                        and f.attr in _REDUCE_FNS
+                        and dotted(f.value) in ("jnp", "jax.numpy", "np",
+                                                "numpy")
+                        and n.args and full_reduce(n)
+                        and any(_tainted_loads(a, tainted)
+                                for a in n.args)):
+                    self._emit("TM040", n,
+                               f"{dotted(f)}() over a sharded value with "
+                               f"no psum/pmean in the shard_map body",
+                               def_line)
+
+    # -- TM042: host round-trips inside sweep inner loops --------------------
+
+    def _check_sweep_loops(self, fn) -> None:
+        is_sweep = any(
+            isinstance(n, ast.Call)
+            and _last(dotted(n.func)) in _SWEEP_CONTEXT_FNS
+            for n in scope_walk(fn))
+        if not is_sweep:
+            return
+        for loop in scope_walk(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for n in ast.walk(loop):
+                if not isinstance(n, ast.Call):
+                    continue
+                name = _last(dotted(n.func))
+                if name in _TRANSFER_FNS:
+                    self._emit("TM042", n,
+                               f"{name} inside a sweep inner loop: one "
+                               f"host<->device transfer per iteration — "
+                               f"hoist the placement out of the loop",
+                               fn.lineno)
+                elif (isinstance(n.func, ast.Attribute)
+                      and n.func.attr == "block_until_ready"):
+                    self._emit("TM042", n,
+                               "block_until_ready inside a sweep inner "
+                               "loop: a device sync per iteration",
+                               fn.lineno)
+
+    # -- TM043: donated-buffer reuse ----------------------------------------
+
+    def _jit_donations(self, expr: ast.AST) -> Optional[Set[int]]:
+        """``jax.jit(f, donate_argnums=...)`` -> donated positions."""
+        if not (isinstance(expr, ast.Call)
+                and _last(dotted(expr.func)) == "jit"):
+            return None
+        for kw in expr.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                out = {e.value for e in elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, int)}
+                return out or None
+        return None
+
+    def _check_donation(self, fn, scope: _Scope) -> None:
+        jitted: Dict[str, Set[int]] = {}
+        events: List[Tuple[int, int, str, str, ast.AST]] = []
+        for n in scope_walk(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                don = self._jit_donations(n.value)
+                if don:
+                    jitted[n.targets[0].id] = don
+                for t in target_names(n.targets[0]):
+                    events.append((n.end_lineno or n.lineno,
+                                   (n.end_col_offset or 0) + 2,
+                                   "store", t, n))
+        if not jitted:
+            return
+        for n in scope_walk(fn):
+            # donation takes effect AFTER the call's own argument loads
+            # (and before any assignment-target store rebinds the name),
+            # so events anchor on the node's END position
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in jitted:
+                for i in jitted[n.func.id]:
+                    if i < len(n.args) and isinstance(n.args[i], ast.Name):
+                        events.append((n.end_lineno or n.lineno,
+                                       (n.end_col_offset or 0) + 1,
+                                       "donate", n.args[i].id, n))
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and isinstance(n.value.func, ast.Name) \
+                    and n.value.func.id in jitted:
+                for t in n.targets:
+                    for t_name in target_names(t):
+                        events.append((n.end_lineno or n.lineno,
+                                       (n.end_col_offset or 0) + 2,
+                                       "store", t_name, n))
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                events.append((n.lineno, n.col_offset, "load", n.id, n))
+        events.sort(key=lambda e: (e[0], e[1]))
+        donated: Set[str] = set()
+        for lineno, _col, kind, name, node in events:
+            if kind == "donate":
+                donated.add(name)
+            elif kind == "store":
+                donated.discard(name)
+            elif kind == "load" and name in donated:
+                self._emit("TM043", node,
+                           f"{name!r} was donated to a jit call "
+                           f"(donate_argnums) and read again: its buffer "
+                           f"may alias the output", fn.lineno)
+                donated.discard(name)  # one report per donation
+
+    # -- TM044: NamedSharding rank vs operand rank ---------------------------
+
+    def _spec_rank(self, expr: ast.AST, scope: _Scope) -> Optional[int]:
+        expr = self._resolve(expr, scope)
+        if isinstance(expr, ast.Call) and \
+                _last(dotted(expr.func)) == "NamedSharding" \
+                and len(expr.args) >= 2:
+            elts = self._spec_elts(expr.args[1])
+            if elts is not None:
+                return len(elts)
+        return None
+
+    def _array_rank(self, expr: ast.AST, scope: _Scope) -> Optional[int]:
+        expr = self._resolve(expr, scope)
+        if not isinstance(expr, ast.Call):
+            return None
+        name = _last(dotted(expr.func))
+        if name in ("zeros", "ones", "empty", "full") and expr.args:
+            shp = expr.args[0]
+            if isinstance(shp, (ast.Tuple, ast.List)):
+                return len(shp.elts)
+            if isinstance(shp, ast.Constant) and \
+                    isinstance(shp.value, int):
+                return 1
+        if name in ("arange", "linspace"):
+            return 1
+        if name == "eye":
+            return 2
+        return None
+
+    def _check_device_put_ranks(self, node: ast.AST, scope: _Scope) -> None:
+        for n in scope_walk(node):
+            if not (isinstance(n, ast.Call)
+                    and _last(dotted(n.func)) == "device_put"
+                    and len(n.args) >= 2):
+                continue
+            spec_rank = self._spec_rank(n.args[1], scope)
+            arr_rank = self._array_rank(n.args[0], scope)
+            if spec_rank is not None and arr_rank is not None \
+                    and spec_rank > arr_rank:
+                self._emit("TM044", n,
+                           f"NamedSharding spec has {spec_rank} "
+                           f"dimension(s) but the operand has rank "
+                           f"{arr_rank}")
+
+
+def lint_source(code: str, filename: str = "<string>") -> Findings:
+    """Shard-safety lint one source string."""
+    try:
+        return _ShardLinter(code, filename).run()
+    except SyntaxError as e:
+        f = Findings()
+        f.add("TM040", f"could not parse: {e}", severity="warning",
+              location=f"{filename}:{e.lineno or 0}")
+        return f
+
+
+def lint_paths(paths: Iterable[str]) -> Findings:
+    """Shard-safety lint files and directory trees of ``.py`` sources."""
+    findings = Findings()
+    for full in iter_py_files(paths):
+        with open(full, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), full))
+    return findings

@@ -31,37 +31,32 @@ from __future__ import annotations
 import ast
 import builtins
 import os
-import re
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
+from .astutil import (Suppressions, dotted as _dotted, load_names as
+                      _load_names, scope_walk as _scope_walk,
+                      target_names as _target_names)
 from .diagnostics import Findings
 
-__all__ = ["lint_source", "lint_paths"]
-
-_DISABLE_RE = re.compile(r"#\s*tmog:\s*disable=([A-Z0-9,\s]+)")
+__all__ = ["lint_source", "lint_paths", "check_host_syncs",
+           "COLLECTIVES", "iter_py_files"]
 
 _HOST_CASTS = {"float", "int", "bool", "complex"}
 _NP_SYNC_FNS = {"asarray", "array", "ascontiguousarray", "asfortranarray"}
 _NP_MODULES = {"np", "numpy", "onp"}
 _SYNC_METHODS = {"item", "tolist"}
 
+#: collective primitives whose RESULTS are device values — a collective
+#: with no tainted operand (``lax.axis_index``) still yields a traced
+#: value, and taint must flow THROUGH collectives (a ``psum`` total is as
+#: device-resident as the partial it reduced).  Shared with shard_lint.
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+               "all_to_all", "axis_index", "psum_scatter"}
+
 #: enclosing-scope assignments considered "Python scalars" for TM031
 _SCALARISH_CALLS = {"len", "int", "float", "round"}
 
 _BUILTIN_NAMES = set(dir(builtins))
-
-_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
-                ast.ClassDef)
-
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """'jax.jit' for Attribute/Name chains, else None."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        base = _dotted(node.value)
-        return f"{base}.{node.attr}" if base else None
-    return None
 
 
 def _is_jit_ref(node: ast.AST) -> bool:
@@ -107,16 +102,6 @@ def _param_names(fn) -> List[str]:
             + [p.arg for p in a.args])
 
 
-def _target_names(t: ast.AST) -> Set[str]:
-    return {n.id for n in ast.walk(t)
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
-
-
-def _load_names(e: ast.AST) -> Set[str]:
-    return {n.id for n in ast.walk(e)
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
-
-
 #: attribute reads that are static trace-time metadata even on traced
 #: values — deriving a Python int from them is NOT a host sync
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
@@ -143,28 +128,18 @@ def _tainted_loads(e: ast.AST, tainted: Set[str]) -> Set[str]:
     return hits
 
 
-def _scope_walk(scope: ast.AST):
-    """Yield this scope's nodes WITHOUT descending into nested
-    function/lambda/class bodies (those are separate scopes); nested scope
-    nodes themselves are yielded so the caller can recurse."""
-    stack = list(ast.iter_child_nodes(scope))
-    while stack:
-        n = stack.pop()
-        yield n
-        if not isinstance(n, _SCOPE_NODES):
-            stack.extend(ast.iter_child_nodes(n))
+def _is_collective_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func)
+    return bool(name) and name.split(".")[-1] in COLLECTIVES
 
 
 class _SourceLinter:
     def __init__(self, code: str, filename: str):
         self.filename = filename
         self.findings = Findings()
-        self.suppressed: Dict[int, Set[str]] = {}
-        for i, line in enumerate(code.splitlines(), 1):
-            m = _DISABLE_RE.search(line)
-            if m:
-                self.suppressed[i] = {
-                    r.strip() for r in m.group(1).split(",") if r.strip()}
+        self.suppressions = Suppressions(code)
         self.tree = ast.parse(code, filename=filename)
         self.module_names = self._module_scope_names()
 
@@ -226,11 +201,13 @@ class _SourceLinter:
 
     # -- reporting ---------------------------------------------------------
 
-    def _emit(self, rule: str, line: int, message: str,
+    def _emit(self, rule: str, node, message: str,
               def_line: Optional[int] = None) -> None:
-        for ln in (line, def_line):
-            if ln is not None and rule in self.suppressed.get(ln, ()):
-                return
+        line = node if isinstance(node, int) else node.lineno
+        if self.suppressions.suppressed(
+                rule, None if isinstance(node, int) else node,
+                extra_lines=(line, def_line)):
+            return
         self.findings.add(rule, message,
                           location=f"{self.filename}:{line}")
 
@@ -263,61 +240,15 @@ class _SourceLinter:
             if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
                     isinstance(d, ast.Call)
                     and _dotted(d.func) in ("list", "dict", "set")):
-                self._emit("TM032", d.lineno,
+                self._emit("TM032", d,
                            f"static argument {nm!r} has an unhashable "
                            f"default ({type(d).__name__.lower()}); jit will "
                            f"raise on the first defaulted call", def_line)
 
         # TM030: taint params (minus static) through local assignments
-        tainted = set(params) - static - {"self"}
-        for _ in range(4):  # fixpoint over loop-carried assignments
-            grew = False
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Assign):
-                    if _tainted_loads(node.value, tainted):
-                        new = set().union(*(_target_names(t)
-                                            for t in node.targets))
-                        grew |= not new <= tainted
-                        tainted |= new
-                elif isinstance(node, ast.AugAssign):
-                    if (_tainted_loads(node.value, tainted)
-                            and isinstance(node.target, ast.Name)):
-                        grew |= node.target.id not in tainted
-                        tainted.add(node.target.id)
-                elif isinstance(node, ast.For):
-                    if _tainted_loads(node.iter, tainted):
-                        new = _target_names(node.target)
-                        grew |= not new <= tainted
-                        tainted |= new
-            if not grew:
-                break
-
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if (isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS
-                    and not node.args
-                    and _tainted_loads(f.value, tainted)):
-                self._emit("TM030", node.lineno,
-                           f".{f.attr}() on traced value "
-                           f"{ast.unparse(f.value)!r} inside jit",
-                           def_line)
-            elif (isinstance(f, ast.Name) and f.id in _HOST_CASTS
-                    and node.args
-                    and _tainted_loads(node.args[0], tainted)):
-                self._emit("TM030", node.lineno,
-                           f"{f.id}() on traced value "
-                           f"{ast.unparse(node.args[0])!r} inside jit",
-                           def_line)
-            elif (isinstance(f, ast.Attribute) and f.attr in _NP_SYNC_FNS
-                    and _dotted(f.value) in _NP_MODULES
-                    and node.args
-                    and _tainted_loads(node.args[0], tainted)):
-                self._emit("TM030", node.lineno,
-                           f"{_dotted(f)}() on traced value "
-                           f"{ast.unparse(node.args[0])!r} inside jit "
-                           f"(device->host copy per call)", def_line)
+        check_host_syncs(
+            fn, static,
+            lambda rule, node, msg: self._emit(rule, node, msg, def_line))
 
         # TM031: closure over enclosing Python scalars
         if enclosing_fn is not None:
@@ -383,6 +314,86 @@ class _SourceLinter:
         return names
 
 
+def check_host_syncs(fn, static: Set[str], emit, *,
+                     context: str = "jit") -> None:
+    """Report TM030 host syncs on traced values inside one traced function.
+
+    ``fn`` is a FunctionDef/Lambda whose parameters (minus ``static`` and
+    ``self``) are traced; the taint propagates through local assignments,
+    loop targets, and collective calls — a ``lax.psum``/``axis_index``
+    RESULT is a device value even when no operand is tainted (collective
+    results are device values; the shard_map bodies in
+    ``parallel/sharded.py`` are the regression corpus).  ``emit(rule,
+    node, message)`` reports; shared between the jit lint and the
+    shard_map-body pass in shard_lint.
+    """
+    params = _param_names(fn)
+    tainted = set(params) - set(static) - {"self"}
+    for _ in range(4):  # fixpoint over loop-carried assignments
+        grew = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if (_tainted_loads(node.value, tainted)
+                        or _is_collective_call(node.value)):
+                    new = set().union(*(_target_names(t)
+                                        for t in node.targets))
+                    grew |= not new <= tainted
+                    tainted |= new
+            elif isinstance(node, ast.AugAssign):
+                if (_tainted_loads(node.value, tainted)
+                        and isinstance(node.target, ast.Name)):
+                    grew |= node.target.id not in tainted
+                    tainted.add(node.target.id)
+            elif isinstance(node, ast.For):
+                if _tainted_loads(node.iter, tainted):
+                    new = _target_names(node.target)
+                    grew |= not new <= tainted
+                    tainted |= new
+        if not grew:
+            break
+
+    def _sync_operand(e: ast.AST) -> bool:
+        return bool(_tainted_loads(e, tainted)) or _is_collective_call(e)
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS
+                and not node.args and _sync_operand(f.value)):
+            emit("TM030", node,
+                 f".{f.attr}() on traced value "
+                 f"{ast.unparse(f.value)!r} inside {context}")
+        elif (isinstance(f, ast.Name) and f.id in _HOST_CASTS
+                and node.args and _sync_operand(node.args[0])):
+            emit("TM030", node,
+                 f"{f.id}() on traced value "
+                 f"{ast.unparse(node.args[0])!r} inside {context}")
+        elif (isinstance(f, ast.Attribute) and f.attr in _NP_SYNC_FNS
+                and _dotted(f.value) in _NP_MODULES
+                and node.args and _sync_operand(node.args[0])):
+            emit("TM030", node,
+                 f"{_dotted(f)}() on traced value "
+                 f"{ast.unparse(node.args[0])!r} inside {context} "
+                 f"(device->host copy per call)")
+
+
+def iter_py_files(paths: Iterable[str]):
+    """Yield every ``.py`` file under ``paths`` (files or directory
+    trees), skipping ``__pycache__``/``.git`` — shared walk for all three
+    source-lint families."""
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+        elif path.endswith(".py"):
+            yield path
+
+
 def lint_source(code: str, filename: str = "<string>") -> Findings:
     """Trace-safety lint one source string."""
     try:
@@ -397,17 +408,7 @@ def lint_source(code: str, filename: str = "<string>") -> Findings:
 def lint_paths(paths: Iterable[str]) -> Findings:
     """Trace-safety lint files and directory trees of ``.py`` sources."""
     findings = Findings()
-    for path in paths:
-        if os.path.isdir(path):
-            for root, dirs, files in os.walk(path):
-                dirs[:] = [d for d in dirs
-                           if d not in ("__pycache__", ".git")]
-                for fn in sorted(files):
-                    if fn.endswith(".py"):
-                        full = os.path.join(root, fn)
-                        with open(full, encoding="utf-8") as fh:
-                            findings.extend(lint_source(fh.read(), full))
-        elif path.endswith(".py"):
-            with open(path, encoding="utf-8") as fh:
-                findings.extend(lint_source(fh.read(), path))
+    for full in iter_py_files(paths):
+        with open(full, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), full))
     return findings

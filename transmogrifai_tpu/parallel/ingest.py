@@ -172,7 +172,30 @@ class ShardedMatrixWriter:
             self.global_shape, self.sharding, arrays)
         self._committed = {}
         self._buf = None
+        self._check_pad_tail(out)
         return out
+
+    def _check_pad_tail(self, out) -> None:
+        """TM024 runtime contract (TMOG_CHECK=1): the mesh-pad tail of
+        the stitched global array must be EXACTLY zero — a non-zero pad
+        row would survive every downstream weighted reduction as a
+        pad-variance leak.  One small tail fetch, paid only in check
+        mode."""
+        from ..analysis.contracts import checks_enabled
+
+        if not self.n_pad or not checks_enabled():
+            return
+        import numpy as _np
+
+        tail = _np.asarray(out[self.rows:])
+        if tail.size and not (tail == 0).all():
+            from ..analysis.diagnostics import ContractViolation, Diagnostic
+
+            raise ContractViolation(Diagnostic(
+                rule="TM024",
+                message=(f"ShardedMatrixWriter pad tail is non-zero "
+                         f"({self.n_pad} pad row(s)); sharded reductions "
+                         f"over this buffer are not pad-invariant")))
 
 
 def stream_to_mesh(chunks: Iterable[np.ndarray], mesh, total_rows: int,

@@ -35,7 +35,7 @@ __all__ = [
     "data_sharding", "feature_sharding", "matrix_sharding",
     "sweep_matrix_sharding", "grid_sharding", "fold_weight_sharding",
     "replicated", "shard_dataset", "pad_to_multiple", "shard_sweep_inputs",
-    "shard_map_compat",
+    "shard_map_compat", "next_shard_pad",
 ]
 
 
@@ -155,6 +155,17 @@ def fold_weight_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def next_shard_pad(mesh: Mesh, n_rows: int) -> int:
+    """Rows to append so ``n_rows`` lands exactly on the NEXT data-axis
+    tile boundary — guaranteeing the internal ``pad_to_multiple`` amount
+    CHANGES, which is what the TM024 pad-invariance contract
+    (``analysis/contracts.check_pad_invariance``) perturbs: results must
+    not move when the padding does."""
+    ndata = int(mesh.shape[mesh.axis_names[0]])
+    rem = n_rows % ndata
+    return (ndata - rem) if rem else ndata
 
 
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0,

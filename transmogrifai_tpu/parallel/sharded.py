@@ -207,6 +207,15 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
 # what GSPMD derives for the whole-array paths above, kept explicit so the
 # per-shard partial/psum contract (zero-weight pad rows are inert, results
 # invariant to pad amount) is directly testable.
+#
+# These bodies run with check_rep/check_vma OFF (jax 0.4.x has no
+# replication rule for the while_loop inside the Newton body), so the
+# runtime never verifies that a replicated out_spec really is replicated.
+# Two guards stand in: the shard-safety lint (analysis/shard_lint.py,
+# TM040 — a reduction of sharded data with no collective in the body is
+# flagged statically; this module is its regression corpus) and the
+# TMOG_CHECK=1 pad-invariance/parity contracts (analysis/contracts.py,
+# TM024/TM025) exercised by the tier-1 multichip smoke.
 # ---------------------------------------------------------------------------
 
 def colstats_psum(X, w, mesh: Mesh):

@@ -47,6 +47,16 @@ class GridGroup:
         self.mesh = None
 
     def with_mesh(self, mesh) -> "GridGroup":
+        if mesh is not None:
+            from ..parallel.mesh import has_grid_axis
+
+            # fail at attach time, not three layers down in _place_sweep:
+            # a (data, model) mesh here would shard candidate vectors over
+            # feature lanes (the TM041 axis-confusion hazard at runtime)
+            if not has_grid_axis(mesh):
+                raise ValueError(
+                    f"GridGroup needs a ('data', 'grid') sweep mesh; got "
+                    f"axes {tuple(getattr(mesh, 'axis_names', ()))}")
         self.mesh = mesh
         return self
 

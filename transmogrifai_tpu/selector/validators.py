@@ -422,7 +422,14 @@ class SweepWorkQueue:
             unit = self.units[i]
             if checkpoint is not None:
                 rec = checkpoint.restore(unit.index)
-                if rec is not None:
+                # a restored record must match THIS sweep's fold geometry
+                # (the fingerprint pins candidates/validator, but a
+                # hand-edited or truncated cursor could still desync);
+                # mismatched records are re-run instead of misaligning
+                # the metric means silently
+                if rec is not None and (
+                        rec[1] is not None
+                        or len(rec[0]) == len(self.fold_ctxs)):
                     all_vals.append(rec[0])
                     errors.append(rec[1])
                     i += 1

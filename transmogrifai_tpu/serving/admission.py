@@ -12,6 +12,11 @@ Degradation ladder (documented in docs/serving.md):
   2. queue      — bounded; absorbs bursts up to ``max_queue_rows``
   3. shed       — over-capacity / past-deadline requests get ``ShedResult``
   4. fall back  — circuit breaker routes device failures to the host scorer
+
+Lock-order convention (pinned by the TM053 lint, analysis/concur_lint.py):
+the admission and breaker locks are LEAF locks — every ``with self._lock``
+region is a few field reads/writes with no calls out, so neither can
+invert against the registry or batcher locks.
 """
 from __future__ import annotations
 

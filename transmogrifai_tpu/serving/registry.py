@@ -7,6 +7,12 @@ construction), and exposes an atomic get/swap surface: scoring threads
 resolve a ``ModelEntry`` by name and keep using that immutable entry for
 the whole batch even while a newer version is being swapped in — no lock
 is held across scoring.
+
+Lock-order convention (pinned by the TM053 lint, analysis/concur_lint.py):
+the registry lock is a LEAF — nothing is called out to while holding it
+(listeners fire after release, entry construction happens before
+acquisition), so it can never participate in an acquisition-order cycle
+with the admission/batcher/metrics locks.
 """
 from __future__ import annotations
 
@@ -106,10 +112,11 @@ class ModelRegistry:
     def get(self, name: str) -> ModelEntry:
         with self._lock:
             entry = self._entries.get(name)
+            have = sorted(self._entries)
         if entry is None:
             raise KeyError(
                 f"no model {name!r} in registry "
-                f"(have: {sorted(self._entries) or 'none'})")
+                f"(have: {have or 'none'})")
         return entry
 
     def maybe_get(self, name: str) -> Optional[ModelEntry]:

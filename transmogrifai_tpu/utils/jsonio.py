@@ -12,7 +12,17 @@ import json
 import os
 from typing import Any, Optional
 
-__all__ = ["write_json_atomic", "read_json_tolerant"]
+__all__ = ["write_json_atomic", "read_json_tolerant", "dumps_canonical"]
+
+
+def dumps_canonical(obj: Any, indent: Optional[int] = 2,
+                    sort_keys: bool = False) -> str:
+    """EXACTLY the text :func:`write_json_atomic` lands on disk for
+    ``obj`` (same separators, same trailing newline) — the byte-equality
+    anchor the checkpoint round-trip contract (TM026,
+    ``analysis/contracts.py``) compares against."""
+    return json.dumps(obj, indent=indent, sort_keys=sort_keys,
+                      default=str) + "\n"
 
 
 def write_json_atomic(path: str, obj: Any, indent: Optional[int] = 2,
@@ -23,8 +33,7 @@ def write_json_atomic(path: str, obj: Any, indent: Optional[int] = 2,
     directory = os.path.dirname(os.path.abspath(path)) or "."
     tmp = os.path.join(directory, os.path.basename(path) + ".tmp")
     with open(tmp, "w") as f:
-        json.dump(obj, f, indent=indent, sort_keys=sort_keys, default=str)
-        f.write("\n")
+        f.write(dumps_canonical(obj, indent=indent, sort_keys=sort_keys))
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)

@@ -509,15 +509,22 @@ class SweepCheckpointManager:
 
     # -- plumbing -----------------------------------------------------------
 
+    def export_doc(self) -> Dict[str, Any]:
+        """The manifest exactly as ``_write`` persists it — the export
+        half of the TM026 fingerprint round-trip contract
+        (``analysis/contracts.check_checkpoint_roundtrip``): a manager
+        primed by ``load()`` must re-export the bytes it read."""
+        return {"version": SWEEP_CHECKPOINT_VERSION,
+                "fingerprint": self.fingerprint,
+                "units": self._units,
+                "rung": self._rung}
+
     def _write(self) -> None:
         from ..utils.jsonio import write_json_atomic
 
         write_json_atomic(
             os.path.join(self.directory, SWEEP_CHECKPOINT_JSON),
-            {"version": SWEEP_CHECKPOINT_VERSION,
-             "fingerprint": self.fingerprint,
-             "units": self._units,
-             "rung": self._rung})
+            self.export_doc())
         self._dirty = 0
         self.saves += 1
         faults.fire("sweep.checkpoint", index=self.saves - 1)

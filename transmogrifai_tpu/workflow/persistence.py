@@ -271,8 +271,11 @@ def save_workflow_model(model, path: str, overwrite: bool = True) -> None:
         "rawFeatureFilterResults": (rff.to_json() if hasattr(rff, "to_json")
                                     else rff),
     }
-    with open(os.path.join(path, MODEL_JSON), "w") as f:
-        json.dump(doc, f, indent=2, default=str)
+    from ..utils.jsonio import write_json_atomic
+
+    # atomic (tmp + os.replace): a kill mid-save can never leave a
+    # truncated model.json next to a complete arrays.npz (TM050)
+    write_json_atomic(os.path.join(path, MODEL_JSON), doc, indent=2)
     np.savez_compressed(os.path.join(path, ARRAYS_NPZ), **store.arrays)
 
 

@@ -262,8 +262,9 @@ class OpWorkflowRunner:
                "app": result.app_metrics.to_json()
                if result.app_metrics else None}
         path = os.path.join(params.metrics_location, "op_metrics.json")
-        with open(path, "w") as fh:
-            json.dump(out, fh, indent=2, default=str)
+        from ..utils.jsonio import write_json_atomic
+
+        write_json_atomic(path, out, indent=2)  # tmp + os.replace (TM050)
 
 
 class OpApp:
