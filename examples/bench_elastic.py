@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Elastic-sweep smoke — the mesh-portable resume matrix, end to end.
+
+The acceptance gate for the elastic execution layer (parallel/elastic.py
++ the mesh-portable SweepCheckpointManager): a halving selector sweep is
+SIGKILLed mid-rung on an 8-virtual-device mesh (``sweep.checkpoint``
+fault, same harness as the resilience smoke), then resumed in fresh
+subprocesses under ``--xla_force_host_platform_device_count=4`` and as a
+plain single-device fit — each resume must reproduce the uninterrupted
+run's winner and summary metrics within the documented 2e-2 sharded
+tolerance, with a NONZERO ``meshShrinks`` counter in the resumed run's
+elastic metadata (the proof the cursor really crossed mesh shapes).  An
+injected ``device.loss`` leg asserts a mid-unit backend loss completes
+the sweep (unit retried on a shrunk mesh) instead of aborting it.
+
+Run by ``scripts/tier1.sh`` as ELASTIC_SMOKE (``--smoke``); emits a JSON
+summary line on stdout and exits non-zero on any parity/counter failure.
+"""
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+#: the sweep a child process runs: LR grid + RF pair under successive
+#: halving (mid-RUNG kills are the interesting case), checkpointed
+_CHILD = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    sys.path.insert(0, {root!r})
+    import jax
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpRandomForestClassifier)
+    from transmogrifai_tpu.selector.model_selector import (
+        ModelSelector, grid)
+    from transmogrifai_tpu.selector.validators import OpCrossValidation
+    from transmogrifai_tpu.parallel.mesh import make_sweep_mesh
+    from transmogrifai_tpu.tuning import HalvingConfig
+    from transmogrifai_tpu.types.columns import FeatureColumn
+    from transmogrifai_tpu.types.feature_types import OPVector, RealNN
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(900, 12)).astype(np.float32)
+    beta = rng.normal(size=12) * (rng.random(12) < 0.6)
+    y = (1/(1+np.exp(-(X @ beta))) > rng.random(900)).astype(np.float32)
+
+    sel = ModelSelector(
+        models_and_params=[
+            (OpLogisticRegression(), grid(
+                reg_param=[0.001, 0.01, 0.1, 1.0],
+                elastic_net_param=[0.0])),
+            (OpRandomForestClassifier(num_trees=6, seed=3), [
+                {{"max_depth": 3}}, {{"max_depth": 5}}]),
+        ],
+        problem_type="binary",
+        validator=OpCrossValidation(num_folds=2, stratify=True),
+        strategy="halving",
+        halving=HalvingConfig(eta=3, min_rows=128, seed=7))
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        sel.with_mesh(make_sweep_mesh(6, n_devices=n_dev))
+    sel.with_sweep_checkpoint({ckdir!r})
+    label = FeatureColumn(RealNN, y.astype(np.float64))
+    feats = FeatureColumn(OPVector, X)
+    sel.fit_columns(None, label, feats)
+    summ = sel.metadata["model_selector_summary"]
+    print(json.dumps({{
+        "devices": n_dev,
+        "best": summ["bestModelType"],
+        "params": summ["bestModelParams"],
+        "metrics": [r["metricValue"] for r in summ["validationResults"]],
+        "elastic": sel.metadata.get("elastic"),
+    }}))
+""")
+
+
+def _spawn(ckdir: str, n_devices: int, faults=None, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in shlex.split(env.get("XLA_FLAGS", ""))
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    if faults is not None:
+        env["TMOG_FAULTS"] = json.dumps(faults)
+    else:
+        env.pop("TMOG_FAULTS", None)
+    script = _CHILD.format(root=_ROOT, ckdir=ckdir)
+    return subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+def _parse(proc) -> dict:
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"child rc={proc.returncode}: {proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+def _close(a, b, tol=2e-2) -> bool:
+    import numpy as np
+
+    fa, fb = np.asarray(a, float), np.asarray(b, float)
+    if fa.shape != fb.shape:
+        return False
+    both = np.isfinite(fa) & np.isfinite(fb)
+    # quarantined/eliminated sentinels must agree in position, values in
+    # tolerance where both runs have a number
+    return bool((np.isfinite(fa) == np.isfinite(fb)).all()
+                and np.allclose(fa[both], fb[both], atol=tol))
+
+
+def run_matrix(tmp: str) -> dict:
+    """kill @8dev -> resume @4dev; kill @8dev -> resume @1dev; plus the
+    injected device-loss leg.  Returns the summary dict (ok flags)."""
+    out: dict = {"legs": {}}
+
+    ref = _parse(_spawn(os.path.join(tmp, "ck_ref"), 8))
+    out["reference"] = {"best": ref["best"], "devices": 8}
+
+    kill_fault = {"faults": [{"point": "sweep.checkpoint",
+                              "action": "kill", "at": 1}]}
+    for resume_dev, name in ((4, "resume_4dev"), (1, "resume_1dev")):
+        ckdir = os.path.join(tmp, f"ck_{name}")
+        killed = _spawn(ckdir, 8, faults=kill_fault)
+        leg = {"killed_rc": killed.returncode,
+               "cursor_present": os.path.exists(
+                   os.path.join(ckdir, "sweep.json"))}
+        if killed.returncode != -signal.SIGKILL or not leg["cursor_present"]:
+            leg["ok"] = False
+            leg["error"] = "kill leg did not die at the cursor"
+            out["legs"][name] = leg
+            continue
+        resumed = _parse(_spawn(ckdir, resume_dev))
+        elastic = resumed.get("elastic") or {}
+        leg.update({
+            "devices": resume_dev,
+            "best": resumed["best"],
+            "mesh_shrinks": elastic.get("meshShrinks", 0),
+            "mesh_repacks": elastic.get("meshRepacks", 0),
+            "winner_parity": resumed["best"] == ref["best"]
+            and resumed["params"] == ref["params"],
+            "metrics_parity": _close(resumed["metrics"], ref["metrics"]),
+            "cursor_cleared": not os.path.exists(
+                os.path.join(ckdir, "sweep.json")),
+        })
+        leg["ok"] = bool(leg["winner_parity"] and leg["metrics_parity"]
+                         and leg["mesh_shrinks"] > 0
+                         and leg["cursor_cleared"])
+        out["legs"][name] = leg
+
+    # device-loss leg: a backend loss mid-unit must complete the sweep
+    # (retried or quarantined), never abort it
+    loss = _parse(_spawn(
+        os.path.join(tmp, "ck_loss"), 8,
+        faults={"faults": [{"point": "device.loss",
+                            "action": "device_loss", "at": 4,
+                            "times": 1}]}))
+    el = loss.get("elastic") or {}
+    out["legs"]["device_loss"] = {
+        "best": loss["best"],
+        "retries": el.get("retries", 0),
+        "winner_parity": loss["best"] == ref["best"],
+        "ok": bool(loss["best"] == ref["best"]
+                   and (el.get("retries", 0) > 0
+                        or el.get("quarantined", 0) > 0)),
+    }
+
+    out["ok"] = all(leg.get("ok") for leg in out["legs"].values())
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier1 gate; no json file written")
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="tmog_elastic_") as tmp:
+        result = run_matrix(tmp)
+    if not args.smoke:
+        from transmogrifai_tpu.utils.jsonio import write_json_atomic
+
+        write_json_atomic(
+            os.path.join(_ROOT, "benchmarks", "elastic_latest.json"),
+            result, indent=2, sort_keys=True)
+    print(json.dumps(result))
+    if not result["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

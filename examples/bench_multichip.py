@@ -96,7 +96,12 @@ def _selector(seed: int = 42):
 
 
 def run_sweep(X, y, n_devices: int):
-    """One full sweep at ``n_devices``; returns (wall_s, best, metrics)."""
+    """One full sweep at ``n_devices``; returns (wall_s, best, metrics).
+
+    Runs with the selector's elastic context attached (exactly as a
+    ``fit_columns`` sweep would), so the elastic counters — retries,
+    mesh shrinks, quarantined units, watchdog fires — accumulate into
+    the profiling snapshot the emitted JSON records."""
     import numpy as np
 
     from transmogrifai_tpu.models.trees import clear_sweep_caches
@@ -107,11 +112,13 @@ def run_sweep(X, y, n_devices: int):
     if n_devices > 1:
         sel.with_mesh(make_sweep_mesh(queue_width, n_devices=n_devices))
     w = np.ones(len(y), np.float32)
+    elastic = sel._elastic_context(len(y), int(X.shape[1]), queue_width)
     cands = sel._candidates()
     t0 = time.perf_counter()
     best, results = sel.validator.validate(
         cands, X, y, w, eval_fn=sel._metric,
-        metric_name=sel.validation_metric, larger_better=sel.larger_better)
+        metric_name=sel.validation_metric, larger_better=sel.larger_better,
+        elastic=elastic)
     wall = time.perf_counter() - t0
     clear_sweep_caches()
     return wall, best, [r.metric_value for r in results]
@@ -305,6 +312,12 @@ def main():
         result["sharding_contracts"] = run_sharding_contracts(
             X, y, n_devices=min(8, n_avail))
         contracts_ok = result["sharding_contracts"]["ok"]
+
+    # elastic counters (parallel/elastic.py via utils/profiling): zeros
+    # on a healthy run, nonzero when any sweep degraded — recorded so
+    # the trajectory shows WHEN a bench survived a device loss
+    from transmogrifai_tpu.utils.profiling import elastic_snapshot
+    result["elastic"] = elastic_snapshot()
 
     if not args.smoke:
         result["streaming_ingest_rss"] = _run_rss_probes(

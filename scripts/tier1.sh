@@ -45,6 +45,17 @@ else
   echo "MULTICHIP_SMOKE=FAILED (see /tmp/_t1_multichip.log)"
   rc=1
 fi
+# elastic smoke: SIGKILL a halving sweep mid-rung under 8 forced host
+# devices, resume under 4 and under 1, assert winner + metrics parity
+# with the uninterrupted run and a NONZERO mesh_shrinks counter in the
+# resumed run's elastic metadata; plus an injected device.loss mid-unit
+# that must complete (retry/quarantine), never abort
+if timeout -k 10 480 env JAX_PLATFORMS=cpu python examples/bench_elastic.py --smoke > /tmp/_t1_elastic.log 2>&1; then
+  echo "ELASTIC_SMOKE=ok $(grep -ao '"ok": true' /tmp/_t1_elastic.log | tail -1)"
+else
+  echo "ELASTIC_SMOKE=FAILED (see /tmp/_t1_elastic.log)"
+  rc=1
+fi
 # self-lint: all three source families (trace TM03x, shard TM04x,
 # concurrency TM05x) over the shipped package (incl. parallel/ tuning/
 # serving/ workflow/) + examples, DAG lint of the example pipeline

@@ -269,9 +269,15 @@ class TestSweepCheckpoint:
             return fitter(X, y, w, p)
         return wrapped
 
-    def test_fingerprint_mismatch_refuses(self, tmp_path):
+    def test_mesh_change_resumes_logical_change_refuses(self, tmp_path):
+        """Mesh-portable checkpoints: the mesh record is ADVISORY — a
+        cursor written on one mesh shape loads on any other (surfaced as
+        ``mesh_changed``/``resumed_mesh``), while a LOGICAL identity
+        change (here: the metric) refuses with a key-level diff naming
+        the offending key."""
         from transmogrifai_tpu.workflow.checkpoint import (
             CheckpointMismatchError, SweepCheckpointManager,
+            sweep_fingerprint,
         )
 
         sel = _selector()
@@ -279,11 +285,165 @@ class TestSweepCheckpoint:
         m1 = SweepCheckpointManager(str(tmp_path),
                                     self._fingerprint(cands))
         m1.record_unit(0, [0.5], None)
-        other = self._fingerprint(cands,
-                                  mesh=make_sweep_mesh(6, n_devices=8))
-        m2 = SweepCheckpointManager(str(tmp_path), other)
-        with pytest.raises(CheckpointMismatchError):
-            m2.load()
+
+        # different mesh shape: resumes, advisory record exposed
+        other_mesh = self._fingerprint(
+            cands, mesh=make_sweep_mesh(6, n_devices=8))
+        m2 = SweepCheckpointManager(str(tmp_path), other_mesh)
+        assert m2.load() is True
+        assert m2.mesh_changed
+        assert m2.resumed_mesh is None          # saved mesh was None
+        assert m2.restore(0) == ([0.5], None)   # the cursor survived
+
+        # different metric (logical identity): refuses, diff names it
+        other_metric = sweep_fingerprint(cands, "AuROC", "cv2",
+                                         strategy="full", n_rows=100)
+        m3 = SweepCheckpointManager(str(tmp_path), other_metric)
+        with pytest.raises(CheckpointMismatchError) as ei:
+            m3.load()
+        assert "metric" in str(ei.value)
+        assert "AuROC" in str(ei.value)
+
+
+class TestMeshPortableResume:
+    """Tentpole gate: a cursor written on an 8-device mesh resumes on a
+    4-device mesh (and single-device), re-batching the REMAINING units
+    onto the resuming process's mesh — same winner, restored units never
+    re-run."""
+
+    @pytest.mark.parametrize("resume_devices", [4, None])
+    def test_partial_resume_on_smaller_mesh(self, tmp_path,
+                                            resume_devices):
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SweepCheckpointManager, sweep_fingerprint,
+        )
+
+        X, y = _toy(n=240, d=8)
+        w = np.ones(len(y), np.float32)
+
+        def fingerprint(cands, mesh):
+            return sweep_fingerprint(cands, "AuPR", "cv2", mesh=mesh,
+                                     strategy="full", n_rows=len(y))
+
+        # full sweep on the 8-device mesh, every unit checkpointed
+        mesh8 = make_sweep_mesh(6, n_devices=8)
+        sel1 = _selector().with_mesh(mesh8)
+        cands1 = sel1._candidates(with_groups=False)
+        m1 = SweepCheckpointManager(str(tmp_path),
+                                    fingerprint(cands1, mesh8))
+        best1, res1 = sel1.validator.validate(
+            cands1, X, y, w, eval_fn=sel1._metric,
+            metric_name=sel1.validation_metric,
+            larger_better=sel1.larger_better, checkpoint=m1)
+
+        # resume on the smaller mesh with HALF the cursor: restored
+        # units stay restored, dropped units re-run on the new mesh
+        mesh_small = (make_sweep_mesh(6, n_devices=resume_devices)
+                      if resume_devices else None)
+        sel2 = _selector()
+        if mesh_small is not None:
+            sel2.with_mesh(mesh_small)
+        cands2 = sel2._candidates(with_groups=False)
+        m2 = SweepCheckpointManager(str(tmp_path),
+                                    fingerprint(cands2, mesh_small))
+        assert m2.load() is True
+        assert m2.mesh_changed
+        assert m2.resumed_mesh == {"shape": {"data": 2, "grid": 4},
+                                   "devices": 8}
+        for idx in (3, 4, 5):
+            m2._units.pop(f"{idx}", None)
+        ran = []
+        spied = [(n, p, _spy_fitter(f, ran, p)) for n, p, f, *_ in cands2]
+        best2, res2 = sel2.validator.validate(
+            spied, X, y, w, eval_fn=sel2._metric,
+            metric_name=sel2.validation_metric,
+            larger_better=sel2.larger_better, checkpoint=m2)
+        # only the 3 dropped units re-ran (once per fold); the restored
+        # units' params never hit a fitter
+        dropped = [cands2[i][1] for i in (3, 4, 5)]
+        assert len(ran) == 3 * 2
+        assert all(p in dropped for p in ran)
+        assert best2 == best1
+        np.testing.assert_allclose(
+            [r.metric_value for r in res2],
+            [r.metric_value for r in res1], atol=2e-2)
+
+
+def _spy_fitter(fitter, ran, params):
+    def wrapped(X, y, w, p):
+        ran.append(params)
+        return fitter(X, y, w, p)
+    return wrapped
+
+
+class TestTreeMeshShrinkParity:
+    """Satellite: the tree families' sequential ``with_mesh`` fallback
+    stays pad-invariant and parity-exact when the mesh SHRINKS mid-sweep
+    — the TM024/TM025 contracts only exercise linear grid groups, so
+    these property tests pin the tree path across mesh shapes directly
+    (n chosen to hit several n mod ndata residues)."""
+
+    def _rf_scores(self, X, y, mesh):
+        from transmogrifai_tpu.models import OpRandomForestClassifier
+
+        est = OpRandomForestClassifier(num_trees=6, seed=3, max_depth=4)
+        if mesh is not None:
+            est.with_mesh(mesh)
+        model = est.fit_raw(X, y, np.ones(len(y), np.float32))
+        batch = model.predict_batch(X)
+        return np.asarray(batch.probability)[:, 1]
+
+    @pytest.mark.parametrize("n", [141, 144, 150])
+    def test_rf_with_mesh_parity_across_shrink_ladder(self, n):
+        """8-dev sweep mesh -> shrunk 2-dev mesh -> no mesh: same scores
+        within the documented 2e-2 tolerance, for row counts on and off
+        the shard tile boundary (pad invariance of the fallback)."""
+        from transmogrifai_tpu.parallel.elastic import shrink_mesh
+
+        X, y = _toy(n=n, d=6, seed=n)
+        mesh8 = make_sweep_mesh(1, n_devices=8)
+        shrunk = shrink_mesh(mesh8)      # 4-device pure-data mesh
+        assert shrunk is not None and dict(shrunk.shape)["data"] == 4
+        s8 = self._rf_scores(X, y, mesh8)
+        s4 = self._rf_scores(X, y, shrunk)
+        s1 = self._rf_scores(X, y, None)
+        np.testing.assert_allclose(s8, s1, atol=2e-2)
+        np.testing.assert_allclose(s4, s1, atol=2e-2)
+
+    def test_sweep_survives_device_loss_on_tree_unit(self):
+        """An injected ``device.loss`` mid-RF-unit shrinks the mesh and
+        retries the unit there: the sweep finishes (never aborts) with
+        the same winner as the uninterrupted run and the metrics within
+        tolerance."""
+        from transmogrifai_tpu.utils import faults
+
+        X, y = _toy(n=420, d=10)
+        w = np.ones(len(y), np.float32)
+
+        sel_ref = _selector()
+        cands_ref = sel_ref._candidates(with_groups=False)
+        best_ref, res_ref = sel_ref.validator.validate(
+            cands_ref, X, y, w, eval_fn=sel_ref._metric,
+            metric_name=sel_ref.validation_metric,
+            larger_better=sel_ref.larger_better)
+
+        sel = _selector().with_mesh(make_sweep_mesh(6, n_devices=8))
+        ctx = sel._elastic_context(len(y), 10, 6)
+        cands = sel._candidates(with_groups=False)
+        with faults.inject(faults.FaultSpec(
+                point="device.loss", action="device_loss", at=4,
+                times=1)):
+            best, res = sel.validator.validate(
+                cands, X, y, w, eval_fn=sel._metric,
+                metric_name=sel.validation_metric,
+                larger_better=sel.larger_better, elastic=ctx)
+        assert all(r.error is None for r in res)
+        assert ctx.counters.retries == 1
+        assert ctx.counters.mesh_shrinks >= 1
+        assert best == best_ref
+        np.testing.assert_allclose(
+            [r.metric_value for r in res],
+            [r.metric_value for r in res_ref], atol=2e-2)
 
 
 _KILL_RESUME_SCRIPT = textwrap.dedent("""

@@ -237,6 +237,15 @@ def _tm045():
         "    return fn(X, w)\n")
 
 
+def _tm046():
+    return _shard(
+        "def sweep(queue, unit):\n"
+        "    try:\n"
+        "        return queue.run_unit(unit)\n"
+        "    except Exception as e:\n"
+        "        return [], str(e)\n")
+
+
 # -- TM05x ------------------------------------------------------------------
 
 def _concur(body):
@@ -288,7 +297,7 @@ FIXTURES = {
     "TM024": _tm024, "TM025": _tm025, "TM026": _tm026,
     "TM030": _tm030, "TM031": _tm031, "TM032": _tm032,
     "TM040": _tm040, "TM041": _tm041, "TM042": _tm042, "TM043": _tm043,
-    "TM044": _tm044, "TM045": _tm045,
+    "TM044": _tm044, "TM045": _tm045, "TM046": _tm046,
     "TM050": _tm050, "TM051": _tm051, "TM052": _tm052, "TM053": _tm053,
 }
 

@@ -63,6 +63,9 @@ RULES: Dict[str, Any] = {
     "TM044": (ERROR, "NamedSharding spec rank exceeds the operand rank"),
     "TM045": (ERROR, "shard_map in_specs/out_specs arity disagrees with "
                      "the wrapped function"),
+    "TM046": (ERROR, "broad except around sweep-unit execution that does "
+                     "not route through the shared device-loss classifier "
+                     "(parallel.elastic)"),
     # -- concurrency / durability (analysis/concur_lint.py) -------------
     "TM050": (ERROR, "non-atomic JSON/benchmark write: bypasses "
                      "write_json_atomic / the tmp + os.replace pattern"),

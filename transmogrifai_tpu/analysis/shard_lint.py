@@ -37,6 +37,15 @@ These rules pin those conventions:
   tuple whose length differs from the wrapped function's parameter
   count, or a literal ``out_specs`` tuple whose length differs from the
   body's returned tuple.
+* **TM046 — unrouted sweep-unit exception handler.**  A broad ``except
+  Exception`` (or bare ``except``) whose try body executes sweep units
+  (calls ``run_unit`` / ``run_group_block`` / ``_run_fold`` /
+  ``_run_group`` / ``run_fold``) but whose handler neither consults the
+  shared device-loss classifier (``parallel.elastic``:
+  ``classify`` / ``classify_sweep_error`` / ``is_device_loss`` /
+  ``DeviceLossError``) nor re-raises — such a handler swallows a chip
+  loss as an ordinary candidate failure and the elastic
+  shrink/retry/quarantine ladder never engages.
 
 Host syncs on traced values inside shard_map bodies are reported as
 TM030 through the shared :func:`~.trace_lint.check_host_syncs` pass —
@@ -65,6 +74,15 @@ _MESH_FNS = {"make_mesh"}
 _RAW_MESH = {"Mesh"}
 #: call sites that establish a sweep context for TM042
 _SWEEP_CONTEXT_FNS = {"make_sweep_mesh", "_place_sweep"}
+
+#: calls that execute a sweep unit's fit body — a try wrapping one of
+#: these is "sweep-unit execution" for TM046
+_SWEEP_UNIT_CALLS = {"run_unit", "run_group_block", "_run_fold",
+                     "_run_group", "run_fold"}
+#: names whose presence in a handler counts as routing through the
+#: shared device-loss classifier (parallel/elastic.py)
+_CLASSIFIER_NAMES = {"classify", "classify_sweep_error", "is_device_loss",
+                     "DeviceLossError"}
 
 _SPEC_NAMES = {"P", "PartitionSpec"}
 _SHARD_MAP_NAMES = {"shard_map", "shard_map_compat"}
@@ -123,6 +141,7 @@ class _ShardLinter:
 
     def run(self) -> Findings:
         self._visit(self.tree, None)
+        self._check_unit_exception_routing(self.tree)
         return self.findings
 
     # -- reporting ---------------------------------------------------------
@@ -498,6 +517,61 @@ class _ShardLinter:
                            f"(donate_argnums) and read again: its buffer "
                            f"may alias the output", fn.lineno)
                 donated.discard(name)  # one report per donation
+
+    # -- TM046: unrouted sweep-unit exception handlers -----------------------
+
+    @staticmethod
+    def _is_broad_handler(type_expr) -> bool:
+        """bare ``except:`` / ``except Exception`` / ``except
+        BaseException`` (incl. inside a tuple)."""
+        if type_expr is None:
+            return True
+        exprs = (type_expr.elts if isinstance(type_expr, ast.Tuple)
+                 else [type_expr])
+        for e in exprs:
+            name = _last(dotted(e))
+            if name in ("Exception", "BaseException"):
+                return True
+        return False
+
+    @staticmethod
+    def _handler_routes(handler: ast.ExceptHandler) -> bool:
+        """The handler consults the shared classifier, or re-raises (the
+        loss is not swallowed — an enclosing handler may still route)."""
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Name) and n.id in _CLASSIFIER_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _CLASSIFIER_NAMES:
+                return True
+        return False
+
+    def _check_unit_exception_routing(self, tree: ast.AST) -> None:
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.Try):
+                continue
+            body_calls = {
+                _last(dotted(c.func))
+                for stmt in n.body for c in ast.walk(stmt)
+                if isinstance(c, ast.Call)}
+            if not (body_calls & _SWEEP_UNIT_CALLS):
+                continue
+            for h in n.handlers:
+                if not self._is_broad_handler(h.type):
+                    continue
+                if self._handler_routes(h):
+                    continue
+                called = sorted(body_calls & _SWEEP_UNIT_CALLS)
+                self._emit(
+                    "TM046", h,
+                    f"broad except around sweep-unit execution "
+                    f"({', '.join(called)}) without routing through the "
+                    f"shared device-loss classifier (parallel.elastic."
+                    f"classify_sweep_error / is_device_loss): a chip loss "
+                    f"is swallowed as a candidate failure and the elastic "
+                    f"shrink/retry/quarantine ladder never engages",
+                    n.lineno)
 
     # -- TM044: NamedSharding rank vs operand rank ---------------------------
 

@@ -1,4 +1,8 @@
 """Mesh / sharding substrate (SURVEY §2.12, §5.8 — Spark → JAX mapping)."""
+from .elastic import (
+    ElasticContext, ElasticCounters, classify_sweep_error, is_device_loss,
+    shrink_mesh,
+)
 from .ingest import ShardedMatrixWriter, stream_to_mesh
 from .mesh import (
     auto_grid_axis, data_sharding, feature_sharding, fold_weight_sharding,
@@ -21,4 +25,6 @@ __all__ = [
     "fit_logreg_sharded", "grow_forest_sharded", "colstats_corr_sharded",
     "colstats_psum", "fit_logreg_newton_psum", "histogram_psum",
     "ShardedMatrixWriter", "stream_to_mesh",
+    "ElasticContext", "ElasticCounters", "classify_sweep_error",
+    "is_device_loss", "shrink_mesh",
 ]

@@ -115,6 +115,7 @@ class ShardedMatrixWriter:
         self._shard_i = 0
         self._fill = 0
         self._committed = {}                   # device -> device buffer
+        self._closed = False
         self._jax = jax
 
     @property
@@ -150,8 +151,22 @@ class ShardedMatrixWriter:
             if self._fill == self.shard_rows:
                 self._flush_shard()
 
+    def close(self) -> None:
+        """Release the per-shard DEVICE buffers and the reusable host
+        slice without finishing — the abort path.  An ingest that dies
+        mid-shard would otherwise strand every committed shard on device
+        (plus one host slice) for as long as the writer object lives;
+        callers wrap the append loop in ``try/finally: close()``
+        (mirrors the ``_BlockStore`` spill cleanup from the streaming
+        driver).  Idempotent; a no-op after ``finish()``."""
+        self._committed = {}
+        self._buf = None
+        self._closed = True
+
     def finish(self):
         """The global row-sharded array (pad rows zero-filled)."""
+        if self._closed:
+            raise ValueError("finish() on a closed ShardedMatrixWriter")
         if self.offset != self.rows:
             raise ValueError(
                 f"finish() at offset {self.offset}, expected "
@@ -172,6 +187,7 @@ class ShardedMatrixWriter:
             self.global_shape, self.sharding, arrays)
         self._committed = {}
         self._buf = None
+        self._closed = True
         self._check_pad_tail(out)
         return out
 
@@ -205,9 +221,12 @@ def stream_to_mesh(chunks: Iterable[np.ndarray], mesh, total_rows: int,
     matrix and the host (padded_rows,) 0/1 validity vector callers fold
     into their sample weights so pad rows stay inert."""
     w = ShardedMatrixWriter(mesh, total_rows, cols, dtype)
-    for chunk in chunks:
-        w.append(chunk)
-    X_dev = w.finish()
+    try:
+        for chunk in chunks:
+            w.append(chunk)
+        X_dev = w.finish()
+    finally:
+        w.close()   # no-op after finish(); releases buffers on abort
     valid = np.zeros(w.padded_rows, np.float32)
     valid[:total_rows] = 1.0
     return X_dev, valid

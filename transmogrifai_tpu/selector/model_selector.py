@@ -124,7 +124,8 @@ class ModelSelector(PredictorEstimator):
                  uid: Optional[str] = None,
                  strategy: str = "full",
                  halving=None,
-                 parallel=None):
+                 parallel=None,
+                 watchdog: Optional[float] = None):
         super().__init__(operation_name="modelSelector", uid=uid)
         self.models_and_params = list(models_and_params)
         self.problem_type = problem_type
@@ -160,6 +161,15 @@ class ModelSelector(PredictorEstimator):
         self.parallel = parallel
         self.sweep_checkpoint_dir: Optional[str] = None
         self.sweep_checkpoint_every: int = 1
+        # elastic execution (parallel/elastic.py): device-loss recovery is
+        # always on — a classified backend loss shrinks the mesh and
+        # retries the unit within this budget before quarantining the
+        # candidate.  The straggler watchdog is OPT-IN: ``watchdog`` is
+        # the deadline factor over the cost model's per-unit prediction
+        # (None = off; also off while the cost-model tier is cold).
+        self.watchdog = watchdog
+        self.watchdog_cost_model = None   # test seam (with_watchdog)
+        self.elastic_max_retries: int = 2
 
     def with_mesh(self, mesh) -> "ModelSelector":
         """Multi-chip selection.  With a ("data", "grid") sweep mesh
@@ -173,6 +183,20 @@ class ModelSelector(PredictorEstimator):
         shortcut (``fit_device``) is bypassed either way — its programs
         are compiled for one chip's memory space."""
         self.mesh = mesh
+        return self
+
+    def with_watchdog(self, factor: float,
+                      cost_model=None) -> "ModelSelector":
+        """Arm the straggler watchdog: each sweep unit gets a deadline of
+        ``factor x (CostModel.predict(sweep kind) / queue width)``.  A
+        unit that overruns escalates timeout -> degraded re-run (mesh
+        shrunk, deadline doubled) -> quarantine as ``failed: straggler``.
+        Only engages when the cost model's tier for the sweep's stage
+        kind is FITTED — a cold tier's analytic guess would produce
+        garbage deadlines (``cost_model`` overrides the history-fitted
+        model; a test seam)."""
+        self.watchdog = float(factor)
+        self.watchdog_cost_model = cost_model
         return self
 
     def with_sweep_checkpoint(self, directory: str,
@@ -214,6 +238,57 @@ class ModelSelector(PredictorEstimator):
         if n <= 1:
             return None
         return make_sweep_mesh(queue_width, n_devices=n)
+
+    # -- elastic execution ---------------------------------------------------
+
+    def _elastic_context(self, n_rows: int, n_cols: int, queue_width: int):
+        """The per-fit elastic policy (parallel/elastic.py): a shrink
+        hook that re-points this stage's LIVE ``mesh`` attribute at a
+        smaller sweep mesh built from surviving devices (the unit fitters
+        read it per fit, so the retried unit lands on the shrunk mesh —
+        ultimately ``None``, the single-device CPU-fallback path), plus
+        the opt-in watchdog deadline."""
+        from ..parallel.elastic import ElasticContext, shrink_mesh
+
+        def shrink() -> bool:
+            new = shrink_mesh(self.mesh)
+            changed = (new is not self.mesh
+                       and (new is None or self.mesh is None
+                            or new.shape != self.mesh.shape))
+            self.mesh = new
+            return changed
+
+        ctx = ElasticContext(shrink=shrink,
+                             max_unit_retries=self.elastic_max_retries,
+                             unit_deadline_s=self._watchdog_deadline(
+                                 n_rows, n_cols, queue_width))
+        return ctx
+
+    def _watchdog_deadline(self, n_rows: int, n_cols: int,
+                           queue_width: int) -> Optional[float]:
+        """``factor x predicted sweep wall / queue width``, or None when
+        the watchdog is unarmed or the cost-model tier is cold (an
+        analytic cold-start guess would quarantine healthy units)."""
+        if not self.watchdog:
+            return None
+        from ..utils.profiling import backend_name
+
+        cm = self.watchdog_cost_model
+        if cm is None:
+            from ..tuning.costmodel import CostModel
+
+            cm = CostModel.from_history()
+        kind = ("ModelSelector:fit-halving" if self.strategy == "halving"
+                else "ModelSelector:fit")
+        backend = backend_name()
+        if cm.source(kind, backend) != "fitted":
+            return None               # cold tier: watchdog stays off
+        from ..parallel.elastic import mesh_device_count
+
+        total = cm.predict(kind, n_rows, n_cols, backend=backend,
+                           n_devices=mesh_device_count(self.mesh))
+        return max(float(self.watchdog) * total / max(queue_width, 1),
+                   1e-3)
 
     # -- validation plumbing -------------------------------------------------
 
@@ -359,14 +434,17 @@ class ModelSelector(PredictorEstimator):
                 "multiclass": DataCutter(),
                 "regression": DataSplitter()}[self.problem_type]
 
-    def _sweep_checkpoint(self, candidates, n_rows: int):
+    def _sweep_checkpoint(self, candidates, n_rows: int, elastic=None):
         """Mid-sweep cursor manager for this fit, or None.  Primed from
-        disk (resume); a checkpoint for a different sweep raises
-        CheckpointMismatchError instead of blending runs."""
+        disk (resume); a checkpoint for a LOGICALLY different sweep
+        raises CheckpointMismatchError instead of blending runs, while a
+        mesh-shape change resumes — the remaining units re-batch onto
+        this process's mesh, and the re-pack/shrink lands on the elastic
+        counters."""
         if self.sweep_checkpoint_dir is None:
             return None
         from ..workflow.checkpoint import (SweepCheckpointManager,
-                                           sweep_fingerprint)
+                                           mesh_record, sweep_fingerprint)
 
         v = self.validator
         vdesc = (f"{type(v).__name__}("
@@ -380,7 +458,9 @@ class ModelSelector(PredictorEstimator):
         manager = SweepCheckpointManager(
             self.sweep_checkpoint_dir, fp,
             every_units=self.sweep_checkpoint_every)
-        manager.load()
+        if manager.load() and manager.mesh_changed and elastic is not None:
+            elastic.note_resumed_mesh(manager.resumed_mesh,
+                                      mesh_record(self.mesh))
         return manager
 
     def _make_rung_regroup(self, candidates):
@@ -596,6 +676,14 @@ class ModelSelector(PredictorEstimator):
         else:
             y_v, base_w_v = y, base_w
 
+        # elastic execution context for this fit: device-loss recovery
+        # (shrink + bounded retry + quarantine) always armed, watchdog
+        # per configuration.  The counters land in metadata["elastic"]
+        # whether or not anything fired, so the numbers are always there
+        # to read (and always zero on a healthy sweep).
+        queue_width = sum(len(g) for _, g in self.models_and_params)
+        elastic = self._elastic_context(n, int(X.shape[1]), queue_width)
+
         best_group = None
         if self.best_estimator is not None:
             # consume the workflow-CV winner: a later fit on new data must
@@ -614,14 +702,15 @@ class ModelSelector(PredictorEstimator):
             from ..tuning.halving import halving_validate
 
             candidates = self._candidates(with_groups=False)
-            ckpt = self._sweep_checkpoint(candidates, n)
+            ckpt = self._sweep_checkpoint(candidates, n, elastic=elastic)
             best_i, results, schedule = halving_validate(
                 self.validator, candidates, X, y_v, base_w_v,
                 eval_fn=self._metric, metric_name=self.validation_metric,
                 larger_better=self.larger_better, config=self.halving,
                 stratify=self.problem_type != "regression",
                 checkpoint=ckpt,
-                regroup=self._make_rung_regroup(candidates))
+                regroup=self._make_rung_regroup(candidates),
+                elastic=elastic)
             if ckpt is not None:
                 ckpt.finish()
             self.metadata["halving_schedule"] = schedule
@@ -631,15 +720,17 @@ class ModelSelector(PredictorEstimator):
             # groups' async device work in a daemon thread
             self._start_tree_prep_prefetch(X)
             candidates = self._candidates()
-            ckpt = self._sweep_checkpoint(candidates, n)
+            ckpt = self._sweep_checkpoint(candidates, n, elastic=elastic)
             best_i, results = self.validator.validate(
                 candidates, X, y_v, base_w_v,
                 eval_fn=self._metric, metric_name=self.validation_metric,
-                larger_better=self.larger_better, checkpoint=ckpt)
+                larger_better=self.larger_better, checkpoint=ckpt,
+                elastic=elastic)
             if ckpt is not None:
                 ckpt.finish()
             best_name, best_params, *rest = candidates[best_i]
             best_group = rest[1] if len(rest) > 1 else None
+        self.metadata["elastic"] = elastic.counters.to_json()
 
         # refit best on the full training split (ModelSelector.fit :180).
         # Grid groups that solved an appended full-train weight row hold the
@@ -657,7 +748,9 @@ class ModelSelector(PredictorEstimator):
         # the fitters; nothing outside the winner's family shares its
         # growth program).
         best_model = None
-        if best_group is not None:
+        if best_group is not None and not elastic.groups_invalid:
+            # (a mid-sweep mesh shrink invalidates group refit artifacts —
+            # their device arrays target the dead mesh; refit sequentially)
             try:
                 row = best_group.grid_points.index(best_params)
             except ValueError:
@@ -792,6 +885,7 @@ class BinaryClassificationModelSelector:
         models_and_parameters=None, parallelism: int = 8,
         max_wait: Optional[float] = None,
         strategy: str = "full", halving=None, parallel=None,
+        watchdog: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
@@ -802,7 +896,8 @@ class BinaryClassificationModelSelector:
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving, parallel=parallel)
+            strategy=strategy, halving=halving, parallel=parallel,
+            watchdog=watchdog)
 
     @staticmethod
     def with_train_validation_split(
@@ -811,6 +906,7 @@ class BinaryClassificationModelSelector:
         parallelism: int = 8,
         max_wait: Optional[float] = None,
         strategy: str = "full", halving=None, parallel=None,
+        watchdog: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _binary_defaults(),
@@ -821,7 +917,8 @@ class BinaryClassificationModelSelector:
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving, parallel=parallel)
+            strategy=strategy, halving=halving, parallel=parallel,
+            watchdog=watchdog)
 
 
 class MultiClassificationModelSelector:
@@ -832,6 +929,7 @@ class MultiClassificationModelSelector:
         parallelism: int = 8,
         max_wait: Optional[float] = None,
         strategy: str = "full", halving=None, parallel=None,
+        watchdog: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
@@ -842,7 +940,8 @@ class MultiClassificationModelSelector:
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving, parallel=parallel)
+            strategy=strategy, halving=halving, parallel=parallel,
+            watchdog=watchdog)
 
     @staticmethod
     def with_train_validation_split(
@@ -851,6 +950,7 @@ class MultiClassificationModelSelector:
         parallelism: int = 8,
         max_wait: Optional[float] = None,
         strategy: str = "full", halving=None, parallel=None,
+        watchdog: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _multiclass_defaults(),
@@ -861,7 +961,8 @@ class MultiClassificationModelSelector:
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving, parallel=parallel)
+            strategy=strategy, halving=halving, parallel=parallel,
+            watchdog=watchdog)
 
 
 class RegressionModelSelector:
@@ -872,6 +973,7 @@ class RegressionModelSelector:
         parallelism: int = 8,
         max_wait: Optional[float] = None,
         strategy: str = "full", halving=None, parallel=None,
+        watchdog: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
@@ -881,7 +983,8 @@ class RegressionModelSelector:
                                         max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving, parallel=parallel)
+            strategy=strategy, halving=halving, parallel=parallel,
+            watchdog=watchdog)
 
     @staticmethod
     def with_train_validation_split(
@@ -891,6 +994,7 @@ class RegressionModelSelector:
         parallelism: int = 8,
         max_wait: Optional[float] = None,
         strategy: str = "full", halving=None, parallel=None,
+        watchdog: Optional[float] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_params=models_and_parameters or _regression_defaults(),
@@ -901,7 +1005,8 @@ class RegressionModelSelector:
                                              max_wait=max_wait),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             validation_metric=validation_metric,
-            strategy=strategy, halving=halving, parallel=parallel)
+            strategy=strategy, halving=halving, parallel=parallel,
+            watchdog=watchdog)
 
 
 class RandomParamBuilder:

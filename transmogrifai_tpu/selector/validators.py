@@ -90,6 +90,7 @@ class _ValidatorBase:
         metric_name: str,
         larger_better: bool = True,
         checkpoint=None,
+        elastic=None,
     ) -> Tuple[int, List[ValidationResult]]:
         raise NotImplementedError
 
@@ -174,7 +175,7 @@ class OpCrossValidation(_ValidatorBase):
         self.max_wait = max_wait
 
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
-                 larger_better=True, checkpoint=None):
+                 larger_better=True, checkpoint=None, elastic=None):
         n = X.shape[0]
         folds = make_folds(n, self.num_folds, y=y, stratify=self.stratify,
                            seed=self.seed)
@@ -196,7 +197,7 @@ class OpCrossValidation(_ValidatorBase):
 
         return _run_sweep(candidates, fold_ctxs, run_fold, metric_name,
                           larger_better, self.max_wait, run_group=run_group,
-                          checkpoint=checkpoint)
+                          checkpoint=checkpoint, elastic=elastic)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -255,7 +256,7 @@ class OpTrainValidationSplit(_ValidatorBase):
         return in_train
 
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
-                 larger_better=True, checkpoint=None):
+                 larger_better=True, checkpoint=None, elastic=None):
         n = X.shape[0]
         in_train = self._split_mask(n, y)
         w_train = base_weights * in_train
@@ -270,7 +271,7 @@ class OpTrainValidationSplit(_ValidatorBase):
 
         return _run_sweep(candidates, [None], run_fold, metric_name,
                           larger_better, self.max_wait, run_group=run_group,
-                          checkpoint=checkpoint)
+                          checkpoint=checkpoint, elastic=elastic)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -343,16 +344,67 @@ class SweepWorkQueue:
 
     # -- unit execution ------------------------------------------------------
 
-    def run_unit(self, unit: SweepUnit) -> Tuple[List[Any], Optional[str]]:
-        """One candidate across every fold context, failure-isolated."""
+    def _unit_attempt(self, unit: SweepUnit) -> List[Any]:
+        """One execution attempt of a unit's (folds x fit) body.  The
+        ``unit.slow`` / ``device.loss`` fault points fire here — once per
+        ATTEMPT, keyed by the unit's queue index — so the elastic
+        escalation ladder (retry on a shrunk mesh, then quarantine) is
+        seed-deterministically testable."""
+        from ..utils import faults
+
+        faults.fire("unit.slow", index=unit.index, tag=unit.name)
+        faults.fire("device.loss", index=unit.index, tag=unit.name)
         fold_vals: List[Any] = []
-        try:
-            for ctx in self.fold_ctxs:
-                fold_vals.append(
-                    self._run_fold(unit.fitter, unit.run_params, ctx))
-        except Exception as e:  # noqa: BLE001 - candidate isolation
-            return [], f"{type(e).__name__}: {e}"
-        return fold_vals, None
+        for ctx in self.fold_ctxs:
+            fold_vals.append(
+                self._run_fold(unit.fitter, unit.run_params, ctx))
+        return fold_vals
+
+    def run_unit(self, unit: SweepUnit,
+                 elastic=None) -> Tuple[List[Any], Optional[str]]:
+        """One candidate across every fold context, failure-isolated.
+
+        With an :class:`~transmogrifai_tpu.parallel.elastic.
+        ElasticContext` attached, two degradation ladders wrap the
+        attempt: classified DEVICE LOSSES re-run the unit (the context
+        shrinks the owner's mesh between attempts, ultimately to the
+        single-device path) within a bounded retry budget before
+        quarantining the candidate as ``failed: device_loss``; and the
+        opt-in STRAGGLER WATCHDOG bounds each attempt at the context's
+        deadline (escalating timeout -> degraded re-run at 2x the
+        deadline -> ``failed: straggler`` quarantine).  Workload failures
+        keep the historical behavior: score worst, record the error."""
+        loss_attempt = 0
+        slow_attempt = 0
+        while True:
+            try:
+                deadline = (elastic.unit_deadline_s
+                            if elastic is not None else None)
+                if deadline is None:
+                    return self._unit_attempt(unit), None
+                from ..parallel.elastic import run_with_deadline
+
+                fold_vals, timed_out = run_with_deadline(
+                    lambda: self._unit_attempt(unit),
+                    deadline * (2 ** slow_attempt),
+                    abandoned=elastic.abandoned)
+                if not timed_out:
+                    return fold_vals, None
+                if elastic.on_watchdog_timeout(unit.index, slow_attempt):
+                    slow_attempt += 1
+                    continue       # degraded re-run on the shrunk mesh
+                return [], (f"failed: straggler (unit exceeded its "
+                            f"{deadline:.3f}s watchdog deadline "
+                            f"{slow_attempt + 1}x)")
+            except Exception as e:  # noqa: BLE001 - candidate isolation,
+                # routed through the shared device-loss classifier
+                if elastic is not None and elastic.classify(e):
+                    if elastic.on_device_loss(unit.index, e, loss_attempt):
+                        loss_attempt += 1
+                        continue   # re-run on the shrunk mesh
+                    return [], (f"failed: device_loss "
+                                f"({type(e).__name__}: {e})")
+                return [], f"{type(e).__name__}: {e}"
 
     def group_span(self, i: int) -> int:
         """End index (exclusive) of the run of units sharing units[i]'s
@@ -374,14 +426,20 @@ class SweepWorkQueue:
             j -= 1
         return j
 
-    def run_group_block(self, i: int, j: int):
+    def run_group_block(self, i: int, j: int, elastic=None):
         """Batched fit for units[i:j] (one shared GridGroup): the group's
         (C_g, F) metric matrix, or None when the group declines/fails —
-        in which case the units are stripped to the sequential path."""
+        in which case the units are stripped to the sequential path.  A
+        failure the shared classifier recognizes as a DEVICE LOSS
+        additionally shrinks the mesh (the stripped members then refit
+        sequentially on the surviving devices)."""
         group = self.units[i].group
         try:
             return self._run_group(group)
-        except Exception as e:  # noqa: BLE001 - fall back per-candidate
+        except Exception as e:  # noqa: BLE001 - fall back per-candidate,
+            # routed through the shared device-loss classifier
+            if elastic is not None and elastic.classify(e):
+                elastic.on_group_device_loss(e)
             import warnings
             warnings.warn(
                 f"grid group {type(group).__name__} failed "
@@ -396,7 +454,7 @@ class SweepWorkQueue:
     # -- the default scheduler: full sweep in stable order -------------------
 
     def run_all(self, metric_name: str, larger_better: bool,
-                max_wait: Optional[float], checkpoint=None
+                max_wait: Optional[float], checkpoint=None, elastic=None
                 ) -> Tuple[int, List[ValidationResult]]:
         """Every unit in stable order — the classic full sweep.
 
@@ -404,16 +462,23 @@ class SweepWorkQueue:
         enables the mid-sweep cursor: units whose fold metrics are already
         durable are restored instead of re-run, and each finished unit's
         metrics persist as the sweep advances — an 8-chip sweep killed
-        mid-flight resumes at its cursor.  Checkpointing materializes each
-        unit's device metrics at completion (one stacked fetch per unit or
-        group block) instead of deferring every fetch to the end; that
-        sync is the durability cost and is only paid when a checkpoint is
-        attached.
+        mid-flight resumes at its cursor, ON WHATEVER MESH the resuming
+        process has (restored records are host fold metrics; the
+        remaining units were re-batched when this queue was built).
+        Checkpointing materializes each unit's device metrics at
+        completion (one stacked fetch per unit or group block) instead of
+        deferring every fetch to the end; that sync is the durability
+        cost and is only paid when a checkpoint is attached.
+
+        ``elastic`` (parallel.elastic.ElasticContext) arms device-loss
+        retry/quarantine and the straggler watchdog — see ``run_unit``.
 
         Raises only when EVERY candidate failed — there is no model to
         select otherwise."""
         import time
 
+        if elastic is not None:
+            elastic.checkpoint = checkpoint
         t0 = time.monotonic()
         all_vals: List[Any] = []
         errors: List[Optional[str]] = []
@@ -444,10 +509,16 @@ class SweepWorkQueue:
                 continue
             if unit.group is not None and self._run_group is not None:
                 j = self.group_span(i)
+                if elastic is not None and elastic.groups_invalid:
+                    # a mesh shrink invalidated the remaining batched
+                    # programs (compiled for the dead mesh): strip to
+                    # sequential fits on the surviving devices
+                    self.strip_groups(i, j)
+                    continue
                 # row offset into the group's (C_g, F) metric matrix: the
                 # block may start mid-group after a checkpoint restore
                 base = i - self.group_start(i)
-                M = self.run_group_block(i, j)
+                M = self.run_group_block(i, j, elastic=elastic)
                 if M is not None:
                     if checkpoint is not None:
                         rows = _materialize(
@@ -470,13 +541,17 @@ class SweepWorkQueue:
                 # declined/failed: strip so members fit sequentially
                 self.strip_groups(i, j)
                 continue
-            fold_vals, err = self.run_unit(unit)
+            fold_vals, err = self.run_unit(unit, elastic=elastic)
             if checkpoint is not None:
                 fold_vals = _materialize([fold_vals])[0]
                 checkpoint.record_unit(unit.index, fold_vals, err)
             all_vals.append(fold_vals)
             errors.append(err)
             i += 1
+        if elastic is not None:
+            # watchdog-abandoned workers must not outlive the sweep (a
+            # straggler finishing into interpreter teardown crashes XLA)
+            elastic.drain()
         return self.collect(all_vals, errors, metric_name, larger_better)
 
     # -- result assembly -----------------------------------------------------
@@ -513,7 +588,7 @@ class SweepWorkQueue:
 
 def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
                larger_better: bool, max_wait: Optional[float],
-               run_group=None, checkpoint=None
+               run_group=None, checkpoint=None, elastic=None
                ) -> Tuple[int, List[ValidationResult]]:
     """The full-sweep scheduler over the work queue (see SweepWorkQueue
     for the execution semantics — this wrapper is the historical entry
@@ -521,7 +596,7 @@ def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
     queue = SweepWorkQueue(candidates, fold_ctxs, run_fold,
                            run_group=run_group)
     return queue.run_all(metric_name, larger_better, max_wait,
-                         checkpoint=checkpoint)
+                         checkpoint=checkpoint, elastic=elastic)
 
 
 def _argbest(vals: List[float], larger_better: bool) -> int:

@@ -173,6 +173,7 @@ def halving_validate(
     stratify: bool = True,
     checkpoint=None,
     regroup=None,
+    elastic=None,
 ) -> Tuple[int, List, Dict[str, Any]]:
     """Run the candidate sweep under successive halving.
 
@@ -193,7 +194,14 @@ def halving_validate(
     fit_params_list)`` lets the caller rebuild same-family batched groups
     over a rung's survivors (the sharded sweep packs each rung's
     candidates onto the mesh's grid axis); returning None keeps the
-    per-candidate path.
+    per-candidate path.  Because the regroup runs fresh at EVERY rung —
+    including the first rung of a resumed sweep — a checkpoint written on
+    one mesh shape resumes with its surviving candidates re-batched onto
+    whatever mesh the resuming process has.
+
+    ``elastic`` (parallel.elastic.ElasticContext) rides into every rung's
+    ``validator.validate`` call: device-loss retry/quarantine and the
+    straggler watchdog apply per rung unit.
     """
     cfg = config or HalvingConfig()
     n, k = len(y), len(candidates)
@@ -205,7 +213,8 @@ def halving_validate(
         t0 = time.perf_counter()
         best, results = validator.validate(
             candidates, X, y, base_weights, eval_fn, metric_name,
-            larger_better=larger_better, checkpoint=checkpoint)
+            larger_better=larger_better, checkpoint=checkpoint,
+            elastic=elastic)
         sched_json.update({
             "fallback": "full sweep (schedule admits no reduction rung)",
             "rungs": [], "candidateSeconds":
@@ -258,7 +267,8 @@ def halving_validate(
         t0 = time.perf_counter()
         _, results = validator.validate(
             rung_cands, Xs, ys, ws, eval_fn, metric_name,
-            larger_better=larger_better, checkpoint=rung_ckpt)
+            larger_better=larger_better, checkpoint=rung_ckpt,
+            elastic=elastic)
         rung.wall_s = time.perf_counter() - t0
         rung.candidate_seconds = rung.wall_s
         total_cand_s += rung.wall_s

@@ -25,10 +25,20 @@ no plan is armed):
                          disk (workflow/checkpoint.SweepCheckpointManager)
                          — a ``kill`` here is the mid-SWEEP crash-resume
                          test (tests/test_parallel_mesh.py)
+  ``unit.slow``          at the top of every sweep-unit attempt
+                         (selector/validators.SweepWorkQueue.run_unit);
+                         ``index`` is the unit's queue index — a ``slow``
+                         here exercises the straggler watchdog
+  ``device.loss``        same site — a ``device_loss`` action here
+                         exercises the elastic shrink/retry/quarantine
+                         ladder (parallel/elastic.py)
 
 Actions: ``io_error`` (raise OSError — the transient class the reader
 retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``
-(sleep ``delay_s``), ``kill`` (SIGKILL this process; subprocess tests only).
+(sleep ``delay_s``), ``kill`` (SIGKILL this process; subprocess tests
+only), ``device_loss`` (raise :class:`DeviceLossError`, whose message is
+shaped like the XLA backend-loss family so the shared classifier
+``parallel.elastic.is_device_loss`` recognizes it).
 
 Determinism: a spec matches by explicit call index (``at``/``every``) or by
 a seeded per-point Bernoulli draw (``p`` + plan ``seed``) — same plan, same
@@ -53,17 +63,25 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-__all__ = ["FaultSpec", "FaultPlan", "FaultError", "install_faults",
-           "clear_faults", "current_plan", "inject", "fire", "ENV_VAR"]
+__all__ = ["FaultSpec", "FaultPlan", "FaultError", "DeviceLossError",
+           "install_faults", "clear_faults", "current_plan", "inject",
+           "fire", "ENV_VAR"]
 
 ENV_VAR = "TMOG_FAULTS"
 
-_ACTIONS = ("io_error", "raise", "slow", "kill")
+_ACTIONS = ("io_error", "raise", "slow", "kill", "device_loss")
 
 
 class FaultError(RuntimeError):
     """Raised by the ``raise`` action (non-transient by design: the retry
     policy must NOT swallow it)."""
+
+
+class DeviceLossError(RuntimeError):
+    """Raised by the ``device_loss`` action — the injected stand-in for a
+    chip/backend dying mid-program.  The message carries the XLA
+    backend-loss needles so ``parallel.elastic.is_device_loss`` classifies
+    it exactly like the real thing."""
 
 
 @dataclass
@@ -203,6 +221,10 @@ class FaultPlan:
             raise OSError(f"{hit.message} ({where})")
         elif hit.action == "raise":
             raise FaultError(f"{hit.message} ({where})")
+        elif hit.action == "device_loss":
+            raise DeviceLossError(
+                f"injected device loss: UNAVAILABLE: TPU backend "
+                f"setup/compile error ({where})")
         elif hit.action == "kill":  # pragma: no cover - dies before report
             os.kill(os.getpid(), signal.SIGKILL)
 

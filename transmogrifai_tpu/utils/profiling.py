@@ -188,6 +188,10 @@ class RunCounters:
     drains: int = 0
     launches: int = 0
     launch_tags: Dict[str, int] = field(default_factory=dict)
+    #: elastic-sweep accounting (parallel/elastic.py mirrors its per-sweep
+    #: ElasticCounters here): retries / mesh_shrinks / mesh_repacks /
+    #: quarantined / watchdog_fires / device_losses
+    elastic: Dict[str, int] = field(default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -201,6 +205,7 @@ class RunCounters:
             "drains": self.drains,
             "launches": self.launches,
             "launchTags": dict(self.launch_tags),
+            "elastic": dict(self.elastic),
         }
 
 
@@ -234,6 +239,23 @@ def count_drain(seconds: float) -> None:
 def count_launch(tag: str, n: int = 1) -> None:
     COUNTERS.launches += n
     COUNTERS.launch_tags[tag] = COUNTERS.launch_tags.get(tag, 0) + n
+
+
+def count_elastic(kind: str, n: int = 1) -> None:
+    """Elastic-sweep event (retries / mesh_shrinks / quarantined /
+    watchdog_fires / ...) — the process-wide mirror of the per-sweep
+    ``parallel.elastic.ElasticCounters``, read by the bench scripts."""
+    COUNTERS.elastic[kind] = COUNTERS.elastic.get(kind, 0) + n
+
+
+def elastic_snapshot() -> Dict[str, int]:
+    """The run's elastic counters with every key present (zeros when the
+    sweep never degraded) — the shape ``benchmarks/multichip_latest.json``
+    records."""
+    base = {"retries": 0, "mesh_shrinks": 0, "mesh_repacks": 0,
+            "quarantined": 0, "watchdog_fires": 0, "device_losses": 0}
+    base.update(COUNTERS.elastic)
+    return base
 
 
 def fetch_timed(x, dtype=None):

@@ -62,12 +62,16 @@ __all__ = ["StreamingCheckpointManager", "CheckpointMismatchError",
            "ResumeState", "compute_fingerprint", "encode_fit_state",
            "decode_fit_state", "adopt_restored_model", "CHECKPOINT_JSON",
            "CHECKPOINT_VERSION", "SweepCheckpointManager",
-           "sweep_fingerprint", "SWEEP_CHECKPOINT_JSON"]
+           "sweep_fingerprint", "mesh_record", "fingerprint_diff",
+           "SWEEP_CHECKPOINT_JSON"]
 
 CHECKPOINT_JSON = "checkpoint.json"
 CHECKPOINT_VERSION = 1
 SWEEP_CHECKPOINT_JSON = "sweep.json"
-SWEEP_CHECKPOINT_VERSION = 1
+#: v2: the fingerprint split into a LOGICAL sweep identity (compared on
+#: resume) and an ADVISORY mesh record (recorded, never compared) — a
+#: sweep preempted on 8 chips may resume on 4, or on one
+SWEEP_CHECKPOINT_VERSION = 2
 
 
 class CheckpointMismatchError(RuntimeError):
@@ -75,6 +79,47 @@ class CheckpointMismatchError(RuntimeError):
     other pipeline, other chunk geometry).  Refusing to resume beats
     silently merging two trainings; point checkpoint_dir elsewhere or
     clear it."""
+
+
+def fingerprint_diff(saved: Any, current: Any, path: str = "",
+                     limit: int = 12) -> List[str]:
+    """Key-level diff of two fingerprint documents — ``"path: saved=X
+    current=Y"`` lines, so a mismatch message says WHICH keys diverged
+    (a mesh-vs-logical mismatch is distinguishable at a glance) instead
+    of dumping both fingerprints whole."""
+    out: List[str] = []
+
+    def walk(a: Any, b: Any, where: str) -> None:
+        if len(out) >= limit:
+            return
+        if isinstance(a, dict) and isinstance(b, dict):
+            for k in sorted(set(a) | set(b)):
+                walk(a.get(k, "<absent>"), b.get(k, "<absent>"),
+                     f"{where}.{k}" if where else str(k))
+            return
+        if isinstance(a, list) and isinstance(b, list):
+            if len(a) != len(b):
+                out.append(f"{where}: saved has {len(a)} item(s), "
+                           f"current has {len(b)}")
+                return
+            for i, (x, y) in enumerate(zip(a, b)):
+                walk(x, y, f"{where}[{i}]")
+            return
+        if a != b:
+            out.append(f"{where}: saved={json.dumps(a, default=str)} "
+                       f"current={json.dumps(b, default=str)}")
+
+    walk(saved, current, path)
+    if len(out) >= limit:
+        out.append("... (diff truncated)")
+    return out
+
+
+def _mismatch_message(what: str, directory: str, saved: Any,
+                      current: Any, hint: str) -> str:
+    lines = fingerprint_diff(saved, current) or ["<no key-level diff>"]
+    return (f"{what} in {directory!r} belongs to a different run; {hint}.\n"
+            f"  differing keys:\n    " + "\n    ".join(lines))
 
 
 # ---------------------------------------------------------------------------
@@ -279,12 +324,10 @@ class StreamingCheckpointManager:
                 f"checkpoint format v{doc.get('version')} != "
                 f"v{CHECKPOINT_VERSION}")
         if doc.get("fingerprint") != self.fingerprint:
-            raise CheckpointMismatchError(
-                f"checkpoint in {self.directory!r} belongs to a different "
-                f"run (reader/pipeline/chunk_rows changed); clear the "
-                f"directory or point checkpoint_dir elsewhere.\n"
-                f"  saved:   {json.dumps(doc.get('fingerprint'))}\n"
-                f"  current: {json.dumps(self.fingerprint)}")
+            raise CheckpointMismatchError(_mismatch_message(
+                "checkpoint", self.directory,
+                doc.get("fingerprint"), self.fingerprint,
+                "clear the directory or point checkpoint_dir elsewhere"))
         arrays = {}
         npz = doc.get("arrays")
         if npz:
@@ -403,27 +446,50 @@ class StreamingCheckpointManager:
 # mid-sweep cursor: selector-sweep checkpoint/resume (ROADMAP item 1)
 # ---------------------------------------------------------------------------
 
+def mesh_record(mesh) -> Optional[Dict[str, Any]]:
+    """The ADVISORY mesh record a sweep checkpoint carries: the shape the
+    sweep was running on when it saved, plus the device count.  Never
+    compared on resume — recorded so the resuming process can see (and
+    count, ``ElasticContext.note_resumed_mesh``) that it re-batched the
+    remaining units onto a different mesh."""
+    if mesh is None:
+        return None
+    shape = {name: int(mesh.shape[name]) for name in mesh.axis_names}
+    n = 1
+    for v in shape.values():
+        n *= v
+    return {"shape": shape, "devices": n}
+
+
 def sweep_fingerprint(candidates, metric_name: str, validator_desc: str,
                       mesh=None, strategy: str = "full",
                       n_rows: int = 0) -> Dict[str, Any]:
-    """Identity of one selector sweep: same candidate list (names +
-    identity params in order), same validator geometry, same metric, same
-    mesh shape, same strategy → same unit sequence, so a cursor from one
-    run is exact for the other.  Mesh SHAPE (not device ids) is part of
-    the identity — a resume on a differently-shaped mesh would change the
-    padding and batching geometry mid-sweep."""
-    shape = None
-    if mesh is not None:
-        shape = {name: int(mesh.shape[name]) for name in mesh.axis_names}
+    """Identity of one selector sweep, split in two:
+
+    ``logical`` — candidate list (names + identity params in order),
+    validator geometry, metric, strategy, row count.  Same logical
+    identity → same unit sequence and the same per-unit fold metrics (the
+    durable records are HOST floats, computed identically on any mesh up
+    to the documented 2e-2 sharded tolerance), so a cursor from one run
+    is exact for the other — this half is COMPARED on resume.
+
+    ``mesh`` — the advisory record of the mesh the sweep ran on
+    (:func:`mesh_record`).  Deliberately NOT part of the compared
+    identity: TPU fleets are preemptible and resize under you, and the
+    sweep's remaining units re-batch onto whatever mesh the resuming
+    process has (the grid-group packing is rebuilt per process/rung).
+    """
     return {
-        "candidates": [[str(c[0]), json.dumps(c[1], sort_keys=True,
-                                              default=str)]
-                       for c in candidates],
-        "metric": metric_name,
-        "validator": validator_desc,
-        "meshShape": shape,
-        "strategy": strategy,
-        "nRows": int(n_rows),
+        "logical": {
+            "candidates": [[str(c[0]), json.dumps(c[1], sort_keys=True,
+                                                  default=str)]
+                           for c in candidates],
+            "metric": metric_name,
+            "validator": validator_desc,
+            "strategy": strategy,
+            "nRows": int(n_rows),
+        },
+        "mesh": mesh_record(mesh),
     }
 
 
@@ -454,13 +520,24 @@ class SweepCheckpointManager:
         self._units: Dict[str, Dict[str, Any]] = {}
         self._rung: Optional[Dict[str, Any]] = None
         self._dirty = 0
+        #: advisory mesh record the loaded checkpoint was saved under
+        #: (None until load(); may differ from the current fingerprint's
+        #: mesh — that is the ELASTIC resume case, not a mismatch)
+        self.resumed_mesh: Optional[Dict[str, Any]] = None
+        self.mesh_changed = False
         os.makedirs(directory, exist_ok=True)
 
     # -- resume -------------------------------------------------------------
 
     def load(self) -> bool:
         """Prime the cursor from disk; True when a checkpoint was found.
-        A fingerprint mismatch raises :class:`CheckpointMismatchError`
+
+        Only the LOGICAL half of the fingerprint is compared — a sweep
+        checkpointed on one mesh shape resumes on any other (the durable
+        unit records are host fold metrics, mesh-independent), with the
+        saved advisory mesh surfaced as ``resumed_mesh``/``mesh_changed``
+        so the caller can count the re-pack.  A logical mismatch raises
+        :class:`CheckpointMismatchError` with the key-level diff
         (refusing to resume beats silently blending two sweeps)."""
         path = os.path.join(self.directory, SWEEP_CHECKPOINT_JSON)
         if not os.path.exists(path):
@@ -471,12 +548,17 @@ class SweepCheckpointManager:
             raise CheckpointMismatchError(
                 f"sweep checkpoint format v{doc.get('version')} != "
                 f"v{SWEEP_CHECKPOINT_VERSION}")
-        if doc.get("fingerprint") != self.fingerprint:
-            raise CheckpointMismatchError(
-                f"sweep checkpoint in {self.directory!r} belongs to a "
-                f"different sweep (candidates/validator/metric/mesh/"
-                f"strategy changed); clear the directory or point the "
-                f"checkpoint elsewhere")
+        saved = doc.get("fingerprint") or {}
+        if saved.get("logical") != self.fingerprint.get("logical"):
+            raise CheckpointMismatchError(_mismatch_message(
+                "sweep checkpoint", self.directory,
+                saved.get("logical"), self.fingerprint.get("logical"),
+                "the LOGICAL sweep identity (candidates/validator/metric/"
+                "strategy) changed — clear the directory or point the "
+                "checkpoint elsewhere (a mesh-shape change alone would "
+                "have resumed)"))
+        self.resumed_mesh = saved.get("mesh")
+        self.mesh_changed = saved.get("mesh") != self.fingerprint.get("mesh")
         self._units = dict(doc.get("units", {}))
         self._rung = doc.get("rung")
         return True
@@ -558,6 +640,9 @@ class _ScopedSweepCheckpoint:
     def record_unit(self, index: int, fold_vals,
                     error: Optional[str]) -> None:
         self._m.record_unit(index, fold_vals, error, tag=self._tag)
+
+    def flush(self) -> None:
+        self._m.flush()
 
 
 def adopt_restored_model(est: Estimator, model: PipelineStage) -> Model:

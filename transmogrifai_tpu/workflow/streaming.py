@@ -281,6 +281,19 @@ class _ColumnWriter:
                     ent["mask_parts"].append(np.asarray(col.mask))
         self.offset += n
 
+    def close(self) -> None:
+        """Abort-path cleanup: release every unfinished sharded column
+        writer's device buffers + reusable host slice
+        (``ShardedMatrixWriter.close``).  A mid-shard ingest failure
+        would otherwise strand the committed shards on device for the
+        writer's lifetime.  Finished writers already released; idempotent
+        (the driver calls this in ``finally`` — the _BlockStore
+        pattern)."""
+        for ent in self.cols.values():
+            sw = ent.get("swriter")
+            if sw is not None and not getattr(sw, "_closed", True):
+                sw.close()
+
     def row_view(self, name: str, start: int,
                  stop: int) -> Optional[FeatureColumn]:
         ent = self.cols.get(name)
@@ -569,9 +582,13 @@ def fit_dag_streaming(
             writer.append(ds, [c for c in ds.names()
                                if c in mat_cols or c in extras])
 
-        run_reader_pass("materialize", ordered, set(mat_cols), write_only,
-                        keep_unknown=True)
-        materialized.update(writer.finish())
+        try:
+            run_reader_pass("materialize", ordered, set(mat_cols),
+                            write_only, keep_unknown=True)
+            materialized.update(writer.finish())
+        except BaseException:
+            writer.close()   # release per-shard device buffers on abort
+            raise
     else:
         # fuse at the SECOND estimator layer when there is one (its pass
         # can already compute the first layer's model outputs, so the
@@ -788,13 +805,20 @@ def fit_dag_streaming(
                     pos = seg_end - len(seg_ests)
                 else:
                     pos = seg_end
+        except BaseException:
+            writer.close()   # release per-shard device buffers on abort
+            raise
         finally:
             store.close()
         missing = (set(mat_cols) & chain_outputs) - set(writer.cols)
         if missing:  # pragma: no cover - cascade covers every chain output
             raise RuntimeError(
                 f"block cascade failed to materialize {sorted(missing)}")
-        materialized.update(writer.finish())
+        try:
+            materialized.update(writer.finish())
+        except BaseException:
+            writer.close()
+            raise
 
     data = ColumnarDataset(materialized, _validated=True)
 
