@@ -56,6 +56,18 @@ else
   echo "ELASTIC_SMOKE=FAILED (see /tmp/_t1_elastic.log)"
   rc=1
 fi
+# online-refresh smoke: injected covariate drift must fire the
+# DriftMonitor, the warm-start refresh must pass the shadow gate and
+# swap (outgoing generation pinned), a poisoned candidate must be
+# rejected with the registry untouched, an injected bake fault must
+# roll back to the pinned generation, and a SIGKILLed refresh must
+# resume from its checkpoint and still pass the gate
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python examples/bench_refresh.py --smoke > /tmp/_t1_refresh.log 2>&1; then
+  echo "REFRESH_SMOKE=ok $(grep -ao '"value": [0-9.]*' /tmp/_t1_refresh.log | tail -1)"
+else
+  echo "REFRESH_SMOKE=FAILED (see /tmp/_t1_refresh.log)"
+  rc=1
+fi
 # self-lint: all three source families (trace TM03x, shard TM04x,
 # concurrency TM05x) over the shipped package (incl. parallel/ tuning/
 # serving/ workflow/) + examples, DAG lint of the example pipeline

@@ -379,6 +379,20 @@ class _LastChunkWins(_MeanFillBase):
         return max(a, b)
 
 
+class _LossyExport(_MeanFillBase):
+    """export_fit_state drops the COUNT (the classic warm-start bug: the
+    persisted state forgets how much data it has seen, so restored+new
+    reweights the old window to one row).  fit_streaming never round-trips
+    the hooks, so TM021/TM022 stay clean — only TM027 fires."""
+
+    def export_fit_state(self, state):
+        s, n = state
+        return {"mean": s / max(n, 1)}
+
+    def import_fit_state(self, payload):
+        return (float(payload["mean"]), 1)
+
+
 def _streaming_data(n=20):
     rng = np.random.default_rng(3)
     data, (f,) = TestFeatureBuilder.build(

@@ -1,0 +1,547 @@
+"""Online-refresh loop tests — drift detection, warm-start refresh,
+guarded hot-swap with shadow validation + automatic rollback (ISSUE 10).
+
+Acceptance pins:
+ * drift baselines exported at fit time survive save/load BYTE-identically
+   (npz externalization), including empty-category and constant-column
+   edge cases;
+ * a DriftMonitor fed shifted traffic fires (PSI / moment-z), same-
+   distribution traffic stays quiet, and the whole matrix is seed-
+   deterministic via the ``drift.window`` fault point;
+ * ``OpWorkflow.refresh`` warm-starts from exported fit states and lands
+   within tolerance of a full streaming retrain over old+new, reports
+   merged/refit/invalidated per estimator, chains, and resumes from a
+   checkpoint after a mid-refresh crash;
+ * ``GuardedSwap`` only swaps candidates that pass the shadow gates,
+   keeps a pinned last-known-good generation, and rolls back (with a
+   structured reason in the metrics) when bake probes regress.
+"""
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.models import OpNaiveBayes
+from transmogrifai_tpu.models.classification import NaiveBayesModel
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.serving import (DriftConfig, DriftMonitor,
+                                       GuardedSwap, ModelRegistry,
+                                       ModelServer, SwapGateConfig,
+                                       export_drift_baselines)
+from transmogrifai_tpu.serving.drift import psi_from_counts
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils import faults
+from transmogrifai_tpu.utils.faults import FaultError, FaultSpec
+
+
+def make_df(rows, seed=7, age_shift=0.0, male_p=0.65):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "Survived": (rng.random(rows) > 0.62).astype(float),
+        "Pclass": rng.choice(["1", "2", "3"], rows, p=[0.24, 0.21, 0.55]),
+        "Sex": rng.choice(["male", "female"], rows, p=[male_p, 1 - male_p]),
+        "Age": rng.normal(30 + age_shift, 13, rows).clip(0.4, 95),
+        "SibSp": rng.integers(0, 6, rows).astype(float),
+        "Fare": rng.lognormal(3.0, 1.0, rows),
+        "Embarked": rng.choice(["S", "C", "Q"], rows, p=[0.72, 0.19, 0.09]),
+    })
+
+
+def build_workflow():
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    predictors = [
+        FeatureBuilder.PickList("Pclass").as_predictor(),
+        FeatureBuilder.PickList("Sex").as_predictor(),
+        FeatureBuilder.Real("Age").as_predictor(),
+        FeatureBuilder.Integral("SibSp").as_predictor(),
+        FeatureBuilder.Real("Fare").as_predictor(),
+        FeatureBuilder.PickList("Embarked").as_predictor(),
+    ]
+    features = transmogrify(predictors)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        survived, features).get_output()
+    prediction = OpNaiveBayes().set_input(survived, checked).get_output()
+    return OpWorkflow().set_result_features(prediction)
+
+
+def probs_of(model, df):
+    scored = model.score(data=df)
+    name = next(n for n in scored.names()
+                if issubclass(scored[n].ftype, ft.Prediction))
+    return np.array([d["probability_1"] for d in scored[name].to_list()])
+
+
+@pytest.fixture(scope="module")
+def base_df():
+    return make_df(400, seed=7)
+
+
+@pytest.fixture(scope="module")
+def trained(base_df):
+    """(workflow, chunked-trained model) — shared read-only base."""
+    wf = build_workflow()
+    model = wf.set_input_data(base_df).train(chunk_rows=64)
+    return wf, model
+
+
+# ---------------------------------------------------------------------------
+# baselines
+# ---------------------------------------------------------------------------
+
+class TestBaselines:
+    def test_streaming_train_exports_baselines_and_states(self, trained):
+        _, model = trained
+        bases = export_drift_baselines(model)
+        assert {"Age", "Fare", "SibSp", "Pclass", "Sex",
+                "Embarked"} <= set(bases)
+        assert bases["Age"]["kind"] == "numeric"
+        assert abs(bases["Age"]["mean"] - 30) < 3
+        assert bases["Age"]["histCentroids"].size > 1
+        assert bases["Sex"]["kind"] == "categorical"
+        assert set(bases["Sex"]["values"]) == {"male", "female"}
+        assert model.fit_states and len(model.fit_states) >= 5
+
+    def test_in_core_train_exports_same_baseline_shape(self, base_df):
+        model = build_workflow().set_input_data(base_df).train()
+        bases = export_drift_baselines(model)
+        assert bases["Age"]["kind"] == "numeric"
+        assert abs(bases["Age"]["mean"] - 30) < 3
+        assert set(bases["Sex"]["values"]) == {"male", "female"}
+        assert model.fit_states is None  # in-core trains carry no states
+
+    def test_sanity_checker_vector_baseline(self, trained):
+        _, model = trained
+        sc = next(s for s in model.stages
+                  if "drift_baseline_vector" in (s.metadata or {}))
+        vec = sc.metadata["drift_baseline_vector"]
+        assert len(vec["names"]) == len(vec["mean"]) == len(vec["variance"])
+        assert vec["n"] == 400
+
+    def test_baselines_survive_save_load_byte_identical(self, trained,
+                                                        tmp_path):
+        _, model = trained
+        path = str(tmp_path / "m")
+        model.save(path)
+        from transmogrifai_tpu.workflow.persistence import \
+            load_workflow_model
+
+        loaded = load_workflow_model(path)
+        a, b = export_drift_baselines(model), export_drift_baselines(loaded)
+        assert set(a) == set(b)
+        for name in a:
+            for key, val in a[name].items():
+                got = b[name][key]
+                if isinstance(val, np.ndarray):
+                    # the npz externalization path must be BIT-exact
+                    assert np.asarray(got).dtype == val.dtype
+                    assert np.asarray(got).tobytes() == val.tobytes(), \
+                        f"{name}.{key} drifted across save/load"
+                else:
+                    assert got == val
+
+    def test_edge_cases_empty_category_and_constant_column(self, tmp_path):
+        df = pd.DataFrame({
+            "y": [0.0, 1.0] * 20,
+            "const": [5.0] * 40,                  # zero-variance numeric
+            "empty": [None] * 40,                 # all-null category
+        })
+        y = FeatureBuilder.RealNN("y").as_response()
+        preds = [FeatureBuilder.Real("const").as_predictor(),
+                 FeatureBuilder.PickList("empty").as_predictor()]
+        features = transmogrify(preds)
+        pred = OpNaiveBayes().set_input(y, features).get_output()
+        wf = OpWorkflow().set_result_features(pred)
+        model = wf.set_input_data(df).train(chunk_rows=16)
+        bases = export_drift_baselines(model)
+        assert bases["const"]["kind"] == "numeric"
+        assert bases["const"]["m2"] == 0.0
+        assert bases["empty"]["kind"] == "categorical"
+        assert bases["empty"]["values"] == []
+        assert bases["empty"]["counts"].size == 0
+        path = str(tmp_path / "edge")
+        model.save(path)
+        from transmogrifai_tpu.workflow.persistence import \
+            load_workflow_model
+
+        loaded = export_drift_baselines(load_workflow_model(path))
+        assert loaded["empty"]["values"] == []
+        assert loaded["const"]["m2"] == 0.0
+        assert (loaded["const"]["histCentroids"].tobytes()
+                == bases["const"]["histCentroids"].tobytes())
+
+
+# ---------------------------------------------------------------------------
+# drift monitor
+# ---------------------------------------------------------------------------
+
+def _rows(df):
+    return df.to_dict("records")
+
+
+def _monitor(model, **over):
+    cfg = dict(min_rows=64, check_every=64, seed=3)
+    cfg.update(over)
+    return DriftMonitor.from_model(model, config=DriftConfig(**cfg))
+
+
+class TestDriftMonitor:
+    def test_same_distribution_stays_quiet(self, trained):
+        _, model = trained
+        mon = _monitor(model)
+        mon.observe_rows(_rows(make_df(300, seed=21)))
+        assert mon.windows_evaluated >= 1
+        assert not mon.refresh_triggered
+        assert mon.last_evaluation["driftedFeatures"] == []
+
+    def test_numeric_shift_fires(self, trained):
+        _, model = trained
+        mon = _monitor(model)
+        mon.observe_rows(_rows(make_df(300, seed=22, age_shift=40.0)))
+        assert mon.refresh_triggered
+        assert "Age" in mon.last_evaluation["driftedFeatures"]
+        rec = mon.last_evaluation["features"]["Age"]
+        assert rec["psi"] > 0.25 or rec["z"] > 8.0
+
+    def test_categorical_flip_fires(self, trained):
+        _, model = trained
+        mon = _monitor(model)
+        mon.observe_rows(_rows(make_df(300, seed=23, male_p=0.05)))
+        assert "Sex" in mon.last_evaluation["driftedFeatures"]
+        assert mon.last_evaluation["features"]["Sex"]["psi"] > 0.25
+
+    def test_min_rows_gates_evaluation(self, trained):
+        _, model = trained
+        mon = _monitor(model, min_rows=1000, check_every=1000)
+        mon.observe_rows(_rows(make_df(100, seed=24, age_shift=40.0)))
+        assert mon.windows_evaluated == 0
+        assert not mon.refresh_triggered
+
+    def test_constant_column_any_move_fires(self):
+        base = {"k": {"kind": "numeric", "n": 100.0, "mean": 5.0,
+                      "m2": 0.0, "min": 5.0, "max": 5.0,
+                      "histCentroids": np.array([5.0]),
+                      "histCounts": np.array([100.0])}}
+        mon = DriftMonitor(base, DriftConfig(min_rows=8, check_every=8))
+        mon.observe_rows([{"k": 6.0}] * 16)
+        assert mon.refresh_triggered  # z explodes off zero variance
+
+    def test_on_drift_callback_fires_once_per_trigger(self, trained):
+        _, model = trained
+        hits = []
+        mon = DriftMonitor(export_drift_baselines(model),
+                           DriftConfig(min_rows=64, check_every=64),
+                           on_drift=hits.append)
+        drifted = _rows(make_df(200, seed=25, age_shift=40.0))
+        mon.observe_rows(drifted)
+        mon.observe_rows(drifted)  # still triggered: no second callback
+        assert len(hits) == 1
+        mon.clear_refresh_trigger()
+        mon.observe_rows(drifted)
+        assert len(hits) == 2
+
+    def test_drift_window_fault_point(self, trained):
+        _, model = trained
+        mon = _monitor(model)
+        with faults.inject(FaultSpec(point="drift.window",
+                                     action="raise", at=0)):
+            with pytest.raises(FaultError):
+                mon.observe_rows(_rows(make_df(100, seed=26)))
+
+    def test_snapshot_shape(self, trained):
+        _, model = trained
+        mon = _monitor(model)
+        mon.observe_rows(_rows(make_df(100, seed=27)))
+        snap = mon.snapshot()
+        for key in ("config", "trackedFeatures", "rowsObserved",
+                    "windowsEvaluated", "driftFires", "refreshTriggered",
+                    "lastEvaluation"):
+            assert key in snap
+        import json
+        json.dumps(snap)  # /metrics payload must be JSON-able
+
+    def test_psi_helper(self):
+        assert psi_from_counts([50, 50], [50, 50]) == pytest.approx(0.0)
+        assert psi_from_counts([90, 10], [10, 90]) > 1.0
+
+
+# ---------------------------------------------------------------------------
+# warm-start refresh
+# ---------------------------------------------------------------------------
+
+class TestRefresh:
+    def test_refresh_matches_full_streaming_retrain(self, trained, base_df):
+        wf, model = trained
+        new = make_df(200, seed=8)
+        both = pd.concat([base_df, new], ignore_index=True)
+        refreshed = wf.refresh(model, data=new, chunk_rows=64)
+        rep = refreshed.refresh_report
+        assert rep["refit"] == [] and rep["invalidated"] == []
+        assert len(rep["merged"]) >= 5
+        full = build_workflow().set_input_data(both).train(chunk_rows=64)
+        dp = np.abs(probs_of(refreshed, both) - probs_of(full, both))
+        assert dp.max() < 0.05  # slot-permutation + fill float noise only
+
+    def test_refresh_chains_and_persists_states(self, trained, tmp_path):
+        wf, model = trained
+        r1 = wf.refresh(model, data=make_df(120, seed=9), chunk_rows=32)
+        assert r1.fit_states
+        r2 = wf.refresh(r1, data=make_df(120, seed=10), chunk_rows=32)
+        assert r2.refresh_report["merged"]
+        path = str(tmp_path / "chained")
+        r2.save(path)
+        from transmogrifai_tpu.workflow.persistence import \
+            load_workflow_model
+
+        loaded = load_workflow_model(path)
+        assert set(loaded.fit_states) == set(r2.fit_states)
+        r3 = wf.refresh(loaded, data=make_df(120, seed=11), chunk_rows=32)
+        assert r3.refresh_report["merged"]
+
+    def test_refresh_without_states_refits_everything(self, base_df):
+        wf = build_workflow()
+        model = wf.set_input_data(base_df).train()  # in-core: no states
+        refreshed = wf.refresh(model, data=make_df(200, seed=12),
+                               chunk_rows=64)
+        rep = refreshed.refresh_report
+        assert rep["merged"] == []
+        assert len(rep["refit"]) >= 5
+
+    def test_vocab_set_change_invalidates_downstream(self):
+        # old window never sees category "c"; the new window is dominated
+        # by it, so the merged top-k SET changes -> genuine geometry
+        # change -> downstream restored states are invalid and refit
+        old = pd.DataFrame({
+            "y": [0.0, 1.0] * 60,
+            "cat": (["a"] * 60 + ["b"] * 60),
+        })
+        new = pd.DataFrame({
+            "y": [0.0, 1.0] * 60,
+            "cat": (["c"] * 100 + ["a"] * 20),
+        })
+        y = FeatureBuilder.RealNN("y").as_response()
+        features = transmogrify(
+            [FeatureBuilder.PickList("cat").as_predictor()])
+        pred = OpNaiveBayes().set_input(y, features).get_output()
+        wf = OpWorkflow().set_result_features(pred)
+        model = wf.set_input_data(old).train(chunk_rows=32)
+        refreshed = wf.refresh(model, data=new, chunk_rows=32)
+        rep = refreshed.refresh_report
+        assert rep["geometryChanged"], "vocab set change went unnoticed"
+        assert rep["invalidated"], "downstream state survived a geometry " \
+                                   "change"
+
+    def test_slot_rotation_alone_keeps_merge(self, trained, base_df):
+        # near-tied Pclass counts rotate the vocab ORDER between old and
+        # old+new; slot alignment must keep the merge path (regression
+        # for the rotation-invalidates-everything failure mode)
+        wf, model = trained
+        refreshed = wf.refresh(model, data=make_df(200, seed=8),
+                               chunk_rows=64)
+        assert refreshed.refresh_report["invalidated"] == []
+        old_vocabs = next(s.vocabs for s in model.stages
+                          if hasattr(s, "vocabs"))
+        new_vocabs = next(s.vocabs for s in refreshed.stages
+                          if hasattr(s, "vocabs"))
+        assert old_vocabs == new_vocabs
+
+    def test_refresh_checkpoint_resume(self, trained, tmp_path):
+        wf, model = trained
+        new = make_df(256, seed=13)
+        ckpt = str(tmp_path / "refresh_ckpt")
+        clean = wf.refresh(model, data=new, chunk_rows=32)
+        with faults.inject(FaultSpec(point="checkpoint.barrier",
+                                     action="raise", at=1)):
+            with pytest.raises(FaultError):
+                wf.refresh(model, data=new, chunk_rows=32,
+                           checkpoint_dir=ckpt,
+                           checkpoint_every_chunks=2)
+        assert os.path.exists(os.path.join(ckpt, "checkpoint.json"))
+        resumed = wf.refresh(model, data=new, chunk_rows=32,
+                             checkpoint_dir=ckpt,
+                             checkpoint_every_chunks=2)
+        assert resumed.ingest_profile.resumed
+        np.testing.assert_allclose(probs_of(resumed, new),
+                                   probs_of(clean, new), atol=1e-12)
+
+    def test_refresh_checkpoint_never_resumes_plain_train(self, trained,
+                                                          tmp_path, base_df):
+        from transmogrifai_tpu.workflow.checkpoint import \
+            CheckpointMismatchError
+
+        wf, model = trained
+        new = make_df(256, seed=14)
+        ckpt = str(tmp_path / "guard_ckpt")
+        with faults.inject(FaultSpec(point="checkpoint.barrier",
+                                     action="raise", at=1)):
+            with pytest.raises(FaultError):
+                wf.refresh(model, data=new, chunk_rows=32,
+                           checkpoint_dir=ckpt,
+                           checkpoint_every_chunks=2)
+        with pytest.raises(CheckpointMismatchError, match="refresh"):
+            wf.train(chunk_rows=32, checkpoint_dir=ckpt,
+                     checkpoint_every_chunks=2)
+
+
+# ---------------------------------------------------------------------------
+# guarded swap
+# ---------------------------------------------------------------------------
+
+def _poison(model):
+    """A structurally-valid but regressed candidate: same stages except
+    the NB likelihoods are inverted, flipping its predictions."""
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    stages = []
+    for s in model.stages:
+        if isinstance(s, NaiveBayesModel):
+            bad = NaiveBayesModel(
+                log_prior=s.log_prior,
+                log_lik=(-np.asarray(s.log_lik)).tolist(), uid=s.uid)
+            bad.operation_name = s.operation_name
+            bad.input_features = list(s.input_features)
+            bad._output_feature = s._output_feature
+            bad.metadata = s.metadata
+            stages.append(bad)
+        else:
+            stages.append(s)
+    return OpWorkflowModel(result_features=model.result_features,
+                           stages=stages)
+
+
+@pytest.fixture()
+def guard_setup(trained, base_df):
+    _, model = trained
+    registry = ModelRegistry()
+    registry.register("m", model)
+    gate = SwapGateConfig(min_replay_rows=16, golden_rows=8,
+                          label_name="Survived", p99_factor=50.0)
+    guard = GuardedSwap(registry, "m", gate=gate)
+    guard.record_traffic(_rows(base_df.head(48)))
+    return registry, guard, model
+
+
+class TestGuardedSwap:
+    def test_equivalent_candidate_swaps_and_pins(self, guard_setup):
+        registry, guard, model = guard_setup
+        decision = guard.propose(model)
+        assert decision.accepted, decision.reasons
+        assert registry.get("m").version == 2
+        assert registry.pinned("m").version == 1  # last known good
+        assert guard.baking
+        snap = guard.metrics.snapshot()
+        assert snap["swapsAccepted"] == 1
+        assert snap["lastSwapDecision"]["accepted"] is True
+        assert "candLogLoss" in snap["lastSwapDecision"]["checks"]
+
+    def test_poisoned_candidate_rejected_registry_untouched(
+            self, guard_setup):
+        registry, guard, model = guard_setup
+        decision = guard.propose(_poison(model))
+        assert not decision.accepted
+        assert any(r.startswith(("pred_distance", "pred_psi",
+                                 "metric_parity"))
+                   for r in decision.reasons), decision.reasons
+        assert registry.get("m").version == 1  # still serving v1
+        snap = guard.metrics.snapshot()
+        assert snap["swapsRejected"] == 1
+        assert snap["lastSwapDecision"]["accepted"] is False
+        assert snap["lastSwapDecision"]["reasons"]
+
+    def test_latency_gate_rejects_slow_candidate(self, guard_setup):
+        registry, guard, model = guard_setup
+        guard.gate.p99_factor = 1.5
+        live_scorer = registry.get("m").scorer
+
+        def slow_scorer(rows):
+            time.sleep(0.05)
+            return live_scorer(rows)
+
+        decision = guard.propose(model, scorer=slow_scorer)
+        assert not decision.accepted
+        assert any(r.startswith("latency") for r in decision.reasons)
+
+    def test_insufficient_replay_rejects(self, trained):
+        _, model = trained
+        registry = ModelRegistry()
+        registry.register("m", model)
+        guard = GuardedSwap(registry, "m",
+                            gate=SwapGateConfig(min_replay_rows=16))
+        decision = guard.propose(model)
+        assert not decision.accepted
+        assert decision.reasons[0].startswith("insufficient_replay")
+
+    def test_shadow_fault_lands_as_gate_rejection(self, guard_setup):
+        registry, guard, model = guard_setup
+        with faults.inject(FaultSpec(point="swap.shadow",
+                                     action="raise", at=0)):
+            decision = guard.propose(model)
+        assert not decision.accepted
+        assert decision.reasons == ["shadow_error:FaultError"]
+        assert registry.get("m").version == 1
+
+    def test_bake_probe_fault_triggers_rollback(self, guard_setup):
+        registry, guard, model = guard_setup
+        assert guard.propose(model).accepted
+        assert registry.get("m").version == 2
+        with faults.inject(FaultSpec(point="swap.bake",
+                                     action="raise", at=0)):
+            reason = guard.bake_probe()
+        assert reason == "probe_error:FaultError"
+        assert registry.get("m").version == 1  # pinned generation back
+        snap = guard.metrics.snapshot()
+        assert snap["rollbacks"] == 1
+        assert snap["lastRollbackReason"] == "probe_error:FaultError"
+        assert not guard.baking
+
+    def test_golden_probe_mismatch_rolls_back(self, guard_setup):
+        registry, guard, model = guard_setup
+        assert guard.propose(model).accepted
+        # the served model corrupts AFTER the swap: golden answers move
+        nb = next(s for s in registry.get("m").model.stages
+                  if isinstance(s, NaiveBayesModel))
+        nb.log_lik = (-np.asarray(nb.log_lik)).tolist()
+        registry.get("m").model.invalidate_scoring_dag()
+        reason = guard.bake_probe()
+        assert reason is not None and reason.startswith("probe_mismatch")
+        assert registry.get("m").version == 1
+        # un-poison the shared fixture model (stages are shared objects)
+        nb.log_lik = (-np.asarray(nb.log_lik)).tolist()
+        registry.get("m").model.invalidate_scoring_dag()
+
+    def test_clean_bake_finalizes(self, guard_setup):
+        registry, guard, model = guard_setup
+        guard.gate.bake_rows = 32
+        assert guard.propose(model).accepted
+        guard.record_traffic(_rows(make_df(64, seed=15)))
+        assert not guard.baking  # baked clean, swap is final
+        assert registry.get("m").version == 2
+        assert guard.metrics.snapshot()["rollbacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# server integration
+# ---------------------------------------------------------------------------
+
+class TestServerIntegration:
+    def test_metrics_surface_drift_and_guard(self, trained, base_df,
+                                             tmp_path):
+        _, model = trained
+        path = str(tmp_path / "served")
+        model.save(path)
+        srv = ModelServer.from_path(path, name="m", max_batch=8,
+                                    max_latency_ms=2.0)
+        loaded = srv.registry.get("m").model
+        srv.with_drift_monitor(_monitor(loaded))
+        srv.with_guard(GuardedSwap(srv.registry, "m"))
+        with srv:
+            srv.score(_rows(base_df.head(8)))
+            snap = srv.snapshot()
+        assert "drift" in snap and "guardedSwap" in snap
+        assert snap["drift"]["rowsObserved"] >= 8
+        assert snap["guardedSwap"]["replayRows"] >= 8
+        assert snap["generations"][0]["current"] is True
+        import json
+        json.dumps(snap, default=str)

@@ -17,7 +17,8 @@ from transmogrifai_tpu.analysis import RULES, Findings
 from transmogrifai_tpu.analysis import concur_lint, shard_lint
 from transmogrifai_tpu.analysis.contracts import (
     ContractViolation, check_checkpoint_roundtrip, check_mesh_parity,
-    check_pad_invariance, check_streaming_fit, guarded_transform_output,
+    check_pad_invariance, check_streaming_fit, check_warm_start,
+    guarded_transform_output,
 )
 from transmogrifai_tpu.analysis.linter import lint_dag
 from transmogrifai_tpu.analysis.trace_lint import lint_source
@@ -145,6 +146,11 @@ def _tm026():
         with open(path, "w") as fh:
             fh.write(json.dumps(doc, sort_keys=True))
         return check_checkpoint_roundtrip(tmp, fp)
+
+
+def _tm027():
+    data, f = TL._streaming_data()
+    return check_warm_start(TL._LossyExport().set_input(f), data)
 
 
 # -- TM03x ------------------------------------------------------------------
@@ -294,7 +300,7 @@ FIXTURES = {
     "TM001": _tm001, "TM002": _tm002, "TM003": _tm003, "TM004": _tm004,
     "TM005": _tm005, "TM006": _tm006,
     "TM020": _tm020, "TM021": _tm021, "TM022": _tm022, "TM023": _tm023,
-    "TM024": _tm024, "TM025": _tm025, "TM026": _tm026,
+    "TM024": _tm024, "TM025": _tm025, "TM026": _tm026, "TM027": _tm027,
     "TM030": _tm030, "TM031": _tm031, "TM032": _tm032,
     "TM040": _tm040, "TM041": _tm041, "TM042": _tm042, "TM043": _tm043,
     "TM044": _tm044, "TM045": _tm045, "TM046": _tm046,

@@ -290,6 +290,57 @@ class TestRegistry:
         assert entry.path is None and entry.version == 1
         assert reg.models()[0]["name"] == "mem"
 
+    def test_generation_history_bounded(self):
+        reg = ModelRegistry(max_generations=2)
+        m = load_model_local(MODEL_V1)
+        for _ in range(4):
+            reg.register("m", m)
+        gens = reg.generations("m")
+        assert [g["version"] for g in gens] == [3, 4]
+        assert gens[-1]["current"] is True
+
+    def test_eviction_never_drops_pinned_generation(self):
+        # REGRESSION (ISSUE 10 satellite): slot-based generation eviction
+        # must skip the pinned last-known-good — the rollback target has
+        # to survive arbitrary swap churn
+        reg = ModelRegistry(max_generations=2)
+        m = load_model_local(MODEL_V1)
+        reg.register("m", m)
+        pinned = reg.pin("m")  # v1 = last known good
+        assert pinned.version == 1
+        for _ in range(5):
+            reg.register("m", m)
+        versions = [g["version"] for g in reg.generations("m")]
+        assert 1 in versions, "pinned generation was evicted"
+        assert len(versions) <= 3  # max_generations + the protected pin
+        assert reg.pinned("m").version == 1
+
+    def test_rollback_restores_pinned_and_fires_listener(self, rows):
+        reg = ModelRegistry()
+        m = load_model_local(MODEL_V1)
+        e1 = reg.register("m", m)
+        reg.pin("m")
+        reg.register("m", m)  # v2 now current
+        swaps = []
+        reg.on_swap(swaps.append)
+        back = reg.rollback("m")
+        assert back is e1 and reg.get("m") is e1
+        assert [e.version for e in swaps] == [1]  # rewarm hook fired
+        assert back.scorer(rows[:2])
+
+    def test_rollback_without_pin_raises(self):
+        reg = ModelRegistry()
+        reg.register("m", load_model_local(MODEL_V1))
+        with pytest.raises(KeyError, match="no pinned"):
+            reg.rollback("m")
+
+    def test_evict_clears_pin(self):
+        reg = ModelRegistry()
+        reg.register("m", load_model_local(MODEL_V1))
+        reg.pin("m")
+        assert reg.evict("m") is True
+        assert reg.pinned("m") is None
+
 
 class TestConcurrentServing:
     def test_many_concurrent_single_row_requests(self, server, rows):

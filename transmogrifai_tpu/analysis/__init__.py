@@ -41,7 +41,8 @@ from .diagnostics import (  # noqa: F401
 from .linter import lint_dag, lint_workflow  # noqa: F401
 from .trace_lint import lint_paths, lint_source  # noqa: F401
 from .contracts import (  # noqa: F401
-    checks_enabled, check_streaming_fit, check_workflow_contracts,
+    checks_enabled, check_streaming_fit, check_warm_start,
+    check_workflow_contracts,
     check_pad_invariance, check_mesh_parity, check_checkpoint_roundtrip,
     check_sharding_contracts,
 )
@@ -50,7 +51,7 @@ __all__ = [
     "Diagnostic", "Findings", "PipelineLintError", "ContractViolation",
     "RULES", "JSON_SCHEMA_VERSION", "lint_dag", "lint_workflow",
     "lint_paths", "lint_source", "lint_paths_all", "checks_enabled",
-    "check_streaming_fit", "check_workflow_contracts",
+    "check_streaming_fit", "check_warm_start", "check_workflow_contracts",
     "check_pad_invariance", "check_mesh_parity",
     "check_checkpoint_roundtrip", "check_sharding_contracts",
 ]

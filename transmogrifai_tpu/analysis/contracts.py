@@ -19,7 +19,10 @@ fits.  With ``TMOG_CHECK=1`` the executor routes every transform through
 Streaming-fit conformance (TM021/TM022) is a property check over every
 ``supports_streaming_fit`` estimator: chunk-independent states must merge
 associatively, and ``fit_streaming`` at two chunk sizes must match ``fit``
-within the fitter's declared ``streaming_fit_tol``.
+within the fitter's declared ``streaming_fit_tol``.  Warm-start
+equivalence (TM027, :func:`check_warm_start`) extends this to the
+refresh path: a state exported, re-imported, and updated with new chunks
+must finish to the fresh old+new streaming fit.
 ``check_workflow_contracts`` auto-discovers the estimators by walking a
 workflow's DAG the way the sequential executor would.
 
@@ -40,9 +43,9 @@ from .diagnostics import ContractViolation, Diagnostic, Findings
 
 __all__ = ["CHECK_ENV", "checks_enabled", "guarded_transform_output",
            "columns_equal", "columns_close", "check_streaming_fit",
-           "check_workflow_contracts", "check_pad_invariance",
-           "check_mesh_parity", "check_checkpoint_roundtrip",
-           "check_sharding_contracts"]
+           "check_warm_start", "check_workflow_contracts",
+           "check_pad_invariance", "check_mesh_parity",
+           "check_checkpoint_roundtrip", "check_sharding_contracts"]
 
 #: set to "1" to enable the instrumented mode (used by tests and the tier-1
 #: contract gate); any other value disables it with zero overhead beyond one
@@ -267,6 +270,56 @@ def check_streaming_fit(est, data, chunk_sizes: Sequence[int] = (7, 64),
     return findings
 
 
+def check_warm_start(est, data, chunk_rows: int = 16,
+                     split_frac: float = 0.6,
+                     findings: Optional[Findings] = None) -> Findings:
+    """TM027 — warm-start equivalence for one streamable estimator.
+
+    The contract ``OpWorkflow.refresh`` builds on: a fit state
+    accumulated over OLD chunks, round-tripped through the estimator's
+    ``export_fit_state``/``import_fit_state`` hooks (the persisted-model
+    path), then updated with NEW chunks, must finish to the same model —
+    within the declared ``streaming_fit_tol`` — as one fresh streaming
+    fit over old+new.  An export hook that drops state (a count, a
+    tie-break position, an RNG cursor) passes TM021/TM022 and still
+    breaks every refresh; this check pins it.
+    """
+    findings = findings if findings is not None else Findings()
+    n = len(data)
+    if n < 8:
+        return findings
+    tol = float(est.streaming_fit_tol)
+    name = type(est).__name__
+    cut = max(1, int(n * split_frac))
+
+    def run(chunks):
+        state = est.begin_fit()
+        for c in chunks:
+            state = est.update_chunk(state, c,
+                                     *[c[nm] for nm in est.input_names])
+        return state
+
+    fresh = est.finish_fit(run(_chunk(data, chunk_rows)))
+    fresh_out = _model_output(est, fresh, data)
+
+    state_old = run(_chunk(data.slice(0, cut), chunk_rows))
+    # the export/import round trip is part of the contract: a refresh
+    # resumes from the PERSISTED state, never the live object
+    restored = est.import_fit_state(
+        copy.deepcopy(est.export_fit_state(state_old)))
+    for c in _chunk(data.slice(cut, n), chunk_rows):
+        restored = est.update_chunk(restored, c,
+                                    *[c[nm] for nm in est.input_names])
+    warm_out = _model_output(est, est.finish_fit(restored), data)
+    if not columns_close(fresh_out, warm_out, tol):
+        findings.add(
+            "TM027",
+            f"{name} warm-start diverges: import(export(state(old))) + "
+            f"new chunks != fresh streaming fit over old+new beyond "
+            f"tol={tol}", stage_uid=est.uid)
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # Sharding / SPMD contracts (TM024-TM026) — the mesh-era runtime half of
 # the shard-safety lint (analysis/shard_lint.py).  Like the streaming
@@ -448,6 +501,7 @@ def check_workflow_contracts(wf, data=None,
                                             chunk_sizes=chunk_sizes,
                                             findings=findings,
                                             ref_model=model)
+                        check_warm_start(stage, data, findings=findings)
                     except ContractViolation as e:
                         findings.diagnostics.append(e.diagnostic)
             elif isinstance(stage, Transformer):

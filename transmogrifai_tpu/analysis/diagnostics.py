@@ -47,6 +47,10 @@ RULES: Dict[str, Any] = {
                      "program disagrees with the single-device program"),
     "TM026": (ERROR, "checkpoint fingerprint round-trip is not byte-exact "
                      "(export -> import -> re-export)"),
+    "TM027": (ERROR, "warm-start refresh diverges: merge(restored_state, "
+                     "fit_state(new_chunks)) does not finish to the fresh "
+                     "streaming fit over old+new within the declared "
+                     "tolerance"),
     # -- trace safety (analysis/trace_lint.py) --------------------------
     "TM030": (ERROR, "host sync on a traced value inside a jit function"),
     "TM031": (WARNING, "jit closure over an enclosing Python scalar: fresh "

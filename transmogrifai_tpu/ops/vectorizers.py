@@ -50,28 +50,121 @@ def _vec_column(arr: np.ndarray, meta: VectorMetadata) -> FeatureColumn:
     return FeatureColumn(OPVector, np.asarray(arr, dtype=np.float32), vmeta=meta)
 
 
-def _pivot_vocab(values, top_k: int, min_support: int) -> List:
-    """TopK pivot vocabulary via ONE vectorized ``np.unique`` pass.
+# ---------------------------------------------------------------------------
+# Drift baselines — the train-side distribution snapshot a serving-side
+# DriftMonitor (serving/drift.py) compares sampled traffic against.  Each
+# fitting vectorizer exports ``metadata["drift_baseline"]`` =
+# {raw feature name -> baseline dict}; numeric baselines carry Welford
+# moments + StreamingHistogram bins (ndarrays -> persistence externalizes
+# them into arrays.npz bit-exactly), categorical baselines carry the top
+# category counts.  Baselines ride on the fitted model's metadata, so they
+# survive save/load and registry hot-swaps with no extra artifact.
+# ---------------------------------------------------------------------------
 
-    Replaces the per-row Python ``Counter`` loop (the hot part of the
-    OneHot/MultiPickList fit at scale) while reproducing
+#: histogram bin budget for numeric baselines (the PSI grid source)
+_BASELINE_BINS = 32
+#: stride-sample cap for the IN-CORE baseline histogram: moments stay
+#: exact; the histogram only needs the distribution's shape, and an
+#: unbounded np.unique over 1M-row columns would tax the headline bench
+_BASELINE_SAMPLE = 65536
+#: categorical baselines keep at most this many categories (rest = OTHER)
+_BASELINE_CATEGORIES = 64
+
+
+def _numeric_baseline(mom, hist) -> Dict[str, Any]:
+    """Codec-safe numeric baseline from a WelfordMoments + histogram."""
+    empty = mom.mean is None
+    return {
+        "kind": "numeric", "n": float(mom.n),
+        "mean": 0.0 if empty else float(mom.mean),
+        "m2": 0.0 if empty else float(mom.m2),
+        "min": 0.0 if empty else float(mom.min),
+        "max": 0.0 if empty else float(mom.max),
+        "histCentroids": np.asarray(hist.centroids, np.float64),
+        "histCounts": np.asarray(hist.counts, np.float64),
+    }
+
+
+def _categorical_baseline(values, counts, total) -> Dict[str, Any]:
+    return {"kind": "categorical", "n": float(total),
+            "values": [str(v) for v in values],
+            "counts": np.asarray(counts, np.float64)}
+
+
+def _numeric_baseline_from_values(vals: np.ndarray) -> Dict[str, Any]:
+    """In-core numeric baseline: exact moments + stride-sampled histogram."""
+    from ..utils.sketches import WelfordMoments
+    from ..utils.streaming_histogram import StreamingHistogram
+
+    mom = WelfordMoments().update(vals)
+    stride = max(1, int(len(vals)) // _BASELINE_SAMPLE)
+    hist = StreamingHistogram(_BASELINE_BINS).update(vals[::stride])
+    return _numeric_baseline(mom, hist)
+
+
+def _numeric_baseline_from_counts(counts: Dict[float, int]) -> Dict[str, Any]:
+    """Exact numeric baseline from a value->count map (the mode fitters)."""
+    from ..utils.streaming_histogram import StreamingHistogram
+
+    if not counts:
+        return _numeric_baseline_from_values(np.zeros(0, np.float64))
+    v = np.asarray(list(counts.keys()), np.float64)
+    c = np.asarray(list(counts.values()), np.float64)
+    n = float(c.sum())
+    mean = float((v * c).sum() / n)
+    hist = StreamingHistogram.from_value_counts(v, c, _BASELINE_BINS)
+    return {
+        "kind": "numeric", "n": n, "mean": mean,
+        "m2": float((c * (v - mean) ** 2).sum()),
+        "min": float(v.min()), "max": float(v.max()),
+        "histCentroids": np.asarray(hist.centroids, np.float64),
+        "histCounts": np.asarray(hist.counts, np.float64),
+    }
+
+
+def _categorical_baseline_from_sketch(sk) -> Dict[str, Any]:
+    """Baseline from a TopKSketch: top categories by (count, first-seen)."""
+    ordered = sorted(sk.counts.items(),
+                     key=lambda kv: (-kv[1][0], kv[1][1]))
+    top = ordered[:_BASELINE_CATEGORIES]
+    return _categorical_baseline([k for k, _ in top],
+                                 [ent[0] for _, ent in top], sk.offset)
+
+
+def _pivot_fit(values, top_k: int, min_support: int):
+    """(vocab, baseline) in ONE vectorized ``np.unique`` pass.
+
+    The vocab half replaces the per-row Python ``Counter`` loop (the hot
+    part of the OneHot/MultiPickList fit at scale) while reproducing
     ``Counter.most_common(top_k)`` EXACTLY, including its tie order: keys
     tie-break by insertion order = first occurrence, so rank by
     ``(-count, first_index)``.  Falls back to the Counter loop for values
     ``np.unique`` cannot sort (mixed/unhashable-by-comparison cells).
+    The baseline half reuses the same pass for the drift snapshot.
     """
     arr = np.asarray(list(values) if not isinstance(values, np.ndarray)
                      else values, dtype=object)
-    if arr.size == 0:
-        return []
+    total = int(arr.size)
+    if total == 0:
+        return [], _categorical_baseline([], [], 0)
     try:
         uniq, first, cnt = np.unique(arr, return_index=True,
                                      return_counts=True)
     except TypeError:  # non-comparable mix: keep the legacy loop semantics
         counts = Counter(arr.tolist())
-        return [v for v, n in counts.most_common(top_k) if n >= min_support]
-    order = np.lexsort((first, -cnt))[:top_k]
-    return [uniq[i] for i in order if cnt[i] >= min_support]
+        vocab = [v for v, n in counts.most_common(top_k) if n >= min_support]
+        top = counts.most_common(_BASELINE_CATEGORIES)
+        return vocab, _categorical_baseline(
+            [v for v, _ in top], [n for _, n in top], total)
+    order = np.lexsort((first, -cnt))
+    vocab = [uniq[i] for i in order[:top_k] if cnt[i] >= min_support]
+    keep = order[:_BASELINE_CATEGORIES]
+    return vocab, _categorical_baseline(uniq[keep], cnt[keep], total)
+
+
+def _pivot_vocab(values, top_k: int, min_support: int) -> List:
+    """TopK pivot vocabulary (see ``_pivot_fit`` for the semantics)."""
+    return _pivot_fit(values, top_k, min_support)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -98,38 +191,55 @@ class RealVectorizer(SequenceEstimator):
 
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         fills = []
-        for c in cols:
+        baseline = {}
+        for f, c in zip(self.input_features, cols):
+            vals = np.asarray(c.values, dtype=np.float64)
+            m = np.asarray(c.mask)
+            present = np.nan_to_num(vals)[m]
             if self.fill_with_mean:
-                vals = np.asarray(c.values, dtype=np.float64)
-                m = np.asarray(c.mask)
-                fills.append(float(np.nan_to_num(vals)[m].mean()) if m.any() else self.fill_value)
+                fills.append(float(present.mean()) if m.any()
+                             else self.fill_value)
             else:
                 fills.append(float(self.fill_value))
+            baseline[f.name] = _numeric_baseline_from_values(present)
+        self.metadata["drift_baseline"] = baseline
         return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
 
-    # -- streaming fit: Welford moments per column (mean fill) --------------
+    # -- streaming fit: Welford moments + histogram bins per column ---------
     # Chunked means match the in-core fit to ~1e-12 relative (documented:
-    # chunked float64 summation order vs numpy's pairwise sum).
+    # chunked float64 summation order vs numpy's pairwise sum).  The
+    # histogram feeds only the drift baseline, never the fill.
 
     supports_streaming_fit = True
 
     def begin_fit(self):
         from ..utils.sketches import WelfordMoments
+        from ..utils.streaming_histogram import StreamingHistogram
 
-        return [WelfordMoments() for _ in self.input_features]
+        return [{"mom": WelfordMoments(),
+                 "hist": StreamingHistogram(_BASELINE_BINS)}
+                for _ in self.input_features]
 
     def update_chunk(self, state, data, *cols):
-        for mom, c in zip(state, cols):
+        for st, c in zip(state, cols):
             vals = np.nan_to_num(np.asarray(c.values, dtype=np.float64))
-            mom.update(vals[np.asarray(c.mask)])
+            present = vals[np.asarray(c.mask)]
+            st["mom"].update(present)
+            st["hist"].update(present)
         return state
 
     def merge_states(self, a, b):
-        return [ma.merge(mb) for ma, mb in zip(a, b)]
+        return [{"mom": sa["mom"].merge(sb["mom"]),
+                 "hist": sa["hist"].merge(sb["hist"])}
+                for sa, sb in zip(a, b)]
 
     def finish_fit(self, state):
-        fills = [float(mom.mean) if self.fill_with_mean and mom.n > 0
-                 else float(self.fill_value) for mom in state]
+        fills = [float(st["mom"].mean)
+                 if self.fill_with_mean and st["mom"].n > 0
+                 else float(self.fill_value) for st in state]
+        self.metadata["drift_baseline"] = {
+            f.name: _numeric_baseline(st["mom"], st["hist"])
+            for f, st in zip(self.input_features, state)}
         return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
 
 
@@ -205,16 +315,19 @@ class IntegralVectorizer(SequenceEstimator):
 
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         fills = []
-        for c in cols:
-            if self.fill_with_mode:
-                vals = np.asarray(c.values)[np.asarray(c.mask)]
-                if len(vals):
-                    uniq, cnt = np.unique(vals, return_counts=True)
-                    fills.append(float(uniq[np.argmax(cnt)]))
-                else:
-                    fills.append(float(self.fill_value))
+        baseline = {}
+        for f, c in zip(self.input_features, cols):
+            vals = np.asarray(c.values)[np.asarray(c.mask)]
+            counts: Dict[float, int] = {}
+            if len(vals):
+                uniq, cnt = np.unique(vals, return_counts=True)
+                counts = {float(v): int(n) for v, n in zip(uniq, cnt)}
+            if self.fill_with_mode and counts:
+                fills.append(float(uniq[np.argmax(cnt)]))
             else:
                 fills.append(float(self.fill_value))
+            baseline[f.name] = _numeric_baseline_from_counts(counts)
+        self.metadata["drift_baseline"] = baseline
         return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
 
     # -- streaming fit: mergeable value counts per column (mode fill) -------
@@ -249,6 +362,9 @@ class IntegralVectorizer(SequenceEstimator):
                 fills.append(float(best[0]))
             else:
                 fills.append(float(self.fill_value))
+        self.metadata["drift_baseline"] = {
+            f.name: _numeric_baseline_from_counts(counts)
+            for f, counts in zip(self.input_features, state)}
         return RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
 
 
@@ -302,11 +418,16 @@ class OneHotVectorizer(SequenceEstimator):
 
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         vocabs: List[List[str]] = []
-        for c in cols:
+        baseline = {}
+        for f, c in zip(self.input_features, cols):
             # vectorized count (one np.unique) instead of the per-row
-            # Counter loop; _pivot_vocab reproduces most_common exactly
+            # Counter loop; _pivot_fit reproduces most_common exactly and
+            # yields the drift baseline from the same pass
             vals = c.values[np.not_equal(c.values, None)]
-            vocabs.append(_pivot_vocab(vals, self.top_k, self.min_support))
+            vocab, base = _pivot_fit(vals, self.top_k, self.min_support)
+            vocabs.append(vocab)
+            baseline[f.name] = base
+        self.metadata["drift_baseline"] = baseline
         return OneHotVectorizerModel(
             vocabs=vocabs, track_nulls=self.track_nulls,
             unseen_to_other=self.unseen_to_other)
@@ -330,6 +451,9 @@ class OneHotVectorizer(SequenceEstimator):
 
     def finish_fit(self, state):
         vocabs = [sk.top_k(self.top_k, self.min_support) for sk in state]
+        self.metadata["drift_baseline"] = {
+            f.name: _categorical_baseline_from_sketch(sk)
+            for f, sk in zip(self.input_features, state)}
         return OneHotVectorizerModel(
             vocabs=vocabs, track_nulls=self.track_nulls,
             unseen_to_other=self.unseen_to_other)
@@ -390,12 +514,16 @@ class MultiPickListVectorizer(SequenceEstimator):
 
     def fit_columns(self, data: ColumnarDataset, *cols: FeatureColumn):
         vocabs = []
-        for c in cols:
+        baseline = {}
+        for f, c in zip(self.input_features, cols):
             # multi-valued cells: flatten once, then one vectorized
             # np.unique — the flattened order equals Counter.update(s)'s
             # insertion order, so ties still break identically
             flat = [v for s in c.values for v in s]
-            vocabs.append(_pivot_vocab(flat, self.top_k, self.min_support))
+            vocab, base = _pivot_fit(flat, self.top_k, self.min_support)
+            vocabs.append(vocab)
+            baseline[f.name] = base
+        self.metadata["drift_baseline"] = baseline
         return MultiPickListVectorizerModel(vocabs=vocabs, track_nulls=self.track_nulls)
 
     # -- streaming fit: mergeable top-k over flattened set elements ---------
@@ -417,6 +545,9 @@ class MultiPickListVectorizer(SequenceEstimator):
 
     def finish_fit(self, state):
         vocabs = [sk.top_k(self.top_k, self.min_support) for sk in state]
+        self.metadata["drift_baseline"] = {
+            f.name: _categorical_baseline_from_sketch(sk)
+            for f, sk in zip(self.input_features, state)}
         return MultiPickListVectorizerModel(vocabs=vocabs,
                                             track_nulls=self.track_nulls)
 
@@ -666,7 +797,8 @@ class SmartTextVectorizer(SequenceEstimator):
         in-core fit and the streaming finish — TextStats is already a
         mergeable monoid, SmartTextVectorizer.scala:207-247)."""
         strategies, vocabs = [], []
-        for stats in stats_list:
+        baseline = {}
+        for f, stats in zip(self.input_features, stats_list):
             fill = (stats.n - stats.n_null) / max(stats.n, 1)
             if fill < self.min_fill_rate:
                 strategies.append(self.IGNORE)
@@ -680,8 +812,16 @@ class SmartTextVectorizer(SequenceEstimator):
             else:
                 strategies.append(self.HASH)
                 vocabs.append([])
+            if not stats.saturated and stats.value_counts:
+                # low-cardinality fields get a categorical drift baseline;
+                # hashed/saturated text has no bounded category space
+                top = stats.value_counts.most_common(_BASELINE_CATEGORIES)
+                baseline[f.name] = _categorical_baseline(
+                    [v for v, _ in top], [cnt for _, cnt in top],
+                    stats.n - stats.n_null)
         self.metadata["text_strategies"] = dict(
             zip([f.name for f in self.input_features], strategies))
+        self.metadata["drift_baseline"] = baseline
         return SmartTextVectorizerModel(
             strategies=strategies, vocabs=vocabs,
             num_hash_features=self.num_hash_features,

@@ -261,6 +261,17 @@ class SanityChecker(BinaryEstimator):
             dropped=[col_names[j] for j in range(d) if to_drop[j]],
             correlation_type=self.correlation_type, sample_size=float(n))
         self.metadata["summary"] = summary.to_json()
+        # vector-level moment baseline over the KEPT slots — the drift
+        # monitor's feature-space view (serving/drift.py compares scored
+        # traffic's slot moments via z-scores; raw-feature baselines come
+        # from the vectorizers).  ndarrays so persistence externalizes
+        # them bit-exactly into arrays.npz.
+        self.metadata["drift_baseline_vector"] = {
+            "names": [col_names[j] for j in keep],
+            "n": float(n),
+            "mean": np.asarray(mean_h, np.float64)[keep],
+            "variance": np.asarray(variance, np.float64)[keep],
+        }
         new_meta = vmeta.select(keep) if vmeta.size == d else None
         model = SanityCheckerModel(keep_indices=keep)
         model._new_vmeta = new_meta

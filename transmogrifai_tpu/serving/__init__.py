@@ -23,13 +23,17 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from .admission import AdmissionController, CircuitBreaker, ShedResult
 from .batcher import MicroBatcher
+from .drift import DriftConfig, DriftMonitor, export_drift_baselines
 from .executor import BucketedExecutor, bucket_for, bucket_sizes
+from .guarded import GuardedSwap, SwapDecision, SwapGateConfig
 from .metrics import ServingMetrics
 from .registry import ModelEntry, ModelRegistry
 
 __all__ = ["ModelServer", "ModelRegistry", "ModelEntry", "MicroBatcher",
            "BucketedExecutor", "AdmissionController", "CircuitBreaker",
-           "ShedResult", "ServingMetrics", "bucket_sizes", "bucket_for"]
+           "ShedResult", "ServingMetrics", "bucket_sizes", "bucket_for",
+           "DriftMonitor", "DriftConfig", "export_drift_baselines",
+           "GuardedSwap", "SwapGateConfig", "SwapDecision"]
 
 
 class ModelServer:
@@ -63,7 +67,27 @@ class ModelServer:
         self.warmup_row = warmup_row
         self._executors: Dict[int, BucketedExecutor] = {}  # entry version -> executor
         self._exec_lock = threading.Lock()
+        #: optional drift monitor + guarded-swap controller (the online-
+        #: refresh loop's serving half); None keeps the hot path untouched
+        self.drift_monitor = None
+        self.guard = None
         registry.on_swap(self._on_swap)
+
+    def with_drift_monitor(self, monitor) -> "ModelServer":
+        """Feed sampled scoring traffic into a :class:`~transmogrifai_tpu.
+        serving.drift.DriftMonitor`; its snapshot joins ``/metrics``."""
+        self.drift_monitor = monitor
+        return self
+
+    def with_guard(self, guard) -> "ModelServer":
+        """Attach a :class:`~transmogrifai_tpu.serving.guarded.GuardedSwap`:
+        live traffic fills its replay window and drives bake probes, and
+        its lifecycle snapshot joins ``/metrics``.  The guard shares this
+        server's metrics object so gate/rollback counters land in the
+        same ledger."""
+        guard.metrics = self.metrics
+        self.guard = guard
+        return self
 
     # -- construction helpers ------------------------------------------------
 
@@ -142,6 +166,10 @@ class ModelServer:
     # -- execution (called by the batcher's dispatch thread) -----------------
 
     def _execute(self, rows: List[Dict[str, Any]]) -> List[Any]:
+        if self.drift_monitor is not None:
+            self.drift_monitor.observe_rows(rows)
+        if self.guard is not None:
+            self.guard.record_traffic(rows)
         entry = self.registry.get(self.name)
         executor = self._executor_for(entry)
         bucket = bucket_for(len(rows), executor.buckets) \
@@ -177,4 +205,9 @@ class ModelServer:
         snap["model"] = self.registry.get(self.name).describe() \
             if self.registry.maybe_get(self.name) else None
         snap["breakerState"] = self.breaker.state
+        if self.drift_monitor is not None:
+            snap["drift"] = self.drift_monitor.snapshot()
+        if self.guard is not None:
+            snap["guardedSwap"] = self.guard.snapshot()
+            snap["generations"] = self.registry.generations(self.name)
         return snap

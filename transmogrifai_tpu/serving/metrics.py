@@ -85,6 +85,15 @@ class ServingMetrics:
         #: /healthz, not just a counter that something happened
         self.last_fallback_reason: Optional[str] = None
         self.last_fallback_at: Optional[float] = None
+        #: guarded-swap lifecycle (serving/guarded.py): gate outcomes,
+        #: rollbacks, and the STRUCTURED reason for each — the operator
+        #: answer to "why is v7 not serving" lives here, not in logs
+        self.swaps_accepted = 0
+        self.swaps_rejected = 0
+        self.rollbacks = 0
+        self.last_swap_decision: Optional[Dict[str, Any]] = None
+        self.last_rollback_reason: Optional[str] = None
+        self.last_rollback_at: Optional[float] = None
 
     # -- recording ----------------------------------------------------------
 
@@ -142,6 +151,23 @@ class ServingMetrics:
         with self._lock:
             self.hot_swaps += 1
 
+    def record_swap_decision(self, decision: Dict[str, Any]) -> None:
+        """One guarded-swap gate outcome (serving/guarded.py SwapDecision
+        JSON): accepted candidates count as swaps, rejected ones keep the
+        structured reasons visible in /metrics."""
+        with self._lock:
+            if decision.get("accepted"):
+                self.swaps_accepted += 1
+            else:
+                self.swaps_rejected += 1
+            self.last_swap_decision = decision
+
+    def record_rollback(self, reason: str) -> None:
+        with self._lock:
+            self.rollbacks += 1
+            self.last_rollback_reason = reason
+            self.last_rollback_at = time.time()
+
     # -- reading ------------------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
@@ -176,6 +202,14 @@ class ServingMetrics:
                 "lastFallbackAgeSecs": (
                     None if self.last_fallback_at is None
                     else round(time.time() - self.last_fallback_at, 3)),
+                "swapsAccepted": self.swaps_accepted,
+                "swapsRejected": self.swaps_rejected,
+                "rollbacks": self.rollbacks,
+                "lastSwapDecision": self.last_swap_decision,
+                "lastRollbackReason": self.last_rollback_reason,
+                "lastRollbackAgeSecs": (
+                    None if self.last_rollback_at is None
+                    else round(time.time() - self.last_rollback_at, 3)),
             }
         snap["compileCache"] = cache_stats()
         return snap

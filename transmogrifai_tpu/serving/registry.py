@@ -53,13 +53,28 @@ class ModelEntry:
 
 
 class ModelRegistry:
-    """Thread-safe name -> ModelEntry map with versioned atomic swaps."""
+    """Thread-safe name -> ModelEntry map with versioned atomic swaps.
 
-    def __init__(self):
+    Beyond the current entry, the registry retains a bounded GENERATION
+    history per name (``max_generations`` slots, oldest-first eviction)
+    so a guarded swap (serving/guarded.py) can pin the last-known-good
+    generation and roll back to it.  Eviction NEVER drops the pinned
+    generation or the current one — the rollback target must survive any
+    amount of swap churn (the whole point of pinning).
+    """
+
+    def __init__(self, max_generations: int = 4):
+        if max_generations < 1:
+            raise ValueError("max_generations must be >= 1")
         self._lock = threading.Lock()
         self._entries: Dict[str, ModelEntry] = {}
         self._versions: Dict[str, int] = {}
         self._swap_listeners: List[Callable[[ModelEntry], None]] = []
+        self.max_generations = int(max_generations)
+        #: name -> {version: entry}, oldest-insertion-first
+        self._history: Dict[str, Dict[int, ModelEntry]] = {}
+        #: name -> pinned (last-known-good) version
+        self._pinned: Dict[str, int] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -92,6 +107,9 @@ class ModelRegistry:
                 return current
             swapped = current is not None
             self._entries[name] = entry
+            hist = self._history.setdefault(name, {})
+            hist[entry.version] = entry
+            self._evict_generations(name)
             listeners = list(self._swap_listeners)
         if swapped:
             for fn in listeners:
@@ -101,11 +119,100 @@ class ModelRegistry:
                     pass
         return entry
 
+    def _evict_generations(self, name: str) -> None:
+        """Slot-based eviction of stale generations (lock held).  The
+        CURRENT entry and the PINNED last-known-good generation are never
+        eviction candidates — dropping the rollback target under swap
+        churn was the bug this guard pins (regression-tested)."""
+        hist = self._history.get(name)
+        if hist is None:
+            return
+        current = self._entries.get(name)
+        protected = {self._pinned.get(name)}
+        if current is not None:
+            protected.add(current.version)
+        for version in sorted(hist):
+            if len(hist) <= self.max_generations:
+                break
+            if version in protected:
+                continue
+            del hist[version]
+
     def evict(self, name: str) -> bool:
-        """Drop ``name`` from the registry; in-flight batches holding the
-        entry finish unaffected.  Returns True if something was evicted."""
+        """Drop ``name`` (ALL generations, pin included) from the
+        registry; in-flight batches holding an entry finish unaffected.
+        Returns True if something was evicted."""
         with self._lock:
+            self._history.pop(name, None)
+            self._pinned.pop(name, None)
             return self._entries.pop(name, None) is not None
+
+    # -- generations / pinning ----------------------------------------------
+
+    def pin(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        """Pin a generation (default: the current one) as last-known-good:
+        it survives generation eviction and is the ``rollback`` target."""
+        with self._lock:
+            if version is None:
+                current = self._entries.get(name)
+                if current is None:
+                    raise KeyError(f"no model {name!r} to pin")
+                version = current.version
+            entry = self._history.get(name, {}).get(version)
+            if entry is None:
+                raise KeyError(f"no generation v{version} of {name!r} "
+                               f"in the registry history")
+            self._pinned[name] = version
+            return entry
+
+    def unpin(self, name: str) -> None:
+        with self._lock:
+            self._pinned.pop(name, None)
+            self._evict_generations(name)
+
+    def pinned(self, name: str) -> Optional[ModelEntry]:
+        with self._lock:
+            version = self._pinned.get(name)
+            if version is None:
+                return None
+            return self._history.get(name, {}).get(version)
+
+    def generations(self, name: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            hist = list(self._history.get(name, {}).values())
+            pinned = self._pinned.get(name)
+            current = self._entries.get(name)
+        out = []
+        for e in hist:
+            rec = e.describe()
+            rec["pinned"] = e.version == pinned
+            rec["current"] = current is not None and \
+                e.version == current.version
+            out.append(rec)
+        return out
+
+    def rollback(self, name: str) -> ModelEntry:
+        """Atomically reinstate the pinned last-known-good generation as
+        the current entry (its original version id is kept — rollbacks
+        are visible in the version sequence).  Swap listeners fire, so a
+        server re-warms the restored generation's buckets exactly like a
+        forward swap."""
+        with self._lock:
+            version = self._pinned.get(name)
+            if version is None:
+                raise KeyError(f"no pinned generation for {name!r}")
+            entry = self._history.get(name, {}).get(version)
+            if entry is None:  # pragma: no cover - pin protects eviction
+                raise KeyError(f"pinned generation v{version} of {name!r} "
+                               f"is gone")
+            self._entries[name] = entry
+            listeners = list(self._swap_listeners)
+        for fn in listeners:
+            try:
+                fn(entry)
+            except Exception:  # listeners must not break the rollback
+                pass
+        return entry
 
     # -- resolution ---------------------------------------------------------
 

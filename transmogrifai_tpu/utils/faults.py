@@ -32,6 +32,20 @@ no plan is armed):
   ``device.loss``        same site — a ``device_loss`` action here
                          exercises the elastic shrink/retry/quarantine
                          ladder (parallel/elastic.py)
+  ``drift.window``       at every drift-window evaluation
+                         (serving/drift.DriftMonitor.evaluate); ``index``
+                         is the window ordinal — a ``raise`` here
+                         exercises a monitor that cannot evaluate
+  ``swap.shadow``        at every guarded-swap shadow evaluation
+                         (serving/guarded.GuardedSwap.propose); ``index``
+                         is the proposal ordinal — a ``raise`` here lands
+                         as a structured gate REJECTION
+                         (``shadow_error:FaultError``), never a swap
+  ``swap.bake``          at every post-swap bake probe
+                         (serving/guarded.GuardedSwap.bake_probe);
+                         ``index`` is the probe ordinal — a ``raise``
+                         here triggers the automatic ROLLBACK to the
+                         pinned generation (``probe_error:FaultError``)
 
 Actions: ``io_error`` (raise OSError — the transient class the reader
 retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``

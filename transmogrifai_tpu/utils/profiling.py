@@ -192,6 +192,9 @@ class RunCounters:
     #: ElasticCounters here): retries / mesh_shrinks / mesh_repacks /
     #: quarantined / watchdog_fires / device_losses
     elastic: Dict[str, int] = field(default_factory=dict)
+    #: warm-start refresh accounting (workflow/refresh.py RefreshContext):
+    #: merged / refit / invalidated / geometry_changed estimator counts
+    refresh: Dict[str, int] = field(default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -206,6 +209,7 @@ class RunCounters:
             "launches": self.launches,
             "launchTags": dict(self.launch_tags),
             "elastic": dict(self.elastic),
+            "refresh": dict(self.refresh),
         }
 
 
@@ -246,6 +250,22 @@ def count_elastic(kind: str, n: int = 1) -> None:
     watchdog_fires / ...) — the process-wide mirror of the per-sweep
     ``parallel.elastic.ElasticCounters``, read by the bench scripts."""
     COUNTERS.elastic[kind] = COUNTERS.elastic.get(kind, 0) + n
+
+
+def count_refresh(kind: str, n: int = 1) -> None:
+    """Warm-start refresh event (merged / refit / invalidated /
+    geometry_changed) — the process-wide mirror of the per-run
+    ``workflow.refresh.RefreshReport``, read by the bench scripts."""
+    COUNTERS.refresh[kind] = COUNTERS.refresh.get(kind, 0) + n
+
+
+def refresh_snapshot() -> Dict[str, int]:
+    """The run's refresh counters with every key present (zeros when no
+    refresh ran) — the shape ``benchmarks/refresh_latest.json`` records."""
+    base = {"merged": 0, "refit": 0, "invalidated": 0,
+            "geometry_changed": 0}
+    base.update(COUNTERS.refresh)
+    return base
 
 
 def elastic_snapshot() -> Dict[str, int]:

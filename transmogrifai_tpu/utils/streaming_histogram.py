@@ -140,3 +140,33 @@ class StreamingHistogram:
         h.centroids = np.asarray(d["centroids"], np.float64)
         h.counts = np.asarray(d["counts"], np.float64)
         return h
+
+    # -- checkpoint codec hooks (workflow/checkpoint.py) --------------------
+
+    def to_state(self) -> dict:
+        """Loss-free snapshot: centroids/counts persist as float64 arrays
+        (npz externalization), so a resumed fit's bins are bit-identical."""
+        return {"max_bins": self.max_bins,
+                "centroids": self.centroids, "counts": self.counts}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "StreamingHistogram":
+        h = cls(int(state["max_bins"]))
+        h.centroids = np.asarray(state["centroids"], np.float64)
+        h.counts = np.asarray(state["counts"], np.float64)
+        return h
+
+    @classmethod
+    def from_value_counts(cls, values, counts,
+                          max_bins: int = 32) -> "StreamingHistogram":
+        """Build from exact (value, count) pairs (the mode-count fitters'
+        states) — bins are the values themselves, shrunk to the budget."""
+        h = cls(max_bins)
+        v = np.asarray(values, np.float64)
+        c = np.asarray(counts, np.float64)
+        finite = np.isfinite(v)
+        v, c = v[finite], c[finite]
+        order = np.argsort(v, kind="stable")
+        h.centroids, h.counts = v[order], c[order]
+        h._shrink()
+        return h

@@ -132,9 +132,11 @@ def _stateful_types() -> Dict[str, type]:
     (lazy: vectorizers import jax-adjacent modules)."""
     from ..ops.vectorizers import TextStats
     from ..utils.sketches import PearsonSketch, TopKSketch, WelfordMoments
+    from ..utils.streaming_histogram import StreamingHistogram
 
     return {"WelfordMoments": WelfordMoments, "PearsonSketch": PearsonSketch,
-            "TopKSketch": TopKSketch, "TextStats": TextStats}
+            "TopKSketch": TopKSketch, "TextStats": TextStats,
+            "StreamingHistogram": StreamingHistogram}
 
 
 def encode_fit_state(value: Any, key: str, store: _ArrayStore) -> Any:

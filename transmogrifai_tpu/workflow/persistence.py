@@ -271,6 +271,17 @@ def save_workflow_model(model, path: str, overwrite: bool = True) -> None:
         "rawFeatureFilterResults": (rff.to_json() if hasattr(rff, "to_json")
                                     else rff),
     }
+    fit_states = getattr(model, "fit_states", None)
+    if fit_states:
+        # exported streaming fit states (the warm-start capital a later
+        # OpWorkflow.refresh merges new data into) persist through the
+        # checkpoint codec — sketches via to_state hooks, ndarrays into
+        # the same arrays.npz store as the stage params
+        from .checkpoint import encode_fit_state
+
+        doc["fitStates"] = {
+            uid: encode_fit_state(payload, f"fitstate.{uid}", store)
+            for uid, payload in fit_states.items()}
     from ..utils.jsonio import write_json_atomic
 
     # atomic (tmp + os.replace): a kill mid-save can never leave a
@@ -312,4 +323,10 @@ def load_workflow_model(path: str):
     result = [features[n] for n in doc["resultFeatures"]]
     model = OpWorkflowModel(result_features=result, stages=stages)
     model.raw_feature_filter_results = doc.get("rawFeatureFilterResults")
+    if doc.get("fitStates"):
+        from .checkpoint import decode_fit_state
+
+        model.fit_states = {
+            uid: decode_fit_state(rec, arrays)
+            for uid, rec in doc["fitStates"].items()}
     return model

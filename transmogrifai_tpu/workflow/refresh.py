@@ -1,0 +1,198 @@
+"""Warm-start refresh — partial_fit a trained workflow from new data.
+
+ROADMAP item 4's missing 10%: the streaming-fit protocol (PR 3) made the
+hot fitters mergeable monoids and the streaming driver now exports each
+estimator's FINAL state onto the trained model (``model.fit_states``,
+persisted with it).  A refresh restores those states and updates them
+with new chunks only — ``merge(restored_state, fit_state(new_chunks))``
+— so the refreshed model is (within each stage's declared
+``streaming_fit_tol``; contract TM027) the model a full streaming
+retrain over old+new would produce, at the cost of reading only the new
+window.  Non-mergeable tails (e.g. a ModelSelector) refit in-core on the
+materialized refresh window.
+
+Feature-geometry guard: a restored downstream state is only valid while
+its upstream transforms kept their geometry (same vocab slots, same kept
+indices).  ``RefreshContext`` tracks a structural signature per refreshed
+model; when new data rotates a vocab or flips a keep decision, every
+downstream restored state is invalidated and those estimators refit from
+the refresh window alone — counted and reported, never silently wrong.
+The guarded swap (serving/guarded.py) remains the deployment backstop
+either way.
+
+Checkpointing: a refresh reuses ``StreamingCheckpointManager`` with the
+fingerprint extended by the base model's identity, so a SIGKILLed
+refresh resumes mid-pass instead of restarting — and a refresh
+checkpoint can never resume into a plain train.
+"""
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Set
+
+from ..stages.base import Estimator, PipelineStage
+
+__all__ = ["RefreshContext", "RefreshReport", "geometry_signature"]
+
+
+def align_vocab_order(old: PipelineStage, new: PipelineStage) -> None:
+    """Pin slot ORDER across a refresh: when a merged pivot fit produced
+    the same category SET as the old model but rotated its order (counts
+    shifting between near-tied categories is sampling noise, not
+    geometry), reuse the old slot order — downstream sketches accumulated
+    per slot stay mergeable.  A genuine set change (a category entering
+    or leaving the top-k) is left alone and shows up as a geometry
+    change."""
+    ov, nv = getattr(old, "vocabs", None), getattr(new, "vocabs", None)
+    if ov is None or nv is None or len(ov) != len(nv):
+        return
+    if getattr(old, "strategies", None) != getattr(new, "strategies", None):
+        return
+    new.vocabs = [list(o) if set(o) == set(n) else list(n)
+                  for o, n in zip(ov, nv)]
+
+
+def geometry_signature(model: PipelineStage) -> Optional[str]:
+    """Structural signature of a fitted model's OUTPUT feature space.
+
+    Two models with equal signatures emit columns whose slots mean the
+    same thing, so a downstream sketch accumulated under one remains
+    mergeable under the other.  ``None`` = no declared geometry (treated
+    as stable; value-only params like fills shift the numbers, not the
+    slots).
+    """
+    sig: Dict[str, Any] = {}
+    vocabs = getattr(model, "vocabs", None)
+    if vocabs is not None:
+        sig["vocabs"] = [[str(v) for v in vocab] for vocab in vocabs]
+    strategies = getattr(model, "strategies", None)
+    if strategies is not None:
+        sig["strategies"] = list(strategies)
+    keep = getattr(model, "keep_indices", None)
+    if keep is not None:
+        sig["keep_indices"] = [int(i) for i in keep]
+    fills = getattr(model, "fills", None)
+    if fills is not None:
+        sig["n_fills"] = len(fills)
+    if not sig:
+        return None
+    return json.dumps(sig, sort_keys=True)
+
+
+class RefreshReport:
+    """What the refresh actually did, per estimator uid."""
+
+    def __init__(self):
+        self.merged: List[str] = []          # warm-started from state
+        self.refit: List[str] = []           # no state: fit from new data
+        self.invalidated: List[str] = []     # upstream geometry changed
+        self.geometry_changed: List[str] = []
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"merged": sorted(self.merged),
+                "refit": sorted(self.refit),
+                "invalidated": sorted(self.invalidated),
+                "geometryChanged": sorted(self.geometry_changed)}
+
+
+class RefreshContext:
+    """Warm-start state broker for one refresh run.
+
+    The streaming driver asks it for each estimator's initial state
+    (``initial_state``) and reports each finished model back
+    (``note_finished``) so geometry changes propagate to downstream
+    seeding decisions — layers finish strictly before later layers'
+    states are created, so the ordering is safe by construction.
+    """
+
+    def __init__(self, model, dag):
+        from ..utils.profiling import count_refresh
+
+        self._count = count_refresh
+        self.states: Dict[str, Any] = dict(getattr(model, "fit_states",
+                                                   None) or {})
+        self.old_models: Dict[str, PipelineStage] = {
+            s.uid: s for s in model.stages}
+        self.report = RefreshReport()
+        self._changed: Set[str] = set()
+        self._ancestors = self._estimator_ancestors(dag)
+
+    @staticmethod
+    def _estimator_ancestors(dag) -> Dict[str, Set[str]]:
+        """uid -> transitive ESTIMATOR-ancestor uids (via input features'
+        origin stages)."""
+        memo: Dict[str, Set[str]] = {}
+
+        def walk(stage) -> Set[str]:
+            got = memo.get(stage.uid)
+            if got is not None:
+                return got
+            memo[stage.uid] = set()  # cycle guard (DAGs have none)
+            anc: Set[str] = set()
+            for f in stage.input_features:
+                parent = f.origin_stage
+                if parent is None:
+                    continue
+                if isinstance(parent, Estimator):
+                    anc.add(parent.uid)
+                anc |= walk(parent)
+            memo[stage.uid] = anc
+            return anc
+
+        for layer in dag.layers:
+            for s in layer:
+                walk(s)
+        return memo
+
+    def base_digest(self) -> Dict[str, Any]:
+        """Checkpoint-fingerprint extension identifying the base model —
+        a refresh checkpoint only resumes into a refresh of the SAME
+        model (state uids + a digest of their geometry)."""
+        sigs = {uid: geometry_signature(m) or ""
+                for uid, m in sorted(self.old_models.items())}
+        digest = hashlib.sha256(
+            json.dumps(sigs, sort_keys=True).encode()).hexdigest()[:16]
+        return {"refresh": {"stateUids": sorted(self.states),
+                            "baseGeometry": digest}}
+
+    # -- driver hooks --------------------------------------------------------
+
+    def initial_state(self, est: Estimator):
+        """The restored warm-start state for ``est``, or None when it must
+        fit fresh (no exported state, invalidated upstream geometry, or a
+        state the estimator can no longer import)."""
+        payload = self.states.get(est.uid)
+        if payload is None:
+            self.report.refit.append(est.uid)
+            self._count("refit")
+            return None
+        if self._ancestors.get(est.uid, set()) & self._changed:
+            self.report.invalidated.append(est.uid)
+            self._count("invalidated")
+            return None
+        try:
+            # DEEP COPY before import: the default import hook is a
+            # passthrough, and update_chunk folds in place — without the
+            # copy a refresh would contaminate the base model's stored
+            # states (breaking reruns, resume parity, and chained
+            # refreshes from the same base)
+            state = est.import_fit_state(copy.deepcopy(payload))
+        except Exception:
+            self.report.invalidated.append(est.uid)
+            self._count("invalidated")
+            return None
+        self.report.merged.append(est.uid)
+        self._count("merged")
+        return state
+
+    def note_finished(self, est: Estimator, new_model) -> None:
+        old = self.old_models.get(est.uid)
+        if old is None:
+            return
+        align_vocab_order(old, new_model)
+        if geometry_signature(old) != geometry_signature(new_model):
+            self._changed.add(est.uid)
+            self.report.geometry_changed.append(est.uid)
+            self._count("geometry_changed")

@@ -381,10 +381,27 @@ def fit_dag_streaming(
     retain_mb: Optional[float] = None,
     shard_onto=None,
     shard_columns: Optional[Sequence[str]] = None,
-) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler]:
+    refresh_ctx=None,
+    fingerprint_extra: Optional[Dict] = None,
+) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler,
+           Dict[str, object]]:
     """Fit ``dag`` from chunked ingestion; returns (fitted stages in topo
     order, final dataset equivalent to the in-core executor's with the
-    same ``keep``, ingest counters).
+    same ``keep``, ingest counters, exported final fit states by uid).
+
+    The returned FIT STATES are each streamed estimator's final mergeable
+    state through its ``export_fit_state`` hook — the warm-start capital
+    a later ``OpWorkflow.refresh`` resumes from (they ride on the model
+    as ``fit_states`` and persist with it).
+
+    ``refresh_ctx`` (a ``workflow.refresh.RefreshContext``) turns this
+    run into a WARM-START refresh: estimators whose restored state is
+    still valid begin from it (so chunks here are a partial_fit on top of
+    the original training data), and geometry changes invalidate
+    downstream restored states (those estimators refit from this reader
+    alone).  ``fingerprint_extra`` extends the checkpoint fingerprint so
+    a refresh checkpoint can never resume into a plain train (or a
+    refresh of a different base model).
 
     ``checkpoint_dir`` enables chunk-level checkpoint/resume: pure fit
     passes persist their mergeable states every ``checkpoint_every``
@@ -415,9 +432,12 @@ def fit_dag_streaming(
         from .checkpoint import (StreamingCheckpointManager,
                                  compute_fingerprint)
 
+        fingerprint = compute_fingerprint(reader, raw_features, layers,
+                                          chunk_rows)
+        if fingerprint_extra:
+            fingerprint = {**fingerprint, **fingerprint_extra}
         manager = StreamingCheckpointManager(
-            checkpoint_dir,
-            compute_fingerprint(reader, raw_features, layers, chunk_rows),
+            checkpoint_dir, fingerprint,
             every_chunks=checkpoint_every)
         resume = manager.load()
         if resume is not None:
@@ -533,6 +553,18 @@ def fit_dag_streaming(
             stage_wall[est.uid] = (stage_wall.get(est.uid, 0.0)
                                    + time.perf_counter() - t0)
 
+    def init_states(ests) -> Dict[str, object]:
+        """Fresh streaming states — or, under a refresh context, the
+        restored warm-start states where still valid."""
+        out: Dict[str, object] = {}
+        for est in ests:
+            state = (refresh_ctx.initial_state(est)
+                     if refresh_ctx is not None else None)
+            out[est.uid] = state if state is not None else est.begin_fit()
+        return out
+
+    final_states: Dict[str, object] = {}
+
     def finish_layer(ests, states) -> None:
         for est in ests:
             t0 = time.perf_counter()
@@ -542,6 +574,10 @@ def fit_dag_streaming(
             est._record_fit_wall(coll, stage_wall[est.uid])
             fitted_by_uid[est.uid] = model
             stage_kind[est.uid] = "fit-stream"
+            # final mergeable state -> warm-start capital for refresh
+            final_states[est.uid] = est.export_fit_state(states[est.uid])
+            if refresh_ctx is not None:
+                refresh_ctx.note_finished(est, model)
 
     def layer_ests(li: int) -> List[Estimator]:
         return [s for s in prefix[li]
@@ -628,7 +664,7 @@ def fit_dag_streaming(
             pass_uids = _closure(sorted(target_inputs), out_stage)
             ordered = [s for lj in range(li) for s in prefix[lj]
                        if s.uid in pass_uids]
-            states = {est.uid: est.begin_fit() for est in ests}
+            states = init_states(ests)
             skip = 0
             if (resume is not None and resume.current is not None
                     and int(resume.current["pass"]) == pass_idx):
@@ -693,7 +729,7 @@ def fit_dag_streaming(
         run_stages = [s for layer in prefix for s in layer
                       if s.uid in needed_uids and s.uid not in chain_uids
                       and s.uid not in fuse_uids]
-        states = {est.uid: est.begin_fit() for est in fuse_ests}
+        states = init_states(fuse_ests)
         store = _BlockStore(_retain_budget_bytes(retain_mb))
 
         def feed_and_capture(ds: ColumnarDataset, _idx: int) -> None:
@@ -756,8 +792,7 @@ def fit_dag_streaming(
                              & {s.get_output().name for s in segment})
                 needed_after = _liveness(
                     segment, seg_inputs | retain_cols | seg_write)
-                seg_states = {est.uid: est.begin_fit()
-                              for est in seg_ests}
+                seg_states = init_states(seg_ests)
                 apass = ingest.begin_pass(
                     "assemble" if not seg_ests else
                     "fit-blocks[layer "
@@ -887,4 +922,4 @@ def fit_dag_streaming(
         # success: a finished train's checkpoint must not resurrect into
         # the next run in the same directory
         manager.finish()
-    return fitted, data, ingest
+    return fitted, data, ingest, final_states

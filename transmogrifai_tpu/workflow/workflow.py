@@ -432,7 +432,7 @@ class OpWorkflow(_WorkflowCore):
         profiler = PlanProfiler()
         try:
             with with_job_group(OpStep.FeatureEngineering):
-                fitted, transformed, ingest = fit_dag_streaming(
+                fitted, transformed, ingest, fit_states = fit_dag_streaming(
                     dag, self.reader, self.raw_features(), chunk_rows,
                     keep=self._train_keep_columns(),
                     fitted_substitutes=dict(self._model_stages),
@@ -452,6 +452,7 @@ class OpWorkflow(_WorkflowCore):
         model.reader = self.reader
         model.train_profile = profiler if profile else None
         model.ingest_profile = ingest
+        model.fit_states = fit_states
         model.lint_snapshot = lint_snap
         profiler.lint = lint_snap
         from ..models.trees import clear_sweep_caches
@@ -459,6 +460,81 @@ class OpWorkflow(_WorkflowCore):
         from ..tuning.costmodel import record_train_observations
         record_train_observations(profiler)
         return model
+
+    def refresh(self, model: "OpWorkflowModel", data=None,
+                chunk_rows: int = 512, prefetch_chunks: int = 2,
+                profile: bool = False,
+                checkpoint_dir: Optional[str] = None,
+                checkpoint_every_chunks: int = 16) -> "OpWorkflowModel":
+        """Warm-start refresh: partial_fit ``model`` from NEW data only.
+
+        Every ``supports_streaming_fit`` estimator whose exported fit
+        state rides on ``model`` (``fit_states`` — chunked trains and
+        refreshes record them) resumes from that state and merges the
+        new chunks via the streaming-fit protocol, so the result matches
+        a full streaming retrain over old+new within each stage's
+        declared ``streaming_fit_tol`` (contract TM027) while reading
+        only the refresh window.  Estimators without a state — or whose
+        upstream feature GEOMETRY changed (vocab rotation, keep-decision
+        flip; see workflow/refresh.py) — refit from the new data alone,
+        and non-streamable tails refit in-core on the materialized
+        window; the returned model's ``refresh_report`` says which path
+        each estimator took.
+
+        ``data`` defaults to this workflow's reader (point either at the
+        new window).  ``checkpoint_dir`` reuses the streaming checkpoint
+        manager with a refresh-scoped fingerprint: a SIGKILLed refresh
+        resumes mid-pass, and a refresh checkpoint can never resume into
+        a plain train or a refresh of a different base model.
+
+        The refreshed model carries freshly merged ``fit_states`` —
+        refreshes chain.  Deployment belongs behind the guarded swap
+        (``serving.GuardedSwap``): a refresh is a CANDIDATE, not a
+        rollout.
+        """
+        from ..utils.profiling import OpStep, PlanProfiler, with_job_group
+        from .refresh import RefreshContext
+        from .streaming import fit_dag_streaming
+
+        if data is not None:
+            self.set_input_data(data)
+        if self.reader is None:
+            raise RuntimeError(
+                "no refresh data — pass data= or set a reader")
+        if self._raw_feature_filter is not None or self._workflow_cv:
+            raise ValueError(
+                "refresh is not supported with RawFeatureFilter or "
+                "workflow-level CV (the same limits as chunked training)")
+        dag = compute_dag(self.result_features)
+        self._validate_stages(dag)
+        lint_snap = self._lint_dag(dag)
+        self._inject_params(dag)
+        ctx = RefreshContext(model, dag)
+        profiler = PlanProfiler()
+        with with_job_group(OpStep.FeatureEngineering):
+            fitted, transformed, ingest, fit_states = fit_dag_streaming(
+                dag, self.reader, self.raw_features(), chunk_rows,
+                keep=self._train_keep_columns(),
+                profiler=profiler, prefetch=prefetch_chunks,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every_chunks,
+                refresh_ctx=ctx, fingerprint_extra=ctx.base_digest())
+        refreshed = OpWorkflowModel(
+            result_features=self.result_features,
+            stages=fitted,
+            train_data=transformed,
+        )
+        refreshed.reader = self.reader
+        refreshed.train_profile = profiler if profile else None
+        refreshed.ingest_profile = ingest
+        refreshed.fit_states = fit_states
+        refreshed.refresh_report = ctx.report.to_json()
+        refreshed.lint_snapshot = lint_snap
+        from ..models.trees import clear_sweep_caches
+        clear_sweep_caches()
+        from ..tuning.costmodel import record_train_observations
+        record_train_observations(profiler)
+        return refreshed
 
     def _train_inner(self, data, dag, filter_results,
                      profile: bool = False) -> "OpWorkflowModel":
@@ -552,6 +628,12 @@ class OpWorkflowModel(_WorkflowCore):
         self.ingest_profile = None
         #: LintSnapshot from ``OpWorkflow.train(validate=True)`` else None
         self.lint_snapshot = None
+        #: exported streaming fit states by estimator uid (the warm-start
+        #: capital ``OpWorkflow.refresh`` resumes from) — populated by
+        #: chunked trains and refreshes, persisted with the model
+        self.fit_states: Optional[Dict[str, Any]] = None
+        #: RefreshReport JSON when this model came from a refresh
+        self.refresh_report: Optional[Dict[str, Any]] = None
         self._scoring_dag_memo: Optional[StagesDAG] = None
 
     def _scoring_dag(self) -> StagesDAG:
