@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Tree fast-path benchmark — ISSUE 11's measurement harness.
+
+Measures the three legs of the tree fast path on a transmogrify-shaped
+(one-hot-heavy) matrix and records them to
+``benchmarks/trees_latest.json`` (atomically):
+
+1. **Depth walls** — a boosted fit at depth 6 and depth 10 with the fast
+   path OFF (``TMOG_EFB=0 TMOG_GOSS=0``) vs ON, same seed, with the
+   holdout AuPR next to each wall so "faster" is always "at equal
+   quality".  (On CPU the EFB width cut is the dominant term; on
+   accelerators GOSS's row cut and the bf16 histogram stream compound.)
+2. **EFB width reduction** — the bundled histogram width ratio the
+   greedy packer achieves on the matrix.
+3. **Batched vs sequential tree sweep at 8 virtual devices** — the SAME
+   RF+GBT candidate grid once as batched tree grid groups on the
+   ("data", "grid") sweep mesh and once as the old sequential
+   mesh-sharded per-candidate fits, with winner/metric parity asserted
+   (documented 2e-2) and the wall ratio recorded.
+
+Under ``TMOG_CHECK=1`` the SPMD runtime contracts also run on the tree
+group (TM024 pad-invariance, TM025 mesh parity) plus the TM028
+bf16-accumulation tolerance probe — findings gate the exit code.
+
+Usage: python examples/bench_trees.py [--rows N] [--cols D] [--smoke]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+# force 8 host (CPU) devices BEFORE jax imports — inert on real multichip
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+OUT_PATH = os.path.join(_ROOT, "benchmarks", "trees_latest.json")
+
+
+def make_data(rows: int, cols: int, seed: int = 11):
+    """Dense numerics + mutually exclusive one-hot blocks — the matrix
+    shape transmogrify() emits and EFB targets.  ~80% of the columns are
+    indicator columns."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_dense = max(2, cols // 5)
+    card = 8
+    n_groups = max(1, (cols - n_dense) // card)
+    cats = rng.integers(0, card, size=(rows, n_groups))
+    oh = np.zeros((rows, n_groups * card), np.float32)
+    for i in range(n_groups):
+        oh[np.arange(rows), i * card + cats[:, i]] = 1.0
+    dn = rng.normal(size=(rows, n_dense)).astype(np.float32)
+    X = np.concatenate([dn, oh], axis=1)
+    z = (dn[:, 0] + (cats[:, 0] == 3) - (cats[:, min(1, n_groups - 1)] == 5)
+         + 0.5 * rng.normal(size=rows))
+    y = (z > 0).astype(np.float32)
+    return X, y
+
+
+def _fit_wall(X, y, depth: int, rounds: int, fast: bool, seed: int = 3):
+    """One boosted fit's wall + holdout AuPR with the fast path toggled."""
+    import numpy as np
+
+    from transmogrifai_tpu.evaluators.metrics import aupr
+    from transmogrifai_tpu.models.trees import (
+        OpGBTClassifier, clear_sweep_caches,
+    )
+
+    os.environ["TMOG_EFB"] = "auto" if fast else "0"
+    os.environ["TMOG_GOSS"] = "auto" if fast else "0"
+    clear_sweep_caches()
+    n = len(y)
+    cut = int(0.8 * n)
+    # warmup: max_iter=1 compiles the SAME es_chunk-round scan program
+    # (and fills the sketch/binning/EFB memos), so the timed fit measures
+    # steady-state growth, not XLA compile — both arms get the same
+    # treatment
+    OpGBTClassifier(max_iter=1, max_depth=depth,
+                    seed=seed).fit_raw(X[:cut], y[:cut])
+    t0 = time.perf_counter()
+    m = OpGBTClassifier(max_iter=rounds, max_depth=depth,
+                        seed=seed).fit_raw(X[:cut], y[:cut])
+    p = np.asarray(m.predict_batch(X[cut:]).probability[:, 1])
+    wall = time.perf_counter() - t0
+    return wall, float(aupr(y[cut:], p))
+
+
+def measure_depth_walls(X, y, rounds: int):
+    out = {}
+    for depth in (6, 10):
+        off_w, off_a = _fit_wall(X, y, depth, rounds, fast=False)
+        on_w, on_a = _fit_wall(X, y, depth, rounds, fast=True)
+        out[str(depth)] = {
+            "off_s": round(off_w, 3), "on_s": round(on_w, 3),
+            "ratio": round(off_w / max(on_w, 1e-9), 3),
+            "aupr_off": round(off_a, 4), "aupr_on": round(on_a, 4),
+        }
+        print(f"depth {depth}: off {off_w:.2f}s (AuPR {off_a:.4f}) vs "
+              f"on {on_w:.2f}s (AuPR {on_a:.4f}) -> "
+              f"{off_w / max(on_w, 1e-9):.2f}x")
+    for v in ("TMOG_EFB", "TMOG_GOSS"):
+        os.environ.pop(v, None)
+    return out
+
+
+def measure_efb_width(X):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from transmogrifai_tpu.models.gbdt_kernels import (
+        apply_bins, bundle_features, quantile_bins_sparse_aware,
+    )
+
+    edges = quantile_bins_sparse_aware(np.asarray(X, np.float32), 32)
+    binned = np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges)),
+                        np.int8)
+    b = bundle_features(binned, edges, 32)
+    if b is None:
+        return {"width_orig": X.shape[1], "width_bundled": X.shape[1],
+                "ratio": 1.0}
+    print(f"EFB: {b.n_orig} -> {b.width} histogram columns "
+          f"({b.width_ratio:.2f}x)")
+    return {"width_orig": b.n_orig, "width_bundled": b.width,
+            "ratio": round(b.width_ratio, 3)}
+
+
+def _fold_ctxs(n, seed=3):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    f = rng.integers(0, 2, n)
+    return [((f != k).astype(np.float32), (f == k).astype(np.float32))
+            for k in range(2)]
+
+
+def measure_tree_sweep(X, y, n_trees: int, rounds: int):
+    """Batched tree grid groups on the sweep mesh vs the sequential
+    mesh-sharded per-candidate fits — same candidates, same mesh."""
+    import numpy as np
+
+    from transmogrifai_tpu.evaluators.metrics import aupr
+    from transmogrifai_tpu.models.trees import (
+        OpGBTClassifier, OpRandomForestClassifier, clear_sweep_caches,
+    )
+    from transmogrifai_tpu.parallel.mesh import make_sweep_mesh
+    from transmogrifai_tpu.selector.grid_groups import (
+        GBTGridGroup, RFGridGroup,
+    )
+
+    n = len(y)
+    ctxs = _fold_ctxs(n)
+    mesh = make_sweep_mesh(4, n_devices=8)
+    rf_proto = OpRandomForestClassifier(num_trees=n_trees, seed=3)
+    rf_pts = [{"max_depth": 3}, {"max_depth": 5}]
+    gbt_proto = OpGBTClassifier(max_iter=rounds, seed=3)
+    gbt_pts = [{"max_depth": 3}, {"max_depth": 4}]
+
+    # batched: both families packed onto the grid axis
+    clear_sweep_caches()
+    t0 = time.perf_counter()
+    M_rf = np.asarray(RFGridGroup(rf_proto, rf_pts, "AuPR")
+                      .with_mesh(mesh).run(X, y, ctxs), np.float64)
+    M_gbt = np.asarray(GBTGridGroup(gbt_proto, gbt_pts, "AuPR")
+                       .with_mesh(mesh).run(X, y, ctxs), np.float64)
+    batched_s = time.perf_counter() - t0
+    batched = np.concatenate([M_rf, M_gbt])
+
+    # sequential: one mesh-sharded fit per (candidate, fold) — what every
+    # tree unit paid before PR 11
+    clear_sweep_caches()
+    t0 = time.perf_counter()
+    seq_rows = []
+    for proto, pts in ((rf_proto, rf_pts), (gbt_proto, gbt_pts)):
+        for p in pts:
+            vals = []
+            for w_tr, w_ev in ctxs:
+                est = proto.copy(**p).with_mesh(mesh)
+                model = est.fit_raw(X, y, w_tr)
+                s = np.asarray(model.score_device(X, "binary"))
+                vals.append(float(aupr(y, s, w_ev)))
+            seq_rows.append(vals)
+    sequential_s = time.perf_counter() - t0
+    sequential = np.asarray(seq_rows, np.float64)
+
+    parity_ok = bool(np.allclose(batched, sequential, atol=2e-2))
+    winner_ok = bool(int(batched.mean(axis=1).argmax())
+                     == int(sequential.mean(axis=1).argmax()))
+    ratio = sequential_s / max(batched_s, 1e-9)
+    print(f"tree sweep @8dev: batched {batched_s:.2f}s vs sequential "
+          f"{sequential_s:.2f}s -> {ratio:.2f}x (parity_ok={parity_ok})")
+    return {"batched_s": round(batched_s, 3),
+            "sequential_s": round(sequential_s, 3),
+            "ratio": round(ratio, 3),
+            "parity_ok": parity_ok, "winner_ok": winner_ok,
+            "max_metric_delta": round(
+                float(np.abs(batched - sequential).max()), 5)}
+
+
+def run_contracts(X, y):
+    """TMOG_CHECK leg: TM024/TM025 on the GBT tree group + TM028."""
+    from transmogrifai_tpu.analysis.contracts import (
+        check_accum_tolerance, check_mesh_parity, check_pad_invariance,
+    )
+    from transmogrifai_tpu.models.trees import (
+        OpGBTClassifier, clear_sweep_caches,
+    )
+    from transmogrifai_tpu.parallel.mesh import make_sweep_mesh
+    from transmogrifai_tpu.selector.grid_groups import GBTGridGroup
+
+    n = len(y)
+    ctxs = _fold_ctxs(n)
+    mesh = make_sweep_mesh(4, n_devices=8)
+    proto = OpGBTClassifier(max_iter=4, seed=3)
+
+    def make_group():
+        clear_sweep_caches()
+        return GBTGridGroup(proto, [{"max_depth": 3}, {"max_depth": 4}],
+                            "AuPR")
+
+    findings = check_pad_invariance(make_group, X, y, ctxs, mesh)
+    check_mesh_parity(make_group, X, y, ctxs, mesh, findings=findings)
+    check_accum_tolerance(X[: min(n, 512)], y[: min(n, 512)],
+                          findings=findings)
+    out = {"findings": [d.to_json() for d in findings.diagnostics],
+           "ok": not findings}
+    print("contracts:", "clean" if out["ok"] else findings.format())
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--cols", type=int, default=120)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--trees", type=int, default=8)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape, correctness gates only, no JSON")
+    args = ap.parse_args()
+    if args.smoke:
+        args.rows, args.cols, args.rounds, args.trees = 1200, 60, 5, 5
+
+    from transmogrifai_tpu.analysis.contracts import checks_enabled
+    from transmogrifai_tpu.utils.jsonio import write_json_atomic
+    from transmogrifai_tpu.utils.profiling import backend_name
+
+    X, y = make_data(args.rows, args.cols)
+    doc = {"rows": args.rows, "cols": args.cols,
+           "backend": backend_name(), "smoke": bool(args.smoke),
+           "efb": measure_efb_width(X),
+           "depth_walls": measure_depth_walls(X, y, args.rounds),
+           "tree_sweep_8dev": measure_tree_sweep(X, y, args.trees,
+                                                 args.rounds)}
+    rc = 0
+    if not doc["tree_sweep_8dev"]["parity_ok"]:
+        print("FAIL: batched-vs-sequential tree sweep parity")
+        rc = 1
+    if doc["efb"]["ratio"] > 0.8:
+        print("FAIL: EFB width reduction below the 0.8x gate")
+        rc = 1
+    if checks_enabled():
+        doc["contracts"] = run_contracts(X, y)
+        if not doc["contracts"]["ok"]:
+            rc = 1
+    if not args.smoke:
+        write_json_atomic(OUT_PATH, doc, indent=2, sort_keys=True)
+        print(f"wrote {OUT_PATH}")
+    print(json.dumps({"ok": rc == 0,
+                      "sweep_ratio": doc["tree_sweep_8dev"]["ratio"],
+                      "efb_ratio": doc["efb"]["ratio"]}))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

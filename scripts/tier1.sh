@@ -45,6 +45,16 @@ else
   echo "MULTICHIP_SMOKE=FAILED (see /tmp/_t1_multichip.log)"
   rc=1
 fi
+# tree fast-path smoke: EFB width reduction + batched-vs-sequential tree
+# sweep parity on 8 forced host devices, with the SPMD contracts (TM024
+# pad-invariance, TM025 mesh parity) running on a TREE grid group and
+# the TM028 bf16-accumulation tolerance probe under TMOG_CHECK=1
+if timeout -k 10 480 env JAX_PLATFORMS=cpu TMOG_CHECK=1 python examples/bench_trees.py --smoke > /tmp/_t1_trees.log 2>&1; then
+  echo "TREES_SMOKE=ok $(grep -ao '"sweep_ratio": [0-9.]*' /tmp/_t1_trees.log | tail -1)"
+else
+  echo "TREES_SMOKE=FAILED (see /tmp/_t1_trees.log)"
+  rc=1
+fi
 # elastic smoke: SIGKILL a halving sweep mid-rung under 8 forced host
 # devices, resume under 4 and under 1, assert winner + metrics parity
 # with the uninterrupted run and a NONZERO mesh_shrinks counter in the

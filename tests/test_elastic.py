@@ -106,13 +106,18 @@ class TestShrinkLadder:
 
 class TestDeviceLossRecovery:
     def test_loss_retries_on_shrunk_mesh_same_winner(self):
+        # with_groups=False: since PR 11 the TREE families batch on the
+        # mesh too, so a grouped sweep runs NO per-unit attempts (the
+        # device.loss point fires per unit attempt) — the unit-level
+        # recovery ladder under test needs sequential units
         X, y = _toy()
-        best0, res0 = _validate(_selector(), X, y)
+        best0, res0 = _validate(_selector(), X, y, with_groups=False)
         sel = _selector().with_mesh(make_sweep_mesh(6, n_devices=8))
         ctx = sel._elastic_context(len(y), X.shape[1], 6)
         with faults.inject(faults.FaultSpec(
                 point="device.loss", action="device_loss", at=4, times=1)):
-            best, res = _validate(sel, X, y, elastic=ctx)
+            best, res = _validate(sel, X, y, elastic=ctx,
+                                  with_groups=False)
         assert all(r.error is None for r in res)
         c = ctx.counters
         assert (c.device_losses, c.retries, c.quarantined) == (1, 1, 0)
@@ -131,7 +136,8 @@ class TestDeviceLossRecovery:
         with faults.inject(faults.FaultSpec(
                 point="device.loss", action="device_loss", at=4,
                 times=None)):
-            best, res = _validate(sel, X, y, elastic=ctx)
+            best, res = _validate(sel, X, y, elastic=ctx,
+                                  with_groups=False)
         assert res[4].error is not None
         assert res[4].error.startswith("failed: device_loss")
         assert sum(r.error is not None for r in res) == 1

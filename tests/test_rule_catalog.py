@@ -153,6 +153,16 @@ def _tm027():
     return check_warm_start(TL._LossyExport().set_input(f), data)
 
 
+def _tm028():
+    from transmogrifai_tpu.analysis.contracts import check_accum_tolerance
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    # tol < 0: ANY drift (including exact-zero) exceeds it -> fires
+    return check_accum_tolerance(X, y, tol=-1.0, n_rounds=2, max_depth=3)
+
+
 # -- TM03x ------------------------------------------------------------------
 
 def _tm030():
@@ -301,6 +311,7 @@ FIXTURES = {
     "TM005": _tm005, "TM006": _tm006,
     "TM020": _tm020, "TM021": _tm021, "TM022": _tm022, "TM023": _tm023,
     "TM024": _tm024, "TM025": _tm025, "TM026": _tm026, "TM027": _tm027,
+    "TM028": _tm028,
     "TM030": _tm030, "TM031": _tm031, "TM032": _tm032,
     "TM040": _tm040, "TM041": _tm041, "TM042": _tm042, "TM043": _tm043,
     "TM044": _tm044, "TM045": _tm045, "TM046": _tm046,

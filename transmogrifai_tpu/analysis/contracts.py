@@ -45,7 +45,8 @@ __all__ = ["CHECK_ENV", "checks_enabled", "guarded_transform_output",
            "columns_equal", "columns_close", "check_streaming_fit",
            "check_warm_start", "check_workflow_contracts",
            "check_pad_invariance", "check_mesh_parity",
-           "check_checkpoint_roundtrip", "check_sharding_contracts"]
+           "check_checkpoint_roundtrip", "check_sharding_contracts",
+           "check_accum_tolerance"]
 
 #: set to "1" to enable the instrumented mode (used by tests and the tier-1
 #: contract gate); any other value disables it with zero overhead beyond one
@@ -472,6 +473,69 @@ def check_sharding_contracts(make_group, X, y, weight_ctxs, mesh, *,
     if checkpoint_dir is not None:
         check_checkpoint_roundtrip(checkpoint_dir, checkpoint_fingerprint,
                                    findings=findings)
+    return findings
+
+
+def check_accum_tolerance(X, y, *, tol: float = 1e-3, max_depth: int = 6,
+                          n_rounds: int = 8, n_bins: int = 16,
+                          seed: int = 7,
+                          findings: Optional[Findings] = None) -> Findings:
+    """TM028 — the bf16 histogram-ACCUMULATION tolerance probe.
+
+    ``TMOG_MATRIX_PRECISION=bf16`` lets the tree kernels accumulate the
+    per-level gradient/hessian histogram partials in bf16 (the operands
+    already ride bf16 on accelerators).  That opt-in is only sound where
+    the metric drift it introduces stays within ``tol`` — this probe
+    grows the SAME small boosted chain twice (explicit ``acc_bf16``
+    flags, independent of env/backend gates so the comparison is real on
+    any backend) and fires TM028 when the train-AuPR drift exceeds
+    ``tol``.  Run next to the TM024 pad-invariance gate under
+    TMOG_CHECK=1 (the tier-1 trees smoke does both).
+    """
+    import jax.numpy as jnp
+
+    from ..evaluators.metrics import aupr
+    from ..models.gbdt_kernels import (_gbt_chain_rounds_jit, apply_bins,
+                                       quantile_bins)
+
+    findings = findings if findings is not None else Findings()
+    X = np.asarray(X, np.float32)
+    y = np.nan_to_num(np.asarray(y, np.float32))
+    n = len(y)
+    edges = quantile_bins(X, n_bins, seed=seed)
+    binned = apply_bins(jnp.asarray(X), jnp.asarray(edges))
+    W = jnp.ones((1, n), jnp.float32)
+    vi = jnp.zeros(1, jnp.int32)
+    vecs = dict(depth_lim=jnp.full((1,), max_depth, jnp.int32),
+                lams=jnp.ones(1, jnp.float32),
+                mcws=jnp.zeros(1, jnp.float32),
+                migs=jnp.zeros(1, jnp.float32),
+                mins_=jnp.ones(1, jnp.float32),
+                lrs=jnp.full((1,), 0.3, jnp.float32),
+                mgrs=jnp.zeros(1, jnp.float32))
+
+    def run(acc_bf16: bool) -> float:
+        Fm = jnp.zeros((1, n), jnp.float32)
+        Fm, *_rest = _gbt_chain_rounds_jit(
+            binned, jnp.asarray(y), W, Fm, vi, vecs["depth_lim"],
+            vecs["lams"], vecs["mcws"], vecs["migs"], vecs["mins_"],
+            vecs["lrs"], vecs["mgrs"], n_rounds, max_depth, n_bins,
+            "binary", False, False, acc_bf16=acc_bf16)
+        import jax
+
+        p = np.asarray(jax.nn.sigmoid(Fm[0]))
+        return float(aupr(y, p))
+
+    m_f32 = run(False)
+    m_bf16 = run(True)
+    drift = abs(m_f32 - m_bf16)
+    if drift > tol:
+        findings.add(
+            "TM028",
+            f"bf16 histogram-accumulation drift {drift:.3e} exceeds "
+            f"tol={tol} (f32 AuPR {m_f32:.4f} vs bf16-accumulated "
+            f"{m_bf16:.4f}); keep TMOG_MATRIX_PRECISION=f32 for this "
+            f"workload")
     return findings
 
 

@@ -51,6 +51,10 @@ RULES: Dict[str, Any] = {
                      "fit_state(new_chunks)) does not finish to the fresh "
                      "streaming fit over old+new within the declared "
                      "tolerance"),
+    "TM028": (ERROR, "bf16 histogram-accumulation drift exceeds the "
+                     "tolerance: a fit with bf16 gradient/hessian "
+                     "accumulation moves the metric beyond the f32 "
+                     "reference by more than the declared bound"),
     # -- trace safety (analysis/trace_lint.py) --------------------------
     "TM030": (ERROR, "host sync on a traced value inside a jit function"),
     "TM031": (WARNING, "jit closure over an enclosing Python scalar: fresh "

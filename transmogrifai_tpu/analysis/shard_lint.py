@@ -108,6 +108,23 @@ def _const_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
     return None
 
 
+def _own_returns(fn: ast.AST):
+    """``Return`` nodes belonging to ``fn`` itself — nested function
+    definitions (scan bodies, helper closures) are skipped, since their
+    return arity is theirs, not the shard_map out_specs contract's."""
+    out = []
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        nd = stack.pop()
+        if isinstance(nd, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+            continue
+        if isinstance(nd, ast.Return):
+            out.append(nd)
+        stack.extend(ast.iter_child_nodes(nd))
+    return out
+
+
 class _Scope:
     """One lexical scope's name -> value-expression table."""
 
@@ -298,9 +315,11 @@ class _ShardLinter:
                        f"spec(s) but the wrapped function takes "
                        f"{len(params)} parameter(s)", def_line)
         if isinstance(out_specs, (ast.Tuple, ast.List)):
-            for ret in ast.walk(fn):
-                if isinstance(ret, ast.Return) and \
-                        isinstance(ret.value, ast.Tuple) and \
+            # only the wrapped function's OWN returns: a nested def (a
+            # lax.scan body returning (carry, ys), a helper closure) has
+            # its own return arity and must not trip the spec check
+            for ret in _own_returns(fn):
+                if isinstance(ret.value, ast.Tuple) and \
                         len(ret.value.elts) != len(out_specs.elts):
                     self._emit(
                         "TM045", ret,

@@ -38,7 +38,9 @@ from jax import lax
 
 __all__ = ["TreeEnsemble", "quantile_bins", "apply_bins", "grow_tree",
            "grow_forest", "grow_forest_rf", "forest_chunk_size",
-           "predict_tree", "predict_ensemble", "compile_depth_hint"]
+           "predict_tree", "predict_ensemble", "compile_depth_hint",
+           "FeatureBundles", "bundle_features", "bundle_matrix",
+           "unbundle_ensemble", "goss_plan", "hist_accum_bf16"]
 
 # Shared compile-depth hint: a model-selection sweep compiles ONE tree-growth
 # program at the grid's deepest max_depth and runs every candidate through it
@@ -65,6 +67,23 @@ def _resolve_compile_depth(max_depth: int) -> int:
     if _COMPILE_DEPTH_HINT is not None and _COMPILE_DEPTH_HINT >= max_depth:
         return _COMPILE_DEPTH_HINT
     return max_depth
+
+
+def hist_accum_bf16() -> bool:
+    """bf16 histogram ACCUMULATION (not just bf16 operands): the level's
+    partial gradient/hessian sums accumulate in bf16 and upcast to f32
+    only at the level cumsum.  Opt-in via ``TMOG_MATRIX_PRECISION=bf16``
+    (the same knob that governs the bf16 matrix upload; ``f32`` is the
+    escape hatch for both) and accelerator-gated like the operand flag —
+    XLA-CPU emulates bf16 scalar-slow, and there is no bandwidth to save
+    there.  The quality contract is the TM028 tolerance probe
+    (``analysis.contracts.check_accum_tolerance``): accumulation drift
+    must stay within 1e-3 of the f32-accumulated metric, proven under
+    TMOG_CHECK=1 next to the TM024 pad-invariance gate."""
+    import os
+
+    return (os.environ.get("TMOG_MATRIX_PRECISION", "auto") == "bf16"
+            and _accel_bf16())
 
 
 @functools.lru_cache(maxsize=1)
@@ -271,6 +290,290 @@ def build_feature_csr(X: np.ndarray, edges: np.ndarray
         [np.searchsorted(np.sort(edges[j]), 0.0, side="left")
          for j in range(d)], np.int8)
     return rows, bins, zero_bin
+
+
+# ---------------------------------------------------------------------------
+# Exclusive feature bundling (EFB) — histogram-width reduction
+# ---------------------------------------------------------------------------
+#
+# transmogrify() emits wide one-hot / picklist indicator blocks: groups of
+# mutually exclusive, mostly-zero columns.  The histogram kernels stream a
+# (rows, B·D) bins one-hot per level — their bandwidth floor — and pay it
+# for every indicator column even though at most one per group is nonzero
+# in any row.  ``bundle_features`` packs mutually exclusive columns into
+# shared histogram columns with per-member bin offsets (the LightGBM EFB
+# algorithm applied to the already-binned matrix), shrinking D before any
+# device work.
+#
+# Invertibility: the split search on a bundled column enumerates only
+# PER-MEMBER splits.  Member m occupies bundle bins [base_m, e_m] (its
+# original nonzero bins shifted by base_m - 1; bundle bin 0 = every
+# member at its default/zero bin), and threshold t with end table
+# ``E(t) = min{e_m : e_m > t}`` opens the interval split "bundle bin in
+# (t, E(t)]" — exactly "member m's ORIGINAL bin > t - base_m + 1", a
+# single original (feature, threshold) pair.  Grown trees therefore map
+# back losslessly (``unbundle_ensemble``): the persisted TreeEnsemble
+# routes on the ORIGINAL binned matrix and feature importances land on
+# original column ids.  On conflict-free matrices the bundled fit is
+# bit-for-tree identical to the unbundled fit (property-tested in
+# tests/test_tree_grid.py); under bounded conflicts (two members nonzero
+# in one row, admitted by ``max_conflict_rate``) the smaller encoded
+# value loses that row — an approximation bounded by the conflict budget.
+
+#: a column qualifies for bundling when at most this fraction of sampled
+#: rows is nonzero (indicator blocks sit far below this)
+EFB_MAX_ACTIVE_FRAC = 0.5
+#: rows sampled for the exclusivity scan — the bundle DECISION is made on
+#: the sample; the full matrix is re-encoded exactly
+EFB_SAMPLE_ROWS = 65536
+#: bundling must shrink the histogram width to at most this ratio to pay
+#: for the re-encode pass (singleton-heavy matrices decline)
+EFB_MIN_WIDTH_RATIO = 0.85
+
+
+class FeatureBundles(NamedTuple):
+    """The invertible bundling plan ``bundle_features`` produces.
+
+    ``plan``: one entry per BUNDLED column — an ``int`` original column
+    id (verbatim copy) or a tuple of ``(orig_id, base, end)`` member
+    triples (member's original nonzero bins shifted to bundle bins
+    [base, end]).  ``col_feat``/``col_thresh`` are the (D_b, B) split
+    map back to original (feature, threshold); ``end_bin`` is the (B,
+    D_b) per-threshold member-end table the growth kernel consumes.
+    """
+
+    plan: Tuple
+    col_feat: np.ndarray      # (D_b, B) int32
+    col_thresh: np.ndarray    # (D_b, B) int32
+    end_bin: np.ndarray       # (B, D_b) int32
+    n_orig: int
+    n_bins: int
+
+    @property
+    def width(self) -> int:
+        return int(self.col_feat.shape[0])
+
+    @property
+    def width_ratio(self) -> float:
+        return self.width / max(self.n_orig, 1)
+
+    def bundled_dd_mask(self, dd_mask: Optional[np.ndarray]) -> np.ndarray:
+        """Default-direction eligibility in BUNDLED column space: bundle
+        columns never learn a default direction (their bin 0 is 'every
+        member default' — variant-b routing would not map back to a
+        single original feature); singleton columns keep their flag."""
+        out = np.zeros(self.width, bool)
+        if dd_mask is None:
+            return out
+        dd = np.asarray(dd_mask, bool)
+        for c, spec in enumerate(self.plan):
+            if isinstance(spec, (int, np.integer)):
+                out[c] = bool(dd[int(spec)])
+        return out
+
+
+def bundle_features(binned: np.ndarray, edges: np.ndarray, max_bins: int,
+                    max_conflict_rate: float = 0.0,
+                    sample_rows: int = EFB_SAMPLE_ROWS,
+                    min_width_ratio: float = EFB_MIN_WIDTH_RATIO,
+                    ) -> Optional[FeatureBundles]:
+    """Greedy exclusive-feature-bundling plan over a binned matrix, or
+    None when bundling would not shrink the histogram width enough.
+
+    Host-side and sample-based like the quantile sketch: exclusivity is
+    decided on a strided row sample (``max_conflict_rate`` bounds the
+    admitted conflicts per bundle, as a fraction of sampled rows); the
+    encode pass (:func:`bundle_matrix`) then runs exactly over all rows.
+    Only columns whose zeros bin to bin 0 qualify — the bundle's shared
+    bin 0 must mean "this member is at its default".
+    """
+    binned = np.asarray(binned)
+    n, d = binned.shape
+    if d < 3 or max_bins > 127:
+        return None
+    e = np.asarray(edges, np.float32)
+    finite = np.isfinite(e)
+    used_bins = finite.sum(axis=1) + 1                 # bins 0..u-1 occur
+    # zeros must land in bin 0: the smallest finite edge is >= 0
+    first_edge = np.where(finite, e, np.inf).min(axis=1)
+    zero_ok = first_edge >= 0.0
+
+    step = max(1, n // sample_rows)
+    samp = binned[::step][:sample_rows]
+    ns = samp.shape[0]
+    active = samp != 0                                  # (ns, d)
+    act_frac = active.mean(axis=0)
+    cand = (used_bins >= 2) & zero_ok & (act_frac <= EFB_MAX_ACTIVE_FRAC)
+    cand_ids = np.where(cand)[0]
+    if len(cand_ids) < 2:
+        return None
+
+    budget = int(max_conflict_rate * ns)
+    # greedy pack, densest candidate first (the LightGBM ordering)
+    order = cand_ids[np.argsort(-act_frac[cand_ids], kind="stable")]
+    bundles: List[dict] = []
+    for j in order:
+        uj = int(used_bins[j])
+        aj = active[:, j]
+        placed = False
+        for b in bundles:
+            if b["bins"] + (uj - 1) > max_bins:
+                continue
+            conflicts = int(np.count_nonzero(aj & b["active"]))
+            if b["conflicts"] + conflicts > budget:
+                continue
+            b["members"].append(int(j))
+            b["bins"] += uj - 1
+            b["conflicts"] += conflicts
+            b["active"] |= aj
+            placed = True
+            break
+        if not placed:
+            bundles.append({"members": [int(j)], "bins": 1 + (uj - 1),
+                            "conflicts": 0, "active": aj.copy()})
+    multi = {}
+    for b in bundles:
+        if len(b["members"]) >= 2:
+            ms = sorted(b["members"])
+            multi[ms[0]] = ms
+    if not multi:
+        return None
+    in_multi = {j for ms in multi.values() for j in ms}
+    width = d - len(in_multi) + len(multi)
+    if width > min_width_ratio * d:
+        return None
+
+    B = int(max_bins)
+    plan: List = []
+    for j in range(d):
+        if j in in_multi:
+            if j in multi:                    # bundle sits at first member
+                specs, base = [], 1
+                for m in multi[j]:
+                    um = int(used_bins[m])
+                    specs.append((m, base, base + um - 2))
+                    base += um - 1
+                plan.append(tuple(specs))
+        else:
+            plan.append(j)
+    d_b = len(plan)
+    col_feat = np.zeros((d_b, B), np.int32)
+    col_thresh = np.zeros((d_b, B), np.int32)
+    end_bin = np.empty((B, d_b), np.int32)
+    ts = np.arange(B, dtype=np.int32)
+    for c, spec in enumerate(plan):
+        if isinstance(spec, (int, np.integer)):
+            col_feat[c] = int(spec)
+            col_thresh[c] = ts
+            end_bin[:, c] = B - 1
+        else:
+            ends = np.asarray([s[2] for s in spec], np.int32)
+            # owner(t): the member whose end is the smallest end > t;
+            # past the last member the interval (t, t] is empty (no split)
+            owner = np.searchsorted(ends, ts, side="right")
+            tail = owner >= len(spec)
+            owner = np.minimum(owner, len(spec) - 1)
+            end_bin[:, c] = np.where(tail, ts, ends[owner])
+            feats = np.asarray([s[0] for s in spec], np.int32)
+            bases = np.asarray([s[1] for s in spec], np.int32)
+            col_feat[c] = feats[owner]
+            col_thresh[c] = np.maximum(ts - bases[owner] + 1, 0)
+    return FeatureBundles(plan=tuple(plan), col_feat=col_feat,
+                          col_thresh=col_thresh, end_bin=end_bin,
+                          n_orig=d, n_bins=B)
+
+
+def bundle_matrix(bundles: FeatureBundles, binned: np.ndarray) -> np.ndarray:
+    """Encode the (N, D) binned matrix into (N, D_b) bundled columns.
+
+    Bundle bin = base_m + orig_bin - 1 for the active member; 0 when every
+    member sits at its zero bin.  Conflicting rows (several members
+    active — only possible under a nonzero conflict budget) keep the
+    LARGEST encoded value, deterministically."""
+    binned = np.asarray(binned)
+    n = binned.shape[0]
+    out = np.zeros((n, bundles.width), binned.dtype)
+    for c, spec in enumerate(bundles.plan):
+        if isinstance(spec, (int, np.integer)):
+            out[:, c] = binned[:, int(spec)]
+        else:
+            enc = np.zeros(n, np.int32)
+            for orig, base, _end in spec:
+                v = binned[:, orig].astype(np.int32)
+                np.maximum(enc, np.where(v > 0, base + v - 1, 0), out=enc)
+            out[:, c] = enc.astype(binned.dtype)
+    return out
+
+
+def unbundle_ensemble(bundles: FeatureBundles, feat, thresh):
+    """Map grown (T, nodes) split arrays from bundled column space back to
+    ORIGINAL (feature, threshold) pairs — exact for every per-member
+    interval split the bundled gain search emits.  No-split sentinels
+    (thresh == B) and default-direction splits (negative thresholds, only
+    ever emitted on singleton columns) pass through unchanged."""
+    feat = np.asarray(feat)
+    thresh = np.asarray(thresh)
+    B = bundles.n_bins
+    t_id = np.clip(thresh, 0, B - 1)
+    f_orig = bundles.col_feat[feat, t_id]
+    t_orig = bundles.col_thresh[feat, t_id]
+    passthrough = (thresh >= B) | (thresh < 0)
+    f_out = np.where(passthrough, bundles.col_feat[feat, 0], f_orig)
+    t_out = np.where(passthrough, thresh, t_orig)
+    return f_out.astype(np.int32), t_out.astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# GOSS — gradient-based one-side sampling (deep boosted candidates)
+# ---------------------------------------------------------------------------
+
+#: GOSS only engages at/above this tree depth: shallow trees are cheap
+#: and the sampling noise isn't worth it (the ISSUE 11 contract)
+GOSS_MIN_DEPTH = 8
+#: below this many rows the gather outweighs the histogram savings
+GOSS_MIN_ROWS = 20000
+#: keep fraction by |gradient| / uniform-sample fraction of the rest —
+#: the LightGBM defaults' neighbourhood (a=0.2, b=0.2, amp=(1-a)/b)
+GOSS_TOP_FRAC = 0.2
+GOSS_REST_FRAC = 0.2
+
+
+def goss_plan(n_rows: int, min_depth: int) -> Optional[Tuple[int, int]]:
+    """Static (k_top, k_rest) GOSS row budget for a launch whose
+    shallowest candidate has ``min_depth``, or None when GOSS stays off.
+    ``TMOG_GOSS``: '1' forces on (row gate bypassed; the depth gate is
+    part of the contract and always holds), '0' forces off, 'auto'
+    (default) engages at depth >= 8 and n >= GOSS_MIN_ROWS.  Resolved by
+    the non-jitted callers so the budget is a static jit-cache-key arg."""
+    import os
+
+    v = os.environ.get("TMOG_GOSS", "auto")
+    if v == "0" or min_depth < GOSS_MIN_DEPTH:
+        return None
+    if v != "1" and n_rows < GOSS_MIN_ROWS:
+        return None
+    k_top = max(1, int(round(GOSS_TOP_FRAC * n_rows)))
+    k_rest = max(1, int(round(GOSS_REST_FRAC * n_rows)))
+    if k_top + k_rest >= n_rows:
+        return None
+    return k_top, k_rest
+
+
+def _goss_select(ga, key, k_top: int, k_rest: int):
+    """One chain/tree's GOSS row selection: the ``k_top`` rows of largest
+    |gradient| kept at weight 1, ``k_rest`` uniform samples of the rest
+    amplified by (N - k_top)/k_rest — the standard unbiasedness weights.
+    Returns (row indices (k_top+k_rest,), per-row multipliers);
+    deterministic in ``key``."""
+    _, top_idx = lax.top_k(ga, k_top)
+    r = jax.random.uniform(key, ga.shape)
+    r = r.at[top_idx].set(-1.0)             # exclude kept rows
+    _, rest_idx = lax.top_k(r, k_rest)
+    idx = jnp.concatenate([top_idx, rest_idx])
+    amp = (ga.shape[0] - k_top) / k_rest
+    mult = jnp.concatenate([jnp.ones(k_top, jnp.float32),
+                            jnp.full(k_rest, amp, jnp.float32)])
+    return idx, mult
 
 
 # ---------------------------------------------------------------------------
@@ -541,8 +844,25 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                       bag_mode: str = "none", feat_idx=None,
                       leaf_levels: Tuple[int, ...] = (), csr=None,
                       seg_hist: bool = False, default_dir: bool = False,
-                      dd_mask=None):
+                      dd_mask=None, bundle_end=None,
+                      acc_bf16: bool = False):
     """One whole tree under trace: Python-unrolled loop over levels.
+
+    ``bundle_end``: optional (B, D) int32 per-(threshold, feature) member
+    END-bin table from :func:`bundle_features` — the matrix is then in
+    BUNDLED column space and every split candidate becomes the per-member
+    interval split "bin in (t, E(t)]" (right) vs everything else (left),
+    which maps back to a single ORIGINAL (feature, threshold) pair.
+    Unbundled columns carry E = B-1, making the interval form bit-
+    identical to the standard "bin > t" split.  Incompatible with
+    ``feat_idx`` (callers guard); ``default_dir`` composes only through a
+    ``dd_mask`` that excludes bundle columns (FeatureBundles.
+    bundled_dd_mask).
+
+    ``acc_bf16``: accumulate the histogram partials in bf16 (operands
+    already ride ``hist_bf16``) and upcast to f32 at the level cumsum —
+    the TMOG_MATRIX_PRECISION=bf16 opt-in, quality-gated by the TM028
+    tolerance probe.
 
     ``csr``: optional (rows (D, NZ) int32, bins (D, NZ) int8,
     zero_bin_onehot (D, B)) device triple from ``build_feature_csr`` — wide
@@ -662,6 +982,9 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
     # floor) halves; channel values ride the already-accepted hist_bf16
     # precision contract.
     hdt = jnp.bfloat16 if hist_bf16 else jnp.float32
+    # histogram ACCUMULATION dtype (preferred_element_type of the dots and
+    # the row-block scan carry); f32 unless the TM028-gated opt-in is on
+    adt = jnp.bfloat16 if acc_bf16 else jnp.float32
 
     # Row-blocked histogram build: the bins one-hot is (rows, B·D) — at
     # 1M×500×32 bins that is 64 GB f32 if materialized whole, so rows stream
@@ -777,10 +1100,10 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                     axis=1)                            # (RB, nchan·Mh)
                 part = jax.lax.dot(wnode.T, oh_bins,
                                    precision=dot_prec,
-                                   preferred_element_type=jnp.float32)
+                                   preferred_element_type=adt)
                 return acc + part.reshape(nchan, Mh, B * d), None
 
-            acc0 = jnp.zeros((nchan, Mh, B * d), jnp.float32)
+            acc0 = jnp.zeros((nchan, Mh, B * d), adt)
             hist_stack, _ = lax.scan(
                 hist_block, acc0, (slot_blk, binned_blk, chans_blk))
             hists = [hist_stack[c].reshape(Mh, B, d) for c in range(nchan)]
@@ -791,9 +1114,12 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
                 axis=1)                               # (N, nchan·Mh)
             hist_all = jax.lax.dot(
                 wnode.T, onehot_bins, precision=dot_prec,
-                preferred_element_type=jnp.float32)   # (nchan·Mh, B·D)
+                preferred_element_type=adt)           # (nchan·Mh, B·D)
             hists = [hist_all[c * Mh:(c + 1) * Mh].reshape(Mh, B, d)
                      for c in range(nchan)]           # 2K+1 × (Mh, B, D)
+        if acc_bf16:
+            # upcast once per level: gain search / gating stay f32
+            hists = [h.astype(jnp.float32) for h in hists]
         if all_reduce is not None:
             # ICI collective replaces Spark's treeAggregate / Rabit allreduce
             # (channel reduction also means fewer collectives per level)
@@ -846,19 +1172,54 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
         gain = 0.0
         HLmin = jnp.inf
         HRmin = jnp.inf
-        for GL, HL in zip(GLs, HLs):
-            Gtot = GL[:, -1:, :1]
-            Htot = HL[:, -1:, :1]
-            GR, HR = Gtot - GL, Htot - HL
-            gain = gain + (GL ** 2 / (HL + lam) + GR ** 2 / (HR + lam)
-                           - Gtot ** 2 / (Htot + lam))
-            HLmin = jnp.minimum(HLmin, HL)
-            HRmin = jnp.minimum(HRmin, HR)
-        Ctot = CL[:, -1:, :1]
-        CR = Ctot - CL
+        if bundle_end is not None:
+            # EFB interval splits: right = bins in (t, E(t)] — the owner
+            # member's remaining bins; left = everything else (other
+            # members + the shared default bin).  Unbundled columns carry
+            # E = B-1, collapsing to the standard form bit-for-bit.
+            # Entries with E = B-1 (unbundled columns, and a bundle's
+            # LAST member) compute the STANDARD arithmetic (Gtot - GL)
+            # rather than GL[E] - GL: the two agree exactly in real
+            # arithmetic but differ by f32 cumsum rounding, and that
+            # last-ulp noise would break gain-PLATEAU ties (thresholds
+            # spanning empty bins) differently from the unbundled
+            # program — the bit-for-tree contract hinges on it.
+            Eb = jnp.broadcast_to(bundle_end[None], (M, B, d))
+            is_std = Eb == (B - 1)
+
+            def right_interval(A):
+                return jnp.take_along_axis(A, Eb, axis=1) - A
+
+            for GL, HL in zip(GLs, HLs):
+                Gtot = GL[:, -1:, :1]
+                Htot = HL[:, -1:, :1]
+                GR = jnp.where(is_std, Gtot - GL, right_interval(GL))
+                HR = jnp.where(is_std, Htot - HL, right_interval(HL))
+                GLft = jnp.where(is_std, GL, Gtot - GR)
+                HLft = jnp.where(is_std, HL, Htot - HR)
+                gain = gain + (GLft ** 2 / (HLft + lam)
+                               + GR ** 2 / (HR + lam)
+                               - Gtot ** 2 / (Htot + lam))
+                HLmin = jnp.minimum(HLmin, HLft)
+                HRmin = jnp.minimum(HRmin, HR)
+            Ctot = CL[:, -1:, :1]
+            CR = jnp.where(is_std, Ctot - CL, right_interval(CL))
+            CLft = jnp.where(is_std, CL, Ctot - CR)
+        else:
+            for GL, HL in zip(GLs, HLs):
+                Gtot = GL[:, -1:, :1]
+                Htot = HL[:, -1:, :1]
+                GR, HR = Gtot - GL, Htot - HL
+                gain = gain + (GL ** 2 / (HL + lam) + GR ** 2 / (HR + lam)
+                               - Gtot ** 2 / (Htot + lam))
+                HLmin = jnp.minimum(HLmin, HL)
+                HRmin = jnp.minimum(HRmin, HR)
+            Ctot = CL[:, -1:, :1]
+            CR = Ctot - CL
+            CLft = CL
 
         valid = ((HLmin >= min_child_weight) & (HRmin >= min_child_weight)
-                 & (CL >= min_instances) & (CR >= min_instances)
+                 & (CLft >= min_instances) & (CR >= min_instances)
                  & (jnp.arange(B)[None, :, None] < B - 1)
                  & feat_mask[None, None, :])
         node_w = jnp.maximum(Ctot[:, 0, 0], 1e-12)
@@ -947,7 +1308,16 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
         fid = feat_idx[feat_l] if feat_idx is not None else feat_l
         x_row = jnp.take_along_axis(binned_full, fid[slot][:, None], 1)[:, 0]
         tv = thresh_l[slot]
-        node = 2 * node + _route_right(x_row, tv).astype(jnp.int32)
+        go_right = _route_right(x_row, tv)
+        if bundle_end is not None:
+            # interval cap: rows past the owner member's end bin belong
+            # to OTHER members of the bundle and route left (flat gather:
+            # 2-D advanced indexing miscompiles at some shapes, see
+            # predict_ensemble)
+            ev = bundle_end.reshape(-1)[
+                jnp.clip(tv, 0, B - 1) * d + fid[slot]]
+            go_right = go_right & (x_row <= ev)
+        node = 2 * node + go_right.astype(jnp.int32)
 
     # heap layout: level l occupies slots [2^l - 1, 2^{l+1} - 1)
     heap_feat = jnp.concatenate(heap_feat_levels)
@@ -981,26 +1351,46 @@ def _grow_tree_traced(binned, G, H, C, feat_mask, depth_limit,
 
 @functools.partial(jax.jit,
                    static_argnames=("max_depth", "n_bins", "hist_bf16",
-                                    "seg_hist", "default_dir"))
+                                    "seg_hist", "default_dir", "goss",
+                                    "acc_bf16"))
 def _grow_chunk(binned, G, H, C, feat_mask, depth_limit, max_depth: int,
                 n_bins: int, lam, min_child_weight, min_info_gain,
                 min_instances, newton_leaf, learning_rate,
                 hist_bf16: bool = False, min_gain_raw=0.0, csr=None,
                 seg_hist: bool = False, default_dir: bool = False,
-                dd_mask=None):
+                dd_mask=None, bundle_end=None, acc_bf16: bool = False,
+                goss=None, goss_key=None):
     """Grow a chunk of trees in one XLA program.
 
     binned (N, D) shared; G/H (T, N, K), C (T, N), feat_mask (T, D),
     depth_limit (T,) traced per-tree effective depth.
     Returns (feat (T, 2^d-1), thresh (T, 2^d-1), leaf (T, 2^d, K)).
+    ``goss``: static (k_top, k_rest) GOSS budget — each tree then grows
+    on its own gradient-selected row gather (``goss_key`` folded per
+    tree), with csr/seg paths declined by the callers.
     """
-    fn = functools.partial(
-        _grow_tree_traced, binned, max_depth=max_depth, n_bins=n_bins,
-        lam=lam, min_child_weight=min_child_weight,
-        min_info_gain=min_info_gain, min_instances=min_instances,
-        newton_leaf=newton_leaf, learning_rate=learning_rate,
-        hist_bf16=hist_bf16, min_gain_raw=min_gain_raw, csr=csr,
-        seg_hist=seg_hist, default_dir=default_dir, dd_mask=dd_mask)
+    kw = dict(max_depth=max_depth, n_bins=n_bins,
+              lam=lam, min_child_weight=min_child_weight,
+              min_info_gain=min_info_gain, min_instances=min_instances,
+              newton_leaf=newton_leaf, learning_rate=learning_rate,
+              hist_bf16=hist_bf16, min_gain_raw=min_gain_raw, csr=csr,
+              seg_hist=seg_hist, default_dir=default_dir, dd_mask=dd_mask,
+              bundle_end=bundle_end, acc_bf16=acc_bf16)
+    if goss is not None:
+        k_top, k_rest = goss
+
+        def one(g, h, c, m, lim, tid):
+            ga = jnp.sum(jnp.abs(g), axis=1)
+            idx, mult = _goss_select(ga, jax.random.fold_in(goss_key, tid),
+                                     k_top, k_rest)
+            f, t, lf, _ = _grow_tree_traced(
+                binned[idx], g[idx] * mult[:, None],
+                h[idx] * mult[:, None], c[idx] * mult, m, lim, **kw)
+            return f, t, lf
+
+        return jax.vmap(one)(G, H, C, feat_mask, depth_limit,
+                             jnp.arange(G.shape[0]))
+    fn = functools.partial(_grow_tree_traced, binned, **kw)
     f, t, lf, _ = jax.vmap(fn)(G, H, C, feat_mask, depth_limit)
     return f, t, lf
 
@@ -1411,14 +1801,18 @@ def _gbt_chain_round_jit(binned, y, W, Fm, depth_lim, lams, mcws, migs,
 @functools.partial(jax.jit, static_argnames=("n_rounds", "max_depth",
                                              "n_bins", "obj", "hist_bf16",
                                              "use_es", "skip_counts",
-                                             "seg_hist", "default_dir"))
+                                             "seg_hist", "default_dir",
+                                             "goss", "acc_bf16"))
 def _gbt_chain_rounds_jit(binned, y, W, Fm0, vi, depth_lim, lams, mcws,
                           migs, mins_, lrs, mgrs, n_rounds: int,
                           max_depth: int, n_bins: int, obj: str,
                           hist_bf16: bool = False, use_es: bool = False,
                           csr=None, skip_counts: bool = False,
                           seg_hist: bool = False, default_dir: bool = False,
-                          dd_mask=None):
+                          dd_mask=None, bundle_end=None,
+                          acc_bf16: bool = False, goss=None,
+                          goss_seed=None, chain_ids=None,
+                          round_offset=None):
     """``n_rounds`` boosting rounds for a chunk of chains in ONE launch.
 
     ``lax.scan`` over rounds (body compiled once) carries the (S, N)
@@ -1427,11 +1821,25 @@ def _gbt_chain_rounds_jit(binned, y, W, Fm0, vi, depth_lim, lams, mcws,
     (measured ~390 ms/round vs ~120 ms device compute at 100k x 500), and
     the scan leaves ONE dispatch (and one lagged metric fetch) per
     ``es_chunk`` of rounds.  Returns (Fm_end, feats (R, S, nodes), threshs,
-    leaves (R, S, L, K), metrics (R, S))."""
+    leaves (R, S, L, K), metrics (R, S)).
+
+    ``bundle_end``: EFB member-end table — ``binned`` is then the BUNDLED
+    matrix; growth, routing and margin updates all run in bundled space
+    (the caller unbundles the returned trees before persisting/scoring
+    outside this launch).  ``goss`` (static (k_top, k_rest)): each chain
+    grows its round tree on a gradient-selected row gather, seeded
+    ``fold_in(fold_in(PRNGKey(goss_seed), round_id), chain_id)`` with
+    GLOBAL chain ids (``chain_ids``) and the global round offset
+    (``round_offset``), so results are invariant to chunking."""
     n, d = binned.shape
     mask = jnp.ones(d, bool)
+    grow_kw = dict(max_depth=max_depth, n_bins=n_bins,
+                   newton_leaf=jnp.bool_(True), hist_bf16=hist_bf16,
+                   bag_mode="newton" if skip_counts else "none",
+                   default_dir=default_dir, dd_mask=dd_mask,
+                   bundle_end=bundle_end, acc_bf16=acc_bf16)
 
-    def round_step(Fm, _):
+    def round_step(Fm, rid):
         if obj == "binary":
             P = jax.nn.sigmoid(Fm)                   # (S, N)
             G = W * (P - y[None, :])
@@ -1440,21 +1848,38 @@ def _gbt_chain_rounds_jit(binned, y, W, Fm0, vi, depth_lim, lams, mcws,
             G = W * (Fm - y[None, :])
             H = W
 
-        def one(g, h, c, lim, lam, mcw, mig, mi, lr, mgr):
-            return _grow_tree_traced(
-                binned, g[:, None], h[:, None], c, mask, lim,
-                max_depth=max_depth, n_bins=n_bins, lam=lam,
-                min_child_weight=mcw, min_info_gain=mig, min_instances=mi,
-                newton_leaf=jnp.bool_(True), learning_rate=lr,
-                hist_bf16=hist_bf16, min_gain_raw=mgr, csr=csr,
-                bag_mode="newton" if skip_counts else "none",
-                seg_hist=seg_hist, default_dir=default_dir,
-                dd_mask=dd_mask)[:3]
+        if goss is not None:
+            k_top, k_rest = goss
 
-        f, t, lf = jax.vmap(one)(G, H, W, depth_lim, lams, mcws, migs,
-                                 mins_, lrs, mgrs)
-        inc = jax.vmap(lambda ff, tt, ll: predict_tree(
-            binned, ff, tt, ll, max_depth))(f, t, lf)[:, :, 0]
+            def one(g, h, c, lim, lam, mcw, mig, mi, lr, mgr, cid):
+                key = jax.random.fold_in(jax.random.fold_in(
+                    jax.random.PRNGKey(goss_seed), rid), cid)
+                idx, mult = _goss_select(jnp.abs(g), key, k_top, k_rest)
+                return _grow_tree_traced(
+                    binned[idx], (g[idx] * mult)[:, None],
+                    (h[idx] * mult)[:, None], c[idx] * mult, mask, lim,
+                    lam=lam, min_child_weight=mcw, min_info_gain=mig,
+                    min_instances=mi, learning_rate=lr, min_gain_raw=mgr,
+                    **grow_kw)[:3]
+
+            f, t, lf = jax.vmap(one)(G, H, W, depth_lim, lams, mcws, migs,
+                                     mins_, lrs, mgrs, chain_ids)
+        else:
+            def one(g, h, c, lim, lam, mcw, mig, mi, lr, mgr):
+                return _grow_tree_traced(
+                    binned, g[:, None], h[:, None], c, mask, lim,
+                    lam=lam, min_child_weight=mcw, min_info_gain=mig,
+                    min_instances=mi, learning_rate=lr, min_gain_raw=mgr,
+                    csr=csr, seg_hist=seg_hist, **grow_kw)[:3]
+
+            f, t, lf = jax.vmap(one)(G, H, W, depth_lim, lams, mcws, migs,
+                                     mins_, lrs, mgrs)
+        if bundle_end is not None:
+            inc = jax.vmap(lambda ff, tt, ll: _predict_tree_bundled(
+                binned, ff, tt, ll, max_depth, bundle_end))(f, t, lf)[:, :, 0]
+        else:
+            inc = jax.vmap(lambda ff, tt, ll: predict_tree(
+                binned, ff, tt, ll, max_depth))(f, t, lf)[:, :, 0]
         Fm = Fm + inc
         if use_es:
             m = _chain_es_metric(Fm, y, vi, obj)
@@ -1462,16 +1887,24 @@ def _gbt_chain_rounds_jit(binned, y, W, Fm0, vi, depth_lim, lams, mcws,
             m = jnp.zeros(Fm.shape[0], jnp.float32)
         return Fm, (f, t, lf, m)
 
-    Fm_end, (fs, ts, lfs, ms) = lax.scan(round_step, Fm0, None,
-                                         length=n_rounds)
+    rounds = jnp.arange(n_rounds, dtype=jnp.int32)
+    if round_offset is not None:
+        rounds = rounds + round_offset
+    Fm_end, (fs, ts, lfs, ms) = lax.scan(round_step, Fm0, rounds)
     return Fm_end, fs, ts, lfs, ms
 
 
 def _chain_es_metric(Fm, y, vi, obj: str):
     """Per-chain early-stopping metric on the validation rows (trace-safe:
     shared by the standalone jit below and the in-scan round body)."""
-    yv = y[vi]
-    Z = Fm[:, vi]
+    return _chain_es_metric_val(Fm[:, vi], y[vi], obj)
+
+
+def _chain_es_metric_val(Z, yv, obj: str):
+    """The metric half of ``_chain_es_metric``, over already-gathered
+    (S, V) validation margins — the sharded chain kernel psum-gathers
+    each shard's owned validation rows first and feeds them here, so
+    both paths score with identical code."""
     if obj == "binary":
         from ..evaluators.metrics import _aupr_dev
 
@@ -1494,7 +1927,8 @@ _chain_es_metric_jit = jax.jit(_chain_es_metric,
 
 def gbt_chain_chunk(n_chains: int, max_depth: int, d: int, n_bins: int,
                     n_rows: int, budget: int = 2 * HIST_BYTES_BUDGET,
-                    seg_hist: bool = False) -> int:
+                    seg_hist: bool = False,
+                    full_slots: bool = False) -> int:
     """Chains per round launch: the (ROW_BLOCK, B*D) bins one-hot is shared
     (counted once), per-chain terms are the slot one-hot + the 3-channel
     histogram accumulator.  The budget is deliberately larger than the
@@ -1503,9 +1937,13 @@ def gbt_chain_chunk(n_chains: int, max_depth: int, d: int, n_bins: int,
 
     ``seg_hist``: the segmented path has no shared one-hot, but each chain
     transiently holds its slot-sorted padded copy of the binned matrix
-    ((N', d_pad) int8) plus the sort/align index vectors."""
+    ((N', d_pad) int8) plus the sort/align index vectors.
+
+    ``full_slots``: the mesh-sharded chain path disables node compaction
+    (shards must agree on the full 2^level slot layout), so its budget
+    uses the uncompacted slot count."""
     slots = 2 ** (max_depth - 1)
-    if n_rows is not None:
+    if n_rows is not None and not full_slots:
         slots = min(slots, 1 << int(np.ceil(np.log2(max(n_rows, 2)))))
     if seg_hist and slots <= SEG_MAX_SLOTS:
         d_pad = -(-d // SEG_D_BLOCK) * SEG_D_BLOCK
@@ -1530,14 +1968,28 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               newton_leaf: bool = True, learning_rate: float = 1.0,
               min_gain_raw: float = 0.0, hist_bf16: bool = False,
               csr=None, seg_hist: Optional[bool] = None,
-              default_dir: bool = False, dd_mask=None,
+              default_dir: bool = False, dd_mask=None, bundle_end=None,
+              acc_bf16: Optional[bool] = None,
+              goss: Optional[Tuple[int, int]] = None, goss_key=None,
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Grow one tree (single-tree view of ``grow_forest``): one XLA launch."""
+    """Grow one tree (single-tree view of ``grow_forest``): one XLA launch.
+
+    ``bundle_end``: EFB member-end table — the matrix is then in bundled
+    column space and the returned splits need ``unbundle_ensemble``.
+    ``goss``/``goss_key``: static GOSS row budget + PRNG key (see
+    ``goss_plan``); incompatible with csr/seg (forced off here).
+    """
     n, d = binned.shape
     if feat_mask is None:
         feat_mask = jnp.ones(d, bool)
     heap_depth = _resolve_compile_depth(max_depth)
     hist_bf16 = hist_bf16 and _accel_bf16()
+    if acc_bf16 is None:
+        acc_bf16 = hist_accum_bf16()
+    if goss is not None:
+        csr, seg_hist = None, False
+        if goss_key is None:
+            goss_key = jax.random.PRNGKey(0)
     if seg_hist is None:
         seg_hist = seg_hist_auto(n)
     limit = jnp.full((1,), max_depth, jnp.int32)
@@ -1548,7 +2000,8 @@ def grow_tree(binned: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
         jnp.bool_(newton_leaf), jnp.float32(learning_rate),
         hist_bf16=hist_bf16, min_gain_raw=jnp.float32(min_gain_raw),
         csr=csr, seg_hist=seg_hist, default_dir=default_dir,
-        dd_mask=dd_mask)
+        dd_mask=dd_mask, bundle_end=bundle_end, acc_bf16=acc_bf16,
+        goss=goss, goss_key=goss_key)
     return f[0], t[0], lf[0]
 
 
@@ -1570,6 +2023,32 @@ def predict_tree(binned: jnp.ndarray, feat: jnp.ndarray, thresh: jnp.ndarray,
         t = thresh[heap]
         x = jnp.take_along_axis(binned, f[:, None], 1)[:, 0]
         return 2 * node + _route_right(x, t).astype(jnp.int32)
+
+    node = lax.fori_loop(0, max_depth, level, node)
+    return leaf[node]
+
+
+def _predict_tree_bundled(binned, feat, thresh, leaf, max_depth: int,
+                          bundle_end):
+    """``predict_tree`` in BUNDLED column space: splits are per-member
+    intervals, so routing right additionally requires the bin to sit at
+    or below the owner member's end bin (``bundle_end``).  Used only for
+    the in-launch margin updates of EFB growth — persisted trees are
+    unbundled and route through the ordinary predictors."""
+    n, d = binned.shape
+    B = bundle_end.shape[0]
+    be_f = bundle_end.reshape(-1)
+    node = jnp.zeros(n, jnp.int32)
+
+    def level(l, node):
+        base = 2 ** l - 1
+        heap = base + node
+        f = feat[heap]
+        t = thresh[heap]
+        x = jnp.take_along_axis(binned, f[:, None], 1)[:, 0]
+        ev = be_f[jnp.clip(t, 0, B - 1) * d + f]
+        go = _route_right(x, t) & (x <= ev)
+        return 2 * node + go.astype(jnp.int32)
 
     node = lax.fori_loop(0, max_depth, level, node)
     return leaf[node]

@@ -584,6 +584,83 @@ def _prep_tree_inputs_sparse(X, max_bins):
     return edges, binned, (csr if csr else None)
 
 
+def _efb_enabled() -> bool:
+    """``TMOG_EFB``: '0' disables exclusive feature bundling, '1' forces
+    it past the width-ratio gate, 'auto' (default) engages when the
+    greedy packer shrinks the histogram width enough to pay for the
+    re-encode pass (gbdt_kernels.EFB_MIN_WIDTH_RATIO)."""
+    import os
+
+    return os.environ.get("TMOG_EFB", "auto") != "0"
+
+
+def _maybe_bundle(hx: str, edges, binned, max_bins: int):
+    """Memoized EFB plan + bundled device matrices for a fit matrix.
+
+    Returns ``(FeatureBundles, bundled binned device array, end-bin device
+    array)`` or None when bundling declines.  Keyed on the SAME content
+    hash as the edges/binned memos, so one host pack serves every
+    candidate of a sweep; the host binned matrix downloads once (the
+    device copy is the memoized upload — on-host backends this is free).
+    """
+    import os
+
+    from .gbdt_kernels import (EFB_MIN_WIDTH_RATIO, bundle_features,
+                               bundle_matrix)
+
+    force = os.environ.get("TMOG_EFB", "auto") == "1"
+    # edges participate in the key: the weight-aware sketch can produce
+    # different edges for the same matrix content (TM024 pad rows)
+    ec = np.ascontiguousarray(np.asarray(edges, np.float32))
+    key = ("efb", hx, _content_hash(ec), tuple(binned.shape), max_bins,
+           force)
+
+    def build():
+        host = np.asarray(binned)
+        b = bundle_features(host, np.asarray(edges), max_bins,
+                            min_width_ratio=(1.0 if force
+                                             else EFB_MIN_WIDTH_RATIO))
+        if b is None:
+            return ()
+        return (b, _upload_timed(bundle_matrix(b, host)),
+                _upload_timed(b.end_bin))
+
+    val = _memo(key, build)
+    return val if val else None
+
+
+def _prep_tree_inputs_weighted(X, max_bins: int, row_weight=None):
+    """``_prep_tree_inputs_sparse`` with a PADDING-aware sketch: a
+    TRAILING block of zero-total-weight rows (mesh row padding — the
+    TM024 contract's shape) is excluded from the quantile sketch, since
+    pad rows participate in no fit and must not move the bin edges;
+    binning still covers every row.  INTERIOR zero-weight rows (holdout
+    reservations, balancer drops) stay in the sketch — the sequential
+    per-candidate fits sketch over all rows, and the batched groups must
+    bin with the same edges those fits would win selection with.
+    """
+    Xf = _as_f32(X)
+    if row_weight is None:
+        return _prep_tree_inputs_sparse(Xf, max_bins)
+    w = np.asarray(row_weight)
+    nz = np.nonzero(w > 0)[0]
+    if len(nz) == 0 or nz[-1] == len(w) - 1:
+        return _prep_tree_inputs_sparse(Xf, max_bins)
+    Xm = np.ascontiguousarray(Xf[: nz[-1] + 1])
+    hxm = _content_hash(Xm)
+    step = max(1, Xm.shape[0] // 4096)
+    if (Xm.size >= _SPARSE_MIN_ELEMS
+            and float((Xm[::step] == 0).mean()) >= _SPARSE_ZERO_FRAC):
+        from .gbdt_kernels import quantile_bins_sparse_aware
+
+        edges = _memo(("edges_sp", hxm, Xm.shape, max_bins),
+                      lambda: quantile_bins_sparse_aware(Xm, max_bins))
+    else:
+        edges = _memo(("edges", hxm, Xm.shape, max_bins),
+                      lambda: quantile_bins(Xm, max_bins))
+    return edges, _binned_cached(Xf, _content_hash(Xf), edges), None
+
+
 def _feature_subset_size(strategy: str, d: int, is_classification: bool) -> int:
     if strategy == "all":
         return d
@@ -943,7 +1020,8 @@ class _GBTBase(PredictorEstimator):
                                          np.where(val)[0], csr=csr,
                                          integer_weights=bool(
                                              (train_w == np.floor(train_w))
-                                             .all()))
+                                             .all()),
+                                         hx=_content_hash(_as_f32(X)))
 
         feats, threshs, leaves = [], [], []
         best_metric, best_len, stall = -np.inf, 0, 0
@@ -1037,21 +1115,43 @@ class _GBTBase(PredictorEstimator):
 
     def _fit_scan_chunks(self, binned, edges, yj, twj, obj: str,
                          base: float, use_es: bool, val_idx, csr=None,
-                         integer_weights: bool = True):
+                         integer_weights: bool = True,
+                         hx: Optional[str] = None):
         """Whole-fit scan-chunked boosting: es_chunk rounds per launch via
         ``_gbt_chain_rounds_jit`` with S=1 — the same kernel, patience rule
         and masked trimming as the batched GBT grid group, so the two paths
         cannot diverge.  Requires subsample/colsample == 1 (no per-round
-        host RNG) and a single device."""
+        host RNG) and a single device.
+
+        The tree fast path composes here: EFB (``_maybe_bundle``) shrinks
+        the histogram width before any launch and the grown splits
+        unbundle back to original columns at the end; GOSS
+        (``goss_plan``) engages for deep fits (max_depth >= 8), growing
+        each round's tree on a gradient-selected row gather; bf16
+        histogram accumulation rides ``TMOG_MATRIX_PRECISION=bf16``."""
         from ..utils.profiling import count_launch
         from .gbdt_kernels import (_gbt_chain_rounds_jit,
                                    _resolve_compile_depth, default_dir_mask,
-                                   seg_hist_auto)
+                                   goss_plan, hist_accum_bf16,
+                                   seg_hist_auto, unbundle_ensemble)
 
         n = int(binned.shape[0])
         seg = seg_hist_auto(n, n_chains=1)
-        dd = (jnp.asarray(default_dir_mask(edges))
-              if self.sparse_default_direction else None)
+        dd_host = (default_dir_mask(edges)
+                   if self.sparse_default_direction else None)
+        bundles = None
+        bend = None
+        if _efb_enabled() and csr is None and hx is not None:
+            eb = _maybe_bundle(hx, edges, binned, self.max_bins)
+            if eb is not None:
+                bundles, binned, bend = eb
+                if dd_host is not None:
+                    dd_host = bundles.bundled_dd_mask(dd_host)
+        dd = jnp.asarray(dd_host) if dd_host is not None else None
+        goss = goss_plan(n, self.max_depth)
+        if goss is not None:
+            csr, seg = None, False
+        acc = hist_accum_bf16()
         # family compile-depth hint: sequential-fallback candidates of
         # differing max_depth share ONE compiled scan program (their own
         # depth rides the traced depth limit) instead of recompiling the
@@ -1095,7 +1195,11 @@ class _GBTBase(PredictorEstimator):
                 es_chunk, heap_depth, self.max_bins, obj,
                 self._hist_bf16(), run_es, csr=csr,
                 skip_counts=skip_counts, seg_hist=seg,
-                default_dir=self.sparse_default_direction, dd_mask=dd)
+                default_dir=self.sparse_default_direction, dd_mask=dd,
+                bundle_end=bend, acc_bf16=acc, goss=goss,
+                goss_seed=jnp.int32(self.seed),
+                chain_ids=jnp.zeros(1, jnp.int32),
+                round_offset=jnp.int32(n_rounds))
             fb.append(fs)
             tb.append(ts)
             lb.append(lfs)
@@ -1120,6 +1224,13 @@ class _GBTBase(PredictorEstimator):
         feat = jnp.concatenate(fb)[:best_len, 0]
         thresh = jnp.concatenate(tb)[:best_len, 0]
         leaf = jnp.concatenate(lb)[:best_len, 0]
+        if bundles is not None:
+            # splits grown in bundled column space map back to original
+            # (feature, threshold) pairs — the persisted model routes on
+            # the ORIGINAL edges/binned matrix
+            feat, thresh = unbundle_ensemble(
+                bundles, np.asarray(feat), np.asarray(thresh))
+            leaf = np.asarray(leaf)
         mode = "gbdt_binary" if obj == "binary" else "gbdt_reg"
         return TreeEnsembleModel(
             mode=mode, edges=edges, feat=feat, thresh=thresh, leaf=leaf,

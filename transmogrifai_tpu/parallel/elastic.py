@@ -242,10 +242,12 @@ class ElasticContext:
     def on_group_device_loss(self, err: BaseException) -> None:
         """A device loss inside a batched grid-group program: shrink and
         let the queue strip the group to sequential fits (which then land
-        on the shrunk mesh)."""
+        on the shrunk mesh).  The strip IS the retry — every member
+        re-runs — so it lands on the retry counter like a unit re-run."""
         self.counters.count("device_losses")
         self._flush_checkpoint()
         self._shrink_once()
+        self.counters.count("retries")
 
     def on_watchdog_timeout(self, unit_index: int, attempt: int) -> bool:
         """Unit ``unit_index`` blew its deadline.  True = degrade and

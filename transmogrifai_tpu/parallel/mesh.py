@@ -34,8 +34,8 @@ __all__ = [
     "make_mesh", "make_sweep_mesh", "auto_grid_axis", "has_grid_axis",
     "data_sharding", "feature_sharding", "matrix_sharding",
     "sweep_matrix_sharding", "grid_sharding", "fold_weight_sharding",
-    "replicated", "shard_dataset", "pad_to_multiple", "shard_sweep_inputs",
-    "shard_map_compat", "next_shard_pad",
+    "chain_sharding", "replicated", "shard_dataset", "pad_to_multiple",
+    "shard_sweep_inputs", "shard_map_compat", "next_shard_pad",
 ]
 
 
@@ -151,6 +151,13 @@ def fold_weight_sharding(mesh: Mesh) -> NamedSharding:
     """A stacked (F, N) fold-weight matrix: folds replicated, rows over
     the data axis (matches the row sharding of the matrix it masks)."""
     return NamedSharding(mesh, P(None, mesh.axis_names[0]))
+
+
+def chain_sharding(mesh: Mesh) -> NamedSharding:
+    """A per-chain (S, N) row-state matrix (boosting margins, chain
+    weights) on a SWEEP mesh: chains over the grid axis, rows over the
+    data axis — the tree grid groups' placement."""
+    return NamedSharding(mesh, P(mesh.axis_names[1], mesh.axis_names[0]))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

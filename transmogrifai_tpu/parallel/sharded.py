@@ -31,7 +31,8 @@ from .mesh import (
 __all__ = ["TrainStepState", "full_train_step", "make_train_step",
            "fit_logreg_sharded", "grow_forest_sharded",
            "colstats_corr_sharded", "colstats_psum",
-           "fit_logreg_newton_psum", "histogram_psum"]
+           "fit_logreg_newton_psum", "histogram_psum",
+           "gbt_chain_rounds_sharded", "grow_rf_grid_sharded"]
 
 
 class TrainStepState(NamedTuple):
@@ -198,6 +199,252 @@ def grow_forest_sharded(binned: np.ndarray, Y: np.ndarray, BW: np.ndarray,
     if len(fs) == 1:
         return fs[0], ts[0], ls[0]
     return (jnp.concatenate(fs), jnp.concatenate(ts), jnp.concatenate(ls))
+
+
+# ---------------------------------------------------------------------------
+# Batched TREE sweeps on the ("data", "grid") mesh (ROADMAP item 2 / PR 11):
+# same-shape RF/GBT candidates ride the grid axis while rows shard over the
+# data axis — the tree analogue of the linear grid groups.  shard_map
+# bodies with EXPLICIT per-level histogram psums (the all_reduce path of
+# ``_grow_tree_traced``, which disables node compaction so every shard
+# agrees on the full 2^level slot layout); per-chain hyperparameter
+# vectors (depth limit, lambda, min_child_weight, eta, gamma / RF gate
+# params) commit P("grid"), the binned int8 matrix commits P("data",
+# None), and tree outputs replicate over data (identical split decisions
+# per shard — the grow_forest_sharded contract, extended to the grid).
+# Zero-weight pad rows/chains are inert, so results are invariant to both
+# paddings (TM024) and agree with the single-device batched programs
+# (TM025).
+# ---------------------------------------------------------------------------
+
+#: compiled shard_map programs per (mesh, static-config) — the sweep
+#: re-enters these once per es_chunk launch / tree chunk, and rebuilding
+#: the shard_map wrapper per call would re-trace every time
+_TREE_SWEEP_JITS: dict = {}
+
+
+def _mesh_cache_key(mesh: Mesh):
+    return (tuple(mesh.axis_names), tuple(sorted(mesh.shape.items())),
+            tuple(int(d.id) for d in np.asarray(mesh.devices).flat))
+
+
+def gbt_chain_rounds_sharded(binned, y, W, Fm0, yv, vi, depth_lim, lams,
+                             mcws, migs, mins_, lrs, mgrs, mesh: Mesh, *,
+                             n_rounds: int, max_depth: int, n_bins: int,
+                             obj: str, hist_bf16: bool = False,
+                             use_es: bool = False,
+                             skip_counts: bool = False, bundle_end=None,
+                             acc_bf16: bool = False):
+    """``n_rounds`` boosting rounds for S chains, chains sharded over the
+    grid axis and rows over the data axis — the mesh form of
+    ``gbdt_kernels._gbt_chain_rounds_jit`` with per-level histogram psums.
+
+    Inputs are COMMITTED device arrays: ``binned`` (N_pad, D) at
+    P("data", None), ``y`` (N_pad,) at P("data"), ``W``/``Fm0``
+    (S_pad, N_pad) at P("grid", "data"), the per-chain vectors (S_pad,)
+    at P("grid"); ``vi`` holds GLOBAL validation row indices (replicated)
+    whose margins each owning shard contributes and one psum gathers, so
+    the early-stopping metric sees exactly the single-device rows.
+    ``bundle_end`` is the host EFB end-bin table or None (the identity
+    table is used — bit-identical to the standard split form).  Returns
+    the same 5-tuple as the single-device kernel, chains still sharded.
+    """
+    from ..models.gbdt_kernels import (_chain_es_metric_val,
+                                       _grow_tree_traced,
+                                       _predict_tree_bundled)
+    from .mesh import shard_map_compat
+
+    data_axis, grid_axis = mesh.axis_names
+    be_host = (np.asarray(bundle_end, np.int32) if bundle_end is not None
+               else np.full((n_bins, int(binned.shape[1])), n_bins - 1,
+                            np.int32))
+    key = ("gbt", _mesh_cache_key(mesh), n_rounds, max_depth, n_bins, obj,
+           hist_bf16, use_es, skip_counts, acc_bf16)
+    fn = _TREE_SWEEP_JITS.get(key)
+    if fn is None:
+        psum_d = functools.partial(lax.psum, axis_name=data_axis)
+
+        def shard_fn(binned_s, y_s, W_s, Fm_s, yv_r, vi_r, be_r,
+                     dl, la, mc, mg, mi, lr_, mgr_):
+            nl, d = binned_s.shape
+            mask = jnp.ones(d, bool)
+            lo = lax.axis_index(data_axis) * nl
+
+            def round_step(Fm, _):
+                if obj == "binary":
+                    Pm = jax.nn.sigmoid(Fm)
+                    G = W_s * (Pm - y_s[None, :])
+                    H = W_s * jnp.maximum(Pm * (1 - Pm), 1e-6)
+                else:
+                    G = W_s * (Fm - y_s[None, :])
+                    H = W_s
+
+                def one(g, h, c, lim, lam_, mcw, mig, mi_, lrr, mgr):
+                    return _grow_tree_traced(
+                        binned_s, g[:, None], h[:, None], c, mask, lim,
+                        max_depth=max_depth, n_bins=n_bins, lam=lam_,
+                        min_child_weight=mcw, min_info_gain=mig,
+                        min_instances=mi_, newton_leaf=jnp.bool_(True),
+                        learning_rate=lrr, hist_bf16=hist_bf16,
+                        min_gain_raw=mgr, all_reduce=psum_d,
+                        bag_mode="newton" if skip_counts else "none",
+                        bundle_end=be_r, acc_bf16=acc_bf16)[:3]
+
+                f, t, lf = jax.vmap(one)(G, H, W_s, dl, la, mc, mg, mi,
+                                         lr_, mgr_)
+                inc = jax.vmap(lambda ff, tt, ll: _predict_tree_bundled(
+                    binned_s, ff, tt, ll, max_depth, be_r))(
+                    f, t, lf)[:, :, 0]
+                Fm = Fm + inc
+                if use_es:
+                    owned = (vi_r >= lo) & (vi_r < lo + nl)
+                    lvi = jnp.clip(vi_r - lo, 0, nl - 1)
+                    Z = psum_d(jnp.where(owned[None, :], Fm[:, lvi], 0.0))
+                    m = _chain_es_metric_val(Z, yv_r, obj)
+                else:
+                    m = jnp.zeros(Fm.shape[0], jnp.float32)
+                return Fm, (f, t, lf, m)
+
+            Fm_end, (fs, ts, lfs, ms) = lax.scan(round_step, Fm_s, None,
+                                                 length=n_rounds)
+            return Fm_end, fs, ts, lfs, ms
+
+        fn = jax.jit(shard_map_compat(
+            shard_fn, mesh,
+            (P(data_axis, None), P(data_axis),
+             P(grid_axis, data_axis), P(grid_axis, data_axis),
+             P(None), P(None), P(None, None),
+             P(grid_axis), P(grid_axis), P(grid_axis), P(grid_axis),
+             P(grid_axis), P(grid_axis), P(grid_axis)),
+            (P(grid_axis, data_axis), P(None, grid_axis, None),
+             P(None, grid_axis, None), P(None, grid_axis, None, None),
+             P(None, grid_axis))))
+        _TREE_SWEEP_JITS[key] = fn
+    return fn(binned, y, W, Fm0, yv, vi, jnp.asarray(be_host), depth_lim,
+              lams, mcws, migs, mins_, lrs, mgrs)
+
+
+def grow_rf_grid_sharded(binned, Y, W_tr, BWr, feat_idx, pair_fold,
+                         pair_min_ig, pair_min_inst, pair_depth,
+                         mesh: Mesh, *, n_trees: int, msub: int,
+                         n_bins: int, heap_depth: int, lam: float = 1e-3,
+                         min_child_weight: float = 0.0,
+                         onehot_targets: bool = False,
+                         leaf_levels=()):
+    """The mesh form of ``gbdt_kernels.grow_rf_grid``: every (candidate x
+    fold) pair's forest grown as chunked shard_map launches — the flat
+    tree axis (pair * n_trees + t) sharded over the GRID axis, rows over
+    the data axis, per-level histograms psum'd (node compaction off so
+    shards agree on slot layout — the ``grow_forest_sharded`` contract).
+
+    Bags come PRE-GENERATED (``rf_bags_and_features`` — the same
+    fold_in(seed, tree_id) stream as the on-device single-chip path, so
+    both grow identical forests): ``BWr`` (T, N_pad) Poisson bags with
+    zero on pad rows, committed P(None, "data") alongside the (F, N_pad)
+    fold weights; ``feat_idx`` (T, msub) replicated.  Returns HOST
+    (P, T, nodes)/(P, T, leaves, K) arrays (+ the depth-truncation
+    snapshot map when ``leaf_levels``), matching ``grow_rf_grid``.
+    """
+    from ..models.gbdt_kernels import (_accel_bf16, _grow_tree_traced,
+                                       forest_chunk_size)
+    from ..utils.profiling import count_launch
+    from .mesh import grid_sharding, shard_map_compat
+
+    data_axis, grid_axis = mesh.axis_names
+    g = int(mesh.shape[grid_axis])
+    n_pad, d = binned.shape
+    nl = n_pad // int(mesh.shape[data_axis])
+    k = Y.shape[1]
+    P_pairs = int(pair_fold.shape[0])
+    total = n_trees * P_pairs
+    hist_bf16 = _accel_bf16()
+    leaf_levels = tuple(sorted(set(int(v) for v in leaf_levels
+                                   if 0 < int(v) < heap_depth)))
+    chunk = forest_chunk_size(
+        total, heap_depth, msub, n_bins, k, n_rows=nl, compact=False,
+        n_channels=(k if onehot_targets else k + 1), d_full=d,
+        onehot_bytes=2 if hist_bf16 else 4)
+    chunk = max(g, (chunk // g) * g)
+
+    key = ("rf", _mesh_cache_key(mesh), chunk, heap_depth, n_bins, msub,
+           float(lam), float(min_child_weight), onehot_targets,
+           leaf_levels, hist_bf16)
+    fn = _TREE_SWEEP_JITS.get(key)
+    if fn is None:
+        psum_d = functools.partial(lax.psum, axis_name=data_axis)
+
+        def shard_fn(binned_s, Y_s, Wtr_s, BWr_s, fi, t_loc, fold,
+                     mig, mi, dep, valid):
+            bw = (Wtr_s[fold] * BWr_s[t_loc]
+                  * valid[:, None].astype(jnp.float32))
+            fi_l = fi[t_loc]
+
+            def one(bw_row, mig_, mi_, lim, fidx):
+                gm = bw_row[:, None] * Y_s
+                h = jnp.broadcast_to(bw_row[:, None], gm.shape)
+                return _grow_tree_traced(
+                    binned_s, gm, h, bw_row,
+                    jnp.ones(binned_s.shape[1], bool), lim,
+                    max_depth=heap_depth, n_bins=n_bins,
+                    lam=jnp.float32(lam),
+                    min_child_weight=jnp.float32(min_child_weight),
+                    min_info_gain=mig_, min_instances=mi_,
+                    newton_leaf=jnp.bool_(False),
+                    learning_rate=jnp.float32(1.0),
+                    hist_bf16=hist_bf16, all_reduce=psum_d,
+                    bag_mode="onehot" if onehot_targets else "bagged",
+                    feat_idx=fidx, leaf_levels=leaf_levels)
+
+            f, t, lf, snaps = jax.vmap(one)(bw, mig, mi, dep, fi_l)
+            return f, t, lf, snaps
+
+        fn = jax.jit(shard_map_compat(
+            shard_fn, mesh,
+            (P(data_axis, None), P(data_axis, None), P(None, data_axis),
+             P(None, data_axis), P(None, None),
+             P(grid_axis), P(grid_axis), P(grid_axis), P(grid_axis),
+             P(grid_axis), P(grid_axis)),
+            (P(grid_axis, None), P(grid_axis, None),
+             P(grid_axis, None, None),
+             tuple(P(grid_axis, None, None) for _ in leaf_levels))))
+        _TREE_SWEEP_JITS[key] = fn
+
+    gs = grid_sharding(mesh)
+    feats, threshs, leaves = [], [], []
+    snap_parts = [[] for _ in leaf_levels]
+    fi_dev = jnp.asarray(np.asarray(feat_idx, np.int32))
+    for s in range(0, total, chunk):
+        count_launch("rf_grid_chunk_sharded")
+        flat = np.arange(s, s + chunk)
+        t_loc = (flat % n_trees).astype(np.int32)
+        p_idx = np.minimum(flat // n_trees, P_pairs - 1)
+        args = [jax.device_put(np.ascontiguousarray(a), gs) for a in (
+            t_loc, np.asarray(pair_fold, np.int32)[p_idx],
+            np.asarray(pair_min_ig, np.float32)[p_idx],
+            np.asarray(pair_min_inst, np.float32)[p_idx],
+            np.asarray(pair_depth, np.int32)[p_idx],
+            (flat < total).astype(np.int32))]
+        f, t, lf, snaps = fn(binned, Y, W_tr, BWr, fi_dev, *args)
+        e = min(s + chunk, total)
+        feats.append(np.asarray(f)[: e - s])
+        threshs.append(np.asarray(t)[: e - s])
+        leaves.append(np.asarray(lf)[: e - s])
+        for li, sv in enumerate(snaps):
+            snap_parts[li].append(np.asarray(sv)[: e - s])
+    feats = np.concatenate(feats) if len(feats) > 1 else feats[0]
+    threshs = np.concatenate(threshs) if len(threshs) > 1 else threshs[0]
+    leaves = np.concatenate(leaves) if len(leaves) > 1 else leaves[0]
+    nodes = feats.shape[1]
+    out = (feats.reshape(P_pairs, n_trees, nodes),
+           threshs.reshape(P_pairs, n_trees, nodes),
+           leaves.reshape(P_pairs, n_trees, *leaves.shape[1:]))
+    if not leaf_levels:
+        return out
+    snap_map = {}
+    for lv, parts in zip(leaf_levels, snap_parts):
+        sv = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        snap_map[lv] = sv.reshape(P_pairs, n_trees, *sv.shape[1:])
+    return (*out, snap_map)
 
 
 # ---------------------------------------------------------------------------

@@ -16,8 +16,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["GridGroup", "LogRegGridGroup", "LinRegGridGroup",
-           "SoftmaxGridGroup", "RFGridGroup", "GBTGridGroup",
-           "make_grid_group"]
+           "SoftmaxGridGroup", "TreeGridGroup", "RFGridGroup",
+           "GBTGridGroup", "make_grid_group"]
 
 
 class GridGroup:
@@ -347,13 +347,86 @@ class SoftmaxGridGroup(_LinearGridGroup):
         return m.T
 
 
-class RFGridGroup(GridGroup):
+class TreeGridGroup(GridGroup):
+    """Shared mesh plumbing for the TREE-family batched groups (RF tree
+    streams, GBT lockstep chains): with a ("data", "grid") sweep mesh
+    attached, the SAME batched programs run sharded — the binned int8
+    matrix row-sharded ``P("data", None)``, per-candidate hyperparameter
+    vectors (num_trees cap via bag masking, depth limit,
+    min_child_weight, lambda, gate params) riding ``P("grid")`` with
+    last-candidate padding stripped, and per-level histograms psum'd over
+    the data axis (parallel/sharded.py ``grow_rf_grid_sharded`` /
+    ``gbt_chain_rounds_sharded``).  Until PR 11 tree families declined
+    the mesh and fell back to sequential mesh-sharded fits."""
+
+    supports_mesh = True
+
+    #: cost-model stage kind recorded per batched run (tuning/planner's
+    #: ``advise_mesh`` and the straggler watchdog consult these)
+    grid_stage_kind = ""
+
+    def _mesh_axes(self):
+        mesh = self.mesh
+        return (int(mesh.shape[mesh.axis_names[0]]),
+                int(mesh.shape[mesh.axis_names[1]]))
+
+    def _sharded_matrix(self, binned, tag: str):
+        """Row-pad a (device or host) binned matrix to tile the data axis
+        and commit it ``P("data", None)`` — content-memoized like every
+        other sweep upload."""
+        from ..models.trees import _dev_memo_sharded
+        from ..parallel.mesh import pad_to_multiple, sweep_matrix_sharding
+
+        ndata, _ = self._mesh_axes()
+        host, _pad = pad_to_multiple(np.asarray(binned), ndata, axis=0)
+        return (_dev_memo_sharded(host, sweep_matrix_sharding(self.mesh),
+                                  tag), host.shape[0])
+
+    def _record_grid_observation(self, wall_s: float, rows: int,
+                                 cols: int) -> None:
+        """Append a ``<family>:fit-grid`` stage observation to the shared
+        cost history so ``advise_mesh`` / the watchdog learn measured
+        tree-grid scaling.  Best-effort — telemetry must not break a
+        sweep."""
+        if not self.grid_stage_kind or wall_s <= 0:
+            return
+        try:
+            import time
+
+            from ..parallel.elastic import mesh_device_count
+            from ..tuning.costmodel import (StageObservation,
+                                            append_observations,
+                                            default_history_path)
+            from ..utils.profiling import backend_name
+
+            mesh_shape = ""
+            if self.mesh is not None:
+                mesh_shape = ",".join(
+                    f"{a}={int(self.mesh.shape[a])}"
+                    for a in self.mesh.axis_names)
+            append_observations(default_history_path(), [StageObservation(
+                stage_kind=self.grid_stage_kind, rows=int(rows),
+                cols=max(int(cols), 1), dtype="float32",
+                backend=backend_name(), wall_s=float(wall_s),
+                t=int(time.time()),
+                n_devices=mesh_device_count(self.mesh),
+                mesh_shape=mesh_shape)])
+        except Exception:
+            pass
+
+
+class RFGridGroup(TreeGridGroup):
     """Every (candidate x fold) random-forest fit as ONE chunked tree
     stream (``gbdt_kernels.grow_rf_grid``): per-tree traced
     (min_info_gain, min_instances, depth_limit) + fold-weight selection,
     identical randomness to the sequential per-candidate fits.  Covers
     binary, multiclass (one-hot targets, argmax scores against the
-    multiclass metric grid) and regression sweeps."""
+    multiclass metric grid) and regression sweeps.  On a sweep mesh the
+    same pair stream runs sharded (``grow_rf_grid_sharded``) with
+    PRE-GENERATED bags from the identical ``fold_in(seed, tree_id)``
+    generator, so mesh and single-chip sweeps grow the same forests."""
+
+    grid_stage_kind = "RandomForest:fit-grid"
 
     _batchable = ("max_depth", "min_info_gain", "min_instances_per_node")
     _static = ("num_trees", "max_bins", "subsample_rate",
@@ -370,15 +443,10 @@ class RFGridGroup(GridGroup):
         return self._uniform(self._static)
 
     def run(self, X, y, weight_ctxs):
-        if self.mesh is not None:
-            # tree grids decline on a sweep mesh: the chunked vmapped
-            # growth program is compiled for one chip's memory space —
-            # these units fall back to sequential fits whose estimators
-            # carry the mesh themselves (grow_forest_sharded psums
-            # per-shard histograms over the data axis)
-            return None
         if not self._batchable_params():
             return None
+        import time as _time
+
         import jax.numpy as jnp
 
         from ..evaluators.metrics import (_MULTI_GRID_METRICS,
@@ -412,8 +480,12 @@ class RFGridGroup(GridGroup):
         # sparse-aware prep: same sketch/memo keys as the GBT group and
         # the selector's prefetch thread, so one host sketch serves the
         # whole sweep (the CSR triple is unused here — RF histograms run
-        # at feature-subset width)
-        edges, binned, _ = _prep_tree_inputs_sparse(X, mb)
+        # at feature-subset width).  Weight-aware: zero-total-weight rows
+        # (mesh padding, balancer drops) never move the bin edges (TM024)
+        from ..models.trees import _prep_tree_inputs_weighted
+
+        edges, binned, _ = _prep_tree_inputs_weighted(
+            X, mb, row_weight=self._full_weights(weight_ctxs))
         n, d = X.shape
         if cls:
             Y = np.eye(n_classes, dtype=np.float32)[y.astype(int)]
@@ -474,15 +546,25 @@ class RFGridGroup(GridGroup):
         pair_ig = np.repeat([k[0] for k in base_keys], F)
         pair_inst = np.repeat([k[1] for k in base_keys], F)
         pair_depth = np.repeat(base_depth, F)
-        grown = grow_rf_grid(
-            binned, _dev_memo(Y, "rf_Y"), _dev_memo(W_tr, "rf_Wtr"),
-            seed=int(proto.seed), n_trees=T, pair_fold=pair_fold,
-            pair_min_ig=pair_ig, pair_min_inst=pair_inst,
-            pair_depth=pair_depth, msub=msub,
-            subsample_rate=float(self._param(self.grid_points[0],
-                                             "subsample_rate")),
-            n_bins=int(self._param(self.grid_points[0], "max_bins")),
-            onehot_targets=cls, leaf_levels=leaf_levels)
+        t0 = _time.perf_counter()
+        subsample = float(self._param(self.grid_points[0],
+                                      "subsample_rate"))
+        if self.mesh is not None:
+            grown = self._grow_pairs_sharded(
+                binned, Y, W_tr, seed=int(proto.seed), T=T,
+                pair_fold=pair_fold, pair_ig=pair_ig, pair_inst=pair_inst,
+                pair_depth=pair_depth, msub=msub, subsample=subsample,
+                mb=mb, cls=cls, leaf_levels=leaf_levels)
+        else:
+            grown = grow_rf_grid(
+                binned, _dev_memo(Y, "rf_Y"), _dev_memo(W_tr, "rf_Wtr"),
+                seed=int(proto.seed), n_trees=T, pair_fold=pair_fold,
+                pair_min_ig=pair_ig, pair_min_inst=pair_inst,
+                pair_depth=pair_depth, msub=msub,
+                subsample_rate=subsample,
+                n_bins=int(self._param(self.grid_points[0], "max_bins")),
+                onehot_targets=cls, leaf_levels=leaf_levels)
+        self._record_grid_observation(_time.perf_counter() - t0, n, d)
         feats, threshs, leaves = grown[:3]
         snap_map = grown[3] if leaf_levels else {}
         heap_depth = int(np.log2(feats.shape[2] + 1))
@@ -502,14 +584,14 @@ class RFGridGroup(GridGroup):
         parts = []
         full_idx = np.where(cp_full)[0]
         if len(full_idx):
-            sel = jnp.asarray(cp_base[full_idx])
+            sel = cp_base[full_idx]       # numpy: indexes device OR host
             parts.append(_score_pairs_jit(
                 binned, feats[sel], threshs[sel], leaves[sel],
                 heap_depth, mode, ptype))
             order.extend(full_idx.tolist())
         for dt in sorted(set(cp_depth[~cp_full].tolist())):
             idx = np.where(~cp_full & (cp_depth == dt))[0]
-            sel = jnp.asarray(cp_base[idx])
+            sel = cp_base[idx]
             nd = 2 ** dt - 1
             # the base trees' first dt levels ARE the depth-dt candidate's
             # splits; its leaves are the level-dt histogram-total snapshot
@@ -530,17 +612,20 @@ class RFGridGroup(GridGroup):
         del grown, feats, threshs, leaves, snap_map, parts
         # context for refit_model: the winner's full-train forest grows as
         # ONE more base pair through the same (cached) grid program, with
-        # identical randomness to a sequential full fit
-        self._refit_ctx = dict(
-            binned=binned, Y=Y, edges=edges, msub=msub, mb=mb, T=T,
-            cls=cls, k=Y.shape[1], heap_depth=heap_depth,
-            key2base=key2base, cand_key=cand_key, cand_depth=cand_depth,
-            base_depth=base_depth, base_keys=base_keys,
-            leaf_levels=leaf_levels,
-            full_w=self._full_weights(weight_ctxs),
-            seed=int(proto.seed),
-            subsample=float(self._param(self.grid_points[0],
-                                        "subsample_rate")))
+        # identical randomness to a sequential full fit.  Single-chip
+        # only: on a mesh the selector refits the winner sequentially
+        # with its own mesh attached (the sharded grid program's chunk
+        # shapes are sized for the whole pair stream, not one pair).
+        if self.mesh is None:
+            self._refit_ctx = dict(
+                binned=binned, Y=Y, edges=edges, msub=msub, mb=mb, T=T,
+                cls=cls, k=Y.shape[1], heap_depth=heap_depth,
+                key2base=key2base, cand_key=cand_key,
+                cand_depth=cand_depth,
+                base_depth=base_depth, base_keys=base_keys,
+                leaf_levels=leaf_levels,
+                full_w=self._full_weights(weight_ctxs),
+                seed=int(proto.seed), subsample=subsample)
         if multiclass:
             m = multiclass_metric_grid(y, scores, jnp.asarray(W_ev),
                                        n_classes, self.metric)
@@ -550,6 +635,48 @@ class RFGridGroup(GridGroup):
         if m is None:
             return None
         return m.T
+
+    def _grow_pairs_sharded(self, binned, Y, W_tr, *, seed: int, T: int,
+                            pair_fold, pair_ig, pair_inst, pair_depth,
+                            msub: int, subsample: float, mb: int,
+                            cls: bool, leaf_levels):
+        """The mesh leg of ``run``: rows padded + sharded over the data
+        axis, the flat (pair x tree) stream over the grid axis, bags
+        pre-generated from the SAME fold_in(seed, tree_id) stream as the
+        on-device single-chip generator (``rf_bags_and_features``)."""
+        from ..models.gbdt_kernels import (_resolve_compile_depth,
+                                           rf_bags_and_features)
+        from ..models.trees import _dev_memo_sharded
+        from ..parallel.mesh import fold_weight_sharding, pad_to_multiple
+        from ..parallel.sharded import grow_rf_grid_sharded
+
+        mesh = self.mesh
+        ndata, _g = self._mesh_axes()
+        n = int(np.asarray(W_tr).shape[1])
+        d = int(binned.shape[1])
+        binned_dev, _n_pad = self._sharded_matrix(binned, "rf_grid_binned")
+        Y_p, _ = pad_to_multiple(np.asarray(Y, np.float32), ndata, axis=0)
+        Wtr_p, _ = pad_to_multiple(
+            np.ascontiguousarray(np.asarray(W_tr, np.float32)), ndata,
+            axis=1)
+        BWr, feat_idx = rf_bags_and_features(seed, T, n, d, msub,
+                                             subsample)
+        BWr_p, _ = pad_to_multiple(np.asarray(BWr, np.float32), ndata,
+                                   axis=1)
+        from ..parallel.mesh import sweep_matrix_sharding
+
+        Y_dev = _dev_memo_sharded(Y_p, sweep_matrix_sharding(mesh),
+                                  "rf_grid_Y")
+        fw = fold_weight_sharding(mesh)
+        Wtr_dev = _dev_memo_sharded(Wtr_p, fw, "rf_grid_Wtr")
+        BWr_dev = _dev_memo_sharded(BWr_p, fw, "rf_grid_BWr")
+        heap_depth = _resolve_compile_depth(
+            max(int(np.asarray(pair_depth).max()), 1))
+        return grow_rf_grid_sharded(
+            binned_dev, Y_dev, Wtr_dev, BWr_dev, feat_idx,
+            pair_fold, pair_ig, pair_inst, pair_depth, mesh,
+            n_trees=T, msub=msub, n_bins=mb, heap_depth=heap_depth,
+            onehot_targets=cls, leaf_levels=leaf_levels)
 
     def refit_model(self, row: int):
         """Full-train refit of candidate ``row`` as ONE extra base pair.
@@ -627,7 +754,7 @@ def _score_pairs_jit(binned, feats, threshs, leaves, heap_depth: int,
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
-class GBTGridGroup(GridGroup):
+class GBTGridGroup(TreeGridGroup):
     """Every (candidate x fold) boosting chain advanced in lockstep.
 
     Each round grows ALL chains' trees in one vmapped launch — the
@@ -639,7 +766,15 @@ class GBTGridGroup(GridGroup):
     are traced per-tree vectors; early stopping replays the reference's
     patience logic per chain from chunked metric fetches
     (OpXGBoostClassifier.scala:47 ES semantics).
+
+    The tree fast path composes here: EFB shrinks the shared histogram
+    width before any launch (splits unbundle before scoring), GOSS
+    engages for all-deep single-chip grids, and on a sweep mesh the SAME
+    lockstep rounds run sharded (``gbt_chain_rounds_sharded`` — chains
+    over the grid axis, rows over data, psum'd histograms).
     """
+
+    grid_stage_kind = "GBT:fit-grid"
 
     def _chains(self):
         """Resolved per-candidate estimator copies (attribute-level params,
@@ -647,10 +782,8 @@ class GBTGridGroup(GridGroup):
         return [self.proto.copy(**p) for p in self.grid_points]
 
     def run(self, X, y, weight_ctxs):
-        if self.mesh is not None:
-            # lockstep chains decline on a sweep mesh (single-chip
-            # program); units fall back to sequential mesh-sharded fits
-            return None
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
@@ -679,7 +812,30 @@ class GBTGridGroup(GridGroup):
 
         y = np.nan_to_num(np.asarray(y, np.float32))
         n = len(y)
-        edges, binned, csr = _prep_tree_inputs_sparse(X, e0.max_bins)
+        t0 = _time.perf_counter()
+        # weight-aware sketch: zero-total-weight rows (mesh padding under
+        # the TM024 contract, balancer drops) must not move the bin edges
+        from ..models.trees import _prep_tree_inputs_weighted
+
+        edges, binned, csr = _prep_tree_inputs_weighted(
+            X, e0.max_bins, row_weight=self._full_weights(weight_ctxs))
+        # EFB: pack the mutually exclusive one-hot/picklist columns into
+        # shared histogram columns BEFORE any launch (both the single-chip
+        # and the sharded path grow in bundled space; splits unbundle
+        # before scoring, which routes on the original matrix)
+        binned_orig = binned
+        bundles = None
+        bend = None
+        if csr is None:
+            from ..models.trees import (_as_f32, _content_hash,
+                                        _efb_enabled, _maybe_bundle)
+
+            if _efb_enabled():
+                eb = _maybe_bundle(_content_hash(_as_f32(X)), edges,
+                                   binned, int(e0.max_bins))
+                if eb is not None:
+                    bundles, binned, bend = eb
+        d_hist = int(binned.shape[1])
         W_tr, W_ev = self._stack_weights(weight_ctxs)
         F = W_tr.shape[0]
         C = len(ests)
@@ -729,10 +885,14 @@ class GBTGridGroup(GridGroup):
             base = ((W_full @ y) / np.maximum(W_full.sum(axis=1), 1e-9)
                     ).astype(np.float32)
 
-        yj = _dev_memo(y, "gbt_y")
-        Wj = _dev_memo(W_train, "gbt_Wtr")
         base_j = jnp.asarray(base)
-        Fm = jnp.broadcast_to(base_j[:, None], (S, n)).astype(jnp.float32)
+        if self.mesh is None:
+            yj = _dev_memo(y, "gbt_y")
+            Wj = _dev_memo(W_train, "gbt_Wtr")
+            Fm = jnp.broadcast_to(base_j[:, None],
+                                  (S, n)).astype(jnp.float32)
+        else:
+            yj = Wj = Fm = None            # placed sharded below
         vi = (jnp.asarray(np.where(val)[0], jnp.int32)
               if use_es and val.any() else None)
 
@@ -744,23 +904,37 @@ class GBTGridGroup(GridGroup):
         es_chunk = max(1, min(8, e0.early_stopping_rounds or 8))
         from ..models.gbdt_kernels import (_gbt_chain_rounds_jit,
                                            default_dir_mask, gbt_chain_chunk,
+                                           goss_plan, hist_accum_bf16,
                                            seg_hist_auto)
 
         # default-direction splits only on features whose bin 0 is a real
-        # missing/zero bucket (sparse-aware pinned edge)
-        dd = (jnp.asarray(default_dir_mask(edges))
-              if e0.sparse_default_direction else None)
+        # missing/zero bucket (sparse-aware pinned edge); bundle columns
+        # never learn a default direction (no single-feature map-back)
+        dd_host = (default_dir_mask(edges)
+                   if e0.sparse_default_direction else None)
+        if bundles is not None and dd_host is not None:
+            dd_host = bundles.bundled_dd_mask(dd_host)
+        dd = jnp.asarray(dd_host) if dd_host is not None else None
+
+        # GOSS for all-deep single-chip grids (the sharded path keeps all
+        # rows — a distributed |grad| top-k is not worth the collectives)
+        goss = (goss_plan(n, min(int(e.max_depth) for e in ests))
+                if self.mesh is None else None)
+        acc = hist_accum_bf16()
 
         # segmented histograms at headline row counts (statically resolved
         # so it keys the jit cache).  Chain count matters: dense shares its
         # bins one-hot across vmapped chains, so seg only wins when the
         # HBM budget (or the grid) leaves <= SEG_MAX_CHAINS per launch
-        chunk_dense = gbt_chain_chunk(S, heap_depth, X.shape[1],
+        chunk_dense = gbt_chain_chunk(S, heap_depth, d_hist,
                                       int(e0.max_bins), n)
         seg = seg_hist_auto(n, n_chains=min(chunk_dense, S))
-        chunk = (gbt_chain_chunk(S, heap_depth, X.shape[1],
+        chunk = (gbt_chain_chunk(S, heap_depth, d_hist,
                                  int(e0.max_bins), n, seg_hist=True)
                  if seg else chunk_dense)
+        if goss is not None:
+            csr, seg = None, False
+            chunk = chunk_dense
         run_es = use_es and vi is not None
         vi_arr = vi if vi is not None else jnp.zeros(1, jnp.int32)
         bf16 = e0._hist_bf16()   # backend-resolved: part of the jit key
@@ -777,17 +951,77 @@ class GBTGridGroup(GridGroup):
         # max_iter or past a chain's stop are masked out of the final
         # scoring, exactly like the ES trim; patience replay only ever sees
         # rounds ≤ max_iter, so selection matches the per-round loop.
+        if self.mesh is not None:
+            # sweep-mesh placement: binned P("data", None), per-chain
+            # row state P("grid", "data"), hyperparameter vectors
+            # P("grid") padded by repeating the last chain (stripped
+            # from every consumer below).  Chains are NOT sub-chunked on
+            # the mesh path: per-device histogram memory is already
+            # divided by the data axis, and a chain slice would have to
+            # re-tile the grid axis per block.
+            from ..parallel.mesh import (chain_sharding, data_sharding,
+                                         pad_to_multiple)
+            from ..parallel.sharded import gbt_chain_rounds_sharded
+            from ..models.trees import _dev_memo_sharded
+
+            mesh = self.mesh
+            ndata, g_ax = self._mesh_axes()
+            c_pad = (-S) % g_ax
+
+            def padc(a):
+                a = np.asarray(a)
+                if not c_pad:
+                    return a
+                return np.concatenate([a, np.repeat(a[-1:], c_pad,
+                                                    axis=0)])
+
+            binned_sh, n_pad = self._sharded_matrix(binned,
+                                                    "gbt_grid_binned")
+            y_p, _ = pad_to_multiple(y, ndata)
+            y_sh = _dev_memo_sharded(y_p, data_sharding(mesh),
+                                     "gbt_grid_y")
+            Wp, _ = pad_to_multiple(
+                np.ascontiguousarray(padc(W_train)), ndata, axis=1)
+            cs = chain_sharding(mesh)
+            Wj = _dev_memo_sharded(Wp, cs, "gbt_grid_W")
+            Fm = jax.device_put(np.ascontiguousarray(np.broadcast_to(
+                padc(base)[:, None], Wp.shape).astype(np.float32)), cs)
+            from ..parallel.mesh import grid_sharding
+
+            gs = grid_sharding(mesh)
+
+            def gvec(a):
+                return jax.device_put(
+                    np.ascontiguousarray(padc(np.asarray(a))), gs)
+
+            vecs_sh = tuple(gvec(v) for v in (depth_lim, lams, mcws,
+                                              migs, mins_, lrs, mgrs))
+            yv_dev = (jnp.asarray(y[np.asarray(vi)]) if run_es
+                      else jnp.zeros(1, jnp.float32))
         feats_b, threshs_b, leaves_b = [], [], []
         n_rounds = 0
         for ci in range(-(-e0.max_iter // es_chunk)):
-            if chunk >= S:
+            if self.mesh is not None:
+                count_launch("gbt_chain_rounds_sharded")
+                Fm, fs, ts, lfs, ms = gbt_chain_rounds_sharded(
+                    binned_sh, y_sh, Wj, Fm, yv_dev, vi_arr, *vecs_sh,
+                    self.mesh, n_rounds=es_chunk, max_depth=heap_depth,
+                    n_bins=int(e0.max_bins), obj=obj, hist_bf16=bf16,
+                    use_es=run_es, skip_counts=skip_counts,
+                    bundle_end=(bundles.end_bin if bundles is not None
+                                else None), acc_bf16=acc)
+            elif chunk >= S:
                 count_launch("gbt_chain_rounds")
                 Fm, fs, ts, lfs, ms = _gbt_chain_rounds_jit(
                     binned, yj, Wj, Fm, vi_arr, depth_lim, lams, mcws, migs,
                     mins_, lrs, mgrs, es_chunk, heap_depth,
                     int(e0.max_bins), obj, bf16, run_es, csr=csr,
                     skip_counts=skip_counts, seg_hist=seg,
-                    default_dir=e0.sparse_default_direction, dd_mask=dd)
+                    default_dir=e0.sparse_default_direction, dd_mask=dd,
+                    bundle_end=bend, acc_bf16=acc, goss=goss,
+                    goss_seed=jnp.int32(e0.seed),
+                    chain_ids=jnp.arange(S, dtype=jnp.int32),
+                    round_offset=jnp.int32(n_rounds))
             else:
                 parts = []
                 for s0 in range(0, S, chunk):
@@ -801,7 +1035,10 @@ class GBTGridGroup(GridGroup):
                         int(e0.max_bins), obj, bf16, run_es, csr=csr,
                         skip_counts=skip_counts, seg_hist=seg,
                         default_dir=e0.sparse_default_direction,
-                        dd_mask=dd))
+                        dd_mask=dd, bundle_end=bend, acc_bf16=acc,
+                        goss=goss, goss_seed=jnp.int32(e0.seed),
+                        chain_ids=jnp.arange(s0, s1, dtype=jnp.int32),
+                        round_offset=jnp.int32(n_rounds)))
                 Fm = jnp.concatenate([p[0] for p in parts])
                 fs = jnp.concatenate([p[1] for p in parts], axis=1)
                 ts = jnp.concatenate([p[2] for p in parts], axis=1)
@@ -817,7 +1054,8 @@ class GBTGridGroup(GridGroup):
                 # (its device values are long since finished, so the sync
                 # is ~free); decisions lag one chunk, the extra rounds are
                 # trimmed by the masked scoring below.
-                pending = [(start + j + 1, ms[j]) for j in range(es_chunk)
+                pending = [(start + j + 1, ms[j][:S])
+                           for j in range(es_chunk)
                            if start + j + 1 <= e0.max_iter]
                 if _replay_es(lagged, stopped, best_metric, best_len,
                               stall, e0.early_stopping_rounds):
@@ -838,20 +1076,45 @@ class GBTGridGroup(GridGroup):
         # shapes — per-chain trimmed stacks meant up to S distinct
         # predict_ensemble compiles plus R*S per-round device slices
         R = n_rounds
-        feats_all = jnp.concatenate(feats_b).transpose(1, 0, 2)  # (S, R, nd)
-        threshs_all = jnp.concatenate(threshs_b).transpose(1, 0, 2)
-        leaves_all = jnp.concatenate(leaves_b).transpose(1, 0, 2, 3)
-        keep = (jnp.arange(R)[None, :]
-                < jnp.asarray(best_len)[:, None])               # (S, R)
-        leaves_m = leaves_all * keep[:, :, None, None]
+        if self.mesh is not None or bundles is not None:
+            # host tree stacks: grid-sharded chain axes gather to host
+            # (bounded — trees are tens of MB), and EFB splits unbundle
+            # back to ORIGINAL columns so the scoring predicts route on
+            # the original binned matrix
+            feats_all = np.concatenate(
+                [np.asarray(f) for f in feats_b]).transpose(1, 0, 2)[:S_val]
+            threshs_all = np.concatenate(
+                [np.asarray(t) for t in threshs_b]
+            ).transpose(1, 0, 2)[:S_val]
+            leaves_all = np.concatenate(
+                [np.asarray(lv) for lv in leaves_b]
+            ).transpose(1, 0, 2, 3)[:S_val]
+            if bundles is not None:
+                from ..models.gbdt_kernels import unbundle_ensemble
+
+                feats_all, threshs_all = unbundle_ensemble(
+                    bundles, feats_all, threshs_all)
+            keep = np.arange(R)[None, :] < best_len[:S_val, None]
+            leaves_m = leaves_all * keep[:, :, None, None]
+            binned_sc = binned_orig
+        else:
+            feats_all = jnp.concatenate(feats_b).transpose(1, 0, 2)
+            threshs_all = jnp.concatenate(threshs_b).transpose(1, 0, 2)
+            leaves_all = jnp.concatenate(leaves_b).transpose(1, 0, 2, 3)
+            keep = (jnp.arange(R)[None, :]
+                    < jnp.asarray(best_len)[:, None])           # (S, R)
+            leaves_m = leaves_all * keep[:, :, None, None]
+            binned_sc = binned
         scores = []
         for s in range(S_val):
             count_launch("gbt_chain_score")
-            raw = predict_ensemble(binned, feats_all[s], threshs_all[s],
+            raw = predict_ensemble(binned_sc, feats_all[s], threshs_all[s],
                                    leaves_m[s], heap_depth)[:, 0]
             z = raw + base_j[s]
             scores.append(jax.nn.sigmoid(z) if obj == "binary" else z)
         scores = jnp.stack(scores).reshape(C, F, n).transpose(1, 0, 2)
+        self._record_grid_observation(_time.perf_counter() - t0, n,
+                                      int(X.shape[1]))
         # release the per-round tree stacks, margins and masked leaves
         # before the metric grid runs (see RFGridGroup.run note); the last
         # chunk's loop locals pin device buffers too

@@ -251,6 +251,11 @@ class ModelSelector(PredictorEstimator):
         from ..parallel.elastic import ElasticContext, shrink_mesh
 
         def shrink() -> bool:
+            # the tree-prep prefetch thread must not outlive the mesh it
+            # may be uploading against: cancel + join BEFORE re-pointing
+            # the live mesh at the shrunk one (ISSUE 11 satellite — an
+            # aborting sweep used to leave the daemon running)
+            self._drain_tree_prefetch()
             new = shrink_mesh(self.mesh)
             changed = (new is not self.mesh
                        and (new is None or self.mesh is None
@@ -281,14 +286,33 @@ class ModelSelector(PredictorEstimator):
         kind = ("ModelSelector:fit-halving" if self.strategy == "halving"
                 else "ModelSelector:fit")
         backend = backend_name()
-        if cm.source(kind, backend) != "fitted":
-            return None               # cold tier: watchdog stays off
+        # tree grid units record their own stage kinds (RandomForest:
+        # fit-grid / GBT:fit-grid) — when those tiers are warm the
+        # watchdog sees tree grid units even before the selector-level
+        # tier is; deadlines sum over whichever kinds are fitted
+        kinds = [kind] + [k for k in self._tree_grid_kinds()]
+        fitted = [k for k in kinds if cm.source(k, backend) == "fitted"]
+        if not fitted:
+            return None               # all tiers cold: watchdog stays off
         from ..parallel.elastic import mesh_device_count
 
-        total = cm.predict(kind, n_rows, n_cols, backend=backend,
-                           n_devices=mesh_device_count(self.mesh))
+        total = sum(cm.predict(k, n_rows, n_cols, backend=backend,
+                               n_devices=mesh_device_count(self.mesh))
+                    for k in fitted)
         return max(float(self.watchdog) * total / max(queue_width, 1),
                    1e-3)
+
+    def _tree_grid_kinds(self) -> List[str]:
+        """The tree-grid cost-model stage kinds present in this grid."""
+        from ..models.trees import _GBTBase, _RandomForestBase
+
+        kinds = []
+        for proto, _pts in self.models_and_params:
+            if isinstance(proto, _RandomForestBase):
+                kinds.append("RandomForest:fit-grid")
+            elif isinstance(proto, _GBTBase):
+                kinds.append("GBT:fit-grid")
+        return sorted(set(kinds))
 
     # -- validation plumbing -------------------------------------------------
 
@@ -618,10 +642,13 @@ class ModelSelector(PredictorEstimator):
 
         from ..utils.profiling import current_collector
         coll = current_collector()   # collector is thread-local: capture now
+        cancel = threading.Event()
 
         def work():
             t0 = _time.perf_counter()
             for mb in bins:
+                if cancel.is_set():   # elastic teardown: stop between bins
+                    return
                 try:
                     _prep_tree_inputs_sparse(X, mb)
                 except Exception:   # prep errors surface on the sweep path
@@ -632,8 +659,29 @@ class ModelSelector(PredictorEstimator):
 
         t = threading.Thread(target=work, name="tree-prep-prefetch",
                              daemon=True)
+        # retained so the elastic teardown / end-of-fit paths can join it:
+        # a daemon prep thread must never outlive a shrunk mesh (its
+        # device work would land on dead devices) or the fit itself
+        self._prep_thread = t
+        self._prep_cancel = cancel
         t.start()
         return t
+
+    def _drain_tree_prefetch(self, timeout_s: float = 30.0) -> None:
+        """Cancel + join the tree-prep prefetch thread (no-op when none
+        is running).  Called from the elastic shrink hook BEFORE the mesh
+        is re-pointed and from the fit's teardown, so no daemon prep work
+        outlives the sweep that started it."""
+        t = getattr(self, "_prep_thread", None)
+        if t is None:
+            return
+        cancel = getattr(self, "_prep_cancel", None)
+        if cancel is not None:
+            cancel.set()
+        if t.is_alive():
+            t.join(timeout_s)
+        self._prep_thread = None
+        self._prep_cancel = None
 
     def fit_columns(self, data: ColumnarDataset, label_col: FeatureColumn,
                     features_col: FeatureColumn):
@@ -662,6 +710,10 @@ class ModelSelector(PredictorEstimator):
             return self._fit_columns_inner(
                 X, y, n, splitter, train_mask, holdout_idx, base_w)
         finally:
+            # join the tree-prep prefetch daemon whether the sweep
+            # finished or aborted (device loss, checkpoint mismatch,
+            # every-candidate failure): no prep work may outlive the fit
+            self._drain_tree_prefetch()
             self.mesh = prev_mesh
 
     def _fit_columns_inner(self, X, y, n, splitter, train_mask,

@@ -435,6 +435,16 @@ class SweepWorkQueue:
         sequentially on the surviving devices)."""
         group = self.units[i].group
         try:
+            # the per-unit fault points fire for every member, so a fault
+            # plan written against unit indices keeps working when those
+            # units pack into ONE batched block (since PR 11 the tree
+            # families batch too — a grouped sweep may run no
+            # per-unit attempts at all)
+            from ..utils import faults
+
+            for k in range(i, j):
+                faults.fire("device.loss", index=self.units[k].index,
+                            tag=self.units[k].name)
             return self._run_group(group)
         except Exception as e:  # noqa: BLE001 - fall back per-candidate,
             # routed through the shared device-loss classifier

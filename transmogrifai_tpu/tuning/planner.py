@@ -140,8 +140,12 @@ class MeshAdvice:
 #: dispatch-bound, not FLOP-bound)
 MESH_MIN_ELEMS = 1 << 22
 
-#: mesh-fit stage kinds the scaling prediction consults
-_MESH_KINDS = ("ModelSelector:fit", "ModelSelector:fit-halving")
+#: mesh-fit stage kinds the scaling prediction consults — the selector
+#: totals plus the tree grid units (grid_groups records RandomForest:
+#: fit-grid / GBT:fit-grid per batched run since PR 11, so advise_mesh
+#: sees measured tree-grid scaling as soon as one sweep has run)
+_MESH_KINDS = ("ModelSelector:fit", "ModelSelector:fit-halving",
+               "RandomForest:fit-grid", "GBT:fit-grid")
 
 
 def advise_mesh(rows: int, cols: int, queue_width: int,
