@@ -187,8 +187,10 @@ def main():
     with tempfile.TemporaryDirectory(prefix="tmog_elastic_") as tmp:
         result = run_matrix(tmp)
     if not args.smoke:
+        from transmogrifai_tpu.obs import bench_meta
         from transmogrifai_tpu.utils.jsonio import write_json_atomic
 
+        result["meta"] = bench_meta()
         write_json_atomic(
             os.path.join(_ROOT, "benchmarks", "elastic_latest.json"),
             result, indent=2, sort_keys=True)

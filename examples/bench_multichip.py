@@ -324,6 +324,8 @@ def main():
             args.rows, args.cols)
         result["_budget"] = budgeter.to_json()
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        from transmogrifai_tpu.obs import bench_meta
+        result["meta"] = bench_meta()
         write_json_atomic(OUT_PATH, result, indent=2, sort_keys=True)
     result["parity_ok"] = parity_ok
     print(json.dumps(result))

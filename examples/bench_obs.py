@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Observability benchmark + OBS_SMOKE gate (docs/observability.md).
+
+What it proves, in one run:
+
+* **Traced train** — a 1x titanic-shaped ``OpWorkflow.train`` under
+  ``obs.start_trace`` produces a span tree (workflow → plan layers →
+  stages) whose Chrome-trace export VALIDATES
+  (``obs.validate_chrome_trace``; the file loads in ``chrome://tracing``),
+  whose flight-recorder ring dumps as parseable JSONL, and whose
+  ``StageProfile`` records carry non-empty compiled-program (HLO
+  cost-analysis) features on at least one device stage.
+* **Traced serve** — the trained model served through ``ModelServer`` +
+  the stdlib HTTP front end answers a real scoring request with serve
+  spans recorded, and ``GET /metrics?format=prometheus`` returns a text
+  exposition that PARSES (``obs.parse_exposition``).
+* **Disabled-path overhead** — with tracing off (the production default),
+  the per-hook cost times the train's hook count stays under
+  ``MAX_DISABLED_FRAC`` (1%) of the measured untraced train wall — the
+  ``lint_wall_s``-style contract that the instrumentation is off-path
+  when disabled.
+
+Writes ``benchmarks/obs_latest.json`` (skipped under ``--smoke``) and
+prints one JSON line with ``"ok"``.  ``--smoke`` is the tier1.sh
+OBS_SMOKE step.
+"""
+import argparse
+import http.client
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+MAX_DISABLED_FRAC = 0.01
+
+
+def traced_train(df):
+    """One traced train; returns (tracer, model, problems, hlo_stages)."""
+    from bench_pipeline import titanic_features
+
+    from transmogrifai_tpu import OpWorkflow, obs
+
+    survived, checked = titanic_features()
+    wf = OpWorkflow().set_result_features(checked).set_input_data(df)
+    tracer = obs.start_trace("bench_obs.train")
+    try:
+        model = wf.train(profile=True)
+    finally:
+        obs.stop_trace()
+    doc = obs.to_chrome_trace(tracer)
+    problems = obs.validate_chrome_trace(doc)
+    hlo_stages = [sp for sp in model.train_profile.stages if sp.hlo]
+    return tracer, model, doc, problems, hlo_stages
+
+
+def traced_serve(model_path, row):
+    """Serve one scoring request over HTTP under tracing; returns
+    (serve_span_count, prometheus_sample_count, score_ok)."""
+    from transmogrifai_tpu import obs
+    from transmogrifai_tpu.serving import ModelServer
+    from transmogrifai_tpu.serving.http import make_http_server
+    import threading
+
+    server = ModelServer.from_path(model_path, name="obs",
+                                   warmup_row=dict(row))
+    tracer = obs.start_trace("bench_obs.serve")
+    try:
+        with server:
+            httpd = make_http_server(server, port=0)  # free port
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            try:
+                port = httpd.server_address[1]
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                conn.request("POST", "/score",
+                             body=json.dumps({"rows": [row]}),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                scores = json.loads(resp.read())
+                score_ok = (resp.status == 200
+                            and len(scores.get("scores", [])) == 1)
+                conn.request("GET", "/metrics?format=prometheus")
+                resp = conn.getresponse()
+                text = resp.read().decode()
+                conn.close()
+                assert resp.status == 200, resp.status
+                samples = obs.parse_exposition(text)
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+    finally:
+        obs.stop_trace()
+    serve_spans = [s for s in tracer.snapshot() if s.cat == "serve"]
+    return len(serve_spans), len(samples), score_ok
+
+
+def disabled_overhead(df):
+    """(untraced train wall, estimated disabled-hook seconds, fraction)."""
+    from bench_pipeline import titanic_features
+
+    from transmogrifai_tpu import OpWorkflow, obs
+
+    survived, checked = titanic_features()
+    wf = OpWorkflow().set_result_features(checked).set_input_data(df)
+    t0 = time.perf_counter()
+    model = wf.train(profile=True)
+    train_s = time.perf_counter() - t0
+    n_hooks = 2 * len(model.train_profile.stages) + 16
+    obs_s = obs.estimate_disabled_overhead_s(n_hooks)
+    return train_s, obs_s, obs_s / train_s
+
+
+def run(smoke: bool) -> dict:
+    from bench_pipeline import make_titanic_like
+
+    from transmogrifai_tpu import obs
+
+    rows = 891 if smoke else 891 * 4
+    df = make_titanic_like(rows)
+    ok = True
+    notes = []
+
+    tracer, model, doc, problems, hlo_stages = traced_train(df)
+    if problems:
+        ok = False
+        notes.append(f"chrome trace invalid: {problems[:3]}")
+    if not hlo_stages:
+        ok = False
+        notes.append("no stage carried HLO cost-analysis features")
+    with tempfile.TemporaryDirectory() as tmp:
+        # flight JSONL round-trip
+        jsonl = os.path.join(tmp, "flight.jsonl")
+        n_events = tracer.flight.dump_jsonl(jsonl)
+        with open(jsonl) as f:
+            parsed_events = [json.loads(line) for line in f]
+        if len(parsed_events) != n_events:
+            ok = False
+            notes.append("flight JSONL round-trip mismatch")
+        # trace file loads through the CLI summarizer path
+        from transmogrifai_tpu.utils.jsonio import write_json_atomic
+
+        trace_path = os.path.join(tmp, "train_trace.json")
+        write_json_atomic(trace_path, doc)
+        if obs.summarize_file(trace_path) is None:
+            ok = False
+            notes.append("tmog-trace summary rejected the export")
+
+        model_path = os.path.join(tmp, "model")
+        model.save(model_path)
+        row = {"Pclass": "1", "Name": "Obs Smoke", "Sex": "male",
+               "Age": 30.0, "SibSp": 1.0, "Parch": 0.0, "Ticket": "T1",
+               "Fare": 20.0, "Cabin": None, "Embarked": "S"}
+        serve_spans, prom_samples, score_ok = traced_serve(model_path, row)
+    if serve_spans < 3 or not score_ok:
+        ok = False
+        notes.append(f"serve path under-traced: {serve_spans} spans, "
+                     f"score_ok={score_ok}")
+
+    train_s, obs_s, frac = disabled_overhead(df)
+    if frac >= MAX_DISABLED_FRAC:
+        ok = False
+        notes.append(f"disabled-path overhead {frac:.4%} >= "
+                     f"{MAX_DISABLED_FRAC:.0%} of train wall")
+
+    return {
+        "metric": "obs_disabled_overhead_frac_of_train",
+        "value": round(frac, 6),
+        "unit": "fraction",
+        "ok": ok,
+        "notes": notes,
+        "spans": len(tracer.spans),
+        "flight_events": n_events,
+        "hlo_stages": len(hlo_stages),
+        "prometheus_samples": prom_samples,
+        "serve_spans": serve_spans,
+        "train_s": round(train_s, 3),
+        "obs_disabled_s": round(obs_s, 6),
+        "rows": rows,
+        "meta": obs.bench_meta(),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="1x scale, no benchmarks/ write (tier1 gate)")
+    args = ap.parse_args()
+    out = run(args.smoke)
+    if not args.smoke:
+        from transmogrifai_tpu.utils.jsonio import write_json_atomic
+        write_json_atomic(
+            os.path.join(_ROOT, "benchmarks", "obs_latest.json"), out)
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

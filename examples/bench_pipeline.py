@@ -214,6 +214,14 @@ def run_scale(mult: int, trials: int) -> dict:
     # cost of the default-on train(validate=True) static DAG lint — the
     # bench contract keeps it <1% of train wall at every scale
     lint_s = (model.lint_snapshot.wall_s if model.lint_snapshot else 0.0)
+    # cost of the DISABLED obs/ tracing hooks this train just paid
+    # (lint_wall_s-style emitted fraction, gated <1% by OBS_SMOKE):
+    # hook sites ≈ one span begin/end + one event check per stage, plus
+    # the layer/root spans — measured per-hook cost x that count
+    from transmogrifai_tpu import obs
+
+    n_hooks = 2 * len(model.train_profile.stages) + 16
+    obs_s = obs.estimate_disabled_overhead_s(n_hooks)
     t0 = time.perf_counter()
     scored = model.score()
     score_s = time.perf_counter() - t0
@@ -240,6 +248,8 @@ def run_scale(mult: int, trials: int) -> dict:
         "train_s": round(train_s, 3),
         "lint_s": round(lint_s, 5),
         "lint_frac_of_train": round(lint_s / train_s, 5),
+        "obs_disabled_s": round(obs_s, 6),
+        "obs_frac_of_train": round(obs_s / train_s, 6),
         "score_s": round(score_s, 3),
         "scored_rows": len(scored),
         "aupr": round(float(metrics["AuPR"]), 4),
@@ -271,10 +281,13 @@ def main():
         "peak_columns_pruned": top.get("peak_columns_pruned"),
         "peak_columns_baseline": top.get("peak_columns_baseline"),
         "lint_frac_of_train": top.get("lint_frac_of_train"),
+        "obs_frac_of_train": top.get("obs_frac_of_train"),
         "backend": jax.default_backend(),
         "rows_1x": BASE_ROWS,
         "configs": configs,
     }
+    from transmogrifai_tpu.obs import bench_meta
+    out["meta"] = bench_meta()
     dest = os.path.join(_ROOT, "benchmarks", "pipeline_latest.json")
     from transmogrifai_tpu.utils.jsonio import write_json_atomic
     write_json_atomic(dest, out)

@@ -378,8 +378,10 @@ def main() -> None:
     }
     print(json.dumps(out), flush=True)
     if not args.smoke:
+        from transmogrifai_tpu.obs import bench_meta
         from transmogrifai_tpu.utils.jsonio import write_json_atomic
 
+        out["meta"] = bench_meta()
         write_json_atomic(
             os.path.join(_ROOT, "benchmarks", "refresh_latest.json"), out)
 

@@ -207,7 +207,9 @@ def main() -> None:
     print(json.dumps(out), flush=True)
     if not args.smoke:
         dest = os.path.join(_ROOT, "benchmarks", "resilience_latest.json")
+        from transmogrifai_tpu.obs import bench_meta
         from transmogrifai_tpu.utils.jsonio import write_json_atomic
+        out["meta"] = bench_meta()
         write_json_atomic(dest, out)
 
 

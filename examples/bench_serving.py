@@ -130,7 +130,9 @@ def run(out_path: str) -> dict:
         "compiles": snap["compileCache"]["totals"]["compiles"],
         "compileHits": snap["compileCache"]["totals"]["hits"],
     }
+    from transmogrifai_tpu.obs import bench_meta
     from transmogrifai_tpu.utils.jsonio import write_json_atomic
+    record["meta"] = bench_meta()
     write_json_atomic(out_path, record)
     return record
 

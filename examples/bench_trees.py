@@ -269,6 +269,8 @@ def main():
         if not doc["contracts"]["ok"]:
             rc = 1
     if not args.smoke:
+        from transmogrifai_tpu.obs import bench_meta
+        doc["meta"] = bench_meta()
         write_json_atomic(OUT_PATH, doc, indent=2, sort_keys=True)
         print(f"wrote {OUT_PATH}")
     print(json.dumps({"ok": rc == 0,

@@ -231,7 +231,9 @@ def main():
 
     out = run(args.rows, args.cols, smoke=False)
 
+    from transmogrifai_tpu.obs import bench_meta
     from transmogrifai_tpu.utils.jsonio import write_json_atomic
+    out["meta"] = bench_meta()
     write_json_atomic(os.path.join(_ROOT, "benchmarks",
                                    "tuning_latest.json"), out)
     print(json.dumps(out), flush=True)

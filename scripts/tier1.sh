@@ -78,6 +78,18 @@ else
   echo "REFRESH_SMOKE=FAILED (see /tmp/_t1_refresh.log)"
   rc=1
 fi
+# observability smoke: a traced 1x train + a traced serve request must
+# produce a VALID Chrome-trace export (schema-checked), a parseable
+# flight-recorder JSONL, non-empty per-stage HLO cost-analysis features,
+# and a Prometheus exposition that parses from the live
+# /metrics?format=prometheus endpoint; with tracing disabled the hook
+# overhead must stay <1% of train wall (the off-path contract)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python examples/bench_obs.py --smoke > /tmp/_t1_obs.log 2>&1; then
+  echo "OBS_SMOKE=ok $(grep -ao '"value": [0-9.e-]*' /tmp/_t1_obs.log | head -1)"
+else
+  echo "OBS_SMOKE=FAILED (see /tmp/_t1_obs.log)"
+  rc=1
+fi
 # self-lint: all three source families (trace TM03x, shard TM04x,
 # concurrency TM05x) over the shipped package (incl. parallel/ tuning/
 # serving/ workflow/) + examples, DAG lint of the example pipeline

@@ -1,0 +1,279 @@
+"""Fault-injected e2e observability — the flight recorder captures the
+full causal chains, seed-deterministically (ISSUE 12 satellite).
+
+Two chains, each driven by utils/faults.py injection so no chip ever
+actually dies:
+
+* **elastic**: ``device.loss → mesh shrink → retry → quarantine`` inside
+  a sharded sweep on the conftest's 8 virtual devices — the event
+  sequence must appear in exactly the order the escalation ladder
+  executed it, linked by span id to the sweep-unit span it fired in, and
+  byte-identical across two runs of the same seed.
+* **closed loop**: ``drift.window → (drift.trigger) → refresh.start →
+  swap.accept → swap.bake_probe → swap.rollback`` — injected covariate
+  shift fires the monitor, the warm-start refresh produces the candidate,
+  the guarded swap accepts it, and an injected bake fault rolls it back.
+
+Plus the traced-capstone shape: one traced chunked train with a selector
+sweep under an injected device loss produces ONE span tree spanning
+workflow/ingest/plan/stage/sweep categories whose Chrome-trace export
+validates and whose stage profiles carry compiled-program features.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.utils import faults
+from transmogrifai_tpu.utils.faults import FaultSpec
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    obs.stop_trace()
+    yield
+    obs.stop_trace()
+
+
+def _subsequence(haystack, needles):
+    """True when ``needles`` appear in ``haystack`` in order."""
+    it = iter(haystack)
+    return all(any(n == h for h in it) for n in needles)
+
+
+# ---------------------------------------------------------------------------
+# chain 1: device.loss -> mesh shrink -> retry -> quarantine
+# ---------------------------------------------------------------------------
+
+def _toy(n=240, d=10, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d) * (rng.random(d) < 0.6)
+    y = (1 / (1 + np.exp(-(X @ beta))) > rng.random(n)).astype(np.float32)
+    return X, y
+
+
+def _run_elastic_chain():
+    """One sharded sweep with a unit that loses its device on EVERY
+    attempt (retry budget 2 -> quarantine); returns (tracer, results)."""
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.parallel import make_sweep_mesh
+    from transmogrifai_tpu.selector.model_selector import (ModelSelector,
+                                                           grid)
+    from transmogrifai_tpu.selector.validators import OpCrossValidation
+
+    X, y = _toy()
+    sel = ModelSelector(
+        models_and_params=[(OpLogisticRegression(), grid(
+            reg_param=[0.001, 0.01, 0.1, 1.0], elastic_net_param=[0.0]))],
+        problem_type="binary",
+        validator=OpCrossValidation(num_folds=2, stratify=True),
+    ).with_mesh(make_sweep_mesh(4, n_devices=8))
+    ctx = sel._elastic_context(len(y), X.shape[1], 4)
+    w = np.ones(len(y), np.float32)
+    # with_groups=False: the unit-level ladder under test needs
+    # sequential units (grouped sweeps run no per-unit attempts)
+    cands = sel._candidates(with_groups=False)
+    tracer = obs.start_trace("elastic-chain")
+    try:
+        with faults.inject(FaultSpec(point="device.loss",
+                                     action="device_loss", at=2,
+                                     times=3)):
+            _, results = sel.validator.validate(
+                cands, X, y, w, eval_fn=sel._metric,
+                metric_name=sel.validation_metric,
+                larger_better=sel.larger_better, elastic=ctx)
+    finally:
+        obs.stop_trace()
+    return tracer, ctx, results
+
+
+class TestElasticChain:
+    def test_causal_chain_in_order_with_span_links(self):
+        tracer, ctx, results = _run_elastic_chain()
+        kinds = tracer.flight.kinds()
+        # the full escalation ladder, in execution order: two
+        # loss->shrink->retry rounds, then the third loss quarantines
+        assert _subsequence(kinds, [
+            "fault.fired", "elastic.device_losses", "elastic.mesh_shrinks",
+            "elastic.retries",
+            "fault.fired", "elastic.device_losses", "elastic.retries",
+            "fault.fired", "elastic.device_losses", "elastic.quarantined",
+        ]), kinds
+        assert ctx.counters.device_losses == 3
+        assert ctx.counters.quarantined == 1
+        # the quarantined candidate is isolated, the sweep finished
+        assert results[2].error is not None
+        assert "device_loss" in results[2].error
+        assert sum(1 for r in results if r.error is None) == 3
+        # causality: every elastic event fired INSIDE the sweep-unit span
+        unit_spans = {s.span_id: s for s in tracer.snapshot()
+                      if s.name.startswith("sweep.unit")}
+        for e in tracer.flight.events("elastic."):
+            assert e["spanId"] in unit_spans, e
+            assert unit_spans[e["spanId"]].name == "sweep.unit[2]"
+        # the unit span recorded its ladder and the mesh it degraded to
+        sp = next(s for s in unit_spans.values()
+                  if s.name == "sweep.unit[2]")
+        assert sp.attrs["retries"] == 2
+        assert sp.attrs["mesh"] != sp.attrs["mesh_after"]
+
+    def test_chain_is_seed_deterministic(self):
+        kinds_a = _run_elastic_chain()[0].flight.kinds()
+        kinds_b = _run_elastic_chain()[0].flight.kinds()
+        assert kinds_a == kinds_b
+
+
+# ---------------------------------------------------------------------------
+# chain 2: drift.window -> refresh -> swap.bake -> rollback
+# ---------------------------------------------------------------------------
+
+def _make_df(rows, seed=7, age_shift=0.0):
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "Survived": (rng.random(rows) > 0.62).astype(float),
+        "Sex": rng.choice(["male", "female"], rows, p=[0.65, 0.35]),
+        "Age": rng.normal(30 + age_shift, 13, rows).clip(0.4, 95),
+        "Fare": rng.lognormal(3.0, 1.0, rows),
+    })
+
+
+def _build_wf():
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import OpNaiveBayes
+    from transmogrifai_tpu.preparators import SanityChecker
+
+    survived = FeatureBuilder.RealNN("Survived").as_response()
+    feats = transmogrify([
+        FeatureBuilder.PickList("Sex").as_predictor(),
+        FeatureBuilder.Real("Age").as_predictor(),
+        FeatureBuilder.Real("Fare").as_predictor(),
+    ])
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        survived, feats).get_output()
+    pred = OpNaiveBayes().set_input(survived, checked).get_output()
+    return OpWorkflow().set_result_features(pred)
+
+
+class TestClosedLoopChain:
+    def test_drift_refresh_swap_rollback_chain(self):
+        from transmogrifai_tpu.serving import (DriftConfig, DriftMonitor,
+                                               GuardedSwap, ModelRegistry,
+                                               SwapGateConfig,
+                                               export_drift_baselines)
+
+        base = _make_df(400, seed=7)
+        wf = _build_wf()
+        model = wf.set_input_data(base).train(chunk_rows=64)
+
+        tracer = obs.start_trace("closed-loop")
+        try:
+            registry = ModelRegistry()
+            registry.register("m", model)
+            # wide-open quality gates: this test pins the EVENT CHAIN
+            # (the gate thresholds themselves are test_refresh.py's job),
+            # and a refresh warm-started on shifted data legitimately
+            # moves its predictions
+            guard = GuardedSwap(registry, "m", gate=SwapGateConfig(
+                min_replay_rows=16, golden_rows=8, p99_factor=50.0,
+                pred_distance_max=5.0, pred_psi_max=50.0, metric_tol=5.0))
+            monitor = DriftMonitor(
+                export_drift_baselines(model),
+                DriftConfig(min_rows=64, check_every=64))
+            # live traffic: shifted Age distribution -> drift fires
+            drifted_rows = _make_df(200, seed=21, age_shift=40.0)
+            monitor.observe_rows(drifted_rows.to_dict("records"))
+            assert monitor.refresh_triggered
+            # the triggered refresh produces the swap candidate
+            refreshed = wf.refresh(model, data=drifted_rows,
+                                   chunk_rows=64)
+            guard.record_traffic(base.to_dict("records")[:48])
+            decision = guard.propose(refreshed)
+            assert decision.accepted, decision.reasons
+            # an injected bake-probe fault must roll the swap back
+            with faults.inject(FaultSpec(point="swap.bake",
+                                         action="raise", at=0)):
+                reason = guard.bake_probe()
+            assert reason == "probe_error:FaultError"
+            assert registry.get("m").version == 1
+        finally:
+            obs.stop_trace()
+
+        kinds = tracer.flight.kinds()
+        assert _subsequence(kinds, [
+            "drift.window", "drift.trigger", "refresh.start",
+            "swap.accept", "fault.fired", "swap.bake_probe",
+            "swap.rollback",
+        ]), kinds
+        # the drift window event says WHAT drifted; the rollback WHY
+        window = next(e for e in tracer.flight.events("drift.window"))
+        assert window["attrs"]["drifted"] is True
+        assert "Age" in window["attrs"]["features"]
+        rollback = next(e for e in tracer.flight.events("swap.rollback"))
+        assert rollback["attrs"]["reason"] == "probe_error:FaultError"
+        bake = next(e for e in tracer.flight.events("swap.bake_probe"))
+        assert bake["attrs"]["ok"] is False
+        # the refresh ran under its own span in the same trace
+        assert any(s.name == "workflow.refresh"
+                   for s in tracer.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# the traced capstone shape
+# ---------------------------------------------------------------------------
+
+class TestTracedCapstone:
+    def test_one_trace_spans_every_plane(self):
+        from transmogrifai_tpu import FeatureBuilder, OpWorkflow, \
+            transmogrify
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.preparators import SanityChecker
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, grid)
+
+        df = _make_df(400, seed=9)
+        survived = FeatureBuilder.RealNN("Survived").as_response()
+        feats = transmogrify([
+            FeatureBuilder.PickList("Sex").as_predictor(),
+            FeatureBuilder.Real("Age").as_predictor(),
+            FeatureBuilder.Real("Fare").as_predictor(),
+        ])
+        checked = SanityChecker(max_correlation=0.99).set_input(
+            survived, feats).get_output()
+        selector = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models_and_parameters=[(OpLogisticRegression(),
+                                    grid(reg_param=[0.01, 0.1]))])
+        pred = selector.set_input(survived, checked).get_output()
+        wf = OpWorkflow().set_result_features(pred).set_input_data(df)
+
+        tracer = obs.start_trace("capstone")
+        try:
+            # chunked ingest + sweep, with a device loss mid-unit that
+            # the elastic ladder must absorb (retry; sweep completes)
+            with faults.inject(FaultSpec(point="device.loss",
+                                         action="device_loss", at=0,
+                                         times=1)):
+                model = wf.train(profile=True, chunk_rows=64)
+        finally:
+            obs.stop_trace()
+
+        spans = tracer.snapshot()
+        cats = {s.cat for s in spans}
+        assert {"workflow", "ingest", "plan", "stage",
+                "sweep"} <= cats, cats
+        # chunk spans nest under pass spans, stages under layers
+        by_id = {s.span_id: s for s in spans}
+        chunk = next(s for s in spans
+                     if s.name.startswith("ingest.chunk"))
+        assert by_id[chunk.parent_id].name.startswith("ingest.pass")
+        # the injected loss left its causal trace
+        assert _subsequence(tracer.flight.kinds(), [
+            "fault.fired", "elastic.device_losses", "elastic.retries"])
+        # compiled-program features landed on the profile
+        assert any(sp.hlo for sp in model.train_profile.stages)
+        # and the whole tree exports as a VALID chrome trace
+        doc = obs.to_chrome_trace(tracer)
+        assert obs.validate_chrome_trace(doc) == []
+        assert doc["otherData"]["droppedSpans"] == 0

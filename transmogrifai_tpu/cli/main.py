@@ -56,6 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="pipeline static analyzer: DAG lint + trace-safety lint "
              "(python -m transmogrifai_tpu.lint)")
 
+    trc = sub.add_parser(
+        "trace", help="validate + summarize an exported Chrome-trace "
+                      "JSON file (obs.to_chrome_trace; the file itself "
+                      "loads in chrome://tracing / Perfetto)")
+    trc.add_argument("file", help="trace JSON file to summarize")
+    trc.add_argument("--top", type=int, default=15,
+                     help="how many top-duration spans to list")
+
     srv = sub.add_parser(
         "serve", help="serve a persisted model (micro-batched scoring)")
     srv.add_argument("--model", required=True,
@@ -143,6 +151,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
     if args.command == "serve":
         return _run_serve(args)
+    if args.command == "trace":
+        from ..obs.export import summarize_file
+
+        summary = summarize_file(args.file, top_k=args.top)
+        if summary is None:
+            return 1
+        try:
+            print(summary)
+        except BrokenPipeError:  # `tmog trace f.json | head` is fine
+            pass
+        return 0
     return 2
 
 

@@ -1,0 +1,48 @@
+"""Unified observability — span tracing, flight recorder, exposition.
+
+One subsystem, three sinks over a shared span tree (docs/observability.md):
+
+* **Span tracing** (``obs/trace.py``): ``start_trace()`` arms a
+  process-wide :class:`Tracer`; hooks threaded through
+  ``OpWorkflow.train/refresh``, plan execution, the streaming driver,
+  the sweep work queue, and the serving batch path record a single
+  hierarchical timeline with a per-run ``trace_id``.
+* **Flight recorder** (``obs/flight.py``): a bounded ring of structured
+  state-transition events (device loss, mesh shrink, quarantine,
+  checkpoint save/resume, drift trigger, swap/rollback, breaker
+  transitions, fault firings) with span-id causality links; JSONL on
+  demand or on crash.
+* **Exposition** (``obs/export.py``, ``obs/prometheus.py``): Chrome-trace
+  JSON that loads in ``chrome://tracing``/Perfetto (summarized by
+  ``tmog trace``), and Prometheus text of ServingMetrics + RunCounters
+  served at ``/metrics?format=prometheus``.
+
+Plus the compiled-program feature capture (``obs/hlo.py``) that lands
+per-stage HLO op mix / FLOPs / bytes-accessed on ``StageProfile`` /
+``StageObservation`` for the tuning cost model, and the shared
+``bench_meta()`` block every ``benchmarks/*_latest.json`` carries.
+
+Everything is off-path-free when disabled: each hook is one module-global
+``None`` check (gated <1% of train wall by the OBS_SMOKE contract).
+"""
+from .bench_meta import bench_meta, estimate_disabled_overhead_s
+from .export import (summarize_file, to_chrome_trace, trace_summary,
+                     validate_chrome_trace)
+from .flight import (FlightRecorder, arm_crash_dump, current_recorder,
+                     disarm_crash_dump, install_recorder, record_event)
+from .prometheus import parse_exposition, prometheus_text
+from .trace import (Span, Tracer, begin_span, current_span, current_tracer,
+                    end_span, install_tracer, new_trace_id, span,
+                    start_trace, stop_trace, tracing)
+
+__all__ = [
+    "Span", "Tracer", "span", "begin_span", "end_span", "current_span",
+    "current_tracer", "install_tracer", "start_trace", "stop_trace",
+    "tracing", "new_trace_id",
+    "FlightRecorder", "record_event", "install_recorder",
+    "current_recorder", "arm_crash_dump", "disarm_crash_dump",
+    "to_chrome_trace", "validate_chrome_trace", "trace_summary",
+    "summarize_file",
+    "prometheus_text", "parse_exposition",
+    "bench_meta", "estimate_disabled_overhead_s",
+]

@@ -1,0 +1,162 @@
+"""Flight recorder — a bounded ring of structured run events.
+
+The span tree (``obs/trace.py``) answers "where did the time go"; the
+flight recorder answers "what HAPPENED, in what order" — the discrete
+state transitions an operator replays after an incident: device losses,
+mesh shrinks, quarantines, checkpoint saves/resumes, drift triggers,
+guarded swaps and rollbacks, breaker transitions, fault-point firings.
+
+Each event carries a monotonically increasing ``seq`` (the causal order,
+immune to wall-clock granularity), the wall time, the event ``kind``, the
+emitting site's attributes, and — when a tracer is active — the enclosing
+span's id, so an event chain links back into the span tree ("this device
+loss fired inside sweep unit 4 of trace 9f2…").
+
+Like the fault harness and the tracer, recording is a single module-global
+``None`` check when no recorder is installed — the disabled path costs one
+branch.  The ring is bounded (``capacity``), so a pathological event storm
+ages out old events instead of growing without bound.
+
+Persistence: :meth:`FlightRecorder.dump_jsonl` writes the ring as JSONL on
+demand; :func:`arm_crash_dump` additionally hooks ``sys.excepthook`` so an
+unhandled crash flushes the ring to disk before the process dies (SIGKILL
+cannot be hooked — the crash-resume story for kills is the checkpoint
+layer's, not the recorder's).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FlightRecorder", "install_recorder", "current_recorder",
+           "record_event", "arm_crash_dump", "disarm_crash_dump"]
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring for one run/process."""
+
+    def __init__(self, capacity: int = 4096,
+                 trace_id: Optional[str] = None):
+        self.capacity = int(capacity)
+        self.trace_id = trace_id
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.recorded = 0  # lifetime count (ring may have aged events out)
+
+    def record(self, kind: str, attrs: Dict[str, Any]) -> None:
+        from .trace import current_span
+
+        sp = current_span()
+        with self._lock:
+            self._seq += 1
+            self.recorded += 1
+            self._ring.append({
+                "seq": self._seq,
+                "t": round(time.time(), 6),
+                "kind": kind,
+                "traceId": self.trace_id,
+                "spanId": sp.span_id if sp is not None else None,
+                "attrs": attrs,
+            })
+
+    # -- reading -------------------------------------------------------------
+
+    def events(self, kind_prefix: Optional[str] = None
+               ) -> List[Dict[str, Any]]:
+        """Events in causal (seq) order, optionally filtered by a kind
+        prefix (``"elastic."`` matches every elastic event)."""
+        with self._lock:
+            out = list(self._ring)
+        if kind_prefix is not None:
+            out = [e for e in out if e["kind"].startswith(kind_prefix)]
+        return out
+
+    def kinds(self) -> List[str]:
+        """The kind sequence in causal order (assertion convenience)."""
+        return [e["kind"] for e in self.events()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- persistence ---------------------------------------------------------
+
+    def dump_jsonl(self, path: str) -> int:
+        """Write the ring to ``path`` as JSON Lines; returns the event
+        count.  Plain write (not tmp+rename): on the crash path the
+        half-written file is still more evidence than no file."""
+        events = self.events()
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e, default=str) + "\n")
+        return len(events)
+
+
+#: installed recorder; None = event recording disabled (the fast path)
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def install_recorder(rec: Optional[FlightRecorder]
+                     ) -> Optional[FlightRecorder]:
+    """Install ``rec`` process-wide (None disables recording)."""
+    global _RECORDER
+    _RECORDER = rec
+    return rec
+
+
+def current_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def record_event(kind: str, **attrs) -> None:
+    """Event-site hook — one global ``None`` check when disabled."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.record(kind, attrs)
+
+
+# ---------------------------------------------------------------------------
+# crash persistence
+# ---------------------------------------------------------------------------
+
+_crash_lock = threading.Lock()
+_crash_path: Optional[str] = None
+_prev_excepthook = None
+
+
+def _crash_hook(exc_type, exc, tb):
+    rec = _RECORDER
+    path = _crash_path
+    if rec is not None and path is not None:
+        try:
+            rec.record("crash", {"error": f"{exc_type.__name__}: {exc}"})
+            rec.dump_jsonl(path)
+        except Exception:  # the recorder must never mask the real crash
+            pass
+    hook = _prev_excepthook or sys.__excepthook__
+    hook(exc_type, exc, tb)
+
+
+def arm_crash_dump(path: str) -> None:
+    """Flush the installed recorder's ring to ``path`` (JSONL) from
+    ``sys.excepthook`` if the process dies on an unhandled exception."""
+    global _crash_path, _prev_excepthook
+    with _crash_lock:
+        if _prev_excepthook is None:
+            _prev_excepthook = sys.excepthook
+            sys.excepthook = _crash_hook
+        _crash_path = path
+
+
+def disarm_crash_dump() -> None:
+    global _crash_path, _prev_excepthook
+    with _crash_lock:
+        _crash_path = None
+        if _prev_excepthook is not None:
+            sys.excepthook = _prev_excepthook
+            _prev_excepthook = None

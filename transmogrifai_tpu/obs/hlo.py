@@ -1,0 +1,181 @@
+"""Compiled-program feature capture — HLO op mix, FLOPs, bytes accessed.
+
+ROADMAP item 4's cost model wants features "from the compiled program
+(HLO op mix, bytes-accessed, launch counts)" per stage, following "A
+Learned Performance Model for TPUs" / "TpuGraphs" (PAPERS.md).  The
+launch counts already flow through ``RunCounters``; this module captures
+the compile-time half: while armed, every XLA compilation in the process
+is intercepted at jax's single compile chokepoint
+(``jax._src.compiler.compile_or_get_cached`` — the path both explicit
+``lower().compile()`` and implicit first-call jit compiles take), and the
+resulting executable's ``cost_analysis()`` plus an op histogram of the
+submitted StableHLO module land in a process-wide ledger.
+
+The execution plan (workflow/plan.py) attributes ledger deltas to the
+device-heavy stage that triggered them (same serial-stage discipline as
+the launch counters), so a traced run's ``StageProfile``/
+``StageObservation`` records carry per-stage compiled-program features
+for the tuning cost model to consume.
+
+Armed only while a trace is active (``obs.start_trace``); disarmed, the
+patch is removed entirely — zero import-time or steady-state cost.  The
+hook is defensive throughout: any failure inside capture degrades to "no
+features recorded", never to a broken compile (telemetry must not take
+down the run it observes).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["arm", "disarm", "is_armed", "mark", "since", "aggregate",
+           "op_histogram", "cost_features"]
+
+_lock = threading.Lock()
+_orig = None          # the unpatched compile_or_get_cached while armed
+_orig_keep = None     # same, but never cleared (see _hooked)
+_ledger: List[Dict[str, Any]] = []
+
+#: cap on the MLIR text scanned for the op histogram — a pathological
+#: megamodule costs bounded capture time, not an unbounded regex pass
+_MODULE_TEXT_CAP = 1_000_000
+
+_OP_RE = re.compile(r"=\s*(?:stablehlo|mhlo|chlo|func|tt)\.([a-zA-Z0-9_]+)")
+
+
+def op_histogram(module_text: str,
+                 cap: int = _MODULE_TEXT_CAP) -> Dict[str, int]:
+    """Opcode histogram of a StableHLO/MHLO module's text form."""
+    out: Dict[str, int] = {}
+    for m in _OP_RE.finditer(module_text[:cap]):
+        op = m.group(1)
+        out[op] = out.get(op, 0) + 1
+    return out
+
+
+def _normalize_cost(ca: Any) -> Dict[str, float]:
+    """``LoadedExecutable.cost_analysis()`` returns a dict (or a
+    one-per-partition list of dicts); keep the scalar headline keys."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for key, dest in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("transcendentals", "transcendentals"),
+                      ("optimal_seconds", "optimal_seconds")):
+        v = ca.get(key)
+        if isinstance(v, (int, float)):
+            out[dest] = float(v)
+    return out
+
+
+def cost_features(compiled, module_text: str = "",
+                  name: str = "") -> Dict[str, Any]:
+    """Feature record for one compiled executable (also usable directly
+    on a ``lowered.compile()`` result, bench_kernels-style)."""
+    entry: Dict[str, Any] = {"name": name}
+    try:
+        entry.update(_normalize_cost(compiled.cost_analysis()))
+    except Exception:  # cost analysis is best-effort per backend
+        pass
+    if module_text:
+        try:
+            entry["ops"] = op_histogram(module_text)
+        except Exception:
+            pass
+    return entry
+
+
+def _hooked(backend, computation, devices, compile_options,
+            host_callbacks, *args, **kwargs):
+    # _orig_keep (never cleared) covers the disarm-while-compiling race:
+    # a thread already inside the hook when disarm() restores the patch
+    # must still reach the real compiler
+    executable = (_orig or _orig_keep)(
+        backend, computation, devices, compile_options,
+        host_callbacks, *args, **kwargs)
+    try:
+        try:
+            name = str(computation.operation.attributes["sym_name"]
+                       ).strip('"')
+        except Exception:
+            name = ""
+        entry = cost_features(executable, module_text=str(computation),
+                              name=name)
+        with _lock:
+            _ledger.append(entry)
+    except Exception:  # capture must never break a compile
+        pass
+    return executable
+
+
+def arm() -> bool:
+    """Install the compile hook; True when (now) armed.  Safe to call
+    repeatedly; a jax whose internals moved leaves capture disabled."""
+    global _orig, _orig_keep
+    with _lock:
+        if _orig is not None:
+            return True
+        try:
+            from jax._src import compiler as _compiler
+
+            fn = _compiler.compile_or_get_cached
+        except Exception:
+            return False
+        if fn is _hooked:  # double-armed by another path: keep as-is
+            return True
+        _orig = _orig_keep = fn
+        _compiler.compile_or_get_cached = _hooked
+        return True
+
+
+def disarm() -> None:
+    global _orig
+    with _lock:
+        if _orig is None:
+            return
+        try:
+            from jax._src import compiler as _compiler
+
+            if _compiler.compile_or_get_cached is _hooked:
+                _compiler.compile_or_get_cached = _orig
+        except Exception:
+            pass
+        _orig = None
+
+
+def is_armed() -> bool:
+    with _lock:
+        return _orig is not None
+
+
+def mark() -> int:
+    """Current ledger position; pass to :func:`since` for delta
+    attribution around a stage execution."""
+    with _lock:
+        return len(_ledger)
+
+
+def since(position: int) -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_ledger[position:])
+
+
+def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-program feature records into one per-stage summary:
+    summed FLOPs/bytes, merged op histogram, program count."""
+    out: Dict[str, Any] = {"programs": len(entries)}
+    ops: Dict[str, int] = {}
+    for e in entries:
+        for key in ("flops", "bytes_accessed", "transcendentals"):
+            v = e.get(key)
+            if isinstance(v, (int, float)):
+                out[key] = out.get(key, 0.0) + float(v)
+        for op, n in (e.get("ops") or {}).items():
+            ops[op] = ops.get(op, 0) + int(n)
+    if ops:
+        out["ops"] = ops
+    return out

@@ -149,9 +149,14 @@ class ElasticCounters:
 
     def count(self, kind: str, n: int = 1) -> None:
         setattr(self, kind, getattr(self, kind) + n)
+        from ..obs.flight import record_event
         from ..utils.profiling import count_elastic
 
         count_elastic(kind, n)
+        # every elastic transition is a flight-recorder event — counting
+        # at the single shared site keeps the causal order (loss →
+        # shrink → retry → quarantine) exactly as the ladder executed it
+        record_event(f"elastic.{kind}")
 
     def to_json(self) -> Dict[str, int]:
         return {"retries": self.retries,

@@ -267,6 +267,9 @@ class ModelSelector(PredictorEstimator):
                              max_unit_retries=self.elastic_max_retries,
                              unit_deadline_s=self._watchdog_deadline(
                                  n_rows, n_cols, queue_width))
+        # live-mesh peek for the sweep spans (obs/): unit spans record the
+        # mesh each attempt actually ran on, which a shrink re-points
+        ctx.mesh_provider = lambda: self.mesh
         return ctx
 
     def _watchdog_deadline(self, n_rows: int, n_cols: int,

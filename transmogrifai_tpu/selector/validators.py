@@ -292,6 +292,21 @@ class OpTrainValidationSplit(_ValidatorBase):
                           larger_better, self.max_wait)
 
 
+def _mesh_attr(elastic) -> str:
+    """The mesh a sweep attempt runs on, as a span attribute ("" = single
+    device / unknown) — read through the elastic context's live-mesh peek
+    so shrink ladders show the mesh each RETRY actually landed on."""
+    provider = getattr(elastic, "mesh_provider", None)
+    if provider is None:
+        return ""
+    try:
+        from ..utils.profiling import mesh_desc
+
+        return mesh_desc(provider())[1]
+    except Exception:
+        return ""
+
+
 @dataclasses.dataclass
 class SweepUnit:
     """One schedulable unit of sweep work: a candidate's (folds x fit)
@@ -374,37 +389,50 @@ class SweepWorkQueue:
         deadline (escalating timeout -> degraded re-run at 2x the
         deadline -> ``failed: straggler`` quarantine).  Workload failures
         keep the historical behavior: score worst, record the error."""
+        from ..obs.trace import begin_span, end_span
+
         loss_attempt = 0
         slow_attempt = 0
-        while True:
-            try:
-                deadline = (elastic.unit_deadline_s
-                            if elastic is not None else None)
-                if deadline is None:
-                    return self._unit_attempt(unit), None
-                from ..parallel.elastic import run_with_deadline
+        sp = begin_span(f"sweep.unit[{unit.index}]", cat="sweep",
+                        candidate=unit.name, index=unit.index,
+                        mesh=_mesh_attr(elastic))
+        try:
+            while True:
+                try:
+                    deadline = (elastic.unit_deadline_s
+                                if elastic is not None else None)
+                    if deadline is None:
+                        return self._unit_attempt(unit), None
+                    from ..parallel.elastic import run_with_deadline
 
-                fold_vals, timed_out = run_with_deadline(
-                    lambda: self._unit_attempt(unit),
-                    deadline * (2 ** slow_attempt),
-                    abandoned=elastic.abandoned)
-                if not timed_out:
-                    return fold_vals, None
-                if elastic.on_watchdog_timeout(unit.index, slow_attempt):
-                    slow_attempt += 1
-                    continue       # degraded re-run on the shrunk mesh
-                return [], (f"failed: straggler (unit exceeded its "
-                            f"{deadline:.3f}s watchdog deadline "
-                            f"{slow_attempt + 1}x)")
-            except Exception as e:  # noqa: BLE001 - candidate isolation,
-                # routed through the shared device-loss classifier
-                if elastic is not None and elastic.classify(e):
-                    if elastic.on_device_loss(unit.index, e, loss_attempt):
-                        loss_attempt += 1
-                        continue   # re-run on the shrunk mesh
-                    return [], (f"failed: device_loss "
-                                f"({type(e).__name__}: {e})")
-                return [], f"{type(e).__name__}: {e}"
+                    fold_vals, timed_out = run_with_deadline(
+                        lambda: self._unit_attempt(unit),
+                        deadline * (2 ** slow_attempt),
+                        abandoned=elastic.abandoned)
+                    if not timed_out:
+                        return fold_vals, None
+                    if elastic.on_watchdog_timeout(unit.index,
+                                                   slow_attempt):
+                        slow_attempt += 1
+                        continue   # degraded re-run on the shrunk mesh
+                    return [], (f"failed: straggler (unit exceeded its "
+                                f"{deadline:.3f}s watchdog deadline "
+                                f"{slow_attempt + 1}x)")
+                except Exception as e:  # noqa: BLE001 - candidate
+                    # isolation, routed through the shared device-loss
+                    # classifier
+                    if elastic is not None and elastic.classify(e):
+                        if elastic.on_device_loss(unit.index, e,
+                                                  loss_attempt):
+                            loss_attempt += 1
+                            continue   # re-run on the shrunk mesh
+                        return [], (f"failed: device_loss "
+                                    f"({type(e).__name__}: {e})")
+                    return [], f"{type(e).__name__}: {e}"
+        finally:
+            end_span(sp, retries=loss_attempt,
+                     watchdog_retries=slow_attempt,
+                     mesh_after=_mesh_attr(elastic))
 
     def group_span(self, i: int) -> int:
         """End index (exclusive) of the run of units sharing units[i]'s
@@ -433,6 +461,8 @@ class SweepWorkQueue:
         failure the shared classifier recognizes as a DEVICE LOSS
         additionally shrinks the mesh (the stripped members then refit
         sequentially on the surviving devices)."""
+        from ..obs.trace import span as _span
+
         group = self.units[i].group
         try:
             # the per-unit fault points fire for every member, so a fault
@@ -445,7 +475,10 @@ class SweepWorkQueue:
             for k in range(i, j):
                 faults.fire("device.loss", index=self.units[k].index,
                             tag=self.units[k].name)
-            return self._run_group(group)
+            with _span(f"sweep.group[{i}:{j}]", cat="sweep",
+                       group=type(group).__name__, units=j - i,
+                       mesh=_mesh_attr(elastic)):
+                return self._run_group(group)
         except Exception as e:  # noqa: BLE001 - fall back per-candidate,
             # routed through the shared device-loss classifier
             if elastic is not None and elastic.classify(e):
@@ -487,8 +520,27 @@ class SweepWorkQueue:
         select otherwise."""
         import time
 
+        from ..obs.trace import begin_span, end_span
+
         if elastic is not None:
             elastic.checkpoint = checkpoint
+        sweep_span = begin_span(
+            "sweep.run", cat="sweep", units=len(self.units),
+            folds=len(self.fold_ctxs), mesh=_mesh_attr(elastic))
+        try:
+            return self._run_all_inner(metric_name, larger_better,
+                                       max_wait, checkpoint, elastic)
+        finally:
+            end_span(sweep_span,
+                     elastic=(elastic.counters.to_json()
+                              if elastic is not None else None))
+
+    def _run_all_inner(self, metric_name: str, larger_better: bool,
+                       max_wait: Optional[float], checkpoint=None,
+                       elastic=None
+                       ) -> Tuple[int, List[ValidationResult]]:
+        import time
+
         t0 = time.monotonic()
         all_vals: List[Any] = []
         errors: List[Optional[str]] = []

@@ -166,6 +166,8 @@ class ModelServer:
     # -- execution (called by the batcher's dispatch thread) -----------------
 
     def _execute(self, rows: List[Dict[str, Any]]) -> List[Any]:
+        from ..obs.trace import begin_span, end_span
+
         if self.drift_monitor is not None:
             self.drift_monitor.observe_rows(rows)
         if self.guard is not None:
@@ -176,15 +178,20 @@ class ModelServer:
             if len(rows) <= executor.max_batch else executor.max_batch
         fallback_reason = "breaker_open"
         if self.breaker.allow_device():
+            sp = begin_span("serve.execute", cat="serve", rows=len(rows),
+                            bucket=bucket, path="device",
+                            version=entry.version)
             t0 = time.perf_counter()
             try:
                 out = executor.score(rows)
                 self.breaker.record_success()
                 self.metrics.record_batch(
                     len(rows), bucket, time.perf_counter() - t0)
+                end_span(sp)
                 return out
             except Exception as exc:
                 fallback_reason = f"device_error:{type(exc).__name__}"
+                end_span(sp, error=fallback_reason)
                 self.metrics.record_device_error()
                 if self.breaker.record_failure():
                     self.metrics.record_breaker_open()
@@ -192,8 +199,14 @@ class ModelServer:
         # slower, but it answers (the device worker-crash mode must degrade
         # a replica, not take it down)
         self.metrics.record_host_fallback(len(rows), reason=fallback_reason)
+        sp = begin_span("serve.execute", cat="serve", rows=len(rows),
+                        bucket=bucket, path="host",
+                        reason=fallback_reason, version=entry.version)
         t0 = time.perf_counter()
-        out = entry.scorer(rows)
+        try:
+            out = entry.scorer(rows)
+        finally:
+            end_span(sp)
         self.metrics.record_batch(len(rows), bucket,
                                   time.perf_counter() - t0)
         return out

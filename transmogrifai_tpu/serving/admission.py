@@ -130,6 +130,9 @@ class CircuitBreaker:
                 and time.monotonic() - self._opened_at >= self.reset_after_s):
             self._state = self.HALF_OPEN
             self._trial_in_flight = False
+            from ..obs.flight import record_event
+
+            record_event("breaker.half_open")
 
     def allow_device(self) -> bool:
         """May the next batch use the device path?"""
@@ -144,10 +147,15 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            reclosed = self._state != self.CLOSED
             self._state = self.CLOSED
             self._consecutive_failures = 0
             self._opened_at = None
             self._trial_in_flight = False
+        if reclosed:
+            from ..obs.flight import record_event
+
+            record_event("breaker.closed")
 
     def record_failure(self) -> bool:
         """Register a device-path failure; returns True if the breaker
@@ -155,10 +163,16 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_failures += 1
             was_open = self._state == self.OPEN
+            opened = False
             if (self._state == self.HALF_OPEN
                     or self._consecutive_failures >= self.failure_threshold):
                 self._state = self.OPEN
                 self._opened_at = time.monotonic()
                 self._trial_in_flight = False
-                return not was_open
-            return False
+                opened = not was_open
+        if opened:
+            from ..obs.flight import record_event
+
+            record_event("breaker.open",
+                         failures=self.failure_threshold)
+        return opened

@@ -19,6 +19,8 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..obs.flight import record_event
+from ..obs.trace import begin_span, end_span
 from .admission import AdmissionController, ShedResult
 from .metrics import ServingMetrics
 
@@ -93,18 +95,23 @@ class MicroBatcher:
         if not rows:
             fut.set_result([])
             return fut
+        admit_span = begin_span("serve.admit", cat="serve", rows=len(rows))
         if self._closed:
             fut.set_result([ShedResult(reason="shutting_down")
                             for _ in rows])
             self.metrics.record_shed(len(rows))
+            end_span(admit_span, outcome="shed:shutting_down")
             return fut
         shed = self.admission.try_admit(
             len(rows), est_drain_ms=self._est_drain_ms())
         if shed is not None:
             self.metrics.record_shed(len(rows))
             fut.set_result([shed for _ in rows])
+            end_span(admit_span, outcome=f"shed:{shed.reason}")
+            record_event("serve.shed", rows=len(rows), reason=shed.reason)
             return fut
         self.metrics.record_admitted(len(rows))
+        end_span(admit_span, outcome="admitted")
         pending = _Pending(rows, self.admission.deadline_for(timeout_ms))
         with self._work:
             self._queue.append(pending)
@@ -164,6 +171,15 @@ class MicroBatcher:
                 self._run_batch(batch)
 
     def _run_batch(self, batch: List[_Pending]) -> None:
+        batch_span = begin_span(
+            "serve.batch", cat="serve", requests=len(batch),
+            rows=sum(len(p.rows) for p in batch))
+        try:
+            self._run_batch_inner(batch)
+        finally:
+            end_span(batch_span)
+
+    def _run_batch_inner(self, batch: List[_Pending]) -> None:
         now = time.monotonic()
         live: List[_Pending] = []
         n_released = 0

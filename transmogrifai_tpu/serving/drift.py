@@ -330,6 +330,13 @@ class DriftMonitor:
                 self.drift_fires += 1
                 self.refresh_triggered = True
             cb = self.on_drift if fired else None
+        from ..obs.flight import record_event
+
+        record_event("drift.window", drifted=bool(drifted),
+                     features=list(drifted),
+                     windowRows=result["windowRows"])
+        if fired:
+            record_event("drift.trigger", features=list(drifted))
         if cb is not None:
             try:
                 cb(result)

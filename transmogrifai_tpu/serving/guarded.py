@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.flight import record_event
 from ..utils import faults
 from .drift import psi_from_counts
 from .metrics import ServingMetrics
@@ -308,6 +309,8 @@ class GuardedSwap:
             decision = SwapDecision(False, reasons, checks)
             self.last_decision = decision
             self.metrics.record_swap_decision(decision.to_json())
+            record_event("swap.reject", reasons=list(reasons),
+                         replay_rows=len(rows))
             return decision
         # PASS: pin the outgoing generation first — the rollback target
         # must exist before the new generation can take traffic
@@ -328,6 +331,8 @@ class GuardedSwap:
         decision = SwapDecision(True, [], checks, version=entry.version)
         self.last_decision = decision
         self.metrics.record_swap_decision(decision.to_json())
+        record_event("swap.accept", version=entry.version,
+                     replay_rows=len(rows))
         return decision
 
     def _capture_golden(self, entry: ModelEntry, rows) -> List[Dict[str, Any]]:
@@ -385,6 +390,8 @@ class GuardedSwap:
                     reason = f"error_rate:{rate:.3f}>{self.gate.error_rate_max}"
         except Exception as exc:
             reason = f"probe_error:{type(exc).__name__}"
+        record_event("swap.bake_probe", probe=self._probes - 1,
+                     ok=reason is None, reason=reason)
         if reason is not None:
             self.rollback(reason)
             return reason
@@ -401,6 +408,8 @@ class GuardedSwap:
         model-quality action, not a device-health one."""
         entry = self.registry.rollback(self.name)
         self.metrics.record_rollback(reason)
+        record_event("swap.rollback", reason=reason,
+                     version=entry.version)
         with self._lock:
             self._bake = None
         return entry

@@ -10,7 +10,10 @@ Endpoints:
                  (rows shed by backpressure come back as their ShedResult
                  JSON and flip the response to 503)
   GET  /metrics  serving metrics snapshot (queue depth, batch histogram,
-                 latency quantiles, shed/fallback counts, compile counters)
+                 latency quantiles, shed/fallback counts, compile counters);
+                 ``?format=prometheus`` renders the same ledgers (plus the
+                 global RunCounters) in Prometheus text exposition for a
+                 stock scraper (obs/prometheus.py)
   GET  /healthz  {"status": "ok", "model": {...}}
   POST /swap     {"path": "/models/titanic_v2"}           -> new entry info
 """
@@ -20,6 +23,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 from .admission import ShedResult
 
@@ -62,7 +66,19 @@ def make_http_server(server, host: str = "127.0.0.1",
             except (ValueError, json.JSONDecodeError):
                 return None
 
+        def _reply_text(self, code: int, text: str,
+                        content_type: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
+            url = urlsplit(self.path)
+            self.path = url.path
+            query = parse_qs(url.query)
             if self.path == "/healthz":
                 entry = server.registry.maybe_get(server.name)
                 breaker_state = server.breaker.state
@@ -77,7 +93,15 @@ def make_http_server(server, host: str = "127.0.0.1",
                         server.metrics.last_fallback_reason,
                 })
             elif self.path == "/metrics":
-                self._reply(200, server.snapshot())
+                fmt = (query.get("format") or ["json"])[0]
+                if fmt == "prometheus":
+                    from ..obs.prometheus import prometheus_text
+
+                    self._reply_text(
+                        200, prometheus_text(server.snapshot()),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._reply(200, server.snapshot())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -69,6 +69,13 @@ class StageObservation:
     #: mesh size so the model can learn measured multi-chip scaling)
     n_devices: int = 1
     mesh_shape: str = ""     # e.g. "data=4,grid=2" ("" = no mesh)
+    #: compiled-program features from a traced run (obs/hlo.py via
+    #: StageProfile.hlo): {"programs", "flops", "bytes_accessed",
+    #: "ops": {opcode: count}} — the "predict from the program, not just
+    #: (rows, cols)" feature source for the cost model v2 (ROADMAP item
+    #: 4, per "A Learned Performance Model for TPUs"/"TpuGraphs").
+    #: Empty for untraced runs; the current ridge ignores it.
+    hlo: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         out = {"stageKind": self.stage_kind, "rows": self.rows,
@@ -81,6 +88,8 @@ class StageObservation:
             out["nDevices"] = self.n_devices
         if self.mesh_shape:
             out["meshShape"] = self.mesh_shape
+        if self.hlo:
+            out["hlo"] = dict(self.hlo)
         return out
 
     @staticmethod
@@ -92,7 +101,8 @@ class StageObservation:
             backend=str(d.get("backend", "")),
             wall_s=float(d.get("wallSecs", 0.0)), t=int(d.get("t", 0)),
             n_devices=int(d.get("nDevices", 1)),
-            mesh_shape=str(d.get("meshShape", "")))
+            mesh_shape=str(d.get("meshShape", "")),
+            hlo=dict(d.get("hlo", {}) or {}))
 
 
 def _features(rows: int, cols: int, n_devices: int = 1) -> np.ndarray:
@@ -316,7 +326,8 @@ def observations_from_profiler(profiler,
             backend=getattr(sp, "backend", "") or backend,
             wall_s=sp.wall_s, t=now,
             n_devices=max(int(getattr(sp, "n_devices", 1) or 1), 1),
-            mesh_shape=getattr(sp, "mesh_shape", "") or ""))
+            mesh_shape=getattr(sp, "mesh_shape", "") or "",
+            hlo=dict(getattr(sp, "hlo", {}) or {})))
     return out
 
 

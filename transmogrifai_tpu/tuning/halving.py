@@ -265,10 +265,15 @@ def halving_validate(
         rung_ckpt = (checkpoint.scoped(f"rung{rung.index}")
                      if checkpoint is not None else None)
         t0 = time.perf_counter()
-        _, results = validator.validate(
-            rung_cands, Xs, ys, ws, eval_fn, metric_name,
-            larger_better=larger_better, checkpoint=rung_ckpt,
-            elastic=elastic)
+        from ..obs.trace import span as _obs_span
+
+        with _obs_span(f"sweep.rung[{rung.index}]", cat="sweep",
+                       rows=rung.rows, candidates=len(rung_cands),
+                       full=full):
+            _, results = validator.validate(
+                rung_cands, Xs, ys, ws, eval_fn, metric_name,
+                larger_better=larger_better, checkpoint=rung_ckpt,
+                elastic=elastic)
         rung.wall_s = time.perf_counter() - t0
         rung.candidate_seconds = rung.wall_s
         total_cand_s += rung.wall_s

@@ -228,6 +228,10 @@ class FaultPlan:
                                  "action": hit.action})
         if hit is None:
             return
+        from ..obs.flight import record_event
+
+        record_event("fault.fired", point=point, index=index, tag=tag,
+                     action=hit.action)
         where = f"{point}[{index}]" + (f" tag={tag}" if tag else "")
         if hit.action == "slow":
             time.sleep(hit.delay_s)

@@ -104,7 +104,11 @@ class MetricsCollector:
         self._end_handlers.append(fn)
 
     def finish(self) -> AppMetrics:
-        self.metrics.app_end_time = time.time()
+        # end-time write under the same lock record() holds — a serving
+        # thread can still be recording when the run finishes; handlers
+        # run OUTSIDE the lock (they may read/record themselves)
+        with self._lock:
+            self.metrics.app_end_time = time.time()
         for fn in self._end_handlers:
             try:
                 fn(self.metrics)
@@ -215,48 +219,62 @@ class RunCounters:
 
 COUNTERS = RunCounters()
 
+#: guards every mutation of the global ``COUNTERS`` — the count sites run
+#: concurrently from the plan's host-stage pool, the serving dispatch
+#: thread, and request-handler threads, and unguarded ``+=`` on shared
+#: ints drops increments under contention (TM052's runtime twin; the
+#: regression test hammers these from threads and asserts exact totals)
+_COUNTERS_LOCK = threading.Lock()
+
 
 def reset_counters() -> RunCounters:
     """Zero the global transfer/dispatch counters; returns the new object."""
     global COUNTERS
-    COUNTERS = RunCounters()
-    return COUNTERS
+    with _COUNTERS_LOCK:
+        COUNTERS = RunCounters()
+        return COUNTERS
 
 
 def count_upload(nbytes: int, seconds: float) -> None:
-    COUNTERS.upload_bytes += int(nbytes)
-    COUNTERS.upload_s += seconds
-    COUNTERS.uploads += 1
+    with _COUNTERS_LOCK:
+        COUNTERS.upload_bytes += int(nbytes)
+        COUNTERS.upload_s += seconds
+        COUNTERS.uploads += 1
 
 
 def count_fetch(nbytes: int, seconds: float) -> None:
-    COUNTERS.fetch_bytes += int(nbytes)
-    COUNTERS.fetch_s += seconds
-    COUNTERS.fetches += 1
+    with _COUNTERS_LOCK:
+        COUNTERS.fetch_bytes += int(nbytes)
+        COUNTERS.fetch_s += seconds
+        COUNTERS.fetches += 1
 
 
 def count_drain(seconds: float) -> None:
-    COUNTERS.drain_s += seconds
-    COUNTERS.drains += 1
+    with _COUNTERS_LOCK:
+        COUNTERS.drain_s += seconds
+        COUNTERS.drains += 1
 
 
 def count_launch(tag: str, n: int = 1) -> None:
-    COUNTERS.launches += n
-    COUNTERS.launch_tags[tag] = COUNTERS.launch_tags.get(tag, 0) + n
+    with _COUNTERS_LOCK:
+        COUNTERS.launches += n
+        COUNTERS.launch_tags[tag] = COUNTERS.launch_tags.get(tag, 0) + n
 
 
 def count_elastic(kind: str, n: int = 1) -> None:
     """Elastic-sweep event (retries / mesh_shrinks / quarantined /
     watchdog_fires / ...) — the process-wide mirror of the per-sweep
     ``parallel.elastic.ElasticCounters``, read by the bench scripts."""
-    COUNTERS.elastic[kind] = COUNTERS.elastic.get(kind, 0) + n
+    with _COUNTERS_LOCK:
+        COUNTERS.elastic[kind] = COUNTERS.elastic.get(kind, 0) + n
 
 
 def count_refresh(kind: str, n: int = 1) -> None:
     """Warm-start refresh event (merged / refit / invalidated /
     geometry_changed) — the process-wide mirror of the per-run
     ``workflow.refresh.RefreshReport``, read by the bench scripts."""
-    COUNTERS.refresh[kind] = COUNTERS.refresh.get(kind, 0) + n
+    with _COUNTERS_LOCK:
+        COUNTERS.refresh[kind] = COUNTERS.refresh.get(kind, 0) + n
 
 
 def refresh_snapshot() -> Dict[str, int]:
@@ -264,7 +282,8 @@ def refresh_snapshot() -> Dict[str, int]:
     refresh ran) — the shape ``benchmarks/refresh_latest.json`` records."""
     base = {"merged": 0, "refit": 0, "invalidated": 0,
             "geometry_changed": 0}
-    base.update(COUNTERS.refresh)
+    with _COUNTERS_LOCK:
+        base.update(COUNTERS.refresh)
     return base
 
 
@@ -274,7 +293,8 @@ def elastic_snapshot() -> Dict[str, int]:
     records."""
     base = {"retries": 0, "mesh_shrinks": 0, "mesh_repacks": 0,
             "quarantined": 0, "watchdog_fires": 0, "device_losses": 0}
-    base.update(COUNTERS.elastic)
+    with _COUNTERS_LOCK:
+        base.update(COUNTERS.elastic)
     return base
 
 
@@ -357,6 +377,10 @@ class StageProfile:
     stage_kind: str = ""    # cost-model bucket key, "Op:kind"
     n_devices: int = 1      # devices the stage ran on (mesh size; 1 = chip)
     mesh_shape: str = ""    # e.g. "data=4,grid=2" ("" = no mesh)
+    #: compiled-program features attributed to this stage when a trace
+    #: was active (obs/hlo.py): {"programs", "flops", "bytes_accessed",
+    #: "ops": {...}} — empty when untraced or nothing compiled
+    hlo: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         out = {"uid": self.uid, "op": self.op, "output": self.output,
@@ -374,6 +398,8 @@ class StageProfile:
             out["nDevices"] = self.n_devices
         if self.mesh_shape:
             out["meshShape"] = self.mesh_shape
+        if self.hlo:
+            out["hlo"] = dict(self.hlo)
         return out
 
 

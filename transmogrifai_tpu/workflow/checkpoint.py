@@ -352,6 +352,11 @@ class StreamingCheckpointManager:
                 "label": rec.get("label"), "live_models": models}
         state.current = doc.get("current")
         self._seq = int(doc.get("seq", 0))
+        from ..obs.flight import record_event
+
+        record_event("checkpoint.resume", directory=self.directory,
+                     seq=self._seq,
+                     passes=len(state.completed))
         return state
 
     # -- save ---------------------------------------------------------------
@@ -402,6 +407,10 @@ class StreamingCheckpointManager:
                 except OSError:  # pragma: no cover
                     pass
         self.saves += 1
+        from ..obs.flight import record_event
+
+        record_event("checkpoint.save", directory=self.directory,
+                     seq=self._seq, saves=self.saves)
         faults.fire("checkpoint.barrier", index=self.saves - 1)
 
     def save_progress(self, pass_index: int, label: str, chunks_done: int,
@@ -563,6 +572,11 @@ class SweepCheckpointManager:
         self.mesh_changed = saved.get("mesh") != self.fingerprint.get("mesh")
         self._units = dict(doc.get("units", {}))
         self._rung = doc.get("rung")
+        from ..obs.flight import record_event
+
+        record_event("checkpoint.resume", directory=self.directory,
+                     units=len(self._units),
+                     mesh_changed=self.mesh_changed, sweep=True)
         return True
 
     # -- unit cursor --------------------------------------------------------
@@ -611,6 +625,10 @@ class SweepCheckpointManager:
             self.export_doc())
         self._dirty = 0
         self.saves += 1
+        from ..obs.flight import record_event
+
+        record_event("checkpoint.save", directory=self.directory,
+                     saves=self.saves, units=len(self._units), sweep=True)
         faults.fire("sweep.checkpoint", index=self.saves - 1)
 
     def flush(self) -> None:

@@ -38,6 +38,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from ..obs import hlo as obs_hlo
+from ..obs.trace import begin_span, current_tracer, end_span
 from ..stages.base import Estimator, Model, PipelineStage, Transformer
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..utils.profiling import (COUNTERS, PlanProfiler, StageProfile,
@@ -392,32 +394,50 @@ class ExecutionPlan:
                     and os.environ.get("TMOG_CHECK") != "1")
         results: Dict[str, Tuple[PipelineStage, str, FeatureColumn]] = {}
 
-        futures = []
-        if use_pool:
-            coll = current_collector()
-            pool = _pool()
-            for stage in host:
-                futures.append((stage, pool.submit(
-                    self._run_stage, stage, data, subs, li, n_rows, prof,
-                    coll, False)))
-        else:
-            # no pool: run host stages inline, in stable order
-            for stage in host:
+        layer_span = begin_span(f"plan.layer[{li}]", cat="plan",
+                                stages=len(layer), rows=n_rows)
+        try:
+            futures = []
+            if use_pool:
+                coll = current_collector()
+                pool = _pool()
+                for stage in host:
+                    futures.append((stage, pool.submit(
+                        self._run_stage, stage, data, subs, li, n_rows,
+                        prof, coll, False, layer_span)))
+            else:
+                # no pool: run host stages inline, in stable order
+                for stage in host:
+                    results[stage.uid] = self._run_stage(
+                        stage, data, subs, li, n_rows, prof, None, True,
+                        layer_span)
+            for stage in dev:
                 results[stage.uid] = self._run_stage(
-                    stage, data, subs, li, n_rows, prof, None, True)
-        for stage in dev:
-            results[stage.uid] = self._run_stage(
-                stage, data, subs, li, n_rows, prof, None, True)
-        for stage, fut in futures:
-            results[stage.uid] = fut.result()
+                    stage, data, subs, li, n_rows, prof, None, True,
+                    layer_span)
+            for stage, fut in futures:
+                results[stage.uid] = fut.result()
+        finally:
+            end_span(layer_span)
         return results
 
     def _run_stage(self, stage: PipelineStage, data: ColumnarDataset,
                    subs, li: int, n_rows: int, prof: PlanProfiler,
-                   coll, serial: bool
+                   coll, serial: bool, layer_span=None
                    ) -> Tuple[PipelineStage, str, FeatureColumn]:
         t0 = time.perf_counter()
         launches0 = COUNTERS.launches if serial else 0
+        # serial stages own the dispatch stream, so compiled-program
+        # features captured during the stage are attributable to it
+        # (same discipline as the launch delta); pool stages are host-side
+        # and never compile
+        hlo_mark = (obs_hlo.mark()
+                    if serial and current_tracer() is not None else None)
+        stage_span = begin_span(
+            f"stage:{type(stage).__name__}", cat="stage",
+            parent=layer_span, uid=stage.uid, layer=li,
+            output=stage.get_output().name, rows=n_rows,
+            device=stage.device_heavy)
         ctx = install_collector(coll) if coll is not None else None
         if ctx is not None:
             ctx.__enter__()
@@ -439,7 +459,11 @@ class ExecutionPlan:
         finally:
             if ctx is not None:
                 ctx.__exit__(None, None, None)
+            end_span(stage_span)
         dt = time.perf_counter() - t0
+        stage_hlo = (obs_hlo.aggregate(obs_hlo.since(hlo_mark))
+                     if hlo_mark is not None
+                     and obs_hlo.mark() > hlo_mark else {})
         width, dtype = _input_shape(stage, data)
         op = type(stage).__name__
         # a stage may refine its cost bucket (e.g. the selector's halving
@@ -456,7 +480,7 @@ class ExecutionPlan:
             launches=(COUNTERS.launches - launches0) if serial else 0,
             cols=width, dtype=dtype, backend=backend_name(),
             stage_kind=f"{op}:{cost_kind}",
-            n_devices=n_dev, mesh_shape=mshape))
+            n_devices=n_dev, mesh_shape=mshape, hlo=stage_hlo))
         return result_stage, name, col
 
 

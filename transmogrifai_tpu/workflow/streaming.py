@@ -498,6 +498,8 @@ def fit_dag_streaming(
         already-consumed chunks — read, counted, but neither transformed
         nor handed to ``per_chunk``.  ``on_chunk(idx, rows_so_far)`` runs
         after each consumed chunk (the checkpoint cadence hook)."""
+        from ..obs.trace import begin_span, end_span
+
         pass_stats = ingest.begin_pass(label)
         needed_after = _liveness(ordered, final_needed)
         if rcfg is not None and rcfg.retry is not None:
@@ -512,6 +514,9 @@ def fit_dag_streaming(
         batcher = AsyncBatcher(source, depth=prefetch)
         rows = 0
         chunk_idx = 0
+        pass_span = begin_span(f"ingest.pass:{label}", cat="ingest",
+                               stages=len(ordered),
+                               skip_chunks=skip_chunks)
         t_pass = time.perf_counter()
         try:
             for chunk in batcher:
@@ -521,17 +526,24 @@ def fit_dag_streaming(
                     chunk_idx += 1
                     continue
                 t0 = time.perf_counter()
+                chunk_span = begin_span(f"ingest.chunk[{chunk_idx}]",
+                                        cat="ingest", parent=pass_span,
+                                        rows=len(chunk))
                 ds = chunk
-                if chunk_idx == 0 and keep_unknown:
-                    extras.update(c for c in ds.names()
-                                  if c not in known_universe)
-                for idx, st in enumerate(ordered):
-                    ds = timed_transform(st, ds)
-                    na = needed_after[idx]
-                    ds = ds.select([c for c in ds.names()
-                                    if c in na or (keep_unknown and
-                                                   c not in known_universe)])
-                per_chunk(ds, chunk_idx)
+                try:
+                    if chunk_idx == 0 and keep_unknown:
+                        extras.update(c for c in ds.names()
+                                      if c not in known_universe)
+                    for idx, st in enumerate(ordered):
+                        ds = timed_transform(st, ds)
+                        na = needed_after[idx]
+                        ds = ds.select(
+                            [c for c in ds.names()
+                             if c in na or (keep_unknown and
+                                            c not in known_universe)])
+                    per_chunk(ds, chunk_idx)
+                finally:
+                    end_span(chunk_span)
                 rows += len(chunk)
                 pass_stats.note_transform(chunk_idx,
                                           time.perf_counter() - t0)
@@ -540,6 +552,7 @@ def fit_dag_streaming(
                 chunk_idx += 1
         finally:
             batcher.close()
+            end_span(pass_span, chunks=chunk_idx, rows=rows)
         pass_stats.wall_s = time.perf_counter() - t_pass
         if rows == 0:
             raise ValueError("chunked reader produced no rows")

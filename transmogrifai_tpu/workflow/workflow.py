@@ -238,6 +238,7 @@ class OpWorkflow(_WorkflowCore):
         history (``benchmarks/cost_history.json``; ``TMOG_COST_HISTORY``
         redirects or disables) — the learned cost model's training data.
         """
+        from ..obs.trace import begin_span, end_span
         from ..utils.profiling import OpStep, with_job_group
 
         retain_mb = None
@@ -249,6 +250,9 @@ class OpWorkflow(_WorkflowCore):
                 prefetch_chunks = advice.prefetch_chunks
                 retain_mb = advice.retain_mb
         tuned_stages = self._apply_tuner(tuner)
+        root = begin_span("workflow.train", cat="workflow",
+                          chunked=chunk_rows is not None,
+                          chunk_rows=chunk_rows)
         try:
             if chunk_rows is not None:
                 return self._train_chunked(
@@ -285,6 +289,7 @@ class OpWorkflow(_WorkflowCore):
                         s.sweep_checkpoint_dir = d
             return self._train_in_core(profile, validate=validate)
         finally:
+            end_span(root)
             for s, prev_strategy, prev_halving in tuned_stages:
                 s.strategy = prev_strategy
                 s.halving = prev_halving
@@ -492,6 +497,8 @@ class OpWorkflow(_WorkflowCore):
         (``serving.GuardedSwap``): a refresh is a CANDIDATE, not a
         rollout.
         """
+        from ..obs.flight import record_event
+        from ..obs.trace import begin_span, end_span
         from ..utils.profiling import OpStep, PlanProfiler, with_job_group
         from .refresh import RefreshContext
         from .streaming import fit_dag_streaming
@@ -511,14 +518,20 @@ class OpWorkflow(_WorkflowCore):
         self._inject_params(dag)
         ctx = RefreshContext(model, dag)
         profiler = PlanProfiler()
-        with with_job_group(OpStep.FeatureEngineering):
-            fitted, transformed, ingest, fit_states = fit_dag_streaming(
-                dag, self.reader, self.raw_features(), chunk_rows,
-                keep=self._train_keep_columns(),
-                profiler=profiler, prefetch=prefetch_chunks,
-                checkpoint_dir=checkpoint_dir,
-                checkpoint_every=checkpoint_every_chunks,
-                refresh_ctx=ctx, fingerprint_extra=ctx.base_digest())
+        root = begin_span("workflow.refresh", cat="workflow",
+                          chunk_rows=chunk_rows)
+        record_event("refresh.start", chunk_rows=chunk_rows)
+        try:
+            with with_job_group(OpStep.FeatureEngineering):
+                fitted, transformed, ingest, fit_states = fit_dag_streaming(
+                    dag, self.reader, self.raw_features(), chunk_rows,
+                    keep=self._train_keep_columns(),
+                    profiler=profiler, prefetch=prefetch_chunks,
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every_chunks,
+                    refresh_ctx=ctx, fingerprint_extra=ctx.base_digest())
+        finally:
+            end_span(root)
         refreshed = OpWorkflowModel(
             result_features=self.result_features,
             stages=fitted,
