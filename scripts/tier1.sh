@@ -66,6 +66,19 @@ else
   echo "ELASTIC_SMOKE=FAILED (see /tmp/_t1_elastic.log)"
   rc=1
 fi
+# serving cold-start gate: two fresh subprocesses serve the same model
+# with device programs — the first JIT-compiles every shape bucket into
+# an empty AOT store, the second cold-starts by LOADING the serialized
+# executables.  The script exits non-zero unless the AOT cold start is
+# >=5x faster than the JIT one, the two children's scores are
+# byte-identical, continuous batching beats the windowed batcher at the
+# 64-way closed-loop leg, and the open-loop p99 stays bounded
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python examples/bench_serving.py --smoke > /tmp/_t1_serving.log 2>&1; then
+  echo "SERVING_COLDSTART=ok $(grep -ao '"aot_speedup": [0-9.]*' /tmp/_t1_serving.log | tail -1)"
+else
+  echo "SERVING_COLDSTART=FAILED (see /tmp/_t1_serving.log)"
+  rc=1
+fi
 # online-refresh smoke: injected covariate drift must fire the
 # DriftMonitor, the warm-start refresh must pass the shadow gate and
 # swap (outgoing generation pinned), a poisoned candidate must be

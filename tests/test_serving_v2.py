@@ -1,0 +1,620 @@
+"""Serving plane v2 tests — AOT executable cache, continuous batching,
+multi-tenant registry (ISSUE 13).
+
+Acceptance pins:
+ * AOT store round-trips serialized executables content-addressed on
+   (model digest, bucket, backend, jax version); corrupted and
+   version-mismatched entries fall back to JIT (and are replaced);
+ * AOT-loaded programs score BYTE-IDENTICAL to their JIT-compiled
+   twins (same compiled artifact, loaded vs built);
+ * warmup runs largest-first and skips buckets the AOT store satisfies;
+ * continuous batching keeps results identical to windowed batching,
+   and the windowed flag preserves the PR 1 coalescing semantics;
+ * ``close(drain=True)`` never drops a pending enqueued during the
+   drain window (the PR 1 race, regression);
+ * two tenants under injected faults (breaker open on A, rollback on B)
+   show ZERO cross-tenant metric/generation contamination; per-tenant
+   quotas shed only the offender; weighted-fair dequeue tracks weights
+   under saturation; per-tenant Prometheus labels parse.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.local import load_model_local
+from transmogrifai_tpu.local.scorer import score_function_batch
+from transmogrifai_tpu.models.classification import LogisticRegressionModel
+from transmogrifai_tpu.serving import (AOTStore, BucketedExecutor,
+                                       MicroBatcher, ModelServer,
+                                       MultiTenantServer, ShedResult,
+                                       TenantConfig, scoring_digest)
+from transmogrifai_tpu.serving.aot import ScoringProgramSet, program_set_for
+from transmogrifai_tpu.tuning.costmodel import ServingCostLookup
+from transmogrifai_tpu.utils import compile_cache
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+MODEL_V1 = os.path.join(FIXTURES, "model_v1")
+
+
+@pytest.fixture(scope="module")
+def rows():
+    df = pd.read_csv(os.path.join(FIXTURES, "model_v1_input.csv"))
+    return df.to_dict("records")
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return AOTStore(str(tmp_path / "aot"))
+
+
+def _model():
+    return LogisticRegressionModel(coef=[0.2, -0.1, 0.4], intercept=0.05)
+
+
+# ---------------------------------------------------------------------------
+# AOT store
+# ---------------------------------------------------------------------------
+
+class TestAOTStore:
+    def test_put_get_roundtrip(self, store):
+        store.put("k1", b"payload-bytes", {"backend": "cpu"})
+        got = store.get("k1", expect={"backend": "cpu"})
+        assert got is not None
+        payload, meta = got
+        assert payload == b"payload-bytes"
+        assert meta["backend"] == "cpu"
+        assert meta["bytes"] == len(b"payload-bytes")
+
+    def test_corrupted_payload_reads_as_miss_and_is_deleted(self, store):
+        store.put("k1", b"payload-bytes", {})
+        bin_path, _ = store._paths("k1")
+        with open(bin_path, "wb") as f:
+            f.write(b"garbage")
+        assert store.get("k1") is None
+        assert "k1" not in store.keys()  # invalid entry dropped
+
+    def test_meta_field_mismatch_reads_as_miss(self, store):
+        store.put("k1", b"x", {"backend": "cpu", "jaxVersion": "9.9.9"})
+        assert store.get("k1", expect={"jaxVersion": "0.4.37"}) is None
+
+    def test_truncated_meta_reads_as_miss(self, store):
+        store.put("k1", b"x", {})
+        _, meta_path = store._paths("k1")
+        with open(meta_path, "w") as f:
+            f.write('{"incomplete":')
+        assert store.get("k1") is None
+
+    def test_contains_probe(self, store):
+        assert not store.contains("nope")
+        store.put("k1", b"x", {"backend": "cpu"})
+        assert store.contains("k1", expect={"backend": "cpu"})
+        assert not store.contains("k1", expect={"backend": "tpu"})
+
+    def test_atomic_write_leaves_no_tmp(self, store):
+        store.put("k1", b"x" * 1024, {})
+        leftovers = [n for n in os.listdir(store.root)
+                     if n.endswith(".tmp")]
+        assert leftovers == []
+
+
+class TestScoringDigest:
+    def test_same_params_same_key_different_params_different(self):
+        a = _model().aot_scoring_spec()
+        b = _model().aot_scoring_spec()
+        c = LogisticRegressionModel(
+            coef=[0.2, -0.1, 0.5], intercept=0.05).aot_scoring_spec()
+        assert scoring_digest(a, 8, "cpu") == scoring_digest(b, 8, "cpu")
+        assert scoring_digest(a, 8, "cpu") != scoring_digest(c, 8, "cpu")
+        assert scoring_digest(a, 8, "cpu") != scoring_digest(a, 16, "cpu")
+        assert scoring_digest(a, 8, "cpu") != scoring_digest(a, 8, "tpu")
+
+
+# ---------------------------------------------------------------------------
+# program set: AOT load vs JIT compile parity
+# ---------------------------------------------------------------------------
+
+class TestScoringProgramSet:
+    def test_jit_then_aot_load_byte_identical(self, store):
+        X = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+        ps1 = program_set_for(_model(), store=store, cache_key_prefix="p1")
+        assert ps1.ensure_bucket(8) == "jit"       # cold store: compiles
+        out1 = ps1.predict(X)
+        ps2 = program_set_for(_model(), store=store, cache_key_prefix="p2")
+        assert ps2.ensure_bucket(8) == "aot"       # write-through hit
+        out2 = ps2.predict(X)
+        assert (out1.prediction == out2.prediction).all()
+        assert (out1.raw_prediction == out2.raw_prediction).all()
+        assert (out1.probability == out2.probability).all()
+
+    def test_host_predict_close(self, store):
+        X = np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32)
+        m = _model()
+        ps = program_set_for(m, store=store)
+        ps.ensure_bucket(4)
+        dev = ps.predict(X)
+        host = m.predict_batch(X)
+        np.testing.assert_allclose(dev.probability, host.probability,
+                                   rtol=3e-6, atol=1e-7)
+        assert (dev.prediction == host.prediction).all()
+
+    def test_corrupted_entry_falls_back_to_jit_and_heals(self, store):
+        ps1 = program_set_for(_model(), store=store)
+        ps1.ensure_bucket(4)
+        key = scoring_digest(ps1.spec, 4, ps1.backend)
+        bin_path, _ = store._paths(key)
+        with open(bin_path, "ab") as f:
+            f.write(b"trailing-corruption")
+        ps2 = program_set_for(_model(), store=store)
+        assert ps2.ensure_bucket(4) == "jit"       # corrupt -> recompile
+        ps3 = program_set_for(_model(), store=store)
+        assert ps3.ensure_bucket(4) == "aot"       # write-through healed
+
+    def test_unknown_shape_returns_none(self, store):
+        ps = program_set_for(_model(), store=store)
+        ps.ensure_bucket(4)
+        assert ps.predict(np.zeros((3, 3), np.float32)) is None   # no bucket
+        assert ps.predict(np.zeros((4, 7), np.float32)) is None   # wrong D
+
+    def test_tree_family_has_no_spec(self):
+        from transmogrifai_tpu.serving.aot import program_set_for as psf
+        from transmogrifai_tpu.models.regression import (
+            IsotonicRegressionModel)
+
+        m = IsotonicRegressionModel(boundaries=[0.0, 1.0],
+                                    predictions=[0.0, 1.0])
+        assert psf(m) is None
+
+
+# ---------------------------------------------------------------------------
+# executor: warmup order + AOT skip
+# ---------------------------------------------------------------------------
+
+class TestWarmupOrder:
+    def test_warmup_is_largest_first(self, rows):
+        srv = ModelServer.from_path(MODEL_V1, name="wo", max_batch=8,
+                                    warmup_row=dict(rows[0]))
+        ex = srv._executor_for(srv.registry.get("wo"))
+        seen = []
+        orig = ex._run_bucket
+
+        def spy(padded, bucket):
+            seen.append(bucket)
+            return orig(padded, bucket)
+
+        ex._run_bucket = spy
+        ex.warmup(dict(rows[0]))
+        assert seen == [8, 4, 2, 1]
+
+    def test_aot_satisfied_buckets_skip_warm_run(self, store):
+        m = _model()
+        pre = program_set_for(m, store=store, cache_key_prefix="pre")
+        for b in (1, 2, 4):
+            pre.ensure_bucket(b)                    # populate the store
+
+        calls = []
+
+        def score_fn(batch_rows):
+            calls.append(len(batch_rows))
+            return [{"s": 0.0} for _ in batch_rows]
+
+        m2 = _model()
+        ex = BucketedExecutor(score_fn, max_batch=4, model=m2,
+                              aot_store=store, device_programs=True,
+                              cache_key_prefix="skip")
+        timings = ex.warmup({"x": 1.0})
+        assert calls == []                          # nothing warm-ran
+        assert sorted(timings) == [1, 2, 4]
+        assert ex.programs.modes == {1: "aot", 2: "aot", 4: "aot"}
+        assert ex.warm_buckets == [1, 2, 4]
+
+
+# ---------------------------------------------------------------------------
+# continuous batching
+# ---------------------------------------------------------------------------
+
+class TestContinuousBatching:
+    def test_results_identical_across_modes(self, rows):
+        expected = score_function_batch(load_model_local(MODEL_V1))(rows[:6])
+        for mode in ("windowed", "continuous"):
+            srv = ModelServer.from_path(
+                MODEL_V1, name=f"mode-{mode}", max_batch=8,
+                max_latency_ms=2.0, warmup_row=dict(rows[0]),
+                batch_mode=mode)
+            with srv:
+                assert srv.score(rows[:6]) == expected
+                assert srv.snapshot()["batchMode"] == mode
+
+    def test_continuous_dispatches_without_window_wait(self):
+        """A lone request must NOT wait out a coalescing window: the
+        continuous dispatcher forms the batch the moment the executor is
+        free."""
+        batcher = MicroBatcher(lambda rs: list(rs), max_batch=64,
+                               max_latency_ms=200.0, mode="continuous")
+        batcher.start()
+        try:
+            t0 = time.perf_counter()
+            batcher.submit([{"i": 1}]).result(timeout=2)
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 0.1   # windowed would have waited ~200ms
+        finally:
+            batcher.close()
+
+    def test_windowed_flag_keeps_pr1_coalescing(self):
+        """The PR 1 pin, now behind mode="windowed": requests queued
+        before start coalesce into ONE batch after the window closes."""
+        executed = []
+        batcher = MicroBatcher(
+            lambda rs: executed.append(len(rs)) or list(rs),
+            max_batch=16, max_latency_ms=1.0, mode="windowed")
+        futures = [batcher.submit([{"i": i}]) for i in range(6)]
+        batcher.start()
+        try:
+            results = [f.result(timeout=2) for f in futures]
+            assert [r[0]["i"] for r in results] == list(range(6))
+            assert executed == [6]
+        finally:
+            batcher.close()
+
+    def test_greedy_bucket_choice_prefers_measured_cheap_bucket(self):
+        lookup = ServingCostLookup()
+        # bucket 8 measured pathological, bucket 4 cheap
+        for _ in range(4):
+            lookup.observe(8, 1.0)
+            lookup.observe(4, 0.001)
+        batcher = MicroBatcher(lambda rs: list(rs), max_batch=8,
+                               mode="continuous", cost_lookup=lookup)
+        assert batcher._choose_bucket(8) == 4
+        # and with no signal: largest fillable wins (linear assumption)
+        fresh = MicroBatcher(lambda rs: list(rs), max_batch=8,
+                             mode="continuous",
+                             cost_lookup=ServingCostLookup())
+        assert fresh._choose_bucket(8) == 8
+        assert fresh._choose_bucket(3) == 4
+
+    def test_cost_lookup_tiers(self):
+        lookup = ServingCostLookup()
+        assert lookup.source(8) == "analytic"
+        lookup.observe(8, 0.01)
+        assert lookup.source(8) == "measured"
+        assert lookup.predict_s(8) == pytest.approx(0.01)
+        lookup.observe(8, 0.02)   # EWMA moves toward the new value
+        assert 0.01 < lookup.predict_s(8) < 0.02
+
+    def test_late_arrivals_admitted_into_forming_batch(self):
+        """While the dispatcher holds an under-filled batch open
+        (throughput mode: a burst projects max_batch fillable), a late
+        submit must ride the SAME batch.  The arrival-rate probe is
+        pinned so the regime choice is deterministic."""
+        executed = []
+
+        def execute(rs):
+            executed.append(len(rs))
+            return list(rs)
+
+        batcher = MicroBatcher(execute, max_batch=8, max_latency_ms=80.0,
+                               mode="continuous")
+        # pinned burst: deficit/rate = 7/100 = 70ms <= 2x max_latency ->
+        # throughput mode targets bucket 8 and holds the batch open
+        batcher._arrival_rate_locked = lambda: 100.0
+        f1 = batcher.submit([{"i": 0}])
+        batcher.start()
+        time.sleep(0.02)           # dispatcher is inside the fill hold
+        f2 = batcher.submit([{"i": 1}])
+        try:
+            assert len(f1.result(timeout=2)) == 1
+            assert len(f2.result(timeout=2)) == 1
+            assert executed[0] == 2   # late row rode the forming batch
+        finally:
+            batcher.close()
+
+    def test_no_burst_dispatches_immediately(self):
+        """Latency mode: with no burst in progress a lone request leaves
+        at once (no hold), regardless of max_latency."""
+        executed = []
+        batcher = MicroBatcher(
+            lambda rs: executed.append(len(rs)) or list(rs),
+            max_batch=8, max_latency_ms=500.0, mode="continuous")
+        batcher._arrival_rate_locked = lambda: 0.0
+        batcher.start()
+        try:
+            t0 = time.perf_counter()
+            batcher.submit([{"i": 0}]).result(timeout=2)
+            assert time.perf_counter() - t0 < 0.1
+            assert executed == [1]
+        finally:
+            batcher.close()
+
+
+class TestCloseDrainRace:
+    def test_drain_never_drops_racing_submits(self):
+        """Regression (ISSUE 13 satellite): submits racing close(drain=True)
+        must ALL resolve — scored or shed, never hung."""
+        def execute(rs):
+            time.sleep(0.002)
+            return list(rs)
+
+        for _ in range(5):
+            batcher = MicroBatcher(execute, max_batch=4,
+                                   mode="continuous")
+            batcher.start()
+            futures = []
+            stop = threading.Event()
+
+            def submitter():
+                while not stop.is_set():
+                    futures.append(batcher.submit([{"i": 1}]))
+                    time.sleep(0.0005)
+
+            t = threading.Thread(target=submitter, daemon=True)
+            t.start()
+            time.sleep(0.01)
+            batcher.close(drain=True)
+            stop.set()
+            t.join(timeout=2)
+            for f in futures:
+                res = f.result(timeout=5)   # hangs = dropped pending
+                assert len(res) == 1
+            # drained: everything in the queue at close time was scored
+            assert not batcher._queue
+
+    def test_submits_after_close_shed_as_shutting_down(self):
+        batcher = MicroBatcher(lambda rs: list(rs), max_batch=4,
+                               mode="continuous")
+        batcher.start()
+        batcher.close(drain=True)
+        res = batcher.submit([{"i": 1}]).result(timeout=1)
+        assert isinstance(res[0], ShedResult)
+        assert res[0].reason == "shutting_down"
+
+
+# ---------------------------------------------------------------------------
+# multi-tenancy
+# ---------------------------------------------------------------------------
+
+def _slow_executor(server, name, delay_s=0.003):
+    ex = server._executor_for(server.registry.get(name))
+    orig = ex.score_fn
+
+    def slow(rs, _orig=orig):
+        time.sleep(delay_s)
+        return _orig(rs)
+
+    ex.score_fn = slow
+    return ex
+
+
+class TestMultiTenant:
+    def test_parity_and_routing(self, rows):
+        expected = score_function_batch(load_model_local(MODEL_V1))(rows[:4])
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("a", max_batch=8,
+                                    warmup_row=dict(rows[0])),
+                       path=MODEL_V1)
+        mts.add_tenant(TenantConfig("b", max_batch=8), path=MODEL_V1)
+        with mts:
+            assert mts.score(rows[:4], tenant="a") == expected
+            assert mts.score(rows[:4], tenant="b") == expected
+            with pytest.raises(KeyError):
+                mts.score(rows[:1], tenant="nope")
+            with pytest.raises(KeyError):
+                mts.score(rows[:1])   # ambiguous with two tenants
+
+    def test_single_tenant_default_routing(self, rows):
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("only", max_batch=8), path=MODEL_V1)
+        with mts:
+            out = mts.score(rows[:2])   # no tenant needed with one lane
+            assert len(out) == 2
+
+    def test_per_tenant_quota_sheds_only_offender(self, rows):
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("small", max_batch=4,
+                                    max_queue_rows=4), path=MODEL_V1)
+        mts.add_tenant(TenantConfig("big", max_batch=4,
+                                    max_queue_rows=1024), path=MODEL_V1)
+        # NOT started: queues cannot drain, quotas bind immediately
+        mts.submit(rows[:4], tenant="small")
+        shed = mts.submit(rows[:2], tenant="small").result(timeout=1)
+        assert isinstance(shed[0], ShedResult)
+        assert shed[0].reason == "queue_full"
+        ok = mts.submit(rows[:2], tenant="big")
+        assert not ok.done()            # big admitted, just queued
+        assert mts.tenant("small").metrics.shed == 2
+        assert mts.tenant("big").metrics.shed == 0
+        mts.stop(drain=False)
+
+    def test_weighted_fair_dequeue_under_saturation(self, rows):
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("gold", weight=3.0, max_batch=4,
+                                    max_queue_rows=64), path=MODEL_V1)
+        mts.add_tenant(TenantConfig("bronze", weight=1.0, max_batch=4,
+                                    max_queue_rows=64), path=MODEL_V1)
+        for name in ("gold", "bronze"):
+            _slow_executor(mts.tenant(name), name)
+        mts.start()
+        stop = threading.Event()
+
+        def flood(tenant):
+            while not stop.is_set():
+                mts.submit(rows[:2], tenant=tenant)
+                time.sleep(0.0005)
+
+        threads = [threading.Thread(target=flood, args=(t,), daemon=True)
+                   for t in ("gold", "bronze")]
+        for t in threads:
+            t.start()
+        time.sleep(1.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        snap = mts.snapshot()
+        mts.stop(drain=False)
+        gold = snap["tenants"]["gold"]["wfq"]["dispatchedRows"]
+        bronze = snap["tenants"]["bronze"]["wfq"]["dispatchedRows"]
+        assert bronze > 0
+        assert 2.0 <= gold / bronze <= 4.5   # tracks the 3:1 weights
+
+    def test_breaker_isolation_under_injected_fault(self, rows):
+        """Breaker open on A: A host-fallbacks, B's ledgers stay clean."""
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("a", max_batch=4, failure_threshold=1,
+                                    breaker_reset_s=60.0), path=MODEL_V1)
+        mts.add_tenant(TenantConfig("b", max_batch=4), path=MODEL_V1)
+        sa = mts.tenant("a")
+        ex = sa._executor_for(sa.registry.get("a"))
+
+        def boom(_rows):
+            raise RuntimeError("injected device worker crash")
+
+        ex.score_fn = boom
+        expected = score_function_batch(load_model_local(MODEL_V1))(rows[:2])
+        with mts:
+            out_a = mts.score(rows[:2], tenant="a")
+            assert out_a == expected          # host fallback answered
+            out_b = mts.score(rows[:2], tenant="b")
+            assert out_b == expected
+            snap_a = mts.tenant("a").snapshot()
+            snap_b = mts.tenant("b").snapshot()
+        assert snap_a["breakerState"] == "open"
+        assert snap_a["deviceErrors"] >= 1
+        assert snap_a["hostFallbacks"] >= 1
+        # ZERO contamination of B
+        assert snap_b["breakerState"] == "closed"
+        assert snap_b["deviceErrors"] == 0
+        assert snap_b["hostFallbacks"] == 0
+        assert snap_b["shed"] == 0
+
+    def test_rollback_isolation(self, rows):
+        """Rollback on B's registry name never touches A's generations,
+        entry, or metrics."""
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("a", max_batch=4), path=MODEL_V1)
+        mts.add_tenant(TenantConfig("b", max_batch=4), path=MODEL_V1)
+        reg = mts.registry
+        reg.pin("b")                       # v1 is last-known-good
+        reg.load("b", MODEL_V1)            # v2 swap
+        a_before = reg.get("a")
+        a_gens_before = reg.generations("a")
+        assert reg.get("b").version == 2
+        rolled = reg.rollback("b")
+        assert rolled.version == 1
+        assert reg.get("b").version == 1
+        # A untouched: same entry object, same generation list
+        assert reg.get("a") is a_before
+        assert reg.generations("a") == a_gens_before
+        assert mts.tenant("a").metrics.rollbacks == 0
+        mts.stop(drain=False)
+
+    def test_drift_monitor_per_tenant(self, rows):
+        """Each tenant's DriftMonitor sees only its own traffic (the
+        fixture model predates exported baselines, so observation routing
+        is pinned with counting stubs — the DriftMonitor protocol)."""
+
+        class CountingMonitor:
+            def __init__(self):
+                self.rows_observed = 0
+
+            def observe_rows(self, batch_rows):
+                self.rows_observed += len(batch_rows)
+
+            def snapshot(self):
+                return {"rowsObserved": self.rows_observed}
+
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("a", max_batch=4), path=MODEL_V1)
+        mts.add_tenant(TenantConfig("b", max_batch=4), path=MODEL_V1)
+        mon_a, mon_b = CountingMonitor(), CountingMonitor()
+        mts.tenant("a").with_drift_monitor(mon_a)
+        mts.tenant("b").with_drift_monitor(mon_b)
+        with mts:
+            mts.score(rows[:4], tenant="a")
+            mts.score(rows[:2], tenant="a")
+            snap = mts.snapshot()
+        assert mon_a.rows_observed == 6
+        assert mon_b.rows_observed == 0
+        assert snap["tenants"]["a"]["drift"]["rowsObserved"] == 6
+        assert snap["tenants"]["b"]["drift"]["rowsObserved"] == 0
+
+    def test_remove_tenant_sheds_and_evicts(self, rows):
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("x", max_batch=4), path=MODEL_V1)
+        mts.add_tenant(TenantConfig("y", max_batch=4), path=MODEL_V1)
+        fut = mts.submit(rows[:2], tenant="x")   # not started: stays queued
+        assert mts.remove_tenant("x")
+        res = fut.result(timeout=1)
+        assert isinstance(res[0], ShedResult)
+        assert mts.tenants() == ["y"]
+        assert mts.registry.maybe_get("x") is None
+        mts.stop(drain=False)
+
+    def test_prometheus_per_tenant_labels_parse(self, rows):
+        from transmogrifai_tpu.obs.prometheus import (parse_exposition,
+                                                      prometheus_text)
+
+        mts = MultiTenantServer()
+        mts.add_tenant(TenantConfig("a", max_batch=8), path=MODEL_V1)
+        mts.add_tenant(TenantConfig("b", max_batch=8), path=MODEL_V1)
+        with mts:
+            mts.score(rows[:4], tenant="a")
+            text = prometheus_text(tenants=mts.tenant_snapshots())
+        parsed = parse_exposition(text)   # raises on any malformed line
+        a_rows = parsed['tmog_serving_rows_total{tenant="a"}']
+        b_rows = parsed['tmog_serving_rows_total{tenant="b"}']
+        assert a_rows == 4 and b_rows == 0
+        assert 'tmog_serving_queue_depth{tenant="a"}' in parsed
+        # the batch histogram carries both labels, sorted
+        assert any(k.startswith("tmog_serving_batches_by_bucket_total{")
+                   and 'tenant="a"' in k for k in parsed)
+
+
+# ---------------------------------------------------------------------------
+# device-programs server e2e (AOT cache through ModelServer)
+# ---------------------------------------------------------------------------
+
+class TestDeviceProgramServer:
+    def test_aot_server_scores_and_reports(self, rows, tmp_path):
+        aot_dir = str(tmp_path / "aot")
+        srv1 = ModelServer.from_path(
+            MODEL_V1, name="dev1", max_batch=4, warmup_row=dict(rows[0]),
+            device_programs=True, aot_store=aot_dir)
+        with srv1:
+            out1 = srv1.score(rows[:3])
+            snap1 = srv1.snapshot()
+        assert set(snap1["aotPrograms"].values()) == {"jit"}
+        # a second "replica" over the same store cold-starts via AOT loads
+        srv2 = ModelServer.from_path(
+            MODEL_V1, name="dev2", max_batch=4, warmup_row=dict(rows[0]),
+            device_programs=True, aot_store=aot_dir)
+        with srv2:
+            out2 = srv2.score(rows[:3])
+            snap2 = srv2.snapshot()
+        assert set(snap2["aotPrograms"].values()) == {"aot"}
+        # byte-identical scoring between the JIT and AOT replicas
+        assert json.dumps(out1, sort_keys=True, default=str) == \
+            json.dumps(out2, sort_keys=True, default=str)
+
+    def test_breaker_fallback_bypasses_device_programs(self, rows,
+                                                       tmp_path):
+        """An open breaker serves from the HOST scorer even when device
+        programs are installed — the programs live behind the device
+        scoring context only."""
+        srv = ModelServer.from_path(
+            MODEL_V1, name="devbrk", max_batch=4, failure_threshold=1,
+            breaker_reset_s=60.0, warmup_row=dict(rows[0]),
+            device_programs=True, aot_store=str(tmp_path / "aot"))
+        expected = score_function_batch(load_model_local(MODEL_V1))(rows[:2])
+        with srv:
+            ex = srv._executor_for(srv.registry.get("devbrk"))
+
+            def boom(_rows):
+                raise RuntimeError("injected")
+
+            ex.score_fn = boom
+            got = srv.score(rows[:2])
+            assert got == expected          # exact host-path parity
+            assert srv.snapshot()["breakerState"] == "open"

@@ -67,6 +67,48 @@ def _device_std_sigmoid_score(X, mu, sigma, coef, intercept):
     return jax.nn.sigmoid(((X - mu) / sigma) @ coef + intercept)
 
 
+# -- AOT-exportable scoring programs (serving/aot.py) ------------------------
+# Pure jax functions of (X, *params) with static shapes: the serving plane
+# lowers one executable per (model digest, shape bucket) and persists it in
+# the AOT store, so a fresh replica cold-starts without tracing or
+# compiling.  Everything stays float32 regardless of the x64 flag so the
+# same program (and the same persisted executable) serves tests and prod.
+
+def _aot_logreg_binary(X, coef, intercept):
+    z = X @ coef + intercept
+    p1 = jax.nn.sigmoid(z)
+    raw = jnp.stack([-z, z], axis=1)
+    proba = jnp.stack([jnp.float32(1.0) - p1, p1], axis=1)
+    pred = (p1 >= jnp.float32(0.5)).astype(jnp.float32)
+    return pred, raw, proba
+
+
+def _aot_softmax(X, coef, intercept):
+    Z = X @ coef.T + intercept
+    e = jnp.exp(Z - Z.max(axis=1, keepdims=True))
+    proba = e / e.sum(axis=1, keepdims=True)
+    pred = proba.argmax(axis=1).astype(jnp.float32)
+    return pred, Z, proba
+
+
+def _aot_svc(X, coef, intercept):
+    z = X @ coef + intercept
+    raw = jnp.stack([-z, z], axis=1)
+    pred = (z >= jnp.float32(0.0)).astype(jnp.float32)
+    return pred, raw
+
+
+def _aot_naive_bayes(X, log_prior, log_lik):
+    Xc = jnp.maximum(X, jnp.float32(0.0))
+    joint = Xc @ log_lik.T + log_prior
+    m = joint.max(axis=1, keepdims=True)
+    logp = joint - (m + jnp.log(
+        jnp.exp(joint - m).sum(axis=1, keepdims=True)))
+    proba = jnp.exp(logp)
+    pred = proba.argmax(axis=1).astype(jnp.float32)
+    return pred, logp, proba
+
+
 class OpLogisticRegression(PredictorEstimator):
     """L2/elastic-net logistic regression trained by jitted Newton-IRLS.
 
@@ -281,6 +323,19 @@ class LogisticRegressionModel(PredictorModel):
             raw_prediction=np.asarray(raw),
             probability=proba)
 
+    def aot_scoring_spec(self):
+        from .prediction import AOTScoringSpec
+        coef = np.asarray(self.coef, np.float32)
+        if coef.ndim == 1:
+            return AOTScoringSpec(
+                name="logreg.binary", fn=_aot_logreg_binary,
+                params=(coef, np.float32(self.intercept)),
+                outputs=("prediction", "rawPrediction", "probability"))
+        return AOTScoringSpec(
+            name="logreg.softmax", fn=_aot_softmax,
+            params=(coef, np.asarray(self.intercept, np.float32)),
+            outputs=("prediction", "rawPrediction", "probability"))
+
 
 class OpLinearSVC(PredictorEstimator):
     """Squared-hinge linear SVM via jitted Newton (OpLinearSVC parity)."""
@@ -331,6 +386,14 @@ class LinearSVCModel(PredictorModel):
         raw = np.stack([-z, z], axis=1)
         return PredictionBatch(prediction=(z >= 0).astype(np.float64),
                                raw_prediction=raw)
+
+    def aot_scoring_spec(self):
+        from .prediction import AOTScoringSpec
+        return AOTScoringSpec(
+            name="linsvc", fn=_aot_svc,
+            params=(np.asarray(self.coef, np.float32),
+                    np.float32(self.intercept)),
+            outputs=("prediction", "rawPrediction"))
 
 
 class OpNaiveBayes(PredictorEstimator):
@@ -436,3 +499,11 @@ class NaiveBayesModel(PredictorModel):
         proba = np.exp(logp)
         return PredictionBatch(prediction=proba.argmax(axis=1).astype(np.float64),
                                raw_prediction=logp, probability=proba)
+
+    def aot_scoring_spec(self):
+        from .prediction import AOTScoringSpec
+        return AOTScoringSpec(
+            name="naivebayes", fn=_aot_naive_bayes,
+            params=(np.asarray(self.log_prior, np.float32),
+                    np.asarray(self.log_lik, np.float32)),
+            outputs=("prediction", "rawPrediction", "probability"))

@@ -21,7 +21,27 @@ from ..types.columns import FeatureColumn
 from ..types.feature_types import OPNumeric, OPVector, Prediction
 
 __all__ = ["PredictionBatch", "prediction_column", "PredictorEstimator",
-           "PredictorModel"]
+           "PredictorModel", "AOTScoringSpec"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AOTScoringSpec:
+    """A model's pure device scoring program, in AOT-exportable form.
+
+    ``fn(X, *params)`` must be a pure jax function of a fixed-shape
+    ``(N, D) float32`` matrix plus the model's parameter arrays, returning
+    a tuple of arrays named by ``outputs`` (a subset/order of
+    ``("prediction", "rawPrediction", "probability")``).  Parameters are
+    RUNTIME arguments (not baked constants) so the serialized executable's
+    shape is exactly ``(bucket, D)`` + the param shapes — the serving AOT
+    cache (serving/aot.py) content-addresses entries on a digest of the
+    params anyway, so a changed model can never reuse a stale program.
+    """
+
+    name: str                 # program family, e.g. "logreg.binary"
+    fn: Any                   # callable (X, *params) -> tuple of arrays
+    params: tuple             # numpy arrays / np scalars, fixed order
+    outputs: tuple            # names for fn's returned tuple, in order
 
 
 @dataclasses.dataclass
@@ -125,6 +145,13 @@ class PredictorModel(BinaryModel):
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
         raise NotImplementedError
 
+    def aot_scoring_spec(self) -> Optional[AOTScoringSpec]:
+        """The model's scoring program as an :class:`AOTScoringSpec`, or
+        None when the family has no single-program device form (trees,
+        isotonic) — serving then keeps the host ``predict_batch`` path.
+        """
+        return None
+
     def score_device(self, X: np.ndarray, problem_type: str):
         """Validation score vector as a DEVICE array, or None if unsupported.
 
@@ -139,5 +166,19 @@ class PredictorModel(BinaryModel):
 
     def transform_columns(self, label_col, features_col) -> FeatureColumn:
         X = np.asarray(features_col.values, dtype=np.float32)
+        # serving device path: when a BucketedExecutor has installed AOT/
+        # JIT-compiled per-bucket scoring programs on this model AND the
+        # calling thread is inside the device scoring context (set by the
+        # executor, never by the breaker's host-fallback path), route
+        # through the compiled program for this batch shape.  Unknown
+        # shapes return None and fall through to the host predict.
+        programs = getattr(self, "_serving_programs", None)
+        if programs is not None:
+            from ..serving.aot import device_scoring_active
+
+            if device_scoring_active():
+                batch = programs.predict(X)
+                if batch is not None:
+                    return FeatureColumn(Prediction, batch)
         batch = self.predict_batch(X)
         return FeatureColumn(Prediction, batch)

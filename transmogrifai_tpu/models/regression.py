@@ -88,6 +88,11 @@ def _device_linear_score(X, coef, intercept):
     return X @ coef + intercept
 
 
+def _aot_linear(X, coef, intercept):
+    # AOT-exportable scoring program (serving/aot.py): prediction only
+    return (X @ coef + intercept,)
+
+
 class LinearRegressionModel(PredictorModel):
     def __init__(self, coef: List[float], intercept: float,
                  uid: Optional[str] = None):
@@ -111,6 +116,14 @@ class LinearRegressionModel(PredictorModel):
                 jnp.asarray(self.coef, jnp.float32),
                 jnp.float32(self.intercept), X))
         return PredictionBatch(prediction=pred.astype(np.float64))
+
+    def aot_scoring_spec(self):
+        from .prediction import AOTScoringSpec
+        return AOTScoringSpec(
+            name="linreg", fn=_aot_linear,
+            params=(np.asarray(self.coef, np.float32),
+                    np.float32(self.intercept)),
+            outputs=("prediction",))
 
 
 class OpGeneralizedLinearRegression(PredictorEstimator):

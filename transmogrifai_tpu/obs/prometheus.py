@@ -90,13 +90,23 @@ def _fmt(v: float) -> str:
 
 
 def prometheus_text(snapshot: Optional[Dict[str, Any]] = None,
-                    counters=None) -> str:
+                    counters=None,
+                    tenants: Optional[Dict[str, Dict[str, Any]]] = None
+                    ) -> str:
     """The full exposition.  ``snapshot`` is a ``ServingMetrics.snapshot()``
     dict (None = no serving section); ``counters`` a ``RunCounters``
-    (None = the process-global ``COUNTERS``)."""
+    (None = the process-global ``COUNTERS``); ``tenants`` maps tenant name
+    -> serving snapshot — every serving sample then carries a
+    ``tenant="<name>"`` label, one family emitted once with one sample per
+    tenant (the multi-tenant registry's per-tenant exposition)."""
     doc = _Doc()
+    sections = []
     if snapshot is not None:
-        _serving_section(doc, snapshot)
+        sections.append((None, snapshot))
+    for name, snap in sorted((tenants or {}).items()):
+        sections.append(({"tenant": name}, snap))
+    if sections:
+        _serving_section(doc, sections)
     if counters is None:
         from ..utils import profiling
 
@@ -105,38 +115,63 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None,
     return doc.text()
 
 
-def _serving_section(doc: _Doc, snap: Dict[str, Any]) -> None:
+def _with_labels(base: Optional[Dict[str, str]],
+                 extra: Dict[str, str]) -> Dict[str, str]:
+    out = dict(base or {})
+    out.update(extra)
+    return out
+
+
+def _serving_section(doc: _Doc, sections) -> None:
+    """``sections`` = [(labels_or_None, snapshot)]: each metric family is
+    emitted ONCE with one sample per section (per tenant)."""
     for key, help_text in _SERVING_COUNTERS:
         doc.metric(f"tmog_serving_{_snake(key)}_total", "counter",
-                   help_text, [(None, _num(snap.get(key)) or 0.0)])
+                   help_text,
+                   [(labels, _num(snap.get(key)) or 0.0)
+                    for labels, snap in sections])
     for key, help_text in _SERVING_GAUGES:
         doc.metric(f"tmog_serving_{_snake(key)}", "gauge", help_text,
-                   [(None, _num(snap.get(key)) or 0.0)])
+                   [(labels, _num(snap.get(key)) or 0.0)
+                    for labels, snap in sections])
     # latency quantiles: absent samples when the reservoir is empty —
     # a summary with no observations yet is a TYPE line, not a NaN
-    lat = snap.get("latencyMs") or {}
     q_samples = []
-    for q_key, q in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
-        v = _num(lat.get(q_key))
-        if v is not None:
-            q_samples.append(({"quantile": q}, v / 1000.0))
+    for labels, snap in sections:
+        lat = snap.get("latencyMs") or {}
+        for q_key, q in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
+            v = _num(lat.get(q_key))
+            if v is not None:
+                q_samples.append((_with_labels(labels, {"quantile": q}),
+                                  v / 1000.0))
     doc.metric("tmog_serving_request_latency_seconds", "summary",
                "end-to-end request latency (reservoir quantiles)",
                q_samples)
-    hist = snap.get("batchSizeHistogram") or {}
+    h_samples = []
+    for labels, snap in sections:
+        hist = snap.get("batchSizeHistogram") or {}
+        h_samples.extend(
+            (_with_labels(labels, {"bucket": str(k)}), _num(v))
+            for k, v in sorted(hist.items(), key=lambda kv: int(kv[0])))
     doc.metric("tmog_serving_batches_by_bucket_total", "counter",
-               "executed micro-batches per shape bucket",
-               [({"bucket": str(k)}, _num(v)) for k, v in
-                sorted(hist.items(), key=lambda kv: int(kv[0]))])
-    cache = (snap.get("compileCache") or {}).get("totals") or {}
+               "executed micro-batches per shape bucket", h_samples)
+    # compile/AOT ledger is process-global: emit once, never per tenant
+    cache = (sections[0][1].get("compileCache") or {}).get("totals") or {}
     doc.metric("tmog_compile_cache_events_total", "counter",
-               "warm-program compiles vs hits",
+               "warm-program compiles vs hits vs AOT store loads/misses",
                [({"event": "compile"}, _num(cache.get("compiles")) or 0.0),
-                ({"event": "hit"}, _num(cache.get("hits")) or 0.0)])
-    age = _num(snap.get("lastFallbackAgeSecs"))
+                ({"event": "hit"}, _num(cache.get("hits")) or 0.0),
+                ({"event": "aot_load"}, _num(cache.get("aotLoads")) or 0.0),
+                ({"event": "aot_miss"},
+                 _num(cache.get("aotMisses")) or 0.0)])
+    age_samples = []
+    for labels, snap in sections:
+        age = _num(snap.get("lastFallbackAgeSecs"))
+        if age is not None:
+            age_samples.append((labels, age))
     doc.metric("tmog_serving_last_fallback_age_seconds", "gauge",
                "seconds since the last host fallback (absent = never)",
-               [(None, age)] if age is not None else [])
+               age_samples)
 
 
 def _run_section(doc: _Doc, counters) -> None:

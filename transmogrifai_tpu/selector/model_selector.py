@@ -877,6 +877,9 @@ class SelectedModel(PredictorModel):
     def predict_batch(self, X: np.ndarray) -> PredictionBatch:
         return self.inner.predict_batch(X)
 
+    def aot_scoring_spec(self):
+        return self.inner.aot_scoring_spec()
+
 
 # ---------------------------------------------------------------------------
 # Factories with default model grids

@@ -22,6 +22,7 @@ from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence
 
 from .admission import AdmissionController, CircuitBreaker, ShedResult
+from .aot import AOTStore, ScoringProgramSet, scoring_digest
 from .batcher import MicroBatcher
 from .drift import DriftConfig, DriftMonitor, export_drift_baselines
 from .executor import BucketedExecutor, bucket_for, bucket_sizes
@@ -33,7 +34,9 @@ __all__ = ["ModelServer", "ModelRegistry", "ModelEntry", "MicroBatcher",
            "BucketedExecutor", "AdmissionController", "CircuitBreaker",
            "ShedResult", "ServingMetrics", "bucket_sizes", "bucket_for",
            "DriftMonitor", "DriftConfig", "export_drift_baselines",
-           "GuardedSwap", "SwapGateConfig", "SwapDecision"]
+           "GuardedSwap", "SwapGateConfig", "SwapDecision",
+           "AOTStore", "ScoringProgramSet", "scoring_digest",
+           "MultiTenantServer", "TenantConfig"]
 
 
 class ModelServer:
@@ -49,7 +52,11 @@ class ModelServer:
                  max_queue_rows: int = 1024,
                  default_deadline_ms: Optional[float] = None,
                  failure_threshold: int = 3, breaker_reset_s: float = 30.0,
-                 warmup_row: Optional[Dict[str, Any]] = None):
+                 warmup_row: Optional[Dict[str, Any]] = None,
+                 batch_mode: str = "continuous",
+                 device_programs: bool = False,
+                 aot_store: Any = None,
+                 cost_lookup: Any = None):
         self.registry = registry
         self.name = name
         self.max_batch = int(max_batch)
@@ -60,10 +67,23 @@ class ModelServer:
         self.breaker = CircuitBreaker(
             failure_threshold=failure_threshold,
             reset_after_s=breaker_reset_s)
+        #: opt-in AOT/device scoring (serving/aot.py): compile each shape
+        #: bucket's scoring program once, persist the serialized executable
+        #: in the content-addressed store, cold-start by LOADING it.
+        #: ``aot_store`` accepts an AOTStore, a directory path, or True for
+        #: the default store location; None with device_programs=True keeps
+        #: JIT-only device scoring (no persistence).
+        self.device_programs = bool(device_programs)
+        if aot_store is True:
+            aot_store = AOTStore()
+        elif isinstance(aot_store, str):
+            aot_store = AOTStore(aot_store)
+        self.aot_store = aot_store
         self.batcher = MicroBatcher(
             self._execute, max_batch=max_batch,
             max_latency_ms=max_latency_ms,
-            admission=self.admission, metrics=self.metrics)
+            admission=self.admission, metrics=self.metrics,
+            mode=batch_mode, cost_lookup=cost_lookup)
         self.warmup_row = warmup_row
         self._executors: Dict[int, BucketedExecutor] = {}  # entry version -> executor
         self._exec_lock = threading.Lock()
@@ -159,7 +179,10 @@ class ModelServer:
             if ex is None:
                 ex = BucketedExecutor(
                     entry.scorer, max_batch=self.max_batch,
-                    cache_key_prefix=f"serving.{entry.name}.v{entry.version}")
+                    cache_key_prefix=f"serving.{entry.name}.v{entry.version}",
+                    model=entry.model if self.device_programs else None,
+                    aot_store=self.aot_store,
+                    device_programs=self.device_programs)
                 self._executors = {entry.version: ex}  # evict stale versions
             return ex
 
@@ -218,9 +241,24 @@ class ModelServer:
         snap["model"] = self.registry.get(self.name).describe() \
             if self.registry.maybe_get(self.name) else None
         snap["breakerState"] = self.breaker.state
+        snap["batchMode"] = self.batcher.mode
+        if self.batcher.cost_lookup is not None:
+            snap["batchCost"] = self.batcher.cost_lookup.snapshot()
+        if self.device_programs:
+            ex = None
+            entry = self.registry.maybe_get(self.name)
+            if entry is not None:
+                with self._exec_lock:
+                    ex = self._executors.get(entry.version)
+            if ex is not None and ex.programs is not None:
+                snap["aotPrograms"] = ex.programs.modes
         if self.drift_monitor is not None:
             snap["drift"] = self.drift_monitor.snapshot()
         if self.guard is not None:
             snap["guardedSwap"] = self.guard.snapshot()
             snap["generations"] = self.registry.generations(self.name)
         return snap
+
+
+# imported last: tenancy composes ModelServer instances per tenant
+from .tenancy import MultiTenantServer, TenantConfig  # noqa: E402

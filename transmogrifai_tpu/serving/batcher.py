@@ -1,31 +1,55 @@
-"""Admission queue + adaptive micro-batcher.
+"""Admission queue + micro-batcher — continuous batch formation by default.
 
 Concurrent callers submit small row lists; a single dispatch thread
-coalesces them into one micro-batch up to ``max_batch`` rows or until the
-oldest waiting request has waited ``max_latency_ms`` — the classic
-serving trade: a request never waits more than the coalescing deadline,
-and under load batches fill to the cap so per-dispatch overhead (host↔
-device round trip, program launch) amortizes across requests.
+coalesces them into micro-batches.  Two formation modes:
+
+``continuous`` (default since serving v2)
+    The next batch forms the moment the executor frees: no fixed
+    coalescing window, no idle gap between batches.  The dispatcher picks
+    the target shape bucket GREEDILY, maximizing predicted service rate
+    ``rows / (projected_fill_wait + predicted_batch_cost)`` over the
+    current queue depth, the measured arrival rate, and the per-bucket
+    predicted batch cost (``tuning.costmodel.ServingCostLookup`` — online
+    EWMA of measured batch walls, cost-model fallback).  When holding the
+    batch open to fill a bigger bucket scores better (saturation: the
+    queue refills in a millisecond or two), it admits late-arriving rows
+    into the forming batch up to the projected-fill deadline (hard-capped
+    at ``max_latency_ms`` — the same bound the windowed mode pays); when
+    arrivals project nothing (light load), the batch dispatches
+    IMMEDIATELY — that asymmetry is the continuous-batching win over a
+    fixed window.
+
+``windowed`` (the PR 1 behavior, behind this flag)
+    Coalesce up to ``max_batch`` rows or until the oldest waiting request
+    has waited ``max_latency_ms``.  Kept byte-identical (test-asserted) as
+    the conservative fallback.
 
 The batcher is transport-agnostic: ``execute`` is any
 ``rows -> score maps`` callable (the server wires in the circuit-breaker +
 bucketed executor).  Results come back on per-request futures; shed and
 expired requests resolve to ``ShedResult``s, not exceptions.
+
+Shutdown discipline: ``close(drain=True)`` flips the batcher to *closing*
+(new submits shed as ``shutting_down``) and then drains UNDER THE LOCK
+until the queue is observably empty — a pending that made it into the
+queue is always either scored or shed, never silently dropped (the PR 1
+drain polled without the lock and could strand a submit that raced the
+final empty-check; regression-tested).
 """
 from __future__ import annotations
 
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..obs.flight import record_event
 from ..obs.trace import begin_span, end_span
 from .admission import AdmissionController, ShedResult
+from .executor import bucket_for, bucket_sizes
 from .metrics import ServingMetrics
 
-__all__ = ["MicroBatcher"]
-
+__all__ = ["MicroBatcher", "run_pending_batch"]
 
 class _Pending:
     __slots__ = ("rows", "future", "deadline", "enqueued_at")
@@ -42,15 +66,37 @@ class MicroBatcher:
     def __init__(self, execute: Callable[[List[Dict[str, Any]]], List[Any]],
                  max_batch: int = 64, max_latency_ms: float = 5.0,
                  admission: Optional[AdmissionController] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 mode: str = "continuous",
+                 cost_lookup: Any = None):
+        if mode not in ("continuous", "windowed"):
+            raise ValueError(
+                f"mode must be 'continuous' or 'windowed', got {mode!r}")
         self.execute = execute
         self.max_batch = int(max_batch)
         self.max_latency_s = float(max_latency_ms) / 1000.0
         self.admission = admission or AdmissionController()
         self.metrics = metrics or ServingMetrics()
+        self.mode = mode
+        #: per-bucket predicted batch cost (ServingCostLookup); built lazily
+        #: so a windowed batcher never touches tuning/
+        self.cost_lookup = cost_lookup
+        self._buckets = bucket_sizes(self.max_batch)
+        #: recent arrivals (monotonic t, rows) — the continuous bucket
+        #: choice anticipates rows that will land DURING the fill window,
+        #: so a closed-loop burst forms full batches instead of
+        #: fragmenting into whatever happened to be queued at form time
+        self._arrivals: List[Tuple[float, int]] = []
+        #: sticky saturation (continuous mode): once a near-full batch
+        #: forms, stay in throughput mode even when the instantaneous
+        #: arrival probe reads momentarily quiet — a single leaked
+        #: fragment breaks the convoy permanently.  Cleared when a fill
+        #: hold genuinely expires under-filled (load actually dropped).
+        self._saturated = False
         self._queue: List[_Pending] = []
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
+        self._closing = False
         self._closed = False
         self._thread: Optional[threading.Thread] = None
 
@@ -58,23 +104,37 @@ class MicroBatcher:
 
     def start(self) -> "MicroBatcher":
         if self._thread is None or not self._thread.is_alive():
+            self._closing = False
             self._closed = False
+            if self.mode == "continuous" and self.cost_lookup is None:
+                from ..tuning.costmodel import ServingCostLookup
+
+                self.cost_lookup = ServingCostLookup()
+            target = (self._dispatch_continuous
+                      if self.mode == "continuous"
+                      else self._dispatch_windowed)
             self._thread = threading.Thread(
-                target=self._dispatch_loop, name="op-serving-batcher",
-                daemon=True)
+                target=target, name="op-serving-batcher", daemon=True)
             self._thread.start()
         return self
 
     def close(self, drain: bool = True, timeout_s: float = 10.0) -> None:
-        """Stop the dispatch thread; by default drain queued work first."""
-        if drain and self._thread is not None and self._thread.is_alive():
-            deadline = time.monotonic() + timeout_s
-            while time.monotonic() < deadline:
-                with self._lock:
-                    if not self._queue:
-                        break
-                time.sleep(0.001)
+        """Stop the dispatch thread; by default drain queued work first.
+
+        Drains UNDER the lock: ``_closing`` makes every later submit shed
+        immediately, then we condition-wait until the dispatch thread has
+        observably emptied the queue (it keeps running until ``_closed``),
+        so nothing enqueued before the flag can be dropped."""
+        alive = self._thread is not None and self._thread.is_alive()
         with self._work:
+            self._closing = True
+            if drain and alive:
+                deadline = time.monotonic() + timeout_s
+                while self._queue:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._work.wait(timeout=min(remaining, 0.005))
             self._closed = True
             self._work.notify_all()
         if self._thread is not None:
@@ -96,7 +156,7 @@ class MicroBatcher:
             fut.set_result([])
             return fut
         admit_span = begin_span("serve.admit", cat="serve", rows=len(rows))
-        if self._closed:
+        if self._closing or self._closed:
             fut.set_result([ShedResult(reason="shutting_down")
                             for _ in rows])
             self.metrics.record_shed(len(rows))
@@ -110,43 +170,186 @@ class MicroBatcher:
             end_span(admit_span, outcome=f"shed:{shed.reason}")
             record_event("serve.shed", rows=len(rows), reason=shed.reason)
             return fut
-        self.metrics.record_admitted(len(rows))
-        end_span(admit_span, outcome="admitted")
         pending = _Pending(rows, self.admission.deadline_for(timeout_ms))
         with self._work:
+            if self._closing or self._closed:
+                # closing raced the unlocked check above: give back the
+                # admission reservation and shed — NEVER enqueue into a
+                # queue the dispatcher may already consider drained
+                self.admission.release(len(rows))
+                self.metrics.record_shed(len(rows))
+                end_span(admit_span, outcome="shed:shutting_down")
+                fut.set_result([ShedResult(reason="shutting_down")
+                                for _ in rows])
+                return fut
+            self.metrics.record_admitted(len(rows))
             self._queue.append(pending)
+            self._arrivals.append((pending.enqueued_at, len(rows)))
+            if len(self._arrivals) > 256:
+                del self._arrivals[:128]
             self.metrics.set_queue_depth(
                 sum(len(p.rows) for p in self._queue))
             self._work.notify()
+        end_span(admit_span, outcome="admitted")
         return pending.future
 
     def _est_drain_ms(self) -> Optional[float]:
-        """Rough retry-after hint: one coalescing window per queued batch."""
+        """Rough retry-after hint: predicted batch wall (continuous) or one
+        coalescing window (windowed) per queued batch."""
         with self._lock:
             queued = sum(len(p.rows) for p in self._queue)
         if queued == 0:
             return None
         batches = (queued + self.max_batch - 1) // self.max_batch
-        return batches * self.max_latency_s * 1000.0
+        per_batch_s = self.max_latency_s
+        if self.cost_lookup is not None:
+            per_batch_s = self.cost_lookup.predict_s(self.max_batch)
+        return batches * per_batch_s * 1000.0
 
-    # -- dispatch -----------------------------------------------------------
+    # -- batch formation ----------------------------------------------------
 
-    def _take_batch_locked(self) -> List[_Pending]:
+    def _take_batch_locked(self, target: Optional[int] = None,
+                           strict: bool = False) -> List[_Pending]:
         """Pop requests FIFO until the row budget is hit.  A request is
-        never split across batches (its rows stay one contiguous slice)."""
+        never split across batches (its rows stay one contiguous slice);
+        an oversized FIRST request is taken anyway (the executor chunks)
+        unless ``strict`` — the late-admission path, where exceeding the
+        already-chosen bucket would defeat the choice."""
+        budget = self.max_batch if target is None else target
         batch: List[_Pending] = []
         rows = 0
         while self._queue:
             nxt = self._queue[0]
-            if batch and rows + len(nxt.rows) > self.max_batch:
+            if (batch or strict) and rows + len(nxt.rows) > budget:
                 break
             batch.append(self._queue.pop(0))
             rows += len(nxt.rows)
-            if rows >= self.max_batch:
+            if rows >= budget:
                 break
         return batch
 
-    def _dispatch_loop(self) -> None:
+    def _arrival_rate_locked(self) -> float:
+        """Instantaneous arrival rate in rows/second (lock held), from
+        the span of the most recent K submits.  Closed-loop traffic is
+        BURSTY — all waiting callers resubmit within a couple of
+        milliseconds of a batch resolving, then go quiet while the next
+        batch runs — so a fixed-horizon average smears the burst down to
+        the mean throughput and never projects a fillable big bucket.
+        The recent-K span reads the burst as it happens and reads a lone
+        caller (whose K recent submits span seconds) as ~nothing."""
+        if len(self._arrivals) < 4:
+            return 0.0   # too few samples to call anything a burst
+        now = time.monotonic()
+        recent = self._arrivals[-16:]
+        # stale arrivals mean no burst is in progress
+        if now - recent[-1][0] > 0.02:
+            return 0.0
+        span = max(now - recent[0][0], 5e-4)
+        return sum(n for _t, n in recent) / span
+
+    def _formation_locked(self, queued_rows: int
+                          ) -> Tuple[int, float]:
+        """Two-regime formation: ``(target_bucket, fill_wait_s)``.
+
+        **Throughput mode** — when the instantaneous arrival rate
+        projects that ``max_batch`` can fill within a generous horizon
+        (2× ``max_latency_ms``), target the full bucket and hold the
+        forming batch open up to the projected fill time (hard-capped at
+        ``max_latency_ms``, the same bound the windowed mode pays).
+        Closed-loop saturation is bursty — every resolved batch wakes its
+        callers, who resubmit within a couple of milliseconds — and
+        per-dispatch cost is floor-heavy, so full batches are what
+        sustains peak rows/s; dispatching the fragment that happens to be
+        queued mid-burst fragments the convoy permanently.
+
+        **Latency mode** — otherwise (no burst in progress) pick the
+        dispatch-NOW bucket greedily by predicted service rate
+        ``servable / cost(b)`` and don't wait at all: a lone request
+        under light load leaves immediately, which is the
+        continuous-batching win over a fixed window.
+
+        Mode choice is HYSTERETIC: a near-full formed batch latches
+        saturation (momentarily-quiet arrival probes mid-burst must not
+        leak convoy-breaking fragments); a fill hold expiring under-
+        filled unlatches it."""
+        deficit = self.max_batch - queued_rows
+        if deficit <= 0:
+            return (self.max_batch, 0.0)
+        rate = self._arrival_rate_locked()
+        if rate > 0:
+            wait = deficit / rate
+            if wait <= 2.0 * self.max_latency_s:
+                return (self.max_batch,
+                        min(wait * 1.25, self.max_latency_s))
+        if self._saturated:
+            return (self.max_batch, self.max_latency_s)
+        return (self._choose_bucket(queued_rows), 0.0)
+
+    def _choose_bucket(self, queued_rows: int) -> int:
+        """Target bucket for what is queued right now (no hold-open
+        component) — the formation policy's dispatch-now half, used
+        directly by the multi-tenant dispatcher."""
+        lookup = self.cost_lookup
+        best_b, best_rate = self._buckets[0], -1.0
+        for b in self._buckets:
+            servable = min(queued_rows, b)
+            if servable <= 0:
+                break
+            cost = (lookup.predict_s(b) if lookup is not None
+                    else 1e-4 + b * 2e-5)
+            score = servable / max(cost, 1e-9)
+            if score >= best_rate:
+                best_rate, best_b = score, b
+        return best_b
+
+    # -- dispatch: continuous ------------------------------------------------
+
+    def _dispatch_continuous(self) -> None:
+        while True:
+            with self._work:
+                while not self._queue and not self._closed:
+                    self._work.wait(timeout=0.1)
+                if self._closed and not self._queue:
+                    return
+                queued = sum(len(p.rows) for p in self._queue)
+                target, fill_wait = self._formation_locked(queued)
+                batch = self._take_batch_locked(target)
+                rows = sum(len(p.rows) for p in batch)
+                # late admission up to dispatch: when the formation policy
+                # chose to hold the batch open (fill_wait > 0), admit
+                # arrivals into the forming batch until the bucket fills
+                # or the projected-fill deadline passes.  Skipped when
+                # closing (drain wants the queue empty, not fuller
+                # batches).
+                if rows < target and fill_wait > 0 and not self._closing:
+                    deadline = time.monotonic() + fill_wait
+                    while rows < target and not self._closing:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._work.wait(timeout=remaining)
+                        late = self._take_batch_locked(target - rows,
+                                                       strict=True)
+                        batch.extend(late)
+                        rows += sum(len(p.rows) for p in late)
+                # saturation hysteresis: a near-full batch latches
+                # throughput mode; a hold that expired nearly EMPTY
+                # (quarter bucket) means load really dropped — unlatch.
+                # The asymmetric thresholds stop a single scheduler
+                # stall from unlatching mid-convoy (the fragment cascade
+                # that follows costs far more than one held batch).
+                if rows >= self.max_batch // 2:
+                    self._saturated = True
+                elif fill_wait > 0 and rows < max(1, self.max_batch // 4):
+                    self._saturated = False
+                self.metrics.set_queue_depth(
+                    sum(len(p.rows) for p in self._queue))
+            if batch:
+                self._run_batch(batch, target=target)
+
+    # -- dispatch: windowed (PR 1 semantics, byte-identical) -----------------
+
+    def _dispatch_windowed(self) -> None:
         while True:
             with self._work:
                 while not self._queue and not self._closed:
@@ -170,44 +373,70 @@ class MicroBatcher:
             if batch:
                 self._run_batch(batch)
 
-    def _run_batch(self, batch: List[_Pending]) -> None:
+    # -- execution -----------------------------------------------------------
+
+    def _run_batch(self, batch: List[_Pending],
+                   target: Optional[int] = None) -> None:
+        n_rows = sum(len(p.rows) for p in batch)
         batch_span = begin_span(
             "serve.batch", cat="serve", requests=len(batch),
-            rows=sum(len(p.rows) for p in batch))
+            rows=n_rows, mode=self.mode,
+            **({"bucket": target} if target is not None else {}))
+        t0 = time.perf_counter()
         try:
             self._run_batch_inner(batch)
         finally:
+            wall = time.perf_counter() - t0
+            if self.cost_lookup is not None and n_rows > 0:
+                # feed the dispatch occupancy back into the formation
+                # policy: the EWMA converges on measured batch walls
+                self.cost_lookup.observe(
+                    bucket_for(min(n_rows, self.max_batch), self._buckets),
+                    wall)
             end_span(batch_span)
+            # wake a close(drain=True) waiting on queue-empty
+            with self._work:
+                self._work.notify_all()
 
     def _run_batch_inner(self, batch: List[_Pending]) -> None:
-        now = time.monotonic()
-        live: List[_Pending] = []
-        n_released = 0
-        for p in batch:
-            n_released += len(p.rows)
-            if p.deadline is not None and now > p.deadline:
-                self.metrics.record_deadline_expired(len(p.rows))
-                p.future.set_result(
-                    [ShedResult(reason="deadline_expired")
-                     for _ in p.rows])
-            else:
-                live.append(p)
-        self.admission.release(n_released)
-        if not live:
-            return
-        rows: List[Dict[str, Any]] = []
+        run_pending_batch(batch, self.execute, self.admission, self.metrics)
+
+
+def run_pending_batch(batch: List[_Pending], execute, admission,
+                      metrics) -> None:
+    """Resolve one formed batch: expire past-deadline pendings, release
+    their admission reservations, execute the live rows, and scatter
+    results back onto the per-request futures.  Shared by the single-
+    tenant dispatch thread and the multi-tenant WFQ dispatcher
+    (serving/tenancy.py) so the two paths cannot diverge."""
+    now = time.monotonic()
+    live: List[_Pending] = []
+    n_released = 0
+    for p in batch:
+        n_released += len(p.rows)
+        if p.deadline is not None and now > p.deadline:
+            metrics.record_deadline_expired(len(p.rows))
+            p.future.set_result(
+                [ShedResult(reason="deadline_expired")
+                 for _ in p.rows])
+        else:
+            live.append(p)
+    admission.release(n_released)
+    if not live:
+        return
+    rows: List[Dict[str, Any]] = []
+    for p in live:
+        rows.extend(p.rows)
+    try:
+        results = execute(rows)
+    except Exception as exc:  # last-resort: executor+fallback both died
         for p in live:
-            rows.extend(p.rows)
-        try:
-            results = self.execute(rows)
-        except Exception as exc:  # last-resort: executor+fallback both died
-            for p in live:
-                if not p.future.done():
-                    p.future.set_exception(exc)
-            return
-        off = 0
-        for p in live:
-            p.future.set_result(results[off:off + len(p.rows)])
-            off += len(p.rows)
-            self.metrics.record_request_latency(
-                time.monotonic() - p.enqueued_at)
+            if not p.future.done():
+                p.future.set_exception(exc)
+        return
+    off = 0
+    for p in live:
+        p.future.set_result(results[off:off + len(p.rows)])
+        off += len(p.rows)
+        metrics.record_request_latency(
+            time.monotonic() - p.enqueued_at)

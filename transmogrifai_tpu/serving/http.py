@@ -8,14 +8,19 @@ the concurrency model IS the micro-batcher, not the HTTP layer.
 Endpoints:
   POST /score    {"rows": [{...}, ...], "timeoutMs": 50}  -> {"scores": [...]}
                  (rows shed by backpressure come back as their ShedResult
-                 JSON and flip the response to 503)
+                 JSON and flip the response to 503; multi-tenant servers
+                 additionally take {"tenant": "<name>"})
   GET  /metrics  serving metrics snapshot (queue depth, batch histogram,
                  latency quantiles, shed/fallback counts, compile counters);
                  ``?format=prometheus`` renders the same ledgers (plus the
                  global RunCounters) in Prometheus text exposition for a
-                 stock scraper (obs/prometheus.py)
-  GET  /healthz  {"status": "ok", "model": {...}}
+                 stock scraper (obs/prometheus.py) — multi-tenant servers
+                 label every serving sample ``tenant="<name>"``
+  GET  /healthz  {"status": "ok", "model": {...}} (multi-tenant: per-tenant
+                 statuses; overall degraded if ANY tenant is)
+  GET  /tenants  multi-tenant only: configured tenants + weights
   POST /swap     {"path": "/models/titanic_v2"}           -> new entry info
+                 (multi-tenant: {"tenant": ..., "path": ...})
 """
 from __future__ import annotations
 
@@ -75,33 +80,62 @@ def make_http_server(server, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(body)
 
+        def _healthz_single(self, srv):
+            entry = srv.registry.maybe_get(srv.name)
+            breaker_state = srv.breaker.state
+            status = "ok" if entry else "no_model"
+            if entry and breaker_state != srv.breaker.CLOSED:
+                status = "degraded"  # serving, but from the host path
+            return entry is not None, {
+                "status": status,
+                "model": entry.describe() if entry else None,
+                "breakerState": breaker_state,
+                "lastFallbackReason":
+                    srv.metrics.last_fallback_reason,
+            }
+
         def do_GET(self):
             url = urlsplit(self.path)
             self.path = url.path
             query = parse_qs(url.query)
+            multi = getattr(server, "is_multi_tenant", False)
             if self.path == "/healthz":
-                entry = server.registry.maybe_get(server.name)
-                breaker_state = server.breaker.state
-                status = "ok" if entry else "no_model"
-                if entry and breaker_state != server.breaker.CLOSED:
-                    status = "degraded"  # serving, but from the host path
-                self._reply(200 if entry else 503, {
-                    "status": status,
-                    "model": entry.describe() if entry else None,
-                    "breakerState": breaker_state,
-                    "lastFallbackReason":
-                        server.metrics.last_fallback_reason,
-                })
+                if multi:
+                    tenants = {}
+                    any_model, degraded = False, False
+                    for name in server.tenants():
+                        ok, doc = self._healthz_single(server.tenant(name))
+                        tenants[name] = doc
+                        any_model = any_model or ok
+                        degraded = degraded or doc["status"] != "ok"
+                    self._reply(200 if any_model else 503, {
+                        "status": ("degraded" if degraded else "ok")
+                        if any_model else "no_model",
+                        "tenants": tenants,
+                    })
+                else:
+                    ok, doc = self._healthz_single(server)
+                    self._reply(200 if ok else 503, doc)
             elif self.path == "/metrics":
                 fmt = (query.get("format") or ["json"])[0]
                 if fmt == "prometheus":
                     from ..obs.prometheus import prometheus_text
 
+                    if multi:
+                        text = prometheus_text(
+                            tenants=server.tenant_snapshots())
+                    else:
+                        text = prometheus_text(server.snapshot())
                     self._reply_text(
-                        200, prometheus_text(server.snapshot()),
+                        200, text,
                         "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self._reply(200, server.snapshot())
+            elif self.path == "/tenants" and multi:
+                self._reply(200, {
+                    "tenants": [
+                        server.snapshot()["tenants"][n]["tenantConfig"]
+                        for n in server.tenants()]})
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -109,14 +143,22 @@ def make_http_server(server, host: str = "127.0.0.1",
             doc = self._read_json()
             if doc is None or not isinstance(doc, dict):
                 return self._reply(400, {"error": "invalid JSON body"})
+            multi = getattr(server, "is_multi_tenant", False)
             if self.path == "/score":
                 rows = doc.get("rows")
                 if not isinstance(rows, list):
                     return self._reply(
                         400, {"error": "body must be {'rows': [...]}"})
                 try:
-                    results = server.score(
-                        rows, timeout_ms=doc.get("timeoutMs"))
+                    if multi:
+                        results = server.score(
+                            rows, tenant=doc.get("tenant"),
+                            timeout_ms=doc.get("timeoutMs"))
+                    else:
+                        results = server.score(
+                            rows, timeout_ms=doc.get("timeoutMs"))
+                except KeyError as exc:  # unknown/ambiguous tenant
+                    return self._reply(404, {"error": str(exc)})
                 except TypeError as exc:  # non-dict rows etc.
                     return self._reply(400, {"error": str(exc)})
                 scores, any_shed = _jsonable_scores(results)
@@ -127,7 +169,12 @@ def make_http_server(server, host: str = "127.0.0.1",
                     return self._reply(
                         400, {"error": "body must be {'path': ...}"})
                 try:
-                    entry = server.swap(path)
+                    if multi:
+                        entry = server.swap(doc.get("tenant"), path)
+                    else:
+                        entry = server.swap(path)
+                except KeyError as exc:
+                    return self._reply(404, {"error": str(exc)})
                 except Exception as exc:
                     return self._reply(500, {"error": str(exc)})
                 self._reply(200, {"swapped": entry.describe()})

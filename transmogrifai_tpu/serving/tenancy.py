@@ -1,0 +1,417 @@
+"""Multi-tenant serving — per-tenant quotas, weighted-fair dequeue, isolation.
+
+One host, one registry, MANY served models: every tenant gets its own
+registry name, admission quota, circuit breaker, metrics ledger, shape-
+bucketed executor set, and (optionally) its own DriftMonitor + GuardedSwap
+— the per-tenant machinery has existed since PR 10; this module is the
+plumbing that shares one dispatch loop across tenants WITHOUT letting one
+tenant's behavior leak into another's:
+
+* **quotas** — admission is per tenant (``max_queue_rows`` each), so a
+  flooding tenant sheds its own traffic and ONLY its own traffic;
+* **weighted-fair dequeue** — the dispatcher picks the next batch by
+  virtual-time WFQ (``vtime += rows / weight``): under saturation each
+  tenant's dispatched-row share converges to its weight, while an idle
+  tenant re-entering is clamped to the current virtual clock so it cannot
+  hoard credit and starve the others;
+* **isolation** — batches never mix tenants (they are different models);
+  a breaker opening, a shed storm, or a guarded-swap rollback on tenant A
+  touches only A's breaker/metrics/generations (test-asserted);
+* **observability** — ``snapshot()`` nests per-tenant serving snapshots,
+  and the Prometheus exposition labels every serving sample with
+  ``tenant="<name>"`` (obs/prometheus.py).
+
+Batch formation per tenant reuses the continuous-batching policy
+(greedy bucket choice from queue depth + predicted per-bucket cost, see
+serving/batcher.py); execution reuses the tenant's full degradation
+ladder (``ModelServer._execute``: breaker -> device/AOT path -> host
+fallback), so everything PR 1-12 built per server now exists per tenant.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..obs.flight import record_event
+from ..obs.trace import begin_span, end_span
+from .admission import ShedResult
+from .batcher import _Pending
+from .executor import bucket_for, bucket_sizes
+from .registry import ModelEntry, ModelRegistry
+
+__all__ = ["TenantConfig", "MultiTenantServer"]
+
+
+class TenantConfig:
+    """Static per-tenant serving configuration.
+
+    ``weight`` is the WFQ share (2.0 gets twice the dispatched rows of
+    1.0 under saturation); ``max_queue_rows`` is the tenant's admission
+    quota — both enforced per tenant, never pooled.
+    """
+
+    def __init__(self, name: str, weight: float = 1.0,
+                 max_batch: int = 64, max_queue_rows: int = 1024,
+                 default_deadline_ms: Optional[float] = None,
+                 failure_threshold: int = 3,
+                 breaker_reset_s: float = 30.0,
+                 warmup_row: Optional[Dict[str, Any]] = None):
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {weight}")
+        self.name = str(name)
+        self.weight = float(weight)
+        self.max_batch = int(max_batch)
+        self.max_queue_rows = int(max_queue_rows)
+        self.default_deadline_ms = default_deadline_ms
+        self.failure_threshold = int(failure_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self.warmup_row = warmup_row
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "weight": self.weight,
+                "maxBatch": self.max_batch,
+                "maxQueueRows": self.max_queue_rows}
+
+
+class _TenantLane:
+    """One tenant's runtime state inside the shared dispatcher."""
+
+    def __init__(self, config: TenantConfig, server):
+        self.config = config
+        self.server = server          # per-tenant ModelServer (its
+        #                               batcher is NEVER started — the
+        #                               shared dispatcher owns dequeue)
+        self.queue: List[_Pending] = []
+        self.vtime = 0.0
+        self.dispatched_rows = 0
+        self.buckets = bucket_sizes(config.max_batch)
+
+    def queued_rows(self) -> int:
+        return sum(len(p.rows) for p in self.queue)
+
+
+class MultiTenantServer:
+    """Weighted-fair multi-tenant serving over one shared registry.
+
+    Usage::
+
+        mts = MultiTenantServer(device_programs=True, aot_store=True)
+        mts.add_tenant(TenantConfig("ads", weight=3.0), path="/models/ads")
+        mts.add_tenant(TenantConfig("risk"), path="/models/risk")
+        with mts:
+            out = mts.score([{...}], tenant="ads")
+    """
+
+    is_multi_tenant = True
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 device_programs: bool = False, aot_store: Any = None,
+                 max_generations: int = 4):
+        self.registry = registry or ModelRegistry(
+            max_generations=max_generations)
+        self.device_programs = device_programs
+        self.aot_store = aot_store
+        self._lanes: Dict[str, _TenantLane] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._closing = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        #: WFQ virtual clock: the vtime of the most recently dispatched
+        #: lane; re-activating lanes are clamped up to it (no hoarding)
+        self._vclock = 0.0
+
+    # -- tenant lifecycle ----------------------------------------------------
+
+    def add_tenant(self, config, path: Optional[str] = None,
+                   model: Any = None):
+        """Register a tenant (``TenantConfig`` or just a name) and load or
+        register its model.  Returns the tenant's ``ModelServer`` (the
+        per-tenant engine: breaker, metrics, executors, drift, guard)."""
+        from . import ModelServer
+
+        if isinstance(config, str):
+            config = TenantConfig(config)
+        server = ModelServer(
+            self.registry, config.name, max_batch=config.max_batch,
+            max_queue_rows=config.max_queue_rows,
+            default_deadline_ms=config.default_deadline_ms,
+            failure_threshold=config.failure_threshold,
+            breaker_reset_s=config.breaker_reset_s,
+            warmup_row=config.warmup_row,
+            device_programs=self.device_programs,
+            aot_store=self.aot_store)
+        lane = _TenantLane(config, server)
+        with self._lock:
+            if config.name in self._lanes:
+                raise ValueError(f"tenant {config.name!r} already exists")
+            lane.vtime = self._vclock
+            self._lanes[config.name] = lane
+        if path is not None:
+            self.registry.load(config.name, path)
+        elif model is not None:
+            self.registry.register(config.name, model)
+        return server
+
+    def remove_tenant(self, name: str, drain_shed_reason: str =
+                      "tenant_removed") -> bool:
+        """Drop a tenant: queued pendings shed, model evicted.  Other
+        tenants' queues and state are untouched."""
+        with self._work:
+            lane = self._lanes.pop(name, None)
+            pendings = list(lane.queue) if lane else []
+            if lane:
+                lane.queue.clear()
+        for p in pendings:
+            lane.server.admission.release(len(p.rows))
+            p.future.set_result(
+                [ShedResult(reason=drain_shed_reason) for _ in p.rows])
+        self.registry.evict(name)
+        return lane is not None
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._lanes)
+
+    def tenant(self, name: str):
+        """The tenant's per-tenant engine (``ModelServer``) — the handle
+        for ``with_drift_monitor`` / ``with_guard`` / ``swap``."""
+        with self._lock:
+            lane = self._lanes.get(name)
+        if lane is None:
+            raise KeyError(f"no tenant {name!r} "
+                           f"(have: {self.tenants() or 'none'})")
+        return lane.server
+
+    def _lane(self, name: Optional[str]) -> _TenantLane:
+        # NOTE: the error paths must not call self.tenants() while the
+        # (non-reentrant) lock is held — collect the names in the same
+        # critical section instead
+        with self._lock:
+            have = sorted(self._lanes)
+            if name is None:
+                if len(self._lanes) == 1:
+                    return next(iter(self._lanes.values()))
+                raise KeyError(
+                    f"tenant is required with {len(self._lanes)} tenants "
+                    f"registered (have: {have})")
+            lane = self._lanes.get(name)
+        if lane is None:
+            raise KeyError(f"no tenant {name!r} (have: {have or 'none'})")
+        return lane
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "MultiTenantServer":
+        """Warm every tenant's buckets (largest-first; AOT-satisfied
+        buckets load instead of compiling), then start the shared
+        weighted-fair dispatcher."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            row = lane.config.warmup_row
+            if row is not None:
+                entry = self.registry.get(lane.config.name)
+                lane.server._executor_for(entry).warmup(row)
+        if self._thread is None or not self._thread.is_alive():
+            self._closing = False
+            self._closed = False
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="op-serving-wfq",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 10.0) -> None:
+        alive = self._thread is not None and self._thread.is_alive()
+        with self._work:
+            self._closing = True
+            if drain and alive:
+                deadline = time.monotonic() + timeout_s
+                while any(lane.queue for lane in self._lanes.values()):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._work.wait(timeout=min(remaining, 0.005))
+            self._closed = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def __enter__(self) -> "MultiTenantServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- scoring -------------------------------------------------------------
+
+    def submit(self, rows: Sequence[Dict[str, Any]],
+               tenant: Optional[str] = None,
+               timeout_ms: Optional[float] = None):
+        """Enqueue ``rows`` on ``tenant``'s lane; same future contract as
+        ``MicroBatcher.submit`` (sheds resolve, never raise)."""
+        from concurrent.futures import Future
+
+        lane = self._lane(tenant)
+        rows = list(rows)
+        fut: "Future[List[Any]]" = Future()
+        if not rows:
+            fut.set_result([])
+            return fut
+        server = lane.server
+        span = begin_span("serve.admit", cat="serve", rows=len(rows),
+                          tenant=lane.config.name)
+        if self._closing or self._closed:
+            server.metrics.record_shed(len(rows))
+            fut.set_result([ShedResult(reason="shutting_down")
+                            for _ in rows])
+            end_span(span, outcome="shed:shutting_down")
+            return fut
+        shed = server.admission.try_admit(len(rows))
+        if shed is not None:
+            server.metrics.record_shed(len(rows))
+            fut.set_result([shed for _ in rows])
+            end_span(span, outcome=f"shed:{shed.reason}")
+            record_event("serve.shed", rows=len(rows), reason=shed.reason,
+                         tenant=lane.config.name)
+            return fut
+        pending = _Pending(rows,
+                           server.admission.deadline_for(timeout_ms))
+        with self._work:
+            if self._closing or self._closed:
+                server.admission.release(len(rows))
+                server.metrics.record_shed(len(rows))
+                end_span(span, outcome="shed:shutting_down")
+                fut.set_result([ShedResult(reason="shutting_down")
+                                for _ in rows])
+                return fut
+            if not lane.queue:
+                # idle lane re-entering: clamp to the virtual clock so a
+                # long-idle tenant cannot starve the others with hoarded
+                # credit
+                lane.vtime = max(lane.vtime, self._vclock)
+            server.metrics.record_admitted(len(rows))
+            lane.queue.append(pending)
+            server.metrics.set_queue_depth(lane.queued_rows())
+            self._work.notify()
+        end_span(span, outcome="admitted")
+        return pending.future
+
+    def score(self, rows: Sequence[Dict[str, Any]],
+              tenant: Optional[str] = None,
+              timeout_ms: Optional[float] = None,
+              wait_s: Optional[float] = 60.0) -> List[Any]:
+        return self.submit(rows, tenant=tenant,
+                           timeout_ms=timeout_ms).result(timeout=wait_s)
+
+    # -- model lifecycle (per tenant) -----------------------------------------
+
+    def swap(self, tenant: Optional[str], path: str) -> ModelEntry:
+        """Hot-swap one tenant's model (tenant optional only when a single
+        tenant is registered) — other tenants' entries/generations are
+        untouched by construction (distinct registry names)."""
+        return self._lane(tenant).server.swap(path)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _pick_lane_locked(self) -> Optional[_TenantLane]:
+        """Min-vtime lane among the non-empty ones — classic WFQ."""
+        best: Optional[_TenantLane] = None
+        for lane in self._lanes.values():
+            if not lane.queue:
+                continue
+            if best is None or lane.vtime < best.vtime:
+                best = lane
+        return best
+
+    def _form_batch_locked(self, lane: _TenantLane) -> List[_Pending]:
+        """Continuous formation on one lane: greedy bucket from queue
+        depth + the lane's predicted per-bucket cost (the tenant server's
+        batcher cost lookup), FIFO no-split up to the bucket."""
+        batcher = lane.server.batcher
+        if batcher.cost_lookup is None:
+            from ..tuning.costmodel import ServingCostLookup
+
+            batcher.cost_lookup = ServingCostLookup()
+        queued = lane.queued_rows()
+        target = batcher._choose_bucket(queued)
+        batch: List[_Pending] = []
+        rows = 0
+        while lane.queue:
+            nxt = lane.queue[0]
+            if batch and rows + len(nxt.rows) > target:
+                break
+            batch.append(lane.queue.pop(0))
+            rows += len(nxt.rows)
+            if rows >= target:
+                break
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        from .batcher import run_pending_batch
+
+        while True:
+            with self._work:
+                lane = self._pick_lane_locked()
+                while lane is None and not self._closed:
+                    self._work.wait(timeout=0.1)
+                    lane = self._pick_lane_locked()
+                if lane is None and self._closed:
+                    return
+                batch = self._form_batch_locked(lane)
+                n_rows = sum(len(p.rows) for p in batch)
+                lane.vtime += n_rows / lane.config.weight
+                lane.dispatched_rows += n_rows
+                self._vclock = max(self._vclock, lane.vtime)
+                lane.server.metrics.set_queue_depth(lane.queued_rows())
+                self._work.notify_all()  # wake a draining stop()
+            if not batch:
+                continue
+            server = lane.server
+            span = begin_span("serve.batch", cat="serve",
+                              tenant=lane.config.name,
+                              requests=len(batch), rows=n_rows,
+                              mode="continuous")
+            t0 = time.perf_counter()
+            try:
+                run_pending_batch(batch, server._execute,
+                                  server.admission, server.metrics)
+            finally:
+                wall = time.perf_counter() - t0
+                lookup = server.batcher.cost_lookup
+                if lookup is not None and n_rows > 0:
+                    lookup.observe(
+                        bucket_for(min(n_rows, lane.config.max_batch),
+                                   lane.buckets), wall)
+                end_span(span)
+                with self._work:
+                    self._work.notify_all()
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Aggregate + per-tenant serving snapshots (the /metrics JSON)."""
+        with self._lock:
+            lanes = dict(self._lanes)
+            vclock = self._vclock
+        tenants: Dict[str, Any] = {}
+        totals = {"requests": 0, "rows": 0, "batches": 0, "shed": 0,
+                  "hostFallbacks": 0, "rollbacks": 0}
+        for name, lane in sorted(lanes.items()):
+            snap = lane.server.snapshot()
+            snap["tenantConfig"] = lane.config.to_json()
+            snap["wfq"] = {"vtime": round(lane.vtime, 3),
+                           "dispatchedRows": lane.dispatched_rows}
+            tenants[name] = snap
+            for k in totals:
+                totals[k] += snap.get(k) or 0
+        return {"tenants": tenants, "aggregate": totals,
+                "vclock": round(vclock, 3)}
+
+    def tenant_snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant ``ServingMetrics`` snapshots for the Prometheus
+        exposition (labels come from the key)."""
+        return {name: snap
+                for name, snap in self.snapshot()["tenants"].items()}

@@ -26,9 +26,9 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .budget import BenchBudgeter
-from .costmodel import (CostModel, StageObservation, append_observations,
-                        default_history_path, load_observations,
-                        observations_from_profiler,
+from .costmodel import (CostModel, ServingCostLookup, StageObservation,
+                        append_observations, default_history_path,
+                        load_observations, observations_from_profiler,
                         record_train_observations)
 from .halving import (HalvingConfig, Rung, halving_validate,
                       nested_subsample_order, rung_schedule)
@@ -37,7 +37,8 @@ from .planner import (MeshAdvice, PlanAdvice, advise_mesh, advise_plan,
 
 __all__ = [
     "Tuner", "HalvingConfig", "Rung", "halving_validate", "rung_schedule",
-    "nested_subsample_order", "CostModel", "StageObservation",
+    "nested_subsample_order", "CostModel", "ServingCostLookup",
+    "StageObservation",
     "load_observations", "append_observations",
     "observations_from_profiler", "record_train_observations",
     "default_history_path", "BenchBudgeter", "PlanAdvice", "advise_plan",

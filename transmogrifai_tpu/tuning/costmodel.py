@@ -28,7 +28,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = [
-    "StageObservation", "CostModel", "load_observations",
+    "StageObservation", "CostModel", "ServingCostLookup",
+    "load_observations",
     "append_observations", "observations_from_profiler",
     "record_train_observations", "default_history_path",
     "HISTORY_OBSERVATION_CAP",
@@ -329,6 +330,95 @@ def observations_from_profiler(profiler,
             mesh_shape=getattr(sp, "mesh_shape", "") or "",
             hlo=dict(getattr(sp, "hlo", {}) or {})))
     return out
+
+
+class ServingCostLookup:
+    """Per-bucket serving batch-cost estimates for continuous batch
+    formation (serving/batcher.py).
+
+    Three tiers, sharpest first: an ONLINE per-bucket EWMA of measured
+    batch walls (the batcher feeds every executed batch back in), the
+    fitted :class:`CostModel` under the ``Serving:batch`` stage kind, and
+    the analytic per-row law — so the batcher's greedy bucket choice and
+    late-admission window always have a number, and the number converges
+    on the replica's actual measured behavior within a few dozen batches.
+    Thread-safe: read by the dispatch thread, written after every batch.
+    """
+
+    STAGE_KIND = "Serving:batch"
+
+    def __init__(self, cost_model: Optional["CostModel"] = None,
+                 cols: int = 0, alpha: float = 0.3):
+        self.cost_model = cost_model
+        self.cols = int(cols)
+        self.alpha = float(alpha)
+        self._ewma: Dict[int, float] = {}
+        self._counts: Dict[int, int] = {}
+        import threading
+
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_history(cls, cols: int = 0,
+                     path: Optional[str] = None) -> "ServingCostLookup":
+        return cls(cost_model=CostModel.from_history(path), cols=cols)
+
+    def observe(self, bucket: int, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        with self._lock:
+            prev = self._ewma.get(bucket)
+            self._ewma[bucket] = seconds if prev is None else (
+                self.alpha * seconds + (1.0 - self.alpha) * prev)
+            self._counts[bucket] = self._counts.get(bucket, 0) + 1
+
+    @staticmethod
+    def _analytic(bucket: int) -> float:
+        # dispatch floor + per-row host/transform cost
+        return PREDICTION_FLOOR_S + bucket * 2e-5
+
+    def predict_s(self, bucket: int) -> float:
+        """Predicted wall seconds for one executed batch at ``bucket``.
+
+        An unmeasured bucket must not look spuriously cheap next to a
+        measured one (the raw analytic law is optimistic): once ANY
+        bucket has an EWMA, unmeasured buckets extrapolate from the
+        nearest measured bucket (log-space nearest), scaled by the
+        analytic shape — measured LEVEL, analytic SLOPE."""
+        with self._lock:
+            measured = self._ewma.get(bucket)
+            ewma = dict(self._ewma) if measured is None else None
+        if measured is not None:
+            return max(measured, PREDICTION_FLOOR_S)
+        if ewma:
+            near = min(ewma, key=lambda b: abs(
+                math.log(max(b, 1) / max(bucket, 1))))
+            scaled = ewma[near] * (self._analytic(bucket)
+                                   / self._analytic(near))
+            return max(scaled, PREDICTION_FLOOR_S)
+        if self.cost_model is not None:
+            return self.cost_model.predict(self.STAGE_KIND, rows=bucket,
+                                           cols=max(self.cols, 1))
+        return self._analytic(bucket)
+
+    def source(self, bucket: int) -> str:
+        with self._lock:
+            if bucket in self._ewma:
+                return "measured"
+        if self.cost_model is not None and self.cost_model.source(
+                self.STAGE_KIND) == "fitted":
+            return "fitted"
+        return "analytic"
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "ewmaMs": {str(b): round(v * 1000.0, 4)
+                           for b, v in sorted(self._ewma.items())},
+                "observedBatches": dict(
+                    sorted((str(k), v)
+                           for k, v in self._counts.items())),
+            }
 
 
 def record_train_observations(profiler,
