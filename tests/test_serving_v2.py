@@ -160,6 +160,38 @@ class TestScoringProgramSet:
         assert ps.predict(np.zeros((3, 3), np.float32)) is None   # no bucket
         assert ps.predict(np.zeros((4, 7), np.float32)) is None   # wrong D
 
+    def test_naive_bayes_parity_classes_neq_features(self, store):
+        # K=3 classes over D=5 features: NB's params[0] is the (K,) log
+        # prior, so inferring D from params[0] used to lower (bucket, 3)
+        # programs whose matmul against the (3, 5) likelihoods blew up at
+        # compile time — D must come from the spec's explicit n_features
+        from transmogrifai_tpu.models.classification import NaiveBayesModel
+
+        rng = np.random.default_rng(2)
+        probs = rng.dirichlet(np.ones(5), size=3)
+
+        def _nb():
+            return NaiveBayesModel(
+                log_prior=np.log([0.2, 0.3, 0.5]).tolist(),
+                log_lik=np.log(probs).tolist())
+
+        X = np.abs(rng.normal(size=(4, 5))).astype(np.float32)
+        m = _nb()
+        ps1 = program_set_for(m, store=store, cache_key_prefix="nb1")
+        assert ps1.n_features == 5
+        assert ps1.ensure_bucket(4) == "jit"
+        dev = ps1.predict(X)
+        host = m.predict_batch(X)
+        assert (dev.prediction == host.prediction).all()
+        np.testing.assert_allclose(dev.probability, host.probability,
+                                   rtol=3e-6, atol=1e-7)
+        # a fresh replica loads the same executable: byte-identical
+        ps2 = program_set_for(_nb(), store=store, cache_key_prefix="nb2")
+        assert ps2.ensure_bucket(4) == "aot"
+        out2 = ps2.predict(X)
+        assert (dev.prediction == out2.prediction).all()
+        assert (dev.probability == out2.probability).all()
+
     def test_tree_family_has_no_spec(self):
         from transmogrifai_tpu.serving.aot import program_set_for as psf
         from transmogrifai_tpu.models.regression import (
@@ -211,6 +243,27 @@ class TestWarmupOrder:
         assert sorted(timings) == [1, 2, 4]
         assert ex.programs.modes == {1: "aot", 2: "aot", 4: "aot"}
         assert ex.warm_buckets == [1, 2, 4]
+
+    def test_failed_jit_warm_run_leaves_bucket_cold(self):
+        # warm is only recorded AFTER a successful first execution — a
+        # transient warmup failure must not mark the bucket warm (which
+        # would also skew the compile/hit accounting)
+        boom = {"on": True}
+
+        def score_fn(batch_rows):
+            if boom["on"]:
+                raise RuntimeError("transient warm-run failure")
+            return [{"s": 0.0} for _ in batch_rows]
+
+        ex = BucketedExecutor(score_fn, max_batch=2, model=_model(),
+                              device_programs=True,
+                              cache_key_prefix="coldfail")
+        with pytest.raises(RuntimeError):
+            ex.warmup({"x": 1.0})
+        assert ex.warm_buckets == []
+        boom["on"] = False
+        ex.warmup({"x": 1.0})
+        assert ex.warm_buckets == [1, 2]
 
 
 # ---------------------------------------------------------------------------
@@ -542,12 +595,14 @@ class TestMultiTenant:
 
     def test_remove_tenant_sheds_and_evicts(self, rows):
         mts = MultiTenantServer()
-        mts.add_tenant(TenantConfig("x", max_batch=4), path=MODEL_V1)
+        srv_x = mts.add_tenant(TenantConfig("x", max_batch=4), path=MODEL_V1)
         mts.add_tenant(TenantConfig("y", max_batch=4), path=MODEL_V1)
         fut = mts.submit(rows[:2], tenant="x")   # not started: stays queued
         assert mts.remove_tenant("x")
         res = fut.result(timeout=1)
         assert isinstance(res[0], ShedResult)
+        # the removal sheds are visible in metrics, like every shed path
+        assert srv_x.metrics.snapshot()["shed"] == 2
         assert mts.tenants() == ["y"]
         assert mts.registry.maybe_get("x") is None
         mts.stop(drain=False)

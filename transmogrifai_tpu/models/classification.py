@@ -330,11 +330,13 @@ class LogisticRegressionModel(PredictorModel):
             return AOTScoringSpec(
                 name="logreg.binary", fn=_aot_logreg_binary,
                 params=(coef, np.float32(self.intercept)),
-                outputs=("prediction", "rawPrediction", "probability"))
+                outputs=("prediction", "rawPrediction", "probability"),
+                n_features=int(coef.shape[-1]))
         return AOTScoringSpec(
             name="logreg.softmax", fn=_aot_softmax,
             params=(coef, np.asarray(self.intercept, np.float32)),
-            outputs=("prediction", "rawPrediction", "probability"))
+            outputs=("prediction", "rawPrediction", "probability"),
+            n_features=int(coef.shape[-1]))
 
 
 class OpLinearSVC(PredictorEstimator):
@@ -389,11 +391,12 @@ class LinearSVCModel(PredictorModel):
 
     def aot_scoring_spec(self):
         from .prediction import AOTScoringSpec
+        coef = np.asarray(self.coef, np.float32)
         return AOTScoringSpec(
             name="linsvc", fn=_aot_svc,
-            params=(np.asarray(self.coef, np.float32),
-                    np.float32(self.intercept)),
-            outputs=("prediction", "rawPrediction"))
+            params=(coef, np.float32(self.intercept)),
+            outputs=("prediction", "rawPrediction"),
+            n_features=int(coef.shape[-1]))
 
 
 class OpNaiveBayes(PredictorEstimator):
@@ -502,8 +505,9 @@ class NaiveBayesModel(PredictorModel):
 
     def aot_scoring_spec(self):
         from .prediction import AOTScoringSpec
+        log_lik = np.asarray(self.log_lik, np.float32)
         return AOTScoringSpec(
             name="naivebayes", fn=_aot_naive_bayes,
-            params=(np.asarray(self.log_prior, np.float32),
-                    np.asarray(self.log_lik, np.float32)),
-            outputs=("prediction", "rawPrediction", "probability"))
+            params=(np.asarray(self.log_prior, np.float32), log_lik),
+            outputs=("prediction", "rawPrediction", "probability"),
+            n_features=int(log_lik.shape[-1]))

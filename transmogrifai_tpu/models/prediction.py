@@ -42,6 +42,10 @@ class AOTScoringSpec:
     fn: Any                   # callable (X, *params) -> tuple of arrays
     params: tuple             # numpy arrays / np scalars, fixed order
     outputs: tuple            # names for fn's returned tuple, in order
+    #: width D of the (N, D) input matrix.  Explicit because it is NOT
+    #: inferrable from the params in general (NaiveBayes' params[0] is the
+    #: (K,) class prior, not the (K, D) likelihood matrix).
+    n_features: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -119,11 +119,12 @@ class LinearRegressionModel(PredictorModel):
 
     def aot_scoring_spec(self):
         from .prediction import AOTScoringSpec
+        coef = np.asarray(self.coef, np.float32)
         return AOTScoringSpec(
             name="linreg", fn=_aot_linear,
-            params=(np.asarray(self.coef, np.float32),
-                    np.float32(self.intercept)),
-            outputs=("prediction",))
+            params=(coef, np.float32(self.intercept)),
+            outputs=("prediction",),
+            n_features=int(coef.shape[-1]))
 
 
 class OpGeneralizedLinearRegression(PredictorEstimator):

@@ -140,7 +140,11 @@ class ScoringProgramSet:
         from ..utils.profiling import backend_name
 
         self.backend = backend_name()
-        self.n_features = int(np.asarray(spec.params[0]).shape[-1])
+        # the spec carries D explicitly; infer from params[0] only for
+        # legacy specs where params[0] happens to be (…, D)-shaped
+        self.n_features = (int(spec.n_features)
+                           if getattr(spec, "n_features", None) is not None
+                           else int(np.asarray(spec.params[0]).shape[-1]))
         self._programs: Dict[int, Any] = {}
         self._modes: Dict[int, str] = {}  # bucket -> "aot" | "jit"
         self._lock = threading.Lock()

@@ -111,13 +111,14 @@ class BucketedExecutor:
         for b in order:
             t0 = time.perf_counter()
             if self.programs is not None:
-                loaded = self.programs.ensure_bucket(b) == "aot"
-                self._warm[b] = True
-                if loaded:
+                if self.programs.ensure_bucket(b) == "aot":
                     # AOT-satisfied: no warm-run needed, the executable is
-                    # already the steady-state artifact
+                    # already the steady-state artifact — warm immediately
+                    self._warm[b] = True
                     timings[b] = time.perf_counter() - t0
                     continue
+            # JIT case: _run_bucket marks the bucket warm only AFTER the
+            # warm-run succeeds — a failed first execution stays cold
             self._run_bucket([dict(sample_row)] * b, b)
             timings[b] = time.perf_counter() - t0
         return timings

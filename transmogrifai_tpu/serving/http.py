@@ -132,10 +132,10 @@ def make_http_server(server, host: str = "127.0.0.1",
                 else:
                     self._reply(200, server.snapshot())
             elif self.path == "/tenants" and multi:
+                snap = server.snapshot()
                 self._reply(200, {
-                    "tenants": [
-                        server.snapshot()["tenants"][n]["tenantConfig"]
-                        for n in server.tenants()]})
+                    "tenants": [t["tenantConfig"]
+                                for _, t in sorted(snap["tenants"].items())]})
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -165,6 +165,7 @@ class MultiTenantServer:
                 lane.queue.clear()
         for p in pendings:
             lane.server.admission.release(len(p.rows))
+            lane.server.metrics.record_shed(len(p.rows))
             p.future.set_result(
                 [ShedResult(reason=drain_shed_reason) for _ in p.rows])
         self.registry.evict(name)
