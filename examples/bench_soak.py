@@ -1,0 +1,596 @@
+#!/usr/bin/env python
+"""The "day in production" soak — every subsystem, fault-injected, twice.
+
+One seed drives hours of simulated production in minutes of wall:
+
+1. **ingest** — the train window lands as a CSV with corrupt rows; the
+   reader carries retry + quarantine, and an injected ``reader.chunk``
+   io_error (recovered by backoff) hits the RawFeatureFilter's streaming
+   distribution pass.
+2. **train** — a chunked WORKFLOW-CV train with RawFeatureFilter
+   (fold-tagged mergeable states, drop decisions from the monoid
+   profile), the fold sweep on a ``parallel=`` device mesh with an
+   injected mid-sweep ``device.loss`` (elastic shrink + retry — the
+   ``meshShrinks`` counter must move), checkpointed at both
+   granularities.
+3. **train kill/resume** — a child process running the same train is
+   SIGKILLed at the CV sweep's cursor save, then resumed by a second
+   child on HALF the devices: same winner, nonzero mesh-change counters.
+4. **serve** — the model serves a closed-loop window through the real
+   ModelServer (admission, continuous batching, bucketed executor).
+5. **drift** — a clean window keeps the DriftMonitor quiet; the drifted
+   window fires it.
+6. **refresh** — warm-start refresh on the drifted window (the same
+   RFF drop decisions reused, the CV re-selection on the window), plus a
+   self-contained child pair proving a SIGKILLed CHECKPOINTED refresh
+   resumes and reproduces its scores.
+7. **swap** — a poisoned candidate is rejected with the registry
+   untouched; the real refresh passes the gate and BAKES IN cleanly;
+   a second accepted swap is forced into rollback by an injected
+   ``swap.bake`` fault (the ``rollbacks`` counter must move).
+8. **score** — the finally-served generation scores the eval window.
+
+Determinism is the headline: the harness runs the WHOLE scenario twice
+at the same seed in fresh subprocesses and requires the deterministic
+records — final score vector, fault/recovery counters, winner, drops,
+per-fold metrics — to be byte-identical.
+
+Run by ``scripts/tier1.sh`` as SOAK_SMOKE (``--smoke``: reduced shapes,
+full fault schedule, nothing written).  Full mode writes
+``benchmarks/soak_latest.json``.
+
+Usage:
+  python examples/bench_soak.py [--scale 5]
+  python examples/bench_soak.py --smoke
+"""
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+BASE_ROWS = 600
+CHUNK_ROWS = 48
+#: run children execute under this many forced host devices so the
+#: elastic mesh legs are real; the kill/resume pair crosses 4 -> 2
+DEVICES = 4
+
+
+# ---------------------------------------------------------------------------
+# data + pipeline (shared by the run child and the kill/resume children)
+# ---------------------------------------------------------------------------
+
+def make_frame(rows, seed, drift=False):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(3.0 if drift else 0.0, 1.0, rows)
+    x2 = rng.normal(0.0, 1.0, rows)
+    cat = rng.choice(["a", "b", "c"], rows,
+                     p=[0.2, 0.3, 0.5] if drift else [0.5, 0.3, 0.2])
+    logits = 1.2 * x1 - 0.8 * x2 + (cat == "a") * 0.9 - (1.8 if drift else 0)
+    y = (rng.random(rows) < 1 / (1 + np.exp(-logits))).astype(float)
+    import pandas as pd
+
+    return pd.DataFrame({
+        "label": y,
+        "x1": x1,
+        "x2": x2,
+        "cat": cat,
+        # 99.9% null -> RFF low-fill drop
+        "junk": np.where(rng.random(rows) < 0.999, np.nan, 1.0),
+        # nullness tracks the label -> RFF leakage drop
+        "leaky": np.where(y > 0, np.nan, rng.normal(size=rows)),
+    })
+
+
+def write_train_csv(df, path):
+    """The train window with TWO corrupt rows (extra fields pandas cannot
+    place) — the quarantine sidecar must count each exactly once across
+    the RFF profile pass + both fit passes."""
+    lines = df.to_csv(index=False).splitlines()
+    lines.insert(5, lines[5] + ",EXTRA,EXTRA")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def build_workflow(parallel=None):
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, grid)
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = transmogrify([
+        FeatureBuilder.Real("x1").as_predictor(),
+        FeatureBuilder.Real("x2").as_predictor(),
+        FeatureBuilder.PickList("cat").as_predictor(),
+        FeatureBuilder.Real("junk").as_predictor(),
+        FeatureBuilder.Real("leaky").as_predictor(),
+    ])
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        label, feats).get_output()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, parallel=parallel,
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))])
+    prediction = selector.set_input(label, checked).get_output()
+    wf = (OpWorkflow().set_result_features(prediction)
+          .with_raw_feature_filter(min_fill_rate=0.05, max_correlation=0.9)
+          .with_workflow_cv())
+    return wf, selector
+
+
+def reader_for_csv(path, sidecar):
+    from transmogrifai_tpu.readers import CSVReader
+    from transmogrifai_tpu.readers.resilience import RetryPolicy
+
+    return CSVReader(path).with_resilience(
+        retry=RetryPolicy(max_attempts=4, base_delay_s=0.01, seed=1),
+        bad_records="quarantine", quarantine_path=sidecar)
+
+
+def probs_of(model, df):
+    from transmogrifai_tpu.types import feature_types as ft
+
+    scored = model.score(data=df)
+    name = next(n for n in scored.names()
+                if issubclass(scored[n].ftype, ft.Prediction))
+    return [float(d["probability_1"]) for d in scored[name].to_list()]
+
+
+def poison(model):
+    """Negated-coefficients LR: a structurally valid regressed candidate
+    the swap gate must reject."""
+    from transmogrifai_tpu.models.classification import (
+        LogisticRegressionModel)
+    from transmogrifai_tpu.selector.model_selector import SelectedModel
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    stages = []
+    for s in model.stages:
+        if isinstance(s, SelectedModel) and isinstance(
+                s.inner, LogisticRegressionModel):
+            bad_inner = LogisticRegressionModel(
+                coef=(-np.asarray(s.inner.coef)).tolist(),
+                intercept=(-np.asarray(s.inner.intercept)).tolist()
+                if np.ndim(s.inner.intercept) else -float(s.inner.intercept))
+            bad = SelectedModel(inner=bad_inner, best_name=s.best_name,
+                                best_params=s.best_params, uid=s.uid)
+            bad.operation_name = s.operation_name
+            bad.input_features = list(s.input_features)
+            bad._output_feature = s._output_feature
+            bad.metadata = s.metadata
+            stages.append(bad)
+        else:
+            stages.append(s)
+    return OpWorkflowModel(result_features=model.result_features,
+                          stages=stages)
+
+
+# ---------------------------------------------------------------------------
+# kill/resume children
+# ---------------------------------------------------------------------------
+
+_TRAIN_CHILD = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {root!r})
+    sys.path.insert(0, {exdir!r})
+    from bench_soak import build_workflow, reader_for_csv
+    wf, sel = build_workflow(parallel={devices})
+    reader = reader_for_csv({csv!r}, {sidecar!r})
+    model = (wf.set_reader(reader)
+             .train(chunk_rows={chunk}, checkpoint_dir={ckdir!r},
+                    checkpoint_every_chunks=2))
+    summ = sel.metadata["model_selector_summary"]
+    print(json.dumps({{
+        "winner": summ["bestModelParams"],
+        "cv_metrics": [round(r["metricValue"], 9)
+                       for r in sel.metadata["workflow_cv_results"]],
+        "elastic": sel.metadata.get("workflow_cv_elastic"),
+        "resumed": bool(model.ingest_profile.resumed),
+    }}))
+""")
+
+_REFRESH_CHILD = textwrap.dedent("""
+    import json, sys
+    sys.path.insert(0, {root!r})
+    sys.path.insert(0, {exdir!r})
+    import pandas as pd
+    from bench_soak import build_workflow, make_frame, probs_of
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import OpNaiveBayes
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = transmogrify([FeatureBuilder.Real("x1").as_predictor(),
+                          FeatureBuilder.Real("x2").as_predictor(),
+                          FeatureBuilder.PickList("cat").as_predictor()])
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        label, feats).get_output()
+    pred = OpNaiveBayes().set_input(label, checked).get_output()
+    wf = OpWorkflow().set_result_features(pred)
+    base = make_frame({rows}, seed={seed})[["label", "x1", "x2", "cat"]]
+    drift = make_frame({rows} // 2, seed={seed} + 1,
+                       drift=True)[["label", "x1", "x2", "cat"]]
+    model = wf.set_input_data(base).train(chunk_rows={chunk})
+    refreshed = wf.refresh(model, data=drift, chunk_rows={chunk},
+                           checkpoint_dir={ckdir!r},
+                           checkpoint_every_chunks=2)
+    print(json.dumps({{
+        "resumed": bool(refreshed.ingest_profile.resumed),
+        "report": refreshed.refresh_report,
+        "probs_head": [round(p, 9)
+                       for p in probs_of(refreshed, drift.head(24))],
+    }}))
+""")
+
+
+def _spawn(script, n_devices, faults=None, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in shlex.split(env.get("XLA_FLAGS", ""))
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    if faults is not None:
+        env["TMOG_FAULTS"] = json.dumps(faults)
+    else:
+        env.pop("TMOG_FAULTS", None)
+    env.setdefault("TMOG_COST_HISTORY", "")
+    return subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+def _parse(proc):
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"child rc={proc.returncode}: {proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+# ---------------------------------------------------------------------------
+# one soak run
+# ---------------------------------------------------------------------------
+
+def run_soak(seed: int, rows: int = BASE_ROWS, chunk_rows: int = CHUNK_ROWS,
+             parallel=DEVICES, kill_legs: bool = True, log=None):
+    """Execute the whole scenario once; returns ``(record, walls)`` where
+    ``record`` is the DETERMINISTIC sub-record (byte-compared across
+    runs) and ``walls`` the timing side-channel."""
+    from transmogrifai_tpu.serving import (DriftConfig, DriftMonitor,
+                                           GuardedSwap, ModelRegistry,
+                                           ModelServer, SwapGateConfig)
+    from transmogrifai_tpu.serving.admission import ShedResult
+    from transmogrifai_tpu.utils import faults
+    from transmogrifai_tpu.utils.faults import FaultSpec
+
+    log = log or (lambda m: print(f"[soak] {m}", file=sys.stderr,
+                                  flush=True))
+    record = {"seed": seed, "rows": rows, "chunk_rows": chunk_rows,
+              "phases": [], "faults_fired": {}}
+    walls = {}
+    fired = record["faults_fired"]
+
+    def note_fired(plan):
+        for e in plan.log:
+            key = f"{e['point']}:{e['action']}"
+            fired[key] = fired.get(key, 0) + 1
+
+    def phase(i, name):
+        faults.fire("soak.phase", index=i, tag=name)
+        record["phases"].append(name)
+        log(f"phase {i}: {name}")
+
+    exdir = os.path.join(_ROOT, "examples")
+    with tempfile.TemporaryDirectory() as tmp:
+        # -- 0. ingest -----------------------------------------------------
+        phase(0, "ingest")
+        base = make_frame(rows, seed=seed)
+        drift_df = make_frame(rows // 2, seed=seed + 1, drift=True)
+        clean_df = make_frame(rows // 2, seed=seed + 2)
+        eval_df = make_frame(max(rows // 3, 150), seed=seed + 3, drift=True)
+        train_csv = os.path.join(tmp, "train.csv")
+        write_train_csv(base, train_csv)
+
+        # -- 1. train (chunked workflow-CV + RFF + mesh + faults) ----------
+        phase(1, "train")
+        t0 = time.perf_counter()
+        wf, sel = build_workflow(parallel=parallel)
+        reader = reader_for_csv(train_csv, os.path.join(tmp, "bad.jsonl"))
+        ck_train = os.path.join(tmp, "ck_train")
+        with faults.inject(
+                FaultSpec(point="reader.chunk", action="io_error",
+                          at=2, times=1),
+                FaultSpec(point="device.loss", action="device_loss",
+                          at=1, times=1),
+                seed=seed) as plan:
+            model = (wf.set_reader(reader)
+                     .train(chunk_rows=chunk_rows,
+                            checkpoint_dir=ck_train,
+                            checkpoint_every_chunks=2))
+        note_fired(plan)
+        walls["train_s"] = round(time.perf_counter() - t0, 3)
+        ip = model.ingest_profile
+        rff = ip.rff or {}
+        retries = ip.total_retries + int(rff.get("retries", 0))
+        summ = sel.metadata["model_selector_summary"]
+        elastic = sel.metadata.get("workflow_cv_elastic") or {}
+        record["train"] = {
+            "dropped_features": sorted(
+                model.raw_feature_filter_results.dropped_features),
+            "winner": summ["bestModelParams"],
+            "cv_metrics": [round(r["metricValue"], 9)
+                           for r in sel.metadata["workflow_cv_results"]],
+            "quarantined_records": ip.quarantined_records,
+            "retries": retries,
+            "mesh_shrinks": int(elastic.get("meshShrinks", 0)),
+            "elastic": elastic,
+        }
+        log(f"train: dropped={record['train']['dropped_features']} "
+            f"winner={summ['bestModelParams']} retries={retries} "
+            f"quarantined={ip.quarantined_records} elastic={elastic}")
+
+        # -- 2. CV-sweep SIGKILL -> cross-mesh resume ----------------------
+        if kill_legs:
+            phase(2, "train-kill-resume")
+            t0 = time.perf_counter()
+            ck_kill = os.path.join(tmp, "ck_kill")
+            side2 = os.path.join(tmp, "bad_kill.jsonl")
+            script = _TRAIN_CHILD.format(
+                root=_ROOT, exdir=exdir, devices=parallel, csv=train_csv,
+                sidecar=side2, chunk=chunk_rows, ckdir=ck_kill)
+            proc = _spawn(script, parallel, faults={"faults": [
+                {"point": "sweep.checkpoint", "action": "kill", "at": 0}]})
+            if proc.returncode != -9:
+                raise RuntimeError(
+                    f"kill child expected rc=-9, got {proc.returncode}: "
+                    f"{proc.stderr[-1500:]}")
+            resumed = _parse(_spawn(script, max(parallel // 2, 1)))
+            el = resumed["elastic"] or {}
+            mesh_moves = (int(el.get("meshShrinks", 0))
+                          + int(el.get("meshRepacks", 0)))
+            if resumed["winner"] != summ["bestModelParams"]:
+                raise RuntimeError(
+                    f"cross-mesh resume winner {resumed['winner']} != "
+                    f"{summ['bestModelParams']}")
+            if parallel and parallel > 1 and mesh_moves < 1:
+                raise RuntimeError(
+                    f"cross-mesh resume moved no mesh counters: {el}")
+            record["train_kill_resume"] = {
+                "winner": resumed["winner"],
+                "resumed": bool(resumed["resumed"]),
+                "mesh_moved": bool(mesh_moves),
+            }
+            walls["train_kill_resume_s"] = round(time.perf_counter() - t0, 3)
+            log(f"CV sweep SIGKILL -> resume on {max(parallel // 2, 1)} "
+                f"devices OK (mesh moves={mesh_moves})")
+
+        # -- 3. serve under closed-loop load -------------------------------
+        phase(3, "serve")
+        t0 = time.perf_counter()
+        registry = ModelRegistry()
+        registry.register("m", model)
+        served = 0
+        rows_iter = eval_df.to_dict("records")
+        with ModelServer(registry, "m", max_latency_ms=2.0,
+                         max_queue_rows=4096) as server:
+            for i in range(0, len(rows_iter), 16):
+                out = server.score(rows_iter[i:i + 16])
+                if any(isinstance(o, ShedResult) for o in out):
+                    raise RuntimeError("serve leg shed under closed loop")
+                served += len(out)
+        walls["serve_s"] = round(time.perf_counter() - t0, 3)
+        record["served_rows"] = served
+        log(f"served {served} rows closed-loop")
+
+        # -- 4. drift ------------------------------------------------------
+        phase(4, "drift")
+        monitor = DriftMonitor.from_model(model, config=DriftConfig(
+            min_rows=100, check_every=100))
+        monitor.observe_rows(clean_df.to_dict("records"))
+        quiet = not monitor.refresh_triggered
+        monitor.observe_rows(drift_df.to_dict("records"))
+        fired_drift = monitor.refresh_triggered
+        if not (quiet and fired_drift):
+            raise RuntimeError(
+                f"drift leg failed (quiet={quiet}, fired={fired_drift})")
+        record["drift"] = {
+            "quiet_on_clean": quiet, "fired_on_drifted": fired_drift,
+            "drifted_features": sorted(
+                (monitor.last_evaluation or {}).get("driftedFeatures", [])),
+        }
+        log(f"drift fired on {record['drift']['drifted_features']}")
+
+        # -- 5. warm-start refresh (+ SIGKILLed refresh child) -------------
+        phase(5, "refresh")
+        t0 = time.perf_counter()
+        refreshed = wf.refresh(model, data=drift_df, chunk_rows=chunk_rows)
+        walls["refresh_s"] = round(time.perf_counter() - t0, 3)
+        record["refresh"] = {"report": refreshed.refresh_report}
+        log(f"refresh report: {refreshed.refresh_report}")
+        if kill_legs:
+            t0 = time.perf_counter()
+            ck_ref = os.path.join(tmp, "ck_refresh")
+            script = _REFRESH_CHILD.format(
+                root=_ROOT, exdir=exdir, rows=rows, seed=seed,
+                chunk=chunk_rows, ckdir=ck_ref)
+            proc = _spawn(script, 1, faults={"faults": [
+                {"point": "checkpoint.barrier", "action": "kill", "at": 1}]})
+            if proc.returncode != -9:
+                raise RuntimeError(
+                    f"refresh kill child expected rc=-9, got "
+                    f"{proc.returncode}: {proc.stderr[-1500:]}")
+            child = _parse(_spawn(script, 1))
+            if not child["resumed"]:
+                raise RuntimeError("refresh rerun did not resume")
+            record["refresh_kill_resume"] = child
+            walls["refresh_kill_resume_s"] = round(
+                time.perf_counter() - t0, 3)
+            log("refresh SIGKILL -> resume OK")
+
+        # -- 6. guarded swap matrix ----------------------------------------
+        phase(6, "swap")
+        gate = SwapGateConfig(min_replay_rows=16, label_name="label",
+                              pred_distance_max=0.45, pred_psi_max=8.0,
+                              metric_tol=0.1, p99_factor=50.0,
+                              bake_rows=64, probe_every=32)
+        guard = GuardedSwap(registry, "m", gate=gate)
+        replay = (base.head(32).to_dict("records")
+                  + drift_df.head(32).to_dict("records"))
+        guard.record_traffic(replay)
+
+        rejected = guard.propose(poison(refreshed))
+        if rejected.accepted or registry.get("m").version != 1:
+            raise RuntimeError("poisoned candidate was not rejected")
+        accepted = guard.propose(refreshed)
+        if not accepted.accepted or registry.get("m").version != 2:
+            raise RuntimeError(
+                f"refresh candidate failed the gate: {accepted.reasons}")
+        # clean bake: traffic-driven probes must pass and close the window
+        for i in range(0, 128, 16):
+            guard.record_traffic(drift_df.head(16).to_dict("records"))
+        if guard._bake is not None:
+            guard.bake_probe()
+        baked_in = registry.get("m").version == 2
+        if not baked_in:
+            raise RuntimeError("clean candidate did not bake in")
+        # second accepted swap, then a forced bake fault -> rollback
+        accepted2 = guard.propose(refreshed)
+        if not accepted2.accepted or registry.get("m").version != 3:
+            raise RuntimeError("second candidate did not swap")
+        # bare spec: the probe ordinal is cumulative across the earlier
+        # clean bake, so "the next probe, whichever ordinal" is the aim
+        with faults.inject(FaultSpec(point="swap.bake", action="raise",
+                                     times=1), seed=seed) as plan:
+            rollback_reason = guard.bake_probe()
+        note_fired(plan)
+        snap = guard.metrics.snapshot()
+        if (rollback_reason is None or registry.get("m").version != 2
+                or snap["rollbacks"] < 1):
+            raise RuntimeError(
+                f"forced bake rollback failed ({rollback_reason}, "
+                f"v{registry.get('m').version})")
+        record["swap"] = {
+            "rejected_reasons": rejected.reasons,
+            "accepted": True, "baked_in": baked_in,
+            "rollback_reason": rollback_reason,
+            "swaps_accepted": snap["swapsAccepted"],
+            "swaps_rejected": snap["swapsRejected"],
+            "rollbacks": snap["rollbacks"],
+        }
+        log(f"swap: rejected poison, baked clean, forced rollback "
+            f"({rollback_reason})")
+
+        # -- 7. final scores (the generation actually serving) -------------
+        phase(7, "score")
+        final_model = registry.get("m").model
+        record["final_scores"] = [round(p, 12)
+                                  for p in probs_of(final_model, eval_df)]
+    return record, walls
+
+
+# ---------------------------------------------------------------------------
+# harness: two runs, byte-compared
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--scale", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--run-one", action="store_true")
+    args = ap.parse_args()
+
+    if args.run_one:
+        record, walls = run_soak(args.seed, rows=BASE_ROWS * max(
+            1, 1 if args.smoke else args.scale))
+        print(json.dumps({"record": record, "walls": walls}), flush=True)
+        return
+
+    log = lambda m: print(f"[bench_soak] {m}", file=sys.stderr, flush=True)
+    scale = 1 if args.smoke else args.scale
+    argv = ["bench_soak", "--run-one", "--seed", str(args.seed)]
+    argv += ["--smoke"] if args.smoke else ["--scale", str(scale)]
+    runner = (f"import sys; sys.path.insert(0, {_ROOT!r}); "
+              f"sys.path.insert(0, {os.path.join(_ROOT, 'examples')!r}); "
+              f"import bench_soak; "
+              f"sys.argv = {argv!r}; bench_soak.main()")
+    t0 = time.perf_counter()
+    runs = []
+    for i in (1, 2):
+        log(f"soak run {i}/2 (seed {args.seed}, {DEVICES} forced devices)")
+        proc = _spawn(runner, DEVICES, timeout=1200)
+        sys.stderr.write(proc.stderr[-4000:])
+        runs.append(_parse(proc))
+    wall = time.perf_counter() - t0
+
+    a, b = runs[0]["record"], runs[1]["record"]
+    ja, jb = (json.dumps(x, sort_keys=True) for x in (a, b))
+    if ja != jb:
+        for k in sorted(set(a) | set(b)):
+            if json.dumps(a.get(k), sort_keys=True) != json.dumps(
+                    b.get(k), sort_keys=True):
+                log(f"NON-DETERMINISTIC key {k!r}:\n  run1={a.get(k)}\n"
+                    f"  run2={b.get(k)}")
+        raise SystemExit("soak runs are not byte-identical at one seed")
+    counters = {
+        "retries": a["train"]["retries"],
+        "quarantined": a["train"]["quarantined_records"],
+        "mesh_shrinks": a["train"]["mesh_shrinks"],
+        "rollbacks": a["swap"]["rollbacks"],
+    }
+    bad = [k for k, v in counters.items() if v < 1]
+    if bad:
+        raise SystemExit(f"soak recovery counters stayed zero: {bad} "
+                         f"({counters})")
+    out = {
+        "metric": "soak_deterministic_replay",
+        "value": 1.0,
+        "unit": "bool (two runs byte-identical)",
+        "acceptance": ("byte-identical records at one seed; retries/"
+                       "quarantined/mesh_shrinks/rollbacks all > 0; "
+                       "SIGKILL-resume for the CV sweep (cross-mesh) "
+                       "and the refresh"),
+        "seed": args.seed,
+        "counters": counters,
+        "faults_fired": a["faults_fired"],
+        "phases": a["phases"],
+        "dropped_features": a["train"]["dropped_features"],
+        "winner": a["train"]["winner"],
+        "drifted_features": a["drift"]["drifted_features"],
+        "refresh_report": a["refresh"]["report"],
+        "rollback_reason": a["swap"]["rollback_reason"],
+        "final_scores_head": a["final_scores"][:8],
+        "n_final_scores": len(a["final_scores"]),
+        "walls": [r["walls"] for r in runs],
+        "wall_s": round(wall, 2),
+        "ok": True,
+    }
+    print(json.dumps(out), flush=True)
+    if not args.smoke:
+        from transmogrifai_tpu.obs import bench_meta
+        from transmogrifai_tpu.utils.jsonio import write_json_atomic
+
+        out["meta"] = bench_meta(wall)
+        write_json_atomic(
+            os.path.join(_ROOT, "benchmarks", "soak_latest.json"), out)
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,20 @@ else
   echo "REFRESH_SMOKE=FAILED (see /tmp/_t1_refresh.log)"
   rc=1
 fi
+# soak smoke: the "day in production" capstone — stream ingest with
+# injected io_error + corrupt rows -> chunked workflow-CV train with RFF
+# on a 4-device mesh with an injected device.loss (elastic shrink) ->
+# CV-sweep SIGKILL + cross-mesh resume -> closed-loop serve -> drift
+# fires -> warm-start refresh (SIGKILLed + resumed) -> guarded swap
+# (poison rejected, clean baked in, forced bake rollback).  The WHOLE
+# scenario runs twice at one seed; exits non-zero on any unrecovered
+# fault, zero recovery counter, or non-byte-identical replay
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python examples/bench_soak.py --smoke > /tmp/_t1_soak.log 2>&1; then
+  echo "SOAK_SMOKE=ok $(grep -ao '"counters": {[^}]*}' /tmp/_t1_soak.log | tail -1)"
+else
+  echo "SOAK_SMOKE=FAILED (see /tmp/_t1_soak.log)"
+  rc=1
+fi
 # observability smoke: a traced 1x train + a traced serve request must
 # produce a VALID Chrome-trace export (schema-checked), a parseable
 # flight-recorder JSONL, non-empty per-stage HLO cost-analysis features,

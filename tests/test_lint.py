@@ -379,6 +379,17 @@ class _LastChunkWins(_MeanFillBase):
         return max(a, b)
 
 
+class _CountDroppingMerge(_MeanFillBase):
+    """merge_states keeps only the LEFT side's count: pairwise ratios
+    still look sane and the merge tree shape cancels (both shapes end on
+    (Σs, n_first)), so associativity holds — but the merged
+    fold-complement mean is Σs/n_first, diverging from the in-core
+    fold-complement fit.  Only TM029's refit-equivalence leg fires."""
+
+    def merge_states(self, a, b):
+        return a[0] + b[0], a[1]
+
+
 class _LossyExport(_MeanFillBase):
     """export_fit_state drops the COUNT (the classic warm-start bug: the
     persisted state forgets how much data it has seen, so restored+new
@@ -418,6 +429,21 @@ def test_conformant_streaming_fitter_is_clean():
     data, f = _streaming_data()
     est = _MeanFillBase().set_input(f)
     assert len(check_streaming_fit(est, data)) == 0
+
+
+def test_tm029_count_dropping_merge_breaks_fold_equivalence():
+    from transmogrifai_tpu.analysis.contracts import check_fold_merge
+
+    data, f = _streaming_data()
+    findings = check_fold_merge(_CountDroppingMerge().set_input(f), data)
+    assert findings.rules_fired() == ["TM029"]
+
+
+def test_tm029_conformant_fold_merge_is_clean():
+    from transmogrifai_tpu.analysis.contracts import check_fold_merge
+
+    data, f = _streaming_data()
+    assert len(check_fold_merge(_MeanFillBase().set_input(f), data)) == 0
 
 
 def test_all_vectorizer_families_cow_clean():

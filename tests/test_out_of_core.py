@@ -616,11 +616,29 @@ class TestChunkedTrainParity:
         labels = [p.label for p in mk.ingest_profile.passes]
         assert any(l.startswith("fit-blocks[") for l in labels)
 
-    def test_unsupported_combinations_raise(self, titanic_df):
-        prediction = build_titanic_pipeline()
+    def test_non_streamable_during_stage_raises_precisely(self, titanic_df):
+        """CV + chunk_rows is now supported (tests/test_streaming_cv.py);
+        the one genuinely unsupported combination — a during-DAG
+        estimator that cannot stream (spearman needs a global rank sort)
+        — must raise a precise error NAMING the offending stage uid."""
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, grid)
+
+        survived = FeatureBuilder.RealNN("Survived").as_response()
+        feats = transmogrify([
+            FeatureBuilder.Real("Age").as_predictor(),
+            FeatureBuilder.Real("Fare").as_predictor(),
+        ])
+        checker = SanityChecker(max_correlation=0.99,
+                                correlation_type="spearman")
+        checked = checker.set_input(survived, feats).get_output()
+        selector = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3, models_and_parameters=[
+                (OpLogisticRegression(), grid(reg_param=[0.01]))])
+        prediction = selector.set_input(survived, checked).get_output()
         wf = (OpWorkflow().set_result_features(prediction)
               .set_input_data(titanic_df).with_workflow_cv())
-        with pytest.raises(ValueError, match="workflow-level CV"):
+        with pytest.raises(ValueError, match=checker.uid):
             wf.train(chunk_rows=64)
 
     def test_block_spill_parity_and_cleanup(self, titanic_df, incore_model,

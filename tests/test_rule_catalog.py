@@ -163,6 +163,13 @@ def _tm028():
     return check_accum_tolerance(X, y, tol=-1.0, n_rounds=2, max_depth=3)
 
 
+def _tm029():
+    from transmogrifai_tpu.analysis.contracts import check_fold_merge
+
+    data, f = TL._streaming_data()
+    return check_fold_merge(TL._CountDroppingMerge().set_input(f), data)
+
+
 # -- TM03x ------------------------------------------------------------------
 
 def _tm030():
@@ -311,7 +318,7 @@ FIXTURES = {
     "TM005": _tm005, "TM006": _tm006,
     "TM020": _tm020, "TM021": _tm021, "TM022": _tm022, "TM023": _tm023,
     "TM024": _tm024, "TM025": _tm025, "TM026": _tm026, "TM027": _tm027,
-    "TM028": _tm028,
+    "TM028": _tm028, "TM029": _tm029,
     "TM030": _tm030, "TM031": _tm031, "TM032": _tm032,
     "TM040": _tm040, "TM041": _tm041, "TM042": _tm042, "TM043": _tm043,
     "TM044": _tm044, "TM045": _tm045, "TM046": _tm046,

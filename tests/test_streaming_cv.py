@@ -1,0 +1,236 @@
+"""Streaming workflow-level CV — fold-tagged mergeable fit states.
+
+The ISSUE 14 tentpole's CV half: ``train(chunk_rows=k)`` with
+``with_workflow_cv()`` must match the in-core fold-refit path — identical
+winner, per-fold metrics within each stage's declared
+``streaming_fit_tol`` — at chunk_rows in {7, 64, N}; checkpointed CV
+trains resume bit-exactly from a mid-fold kill AND from a mid-CV-sweep
+kill; fold-geometry changes refuse with a key-level fingerprint diff.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector, grid
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils import faults
+from transmogrifai_tpu.utils.faults import FaultSpec
+from transmogrifai_tpu.utils.uid import reset_uids
+from transmogrifai_tpu.workflow.checkpoint import CheckpointMismatchError
+
+N_ROWS = 400
+
+
+def synthetic_binary(n=N_ROWS, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    logits = 1.5 * x1 - 1.0 * x2 + (cat == "a") * 0.8
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(float)
+    return pd.DataFrame({"label": y, "x1": x1, "x2": x2, "cat": cat})
+
+
+def build_dag(num_folds=3, validator="cv", spearman=False):
+    reset_uids()
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = transmogrify([FeatureBuilder.Real("x1").as_predictor(),
+                          FeatureBuilder.Real("x2").as_predictor(),
+                          FeatureBuilder.PickList("cat").as_predictor()])
+    checker = SanityChecker(
+        max_correlation=0.99,
+        correlation_type="spearman" if spearman else "pearson")
+    checked = checker.set_input(label, feats).get_output()
+    factory = (BinaryClassificationModelSelector.with_cross_validation
+               if validator == "cv" else
+               BinaryClassificationModelSelector.with_train_validation_split)
+    kwargs = ({"num_folds": num_folds} if validator == "cv"
+              else {"train_ratio": 0.75})
+    selector = factory(models_and_parameters=[
+        (OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))], **kwargs)
+    prediction = selector.set_input(label, checked).get_output()
+    return prediction, selector, checker
+
+
+def _probs(model, df):
+    scored = model.score(data=df)
+    name = next(n for n in scored.names()
+                if issubclass(scored[n].ftype, ft.Prediction))
+    return [d["probability_1"] for d in scored[name].to_list()]
+
+
+@pytest.fixture(scope="module")
+def df():
+    return synthetic_binary()
+
+
+@pytest.fixture(scope="module")
+def incore_cv(df):
+    prediction, selector, _ = build_dag()
+    model = (OpWorkflow().set_result_features(prediction)
+             .set_input_data(df).with_workflow_cv().train())
+    return (model, _probs(model, df),
+            selector.metadata["workflow_cv_results"],
+            selector.metadata["model_selector_summary"])
+
+
+class TestStreamingCVParity:
+    @pytest.mark.parametrize("chunk_rows", [7, 64, N_ROWS])
+    def test_matches_incore_fold_refit(self, df, incore_cv, chunk_rows):
+        _, p0, results0, summ0 = incore_cv
+        prediction, selector, _ = build_dag()
+        model = (OpWorkflow().set_result_features(prediction)
+                 .set_input_data(df).with_workflow_cv()
+                 .train(chunk_rows=chunk_rows))
+        results = selector.metadata["workflow_cv_results"]
+        summ = selector.metadata["model_selector_summary"]
+        # identical winner, per-fold metrics within streaming tolerance
+        assert summ["bestModelParams"] == summ0["bestModelParams"]
+        assert len(results) == len(results0)
+        for a, b in zip(results0, results):
+            assert a["params"] == b["params"]
+            assert len(b["foldValues"]) == 3
+            assert b["metricValue"] == pytest.approx(a["metricValue"],
+                                                     abs=1e-4)
+        # the winner was CONSUMED by the tail fit (find_best contract)
+        assert selector.best_estimator is None
+        # end-to-end scores track the in-core CV train
+        assert _probs(model, df) == pytest.approx(p0, abs=1e-3)
+
+    def test_train_validation_split_variant(self, df):
+        prediction, selector, _ = build_dag(validator="tvs")
+        m0 = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(df).with_workflow_cv().train())
+        r0 = selector.metadata["workflow_cv_results"]
+        prediction1, selector1, _ = build_dag(validator="tvs")
+        (OpWorkflow().set_result_features(prediction1)
+         .set_input_data(df).with_workflow_cv().train(chunk_rows=64))
+        r1 = selector1.metadata["workflow_cv_results"]
+        assert [len(r["foldValues"]) for r in r1] == [1, 1]
+        for a, b in zip(r0, r1):
+            assert b["metricValue"] == pytest.approx(a["metricValue"],
+                                                     abs=1e-4)
+
+    def test_refresh_composes_with_workflow_cv(self, df):
+        prediction, selector, _ = build_dag()
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(df).with_workflow_cv())
+        model = wf.train(chunk_rows=64)
+        window = synthetic_binary(n=200, seed=9)
+        refreshed = wf.refresh(model, data=window, chunk_rows=64)
+        # the re-selection ran on the window, warm-started states merged
+        assert refreshed.refresh_report["merged"]
+        assert selector.metadata["workflow_cv_results"]
+        assert len(_probs(refreshed, window)) == 200
+
+    def test_cv_fold_fault_point_fires(self, df):
+        prediction, _sel, _ = build_dag()
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(df).with_workflow_cv())
+        with faults.inject(FaultSpec(point="cv.fold", action="raise",
+                                     at=1)):
+            with pytest.raises(faults.FaultError, match=r"cv\.fold\[1\]"):
+                wf.train(chunk_rows=64)
+
+
+class TestStreamingCVCheckpoint:
+    def _train(self, df, ckdir, fault=None, num_folds=3):
+        prediction, selector, _ = build_dag(num_folds=num_folds)
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(df).with_workflow_cv())
+        if fault is None:
+            model = wf.train(chunk_rows=32, checkpoint_dir=ckdir,
+                             checkpoint_every_chunks=2)
+            return model, selector
+        with faults.inject(fault):
+            with pytest.raises(faults.FaultError):
+                wf.train(chunk_rows=32, checkpoint_dir=ckdir,
+                         checkpoint_every_chunks=2)
+        return None, None
+
+    def test_mid_fold_resume_is_bit_exact(self, df, tmp_path):
+        """A kill DURING the fold-tagged SanityChecker pass: the per-fold
+        states restore bit-exactly from the mid-pass cursor and the
+        resumed train reproduces the uninterrupted scores byte-for-byte."""
+        ref, _ = self._train(df, str(tmp_path / "a"))
+        p_ref = _probs(ref, df)
+        ck = str(tmp_path / "b")
+        self._train(df, ck, fault=FaultSpec(
+            point="checkpoint.barrier", action="raise", at=3))
+        assert os.path.exists(os.path.join(ck, "checkpoint.json"))
+        resumed, selector = self._train(df, ck)
+        assert resumed.ingest_profile.resumed
+        assert sum(p.chunks_skipped
+                   for p in resumed.ingest_profile.passes) > 0
+        assert _probs(resumed, df) == p_ref
+
+    def test_mid_cv_sweep_resume_is_bit_exact(self, df, tmp_path):
+        """A kill at the CV sweep's cursor save (after the prefix passes
+        completed): the fold states restore from the pass-boundary
+        record, the sweep resumes at its unit cursor, and the final
+        scores + per-fold metrics are byte-identical."""
+        ref, sel_ref = self._train(df, str(tmp_path / "a"))
+        p_ref = _probs(ref, df)
+        ck = str(tmp_path / "b")
+        self._train(df, ck, fault=FaultSpec(
+            point="sweep.checkpoint", action="raise", at=1))
+        assert os.path.exists(os.path.join(ck, "sweep", "sweep.json"))
+        resumed, selector = self._train(df, ck)
+        assert resumed.ingest_profile.resumed
+        assert _probs(resumed, df) == p_ref
+        assert ([r["metricValue"]
+                 for r in selector.metadata["workflow_cv_results"]]
+                == [r["metricValue"]
+                    for r in sel_ref.metadata["workflow_cv_results"]])
+
+    def test_fold_geometry_mismatch_refuses_with_key_diff(self, df,
+                                                          tmp_path):
+        ck = str(tmp_path / "ck")
+        self._train(df, ck, fault=FaultSpec(
+            point="checkpoint.barrier", action="raise", at=1))
+        prediction, _, _ = build_dag(num_folds=5)
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(df).with_workflow_cv())
+        with pytest.raises(CheckpointMismatchError,
+                           match=r"cv\.numFolds: saved=3 current=5"):
+            wf.train(chunk_rows=32, checkpoint_dir=ck)
+
+    def test_cv_checkpoint_refuses_plain_train(self, df, tmp_path):
+        """The CV geometry key is part of the LOGICAL fingerprint: a
+        plain (non-CV) chunked train must refuse a CV checkpoint."""
+        ck = str(tmp_path / "ck")
+        self._train(df, ck, fault=FaultSpec(
+            point="checkpoint.barrier", action="raise", at=1))
+        prediction, _, _ = build_dag()
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(df))  # no with_workflow_cv
+        with pytest.raises(CheckpointMismatchError, match="cv"):
+            wf.train(chunk_rows=32, checkpoint_dir=ck)
+
+
+class TestFoldTaggedStates:
+    def test_fold_states_export_full_only_onto_model(self, df):
+        """fit_states carries the FULL-data component (warm-start
+        capital), never the per-fold scaffolding."""
+        prediction, _, checker = build_dag()
+        model = (OpWorkflow().set_result_features(prediction)
+                 .set_input_data(df).with_workflow_cv()
+                 .train(chunk_rows=64))
+        payload = model.fit_states[checker.uid]
+        assert not (isinstance(payload, dict)
+                    and payload.get("__fold_tagged__"))
+
+    def test_non_streamable_during_est_raises_named(self, df):
+        prediction, _, checker = build_dag(spearman=True)
+        wf = (OpWorkflow().set_result_features(prediction)
+              .set_input_data(df).with_workflow_cv())
+        with pytest.raises(ValueError, match=checker.uid):
+            wf.train(chunk_rows=64)
+        # in-core CV keeps working for the same DAG
+        model = wf.train()
+        assert _probs(model, df)

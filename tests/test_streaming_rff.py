@@ -1,0 +1,215 @@
+"""Streaming RawFeatureFilter — mergeable-monoid distribution profiles.
+
+The ISSUE 14 tentpole's RFF half: ``train(chunk_rows=k)`` with
+``with_raw_feature_filter(...)`` profiles the train (and scoring) reader
+chunk by chunk and must make IDENTICAL drop decisions to the in-core
+pass at chunk_rows in {7, 64, N}; the distribution pass honors the
+reader's resilience config, and bad records hit by all three reader
+passes count ONCE in the quarantine sidecar.
+"""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.readers import CSVReader
+from transmogrifai_tpu.readers.resilience import RetryPolicy
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils import faults
+from transmogrifai_tpu.utils.faults import FaultSpec
+
+N_ROWS = 300
+
+
+def make_df(n=N_ROWS, seed=5):
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) > 0.5).astype(float)
+    return pd.DataFrame({
+        "label": y,
+        "good": rng.normal(size=n),
+        "mostly_null": np.where(rng.random(n) < 0.999, np.nan, 1.0),
+        "leaky": np.where(y > 0, np.nan, rng.normal(size=n)),
+        "cat": rng.choice(["a", "b"], n),
+    })
+
+
+def build_pred():
+    label = FeatureBuilder.RealNN("label").as_response()
+    preds = [FeatureBuilder.Real("good").as_predictor(),
+             FeatureBuilder.Real("mostly_null").as_predictor(),
+             FeatureBuilder.Real("leaky").as_predictor(),
+             FeatureBuilder.PickList("cat").as_predictor()]
+    features = transmogrify(preds)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        label, features).get_output()
+    return OpLogisticRegression(reg_param=0.01).set_input(
+        label, checked).get_output()
+
+
+def _wf(df_or_reader, **rff):
+    kwargs = dict(min_fill_rate=0.05, max_correlation=0.9)
+    kwargs.update(rff)
+    wf = (OpWorkflow().set_result_features(build_pred())
+          .with_raw_feature_filter(**kwargs))
+    return wf.set_reader(df_or_reader)
+
+
+@pytest.fixture(scope="module")
+def df():
+    return make_df()
+
+
+@pytest.fixture(scope="module")
+def incore(df):
+    model = _wf(df).train()
+    return model, model.raw_feature_filter_results
+
+
+class TestStreamingDropParity:
+    @pytest.mark.parametrize("chunk_rows", [7, 64, N_ROWS])
+    def test_identical_drop_decisions(self, df, incore, chunk_rows):
+        m0, res0 = incore
+        mk = _wf(df).train(chunk_rows=chunk_rows)
+        res = mk.raw_feature_filter_results
+        assert (sorted(res.dropped_features)
+                == sorted(res0.dropped_features)
+                == ["leaky", "mostly_null"])
+        assert res.dropped_map_keys == res0.dropped_map_keys
+        # per-distribution parity: exact counts, leakage corr to float tol
+        for d0, d1 in zip(res0.train_distributions,
+                          res.train_distributions):
+            assert (d0.name, d0.key) == (d1.name, d1.key)
+            assert (d0.count, d0.nulls) == (d1.count, d1.nulls)
+            assert d1.null_label_corr() == pytest.approx(
+                d0.null_label_corr(), abs=1e-9)
+        # exclusion reasons agree flag-for-flag
+        assert ([r.to_json() for r in res.exclusion_reasons]
+                == [r.to_json() for r in res0.exclusion_reasons])
+        # the model actually trained on the pruned DAG
+        scored = mk.score(data=df)
+        assert any(issubclass(scored[n].ftype, ft.Prediction)
+                   for n in scored.names())
+
+    def test_scoring_reader_divergence_streams(self, df, rng):
+        score_df = df.copy()
+        score_df["good"] = rng.normal(50.0, 1.0, len(df))
+        m0 = _wf(df, max_js_divergence=0.5, min_fill_rate=0.0,
+                 max_correlation=1.1, scoring_data=score_df).train()
+        mk = _wf(df, max_js_divergence=0.5, min_fill_rate=0.0,
+                 max_correlation=1.1,
+                 scoring_data=score_df).train(chunk_rows=64)
+        assert "good" in mk.raw_feature_filter_results.dropped_features
+        assert (sorted(mk.raw_feature_filter_results.dropped_features)
+                == sorted(m0.raw_feature_filter_results.dropped_features))
+        assert (mk.ingest_profile.rff or {}).get("passes") == 2
+
+    def test_map_key_drops_clean_per_chunk(self):
+        """A map column with one leaky key: the key (not the feature)
+        drops, and every later chunked pass sees the cleaned maps."""
+        rng = np.random.default_rng(3)
+        n = 200
+        y = (rng.random(n) > 0.5).astype(float)
+        rows = [{"ok": float(rng.normal()),
+                 **({} if y[i] > 0 else {"bad": float(rng.normal())})}
+                for i in range(n)]
+        df = pd.DataFrame({"label": y, "m": rows,
+                           "good": rng.normal(size=n)})
+        def build():
+            label = FeatureBuilder.RealNN("label").as_response()
+            features = transmogrify([
+                FeatureBuilder.RealMap("m").as_predictor(),
+                FeatureBuilder.Real("good").as_predictor()])
+            # the label must reach the result DAG for the leakage check
+            return SanityChecker(max_correlation=0.999).set_input(
+                label, features).get_output()
+
+        wf = (OpWorkflow().set_result_features(build())
+              .with_raw_feature_filter(min_fill_rate=0.0,
+                                       max_correlation=0.9))
+        m0 = wf.set_reader(df).train()
+        res0 = m0.raw_feature_filter_results
+        assert res0.dropped_map_keys == {"m": ["bad"]}
+        wf2 = (OpWorkflow().set_result_features(build())
+               .with_raw_feature_filter(min_fill_rate=0.0,
+                                        max_correlation=0.9))
+        mk = wf2.set_reader(df).train(chunk_rows=32)
+        assert (mk.raw_feature_filter_results.dropped_map_keys
+                == {"m": ["bad"]})
+        # the fitted map vectorizer never saw the dropped key
+        vec = next(s for s in mk.stages
+                   if "Map" in type(s).__name__ and hasattr(s, "keysets"))
+        assert all("bad" not in ks for ks in vec.keysets)
+
+
+class TestQuarantineReconciliation:
+    def _csv_with_bad_row(self, df, tmp_path):
+        path = str(tmp_path / "rows.csv")
+        lines = df.to_csv(index=False).splitlines()
+        lines.insert(8, lines[8] + ",EXTRA,EXTRA")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return path
+
+    def test_bad_row_counts_once_across_three_passes(self, df, tmp_path):
+        """RFF adds a third reader pass; the same corrupt row is hit by
+        the distribution pass AND both fit passes, and must reconcile to
+        exactly ONE sidecar entry (dedupe on (source, location))."""
+        path = self._csv_with_bad_row(df, tmp_path)
+        side = str(tmp_path / "bad.jsonl")
+        reader = CSVReader(path).with_resilience(
+            bad_records="quarantine", quarantine_path=side)
+        mk = _wf(reader).train(chunk_rows=32)
+        ip = mk.ingest_profile
+        assert ip.quarantined_records == 1
+        assert ip.quarantined_rows == 1
+        entries = [json.loads(l) for l in open(side)]
+        assert len(entries) == 1
+        assert "malformed CSV row" in entries[0]["reason"]
+        # the RFF pass saw the same row universe as the fit passes
+        assert (ip.rff or {}).get("rows") == ip.total_rows
+        assert ip.to_json()["quarantinedRecords"] == 1
+
+    def test_rff_pass_retries_transient_io(self, df, tmp_path):
+        path = str(tmp_path / "rows.csv")
+        df.to_csv(path, index=False)
+        reader = CSVReader(path).with_resilience(
+            retry=RetryPolicy(max_attempts=4, base_delay_s=0.01, seed=1))
+        # at=1 hits the FIRST pass reaching chunk 1 — the RFF profile pass
+        with faults.inject(FaultSpec(point="reader.chunk",
+                                     action="io_error", at=1, times=1)):
+            mk = _wf(reader).train(chunk_rows=64)
+        assert (mk.ingest_profile.rff or {}).get("retries") == 1
+        m0 = _wf(df).train()
+        assert (sorted(mk.raw_feature_filter_results.dropped_features)
+                == sorted(m0.raw_feature_filter_results.dropped_features))
+
+    def test_rff_pass_fault_point_fires(self, df):
+        wf = _wf(df)
+        with faults.inject(FaultSpec(point="rff.pass", action="raise",
+                                     tag="train")):
+            with pytest.raises(faults.FaultError, match=r"rff\.pass"):
+                wf.train(chunk_rows=64)
+
+
+class TestRefreshWithRFF:
+    def test_refresh_reuses_recorded_drops(self, df):
+        wf = _wf(df)
+        model = wf.train(chunk_rows=64)
+        window = make_df(n=150, seed=11)
+        refreshed = wf.refresh(model, data=window, chunk_rows=64)
+        assert (refreshed.raw_feature_filter_results
+                is model.raw_feature_filter_results)
+        assert refreshed.refresh_report["merged"]
+
+    def test_refresh_without_recorded_results_raises(self, df):
+        plain = (OpWorkflow().set_result_features(build_pred())
+                 .set_reader(df).train(chunk_rows=64))
+        plain.raw_feature_filter_results = None
+        wf = _wf(df)
+        with pytest.raises(ValueError, match="recorded filter results"):
+            wf.refresh(plain, data=make_df(n=100, seed=2), chunk_rows=64)

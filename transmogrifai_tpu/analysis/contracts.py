@@ -43,7 +43,8 @@ from .diagnostics import ContractViolation, Diagnostic, Findings
 
 __all__ = ["CHECK_ENV", "checks_enabled", "guarded_transform_output",
            "columns_equal", "columns_close", "check_streaming_fit",
-           "check_warm_start", "check_workflow_contracts",
+           "check_warm_start", "check_fold_merge",
+           "check_workflow_contracts",
            "check_pad_invariance", "check_mesh_parity",
            "check_checkpoint_roundtrip", "check_sharding_contracts",
            "check_accum_tolerance"]
@@ -321,6 +322,103 @@ def check_warm_start(est, data, chunk_rows: int = 16,
     return findings
 
 
+def check_fold_merge(est, data, num_folds: int = 4, chunk_rows: int = 16,
+                     seed: int = 42,
+                     findings: Optional[Findings] = None) -> Findings:
+    """TM029 — fold-tagged state merge equivalence for one streamable
+    estimator (the contract streaming workflow-CV builds on,
+    workflow/streaming_cv.py).
+
+    Rows are assigned to ``num_folds`` folds per GLOBAL row id
+    (``selector.validators.make_folds``) and per-fold states accumulated
+    chunk by chunk — exactly the fold-tagged accumulation the streaming
+    CV driver performs.  For every fold k the COMPLEMENT merge must:
+
+    * be merge-tree-shape invariant: ``(a+b)+c == a+(b+c)`` over the
+      complement's fold states within ``streaming_fit_tol``;
+    * be fold-PERMUTATION invariant when the estimator declares
+      ``streaming_order_insensitive`` (tie-break ordering makes counting
+      fits legitimately order-sensitive, mirroring TM021);
+    * match the in-core fit over the complement's rows in fold-grouped
+      order (the row order a merged fold state represents) within
+      ``streaming_fit_tol`` — the refit-per-fold equivalence that makes
+      CV-from-merged-states honest.
+    """
+    from ..selector.validators import make_folds
+
+    findings = findings if findings is not None else Findings()
+    n = len(data)
+    if n < num_folds * 2:
+        return findings
+    tol = float(est.streaming_fit_tol)
+    name = type(est).__name__
+    folds = make_folds(n, num_folds, seed=seed)
+
+    states = [est.begin_fit() for _ in range(num_folds)]
+    for i in range(0, n, chunk_rows):
+        chunk = data.slice(i, min(i + chunk_rows, n))
+        g = folds[i:i + len(chunk)]
+        for k in range(num_folds):
+            idx = np.where(g == k)[0]
+            if not len(idx):
+                continue
+            sub = chunk.take(idx)
+            cols = [sub[nm] for nm in est.input_names]
+            states[k] = est.update_chunk(states[k], sub, *cols)
+
+    def merged(order, shape="left"):
+        parts = [copy.deepcopy(states[j]) for j in order]
+        if shape == "right" and len(parts) >= 3:
+            out = parts[-1]
+            for p in reversed(parts[:-1]):
+                out = est.merge_states(p, out)
+            return out
+        out = parts[0]
+        for p in parts[1:]:
+            out = est.merge_states(out, p)
+        return out
+
+    for k in range(num_folds):
+        comp = [j for j in range(num_folds) if j != k]
+        left = _model_output(est, est.finish_fit(merged(comp, "left")),
+                             data)
+        right = _model_output(est, est.finish_fit(merged(comp, "right")),
+                              data)
+        if not columns_close(left, right, tol):
+            findings.add(
+                "TM029",
+                f"{name} fold-complement merge is not associative: the "
+                f"merge-tree shape moves fold {k}'s complement model "
+                f"beyond tol={tol}", stage_uid=est.uid)
+        if est.streaming_order_insensitive:
+            rev = _model_output(
+                est, est.finish_fit(merged(list(reversed(comp)), "left")),
+                data)
+            if not columns_close(left, rev, tol):
+                findings.add(
+                    "TM029",
+                    f"{name} fold-complement merge is fold-order "
+                    f"sensitive but the estimator declares "
+                    f"streaming_order_insensitive (fold {k})",
+                    stage_uid=est.uid)
+        # in-core reference over the complement rows in FOLD-GROUPED
+        # order — the row order the merged state represents
+        ref_rows = np.concatenate(
+            [np.where(folds == j)[0] for j in comp])
+        sub_ds = data.take(ref_rows)
+        ref_cols = [sub_ds[nm] for nm in est.input_names]
+        ref_out = _model_output(est, est.fit_columns(sub_ds, *ref_cols),
+                                data)
+        if not columns_close(left, ref_out, tol):
+            findings.add(
+                "TM029",
+                f"{name} merged fold-complement state diverges from the "
+                f"in-core fit over fold {k}'s complement rows beyond "
+                f"tol={tol} — CV from merged fold states would not match "
+                f"refit-per-fold", stage_uid=est.uid)
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # Sharding / SPMD contracts (TM024-TM026) — the mesh-era runtime half of
 # the shard-safety lint (analysis/shard_lint.py).  Like the streaming
@@ -566,6 +664,7 @@ def check_workflow_contracts(wf, data=None,
                                             findings=findings,
                                             ref_model=model)
                         check_warm_start(stage, data, findings=findings)
+                        check_fold_merge(stage, data, findings=findings)
                     except ContractViolation as e:
                         findings.diagnostics.append(e.diagnostic)
             elif isinstance(stage, Transformer):

@@ -55,6 +55,11 @@ RULES: Dict[str, Any] = {
                      "tolerance: a fit with bf16 gradient/hessian "
                      "accumulation moves the metric beyond the f32 "
                      "reference by more than the declared bound"),
+    "TM029": (ERROR, "fold-tagged state merge diverges: the merged "
+                     "fold-complement state is not associative / "
+                     "fold-permutation invariant, or does not match the "
+                     "in-core fold-complement fit within the declared "
+                     "tolerance (streaming workflow-CV equivalence)"),
     # -- trace safety (analysis/trace_lint.py) --------------------------
     "TM030": (ERROR, "host sync on a traced value inside a jit function"),
     "TM031": (WARNING, "jit closure over an enclosing Python scalar: fresh "

@@ -40,6 +40,15 @@ class FeatureDistribution:
     moments_n: float = 0.0
     moments_sum: float = 0.0
     moments_sum2: float = 0.0
+    #: null×label leakage co-counts (monoid fields): with the null
+    #: indicator n_i ∈ {0,1} per row and label l_i, the Pearson
+    #: corr(null, label) the filter's leakage check needs is a pure
+    #: function of (count, nulls, Σl, Σl², Σ n_i·l_i) — so the check
+    #: streams and merges exactly like fill rates do
+    lab_sum: float = 0.0
+    lab_sum2: float = 0.0
+    null_lab_sum: float = 0.0
+    has_label: bool = False
 
     @property
     def full_name(self) -> str:
@@ -58,20 +67,56 @@ class FeatureDistribution:
 
     def __add__(self, other: "FeatureDistribution") -> "FeatureDistribution":
         assert (self.name, self.key) == (other.name, other.key)
-        hist = (self.hist.merge(other.hist)
-                if self.hist is not None and other.hist is not None
-                else self.hist or other.hist)
-        tc = None
-        if self.text_counts is not None or other.text_counts is not None:
-            a = self.text_counts if self.text_counts is not None else 0
-            b = other.text_counts if other.text_counts is not None else 0
-            tc = a + b
+        if ((self.hist is not None and other.text_counts is not None)
+                or (self.text_counts is not None and other.hist is not None)):
+            # representation conflict (a map key that looked numeric in one
+            # chunk and textual in another): degrade to a fill-rate-only
+            # profile — the JS check then reads 0 (never drops), which is
+            # the conservative failure mode for a heterogeneous key
+            hist, tc = None, None
+        else:
+            hist = (self.hist.merge(other.hist)
+                    if self.hist is not None and other.hist is not None
+                    else self.hist or other.hist)
+            tc = None
+            if self.text_counts is not None or other.text_counts is not None:
+                a = self.text_counts if self.text_counts is not None else 0
+                b = other.text_counts if other.text_counts is not None else 0
+                tc = a + b
         return FeatureDistribution(
             self.name, self.key, self.count + other.count,
             self.nulls + other.nulls, hist, tc,
             self.moments_n + other.moments_n,
             self.moments_sum + other.moments_sum,
-            self.moments_sum2 + other.moments_sum2)
+            self.moments_sum2 + other.moments_sum2,
+            self.lab_sum + other.lab_sum,
+            self.lab_sum2 + other.lab_sum2,
+            self.null_lab_sum + other.null_lab_sum,
+            self.has_label or other.has_label)
+
+    def null_label_corr(self) -> float:
+        """Pearson correlation between the per-row null indicator and the
+        label, from the accumulated co-counts (identical to
+        ``np.corrcoef(null, label)`` up to float summation order)."""
+        n = self.count
+        if n == 0 or not self.has_label:
+            return 0.0
+        p = self.nulls / n
+        var_null = p * (1.0 - p)
+        mean_l = self.lab_sum / n
+        var_l = self.lab_sum2 / n - mean_l * mean_l
+        if var_null <= 0.0 or var_l <= 0.0:
+            return 0.0
+        cov = self.null_lab_sum / n - p * mean_l
+        return float(cov / np.sqrt(var_null * var_l))
+
+    def _note_label(self, null_mask: np.ndarray, label: np.ndarray) -> None:
+        """Accumulate the leakage co-counts for this profile's rows."""
+        lab = np.nan_to_num(np.asarray(label, np.float64))
+        self.lab_sum += float(lab.sum())
+        self.lab_sum2 += float((lab ** 2).sum())
+        self.null_lab_sum += float(lab[np.asarray(null_mask, bool)].sum())
+        self.has_label = True
 
     def js_divergence(self, other: "FeatureDistribution") -> float:
         """Jensen-Shannon divergence between two profiles of the same feature
@@ -117,7 +162,8 @@ class FeatureDistribution:
         }
 
 
-def _profile_numeric(name, key, vals: np.ndarray, mask: np.ndarray):
+def _profile_numeric(name, key, vals: np.ndarray, mask: np.ndarray,
+                     label: Optional[np.ndarray] = None):
     d = FeatureDistribution(name, key, count=len(vals),
                             nulls=int((~mask).sum()))
     finite = vals[mask & np.isfinite(vals)]
@@ -125,39 +171,53 @@ def _profile_numeric(name, key, vals: np.ndarray, mask: np.ndarray):
     d.moments_n = float(finite.size)
     d.moments_sum = float(finite.sum())
     d.moments_sum2 = float((finite ** 2).sum())
+    if label is not None:
+        d._note_label(~np.asarray(mask, bool), label)
     return d
 
 
-def _profile_text(name, key, values) -> FeatureDistribution:
+def _profile_text(name, key, values,
+                  label: Optional[np.ndarray] = None) -> FeatureDistribution:
     d = FeatureDistribution(name, key, count=len(values))
     counts = np.zeros(TEXT_BINS, np.float64)
-    nulls = 0
-    for v in values:
+    null = np.zeros(len(values), bool)
+    for i, v in enumerate(values):
         if v is None:
-            nulls += 1
+            null[i] = True
         else:
             counts[murmur3_32(str(v)) % TEXT_BINS] += 1
-    d.nulls = nulls
+    d.nulls = int(null.sum())
     d.text_counts = counts
+    if label is not None:
+        d._note_label(null, label)
     return d
 
 
-def profile_column(name: str, col: FeatureColumn) -> List[FeatureDistribution]:
-    """Profile one raw column into distributions (one per map key for maps)."""
+def profile_column(name: str, col: FeatureColumn,
+                   label: Optional[np.ndarray] = None
+                   ) -> List[FeatureDistribution]:
+    """Profile one raw column into distributions (one per map key for maps).
+
+    ``label`` (the response values for the SAME rows, already
+    ``nan_to_num``-able) additionally accumulates the null×label leakage
+    co-counts — pass it on the training side so the filter's leakage
+    decision is a pure function of the (mergeable) distributions.
+    """
     st = col.ftype.storage
     if st in ("real", "integral", "binary", "date"):
         vals = np.asarray(col.values, np.float64)
-        return [_profile_numeric(name, None, vals, np.asarray(col.mask))]
+        return [_profile_numeric(name, None, vals, np.asarray(col.mask),
+                                 label)]
     if st == "text":
-        return [_profile_text(name, None, list(col.values))]
+        return [_profile_text(name, None, list(col.values), label)]
     if st in ("text_list", "multi_pick_list", "date_list"):
         flat = [" ".join(map(str, sorted(v))) if v else None
                 for v in col.values]
-        return [_profile_text(name, None, flat)]
+        return [_profile_text(name, None, flat, label)]
     if st == "geolocation":
         vals = np.asarray(col.values, np.float64)
         mask = np.asarray(col.mask)
-        return [_profile_numeric(name, None, vals[:, 0], mask)]
+        return [_profile_numeric(name, None, vals[:, 0], mask, label)]
     if st == "map":
         keys = sorted({k for row in col.values for k in row})
         out = []
@@ -172,13 +232,24 @@ def profile_column(name: str, col: FeatureColumn) -> List[FeatureDistribution]:
                         mask.append(v is not None)
                         vals.append(float(v) if v is not None else np.nan)
                     out.append(_profile_numeric(
-                        name, k, np.asarray(vals), np.asarray(mask)))
+                        name, k, np.asarray(vals), np.asarray(mask), label))
                     continue
                 except (TypeError, ValueError):
                     pass  # heterogeneous values — profile as text below
             out.append(_profile_text(
                 name, k, [None if row.get(k) is None else str(row.get(k))
-                          for row in col.values]))
+                          for row in col.values], label))
         return out
     # vectors and unknowns: count-only profile
     return [FeatureDistribution(name, None, count=len(col))]
+
+
+def merge_distributions(acc: Dict[tuple, FeatureDistribution],
+                        dists: List[FeatureDistribution]) -> None:
+    """Fold one chunk's profiles into the running (name, key)-keyed monoid
+    accumulator — the streaming analogue of the reference's partition
+    map-reduce (FeatureDistribution.scala:187-192)."""
+    for d in dists:
+        k = (d.name, d.key)
+        prev = acc.get(k)
+        acc[k] = d if prev is None else prev + d

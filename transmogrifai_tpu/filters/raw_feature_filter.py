@@ -18,7 +18,8 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..types.columns import ColumnarDataset, FeatureColumn
-from .feature_distribution import FeatureDistribution, profile_column
+from .feature_distribution import (FeatureDistribution, merge_distributions,
+                                   profile_column)
 
 __all__ = ["RawFeatureFilter", "RawFeatureFilterResults", "ExclusionReasons"]
 
@@ -108,7 +109,8 @@ class RawFeatureFilter:
 
     _MESH_NUMERIC = ("real", "integral", "binary", "date")
 
-    def _profiles(self, data: ColumnarDataset, names: Sequence[str]):
+    def _profiles(self, data: ColumnarDataset, names: Sequence[str],
+                  label: Optional[np.ndarray] = None):
         out: List[FeatureDistribution] = []
         mesh_cols: List[str] = []
         for n in names:
@@ -118,13 +120,15 @@ class RawFeatureFilter:
                     and data[n].ftype.storage in self._MESH_NUMERIC):
                 mesh_cols.append(n)
             else:
-                out.extend(profile_column(n, data[n]))
+                out.extend(profile_column(n, data[n], label))
         if mesh_cols:
-            out.extend(self._profiles_numeric_sharded(data, mesh_cols))
+            out.extend(self._profiles_numeric_sharded(data, mesh_cols,
+                                                      label))
         return out
 
     def _profiles_numeric_sharded(self, data: ColumnarDataset,
-                                  names: Sequence[str]):
+                                  names: Sequence[str],
+                                  label: Optional[np.ndarray] = None):
         """All scalar-numeric columns in one sharded device pass; the
         fixed-grid histogram loads into the same StreamingHistogram
         estimator the host pass builds (grid centers as centroids)."""
@@ -150,48 +154,22 @@ class RawFeatureFilter:
             d.moments_n = float(valid[j])
             d.moments_sum = float(s[j])
             d.moments_sum2 = float(s2[j])
+            if label is not None:
+                d._note_label(~mask[:, j], label)
             out.append(d)
         return out
 
-    def _null_label_corr(self, data: ColumnarDataset, name: str,
-                         key: Optional[str], label: np.ndarray) -> float:
-        col = data[name]
-        if key is not None:
-            null = np.array([key not in row or row.get(key) is None
-                             for row in col.values], np.float64)
-        elif col.mask is not None:
-            null = (~np.asarray(col.mask)).astype(np.float64)
-        else:
-            null = np.array([v is None for v in col.values], np.float64)
-        if null.std() == 0 or np.std(label) == 0:
-            return 0.0
-        return float(np.corrcoef(null, label)[0, 1])
-
     # -- decision + data cleaning ------------------------------------------
 
-    def filter_raw_data(self, data: ColumnarDataset,
-                        raw_features) -> Tuple[ColumnarDataset,
-                                               RawFeatureFilterResults]:
-        predictors = [f for f in raw_features if not f.is_response]
-        responses = [f for f in raw_features if f.is_response]
-        pred_names = [f.name for f in predictors]
-
-        train_dists = self._profiles(data, pred_names)
-        score_data = None
-        score_dists: List[FeatureDistribution] = []
-        if self.scoring_data is not None:
-            from ..readers.base import reader_for
-
-            score_data = reader_for(self.scoring_data).generate_dataset(
-                predictors)
-            score_dists = self._profiles(score_data, pred_names)
+    def _decide(self, train_dists: List[FeatureDistribution],
+                score_dists: List[FeatureDistribution]
+                ) -> Tuple[List[ExclusionReasons], List[str],
+                           Dict[str, List[str]]]:
+        """Drop decisions as a pure function of the (mergeable)
+        distributions — shared by the in-core and streaming profiles, so
+        chunked profiling cannot drift from the reference decision logic
+        (RawFeatureFilter.scala:445-486)."""
         score_by_key = {(d.name, d.key): d for d in score_dists}
-
-        label = None
-        if responses and responses[0].name in data:
-            label = np.nan_to_num(
-                np.asarray(data[responses[0].name].values, np.float64))
-
         reasons: List[ExclusionReasons] = []
         for d in train_dists:
             r = ExclusionReasons(d.name, d.key, d.fill_rate())
@@ -206,9 +184,9 @@ class RawFeatureFilter:
                     if d.name not in self.js_protected:
                         r.js_divergence = (d.js_divergence(s)
                                            > self.max_js_divergence)
-                if label is not None:
-                    corr = self._null_label_corr(data, d.name, d.key, label)
-                    r.null_label_leakage = abs(corr) > self.max_correlation
+                if d.has_label:
+                    r.null_label_leakage = (abs(d.null_label_corr())
+                                            > self.max_correlation)
             reasons.append(r)
 
         dropped_features: List[str] = []
@@ -227,20 +205,11 @@ class RawFeatureFilter:
                         dropped_map_keys[name] = bad
             elif any(r.excluded for r in rs):
                 dropped_features.append(name)
+        return reasons, dropped_features, dropped_map_keys
 
-        cleaned = data.copy()
-        for name in dropped_features:
-            if name in cleaned:
-                cleaned = cleaned.drop([name])
-        for name, keys in dropped_map_keys.items():
-            col = cleaned[name]
-            vals = np.empty(len(col.values), dtype=object)
-            bad = set(keys)
-            for i, row in enumerate(col.values):
-                vals[i] = {k: v for k, v in row.items() if k not in bad}
-            cleaned.set(name, FeatureColumn(col.ftype, vals))
-
-        results = RawFeatureFilterResults(
+    def _results(self, train_dists, score_dists, reasons, dropped_features,
+                 dropped_map_keys) -> RawFeatureFilterResults:
+        return RawFeatureFilterResults(
             config={
                 "minFillRate": self.min_fill_rate,
                 "maxFillDifference": self.max_fill_difference,
@@ -254,4 +223,180 @@ class RawFeatureFilter:
             dropped_features=dropped_features,
             dropped_map_keys=dropped_map_keys,
         )
+
+    def clean_chunk(self, data: ColumnarDataset,
+                    dropped_features: Sequence[str],
+                    dropped_map_keys: Dict[str, List[str]]
+                    ) -> ColumnarDataset:
+        """Apply already-made drop decisions to one dataset/chunk — the
+        per-chunk cleaning step of the streaming path (decisions are made
+        once on the profile pass; every later reader pass cleans chunks
+        identically, so chunking never changes what the DAG sees)."""
+        cleaned = data
+        to_drop = [n for n in dropped_features if n in cleaned]
+        if to_drop:
+            cleaned = cleaned.drop(to_drop)
+        for name, keys in dropped_map_keys.items():
+            if name not in cleaned:
+                continue
+            col = cleaned[name]
+            vals = np.empty(len(col.values), dtype=object)
+            bad = set(keys)
+            for i, row in enumerate(col.values):
+                vals[i] = {k: v for k, v in row.items() if k not in bad}
+            if cleaned is data:
+                cleaned = data.copy()
+            cleaned.set(name, FeatureColumn(col.ftype, vals))
+        return cleaned
+
+    def filter_raw_data(self, data: ColumnarDataset,
+                        raw_features) -> Tuple[ColumnarDataset,
+                                               RawFeatureFilterResults]:
+        predictors = [f for f in raw_features if not f.is_response]
+        responses = [f for f in raw_features if f.is_response]
+        pred_names = [f.name for f in predictors]
+
+        label = None
+        if responses and responses[0].name in data:
+            label = np.nan_to_num(
+                np.asarray(data[responses[0].name].values, np.float64))
+
+        train_dists = self._profiles(data, pred_names, label=label)
+        score_dists: List[FeatureDistribution] = []
+        if self.scoring_data is not None:
+            from ..readers.base import reader_for
+
+            score_data = reader_for(self.scoring_data).generate_dataset(
+                predictors)
+            score_dists = self._profiles(score_data, pred_names)
+
+        reasons, dropped_features, dropped_map_keys = self._decide(
+            train_dists, score_dists)
+        cleaned = self.clean_chunk(data.copy(), dropped_features,
+                                   dropped_map_keys)
+        results = self._results(train_dists, score_dists, reasons,
+                                dropped_features, dropped_map_keys)
         return cleaned, results
+
+    # -- streaming profile (out-of-core trains) -----------------------------
+
+    def filter_streaming(self, reader, raw_features, chunk_rows: int
+                         ) -> Tuple[RawFeatureFilterResults,
+                                    Dict[str, Any]]:
+        """Profile the TRAIN reader (and the scoring reader, when given)
+        one bounded chunk at a time and make the same drop decisions as
+        the in-core pass — ``FeatureDistribution`` is a monoid, so the
+        per-chunk profiles merge exactly like the reference's partition
+        reduce, and the leakage check rides the null×label co-counts
+        accumulated alongside.  Adds ONE reader pass before the streaming
+        fit passes (the ``rff.pass`` fault point fires per pass: index 0
+        tag="train", index 1 tag="score").
+
+        Returns ``(results, stats)`` where stats carries the pass's row /
+        retry / quarantine accounting for the ingest profiler.
+        """
+        from ..utils import faults
+
+        predictors = [f for f in raw_features if not f.is_response]
+        responses = [f for f in raw_features if f.is_response]
+        pred_names = [f.name for f in predictors]
+        label_name = responses[0].name if responses else None
+
+        stats: Dict[str, Any] = {"passes": 1, "rows": 0, "score_rows": 0,
+                                 "retries": 0, "retry_wait_s": 0.0}
+        faults.fire("rff.pass", index=0, tag="train")
+        train_dists, rows = self._profile_reader(
+            reader, list(raw_features), pred_names, label_name, chunk_rows,
+            stats)
+        stats["rows"] = rows
+
+        score_dists: List[FeatureDistribution] = []
+        if self.scoring_data is not None:
+            from ..readers.base import reader_for
+
+            faults.fire("rff.pass", index=1, tag="score")
+            stats["passes"] = 2
+            score_dists, srows = self._profile_reader(
+                reader_for(self.scoring_data), predictors, pred_names,
+                None, chunk_rows, stats)
+            stats["score_rows"] = srows
+
+        reasons, dropped_features, dropped_map_keys = self._decide(
+            train_dists, score_dists)
+        results = self._results(train_dists, score_dists, reasons,
+                                dropped_features, dropped_map_keys)
+        return results, stats
+
+    def _profile_reader(self, reader, read_features, pred_names: List[str],
+                        label_name: Optional[str], chunk_rows: int,
+                        stats: Dict[str, Any]
+                        ) -> Tuple[List[FeatureDistribution], int]:
+        """One chunked profile pass over ``reader``; honors the reader's
+        resilience config (retry/backoff + bad-record quarantine), so a
+        corrupt row hit here AND by the later fit passes still counts
+        once in the sidecar (dedup on (source, location))."""
+        rcfg = getattr(reader, "resilience", None)
+        if rcfg is not None and rcfg.retry is not None:
+            from ..readers.resilience import RetryingChunkStream
+
+            stream = RetryingChunkStream(
+                lambda: reader.iter_chunks(read_features, chunk_rows),
+                rcfg.retry)
+        else:
+            stream = reader.iter_chunks(read_features, chunk_rows)
+        acc: Dict[tuple, FeatureDistribution] = {}
+        rows = 0
+        lab_n = lab_sum = lab_sum2 = 0.0
+        for chunk in stream:
+            label = None
+            if label_name is not None and label_name in chunk:
+                label = np.nan_to_num(np.asarray(
+                    chunk[label_name].values, np.float64))
+                lab_n += len(label)
+                lab_sum += float(label.sum())
+                lab_sum2 += float((label ** 2).sum())
+            for name in pred_names:
+                if name not in chunk:
+                    continue
+                merge_distributions(acc,
+                                    profile_column(name, chunk[name], label))
+            rows += len(chunk)
+        stats["retries"] += int(getattr(stream, "retries", 0) or 0)
+        stats["retry_wait_s"] += float(
+            getattr(stream, "retry_wait_s", 0.0) or 0.0)
+        return self._ordered_dists(acc, pred_names, rows,
+                                   (lab_sum, lab_sum2) if lab_n else None
+                                   ), rows
+
+    @staticmethod
+    def _ordered_dists(acc: Dict[tuple, FeatureDistribution],
+                       pred_names: List[str], total_rows: int,
+                       label_totals: Optional[Tuple[float, float]]
+                       ) -> List[FeatureDistribution]:
+        """Deterministic in-core-parity ordering + map-key normalization:
+        a map key absent from some chunks never produced a profile for
+        those rows, so its count/nulls (and, with a label, the null×label
+        co-counts — the missing rows are all-null) are topped up to the
+        full row count, matching the single-pass profile exactly."""
+        out: List[FeatureDistribution] = []
+        for name in pred_names:
+            keyed = sorted((k for (n, k) in acc if n == name
+                            and k is not None))
+            if (name, None) in acc:
+                out.append(acc[(name, None)])
+            for k in keyed:
+                d = acc[(name, k)]
+                if d.count < total_rows:
+                    missing = total_rows - d.count
+                    d.nulls += missing
+                    d.count = total_rows
+                    if label_totals is not None and d.has_label:
+                        # the missing rows are all-null: their labels move
+                        # into the null·label cross term, and the label
+                        # moments become the full-data moments
+                        tot_sum, tot_sum2 = label_totals
+                        d.null_lab_sum += tot_sum - d.lab_sum
+                        d.lab_sum = tot_sum
+                        d.lab_sum2 = tot_sum2
+                out.append(d)
+        return out

@@ -576,6 +576,53 @@ class ModelSelector(PredictorEstimator):
         self.metadata["workflow_cv_results"] = [r.to_json() for r in results]
         return best_name, best_params
 
+    def find_best_estimator_prefold(self, per_fold, y=None,
+                                    n_rows: int = 0
+                                    ) -> Tuple[str, Dict[str, Any]]:
+        """Workflow-level CV over PRE-BUILT fold matrices — the streaming
+        path's ``find_best_estimator`` (workflow/streaming_cv.py builds
+        the matrices from merged fold-tagged monoid states).  Same
+        contract: the winner is remembered so the subsequent ``fit``
+        skips validation; the fold-validated results land in
+        ``metadata["workflow_cv_results"]``.
+
+        Unlike the in-core DAG variant this one runs through the full
+        sweep machinery: ``parallel=``/mesh resolution, the mid-sweep
+        checkpoint cursor (``with_sweep_checkpoint`` — a SIGKILLed CV
+        sweep resumes at its unit cursor, on whatever mesh the resuming
+        process has), and the elastic device-loss ladder with its
+        counters in ``metadata["workflow_cv_elastic"]``.
+        """
+        if y is not None:
+            self._capture_class_space(np.asarray(y, np.float32))
+        n_cols = int(per_fold[0][0].shape[1]) if per_fold else 0
+        queue_width = sum(len(g) for _, g in self.models_and_params)
+        prev_mesh = self.mesh
+        self.mesh = self._resolve_parallel(n_rows, n_cols, queue_width)
+        try:
+            elastic = self._elastic_context(n_rows, n_cols, queue_width)
+            # per-fold matrices differ per context, so family grid
+            # groups (which batch over ONE shared matrix) don't apply
+            candidates = self._candidates(with_groups=False)
+            ckpt = self._sweep_checkpoint(candidates, n_rows,
+                                          elastic=elastic)
+            best_i, results = self.validator.validate_prefold(
+                candidates, per_fold, eval_fn=self._metric,
+                metric_name=self.validation_metric,
+                larger_better=self.larger_better,
+                checkpoint=ckpt, elastic=elastic)
+            if ckpt is not None:
+                ckpt.finish()
+            self.metadata["workflow_cv_elastic"] = (
+                elastic.counters.to_json())
+        finally:
+            self._drain_tree_prefetch()
+            self.mesh = prev_mesh
+        best_name, best_params, *_ = candidates[best_i]
+        self.best_estimator = (best_name, best_params, results)
+        self.metadata["workflow_cv_results"] = [r.to_json() for r in results]
+        return best_name, best_params
+
     # -- fit -----------------------------------------------------------------
 
     def _grid_has_linear(self) -> bool:

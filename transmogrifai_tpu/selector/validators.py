@@ -113,6 +113,35 @@ class _ValidatorBase:
         (SanityChecker, supervised bucketizers) cannot leak fold labels."""
         raise NotImplementedError
 
+    def validate_prefold(
+        self,
+        candidates,
+        per_fold: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray, np.ndarray, np.ndarray]],
+        eval_fn,
+        metric_name: str,
+        larger_better: bool = True,
+        checkpoint=None,
+        elastic=None,
+    ) -> Tuple[int, List[ValidationResult]]:
+        """Validate candidates over PRE-BUILT fold matrices — each context
+        a ``(X_tr, y_tr, w_tr, X_ev, y_ev, w_ev)`` tuple.  The streaming
+        workflow-CV path (workflow/streaming_cv.py) builds these from
+        merged fold-tagged monoid states instead of refitting the during
+        DAG per fold; the candidate fits and metric extraction are
+        byte-for-byte the ``validate_with_dag`` bodies, and the sweep
+        runs through the same work queue (mid-sweep checkpoint cursor +
+        elastic device-loss ladder both compose)."""
+
+        def run_fold(fitter, params, ctx):
+            X_tr, y_tr, w_tr, X_ev, y_ev, w_ev = ctx
+            predict = fitter(X_tr, y_tr, w_tr, params)
+            return eval_fn(y_ev, predict(X_ev), w_ev)
+
+        return _run_sweep(candidates, list(per_fold), run_fold, metric_name,
+                          larger_better, getattr(self, "max_wait", None),
+                          checkpoint=checkpoint, elastic=elastic)
+
     @staticmethod
     def _fold_matrices(data, during_dag, label_name, features_name,
                        tr_idx: np.ndarray, ev_idx: np.ndarray):

@@ -46,6 +46,20 @@ no plan is armed):
                          ``index`` is the probe ordinal — a ``raise``
                          here triggers the automatic ROLLBACK to the
                          pinned generation (``probe_error:FaultError``)
+  ``rff.pass``           at the start of each RawFeatureFilter streaming
+                         distribution pass (filters/raw_feature_filter.
+                         filter_streaming); ``index`` 0 = train pass,
+                         1 = scoring pass, ``tag`` = "train"/"score" —
+                         an ``io_error`` below it (reader.chunk)
+                         exercises retry on the profile pass
+  ``cv.fold``            as each streaming workflow-CV fold context
+                         builds its matrices (workflow/streaming_cv.
+                         StreamingCVContext.run_validation); ``index``
+                         is the fold ordinal
+  ``soak.phase``         at every phase boundary of the soak scenario
+                         (examples/bench_soak.py); ``index`` is the
+                         phase ordinal, ``tag`` the phase name — the
+                         handle for aiming any fault at "during phase k"
 
 Actions: ``io_error`` (raise OSError — the transient class the reader
 retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``

@@ -518,6 +518,9 @@ class IngestProfiler:
         self.checkpoint_saves: int = 0
         self.checkpoint_wall_s: float = 0.0
         self.resumed: bool = False
+        #: RawFeatureFilter streaming-profile pass accounting (rows /
+        #: retries per pass) when the train ran with a filter; None else
+        self.rff: "Optional[Dict[str, Any]]" = None
         self._lock = threading.Lock()
 
     def begin_pass(self, label: str) -> IngestPass:
@@ -556,6 +559,7 @@ class IngestProfiler:
                 "checkpointSaves": self.checkpoint_saves,
                 "checkpointWallSecs": round(self.checkpoint_wall_s, 4),
                 "resumed": self.resumed,
+                "rff": self.rff,
                 "passes": [p.to_json() for p in self.passes],
             }
 

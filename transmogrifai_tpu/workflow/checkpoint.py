@@ -341,15 +341,19 @@ class StreamingCheckpointManager:
         for rec in doc.get("completedPasses", []):
             models = {uid: _load_stage(srec, arrays)
                       for uid, srec in rec["models"].items()}
+            # final state payloads (fold-tagged CV layers persist theirs
+            # so a post-pass kill still resumes the fold validation) —
+            # decoded to live sketch objects, same carry-forward rule as
+            # the models (raw records would dangle into superseded npz)
+            payloads = {uid: decode_fit_state(p, arrays)
+                        for uid, p in (rec.get("states") or {}).items()}
             state.completed[int(rec["pass"])] = {
                 "rows": int(rec["rows"]), "label": rec.get("label"),
-                "models": models}
-            # carry forward as LIVE stages: future saves re-encode them
-            # against their own array store (raw records would dangle
-            # references into the superseded npz generation)
+                "models": models, "states": payloads}
             self._completed[int(rec["pass"])] = {
                 "pass": int(rec["pass"]), "rows": int(rec["rows"]),
-                "label": rec.get("label"), "live_models": models}
+                "label": rec.get("label"), "live_models": models,
+                "live_payloads": payloads}
         state.current = doc.get("current")
         self._seq = int(doc.get("seq", 0))
         from ..obs.flight import record_event
@@ -376,11 +380,17 @@ class StreamingCheckpointManager:
         # (records are small: vocabs, fills, keep-indices)
         for pi in sorted(self._completed):
             rec = self._completed[pi]
-            doc["completedPasses"].append({
+            entry = {
                 "pass": pi, "rows": rec["rows"], "label": rec.get("label"),
                 "models": {uid: _stage_record(m, store)
                            for uid, m in rec["live_models"].items()},
-            })
+            }
+            payloads = rec.get("live_payloads")
+            if payloads:
+                entry["states"] = {
+                    uid: encode_fit_state(p, f"done{pi}.{uid}", store)
+                    for uid, p in payloads.items()}
+            doc["completedPasses"].append(entry)
         if self._current is not None:
             cur = dict(self._current)
             cur["states"] = {
@@ -427,12 +437,21 @@ class StreamingCheckpointManager:
         self._write()
 
     def complete_pass(self, pass_index: int, label: str, rows: int,
-                      models: Dict[str, Model]) -> None:
+                      models: Dict[str, Model],
+                      state_payloads: Optional[Dict[str, Any]] = None
+                      ) -> None:
         """Persist a finished pass's fitted models; clears the in-flight
-        record (the cursor is meaningless once the pass is done)."""
+        record (the cursor is meaningless once the pass is done).
+
+        ``state_payloads`` (uid -> ``export_fit_state`` payload) rides
+        along for estimators whose FINAL state is still needed after the
+        pass — the fold-tagged CV layers: a kill after the pass but
+        before the fold validation must restore the per-fold states, not
+        just the full-data model."""
         self._completed[int(pass_index)] = {
             "pass": int(pass_index), "label": label, "rows": int(rows),
             "live_models": models,
+            "live_payloads": dict(state_payloads or {}),
         }
         self._current = None
         self._write()

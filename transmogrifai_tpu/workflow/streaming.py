@@ -325,6 +325,11 @@ class _ColumnWriter:
         return out
 
 
+def _est_name(est) -> str:
+    """Display name of an estimator (unwraps the fold-tagged CV proxy)."""
+    return type(getattr(est, "inner", est)).__name__
+
+
 def _split_streamable(layers: List[List[PipelineStage]],
                       subs: Dict[str, Model]) -> int:
     """Index of the first layer containing an estimator that cannot stream
@@ -383,6 +388,8 @@ def fit_dag_streaming(
     shard_columns: Optional[Sequence[str]] = None,
     refresh_ctx=None,
     fingerprint_extra: Optional[Dict] = None,
+    cv_ctx=None,
+    chunk_filter=None,
 ) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler,
            Dict[str, object]]:
     """Fit ``dag`` from chunked ingestion; returns (fitted stages in topo
@@ -413,7 +420,22 @@ def fit_dag_streaming(
     packed float matrices straight into per-shard device buffers instead
     of one host buffer (the streaming→sharded hand-off; see
     ``_ColumnWriter`` and ``parallel.ingest``) — the mesh sweep then
-    consumes the committed row-sharded array without a host round trip."""
+    consumes the committed row-sharded array without a host round trip.
+
+    ``cv_ctx`` (a ``workflow.streaming_cv.StreamingCVContext``) turns
+    this run into a streaming WORKFLOW-CV train: during-DAG estimators
+    accumulate fold-tagged states (one mergeable state per fold, fold
+    ids assigned per global row id), and after the prefix materializes
+    the context runs the fold validation (per-fold models from merged
+    complement states) so the tail's ModelSelector consumes the winner.
+    With a checkpoint manager attached the fold-tagged layers run as
+    dedicated checkpointable passes (fold states are part of the
+    mid-pass cursor — a mid-fold kill resumes bit-exactly) at the cost
+    of one extra reader pass.
+
+    ``chunk_filter`` (dataset -> dataset) runs on every RAW chunk of
+    every pass before any transform — RawFeatureFilter's map-key
+    cleaning rides here, so chunking never changes what the DAG sees."""
     from .dag import StagesDAG, fit_and_transform_dag
 
     if chunk_rows <= 0:
@@ -501,6 +523,8 @@ def fit_dag_streaming(
         from ..obs.trace import begin_span, end_span
 
         pass_stats = ingest.begin_pass(label)
+        if cv_ctx is not None:
+            cv_ctx.begin_label_pass()
         needed_after = _liveness(ordered, final_needed)
         if rcfg is not None and rcfg.retry is not None:
             from ..readers.resilience import RetryingChunkStream
@@ -520,6 +544,14 @@ def fit_dag_streaming(
         t_pass = time.perf_counter()
         try:
             for chunk in batcher:
+                if chunk_filter is not None:
+                    chunk = chunk_filter(chunk)
+                if cv_ctx is not None and cv_ctx.collecting_labels:
+                    # fold assignment needs (n, y) up front: the label
+                    # column is collected from the RAW chunks of the
+                    # first executed pass (skipped chunks are still
+                    # read, so a mid-pass resume collects them too)
+                    cv_ctx.collect_labels(chunk)
                 if chunk_idx < skip_chunks:
                     rows += len(chunk)
                     pass_stats.chunks_skipped += 1
@@ -541,6 +573,10 @@ def fit_dag_streaming(
                             [c for c in ds.names()
                              if c in na or (keep_unknown and
                                             c not in known_universe)])
+                    if cv_ctx is not None:
+                        # global row window of this chunk — fold-tagged
+                        # update_chunks slice their fold ids from it
+                        cv_ctx.set_window(rows, len(chunk))
                     per_chunk(ds, chunk_idx)
                 finally:
                     end_span(chunk_span)
@@ -556,6 +592,8 @@ def fit_dag_streaming(
         pass_stats.wall_s = time.perf_counter() - t_pass
         if rows == 0:
             raise ValueError("chunked reader produced no rows")
+        if cv_ctx is not None:
+            cv_ctx.finish_label_pass(rows)
         return rows
 
     def update_states(ests, states, ds: ColumnarDataset) -> None:
@@ -581,20 +619,41 @@ def fit_dag_streaming(
     def finish_layer(ests, states) -> None:
         for est in ests:
             t0 = time.perf_counter()
-            model = est.adopt_model(est.finish_fit(states[est.uid]))
+            state = states[est.uid]
+            # fold-tagged proxies export ONLY the full-data component as
+            # warm-start capital (fold states are per-train scaffolding)
+            exporter = getattr(est, "export_full_state", None)
+            model = est.adopt_model(est.finish_fit(state))
             stage_wall[est.uid] = (stage_wall.get(est.uid, 0.0)
                                    + time.perf_counter() - t0)
             est._record_fit_wall(coll, stage_wall[est.uid])
             fitted_by_uid[est.uid] = model
             stage_kind[est.uid] = "fit-stream"
             # final mergeable state -> warm-start capital for refresh
-            final_states[est.uid] = est.export_fit_state(states[est.uid])
+            final_states[est.uid] = (exporter(state) if exporter is not None
+                                     else est.export_fit_state(state))
             if refresh_ctx is not None:
                 refresh_ctx.note_finished(est, model)
 
     def layer_ests(li: int) -> List[Estimator]:
-        return [s for s in prefix[li]
-                if isinstance(s, Estimator) and s.uid not in subs]
+        out = [s for s in prefix[li]
+               if isinstance(s, Estimator) and s.uid not in subs]
+        if cv_ctx is not None:
+            out = [cv_ctx.wrap(s) for s in out]
+        return out
+
+    def ensure_cv_folds(ests) -> None:
+        """Fold assignment must precede any fold-tagged update: labels
+        come from the first executed reader pass, or — when the tagged
+        layer fits on the FIRST pass, or a resume restored every earlier
+        pass without reading — from a dedicated label pre-pass."""
+        if (cv_ctx is None or cv_ctx.folds_ready
+                or not cv_ctx.wraps_any(ests)):
+            return
+        if not cv_ctx.labels_ready:
+            run_reader_pass("cv-labels", [], set(),
+                            lambda ds, _i: None, keep_unknown=False)
+        cv_ctx.assign_folds()
 
     # -- what must materialize: keep-set + the in-core tail's inputs --------
     prefix_outputs = set(out_stage)
@@ -608,6 +667,11 @@ def fit_dag_streaming(
         mat_cols |= available
     else:
         mat_cols |= set(keep) & available
+    if cv_ctx is not None:
+        # fold validation re-transforms the during DAG over fold slices:
+        # its upstream inputs (+ the label) must materialize; the final
+        # keep-select drops them again after validation
+        mat_cols |= cv_ctx.extra_columns & available
 
     est_idxs = [li for li in range(len(prefix)) if layer_ests(li)]
     # everything the whole run must compute: mat_cols plus every fitting
@@ -622,139 +686,35 @@ def fit_dag_streaming(
                            shard_columns=set(shard_columns or ()))
     materialized: Dict[str, FeatureColumn] = {}
 
-    if not est_idxs:
-        # no estimators in the prefix: a single materialize pass
+    def write_only(ds: ColumnarDataset, _idx: int) -> None:
+        writer.append(ds, [c for c in ds.names()
+                           if c in mat_cols or c in extras])
+
+    def materialize_only_pass() -> int:
+        """One reader pass over the (fully fitted) prefix writing every
+        materialized column — the no-estimator path, and the final pass
+        of a checkpointed CV train whose fold-tagged layers all ran as
+        dedicated checkpointable passes."""
         ordered = [s for layer in prefix for s in layer
                    if s.uid in needed_uids]
-
-        def write_only(ds: ColumnarDataset, _idx: int) -> None:
-            writer.append(ds, [c for c in ds.names()
-                               if c in mat_cols or c in extras])
-
         try:
-            run_reader_pass("materialize", ordered, set(mat_cols),
-                            write_only, keep_unknown=True)
+            rows = run_reader_pass("materialize", ordered, set(mat_cols),
+                                   write_only, keep_unknown=True)
             materialized.update(writer.finish())
+            return rows
         except BaseException:
             writer.close()   # release per-shard device buffers on abort
             raise
-    else:
-        # fuse at the SECOND estimator layer when there is one (its pass
-        # can already compute the first layer's model outputs, so the
-        # retained blocks are derived, compact columns); a single
-        # estimator layer fuses on its own pass.
-        fuse_at = est_idxs[1] if len(est_idxs) >= 2 else est_idxs[0]
 
-        # plain reader fit passes for estimator layers before the fuse —
-        # the checkpointable passes: their whole progress is the mergeable
-        # states + a chunk cursor (workflow/checkpoint.py)
-        prefuse = [li for li in est_idxs if li < fuse_at]
-        for pass_idx, li in enumerate(prefuse):
-            ests = layer_ests(li)
-            names = ", ".join(type(e).__name__ for e in ests)
-            label = f"fit[layer {li}: {names}]"
-            if resume is not None and pass_idx in resume.completed:
-                # pass-boundary resume: adopt the persisted models, never
-                # re-read the data for this layer
-                from .checkpoint import (CheckpointMismatchError,
-                                         adopt_restored_model)
-
-                done = resume.completed[pass_idx]
-                for est in ests:
-                    model = done["models"].get(est.uid)
-                    if model is None:
-                        raise CheckpointMismatchError(
-                            f"checkpoint pass {pass_idx} is missing a "
-                            f"model for estimator {est.uid}")
-                    fitted_by_uid[est.uid] = adopt_restored_model(est, model)
-                    stage_kind[est.uid] = "fit-restored"
-                if total_rows is None:
-                    total_rows = done["rows"]
-                continue
-            target_inputs: Set[str] = set()
-            for est in ests:
-                target_inputs |= set(est.input_names)
-            pass_uids = _closure(sorted(target_inputs), out_stage)
-            ordered = [s for lj in range(li) for s in prefix[lj]
-                       if s.uid in pass_uids]
-            states = init_states(ests)
-            skip = 0
-            if (resume is not None and resume.current is not None
-                    and int(resume.current["pass"]) == pass_idx):
-                # mid-pass resume: bit-exact states + fast-skip cursor
-                states = resume.states_for(ests)
-                skip = int(resume.current["chunks_done"])
-            on_chunk = None
-            if manager is not None:
-                def on_chunk(ci, rows_done, _pi=pass_idx, _lb=label,
-                             _e=ests, _st=states):
-                    if (ci + 1) % manager.every_chunks == 0:
-                        t0 = time.perf_counter()
-                        manager.save_progress(_pi, _lb, ci + 1, rows_done,
-                                              _e, _st)
-                        _note_checkpoint(t0)
-            rows = run_reader_pass(
-                label, ordered, set(target_inputs),
-                lambda ds, _i, e=ests, st=states: update_states(e, st, ds),
-                keep_unknown=False, skip_chunks=skip, on_chunk=on_chunk)
-            total_rows = rows if total_rows is None else total_rows
-            finish_layer(ests, states)
-            if manager is not None:
-                t0 = time.perf_counter()
-                manager.complete_pass(
-                    pass_idx, label, rows,
-                    {est.uid: fitted_by_uid[est.uid] for est in ests})
-                _note_checkpoint(t0)
-
-        # -- fused retention pass at ``fuse_at`` ---------------------------
-        fuse_ests = layer_ests(fuse_at)
-        fuse_uids = {e.uid for e in fuse_ests}
-        fuse_inputs: Set[str] = set()
-        for est in fuse_ests:
-            fuse_inputs |= set(est.input_names)
-
-        # forward reachability from every not-yet-fitted estimator at or
-        # after the fuse point: those stages form the block-cascade chain
-        pending_est_uids = {e.uid for li in est_idxs if li >= fuse_at
-                            for e in layer_ests(li)}
-        down_out_names = {e.get_output().name for e in fuse_ests}
-        chain_tail: List[PipelineStage] = []
-        for lj in range(fuse_at, len(prefix)):
-            for s in prefix[lj]:
-                if s.uid in fuse_uids or s.uid not in needed_uids:
-                    continue
-                if (s.uid in pending_est_uids
-                        or any(n in down_out_names
-                               for n in s.input_names)):
-                    chain_tail.append(s)
-                    down_out_names.add(s.get_output().name)
-        consumed = set(mat_cols) | {
-            n for s in chain_tail for n in s.input_names}
-        chain: List[PipelineStage] = (
-            [e for e in fuse_ests if e.get_output().name in consumed]
-            + chain_tail)
-        chain_uids = {s.uid for s in chain}
-        chain_outputs = {s.get_output().name for s in chain}
-        block_cols = ({n for s in chain for n in s.input_names}
-                      - chain_outputs)
-        direct_cols = set(mat_cols) - chain_outputs
-
-        run_stages = [s for layer in prefix for s in layer
-                      if s.uid in needed_uids and s.uid not in chain_uids
-                      and s.uid not in fuse_uids]
-        states = init_states(fuse_ests)
-        store = _BlockStore(_retain_budget_bytes(retain_mb))
-
-        def feed_and_capture(ds: ColumnarDataset, _idx: int) -> None:
-            update_states(fuse_ests, states, ds)
-            writer.append(ds, [c for c in ds.names()
-                               if c in direct_cols or c in extras])
-            if chain:
-                store.append(ds.select([c for c in block_cols
-                                        if c in ds]))
-
+    def _run_fused_and_cascade(fuse_at, fuse_ests, fuse_inputs, chain,
+                               run_stages, states, store, direct_cols,
+                               block_cols, feed_and_capture) -> None:
+        """The fused fit+materialize reader pass and the block cascade
+        over the retained chunks (extracted so the deferred-fuse CV path
+        can skip it wholesale)."""
+        nonlocal total_rows
         try:
-            names = ", ".join(type(e).__name__ for e in fuse_ests)
+            names = ", ".join(_est_name(e) for e in fuse_ests)
             rows = run_reader_pass(
                 f"fit+materialize[layer {fuse_at}: {names}]", run_stages,
                 fuse_inputs | direct_cols | block_cols, feed_and_capture,
@@ -805,12 +765,13 @@ def fit_dag_streaming(
                              & {s.get_output().name for s in segment})
                 needed_after = _liveness(
                     segment, seg_inputs | retain_cols | seg_write)
+                ensure_cv_folds(seg_ests)
                 seg_states = init_states(seg_ests)
                 apass = ingest.begin_pass(
                     "assemble" if not seg_ests else
                     "fit-blocks[layer "
                     f"{stage_layer[seg_ests[0].uid]}: "
-                    + ", ".join(type(e).__name__ for e in seg_ests) + "]")
+                    + ", ".join(_est_name(e) for e in seg_ests) + "]")
                 t_pass = time.perf_counter()
                 nxt: List[Optional[ColumnarDataset]] = []
                 offset = 0
@@ -827,6 +788,8 @@ def fit_dag_streaming(
                         ds_b = ds_b.select([c for c in ds_b.names()
                                             if c in needed_after[idx]])
                     if seg_ests:
+                        if cv_ctx is not None:
+                            cv_ctx.set_window(offset, n_b)
                         update_states(seg_ests, seg_states, ds_b)
                     writer.offset = offset
                     writer.append(ds_b, [c for c in ds_b.names()
@@ -858,17 +821,195 @@ def fit_dag_streaming(
             raise
         finally:
             store.close()
-        missing = (set(mat_cols) & chain_outputs) - set(writer.cols)
-        if missing:  # pragma: no cover - cascade covers every chain output
-            raise RuntimeError(
-                f"block cascade failed to materialize {sorted(missing)}")
-        try:
-            materialized.update(writer.finish())
-        except BaseException:
-            writer.close()
-            raise
+
+    if not est_idxs:
+        # no estimators in the prefix: a single materialize pass
+        materialize_only_pass()
+    else:
+        # fuse at the SECOND estimator layer when there is one (its pass
+        # can already compute the first layer's model outputs, so the
+        # retained blocks are derived, compact columns); a single
+        # estimator layer fuses on its own pass.  CHECKPOINTED CV trains
+        # defer the fuse past the last fold-tagged layer: the fused
+        # fit+materialize pass is deliberately not mid-pass-checkpointed
+        # (its progress is the output buffers), so fold-tagged layers run
+        # as dedicated checkpointable passes instead — one extra reader
+        # pass buys a bit-exact mid-fold resume (fuse_at=None = every
+        # estimator layer is a plain pass + a final materialize pass).
+        fuse_at: Optional[int] = (est_idxs[1] if len(est_idxs) >= 2
+                                  else est_idxs[0])
+        if cv_ctx is not None and manager is not None:
+            tagged = [li for li in est_idxs
+                      if cv_ctx.wraps_any(layer_ests(li))]
+            if tagged:
+                later = [li for li in est_idxs if li > max(tagged)]
+                fuse_at = later[0] if later else None
+
+        # plain reader fit passes for estimator layers before the fuse —
+        # the checkpointable passes: their whole progress is the mergeable
+        # states + a chunk cursor (workflow/checkpoint.py)
+        prefuse = [li for li in est_idxs
+                   if fuse_at is None or li < fuse_at]
+        for pass_idx, li in enumerate(prefuse):
+            ests = layer_ests(li)
+            names = ", ".join(_est_name(e) for e in ests)
+            label = f"fit[layer {li}: {names}]"
+            if resume is not None and pass_idx in resume.completed:
+                # pass-boundary resume: adopt the persisted models, never
+                # re-read the data for this layer
+                import copy as _copy
+
+                from .checkpoint import (CheckpointMismatchError,
+                                         adopt_restored_model)
+
+                done = resume.completed[pass_idx]
+                for est in ests:
+                    model = done["models"].get(est.uid)
+                    if model is None:
+                        raise CheckpointMismatchError(
+                            f"checkpoint pass {pass_idx} is missing a "
+                            f"model for estimator {est.uid}")
+                    inner = getattr(est, "inner", est)
+                    fitted_by_uid[est.uid] = adopt_restored_model(inner,
+                                                                  model)
+                    stage_kind[est.uid] = "fit-restored"
+                    payload = (done.get("states") or {}).get(est.uid)
+                    if payload is not None:
+                        # fold-tagged layer: re-import the persisted
+                        # final state so the CV validation still has its
+                        # per-fold states (deep copy — the manager's
+                        # carried payloads re-encode on the next save)
+                        st = est.import_fit_state(_copy.deepcopy(payload))
+                        if (cv_ctx is not None
+                                and hasattr(est, "export_full_state")):
+                            cv_ctx.note_fold_states(inner, st.folds)
+                        final_states.setdefault(
+                            est.uid,
+                            inner.export_fit_state(st.full)
+                            if hasattr(est, "export_full_state")
+                            else est.export_fit_state(st))
+                if total_rows is None:
+                    total_rows = done["rows"]
+                continue
+            target_inputs: Set[str] = set()
+            for est in ests:
+                target_inputs |= set(est.input_names)
+            pass_uids = _closure(sorted(target_inputs), out_stage)
+            ordered = [s for lj in range(li) for s in prefix[lj]
+                       if s.uid in pass_uids]
+            ensure_cv_folds(ests)
+            states = init_states(ests)
+            skip = 0
+            if (resume is not None and resume.current is not None
+                    and int(resume.current["pass"]) == pass_idx):
+                # mid-pass resume: bit-exact states + fast-skip cursor
+                states = resume.states_for(ests)
+                skip = int(resume.current["chunks_done"])
+            on_chunk = None
+            if manager is not None:
+                def on_chunk(ci, rows_done, _pi=pass_idx, _lb=label,
+                             _e=ests, _st=states):
+                    if (ci + 1) % manager.every_chunks == 0:
+                        t0 = time.perf_counter()
+                        manager.save_progress(_pi, _lb, ci + 1, rows_done,
+                                              _e, _st)
+                        _note_checkpoint(t0)
+            rows = run_reader_pass(
+                label, ordered, set(target_inputs),
+                lambda ds, _i, e=ests, st=states: update_states(e, st, ds),
+                keep_unknown=False, skip_chunks=skip, on_chunk=on_chunk)
+            total_rows = rows if total_rows is None else total_rows
+            finish_layer(ests, states)
+            if manager is not None:
+                t0 = time.perf_counter()
+                manager.complete_pass(
+                    pass_idx, label, rows,
+                    {est.uid: fitted_by_uid[est.uid] for est in ests},
+                    state_payloads={
+                        est.uid: est.export_fit_state(states[est.uid])
+                        for est in ests
+                        if hasattr(est, "export_full_state")})
+                _note_checkpoint(t0)
+
+        if fuse_at is None:
+            # every estimator layer ran as a checkpointable plain pass
+            # (the deferred-fuse CV+checkpoint path): one final
+            # materialize pass over the fully fitted prefix
+            writer.total = total_rows
+            materialize_only_pass()
+            chain_outputs: Set[str] = set()
+        else:
+            # -- fused retention pass at ``fuse_at`` -----------------------
+            fuse_ests = layer_ests(fuse_at)
+            fuse_uids = {e.uid for e in fuse_ests}
+            fuse_inputs: Set[str] = set()
+            for est in fuse_ests:
+                fuse_inputs |= set(est.input_names)
+
+            # forward reachability from every not-yet-fitted estimator at
+            # or after the fuse point: those form the block-cascade chain
+            pending_est_uids = {e.uid for li in est_idxs if li >= fuse_at
+                                for e in layer_ests(li)}
+            down_out_names = {e.get_output().name for e in fuse_ests}
+            chain_tail: List[PipelineStage] = []
+            for lj in range(fuse_at, len(prefix)):
+                for s in prefix[lj]:
+                    if s.uid in fuse_uids or s.uid not in needed_uids:
+                        continue
+                    if (s.uid in pending_est_uids
+                            or any(n in down_out_names
+                                   for n in s.input_names)):
+                        chain_tail.append(
+                            cv_ctx.wrap(s) if (cv_ctx is not None
+                                               and isinstance(s, Estimator))
+                            else s)
+                        down_out_names.add(s.get_output().name)
+            consumed = set(mat_cols) | {
+                n for s in chain_tail for n in s.input_names}
+            chain: List[PipelineStage] = (
+                [e for e in fuse_ests if e.get_output().name in consumed]
+                + chain_tail)
+            chain_uids = {s.uid for s in chain}
+            chain_outputs = {s.get_output().name for s in chain}
+            block_cols = ({n for s in chain for n in s.input_names}
+                          - chain_outputs)
+            direct_cols = set(mat_cols) - chain_outputs
+
+            run_stages = [s for layer in prefix for s in layer
+                          if s.uid in needed_uids and s.uid not in chain_uids
+                          and s.uid not in fuse_uids]
+            ensure_cv_folds(fuse_ests)
+            states = init_states(fuse_ests)
+            store = _BlockStore(_retain_budget_bytes(retain_mb))
+
+            def feed_and_capture(ds: ColumnarDataset, _idx: int) -> None:
+                update_states(fuse_ests, states, ds)
+                writer.append(ds, [c for c in ds.names()
+                                   if c in direct_cols or c in extras])
+                if chain:
+                    store.append(ds.select([c for c in block_cols
+                                            if c in ds]))
+
+            _run_fused_and_cascade(
+                fuse_at, fuse_ests, fuse_inputs, chain, run_stages, states,
+                store, direct_cols, block_cols, feed_and_capture)
+            missing = (set(mat_cols) & chain_outputs) - set(writer.cols)
+            if missing:  # pragma: no cover - cascade covers chain outputs
+                raise RuntimeError(
+                    f"block cascade failed to materialize {sorted(missing)}")
+            try:
+                materialized.update(writer.finish())
+            except BaseException:
+                writer.close()
+                raise
 
     data = ColumnarDataset(materialized, _validated=True)
+
+    # -- workflow-CV validation (between prefix and tail): per-fold models
+    #    from merged fold-tagged states, the selector sweep over the fold
+    #    matrices, best_estimator set so the tail's fit skips validation --
+    if cv_ctx is not None:
+        cv_ctx.run_validation(data)
 
     # fitted stages in topo order: prefix (transformers are their own
     # fitted stage, matching the in-core executor's returned list)

@@ -1,0 +1,432 @@
+"""Fold-tagged streaming CV — workflow-level cross-validation out of core.
+
+The in-core workflow-CV path (``OpWorkflow.with_workflow_cv``) cuts the
+DAG at the ModelSelector and REFITS the label-leaking "during" segment
+(SanityChecker, supervised bucketizers) inside every fold
+(``OpValidator.applyDAG``).  That refit-per-fold re-reads the training
+data K times — exactly what an out-of-core train cannot do.
+
+The streaming substitute exploits what the streaming-fit protocol already
+guarantees: per-estimator states are MERGEABLE MONOIDS.  Fold ids are
+assigned per GLOBAL row id (``selector.validators.make_folds`` over the
+splitter's train subset — the same seeded assignment the in-core
+validator makes, so chunking is invariant), every during-DAG estimator's
+``update_chunk`` additionally accumulates ONE STATE PER FOLD, and the
+fold-k refit model is ``finish_fit(merge(states[j] for j != k))`` — the
+fold-complement fit without a single extra reader pass.  The per-fold
+metrics then come from transforming the materialized fold slices through
+the during DAG with the fold models substituted, byte-for-byte the same
+candidate fitters the in-core sweep runs (contract TM029 property-checks
+the fold-merge equivalence; the per-fold outputs match the in-core
+refit within each stage's declared ``streaming_fit_tol``).
+
+Fault points: ``cv.fold`` fires once per fold context as its matrices
+build (``index`` = fold ordinal) — a ``raise`` here exercises a fold
+that cannot evaluate; the selector sweep itself runs through the
+ordinary ``SweepWorkQueue`` (mid-sweep checkpoint cursor + elastic
+device-loss ladder both armed when configured).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..stages.base import Estimator, Model
+from ..utils import faults
+
+__all__ = ["StreamingCVContext", "FoldTaggedState", "FoldTaggedEstimator"]
+
+
+class FoldTaggedState:
+    """One streamed estimator's CV-aware fit state: the FULL-data state
+    (the model the DAG adopts) plus one mergeable state per fold of the
+    splitter's train subset (holdout rows ride only the full state)."""
+
+    __slots__ = ("full", "folds")
+
+    def __init__(self, full, folds: List[Any]):
+        self.full = full
+        self.folds = folds
+
+
+#: checkpoint-codec marker for a fold-tagged state payload
+_TAG = "__fold_tagged__"
+
+
+class FoldTaggedEstimator(Estimator):
+    """Streaming-protocol proxy that accumulates fold-tagged states.
+
+    Wraps a during-DAG estimator for the out-of-core driver: every
+    ``update_chunk`` updates the full-data state with the whole chunk
+    (chunk order preserved — parity with a plain streaming train) and
+    each fold's state with that fold's rows (row→fold via the context's
+    global assignment, so the accumulation is chunking-invariant).  The
+    wrapped estimator's own ``export/import_fit_state`` hooks carry each
+    component through the checkpoint codec — a mid-pass kill restores
+    every fold state bit-exactly.
+    """
+
+    # deliberately no super().__init__: the proxy answers for the inner
+    # stage's identity (uid/wiring) rather than minting its own
+    def __init__(self, inner: Estimator, ctx: "StreamingCVContext"):
+        self.inner = inner
+        self.ctx = ctx
+        self.uid = inner.uid
+        self.operation_name = inner.operation_name
+        self.output_type = inner.output_type
+        self.input_features = inner.input_features
+        self._output_feature = inner._output_feature
+        self.metadata = inner.metadata
+
+    # -- identity delegation -------------------------------------------------
+
+    @property
+    def supports_streaming_fit(self) -> bool:
+        return bool(self.inner.supports_streaming_fit)
+
+    @property
+    def streaming_fit_tol(self) -> float:
+        return float(self.inner.streaming_fit_tol)
+
+    @property
+    def device_heavy(self) -> bool:
+        return self.inner.device_heavy
+
+    def adopt_model(self, model: Model) -> Model:
+        return self.inner.adopt_model(model)
+
+    def _record_fit_wall(self, coll, dt: float) -> None:
+        self.inner._record_fit_wall(coll, dt)
+
+    # -- fold-tagged streaming protocol --------------------------------------
+
+    def begin_fit(self) -> FoldTaggedState:
+        k = self.ctx.num_folds
+        return FoldTaggedState(self.inner.begin_fit(),
+                               [self.inner.begin_fit() for _ in range(k)])
+
+    def update_chunk(self, state: FoldTaggedState, data, *cols
+                     ) -> FoldTaggedState:
+        state.full = self.inner.update_chunk(state.full, data, *cols)
+        g = self.ctx.window_folds(len(data))
+        for k in range(self.ctx.num_folds):
+            idx = np.where(g == k)[0]
+            if not len(idx):
+                continue
+            sub = data.take(idx)
+            sub_cols = [sub[n] for n in self.inner.input_names]
+            state.folds[k] = self.inner.update_chunk(
+                state.folds[k], sub, *sub_cols)
+        return state
+
+    def merge_states(self, a: FoldTaggedState,
+                     b: FoldTaggedState) -> FoldTaggedState:
+        return FoldTaggedState(
+            self.inner.merge_states(a.full, b.full),
+            [self.inner.merge_states(x, y)
+             for x, y in zip(a.folds, b.folds)])
+
+    def finish_fit(self, state: FoldTaggedState) -> Model:
+        # the fold states are the CV capital — hand them to the context
+        # BEFORE finish_fit (implementations may finalize in place)
+        self.ctx.note_fold_states(self.inner, state.folds)
+        return self.inner.finish_fit(state.full)
+
+    # -- checkpoint codec hooks ----------------------------------------------
+
+    def export_fit_state(self, state: FoldTaggedState):
+        return {_TAG: True,
+                "full": self.inner.export_fit_state(state.full),
+                "folds": [self.inner.export_fit_state(s)
+                          for s in state.folds]}
+
+    def export_full_state(self, state: FoldTaggedState):
+        """The FULL-data component only — what rides on the model as
+        ``fit_states`` (the warm-start capital a refresh resumes from;
+        fold states are per-train scaffolding, not model state)."""
+        return self.inner.export_fit_state(state.full)
+
+    def import_fit_state(self, payload) -> FoldTaggedState:
+        if isinstance(payload, dict) and payload.get(_TAG):
+            return FoldTaggedState(
+                self.inner.import_fit_state(payload["full"]),
+                [self.inner.import_fit_state(p)
+                 for p in payload["folds"]])
+        # a PLAIN payload (a refresh warm-starting from the base model's
+        # exported full state): the full state resumes, fold states
+        # accumulate from this run's window alone
+        return FoldTaggedState(
+            self.inner.import_fit_state(payload),
+            [self.inner.begin_fit() for _ in range(self.ctx.num_folds)])
+
+
+class StreamingCVContext:
+    """Fold bookkeeping + validation orchestration for ONE streaming
+    workflow-CV train (built by ``OpWorkflow._train_chunked`` from the
+    CV cut, consumed by ``workflow.streaming.fit_dag_streaming``)."""
+
+    def __init__(self, selector, during_dag, subs: Dict[str, Model]):
+        self.selector = selector
+        self.during_dag = during_dag
+        self.subs = dict(subs or {})
+        during = [s for layer in during_dag.layers for s in layer]
+        self.during_uids: Set[str] = {
+            s.uid for s in during
+            if isinstance(s, Estimator) and s.uid not in self.subs}
+        outputs = {s.get_output().name for s in during}
+        #: during-DAG inputs produced UPSTREAM (before-DAG / raw) — these
+        #: must materialize so fold slices can re-transform per fold
+        self.extra_columns: Set[str] = {
+            n for s in during for n in s.input_names} - outputs
+        self.label_name = selector.label_feature.name
+        self.features_name = selector.features_feature.name
+        self.extra_columns.add(self.label_name)
+
+        v = selector.validator
+        from ..selector.validators import OpTrainValidationSplit
+
+        self._is_split = isinstance(v, OpTrainValidationSplit)
+        self.num_folds = 2 if self._is_split else int(v.num_folds)
+
+        self._wrapped: Dict[str, FoldTaggedEstimator] = {}
+        self._label_parts: List[np.ndarray] = []
+        self.labels_ready = False
+        self.folds_ready = False
+        self.y: Optional[np.ndarray] = None
+        self._global_folds: Optional[np.ndarray] = None
+        self._train_idx: Optional[np.ndarray] = None
+        self._folds_sub: Optional[np.ndarray] = None
+        self._base_w: Optional[np.ndarray] = None
+        self._win: Tuple[int, int] = (0, 0)
+        self._fold_states: Dict[str, List[Any]] = {}
+        self.validated = False
+
+    # -- fingerprint (checkpoint fold-geometry guard) ------------------------
+
+    def fingerprint(self) -> Dict[str, Any]:
+        """The LOGICAL fold geometry a streaming-CV checkpoint is pinned
+        to: resuming with different folds/seed/stratification must refuse
+        (``CheckpointMismatchError`` with the key-level diff) — the fold
+        states in the checkpoint were accumulated under THIS assignment.
+        Mesh shape stays out of it (advisory, PR 9 split)."""
+        v = self.selector.validator
+        return {"cv": {
+            "validator": type(v).__name__,
+            "numFolds": None if self._is_split else int(v.num_folds),
+            "trainRatio": (float(v.train_ratio) if self._is_split
+                           else None),
+            "seed": int(v.seed),
+            "stratify": bool(getattr(v, "stratify", False)),
+        }}
+
+    # -- label collection (first reader pass) --------------------------------
+
+    @property
+    def collecting_labels(self) -> bool:
+        return not self.labels_ready
+
+    def begin_label_pass(self) -> None:
+        if not self.labels_ready:
+            self._label_parts = []
+
+    def collect_labels(self, chunk) -> None:
+        if self.labels_ready or self.label_name not in chunk:
+            return
+        self._label_parts.append(np.nan_to_num(np.asarray(
+            chunk[self.label_name].values, np.float64)))
+
+    def finish_label_pass(self, rows: int) -> None:
+        if self.labels_ready:
+            return
+        got = sum(len(p) for p in self._label_parts)
+        if got != rows:  # pragma: no cover - label is a raw column
+            raise RuntimeError(
+                f"workflow CV could not collect the label column "
+                f"{self.label_name!r} over the reader pass "
+                f"({got} of {rows} rows)")
+        self.y = (np.concatenate(self._label_parts) if self._label_parts
+                  else np.zeros(0))
+        self._label_parts = []
+        self.labels_ready = True
+
+    # -- fold assignment (global row ids) ------------------------------------
+
+    def assign_folds(self) -> None:
+        """Fold id per GLOBAL row, mirroring the in-core
+        ``find_best_estimator`` exactly: the splitter reserves the
+        holdout and weights the train subset, then folds are made over
+        the train subset with the validator's seed/stratification.
+        Rows outside the train subset get fold -1 (full state only)."""
+        if self.folds_ready:
+            return
+        if not self.labels_ready:  # pragma: no cover - driver orders this
+            raise RuntimeError("fold assignment before label collection")
+        from ..selector.validators import make_folds
+
+        y = self.y
+        n = len(y)
+        self.selector._capture_class_space(y)
+        splitter = self.selector._resolved_splitter()
+        train_idx, _ = splitter.split_indices(n, y)
+        train_mask = np.zeros(n, dtype=bool)
+        train_mask[train_idx] = True
+        self._base_w = splitter.train_weights(y, train_mask)
+        v = self.selector.validator
+        if self._is_split:
+            in_train = v._split_mask(len(train_idx), y[train_idx])
+            folds_sub = np.where(in_train, 1, 0).astype(np.int32)
+        else:
+            folds_sub = make_folds(len(train_idx), v.num_folds,
+                                   y=y[train_idx],
+                                   stratify=v.stratify, seed=v.seed)
+        g = np.full(n, -1, dtype=np.int32)
+        g[train_idx] = folds_sub
+        self._train_idx = train_idx
+        self._folds_sub = folds_sub
+        self._global_folds = g
+        self.folds_ready = True
+
+    # -- driver hooks --------------------------------------------------------
+
+    def wrap(self, est: Estimator) -> Estimator:
+        """The fold-tagged proxy for a during-DAG estimator (memoized so
+        every driver code path sees ONE object per uid)."""
+        if est.uid not in self.during_uids:
+            return est
+        got = self._wrapped.get(est.uid)
+        if got is None:
+            got = self._wrapped[est.uid] = FoldTaggedEstimator(est, self)
+        return got
+
+    def wraps_any(self, ests: Sequence[Estimator]) -> bool:
+        return any(isinstance(e, FoldTaggedEstimator) for e in ests)
+
+    def set_window(self, start_row: int, n_rows: int) -> None:
+        self._win = (int(start_row), int(n_rows))
+
+    def window_folds(self, n: int) -> np.ndarray:
+        if not self.folds_ready:  # pragma: no cover - driver orders this
+            raise RuntimeError("fold-tagged update before fold assignment")
+        start, wn = self._win
+        if n != wn:  # pragma: no cover - transforms are row-preserving
+            raise RuntimeError(
+                f"fold window desync: chunk has {n} rows, window {wn}")
+        return self._global_folds[start:start + n]
+
+    def note_fold_states(self, inner: Estimator, folds: List[Any]) -> None:
+        self._fold_states[inner.uid] = folds
+
+    # -- the CV validation (between prefix and tail) -------------------------
+
+    def _fold_model(self, inner: Estimator, train_folds: Sequence[int]
+                    ) -> Model:
+        """finish_fit(merge of the complement's fold states) wired as a
+        standalone transform — the estimator's live metadata (written by
+        the FULL-data finish that already ran) is shielded from the fold
+        finishes, matching the in-core order where the full fit lands
+        last."""
+        states = self._fold_states[inner.uid]
+        parts = [copy.deepcopy(states[j]) for j in train_folds]
+        merged = parts[0]
+        for p in parts[1:]:
+            merged = inner.merge_states(merged, p)
+        saved = inner.metadata
+        inner.metadata = {}
+        try:
+            model = inner.finish_fit(merged)
+            model.uid = inner.uid
+            model.operation_name = inner.operation_name
+            model.input_features = list(inner.input_features)
+            model._output_feature = inner._output_feature
+            model.metadata = inner.metadata
+        finally:
+            inner.metadata = saved
+        return model
+
+    def _fold_matrices(self, data, tr_idx: np.ndarray, ev_idx: np.ndarray,
+                       fold_subs: Dict[str, Model]):
+        """The streaming analogue of ``_ValidatorBase._fold_matrices``:
+        same plan-bounded gathers, same matrix extraction — but the
+        during-DAG estimators are SUBSTITUTED with fold-complement models
+        instead of refit from the rows."""
+        from .dag import fit_and_transform_dag, sequential_executor_forced
+        from .plan import plan_for
+
+        keep = [self.features_name, self.label_name]
+        if sequential_executor_forced():
+            train_ds = data.take(tr_idx)
+            eval_ds = data.take(ev_idx)
+            _, train_t, eval_t = fit_and_transform_dag(
+                self.during_dag, train_ds, apply_to=eval_ds,
+                fitted_substitutes=fold_subs, sequential=True)
+        else:
+            plan = plan_for(self.during_dag, keep=keep)
+            req = plan.required_input_columns()
+            base = data.select([n for n in data.names() if n in req])
+            train_ds = base.take(tr_idx)
+            eval_ds = base.take(ev_idx)
+            _, train_t, eval_t = fit_and_transform_dag(
+                self.during_dag, train_ds, apply_to=eval_ds,
+                fitted_substitutes=fold_subs, keep=keep)
+        X_tr = np.ascontiguousarray(np.asarray(
+            train_t[self.features_name].values, dtype=np.float32))
+        X_ev = np.ascontiguousarray(np.asarray(
+            eval_t[self.features_name].values, dtype=np.float32))
+        y_tr = np.nan_to_num(np.asarray(
+            train_t[self.label_name].values, dtype=np.float32))
+        y_ev = np.nan_to_num(np.asarray(
+            eval_t[self.label_name].values, dtype=np.float32))
+        return X_tr, y_tr, X_ev, y_ev
+
+    def fold_contexts(self) -> List[Tuple[Tuple[int, ...], int]]:
+        """(train_folds, eval_fold) per validation context: K
+        leave-one-out complements for CV, the single (train side, eval
+        side) pair for a train/validation split."""
+        if self._is_split:
+            return [((1,), 0)]
+        k = self.num_folds
+        return [(tuple(j for j in range(k) if j != fold), fold)
+                for fold in range(k)]
+
+    def run_validation(self, data) -> None:
+        """Build the per-fold matrices from merged fold states and run
+        the selector sweep — sets ``selector.best_estimator`` so the
+        in-core tail's fit consumes the winner without re-validating
+        (the exact contract of the in-core ``find_best_estimator``)."""
+        if self.validated:
+            return
+        self.assign_folds()
+        missing = self.during_uids - set(self._fold_states)
+        if missing:  # pragma: no cover - driver fits the whole prefix
+            raise RuntimeError(
+                f"workflow CV reached validation with unfitted during-DAG "
+                f"estimators: {sorted(missing)}")
+        per_fold = []
+        for ci, (train_folds, eval_fold) in enumerate(self.fold_contexts()):
+            faults.fire("cv.fold", index=ci)
+            tr_pos = np.isin(self._folds_sub, train_folds)
+            ev_pos = self._folds_sub == eval_fold
+            tr_idx = self._train_idx[tr_pos]
+            ev_idx = self._train_idx[ev_pos]
+            if not len(tr_idx) or not len(ev_idx):
+                continue
+            w_tr = self._base_w[tr_idx]
+            w_ev = self._base_w[ev_idx]
+            if w_tr.sum() == 0 or w_ev.sum() == 0:
+                continue
+            fold_subs = dict(self.subs)
+            for uid in self.during_uids:
+                inner = self._wrapped[uid].inner
+                fold_subs[uid] = self._fold_model(inner, train_folds)
+            X_tr, y_tr, X_ev, y_ev = self._fold_matrices(
+                data, tr_idx, ev_idx, fold_subs)
+            per_fold.append((X_tr, y_tr, w_tr, X_ev, y_ev, w_ev))
+        if not per_fold:
+            raise RuntimeError(
+                "workflow CV produced no usable fold contexts "
+                "(every fold had an empty or zero-weight side)")
+        self.selector.find_best_estimator_prefold(
+            per_fold, y=self.y, n_rows=len(self._train_idx))
+        self.validated = True

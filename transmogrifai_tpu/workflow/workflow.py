@@ -395,24 +395,70 @@ class OpWorkflow(_WorkflowCore):
                        retain_mb: Optional[float] = None
                        ) -> "OpWorkflowModel":
         """The out-of-core train: chunked ingestion + streaming two-pass
-        fit + in-core tail (see workflow/streaming.py)."""
+        fit + in-core tail (see workflow/streaming.py).
+
+        RawFeatureFilter composes: its distribution pass runs CHUNKED
+        over the train reader (and the scoring reader, when given) as a
+        mergeable-monoid profile (filters/raw_feature_filter.py
+        ``filter_streaming``) before the fit passes — drop decisions are
+        identical to the in-core pass, dropped features never parse
+        again, and dropped map keys are cleaned per chunk.
+
+        Workflow-level CV composes: during-DAG estimators accumulate
+        fold-tagged mergeable states (one per fold, assigned per global
+        row id) and the fold validation runs on merged complement states
+        between prefix and tail (workflow/streaming_cv.py) — every
+        during-DAG estimator must support streaming fit.
+        """
+        import os as _os
+
         from ..utils.profiling import OpStep, PlanProfiler, with_job_group
         from .streaming import fit_dag_streaming
 
         if self.reader is None:
             raise RuntimeError("no reader set — call set_reader/set_input_data")
+
+        rcfg = getattr(self.reader, "resilience", None)
+        sink = (rcfg.sink() if (rcfg is not None and rcfg.quarantines)
+                else None)
+        q0 = (sink.count, sink.rows) if sink is not None else (0, 0)
+
+        # -- RawFeatureFilter: chunked distribution pass + per-chunk clean
+        filter_results = None
+        rff_stats = None
+        chunk_filter = None
         if self._raw_feature_filter is not None:
-            raise ValueError(
-                "chunk_rows is not supported with RawFeatureFilter yet — "
-                "its distribution pass needs a dedicated streaming profile")
-        if self._workflow_cv:
-            raise ValueError(
-                "chunk_rows is not supported with workflow-level CV — the "
-                "fold refit loop needs the materialized feature matrix")
+            with with_job_group(OpStep.DataReadingAndFiltering):
+                filter_results, rff_stats = (
+                    self._raw_feature_filter.filter_streaming(
+                        self.reader, self.raw_features(), chunk_rows))
+            self._apply_blocklist(filter_results.dropped_features)
+            chunk_filter = self._rff_chunk_filter(filter_results)
+
         dag = compute_dag(self.result_features)
         self._validate_stages(dag)
         lint_snap = self._lint_dag(dag) if validate else None
         self._inject_params(dag)
+
+        cv_ctx = self._streaming_cv_context(dag)
+        fingerprint_extra = (cv_ctx.fingerprint()
+                             if cv_ctx is not None else None)
+
+        # chunked trains checkpoint at TWO granularities under one
+        # directory: the streaming manager owns the prefix passes, and
+        # every ModelSelector in the (in-core) tail gets a mid-sweep
+        # cursor under <dir>/sweep — a SIGKILL anywhere resumes at the
+        # finest durable point
+        sel_prev = []
+        if checkpoint_dir is not None:
+            from ..selector.model_selector import ModelSelector
+
+            for s in dag.all_stages():
+                if (isinstance(s, ModelSelector)
+                        and s.sweep_checkpoint_dir is None):
+                    sel_prev.append((s, s.sweep_checkpoint_dir))
+                    s.sweep_checkpoint_dir = _os.path.join(
+                        checkpoint_dir, "sweep")
         meshed_stages = []
         shard_cols = None
         if self.mesh is not None:
@@ -445,18 +491,30 @@ class OpWorkflow(_WorkflowCore):
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_every=checkpoint_every,
                     retain_mb=retain_mb, shard_onto=self.mesh,
-                    shard_columns=shard_cols)
+                    shard_columns=shard_cols,
+                    fingerprint_extra=fingerprint_extra,
+                    cv_ctx=cv_ctx, chunk_filter=chunk_filter)
         finally:
             for s, prev in meshed_stages:
                 s.with_mesh(prev)
+            for s, prev in sel_prev:
+                s.sweep_checkpoint_dir = prev
         model = OpWorkflowModel(
             result_features=self.result_features,
             stages=fitted,
             train_data=transformed,
         )
         model.reader = self.reader
+        model.raw_feature_filter_results = filter_results
         model.train_profile = profiler if profile else None
         model.ingest_profile = ingest
+        ingest.rff = rff_stats
+        if sink is not None:
+            # totals over EVERY pass of this train, the RFF distribution
+            # pass included — the sidecar dedupes on (source, location),
+            # so a row hit by all three passes still counts once
+            ingest.quarantined_records = sink.count - q0[0]
+            ingest.quarantined_rows = sink.rows - q0[1]
         model.fit_states = fit_states
         model.lint_snapshot = lint_snap
         profiler.lint = lint_snap
@@ -465,6 +523,43 @@ class OpWorkflow(_WorkflowCore):
         from ..tuning.costmodel import record_train_observations
         record_train_observations(profiler)
         return model
+
+    def _rff_chunk_filter(self, filter_results):
+        """Per-chunk cleaner applying the filter's already-made drop
+        decisions (map-key removal; dropped features never parse again
+        because the blocklist pruned them out of the raw feature set)."""
+        if not filter_results.dropped_map_keys:
+            return None
+        rff = self._raw_feature_filter
+        dropped = list(filter_results.dropped_features)
+        keys = dict(filter_results.dropped_map_keys)
+        return lambda ds: rff.clean_chunk(ds, dropped, keys)
+
+    def _streaming_cv_context(self, dag: StagesDAG):
+        """The fold-tagged CV context for a chunked train/refresh, or
+        None when workflow CV is off (or the DAG has no CV cut).  Raises
+        a precise error naming the offending stage when a during-DAG
+        estimator cannot stream — the one genuinely unsupported
+        combination left."""
+        if not self._workflow_cv:
+            return None
+        from .streaming_cv import StreamingCVContext
+
+        cut = cut_dag_cv(dag)
+        if cut.selector is None or not cut.during.layers:
+            return None
+        for s in cut.during.all_stages():
+            if (isinstance(s, Estimator) and s.uid not in self._model_stages
+                    and not s.supports_streaming_fit):
+                raise ValueError(
+                    f"chunk_rows with workflow-level CV requires every "
+                    f"fold-refit (during-DAG) estimator to support "
+                    f"streaming fit; stage {s.uid} "
+                    f"({type(s).__name__}) does not — fit it in-core or "
+                    f"make its state a mergeable monoid "
+                    f"(stages/base.py streaming-fit protocol)")
+        return StreamingCVContext(cut.selector, cut.during,
+                                  dict(self._model_stages))
 
     def refresh(self, model: "OpWorkflowModel", data=None,
                 chunk_rows: int = 512, prefetch_chunks: int = 2,
@@ -508,15 +603,36 @@ class OpWorkflow(_WorkflowCore):
         if self.reader is None:
             raise RuntimeError(
                 "no refresh data — pass data= or set a reader")
-        if self._raw_feature_filter is not None or self._workflow_cv:
-            raise ValueError(
-                "refresh is not supported with RawFeatureFilter or "
-                "workflow-level CV (the same limits as chunked training)")
+        # RawFeatureFilter composes by REUSING the base model's recorded
+        # drop decisions (re-profiling mid-refresh could change the DAG
+        # geometry under the warm-started states — never silently);
+        # workflow CV composes via the same fold-tagged context as a
+        # chunked train (the re-selection runs on the refresh window).
+        filter_results = None
+        chunk_filter = None
+        if self._raw_feature_filter is not None:
+            filter_results = getattr(model, "raw_feature_filter_results",
+                                     None)
+            if filter_results is None:
+                raise ValueError(
+                    "refresh with RawFeatureFilter requires the base "
+                    "model's recorded filter results "
+                    "(model.raw_feature_filter_results — train with the "
+                    "filter first); re-profiling inside a refresh would "
+                    "change the feature geometry under the warm-started "
+                    "states")
+            self._apply_blocklist(filter_results.dropped_features)
+            chunk_filter = self._rff_chunk_filter(filter_results)
         dag = compute_dag(self.result_features)
         self._validate_stages(dag)
         lint_snap = self._lint_dag(dag)
         self._inject_params(dag)
+        cv_ctx = self._streaming_cv_context(dag)
         ctx = RefreshContext(model, dag)
+        fingerprint_extra = ctx.base_digest()
+        if cv_ctx is not None:
+            fingerprint_extra = {**fingerprint_extra,
+                                 **cv_ctx.fingerprint()}
         profiler = PlanProfiler()
         root = begin_span("workflow.refresh", cat="workflow",
                           chunk_rows=chunk_rows)
@@ -529,7 +645,8 @@ class OpWorkflow(_WorkflowCore):
                     profiler=profiler, prefetch=prefetch_chunks,
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_every=checkpoint_every_chunks,
-                    refresh_ctx=ctx, fingerprint_extra=ctx.base_digest())
+                    refresh_ctx=ctx, fingerprint_extra=fingerprint_extra,
+                    cv_ctx=cv_ctx, chunk_filter=chunk_filter)
         finally:
             end_span(root)
         refreshed = OpWorkflowModel(
@@ -538,6 +655,7 @@ class OpWorkflow(_WorkflowCore):
             train_data=transformed,
         )
         refreshed.reader = self.reader
+        refreshed.raw_feature_filter_results = filter_results
         refreshed.train_profile = profiler if profile else None
         refreshed.ingest_profile = ingest
         refreshed.fit_states = fit_states
