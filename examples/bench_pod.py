@@ -1,0 +1,479 @@
+#!/usr/bin/env python
+"""Pod-runtime bench/smoke — multi-process trains on ONE host.
+
+Four legs, all driven through ``distributed.launch_local_pod`` (each
+child boots ``jax.distributed`` on CPU with 2 forced host devices):
+
+1. **single** — the reference: a POD OF ONE (same pass structure as the
+   multi-process legs), recording winner / per-fold CV metrics / the
+   post-ingest RSS delta probe.
+2. **pod** — the same chunked workflow-CV + RawFeatureFilter train on a
+   2-process pod: host-sharded ingest (each process parses only its row
+   range), distribution + fit-state merges, coordinator-only quarantine
+   sidecar, per-process flight dumps merged by the coordinator.
+   Gates: same winner, per-fold metrics within the streaming tolerance,
+   and EVERY host's ingest RSS delta < 0.75x the single-process delta.
+3. **faults** — the pod under an injected schedule: a transient
+   ``reader.chunk`` io_error (recovered by retry/backoff) plus a
+   ``device_loss`` aimed at PROCESS 1 ONLY (``process`` selector) inside
+   the CV sweep — the pod must complete without deadlocking a barrier,
+   with the loss counted in process 1's elastic counters.
+4. **kill/resume** — the elastic headline: a 2-process checkpointed
+   train SIGKILLed at a mid-pass checkpoint barrier, resumed by ONE
+   process (the checkpoint's per-host entries re-owned), which must
+   reproduce the uninterrupted 2-process run BIT-EXACTLY (winner, fold
+   metrics, final score vector) and count the repack.
+
+Run by ``scripts/tier1.sh`` as POD_SMOKE (``--smoke``: reduced shapes,
+writes /tmp).  Full mode writes ``benchmarks/pod_latest.json``.
+
+Usage:
+  python examples/bench_pod.py [--rows 120000]
+  python examples/bench_pod.py --smoke
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+CHUNK_ROWS = 2048
+WIDE = 24                      # numeric predictors (RSS probe needs width)
+#: big enough that the materialized buffers dominate the pod runtime's
+#: ~10MB fixed overhead in the per-host RSS delta (ratio ~0.69 measured)
+SMOKE_ROWS = 220_000
+SMOKE_RESUME_ROWS = 4_000
+RESUME_CHUNK = 256
+STREAM_TOL = 2e-2              # per-fold metric tolerance single-vs-pod
+RSS_RATIO_GATE = 0.75
+RSS_FLOOR_MB = 6.0             # below this the probe is all noise
+
+
+# ---------------------------------------------------------------------------
+# data + pipeline (shared by every child)
+# ---------------------------------------------------------------------------
+
+def make_pod_frame(rows, seed):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    cols = {}
+    logits = np.zeros(rows)
+    for i in range(WIDE):
+        x = rng.normal(0.0, 1.0, rows)
+        cols[f"x{i:02d}"] = x
+        logits += ((-1) ** i) * (1.2 / (i + 1)) * x
+    cat = rng.choice(["a", "b", "c"], rows, p=[0.5, 0.3, 0.2])
+    logits += (cat == "a") * 0.9
+    y = (rng.random(rows) < 1 / (1 + np.exp(-logits))).astype(float)
+    cols["cat"] = cat
+    cols["junk"] = np.where(rng.random(rows) < 0.999, np.nan, 1.0)
+    cols["label"] = y
+    return pd.DataFrame(cols)
+
+
+def write_csv_with_corruption(df, path):
+    """Two malformed rows (extra fields), one in each HALF of the file,
+    so each pod process quarantines one — the coordinator's sidecar must
+    still reconcile to exactly two entries."""
+    lines = df.to_csv(index=False).splitlines()
+    n = len(lines)
+    lines.insert(max(n // 4, 2), "BAD,ROW" + ",X" * (WIDE + 2))
+    lines.insert(max(3 * n // 4, 4), "BAD,ROW" + ",Y" * (WIDE + 2))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return 2
+
+
+def build_workflow(parallel=2):
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, grid)
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    label = FeatureBuilder.RealNN("label").as_response()
+    preds = [FeatureBuilder.Real(f"x{i:02d}").as_predictor()
+             for i in range(WIDE)]
+    preds.append(FeatureBuilder.PickList("cat").as_predictor())
+    preds.append(FeatureBuilder.Real("junk").as_predictor())
+    feats = transmogrify(preds)
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        label, feats).get_output()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, parallel=parallel,
+        models_and_parameters=[
+            (OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))])
+    prediction = selector.set_input(label, checked).get_output()
+    wf = (OpWorkflow().set_result_features(prediction)
+          .with_raw_feature_filter(min_fill_rate=0.05)
+          .with_workflow_cv())
+    return wf, selector
+
+
+def reader_for_csv(path, sidecar):
+    from transmogrifai_tpu.readers import CSVReader
+    from transmogrifai_tpu.readers.resilience import RetryPolicy
+
+    return CSVReader(path).with_resilience(
+        retry=RetryPolicy(max_attempts=4, base_delay_s=0.01, seed=1),
+        bad_records="quarantine", quarantine_path=sidecar)
+
+
+def probs_of(model, df):
+    from transmogrifai_tpu.types import feature_types as ft
+
+    scored = model.score(data=df)
+    name = next(n for n in scored.names()
+                if issubclass(scored[n].ftype, ft.Prediction))
+    return [float(d["probability_1"]) for d in scored[name].to_list()]
+
+
+# ---------------------------------------------------------------------------
+# child (runs INSIDE the pod; one per process)
+# ---------------------------------------------------------------------------
+
+def run_child(args) -> int:
+    from transmogrifai_tpu.distributed import current_pod
+
+    pod = current_pod()
+    import warnings
+
+    import numpy as np
+
+    trace_dir = os.environ.get("TMOG_POD_BENCH_TRACE_DIR")
+    tracer = None
+    if trace_dir:
+        from transmogrifai_tpu import obs
+
+        tracer = obs.start_trace(label=f"pod.p{pod.process_index}")
+    wf, sel = build_workflow(parallel=2)
+    reader = reader_for_csv(args.csv, args.sidecar)
+    t0 = time.perf_counter()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = wf.set_reader(reader).train(
+            chunk_rows=args.chunk_rows,
+            checkpoint_dir=args.ckdir or None,
+            checkpoint_every_chunks=4)
+    wall = time.perf_counter() - t0
+    summ = sel.metadata["model_selector_summary"]
+    ev = make_pod_frame(96, seed=1234)
+    out = {
+        "process": pod.process_index,
+        "processes": pod.process_count,
+        "winner": summ["bestModelParams"],
+        "cv": [round(r["metricValue"], 12)
+               for r in sel.metadata.get("workflow_cv_results", [])],
+        "elastic": sel.metadata.get("workflow_cv_elastic"),
+        "pod": model.ingest_profile.pod,
+        "resumed": bool(model.ingest_profile.resumed),
+        "quarantined": [model.ingest_profile.quarantined_records,
+                        model.ingest_profile.quarantined_rows],
+        "retries": model.ingest_profile.total_retries,
+        "probs": [round(p, 12) for p in probs_of(model, ev)],
+        "wall_s": round(wall, 2),
+    }
+    if tracer is not None:
+        from transmogrifai_tpu import obs
+        from transmogrifai_tpu.obs.flight import merge_flight_dumps
+
+        obs.stop_trace()
+        dump = os.path.join(trace_dir,
+                            f"flight.p{pod.process_index}.jsonl")
+        _dump_process_flight(tracer, dump)
+        pod.barrier("flight.dumped")
+        if pod.is_coordinator():
+            merged = merge_flight_dumps(
+                [os.path.join(trace_dir, f"flight.p{i}.jsonl")
+                 for i in range(pod.process_count)],
+                out_path=os.path.join(trace_dir, "flight.merged.jsonl"))
+            out["flightMergedEvents"] = len(merged)
+            out["flightProcesses"] = sorted(
+                {e.get("process") for e in merged})
+    print("POD_RESULT " + json.dumps(out), flush=True)
+    return 0
+
+
+def _dump_process_flight(tracer, path):
+    """Per-process flight dump: the path carries the process index, so
+    this is a PRIVATE artifact, not a shared one — only the MERGED
+    stream is coordinator-written (TM047's concern)."""
+    tracer.flight.dump_jsonl(path)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _parse_results(results):
+    out = []
+    for r in results:
+        rec = None
+        for line in r["stdout"].splitlines():
+            if line.startswith("POD_RESULT "):
+                rec = json.loads(line[len("POD_RESULT "):])
+        out.append(rec)
+    return out
+
+
+def _child_argv(csv, sidecar, ckdir, chunk_rows):
+    return [sys.executable, os.path.abspath(__file__), "--child",
+            "--csv", csv, "--sidecar", sidecar, "--ckdir", ckdir or "",
+            "--chunk-rows", str(chunk_rows)]
+
+
+def _launch(n, argv, extra_env=None, timeout=600, kill_grace_s=25):
+    from transmogrifai_tpu.distributed import launch_local_pod
+
+    base = dict(os.environ)
+    base["TMOG_COST_HISTORY"] = base.get("TMOG_COST_HISTORY", "")
+    base.pop("TMOG_FAULTS", None)
+    if extra_env:
+        base.update(extra_env)
+    return launch_local_pod(n, argv, local_devices=2, base_env=base,
+                            timeout=timeout, kill_grace_s=kill_grace_s)
+
+
+def _fail(gates, name, detail):
+    gates.append({"gate": name, "ok": False, "detail": detail})
+    print(f"GATE FAIL {name}: {detail}")
+
+
+def _ok(gates, name, detail=""):
+    gates.append({"gate": name, "ok": True, "detail": detail})
+    print(f"gate ok   {name}: {detail}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--csv", default="")
+    ap.add_argument("--sidecar", default="")
+    ap.add_argument("--ckdir", default="")
+    ap.add_argument("--chunk-rows", type=int, default=CHUNK_ROWS)
+    args = ap.parse_args()
+    if args.child:
+        return run_child(args)
+
+    rows = args.rows or SMOKE_ROWS
+    work = tempfile.mkdtemp(prefix="tmog_pod_bench_")
+    try:
+        return _run_legs(args, rows, work)
+    finally:
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def _run_legs(args, rows, work) -> int:
+    df = make_pod_frame(rows, seed=7)
+    csv = os.path.join(work, "train.csv")
+    n_bad = write_csv_with_corruption(df, csv)
+    small = make_pod_frame(SMOKE_RESUME_ROWS, seed=11)
+    csv_small = os.path.join(work, "small.csv")
+    small.to_csv(csv_small, index=False)
+    gates = []
+    report = {"rows": rows, "wide": WIDE, "chunkRows": CHUNK_ROWS,
+              "legs": {}}
+
+    # -- leg 1: single (pod of one) ----------------------------------------
+    r1 = _launch(1, _child_argv(csv, os.path.join(work, "q1.jsonl"), "",
+                                CHUNK_ROWS), timeout=900)
+    (single,) = _parse_results(r1)
+    if r1[0]["returncode"] != 0 or single is None:
+        _fail(gates, "single", r1[0]["stderr"][-1500:])
+        single = None
+    else:
+        report["legs"]["single"] = single
+        _ok(gates, "single",
+            f"wall {single['wall_s']}s rssDelta "
+            f"{single['pod']['rssIngestDeltaMb']}MB")
+
+    # -- leg 2: 2-process pod parity + RSS + quarantine + flight merge ------
+    trace_dir = os.path.join(work, "flight")
+    os.makedirs(trace_dir, exist_ok=True)
+    r2 = _launch(2, _child_argv(csv, os.path.join(work, "q2.jsonl"), "",
+                                CHUNK_ROWS),
+                 extra_env={"TMOG_POD_BENCH_TRACE_DIR": trace_dir},
+                 timeout=900)
+    pods = _parse_results(r2)
+    if any(r["returncode"] != 0 for r in r2) or any(
+            p is None for p in pods):
+        _fail(gates, "pod_train",
+              " | ".join(r["stderr"][-800:] for r in r2
+                         if r["returncode"]))
+        pods = None
+    else:
+        report["legs"]["pod"] = pods
+        _ok(gates, "pod_train",
+            f"walls {[p['wall_s'] for p in pods]}s")
+    if single and pods:
+        if pods[0]["winner"] != single["winner"]:
+            _fail(gates, "parity_winner",
+                  f"{pods[0]['winner']} != {single['winner']}")
+        else:
+            _ok(gates, "parity_winner", str(single["winner"]))
+        import numpy as np
+
+        dv = float(np.max(np.abs(np.asarray(pods[0]["cv"])
+                                 - np.asarray(single["cv"]))))
+        if dv > STREAM_TOL:
+            _fail(gates, "parity_cv", f"max fold-metric delta {dv}")
+        else:
+            _ok(gates, "parity_cv", f"max fold-metric delta {dv:.2e}")
+        if pods[0]["cv"] != pods[1]["cv"]:
+            _fail(gates, "pod_replicas_agree", "per-process CV differs")
+        else:
+            _ok(gates, "pod_replicas_agree", "")
+        d_single = single["pod"]["rssIngestDeltaMb"]
+        d_hosts = [p["pod"]["rssIngestDeltaMb"] for p in pods]
+        if d_single is None or d_single < RSS_FLOOR_MB:
+            _fail(gates, "rss_per_host",
+                  f"single ingest delta {d_single}MB below the "
+                  f"{RSS_FLOOR_MB}MB floor — shape too small to gate")
+        elif max(d_hosts) >= RSS_RATIO_GATE * d_single:
+            _fail(gates, "rss_per_host",
+                  f"per-host {d_hosts}MB vs single {d_single}MB "
+                  f"(gate {RSS_RATIO_GATE}x)")
+        else:
+            _ok(gates, "rss_per_host",
+                f"per-host {d_hosts}MB vs single {d_single}MB "
+                f"(ratio {max(d_hosts) / d_single:.2f})")
+        sidecar = os.path.join(work, "q2.jsonl")
+        lines = (open(sidecar).read().splitlines()
+                 if os.path.exists(sidecar) else [])
+        if len(lines) != n_bad:
+            _fail(gates, "quarantine_sidecar",
+                  f"{len(lines)} entries, expected {n_bad}")
+        else:
+            _ok(gates, "quarantine_sidecar", f"{len(lines)} entries")
+        fp = pods[0].get("flightProcesses")
+        if fp != [0, 1]:
+            _fail(gates, "flight_merge", f"processes in merged dump: {fp}")
+        else:
+            _ok(gates, "flight_merge",
+                f"{pods[0]['flightMergedEvents']} events from {fp}")
+
+    # -- leg 3: fault schedule (retryable io_error + one-host device loss) --
+    faults = {"faults": [
+        # skip=2: the first two streams to reach chunk 2 are the
+        # host-shard counting pre-pass and the RFF profile pass — the
+        # third is a FIT pass, whose retry lands in the ingest profiler
+        {"point": "reader.chunk", "action": "io_error", "at": 2,
+         "times": 1, "skip": 2},
+        {"point": "device.loss", "action": "device_loss", "at": 0,
+         "times": 1, "process": 1},
+    ]}
+    r3 = _launch(2, _child_argv(csv_small,
+                                os.path.join(work, "q3.jsonl"), "",
+                                RESUME_CHUNK),
+                 extra_env={"TMOG_FAULTS": json.dumps(faults)},
+                 timeout=600)
+    f_res = _parse_results(r3)
+    if any(r["returncode"] != 0 for r in r3) or any(
+            p is None for p in f_res):
+        _fail(gates, "faults_complete",
+              " | ".join(r["stderr"][-800:] for r in r3
+                         if r["returncode"]))
+    else:
+        report["legs"]["faults"] = f_res
+        losses = [(p.get("elastic") or {}).get("deviceLosses", 0)
+                  for p in f_res]
+        retries = [p.get("retries", 0) for p in f_res]
+        if losses[1] < 1:
+            _fail(gates, "faults_device_loss_counted",
+                  f"process-1 elastic counters: {f_res[1].get('elastic')}")
+        else:
+            _ok(gates, "faults_device_loss_counted",
+                f"losses per process {losses}")
+        if max(retries) < 1:
+            _fail(gates, "faults_retry_counted", f"retries {retries}")
+        else:
+            _ok(gates, "faults_retry_counted", f"retries {retries}")
+        if f_res[0]["winner"] != f_res[1]["winner"]:
+            _fail(gates, "faults_winner_agrees",
+                  f"{f_res[0]['winner']} vs {f_res[1]['winner']}")
+        else:
+            _ok(gates, "faults_winner_agrees", str(f_res[0]["winner"]))
+
+    # -- leg 4: SIGKILL mid-pass -> cross-host-count resume -----------------
+    ck_ref = os.path.join(work, "ck_ref")
+    r_ref = _launch(2, _child_argv(csv_small,
+                                   os.path.join(work, "q4r.jsonl"),
+                                   ck_ref, RESUME_CHUNK), timeout=600)
+    ref = _parse_results(r_ref)
+    ck = os.path.join(work, "ck")
+    kill = {"faults": [{"point": "checkpoint.barrier", "action": "kill",
+                        "at": 2}]}
+    r_kill = _launch(2, _child_argv(csv_small,
+                                    os.path.join(work, "q4k.jsonl"),
+                                    ck, RESUME_CHUNK),
+                     extra_env={"TMOG_FAULTS": json.dumps(kill)},
+                     timeout=600, kill_grace_s=15)
+    killed_rcs = [r["returncode"] for r in r_kill]
+    r_res = _launch(1, _child_argv(csv_small,
+                                   os.path.join(work, "q4k.jsonl"),
+                                   ck, RESUME_CHUNK), timeout=600)
+    res = _parse_results(r_res)
+    if (any(r["returncode"] != 0 for r in r_ref) or ref[0] is None
+            or r_res[0]["returncode"] != 0 or res[0] is None):
+        _fail(gates, "resume_runs",
+              (r_ref[0]["stderr"][-600:] or "")
+              + (r_res[0]["stderr"][-900:] or ""))
+    elif 0 in killed_rcs:
+        _fail(gates, "resume_runs",
+              f"kill leg exited cleanly ({killed_rcs}) — fault missed")
+    else:
+        rec, ref0 = res[0], ref[0]
+        report["legs"]["resume"] = {"ref": ref0, "resumed": rec,
+                                    "killedRcs": killed_rcs}
+        bit = (rec["winner"] == ref0["winner"]
+               and rec["cv"] == ref0["cv"]
+               and rec["probs"] == ref0["probs"])
+        if not bit:
+            _fail(gates, "resume_bit_exact",
+                  f"winner {rec['winner']} vs {ref0['winner']}; "
+                  f"cv eq {rec['cv'] == ref0['cv']}; "
+                  f"probs eq {rec['probs'] == ref0['probs']}")
+        else:
+            _ok(gates, "resume_bit_exact",
+                "2-proc kill -> 1-proc resume reproduces the "
+                "uninterrupted run")
+        if not rec["resumed"] or not rec["pod"]["repacked"]:
+            _fail(gates, "resume_repack_counted",
+                  f"resumed={rec['resumed']} pod={rec['pod']}")
+        else:
+            _ok(gates, "resume_repack_counted",
+                f"savedProcessCount={rec['pod']['savedProcessCount']} "
+                f"-> {rec['pod']['processCount']}")
+
+    ok = all(g["ok"] for g in gates)
+    report["gates"] = gates
+    report["ok"] = ok
+    from transmogrifai_tpu import obs
+
+    report["meta"] = obs.bench_meta()
+    out_path = (os.path.join(tempfile.gettempdir(),
+                             "pod_smoke_latest.json") if args.smoke
+                else os.path.join(_ROOT, "benchmarks",
+                                  "pod_latest.json"))
+    from transmogrifai_tpu.utils.jsonio import write_json_atomic
+
+    write_json_atomic(out_path, report)
+    print(json.dumps({"ok": ok, "report": out_path}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

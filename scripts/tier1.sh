@@ -66,6 +66,22 @@ else
   echo "ELASTIC_SMOKE=FAILED (see /tmp/_t1_elastic.log)"
   rc=1
 fi
+# pod smoke: the multi-process pod runtime on one host — a 2-process
+# CPU pod (jax.distributed + gloo, 2 forced host devices each) runs the
+# chunked workflow-CV + RawFeatureFilter train with host-sharded
+# ingest: same winner + per-fold metrics as the single-process (pod of
+# one) reference, per-host ingest RSS delta < 0.75x single, the
+# quarantine sidecar written coordinator-only, per-process flight dumps
+# merged; a transient reader io_error + a device loss aimed at ONE
+# process must complete without deadlocking a barrier; and a SIGKILLed
+# 2-process checkpointed train must resume BIT-EXACTLY on 1 process
+# with the repack counted (cross-host-count elastic resume)
+if timeout -k 10 540 env JAX_PLATFORMS=cpu python examples/bench_pod.py --smoke > /tmp/_t1_pod.log 2>&1; then
+  echo "POD_SMOKE=ok $(grep -ao '"ok": true' /tmp/_t1_pod.log | tail -1)"
+else
+  echo "POD_SMOKE=FAILED (see /tmp/_t1_pod.log)"
+  rc=1
+fi
 # serving cold-start gate: two fresh subprocesses serve the same model
 # with device programs — the first JIT-compiles every shape bucket into
 # an empty AOT store, the second cold-starts by LOADING the serialized

@@ -22,6 +22,57 @@ def _lint(body: str):
 
 
 # ---------------------------------------------------------------------------
+# TM047 — unguarded durable writes on pod code paths
+# ---------------------------------------------------------------------------
+
+def test_tm047_unguarded_write_json_atomic_fires():
+    f = _lint(
+        "def emit(doc):\n"
+        "    pod = current_pod()\n"
+        "    write_json_atomic('benchmarks/pod_latest.json', doc)\n")
+    assert f.rules_fired() == ["TM047"]
+
+
+def test_tm047_unguarded_manager_save_fires():
+    f = _lint(
+        "def step(manager, ests, states, pod_ctx):\n"
+        "    manager.save_progress(0, 'fit', 3, 100, ests, states)\n")
+    assert "TM047" in f.rules_fired()
+
+
+def test_tm047_coordinator_branch_is_clean():
+    f = _lint(
+        "def emit(doc, pod):\n"
+        "    if pod.is_coordinator():\n"
+        "        write_json_atomic('benchmarks/pod_latest.json', doc)\n")
+    assert "TM047" not in f.rules_fired()
+
+
+def test_tm047_early_exit_guard_is_clean():
+    f = _lint(
+        "def emit(doc, pod):\n"
+        "    if pod.active and not pod.is_coordinator():\n"
+        "        return\n"
+        "    write_json_atomic('benchmarks/pod_latest.json', doc)\n")
+    assert "TM047" not in f.rules_fired()
+
+
+def test_tm047_process_index_guard_is_clean():
+    f = _lint(
+        "def emit(doc, pod):\n"
+        "    if pod.process_index == 0:\n"
+        "        write_json_atomic('benchmarks/pod_latest.json', doc)\n")
+    assert "TM047" not in f.rules_fired()
+
+
+def test_tm047_non_pod_function_is_clean():
+    f = _lint(
+        "def emit(doc):\n"
+        "    write_json_atomic('benchmarks/pod_latest.json', doc)\n")
+    assert "TM047" not in f.rules_fired()
+
+
+# ---------------------------------------------------------------------------
 # TM050 — non-atomic durable writes
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,507 @@
+"""distributed/ pod runtime tests.
+
+Fast, in-process: host-range math (uneven tails), the per-format
+``iter_chunks(host_range=...)`` window + ``estimate_rows`` exactness
+contract, the counting pre-pass fallback, the inert single-process
+collectives, and the streaming checkpoint's advisory-vs-logical
+fingerprint split (``pod.processCount`` never blocks a resume; a
+logical mismatch refuses with a key-level diff that names the advisory
+convention).
+
+Subprocess (real 2-process ``jax.distributed`` CPU pods): the pod
+bootstrap + collectives hello, and host-sharded ingest into a GLOBAL
+mesh via the process-local ``ShardedMatrixWriter`` path.  The heavier
+end-to-end legs — 2-process train parity, the fault schedule, and the
+cross-host-count SIGKILL resume — run as ``slow`` here and are gated in
+tier1 by ``POD_SMOKE`` (examples/bench_pod.py) instead.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.distributed import (HostShardedReader, count_rows,
+                                           host_ranges, plan_host_shard)
+from transmogrifai_tpu.distributed.hostshard import range_chunks
+from transmogrifai_tpu.distributed.runtime import (PodContext,
+                                                   launch_local_pod)
+from transmogrifai_tpu.readers import CSVReader, JSONLinesReader
+from transmogrifai_tpu.readers.base import (DataFrameReader, RecordsReader,
+                                            reader_for)
+from transmogrifai_tpu.readers.files import ParquetReader
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = os.path.join(_ROOT, "examples")
+
+
+def _features(n=1):
+    return [FeatureBuilder.Real(f"c{i}").as_predictor() for i in range(n)]
+
+
+def _frame(rows):
+    return pd.DataFrame({"c0": np.arange(float(rows))})
+
+
+def _rows_of(stream):
+    return np.concatenate([np.asarray(c["c0"].values) for c in stream])
+
+
+# ---------------------------------------------------------------------------
+# host ranges
+# ---------------------------------------------------------------------------
+
+class TestHostRanges:
+    def test_uneven_tail_spreads_over_first_hosts(self):
+        assert host_ranges(10, 3) == [(0, 4), (4, 7), (7, 10)]
+        assert host_ranges(11, 4) == [(0, 3), (3, 6), (6, 9), (9, 11)]
+
+    def test_even_split(self):
+        assert host_ranges(8, 2) == [(0, 4), (4, 8)]
+
+    def test_covers_every_row_once(self):
+        for n in (7, 64, 100, 101):
+            for p in (1, 2, 3, 5):
+                rngs = host_ranges(n, p)
+                assert rngs[0][0] == 0 and rngs[-1][1] == n
+                for (a, b), (c, d) in zip(rngs, rngs[1:]):
+                    assert b == c and b > a
+                assert sum(b - a for a, b in rngs) == n
+
+    def test_too_few_rows_refuses(self):
+        with pytest.raises(ValueError, match="shrink the pod"):
+            host_ranges(2, 3)
+
+    def test_range_chunks(self):
+        assert range_chunks((0, 10), 4) == 3
+        assert range_chunks((5, 5), 4) == 0
+        assert range_chunks((3, 7), 4) == 1
+
+
+# ---------------------------------------------------------------------------
+# host_range windows + estimate_rows, per reader format
+# ---------------------------------------------------------------------------
+
+class TestReaderWindows:
+    def _check_window(self, reader, total, chunk_rows=4, lo=3, hi=None):
+        hi = total - 2 if hi is None else hi
+        feats = _features()
+        full = _rows_of(reader.iter_chunks(feats, chunk_rows))
+        assert len(full) == total
+        got = _rows_of(reader.iter_chunks(feats, chunk_rows,
+                                          host_range=(lo, hi)))
+        np.testing.assert_array_equal(got, full[lo:hi])
+
+    def test_dataframe_reader(self):
+        r = DataFrameReader(_frame(17))
+        self._check_window(r, 17)
+        assert r.estimate_rows() == 17 and r.estimate_rows_exact()
+
+    def test_records_reader(self):
+        r = RecordsReader([{"c0": float(i)} for i in range(15)])
+        self._check_window(r, 15)
+        assert r.estimate_rows() == 15 and r.estimate_rows_exact()
+
+    def test_csv_reader(self, tmp_path):
+        p = str(tmp_path / "x.csv")
+        _frame(19).to_csv(p, index=False)
+        r = CSVReader(p)
+        self._check_window(r, 19)
+        # line count minus header: right here, but declared an ESTIMATE
+        assert r.estimate_rows() == 19
+        assert not r.estimate_rows_exact()
+
+    def test_jsonl_reader(self, tmp_path):
+        p = str(tmp_path / "x.jsonl")
+        with open(p, "w") as f:
+            for i in range(13):
+                f.write(json.dumps({"c0": float(i)}) + "\n")
+        r = JSONLinesReader(p)
+        self._check_window(r, 13)
+        assert r.estimate_rows() == 13
+        assert not r.estimate_rows_exact()
+
+    def test_parquet_reader(self, tmp_path):
+        p = str(tmp_path / "x.parquet")
+        _frame(21).to_parquet(p)
+        r = ParquetReader(p)
+        self._check_window(r, 21, chunk_rows=5)
+        # footer metadata: exact without decoding
+        assert r.estimate_rows() == 21 and r.estimate_rows_exact()
+
+    def test_avro_reader(self, tmp_path):
+        from transmogrifai_tpu.readers.avro import AvroReader, write_avro
+
+        p = str(tmp_path / "x.avro")
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "c0", "type": "double"}]}
+        write_avro(p, schema, [{"c0": float(i)} for i in range(23)],
+                   block_records=6)
+        r = AvroReader(p)
+        self._check_window(r, 23, chunk_rows=4)
+        # block headers carry record counts: exact, no payload decode
+        assert r.estimate_rows() == 23 and r.estimate_rows_exact()
+
+    def test_avro_estimate_inexact_under_quarantine(self, tmp_path):
+        from transmogrifai_tpu.readers.avro import AvroReader, write_avro
+
+        p = str(tmp_path / "x.avro")
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "c0", "type": "double"}]}
+        write_avro(p, schema, [{"c0": 1.0}] * 8)
+        r = AvroReader(p).with_resilience(
+            bad_records="quarantine",
+            quarantine_path=str(tmp_path / "q.jsonl"))
+        assert not r.estimate_rows_exact()
+
+    def test_schema_csv_reader(self, tmp_path):
+        from transmogrifai_tpu.readers.avro import AvroSchemaCSVReader
+
+        csv = str(tmp_path / "x.csv")
+        avsc = str(tmp_path / "x.avsc")
+        with open(csv, "w") as f:
+            for i in range(12):
+                f.write(f"{float(i)}\n")
+        with open(avsc, "w") as f:
+            json.dump({"type": "record", "name": "R",
+                       "fields": [{"name": "c0", "type": "double"}]}, f)
+        r = AvroSchemaCSVReader(csv, avsc)
+        self._check_window(r, 12)
+        assert r.estimate_rows() == 12
+        assert not r.estimate_rows_exact()
+
+    def test_empty_window_yields_nothing(self):
+        r = DataFrameReader(_frame(9))
+        chunks = list(r.iter_chunks(_features(), 4, host_range=(4, 4)))
+        assert chunks == []
+
+
+class TestShardPlan:
+    def test_exact_estimate_skips_counting(self, recwarn):
+        plan = plan_host_shard(DataFrameReader(_frame(10)), _features(),
+                               4, 2)
+        assert plan.total_rows == 10 and not plan.counted
+        assert plan.ranges == [(0, 5), (5, 10)]
+        assert not [w for w in recwarn.list
+                    if "counting pre-pass" in str(w.message)]
+
+    def test_inexact_estimate_counts_with_warning(self, tmp_path):
+        p = str(tmp_path / "x.csv")
+        _frame(10).to_csv(p, index=False)
+        with pytest.warns(UserWarning, match="counting pre-pass"):
+            plan = plan_host_shard(CSVReader(p), _features(), 4, 2)
+        assert plan.total_rows == 10 and plan.counted
+
+    def test_count_rows_matches_stream(self, tmp_path):
+        p = str(tmp_path / "x.csv")
+        _frame(33).to_csv(p, index=False)
+        assert count_rows(CSVReader(p), _features(), chunk_rows=7) == 33
+
+    def test_plan_chunk_math(self):
+        plan = plan_host_shard(DataFrameReader(_frame(10)), _features(),
+                               4, 3)
+        assert [plan.chunks_of(i) for i in range(3)] == [1, 1, 1]
+        assert plan.max_chunks() == 1
+
+
+class TestHostShardedReader:
+    def test_multi_range_chaining(self):
+        inner = DataFrameReader(_frame(20))
+        r = HostShardedReader(inner, [(0, 5), (15, 20)])
+        got = _rows_of(r.iter_chunks(_features(), 3))
+        np.testing.assert_array_equal(
+            got, np.concatenate([np.arange(5.0), np.arange(15.0, 20.0)]))
+        assert r.estimate_rows() == 10 and r.estimate_rows_exact()
+
+    def test_resilience_delegates_to_inner(self, tmp_path):
+        inner = CSVReader(str(tmp_path / "x.csv")).with_resilience(
+            bad_records="quarantine",
+            quarantine_path=str(tmp_path / "q.jsonl"))
+        r = HostShardedReader(inner, [(0, 1)])
+        assert r.resilience is inner.resilience
+        assert r.inner_reader is inner
+
+
+# ---------------------------------------------------------------------------
+# inert single-process collectives
+# ---------------------------------------------------------------------------
+
+class TestInertPod:
+    def test_collectives_degenerate(self):
+        pod = PodContext()
+        assert not pod.active and not pod.declared
+        assert pod.is_coordinator()
+        assert pod.allgather_obj({"x": 1}) == [{"x": 1}]
+        assert pod.broadcast_obj("v") == "v"
+        np.testing.assert_array_equal(
+            pod.allsum(np.array([1.0, 2.0])), [1.0, 2.0])
+        pod.barrier("noop")  # must not block
+
+    def test_declared_pod_of_one(self):
+        pod = PodContext(0, 1, initialized=True, declared=True)
+        assert pod.declared and not pod.active
+        assert pod.describe() == {"processCount": 1, "processIndex": 0}
+
+    def test_spans_tagged_with_global_attrs(self):
+        from transmogrifai_tpu.obs import trace
+
+        prev = dict(trace.global_attrs())
+        tracer = trace.start_trace(label="podtag")
+        try:
+            trace.set_global_attrs(process=3)
+            sp = trace.begin_span("x", cat="test")
+            trace.end_span(sp)
+            assert tracer.spans[-1].attrs["process"] == 3
+        finally:
+            trace.stop_trace()
+            trace._GLOBAL_ATTRS.clear()
+            trace._GLOBAL_ATTRS.update(prev)
+
+
+# ---------------------------------------------------------------------------
+# advisory-vs-logical streaming fingerprint
+# ---------------------------------------------------------------------------
+
+class TestAdvisoryFingerprint:
+    def _manager(self, d, chunk_rows, process_count):
+        from transmogrifai_tpu.workflow.checkpoint import (
+            StreamingCheckpointManager)
+
+        fp = {"chunkRows": chunk_rows, "reader": {"class": "CSVReader"},
+              "advisory": {"pod": {"processCount": process_count}}}
+        return StreamingCheckpointManager(d, fp)
+
+    def _seed(self, d):
+        m = self._manager(d, 48, 2)
+        m.pod_record = {"ranges": [[0, 50], [50, 100]], "processCount": 2}
+        m.complete_pass(0, "fit", 100, {})
+        return m
+
+    def test_process_count_change_resumes(self, tmp_path):
+        d = str(tmp_path)
+        self._seed(d)
+        m2 = self._manager(d, 48, 1)   # advisory changed ONLY
+        resume = m2.load()
+        assert resume is not None
+        assert resume.pod["processCount"] == 2
+        assert resume.pod["ranges"] == [[0, 50], [50, 100]]
+
+    def test_logical_mismatch_refuses_naming_advisory(self, tmp_path):
+        from transmogrifai_tpu.workflow.checkpoint import (
+            CheckpointMismatchError)
+
+        d = str(tmp_path)
+        self._seed(d)
+        m2 = self._manager(d, 64, 1)   # chunk geometry changed: LOGICAL
+        with pytest.raises(CheckpointMismatchError) as err:
+            m2.load()
+        msg = str(err.value)
+        assert "chunkRows" in msg                 # the key-level diff
+        assert "pod.processCount" in msg          # named as advisory
+        assert "host-count change alone would have resumed" in msg
+
+    def test_plain_resume_of_pod_checkpoint_refuses(self, tmp_path):
+        """A pod checkpoint resumed WITHOUT the pod runtime must refuse
+        with a pointer at `tmog pod` instead of silently single-running
+        a different chunk-fold structure."""
+        from transmogrifai_tpu import OpWorkflow
+        from transmogrifai_tpu.workflow.checkpoint import (
+            CheckpointMismatchError, StreamingCheckpointManager,
+            compute_fingerprint)
+
+        d = str(tmp_path / "ck")
+        df = pd.DataFrame({"c0": np.arange(40.0),
+                           "label": (np.arange(40) % 2).astype(float)})
+        from transmogrifai_tpu import transmogrify
+        from transmogrifai_tpu.models import OpNaiveBayes
+        from transmogrifai_tpu.utils.uid import reset_uids
+
+        reset_uids()
+        label = FeatureBuilder.RealNN("label").as_response()
+        feats = transmogrify([FeatureBuilder.Real("c0").as_predictor()])
+        pred = OpNaiveBayes().set_input(label, feats).get_output()
+        wf = OpWorkflow().set_result_features(pred).set_input_data(df)
+        from transmogrifai_tpu.workflow.dag import compute_dag
+
+        dag = compute_dag([pred])
+        layers = [l for l in dag.non_generator_layers() if l]
+        fp = compute_fingerprint(wf.reader, wf.raw_features(), layers, 8)
+        m = StreamingCheckpointManager(d, fp)
+        m.pod_record = {"ranges": [[0, 20], [20, 40]], "processCount": 2}
+        m.complete_pass(0, "fit", 40, {})
+        with pytest.raises(CheckpointMismatchError, match="pod runtime"):
+            wf.train(chunk_rows=8, checkpoint_dir=d)
+
+
+# ---------------------------------------------------------------------------
+# real 2-process pods (subprocess; the heavier e2e legs are `slow` —
+# tier1 gates them through POD_SMOKE / examples/bench_pod.py)
+# ---------------------------------------------------------------------------
+
+def _launch(n, argv, extra_env=None, timeout=240, kill_grace_s=20):
+    base = dict(os.environ)
+    base["TMOG_COST_HISTORY"] = ""
+    base.pop("TMOG_FAULTS", None)
+    if extra_env:
+        base.update(extra_env)
+    return launch_local_pod(n, argv, local_devices=2, base_env=base,
+                            timeout=timeout, kill_grace_s=kill_grace_s)
+
+
+class TestPodSubprocess:
+    def test_pod_hello_collectives(self):
+        res = _launch(2, [sys.executable,
+                          os.path.join(_EXAMPLES, "launch_pod.py"),
+                          "--child"])
+        assert [r["returncode"] for r in res] == [0, 0], (
+            res[0]["stderr"][-800:] + res[1]["stderr"][-800:])
+        recs = [json.loads(r["stdout"].strip().splitlines()[-1])
+                for r in res]
+        for i, rec in enumerate(recs):
+            assert rec["process"] == i
+            assert rec["processes"] == 2
+            assert rec["localDevices"] == 2
+            assert rec["globalDevices"] == 4
+            assert rec["peers"] == [0, 1]
+            assert rec["podSum"] == 12.0   # 4*(1) + 4*(2)
+
+    def test_global_mesh_process_local_writer(self):
+        """Host-sharded ingest into a GLOBAL mesh: each process appends
+        ONLY its host range into its addressable shards; the stitched
+        global array reduces to the right total across the pod."""
+        child = (
+            "import json, os, sys\n"
+            f"sys.path.insert(0, {_ROOT!r})\n"
+            "from transmogrifai_tpu.distributed import init_pod_from_env\n"
+            "pod = init_pod_from_env()\n"
+            "import jax, numpy as np, jax.numpy as jnp\n"
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+            "from transmogrifai_tpu.parallel.mesh import global_mesh\n"
+            "from transmogrifai_tpu.parallel.ingest import "
+            "ShardedMatrixWriter\n"
+            "mesh = global_mesh()\n"
+            "rows, cols = 37, 3\n"
+            "w = ShardedMatrixWriter(mesh, rows, cols)\n"
+            "assert w.process_local == pod.active\n"
+            "lo, hi = w.span[0], min(w.span[1], rows)\n"
+            "data = (np.arange(rows * cols, dtype=np.float32)"
+            ".reshape(rows, cols))\n"
+            "for s in range(lo, hi, 5):\n"
+            "    w.append(data[s:min(s + 5, hi)])\n"
+            "x = w.finish()\n"
+            "tot = float(jax.jit(jnp.sum, out_shardings="
+            "NamedSharding(mesh, P()))(x))\n"
+            "print(json.dumps({'proc': pod.process_index, 'tot': tot,\n"
+            "                  'span': list(w.span),\n"
+            "                  'local_rows': w.local_rows}), flush=True)\n"
+        )
+        res = _launch(2, [sys.executable, "-c", child])
+        assert [r["returncode"] for r in res] == [0, 0], (
+            res[0]["stderr"][-1200:] + res[1]["stderr"][-1200:])
+        expected = float(np.arange(37 * 3, dtype=np.float32).sum())
+        spans = []
+        for r in res:
+            rec = json.loads(r["stdout"].strip().splitlines()[-1])
+            assert rec["tot"] == expected
+            spans.append(tuple(rec["span"]))
+        # the two processes' spans tile the padded row space
+        assert spans[0][1] == spans[1][0]
+        assert spans[0][0] == 0
+
+
+def _run_bench_child(csv, sidecar, ckdir, chunk_rows, n, extra_env=None,
+                     timeout=420, kill_grace_s=20):
+    argv = [sys.executable, os.path.join(_EXAMPLES, "bench_pod.py"),
+            "--child", "--csv", csv, "--sidecar", sidecar,
+            "--ckdir", ckdir, "--chunk-rows", str(chunk_rows)]
+    return _launch(n, argv, extra_env=extra_env, timeout=timeout,
+                   kill_grace_s=kill_grace_s)
+
+
+def _parse_pod_result(stdout):
+    for line in stdout.splitlines():
+        if line.startswith("POD_RESULT "):
+            return json.loads(line[len("POD_RESULT "):])
+    return None
+
+
+@pytest.fixture(scope="module")
+def small_csv(tmp_path_factory):
+    sys.path.insert(0, _EXAMPLES)
+    import bench_pod
+
+    d = tmp_path_factory.mktemp("podcsv")
+    df = bench_pod.make_pod_frame(2400, seed=5)
+    p = str(d / "small.csv")
+    df.to_csv(p, index=False)
+    return p
+
+
+@pytest.mark.slow
+class TestPodTrainE2E:
+    """The in-pytest variants of the POD_SMOKE legs (smaller shapes)."""
+
+    def test_parity_and_replica_agreement(self, small_csv, tmp_path):
+        r1 = _run_bench_child(small_csv, str(tmp_path / "q1.jsonl"),
+                              "", 256, n=1)
+        assert r1[0]["returncode"] == 0, r1[0]["stderr"][-1500:]
+        single = _parse_pod_result(r1[0]["stdout"])
+        r2 = _run_bench_child(small_csv, str(tmp_path / "q2.jsonl"),
+                              "", 256, n=2)
+        assert [r["returncode"] for r in r2] == [0, 0], (
+            r2[0]["stderr"][-1200:] + r2[1]["stderr"][-1200:])
+        pods = [_parse_pod_result(r["stdout"]) for r in r2]
+        assert pods[0]["winner"] == single["winner"]
+        assert pods[0]["cv"] == pods[1]["cv"]
+        dv = np.max(np.abs(np.asarray(pods[0]["cv"])
+                           - np.asarray(single["cv"])))
+        assert dv <= 2e-2
+        assert pods[0]["pod"]["localRows"] == 1200
+
+    def test_sigkill_cross_host_count_resume_bit_exact(self, small_csv,
+                                                       tmp_path):
+        ck_ref = str(tmp_path / "ck_ref")
+        r_ref = _run_bench_child(small_csv, str(tmp_path / "qr.jsonl"),
+                                 ck_ref, 256, n=2)
+        assert [r["returncode"] for r in r_ref] == [0, 0]
+        ref = _parse_pod_result(r_ref[0]["stdout"])
+        ck = str(tmp_path / "ck")
+        kill = json.dumps({"faults": [{"point": "checkpoint.barrier",
+                                       "action": "kill", "at": 1}]})
+        r_kill = _run_bench_child(small_csv, str(tmp_path / "qk.jsonl"),
+                                  ck, 256, n=2,
+                                  extra_env={"TMOG_FAULTS": kill},
+                                  kill_grace_s=15)
+        assert 0 not in [r["returncode"] for r in r_kill]
+        r_res = _run_bench_child(small_csv, str(tmp_path / "qk.jsonl"),
+                                 ck, 256, n=1)
+        assert r_res[0]["returncode"] == 0, r_res[0]["stderr"][-2000:]
+        rec = _parse_pod_result(r_res[0]["stdout"])
+        assert rec["resumed"]
+        assert rec["pod"]["repacked"]
+        assert rec["pod"]["savedProcessCount"] == 2
+        assert rec["winner"] == ref["winner"]
+        assert rec["cv"] == ref["cv"]
+        assert rec["probs"] == ref["probs"]
+
+    def test_one_host_device_loss_does_not_deadlock(self, small_csv,
+                                                    tmp_path):
+        faults = json.dumps({"faults": [
+            {"point": "device.loss", "action": "device_loss", "at": 0,
+             "times": 1, "process": 1}]})
+        res = _run_bench_child(small_csv, str(tmp_path / "qf.jsonl"),
+                               "", 256, n=2,
+                               extra_env={"TMOG_FAULTS": faults})
+        assert [r["returncode"] for r in res] == [0, 0], (
+            res[0]["stderr"][-1200:] + res[1]["stderr"][-1200:])
+        recs = [_parse_pod_result(r["stdout"]) for r in res]
+        losses = [(p.get("elastic") or {}).get("deviceLosses", 0)
+                  for p in recs]
+        assert losses[0] == 0 and losses[1] >= 1
+        assert recs[0]["winner"] == recs[1]["winner"]

@@ -299,6 +299,12 @@ def _tm052():
         "        pool.submit(one, i)\n")
 
 
+def _tm047():
+    return _concur(
+        "def emit(doc, pod):\n"
+        "    write_json_atomic('benchmarks/pod_latest.json', doc)\n")
+
+
 def _tm053():
     return _concur(
         "class Pair:\n"
@@ -321,7 +327,7 @@ FIXTURES = {
     "TM028": _tm028, "TM029": _tm029,
     "TM030": _tm030, "TM031": _tm031, "TM032": _tm032,
     "TM040": _tm040, "TM041": _tm041, "TM042": _tm042, "TM043": _tm043,
-    "TM044": _tm044, "TM045": _tm045, "TM046": _tm046,
+    "TM044": _tm044, "TM045": _tm045, "TM046": _tm046, "TM047": _tm047,
     "TM050": _tm050, "TM051": _tm051, "TM052": _tm052, "TM053": _tm053,
 }
 

@@ -9,6 +9,20 @@ Spark jobs over row RDDs.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("TMOG_POD_NUM_PROCESSES"):
+    # pod child processes (launched via `tmog pod` / launch_local_pod)
+    # must boot jax.distributed BEFORE any jax computation — which the
+    # imports below can trigger — so the bootstrap runs first.  A pod
+    # of ONE is still a declared pod (it runs the pod train protocol,
+    # minus the distributed runtime).  distributed/runtime deliberately
+    # imports nothing jax-adjacent at module level, and
+    # distributed/__init__ resolves lazily.
+    from .distributed.runtime import init_pod_from_env as _init_pod
+
+    _init_pod()
+
 from .features import Feature, FeatureBuilder  # noqa: F401
 from .ops.transmogrify import transmogrify  # noqa: F401
 from .workflow.workflow import OpWorkflow, OpWorkflowModel  # noqa: F401

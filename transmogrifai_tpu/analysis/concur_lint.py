@@ -30,6 +30,20 @@ hold real locks on real threads.  These rules pin those conventions:
   another) — the classic deadlock.  Lock identity is the enclosing
   class + attribute (``ModelRegistry._lock``) so the serving registry /
   admission queue pair is tracked across files.
+* **TM047 — unguarded durable write on a pod code path.**  The pod
+  runtime's convention (distributed/podstream.py) is that durable
+  artifacts — checkpoints, ``benchmarks/*.json``, cost-history appends,
+  quarantine sidecars — are written by the COORDINATOR only; N
+  processes writing the same file race and corrupt it.  In a POD-AWARE
+  function (one that calls ``current_pod()`` or takes a ``pod`` /
+  ``pod_ctx`` parameter), a durable-write call
+  (``write_json_atomic``, ``json.dump``, checkpoint-manager
+  ``save_progress*`` / ``complete_pass`` / ``record_unit`` /
+  ``save_rung_state``, ``dump_jsonl``) must be coordinator-guarded:
+  inside an ``if ...is_coordinator()`` / ``process_index == 0`` branch,
+  or after an early-exit guard (``if ... not ...is_coordinator():
+  return`` — or a pod-branch exit, so single-process fallthrough code
+  stays clean) earlier in the function.
 
 Suppression: ``# tmog: disable=TM050`` on the flagged line (any line of
 a multi-line statement, or the enclosing ``def`` line).  Entry points:
@@ -52,6 +66,15 @@ _CLEANUP_HINTS = {"unlink", "remove", "rmtree", "cleanup", "close"}
 _MUTATORS = {"append", "extend", "add", "update", "insert", "setdefault",
              "pop", "popitem", "clear", "remove", "discard", "put"}
 _DURABLE_PATH_HINTS = ("benchmarks", "checkpoint")
+#: TM047: durable-write call names / attribute calls; guard needles the
+#: coordinator test must mention
+_POD_PARAMS = {"pod", "pod_ctx", "pod_context"}
+_POD_DURABLE_NAMES = {"write_json_atomic"}
+_POD_DURABLE_ATTRS = {"save_progress", "save_progress_pod",
+                      "complete_pass", "record_unit", "save_rung_state",
+                      "dump_jsonl"}
+_POD_GUARD_NEEDLES = ("is_coordinator", "process_index", "coordinator",
+                      "pod")
 
 
 def _last(name: Optional[str]) -> Optional[str]:
@@ -103,6 +126,7 @@ class _ConcurLinter:
             self._check_atomic_writes(scope)
             self._check_tempfiles(scope)
             self._check_pool_closures(scope)
+            self._check_pod_writes(scope)
         self._check_lock_order(scope, class_name)
         for n in scope_walk(scope):
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -141,6 +165,72 @@ class _ConcurLinter:
                         f"non-atomic write to a durable artifact path "
                         f"({hay.strip()!r}): use write_json_atomic or "
                         f"tmp + os.replace", fn.lineno)
+
+    # -- TM047 ---------------------------------------------------------------
+
+    def _pod_aware(self, fn) -> bool:
+        """A function on a pod code path: takes a pod/pod_ctx parameter
+        or resolves the process-wide context itself."""
+        a = fn.args
+        params = {p.arg for p in (getattr(a, "posonlyargs", []) + a.args
+                                  + getattr(a, "kwonlyargs", []))}
+        if params & _POD_PARAMS:
+            return True
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and \
+                    _last(dotted(n.func)) == "current_pod":
+                return True
+        return False
+
+    @staticmethod
+    def _pod_guard_test(test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            name = None
+            if isinstance(sub, ast.Attribute):
+                name = sub.attr
+            elif isinstance(sub, ast.Name):
+                name = sub.id
+            if name and any(n in name.lower()
+                            for n in _POD_GUARD_NEEDLES):
+                return True
+        return False
+
+    def _check_pod_writes(self, fn) -> None:
+        if not self._pod_aware(fn):
+            return
+        guarded_ids = set()      # nodes inside a coordinator-tested If
+        exit_guard_lines = []    # early-exit guards: later lines are safe
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.If) or not self._pod_guard_test(
+                    n.test):
+                continue
+            for sub in ast.walk(n):
+                guarded_ids.add(id(sub))
+            if any(isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                                  ast.Break))
+                   for b in n.body for s in ast.walk(b)):
+                exit_guard_lines.append(n.lineno)
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = dotted(n.func) or ""
+            is_write = (_last(name) in _POD_DURABLE_NAMES
+                        or name == "json.dump"
+                        or (isinstance(n.func, ast.Attribute)
+                            and n.func.attr in _POD_DURABLE_ATTRS))
+            if not is_write:
+                continue
+            if id(n) in guarded_ids:
+                continue
+            if any(line < n.lineno for line in exit_guard_lines):
+                continue
+            self._emit(
+                "TM047", n,
+                f"durable write ({_last(name) or name}) on a pod-aware "
+                f"code path without a process_index == 0 / "
+                f"is_coordinator() guard: every pod process would race "
+                f"the same artifact — write on the coordinator only",
+                fn.lineno)
 
     # -- TM051 ---------------------------------------------------------------
 

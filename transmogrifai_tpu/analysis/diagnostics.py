@@ -79,6 +79,9 @@ RULES: Dict[str, Any] = {
     "TM046": (ERROR, "broad except around sweep-unit execution that does "
                      "not route through the shared device-loss classifier "
                      "(parallel.elastic)"),
+    "TM047": (ERROR, "durable write reachable from pod-context code "
+                     "without a process_index == 0 / is_coordinator() "
+                     "guard (every pod process would race the artifact)"),
     # -- concurrency / durability (analysis/concur_lint.py) -------------
     "TM050": (ERROR, "non-atomic JSON/benchmark write: bypasses "
                      "write_json_atomic / the tmp + os.replace pattern"),

@@ -64,6 +64,21 @@ def build_parser() -> argparse.ArgumentParser:
     trc.add_argument("--top", type=int, default=15,
                      help="how many top-duration spans to list")
 
+    pod = sub.add_parser(
+        "pod", help="run a command as an N-process local pod "
+                    "(jax.distributed bootstrap via TMOG_POD_* env; "
+                    "docs/distributed.md)")
+    pod.add_argument("-n", "--num-processes", type=int, default=2,
+                     help="pod size (default 2)")
+    pod.add_argument("--devices", type=int, default=2,
+                     help="forced host-platform devices per process "
+                          "(CPU pods; default 2)")
+    pod.add_argument("--timeout", type=float, default=600.0,
+                     help="seconds before the pod is torn down")
+    pod.add_argument("cmd", nargs=argparse.REMAINDER,
+                     help="command to run in every pod process "
+                          "(prefix with --)")
+
     srv = sub.add_parser(
         "serve", help="serve a persisted model (micro-batched scoring)")
     srv.add_argument("--model", required=True,
@@ -137,6 +152,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
+    if args.command == "pod":
+        from ..distributed.runtime import main_pod_cli
+
+        cmd = list(args.cmd)
+        if cmd[:1] == ["--"]:
+            cmd = cmd[1:]
+        if not cmd:
+            print("tmog pod: no command given (tmog pod -n 2 -- "
+                  "python train.py)", file=sys.stderr)
+            return 2
+        args.cmd = cmd
+        return main_pod_cli(args)
     if args.command == "gen":
         schema = ProblemSchema.from_file(
             args.name, args.input, args.response, args.id_field,

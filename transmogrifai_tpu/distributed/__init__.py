@@ -1,0 +1,44 @@
+"""Multi-process pod runtime — ROADMAP item 3's missing layer.
+
+``runtime`` boots ``jax.distributed`` (or stays inert in a single
+process), ``hostshard`` assigns each process its contiguous row range of
+every reader, and ``podstream`` runs the streaming two-pass fit as a
+cooperating pod: per-host partial states, allgather merges at pass
+boundaries, coordinator-only durable side effects, and cross-host-count
+elastic resume.  See docs/distributed.md.
+
+This package resolves its exports LAZILY: the pod bootstrap in the
+top-level ``__init__`` must import ``distributed.runtime`` before any
+jax computation, and ``hostshard`` pulls the reader stack — eager
+imports here would defeat the ordering.
+"""
+from typing import Any
+
+__all__ = [
+    "PodContext", "PodTimeoutError", "current_pod", "init_pod_from_env",
+    "launch_local_pod", "pick_free_port", "pod_env",
+    "HostShardedReader", "ShardPlan", "count_rows", "host_ranges",
+    "plan_host_shard", "PodStreamContext",
+]
+
+_RUNTIME = {"PodContext", "PodTimeoutError", "current_pod",
+            "init_pod_from_env", "launch_local_pod", "pick_free_port",
+            "pod_env"}
+_HOSTSHARD = {"HostShardedReader", "ShardPlan", "count_rows",
+              "host_ranges", "plan_host_shard"}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _RUNTIME:
+        from . import runtime
+
+        return getattr(runtime, name)
+    if name in _HOSTSHARD:
+        from . import hostshard
+
+        return getattr(hostshard, name)
+    if name == "PodStreamContext":
+        from .podstream import PodStreamContext
+
+        return PodStreamContext
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

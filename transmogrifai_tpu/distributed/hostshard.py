@@ -1,0 +1,243 @@
+"""Host-sharded ingest — each pod process streams ONLY its row range.
+
+The out-of-core driver (workflow/streaming.py) bounded peak host memory
+per chunk; a pod bounds it per HOST: the global row space [0, N) is
+split into one contiguous range per process, every reader serves a
+``host_range`` window of its chunk stream (readers/base.py
+``iter_chunks(host_range=...)``), and no process ever parses — let
+alone materializes — rows outside its range past the window filter.
+Combined with the process-local :class:`~transmogrifai_tpu.parallel.
+ingest.ShardedMatrixWriter` path, the packed (N, D) matrix exists only
+as per-host device shards: the 10M×500 regime stops being a single-host
+RAM problem.
+
+Range assignment is CONTIGUOUS (host h owns one block, longer blocks
+first when ``rows % hosts != 0``) so that a host's chunk sequence is
+byte-identical to the same rows' chunk sequence in a single-process run
+— the property the cross-host-count checkpoint resume leans on
+(distributed/podstream.py: per-host partial states merge in host order,
+so any process count reproduces any other bit-exactly).
+
+Row-count resolution: splitting needs the EXACT total row count before
+any pass.  ``Reader.estimate_rows`` answers instantly for in-memory
+readers and Avro (block headers carry record counts); formats whose
+estimate is a heuristic (CSV/JSONL line counts — quoted newlines,
+quarantined rows) fall back to a COUNTING PRE-PASS over the chunk
+stream, with a warning naming the reader (the satellite contract).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..readers.base import Reader
+
+__all__ = ["host_ranges", "range_chunks", "count_rows", "plan_host_shard",
+           "ShardPlan", "HostShardedReader"]
+
+
+def host_ranges(total_rows: int, process_count: int
+                ) -> List[Tuple[int, int]]:
+    """Contiguous [start, stop) row range per process.
+
+    The first ``total_rows % process_count`` hosts take one extra row —
+    the uneven tail is spread, never dumped on the last host (unit-tested
+    with rows % hosts != 0).
+    """
+    n, p = int(total_rows), int(process_count)
+    if p < 1:
+        raise ValueError(f"process_count must be >= 1, got {p}")
+    if n < p:
+        raise ValueError(
+            f"cannot shard {n} row(s) across {p} processes — every "
+            f"process needs at least one row (shrink the pod)")
+    base, rem = divmod(n, p)
+    out = []
+    start = 0
+    for h in range(p):
+        stop = start + base + (1 if h < rem else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+def range_chunks(rng: Tuple[int, int], chunk_rows: int) -> int:
+    """Nominal chunk count of a [start, stop) window at ``chunk_rows``.
+
+    The window rides the SOURCE's global chunk grid, so a misaligned
+    window can yield one more chunk than this (both edge chunks
+    partial).  Consumers use this only for checkpoint STEP PACING
+    (distributed/podstream.py), where the estimate is deterministic and
+    identical on every process, and any steps left unfired when a
+    stream ends early are drained at pass end — the exchange can never
+    deadlock on the off-by-one.  Durable cursors always record ACTUAL
+    delivered chunk counts.
+    """
+    n = rng[1] - rng[0]
+    return (n + chunk_rows - 1) // chunk_rows
+
+
+def count_rows(reader: Reader, raw_features, chunk_rows: int = 4096) -> int:
+    """The counting pre-pass: one full chunk iteration summing lengths.
+
+    Runs with the reader's resilience config live (retry + quarantine),
+    so the count matches exactly what later passes will yield — a
+    quarantined row is already absent here.
+    """
+    rcfg = getattr(reader, "resilience", None)
+    if rcfg is not None and rcfg.retry is not None:
+        from ..readers.resilience import RetryingChunkStream
+
+        stream = RetryingChunkStream(
+            lambda: reader.iter_chunks(raw_features, chunk_rows),
+            rcfg.retry)
+    else:
+        stream = reader.iter_chunks(raw_features, chunk_rows)
+    return sum(len(chunk) for chunk in stream)
+
+
+class ShardPlan:
+    """The pod's agreed view of one reader: exact total rows + the
+    per-process contiguous ranges.  Identical on every process (total
+    rows resolve deterministically), so no exchange is needed to agree.
+    """
+
+    def __init__(self, total_rows: int, ranges: List[Tuple[int, int]],
+                 chunk_rows: int, counted: bool):
+        self.total_rows = int(total_rows)
+        self.ranges = list(ranges)
+        self.chunk_rows = int(chunk_rows)
+        #: True when the total came from a counting pre-pass rather than
+        #: an exact reader estimate
+        self.counted = bool(counted)
+
+    def range_of(self, process_index: int) -> Tuple[int, int]:
+        return self.ranges[process_index]
+
+    def chunks_of(self, process_index: int) -> int:
+        return range_chunks(self.ranges[process_index], self.chunk_rows)
+
+    def max_chunks(self) -> int:
+        return max(range_chunks(r, self.chunk_rows) for r in self.ranges)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"totalRows": self.total_rows, "chunkRows": self.chunk_rows,
+                "ranges": [list(r) for r in self.ranges],
+                "counted": self.counted}
+
+
+def plan_host_shard(reader: Reader, raw_features, chunk_rows: int,
+                    process_count: int) -> ShardPlan:
+    """Resolve the exact row count and split it across the pod.
+
+    ``reader.estimate_rows()`` is trusted only when the reader declares
+    it exact (``estimate_rows_exact()``); otherwise the counting
+    pre-pass runs with a warning — a mis-sized range map would silently
+    drop or duplicate rows, which is never worth one saved pass.
+    """
+    rows: Optional[int] = None
+    counted = False
+    if reader.estimate_rows_exact():
+        rows = reader.estimate_rows()
+    if rows is None:
+        est = reader.estimate_rows()
+        warnings.warn(
+            f"{type(reader).__name__} cannot report an exact row count "
+            f"(estimate: {est}); host sharding is running a counting "
+            f"pre-pass over the chunk stream", stacklevel=2)
+        rows = count_rows(reader, raw_features, chunk_rows)
+        counted = True
+    return ShardPlan(rows, host_ranges(rows, process_count), chunk_rows,
+                     counted)
+
+
+class HostShardedReader(Reader):
+    """A reader restricted to row windows of an inner reader.
+
+    Normally holds ONE range (this process's shard); a cross-host-count
+    resume hands a process SEVERAL adopted ranges (the dead pod's
+    per-host entries), each streamed as its own self-aligned chunk
+    sequence — ``iter_chunks`` chains them in range order.
+
+    ``inner_reader`` is the LOGICAL identity: checkpoint fingerprints
+    describe the source reader, never the wrapper, so a checkpoint
+    written by a 2-process pod resumes under any other process count
+    (the pod record itself is advisory).
+    """
+
+    def __init__(self, inner: Reader, ranges: Sequence[Tuple[int, int]]):
+        self.inner_reader = inner
+        self.ranges = [tuple(map(int, r)) for r in ranges]
+        for start, stop in self.ranges:
+            if stop < start or start < 0:
+                raise ValueError(f"bad host range ({start}, {stop})")
+
+    @property
+    def resilience(self):
+        """The inner reader's resilience config (retry/quarantine) — the
+        streaming driver reads it off whatever reader it is handed."""
+        return getattr(self.inner_reader, "resilience", None)
+
+    def estimate_rows(self) -> Optional[int]:
+        return sum(stop - start for start, stop in self.ranges)
+
+    def estimate_rows_exact(self) -> bool:
+        return True
+
+    def generate_dataset(self, raw_features):
+        ds = self.inner_reader.generate_dataset(raw_features)
+        if len(self.ranges) == 1:
+            start, stop = self.ranges[0]
+            return ds.slice(start, min(stop, len(ds)))
+        raise NotImplementedError(
+            "multi-range HostShardedReader is chunk-stream only")
+
+    def iter_chunks(self, raw_features, chunk_rows: int,
+                    host_range: Optional[Tuple[int, int]] = None
+                    ) -> "_ChainedChunkStream":
+        if host_range is not None:
+            raise ValueError("HostShardedReader already carries its ranges")
+        return _ChainedChunkStream(self.inner_reader, raw_features,
+                                   chunk_rows, self.ranges)
+
+
+class _ChainedChunkStream:
+    """Chains one windowed chunk stream per range, LAZILY (a range's
+    stream — and its file handle — opens only when the previous range is
+    exhausted).  The inner streams are real ``ChunkStream``s and fire the
+    ``reader.chunk`` fault point themselves; this wrapper deliberately
+    does not re-fire it."""
+
+    def __init__(self, inner: Reader, raw_features, chunk_rows: int,
+                 ranges: Sequence[Tuple[int, int]]):
+        self._inner = inner
+        self._raw = raw_features
+        self._chunk_rows = chunk_rows
+        self._ranges = list(ranges)
+        self._pos = -1
+        self._cur = None
+        self._done_bytes = 0
+        self.bytes_read = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._cur is None:
+                self._pos += 1
+                if self._pos >= len(self._ranges):
+                    raise StopIteration
+                self._cur = self._inner.iter_chunks(
+                    self._raw, self._chunk_rows,
+                    host_range=self._ranges[self._pos])
+            try:
+                chunk = next(self._cur)
+            except StopIteration:
+                self._done_bytes += int(
+                    getattr(self._cur, "bytes_read", 0) or 0)
+                self._cur = None
+                continue
+            self.bytes_read = self._done_bytes + int(
+                getattr(self._cur, "bytes_read", 0) or 0)
+            return chunk

@@ -1,0 +1,480 @@
+"""Pod streaming-fit protocol — cooperating processes, one train.
+
+The streaming two-pass driver (workflow/streaming.py) already reduced a
+fit pass to MERGEABLE MONOID states plus a chunk cursor; this module is
+the observation that the same algebra distributes across processes for
+free:
+
+* each process streams ONLY its host range (distributed/hostshard.py)
+  and folds its own partial state per estimator;
+* at every pass boundary the partial states allgather (host order) and
+  merge — every process finishes the pass with the IDENTICAL merged
+  state, so the rest of the train (fold validation, selector sweep,
+  tail fit) replicates deterministically instead of diverging;
+* durable side effects (checkpoints, quarantine sidecars, bench JSON)
+  happen on the COORDINATOR only, fenced by a pod barrier so a kill
+  after the barrier implies the artifact is on disk (lint rule TM047
+  pins the convention statically).
+
+Cross-host-count elastic resume is the payoff: a checkpoint stores one
+record PER ORIGINAL HOST — its row range, chunk cursor, and partial
+states.  A resume under ANY process count adopts the original entries
+(round-robin), keeps each entry's accumulation separate, and merges in
+entry order at the pass boundary — producing bit-for-bit the states the
+uninterrupted original pod would have produced, with the process-count
+change counted as a ``mesh_repacks`` elastic event.  The pod identity
+itself (``pod.processCount``) rides in the fingerprint's ADVISORY
+section: never compared, exactly like PR 9's mesh record.
+"""
+from __future__ import annotations
+
+import resource
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .hostshard import (HostShardedReader, ShardPlan, plan_host_shard,
+                        range_chunks)
+from .runtime import PodContext
+
+__all__ = ["PodEntry", "PodStreamContext"]
+
+
+def _rss_now_mb() -> float:
+    """CURRENT resident set size (VmRSS), not the high-water mark —
+    import/compile transients push ``ru_maxrss`` far above steady state,
+    which would mask what ingest actually retains.  glibc arenas are
+    trimmed first (best effort) so freed chunk-parse transients stop
+    counting as resident.  Falls back to the high-water on non-/proc
+    platforms."""
+    try:
+        import ctypes
+
+        ctypes.CDLL("libc.so.6").malloc_trim(0)
+    except (OSError, AttributeError):  # pragma: no cover - non-glibc
+        pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except (OSError, ValueError, IndexError):  # pragma: no cover
+        pass
+    return resource.getrusage(  # pragma: no cover - /proc-less platform
+        resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+class PodEntry:
+    """One ORIGINAL host's share of the train, owned by this process.
+
+    Fresh pods own exactly their own range; a cross-host-count resume
+    hands a process several adopted entries (or none of some).  The
+    ``entry_id`` is the original host index — the global merge order.
+    """
+
+    def __init__(self, entry_id: int, rng: Tuple[int, int],
+                 skip_chunks: int = 0,
+                 initial: Optional[Dict[str, Any]] = None):
+        self.entry_id = int(entry_id)
+        self.range = (int(rng[0]), int(rng[1]))
+        #: chunks of this entry already consumed by the checkpointed run
+        self.skip_chunks = int(skip_chunks)
+        #: uid -> decoded exported state payload to resume from
+        self.initial = initial or {}
+
+    @property
+    def rows(self) -> int:
+        return self.range[1] - self.range[0]
+
+    def chunks(self, chunk_rows: int) -> int:
+        return range_chunks(self.range, chunk_rows)
+
+
+def _owner(entry_id: int, process_count: int) -> int:
+    """Deterministic adoption rule: original host h belongs to process
+    h % P' — every process derives every owner without an exchange."""
+    return entry_id % process_count
+
+
+class PodStreamContext:
+    """Everything ``fit_dag_streaming`` needs to run as one pod member."""
+
+    def __init__(self, pod: PodContext, reader, raw_features,
+                 chunk_rows: int, plan: Optional[ShardPlan] = None):
+        self.pod = pod
+        self.inner_reader = reader
+        self.raw_features = raw_features
+        self.chunk_rows = int(chunk_rows)
+        if plan is None:
+            plan = plan_host_shard(reader, raw_features, chunk_rows,
+                                   pod.process_count)
+        self.plan = plan
+        self.total_rows = plan.total_rows
+        #: ranges of the ORIGINAL pod this train continues (fresh: ours)
+        self.all_ranges: List[Tuple[int, int]] = list(plan.ranges)
+        self.saved_process_count: Optional[int] = None
+        self.repacked = False
+        self.entries: List[PodEntry] = [
+            PodEntry(h, rng) for h, rng in enumerate(self.all_ranges)
+            if _owner(h, pod.process_count) == pod.process_index]
+        #: pass index the in-flight resume cursor applies to (None = no
+        #: mid-pass resume)
+        self.resume_pass: Optional[int] = None
+        #: the streaming driver flips this after the global CV label sync
+        self.labels_synced = False
+        # first-touch the collective machinery (gloo init, the allgather
+        # jit programs, device buffers) BEFORE the RSS baseline probe:
+        # that cost is the pod RUNTIME's, not ingest's, and it would
+        # otherwise pollute the per-host ingest delta the POD_SMOKE
+        # memory gate measures
+        if pod.active:
+            pod.allgather_obj(b"\x00" * (1 << 20))
+            pod.barrier("warmup")
+        #: resident set size at context construction (train start) — the
+        #: baseline the ingest delta subtracts, so the POD_SMOKE memory
+        #: gate compares what INGEST retains, not the interpreter's floor
+        self._rss0_mb = round(_rss_now_mb(), 2)
+        self._rss_after_ingest_mb: Optional[float] = None
+
+    # -- resume adoption -----------------------------------------------------
+
+    def adopt_resume(self, resume, ests_by_uid=None) -> None:
+        """Re-own the checkpoint's original-host entries under the
+        CURRENT process count.  ``resume`` is the driver's ResumeState;
+        its manifest-level pod record carries the original ranges, and
+        the in-flight record (when present) carries per-entry cursors +
+        state payloads, decoded lazily by ``init_entry_states``."""
+        pod_rec = getattr(resume, "pod", None)
+        if not pod_rec:
+            return
+        ranges = [tuple(map(int, r)) for r in pod_rec["ranges"]]
+        saved_count = int(pod_rec.get("processCount", len(ranges)))
+        self.all_ranges = ranges
+        self.saved_process_count = saved_count
+        per_entry: Dict[int, Dict[str, Any]] = {}
+        cur = resume.current
+        if cur is not None and cur.get("pod_entries"):
+            self.resume_pass = int(cur["pass"])
+            for rec in cur["pod_entries"]:
+                per_entry[int(rec["entry"])] = rec
+        self.entries = []
+        for h, rng in enumerate(ranges):
+            if _owner(h, self.pod.process_count) != self.pod.process_index:
+                continue
+            rec = per_entry.get(h)
+            self.entries.append(PodEntry(
+                h, rng,
+                skip_chunks=int(rec["chunks_done"]) if rec else 0,
+                initial=dict(rec.get("states") or {}) if rec else {}))
+        if saved_count != self.pod.process_count and not self.repacked:
+            # the elastic event: same logical train, different host count
+            self.repacked = True
+            self.pod.repacks += 1
+            from ..utils.profiling import count_elastic
+
+            count_elastic("mesh_repacks")
+            from ..obs.flight import record_event
+
+            record_event("pod.repack", saved=saved_count,
+                         current=self.pod.process_count)
+
+    # -- reader + geometry ---------------------------------------------------
+
+    def local_reader(self) -> HostShardedReader:
+        return HostShardedReader(self.inner_reader,
+                                 [e.range for e in self.entries])
+
+    @property
+    def local_rows(self) -> int:
+        return sum(e.rows for e in self.entries)
+
+    def entry_row_counts(self) -> List[int]:
+        return [e.rows for e in self.entries]
+
+    def local_chunks(self) -> int:
+        return sum(e.chunks(self.chunk_rows) for e in self.entries)
+
+    def chunks_of_process(self, p: int) -> int:
+        return sum(range_chunks(rng, self.chunk_rows)
+                   for h, rng in enumerate(self.all_ranges)
+                   if _owner(h, self.pod.process_count) == p)
+
+    def fingerprint_advisory(self) -> Dict[str, Any]:
+        """The ADVISORY half (never compared on resume): host counts are
+        elastic by design, the pod analogue of the PR 9 mesh record."""
+        return {"pod": {"processCount": self.pod.process_count}}
+
+    def pod_record(self) -> Dict[str, Any]:
+        """Manifest record every checkpoint save carries: the ORIGINAL
+        ranges (stable across resumes — they define the chunk folds every
+        later pass must reproduce) plus the original host count."""
+        return {"ranges": [list(r) for r in self.all_ranges],
+                "processCount": (self.saved_process_count
+                                 if self.saved_process_count is not None
+                                 else self.pod.process_count)}
+
+    # -- per-entry states ----------------------------------------------------
+
+    def init_entry_states(self, ests, decode_payload=None,
+                          use_initial: bool = False
+                          ) -> List[Dict[str, Any]]:
+        """One {uid: state} dict per owned entry — fresh ``begin_fit``s,
+        or (on the resumed pass, ``use_initial=True``) states imported
+        from the checkpoint's per-entry payloads via
+        ``decode_payload(raw) -> payload``."""
+        out = []
+        for e in self.entries:
+            states: Dict[str, Any] = {}
+            for est in ests:
+                raw = e.initial.get(est.uid) if use_initial else None
+                if raw is not None and decode_payload is not None:
+                    states[est.uid] = est.import_fit_state(
+                        decode_payload(raw))
+                else:
+                    states[est.uid] = est.begin_fit()
+            out.append(states)
+        return out
+
+    def merge_pass_states(self, ests, entry_states: List[Dict[str, Any]]
+                          ) -> Dict[str, Any]:
+        """Allgather every entry's exported states and merge in ENTRY
+        ORDER — the deterministic global fold every process reproduces
+        identically.  Local states also round-trip export→import so the
+        fold is the same computation on every process (and on a resumed
+        one)."""
+        local = [(e.entry_id,
+                  {est.uid: est.export_fit_state(st[est.uid])
+                   for est in ests})
+                 for e, st in zip(self.entries, entry_states)]
+        gathered = self.pod.allgather_obj(local)
+        flat = sorted((rec for part in gathered for rec in part),
+                      key=lambda r: r[0])
+        ids = [rec[0] for rec in flat]
+        if ids != sorted(set(ids)) or len(ids) != len(self.all_ranges):
+            raise RuntimeError(
+                f"pod pass exchange is missing entries: got {ids}, "
+                f"expected one of each of 0..{len(self.all_ranges) - 1}")
+        merged: Dict[str, Any] = {}
+        for est in ests:
+            parts = [est.import_fit_state(payload[est.uid])
+                     for _h, payload in flat]
+            acc = parts[0]
+            for p in parts[1:]:
+                acc = est.merge_states(acc, p)
+            merged[est.uid] = acc
+        from ..obs.flight import record_event
+
+        record_event("pod.pass_merge", process=self.pod.process_index,
+                     entries=len(flat), estimators=len(list(ests)))
+        return merged
+
+    # -- barrier-fenced checkpoint protocol ----------------------------------
+
+    def pass_saver(self, manager, pass_index: int, label: str, ests,
+                   entry_states: List[Dict[str, Any]]):
+        """Mid-pass checkpoint coordinator for one pod fit pass, or None
+        when the pass has no agreed mid-pass steps.  Steps happen at
+        multiples of ``manager.every_chunks`` of the BUSIEST process's
+        chunk count; every process joins every step (processes that ran
+        out of chunks contribute their final cursors), so the exchange
+        can never deadlock on uneven ranges."""
+        if manager is None:
+            return None
+        steps = max(self.chunks_of_process(p)
+                    for p in range(self.pod.process_count)
+                    ) // manager.every_chunks
+        return _PodPassSaver(self, manager, pass_index, label, ests,
+                             entry_states, steps)
+
+    def complete_pass(self, manager, pass_index: int, label: str,
+                      models, state_payloads=None) -> None:
+        """Pass-boundary save: the models (identical on every process —
+        they came from the merged states) land on disk via the
+        coordinator, fenced by a barrier."""
+        if manager is None:
+            return
+        if self.pod.is_coordinator():
+            manager.complete_pass(pass_index, label, self.total_rows,
+                                  models, state_payloads=state_payloads)
+        self.pod.barrier(f"ckpt.pass{pass_index}")
+
+    # -- CV label sync -------------------------------------------------------
+
+    def sync_cv_labels(self, cv_ctx) -> None:
+        """Replace the context's LOCAL label vector with the global one:
+        slice local labels by entry, allgather, reorder by range start,
+        concatenate.  Runs once, right after labels_ready flips."""
+        y_local = cv_ctx.y
+        counts = self.entry_row_counts()
+        if y_local is None or len(y_local) != sum(counts):
+            raise RuntimeError(
+                f"pod CV label sync: local labels {0 if y_local is None else len(y_local)} "
+                f"rows, entries cover {sum(counts)}")
+        parts, off = [], 0
+        for e, n in zip(self.entries, counts):
+            parts.append((e.range[0], y_local[off:off + n]))
+            off += n
+        gathered = self.pod.allgather_obj(parts)
+        flat = sorted((rec for p in gathered for rec in p),
+                      key=lambda r: r[0])
+        cv_ctx.y = (np.concatenate([y for _s, y in flat])
+                    if flat else np.zeros(0))
+        if len(cv_ctx.y) != self.total_rows:
+            raise RuntimeError(
+                f"pod CV label sync: gathered {len(cv_ctx.y)} rows, "
+                f"expected {self.total_rows}")
+
+    # -- materialized-column gather ------------------------------------------
+
+    def note_ingest_rss(self, ingest) -> None:
+        """Post-ingest, pre-gather resident set — the number the
+        POD_SMOKE memory gate compares per host (the gather that follows
+        deliberately does not count as ingest).  The local materialized
+        buffers are still live here, so (after - before) is what
+        host-sharded ingest RETAINED on this host."""
+        self._rss_after_ingest_mb = round(_rss_now_mb(), 2)
+        ingest.pod = self.to_json()
+        from ..obs.flight import record_event
+
+        record_event("pod.ingest", process=self.pod.process_index,
+                     local_rows=self.local_rows,
+                     rss_after_mb=self._rss_after_ingest_mb,
+                     rss_delta_mb=ingest.pod.get("rssIngestDeltaMb"))
+
+    def gather_columns(self, cols: Dict[str, Any]) -> Dict[str, Any]:
+        """Assemble the full materialized dataset on EVERY process from
+        the per-host pieces: split each local column by entry, allgather,
+        reorder by global range start, concatenate.
+
+        This is the smoke-testable host-level assembly; device-resident
+        matrices take the :class:`~transmogrifai_tpu.parallel.ingest.
+        ShardedMatrixWriter` process-local path instead and never ride
+        through here."""
+        from ..types.columns import FeatureColumn
+
+        counts = self.entry_row_counts()
+        local = []
+        for e, n, off in zip(self.entries, counts,
+                             np.cumsum([0] + counts)[:-1]):
+            sliced = {name: col.slice(int(off), int(off + n))
+                      for name, col in cols.items()}
+            local.append((e.range[0], sliced))
+        gathered = self.pod.allgather_obj(local)
+        flat = sorted((rec for p in gathered for rec in p),
+                      key=lambda r: r[0])
+        out: Dict[str, Any] = {}
+        names = list(cols.keys())
+        for name in names:
+            pieces = [part[name] for _s, part in flat]
+            first = pieces[0]
+            vals = [np.asarray(p.values) for p in pieces]
+            values = np.concatenate(vals) if vals else np.zeros(0)
+            mask = None
+            if first.mask is not None:
+                mask = np.concatenate([np.asarray(p.mask) for p in pieces])
+            out[name] = FeatureColumn(first.ftype, values, mask,
+                                      first.vmeta)
+        return out
+
+    # -- quarantine + reporting ----------------------------------------------
+
+    def flush_quarantine(self, sink) -> None:
+        """Gather every process's buffered quarantine entries; the
+        coordinator appends them to the ONE sidecar (dedupe on
+        (source, location) as always) — non-coordinators never open it."""
+        if sink is None:
+            self.pod.barrier("quarantine.none")
+            return
+        pending = sink.drain_pending()
+        gathered = self.pod.allgather_obj(pending)
+        if self.pod.is_coordinator():
+            for part in gathered[1:]:  # coordinator's own already landed
+                sink.absorb(part)
+        self.pod.barrier("quarantine.flush")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "processIndex": self.pod.process_index,
+            "processCount": self.pod.process_count,
+            "totalRows": self.total_rows,
+            "localRows": self.local_rows,
+            "entries": [{"id": e.entry_id, "range": list(e.range),
+                         "skipChunks": e.skip_chunks}
+                        for e in self.entries],
+            "counted": self.plan.counted,
+            "repacked": self.repacked,
+            "savedProcessCount": self.saved_process_count,
+            "rssBeforeIngestMb": self._rss0_mb,
+            "rssAfterIngestMb": self._rss_after_ingest_mb,
+            "rssIngestDeltaMb": (
+                None if self._rss_after_ingest_mb is None
+                else round(max(self._rss_after_ingest_mb - self._rss0_mb,
+                               0.0), 2)),
+        }
+
+
+class _PodPassSaver:
+    """Mid-pass checkpoint steps for one pod fit pass.
+
+    ``note_chunk`` is called once per consumed chunk; whenever this
+    process crosses a step threshold it joins the pod exchange for that
+    step and the coordinator persists ALL hosts' cursors + states in one
+    durable record.  ``drain`` joins any remaining steps after the local
+    chunks ran out (uneven ranges), keeping the step count identical on
+    every process.
+    """
+
+    def __init__(self, ctx: PodStreamContext, manager, pass_index: int,
+                 label: str, ests, entry_states, steps: int):
+        self.ctx = ctx
+        self.manager = manager
+        self.pass_index = int(pass_index)
+        self.label = label
+        self.ests = ests
+        self.entry_states = entry_states
+        self.steps = int(steps)
+        self.consumed = 0       # chunks consumed locally (skips included)
+        self.steps_done = 0
+        self.entry_cursors = [e.skip_chunks for e in ctx.entries]
+        self._my_chunks = ctx.local_chunks()
+
+    def note_chunk(self, entry_pos: int, entry_chunks_done: int) -> None:
+        """One local chunk consumed (resume fast-skips included) —
+        ``entry_chunks_done`` is the absolute cursor of that entry."""
+        self.consumed += 1
+        self.entry_cursors[entry_pos] = int(entry_chunks_done)
+        every = self.manager.every_chunks
+        while (self.steps_done < self.steps
+               and self.consumed >= min((self.steps_done + 1) * every,
+                                        self._my_chunks)):
+            self._step()
+
+    def drain(self) -> None:
+        while self.steps_done < self.steps:
+            self._step()
+
+    def _step(self) -> None:
+        self.steps_done += 1
+        t0 = time.perf_counter()
+        local = []
+        for e, cur, states in zip(self.ctx.entries, self.entry_cursors,
+                                  self.entry_states):
+            local.append({
+                "entry": e.entry_id,
+                "range": list(e.range),
+                "chunks_done": int(cur),
+                "states": {est.uid: est.export_fit_state(states[est.uid])
+                           for est in self.ests}})
+        gathered = self.ctx.pod.allgather_obj(local)
+        flat = sorted((rec for p in gathered for rec in p),
+                      key=lambda r: r["entry"])
+        if self.ctx.pod.is_coordinator():
+            self.manager.save_progress_pod(
+                self.pass_index, self.label, flat,
+                rows_done=sum(min(r["chunks_done"] * self.ctx.chunk_rows,
+                                  r["range"][1] - r["range"][0])
+                              for r in flat))
+        self.ctx.pod.barrier(
+            f"ckpt.step{self.pass_index}.{self.steps_done}")
+        self.wall = time.perf_counter() - t0

@@ -280,7 +280,8 @@ class RawFeatureFilter:
 
     # -- streaming profile (out-of-core trains) -----------------------------
 
-    def filter_streaming(self, reader, raw_features, chunk_rows: int
+    def filter_streaming(self, reader, raw_features, chunk_rows: int,
+                         pod=None
                          ) -> Tuple[RawFeatureFilterResults,
                                     Dict[str, Any]]:
         """Profile the TRAIN reader (and the scoring reader, when given)
@@ -307,7 +308,7 @@ class RawFeatureFilter:
         faults.fire("rff.pass", index=0, tag="train")
         train_dists, rows = self._profile_reader(
             reader, list(raw_features), pred_names, label_name, chunk_rows,
-            stats)
+            stats, pod=pod)
         stats["rows"] = rows
 
         score_dists: List[FeatureDistribution] = []
@@ -329,12 +330,20 @@ class RawFeatureFilter:
 
     def _profile_reader(self, reader, read_features, pred_names: List[str],
                         label_name: Optional[str], chunk_rows: int,
-                        stats: Dict[str, Any]
+                        stats: Dict[str, Any], pod=None
                         ) -> Tuple[List[FeatureDistribution], int]:
         """One chunked profile pass over ``reader``; honors the reader's
         resilience config (retry/backoff + bad-record quarantine), so a
         corrupt row hit here AND by the later fit passes still counts
-        once in the sidecar (dedup on (source, location))."""
+        once in the sidecar (dedup on (source, location)).
+
+        ``pod`` (an active ``distributed.PodContext``) means ``reader``
+        covers only THIS process's host ranges: the per-host monoid
+        accumulators (and the label totals the leakage co-counts need)
+        allgather and re-merge before normalization, so every process
+        makes IDENTICAL drop decisions from the full-data profile —
+        ``FeatureDistribution`` merges exactly like the reference's
+        partition reduce, just across processes now."""
         rcfg = getattr(reader, "resilience", None)
         if rcfg is not None and rcfg.retry is not None:
             from ..readers.resilience import RetryingChunkStream
@@ -364,6 +373,19 @@ class RawFeatureFilter:
         stats["retries"] += int(getattr(stream, "retries", 0) or 0)
         stats["retry_wait_s"] += float(
             getattr(stream, "retry_wait_s", 0.0) or 0.0)
+        if pod is not None and pod.active:
+            parts = pod.allgather_obj(
+                (list(acc.items()), rows, lab_n, lab_sum, lab_sum2))
+            acc = {}
+            rows = 0
+            lab_n = lab_sum = lab_sum2 = 0.0
+            for items, r, ln, ls, ls2 in parts:
+                for _key, d in items:
+                    merge_distributions(acc, [d])
+                rows += r
+                lab_n += ln
+                lab_sum += ls
+                lab_sum2 += ls2
         return self._ordered_dists(acc, pred_names, rows,
                                    (lab_sum, lab_sum2) if lab_n else None
                                    ), rows

@@ -33,7 +33,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "install_recorder", "current_recorder",
-           "record_event", "arm_crash_dump", "disarm_crash_dump"]
+           "record_event", "arm_crash_dump", "disarm_crash_dump",
+           "merge_flight_dumps"]
 
 
 class FlightRecorder:
@@ -95,6 +96,39 @@ class FlightRecorder:
             for e in events:
                 f.write(json.dumps(e, default=str) + "\n")
         return len(events)
+
+
+def merge_flight_dumps(paths, out_path: Optional[str] = None):
+    """Merge per-process flight-recorder JSONL dumps into ONE causally
+    ordered stream — the pod coordinator's view of the whole train.
+
+    Events sort by wall time then (process, seq) — each process's
+    internal ``seq`` order is preserved, and every event is tagged with
+    the ``process`` index derived from its dump's position (unless the
+    event already carries one).  Returns the merged event list; with
+    ``out_path`` also writes it as JSONL (the coordinator is the only
+    writer — TM047's convention).
+    """
+    merged = []
+    for proc, path in enumerate(paths):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    e = json.loads(line)
+                    e.setdefault("process", proc)
+                    merged.append(e)
+        except OSError:
+            continue
+    merged.sort(key=lambda e: (e.get("t", 0.0), e.get("process", 0),
+                               e.get("seq", 0)))
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            for e in merged:
+                f.write(json.dumps(e, default=str) + "\n")
+    return merged
 
 
 #: installed recorder; None = event recording disabled (the fast path)

@@ -39,7 +39,24 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["Span", "Tracer", "start_trace", "stop_trace", "install_tracer",
            "current_tracer", "tracing", "span", "current_span",
-           "begin_span", "end_span", "new_trace_id"]
+           "begin_span", "end_span", "new_trace_id", "set_global_attrs",
+           "global_attrs"]
+
+#: attrs stamped onto EVERY span this process opens — the pod runtime
+#: sets {"process": process_index} here so the coordinator can merge the
+#: per-process span trees and still attribute each span to its host
+_GLOBAL_ATTRS: Dict[str, Any] = {}
+
+
+def set_global_attrs(**attrs: Any) -> None:
+    """Merge process-wide span attributes (e.g. the pod process index).
+    Only consulted while a tracer is armed — the disabled hook path stays
+    a single None check."""
+    _GLOBAL_ATTRS.update(attrs)
+
+
+def global_attrs() -> Dict[str, Any]:
+    return dict(_GLOBAL_ATTRS)
 
 
 def new_trace_id() -> str:
@@ -193,6 +210,8 @@ def begin_span(name: str, cat: str = "run",
         return None
     if parent is None:
         parent = current_span()
+    if _GLOBAL_ATTRS:
+        attrs = {**_GLOBAL_ATTRS, **attrs}
     sp = t.begin(name, cat, parent.span_id if parent is not None else None,
                  attrs)
     stack = getattr(_local, "stack", None)

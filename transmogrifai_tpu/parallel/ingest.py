@@ -104,15 +104,35 @@ class ShardedMatrixWriter:
         self.global_shape = shape
         # device -> global row slice start, from the sharding itself (the
         # authoritative layout — replicated grid/model lanes map to the
-        # same row range and receive the same host buffer)
+        # same row range and receive the same host buffer).  On a
+        # MULTI-PROCESS mesh this map covers only the ADDRESSABLE
+        # devices: the writer fills exactly this process's shards and
+        # the global array assembles from every process's contributions
+        # — no host ever materializes more than its own span.
         self._dev_start = {
             dev: (idx[0].start or 0)
             for dev, idx in self.sharding.addressable_devices_indices_map(
                 shape).items()}
+        #: global row starts of the shards THIS process owns, in order
+        self._starts = sorted(set(self._dev_start.values()))
+        self.process_local = len(self._starts) < self.ndata
+        if self.process_local:
+            span = self._starts[-1] + self.shard_rows - self._starts[0]
+            if span != self.shard_rows * len(self._starts):
+                raise ValueError(
+                    "ShardedMatrixWriter requires this process's shards "
+                    "to cover one contiguous row span (process-major "
+                    "device order)")
+        #: [local_span_start, local_span_stop) in global rows
+        self.span = (self._starts[0], self._starts[-1] + self.shard_rows)
+        #: REAL rows this process is expected to append (its span minus
+        #: any global pad tail living in its last shard)
+        self.local_rows = max(min(self.rows, self.span[1]) - self.span[0],
+                              0)
         self._buf = np.zeros(
             (self.shard_rows, cols) if cols is not None
             else (self.shard_rows,), self.dtype)
-        self._shard_i = 0
+        self._shard_i = 0                      # index into self._starts
         self._fill = 0
         self._committed = {}                   # device -> device buffer
         self._closed = False
@@ -120,27 +140,32 @@ class ShardedMatrixWriter:
 
     @property
     def offset(self) -> int:
-        return self._shard_i * self.shard_rows + self._fill
+        """GLOBAL row position of the next appended row."""
+        return (self.span[0] + self._shard_i * self.shard_rows
+                + self._fill)
 
     def _flush_shard(self) -> None:
-        start = self._shard_i * self.shard_rows
+        start = self._starts[self._shard_i]
         for dev, s in self._dev_start.items():
             if s == start:
                 self._committed[dev] = self._jax.device_put(self._buf, dev)
         self._shard_i += 1
         self._fill = 0
-        if self._shard_i < self.ndata:
+        if self._shard_i < len(self._starts):
             # fresh buffer: the committed device array must not alias the
             # host memory the next shard overwrites
             self._buf = np.zeros_like(self._buf)
 
     def append(self, chunk: np.ndarray) -> None:
+        """Append this process's next rows (global order within its
+        span)."""
         arr = np.asarray(chunk, self.dtype)
         k = arr.shape[0]
-        if self.offset + k > self.rows:
+        if self.offset + k > min(self.rows, self.span[1]):
             raise ValueError(
-                f"append past declared total_rows={self.rows} "
-                f"(offset {self.offset} + chunk {k})")
+                f"append past this process's rows "
+                f"(span {self.span}, total_rows={self.rows}; offset "
+                f"{self.offset} + chunk {k})")
         pos = 0
         while pos < k:
             room = self.shard_rows - self._fill
@@ -164,19 +189,27 @@ class ShardedMatrixWriter:
         self._closed = True
 
     def finish(self):
-        """The global row-sharded array (pad rows zero-filled)."""
+        """The global row-sharded array (pad rows zero-filled).
+
+        Every process contributes ONLY its addressable per-device
+        buffers; ``jax.make_array_from_single_device_arrays`` stitches
+        them into one global array (the multi-process assembly path —
+        each process names the same global shape + sharding and its own
+        shards, the documented cross-host contract)."""
         if self._closed:
             raise ValueError("finish() on a closed ShardedMatrixWriter")
-        if self.offset != self.rows:
+        expected = self.span[0] + self.local_rows
+        if self.offset != expected:
             raise ValueError(
                 f"finish() at offset {self.offset}, expected "
-                f"{self.rows} rows")
-        if self._shard_i < self.ndata:
-            # zero-fill the pad tail of the last shard(s)
+                f"{expected} rows (span {self.span}, "
+                f"total_rows={self.rows})")
+        if self._shard_i < len(self._starts):
+            # zero-fill the pad tail of the last local shard(s)
             self._buf[self._fill:] = 0
             self._fill = self.shard_rows
             self._flush_shard()
-            while self._shard_i < self.ndata:
+            while self._shard_i < len(self._starts):
                 self._buf[:] = 0
                 self._fill = self.shard_rows
                 self._flush_shard()
@@ -200,6 +233,11 @@ class ShardedMatrixWriter:
         from ..analysis.contracts import checks_enabled
 
         if not self.n_pad or not checks_enabled():
+            return
+        if self.process_local:
+            # a cross-process host fetch of the tail is not addressable
+            # from here; the zero-fill above is the same code path the
+            # single-process check covers
             return
         import numpy as _np
 

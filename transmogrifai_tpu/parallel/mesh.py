@@ -36,13 +36,42 @@ __all__ = [
     "sweep_matrix_sharding", "grid_sharding", "fold_weight_sharding",
     "chain_sharding", "replicated", "shard_dataset", "pad_to_multiple",
     "shard_sweep_inputs", "shard_map_compat", "next_shard_pad",
+    "pod_default_devices", "global_mesh",
 ]
+
+
+def pod_default_devices():
+    """The device set mesh construction defaults to: under an active
+    multi-process pod, the LOCALLY ADDRESSABLE devices (each process's
+    sweep/fit machinery replicates deterministically on its own slice —
+    the host-level pod protocol, distributed/podstream.py); otherwise
+    every device jax can see.  Cross-process GLOBAL meshes (the
+    ShardedMatrixWriter process-local ingest path) are built explicitly
+    via :func:`global_mesh`."""
+    import jax as _jax
+
+    from ..distributed.runtime import current_pod
+
+    if current_pod().active:
+        return list(_jax.local_devices())
+    return list(_jax.devices())
+
+
+def global_mesh(axis_name: str = "data") -> Mesh:
+    """A 1-D mesh over EVERY device of the pod (all processes), in
+    process-major order — row shards land contiguously per process, which
+    is exactly the layout host-sharded ingest fills.  In a single
+    process this is just a 1-D mesh over the local devices."""
+    import jax as _jax
+
+    return Mesh(np.asarray(_jax.devices()), (axis_name,))
 
 
 def make_mesh(n_devices: Optional[int] = None,
               axis_names: Tuple[str, str] = ("data", "model"),
               model_parallelism: Optional[int] = None,
-              queue_width: Optional[int] = None) -> Mesh:
+              queue_width: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
     """Build a 2-D mesh over the available devices.
 
     The default is the (data, model) mesh: ``model_parallelism`` defaults
@@ -58,7 +87,7 @@ def make_mesh(n_devices: Optional[int] = None,
     is auto-selected from ``queue_width`` — the number of schedulable
     sweep units — via :func:`auto_grid_axis`.
     """
-    devs = jax.devices()
+    devs = list(devices) if devices is not None else pod_default_devices()
     n = n_devices if n_devices is not None else len(devs)
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")

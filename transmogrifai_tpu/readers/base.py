@@ -21,7 +21,41 @@ from ..types.columns import ColumnarDataset, FeatureColumn
 from ..utils import faults
 
 __all__ = ["Reader", "DataFrameReader", "RecordsReader", "reader_for",
-           "ChunkStream"]
+           "ChunkStream", "window_gen"]
+
+
+def window_gen(gen, host_range):
+    """Restrict a chunk generator to the global row window [start, stop).
+
+    The generic ``host_range`` implementation every reader shares
+    (distributed/hostshard.py): chunks entirely before the window are
+    drained and discarded (streaming parses cannot seek rows), chunks
+    overlapping an edge are sliced zero-copy, and iteration STOPS at
+    ``stop`` — a pod process never parses the file past its own range.
+    Chunk boundaries stay on the source's GLOBAL chunk grid (the first
+    and last window chunks may be partial); the sequence is a pure
+    function of (source chunking, window), which is the determinism the
+    cross-host-count checkpoint cursor counts on.
+    """
+    start, stop = int(host_range[0]), int(host_range[1])
+    if start < 0 or stop < start:
+        raise ValueError(f"bad host_range ({start}, {stop})")
+
+    def windowed():
+        offset = 0          # global rows consumed from the source
+        if start == stop:
+            return
+        for ds in gen:
+            n = len(ds)
+            lo = max(start - offset, 0)
+            hi = min(stop - offset, n)
+            offset += n
+            if hi > lo:
+                yield ds if (lo == 0 and hi == n) else ds.slice(lo, hi)
+            if offset >= stop:
+                break
+
+    return windowed()
 
 
 class ChunkStream:
@@ -96,8 +130,17 @@ class Reader:
         cannot say without a full parse (file readers)."""
         return None
 
+    def estimate_rows_exact(self) -> bool:
+        """True when :meth:`estimate_rows` is the EXACT post-policy row
+        count (in-memory readers; Avro block headers).  Host sharding
+        (distributed/hostshard.py) trusts exact estimates and runs a
+        counting pre-pass otherwise — line-count heuristics (CSV quoted
+        newlines, quarantined rows) must return False here."""
+        return False
+
     def iter_chunks(self, raw_features: Sequence[Feature],
-                    chunk_rows: int) -> ChunkStream:
+                    chunk_rows: int,
+                    host_range: Optional[tuple] = None) -> ChunkStream:
         """Yield the dataset as bounded row chunks (out-of-core ingestion).
 
         Base fallback: materialize once and yield zero-copy row slices —
@@ -105,6 +148,10 @@ class Reader:
         whose entity grouping is inherently global), while the file readers
         override it with true streaming parses that never hold the full
         dataset.
+
+        ``host_range=(start, stop)`` restricts the stream to that global
+        row window (:func:`window_gen`) — the pod runtime's host-sharded
+        ingest, honored by every reader.
         """
         if chunk_rows <= 0:
             raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
@@ -115,7 +162,8 @@ class Reader:
             for start in range(0, n, chunk_rows):
                 yield ds.slice(start, min(start + chunk_rows, n))
 
-        return ChunkStream(gen())
+        g = gen() if host_range is None else window_gen(gen(), host_range)
+        return ChunkStream(g)
 
 
 class DataFrameReader(Reader):
@@ -131,6 +179,9 @@ class DataFrameReader(Reader):
 
     def estimate_rows(self) -> Optional[int]:
         return len(self.df)
+
+    def estimate_rows_exact(self) -> bool:
+        return True
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         records: Optional[List[dict]] = None
@@ -160,7 +211,8 @@ class DataFrameReader(Reader):
         return ColumnarDataset(cols)
 
     def iter_chunks(self, raw_features: Sequence[Feature],
-                    chunk_rows: int) -> "ChunkStream":
+                    chunk_rows: int,
+                    host_range: Optional[tuple] = None) -> "ChunkStream":
         """Row-range chunks over the wrapped frame; per-chunk extraction
         yields values identical to the monolithic path (numeric dtypes are
         frame-wide, so slicing cannot change per-chunk coercions)."""
@@ -174,7 +226,8 @@ class DataFrameReader(Reader):
                 yield DataFrameReader(part, self.key_col).generate_dataset(
                     raw_features)
 
-        return ChunkStream(gen())
+        g = gen() if host_range is None else window_gen(gen(), host_range)
+        return ChunkStream(g)
 
 
 class RecordsReader(Reader):
@@ -186,6 +239,9 @@ class RecordsReader(Reader):
 
     def estimate_rows(self) -> Optional[int]:
         return len(self.records)
+
+    def estimate_rows_exact(self) -> bool:
+        return True
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         from ..types.feature_types import ID
@@ -202,7 +258,8 @@ class RecordsReader(Reader):
         return ds
 
     def iter_chunks(self, raw_features: Sequence[Feature],
-                    chunk_rows: int) -> "ChunkStream":
+                    chunk_rows: int,
+                    host_range: Optional[tuple] = None) -> "ChunkStream":
         if chunk_rows <= 0:
             raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
 
@@ -213,7 +270,8 @@ class RecordsReader(Reader):
                     self.records[start:start + chunk_rows],
                     key_fn=self.key_fn).generate_dataset(raw_features)
 
-        return ChunkStream(gen())
+        g = gen() if host_range is None else window_gen(gen(), host_range)
+        return ChunkStream(g)
 
 
 def reader_for(data) -> Reader:
@@ -240,6 +298,9 @@ class _PassthroughReader(Reader):
 
     def estimate_rows(self) -> Optional[int]:
         return len(self.ds)
+
+    def estimate_rows_exact(self) -> bool:
+        return True
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         missing = [f.name for f in raw_features if f.name not in self.ds]

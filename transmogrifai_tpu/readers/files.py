@@ -12,7 +12,25 @@ from typing import Dict, List, Optional, Sequence, Type
 from ..features.feature import Feature
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types.feature_types import FeatureType
-from .base import ChunkStream, DataFrameReader, Reader
+from .base import ChunkStream, DataFrameReader, Reader, window_gen
+
+
+def _count_lines(path: str) -> int:
+    """Newline count by raw 1MB blocks — the cheap line-count estimate
+    (no parse, no decode).  A final line without a trailing newline still
+    counts."""
+    n = 0
+    last = b"\n"
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(1 << 20)
+            if not block:
+                break
+            n += block.count(b"\n")
+            last = block[-1:]
+    if last != b"\n":
+        n += 1
+    return n
 
 __all__ = ["CSVReader", "CSVAutoReader", "ParquetReader", "JSONLinesReader",
            "DataReaders"]
@@ -81,10 +99,24 @@ class CSVReader(Reader):
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
         return DataFrameReader(self._load(), self.key_col).generate_dataset(raw_features)
 
+    def estimate_rows(self) -> Optional[int]:
+        """Line count minus the header — an ESTIMATE (quoted embedded
+        newlines over-count; quarantined bad lines drop rows), so
+        ``estimate_rows_exact`` stays False and host sharding runs its
+        counting pre-pass instead of trusting this."""
+        try:
+            n = _count_lines(self.path)
+        except OSError:
+            return None
+        return max(n - (1 if self.has_header else 0), 0)
+
     def iter_chunks(self, raw_features: Sequence[Feature],
-                    chunk_rows: int) -> ChunkStream:
+                    chunk_rows: int,
+                    host_range=None) -> ChunkStream:
         """Streaming parse via pandas' chunked reader — the full CSV is
-        never resident; bytes_read tracks the underlying file position."""
+        never resident; bytes_read tracks the underlying file position.
+        ``host_range`` windows the stream (rows past the window's stop
+        are never parsed — the parse loop breaks early)."""
         if chunk_rows <= 0:
             raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
         import pandas as pd
@@ -107,7 +139,8 @@ class CSVReader(Reader):
             finally:
                 fh.close()
 
-        return ChunkStream(gen(), bytes_fn=lambda: pos["bytes"])
+        g = gen() if host_range is None else window_gen(gen(), host_range)
+        return ChunkStream(g, bytes_fn=lambda: pos["bytes"])
 
 
 class CSVAutoReader(CSVReader):
@@ -125,8 +158,21 @@ class ParquetReader(Reader):
         df = pd.read_parquet(self.path)
         return DataFrameReader(df, self.key_col).generate_dataset(raw_features)
 
+    def estimate_rows(self) -> Optional[int]:
+        """Parquet footer metadata row count — exact without decoding."""
+        try:
+            import pyarrow.parquet as pq
+
+            return int(pq.ParquetFile(self.path).metadata.num_rows)
+        except Exception:
+            return None
+
+    def estimate_rows_exact(self) -> bool:
+        return self.estimate_rows() is not None
+
     def iter_chunks(self, raw_features: Sequence[Feature],
-                    chunk_rows: int) -> ChunkStream:
+                    chunk_rows: int,
+                    host_range=None) -> ChunkStream:
         """Arrow record-batch streaming (row groups decode incrementally);
         bytes_read counts decoded batch bytes.  Falls back to the
         slice-after-load base path when pyarrow is unavailable."""
@@ -135,7 +181,8 @@ class ParquetReader(Reader):
         try:
             import pyarrow.parquet as pq
         except ImportError:  # pragma: no cover - pyarrow is baked in
-            return super().iter_chunks(raw_features, chunk_rows)
+            return super().iter_chunks(raw_features, chunk_rows,
+                                       host_range=host_range)
         pos = {"bytes": 0}
 
         def gen():
@@ -146,7 +193,8 @@ class ParquetReader(Reader):
                     batch.to_pandas(),
                     self.key_col).generate_dataset(raw_features)
 
-        return ChunkStream(gen(), bytes_fn=lambda: pos["bytes"])
+        g = gen() if host_range is None else window_gen(gen(), host_range)
+        return ChunkStream(g, bytes_fn=lambda: pos["bytes"])
 
 
 class JSONLinesReader(Reader):
@@ -189,8 +237,17 @@ class JSONLinesReader(Reader):
 
         return RecordsReader(records).generate_dataset(raw_features)
 
+    def estimate_rows(self) -> Optional[int]:
+        """Line count — an ESTIMATE (blank lines and quarantined bad
+        lines both shrink the real yield), never trusted as exact."""
+        try:
+            return _count_lines(self.path)
+        except OSError:
+            return None
+
     def iter_chunks(self, raw_features: Sequence[Feature],
-                    chunk_rows: int) -> ChunkStream:
+                    chunk_rows: int,
+                    host_range=None) -> ChunkStream:
         """Line-streaming parse: at most ``chunk_rows`` decoded records are
         ever resident; bytes_read tracks raw line bytes consumed."""
         if chunk_rows <= 0:
@@ -220,7 +277,8 @@ class JSONLinesReader(Reader):
                     yield RecordsReader(records).generate_dataset(
                         raw_features)
 
-        return ChunkStream(gen(), bytes_fn=lambda: pos["bytes"])
+        g = gen() if host_range is None else window_gen(gen(), host_range)
+        return ChunkStream(g, bytes_fn=lambda: pos["bytes"])
 
 
 class DataReaders:

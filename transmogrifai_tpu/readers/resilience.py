@@ -113,6 +113,10 @@ class QuarantineSink:
         self.count = 0       # sidecar entries
         self.rows = 0        # data rows dropped (an Avro block entry is many)
         self._fh = None
+        #: entries buffered on non-coordinator pod processes — the
+        #: sidecar is a COORDINATOR-ONLY artifact (TM047): the pod train
+        #: gathers these at the end and process 0 appends them
+        self._pending: list = []
 
     def quarantine(self, source: str, location: str, reason: str,
                    record: Any = None, rows: int = 1) -> None:
@@ -120,7 +124,18 @@ class QuarantineSink:
         TooManyBadRecordsError once more than ``max_bad_records`` ROWS are
         quarantined.  (source, location) pairs de-duplicate, so a retried
         re-read cannot double-count."""
+        from ..distributed.runtime import current_pod
+
         key = (source, location)
+        entry = {"source": source, "location": location,
+                 "reason": reason, "rows": int(rows)}
+        if record is not None:
+            try:
+                json.dumps(record)
+                entry["record"] = record
+            except (TypeError, ValueError):
+                entry["record"] = repr(record)
+        pod = current_pod()
         with self._lock:
             if key in self._seen:
                 return
@@ -128,26 +143,44 @@ class QuarantineSink:
             self.count += 1
             self.rows += int(rows)
             total_rows = self.rows
-            if self._fh is None:
-                d = os.path.dirname(self.path)
-                if d:
-                    os.makedirs(d, exist_ok=True)
-                self._fh = open(self.path, "a", encoding="utf-8")
-            entry = {"source": source, "location": location,
-                     "reason": reason, "rows": int(rows)}
-            if record is not None:
-                try:
-                    json.dumps(record)
-                    entry["record"] = record
-                except (TypeError, ValueError):
-                    entry["record"] = repr(record)
-            self._fh.write(json.dumps(entry) + "\n")
-            self._fh.flush()
+            if pod.active and not pod.is_coordinator():
+                self._pending.append(entry)
+            else:
+                self._write_entry(entry)
         if total_rows > self.max_bad_records:
             raise TooManyBadRecordsError(
                 source, location,
                 f"exceeded max_bad_records={self.max_bad_records} "
                 f"(quarantined {total_rows} rows; sidecar: {self.path})")
+
+    def _write_entry(self, entry: dict) -> None:
+        if self._fh is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._fh.write(json.dumps(entry) + "\n")
+        self._fh.flush()
+
+    def drain_pending(self) -> list:
+        """Buffered entries (non-coordinator pod processes), cleared."""
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
+
+    def absorb(self, entries: list) -> None:
+        """Coordinator-side: append another process's gathered entries
+        (same (source, location) dedupe — pod processes read disjoint
+        row ranges, so collisions only happen on shared sources)."""
+        with self._lock:
+            for entry in entries:
+                key = (entry["source"], entry["location"])
+                if key in self._seen:
+                    continue
+                self._seen.add(key)
+                self.count += 1
+                self.rows += int(entry.get("rows", 1))
+                self._write_entry(entry)
 
     def close(self) -> None:
         with self._lock:

@@ -425,8 +425,15 @@ def record_train_observations(profiler,
                               path: Optional[str] = None) -> bool:
     """Called by ``OpWorkflow.train()`` after every fit: persist the run's
     stage profiles into the cost history.  Never raises — telemetry must
-    not break a train."""
+    not break a train.  Pod trains append through the COORDINATOR only
+    (every process would otherwise race the same history file with
+    identical observations — TM047's durable-write convention)."""
     try:
+        from ..distributed.runtime import current_pod
+
+        pod = current_pod()
+        if pod.active and not pod.is_coordinator():
+            return False
         path = path if path is not None else default_history_path()
         if not path or profiler is None:
             return False

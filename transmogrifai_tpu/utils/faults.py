@@ -124,6 +124,15 @@ class FaultSpec:
     passes over the first n otherwise-matching calls (the way to target
     "the 3rd transform of stage X" when the point's call counter is
     global).  ``times`` caps total firings (None = unlimited).
+
+    ``process`` restricts the spec to ONE pod process (the
+    ``distributed.runtime`` process index): pod children inherit the
+    whole ``TMOG_FAULTS`` schedule from the launcher's env, so without a
+    ``process`` selector a deterministic spec fires IDENTICALLY on every
+    process (replicas stay in lockstep); with one, a fault — e.g. a
+    ``device_loss`` — lands on a single host while the others keep
+    running, which is the "one host loses a chip" scenario the pod
+    barrier protocol must survive without deadlocking.
     """
 
     point: str
@@ -136,6 +145,7 @@ class FaultSpec:
     times: Optional[int] = 1
     delay_s: float = 0.05
     message: str = "injected fault"
+    process: Optional[int] = None
     fired: int = field(default=0, compare=False)
     seen: int = field(default=0, compare=False)
 
@@ -149,6 +159,11 @@ class FaultSpec:
             return False
         if self.tag is not None and tag != self.tag:
             return False
+        if self.process is not None:
+            from ..distributed.runtime import current_pod
+
+            if current_pod().process_index != self.process:
+                return False
         if self.at is not None:
             ats = self.at if isinstance(self.at, (list, tuple)) else [self.at]
             hit = index in ats
@@ -167,7 +182,7 @@ class FaultSpec:
 
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"point": self.point, "action": self.action}
-        for k in ("at", "every", "p", "tag", "times"):
+        for k in ("at", "every", "p", "tag", "times", "process"):
             if getattr(self, k) is not None:
                 out[k] = getattr(self, k)
         if self.skip:

@@ -521,6 +521,10 @@ class IngestProfiler:
         #: RawFeatureFilter streaming-profile pass accounting (rows /
         #: retries per pass) when the train ran with a filter; None else
         self.rff: "Optional[Dict[str, Any]]" = None
+        #: pod-train record (distributed/podstream.py): shard plan, this
+        #: process's entries, post-ingest peak RSS, resume repacks; None
+        #: on single-process trains
+        self.pod: "Optional[Dict[str, Any]]" = None
         self._lock = threading.Lock()
 
     def begin_pass(self, label: str) -> IngestPass:
@@ -560,6 +564,7 @@ class IngestProfiler:
                 "checkpointWallSecs": round(self.checkpoint_wall_s, 4),
                 "resumed": self.resumed,
                 "rff": self.rff,
+                "pod": self.pod,
                 "passes": [p.to_json() for p in self.passes],
             }
 

@@ -59,11 +59,11 @@ from ..utils import faults
 from .persistence import _ArrayStore, _load_stage, _stage_record
 
 __all__ = ["StreamingCheckpointManager", "CheckpointMismatchError",
-           "ResumeState", "compute_fingerprint", "encode_fit_state",
-           "decode_fit_state", "adopt_restored_model", "CHECKPOINT_JSON",
-           "CHECKPOINT_VERSION", "SweepCheckpointManager",
-           "sweep_fingerprint", "mesh_record", "fingerprint_diff",
-           "SWEEP_CHECKPOINT_JSON"]
+           "ResumeState", "compute_fingerprint", "logical_fingerprint",
+           "encode_fit_state", "decode_fit_state", "adopt_restored_model",
+           "CHECKPOINT_JSON", "CHECKPOINT_VERSION",
+           "SweepCheckpointManager", "sweep_fingerprint", "mesh_record",
+           "fingerprint_diff", "SWEEP_CHECKPOINT_JSON"]
 
 CHECKPOINT_JSON = "checkpoint.json"
 CHECKPOINT_VERSION = 1
@@ -220,6 +220,12 @@ def decode_fit_state(value: Any, arrays) -> Any:
 # ---------------------------------------------------------------------------
 
 def _describe_reader(reader) -> Dict[str, Any]:
+    # a host-sharded pod wrapper's LOGICAL identity is its source reader:
+    # checkpoints written under one process count must resume under any
+    # other (the pod record itself is advisory)
+    inner = getattr(reader, "inner_reader", None)
+    if inner is not None:
+        reader = inner
     out: Dict[str, Any] = {"class": type(reader).__name__}
     for attr in ("path", "csv_path"):
         path = getattr(reader, attr, None)
@@ -240,6 +246,15 @@ def _describe_reader(reader) -> Dict[str, Any]:
     if isinstance(recs, list):
         out["rows"] = len(recs)
     return out
+
+
+def logical_fingerprint(fp: Any) -> Any:
+    """The COMPARED half of a streaming fingerprint: everything except
+    the ``advisory`` section (pod process count — host counts are
+    elastic, so ``pod.processCount`` must never block a resume)."""
+    if isinstance(fp, dict):
+        return {k: v for k, v in fp.items() if k != "advisory"}
+    return fp
 
 
 def compute_fingerprint(reader, raw_features, layers,
@@ -270,7 +285,18 @@ class ResumeState:
         #: "states": {uid: encoded payload}}; states decode lazily per
         #: estimator via ``states_for`` (import hooks need the estimator)
         self.current: Optional[Dict[str, Any]] = None
+        #: pod manifest record ({"ranges", "processCount"}) when the
+        #: checkpoint was written by a pod train; the resuming
+        #: PodStreamContext adopts these ORIGINAL host entries so any
+        #: process count reproduces the same per-host chunk folds
+        self.pod: Optional[Dict[str, Any]] = None
         self._arrays = {}
+
+    def decode_payload(self, raw: Any) -> Any:
+        """Decode one encoded fit-state payload against this
+        checkpoint's array store (the pod resume path decodes per-entry
+        states lazily, one entry at a time)."""
+        return decode_fit_state(raw, self._arrays)
 
     def states_for(self, ests: List[Estimator]) -> Dict[str, Any]:
         """Restore the in-flight states for ``ests`` through each
@@ -308,6 +334,8 @@ class StreamingCheckpointManager:
         self._seq = 0
         self._completed: Dict[int, Dict[str, Any]] = {}  # manifest records
         self._current: Optional[Dict[str, Any]] = None
+        #: set by the pod driver: rides on every manifest write
+        self.pod_record: Optional[Dict[str, Any]] = None
         os.makedirs(directory, exist_ok=True)
 
     # -- resume -------------------------------------------------------------
@@ -325,11 +353,16 @@ class StreamingCheckpointManager:
             raise CheckpointMismatchError(
                 f"checkpoint format v{doc.get('version')} != "
                 f"v{CHECKPOINT_VERSION}")
-        if doc.get("fingerprint") != self.fingerprint:
+        saved_fp = doc.get("fingerprint")
+        if logical_fingerprint(saved_fp) != logical_fingerprint(
+                self.fingerprint):
             raise CheckpointMismatchError(_mismatch_message(
                 "checkpoint", self.directory,
-                doc.get("fingerprint"), self.fingerprint,
-                "clear the directory or point checkpoint_dir elsewhere"))
+                logical_fingerprint(saved_fp),
+                logical_fingerprint(self.fingerprint),
+                "clear the directory or point checkpoint_dir elsewhere "
+                "(advisory keys — pod.processCount — are NOT compared: a "
+                "host-count change alone would have resumed)"))
         arrays = {}
         npz = doc.get("arrays")
         if npz:
@@ -355,6 +388,8 @@ class StreamingCheckpointManager:
                 "label": rec.get("label"), "live_models": models,
                 "live_payloads": payloads}
         state.current = doc.get("current")
+        state.pod = doc.get("pod")
+        self.pod_record = doc.get("pod") or self.pod_record
         self._seq = int(doc.get("seq", 0))
         from ..obs.flight import record_event
 
@@ -366,7 +401,17 @@ class StreamingCheckpointManager:
     # -- save ---------------------------------------------------------------
 
     def _write(self) -> None:
-        """Re-encode the manifest + arrays and land them atomically."""
+        """Re-encode the manifest + arrays and land them atomically.
+
+        Pod trains write through the COORDINATOR only (process 0) — the
+        callers' save protocol is barrier-fenced around this, so every
+        process observes the save as durable before proceeding (TM047
+        pins the guard convention)."""
+        from ..distributed.runtime import current_pod
+
+        pod = current_pod()
+        if pod.active and not pod.is_coordinator():
+            return
         self._seq += 1
         store = _ArrayStore()
         doc: Dict[str, Any] = {
@@ -393,10 +438,23 @@ class StreamingCheckpointManager:
             doc["completedPasses"].append(entry)
         if self._current is not None:
             cur = dict(self._current)
-            cur["states"] = {
-                uid: encode_fit_state(payload, f"cur.{uid}", store)
-                for uid, payload in cur.pop("live_states").items()}
+            if "live_states" in cur:
+                cur["states"] = {
+                    uid: encode_fit_state(payload, f"cur.{uid}", store)
+                    for uid, payload in cur.pop("live_states").items()}
+            if "pod_live" in cur:
+                # one record per ORIGINAL host: range + cursor + states
+                cur["pod_entries"] = [
+                    {"entry": rec["entry"], "range": rec["range"],
+                     "chunks_done": rec["chunks_done"],
+                     "states": {
+                         uid: encode_fit_state(
+                             p, f"pod{rec['entry']}.{uid}", store)
+                         for uid, p in rec["states"].items()}}
+                    for rec in cur.pop("pod_live")]
             doc["current"] = cur
+        if self.pod_record is not None:
+            doc["pod"] = self.pod_record
         npz_name = f"state-{self._seq}.npz"
         old = [n for n in os.listdir(self.directory)
                if n.startswith("state-") and n.endswith(".npz")]
@@ -436,6 +494,20 @@ class StreamingCheckpointManager:
         }
         self._write()
 
+    def save_progress_pod(self, pass_index: int, label: str,
+                          entries: List[Dict[str, Any]],
+                          rows_done: int = 0) -> None:
+        """Pod variant of :meth:`save_progress`: one record PER ORIGINAL
+        HOST ({entry, range, chunks_done, states} — states already
+        exported payloads, gathered from every process).  Called on the
+        coordinator only, inside the barrier-fenced pod save step."""
+        self._current = {
+            "pass": int(pass_index), "label": label,
+            "rows_done": int(rows_done),
+            "pod_live": [dict(rec) for rec in entries],
+        }
+        self._write()
+
     def complete_pass(self, pass_index: int, label: str, rows: int,
                       models: Dict[str, Model],
                       state_payloads: Optional[Dict[str, Any]] = None
@@ -459,6 +531,11 @@ class StreamingCheckpointManager:
     def finish(self) -> None:
         """The train succeeded: remove the checkpoint so a later run in the
         same directory starts fresh instead of resuming a finished fit."""
+        from ..distributed.runtime import current_pod
+
+        pod = current_pod()
+        if pod.active and not pod.is_coordinator():
+            return
         for n in (CHECKPOINT_JSON, CHECKPOINT_JSON + ".tmp"):
             try:
                 os.unlink(os.path.join(self.directory, n))
@@ -637,8 +714,15 @@ class SweepCheckpointManager:
                 "rung": self._rung}
 
     def _write(self) -> None:
+        from ..distributed.runtime import current_pod
         from ..utils.jsonio import write_json_atomic
 
+        pod = current_pod()
+        if pod.active and not pod.is_coordinator():
+            # the sweep replicates deterministically on every pod process;
+            # its durable cursor is the coordinator's to write (TM047)
+            self._dirty = 0
+            return
         write_json_atomic(
             os.path.join(self.directory, SWEEP_CHECKPOINT_JSON),
             self.export_doc())
@@ -660,6 +744,11 @@ class SweepCheckpointManager:
     def finish(self) -> None:
         """The sweep completed: remove the cursor so a later sweep in the
         same directory starts fresh."""
+        from ..distributed.runtime import current_pod
+
+        pod = current_pod()
+        if pod.active and not pod.is_coordinator():
+            return
         try:
             os.unlink(os.path.join(self.directory, SWEEP_CHECKPOINT_JSON))
         except OSError:

@@ -390,6 +390,7 @@ def fit_dag_streaming(
     fingerprint_extra: Optional[Dict] = None,
     cv_ctx=None,
     chunk_filter=None,
+    pod_ctx=None,
 ) -> Tuple[List[PipelineStage], ColumnarDataset, IngestProfiler,
            Dict[str, object]]:
     """Fit ``dag`` from chunked ingestion; returns (fitted stages in topo
@@ -435,11 +436,26 @@ def fit_dag_streaming(
 
     ``chunk_filter`` (dataset -> dataset) runs on every RAW chunk of
     every pass before any transform — RawFeatureFilter's map-key
-    cleaning rides here, so chunking never changes what the DAG sees."""
+    cleaning rides here, so chunking never changes what the DAG sees.
+
+    ``pod_ctx`` (a ``distributed.podstream.PodStreamContext``) turns
+    this run into ONE MEMBER of a multi-process pod train: this process
+    streams only its host ranges, per-pass states allgather-merge in
+    host order (every process finishes each pass with identical merged
+    states), the materialized columns gather after an RSS probe, and
+    every durable artifact is written by the coordinator behind a
+    barrier.  Checkpoints store one record per ORIGINAL host, so a
+    SIGKILLed pod train resumes bit-exactly under ANY process count
+    (``pod.processCount`` is advisory in the fingerprint)."""
     from .dag import StagesDAG, fit_and_transform_dag
 
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+    if pod_ctx is not None and (refresh_ctx is not None
+                                or shard_onto is not None):
+        raise ValueError(
+            "pod trains do not yet compose with warm-start refresh or "
+            "the shard_onto device hand-off — run those single-process")
     subs = dict(fitted_substitutes or {})
     layers = [l for l in dag.non_generator_layers() if l]
     split = _split_streamable(layers, subs)
@@ -451,19 +467,36 @@ def fit_dag_streaming(
     manager = None
     resume = None
     if checkpoint_dir is not None:
-        from .checkpoint import (StreamingCheckpointManager,
+        from .checkpoint import (CheckpointMismatchError,
+                                 StreamingCheckpointManager,
                                  compute_fingerprint)
 
         fingerprint = compute_fingerprint(reader, raw_features, layers,
                                           chunk_rows)
         if fingerprint_extra:
             fingerprint = {**fingerprint, **fingerprint_extra}
+        if pod_ctx is not None:
+            # advisory: recorded for the diff message, never compared
+            fingerprint = {**fingerprint,
+                           "advisory": pod_ctx.fingerprint_advisory()}
         manager = StreamingCheckpointManager(
             checkpoint_dir, fingerprint,
             every_chunks=checkpoint_every)
         resume = manager.load()
         if resume is not None:
             ingest.resumed = True
+            if pod_ctx is None and resume.pod is not None:
+                raise CheckpointMismatchError(
+                    f"checkpoint in {checkpoint_dir!r} was written by a "
+                    f"{resume.pod.get('processCount')}-process pod train; "
+                    f"resume it under the pod runtime (a pod of 1 works: "
+                    f"`tmog pod -n 1 ...`)")
+            if pod_ctx is not None:
+                pod_ctx.adopt_resume(resume)
+    if pod_ctx is not None:
+        if manager is not None:
+            manager.pod_record = pod_ctx.pod_record()
+        reader = pod_ctx.local_reader()
 
     rcfg = getattr(reader, "resilience", None)
     sink = rcfg.sink() if (rcfg is not None and rcfg.quarantines) else None
@@ -484,7 +517,10 @@ def fit_dag_streaming(
     stage_layer: Dict[str, int] = {
         s.uid: li for li, layer in enumerate(prefix) for s in layer}
     stage_kind: Dict[str, str] = {}
-    total_rows: Optional[int] = None
+    #: GLOBAL row count (pod: known up front from the shard plan; single
+    #: process: learned from the first completed pass)
+    total_rows: Optional[int] = (None if pod_ctx is None
+                                 else pod_ctx.total_rows)
     coll = current_collector()
     extras: Set[str] = set()  # plan-unknown passthroughs (e.g. "key")
 
@@ -508,10 +544,11 @@ def fit_dag_streaming(
     def run_reader_pass(label: str, ordered: List[PipelineStage],
                         final_needed: Set[str], per_chunk,
                         keep_unknown: bool, skip_chunks: int = 0,
-                        on_chunk=None) -> int:
+                        on_chunk=None, pod_skips=None,
+                        on_pod_entry=None, on_pod_chunk=None) -> int:
         """One prefetch-overlapped pass over the reader's chunks: transform
         through ``ordered`` (liveness-pruned), then hand the chunk to
-        ``per_chunk``.  Returns the row count.
+        ``per_chunk``.  Returns the row count (LOCAL rows under a pod).
 
         With a reader-side retry policy the chunk stream is wrapped in the
         resilience layer's ``RetryingChunkStream`` (transient IO errors
@@ -519,81 +556,134 @@ def fit_dag_streaming(
         exactly).  ``skip_chunks`` fast-skips a checkpoint resume's
         already-consumed chunks — read, counted, but neither transformed
         nor handed to ``per_chunk``.  ``on_chunk(idx, rows_so_far)`` runs
-        after each consumed chunk (the checkpoint cadence hook)."""
+        after each consumed chunk (the checkpoint cadence hook).
+
+        Under a pod the pass iterates this process's HOST ENTRIES in
+        order, one windowed stream per entry (each entry's chunk grid is
+        deterministic, so the pod checkpoint's per-entry cursors are
+        exact): ``pod_skips`` gives the per-entry resume skip,
+        ``on_pod_entry(entry_pos)`` fires before an entry's first chunk
+        (state-routing hook) and ``on_pod_chunk(entry_pos, chunks_done)``
+        after every consumed chunk (the pod checkpoint cadence)."""
         from ..obs.trace import begin_span, end_span
 
         pass_stats = ingest.begin_pass(label)
         if cv_ctx is not None:
             cv_ctx.begin_label_pass()
         needed_after = _liveness(ordered, final_needed)
-        if rcfg is not None and rcfg.retry is not None:
-            from ..readers.resilience import RetryingChunkStream
 
-            stream = RetryingChunkStream(
-                lambda: reader.iter_chunks(raw_features, chunk_rows),
-                rcfg.retry, on_retry=pass_stats.note_retry)
+        if pod_ctx is not None:
+            sources = []
+            for pos, entry in enumerate(pod_ctx.entries):
+                skip = pod_skips[pos] if pod_skips else 0
+                sources.append((pos, entry.range,
+                                (lambda _r=entry.range:
+                                 pod_ctx.inner_reader.iter_chunks(
+                                     raw_features, chunk_rows,
+                                     host_range=_r)),
+                                skip))
         else:
-            stream = reader.iter_chunks(raw_features, chunk_rows)
-        source = _TimedChunks(stream, pass_stats)
-        batcher = AsyncBatcher(source, depth=prefetch)
+            sources = [(None, (0, 0),
+                        lambda: reader.iter_chunks(raw_features,
+                                                   chunk_rows),
+                        skip_chunks)]
+
         rows = 0
-        chunk_idx = 0
+        total_chunks = 0
         pass_span = begin_span(f"ingest.pass:{label}", cat="ingest",
                                stages=len(ordered),
                                skip_chunks=skip_chunks)
         t_pass = time.perf_counter()
         try:
-            for chunk in batcher:
-                if chunk_filter is not None:
-                    chunk = chunk_filter(chunk)
-                if cv_ctx is not None and cv_ctx.collecting_labels:
-                    # fold assignment needs (n, y) up front: the label
-                    # column is collected from the RAW chunks of the
-                    # first executed pass (skipped chunks are still
-                    # read, so a mid-pass resume collects them too)
-                    cv_ctx.collect_labels(chunk)
-                if chunk_idx < skip_chunks:
-                    rows += len(chunk)
-                    pass_stats.chunks_skipped += 1
-                    chunk_idx += 1
-                    continue
-                t0 = time.perf_counter()
-                chunk_span = begin_span(f"ingest.chunk[{chunk_idx}]",
-                                        cat="ingest", parent=pass_span,
-                                        rows=len(chunk))
-                ds = chunk
+            for src_pos, src_range, factory, src_skip in sources:
+                if rcfg is not None and rcfg.retry is not None:
+                    from ..readers.resilience import RetryingChunkStream
+
+                    stream = RetryingChunkStream(
+                        factory, rcfg.retry,
+                        on_retry=pass_stats.note_retry)
+                else:
+                    stream = factory()
+                source = _TimedChunks(stream, pass_stats)
+                batcher = AsyncBatcher(source, depth=prefetch)
+                if on_pod_entry is not None:
+                    on_pod_entry(src_pos)
+                local_idx = 0
+                local_row = 0
                 try:
-                    if chunk_idx == 0 and keep_unknown:
-                        extras.update(c for c in ds.names()
-                                      if c not in known_universe)
-                    for idx, st in enumerate(ordered):
-                        ds = timed_transform(st, ds)
-                        na = needed_after[idx]
-                        ds = ds.select(
-                            [c for c in ds.names()
-                             if c in na or (keep_unknown and
-                                            c not in known_universe)])
-                    if cv_ctx is not None:
-                        # global row window of this chunk — fold-tagged
-                        # update_chunks slice their fold ids from it
-                        cv_ctx.set_window(rows, len(chunk))
-                    per_chunk(ds, chunk_idx)
+                    for chunk in batcher:
+                        if chunk_filter is not None:
+                            chunk = chunk_filter(chunk)
+                        if cv_ctx is not None and cv_ctx.collecting_labels:
+                            # fold assignment needs (n, y) up front: the
+                            # label column is collected from the RAW
+                            # chunks of the first executed pass (skipped
+                            # chunks are still read, so a mid-pass resume
+                            # collects them too)
+                            cv_ctx.collect_labels(chunk)
+                        if local_idx < src_skip:
+                            rows += len(chunk)
+                            local_row += len(chunk)
+                            pass_stats.chunks_skipped += 1
+                            local_idx += 1
+                            total_chunks += 1
+                            if on_pod_chunk is not None:
+                                on_pod_chunk(src_pos, local_idx)
+                            continue
+                        t0 = time.perf_counter()
+                        chunk_span = begin_span(
+                            f"ingest.chunk[{total_chunks}]",
+                            cat="ingest", parent=pass_span,
+                            rows=len(chunk))
+                        ds = chunk
+                        try:
+                            if total_chunks == 0 and keep_unknown:
+                                extras.update(c for c in ds.names()
+                                              if c not in known_universe)
+                            for idx, st in enumerate(ordered):
+                                ds = timed_transform(st, ds)
+                                na = needed_after[idx]
+                                ds = ds.select(
+                                    [c for c in ds.names()
+                                     if c in na or (keep_unknown and
+                                                    c not in
+                                                    known_universe)])
+                            if cv_ctx is not None:
+                                # GLOBAL row window of this chunk —
+                                # fold-tagged update_chunks slice their
+                                # fold ids from it (pod: offset by the
+                                # entry's global range start)
+                                base = (rows if pod_ctx is None
+                                        else src_range[0] + local_row)
+                                cv_ctx.set_window(base, len(chunk))
+                            per_chunk(ds, local_idx)
+                        finally:
+                            end_span(chunk_span)
+                        rows += len(chunk)
+                        local_row += len(chunk)
+                        pass_stats.note_transform(total_chunks,
+                                                  time.perf_counter() - t0)
+                        local_idx += 1
+                        total_chunks += 1
+                        if on_chunk is not None:
+                            on_chunk(local_idx - 1, rows)
+                        if on_pod_chunk is not None:
+                            on_pod_chunk(src_pos, local_idx)
                 finally:
-                    end_span(chunk_span)
-                rows += len(chunk)
-                pass_stats.note_transform(chunk_idx,
-                                          time.perf_counter() - t0)
-                if on_chunk is not None:
-                    on_chunk(chunk_idx, rows)
-                chunk_idx += 1
+                    batcher.close()
         finally:
-            batcher.close()
-            end_span(pass_span, chunks=chunk_idx, rows=rows)
+            end_span(pass_span, chunks=total_chunks, rows=rows)
         pass_stats.wall_s = time.perf_counter() - t_pass
         if rows == 0:
             raise ValueError("chunked reader produced no rows")
         if cv_ctx is not None:
             cv_ctx.finish_label_pass(rows)
+            if pod_ctx is not None and cv_ctx.labels_ready \
+                    and not getattr(pod_ctx, "labels_synced", False):
+                # the context collected LOCAL labels; fold assignment
+                # needs the GLOBAL vector on every process
+                pod_ctx.sync_cv_labels(cv_ctx)
+                pod_ctx.labels_synced = True
         return rows
 
     def update_states(ests, states, ds: ColumnarDataset) -> None:
@@ -682,8 +772,11 @@ def fit_dag_streaming(
             all_targets |= set(est.input_names)
     needed_uids = _closure(sorted(all_targets), out_stage)
 
-    writer = _ColumnWriter(total_rows, shard_onto=shard_onto,
-                           shard_columns=set(shard_columns or ()))
+    # under a pod the writer holds LOCAL rows only (this process's host
+    # ranges); the materialize pass gathers the pieces afterwards
+    writer = _ColumnWriter(
+        pod_ctx.local_rows if pod_ctx is not None else total_rows,
+        shard_onto=shard_onto, shard_columns=set(shard_columns or ()))
     materialized: Dict[str, FeatureColumn] = {}
 
     def write_only(ds: ColumnarDataset, _idx: int) -> None:
@@ -692,15 +785,23 @@ def fit_dag_streaming(
 
     def materialize_only_pass() -> int:
         """One reader pass over the (fully fitted) prefix writing every
-        materialized column — the no-estimator path, and the final pass
-        of a checkpointed CV train whose fold-tagged layers all ran as
-        dedicated checkpointable passes."""
+        materialized column — the no-estimator path, the final pass of a
+        checkpointed CV train whose fold-tagged layers all ran as
+        dedicated checkpointable passes, and EVERY pod train's final
+        pass (pod: local rows only, then the RSS probe and the
+        cross-process gather)."""
         ordered = [s for layer in prefix for s in layer
                    if s.uid in needed_uids]
         try:
             rows = run_reader_pass("materialize", ordered, set(mat_cols),
                                    write_only, keep_unknown=True)
-            materialized.update(writer.finish())
+            cols = writer.finish()
+            if pod_ctx is not None:
+                # the POD_SMOKE memory gate's probe point: per-host peak
+                # RSS BEFORE any process sees the full dataset
+                pod_ctx.note_ingest_rss(ingest)
+                cols = pod_ctx.gather_columns(cols)
+            materialized.update(cols)
             return rows
         except BaseException:
             writer.close()   # release per-shard device buffers on abort
@@ -844,6 +945,13 @@ def fit_dag_streaming(
             if tagged:
                 later = [li for li in est_idxs if li > max(tagged)]
                 fuse_at = later[0] if later else None
+        if pod_ctx is not None:
+            # pod trains always run the pass-structured shape: every
+            # estimator layer is a plain (exchange-mergeable,
+            # checkpointable) pass + one final materialize pass — the
+            # fused pass's block cascade is a single-process optimization
+            # whose retained blocks cannot allgather incrementally
+            fuse_at = None
 
         # plain reader fit passes for estimator layers before the fuse —
         # the checkpointable passes: their whole progress is the mergeable
@@ -898,6 +1006,49 @@ def fit_dag_streaming(
             ordered = [s for lj in range(li) for s in prefix[lj]
                        if s.uid in pass_uids]
             ensure_cv_folds(ests)
+            if pod_ctx is not None:
+                # -- pod fit pass: per-entry partial states, barrier-
+                #    fenced mid-pass saves, allgather merge at the end --
+                use_resume = (pod_ctx.resume_pass == pass_idx)
+                decode = (resume.decode_payload
+                          if (resume is not None and use_resume) else None)
+                entry_states = pod_ctx.init_entry_states(
+                    ests, decode, use_initial=use_resume)
+                pod_skips = ([e.skip_chunks for e in pod_ctx.entries]
+                             if use_resume else None)
+                saver = pod_ctx.pass_saver(manager, pass_idx, label,
+                                           ests, entry_states)
+                cur_entry = {"pos": 0}
+
+                def pod_update(ds, _i, _es=ests, _st=entry_states,
+                               _c=cur_entry):
+                    update_states(_es, _st[_c["pos"]], ds)
+
+                def on_pod_chunk(pos, done, _s=saver):
+                    if _s is not None:
+                        _s.note_chunk(pos, done)
+
+                run_reader_pass(
+                    label, ordered, set(target_inputs), pod_update,
+                    keep_unknown=False, pod_skips=pod_skips,
+                    on_pod_entry=lambda pos, _c=cur_entry:
+                        _c.__setitem__("pos", pos),
+                    on_pod_chunk=on_pod_chunk)
+                if saver is not None:
+                    saver.drain()
+                states = pod_ctx.merge_pass_states(ests, entry_states)
+                finish_layer(ests, states)
+                if manager is not None:
+                    t0 = time.perf_counter()
+                    pod_ctx.complete_pass(
+                        manager, pass_idx, label,
+                        {est.uid: fitted_by_uid[est.uid] for est in ests},
+                        state_payloads={
+                            est.uid: est.export_fit_state(states[est.uid])
+                            for est in ests
+                            if hasattr(est, "export_full_state")})
+                    _note_checkpoint(t0)
+                continue
             states = init_states(ests)
             skip = 0
             if (resume is not None and resume.current is not None
@@ -933,9 +1084,11 @@ def fit_dag_streaming(
 
         if fuse_at is None:
             # every estimator layer ran as a checkpointable plain pass
-            # (the deferred-fuse CV+checkpoint path): one final
-            # materialize pass over the fully fitted prefix
-            writer.total = total_rows
+            # (the deferred-fuse CV+checkpoint path, and every pod
+            # train): one final materialize pass over the fully fitted
+            # prefix
+            writer.total = (pod_ctx.local_rows if pod_ctx is not None
+                            else total_rows)
             materialize_only_pass()
             chain_outputs: Set[str] = set()
         else:
@@ -1069,6 +1222,12 @@ def fit_dag_streaming(
         keep_set = set(keep)
         data = data.select([c for c in data.names()
                             if c in keep_set or c not in known_universe])
+    if pod_ctx is not None:
+        # coordinator lands every process's buffered quarantine entries
+        # in the ONE sidecar; doubles as the train-end sync point
+        pod_ctx.flush_quarantine(sink)
+        if ingest.pod is None:
+            ingest.pod = pod_ctx.to_json()
     if sink is not None:
         ingest.quarantined_records = sink.count - q0_records
         ingest.quarantined_rows = sink.rows - q0_rows

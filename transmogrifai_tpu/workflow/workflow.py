@@ -250,6 +250,13 @@ class OpWorkflow(_WorkflowCore):
                 prefetch_chunks = advice.prefetch_chunks
                 retain_mb = advice.retain_mb
         tuned_stages = self._apply_tuner(tuner)
+        from ..distributed.runtime import current_pod
+
+        if current_pod().declared and chunk_rows is None:
+            raise ValueError(
+                "pod trains run out-of-core only — pass chunk_rows=k "
+                "(the pod protocol is built on host-sharded chunk "
+                "streams and mergeable fit states; docs/distributed.md)")
         root = begin_span("workflow.train", cat="workflow",
                           chunked=chunk_rows is not None,
                           chunk_rows=chunk_rows)
@@ -423,15 +430,37 @@ class OpWorkflow(_WorkflowCore):
                 else None)
         q0 = (sink.count, sink.rows) if sink is not None else (0, 0)
 
+        # -- pod context: this process is ONE MEMBER of a multi-process
+        #    train (distributed/podstream.py) — host-sharded ingest,
+        #    state merges at pass boundaries, coordinator-only durables
+        from ..distributed.runtime import current_pod
+
+        pod = current_pod()
+        pod_ctx = None
+        if pod.declared:
+            from ..distributed.podstream import PodStreamContext
+
+            pod_ctx = PodStreamContext(pod, self.reader,
+                                       self.raw_features(), chunk_rows)
+
         # -- RawFeatureFilter: chunked distribution pass + per-chunk clean
         filter_results = None
         rff_stats = None
         chunk_filter = None
         if self._raw_feature_filter is not None:
             with with_job_group(OpStep.DataReadingAndFiltering):
-                filter_results, rff_stats = (
-                    self._raw_feature_filter.filter_streaming(
-                        self.reader, self.raw_features(), chunk_rows))
+                if pod_ctx is not None:
+                    # each process profiles its own host ranges; the
+                    # monoid accumulators allgather-merge inside, so
+                    # every process makes identical drop decisions
+                    filter_results, rff_stats = (
+                        self._raw_feature_filter.filter_streaming(
+                            pod_ctx.local_reader(), self.raw_features(),
+                            chunk_rows, pod=pod))
+                else:
+                    filter_results, rff_stats = (
+                        self._raw_feature_filter.filter_streaming(
+                            self.reader, self.raw_features(), chunk_rows))
             self._apply_blocklist(filter_results.dropped_features)
             chunk_filter = self._rff_chunk_filter(filter_results)
 
@@ -468,7 +497,9 @@ class OpWorkflow(_WorkflowCore):
                     s.with_mesh(self.mesh)
             from ..parallel.mesh import has_grid_axis
 
-            if has_grid_axis(self.mesh):
+            if pod_ctx is not None:
+                pass  # pod trains gather on host; no device hand-off yet
+            elif has_grid_axis(self.mesh):
                 # streaming→sharded hand-off: each ModelSelector's packed
                 # feature matrix streams straight into per-shard device
                 # buffers (parallel/ingest.py) — the (N, D) matrix never
@@ -490,10 +521,12 @@ class OpWorkflow(_WorkflowCore):
                     profiler=profiler, prefetch=prefetch,
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_every=checkpoint_every,
-                    retain_mb=retain_mb, shard_onto=self.mesh,
+                    retain_mb=retain_mb,
+                    shard_onto=None if pod_ctx is not None else self.mesh,
                     shard_columns=shard_cols,
                     fingerprint_extra=fingerprint_extra,
-                    cv_ctx=cv_ctx, chunk_filter=chunk_filter)
+                    cv_ctx=cv_ctx, chunk_filter=chunk_filter,
+                    pod_ctx=pod_ctx)
         finally:
             for s, prev in meshed_stages:
                 s.with_mesh(prev)
@@ -603,6 +636,13 @@ class OpWorkflow(_WorkflowCore):
         if self.reader is None:
             raise RuntimeError(
                 "no refresh data — pass data= or set a reader")
+        from ..distributed.runtime import current_pod
+
+        if current_pod().declared:
+            raise ValueError(
+                "warm-start refresh does not yet compose with the pod "
+                "runtime — run the refresh single-process "
+                "(docs/distributed.md)")
         # RawFeatureFilter composes by REUSING the base model's recorded
         # drop decisions (re-profiling mid-refresh could change the DAG
         # geometry under the warm-started states — never silently);
