@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""Event-time ingestion benchmark — streamed vs in-core aggregation.
+
+The workload is a clickstream: per-user web events on disk (JSONL), a
+``StreamingConditionalReader`` that sets each user's cutoff at their
+first checkout visit, predictors monoid-aggregated BEFORE the cutoff and
+the response inside the day after — then the full AutoML train, a scoring
+pass over a fresh event log, and a drift check on an event-RATE shift
+(the same users generating 3x the events per session).
+
+Measured, one subprocess per mode (honest ``ru_maxrss``):
+
+* ``incore``  — the classic load-then-aggregate workflow: the whole
+  record log parsed into RAM (``ConditionalDataReader`` over a records
+  list), ``train()`` materializing the aggregated dataset whole;
+* ``streamed`` — ``train(chunk_rows=k)`` over a
+  ``StreamingConditionalReader`` on the JSONL file: the parse streams,
+  the event fold buffers only in-window events, and the workflow
+  consumes key-grid chunks.
+
+Full mode asserts the streamed fit's RSS delta < 0.5x in-core at the
+100k-event scale and writes ``benchmarks/events_latest.json``.
+``--smoke`` runs a small shape, asserts only the correctness legs
+(scoring parity across modes, drift quiet/fired), writes nothing — the
+scripts/tier1.sh EVENTS_SMOKE wiring.
+
+Usage:
+  python examples/bench_events.py [--users 5000] [--chunk-rows 512]
+  python examples/bench_events.py --smoke
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+HOUR = 3_600_000
+DAY = 24 * HOUR
+
+
+def _rss_mb() -> float:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def make_clickstream(path: str, n_users: int, seed: int = 9,
+                     rate: float = 1.0) -> int:
+    """Write a JSONL event log; returns the event count.  ``rate``
+    scales events-per-user (the drift leg's rate shift) without changing
+    the purchase behavior."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    uas = [f"Mozilla/5.0 (dev-{i}; rv:{100 + i}) Gecko/2026 shop/{i}.0"
+           for i in range(24)]
+    n_events = 0
+    with open(path, "w") as fh:
+        for u in range(n_users):
+            engaged = rng.random() < 0.5
+            t = int(rng.integers(0, 30)) * DAY
+            n_ev = int((int(rng.integers(6, 18)) + (8 if engaged else 0))
+                       * rate)
+            saw_checkout = False
+            ua = uas[int(rng.integers(0, len(uas)))]
+            for i in range(n_ev):
+                t += int(rng.integers(1, 12)) * HOUR
+                page = rng.choice(["home", "search", "product", "checkout"],
+                                  p=[0.3, 0.3, 0.3, 0.1])
+                if page == "checkout":
+                    saw_checkout = True
+                # referrer/session/ua are realistic clickstream payload the
+                # pipeline never extracts: streamed folds drop them at parse
+                # time, the in-core record log keeps them resident
+                fh.write(json.dumps({
+                    "user": f"u{u}", "time": t, "page": str(page),
+                    "dwell_s": round(float(rng.gamma(2.0, 20.0)
+                                           * (2.0 if engaged else 1.0)), 6),
+                    "purchase": 0.0,
+                    "session": f"s-{u}-{i // 6}-{t % DAY:08d}",
+                    "referrer": f"https://shop.example.com/{page}"
+                                f"?cid=c{int(rng.integers(0, 9999)):04d}"
+                                f"&src=organic",
+                    "ua": ua}) + "\n")
+                n_events += 1
+            if saw_checkout and engaged and rng.random() < 0.8:
+                fh.write(json.dumps({
+                    "user": f"u{u}", "time": t + HOUR, "page": "order",
+                    "dwell_s": 30.0, "purchase": 1.0,
+                    "session": f"s-{u}-{n_ev // 6}-{(t + HOUR) % DAY:08d}",
+                    "referrer": "https://shop.example.com/order",
+                    "ua": ua}) + "\n")
+                n_events += 1
+    return n_events
+
+
+def build_pipeline():
+    from transmogrifai_tpu import FeatureBuilder, transmogrify
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            grid)
+
+    visits = (FeatureBuilder.Integral("n_events")
+              .extract(lambda r: 1).aggregate("sumNumeric").as_predictor())
+    dwell = (FeatureBuilder.Real("total_dwell")
+             .extract(lambda r: r["dwell_s"]).aggregate("sumNumeric")
+             .as_predictor())
+    checkouts = (FeatureBuilder.Integral("n_checkout")
+                 .extract(lambda r: int(r["page"] == "checkout"),
+                          event_field="page")
+                 .aggregate("sumNumeric").as_predictor())
+    bought = (FeatureBuilder.Binary("purchased")
+              .extract(lambda r: bool(r["purchase"]),
+                       event_field="purchase")
+              .aggregate("maxBoolean").as_response())
+    features = transmogrify([visits, dwell, checkouts])
+    checked = SanityChecker(max_correlation=0.99).set_input(
+        bought, features).get_output()
+    pred = (BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(),
+                                grid(reg_param=[0.01, 0.1]))])
+        .set_input(bought, checked).get_output())
+    return pred
+
+
+def make_reader(jsonl: str):
+    from transmogrifai_tpu.readers import (JSONLinesReader,
+                                           StreamingConditionalReader)
+
+    return StreamingConditionalReader(
+        JSONLinesReader(jsonl),
+        key_fn=lambda r: r["user"],
+        time_fn=lambda r: r["time"],
+        target_condition=lambda r: r["page"] == "checkout",
+        predictor_window_ms=30 * DAY,
+        response_window_ms=DAY)
+
+
+def _probs(model, score_data=None):
+    from transmogrifai_tpu.types import feature_types as ft
+
+    s = model.score(data=score_data)
+    name = next(n for n in s.names()
+                if issubclass(s[n].ftype, ft.Prediction))
+    return [round(d["probability_1"], 9) for d in s[name].to_list()]
+
+
+def _warm_backend() -> None:
+    """Pay the one-time JAX/XLA compiler + BLAS residency BEFORE the
+    baseline RSS capture, so the measured delta is data structures —
+    record logs, fold state, materialized datasets — not jit machinery
+    common to both modes."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.zeros((256, 16), jnp.float32)
+    w = jnp.zeros((16,), jnp.float32)
+    jax.jit(lambda a: (a @ a.T).sum())(x).block_until_ready()
+    jax.grad(lambda v: ((x @ v) ** 2).sum())(w).block_until_ready()
+
+
+def child(jsonl: str, mode: str, chunk_rows: int) -> None:
+    """One measured train in THIS process; prints one JSON line."""
+    from transmogrifai_tpu import OpWorkflow
+
+    _warm_backend()
+    baseline_mb = _rss_mb()
+    if mode == "incore":
+        from transmogrifai_tpu.readers import ConditionalDataReader
+
+        # the classic workflow: the whole record log resident in RAM
+        with open(jsonl) as fh:
+            records = [json.loads(l) for l in fh]
+        reader = ConditionalDataReader(
+            records, key_fn=lambda r: r["user"],
+            time_fn=lambda r: r["time"],
+            target_condition=lambda r: r["page"] == "checkout",
+            predictor_window_ms=30 * DAY, response_window_ms=DAY)
+    else:
+        reader = make_reader(jsonl)
+    wf = (OpWorkflow().allow_non_serializable()
+          .set_result_features(build_pipeline()).set_reader(reader))
+    t0 = time.perf_counter()
+    model = wf.train(chunk_rows=chunk_rows if mode == "streamed" else None)
+    wall_s = time.perf_counter() - t0
+    peak_mb = _rss_mb()
+    out = {
+        "mode": mode, "wall_s": round(wall_s, 3),
+        "rows": len(model.train_data),
+        "baseline_rss_mb": round(baseline_mb, 1),
+        "peak_rss_mb": round(peak_mb, 1),
+        "rss_delta_mb": round(peak_mb - baseline_mb, 1),
+        "probs_head": _probs(model)[:20],
+    }
+    if model.ingest_profile is not None:
+        out["chunk_rows"] = chunk_rows
+        out["passes"] = len(model.ingest_profile.passes)
+    print(json.dumps(out), flush=True)
+
+
+def run_child(jsonl: str, mode: str, chunk_rows: int) -> dict:
+    cmd = [sys.executable, os.path.abspath(__file__), "--run-child",
+           "--jsonl", jsonl, "--mode", mode,
+           "--chunk-rows", str(chunk_rows)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TMOG_FAULTS", None)
+    if mode == "streamed":
+        env.setdefault("TMOG_STREAM_RETAIN_MB", "64")
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=3600)
+    lines = [l for l in (proc.stdout or "").splitlines()
+             if l.strip().startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(f"{mode} child failed rc={proc.returncode}: "
+                           f"{(proc.stderr or '')[-600:]}")
+    return json.loads(lines[-1])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=6500)
+    ap.add_argument("--chunk-rows", type=int, default=512)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small shape, correctness legs only, no json")
+    ap.add_argument("--run-child", action="store_true")
+    ap.add_argument("--jsonl")
+    ap.add_argument("--mode", choices=["incore", "streamed"])
+    args = ap.parse_args()
+
+    if args.run_child:
+        child(args.jsonl, args.mode, args.chunk_rows)
+        return
+
+    from transmogrifai_tpu import OpWorkflow
+    from transmogrifai_tpu.serving import DriftConfig, DriftMonitor
+
+    users = 150 if args.smoke else args.users
+    chunk_rows = min(args.chunk_rows, 64) if args.smoke else args.chunk_rows
+    log = lambda m: print(f"[bench_events] {m}", file=sys.stderr, flush=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        jsonl = os.path.join(tmp, "clickstream.jsonl")
+        n_events = make_clickstream(jsonl, users, seed=9)
+        log(f"{users} users, {n_events} events, chunk_rows={chunk_rows}")
+
+        # -- 1. streamed vs in-core fit (one subprocess each) --------------
+        incore = run_child(jsonl, "incore", chunk_rows)
+        streamed = run_child(jsonl, "streamed", chunk_rows)
+        rss_ratio = round(streamed["rss_delta_mb"]
+                          / max(incore["rss_delta_mb"], 1e-9), 3)
+        wall_ratio = round(streamed["wall_s"]
+                           / max(incore["wall_s"], 1e-9), 3)
+        log(f"rss delta {streamed['rss_delta_mb']:.0f}MB vs "
+            f"{incore['rss_delta_mb']:.0f}MB ({rss_ratio}x), wall "
+            f"{streamed['wall_s']:.1f}s vs {incore['wall_s']:.1f}s "
+            f"({wall_ratio}x)")
+        if streamed["probs_head"] != incore["probs_head"]:
+            raise RuntimeError("streamed and in-core fits diverged: "
+                               f"{streamed['probs_head'][:3]} vs "
+                               f"{incore['probs_head'][:3]}")
+        if streamed["rows"] != incore["rows"]:
+            raise RuntimeError("row-count mismatch between modes")
+        if not args.smoke and rss_ratio >= 0.5:
+            raise RuntimeError(
+                f"streamed event fit RSS delta {rss_ratio}x in-core — "
+                "the < 0.5x out-of-core contract failed")
+
+        # -- 2. train here for the serve + drift legs ----------------------
+        wf = (OpWorkflow().allow_non_serializable()
+              .set_result_features(build_pipeline())
+              .set_reader(make_reader(jsonl)))
+        model = wf.train(chunk_rows=chunk_rows)
+        raw_names = ["n_events", "total_dwell", "n_checkout", "purchased"]
+
+        def aggregated_records(path):
+            ds = make_reader(path).generate_dataset(
+                [f for f in wf.raw_features() if f.name in raw_names])
+            cols = {n: ds[n].to_list() for n in ds.names()}
+            return [dict(zip(cols, vals)) for vals in zip(*cols.values())]
+
+        # serve: score a FRESH same-rate event log through the model
+        fresh = os.path.join(tmp, "fresh.jsonl")
+        make_clickstream(fresh, users, seed=10)
+        served = _probs(model, score_data=make_reader(fresh)
+                        .generate_dataset(list(wf.raw_features())))
+        log(f"served {len(served)} aggregated rows")
+
+        # drift: same users, 3x the event RATE -> per-key sums shift.
+        # Shifted traffic is SUSTAINED: batches keep arriving until the
+        # monitor fires (the rolling window still holds the clean rows,
+        # so one small smoke batch alone is diluted below threshold).
+        monitor = DriftMonitor.from_model(model, config=DriftConfig(
+            min_rows=20, check_every=20))
+        monitor.observe_rows(aggregated_records(fresh))
+        quiet = not monitor.refresh_triggered
+        fired = False
+        for k in range(3):
+            shifted = os.path.join(tmp, f"shifted{k}.jsonl")
+            make_clickstream(shifted, users, seed=11 + k, rate=3.0)
+            monitor.observe_rows(aggregated_records(shifted))
+            fired = monitor.refresh_triggered
+            if fired:
+                break
+        drifted = list((monitor.last_evaluation or {})
+                       .get("driftedFeatures", []))
+        log(f"drift: quiet on same-rate={quiet}, fired on 3x rate={fired} "
+            f"({drifted})")
+        if not quiet or not fired:
+            raise RuntimeError(f"drift leg failed (quiet={quiet}, "
+                               f"fired={fired})")
+
+    import jax
+
+    out = {
+        "metric": "events_streamed_vs_incore_rss_delta",
+        "value": rss_ratio,
+        "unit": "x",
+        "wall_ratio": wall_ratio,
+        "events": n_events,
+        "users": users,
+        "rows": streamed["rows"],
+        "chunk_rows": chunk_rows,
+        "incore": incore,
+        "streamed": streamed,
+        "served_rows": len(served),
+        "drift": {"quiet_on_clean": quiet, "fired_on_rate_shift": fired,
+                  "drifted_features": drifted},
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(out), flush=True)
+    if not args.smoke:
+        from transmogrifai_tpu.obs import bench_meta
+        from transmogrifai_tpu.utils.jsonio import write_json_atomic
+        out["meta"] = bench_meta()
+        write_json_atomic(os.path.join(_ROOT, "benchmarks",
+                                       "events_latest.json"), out)
+
+
+if __name__ == "__main__":
+    main()

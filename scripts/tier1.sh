@@ -82,6 +82,17 @@ else
   echo "POD_SMOKE=FAILED (see /tmp/_t1_pod.log)"
   rc=1
 fi
+# event-time ingestion smoke: streamed vs in-core conditional-aggregate
+# fit on a small clickstream — byte-identical winner probabilities
+# between the two modes, event-time scoring of a fresh log through the
+# fitted model, and the DriftMonitor quiet on same-rate traffic but
+# fired on a 3x event-rate shift of the aggregated features
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python examples/bench_events.py --smoke > /tmp/_t1_events.log 2>&1; then
+  echo "EVENTS_SMOKE=ok $(grep -ao '"value": [0-9.]*' /tmp/_t1_events.log | tail -1)"
+else
+  echo "EVENTS_SMOKE=FAILED (see /tmp/_t1_events.log)"
+  rc=1
+fi
 # serving cold-start gate: two fresh subprocesses serve the same model
 # with device programs — the first JIT-compiles every shape bucket into
 # an empty AOT store, the second cold-starts by LOADING the serialized

@@ -1,0 +1,816 @@
+"""Event-time ingestion algebra (readers/events.py).
+
+Contracts under test:
+
+* streamed fold == in-core aggregation byte-for-byte, at ANY chunk_rows
+  (odd boundaries, keys spanning chunks) and over every source format;
+* cutoff-window semantics: predictors strictly BEFORE the cutoff
+  (t == cutoff excluded), responses inside [cutoff, cutoff+rw) only;
+* the per-key fold state is a mergeable monoid: shard by key-hash
+  ownership, merge in host order, serialize through the checkpoint
+  codec — all bit-preserving;
+* aggregate/conditional/joined readers report EXACT row counts, so
+  ``plan_host_shard`` never warns about a counting pre-pass;
+* joins stream as chunked sort-merge over key-sorted spill runs bounded
+  by ``TMOG_STREAM_RETAIN_MB``, row content identical to the in-core
+  pandas merge;
+* a corrupt event row quarantines ONCE with (source, location)
+  attribution across both fit passes; ``event.window`` io_errors ride
+  the ordinary retry path;
+* ``train(chunk_rows=...)`` over an event reader is chunking-invariant
+  (same winner + scores), resumes bit-exactly after a SIGKILL, and a
+  2-process pod reproduces the single-process rows;
+* TM060 fires on event-time leakage and is suppressible at the
+  feature's construction site.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow, transmogrify
+from transmogrifai_tpu.aggregators import (
+    CutOffTime, Event, FeatureAggregator,
+)
+from transmogrifai_tpu.distributed import host_ranges, plan_host_shard
+from transmogrifai_tpu.distributed.runtime import launch_local_pod
+from transmogrifai_tpu.readers import (
+    AggregateDataReader, ConditionalDataReader, EventFoldState,
+    JSONLinesReader, JoinedDataReader, RecordsReader,
+    StreamingAggregateReader, StreamingConditionalReader, key_owner,
+    merge_fold_states, streaming_view,
+)
+from transmogrifai_tpu.readers.aggregates import TimeBasedFilter
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils import faults
+from transmogrifai_tpu.utils.faults import FaultError, FaultSpec
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def make_events(n_keys=37, n_events=400, seed=3):
+    """Interleaved multi-key event log: consecutive records almost never
+    share a key, so every key's events span many chunks at small
+    chunk_rows — the regime the fold's cross-chunk merge must get right."""
+    rng = np.random.default_rng(seed)
+    events = []
+    for i in range(n_events):
+        k = int(rng.integers(0, n_keys))
+        events.append({
+            "id": f"k{k}",
+            "t": int(rng.integers(0, 1000)),
+            "amount": float(np.round(rng.gamma(2.0, 10.0), 6)),
+            "label": float(rng.random() < 0.4),
+        })
+    return events
+
+
+def _event_features():
+    amount = FeatureBuilder.Real("amount").as_predictor()
+    label = FeatureBuilder.RealNN("label").as_response()
+    return amount, label
+
+
+def _agg_reader(events, **kw):
+    kw.setdefault("cutoff", CutOffTime.unix(500))
+    return AggregateDataReader(events, key_fn=lambda r: r["id"],
+                               time_fn=lambda r: r["t"], **kw)
+
+
+def _cond_reader(events, **kw):
+    return ConditionalDataReader(
+        events, key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+        target_condition=lambda r: r["label"] > 0, **kw)
+
+
+def _collect(stream):
+    return list(stream)
+
+
+def _rows(ds, names):
+    cols = [ds[n].to_list() for n in names]
+    return list(zip(*cols))
+
+
+def _assert_stream_equals_dataset(reader, feats, chunk_rows, names,
+                                  host_range=None):
+    full = reader.generate_dataset(feats)
+    chunks = _collect(reader.iter_chunks(feats, chunk_rows,
+                                         host_range=host_range))
+    got = [r for c in chunks for r in _rows(c, names)]
+    want = _rows(full, names)
+    if host_range is not None:
+        want = want[host_range[0]:host_range[1]]
+    assert got == want
+    if chunks and host_range is None:
+        assert all(len(c) <= chunk_rows for c in chunks)
+
+
+# ---------------------------------------------------------------------------
+# streamed fold == in-core aggregation
+# ---------------------------------------------------------------------------
+
+NAMES = ["key", "amount", "label"]
+
+
+class TestStreamedChunkParity:
+    @pytest.mark.parametrize("chunk_rows", [3, 7, 64, 1000])
+    def test_aggregate_parity(self, chunk_rows):
+        reader = _agg_reader(make_events())
+        _assert_stream_equals_dataset(reader, list(_event_features()),
+                                      chunk_rows, NAMES)
+
+    @pytest.mark.parametrize("chunk_rows", [3, 7, 64, 1000])
+    def test_conditional_parity(self, chunk_rows):
+        reader = _cond_reader(make_events(), predictor_window_ms=600,
+                              response_window_ms=300)
+        _assert_stream_equals_dataset(reader, list(_event_features()),
+                                      chunk_rows, NAMES)
+
+    def test_windowed_aggregate_parity(self):
+        reader = _agg_reader(make_events(seed=5), predictor_window_ms=250,
+                             response_window_ms=100)
+        _assert_stream_equals_dataset(reader, list(_event_features()),
+                                      7, NAMES)
+
+    def test_streaming_view_is_in_core_twin(self):
+        events = make_events(n_keys=9, n_events=80)
+        incore = _cond_reader(events)
+        feats = list(_event_features())
+        sv = streaming_view(incore)
+        assert isinstance(sv, StreamingConditionalReader)
+        a = incore.generate_dataset(feats)
+        b = sv.generate_dataset(feats)
+        assert _rows(a, NAMES) == _rows(b, NAMES)
+
+    def test_source_format_invariance(self, tmp_path):
+        events = make_events(n_keys=11, n_events=120, seed=8)
+        feats = list(_event_features())
+        df = pd.DataFrame(events)
+        jsonl = str(tmp_path / "ev.jsonl")
+        with open(jsonl, "w") as fh:
+            for r in events:
+                fh.write(json.dumps(r) + "\n")
+        want = _rows(_agg_reader(events).generate_dataset(feats), NAMES)
+        for source in (df, JSONLinesReader(jsonl), RecordsReader(events)):
+            reader = StreamingAggregateReader(
+                source, key_fn=lambda r: r["id"],
+                time_fn=lambda r: r["t"], cutoff=CutOffTime.unix(500))
+            got = [r for c in reader.iter_chunks(feats, 16)
+                   for r in _rows(c, NAMES)]
+            assert got == want, type(source).__name__
+
+    def test_host_range_slices_key_universe(self):
+        reader = _agg_reader(make_events())
+        feats = list(_event_features())
+        n = reader.estimate_rows()
+        for rng in host_ranges(n, 2) + [(1, n - 2)]:
+            _assert_stream_equals_dataset(reader, feats, 7, NAMES,
+                                          host_range=rng)
+
+    def test_chunk_grid_is_global_under_host_range(self):
+        # both pod halves ride the SAME chunk grid, so stitching them
+        # reproduces the single-process chunk sequence bit-for-bit
+        reader = _agg_reader(make_events())
+        feats = list(_event_features())
+        n = reader.estimate_rows()
+        whole = [_rows(c, NAMES)
+                 for c in reader.iter_chunks(feats, 8)]
+        parts = []
+        for rng in host_ranges(n, 3):
+            parts.extend(_rows(c, NAMES) for c in
+                         reader.iter_chunks(feats, 8, host_range=rng))
+        assert [r for c in parts for r in c] == [r for c in whole
+                                                 for r in c]
+
+
+# ---------------------------------------------------------------------------
+# cutoff-window semantics
+# ---------------------------------------------------------------------------
+
+class TestCutoffWindowSemantics:
+    def _one_key(self, events, **kw):
+        reader = StreamingAggregateReader(
+            events, key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+            **kw)
+        amount, label = _event_features()
+        ds = reader.generate_dataset([amount, label])
+        return ds["amount"].to_list()[0], ds["label"].to_list()[0]
+
+    def test_event_at_cutoff_is_response_not_predictor(self):
+        events = [{"id": "a", "t": 500, "amount": 8.0, "label": 1.0},
+                  {"id": "a", "t": 499, "amount": 3.0, "label": 0.0}]
+        amount, label = self._one_key(events, cutoff=CutOffTime.unix(500))
+        assert amount == 3.0        # t == cutoff strictly excluded
+        assert label == 1.0         # ... but inside the response window
+
+    def test_response_window_half_open(self):
+        events = [{"id": "a", "t": 500, "amount": 1.0, "label": 1.0},
+                  {"id": "a", "t": 599, "amount": 1.0, "label": 1.0},
+                  {"id": "a", "t": 600, "amount": 1.0, "label": 1.0}]
+        _, label = self._one_key(events, cutoff=CutOffTime.unix(500),
+                                 response_window_ms=100)
+        # [500, 600): t=600 falls out, sum over {t=500, t=599}
+        _, label2 = self._one_key(
+            [{"id": "a", "t": 600, "amount": 1.0, "label": 1.0}],
+            cutoff=CutOffTime.unix(500), response_window_ms=100)
+        assert label == 2.0 and label2 is None
+
+    def test_predictor_window_closed_left(self):
+        events = [{"id": "a", "t": 400, "amount": 2.0, "label": 0.0},
+                  {"id": "a", "t": 399, "amount": 32.0, "label": 0.0},
+                  {"id": "a", "t": 499, "amount": 4.0, "label": 0.0}]
+        amount, _ = self._one_key(events, cutoff=CutOffTime.unix(500),
+                                  predictor_window_ms=100)
+        assert amount == 6.0        # [400, 500): 2+4, t=399 excluded
+
+    def test_conditional_cutoff_is_first_match(self):
+        events = [{"id": "a", "t": 30, "amount": 1.0, "label": 0.0},
+                  {"id": "a", "t": 10, "amount": 2.0, "label": 0.0},
+                  {"id": "a", "t": 20, "amount": 4.0, "label": 1.0},
+                  {"id": "a", "t": 40, "amount": 8.0, "label": 1.0}]
+        reader = _cond_reader(events)
+        ds = reader.generate_dataset(list(_event_features()))
+        # first match at t=20 (minimum matching time, not file order)
+        assert ds["amount"].to_list() == [2.0]
+
+    def test_drop_if_no_target(self):
+        events = [{"id": "a", "t": 1, "amount": 1.0, "label": 1.0},
+                  {"id": "b", "t": 2, "amount": 2.0, "label": 0.0}]
+        assert _cond_reader(events).generate_dataset(
+            list(_event_features()))["key"].to_list() == ["a"]
+        kept = _cond_reader(events, drop_if_no_target=False)
+        assert kept.generate_dataset(
+            list(_event_features()))["key"].to_list() == ["a", "b"]
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_property_matches_feature_aggregator(self, seed):
+        """Brute-force oracle: per key, the streamed result must equal
+        ``FeatureAggregator.extract`` over that key's stable-time-sorted
+        events — for random windows and a random cutoff."""
+        rng = np.random.default_rng(seed)
+        events = make_events(n_keys=13, n_events=200, seed=seed + 40)
+        cutoff = int(rng.integers(200, 800))
+        pw = int(rng.integers(50, 500))
+        rw = int(rng.integers(50, 500))
+        reader = _agg_reader(events, cutoff=CutOffTime.unix(cutoff),
+                             predictor_window_ms=pw, response_window_ms=rw)
+        ds = reader.generate_dataset(list(_event_features()))
+        pred = FeatureAggregator(ft.Real, is_response=False,
+                                 predictor_window_ms=pw)
+        resp = FeatureAggregator(ft.RealNN, is_response=True,
+                                 response_window_ms=rw)
+        by_key = {}
+        for r in events:
+            by_key.setdefault(r["id"], []).append(r)
+        keys = sorted(by_key, key=repr)
+        assert ds["key"].to_list() == keys
+        for i, k in enumerate(keys):
+            evs = by_key[k]
+            a = pred.extract([Event(r["t"], r["amount"]) for r in evs],
+                             cutoff_ms=cutoff)
+            l = resp.extract([Event(r["t"], r["label"]) for r in evs],
+                             cutoff_ms=cutoff)
+            assert ds["amount"].to_list()[i] == a, k
+            assert ds["label"].to_list()[i] == l, k
+
+
+# ---------------------------------------------------------------------------
+# the fold state is a mergeable, serializable monoid
+# ---------------------------------------------------------------------------
+
+class TestFoldStateAlgebra:
+    def test_key_owner_is_stable_and_bounded(self):
+        # crc32-of-repr, NOT hash(): identical across processes with
+        # different PYTHONHASHSEED (the pod ownership contract)
+        for n in (1, 2, 5):
+            for k in ("a", "u19", 7, ("x", 3)):
+                o = key_owner(k, n)
+                assert 0 <= o < n
+                assert o == key_owner(k, n)
+        owners = {key_owner(f"k{i}", 4) for i in range(64)}
+        assert owners == {0, 1, 2, 3}   # spreads, no dead shard
+
+    def test_shard_merge_state_roundtrip_parity(self):
+        events = make_events(n_keys=17, n_events=150, seed=11)
+        feats = list(_event_features())
+        reader = streaming_view(_agg_reader(events))
+        index = reader._index()
+        aggs = reader._aggregators(feats)
+        n = len(index.keys)
+        whole = reader._fold(feats, index, 0, n)
+        shards = whole.shard(3)
+        assert sorted(k for s in shards for k in s.rows) == \
+            sorted(whole.rows)
+        for i, s in enumerate(shards):
+            assert all(key_owner(k, 3) == i for k in s.rows)
+        # serialize each shard through the checkpoint codec, merge in
+        # host order: the merged state must finalize bit-identically
+        revived = [EventFoldState.from_state(s.to_state()) for s in shards]
+        merged = merge_fold_states(revived)
+        a = reader._finalize_block(feats, aggs, index, whole, 0, n)
+        b = reader._finalize_block(feats, aggs, index, merged, 0, n)
+        assert _rows(a, NAMES) == _rows(b, NAMES)
+
+    def test_merge_is_order_normalizing(self):
+        # a key's rows arriving via ANY shard interleaving still finalize
+        # identically ((time, seq) sort at finalize, not arrival order)
+        events = make_events(n_keys=5, n_events=60, seed=2)
+        feats = list(_event_features())
+        reader = streaming_view(_agg_reader(events))
+        index = reader._index()
+        aggs = reader._aggregators(feats)
+        n = len(index.keys)
+        whole = reader._fold(feats, index, 0, n)
+        s0, s1 = whole.shard(2)
+        fwd = merge_fold_states(
+            [EventFoldState.from_state(s0.to_state()),
+             EventFoldState.from_state(s1.to_state())])
+        rev = merge_fold_states(
+            [EventFoldState.from_state(s1.to_state()),
+             EventFoldState.from_state(s0.to_state())])
+        a = reader._finalize_block(feats, aggs, index, whole, 0, n)
+        b = reader._finalize_block(feats, aggs, index, fwd, 0, n)
+        c = reader._finalize_block(feats, aggs, index, rev, 0, n)
+        assert _rows(a, NAMES) == _rows(b, NAMES) == _rows(c, NAMES)
+
+
+# ---------------------------------------------------------------------------
+# exact row estimates (no counting pre-pass)
+# ---------------------------------------------------------------------------
+
+class TestExactEstimates:
+    def test_aggregate_counts_distinct_keys(self):
+        events = make_events(n_keys=23, n_events=300)
+        reader = _agg_reader(events)
+        assert reader.estimate_rows_exact()
+        assert reader.estimate_rows() == len({r["id"] for r in events})
+
+    def test_conditional_counts_post_policy_keys(self):
+        events = make_events(n_keys=19, n_events=200, seed=6)
+        reader = _cond_reader(events)
+        matched = {r["id"] for r in events if r["label"] > 0}
+        assert reader.estimate_rows() == len(matched)
+        assert reader.estimate_rows_exact()
+
+    def _joined(self, join_type):
+        left = [{"key": "k1", "x": 1.0}, {"key": "k2", "x": 2.0},
+                {"key": "k2", "x": 3.0}]
+        right = [{"key": "k2", "z": 20.0}, {"key": "k2", "z": 21.0},
+                 {"key": "k3", "z": 30.0}]
+        xf = FeatureBuilder.Real("x").as_predictor()
+        zf = FeatureBuilder.Real("z").as_predictor()
+        return JoinedDataReader(RecordsReader(left), RecordsReader(right),
+                                [xf], [zf], join_type=join_type,
+                                left_key="key", right_key="key"), xf, zf
+
+    @pytest.mark.parametrize("join_type", ["inner", "left", "outer"])
+    def test_joined_estimate_matches_materialized(self, join_type):
+        jr, xf, zf = self._joined(join_type)
+        assert jr.estimate_rows_exact()
+        assert jr.estimate_rows() == len(
+            jr.generate_dataset([xf, zf]))
+
+    def test_no_counting_prepass_warning(self, recwarn):
+        events = make_events(n_keys=12, n_events=100)
+        plan = plan_host_shard(_agg_reader(events),
+                               list(_event_features()), 4, 2)
+        assert plan.total_rows == 12 and not plan.counted
+        jr, xf, zf = self._joined("outer")
+        plan = plan_host_shard(jr, [xf, zf], 2, 2)
+        assert not plan.counted
+        assert not [w for w in recwarn.list
+                    if "counting pre-pass" in str(w.message)]
+
+
+# ---------------------------------------------------------------------------
+# streamed joins: sort-merge over key-sorted spill runs
+# ---------------------------------------------------------------------------
+
+class TestJoinStreaming:
+    def _sides(self, n=40, seed=4):
+        rng = np.random.default_rng(seed)
+        left = [{"key": f"k{int(rng.integers(0, 12))}",
+                 "x": float(i), "tl": int(rng.integers(0, 100))}
+                for i in range(n)]
+        right = [{"key": f"k{int(rng.integers(0, 16))}",
+                  "z": float(i * 10), "tr": int(rng.integers(0, 100))}
+                 for i in range(n)]
+        xf = FeatureBuilder.Real("x").as_predictor()
+        zf = FeatureBuilder.Real("z").as_predictor()
+        return left, right, xf, zf
+
+    @pytest.mark.parametrize("join_type", ["inner", "left", "outer"])
+    def test_stream_join_content_parity(self, join_type):
+        left, right, xf, zf = self._sides()
+        jr = JoinedDataReader(RecordsReader(left), RecordsReader(right),
+                              [xf], [zf], join_type=join_type,
+                              left_key="key", right_key="key")
+        feats = [xf, zf]
+        want = sorted(_rows(jr.generate_dataset(feats),
+                            ["key", "x", "z"]))
+        chunks = _collect(jr.stream(feats, 7))
+        got = [r for c in chunks for r in _rows(c, ["key", "x", "z"])]
+        # streamed order is key-sorted (stable in-key); content identical
+        assert sorted(got) == want
+        assert [r[0] for r in got] == sorted(r[0] for r in got)
+        assert all(len(c) <= 7 for c in chunks)
+
+    def test_stream_join_spills_under_tiny_budget(self, monkeypatch):
+        left, right, xf, zf = self._sides(n=120, seed=9)
+        jr = JoinedDataReader(RecordsReader(left), RecordsReader(right),
+                              [xf], [zf], join_type="outer",
+                              left_key="key", right_key="key")
+        feats = [xf, zf]
+        want = [r for c in jr.stream(feats, 13)
+                for r in _rows(c, ["key", "x", "z"])]
+        monkeypatch.setenv("TMOG_STREAM_RETAIN_MB", "0.01")  # force spill
+        got = [r for c in jr.stream(feats, 13)
+               for r in _rows(c, ["key", "x", "z"])]
+        assert got == want
+
+    def test_stream_join_aggregate_byte_parity(self):
+        left, right, xf, zf = self._sides(n=60, seed=12)
+        tlf = FeatureBuilder.Integral("tl").as_predictor()
+        trf = FeatureBuilder.Integral("tr").as_predictor()
+        jr = JoinedDataReader(
+            RecordsReader(left), RecordsReader(right), [xf, tlf],
+            [zf, trf], join_type="left", left_key="key", right_key="key"
+        ).with_secondary_aggregation(
+            TimeBasedFilter(condition="tr", primary="tl", window_ms=50))
+        feats = [xf, zf]
+        want = _rows(jr.generate_dataset(feats), ["key", "x", "z"])
+        got = [r for c in jr.stream(feats, 5)
+               for r in _rows(c, ["key", "x", "z"])]
+        assert got == want      # same rows, same sorted-key order
+
+    def test_join_chunk_fault_point_fires(self):
+        left, right, xf, zf = self._sides(n=20)
+        jr = JoinedDataReader(RecordsReader(left), RecordsReader(right),
+                              [xf], [zf], join_type="inner",
+                              left_key="key", right_key="key")
+        with faults.inject(FaultSpec(point="join.chunk", action="raise",
+                                     at=1)):
+            with pytest.raises(FaultError):
+                _collect(jr.stream([xf, zf], 4))
+
+
+# ---------------------------------------------------------------------------
+# resilience: quarantine-once attribution + retried event windows
+# ---------------------------------------------------------------------------
+
+class TestEventResilience:
+    def _jsonl_with_corrupt_line(self, tmp_path, events, bad_at=18):
+        p = str(tmp_path / "ev.jsonl")
+        with open(p, "w") as fh:
+            for i, r in enumerate(events):
+                fh.write("{not json]\n" if i == bad_at
+                         else json.dumps(r) + "\n")
+        return p
+
+    def test_corrupt_line_quarantines_once_across_passes(self, tmp_path):
+        events = make_events(n_keys=7, n_events=60, seed=13)
+        p = self._jsonl_with_corrupt_line(tmp_path, events)
+        qpath = str(tmp_path / "quarantine.jsonl")
+        reader = StreamingAggregateReader(
+            JSONLinesReader(p), key_fn=lambda r: r["id"],
+            time_fn=lambda r: r["t"], cutoff=CutOffTime.unix(500)
+        ).with_resilience(bad_records="quarantine", quarantine_path=qpath)
+        feats = list(_event_features())
+        ds = reader.generate_dataset(feats)          # pass 1 (scan + fold)
+        _collect(reader.iter_chunks(feats, 16))      # pass 2 (re-fold)
+        sink = reader.resilience.sink()
+        assert sink.count == 1 and sink.rows == 1    # deduped across passes
+        entry = json.loads(open(qpath).read().splitlines()[0])
+        assert "line 19" in entry["location"]        # 1-based attribution
+        clean = [r for i, r in enumerate(events) if i != 18]
+        want = _rows(_agg_reader(clean).generate_dataset(feats), NAMES)
+        assert _rows(ds, NAMES) == want              # row really dropped
+
+    def _float_features(self):
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: float(r["amount"])).as_predictor())
+        label = FeatureBuilder.RealNN("label").as_response()
+        return [amount, label]
+
+    def test_bad_extract_quarantines_at_event_record(self, tmp_path):
+        events = make_events(n_keys=5, n_events=40, seed=14)
+        events[13]["amount"] = {"oops": 1}           # breaks float()
+        qpath = str(tmp_path / "q.jsonl")
+        reader = _agg_reader(events).with_resilience(
+            bad_records="quarantine", quarantine_path=qpath)
+        feats = self._float_features()
+        reader.generate_dataset(feats)
+        _collect(reader.iter_chunks(feats, 8))
+        sink = reader.resilience.sink()
+        assert sink.count == 1
+        entry = json.loads(open(qpath).read().splitlines()[0])
+        assert entry["location"] == "event-record#13"
+
+    def test_fail_fast_without_resilience(self):
+        events = make_events(n_keys=5, n_events=40, seed=14)
+        events[13]["amount"] = {"oops": 1}
+        with pytest.raises((TypeError, ValueError)):
+            _agg_reader(events).generate_dataset(self._float_features())
+
+    def test_event_window_io_error_rides_retry(self):
+        from transmogrifai_tpu.readers.resilience import (
+            RetryingChunkStream, RetryPolicy)
+
+        reader = _agg_reader(make_events(n_keys=9, n_events=80, seed=15))
+        feats = list(_event_features())
+        want = [r for c in reader.iter_chunks(feats, 4)
+                for r in _rows(c, NAMES)]
+        with faults.inject(FaultSpec(point="event.window",
+                                     action="io_error", at=2, times=1)):
+            stream = RetryingChunkStream(
+                lambda: reader.iter_chunks(feats, 4),
+                RetryPolicy(max_attempts=3, base_delay_s=0.0))
+            got = [r for c in stream for r in _rows(c, NAMES)]
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# train-plane composition
+# ---------------------------------------------------------------------------
+
+def _purchase_pipeline():
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.preparators import SanityChecker
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, grid)
+
+    amount = FeatureBuilder.Real("amount").as_predictor()
+    label = FeatureBuilder.RealNN("label").as_response()
+    n_ev = (FeatureBuilder.Integral("n_events")
+            .extract(lambda r: 1).aggregate("sumNumeric").as_predictor())
+    features = transmogrify([amount, n_ev])
+    checked = SanityChecker(min_variance=-1.0).set_input(
+        label, features).get_output()
+    pred = (BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(),
+                                grid(reg_param=[0.01, 0.1]))])
+        .set_input(label, checked).get_output())
+    return pred
+
+
+def _probs_of(model):
+    s = model.score()
+    name = next(n for n in s.names()
+                if issubclass(s[n].ftype, ft.Prediction))
+    return [round(d["probability_1"], 9) for d in s[name].to_list()]
+
+
+def _winner_of(model):
+    for s in model.stages:
+        summ = getattr(s, "metadata", {}).get("model_selector_summary")
+        if summ:
+            return (summ["bestModelType"], summ.get("bestModelParams"))
+    return None
+
+
+@pytest.mark.slow
+class TestTrainChunkingInvariance:
+    def test_same_winner_and_scores_at_any_chunk_rows(self):
+        events = make_events(n_keys=60, n_events=900, seed=21)
+        results = {}
+        for cr in (None, 7, 64):
+            reader = _cond_reader(events, predictor_window_ms=2000,
+                                  response_window_ms=2000)
+            wf = (OpWorkflow().allow_non_serializable()
+                  .set_result_features(_purchase_pipeline())
+                  .set_reader(reader))
+            m = wf.train(chunk_rows=cr)
+            results[cr] = (_winner_of(m), _probs_of(m))
+        assert results[7] == results[None]
+        assert results[64] == results[None]
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+class TestEventKillResume:
+    """SIGKILL the event-reader fit at a checkpoint barrier; the rerun
+    must resume (not restart) and reproduce the uninterrupted model's
+    scores bit-exactly — the fold state rebuilt from the durable cursor."""
+
+    CHILD = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r}); sys.path.insert(0, {repo!r} + "/tests")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import conftest  # noqa: F401  (platform pinning)
+from test_events_streaming import _purchase_pipeline
+from transmogrifai_tpu import OpWorkflow
+from transmogrifai_tpu.readers import (JSONLinesReader,
+                                       StreamingConditionalReader)
+from transmogrifai_tpu.types import feature_types as ft
+
+jsonl, ckpt = sys.argv[1], sys.argv[2]
+reader = StreamingConditionalReader(
+    JSONLinesReader(jsonl), key_fn=lambda r: r["id"],
+    time_fn=lambda r: r["t"], target_condition=lambda r: r["label"] > 0,
+    predictor_window_ms=2000, response_window_ms=2000)
+wf = (OpWorkflow().allow_non_serializable()
+      .set_result_features(_purchase_pipeline()).set_reader(reader))
+m = wf.train(chunk_rows=8, checkpoint_dir=ckpt, checkpoint_every_chunks=2)
+print("RESUMED", m.ingest_profile.resumed)
+s = m.score()
+name = next(n for n in s.names() if issubclass(s[n].ftype, ft.Prediction))
+p = [round(d["probability_1"], 9) for d in s[name].to_list()]
+print("RESULT", p[:25])
+"""
+
+    def _run_child(self, jsonl, ckpt, kill_at=None, timeout=420):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("TMOG_FAULTS", None)
+        if kill_at is not None:
+            env["TMOG_FAULTS"] = json.dumps({"faults": [
+                {"point": "checkpoint.barrier", "action": "kill",
+                 "at": kill_at}]})
+        return subprocess.run(
+            [sys.executable, "-c", self.CHILD.format(repo=_ROOT), jsonl,
+             ckpt], capture_output=True, text=True, env=env,
+            timeout=timeout)
+
+    def test_sigkill_mid_aggregation_resumes_bit_exact(self, tmp_path):
+        events = make_events(n_keys=48, n_events=700, seed=22)
+        jsonl = str(tmp_path / "ev.jsonl")
+        with open(jsonl, "w") as fh:
+            for r in events:
+                fh.write(json.dumps(r) + "\n")
+        ckpt = str(tmp_path / "ckpt")
+        killed = self._run_child(jsonl, ckpt, kill_at=2)
+        assert killed.returncode == -9, killed.stderr[-600:]
+        assert os.path.exists(os.path.join(ckpt, "checkpoint.json"))
+        resumed = self._run_child(jsonl, ckpt)
+        assert resumed.returncode == 0, resumed.stderr[-800:]
+        assert "RESUMED True" in resumed.stdout
+        clean = self._run_child(jsonl, str(tmp_path / "ckpt2"))
+        assert clean.returncode == 0, clean.stderr[-800:]
+        assert "RESUMED False" in clean.stdout
+        got = [l for l in resumed.stdout.splitlines()
+               if l.startswith("RESULT")]
+        want = [l for l in clean.stdout.splitlines()
+                if l.startswith("RESULT")]
+        assert got and got == want
+
+
+@pytest.mark.slow
+class TestPodKeyOwnership:
+    """A 2-process pod over an event reader: each process streams ONLY
+    its host slice of the sorted key universe; the stitched rows equal
+    the single-process dataset exactly."""
+
+    CHILD = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r}); sys.path.insert(0, {repo!r} + "/tests")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import conftest  # noqa: F401
+from test_events_streaming import _event_features
+from transmogrifai_tpu.distributed import plan_host_shard
+from transmogrifai_tpu.readers import (JSONLinesReader,
+                                       StreamingConditionalReader)
+
+jsonl = sys.argv[1]
+idx = int(os.environ["TMOG_POD_PROCESS_ID"])
+n = int(os.environ["TMOG_POD_NUM_PROCESSES"])
+reader = StreamingConditionalReader(
+    JSONLinesReader(jsonl), key_fn=lambda r: r["id"],
+    time_fn=lambda r: r["t"], target_condition=lambda r: r["label"] > 0)
+feats = list(_event_features())
+plan = plan_host_shard(reader, feats, 8, n)
+rows = []
+for c in reader.iter_chunks(feats, 8, host_range=plan.range_of(idx)):
+    rows += list(zip(c["key"].to_list(), c["amount"].to_list(),
+                     c["label"].to_list()))
+print("POD_RESULT", json.dumps(dict(counted=plan.counted, rows=rows)))
+"""
+
+    def test_two_process_rows_stitch_to_single(self, tmp_path):
+        events = make_events(n_keys=21, n_events=240, seed=30)
+        jsonl = str(tmp_path / "ev.jsonl")
+        with open(jsonl, "w") as fh:
+            for r in events:
+                fh.write(json.dumps(r) + "\n")
+        child = str(tmp_path / "pod_child.py")
+        with open(child, "w") as fh:
+            fh.write(self.CHILD.format(repo=_ROOT))
+        base = dict(os.environ, JAX_PLATFORMS="cpu")
+        base.pop("TMOG_FAULTS", None)
+        res = launch_local_pod(2, [sys.executable, child, jsonl],
+                               local_devices=1, base_env=base,
+                               timeout=240)
+        assert [r["returncode"] for r in res] == [0, 0], (
+            res[0]["stderr"][-400:] + res[1]["stderr"][-400:])
+        parts = []
+        for r in res:
+            line = next(l for l in r["stdout"].splitlines()
+                        if l.startswith("POD_RESULT "))
+            rec = json.loads(line[len("POD_RESULT "):])
+            assert not rec["counted"]    # exact estimate, no pre-pass
+            parts.append([tuple(row) for row in rec["rows"]])
+        single = _cond_reader(events).generate_dataset(
+            list(_event_features()))
+        assert parts[0] + parts[1] == _rows(single, NAMES)
+
+
+# ---------------------------------------------------------------------------
+# TM060 — event-time leakage lint
+# ---------------------------------------------------------------------------
+
+class TestTM060:
+    def _lint(self, feats, reader):
+        from transmogrifai_tpu.analysis.linter import lint_dag
+        from transmogrifai_tpu.workflow.dag import StagesDAG
+
+        return lint_dag(StagesDAG([[f.origin_stage for f in feats]]),
+                        reader=reader)
+
+    def test_fires_on_no_cutoff_reader(self):
+        amount, label = _event_features()
+        reader = _agg_reader([], cutoff=CutOffTime.no_cutoff())
+        findings = self._lint([amount, label], reader)
+        assert findings.rules_fired() == ["TM060"]
+        assert "no cutoff" in findings.format()
+
+    def test_fires_on_response_field_as_predictor(self):
+        leak = (FeatureBuilder.Real("leak")
+                .extract(lambda r: r["purchase"], event_field="purchase")
+                .as_predictor())
+        bought = (FeatureBuilder.Binary("bought")
+                  .extract(lambda r: bool(r["purchase"]),
+                           event_field="purchase").as_response())
+        findings = self._lint([leak, bought], _agg_reader(
+            [], cutoff=CutOffTime.unix(10)))
+        assert findings.rules_fired() == ["TM060"]
+        assert "'purchase'" in findings.format()
+
+    def test_fires_on_implicit_name_field_overlap(self):
+        # no extract_fn -> the implicit r.get(name) read IS the field
+        amount = FeatureBuilder.Real("amount").as_predictor()
+        label = (FeatureBuilder.RealNN("lbl")
+                 .extract(lambda r: r["amount"], event_field="amount")
+                 .as_response())
+        findings = self._lint([amount, label], _agg_reader(
+            [], cutoff=CutOffTime.unix(10)))
+        assert findings.rules_fired() == ["TM060"]
+
+    def test_silent_on_conditional_reader(self):
+        amount, label = _event_features()
+        findings = self._lint([amount, label], _cond_reader([]))
+        assert findings.rules_fired() == []
+
+    def test_silent_on_non_event_reader(self):
+        amount, label = _event_features()
+        findings = self._lint([amount, label],
+                              RecordsReader([{"amount": 1.0}]))
+        assert findings.rules_fired() == []
+
+    def test_suppression_at_construction_site(self, tmp_path):
+        src = (
+            "from transmogrifai_tpu import FeatureBuilder\n"
+            "prev = (FeatureBuilder.Real('prev_purchase')\n"
+            "        .extract(lambda r: r['purchase'],\n"
+            "                 event_field='purchase')\n"
+            "        .as_predictor())  # tmog: disable=TM060\n"
+            "bought = (FeatureBuilder.Binary('bought')\n"
+            "          .extract(lambda r: bool(r['purchase']),\n"
+            "                   event_field='purchase').as_response())\n")
+        mod = tmp_path / "lagged_features.py"
+        mod.write_text(src)
+        ns = {}
+        code = compile(src, str(mod), "exec")
+        exec(code, ns)
+        findings = self._lint([ns["prev"], ns["bought"]],
+                              _agg_reader([], cutoff=CutOffTime.unix(10)))
+        assert findings.rules_fired() == []
+
+    def test_train_gate_blocks_leaky_pipeline(self):
+        from transmogrifai_tpu.analysis import PipelineLintError
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.preparators import SanityChecker
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, grid)
+
+        events = make_events(n_keys=12, n_events=100, seed=33)
+        amount, label = _event_features()
+        features = transmogrify([amount])
+        checked = SanityChecker(min_variance=-1.0).set_input(
+            label, features).get_output()
+        pred = (BinaryClassificationModelSelector
+                .with_train_validation_split(
+                    models_and_parameters=[(OpLogisticRegression(),
+                                            grid(reg_param=[0.1]))])
+                .set_input(label, checked).get_output())
+        leaky = _agg_reader(events, cutoff=CutOffTime.no_cutoff())
+        wf = (OpWorkflow().allow_non_serializable()
+              .set_result_features(pred).set_reader(leaky))
+        with pytest.raises(PipelineLintError, match="TM060"):
+            wf.train()

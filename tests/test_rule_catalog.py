@@ -305,6 +305,20 @@ def _tm047():
         "    write_json_atomic('benchmarks/pod_latest.json', doc)\n")
 
 
+# -- TM06x ------------------------------------------------------------------
+
+def _tm060():
+    from transmogrifai_tpu.readers import AggregateDataReader
+
+    label, age = TL._real_features("label", "age", response="label")
+    # an event reader with NO cutoff: predictor windows are unbounded, so
+    # response-time events aggregate straight into the predictor
+    reader = AggregateDataReader([], key_fn=lambda r: r["k"],
+                                 time_fn=lambda r: r["t"])
+    return lint_dag(StagesDAG([[TL._gen(age), TL._gen(label)]]),
+                    reader=reader)
+
+
 def _tm053():
     return _concur(
         "class Pair:\n"
@@ -329,6 +343,7 @@ FIXTURES = {
     "TM040": _tm040, "TM041": _tm041, "TM042": _tm042, "TM043": _tm043,
     "TM044": _tm044, "TM045": _tm045, "TM046": _tm046, "TM047": _tm047,
     "TM050": _tm050, "TM051": _tm051, "TM052": _tm052, "TM053": _tm053,
+    "TM060": _tm060,
 }
 
 

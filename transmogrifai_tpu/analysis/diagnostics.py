@@ -90,6 +90,10 @@ RULES: Dict[str, Any] = {
     "TM052": (ERROR, "shared mutable state touched from a thread-pool "
                      "closure without a lock"),
     "TM053": (ERROR, "lock acquisition order inversion (deadlock hazard)"),
+    # -- event-time ingestion (analysis/linter.py, readers/events.py) ---
+    "TM060": (ERROR, "event-time leakage: a predictor reads event data not "
+                     "provably before the key's cutoff (no cutoff spec, or "
+                     "a response event field consumed as a predictor)"),
 }
 
 #: version of the ``tmog lint --json`` report shape (bumped with any

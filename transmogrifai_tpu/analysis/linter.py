@@ -61,9 +61,11 @@ def _is_generator(stage: PipelineStage) -> bool:
 
 
 def lint_dag(dag, result_features: Optional[Sequence[Feature]] = None,
-             suppress: Iterable[str] = ()) -> Findings:
+             suppress: Iterable[str] = (), reader=None) -> Findings:
     """Lint a ``StagesDAG``.  ``result_features`` enables the dead-stage
-    rule (TM005); ``suppress`` drops listed rule ids from the report."""
+    rule (TM005); ``suppress`` drops listed rule ids from the report;
+    ``reader`` (the workflow's data reader, when known) enables the
+    event-time leakage rule (TM060)."""
     findings = Findings()
     suppress = set(suppress)
 
@@ -139,6 +141,9 @@ def lint_dag(dag, result_features: Optional[Sequence[Feature]] = None,
     # -- label leakage (TM006) -------------------------------------------
     findings.extend(_lint_leakage(dag))
 
+    # -- event-time leakage (TM060) --------------------------------------
+    findings.extend(_lint_event_windows(dag, reader))
+
     if suppress:
         findings.diagnostics = [d for d in findings.diagnostics
                                 if d.rule not in suppress]
@@ -182,13 +187,124 @@ def _lint_leakage(dag) -> Findings:
     return findings
 
 
+_SUPPRESS_CACHE: Dict[str, Optional["object"]] = {}
+_UNCACHED = object()
+
+
+def _suppressed_at(rule: str, location: Optional[str]) -> bool:
+    """``# tmog: disable=<rule>`` check for a ``file:line`` construction
+    site (per-file Suppressions cache; unreadable/synthetic files never
+    suppress)."""
+    if not location or ":" not in location:
+        return False
+    path, _, line_s = location.rpartition(":")
+    try:
+        line = int(line_s)
+    except ValueError:
+        return False
+    sup = _SUPPRESS_CACHE.get(path, _UNCACHED)
+    if sup is _UNCACHED:
+        from .astutil import Suppressions
+
+        try:
+            with open(path, "r", encoding="utf-8", errors="replace") as fh:
+                sup = Suppressions(fh.read())
+        except OSError:
+            sup = None
+        _SUPPRESS_CACHE[path] = sup
+    if sup is None:
+        return False
+    return sup.suppressed(rule, extra_lines=(line,))
+
+
+def _event_reader(reader):
+    """The event-time reader behind ``reader`` (unwrapping resilience /
+    shard wrappers via ``inner_reader``), or None."""
+    from ..readers.aggregates import AggregateDataReader
+    from ..readers.events import StreamingAggregateReader
+
+    seen = 0
+    while reader is not None and seen < 8:
+        if isinstance(reader, (AggregateDataReader,
+                               StreamingAggregateReader)):
+            return reader
+        reader = getattr(reader, "inner_reader", None)
+        seen += 1
+    return None
+
+
+def _lint_event_windows(dag, reader) -> Findings:
+    """TM060 — event-time leakage over aggregate/conditional readers.
+
+    A raw predictor over an event reader is safe only when its events are
+    provably before the key's cutoff.  Two violations:
+
+    * the reader declares NO cutoff (``CutOffTime.no_cutoff`` and no
+      target condition): every predictor window is unbounded, so
+      response-time events aggregate into predictors;
+    * a predictor reads the same event field a response reads (declared
+      via ``event_field`` or the implicit ``r.get(name)`` default):
+      outcome data consumed as a predictor regardless of windows.
+
+    Findings anchor at the feature's construction site, where
+    ``# tmog: disable=TM060`` suppresses (a legitimately lagged outcome
+    feature, e.g. "previous purchase" with a bounded predictor window).
+    """
+    findings = Findings()
+    er = _event_reader(reader)
+    if er is None:
+        return findings
+    gens = [s for layer in dag.layers for s in layer if _is_generator(s)]
+    predictors = [s for s in gens if not s.get_output().is_response]
+    responses = [s for s in gens if s.get_output().is_response]
+    if not predictors or not responses:
+        return findings
+
+    cutoff = getattr(er, "cutoff", None)
+    has_cutoff = (getattr(er, "target_condition", None) is not None
+                  or (cutoff is not None and cutoff.kind != "no_cutoff"))
+
+    def field_of(s) -> Optional[str]:
+        ef = getattr(s, "event_field", None)
+        if ef is not None:
+            return ef
+        # no extract_fn -> the implicit r.get(name) field read
+        return s.name if getattr(s, "extract_fn", None) is None else None
+
+    response_fields = {field_of(s) for s in responses} - {None}
+    for s in predictors:
+        problems = []
+        if not has_cutoff:
+            problems.append(
+                "the reader declares no cutoff (CutOffTime.no_cutoff, no "
+                "target condition), so predictor events are not provably "
+                "before the key's cutoff")
+        fld = field_of(s)
+        if fld is not None and fld in response_fields:
+            problems.append(
+                f"event field {fld!r} is also read by a response feature "
+                "(outcome data consumed as a predictor)")
+        if not problems:
+            continue
+        site = getattr(s, "source_location", None)
+        if _suppressed_at("TM060", site):
+            continue
+        findings.add(
+            "TM060",
+            f"event-time leakage in raw feature {s.name!r}: "
+            + "; ".join(problems),
+            stage_uid=s.uid, location=site or _stage_location(s))
+    return findings
+
+
 def lint_workflow(wf, suppress: Iterable[str] = ()) -> Findings:
     """Lint an ``OpWorkflow`` (or fitted ``OpWorkflowModel``) by
     reconstructing its stage DAG from the result features."""
     from ..workflow.dag import compute_dag
 
     return lint_dag(compute_dag(wf.result_features),
-                    result_features=wf.result_features, suppress=suppress)
+                    result_features=wf.result_features, suppress=suppress,
+                    reader=getattr(wf, "reader", None))
 
 
 def lint_plan(plan, result_features: Optional[Sequence[Feature]] = None,

@@ -20,10 +20,14 @@ so any process count reproduces any other bit-exactly).
 
 Row-count resolution: splitting needs the EXACT total row count before
 any pass.  ``Reader.estimate_rows`` answers instantly for in-memory
-readers and Avro (block headers carry record counts); formats whose
-estimate is a heuristic (CSV/JSONL line counts — quoted newlines,
-quarantined rows) fall back to a COUNTING PRE-PASS over the chunk
-stream, with a warning naming the reader (the satellite contract).
+readers and Avro (block headers carry record counts); event-time
+readers (readers/aggregates.py, readers/events.py) answer EXACTLY too —
+their rows are distinct entity KEYS, counted by the cached key scan, so
+a ``host_range`` over an aggregate reader is a contiguous slice of the
+sorted key universe.  Formats whose estimate is a heuristic (CSV/JSONL
+line counts — quoted newlines, quarantined rows) fall back to a
+COUNTING PRE-PASS over the chunk stream, with a warning naming the
+reader (the satellite contract).
 """
 from __future__ import annotations
 

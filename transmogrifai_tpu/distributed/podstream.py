@@ -6,7 +6,13 @@ the observation that the same algebra distributes across processes for
 free:
 
 * each process streams ONLY its host range (distributed/hostshard.py)
-  and folds its own partial state per estimator;
+  and folds its own partial state per estimator; event-time readers
+  (readers/events.py) slot straight in — their rows are distinct entity
+  keys, the host range is a contiguous slice of the sorted key universe
+  (their fold buffers only owned keys' in-window events), and the same
+  fold state also merges under crc32 key-hash ownership
+  (``EventFoldState.shard`` / ``merge_fold_states``) with bit-identical
+  finalized output under any partition;
 * at every pass boundary the partial states allgather (host order) and
   merge — every process finishes the pass with the IDENTICAL merged
   state, so the rest of the train (fold validation, selector sweep,

@@ -31,9 +31,17 @@ class _TypedFeatureBuilder:
         self._extract_fn: Optional[Callable[[Any], Any]] = None
         self._aggregator: Optional[str] = None
         self._window_ms: Optional[int] = None
+        self._event_field: Optional[str] = None
 
-    def extract(self, fn: Callable[[Any], Any]) -> "_TypedFeatureBuilder":
+    def extract(self, fn: Callable[[Any], Any],
+                event_field: Optional[str] = None) -> "_TypedFeatureBuilder":
+        """Set the record->value extractor.  ``event_field`` optionally
+        declares WHICH event-record field the lambda reads — opaque
+        lambdas defeat static analysis, so the event-time leakage lint
+        (TM060) uses this declaration to track response fields consumed
+        as predictors."""
         self._extract_fn = fn
+        self._event_field = event_field
         return self
 
     def aggregate(self, aggregator: str) -> "_TypedFeatureBuilder":
@@ -53,6 +61,7 @@ class _TypedFeatureBuilder:
             is_response=is_response,
             aggregator=self._aggregator,
             aggregate_window_ms=self._window_ms,
+            event_field=self._event_field,
         )
         return stage.get_output()
 

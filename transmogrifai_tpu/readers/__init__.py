@@ -7,5 +7,8 @@ from .files import CSVReader, CSVAutoReader, ParquetReader, JSONLinesReader, Dat
 from .aggregates import (AggregateDataReader, ConditionalDataReader,  # noqa: F401
                          JoinedDataReader, JoinedAggregateDataReader,
                          TimeBasedFilter)
+from .events import (StreamingAggregateReader,  # noqa: F401
+                     StreamingConditionalReader, EventFoldState,
+                     merge_fold_states, key_owner, streaming_view)
 from .avro import (AvroReader, AvroSchemaCSVReader, read_avro,  # noqa: F401
                    write_avro, schema_feature_types)

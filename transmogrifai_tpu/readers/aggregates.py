@@ -15,14 +15,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..aggregators import (
-    AGGREGATOR_REGISTRY, CutOffTime, Event, FeatureAggregator,
-    default_aggregator,
+    AGGREGATOR_REGISTRY, CutOffTime, default_aggregator,
 )
 from ..features.feature import Feature
-from ..stages.generator import FeatureGeneratorStage
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types.feature_types import ID
-from .base import DataFrameReader, Reader, RecordsReader, reader_for
+from .base import Reader
 
 __all__ = ["AggregateDataReader", "ConditionalDataReader",
            "JoinedDataReader", "JoinedAggregateDataReader",
@@ -37,14 +35,19 @@ def _records_of(source) -> List[dict]:
     return list(source)
 
 
-def _extract(gen: FeatureGeneratorStage, record: dict) -> Any:
-    fn = gen.extract_fn or (lambda r: r.get(gen.name))
-    return fn(record)
-
-
 class AggregateDataReader(Reader):
     """Group records by entity key, monoid-aggregate each feature's events
-    around a cutoff (DataReader.scala:206-278)."""
+    around a cutoff (DataReader.scala:206-278).
+
+    Since the event-time ingestion algebra landed (readers/events.py) this
+    class is a facade over the ONE streamed aggregation code path: every
+    dataset generation — in-core or chunked — delegates to the equivalent
+    :class:`~.events.StreamingAggregateReader`, whose full-range fold is
+    asserted byte-identical to the historical in-core grouping
+    (tests/test_events_streaming.py, tests/test_aggregators_readers.py).
+    The streamed twin is cached so the key-scan pass (which also backs the
+    EXACT ``estimate_rows``) runs once per reader, not once per call.
+    """
 
     def __init__(self, source, key_fn: Callable[[dict], Any],
                  time_fn: Callable[[dict], int],
@@ -57,43 +60,37 @@ class AggregateDataReader(Reader):
         self.cutoff = cutoff or CutOffTime.no_cutoff()
         self.predictor_window_ms = predictor_window_ms
         self.response_window_ms = response_window_ms
+        self._streamed = None
 
-    def _grouped(self):
-        groups: Dict[Any, List[dict]] = {}
-        for r in _records_of(self.source):
-            groups.setdefault(self.key_fn(r), []).append(r)
-        return groups
+    def _streaming(self):
+        from .events import streaming_view
 
-    def _cutoff_for(self, records: List[dict]) -> Optional[int]:
-        return self.cutoff.cutoff_for(records[0])
+        if self._streamed is None:
+            self._streamed = streaming_view(self)
+        # resilience can be attached after construction (with_resilience
+        # returns self) — re-sync on every use so both views share ONE
+        # config and therefore one dedup-ing quarantine sink
+        self._streamed.resilience = self.resilience
+        return self._streamed
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> ColumnarDataset:
-        groups = self._grouped()
-        keys = sorted(groups, key=repr)
-        data = ColumnarDataset()
-        aggs = {}
-        for f in raw_features:
-            gen = f.origin_stage
-            assert isinstance(gen, FeatureGeneratorStage)
-            agg = (AGGREGATOR_REGISTRY[gen.aggregator]
-                   if gen.aggregator else None)
-            window = gen.aggregate_window_ms
-            aggs[f.name] = FeatureAggregator(
-                f.ftype, f.is_response, aggregator=agg,
-                predictor_window_ms=window or self.predictor_window_ms,
-                response_window_ms=window or self.response_window_ms)
-        for f in raw_features:
-            gen = f.origin_stage
-            vals = []
-            for k in keys:
-                records = groups[k]
-                cutoff = self._cutoff_for(records)
-                events = [Event(self.time_fn(r), _extract(gen, r))
-                          for r in records]
-                vals.append(aggs[f.name].extract(events, cutoff))
-            data.set(f.name, FeatureColumn.from_values(f.ftype, vals))
-        data.set("key", FeatureColumn.from_values(ID, [str(k) for k in keys]))
-        return data
+        return self._streaming().generate_dataset(raw_features)
+
+    def iter_chunks(self, raw_features: Sequence[Feature],
+                    chunk_rows: int, host_range=None):
+        """True streamed chunks (the fold buffers only in-window events of
+        owned keys — never the record log); ``host_range`` slices the
+        sorted KEY universe, the row grid of aggregate readers."""
+        return self._streaming().iter_chunks(raw_features, chunk_rows,
+                                             host_range=host_range)
+
+    def estimate_rows(self) -> Optional[int]:
+        """EXACT: one output row per distinct post-policy entity key
+        (counted by the cached key scan)."""
+        return self._streaming().estimate_rows()
+
+    def estimate_rows_exact(self) -> bool:
+        return True
 
 
 class ConditionalDataReader(AggregateDataReader):
@@ -112,18 +109,6 @@ class ConditionalDataReader(AggregateDataReader):
                          response_window_ms=response_window_ms)
         self.target_condition = target_condition
         self.drop_if_no_target = drop_if_no_target
-
-    def _grouped(self):
-        groups = super()._grouped()
-        if self.drop_if_no_target:
-            groups = {k: rs for k, rs in groups.items()
-                      if any(self.target_condition(r) for r in rs)}
-        return groups
-
-    def _cutoff_for(self, records: List[dict]) -> Optional[int]:
-        matching = [self.time_fn(r) for r in records
-                    if self.target_condition(r)]
-        return min(matching) if matching else None
 
 
 class TimeBasedFilter:
@@ -272,6 +257,50 @@ class JoinedDataReader(Reader):
         out.set("key", FeatureColumn.from_values(ID, list(keys)))
         return out
 
+    def stream(self, raw_features: Sequence[Feature], chunk_rows: int,
+               host_range=None):
+        """Chunked sort-merge join over key-sorted spill runs
+        (readers/events.py), bounded by ``TMOG_STREAM_RETAIN_MB``.  Row
+        ORDER is key-sorted (stable within a key) — a documented
+        divergence from :meth:`generate_dataset`'s pandas hash-merge
+        order; row CONTENT is identical."""
+        from .events import stream_join
+
+        return stream_join(self, raw_features, chunk_rows,
+                           host_range=host_range)
+
+    def _key_counts(self):
+        """Per-side ``Counter`` of composite key strings (cached): the
+        exact join cardinality needs multiplicities, not just distincts."""
+        if getattr(self, "_key_counts_cache", None) is None:
+            from collections import Counter
+
+            def side(reader, keys):
+                data = self._with_key(reader, [], keys)
+                parts = [[str(v) for v in data[k].to_list()] for k in keys]
+                return Counter("\x1f".join(p[i] for p in parts)
+                               for i in range(len(parts[0])))
+
+            self._key_counts_cache = (side(self.left, self.left_key),
+                                      side(self.right, self.right_key))
+        return self._key_counts_cache
+
+    def estimate_rows(self) -> Optional[int]:
+        """EXACT joined row count from per-side key multiplicities —
+        matched keys fan out multiplicatively; left/outer add the
+        unmatched side(s).  Host sharding can trust this instead of
+        falling back to the counting pre-pass."""
+        lc, rc = self._key_counts()
+        n = sum(c * rc[k] for k, c in lc.items() if k in rc)
+        if self.join_type in ("left", "outer"):
+            n += sum(c for k, c in lc.items() if k not in rc)
+        if self.join_type == "outer":
+            n += sum(c for k, c in rc.items() if k not in lc)
+        return n
+
+    def estimate_rows_exact(self) -> bool:
+        return True
+
 
 class JoinedAggregateDataReader(JoinedDataReader):
     """Join then aggregate back to one row per key
@@ -342,5 +371,26 @@ class JoinedAggregateDataReader(JoinedDataReader):
             out.set(f.name, FeatureColumn.from_values(f.ftype, vals))
         out.set("key", FeatureColumn.from_values(ID, list(uniq)))
         return out
+
+    def stream(self, raw_features: Sequence[Feature], chunk_rows: int,
+               host_range=None):
+        """Chunked sort-merge join + secondary aggregation — one row per
+        key in sorted-key order, byte-identical to
+        :meth:`generate_dataset` (whose ``np.unique`` key order is the
+        same lexicographic sort)."""
+        from .events import stream_join_aggregate
+
+        return stream_join_aggregate(self, raw_features, chunk_rows,
+                                     host_range=host_range)
+
+    def estimate_rows(self) -> Optional[int]:
+        """EXACT: one row per distinct joined key (inner: both sides;
+        left: left keys; outer: either side)."""
+        lc, rc = self._key_counts()
+        if self.join_type == "inner":
+            return len(lc.keys() & rc.keys())
+        if self.join_type == "left":
+            return len(lc)
+        return len(lc.keys() | rc.keys())
 
 

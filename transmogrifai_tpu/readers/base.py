@@ -144,10 +144,11 @@ class Reader:
         """Yield the dataset as bounded row chunks (out-of-core ingestion).
 
         Base fallback: materialize once and yield zero-copy row slices —
-        correct for any reader (and the right answer for aggregate readers,
-        whose entity grouping is inherently global), while the file readers
-        override it with true streaming parses that never hold the full
-        dataset.
+        correct for any reader — while the file readers override it with
+        true streaming parses that never hold the full dataset, and the
+        aggregate/conditional readers override it with the streamed
+        event-time fold (readers/events.py) whose buffers hold only
+        in-window events of owned keys.
 
         ``host_range=(start, stop)`` restricts the stream to that global
         row window (:func:`window_gen`) — the pod runtime's host-sharded

@@ -6,6 +6,8 @@ event-aggregated readers, and an optional aggregation time window.
 """
 from __future__ import annotations
 
+import os
+import sys
 from typing import Any, Callable, Optional, Sequence, Type
 
 from ..features.feature import Feature
@@ -14,6 +16,25 @@ from ..types.feature_types import FeatureType
 from .base import PipelineStage
 
 __all__ = ["FeatureGeneratorStage"]
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _construction_site() -> Optional[str]:
+    """``file:line`` of the first caller frame OUTSIDE this package — where
+    the user declared the feature.  The event-time lint (TM060,
+    analysis/linter.py) anchors its findings and ``# tmog: disable=``
+    suppressions there, not at the stage class definition."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:  # pragma: no cover - interpreter without frames
+        return None
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not os.path.abspath(fn).startswith(_PKG_ROOT + os.sep):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return None
 
 
 class FeatureGeneratorStage(PipelineStage):
@@ -35,6 +56,7 @@ class FeatureGeneratorStage(PipelineStage):
         is_response: bool = False,
         aggregator: Optional[str] = None,
         aggregate_window_ms: Optional[int] = None,
+        event_field: Optional[str] = None,
         uid: Optional[str] = None,
     ):
         super().__init__(
@@ -47,6 +69,15 @@ class FeatureGeneratorStage(PipelineStage):
         # the per-type default (MonoidAggregatorDefaults.aggregatorOf parity)
         self.aggregator = aggregator
         self.aggregate_window_ms = aggregate_window_ms
+        # declared event-record field this feature reads — provenance for
+        # the event-time leakage lint (TM060): an ``extract_fn`` is opaque
+        # to static analysis, so features over event readers declare their
+        # source field here (features without one fall back to ``name``
+        # when extract_fn is None, the r.get(name) default)
+        self.event_field = event_field
+        # where the USER declared this feature (``file:line``), for
+        # clickable TM060 findings and line-precise suppressions
+        self.source_location = _construction_site()
         self._output_feature = Feature(
             name=name,
             ftype=output_type,

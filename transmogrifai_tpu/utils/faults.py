@@ -60,6 +60,14 @@ no plan is armed):
                          (examples/bench_soak.py); ``index`` is the
                          phase ordinal, ``tag`` the phase name — the
                          handle for aiming any fault at "during phase k"
+  ``event.window``       before each finalized key-window chunk leaves
+                         the streamed event fold (readers/events.py);
+                         ``index`` is the output chunk ordinal — an
+                         ``io_error`` here exercises retry over the
+                         whole scan+fold re-run
+  ``join.chunk``         before each streamed sort-merge join chunk
+                         (readers/events.stream_join); ``index`` is the
+                         joined chunk ordinal
 
 Actions: ``io_error`` (raise OSError — the transient class the reader
 retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``

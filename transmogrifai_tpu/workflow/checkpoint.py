@@ -227,6 +227,32 @@ def _describe_reader(reader) -> Dict[str, Any]:
     if inner is not None:
         reader = inner
     out: Dict[str, Any] = {"class": type(reader).__name__}
+    # event-time readers (readers/aggregates.py, readers/events.py): the
+    # logical identity is (cutoff spec, windows, source) — two readers over
+    # the same file with different cutoffs produce different datasets, so
+    # a resume across a cutoff change must invalidate
+    if hasattr(reader, "key_fn") and hasattr(reader, "cutoff"):
+        cutoff = reader.cutoff
+        out["event"] = {
+            "cutoffKind": getattr(cutoff, "kind", None),
+            "cutoffMs": getattr(cutoff, "time_ms", None),
+            "predictorWindowMs": reader.predictor_window_ms,
+            "responseWindowMs": reader.response_window_ms,
+            "conditional": getattr(reader, "target_condition",
+                                   None) is not None,
+        }
+        source = getattr(reader, "source", None)
+        if source is not None:
+            from ..readers.base import Reader as _Reader
+
+            if isinstance(source, _Reader):
+                out["source"] = _describe_reader(source)
+            elif hasattr(source, "to_dict") and hasattr(source, "columns"):
+                out["source"] = {"rows": int(len(source)),
+                                 "columns": [str(c) for c in source.columns]}
+            elif isinstance(source, (list, tuple)):
+                out["source"] = {"rows": len(source)}
+        return out
     for attr in ("path", "csv_path"):
         path = getattr(reader, attr, None)
         if isinstance(path, str):

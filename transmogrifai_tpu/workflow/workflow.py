@@ -388,7 +388,8 @@ class OpWorkflow(_WorkflowCore):
         from ..utils.profiling import LintSnapshot
 
         t0 = time.perf_counter()
-        findings = lint_dag(dag, result_features=self.result_features)
+        findings = lint_dag(dag, result_features=self.result_features,
+                            reader=self.reader)
         wall = time.perf_counter() - t0
         if findings.errors:
             raise PipelineLintError(findings)
