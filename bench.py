@@ -421,6 +421,8 @@ def main():
             "vs_baseline": d.get("vs_cpu_1core", d["vs_baseline"]),
             "aupr": d["aupr"], "candidates": d["candidates"],
             "candidate_errors": d["candidate_errors"],
+            "drainFracOfWall": d.get("drainFracOfWall"),
+            "winner": d.get("winner"),
             "baseline_kind": ("measured 1-core XLA-CPU, same shape+grid "
                               "(extrapolated from subscale)"
                               if "vs_cpu_1core" in d

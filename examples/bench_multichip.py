@@ -96,7 +96,9 @@ def _selector(seed: int = 42):
 
 
 def run_sweep(X, y, n_devices: int):
-    """One full sweep at ``n_devices``; returns (wall_s, best, metrics).
+    """One full sweep at ``n_devices``; returns (wall_s, best, metrics,
+    transfers) — the transfer ledger (with overlap/drain attribution) is
+    reset at entry so each device-count entry records only its own sweep.
 
     Runs with the selector's elastic context attached (exactly as a
     ``fit_columns`` sweep would), so the elastic counters — retries,
@@ -106,7 +108,9 @@ def run_sweep(X, y, n_devices: int):
 
     from transmogrifai_tpu.models.trees import clear_sweep_caches
     from transmogrifai_tpu.parallel.mesh import make_sweep_mesh
+    from transmogrifai_tpu.utils import profiling
 
+    profiling.reset_counters()
     sel = _selector()
     queue_width = sum(len(g) for _, g in sel.models_and_params)
     if n_devices > 1:
@@ -121,7 +125,8 @@ def run_sweep(X, y, n_devices: int):
         elastic=elastic)
     wall = time.perf_counter() - t0
     clear_sweep_caches()
-    return wall, best, [r.metric_value for r in results]
+    transfers = profiling.COUNTERS.to_json()
+    return wall, best, [r.metric_value for r in results], transfers
 
 
 def run_sharding_contracts(X, y, n_devices: int) -> dict:
@@ -282,13 +287,16 @@ def main():
             result["sweeps"][str(n)] = {"skipped": reason}
             continue
         t0 = time.perf_counter()
-        wall, best, metrics = run_sweep(X, y, n)
+        wall, best, metrics, transfers = run_sweep(X, y, n)
         if not args.smoke:
             from transmogrifai_tpu.tuning.budget import record_measurement
             record_measurement(COST_HISTORY, name,
                                time.perf_counter() - t0, False, sig)
         entry = {"wall_s": round(wall, 3), "best": best,
-                 "metrics": [round(m, 5) for m in metrics]}
+                 "metrics": [round(m, 5) for m in metrics],
+                 "transfers": transfers,
+                 "drainFracOfWall": round(
+                     transfers.get("drainSecs", 0.0) / max(wall, 1e-9), 4)}
         if ref is None:
             ref = (best, metrics, wall)
         else:

@@ -131,6 +131,12 @@ def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
                  if "model_selector_summary" in s.metadata), {})
     n_err = sum(1 for rrow in summ.get("validationResults", [])
                 if rrow.get("error"))
+    transfers = profiling.COUNTERS.to_json()
+    # drainFracOfWall: true dispatch stalls (drainSecs excludes overlapped
+    # lagged fetches) over the measured train wall — the async-sweep gate
+    # tracks this at < 0.3 on the smoke shape
+    drain_frac = (transfers.get("drainSecs", 0.0) / train_s
+                  if train_s > 0 else 0.0)
     return {
         "candidates": len(summ.get("validationResults", [])),
         "candidate_errors": n_err,
@@ -145,7 +151,10 @@ def run(rows: int, cols: int, folds: int = 3, warmup: bool = False,
         "baseline_s_assumed": baseline_s,
         "warmup_s": round(warmup_s, 1),
         "phases": steps,
-        "transfers": profiling.COUNTERS.to_json(),
+        "transfers": transfers,
+        "drainFracOfWall": round(drain_frac, 4),
+        "winner": {"model": summ.get("bestModelType"),
+                   "params": summ.get("bestModelParams")},
     }
 
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Async sweep-dispatch smoke — the drain-stall gate for scripts/tier1.sh.
+
+Runs the SAME selector sweep twice in-process: once with
+``TMOG_SYNC_SWEEP=1`` (the synchronous kill-switch baseline — every unit's
+metrics fetched before the next dispatch) and once on the default async
+double-buffered path (fetches deferred to the end-of-sweep collect, lagged
+checkpoint flushes booked as overlap).  Gates:
+
+  * winner + per-candidate metric parity: byte-identical between modes,
+    for both the flat sweep and the successive-halving ladder (whose rung
+    promotions run as on-device top-k in async mode);
+  * ``drainSecs/wall < 0.3`` on the async flat sweep — ``drainSecs`` counts
+    only TRUE stalls (the transfer ledger books lagged fetches that overlap
+    still-enqueued launches into ``overlapSecs``), so a re-serialized
+    dispatch loop fails this gate even when total transfer time is flat.
+
+Prints ONE JSON line; exits nonzero when any gate fails.
+
+Usage: python examples/bench_sweep_async.py [--rows N] [--cols D] [--smoke]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+DRAIN_FRAC_GATE = 0.3
+
+
+def make_data(rows: int, cols: int, seed: int = 11):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    beta = np.zeros(cols, np.float32)
+    informative = rng.choice(cols, max(3, cols // 8), replace=False)
+    beta[informative] = rng.normal(size=len(informative)) * 1.5
+    z = X @ beta + 0.5 * rng.normal(size=rows).astype(np.float32)
+    y = (1 / (1 + np.exp(-z)) > rng.random(rows)).astype(np.float32)
+    return X, y
+
+
+def _selector(seed: int = 42):
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpRandomForestClassifier,
+    )
+    from transmogrifai_tpu.selector.model_selector import ModelSelector, grid
+    from transmogrifai_tpu.selector.validators import OpTrainValidationSplit
+
+    return ModelSelector(
+        models_and_params=[
+            (OpLogisticRegression(), grid(
+                reg_param=[0.001, 0.01, 0.1, 0.3],
+                elastic_net_param=[0.0])),
+            (OpRandomForestClassifier(num_trees=8, seed=seed), [
+                {"max_depth": 3}, {"max_depth": 5}]),
+        ],
+        problem_type="binary",
+        validator=OpTrainValidationSplit(train_ratio=0.75, seed=seed,
+                                         stratify=True))
+
+
+def _run_flat(X, y, sync: bool):
+    """One flat sweep; returns (wall_s, best, metrics, transfer_ledger)."""
+    import numpy as np
+
+    from transmogrifai_tpu.models.trees import clear_sweep_caches
+    from transmogrifai_tpu.utils import profiling
+
+    os.environ.pop("TMOG_SYNC_SWEEP", None)
+    if sync:
+        os.environ["TMOG_SYNC_SWEEP"] = "1"
+    try:
+        profiling.reset_counters()
+        sel = _selector()
+        w = np.ones(len(y), np.float32)
+        t0 = time.perf_counter()
+        best, results = sel.validator.validate(
+            sel._candidates(), X, y, w, eval_fn=sel._metric,
+            metric_name=sel.validation_metric,
+            larger_better=sel.larger_better)
+        wall = time.perf_counter() - t0
+        clear_sweep_caches()
+        return (wall, best, [r.metric_value for r in results],
+                profiling.COUNTERS.to_json())
+    finally:
+        os.environ.pop("TMOG_SYNC_SWEEP", None)
+
+
+def _run_halving(X, y, sync: bool):
+    """One successive-halving ladder over an LR grid; returns
+    (wall_s, best, metrics, transfer_ledger)."""
+    import numpy as np
+
+    from transmogrifai_tpu.models.trees import clear_sweep_caches
+    from transmogrifai_tpu.tuning import HalvingConfig, halving_validate
+    from transmogrifai_tpu.utils import profiling
+
+    os.environ.pop("TMOG_SYNC_SWEEP", None)
+    if sync:
+        os.environ["TMOG_SYNC_SWEEP"] = "1"
+    try:
+        profiling.reset_counters()
+        sel = _selector()
+        w = np.ones(len(y), np.float32)
+        t0 = time.perf_counter()
+        best, results, _sched = halving_validate(
+            sel.validator, sel._candidates(), X, y, w, sel._metric,
+            sel.validation_metric, sel.larger_better,
+            HalvingConfig(min_rows=256))
+        wall = time.perf_counter() - t0
+        clear_sweep_caches()
+        return (wall, best, [r.metric_value for r in results],
+                profiling.COUNTERS.to_json())
+    finally:
+        os.environ.pop("TMOG_SYNC_SWEEP", None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4000)
+    ap.add_argument("--cols", type=int, default=32)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 shape (the defaults already are)")
+    args = ap.parse_args()
+
+    X, y = make_data(args.rows, args.cols)
+    result = {"rows": args.rows, "cols": args.cols,
+              "drain_frac_gate": DRAIN_FRAC_GATE, "sweeps": {}}
+    failures = []
+
+    for name, runner in (("flat", _run_flat), ("halving", _run_halving)):
+        # sync (kill-switch) first: it also warms every compile cache, so
+        # the async run's wall — the one the drain gate divides by — is
+        # not dominated by first-compile time
+        s_wall, s_best, s_metrics, _ = runner(X, y, sync=True)
+        a_wall, a_best, a_metrics, ledger = runner(X, y, sync=False)
+        parity = bool(s_best == a_best and s_metrics == a_metrics)
+        drain_frac = ledger.get("drainSecs", 0.0) / max(a_wall, 1e-9)
+        entry = {"sync_wall_s": round(s_wall, 3),
+                 "async_wall_s": round(a_wall, 3),
+                 "best": a_best, "parity": parity,
+                 "drainFracOfWall": round(drain_frac, 4),
+                 "transfers": ledger}
+        if not parity:
+            entry["sync_best"] = s_best
+            entry["sync_metrics"] = s_metrics
+            entry["async_metrics"] = a_metrics
+            failures.append(f"{name}: async/sync winner or metric mismatch")
+        if name == "flat" and drain_frac >= DRAIN_FRAC_GATE:
+            failures.append(
+                f"{name}: drainSecs/wall {drain_frac:.3f} >= "
+                f"{DRAIN_FRAC_GATE} — the dispatch loop is stalling on "
+                f"per-unit fetches again")
+        result["sweeps"][name] = entry
+
+    result["ok"] = not failures
+    if failures:
+        result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

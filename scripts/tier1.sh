@@ -34,6 +34,18 @@ else
   echo "TUNING_SMOKE=FAILED (see /tmp/_t1_tuning.log)"
   rc=1
 fi
+# async-dispatch smoke: the same selector sweep run under the
+# TMOG_SYNC_SWEEP=1 kill-switch and on the default async double-buffered
+# path — byte-identical winner + per-candidate metrics for both the flat
+# sweep and the halving ladder (on-device rung top-k), and the async
+# run's TRUE drain stall gated at drainSecs/wall < 0.3 (lagged fetches
+# book as overlap, so a re-serialized dispatch loop fails the gate)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python examples/bench_sweep_async.py --smoke > /tmp/_t1_sweep_async.log 2>&1; then
+  echo "SWEEP_ASYNC_SMOKE=ok $(grep -ao '"drainFracOfWall": [0-9.]*' /tmp/_t1_sweep_async.log | head -1)"
+else
+  echo "SWEEP_ASYNC_SMOKE=FAILED (see /tmp/_t1_sweep_async.log)"
+  rc=1
+fi
 # multichip smoke: the sharded selector sweep on 8 forced host devices —
 # tiny shape, winner/metric parity against the single-device sweep
 # asserted inside the script (rc!=0 on parity failure).  TMOG_CHECK=1

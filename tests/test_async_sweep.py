@@ -1,0 +1,351 @@
+"""Async sweep dispatch (ROADMAP item 1, the drain-stall removal).
+
+Parity contract: the default async double-buffered dispatch path and the
+``TMOG_SYNC_SWEEP=1`` kill-switch baseline must produce byte-identical
+winners, per-candidate metrics, and checkpoint documents — across flat and
+halving sweeps, chunked (early-stopped GBT) and unchunked fits, 1/4/8
+virtual devices, and under injected mid-block device losses (where the
+elastic counters must still move).  Plus the transfer-ledger overlap
+accounting (``overlapSecs`` / ``drainTags``) the dispatch loop books into.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.parallel import make_sweep_mesh
+from transmogrifai_tpu.selector.async_dispatch import sync_sweep_forced
+from transmogrifai_tpu.selector.model_selector import ModelSelector, grid
+from transmogrifai_tpu.selector.validators import (
+    OpCrossValidation, OpTrainValidationSplit,
+)
+from transmogrifai_tpu.utils import faults, profiling
+
+
+class _sync_sweep:
+    """Context manager flipping the TMOG_SYNC_SWEEP kill-switch."""
+
+    def __init__(self, on: bool):
+        self.on = on
+
+    def __enter__(self):
+        self._prev = os.environ.pop("TMOG_SYNC_SWEEP", None)
+        if self.on:
+            os.environ["TMOG_SYNC_SWEEP"] = "1"
+        return self
+
+    def __exit__(self, *exc):
+        os.environ.pop("TMOG_SYNC_SWEEP", None)
+        if self._prev is not None:
+            os.environ["TMOG_SYNC_SWEEP"] = self._prev
+        return False
+
+
+def _toy(n=400, d=10, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d) * (rng.random(d) < 0.6)
+    y = (1 / (1 + np.exp(-(X @ beta))) > rng.random(n)).astype(np.float32)
+    return X, y
+
+
+def _selector(validator=None, models=None):
+    from transmogrifai_tpu.models import (
+        OpLogisticRegression, OpRandomForestClassifier,
+    )
+
+    models = models or [
+        (OpLogisticRegression(), grid(
+            reg_param=[0.001, 0.01, 0.1, 1.0], elastic_net_param=[0.0])),
+        (OpRandomForestClassifier(num_trees=6, seed=3), [
+            {"max_depth": 3}, {"max_depth": 5}]),
+    ]
+    return ModelSelector(
+        models_and_params=models, problem_type="binary",
+        validator=validator or OpCrossValidation(num_folds=2,
+                                                 stratify=True))
+
+
+def _sweep(sel, X, y, sync, elastic=None, checkpoint=None,
+           with_groups=True):
+    from transmogrifai_tpu.models.trees import clear_sweep_caches
+
+    with _sync_sweep(sync):
+        best, results = sel.validator.validate(
+            sel._candidates(with_groups=with_groups), X, y,
+            np.ones(len(y), np.float32), eval_fn=sel._metric,
+            metric_name=sel.validation_metric,
+            larger_better=sel.larger_better, checkpoint=checkpoint,
+            elastic=elastic)
+    clear_sweep_caches()
+    return best, results
+
+
+def _pairs(results):
+    return [(r.metric_value, r.error) for r in results]
+
+
+class TestKillSwitch:
+    def test_env_toggle_reads_at_call_time(self):
+        with _sync_sweep(True):
+            assert sync_sweep_forced()
+        with _sync_sweep(False):
+            assert not sync_sweep_forced()
+
+    def test_sync_mode_books_no_overlap(self):
+        X, y = _toy()
+        profiling.reset_counters()
+        with _sync_sweep(True):
+            sel = _selector()
+            _sweep(sel, X, y, sync=True)
+        assert profiling.COUNTERS.overlaps == 0
+        assert profiling.COUNTERS.overlap_s == 0.0
+
+
+class TestFlatParity:
+    @pytest.mark.parametrize("n_devices", [1, 4, 8])
+    def test_winner_and_metrics_byte_identical(self, n_devices):
+        X, y = _toy()
+
+        def run(sync):
+            sel = _selector()
+            if n_devices > 1:
+                sel.with_mesh(make_sweep_mesh(6, n_devices=n_devices))
+            return _sweep(sel, X, y, sync=sync)
+
+        best_s, res_s = run(sync=True)
+        best_a, res_a = run(sync=False)
+        assert best_a == best_s
+        assert _pairs(res_a) == _pairs(res_s)
+
+    def test_tvs_parity(self):
+        X, y = _toy(seed=11)
+        v = OpTrainValidationSplit(train_ratio=0.75, seed=3, stratify=True)
+        best_s, res_s = _sweep(_selector(validator=v), X, y, sync=True)
+        v2 = OpTrainValidationSplit(train_ratio=0.75, seed=3, stratify=True)
+        best_a, res_a = _sweep(_selector(validator=v2), X, y, sync=False)
+        assert (best_a, _pairs(res_a)) == (best_s, _pairs(res_s))
+
+    @pytest.mark.parametrize("early_stopping", [0, 3])
+    def test_gbt_chunked_and_unchunked_parity(self, early_stopping):
+        """XGB candidates: es>0 runs the chunked lagged-fetch boosting
+        loop (overlap-booked in async mode), es=0 the plain loop."""
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+
+        X, y = _toy(n=300, d=6, seed=2)
+        models = [(OpXGBoostClassifier(
+            num_round=12, early_stopping_rounds=early_stopping),
+            grid(max_depth=[2, 3]))]
+        best_s, res_s = _sweep(_selector(models=models), X, y, sync=True)
+        best_a, res_a = _sweep(_selector(models=models), X, y, sync=False)
+        assert (best_a, _pairs(res_a)) == (best_s, _pairs(res_s))
+
+    def test_errored_candidate_parity(self):
+        from transmogrifai_tpu.models import OpLogisticRegression
+
+        class _Boom(OpLogisticRegression):
+            def fit_device(self, X, y, w, problem_type):
+                raise FloatingPointError("synthetic divergence")
+
+            def fit_raw(self, X, y, w=None):
+                raise FloatingPointError("synthetic divergence")
+
+        X, y = _toy()
+        models = [(_Boom(), grid(reg_param=[0.01])),
+                  (OpLogisticRegression(), grid(reg_param=[0.01, 0.1]))]
+        best_s, res_s = _sweep(_selector(models=models), X, y, sync=True)
+        best_a, res_a = _sweep(_selector(models=models), X, y, sync=False)
+        assert (best_a, _pairs(res_a)) == (best_s, _pairs(res_s))
+        assert res_a[0].error is not None
+
+
+class TestCheckpointParity:
+    def _manager(self, tmp_path, name, mesh=None):
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SweepCheckpointManager, sweep_fingerprint,
+        )
+
+        sel = _selector()
+        fp = sweep_fingerprint(sel._candidates(), "AuPR", "cv2", mesh=mesh,
+                               n_rows=400)
+        return SweepCheckpointManager(str(tmp_path / name), fp)
+
+    def test_checkpoint_doc_byte_identical(self, tmp_path):
+        X, y = _toy()
+        m_s = self._manager(tmp_path, "sync")
+        best_s, res_s = _sweep(_selector(), X, y, sync=True,
+                               checkpoint=m_s)
+        m_a = self._manager(tmp_path, "async")
+        best_a, res_a = _sweep(_selector(), X, y, sync=False,
+                               checkpoint=m_a)
+        assert best_a == best_s
+        doc_s, doc_a = m_s.export_doc(), m_a.export_doc()
+        assert doc_a["units"] == doc_s["units"]
+        assert doc_a["rung"] == doc_s["rung"]
+
+    def test_async_resumes_sync_cursor(self, tmp_path):
+        """A cursor written by the sync loop restores under async dispatch
+        (and vice versa) — same final winner, restored units not re-run."""
+        X, y = _toy()
+        m1 = self._manager(tmp_path, "ck")
+        best_ref, res_ref = _sweep(_selector(), X, y, sync=True,
+                                   checkpoint=m1)
+        m2 = self._manager(tmp_path, "ck")
+        assert m2.load() is True
+        best2, res2 = _sweep(_selector(), X, y, sync=False, checkpoint=m2)
+        assert best2 == best_ref
+        assert _pairs(res2) == _pairs(res_ref)
+
+
+class TestHalvingParity:
+    def _halve(self, X, y, sync, checkpoint=None, mesh=None):
+        from transmogrifai_tpu.models.trees import clear_sweep_caches
+        from transmogrifai_tpu.tuning import HalvingConfig, halving_validate
+
+        with _sync_sweep(sync):
+            sel = _selector()
+            if mesh is not None:
+                sel.with_mesh(mesh)
+            best, results, sched = halving_validate(
+                sel.validator, sel._candidates(with_groups=False), X, y,
+                np.ones(len(y), np.float32), sel._metric,
+                sel.validation_metric, sel.larger_better,
+                HalvingConfig(eta=3, min_rows=128, seed=7),
+                checkpoint=checkpoint)
+        clear_sweep_caches()
+        return best, results, sched
+
+    @pytest.mark.parametrize(
+        "n_devices",
+        [1, pytest.param(4, marks=pytest.mark.slow)])
+    def test_rung_promotions_and_winner_identical(self, n_devices):
+        X, y = _toy(n=900, d=8, seed=9)
+        mesh = (make_sweep_mesh(6, n_devices=n_devices)
+                if n_devices > 1 else None)
+        best_s, res_s, sched_s = self._halve(X, y, sync=True, mesh=mesh)
+        best_a, res_a, sched_a = self._halve(X, y, sync=False, mesh=mesh)
+        assert best_a == best_s
+        assert _pairs(res_a) == _pairs(res_s)
+        assert sched_a["survivors"] == sched_s["survivors"]
+        assert [r["promoted"] for r in sched_a["rungs"]] == \
+               [r["promoted"] for r in sched_s["rungs"]]
+
+    def test_on_device_promote_fetches_indices_only(self):
+        """Deferred rungs fetch k int32 indices per promotion (tag
+        halving.promote) and one combined end-of-ladder materialize —
+        never per-candidate host metrics mid-ladder."""
+        X, y = _toy(n=900, d=8, seed=9)
+        profiling.reset_counters()
+        self._halve(X, y, sync=False)
+        tags = profiling.COUNTERS.drain_tags
+        assert any(k.startswith("halving.promote") for k in tags)
+        assert any(k.startswith("sweep.final") for k in tags)
+
+    def test_checkpointed_ladder_stays_sync_and_matches(self, tmp_path):
+        """With a rung checkpoint attached, deferral disables (durability
+        cursor needs per-rung host values) — doc + winner still match the
+        kill-switch run byte for byte."""
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SweepCheckpointManager, sweep_fingerprint,
+        )
+
+        X, y = _toy(n=900, d=8, seed=9)
+
+        def manager(name):
+            sel = _selector()
+            fp = sweep_fingerprint(sel._candidates(with_groups=False),
+                                   "AuPR", "cv2", strategy="halving",
+                                   n_rows=len(y))
+            return SweepCheckpointManager(str(tmp_path / name), fp)
+
+        m_s = manager("sync")
+        best_s, res_s, _ = self._halve(X, y, sync=True, checkpoint=m_s)
+        m_a = manager("async")
+        best_a, res_a, _ = self._halve(X, y, sync=False, checkpoint=m_a)
+        assert best_a == best_s
+        assert _pairs(res_a) == _pairs(res_s)
+        assert m_a.export_doc()["units"] == m_s.export_doc()["units"]
+
+
+class TestElasticComposition:
+    @pytest.mark.slow
+    def test_device_loss_mid_block_still_recovers(self):
+        """Async dispatch must not change WHERE faults fire: an injected
+        device.loss mid-sweep retries on a shrunk mesh, counters move,
+        and the winner matches the healthy sync run."""
+        X, y = _toy(n=300, d=12, seed=5)
+        best0, res0 = _sweep(_selector(), X, y, sync=True,
+                             with_groups=False)
+        sel = _selector().with_mesh(make_sweep_mesh(6, n_devices=8))
+        ctx = sel._elastic_context(len(y), X.shape[1], 6)
+        with faults.inject(faults.FaultSpec(
+                point="device.loss", action="device_loss", at=4, times=1)):
+            best, res = _sweep(sel, X, y, sync=False, elastic=ctx,
+                               with_groups=False)
+        assert all(r.error is None for r in res)
+        c = ctx.counters
+        assert c.device_losses == 1 and c.retries == 1
+        assert c.mesh_shrinks >= 1
+        assert best == best0
+        np.testing.assert_allclose(
+            [r.metric_value for r in res],
+            [r.metric_value for r in res0], atol=2e-2)
+
+
+class TestOverlapLedger:
+    def test_count_drain_overlap_booking(self):
+        profiling.reset_counters()
+        profiling.count_drain(0.25, tag="x")
+        profiling.count_drain(0.5, tag="x", overlapped=True)
+        profiling.count_drain(0.125, overlapped=True)
+        c = profiling.COUNTERS
+        assert (c.drain_s, c.drains) == (0.25, 1)
+        assert (c.overlap_s, c.overlaps) == (0.625, 2)
+        assert c.drain_tags == {"x": 0.25, "x+overlap": 0.5}
+
+    def test_ledger_json_fields(self):
+        profiling.reset_counters()
+        profiling.count_drain(0.25, tag="sweep.final")
+        profiling.count_drain(0.5, tag="sweep.checkpoint", overlapped=True)
+        j = profiling.COUNTERS.to_json()
+        assert j["drainSecs"] == 0.25
+        assert j["overlapSecs"] == 0.5
+        assert j["overlaps"] == 1
+        assert j["drainTags"] == {"sweep.final": 0.25,
+                                  "sweep.checkpoint+overlap": 0.5}
+
+    def test_fetch_timed_attribution(self):
+        import jax.numpy as jnp
+
+        profiling.reset_counters()
+        v = profiling.fetch_timed(jnp.arange(4.0), np.float64,
+                                  tag="t", overlapped=True)
+        assert v.dtype == np.float64 and v.shape == (4,)
+        c = profiling.COUNTERS
+        assert c.drains == 0 and c.overlaps == 1
+        assert set(c.drain_tags) == {"t+overlap"}
+
+    def test_async_flat_sweep_books_final_drain_tag(self):
+        X, y = _toy()
+        profiling.reset_counters()
+        _sweep(_selector(), X, y, sync=False)
+        assert any(k.startswith("sweep.final")
+                   for k in profiling.COUNTERS.drain_tags)
+
+    def test_lagged_checkpoint_flush_books_overlap(self, tmp_path):
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SweepCheckpointManager, sweep_fingerprint,
+        )
+
+        X, y = _toy()
+        sel = _selector()
+        fp = sweep_fingerprint(sel._candidates(), "AuPR", "cv2",
+                               n_rows=len(y))
+        m = SweepCheckpointManager(str(tmp_path / "ck"), fp)
+        profiling.reset_counters()
+        _sweep(sel, X, y, sync=False, checkpoint=m)
+        tags = profiling.COUNTERS.drain_tags
+        # all but the last flush ride behind the next dispatch; the final
+        # in-flight flush is the genuine durability sync point
+        assert any(k == "sweep.checkpoint+overlap" for k in tags)
+        assert any(k == "sweep.checkpoint" for k in tags)

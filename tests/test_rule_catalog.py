@@ -225,12 +225,14 @@ def _tm041():
 
 
 def _tm042():
+    # the async-dispatch extension: a bare _materialize in the loop that
+    # drives run_group_block blocks on per-unit metrics mid-pipeline
     return _shard(
-        "def sweep(chunks, n):\n"
-        "    mesh = make_sweep_mesh(n)\n"
+        "def drive(queue, groups):\n"
         "    out = []\n"
-        "    for c in chunks:\n"
-        "        out.append(jax.device_put(c))\n"
+        "    for g in groups:\n"
+        "        queue.run_group_block(g)\n"
+        "        out.extend(_materialize(g.vals))\n"
         "    return out\n")
 
 

@@ -196,6 +196,76 @@ def test_tm042_non_sweep_function_is_clean():
     assert len(f) == 0
 
 
+# -- the async-dispatch extension: blocking metric fetches in the loop
+# that drives run_group_block/run_unit ---------------------------------------
+
+def test_tm042_bare_materialize_in_dispatch_loop_fires():
+    f = _lint(
+        "def drive(queue, groups, all_vals):\n"
+        "    for g in groups:\n"
+        "        queue.run_group_block(g)\n"
+        "        rows = _materialize(all_vals)\n")
+    assert f.rules_fired() == ["TM042"]
+    assert "overlapped=" in f.format()
+
+
+def test_tm042_bare_fetch_timed_in_dispatch_loop_fires():
+    f = _lint(
+        "def drive(queue, units):\n"
+        "    out = []\n"
+        "    for u in units:\n"
+        "        queue.run_unit(u)\n"
+        "        out.append(fetch_timed(u.metrics))\n"
+        "    return out\n")
+    assert f.rules_fired() == ["TM042"]
+
+
+def test_tm042_overlapped_kwarg_is_the_sanctioned_lagged_fetch():
+    """Any statically visible overlapped= keyword exempts the call —
+    including overlapped=<variable> (the flush_pending idiom)."""
+    f = _lint(
+        "def drive(queue, groups, all_vals, overlapped):\n"
+        "    for g in groups:\n"
+        "        queue.run_group_block(g)\n"
+        "        _materialize(all_vals, overlapped=True)\n"
+        "        fetch_timed(g.matrix, overlapped=overlapped)\n")
+    assert len(f) == 0
+
+
+def test_tm042_block_until_ready_in_dispatch_loop_names_pipeline():
+    f = _lint(
+        "def drive(queue, groups):\n"
+        "    for g in groups:\n"
+        "        queue.run_group_block(g)\n"
+        "        g.matrix.block_until_ready()\n")
+    assert f.rules_fired() == ["TM042"]
+    assert "double-buffered launch pipeline" in f.format()
+
+
+def test_tm042_materialize_outside_dispatch_context_is_clean():
+    """halving_validate's end-of-ladder combined materialize: the
+    function calls validator.validate, not run_group_block/run_unit, so
+    it is no dispatch context and the one-shot fetch is sanctioned."""
+    f = _lint(
+        "def ladder(validator, rungs):\n"
+        "    deferred = []\n"
+        "    for r in rungs:\n"
+        "        deferred.append(validator.validate(r))\n"
+        "    return _materialize(deferred)\n")
+    assert len(f) == 0
+
+
+def test_tm042_fetch_after_dispatch_loop_is_clean():
+    """The end-of-sweep collect: fetches AFTER the dispatch loop (not
+    inside it) are the design, not a violation."""
+    f = _lint(
+        "def drive(queue, groups, all_vals):\n"
+        "    for g in groups:\n"
+        "        queue.run_group_block(g)\n"
+        "    return _materialize(all_vals)\n")
+    assert len(f) == 0
+
+
 # ---------------------------------------------------------------------------
 # TM043 — donated-buffer reuse
 # ---------------------------------------------------------------------------

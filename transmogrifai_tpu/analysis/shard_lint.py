@@ -25,7 +25,16 @@ These rules pin those conventions:
   / ``device_get`` / ``.block_until_ready()`` lexically inside a
   ``for``/``while`` loop of a function that establishes a sweep context
   (calls ``make_sweep_mesh`` or ``_place_sweep``) — per-iteration
-  transfers are the classic sweep-scaling leak.
+  transfers are the classic sweep-scaling leak.  Since the async sweep
+  scheduler, a DISPATCH loop (a loop in a function driving
+  ``run_group_block`` / ``run_unit``) is also a sweep context, and a
+  blocking metric fetch inside it (``_materialize`` / ``fetch_timed``
+  without a statically visible ``overlapped=`` opt-in, or
+  ``block_until_ready`` between group blocks) is a forbidden sync point:
+  it stalls the double-buffered launch pipeline once per iteration.  The
+  ``overlapped=`` keyword marks a lagged fetch that drains behind
+  already-enqueued work (utils/profiling.py books it as overlap, not
+  drain) and is the sanctioned way to wait inside the loop.
 * **TM043 — donated-buffer reuse.**  An argument passed in a donated
   position of a ``jax.jit(..., donate_argnums=...)`` function is read
   again after the call (its buffer may alias the output).
@@ -74,6 +83,14 @@ _MESH_FNS = {"make_mesh"}
 _RAW_MESH = {"Mesh"}
 #: call sites that establish a sweep context for TM042
 _SWEEP_CONTEXT_FNS = {"make_sweep_mesh", "_place_sweep"}
+#: call sites that make a function a sweep DISPATCH loop for TM042 —
+#: the async scheduler's hot path, where any blocking fetch stalls the
+#: double-buffered launch pipeline
+_DISPATCH_CONTEXT_FNS = {"run_group_block", "run_unit"}
+#: blocking metric fetches forbidden inside a dispatch loop unless they
+#: carry an ``overlapped=`` keyword (the lagged-fetch opt-in —
+#: utils/profiling.py books those as overlap, not drain)
+_DEFERRED_FETCH_FNS = {"_materialize", "fetch_timed"}
 
 #: calls that execute a sweep unit's fit body — a try wrapping one of
 #: these is "sweep-unit execution" for TM046
@@ -444,11 +461,11 @@ class _ShardLinter:
     # -- TM042: host round-trips inside sweep inner loops --------------------
 
     def _check_sweep_loops(self, fn) -> None:
-        is_sweep = any(
-            isinstance(n, ast.Call)
-            and _last(dotted(n.func)) in _SWEEP_CONTEXT_FNS
-            for n in scope_walk(fn))
-        if not is_sweep:
+        ctx = {_last(dotted(n.func)) for n in scope_walk(fn)
+               if isinstance(n, ast.Call)}
+        is_sweep = bool(ctx & _SWEEP_CONTEXT_FNS)
+        is_dispatch = bool(ctx & _DISPATCH_CONTEXT_FNS)
+        if not (is_sweep or is_dispatch):
             return
         for loop in scope_walk(fn):
             if not isinstance(loop, (ast.For, ast.While)):
@@ -467,7 +484,20 @@ class _ShardLinter:
                       and n.func.attr == "block_until_ready"):
                     self._emit("TM042", n,
                                "block_until_ready inside a sweep inner "
-                               "loop: a device sync per iteration",
+                               "loop: a device sync per iteration"
+                               + (" — between group blocks it stalls "
+                                  "the double-buffered launch pipeline"
+                                  if is_dispatch else ""),
+                               fn.lineno)
+                elif (is_dispatch and name in _DEFERRED_FETCH_FNS
+                      and not any(kw.arg == "overlapped"
+                                  for kw in n.keywords)):
+                    self._emit("TM042", n,
+                               f"{name} inside the sweep dispatch loop "
+                               f"blocks on per-unit metrics while later "
+                               f"launches wait — defer the fetch to the "
+                               f"end-of-sweep collect, or mark a lagged "
+                               f"fetch with overlapped=",
                                fn.lineno)
 
     # -- TM043: donated-buffer reuse ----------------------------------------

@@ -1092,7 +1092,8 @@ class _GBTBase(PredictorEstimator):
                     # instead of blocking on the fresh one, which would
                     # serialize the boosting pipeline on the fetch round trip
                     best_metric, best_len, stall, stop = _es_patience(
-                        _materialize_es(lagged), best_metric, best_len,
+                        _materialize_es(lagged, overlapped=True),
+                        best_metric, best_len,
                         stall, self.early_stopping_rounds)
                     lagged, pending = pending, []
                     if stop:
@@ -1208,7 +1209,8 @@ class _GBTBase(PredictorEstimator):
             if run_es:
                 pending = [(start + j + 1, ms[j]) for j in range(es_chunk)
                            if start + j + 1 <= self.max_iter]
-                if es_patience_vec(_materialize_es(lagged), stopped,
+                if es_patience_vec(_materialize_es(lagged, overlapped=True),
+                                   stopped,
                                    best_metric, best_len_a, stall_a,
                                    self.early_stopping_rounds):
                     break
@@ -1250,15 +1252,20 @@ class _GBTBase(PredictorEstimator):
         return -jnp.mean((F[vi, 0] - yj[vi]) ** 2)
 
 
-def _materialize_es(chunk_rows):
+def _materialize_es(chunk_rows, overlapped: bool = False):
     """Fetch a chunk of (round, device-metric) pairs in ONE sync — THE
     chunk-fetch idiom for both ES paths: metrics may be scalars (single
     chain) or (S,) chain vectors (the batched GBT grid group).  The sync
-    books queue-drain separately from the byte transfer (fetch_timed)."""
+    books queue-drain separately from the byte transfer (fetch_timed);
+    ``overlapped=True`` is the LAGGED call sites' booking (the next
+    chunk's rounds are already enqueued behind these values, so the wait
+    runs under live compute — ``overlapSecs``, not ``drainSecs``), while
+    the end-of-fit drain of the in-flight chunk stays a genuine drain."""
     if not chunk_rows:
         return []
     from ..utils.profiling import fetch_timed
-    vals = fetch_timed(jnp.stack([m for _, m in chunk_rows]))
+    vals = fetch_timed(jnp.stack([m for _, m in chunk_rows]),
+                       tag="gbt.es", overlapped=overlapped)
     return [(n_at, m) for (n_at, _), m in zip(chunk_rows, vals)]
 
 

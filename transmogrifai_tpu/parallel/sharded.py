@@ -309,16 +309,24 @@ def gbt_chain_rounds_sharded(binned, y, W, Fm0, yv, vi, depth_lim, lams,
                                                  length=n_rounds)
             return Fm_end, fs, ts, lfs, ms
 
-        fn = jax.jit(shard_map_compat(
-            shard_fn, mesh,
-            (P(data_axis, None), P(data_axis),
-             P(grid_axis, data_axis), P(grid_axis, data_axis),
-             P(None), P(None), P(None, None),
-             P(grid_axis), P(grid_axis), P(grid_axis), P(grid_axis),
-             P(grid_axis), P(grid_axis), P(grid_axis)),
-            (P(grid_axis, data_axis), P(None, grid_axis, None),
-             P(None, grid_axis, None), P(None, grid_axis, None, None),
-             P(None, grid_axis))))
+        # out_shardings pinned to the shard_map out_specs: the async sweep
+        # dispatches block N+1 while block N's outputs are still in flight,
+        # and an explicit output layout keeps GSPMD from inserting a
+        # resharding (or worse, a host round-trip) between chained launches
+        # that feed one block's Fm/metrics into the next chunk's inputs.
+        out_specs = (P(grid_axis, data_axis), P(None, grid_axis, None),
+                     P(None, grid_axis, None), P(None, grid_axis, None, None),
+                     P(None, grid_axis))
+        fn = jax.jit(
+            shard_map_compat(
+                shard_fn, mesh,
+                (P(data_axis, None), P(data_axis),
+                 P(grid_axis, data_axis), P(grid_axis, data_axis),
+                 P(None), P(None), P(None, None),
+                 P(grid_axis), P(grid_axis), P(grid_axis), P(grid_axis),
+                 P(grid_axis), P(grid_axis), P(grid_axis)),
+                out_specs),
+            out_shardings=tuple(NamedSharding(mesh, p) for p in out_specs))
         _TREE_SWEEP_JITS[key] = fn
     return fn(binned, y, W, Fm0, yv, vi, jnp.asarray(be_host), depth_lim,
               lams, mcws, migs, mins_, lrs, mgrs)
@@ -398,15 +406,23 @@ def grow_rf_grid_sharded(binned, Y, W_tr, BWr, feat_idx, pair_fold,
             f, t, lf, snaps = jax.vmap(one)(bw, mig, mi, dep, fi_l)
             return f, t, lf, snaps
 
-        fn = jax.jit(shard_map_compat(
-            shard_fn, mesh,
-            (P(data_axis, None), P(data_axis, None), P(None, data_axis),
-             P(None, data_axis), P(None, None),
-             P(grid_axis), P(grid_axis), P(grid_axis), P(grid_axis),
-             P(grid_axis), P(grid_axis)),
-            (P(grid_axis, None), P(grid_axis, None),
-             P(grid_axis, None, None),
-             tuple(P(grid_axis, None, None) for _ in leaf_levels))))
+        # explicit out_shardings matching the shard_map out_specs — chunked
+        # async launches keep a fixed grid-sharded output layout, so the
+        # dispatch loop never forces a resharding between in-flight chunks
+        out_specs = (P(grid_axis, None), P(grid_axis, None),
+                     P(grid_axis, None, None),
+                     tuple(P(grid_axis, None, None) for _ in leaf_levels))
+        fn = jax.jit(
+            shard_map_compat(
+                shard_fn, mesh,
+                (P(data_axis, None), P(data_axis, None), P(None, data_axis),
+                 P(None, data_axis), P(None, None),
+                 P(grid_axis), P(grid_axis), P(grid_axis), P(grid_axis),
+                 P(grid_axis), P(grid_axis)),
+                out_specs),
+            out_shardings=jax.tree_util.tree_map(
+                lambda p: NamedSharding(mesh, p), out_specs,
+                is_leaf=lambda x: isinstance(x, P)))
         _TREE_SWEEP_JITS[key] = fn
 
     gs = grid_sharding(mesh)

@@ -1,0 +1,129 @@
+"""Async sweep dispatch helpers — device-resident scoring and promotion.
+
+ROADMAP item 1 ("kill the drain stall"): the sweep hot loop keeps every
+candidate's fold metrics device-resident across units, dispatches the
+next grid-group block while the previous one drains, and fetches only
+the final reduced summary.  This module holds the pieces shared by the
+queue scheduler (``selector.validators.SweepWorkQueue._run_all_async``)
+and the halving scheduler (``tuning.halving``):
+
+* :func:`sync_sweep_forced` — the ``TMOG_SYNC_SWEEP=1`` kill-switch,
+  read at sweep time (not import time) so a process can toggle it
+  between sweeps.  The switch restores the historical synchronous loop
+  byte-identically (``_run_all_inner``).
+* :func:`device_rung_scores` / :func:`device_promote` — a halving rung's
+  elimination as an on-device finite-mean + ``lax.top_k`` reduction: the
+  host fetches ``survivors_out`` int32 indices instead of the rung's
+  full (C, F) metric matrix, so a rung advances without materializing
+  per-candidate metrics.
+
+Tie-breaking parity: ``lax.top_k`` returns the LOWER-index element first
+among equals, which matches the host promotion's
+``sorted(alive, key=lambda i: (sign * score[i], i))`` order, so the
+device and host paths promote identical sets on ties (errored candidates
+all carry the same worst sentinel and tie-break by index).  Device means
+run in f32 where the host collect averages in f64 — candidates separated
+by less than f32 epsilon may rank differently between the two paths; the
+final winner is always re-selected from the host-precision ``collect``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+__all__ = ["sync_sweep_forced", "device_rung_scores", "device_promote"]
+
+
+def sync_sweep_forced() -> bool:
+    """True when ``TMOG_SYNC_SWEEP=1``: run the historical synchronous
+    sweep loop (per-unit materialization, host-side halving promotion)."""
+    return os.environ.get("TMOG_SYNC_SWEEP", "") == "1"
+
+
+_ROW_MEANS_JIT = None
+_TOP_K_JIT = None
+
+
+def _finite_mean_rows(M):
+    """(C, F) device matrix -> (C,) f32 row means over FINITE entries
+    (NaN when a row has none) — the device twin of ``collect``'s
+    finite-fold averaging."""
+    global _ROW_MEANS_JIT
+    if _ROW_MEANS_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        def f(m):
+            m = m.astype(jnp.float32)
+            fin = jnp.isfinite(m)
+            s = jnp.where(fin, m, 0.0).sum(axis=1)
+            c = fin.sum(axis=1)
+            return jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan)
+
+        _ROW_MEANS_JIT = jax.jit(f)
+    return _ROW_MEANS_JIT(M)
+
+
+def device_rung_scores(all_vals: List[Any], errors: List[Optional[str]],
+                       larger_better: bool):
+    """A rung's per-candidate scores as ONE (C,) device vector.
+
+    ``all_vals``/``errors`` are a deferred sweep's raw outputs
+    (``SweepWorkQueue.run_all(..., defer=True)``): each entry is a
+    ``_GroupRow`` marker into a device metric matrix, a list of device
+    metric scalars, or host floats (restored / budget-skipped units).
+    Grid-group matrices reduce with one ``_finite_mean_rows`` launch per
+    matrix; nothing is fetched to the host here — the caller hands the
+    vector to :func:`device_promote`.  Errored units score the worst
+    sentinel for the metric direction (matching ``collect``)."""
+    import jax.numpy as jnp
+
+    from .validators import _GroupRow
+
+    worst = float("-inf") if larger_better else float("inf")
+    row_means: dict = {}
+    cols = []
+    for vals, err in zip(all_vals, errors):
+        if isinstance(vals, _GroupRow):
+            mid = id(vals.matrix)
+            if mid not in row_means:
+                row_means[mid] = _finite_mean_rows(vals.matrix)
+            cols.append(row_means[mid][vals.row])
+        elif err is not None or not len(vals):
+            cols.append(jnp.float32(worst))
+        else:
+            v = jnp.stack([jnp.asarray(x, jnp.float32) for x in vals])
+            cols.append(_finite_mean_rows(v[None, :])[0])
+    return jnp.stack(cols)
+
+
+def device_promote(scores, survivors_out: int, larger_better: bool
+                   ) -> List[int]:
+    """Top-``survivors_out`` positions of a (C,) device score vector,
+    fetched as ``survivors_out`` int32s (the rung's ONLY host round-trip
+    — booked as a genuine drain under ``halving.promote``: the next
+    rung's candidate set depends on it, so nothing can overlap it).
+    NaN scores (all-non-finite folds) rank worst, like ``collect``'s
+    error promotion; returned positions are sorted ascending."""
+    global _TOP_K_JIT
+    import numpy as np
+
+    from ..utils.profiling import fetch_timed
+
+    if _TOP_K_JIT is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=(1, 2))
+        def f(s, k, larger):
+            v = s if larger else -s
+            v = jnp.where(jnp.isnan(v), -jnp.inf, v)
+            _, idx = jax.lax.top_k(v, k)
+            return idx
+
+        _TOP_K_JIT = f
+    idx = _TOP_K_JIT(scores, int(survivors_out), bool(larger_better))
+    fetched = fetch_timed(idx, np.int64, tag="halving.promote")
+    return sorted(int(i) for i in fetched)

@@ -1058,7 +1058,8 @@ class GBTGridGroup(TreeGridGroup):
                            for j in range(es_chunk)
                            if start + j + 1 <= e0.max_iter]
                 if _replay_es(lagged, stopped, best_metric, best_len,
-                              stall, e0.early_stopping_rounds):
+                              stall, e0.early_stopping_rounds,
+                              overlapped=True):
                     break
                 lagged = pending
         if run_es and not stopped.all():
@@ -1129,16 +1130,20 @@ class GBTGridGroup(TreeGridGroup):
 
 
 def _replay_es(chunk_rows, stopped, best_metric, best_len, stall,
-               patience: int) -> bool:
+               patience: int, overlapped: bool = False) -> bool:
     """Replay one fetched chunk of per-chain ES metrics against the
     host-side patience state (in place); True when every chain stopped.
     The rule itself is ``trees.es_patience_vec`` — the same code the
-    sequential single-chain fits run."""
+    sequential single-chain fits run.  ``overlapped=True`` at the lagged
+    call site (the next chunk's launch is already enqueued, so this wait
+    books as overlap, not drain — utils/profiling.py)."""
     if not chunk_rows:
         return bool(stopped.all())
     from ..models.trees import _materialize_es, es_patience_vec
 
-    return es_patience_vec(_materialize_es(chunk_rows), stopped,
+    return es_patience_vec(_materialize_es(chunk_rows,
+                                           overlapped=overlapped),
+                           stopped,
                            best_metric, best_len, stall, patience)
 
 

@@ -721,7 +721,9 @@ class ModelSelector(PredictorEstimator):
         """Cancel + join the tree-prep prefetch thread (no-op when none
         is running).  Called from the elastic shrink hook BEFORE the mesh
         is re-pointed and from the fit's teardown, so no daemon prep work
-        outlives the sweep that started it."""
+        outlives the sweep that started it.  The join wait is booked into
+        the transfer ledger (``tree_prefetch.join`` drain) — it used to
+        disappear into fit wall, making prefetch stalls unattributable."""
         t = getattr(self, "_prep_thread", None)
         if t is None:
             return
@@ -729,7 +731,14 @@ class ModelSelector(PredictorEstimator):
         if cancel is not None:
             cancel.set()
         if t.is_alive():
+            import time as _time
+
+            from ..utils.profiling import count_drain
+
+            t0 = _time.perf_counter()
             t.join(timeout_s)
+            count_drain(_time.perf_counter() - t0,
+                        tag="tree_prefetch.join")
         self._prep_thread = None
         self._prep_cancel = None
 

@@ -78,6 +78,11 @@ class _ValidatorBase:
     eval_fn(y, scores, w_eval) -> float metric."""
 
     larger_better: bool = True
+    #: this validator's sweep runs through SweepWorkQueue and honors
+    #: ``validate(..., defer=True)`` (raw deferred results instead of a
+    #: collected ranking) — the halving scheduler checks this before
+    #: deferring a rung's materialization to its on-device promotion
+    supports_defer: bool = True
 
     def validate(
         self,
@@ -91,6 +96,7 @@ class _ValidatorBase:
         larger_better: bool = True,
         checkpoint=None,
         elastic=None,
+        defer: bool = False,
     ) -> Tuple[int, List[ValidationResult]]:
         raise NotImplementedError
 
@@ -123,6 +129,7 @@ class _ValidatorBase:
         larger_better: bool = True,
         checkpoint=None,
         elastic=None,
+        defer: bool = False,
     ) -> Tuple[int, List[ValidationResult]]:
         """Validate candidates over PRE-BUILT fold matrices — each context
         a ``(X_tr, y_tr, w_tr, X_ev, y_ev, w_ev)`` tuple.  The streaming
@@ -140,7 +147,7 @@ class _ValidatorBase:
 
         return _run_sweep(candidates, list(per_fold), run_fold, metric_name,
                           larger_better, getattr(self, "max_wait", None),
-                          checkpoint=checkpoint, elastic=elastic)
+                          checkpoint=checkpoint, elastic=elastic, defer=defer)
 
     @staticmethod
     def _fold_matrices(data, during_dag, label_name, features_name,
@@ -204,7 +211,8 @@ class OpCrossValidation(_ValidatorBase):
         self.max_wait = max_wait
 
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
-                 larger_better=True, checkpoint=None, elastic=None):
+                 larger_better=True, checkpoint=None, elastic=None,
+                 defer=False):
         n = X.shape[0]
         folds = make_folds(n, self.num_folds, y=y, stratify=self.stratify,
                            seed=self.seed)
@@ -226,7 +234,7 @@ class OpCrossValidation(_ValidatorBase):
 
         return _run_sweep(candidates, fold_ctxs, run_fold, metric_name,
                           larger_better, self.max_wait, run_group=run_group,
-                          checkpoint=checkpoint, elastic=elastic)
+                          checkpoint=checkpoint, elastic=elastic, defer=defer)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -285,7 +293,8 @@ class OpTrainValidationSplit(_ValidatorBase):
         return in_train
 
     def validate(self, candidates, X, y, base_weights, eval_fn, metric_name,
-                 larger_better=True, checkpoint=None, elastic=None):
+                 larger_better=True, checkpoint=None, elastic=None,
+                 defer=False):
         n = X.shape[0]
         in_train = self._split_mask(n, y)
         w_train = base_weights * in_train
@@ -300,7 +309,7 @@ class OpTrainValidationSplit(_ValidatorBase):
 
         return _run_sweep(candidates, [None], run_fold, metric_name,
                           larger_better, self.max_wait, run_group=run_group,
-                          checkpoint=checkpoint, elastic=elastic)
+                          checkpoint=checkpoint, elastic=elastic, defer=defer)
 
     def validate_with_dag(self, candidates, data, during_dag, label_name,
                           features_name, y, base_weights, eval_fn,
@@ -526,9 +535,19 @@ class SweepWorkQueue:
     # -- the default scheduler: full sweep in stable order -------------------
 
     def run_all(self, metric_name: str, larger_better: bool,
-                max_wait: Optional[float], checkpoint=None, elastic=None
+                max_wait: Optional[float], checkpoint=None, elastic=None,
+                defer: bool = False
                 ) -> Tuple[int, List[ValidationResult]]:
         """Every unit in stable order — the classic full sweep.
+
+        The default scheduler is ASYNC (``_run_all_async``): group blocks
+        and unit programs dispatch back-to-back with no device sync
+        between them, checkpoint flushes lag one dispatch behind the
+        queue head (the flushed block's drain overlaps the block just
+        enqueued), and per-candidate metrics stay device-resident until
+        one end-of-sweep fetch in ``collect``.  ``TMOG_SYNC_SWEEP=1``
+        (read here, at sweep time) restores the historical synchronous
+        loop ``_run_all_inner`` byte-identically.
 
         ``checkpoint`` (a workflow.checkpoint.SweepCheckpointManager view)
         enables the mid-sweep cursor: units whose fold metrics are already
@@ -537,28 +556,41 @@ class SweepWorkQueue:
         mid-flight resumes at its cursor, ON WHATEVER MESH the resuming
         process has (restored records are host fold metrics; the
         remaining units were re-batched when this queue was built).
-        Checkpointing materializes each unit's device metrics at
-        completion (one stacked fetch per unit or group block) instead of
-        deferring every fetch to the end; that sync is the durability
-        cost and is only paid when a checkpoint is attached.
+        On the sync path checkpointing materializes each unit's device
+        metrics at completion; on the async path the flush is LAGGED one
+        dispatch (booked as an overlapped wait, not a drain) — at most
+        the final in-flight block's durability is lost to a kill, and a
+        resume re-runs exactly that block.
 
         ``elastic`` (parallel.elastic.ElasticContext) arms device-loss
         retry/quarantine and the straggler watchdog — see ``run_unit``.
+
+        ``defer=True`` (async only — the halving scheduler) returns the
+        RAW ``(all_vals, errors)`` with device values still deferred,
+        skipping ``collect``: the caller ranks on device and materializes
+        once at end of sweep.
 
         Raises only when EVERY candidate failed — there is no model to
         select otherwise."""
         import time
 
         from ..obs.trace import begin_span, end_span
+        from .async_dispatch import sync_sweep_forced
 
         if elastic is not None:
             elastic.checkpoint = checkpoint
+        sync = sync_sweep_forced() and not defer
         sweep_span = begin_span(
             "sweep.run", cat="sweep", units=len(self.units),
-            folds=len(self.fold_ctxs), mesh=_mesh_attr(elastic))
+            folds=len(self.fold_ctxs), mesh=_mesh_attr(elastic),
+            mode=("sync" if sync else "async"))
         try:
-            return self._run_all_inner(metric_name, larger_better,
-                                       max_wait, checkpoint, elastic)
+            if sync:
+                return self._run_all_inner(metric_name, larger_better,
+                                           max_wait, checkpoint, elastic)
+            return self._run_all_async(metric_name, larger_better,
+                                       max_wait, checkpoint, elastic,
+                                       defer=defer)
         finally:
             end_span(sweep_span,
                      elastic=(elastic.counters.to_json()
@@ -612,7 +644,10 @@ class SweepWorkQueue:
                 M = self.run_group_block(i, j, elastic=elastic)
                 if M is not None:
                     if checkpoint is not None:
-                        rows = _materialize(
+                        # the sync path's per-block durability sync — the
+                        # async scheduler books the same flush lagged;
+                        # this loop IS the kill-switch baseline
+                        rows = _materialize(  # tmog: disable=TM042
                             [_GroupRow(M, base + r) for r in range(j - i)])
                         for r, vals in enumerate(rows):
                             all_vals.append(vals)
@@ -634,7 +669,7 @@ class SweepWorkQueue:
                 continue
             fold_vals, err = self.run_unit(unit, elastic=elastic)
             if checkpoint is not None:
-                fold_vals = _materialize([fold_vals])[0]
+                fold_vals = _materialize([fold_vals])[0]  # tmog: disable=TM042
                 checkpoint.record_unit(unit.index, fold_vals, err)
             all_vals.append(fold_vals)
             errors.append(err)
@@ -645,18 +680,124 @@ class SweepWorkQueue:
             elastic.drain()
         return self.collect(all_vals, errors, metric_name, larger_better)
 
+    def _run_all_async(self, metric_name: str, larger_better: bool,
+                       max_wait: Optional[float], checkpoint=None,
+                       elastic=None, defer: bool = False):
+        """The double-buffered scheduler: same unit semantics as
+        ``_run_all_inner`` (restore cursor, budget skip, group batching
+        with sequential fallback, elastic ladders), but NO device sync
+        inside the dispatch loop.  Group metric matrices and per-fold
+        device scalars accumulate as deferred values; a checkpointed
+        sweep flushes the PREVIOUS block's records right after the next
+        block is enqueued, so the flush's ``block_until_ready`` overlaps
+        live device work (booked into ``overlapSecs``, tag
+        ``sweep.checkpoint``) instead of stalling the accelerator.  The
+        one genuine drain is the end-of-sweep fetch in ``collect``
+        (``overlap_tail=True``: only the LAST deferred value's wait is a
+        stall — everything fetched before it drains behind still-enqueued
+        later blocks)."""
+        import time
+
+        from ..obs.trace import span as _span
+
+        t0 = time.monotonic()
+        all_vals: List[Any] = []
+        errors: List[Optional[str]] = []
+        #: queue positions (== unit positions) dispatched but not yet
+        #: durable — the lagged checkpoint window, at most one block deep
+        pending: List[int] = []
+
+        def flush_pending(overlapped: bool) -> None:
+            if checkpoint is None or not pending:
+                return
+            with _span("sweep.checkpoint.flush", cat="sweep",
+                       units=len(pending), overlapped=overlapped):
+                rows = _materialize([all_vals[p] for p in pending],
+                                    tag="sweep.checkpoint",
+                                    overlapped=overlapped)
+                for p, vals in zip(pending, rows):
+                    all_vals[p] = vals
+                    checkpoint.record_unit(self.units[p].index, vals,
+                                           errors[p])
+            pending.clear()
+
+        i = 0
+        while i < len(self.units):
+            unit = self.units[i]
+            if checkpoint is not None:
+                rec = checkpoint.restore(unit.index)
+                # geometry check as in the sync loop: a restored record
+                # must match THIS sweep's fold count or it re-runs
+                if rec is not None and (
+                        rec[1] is not None
+                        or len(rec[0]) == len(self.fold_ctxs)):
+                    all_vals.append(rec[0])
+                    errors.append(rec[1])
+                    i += 1
+                    continue
+            elapsed = time.monotonic() - t0
+            if max_wait is not None and elapsed > max_wait and all_vals:
+                all_vals.append([])
+                errors.append(
+                    f"skipped: validation budget max_wait={max_wait}s "
+                    f"exceeded after {elapsed:.1f}s")
+                i += 1
+                continue
+            if unit.group is not None and self._run_group is not None:
+                j = self.group_span(i)
+                if elastic is not None and elastic.groups_invalid:
+                    self.strip_groups(i, j)
+                    continue
+                base = i - self.group_start(i)
+                M = self.run_group_block(i, j, elastic=elastic)
+                if M is not None:
+                    block = []
+                    for r in range(j - i):
+                        block.append(len(all_vals))
+                        all_vals.append(_GroupRow(M, base + r))
+                        errors.append(None)
+                    # this block is now ENQUEUED: the previous block's
+                    # flush drains behind it (overlapped), then this
+                    # block becomes the lagged window
+                    flush_pending(overlapped=True)
+                    pending.extend(block)
+                    i = j
+                    continue
+                self.strip_groups(i, j)
+                continue
+            fold_vals, err = self.run_unit(unit, elastic=elastic)
+            pos = len(all_vals)
+            all_vals.append(fold_vals)
+            errors.append(err)
+            flush_pending(overlapped=True)
+            pending.append(pos)
+            i += 1
+        # the final in-flight block: nothing is enqueued behind it, so
+        # its flush is a genuine (booked) drain — the explicit durability
+        # sync point
+        flush_pending(overlapped=False)
+        if elastic is not None:
+            elastic.drain()
+        if defer:
+            return all_vals, errors
+        with _span("sweep.drain", cat="sweep", units=len(all_vals)):
+            return self.collect(all_vals, errors, metric_name,
+                                larger_better, overlap_tail=True)
+
     # -- result assembly -----------------------------------------------------
 
     def collect(self, all_vals, errors, metric_name: str,
-                larger_better: bool
+                larger_better: bool, overlap_tail: bool = False
                 ) -> Tuple[int, List[ValidationResult]]:
         # the losing sentinel depends on the metric direction: -inf only
         # loses when larger is better; minimize metrics (RMSE, LogLoss)
         # need +inf
         worst = float("-inf") if larger_better else float("inf")
         results: List[ValidationResult] = []
-        for unit, fold_vals, err in zip(
-                self.units, _materialize(all_vals), errors):
+        host_vals = _materialize(
+            all_vals, tag="sweep.final" if overlap_tail else None,
+            overlap_tail=overlap_tail)
+        for unit, fold_vals, err in zip(self.units, host_vals, errors):
             # mean over FINITE folds only: a single faulted fold (NaN from
             # the per-value _materialize fallback) should not zero out the
             # folds that did complete — the reference likewise averages
@@ -679,15 +820,22 @@ class SweepWorkQueue:
 
 def _run_sweep(candidates, fold_ctxs, run_fold, metric_name: str,
                larger_better: bool, max_wait: Optional[float],
-               run_group=None, checkpoint=None, elastic=None
+               run_group=None, checkpoint=None, elastic=None,
+               defer: bool = False
                ) -> Tuple[int, List[ValidationResult]]:
     """The full-sweep scheduler over the work queue (see SweepWorkQueue
     for the execution semantics — this wrapper is the historical entry
-    point every validator calls)."""
+    point every validator calls).  ``defer=True`` skips ``collect`` and
+    returns ``(queue, all_vals, errors)`` with device values deferred —
+    the halving scheduler's on-device rung promotion consumes these."""
     queue = SweepWorkQueue(candidates, fold_ctxs, run_fold,
                            run_group=run_group)
-    return queue.run_all(metric_name, larger_better, max_wait,
-                         checkpoint=checkpoint, elastic=elastic)
+    out = queue.run_all(metric_name, larger_better, max_wait,
+                        checkpoint=checkpoint, elastic=elastic, defer=defer)
+    if defer:
+        all_vals, errors = out
+        return queue, all_vals, errors
+    return out
 
 
 def _argbest(vals: List[float], larger_better: bool) -> int:
@@ -709,7 +857,9 @@ class _GroupRow:
         self.row = row
 
 
-def _materialize(nested: List[Any]) -> List[List[float]]:
+def _materialize(nested: List[Any], tag: Optional[str] = None,
+                 overlapped: bool = False, overlap_tail: bool = False
+                 ) -> List[List[float]]:
     """Fetch all fold metric values in ONE device transfer.
 
     ``eval_fn`` returns device scalars on the device-resident sweep path
@@ -717,16 +867,42 @@ def _materialize(nested: List[Any]) -> List[List[float]]:
     ~0.6 s round trip, so the whole candidates×folds sweep is dispatched
     async and this single stacked fetch replaces per-fold ``float()`` calls.
     Grid-group rows (``_GroupRow``) resolve with one fetch per group matrix.
-    """
+
+    Ledger attribution: ``tag`` names the call site in ``drain_tags``;
+    ``overlapped=True`` books EVERY wait here as overlapped (the async
+    scheduler's lagged checkpoint flush — later work is already enqueued
+    behind these values); ``overlap_tail=True`` is the end-of-sweep mode:
+    waits are overlapped while LATER deferred values still have enqueued
+    programs draining behind them, and only the final wait (the last
+    group matrix, or the stacked scalar fetch when there is one) is a
+    genuine drain — the accelerator is busy until that last value lands."""
     # resolve group matrices first (one transfer each, NaN rows on failure);
     # fetch_timed books queue-drain separately from the byte transfer
     from ..utils.profiling import fetch_timed
 
+    try:
+        import jax
+        has_scalar_tail = any(
+            not isinstance(vals, _GroupRow)
+            and any(isinstance(v, jax.Array) for v in vals)
+            for vals in nested)
+    except Exception:  # pragma: no cover
+        has_scalar_tail = False
+    mat_ids = []
+    for v in nested:
+        if isinstance(v, _GroupRow) and id(v.matrix) not in mat_ids:
+            mat_ids.append(id(v.matrix))
     mats: dict = {}
     for v in nested:
         if isinstance(v, _GroupRow) and id(v.matrix) not in mats:
+            # in tail mode a matrix wait overlaps the still-enqueued
+            # fetches behind it; the LAST one (with no scalar fetch to
+            # follow) is the sweep's terminal stall
+            is_last = (id(v.matrix) == mat_ids[-1]) and not has_scalar_tail
+            ovl = overlapped or (overlap_tail and not is_last)
             try:
-                mats[id(v.matrix)] = fetch_timed(v.matrix, np.float64)
+                mats[id(v.matrix)] = fetch_timed(
+                    v.matrix, np.float64, tag=tag, overlapped=ovl)
             except Exception as e:  # async device fault in the group program
                 import warnings
                 warnings.warn(
@@ -756,7 +932,8 @@ def _materialize(nested: List[Any]) -> List[List[float]]:
     # scalar (~30 ms tunnel dispatch each); jitted it is ONE launch
     try:
         stacked = _stack_jit(*dev)
-        fetched = fetch_timed(stacked, np.float64)
+        fetched = fetch_timed(stacked, np.float64, tag=tag,
+                              overlapped=overlapped)
         host = iter(fetched)
         return [[float(next(host)) if isinstance(v, jax.Array) else float(v)
                  for v in vals] for vals in nested]

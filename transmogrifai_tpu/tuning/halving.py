@@ -202,6 +202,15 @@ def halving_validate(
     ``elastic`` (parallel.elastic.ElasticContext) rides into every rung's
     ``validator.validate`` call: device-loss retry/quarantine and the
     straggler watchdog apply per rung unit.
+
+    Rung elimination is an ON-DEVICE reduction on the async path
+    (``checkpoint is None`` and no ``TMOG_SYNC_SWEEP=1``): each rung's
+    sweep returns DEFERRED device metrics (``validate(..., defer=True)``),
+    promotion is a device finite-mean + top-k whose only host round-trip
+    is ``survivors_out`` int32 indices, and every rung's full metrics
+    materialize in ONE end-of-ladder fetch.  Checkpointed sweeps keep the
+    per-rung materialization (the rung cursor needs durable host metrics)
+    — that sync is the durability cost, exactly as in ``run_all``.
     """
     cfg = config or HalvingConfig()
     n, k = len(y), len(candidates)
@@ -221,8 +230,20 @@ def halving_validate(
                 round(time.perf_counter() - t0, 4)})
         return best, results, sched_json
 
+    from ..selector.async_dispatch import sync_sweep_forced
+
     order = nested_subsample_order(y, cfg.seed, stratify=stratify)
     worst = float("-inf") if larger_better else float("inf")
+    # the deferred-rung path needs a queue-capable validator and no
+    # per-rung durability cursor; the kill-switch restores host promotion
+    use_defer = (checkpoint is None
+                 and getattr(validator, "supports_defer", False)
+                 and not sync_sweep_forced())
+    #: rung outputs applied to ``last_result`` IN ORDER after the ladder
+    #: (deferred rungs resolve in one combined end-of-ladder fetch; a
+    #: rung that fell back to host promotion stores results eagerly) —
+    #: (alive_snapshot, queue, all_vals, errors, results_or_None)
+    deferred_rungs: List[List[Any]] = []
     alive = list(range(k))
     last_result: Dict[int, Any] = {}
     #: original index -> (rung index, rung rows) at elimination
@@ -269,14 +290,61 @@ def halving_validate(
 
         with _obs_span(f"sweep.rung[{rung.index}]", cat="sweep",
                        rows=rung.rows, candidates=len(rung_cands),
-                       full=full):
-            _, results = validator.validate(
-                rung_cands, Xs, ys, ws, eval_fn, metric_name,
-                larger_better=larger_better, checkpoint=rung_ckpt,
-                elastic=elastic)
+                       full=full, deferred=use_defer):
+            if use_defer:
+                queue, all_vals, errs = validator.validate(
+                    rung_cands, Xs, ys, ws, eval_fn, metric_name,
+                    larger_better=larger_better, checkpoint=rung_ckpt,
+                    elastic=elastic, defer=True)
+            else:
+                _, results = validator.validate(
+                    rung_cands, Xs, ys, ws, eval_fn, metric_name,
+                    larger_better=larger_better, checkpoint=rung_ckpt,
+                    elastic=elastic)
         rung.wall_s = time.perf_counter() - t0
         rung.candidate_seconds = rung.wall_s
         total_cand_s += rung.wall_s
+        if use_defer:
+            entry = [list(alive), queue, all_vals, errs, None]
+            deferred_rungs.append(entry)
+            if all(e is not None for e in errs):
+                # every unit errored at DISPATCH time: collect raises the
+                # same "every candidate errored" the sync rung would
+                queue.collect(all_vals, errs, metric_name, larger_better)
+            if full:
+                rung.promoted = list(alive)
+                rungs_done.append(rung.to_json())
+                break
+            from ..selector.async_dispatch import (device_promote,
+                                                   device_rung_scores)
+
+            try:
+                scores_dev = device_rung_scores(all_vals, errs,
+                                                larger_better)
+                pos = device_promote(scores_dev, rung.survivors_out,
+                                     larger_better)
+                promoted = sorted(alive[p] for p in pos)
+            except Exception:  # async device fault surfacing in the
+                # reduction: materialize this rung now (NaN fallbacks
+                # isolate the faulted values) and promote on host
+                _, results = queue.collect(all_vals, errs, metric_name,
+                                           larger_better,
+                                           overlap_tail=True)
+                entry[4] = results
+                scores = {i: (r.metric_value if r.error is None
+                              else worst)
+                          for i, r in zip(alive, results)}
+                sign = -1.0 if larger_better else 1.0
+                ranked = sorted(alive,
+                                key=lambda i: (sign * scores[i], i))
+                promoted = sorted(ranked[:rung.survivors_out])
+            rung.promoted = promoted
+            for i in alive:
+                if i not in promoted:
+                    eliminated[i] = (rung.index, rung.rows)
+            alive = promoted
+            rungs_done.append(rung.to_json())
+            continue
         scores: Dict[int, float] = {}
         for i, r in zip(alive, results):
             # report under the candidate's ORIGINAL params (rung scaling
@@ -306,6 +374,31 @@ def halving_validate(
                 "eliminated": {str(i): [ri, rr]
                                for i, (ri, rr) in eliminated.items()},
                 "rungJson": rungs_done})
+
+    if deferred_rungs:
+        # ONE end-of-ladder fetch resolves every deferred rung's metrics
+        # (the ladder's single materialization point); rung results then
+        # apply to last_result IN RUNG ORDER so a candidate surviving to
+        # a later rung reports that rung's (higher-fidelity) metric —
+        # byte-identical to the sync ladder's incremental overwrites
+        from ..selector.validators import _materialize
+
+        unresolved = [e for e in deferred_rungs if e[4] is None]
+        combined: List[Any] = []
+        for _, _, vals, _, _ in unresolved:
+            combined.extend(vals)
+        host_all = _materialize(combined, tag="sweep.final",
+                                overlap_tail=True)
+        off = 0
+        for e in unresolved:
+            hv = host_all[off:off + len(e[2])]
+            off += len(e[2])
+            _, res = e[1].collect(hv, e[3], metric_name, larger_better)
+            e[4] = res
+        for snap, _, _, _, results in deferred_rungs:
+            for i, r in zip(snap, results):
+                r.params = candidates[i][1]
+                last_result[i] = r
 
     for i, (ri, rrows) in eliminated.items():
         r = last_result[i]

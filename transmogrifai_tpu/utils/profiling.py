@@ -180,6 +180,15 @@ class RunCounters:
     explicit kernel dispatches at our call sites (tree-growth chunks,
     grid-solver programs, scoring programs) — a design-level dispatch
     count, not an XLA op count.
+
+    ``overlap_s`` separates OVERLAPPED waits from stalls: a drain during
+    which later work is already enqueued (the double-buffered sweep loop's
+    lagged checkpoint flush, GBT's lagged ES fetch) keeps the accelerator
+    busy, so its wall belongs in neither ``drain_s`` (host stalled, device
+    idle-after-finish) nor ``fetch_s``.  ``drain_tags`` attributes both
+    kinds of wait to the launch site that caused them ("sweep.final",
+    "sweep.checkpoint", "halving.promote", ...), keyed ``tag`` or
+    ``tag+"+overlap"`` — the ledger a drain regression is debugged from.
     """
 
     upload_bytes: int = 0
@@ -190,6 +199,9 @@ class RunCounters:
     fetches: int = 0
     drain_s: float = 0.0
     drains: int = 0
+    overlap_s: float = 0.0
+    overlaps: int = 0
+    drain_tags: Dict[str, float] = field(default_factory=dict)
     launches: int = 0
     launch_tags: Dict[str, int] = field(default_factory=dict)
     #: elastic-sweep accounting (parallel/elastic.py mirrors its per-sweep
@@ -210,6 +222,9 @@ class RunCounters:
             "fetches": self.fetches,
             "drainSecs": round(self.drain_s, 3),
             "drains": self.drains,
+            "overlapSecs": round(self.overlap_s, 3),
+            "overlaps": self.overlaps,
+            "drainTags": {k: round(v, 3) for k, v in self.drain_tags.items()},
             "launches": self.launches,
             "launchTags": dict(self.launch_tags),
             "elastic": dict(self.elastic),
@@ -249,10 +264,25 @@ def count_fetch(nbytes: int, seconds: float) -> None:
         COUNTERS.fetches += 1
 
 
-def count_drain(seconds: float) -> None:
+def count_drain(seconds: float, tag: Optional[str] = None,
+                overlapped: bool = False) -> None:
+    """Book a device wait.  ``overlapped=True`` means later work was
+    already enqueued when the wait started (the device stays busy), so the
+    time goes to ``overlap_s`` rather than ``drain_s`` — only genuine
+    stalls (nothing behind the wait) count against the drain budget the
+    SWEEP_ASYNC smoke gates.  ``tag`` attributes the wait to its launch
+    site in ``drain_tags`` (suffixed ``+overlap`` for overlapped waits)."""
     with _COUNTERS_LOCK:
-        COUNTERS.drain_s += seconds
-        COUNTERS.drains += 1
+        if overlapped:
+            COUNTERS.overlap_s += seconds
+            COUNTERS.overlaps += 1
+        else:
+            COUNTERS.drain_s += seconds
+            COUNTERS.drains += 1
+        if tag is not None:
+            key = tag + "+overlap" if overlapped else tag
+            COUNTERS.drain_tags[key] = (
+                COUNTERS.drain_tags.get(key, 0.0) + seconds)
 
 
 def count_launch(tag: str, n: int = 1) -> None:
@@ -298,7 +328,7 @@ def elastic_snapshot() -> Dict[str, int]:
     return base
 
 
-def fetch_timed(x, dtype=None):
+def fetch_timed(x, dtype=None, tag=None, overlapped=False):
     """Device→host fetch with drain/transfer split accounting.
 
     ``block_until_ready`` first (time booked as ``drain_s`` — the async
@@ -306,6 +336,14 @@ def fetch_timed(x, dtype=None):
     copy (booked as ``fetch_s`` against the fetched bytes).  Plain
     ``np.asarray`` conflated the two, which at r3's default grid booked
     ~42 s of sweep compute as "fetch time".
+
+    ``overlapped=True`` routes the wait into ``overlap_s`` instead of
+    ``drain_s``: use it ONLY when later device work is already enqueued
+    behind this value, so the wait runs concurrently with useful compute
+    (the async sweep loop's lagged fetches).  TM042 treats a bare
+    ``fetch_timed`` inside a dispatch loop as a forbidden sync point; the
+    statically-visible ``overlapped=True`` kwarg is the opt-out.  ``tag``
+    names the launch site in ``drain_tags``.
 
     Platform caveat (ADVICE r4): on the tunneled axon TPU backend,
     ``block_until_ready`` has been observed to return EARLY — the
@@ -323,7 +361,7 @@ def fetch_timed(x, dtype=None):
     t1 = time.perf_counter()
     out = np.asarray(x) if dtype is None else np.asarray(x, dtype)
     t2 = time.perf_counter()
-    count_drain(t1 - t0)
+    count_drain(t1 - t0, tag=tag, overlapped=overlapped)
     count_fetch(out.nbytes, t2 - t1)
     return out
 
