@@ -157,6 +157,9 @@ def run_child(args) -> int:
         tracer = obs.start_trace(label=f"pod.p{pod.process_index}")
     wf, sel = build_workflow(parallel=2)
     reader = reader_for_csv(args.csv, args.sidecar)
+    from transmogrifai_tpu.utils import profiling
+
+    profiling.reset_counters()
     t0 = time.perf_counter()
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
@@ -165,6 +168,11 @@ def run_child(args) -> int:
             checkpoint_dir=args.ckdir or None,
             checkpoint_every_chunks=4)
     wall = time.perf_counter() - t0
+    # the dispatch-overlap ledger (same fields bench_scale emits): how
+    # much of the train wall was spent BLOCKED draining the async queue
+    transfers = profiling.COUNTERS.to_json()
+    drain_frac = (transfers.get("drainSecs", 0.0) / wall
+                  if wall > 0 else 0.0)
     summ = sel.metadata["model_selector_summary"]
     ev = make_pod_frame(96, seed=1234)
     out = {
@@ -181,6 +189,8 @@ def run_child(args) -> int:
         "retries": model.ingest_profile.total_retries,
         "probs": [round(p, 12) for p in probs_of(model, ev)],
         "wall_s": round(wall, 2),
+        "transfers": transfers,
+        "drainFracOfWall": round(drain_frac, 4),
     }
     if tracer is not None:
         from transmogrifai_tpu import obs

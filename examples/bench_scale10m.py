@@ -1,0 +1,548 @@
+#!/usr/bin/env python
+"""Block-decomposed pod data plane bench/smoke — the 10M-row regime.
+
+Four legs over the SAME deterministic synthetic table (generation is
+keyed on the global row grid, so every leg, every process count, and
+every chunking sees identical bytes), all launched through
+``distributed.launch_local_pod``:
+
+1. **resident** — the full-shard reference: each host materializes its
+   whole row range as one resident array and folds it through the SAME
+   per-block jitted kernels on the SAME block grid.  Its per-host RSS
+   delta is the memory bar the block path must beat; its winner /
+   metric digests are the parity bar.
+2. **block** — the streaming path: each host spills fixed-size row
+   blocks (sized from ``TMOG_STREAM_RETAIN_MB``) through
+   ``ShardedMatrixWriter``'s block-spill mode and folds them one at a
+   time through a device-resident accumulator (``BlockPlane``).  Gates:
+   every metric digest BYTE-IDENTICAL to the resident leg (fold order
+   and combine order are fixed, so residency cannot change a bit), and
+   per-host peak RSS delta < 0.35x the resident leg's.
+3. **killswitch** — ``TMOG_BLOCK_KERNELS=0``: the grid collapses to one
+   whole-shard block, i.e. the pre-block resident reduction.  Gates:
+   run completes, winner agrees with the blocked legs, and both
+   processes report byte-identical digests (whole-shard f32 sums
+   legitimately differ from blocked sums in the last bits, so parity
+   here is winner-level, not byte-level).
+4. **kill/resume** — leg 2 with per-host stripe checkpoints
+   (``BlockStripeStore``) and a SIGKILL injected at the third stripe
+   save (``blockplane.checkpoint``); a rerun over the same stripe
+   directory must restore the striped accumulators and block cursors
+   and finish BYTE-IDENTICAL to leg 2, reporting ``resumed``.
+
+``--smoke`` (scripts/tier1.sh SCALE_SMOKE): downscaled shape, 2 forced
+processes, 32MB retain budget.  ``--full``: 10M x 500 over a 4-process
+pod — the resident reference stays at the parity shape (materializing
+10M x 500 per host is exactly what this PR removes) and the RSS gate
+compares the block leg against the THEORETICAL resident shard bytes.
+
+Usage:
+  python examples/bench_scale10m.py --smoke
+  python examples/bench_scale10m.py --full
+"""
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+SMOKE_ROWS = 320_000
+SMOKE_COLS = 128
+FULL_ROWS = 10_000_000
+FULL_COLS = 500
+SMOKE_RETAIN_MB = 32           # -> 16384-row (8MB) blocks at 128 cols
+GEN_ROWS = 8192                # global generation grid (chunk-invariant)
+REG_GRID = [0.01, 0.1, 1.0]
+N_BINS = 16
+STRIPE_EVERY = 2               # leg-4 stripe cadence (blocks per stripe)
+RSS_RATIO_GATE = 0.35
+RSS_FLOOR_MB = 24.0            # resident delta below this is all noise
+DRAIN_FRAC_GATE = 0.5
+
+
+# ---------------------------------------------------------------------------
+# deterministic data plane (shared by every child)
+# ---------------------------------------------------------------------------
+
+def true_weights(cols, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=cols) / np.sqrt(cols)).astype(np.float32)
+
+
+def gen_global_rows(start, stop, cols, seed):
+    """(X, y) for GLOBAL rows [start, stop) — generated on the fixed
+    ``GEN_ROWS`` grid and sliced, so the bytes depend only on the global
+    row index, never on host ranges or chunk sizes."""
+    import numpy as np
+
+    wt = true_weights(cols, seed)
+    xs, ys = [], []
+    g0 = (start // GEN_ROWS) * GEN_ROWS
+    for g in range(g0, stop, GEN_ROWS):
+        rng = np.random.default_rng([seed, g])
+        # always generate the FULL gen chunk so slices are invariant
+        X = rng.normal(size=(GEN_ROWS, cols)).astype(np.float32)
+        u = rng.random(GEN_ROWS)
+        y = (u < 1.0 / (1.0 + np.exp(-(X @ wt)))).astype(np.float32)
+        lo, hi = max(start - g, 0), min(stop - g, GEN_ROWS)
+        xs.append(X[lo:hi])
+        ys.append(y[lo:hi])
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def _digest(arr) -> str:
+    import numpy as np
+
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# child (one pod process)
+# ---------------------------------------------------------------------------
+
+def run_child(args) -> int:
+    import numpy as np
+
+    from transmogrifai_tpu.distributed import current_pod
+    from transmogrifai_tpu.distributed.hostshard import host_ranges
+    from transmogrifai_tpu.distributed.podstream import (BlockPlane,
+                                                         _rss_now_mb)
+    from transmogrifai_tpu.parallel import sharded as S
+    from transmogrifai_tpu.parallel.ingest import ShardedMatrixWriter
+    from transmogrifai_tpu.utils import profiling
+    from transmogrifai_tpu.workflow.checkpoint import BlockStripeStore
+
+    import jax.numpy as jnp
+
+    pod = current_pod()
+    rows, cols, seed = args.rows, args.cols, args.seed
+    lo, hi = host_ranges(rows, pod.process_count)[pod.process_index]
+    n_local = hi - lo
+    block_rows = S.block_rows_for(cols)
+
+    # warm the collectives AND the fold kernels before the RSS baseline —
+    # gloo buffers, XLA compile caches, and the allocator pool growth from
+    # the first block-sized device buffers are RUNTIME cost, not data-plane
+    # residency (same discipline as PodStreamContext's warmup).  Kernels
+    # are warmed at the REAL block-grid shapes (full block + short tail),
+    # so the measured delta is what the chosen residency mode RETAINS.
+    if pod.active:
+        pod.allgather_obj(b"\x00" * (1 << 20))
+        pod.barrier("warmup")
+    beta0 = jnp.zeros(cols + 1, jnp.float32)
+    for h in sorted({e - s for s, e in S.block_grid(n_local, cols)}):
+        Xw = jnp.zeros((h, cols), jnp.float32)
+        vw = jnp.zeros(h, jnp.float32)
+        np.asarray(S._colstats_fold_jit(
+            jnp.zeros((2, cols + 1), jnp.float32), Xw, vw))
+        g_w, H_w = S._newton_fold_jit(
+            beta0, jnp.zeros((cols + 1, cols + 1), jnp.float32),
+            Xw, vw, vw, beta0, jnp.float32(1.0))
+        np.asarray(g_w), np.asarray(H_w)
+        np.asarray(S._logloss_fold_jit(jnp.zeros(2, jnp.float32),
+                                       Xw, vw, vw, beta0))
+        np.asarray(S._histogram_fold_jit(
+            jnp.zeros((N_BINS, cols, 3), jnp.float32),
+            jnp.zeros((h, cols), jnp.int32), vw, vw, vw, N_BINS))
+    S.newton_solve_host(np.zeros(cols + 1, np.float32),
+                        np.eye(cols + 1, dtype=np.float32),
+                        np.zeros(cols + 1, np.float32), 0.0, cols)
+
+    profiling.reset_counters()
+    rss0 = _rss_now_mb()
+    peak = rss0
+    t0 = time.perf_counter()
+
+    # -- ingest: stream global gen chunks of MY range ----------------------
+    y_local = np.empty(n_local, np.float32)
+    if args.leg == "block":
+        writer = ShardedMatrixWriter(None, n_local, cols,
+                                     block_rows=block_rows)
+        off = 0
+        for g in range(lo, hi, GEN_ROWS):
+            Xg, yg = gen_global_rows(g, min(g + GEN_ROWS, hi), cols, seed)
+            writer.append(Xg)
+            y_local[off:off + len(yg)] = yg
+            off += len(yg)
+        source = writer.finish()
+    else:
+        X_local = np.empty((n_local, cols), np.float32)
+        off = 0
+        for g in range(lo, hi, GEN_ROWS):
+            Xg, yg = gen_global_rows(g, min(g + GEN_ROWS, hi), cols, seed)
+            X_local[off:off + len(Xg)] = Xg
+            y_local[off:off + len(yg)] = yg
+            off += len(Xg)
+        source = X_local
+    peak = max(peak, _rss_now_mb())
+
+    stripes = (BlockStripeStore(args.ckdir, pod.process_index)
+               if args.ckdir else None)
+    plane = BlockPlane(pod, source, stripes=stripes,
+                       stripe_every=STRIPE_EVERY if stripes else 0)
+    digests = {}
+
+    # -- pass 1: colstats --------------------------------------------------
+    def colstats_fold(acc, blk, s, e):
+        return S._colstats_fold_jit(acc, jnp.asarray(blk, jnp.float32),
+                                    jnp.ones(e - s, jnp.float32))
+
+    cacc = plane.run_pass("colstats",
+                          np.zeros((2, cols + 1), np.float32),
+                          colstats_fold)
+    mean, var = S.colstats_from_acc(cacc)
+    digests["colstats"] = _digest(cacc)
+    digests["mean"] = _digest(mean)
+    digests["var"] = _digest(var)
+    peak = max(peak, _rss_now_mb())
+
+    # -- pass 2: blocked Newton sweep + per-candidate logloss scoring ------
+    losses = {}
+    for reg in REG_GRID:
+        coef, b0, n_it = S.fit_logreg_newton_blocked(
+            plane.newton_blocks(y_local), cols, reg_param=reg,
+            wsum=float(rows), combine=plane.combine)
+        beta = np.concatenate([coef, [b0]]).astype(np.float32)
+        digests[f"beta.r{reg}"] = _digest(beta)
+        beta_d = jnp.asarray(beta)
+
+        def ll_fold(acc, blk, s, e, _b=beta_d):
+            return S._logloss_fold_jit(
+                acc, jnp.asarray(blk, jnp.float32),
+                jnp.asarray(y_local[s:e]), jnp.ones(e - s, jnp.float32),
+                _b)
+
+        lacc = plane.run_pass(f"logloss.r{reg}", np.zeros(2, np.float32),
+                              ll_fold)
+        digests[f"logloss.r{reg}"] = _digest(lacc)
+        losses[reg] = float(lacc[0]) / max(float(lacc[1]), 1.0)
+        peak = max(peak, _rss_now_mb())
+    winner = min(REG_GRID, key=lambda r: (losses[r], r))
+
+    # -- pass 3: gradient histogram (tree-sweep form) ----------------------
+    std = np.sqrt(np.maximum(var, 1e-12)).astype(np.float32)
+    blo = (mean - 3.0 * std).astype(np.float32)
+    bw = (6.0 * std / N_BINS).astype(np.float32)
+
+    def hist_fold(acc, blk, s, e):
+        binned = np.clip((blk - blo) / bw, 0, N_BINS - 1).astype(np.int32)
+        yb = y_local[s:e]
+        return S._histogram_fold_jit(
+            acc, jnp.asarray(binned),
+            jnp.asarray(yb - np.float32(0.5)),
+            jnp.full(e - s, 0.25, jnp.float32),
+            jnp.ones(e - s, jnp.float32), N_BINS)
+
+    hacc = plane.run_pass("histogram",
+                          np.zeros((N_BINS, cols, 3), np.float32),
+                          hist_fold)
+    digests["histogram"] = _digest(hacc)
+    peak = max(peak, _rss_now_mb())
+
+    if hasattr(source, "close"):
+        source.close()
+    wall = time.perf_counter() - t0
+    transfers = profiling.COUNTERS.to_json()
+    drain_frac = (transfers.get("drainSecs", 0.0) / wall
+                  if wall > 0 else 0.0)
+    out = {
+        "process": pod.process_index,
+        "processes": pod.process_count,
+        "leg": args.leg,
+        "rows": rows, "cols": cols,
+        "localRows": n_local,
+        "blockRows": block_rows,
+        "plane": plane.to_json(),
+        "resumed": plane.resumed,
+        "winner": winner,
+        "losses": {str(k): round(v, 12) for k, v in losses.items()},
+        "digests": digests,
+        "rssBaseMb": round(rss0, 2),
+        "rssPeakDeltaMb": round(max(peak - rss0, 0.0), 2),
+        "wall_s": round(wall, 2),
+        "transfers": transfers,
+        "drainFracOfWall": round(drain_frac, 4),
+    }
+    print("POD_RESULT " + json.dumps(out), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _parse_results(results):
+    out = []
+    for r in results:
+        rec = None
+        for line in r["stdout"].splitlines():
+            if line.startswith("POD_RESULT "):
+                rec = json.loads(line[len("POD_RESULT "):])
+        out.append(rec)
+    return out
+
+
+def _child_argv(leg, rows, cols, seed, ckdir=""):
+    return [sys.executable, os.path.abspath(__file__), "--child",
+            "--leg", leg, "--rows", str(rows), "--cols", str(cols),
+            "--seed", str(seed), "--ckdir", ckdir]
+
+
+def _launch(n, argv, extra_env=None, timeout=600, kill_grace_s=25):
+    from transmogrifai_tpu.distributed import launch_local_pod
+
+    base = dict(os.environ)
+    base["TMOG_COST_HISTORY"] = base.get("TMOG_COST_HISTORY", "")
+    base.setdefault("TMOG_STREAM_RETAIN_MB", str(SMOKE_RETAIN_MB))
+    base.setdefault("TMOG_BLOCK_KERNELS", "1")
+    base.pop("TMOG_FAULTS", None)
+    if extra_env:
+        base.update(extra_env)
+    return launch_local_pod(n, argv, local_devices=2, base_env=base,
+                            timeout=timeout, kill_grace_s=kill_grace_s)
+
+
+def _fail(gates, name, detail):
+    gates.append({"gate": name, "ok": False, "detail": detail})
+    print(f"GATE FAIL {name}: {detail}")
+
+
+def _ok(gates, name, detail=""):
+    gates.append({"gate": name, "ok": True, "detail": detail})
+    print(f"gate ok   {name}: {detail}")
+
+
+def _child_errs(results):
+    return " | ".join(r["stderr"][-800:] for r in results
+                      if r["returncode"])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--full", action="store_true",
+                    help="10M x 500 block leg over a 4-process pod")
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--cols", type=int, default=None)
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--leg", default="resident")
+    ap.add_argument("--ckdir", default="")
+    args = ap.parse_args()
+    if args.child:
+        return run_child(args)
+
+    rows = args.rows or SMOKE_ROWS
+    cols = args.cols or SMOKE_COLS
+    work = tempfile.mkdtemp(prefix="tmog_scale10m_")
+    try:
+        return _run_legs(args, rows, cols, work)
+    finally:
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def _run_legs(args, rows, cols, work) -> int:
+    procs = max(2, args.procs)
+    gates = []
+    report = {"rows": rows, "cols": cols, "processes": procs,
+              "retainMb": int(os.environ.get("TMOG_STREAM_RETAIN_MB",
+                                             SMOKE_RETAIN_MB)),
+              "legs": {}}
+
+    # -- leg 1: resident full-shard reference ------------------------------
+    r1 = _launch(procs, _child_argv("resident", rows, cols, args.seed),
+                 timeout=900)
+    res = _parse_results(r1)
+    if any(r["returncode"] != 0 for r in r1) or any(p is None for p in res):
+        _fail(gates, "resident", _child_errs(r1))
+        res = None
+    else:
+        report["legs"]["resident"] = res
+        _ok(gates, "resident",
+            f"walls {[p['wall_s'] for p in res]}s rssDelta "
+            f"{[p['rssPeakDeltaMb'] for p in res]}MB")
+
+    # -- leg 2: block-spill streaming path ----------------------------------
+    r2 = _launch(procs, _child_argv("block", rows, cols, args.seed),
+                 timeout=900)
+    blk = _parse_results(r2)
+    if any(r["returncode"] != 0 for r in r2) or any(p is None for p in blk):
+        _fail(gates, "block", _child_errs(r2))
+        blk = None
+    else:
+        report["legs"]["block"] = blk
+        _ok(gates, "block",
+            f"walls {[p['wall_s'] for p in blk]}s rssDelta "
+            f"{[p['rssPeakDeltaMb'] for p in blk]}MB blocks "
+            f"{blk[0]['plane']['blocks']}")
+    if res and blk:
+        if blk[0]["plane"]["blocks"] < 2:
+            _fail(gates, "block_grid",
+                  f"{blk[0]['plane']['blocks']} block(s) — shape too "
+                  f"small to exercise the streaming fold")
+        else:
+            _ok(gates, "block_grid",
+                f"{blk[0]['plane']['blocks']} blocks of "
+                f"{blk[0]['blockRows']} rows")
+        if any(p["digests"] != res[0]["digests"] for p in blk) or \
+                res[0]["digests"] != res[1]["digests"]:
+            diff = [k for k in res[0]["digests"]
+                    if blk[0]["digests"].get(k) != res[0]["digests"][k]]
+            _fail(gates, "block_parity_bytes",
+                  f"digests differ from resident leg at: {diff or 'cross-process'}")
+        else:
+            _ok(gates, "block_parity_bytes",
+                f"{len(res[0]['digests'])} reduction digests identical "
+                f"across residency modes and processes")
+        if blk[0]["winner"] != res[0]["winner"]:
+            _fail(gates, "block_parity_winner",
+                  f"{blk[0]['winner']} != {res[0]['winner']}")
+        else:
+            _ok(gates, "block_parity_winner", f"reg={res[0]['winner']}")
+        d_res = max(p["rssPeakDeltaMb"] for p in res)
+        d_blk = max(p["rssPeakDeltaMb"] for p in blk)
+        if d_res < RSS_FLOOR_MB:
+            _fail(gates, "block_rss",
+                  f"resident delta {d_res}MB below the {RSS_FLOOR_MB}MB "
+                  f"floor — shape too small to gate")
+        elif d_blk >= RSS_RATIO_GATE * d_res:
+            _fail(gates, "block_rss",
+                  f"block {d_blk}MB vs resident {d_res}MB "
+                  f"(gate {RSS_RATIO_GATE}x)")
+        else:
+            _ok(gates, "block_rss",
+                f"block {d_blk}MB vs resident {d_res}MB "
+                f"(ratio {d_blk / d_res:.2f})")
+        if d_res > 0:
+            report["rssRatio"] = round(d_blk / d_res, 3)
+        d_frac = max(p["drainFracOfWall"] for p in blk)
+        if d_frac >= DRAIN_FRAC_GATE:
+            _fail(gates, "block_drain_frac",
+                  f"drainFracOfWall {d_frac} >= {DRAIN_FRAC_GATE} — the "
+                  f"fold loop is blocking mid-pass")
+        else:
+            _ok(gates, "block_drain_frac", f"drainFracOfWall {d_frac}")
+
+    # -- leg 3: kill-switch (resident single-block reduction) ---------------
+    r3 = _launch(procs, _child_argv("resident", rows, cols, args.seed),
+                 extra_env={"TMOG_BLOCK_KERNELS": "0"}, timeout=900)
+    ks = _parse_results(r3)
+    if any(r["returncode"] != 0 for r in r3) or any(p is None for p in ks):
+        _fail(gates, "killswitch", _child_errs(r3))
+    else:
+        report["legs"]["killswitch"] = ks
+        if ks[0]["plane"]["blocks"] != 1:
+            _fail(gates, "killswitch",
+                  f"TMOG_BLOCK_KERNELS=0 left {ks[0]['plane']['blocks']} "
+                  f"blocks — kill-switch did not collapse the grid")
+        elif any(p["digests"] != ks[0]["digests"] for p in ks):
+            _fail(gates, "killswitch", "processes disagree byte-wise")
+        elif res and ks[0]["winner"] != res[0]["winner"]:
+            _fail(gates, "killswitch",
+                  f"winner {ks[0]['winner']} != blocked {res[0]['winner']}")
+        else:
+            _ok(gates, "killswitch",
+                f"single whole-shard block, winner reg={ks[0]['winner']}, "
+                f"processes byte-agree")
+
+    # -- leg 4: SIGKILL at a stripe save -> bit-exact resume ----------------
+    ck = os.path.join(work, "stripes")
+    kill = {"faults": [{"point": "blockplane.checkpoint", "action": "kill",
+                        "at": 2}]}
+    r_kill = _launch(procs, _child_argv("block", rows, cols, args.seed,
+                                        ckdir=ck),
+                     extra_env={"TMOG_FAULTS": json.dumps(kill)},
+                     timeout=600, kill_grace_s=15)
+    killed_rcs = [r["returncode"] for r in r_kill]
+    r_res = _launch(procs, _child_argv("block", rows, cols, args.seed,
+                                       ckdir=ck), timeout=900)
+    resumed = _parse_results(r_res)
+    if 0 in killed_rcs:
+        _fail(gates, "resume_bit_exact",
+              f"kill leg exited cleanly ({killed_rcs}) — fault missed")
+    elif any(r["returncode"] != 0 for r in r_res) or any(
+            p is None for p in resumed):
+        _fail(gates, "resume_bit_exact", _child_errs(r_res))
+    else:
+        report["legs"]["resume"] = {"killedRcs": killed_rcs,
+                                    "resumed": resumed}
+        if not any(p["resumed"] for p in resumed):
+            _fail(gates, "resume_bit_exact",
+                  "no process restored a stripe cursor")
+        elif blk and any(p["digests"] != blk[0]["digests"]
+                         for p in resumed):
+            diff = [k for k in blk[0]["digests"]
+                    if resumed[0]["digests"].get(k) != blk[0]["digests"][k]]
+            _fail(gates, "resume_bit_exact",
+                  f"resumed digests differ from uninterrupted block leg "
+                  f"at: {diff}")
+        elif blk and resumed[0]["winner"] != blk[0]["winner"]:
+            _fail(gates, "resume_bit_exact",
+                  f"winner {resumed[0]['winner']} != {blk[0]['winner']}")
+        else:
+            _ok(gates, "resume_bit_exact",
+                f"SIGKILL at stripe save, resume reproduces the "
+                f"uninterrupted leg byte-for-byte "
+                f"(resumed flags {[p['resumed'] for p in resumed]})")
+
+    # -- full mode: the 10M x 500 block leg ---------------------------------
+    if args.full:
+        full_env = {"TMOG_STREAM_RETAIN_MB":
+                    os.environ.get("TMOG_STREAM_RETAIN_MB", "256")}
+        fprocs = max(procs, 4)
+        rf = _launch(fprocs, _child_argv("block", FULL_ROWS, FULL_COLS,
+                                         args.seed),
+                     extra_env=full_env, timeout=3600)
+        fblk = _parse_results(rf)
+        if any(r["returncode"] != 0 for r in rf) or any(
+                p is None for p in fblk):
+            _fail(gates, "full_block", _child_errs(rf))
+        else:
+            report["legs"]["full"] = fblk
+            # resident would hold rows/P * cols * 4 bytes per host
+            resident_mb = (FULL_ROWS // fprocs) * FULL_COLS * 4 / 2 ** 20
+            d_blk = max(p["rssPeakDeltaMb"] for p in fblk)
+            if d_blk >= RSS_RATIO_GATE * resident_mb:
+                _fail(gates, "full_block",
+                      f"block {d_blk}MB vs theoretical resident "
+                      f"{resident_mb:.0f}MB (gate {RSS_RATIO_GATE}x)")
+            else:
+                _ok(gates, "full_block",
+                    f"{FULL_ROWS}x{FULL_COLS} over {fprocs} hosts: "
+                    f"{d_blk}MB per host vs {resident_mb:.0f}MB resident "
+                    f"(ratio {d_blk / resident_mb:.3f})")
+
+    ok = all(g["ok"] for g in gates)
+    report["gates"] = gates
+    report["ok"] = ok
+    from transmogrifai_tpu import obs
+
+    report["meta"] = obs.bench_meta()
+    out_path = (os.path.join(tempfile.gettempdir(),
+                             "scale10m_smoke_latest.json")
+                if not args.full
+                else os.path.join(_ROOT, "benchmarks",
+                                  "scale10m_latest.json"))
+    from transmogrifai_tpu.utils.jsonio import write_json_atomic
+
+    write_json_atomic(out_path, report)
+    line = {"ok": ok, "report": out_path}
+    if "rssRatio" in report:
+        line["rssRatio"] = report["rssRatio"]
+    print(json.dumps(line))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

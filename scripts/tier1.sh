@@ -94,6 +94,21 @@ else
   echo "POD_SMOKE=FAILED (see /tmp/_t1_pod.log)"
   rc=1
 fi
+# scale smoke: the block-decomposed 10M-regime data plane at smoke
+# shape — a 2-process pod folds block-streaming colstats / Newton /
+# histogram / logloss passes with per-host spill ingest: per-pass
+# digests and winner BYTE-identical between the block and
+# resident-shard legs (and across processes), per-host peak RSS delta
+# < 0.35x the resident leg, drain fraction < 0.5 (PR 17 async dispatch
+# composes), TMOG_BLOCK_KERNELS=0 collapses to one whole-range block
+# with byte-agreement, and a SIGKILL at a stripe save resumes
+# BIT-exactly from per-host block cursors
+if timeout -k 10 560 env JAX_PLATFORMS=cpu python examples/bench_scale10m.py --smoke > /tmp/_t1_scale10m.log 2>&1; then
+  echo "SCALE_SMOKE=ok $(grep -ao '"rssRatio": [0-9.]*' /tmp/_t1_scale10m.log | tail -1)"
+else
+  echo "SCALE_SMOKE=FAILED (see /tmp/_t1_scale10m.log)"
+  rc=1
+fi
 # event-time ingestion smoke: streamed vs in-core conditional-aggregate
 # fit on a small clickstream — byte-identical winner probabilities
 # between the two modes, event-time scoring of a fresh log through the

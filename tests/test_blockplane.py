@@ -1,0 +1,530 @@
+"""Block-decomposed cross-host linear algebra (the 10M-row data plane).
+
+Covers the blocked reduction kernels against their resident/host
+references, the ``ShardedMatrixWriter`` block-spill mode's edge cases
+(block size not dividing the host range, zero-row hosts, abort mid
+block), the ``BlockPlane`` driver's residency-parity and stripe-resume
+bit-exactness, the ``TMOG_BLOCK_KERNELS`` kill-switch, the counting
+pre-pass cache on CSV/JSONL readers, and the sweep cursor's
+coordinator-only durable-write fence (TM047) under the async scheduler's
+final durability sync.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.parallel import sharded as S
+from transmogrifai_tpu.parallel.ingest import (BlockSpillMatrix,
+                                               ShardedMatrixWriter)
+
+
+def _toy(n=500, d=9, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d) * (rng.random(d) < 0.6)
+    y = (1 / (1 + np.exp(-(X @ beta))) > rng.random(n)).astype(np.float32)
+    return X, y
+
+
+def _blocks(X, *vecs, bs=97):
+    for s in range(0, len(X), bs):
+        yield (X[s:s + bs],) + tuple(v[s:s + bs] for v in vecs)
+
+
+# ---------------------------------------------------------------------------
+# block grid + kill switch
+# ---------------------------------------------------------------------------
+
+class TestBlockGrid:
+    def test_grid_covers_rows_with_short_tail(self):
+        g = S.block_grid(1003, 4, retain_mb=1)  # 1MB/4 -> 16384-row blocks
+        assert g == [(0, 1003)]                 # budget exceeds rows
+        br = S.block_rows_for(4096, retain_mb=1)
+        assert br == S._BLOCK_ROWS_MIN          # floor kicks in
+        g = [(s, e) for s, e in S.block_grid(br * 3 + 17, 4096,
+                                             retain_mb=1)]
+        assert g[0] == (0, br) and g[-1][1] == br * 3 + 17
+        assert all(e - s == br for s, e in g[:-1])
+        assert g[-1][1] - g[-1][0] == 17        # short tail, never dropped
+
+    def test_grid_deterministic_and_zero_rows(self):
+        assert S.block_grid(0, 8) == []
+        assert S.block_grid(5000, 8, retain_mb=2) == \
+            S.block_grid(5000, 8, retain_mb=2)
+
+    def test_kill_switch_collapses_to_whole_range(self, monkeypatch):
+        monkeypatch.setenv("TMOG_BLOCK_KERNELS", "0")
+        assert not S.block_kernels_enabled()
+        assert S.block_grid(123456, 4096, retain_mb=1) == [(0, 123456)]
+        monkeypatch.setenv("TMOG_BLOCK_KERNELS", "1")
+        assert S.block_kernels_enabled()
+        assert len(S.block_grid(123456, 4096, retain_mb=1)) > 1
+
+
+# ---------------------------------------------------------------------------
+# blocked kernels vs host / resident references
+# ---------------------------------------------------------------------------
+
+class TestBlockedKernels:
+    def test_colstats_fold_matches_host(self):
+        X, _ = _toy()
+        w = np.ones(len(X), np.float32)
+        acc = S.colstats_block_fold(_blocks(X, w), X.shape[1])
+        mean, var = S.colstats_from_acc(acc)
+        np.testing.assert_allclose(mean, X.mean(0), atol=1e-4)
+        np.testing.assert_allclose(var, X.var(0), atol=1e-4)
+
+    def test_colstats_fold_byte_deterministic(self):
+        X, _ = _toy()
+        w = np.ones(len(X), np.float32)
+        a1 = S.colstats_block_fold(_blocks(X, w), X.shape[1])
+        a2 = S.colstats_block_fold(_blocks(X, w), X.shape[1])
+        assert a1.tobytes() == a2.tobytes()
+
+    def test_newton_blocked_matches_resident_psum(self):
+        from transmogrifai_tpu.parallel import make_sweep_mesh
+
+        X, y = _toy()
+        d = X.shape[1]
+        w = np.ones(len(X), np.float32)
+        coef, b0, n_it = S.fit_logreg_newton_blocked(
+            lambda: _blocks(X, y, w), d, reg_param=0.1)
+        assert 0 < n_it <= 50
+        mesh = make_sweep_mesh(1, n_devices=8)
+        coef_r, b0_r = S.fit_logreg_newton_psum(X, y, mesh, w=w,
+                                                reg_param=0.1)
+        np.testing.assert_allclose(coef, np.asarray(coef_r), atol=1e-3)
+        assert abs(b0 - float(b0_r)) < 1e-3
+
+    def test_newton_blocked_gradient_vanishes(self):
+        X, y = _toy(400, 6, seed=11)
+        w = np.ones(len(X), np.float32)
+        coef, b0, _ = S.fit_logreg_newton_blocked(
+            lambda: _blocks(X, y, w), X.shape[1], reg_param=0.05)
+        p = 1 / (1 + np.exp(-(X @ coef + b0)))
+        g = X.T @ (p - y) / len(X) + 0.05 * coef
+        assert float(np.abs(g).max()) < 1e-5
+
+    def test_histogram_fold_matches_host(self):
+        X, y = _toy()
+        d, nb = X.shape[1], 8
+        rng = np.random.default_rng(0)
+        binned = rng.integers(0, nb, size=X.shape).astype(np.int32)
+        g = (y - 0.5).astype(np.float32)
+        h = np.full(len(X), 0.25, np.float32)
+        w = np.ones(len(X), np.float32)
+        acc = S.histogram_block_fold(_blocks(binned, g, h, w), d,
+                                     n_bins=nb)
+        ref = np.zeros((nb, d, 3), np.float32)
+        for b in range(nb):
+            m = binned == b
+            ref[b, :, 0] = (m * g[:, None]).sum(0)
+            ref[b, :, 1] = (m * h[:, None]).sum(0)
+            ref[b, :, 2] = m.sum(0)
+        np.testing.assert_allclose(acc, ref, atol=1e-3)
+
+    def test_logloss_fold_matches_host(self):
+        X, y = _toy()
+        w = np.ones(len(X), np.float32)
+        beta = np.linspace(-0.5, 0.5, X.shape[1] + 1).astype(np.float32)
+        acc = S.logloss_block_fold(_blocks(X, y, w), beta)
+        z = (X @ beta[:-1] + beta[-1]).astype(np.float32)
+        ref = float((np.maximum(z, 0) - z * y
+                     + np.log1p(np.exp(-np.abs(z)))).sum())
+        assert acc[1] == pytest.approx(len(X))
+        assert float(acc[0]) == pytest.approx(ref, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# ShardedMatrixWriter block-spill mode
+# ---------------------------------------------------------------------------
+
+class TestBlockSpill:
+    def test_block_size_not_dividing_range(self, tmp_path):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(403, 7)).astype(np.float32)
+        w = ShardedMatrixWriter(None, 403, 7, block_rows=64,
+                                spill_dir=str(tmp_path))
+        off = 0
+        while off < 403:                   # appends misaligned to blocks
+            n = min(37, 403 - off)
+            w.append(X[off:off + n])
+            off += n
+        handle = w.finish()
+        try:
+            assert handle.n_blocks == 7
+            assert handle.block_bounds[0] == (0, 64)
+            assert handle.block_bounds[-1] == (384, 403)  # short tail
+            assert handle.read_all().tobytes() == X.tobytes()
+            # seek-resume skips bytes, not just blocks
+            rest = np.concatenate(list(handle.iter_blocks(3)))
+            assert rest.tobytes() == X[192:].tobytes()
+        finally:
+            handle.close()
+        assert not os.path.exists(handle.path)
+
+    def test_zero_row_host(self):
+        w = ShardedMatrixWriter(None, 0, 5, block_rows=64)
+        handle = w.finish()
+        assert handle.n_blocks == 0
+        assert handle.read_all().shape == (0, 5)
+        assert list(handle.iter_blocks()) == []
+        handle.close()
+
+    def test_abort_mid_block_releases_buffers(self):
+        """PR 9's leak-regression pattern (tests/test_elastic.py) for the
+        spill path: close() mid-stream frees the block buffer, unlinks
+        the spill file, is idempotent, and finish() then refuses."""
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(403, 7)).astype(np.float32)
+        w = ShardedMatrixWriter(None, 403, 7, block_rows=64)
+        w.append(X[:100])                       # one spilled, one partial
+        spill = w._spill_path
+        assert spill is not None and os.path.exists(spill)
+        w.close()
+        assert w._buf is None
+        assert not os.path.exists(spill)
+        w.close()                               # idempotent
+        with pytest.raises(ValueError, match="closed"):
+            w.finish()
+        with pytest.raises(ValueError):
+            w.append(X[:10])
+
+    def test_closed_handle_refuses_iteration(self):
+        w = ShardedMatrixWriter(None, 10, 3, block_rows=4)
+        w.append(np.zeros((10, 3), np.float32))
+        handle = w.finish()
+        handle.close()
+        with pytest.raises(ValueError, match="closed"):
+            list(handle.iter_blocks())
+
+    def test_truncated_spill_file_raises(self, tmp_path):
+        w = ShardedMatrixWriter(None, 8, 3, block_rows=4,
+                                spill_dir=str(tmp_path))
+        w.append(np.ones((8, 3), np.float32))
+        handle = w.finish()
+        try:
+            with open(handle.path, "r+b") as f:
+                f.truncate(20)
+            with pytest.raises(IOError, match="truncated"):
+                list(handle.iter_blocks())
+        finally:
+            handle.close()
+
+
+# ---------------------------------------------------------------------------
+# BlockPlane: residency parity + stripe resume
+# ---------------------------------------------------------------------------
+
+def _colstats_fold(acc, blk, s, e):
+    import jax.numpy as jnp
+
+    return S._colstats_fold_jit(acc, jnp.asarray(blk, jnp.float32),
+                                jnp.ones(e - s, jnp.float32))
+
+
+class TestBlockPlane:
+    def _spill(self, X, block_rows=64):
+        w = ShardedMatrixWriter(None, len(X), X.shape[1],
+                                block_rows=block_rows)
+        w.append(X)
+        return w.finish()
+
+    def test_spill_vs_resident_byte_parity(self, monkeypatch):
+        from transmogrifai_tpu.distributed.podstream import BlockPlane
+
+        monkeypatch.setenv("TMOG_BLOCK_KERNELS", "1")
+        monkeypatch.setenv("TMOG_STREAM_RETAIN_MB", "1")
+        rng = np.random.default_rng(4)
+        # 64 cols at a 1MB budget pins the grid at the 1024-row floor
+        X = rng.normal(size=(S._BLOCK_ROWS_MIN * 2 + 100, 64)) \
+            .astype(np.float32)
+        init = np.zeros((2, 65), np.float32)
+        handle = self._spill(X,
+                             block_rows=S.block_rows_for(64, retain_mb=1))
+        try:
+            a_spill = BlockPlane(None, handle).run_pass(
+                "colstats", init, _colstats_fold)
+        finally:
+            handle.close()
+        plane_res = BlockPlane(None, X)
+        assert len(plane_res.block_bounds()) == 3
+        a_res = plane_res.run_pass("colstats", init, _colstats_fold)
+        assert a_spill.tobytes() == a_res.tobytes()
+
+    def test_stripe_resume_bit_exact(self, tmp_path, monkeypatch):
+        from transmogrifai_tpu.distributed.podstream import BlockPlane
+        from transmogrifai_tpu.workflow.checkpoint import BlockStripeStore
+
+        monkeypatch.setenv("TMOG_BLOCK_KERNELS", "1")
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(403, 5)).astype(np.float32)
+        init = np.zeros((2, 6), np.float32)
+        handle = self._spill(X)
+        try:
+            ref = BlockPlane(None, handle).run_pass(
+                "colstats", init, _colstats_fold)
+            # a killed run left a mid-pass stripe: acc after 3 blocks
+            import jax.numpy as jnp
+
+            acc = jnp.asarray(init)
+            for i, blk in enumerate(handle.iter_blocks()):
+                if i == 3:
+                    break
+                acc = _colstats_fold(acc, blk, 0, len(blk))
+            st = BlockStripeStore(str(tmp_path), 0)
+            st.save("blockplane.colstats", 3, {"acc": np.asarray(acc)})
+            plane = BlockPlane(None, handle,
+                               stripes=BlockStripeStore(str(tmp_path), 0),
+                               stripe_every=2)
+            out = plane.run_pass("colstats", init, _colstats_fold)
+            assert plane.resumed
+            assert out.tobytes() == ref.tobytes()
+            # pass completed -> final stripe; a rerun skips every block
+            plane2 = BlockPlane(None, handle,
+                                stripes=BlockStripeStore(str(tmp_path), 0),
+                                stripe_every=2)
+            out2 = plane2.run_pass("colstats", init, _colstats_fold)
+            assert plane2.resumed
+            assert out2.tobytes() == ref.tobytes()
+        finally:
+            handle.close()
+
+    def test_label_mismatch_starts_fresh(self, tmp_path):
+        from transmogrifai_tpu.workflow.checkpoint import BlockStripeStore
+
+        st = BlockStripeStore(str(tmp_path), 1)
+        st.save("blockplane.colstats", 2,
+                {"acc": np.ones((2, 3), np.float32)}, meta={"k": 1})
+        rec = BlockStripeStore(str(tmp_path), 1).load("blockplane.colstats")
+        assert rec["blocksDone"] == 2 and rec["meta"] == {"k": 1}
+        np.testing.assert_array_equal(rec["accs"]["acc"],
+                                      np.ones((2, 3), np.float32))
+        assert BlockStripeStore(str(tmp_path), 1).load("other.pass") is None
+        assert BlockStripeStore(str(tmp_path), 0).load(
+            "blockplane.colstats") is None   # per-process stripes
+        st.clear()
+        assert BlockStripeStore(str(tmp_path), 1).load(
+            "blockplane.colstats") is None
+
+    def test_zero_row_plane(self):
+        from transmogrifai_tpu.distributed.podstream import BlockPlane
+
+        w = ShardedMatrixWriter(None, 0, 5, block_rows=64)
+        handle = w.finish()
+        out = BlockPlane(None, handle).run_pass(
+            "colstats", np.zeros((2, 6), np.float32), _colstats_fold)
+        assert not out.any()
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# counting pre-pass cache (CSV/JSONL readers)
+# ---------------------------------------------------------------------------
+
+class TestRowCountCache:
+    def _csv(self, tmp_path, n=50, name="t.csv"):
+        path = tmp_path / name
+        lines = ["a,b"] + [f"{i},{i * 2}" for i in range(n)]
+        path.write_text("\n".join(lines) + "\n")
+        return str(path)
+
+    def _features(self):
+        from transmogrifai_tpu import FeatureBuilder
+
+        return [FeatureBuilder.Real("a").as_predictor(),
+                FeatureBuilder.Real("b").as_predictor()]
+
+    def test_count_rows_memoizes_on_reader(self, tmp_path):
+        from transmogrifai_tpu.distributed.hostshard import count_rows
+        from transmogrifai_tpu.readers import CSVReader
+
+        reader = CSVReader(self._csv(tmp_path))
+        feats = self._features()
+        calls = {"n": 0}
+        inner = reader.iter_chunks
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return inner(*a, **k)
+
+        reader.iter_chunks = counting
+        assert count_rows(reader, feats, chunk_rows=16) == 50
+        assert count_rows(reader, feats, chunk_rows=16) == 50
+        assert calls["n"] == 1              # second call served from cache
+        assert reader.cached_row_count() == 50
+
+    def test_cache_invalidates_on_rewrite(self, tmp_path):
+        from transmogrifai_tpu.readers import CSVReader
+
+        path = self._csv(tmp_path, n=10)
+        reader = CSVReader(path)
+        reader.cache_row_count(10)
+        assert reader.cached_row_count() == 10
+        st = os.stat(path)
+        with open(path, "a") as f:
+            f.write("99,198\n")
+        os.utime(path, ns=(st.st_mtime_ns + 10 ** 9,
+                           st.st_mtime_ns + 10 ** 9))
+        assert reader.cached_row_count() is None
+        assert reader.cached_row_count() is None  # missing file safe too
+
+    def test_cache_is_per_instance(self, tmp_path):
+        from transmogrifai_tpu.readers import CSVReader, JSONLinesReader
+
+        path = self._csv(tmp_path)
+        r1, r2 = CSVReader(path), CSVReader(path)
+        r1.cache_row_count(50)
+        assert r1.cached_row_count() == 50
+        assert r2.cached_row_count() is None
+        jpath = tmp_path / "t.jsonl"
+        jpath.write_text('{"a": 1}\n{"a": 2}\n')
+        jr = JSONLinesReader(str(jpath))
+        jr.cache_row_count(2)
+        assert jr.cached_row_count() == 2
+
+    def test_plan_host_shard_reuses_cached_count(self, tmp_path):
+        import warnings
+
+        from transmogrifai_tpu.distributed.hostshard import plan_host_shard
+        from transmogrifai_tpu.readers import CSVReader
+
+        reader = CSVReader(self._csv(tmp_path))
+        feats = self._features()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            p1 = plan_host_shard(reader, feats, chunk_rows=16,
+                                 process_count=2)
+        calls = {"n": 0}
+        inner = reader.iter_chunks
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return inner(*a, **k)
+
+        reader.iter_chunks = counting
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            p2 = plan_host_shard(reader, feats, chunk_rows=16,
+                                 process_count=2)
+        assert calls["n"] == 0
+        assert p2.total_rows == p1.total_rows == 50
+
+
+# ---------------------------------------------------------------------------
+# TM047: sweep cursor fence (coordinator-only write + final sync barrier)
+# ---------------------------------------------------------------------------
+
+class _FakePod:
+    """An ACTIVE 2-process pod whose collectives only count calls — the
+    non-coordinator fence is purely host-side logic, no runtime needed."""
+
+    def __init__(self, process_index):
+        self.process_index = process_index
+        self.process_count = 2
+        self.active = True
+        self.barriers = []
+
+    def is_coordinator(self):
+        return self.process_index == 0
+
+    def barrier(self, name):
+        self.barriers.append(name)
+
+
+class TestSweepCursorFence:
+    def _manager(self, tmp_path):
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SweepCheckpointManager)
+
+        return SweepCheckpointManager(
+            str(tmp_path), {"logical": {"sweep": "t"}}, every_units=1)
+
+    def test_non_coordinator_never_writes_cursor(self, tmp_path):
+        from transmogrifai_tpu.distributed.runtime import (PodContext,
+                                                           _set_pod)
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SWEEP_CHECKPOINT_JSON)
+
+        pod = _FakePod(process_index=1)
+        _set_pod(pod)
+        try:
+            m = self._manager(tmp_path)
+            for i in range(4):
+                m.record_unit(i, [0.5, 0.6], None)
+            m.flush()
+            assert not os.path.exists(
+                os.path.join(str(tmp_path), SWEEP_CHECKPOINT_JSON))
+            assert m._dirty == 0            # fence resets, never defers
+            assert m.saves == 0
+        finally:
+            _set_pod(PodContext())
+
+    def test_coordinator_writes_and_finish_is_fenced(self, tmp_path):
+        from transmogrifai_tpu.distributed.runtime import (PodContext,
+                                                           _set_pod)
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SWEEP_CHECKPOINT_JSON)
+
+        pod = _FakePod(process_index=0)
+        _set_pod(pod)
+        try:
+            m = self._manager(tmp_path)
+            m.record_unit(0, [0.5], None)
+            path = os.path.join(str(tmp_path), SWEEP_CHECKPOINT_JSON)
+            assert os.path.exists(path)
+            m.sync_durability()
+            assert pod.barriers[-1] == "sweep.final"
+            m.finish()
+            assert not os.path.exists(path)
+            assert pod.barriers[-1] == "sweep.finish"
+        finally:
+            _set_pod(PodContext())
+
+    def test_non_coordinator_finish_joins_barrier_without_unlink(
+            self, tmp_path):
+        from transmogrifai_tpu.distributed.runtime import (PodContext,
+                                                           _set_pod)
+        from transmogrifai_tpu.workflow.checkpoint import (
+            SWEEP_CHECKPOINT_JSON)
+
+        path = os.path.join(str(tmp_path), SWEEP_CHECKPOINT_JSON)
+        with open(path, "w") as f:
+            json.dump({"version": 0}, f)   # someone else's durable cursor
+        pod = _FakePod(process_index=1)
+        _set_pod(pod)
+        try:
+            m = self._manager(tmp_path)
+            m.sync_durability()
+            m.finish()
+            assert os.path.exists(path)     # unlink is the coordinator's
+            assert pod.barriers == ["sweep.final", "sweep.finish"]
+        finally:
+            _set_pod(PodContext())
+
+    def test_async_scheduler_calls_durability_sync(self):
+        """The async sweep path must fence its FINAL flush — regression
+        for the second half of TM047 under overlapped checkpointing."""
+        import inspect
+
+        from transmogrifai_tpu.selector import validators
+
+        src = inspect.getsource(validators.SweepWorkQueue._run_all_async)
+        assert "sync_durability" in src
+        idx_flush = src.rindex("flush_pending(overlapped=False)")
+        assert src.index("sync_durability", idx_flush) > idx_flush
+
+    def test_scoped_view_passes_durability_sync_through(self, tmp_path):
+        from transmogrifai_tpu.distributed.runtime import (PodContext,
+                                                           _set_pod)
+
+        pod = _FakePod(process_index=0)
+        _set_pod(pod)
+        try:
+            m = self._manager(tmp_path)
+            m.scoped("rung0").sync_durability()
+            assert pod.barriers == ["sweep.final"]
+        finally:
+            _set_pod(PodContext())

@@ -373,6 +373,57 @@ class TestShardedWriterClose:
         cw.close()
         assert sw._closed and sw._buf is None and sw._committed == {}
 
+    def test_block_spill_close_releases_buffers_and_disk(self, tmp_path):
+        """Block-spill mode extends the abort contract: close() mid-block
+        must also unlink the partial spill file (RSS AND disk bounded)."""
+        import os
+
+        from transmogrifai_tpu.parallel.ingest import ShardedMatrixWriter
+
+        w = ShardedMatrixWriter(None, 403, 7, block_rows=64,
+                                spill_dir=str(tmp_path))
+        rng = np.random.default_rng(0)
+        w.append(rng.normal(size=(250, 7)).astype(np.float32))
+        spill = w._spill_path
+        assert spill is not None and os.path.exists(spill)
+        w.close()
+        assert w._buf is None and not os.path.exists(spill)
+        w.close()                      # idempotent
+        with pytest.raises(ValueError, match="closed"):
+            w.finish()
+
+    def test_block_spill_handle_owns_file_after_finish(self, tmp_path):
+        """After finish() the handle owns the spill file: the writer's
+        finally-close must NOT unlink it under the reader's feet."""
+        import os
+
+        from transmogrifai_tpu.parallel.ingest import ShardedMatrixWriter
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(130, 4)).astype(np.float32)
+        w = ShardedMatrixWriter(None, 130, 4, block_rows=64,
+                                spill_dir=str(tmp_path))
+        w.append(X)
+        handle = w.finish()
+        try:
+            w.close()                  # the stream_to_mesh finally path
+            assert os.path.exists(handle.path)
+            assert handle.block_bounds == [(0, 64), (64, 128), (128, 130)]
+            assert handle.read_all().tobytes() == X.tobytes()
+        finally:
+            handle.close()
+        assert not os.path.exists(handle.path)
+
+    def test_block_spill_zero_row_host(self):
+        from transmogrifai_tpu.parallel.ingest import ShardedMatrixWriter
+
+        w = ShardedMatrixWriter(None, 0, 5, block_rows=64)
+        handle = w.finish()
+        assert handle.n_blocks == 0
+        assert handle.read_all().shape == (0, 5)
+        assert list(handle.iter_blocks()) == []
+        handle.close()
+
 
 class TestElasticSmokeHalvingResume:
     """The in-process half of the ELASTIC_SMOKE matrix: a halving sweep

@@ -87,7 +87,18 @@ def count_rows(reader: Reader, raw_features, chunk_rows: int = 4096) -> int:
     Runs with the reader's resilience config live (retry + quarantine),
     so the count matches exactly what later passes will yield — a
     quarantined row is already absent here.
+
+    The result is memoized on the reader when it carries a count cache
+    (CSV/JSONL: ``cached_row_count``/``cache_row_count``, keyed by
+    (path, mtime, size) so a rewritten file re-counts): a pod that
+    trains, checkpoints, and resumes over the same file pays the full
+    pre-pass once, not once per plan.
     """
+    cached_get = getattr(reader, "cached_row_count", None)
+    if cached_get is not None:
+        hit = cached_get()
+        if hit is not None:
+            return hit
     rcfg = getattr(reader, "resilience", None)
     if rcfg is not None and rcfg.retry is not None:
         from ..readers.resilience import RetryingChunkStream
@@ -97,7 +108,11 @@ def count_rows(reader: Reader, raw_features, chunk_rows: int = 4096) -> int:
             rcfg.retry)
     else:
         stream = reader.iter_chunks(raw_features, chunk_rows)
-    return sum(len(chunk) for chunk in stream)
+    rows = sum(len(chunk) for chunk in stream)
+    cached_put = getattr(reader, "cache_row_count", None)
+    if cached_put is not None:
+        cached_put(rows)
+    return rows
 
 
 class ShardPlan:

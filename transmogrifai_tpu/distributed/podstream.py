@@ -44,7 +44,7 @@ from .hostshard import (HostShardedReader, ShardPlan, plan_host_shard,
                         range_chunks)
 from .runtime import PodContext
 
-__all__ = ["PodEntry", "PodStreamContext"]
+__all__ = ["PodEntry", "PodStreamContext", "BlockPlane"]
 
 
 def _rss_now_mb() -> float:
@@ -418,6 +418,206 @@ class PodStreamContext:
                 else round(max(self._rss_after_ingest_mb - self._rss0_mb,
                                0.0), 2)),
         }
+
+
+class BlockPlane:
+    """Block-streaming reduction passes over one host's shard — the
+    10M-row pod data plane (ROADMAP item 3).
+
+    ``source`` is either a :class:`~transmogrifai_tpu.parallel.ingest.
+    BlockSpillMatrix` (blocks re-read from the spill file one at a
+    time — peak host residency is ONE block) or a resident ``(rows,
+    cols)`` array (sliced on the same deterministic ``block_grid``).
+    ``run_pass`` folds every block through a device-resident accumulator
+    with a jitted kernel: the fold ENQUEUES and returns (PR 17 async
+    dispatch), so the host reads/prepares the next block while the
+    device folds the current one, and the single blocking fetch at pass
+    end books as drain — ``drainFracOfWall`` stays an honest overlap
+    measure.  ONE cross-host exchange per pass (``combine``: allgather +
+    process-order sum) turns host partials into the identical global
+    reduction on every process.
+
+    Determinism contract, which the scale bench's parity and resume
+    gates check bit-for-bit: fold order is the block-grid order, the
+    cross-host combine is a fixed process-order f32 sum, and a stripe
+    resume restores the exact device accumulator bytes — so blocked vs
+    resident RESIDENCY, any kill/resume split, and every process member
+    produce byte-identical results.
+
+    Stripe checkpoints (``stripes`` = a :class:`~transmogrifai_tpu.
+    workflow.checkpoint.BlockStripeStore`) are PROCESS-PRIVATE: each
+    host persists only its own block cursor + partial accumulator, so
+    resume wall scales with the per-host shard, never the global row
+    count.  TM047's coordinator-only rule does not apply — a stripe is
+    this host's private scratch, like a per-process flight dump; the
+    COORDINATED artifacts (sweep cursor, manifests) still ride the
+    barrier-fenced managers.
+    """
+
+    def __init__(self, pod: Optional[PodContext], source, *,
+                 stripes=None, stripe_every: int = 0,
+                 label: str = "blockplane"):
+        self.pod = pod
+        self._source = source
+        rows, cols = source.shape
+        self.rows, self.cols = int(rows), int(cols)
+        self.stripes = stripes
+        self.stripe_every = int(stripe_every)
+        self.label = label
+        #: True once any pass restored a stripe cursor (the resume gate)
+        self.resumed = False
+        self.pass_walls: Dict[str, float] = {}
+
+    # -- block geometry ------------------------------------------------------
+
+    def block_bounds(self) -> List[Tuple[int, int]]:
+        """The deterministic [start, stop) grid this plane folds in —
+        the spill file's own bounds, or ``block_grid`` over the resident
+        shard (identical by construction when the writer was sized with
+        the same retain budget)."""
+        bounds = getattr(self._source, "block_bounds", None)
+        if bounds is not None:
+            return list(bounds)
+        from ..parallel.sharded import block_grid
+
+        X = np.asarray(self._source)
+        itemsize = X.dtype.itemsize if X.size else 4
+        return block_grid(self.rows, self.cols, dtype_bytes=itemsize)
+
+    def blocks(self, start_block: int = 0):
+        """Yield ``(start, stop, block)`` in grid order, skipping the
+        first ``start_block`` blocks without materializing them."""
+        bounds = self.block_bounds()
+        it = getattr(self._source, "iter_blocks", None)
+        if it is not None:
+            for (start, stop), blk in zip(bounds[start_block:],
+                                          it(start_block)):
+                yield start, stop, blk
+        else:
+            X = np.asarray(self._source)
+            for start, stop in bounds[start_block:]:
+                yield start, stop, X[start:stop]
+
+    # -- cross-host combine --------------------------------------------------
+
+    def combine(self, arr: np.ndarray) -> np.ndarray:
+        """ONE exchange: allgather the host partials and sum them in
+        PROCESS ORDER — the fixed-order f32 fold every process (and any
+        resume) reproduces bit-exactly.  Identity when no pod is live."""
+        part = np.asarray(arr)
+        if self.pod is None or not self.pod.active:
+            return part
+        parts = self.pod.allgather_obj(part)
+        acc = parts[0]
+        for p in parts[1:]:
+            acc = acc + p
+        return acc
+
+    # -- the pass driver -----------------------------------------------------
+
+    def run_pass(self, name: str, init_acc: np.ndarray, fold, *,
+                 combine: bool = True) -> np.ndarray:
+        """Fold every local block through ``fold(acc, block, start,
+        stop) -> acc`` (a jitted kernel — it must only ENQUEUE), fetch
+        the host partial once, and return the cross-host combined
+        reduction (or the bare partial with ``combine=False``).
+
+        With stripes enabled, every ``stripe_every`` blocks the current
+        accumulator is fetched (overlapped — the fetch drains compute
+        that had to finish anyway) and persisted with its block cursor;
+        a rerun restores the accumulator bytes and resumes at the
+        cursor.  A final stripe marks the pass complete so reruns of
+        finished passes skip straight to the saved result.
+        """
+        import jax.numpy as jnp
+
+        from ..utils.profiling import fetch_timed
+
+        label = f"{self.label}.{name}"
+        t0 = time.perf_counter()
+        bounds = self.block_bounds()
+        n_total = len(bounds)
+        skip = 0
+        acc = None
+        if self.stripes is not None:
+            rec = self.stripes.load(label)
+            if rec is not None and "acc" in rec.get("accs", {}):
+                skip = min(int(rec["blocksDone"]), n_total)
+                acc = jnp.asarray(rec["accs"]["acc"])
+                if skip > 0:
+                    self.resumed = True
+        if acc is None:
+            acc = jnp.asarray(np.asarray(init_acc))
+        done = skip
+        prev = None
+        for start, stop, blk in self.blocks(skip):
+            acc = fold(acc, blk, start, stop)
+            # lag-one backpressure (the PR 17 double-buffer idiom): wait
+            # for the PREVIOUS fold before enqueuing past it, so at most
+            # two blocks are ever in flight — without this the host
+            # races ahead and every enqueued block's device buffer stays
+            # alive, unbounding the very residency this plane bounds.
+            # The current fold still overlaps the next block's read.
+            if prev is not None:
+                wait = getattr(prev, "block_until_ready", None)
+                if wait is not None:
+                    wait()
+            prev = acc
+            done += 1
+            if (self.stripes is not None and self.stripe_every > 0
+                    and done < n_total and done % self.stripe_every == 0):
+                host = np.asarray(fetch_timed(
+                    acc, tag="blockplane.checkpoint", overlapped=True))
+                self.stripes.save(label, done, {"acc": host})
+        part = np.asarray(fetch_timed(acc, tag="blockplane.pass"))
+        if self.stripes is not None and done >= n_total:
+            self.stripes.save(label, n_total, {"acc": part})
+        wall = time.perf_counter() - t0
+        self.pass_walls[name] = round(wall, 4)
+        self._record_observation(name, wall)
+        return self.combine(part) if combine else part
+
+    def newton_blocks(self, y: np.ndarray, w: Optional[np.ndarray] = None):
+        """Adapter for ``parallel.sharded.fit_logreg_newton_blocked``:
+        a ``blocks_fn`` yielding ``(X_b, y_b, w_b)`` with the label /
+        weight vectors sliced on this plane's LOCAL row space (the
+        caller passes vectors of ``self.rows`` entries)."""
+        def blocks_fn():
+            for start, stop, blk in self.blocks():
+                yb = np.asarray(y[start:stop], dtype=np.float32)
+                wb = (np.ones(stop - start, np.float32) if w is None
+                      else np.asarray(w[start:stop], dtype=np.float32))
+                yield blk, yb, wb
+        return blocks_fn
+
+    def _record_observation(self, name: str, wall_s: float) -> None:
+        """Best-effort block-level StageObservation into the shared cost
+        history — telemetry must never break a pass."""
+        if wall_s <= 0:
+            return
+        try:
+            from ..tuning.costmodel import (StageObservation,
+                                            append_observations,
+                                            default_history_path)
+            from ..utils.profiling import backend_name
+
+            append_observations(default_history_path(), [StageObservation(
+                stage_kind=f"BlockPlane:{name}", rows=int(self.rows),
+                cols=max(int(self.cols), 1), dtype="float32",
+                backend=backend_name(), wall_s=float(wall_s),
+                t=int(time.time()),
+                n_devices=max(
+                    1, self.pod.process_count
+                    if self.pod is not None and self.pod.active else 1))])
+        except Exception:
+            pass
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rows": self.rows, "cols": self.cols,
+                "blocks": len(self.block_bounds()),
+                "stripeEvery": self.stripe_every,
+                "resumed": self.resumed,
+                "passWalls": dict(self.pass_walls)}
 
 
 class _PodPassSaver:

@@ -18,11 +18,86 @@ pad rows are inert in every weighted reduction).
 """
 from __future__ import annotations
 
-from typing import Iterable, Optional, Tuple
+import os
+import tempfile
+from typing import Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["ShardedMatrixWriter", "ShardedMatrix", "stream_to_mesh"]
+__all__ = ["ShardedMatrixWriter", "ShardedMatrix", "BlockSpillMatrix",
+           "stream_to_mesh"]
+
+
+class BlockSpillMatrix:
+    """Per-block views over a disk-spilled row matrix — what the block
+    plane's streaming driver folds instead of one resident shard.
+
+    The writer's block-spill mode appends fixed-size row blocks to ONE
+    sequential spill file and hands back this handle; ``iter_blocks``
+    re-reads the blocks one at a time (peak host residency: one block),
+    in the same order every pass — the bit-exact fold-order property the
+    blocked kernels' parity/resume gates lean on.  ``close`` (idempotent)
+    unlinks the spill file; abandoning the handle leaks a temp file until
+    process exit, so callers pair it with try/finally like the writer.
+    """
+
+    def __init__(self, path: Optional[str], rows: int, cols: int,
+                 block_bounds: List[Tuple[int, int]], dtype):
+        self.path = path
+        self.rows = int(rows)
+        self.cols = int(cols)
+        #: [start, stop) row bounds of each spilled block, in file order
+        self.block_bounds = list(block_bounds)
+        self.dtype = np.dtype(dtype)
+        self._closed = False
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.rows, self.cols)
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.block_bounds)
+
+    def iter_blocks(self, start_block: int = 0) -> Iterator[np.ndarray]:
+        """Yield each (block_rows_i, cols) block, re-read sequentially
+        from the spill file — never more than one block resident.
+        ``start_block`` seeks straight to that block (stripe resume skips
+        already-folded blocks without re-reading their bytes)."""
+        if self._closed:
+            raise ValueError("iter_blocks() on a closed BlockSpillMatrix")
+        if not self.block_bounds or start_block >= len(self.block_bounds):
+            return
+        row_bytes = self.cols * self.dtype.itemsize
+        first = self.block_bounds[start_block]
+        with open(self.path, "rb") as fh:
+            if first[0] > 0:
+                fh.seek(first[0] * row_bytes)
+            for start, stop in self.block_bounds[start_block:]:
+                n = stop - start
+                buf = fh.read(n * row_bytes)
+                if len(buf) != n * row_bytes:
+                    raise IOError(
+                        f"block spill file truncated at rows "
+                        f"[{start}, {stop}) of {self.path}")
+                yield np.frombuffer(buf, self.dtype).reshape(n, self.cols)
+
+    def read_all(self) -> np.ndarray:
+        """Materialize the WHOLE local matrix — the resident fallback
+        (kill-switch / debugging), deliberately not the streaming path."""
+        if not self.block_bounds:
+            return np.zeros((0, self.cols), self.dtype)
+        return np.concatenate(list(self.iter_blocks()), axis=0)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.path is not None:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
 
 
 class ShardedMatrix:
@@ -83,7 +158,38 @@ class ShardedMatrixWriter:
     """
 
     def __init__(self, mesh, total_rows: int, cols: Optional[int],
-                 dtype=np.float32):
+                 dtype=np.float32, block_rows: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        # -- block-spill mode (the 10M-row pod data plane) ------------------
+        # ``block_rows`` set => rows accumulate into fixed-size blocks
+        # appended to ONE sequential spill file; ``finish`` returns a
+        # BlockSpillMatrix of per-block views instead of a device array.
+        # Host-local by construction (the pod's host sharding already
+        # scoped ``total_rows`` to this host's range), so no mesh is
+        # needed; a host owning ZERO rows is legal (empty handle).
+        self.block_rows = None if block_rows is None else int(block_rows)
+        if self.block_rows is not None:
+            if self.block_rows < 1:
+                raise ValueError(
+                    f"block_rows must be >= 1, got {block_rows}")
+            if cols is None:
+                raise ValueError("block-spill mode needs a column count")
+            self.mesh = mesh
+            self.rows = int(total_rows)
+            self.cols = int(cols)
+            self.dtype = np.dtype(dtype)
+            self.span = (0, self.rows)
+            self.local_rows = self.rows
+            self._spill_dir = spill_dir
+            self._spill_path: Optional[str] = None
+            self._spill_fh = None
+            self._blk_bounds: List[Tuple[int, int]] = []
+            self._buf = np.zeros((self.block_rows, self.cols), self.dtype)
+            self._fill = 0
+            self._done_rows = 0
+            self._committed = {}
+            self._closed = False
+            return
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -141,8 +247,26 @@ class ShardedMatrixWriter:
     @property
     def offset(self) -> int:
         """GLOBAL row position of the next appended row."""
+        if self.block_rows is not None:
+            return self._done_rows + self._fill
         return (self.span[0] + self._shard_i * self.shard_rows
                 + self._fill)
+
+    def _spill_block(self) -> None:
+        """Append the filled rows of the block buffer to the spill file
+        and reuse the buffer — peak host residency stays one block."""
+        if self._fill == 0:
+            return
+        if self._spill_fh is None:
+            fd, self._spill_path = tempfile.mkstemp(
+                prefix="tmog_blockspill_", suffix=".bin",
+                dir=self._spill_dir)
+            self._spill_fh = os.fdopen(fd, "wb")
+        self._spill_fh.write(self._buf[:self._fill].tobytes())
+        self._blk_bounds.append((self._done_rows,
+                                 self._done_rows + self._fill))
+        self._done_rows += self._fill
+        self._fill = 0
 
     def _flush_shard(self) -> None:
         start = self._starts[self._shard_i]
@@ -161,20 +285,27 @@ class ShardedMatrixWriter:
         span)."""
         arr = np.asarray(chunk, self.dtype)
         k = arr.shape[0]
+        if self._closed:
+            raise ValueError("append() on a closed ShardedMatrixWriter")
         if self.offset + k > min(self.rows, self.span[1]):
             raise ValueError(
                 f"append past this process's rows "
                 f"(span {self.span}, total_rows={self.rows}; offset "
                 f"{self.offset} + chunk {k})")
+        cap = (self.block_rows if self.block_rows is not None
+               else self.shard_rows)
         pos = 0
         while pos < k:
-            room = self.shard_rows - self._fill
+            room = cap - self._fill
             take = min(room, k - pos)
             self._buf[self._fill:self._fill + take] = arr[pos:pos + take]
             self._fill += take
             pos += take
-            if self._fill == self.shard_rows:
-                self._flush_shard()
+            if self._fill == cap:
+                if self.block_rows is not None:
+                    self._spill_block()
+                else:
+                    self._flush_shard()
 
     def close(self) -> None:
         """Release the per-shard DEVICE buffers and the reusable host
@@ -183,10 +314,26 @@ class ShardedMatrixWriter:
         (plus one host slice) for as long as the writer object lives;
         callers wrap the append loop in ``try/finally: close()``
         (mirrors the ``_BlockStore`` spill cleanup from the streaming
-        driver).  Idempotent; a no-op after ``finish()``."""
+        driver).  In block-spill mode this also closes AND unlinks the
+        partial spill file — an abort mid-block must not strand disk.
+        Idempotent; a no-op after ``finish()``."""
         self._committed = {}
         self._buf = None
         self._closed = True
+        if self.block_rows is not None:
+            if self._spill_fh is not None:
+                try:
+                    self._spill_fh.close()
+                except OSError:  # pragma: no cover
+                    pass
+                self._spill_fh = None
+            if self._spill_path is not None:
+                try:
+                    os.unlink(self._spill_path)
+                except OSError:
+                    pass
+                self._spill_path = None
+            self._blk_bounds = []
 
     def finish(self):
         """The global row-sharded array (pad rows zero-filled).
@@ -198,6 +345,26 @@ class ShardedMatrixWriter:
         shards, the documented cross-host contract)."""
         if self._closed:
             raise ValueError("finish() on a closed ShardedMatrixWriter")
+        if self.block_rows is not None:
+            if self.offset != self.rows:
+                raise ValueError(
+                    f"finish() at offset {self.offset}, expected "
+                    f"{self.rows} rows (block-spill mode)")
+            self._spill_block()           # short tail block, if any
+            if self._spill_fh is not None:
+                self._spill_fh.flush()
+                os.fsync(self._spill_fh.fileno())
+                self._spill_fh.close()
+                self._spill_fh = None
+            out = BlockSpillMatrix(self._spill_path, self.rows, self.cols,
+                                   self._blk_bounds, self.dtype)
+            # the handle owns the spill file now: a later close() on the
+            # writer (the stream_to_mesh finally) must not unlink it
+            self._spill_path = None
+            self._blk_bounds = []
+            self._buf = None
+            self._closed = True
+            return out
         expected = self.span[0] + self.local_rows
         if self.offset != expected:
             raise ValueError(

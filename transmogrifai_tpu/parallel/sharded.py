@@ -16,7 +16,8 @@ all under one jit, with explicit sharding constraints on the carried state.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+import os
+from typing import Callable, Iterable, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +33,12 @@ __all__ = ["TrainStepState", "full_train_step", "make_train_step",
            "fit_logreg_sharded", "grow_forest_sharded",
            "colstats_corr_sharded", "colstats_psum",
            "fit_logreg_newton_psum", "histogram_psum",
-           "gbt_chain_rounds_sharded", "grow_rf_grid_sharded"]
+           "gbt_chain_rounds_sharded", "grow_rf_grid_sharded",
+           "block_kernels_enabled", "block_rows_for", "block_grid",
+           "colstats_block_fold", "colstats_from_acc",
+           "newton_block_pass", "newton_solve_host",
+           "fit_logreg_newton_blocked", "histogram_block_fold",
+           "logloss_block_fold"]
 
 
 class TrainStepState(NamedTuple):
@@ -621,6 +627,253 @@ def histogram_psum(binned, g, h, w, mesh: Mesh, n_bins: int = 32):
         jax.device_put(bp, xs), jax.device_put(gp, ds),
         jax.device_put(hp, ds), jax.device_put(wp, ds))
     return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# Block-decomposed reductions (ROADMAP item 3 / the 10M-row pod data plane):
+# the same inner sums as colstats_psum / fit_logreg_newton_psum /
+# histogram_psum, decomposed into fixed-size row blocks folded through a
+# DEVICE-RESIDENT accumulator — per-host memory scales with the block
+# budget (TMOG_STREAM_RETAIN_MB), not the shard.  Each fold call is one
+# async jit launch (acc' = acc + partial(block)), so JAX's async dispatch
+# overlaps the next block's host prep/upload with the in-flight fold, the
+# grid-group pattern from PR 17.  Cross-host combination happens ONCE per
+# pass at the accumulator level (distributed/podstream.py gathers the
+# per-host partials and sums them in host order — the allgather analogue
+# of the resident kernels' lax.psum), so a pass over any number of hosts
+# costs one exchange.
+#
+# Accumulation order is FIXED by the block grid (a pure function of
+# (rows, cols, budget)), so two runs over the same rows fold bit-
+# identically regardless of where the blocks live — the property the
+# bench_scale10m parity and resume gates assert.  TMOG_BLOCK_KERNELS=0
+# (read at call time, like TMOG_SYNC_SWEEP) collapses the grid to ONE
+# whole-shard block: a single resident-style reduction, byte-identical to
+# the pre-block path.
+# ---------------------------------------------------------------------------
+
+_BLOCK_KERNELS_ENV = "TMOG_BLOCK_KERNELS"
+_BLOCK_ROWS_MIN = 1024
+
+
+def block_kernels_enabled() -> bool:
+    """Kill-switch, read at call time so tests/benches flip it per run:
+    ``TMOG_BLOCK_KERNELS=0`` restores the resident (single whole-shard
+    block) path byte-identically."""
+    return os.environ.get(_BLOCK_KERNELS_ENV, "") != "0"
+
+
+def block_rows_for(cols: int, dtype_bytes: int = 4,
+                   retain_mb: Optional[int] = None) -> int:
+    """Rows per block from the streaming retain budget.
+
+    One quarter of the ``TMOG_STREAM_RETAIN_MB`` budget (default: the
+    streaming driver's 256MB) — the block itself, its transient device
+    copy, the accumulators, and chunk-parse headroom share the envelope,
+    the same 1/4 rule as ``tuning.planner.advise_plan``'s retain_mb.
+    Deterministic in (cols, dtype_bytes, env) only, so every host, every
+    pass, and every resume derives the identical block grid without an
+    exchange."""
+    if retain_mb is None:
+        from ..workflow.streaming import (_RETAIN_MB_DEFAULT,
+                                          _RETAIN_MB_ENV)
+
+        try:
+            retain_mb = int(os.environ.get(_RETAIN_MB_ENV, "") or
+                            _RETAIN_MB_DEFAULT)
+        except ValueError:
+            retain_mb = _RETAIN_MB_DEFAULT
+    row_bytes = max(int(cols), 1) * int(dtype_bytes)
+    target = (max(int(retain_mb), 1) << 20) // 4
+    return max(target // row_bytes, _BLOCK_ROWS_MIN)
+
+
+def block_grid(rows: int, cols: int, dtype_bytes: int = 4,
+               retain_mb: Optional[int] = None) -> List[Tuple[int, int]]:
+    """The [start, stop) row blocks one host folds, in fold order.
+
+    With the kill-switch off the grid is one whole-range block (the
+    resident path); otherwise fixed-size blocks with a short tail."""
+    rows = int(rows)
+    if rows <= 0:
+        return []
+    if not block_kernels_enabled():
+        return [(0, rows)]
+    br = block_rows_for(cols, dtype_bytes, retain_mb)
+    return [(s, min(s + br, rows)) for s in range(0, rows, br)]
+
+
+@jax.jit
+def _colstats_fold_jit(acc, X_b, w_b):
+    part = jnp.stack([jnp.concatenate([w_b.sum()[None], w_b @ X_b]),
+                      jnp.concatenate([jnp.zeros((1,), X_b.dtype),
+                                       w_b @ (X_b * X_b)])])
+    return acc + part
+
+
+def colstats_block_fold(blocks: Iterable[Tuple[np.ndarray, np.ndarray]],
+                        cols: int) -> np.ndarray:
+    """Fold (X_block, w_block) pairs into the (2, cols+1) colstats
+    accumulator ``[[sum w, w@X], [0, w@X^2]]`` — THIS host's partial.
+    Blocks stay on device only one at a time; the accumulator is device
+    resident across the whole pass.  Returns the host partial (the
+    caller cross-host combines, then ``colstats_from_acc``)."""
+    acc = jnp.zeros((2, int(cols) + 1), jnp.float32)
+    for X_b, w_b in blocks:
+        acc = _colstats_fold_jit(acc, jnp.asarray(X_b, jnp.float32),
+                                 jnp.asarray(w_b, jnp.float32))
+    return np.asarray(acc)
+
+
+def colstats_from_acc(acc: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(mean, var) from a COMBINED colstats accumulator — the replicated
+    epilogue of ``colstats_psum``, identical formulas."""
+    wsum = max(float(acc[0, 0]), 1.0)
+    mean = acc[0, 1:] / wsum
+    var = acc[1, 1:] / wsum - mean ** 2
+    return mean, var
+
+
+@jax.jit
+def _newton_fold_jit(acc_g, acc_H, X_b, y_b, w_b, beta, inv_wsum):
+    m = X_b.shape[0]
+    Xa = jnp.concatenate([X_b, jnp.ones((m, 1), X_b.dtype)], axis=1)
+    z = Xa @ beta
+    p = jax.nn.sigmoid(z)
+    g_part = Xa.T @ (w_b * (p - y_b) * inv_wsum)
+    s = jnp.maximum(w_b * p * (1 - p) * inv_wsum, 1e-10) \
+        * (w_b > 0)                           # zero-weight rows: inert
+    H_part = (Xa * s[:, None]).T @ Xa
+    return acc_g + g_part, acc_H + H_part
+
+
+def newton_block_pass(blocks: Iterable[
+        Tuple[np.ndarray, np.ndarray, np.ndarray]],
+        beta: np.ndarray, wsum: float,
+        d: int) -> Tuple[np.ndarray, np.ndarray]:
+    """ONE Newton-IRLS pass over (X, y, w) blocks at the current ``beta``:
+    per-block Gram/gradient partials folded into device-resident (D+1,)
+    / (D+1, D+1) accumulators.  Returns the host partials; the caller
+    combines across hosts and solves (``newton_solve_host``)."""
+    inv = jnp.float32(1.0 / max(float(wsum), 1.0))
+    beta_d = jnp.asarray(beta, jnp.float32)
+    acc_g = jnp.zeros(d + 1, jnp.float32)
+    acc_H = jnp.zeros((d + 1, d + 1), jnp.float32)
+    for X_b, y_b, w_b in blocks:
+        acc_g, acc_H = _newton_fold_jit(
+            acc_g, acc_H, jnp.asarray(X_b, jnp.float32),
+            jnp.asarray(y_b, jnp.float32), jnp.asarray(w_b, jnp.float32),
+            beta_d, inv)
+    return np.asarray(acc_g), np.asarray(acc_H)
+
+
+@functools.partial(jax.jit, static_argnames=("d",))
+def _newton_solve_jit(grad, H, beta, l2, d: int):
+    from ..models.linear import _damped_solve, _finite_or
+
+    grad = grad.at[:d].add(l2 * beta[:d])
+    H = H.at[jnp.arange(d), jnp.arange(d)].add(l2)
+    nb = _finite_or(beta - _damped_solve(H, grad), beta)
+    return nb, jnp.max(jnp.abs(nb - beta))
+
+
+def newton_solve_host(grad: np.ndarray, H: np.ndarray, beta: np.ndarray,
+                      l2: float, d: int) -> Tuple[np.ndarray, float]:
+    """The replicated (D+1) damped solve on COMBINED partials — the same
+    ``_damped_solve``/``_finite_or`` step the resident kernel runs inside
+    its while_loop.  Returns (new beta, max |step|)."""
+    nb, dn = _newton_solve_jit(jnp.asarray(grad, jnp.float32),
+                               jnp.asarray(H, jnp.float32),
+                               jnp.asarray(beta, jnp.float32),
+                               jnp.float32(l2), d)
+    return np.asarray(nb), float(dn)
+
+
+def fit_logreg_newton_blocked(blocks_fn: Callable[[], Iterable[
+        Tuple[np.ndarray, np.ndarray, np.ndarray]]],
+        d: int, *, reg_param: float = 0.0, max_iter: int = 50,
+        tol: float = 1e-6, wsum: Optional[float] = None,
+        combine: Optional[Callable[[np.ndarray], np.ndarray]] = None
+        ) -> Tuple[np.ndarray, float, int]:
+    """Newton-IRLS logistic regression over row blocks that never
+    co-reside: the block-streaming rewrite of
+    ``fit_logreg_newton_psum``'s Gram/grad inner step.
+
+    ``blocks_fn()`` yields a FRESH (X, y, w) block iterator per call (one
+    pass per Newton iteration — spilled blocks re-read from disk);
+    ``combine`` merges a host-partial array across hosts (identity when
+    single-host; the pod driver sums gathered partials in host order).
+    One combine per pass: the g/H partials ride one stacked exchange.
+    Returns host (coef, intercept, n_iter)."""
+    if combine is None:
+        combine = lambda a: a  # noqa: E731 - single-host identity
+    if wsum is None:
+        acc = np.zeros(1, np.float32)
+        for _X_b, _y_b, w_b in blocks_fn():
+            acc = acc + np.asarray(w_b, np.float32).sum(dtype=np.float32)
+        wsum = float(combine(acc)[0])
+    wsum = max(float(wsum), 1.0)
+    beta = np.zeros(d + 1, np.float32)
+    it = 0
+    while it < max_iter:
+        g, H = newton_block_pass(blocks_fn(), beta, wsum, d)
+        # ONE cross-host exchange per pass: gradient + Gram stacked
+        packed = combine(np.concatenate([g[None, :], H], axis=0))
+        g, H = packed[0], packed[1:]
+        beta, dn = newton_solve_host(g, H, beta, float(reg_param), d)
+        it += 1
+        if dn <= tol:
+            break
+    return beta[:d], float(beta[d]), it
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def _histogram_fold_jit(acc, b_b, g_b, h_b, w_b, n_bins: int):
+    oh = (b_b[:, None, :] == jnp.arange(n_bins)[None, :, None])
+    oh = oh.astype(jnp.float32)                        # (m, B, D)
+    vals = jnp.stack([g_b * w_b, h_b * w_b, w_b], axis=1)   # (m, 3)
+    return acc + jnp.einsum("mbd,mk->bdk", oh, vals)
+
+
+def histogram_block_fold(blocks: Iterable[Tuple[
+        np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+        d: int, n_bins: int = 32) -> np.ndarray:
+    """Fold (binned, g, h, w) blocks into the (n_bins, D, 3) histogram
+    accumulator — the block-streaming form of ``histogram_psum``'s
+    per-shard partial.  Returns this host's partial; the caller combines
+    across hosts (same [g*w, h*w, w] stacking)."""
+    acc = jnp.zeros((n_bins, int(d), 3), jnp.float32)
+    for b_b, g_b, h_b, w_b in blocks:
+        acc = _histogram_fold_jit(
+            acc, jnp.asarray(b_b, jnp.int32),
+            jnp.asarray(g_b, jnp.float32), jnp.asarray(h_b, jnp.float32),
+            jnp.asarray(w_b, jnp.float32), n_bins)
+    return np.asarray(acc)
+
+
+@jax.jit
+def _logloss_fold_jit(acc, X_b, y_b, w_b, beta):
+    m = X_b.shape[0]
+    Xa = jnp.concatenate([X_b, jnp.ones((m, 1), X_b.dtype)], axis=1)
+    z = Xa @ beta
+    # numerically stable weighted logloss partial: [sum w*loss, sum w]
+    loss = jnp.maximum(z, 0.0) - z * y_b + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return acc + jnp.stack([(w_b * loss).sum(), w_b.sum()])
+
+
+def logloss_block_fold(blocks: Iterable[
+        Tuple[np.ndarray, np.ndarray, np.ndarray]],
+        beta: np.ndarray) -> np.ndarray:
+    """Fold (X, y, w) blocks into the (2,) ``[sum w*logloss, sum w]``
+    accumulator for a fixed ``beta`` — the candidate-scoring pass of the
+    blocked linear sweep (winner = argmin combined loss/weight)."""
+    acc = jnp.zeros(2, jnp.float32)
+    beta_d = jnp.asarray(beta, jnp.float32)
+    for X_b, y_b, w_b in blocks:
+        acc = _logloss_fold_jit(acc, jnp.asarray(X_b, jnp.float32),
+                                jnp.asarray(y_b, jnp.float32),
+                                jnp.asarray(w_b, jnp.float32), beta_d)
+    return np.asarray(acc)
 
 
 @jax.jit

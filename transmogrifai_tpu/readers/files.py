@@ -7,12 +7,73 @@ inference (FeatureBuilder.infer_schema_from_pandas) plays that role.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Type
+import os
+from typing import Dict, List, Optional, Sequence, Tuple, Type
 
 from ..features.feature import Feature
 from ..types.columns import ColumnarDataset, FeatureColumn
 from ..types.feature_types import FeatureType
 from .base import ChunkStream, DataFrameReader, Reader, window_gen
+
+
+class _CountedRowsCache:
+    """Exact-row-count memo for file readers whose ``estimate_rows`` is a
+    heuristic (CSV/JSONL): host sharding's counting pre-pass is a full
+    chunk iteration, and before this cache it re-ran on EVERY pod train
+    over the same file — every resume, every repeated fit.  The count is
+    keyed by (path, mtime_ns, size), so any rewrite of the file (even
+    same-size, via mtime) invalidates it; a vanished file just misses.
+
+    The memo lives on the READER INSTANCE (not a process global): two
+    readers over the same path with different resilience configs can
+    legitimately yield different counts (quarantined rows are absent),
+    and an instance keeps one config for its lifetime.
+    """
+
+    def __init__(self):
+        self._key: Optional[Tuple[str, int, int]] = None
+        self._rows: Optional[int] = None
+
+    @staticmethod
+    def key_of(path: str) -> Optional[Tuple[str, int, int]]:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (path, int(st.st_mtime_ns), int(st.st_size))
+
+    def get(self, path: str) -> Optional[int]:
+        key = self.key_of(path)
+        if key is None or key != self._key:
+            return None
+        return self._rows
+
+    def put(self, path: str, rows: int) -> None:
+        key = self.key_of(path)
+        if key is None:
+            return
+        self._key = key
+        self._rows = int(rows)
+
+
+class _CountCacheMixin:
+    """Readers mix this in to expose the counted-rows memo to
+    ``distributed.hostshard.count_rows`` (duck-typed: the pre-pass calls
+    these when present)."""
+
+    @property
+    def _count_cache(self) -> _CountedRowsCache:
+        cache = getattr(self, "_count_cache_obj", None)
+        if cache is None:
+            cache = _CountedRowsCache()
+            self._count_cache_obj = cache
+        return cache
+
+    def cached_row_count(self) -> Optional[int]:
+        return self._count_cache.get(self.path)
+
+    def cache_row_count(self, rows: int) -> None:
+        self._count_cache.put(self.path, rows)
 
 
 def _count_lines(path: str) -> int:
@@ -56,7 +117,7 @@ def _text_dtype_overrides(raw_features: Sequence[Feature]) -> dict:
     return out
 
 
-class CSVReader(Reader):
+class CSVReader(_CountCacheMixin, Reader):
     """CSV with explicit column names (header optional)."""
 
     def __init__(self, path: str, column_names: Optional[List[str]] = None,
@@ -197,7 +258,7 @@ class ParquetReader(Reader):
         return ChunkStream(g, bytes_fn=lambda: pos["bytes"])
 
 
-class JSONLinesReader(Reader):
+class JSONLinesReader(_CountCacheMixin, Reader):
     def __init__(self, path: str, key_col: Optional[str] = None):
         self.path = path
         self.key_col = key_col

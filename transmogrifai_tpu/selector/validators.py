@@ -774,8 +774,14 @@ class SweepWorkQueue:
             i += 1
         # the final in-flight block: nothing is enqueued behind it, so
         # its flush is a genuine (booked) drain — the explicit durability
-        # sync point
+        # sync point.  On a pod the sync is barrier-fenced: the cursor
+        # write is the coordinator's (TM047), and non-coordinators must
+        # not run past the sweep's last durable write before it lands
         flush_pending(overlapped=False)
+        if checkpoint is not None:
+            sync = getattr(checkpoint, "sync_durability", None)
+            if sync is not None:
+                sync()
         if elastic is not None:
             elastic.drain()
         if defer:

@@ -63,7 +63,8 @@ __all__ = ["StreamingCheckpointManager", "CheckpointMismatchError",
            "encode_fit_state", "decode_fit_state", "adopt_restored_model",
            "CHECKPOINT_JSON", "CHECKPOINT_VERSION",
            "SweepCheckpointManager", "sweep_fingerprint", "mesh_record",
-           "fingerprint_diff", "SWEEP_CHECKPOINT_JSON"]
+           "fingerprint_diff", "SWEEP_CHECKPOINT_JSON",
+           "BlockStripeStore"]
 
 CHECKPOINT_JSON = "checkpoint.json"
 CHECKPOINT_VERSION = 1
@@ -576,6 +577,93 @@ class StreamingCheckpointManager:
 
 
 # ---------------------------------------------------------------------------
+# pod-striped block-pass checkpoints (ROADMAP item 3: the 10M-row plane)
+# ---------------------------------------------------------------------------
+
+class BlockStripeStore:
+    """Per-host checkpoint stripes for one block-streaming pass.
+
+    The block plane (distributed/podstream.py) folds a host's row blocks
+    through device-resident accumulators; its durable progress is just
+    {pass label, blocks folded, accumulator arrays} — the per-host record
+    format of the pod mid-pass protocol, striped: EACH host persists ONLY
+    its own cursor + partials to its own ``blocks.p<i>.npz``, so a resume
+    reads one stripe sized by the host's shard, never the whole pod's —
+    resume wall scales with per-host shard size, not total rows.
+
+    TM047 (coordinator-only durable writes) governs SHARED artifacts; a
+    stripe is process-private by construction — the filename carries the
+    process index, exactly like the per-process flight dumps — so every
+    host writing its own stripe is the point, not a violation.  Writes
+    are atomic (tmp + ``os.replace`` + fsync) and fire the
+    ``blockplane.checkpoint`` fault point after landing, the hook the
+    SIGKILL-resume bench kills at.
+    """
+
+    def __init__(self, directory: str, process_index: int):
+        self.directory = directory
+        self.process_index = int(process_index)
+        self.saves = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self) -> str:
+        return os.path.join(self.directory,
+                            f"blocks.p{self.process_index}.npz")
+
+    def save(self, label: str, blocks_done: int,
+             accs: Dict[str, np.ndarray],
+             meta: Optional[Dict[str, Any]] = None) -> None:
+        """Persist this host's pass cursor + partial accumulators
+        (bit-exact npz round trip — blocked folds resume mid-pass)."""
+        payload = {f"acc_{k}": np.asarray(v) for k, v in accs.items()}
+        payload["__meta__"] = np.frombuffer(json.dumps({
+            "label": str(label), "blocksDone": int(blocks_done),
+            "process": self.process_index, "meta": meta or {},
+        }).encode("utf-8"), dtype=np.uint8)
+        tmp = self._path() + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path())
+        self.saves += 1
+        from ..obs.flight import record_event
+
+        record_event("checkpoint.save", directory=self.directory,
+                     saves=self.saves, stripe=self.process_index,
+                     blocks=int(blocks_done), blockplane=True)
+        faults.fire("blockplane.checkpoint", index=self.saves - 1)
+
+    def load(self, label: str) -> Optional[Dict[str, Any]]:
+        """This host's stripe for ``label``, or None (fresh pass / stripe
+        belongs to a different pass).  Returns ``{"blocksDone", "accs",
+        "meta"}`` with accumulators restored bit-exactly."""
+        path = self._path()
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                head = json.loads(bytes(z["__meta__"]).decode("utf-8"))
+                if head.get("label") != str(label):
+                    return None
+                accs = {k[len("acc_"):]: z[k] for k in z.files
+                        if k.startswith("acc_")}
+        except (OSError, ValueError, KeyError):
+            return None
+        return {"blocksDone": int(head.get("blocksDone", 0)),
+                "accs": accs, "meta": head.get("meta") or {}}
+
+    def clear(self) -> None:
+        """The pass completed: drop THIS host's stripe (each host clears
+        its own — no coordinator funnel, same striping as the saves)."""
+        for suffix in ("", ".tmp"):
+            try:
+                os.unlink(self._path() + suffix)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
 # mid-sweep cursor: selector-sweep checkpoint/resume (ROADMAP item 1)
 # ---------------------------------------------------------------------------
 
@@ -764,21 +852,43 @@ class SweepCheckpointManager:
         if self._dirty:
             self._write()
 
+    def sync_durability(self, name: str = "sweep.final") -> None:
+        """Barrier-fence the sweep cursor's FINAL durable sync.
+
+        ``_write`` is coordinator-only (TM047's first half); under PR
+        17's async dispatch the closing ``flush_pending(overlapped=
+        False)`` is the last write of the sweep, and without a fence a
+        non-coordinator could run past it — and be SIGKILLed, or start
+        consuming the winner — before the coordinator's cursor landed on
+        disk (TM047's second half: every process observes the save as
+        durable before proceeding).  Called by the async scheduler right
+        after its final flush; a no-op outside a pod."""
+        from ..distributed.runtime import current_pod
+
+        pod = current_pod()
+        if pod.active:
+            pod.barrier(name)
+
     def scoped(self, tag: str) -> "_ScopedSweepCheckpoint":
         return _ScopedSweepCheckpoint(self, f"{tag}:")
 
     def finish(self) -> None:
         """The sweep completed: remove the cursor so a later sweep in the
-        same directory starts fresh."""
+        same directory starts fresh.  Coordinator-only unlink, fenced by
+        a barrier so no process outlives the sweep believing a stale
+        cursor is still on disk (the same fence-after-durable-effect
+        rule as the streaming manager's pass saves)."""
         from ..distributed.runtime import current_pod
 
         pod = current_pod()
-        if pod.active and not pod.is_coordinator():
-            return
-        try:
-            os.unlink(os.path.join(self.directory, SWEEP_CHECKPOINT_JSON))
-        except OSError:
-            pass
+        if not pod.active or pod.is_coordinator():
+            try:
+                os.unlink(os.path.join(self.directory,
+                                       SWEEP_CHECKPOINT_JSON))
+            except OSError:
+                pass
+        if pod.active:
+            pod.barrier("sweep.finish")
 
 
 class _ScopedSweepCheckpoint:
@@ -797,6 +907,9 @@ class _ScopedSweepCheckpoint:
 
     def flush(self) -> None:
         self._m.flush()
+
+    def sync_durability(self, name: str = "sweep.final") -> None:
+        self._m.sync_durability(name)
 
 
 def adopt_restored_model(est: Estimator, model: PipelineStage) -> Model:
