@@ -209,6 +209,15 @@ def run_child(args) -> int:
             out["flightMergedEvents"] = len(merged)
             out["flightProcesses"] = sorted(
                 {e.get("process") for e in merged})
+    # under TMOG_CHECK=1 every collective was ledgered: emit the final
+    # (seq, digest) fingerprint so the driver can assert the pod issued
+    # IDENTICAL collective sequences (the TM074 zero-divergence gate)
+    from transmogrifai_tpu.analysis.contracts import (checks_enabled,
+                                                      collective_ledger)
+
+    if checks_enabled():
+        led = collective_ledger()
+        out["collectives"] = {"seq": led.seq, "digest": led.digest()}
     print("POD_RESULT " + json.dumps(out), flush=True)
     return 0
 
@@ -374,6 +383,18 @@ def _run_legs(args, rows, work) -> int:
         else:
             _ok(gates, "flight_merge",
                 f"{pods[0]['flightMergedEvents']} events from {fp}")
+        # zero-divergence gate: under TMOG_CHECK=1 both processes must
+        # report the SAME non-empty collective-ledger fingerprint
+        leds = [p.get("collectives") for p in pods]
+        if all(l is not None for l in leds):
+            if (leds[0]["digest"] != leds[1]["digest"]
+                    or leds[0]["seq"] != leds[1]["seq"]
+                    or leds[0]["seq"] <= 0):
+                _fail(gates, "collective_ledger",
+                      f"divergent or empty ledgers: {leds}")
+            else:
+                _ok(gates, "collective_ledger",
+                    f"seq {leds[0]['seq']}, identical digests")
 
     # -- leg 3: fault schedule (retryable io_error + one-host device loss) --
     faults = {"faults": [

@@ -211,7 +211,9 @@ def main() -> None:
     ap.add_argument("--checkpoint-dir", default="")
     args = ap.parse_args()
 
-    if args.run_child:
+    # driver/child MODE dispatch: the two arms run in separate
+    # processes by construction, never as peers of one pod
+    if args.run_child:  # tmog: disable=TM071
         refresh_child(args.base_csv, args.drift_csv, args.chunk_rows,
                       args.checkpoint_dir or None)
         return

@@ -87,8 +87,10 @@ fi
 # merged; a transient reader io_error + a device loss aimed at ONE
 # process must complete without deadlocking a barrier; and a SIGKILLed
 # 2-process checkpointed train must resume BIT-EXACTLY on 1 process
-# with the repack counted (cross-host-count elastic resume)
-if timeout -k 10 540 env JAX_PLATFORMS=cpu python examples/bench_pod.py --smoke > /tmp/_t1_pod.log 2>&1; then
+# with the repack counted (cross-host-count elastic resume).
+# TMOG_CHECK=1 additionally arms the collective LEDGER on every pod
+# process: the smoke asserts zero TM074 divergences (identical digests)
+if timeout -k 10 780 env JAX_PLATFORMS=cpu TMOG_CHECK=1 python examples/bench_pod.py --smoke > /tmp/_t1_pod.log 2>&1; then
   echo "POD_SMOKE=ok $(grep -ao '"ok": true' /tmp/_t1_pod.log | tail -1)"
 else
   echo "POD_SMOKE=FAILED (see /tmp/_t1_pod.log)"
@@ -171,13 +173,16 @@ else
   echo "OBS_SMOKE=FAILED (see /tmp/_t1_obs.log)"
   rc=1
 fi
-# self-lint: all three source families (trace TM03x, shard TM04x,
-# concurrency TM05x) over the shipped package (incl. parallel/ tuning/
-# serving/ workflow/) + examples, DAG lint of the example pipeline
-# factory, ratcheted against the committed findings baseline — NEW
-# findings fail, vanished findings shrink benchmarks/lint_baseline.json
+# self-lint: all four source families (trace TM03x, shard TM04x,
+# concurrency TM05x, collective TM07x) over the shipped package (incl.
+# parallel/ tuning/ serving/ workflow/ distributed/) + examples, DAG
+# lint of the example pipeline factory, ratcheted against the committed
+# findings baseline — NEW findings fail, vanished findings shrink
+# benchmarks/lint_baseline.json; --cache skips unchanged files on
+# repeated local runs
 if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m transmogrifai_tpu.lint \
     transmogrifai_tpu examples \
+    --cache /tmp/_t1_lint_cache.json \
     --baseline benchmarks/lint_baseline.json \
     --dag examples/bench_pipeline.py:titanic_features > /tmp/_t1_lint.log 2>&1; then
   echo "LINT=ok"

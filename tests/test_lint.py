@@ -692,9 +692,10 @@ def test_cli_json_report(tmp_path, capsys):
     bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
     assert lint_cli([str(bad), "--json"]) == 1
     report = json.loads(capsys.readouterr().out)
-    assert report["schemaVersion"] == 2
+    assert report["schemaVersion"] == 3
     assert report["errors"] == 1
     assert report["findings"][0]["rule"] == "TM030"
+    assert report["cacheHits"] == 0
 
 
 def test_cli_baseline_ratchet(tmp_path, capsys):

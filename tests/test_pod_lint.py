@@ -1,0 +1,329 @@
+"""TM07x collective-safety: static lint (callgraph + pod_lint), the
+runtime collective ledger, the per-file lint cache, and the
+skip-a-barrier e2e — a 2-process pod where one host skips a barrier must
+FAIL ATTRIBUTED (TM074 naming both divergent sites), not hang.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.analysis import Findings, lint_paths_all
+from transmogrifai_tpu.analysis import pod_lint
+from transmogrifai_tpu.analysis.cache import LintResultCache
+from transmogrifai_tpu.analysis.callgraph import (CallGraph,
+                                                  summarize_source)
+from transmogrifai_tpu.analysis.cli import expand_rule_selectors
+from transmogrifai_tpu.analysis.cli import main as lint_cli
+from transmogrifai_tpu.analysis.contracts import (
+    CollectiveLedger, CollectiveWatchdog, ContractViolation,
+    diff_collective_ledgers, verify_collective_headers)
+from transmogrifai_tpu.distributed.runtime import launch_local_pod
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(code):
+    return pod_lint.lint_source(code, "fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# call graph: transitive collective reachability
+# ---------------------------------------------------------------------------
+
+class TestCallGraph:
+    def test_transitive_reaching(self):
+        g = CallGraph()
+        g.add_source(
+            "def low(pod):\n"
+            "    pod.allgather_obj(1)\n"
+            "def mid(pod):\n"
+            "    low(pod)\n"
+            "def top(pod):\n"
+            "    mid(pod)\n"
+            "def clean(pod):\n"
+            "    return 1\n", "a.py")
+        names = g.reaching_names()
+        assert {"low", "mid", "top"} <= names
+        assert "clean" not in names
+
+    def test_ambiguous_name_suppresses(self):
+        """Two defs of one name: reachability through it is NOT assumed
+        (ambiguity must never invent a finding)."""
+        g = CallGraph()
+        g.add_source(
+            "def helper(pod):\n"
+            "    pod.barrier('x')\n", "a.py")
+        g.add_source(
+            "def helper(pod):\n"
+            "    return 1\n"
+            "def caller(pod):\n"
+            "    helper(pod)\n", "b.py")
+        assert "caller" not in g.reaching_names()
+
+    def test_barrier_needs_pod_receiver(self):
+        g = CallGraph()
+        g.add_source(
+            "def wait(lock):\n"
+            "    lock.barrier('x')\n", "a.py")
+        assert "wait" not in g.reaching_names()
+
+
+# ---------------------------------------------------------------------------
+# pod lint: TM070 / TM071 / TM072 semantics beyond the catalog fixtures
+# ---------------------------------------------------------------------------
+
+class TestPodLint:
+    def test_tm070_transitive_through_helper(self):
+        f = _lint(
+            "def helper(pod):\n"
+            "    pod.barrier('save')\n"
+            "def step(pod):\n"
+            "    if pod.is_coordinator():\n"
+            "        helper(pod)\n")
+        assert f.rules_fired() == ["TM070"]
+
+    def test_tm071_early_return_path(self):
+        f = _lint(
+            "def step(pod, chunks_done):\n"
+            "    if chunks_done > 3:\n"
+            "        pod.barrier('late')\n"
+            "        return\n"
+            "    pod.allgather_obj(1)\n")
+        assert "TM071" in f.rules_fired()
+
+    def test_pod_active_guard_is_clean(self):
+        # `pod.active` is uniform across a launched pod: the canonical
+        # warmup / no-pod fallback shape must not fire
+        f = _lint(
+            "def warmup(pod):\n"
+            "    if pod.active:\n"
+            "        pod.barrier('warmup')\n")
+        assert f.rules_fired() == []
+
+    def test_coordinator_guarded_local_work_is_clean(self):
+        f = _lint(
+            "def save(pod, doc):\n"
+            "    if pod.is_coordinator():\n"
+            "        print(doc)\n"
+            "    pod.barrier('saved')\n")
+        assert f.rules_fired() == []
+
+    def test_tm072_sorted_wrap_is_clean(self):
+        f = _lint(
+            "def merge(pod, parts):\n"
+            "    out = []\n"
+            "    for p in sorted({1, 2, 3}):\n"
+            "        out.append(p)\n"
+            "    return out\n")
+        assert f.rules_fired() == []
+
+    def test_non_pod_code_is_ignored(self):
+        f = _lint(
+            "def plain(items):\n"
+            "    for p in {1, 2}:\n"
+            "        print(p)\n")
+        assert f.rules_fired() == []
+
+    def test_suppression_comment(self):
+        f = _lint(
+            "def save(pod, doc):\n"
+            "    if pod.is_coordinator():  # tmog: disable=TM070\n"
+            "        pod.barrier('save')\n")
+        assert f.rules_fired() == []
+
+    def test_syntax_error_is_reported_not_raised(self):
+        f = _lint("def broken(:\n")
+        assert f.rules_fired() == ["TM070"]
+        assert f.diagnostics[0].severity == "warning"
+
+
+# ---------------------------------------------------------------------------
+# runtime ledger
+# ---------------------------------------------------------------------------
+
+class TestCollectiveLedger:
+    def test_identical_sequences_identical_digests(self):
+        a, b = CollectiveLedger(), CollectiveLedger()
+        for led in (a, b):
+            led.record("barrier(x)", "f.py:1")
+            led.record("allgather_obj", "f.py:2")
+        assert a.digest() == b.digest()
+        assert not diff_collective_ledgers([a.snapshot(0), b.snapshot(1)])
+
+    def test_divergence_names_both_sites(self):
+        a, b = CollectiveLedger(), CollectiveLedger()
+        a.record("barrier(phase1)", "train.py:10")
+        b.record("allgather_obj", "train.py:14")
+        f = diff_collective_ledgers([a.snapshot(0), b.snapshot(1)])
+        assert f.rules_fired() == ["TM074"]
+        msg = f.diagnostics[0].message
+        assert "train.py:10" in msg and "train.py:14" in msg
+
+    def test_suspended_records_nothing(self):
+        led = CollectiveLedger()
+        with led.suspended():
+            assert led.record("barrier(x)", "f.py:1") is None
+        assert led.seq == 0
+
+    def test_verify_headers_raises_attributed(self):
+        with pytest.raises(ContractViolation) as ei:
+            verify_collective_headers([
+                [2, "barrier(phase1)", "a.py:7"],
+                [2, "allgather_obj", "a.py:9"]])
+        assert ei.value.diagnostic.rule == "TM074"
+        assert "barrier(phase1)" in str(ei.value)
+
+    def test_watchdog_cancelled_on_completion(self):
+        fired = []
+        with CollectiveWatchdog("barrier(x)", "f.py:1", timeout=0.05,
+                                ledger=CollectiveLedger(),
+                                on_hang=fired.append):
+            pass
+        time.sleep(0.15)
+        assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# per-file lint result cache
+# ---------------------------------------------------------------------------
+
+def _write_tree(tmp_path):
+    (tmp_path / "helper.py").write_text(
+        "def helper(pod):\n"
+        "    pod.barrier('x')\n")
+    (tmp_path / "caller.py").write_text(
+        "def step(pod):\n"
+        "    if pod.is_coordinator():\n"
+        "        helper(pod)\n")
+    return [str(tmp_path)]
+
+
+class TestLintCache:
+    def test_warm_run_hits_and_matches_cold(self, tmp_path):
+        paths = _write_tree(tmp_path)
+        store = str(tmp_path / "cache.json")
+        cold_cache = LintResultCache(store)
+        cold = lint_paths_all(paths, cache=cold_cache)
+        assert cold_cache.hits == 0 and cold_cache.misses == 2
+        warm_cache = LintResultCache(store)
+        warm = lint_paths_all(paths, cache=warm_cache)
+        assert warm_cache.hits == 2 and warm_cache.misses == 0
+        assert ([d.to_json() for d in cold]
+                == [d.to_json() for d in warm])
+
+    def test_cross_file_edit_invalidates_reaching(self, tmp_path):
+        """Editing helper.py so it no longer reaches a collective must
+        re-lint caller.py too (the reaching digest changed) and clear
+        its TM070."""
+        paths = _write_tree(tmp_path)
+        store = str(tmp_path / "cache.json")
+        first = lint_paths_all(paths, cache=LintResultCache(store))
+        assert "TM070" in first.rules_fired()
+        time.sleep(0.01)
+        (tmp_path / "helper.py").write_text(
+            "def helper(pod):\n"
+            "    return 1\n")
+        cache = LintResultCache(store)
+        second = lint_paths_all(paths, cache=cache)
+        assert second.rules_fired() == []
+        assert cache.misses == 2    # caller.py re-linted despite no edit
+
+    def test_corrupt_cache_degrades_to_cold(self, tmp_path):
+        paths = _write_tree(tmp_path)
+        store = tmp_path / "cache.json"
+        store.write_text("{not json")
+        cache = LintResultCache(str(store))
+        findings = lint_paths_all(paths, cache=cache)
+        assert cache.hits == 0 and "TM070" in findings.rules_fired()
+
+
+# ---------------------------------------------------------------------------
+# CLI: family-prefix selectors + cacheHits
+# ---------------------------------------------------------------------------
+
+class TestCliRules:
+    def test_expand_family_prefix(self):
+        fam = expand_rule_selectors("TM07x")
+        assert fam == {"TM070", "TM071", "TM072", "TM073", "TM074"}
+        assert expand_rule_selectors("TM041,TM07x") == fam | {"TM041"}
+
+    def test_unknown_family_rejected(self):
+        with pytest.raises(SystemExit):
+            expand_rule_selectors("TM99x")
+
+    def test_rules_filter_run(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def step(pod):\n"
+            "    if pod.is_coordinator():\n"
+            "        pod.barrier('x')\n"
+            "    for p in {1, 2}:\n"
+            "        print(p)\n")
+        assert lint_cli([str(bad), "--rules", "TM070", "--json"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in report["findings"]] == ["TM070"]
+        assert lint_cli([str(bad), "--suppress", "TM07x"]) == 0
+
+    def test_rules_catalog_slice(self, capsys):
+        assert lint_cli(["--rules", "TM07x"]) == 0
+        out = capsys.readouterr().out
+        assert "TM070" in out and "TM030" not in out
+
+    def test_cache_hits_in_json(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def step(pod):\n"
+            "    if pod.is_coordinator():\n"
+            "        pod.barrier('x')\n")
+        store = str(tmp_path / "cache.json")
+        lint_cli([str(bad), "--cache", store, "--json"])
+        assert json.loads(capsys.readouterr().out)["cacheHits"] == 0
+        lint_cli([str(bad), "--cache", store, "--json"])
+        assert json.loads(capsys.readouterr().out)["cacheHits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# e2e: one host skips a barrier -> attributed TM074, no hang
+# ---------------------------------------------------------------------------
+
+_CHILD = (
+    "import sys\n"
+    f"sys.path.insert(0, {_ROOT!r})\n"
+    "from transmogrifai_tpu.distributed import init_pod_from_env\n"
+    "pod = init_pod_from_env()\n"
+    "pod.allgather_obj(pod.process_index)\n"
+    "pod.barrier('phase1')\n"     # process 1 SKIPS this via the fault
+    "pod.allgather_obj('tail')\n"
+    "print('done', flush=True)\n"
+)
+
+
+@pytest.mark.slow
+class TestSkipBarrierE2E:
+    def test_skipped_barrier_fails_attributed(self):
+        faults = {"faults": [{"point": "pod.barrier", "action": "skip",
+                              "tag": "phase1", "process": 1}]}
+        base = dict(os.environ)
+        base["TMOG_COST_HISTORY"] = ""
+        base["TMOG_CHECK"] = "1"
+        base["TMOG_FAULTS"] = json.dumps(faults)
+        # belt & braces: even if attribution regressed, the watchdog
+        # bounds the run — the test must never hang to the timeout
+        base["TMOG_COLLECTIVE_TIMEOUT"] = "60"
+        t0 = time.monotonic()
+        res = launch_local_pod(
+            2, [sys.executable, "-c", _CHILD], local_devices=2,
+            base_env=base, timeout=180, kill_grace_s=20)
+        wall = time.monotonic() - t0
+        assert wall < 120, f"skip-a-barrier took {wall:.0f}s"
+        for r in res:
+            assert r["returncode"] not in (0, None), res
+        stderr = "".join(r["stderr"] for r in res)
+        assert "TM074" in stderr, stderr[-2000:]
+        # the report names the two divergent collectives
+        assert "barrier(phase1)" in stderr
+        assert "allgather_obj" in stderr

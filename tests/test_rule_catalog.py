@@ -14,7 +14,7 @@ import numpy as np
 import pytest
 
 from transmogrifai_tpu.analysis import RULES, Findings
-from transmogrifai_tpu.analysis import concur_lint, shard_lint
+from transmogrifai_tpu.analysis import concur_lint, pod_lint, shard_lint
 from transmogrifai_tpu.analysis.contracts import (
     ContractViolation, check_checkpoint_roundtrip, check_mesh_parity,
     check_pad_invariance, check_streaming_fit, check_warm_start,
@@ -334,6 +334,68 @@ def _tm053():
         "                pass\n")
 
 
+# -- TM07x ------------------------------------------------------------------
+
+def _pod(body):
+    return pod_lint.lint_source(body, "fixture.py")
+
+
+def _tm070():
+    return _pod(
+        "def save(pod, doc):\n"
+        "    if pod.is_coordinator():\n"
+        "        pod.barrier('save')\n")
+
+
+def _tm071():
+    return _pod(
+        "def step(pod, doc):\n"
+        "    if pod.process_index == 0:\n"
+        "        pod.allgather_obj(doc)\n"
+        "    else:\n"
+        "        pod.barrier('step')\n")
+
+
+def _tm072():
+    return _pod(
+        "def merge(pod, parts):\n"
+        "    out = []\n"
+        "    for p in {1, 2, 3}:\n"
+        "        out.append(p)\n"
+        "    return out\n")
+
+
+def _tm073():
+    import threading
+
+    from transmogrifai_tpu.analysis.contracts import (CollectiveLedger,
+                                                      CollectiveWatchdog)
+
+    out = Findings()
+    fired = threading.Event()
+
+    def on_hang(diag):
+        out.diagnostics.append(diag)
+        fired.set()
+
+    # the guarded collective never returns: the watchdog must fire
+    with CollectiveWatchdog("barrier(fixture)", "fixture.py:1",
+                            timeout=0.02, ledger=CollectiveLedger(),
+                            on_hang=on_hang):
+        assert fired.wait(10.0), "watchdog did not fire"
+    return out
+
+
+def _tm074():
+    from transmogrifai_tpu.analysis.contracts import (
+        CollectiveLedger, diff_collective_ledgers)
+
+    a, b = CollectiveLedger(), CollectiveLedger()
+    a.record("barrier(phase1)", "train.py:10")
+    b.record("allgather_obj", "train.py:14")
+    return diff_collective_ledgers([a.snapshot(0), b.snapshot(1)])
+
+
 #: rule id -> its ONE seeded fixture
 FIXTURES = {
     "TM001": _tm001, "TM002": _tm002, "TM003": _tm003, "TM004": _tm004,
@@ -346,6 +408,8 @@ FIXTURES = {
     "TM044": _tm044, "TM045": _tm045, "TM046": _tm046, "TM047": _tm047,
     "TM050": _tm050, "TM051": _tm051, "TM052": _tm052, "TM053": _tm053,
     "TM060": _tm060,
+    "TM070": _tm070, "TM071": _tm071, "TM072": _tm072, "TM073": _tm073,
+    "TM074": _tm074,
 }
 
 

@@ -28,11 +28,19 @@ safety layer back as five rule families, each with stable ``TM0xx`` ids:
   JSON/benchmark writes bypassing ``write_json_atomic``, leaked
   tempfiles, unlocked shared mutation from thread-pool closures, and
   lock acquisition order inversions.
+* **Collective-safety lint** (``pod_lint`` + ``contracts``, TM07x) —
+  host collectives reachable only under process-divergent guards,
+  collective-order mismatches between sibling/early-exit paths,
+  non-deterministic folds of gathered partials; plus the runtime
+  collective LEDGER (``TMOG_CHECK=1``): every pod collective records
+  ``(seq, kind, site)``, divergent sequences fail attributed (TM074)
+  and a ``TMOG_COLLECTIVE_TIMEOUT`` watchdog dumps the ledger on a
+  hang (TM073).
 
 CLI: ``python -m transmogrifai_tpu.lint`` (or ``tmog lint``); library entry
 points: ``lint_dag``, ``lint_workflow``, ``lint_paths``,
 ``lint_paths_all``, ``check_workflow_contracts``,
-``check_sharding_contracts``.
+``check_sharding_contracts``, ``check_collective_consistency``.
 """
 from .diagnostics import (  # noqa: F401
     Diagnostic, Findings, PipelineLintError, ContractViolation, RULES,
@@ -44,7 +52,7 @@ from .contracts import (  # noqa: F401
     checks_enabled, check_streaming_fit, check_warm_start,
     check_workflow_contracts,
     check_pad_invariance, check_mesh_parity, check_checkpoint_roundtrip,
-    check_sharding_contracts,
+    check_sharding_contracts, check_collective_consistency,
 )
 
 __all__ = [
@@ -54,16 +62,24 @@ __all__ = [
     "check_streaming_fit", "check_warm_start", "check_workflow_contracts",
     "check_pad_invariance", "check_mesh_parity",
     "check_checkpoint_roundtrip", "check_sharding_contracts",
+    "check_collective_consistency",
 ]
 
 
-def lint_paths_all(paths) -> Findings:
-    """All three source-lint families (trace TM03x, shard TM04x, concur
-    TM05x) over files / directory trees — what the CLI and the tier-1
-    self-lint run."""
-    from . import concur_lint, shard_lint, trace_lint
+def lint_paths_all(paths, cache=None) -> Findings:
+    """All four source-lint families (trace TM03x, shard TM04x, concur
+    TM05x, pod TM07x) over files / directory trees — what the CLI and
+    the tier-1 self-lint run.  ``cache`` (a
+    :class:`analysis.cache.LintResultCache`) reuses unchanged files'
+    results keyed on ``(path, mtime_ns, size)`` + cross-file digests."""
+    if cache is not None:
+        from .cache import lint_paths_all_cached
+
+        return lint_paths_all_cached(paths, cache)
+    from . import concur_lint, pod_lint, shard_lint, trace_lint
 
     findings = trace_lint.lint_paths(paths)
     findings.extend(shard_lint.lint_paths(paths))
     findings.extend(concur_lint.lint_paths(paths))
+    findings.extend(pod_lint.lint_paths(paths))
     return findings

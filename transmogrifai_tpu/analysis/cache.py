@@ -1,0 +1,192 @@
+"""Per-file lint result cache — the tier-1 self-lint stops re-parsing
+unchanged files.
+
+Same idiom as the CSV counting-pre-pass memo (``readers/files.py``): a
+file's entry is keyed on ``(path, mtime_ns, size)``, so any rewrite —
+even a same-size one, thanks to mtime_ns — invalidates it.  Unlike the
+row memo, lint results also depend on CROSS-FILE state, which two
+digests pin:
+
+* ``reachingDigest`` — the call graph's collective-reaching name set
+  (pod lint TM070/TM071 findings change when ANY file alters
+  reachability);
+* ``preEdges`` — the lock-order edge set accumulated over the files
+  sorted BEFORE this one (concur lint TM053 fires at the LATER file of
+  an inversion pair, so a file's findings depend on exactly that
+  prefix).
+
+A hit requires all three to match; anything else re-lints the file.
+Function summaries (:mod:`analysis.callgraph`) are cached alongside the
+findings so a fully warm run rebuilds the whole call graph without
+parsing a single file.
+
+The orchestrated entry point is :func:`lint_paths_all_cached` — the
+same four families as ``analysis.lint_paths_all`` (trace TM03x, shard
+TM04x, concur TM05x, pod TM07x), file-major order.  Persistence is a
+single JSON document (``write_json_atomic``); a missing or corrupt
+cache file degrades to a cold run.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .callgraph import CallGraph, FunctionSummary, summarize_source
+from .diagnostics import JSON_SCHEMA_VERSION, Diagnostic, Findings
+
+__all__ = ["LintResultCache", "lint_paths_all_cached"]
+
+
+def _stat_key(path: str) -> Optional[List[int]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return [int(st.st_mtime_ns), int(st.st_size)]
+
+
+def _edges_digest(edges: Dict[Tuple[str, str], str]) -> str:
+    h = hashlib.sha256()
+    for (a, b), loc in sorted(edges.items()):
+        h.update(f"{a}|{b}|{loc}\n".encode())
+    return h.hexdigest()
+
+
+def _reaching_digest(graph: CallGraph) -> str:
+    h = hashlib.sha256()
+    for name in sorted(graph.reaching_names()):
+        h.update(name.encode() + b"\n")
+    return h.hexdigest()
+
+
+class LintResultCache:
+    """Disk-persisted memo of per-file lint results + call summaries."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.files: Dict[str, Dict[str, Any]] = {}
+        self.hits = 0
+        self.misses = 0
+        if path is not None and os.path.exists(path):
+            try:
+                import json
+
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                if doc.get("schemaVersion") == JSON_SCHEMA_VERSION:
+                    self.files = dict(doc.get("files", {}))
+            except (OSError, ValueError):
+                self.files = {}
+
+    def save(self) -> None:
+        if self.path is None:
+            return
+        from ..utils.jsonio import write_json_atomic
+
+        write_json_atomic(self.path, {
+            "schemaVersion": JSON_SCHEMA_VERSION, "files": self.files})
+
+    # -- entry plumbing -----------------------------------------------
+
+    def lookup(self, path: str, key, reaching_digest: str,
+               pre_edges: str) -> Optional[Dict[str, Any]]:
+        e = self.files.get(path)
+        if (e is not None and e.get("key") == key
+                and e.get("reachingDigest") == reaching_digest
+                and e.get("preEdges") == pre_edges):
+            return e
+        return None
+
+    def store(self, path: str, key, reaching_digest: str, pre_edges: str,
+              summaries: List[FunctionSummary],
+              own_edges: List[List[str]],
+              findings: Findings) -> None:
+        self.files[path] = {
+            "key": key,
+            "reachingDigest": reaching_digest,
+            "preEdges": pre_edges,
+            "summaries": [s.to_json() for s in summaries],
+            "ownEdges": own_edges,
+            "findings": [d.to_json() for d in findings],
+        }
+
+
+def _decode_findings(raw: Iterable[Dict[str, Any]]) -> Findings:
+    return Findings(Diagnostic(
+        rule=d["rule"], message=d["message"],
+        severity=d.get("severity", "error"),
+        stage_uid=d.get("stageUid"), location=d.get("location"))
+        for d in raw)
+
+
+def lint_paths_all_cached(paths: Iterable[str],
+                          cache: LintResultCache) -> Findings:
+    """All four source-lint families over ``paths`` through ``cache``.
+
+    Phase 1 assembles every file's function summaries (cache or one
+    parse) and builds the whole-tree call graph; phase 2 walks the files
+    in sorted order, reusing a file's findings when its stat key and
+    both cross-file digests match, re-linting otherwise.  Saves the
+    cache before returning.
+    """
+    from . import concur_lint, pod_lint, shard_lint, trace_lint
+    from .trace_lint import iter_py_files
+
+    files = list(iter_py_files(paths))
+    graph = CallGraph()
+    prepared: List[Tuple[str, Any, Optional[str],
+                         List[FunctionSummary]]] = []
+    for path in files:
+        key = _stat_key(path)
+        entry = cache.files.get(path)
+        if entry is not None and entry.get("key") == key:
+            summaries = [FunctionSummary.from_json(s)
+                         for s in entry.get("summaries", [])]
+            code = None     # lazily read only on a findings miss
+        else:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    code = f.read()
+            except OSError:
+                continue
+            try:
+                summaries = summarize_source(code, path)
+            except SyntaxError:
+                summaries = []
+        graph.add_summaries(summaries)
+        prepared.append((path, key, code, summaries))
+
+    reaching_digest = _reaching_digest(graph)
+    edges: Dict[Tuple[str, str], str] = {}
+    findings = Findings()
+    for path, key, code, summaries in prepared:
+        pre_edges = _edges_digest(edges)
+        entry = cache.lookup(path, key, reaching_digest, pre_edges)
+        if entry is not None:
+            cache.hits += 1
+            findings.extend(_decode_findings(entry.get("findings", [])))
+            for a, b, loc in entry.get("ownEdges", []):
+                edges.setdefault((a, b), loc)
+            continue
+        cache.misses += 1
+        if code is None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    code = f.read()
+            except OSError:
+                continue
+        before = set(edges)
+        file_findings = trace_lint.lint_source(code, path)
+        file_findings.extend(shard_lint.lint_source(code, path))
+        file_findings.extend(
+            concur_lint.lint_source(code, path, _edges=edges))
+        file_findings.extend(
+            pod_lint.lint_source(code, path, graph=graph))
+        own = [[a, b, edges[(a, b)]]
+               for (a, b) in sorted(set(edges) - before)]
+        cache.store(path, key, reaching_digest, pre_edges, summaries,
+                    own, file_findings)
+        findings.extend(file_findings)
+    cache.save()
+    return findings

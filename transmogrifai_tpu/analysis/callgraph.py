@@ -1,0 +1,176 @@
+"""Package-local call graph for the collective-safety lint (TM07x).
+
+The pod runtime's host collectives (``allgather_obj`` / ``broadcast_obj``
+/ ``allsum`` / ``pod.barrier`` and the ``multihost_utils`` primitives
+under them) must be issued by EVERY process in the same order, so
+``pod_lint`` needs to know not just where a collective literally appears
+but which functions *transitively reach* one through plain calls.  This
+module builds that reachability set from the AST alone — no imports, no
+execution — with deliberately conservative name resolution:
+
+* Functions are indexed by their bare ``def`` name (the last segment of
+  any dotted call).  A call site resolves to a graph node ONLY when that
+  name maps to exactly one definition across the whole linted file set;
+  an ambiguous name (``complete_pass`` is defined on both the stream
+  context and the checkpoint manager) resolves to nothing, so ambiguity
+  can suppress a finding but never invent one.
+* ``barrier`` is treated as a collective only when the receiver chain
+  mentions a pod (``pod.barrier`` / ``self.pod.barrier``); the many
+  unrelated ``barrier``-named things in test harnesses stay invisible.
+
+Summaries (:class:`FunctionSummary`) are plain data so the per-file lint
+cache can persist them and rebuild the graph without re-parsing
+unchanged files.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from .astutil import SCOPE_NODES, dotted
+
+__all__ = ["CallGraph", "FunctionSummary", "HOST_COLLECTIVES",
+           "collective_call_kind", "summarize_source"]
+
+#: host-collective call names: the object-level pod collectives plus the
+#: ``jax.experimental.multihost_utils`` primitives they are built on
+HOST_COLLECTIVES = {"allgather_obj", "broadcast_obj", "allsum",
+                    "sync_global_devices", "process_allgather"}
+
+
+def _last(name: Optional[str]) -> Optional[str]:
+    return name.split(".")[-1] if name else None
+
+
+def collective_call_kind(call: ast.Call) -> Optional[str]:
+    """The collective kind a call issues directly, or None.
+
+    ``barrier`` qualifies only through a pod receiver (``pod.barrier``,
+    ``self.pod.barrier``, ``ctx.pod_ctx.barrier`` ...), everything in
+    :data:`HOST_COLLECTIVES` by bare name.
+    """
+    name = dotted(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    leaf = parts[-1]
+    if leaf in HOST_COLLECTIVES:
+        return leaf
+    if leaf == "barrier" and any("pod" in p for p in parts[:-1]):
+        return "barrier"
+    return None
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    """One ``def``'s collective-relevant facts, JSON-serializable."""
+
+    name: str                  # bare def name (call-site key)
+    qualname: str              # Class.name for methods
+    filename: str
+    lineno: int
+    direct: List[str]          # collective kinds issued directly
+    calls: List[str]           # bare names of everything it calls
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "FunctionSummary":
+        return cls(name=d["name"], qualname=d["qualname"],
+                   filename=d["filename"], lineno=int(d["lineno"]),
+                   direct=list(d["direct"]), calls=list(d["calls"]))
+
+
+def _own_calls(fn: ast.AST):
+    """Call nodes in ``fn``'s own scope (nested defs are their own
+    graph nodes and are summarized separately)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, SCOPE_NODES):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def summarize_source(code: str, filename: str) -> List[FunctionSummary]:
+    """Summaries for every function/method in one source file.
+
+    Raises ``SyntaxError`` on unparsable input (callers degrade to a
+    warning finding the same way the other lint families do).
+    """
+    tree = ast.parse(code, filename=filename)
+    out: List[FunctionSummary] = []
+
+    def visit(scope: ast.AST, prefix: str) -> None:
+        for n in ast.iter_child_nodes(scope):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                direct: List[str] = []
+                calls: List[str] = []
+                for c in _own_calls(n):
+                    kind = collective_call_kind(c)
+                    if kind is not None:
+                        direct.append(kind)
+                    leaf = _last(dotted(c.func))
+                    if leaf:
+                        calls.append(leaf)
+                qual = f"{prefix}.{n.name}" if prefix else n.name
+                out.append(FunctionSummary(
+                    name=n.name, qualname=qual, filename=filename,
+                    lineno=n.lineno, direct=direct, calls=calls))
+                visit(n, qual)
+            elif isinstance(n, ast.ClassDef):
+                visit(n, f"{prefix}.{n.name}" if prefix else n.name)
+            elif not isinstance(n, SCOPE_NODES):
+                visit(n, prefix)
+
+    visit(tree, "")
+    return out
+
+
+class CallGraph:
+    """Whole-file-set reachability: which bare names provably lead to a
+    host collective."""
+
+    def __init__(self) -> None:
+        self._by_name: Dict[str, List[FunctionSummary]] = {}
+        self._reaching: Optional[Set[str]] = None
+
+    def add_summaries(self, summaries: List[FunctionSummary]) -> None:
+        for s in summaries:
+            self._by_name.setdefault(s.name, []).append(s)
+        self._reaching = None
+
+    def add_source(self, code: str, filename: str) -> List[FunctionSummary]:
+        summaries = summarize_source(code, filename)
+        self.add_summaries(summaries)
+        return summaries
+
+    def reaching_names(self) -> Set[str]:
+        """Bare names that (a) map to exactly ONE definition in the file
+        set and (b) transitively reach a host collective.  Ambiguous
+        names are excluded — a call through one can never be proven to
+        issue a collective, so pod_lint treats it as inert."""
+        if self._reaching is not None:
+            return self._reaching
+        unique = {name: defs[0] for name, defs in self._by_name.items()
+                  if len(defs) == 1}
+        reach: Set[str] = {name for name, s in unique.items() if s.direct}
+        changed = True
+        while changed:
+            changed = False
+            for name, s in unique.items():
+                if name in reach:
+                    continue
+                if any(c in reach for c in s.calls):
+                    reach.add(name)
+                    changed = True
+        self._reaching = reach
+        return reach
+
+    def describe(self, name: str) -> Optional[FunctionSummary]:
+        defs = self._by_name.get(name)
+        return defs[0] if defs and len(defs) == 1 else None

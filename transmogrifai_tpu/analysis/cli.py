@@ -14,7 +14,12 @@ Two kinds of targets, combinable in one invocation:
 Exit status is non-zero when any finding (error or warning) is reported —
 the CI contract ``scripts/tier1.sh`` relies on.  ``--json`` emits a
 machine-readable report (``schemaVersion`` gates its shape); ``--rules``
-prints the rule catalog.
+prints the rule catalog — with a selector (``--rules TM07x`` or
+``--rules TM070,TM041``) it instead restricts the run to the selected
+rules, where ``TM0Nx`` expands to the whole family.  ``--cache FILE``
+persists per-file results keyed on ``(path, mtime_ns, size)`` plus
+cross-file digests so unchanged files are never re-parsed; the JSON
+report's top-level ``cacheHits`` counts the reused files.
 
 ``--baseline FILE`` arms the ratchet CI uses: findings recorded in the
 committed baseline are tolerated (not reported, exit stays 0), NEW
@@ -48,17 +53,50 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dag", action="append", default=[], metavar="SPEC",
                    help="lint a pipeline DAG built by SPEC = "
                         "module:callable or file.py:callable (repeatable)")
-    p.add_argument("--suppress", default="", metavar="TM001,TM005",
-                   help="comma-separated rule ids to drop from the report")
+    p.add_argument("--suppress", default="", metavar="TM001,TM07x",
+                   help="comma-separated rule ids (or TM0Nx family "
+                        "prefixes) to drop from the report")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit a JSON report instead of text")
-    p.add_argument("--rules", action="store_true",
-                   help="print the rule catalog and exit")
+    p.add_argument("--rules", nargs="?", const="*", default=None,
+                   metavar="TM07x,TM041",
+                   help="bare: print the rule catalog and exit; with a "
+                        "comma-separated selector (ids or TM0Nx family "
+                        "prefixes): restrict the run to those rules, or "
+                        "print just that catalog slice when no targets "
+                        "are given")
+    p.add_argument("--cache", default=None, metavar="FILE",
+                   help="per-file lint result cache: unchanged files "
+                        "(same mtime_ns/size and cross-file digests) "
+                        "reuse their stored findings")
     p.add_argument("--baseline", default=None, metavar="FILE",
                    help="JSON findings baseline: baselined findings pass, "
                         "new ones fail, vanished ones shrink the file "
                         "(the CI ratchet)")
     return p
+
+
+def expand_rule_selectors(spec: str) -> set:
+    """Expand ``TM001,TM07x`` into concrete rule ids.
+
+    An ``x``-suffixed selector (``TM07x``) is a FAMILY prefix matching
+    every catalog rule that starts with its first four characters
+    (``TM070``–``TM079``); anything else passes through as an exact id.
+    """
+    out = set()
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if len(tok) == 5 and tok.lower().endswith("x"):
+            fam = tok[:4]
+            members = {r for r in RULES if r.startswith(fam)}
+            if not members:
+                raise SystemExit(f"unknown rule family {tok!r}")
+            out |= members
+        else:
+            out.add(tok)
+    return out
 
 
 def _baseline_key(d) -> str:
@@ -138,23 +176,36 @@ def _lint_dag_spec(spec: str, findings: Findings) -> None:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.rules:
-        for rule, (sev, title) in sorted(RULES.items()):
-            print(f"{rule} [{sev}] {title}")
-        return 0
+    selected = None
+    if args.rules is not None:
+        if args.rules != "*":
+            selected = expand_rule_selectors(args.rules)
+        if args.rules == "*" or (not args.paths and not args.dag):
+            for rule, (sev, title) in sorted(RULES.items()):
+                if selected is None or rule in selected:
+                    print(f"{rule} [{sev}] {title}")
+            return 0
     if not args.paths and not args.dag:
         build_parser().print_usage()
         return 2
 
+    cache = None
     findings = Findings()
     if args.paths:
         from . import lint_paths_all
 
-        findings.extend(lint_paths_all(args.paths))
+        if args.cache:
+            from .cache import LintResultCache
+
+            cache = LintResultCache(args.cache)
+        findings.extend(lint_paths_all(args.paths, cache=cache))
     for spec in args.dag:
         _lint_dag_spec(spec, findings)
 
-    suppress = {r.strip() for r in args.suppress.split(",") if r.strip()}
+    if selected is not None:
+        findings.diagnostics = [d for d in findings.diagnostics
+                                if d.rule in selected]
+    suppress = expand_rule_selectors(args.suppress)
     if suppress:
         findings.diagnostics = [d for d in findings.diagnostics
                                 if d.rule not in suppress]
@@ -162,7 +213,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         _apply_baseline(findings, args.baseline)
 
     if args.as_json:
-        print(json.dumps(findings.to_json(), indent=2))
+        report = findings.to_json()
+        report["cacheHits"] = cache.hits if cache is not None else 0
+        print(json.dumps(report, indent=2))
     else:
         print(findings.format())
     return 1 if findings else 0

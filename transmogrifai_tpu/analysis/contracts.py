@@ -32,10 +32,15 @@ into ``Findings`` so a full audit reports every violation at once.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import copy
+import hashlib
 import os
-from typing import Iterable, List, Optional, Sequence, Tuple
+import sys
+import threading
+from typing import (Any, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -47,7 +52,12 @@ __all__ = ["CHECK_ENV", "checks_enabled", "guarded_transform_output",
            "check_workflow_contracts",
            "check_pad_invariance", "check_mesh_parity",
            "check_checkpoint_roundtrip", "check_sharding_contracts",
-           "check_accum_tolerance"]
+           "check_accum_tolerance",
+           "COLLECTIVE_TIMEOUT_ENV", "collective_timeout",
+           "CollectiveLedger", "collective_ledger",
+           "reset_collective_ledger", "record_collective",
+           "verify_collective_headers", "diff_collective_ledgers",
+           "check_collective_consistency", "CollectiveWatchdog"]
 
 #: set to "1" to enable the instrumented mode (used by tests and the tier-1
 #: contract gate); any other value disables it with zero overhead beyond one
@@ -635,6 +645,276 @@ def check_accum_tolerance(X, y, *, tol: float = 1e-3, max_depth: int = 6,
             f"{m_bf16:.4f}); keep TMOG_MATRIX_PRECISION=f32 for this "
             f"workload")
     return findings
+
+
+# ---------------------------------------------------------------------------
+# Collective-ledger contracts (TM073/TM074) — the runtime half of the
+# TM07x collective-safety family (analysis/pod_lint.py is the static
+# half).  Under TMOG_CHECK=1 every host collective the pod issues
+# (distributed/runtime.py) appends ``(seq, kind, call site)`` to the
+# per-process ledger and carries that header inside its payload, so a
+# pod whose processes drift onto different collective sequences fails
+# with BOTH divergent sites named (TM074) instead of hanging; a
+# TMOG_COLLECTIVE_TIMEOUT watchdog turns the residual hang (a peer that
+# never arrives at all) into a ledger dump in the flight recorder
+# (TM073).
+# ---------------------------------------------------------------------------
+
+#: seconds a single host collective may block before the watchdog fires;
+#: unset/empty disables the watchdog
+COLLECTIVE_TIMEOUT_ENV = "TMOG_COLLECTIVE_TIMEOUT"
+
+
+def collective_timeout() -> Optional[float]:
+    raw = os.environ.get(COLLECTIVE_TIMEOUT_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        t = float(raw)
+    except ValueError:
+        return None
+    return t if t > 0 else None
+
+
+class CollectiveLedger:
+    """Per-process record of every host collective issued.
+
+    Keeps a RUNNING digest over the full ``(seq, kind, site)`` history
+    (so two processes with identical digests provably issued identical
+    sequences) plus a bounded tail for attribution; memory stays O(tail)
+    over arbitrarily long trains.
+    """
+
+    def __init__(self, tail: int = 64):
+        self.seq = 0
+        self.tail: collections.deque = collections.deque(maxlen=tail)
+        self._hash = hashlib.blake2s()
+        self._suspended = 0
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, site: str) -> Optional[Tuple[int, str, str]]:
+        with self._lock:
+            if self._suspended:
+                return None
+            self.seq += 1
+            entry = (self.seq, kind, site)
+            self._hash.update(f"{self.seq}|{kind}|{site}\n".encode())
+            self.tail.append(entry)
+            return entry
+
+    def digest(self) -> str:
+        with self._lock:
+            return self._hash.hexdigest()
+
+    @contextlib.contextmanager
+    def suspended(self):
+        """Recording off for the duration — the consistency check's own
+        exchange must not perturb the ledger it is auditing."""
+        with self._lock:
+            self._suspended += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._suspended -= 1
+
+    def snapshot(self, process: int = 0) -> Dict[str, Any]:
+        with self._lock:
+            return {"process": int(process), "seq": self.seq,
+                    "digest": self._hash.hexdigest(),
+                    "tail": [list(e) for e in self.tail]}
+
+
+_COLLECTIVE_LEDGER = CollectiveLedger()
+
+
+def collective_ledger() -> CollectiveLedger:
+    return _COLLECTIVE_LEDGER
+
+
+def reset_collective_ledger(tail: int = 64) -> CollectiveLedger:
+    """Fresh process-wide ledger (test seam)."""
+    global _COLLECTIVE_LEDGER
+    _COLLECTIVE_LEDGER = CollectiveLedger(tail=tail)
+    return _COLLECTIVE_LEDGER
+
+
+_LEDGER_INTERNAL = (os.path.join("analysis", "contracts.py"),
+                    os.path.join("distributed", "runtime.py"))
+
+
+def _call_site() -> str:
+    """First stack frame outside the collective plumbing — the line the
+    divergence report should point at."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(_LEDGER_INTERNAL):
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def record_collective(kind: str, name: str = ""
+                      ) -> Optional[Tuple[int, str, str]]:
+    """Ledger hook the pod collectives call.  Returns the new ``(seq,
+    kind, site)`` entry when the ledger is on (``TMOG_CHECK=1`` and not
+    suspended), else None — the runtime only header-wraps payloads when
+    an entry comes back."""
+    if not checks_enabled():
+        return None
+    label = f"{kind}({name})" if name else kind
+    return _COLLECTIVE_LEDGER.record(label, _call_site())
+
+
+def verify_collective_headers(headers: Sequence[Sequence]) -> None:
+    """TM074 — every process's in-band ``(seq, kind, site)`` header for
+    ONE paired exchange must agree on seq and kind; a mismatch means the
+    pod's collective sequences split, and both sites are named."""
+    base = tuple(headers[0])
+    for i, h in enumerate(headers):
+        h = tuple(h)
+        if (h[0], h[1]) != (base[0], base[1]):
+            raise ContractViolation(Diagnostic(
+                rule="TM074",
+                message=(
+                    f"collective-ledger divergence: process 0 is at "
+                    f"ledger seq {base[0]} issuing {base[1]} from "
+                    f"{base[2]}, but process {i} is at seq {h[0]} "
+                    f"issuing {h[1]} from {h[2]} — the pod's collective "
+                    f"sequences have split; lint the code between the "
+                    f"two sites (TM070/TM071)"),
+                location=str(base[2])))
+
+
+def _first_divergent(tail_a, tail_b):
+    da = {int(e[0]): (str(e[1]), str(e[2])) for e in tail_a}
+    db = {int(e[0]): (str(e[1]), str(e[2])) for e in tail_b}
+    for seq in sorted(set(da) & set(db)):
+        if da[seq] != db[seq]:
+            return seq, da[seq], db[seq]
+    only = sorted(set(da) ^ set(db))
+    if only:
+        seq = only[0]
+        return seq, da.get(seq), db.get(seq)
+    return None, None, None
+
+
+def _entry_str(e) -> str:
+    return f"{e[0]} at {e[1]}" if e is not None else "nothing (never issued)"
+
+
+def diff_collective_ledgers(snapshots: Sequence[Dict[str, Any]]
+                            ) -> Findings:
+    """Compare per-process ledger snapshots (``CollectiveLedger
+    .snapshot``); one TM074 finding per process that diverged from
+    process 0, naming the first divergent entry on BOTH sides."""
+    findings = Findings()
+    base = snapshots[0]
+    for s in snapshots[1:]:
+        if s["seq"] == base["seq"] and s["digest"] == base["digest"]:
+            continue
+        seq, a, b = _first_divergent(base["tail"], s["tail"])
+        where = (f"first divergence at ledger seq {seq}: process "
+                 f"{base['process']} issued {_entry_str(a)}, process "
+                 f"{s['process']} issued {_entry_str(b)}"
+                 if seq is not None else
+                 f"divergence precedes the retained ledger tails "
+                 f"(seq {base['seq']} vs {s['seq']})")
+        findings.add(
+            "TM074",
+            f"collective-ledger divergence between process "
+            f"{base['process']} (seq {base['seq']}, digest "
+            f"{base['digest'][:12]}) and process {s['process']} (seq "
+            f"{s['seq']}, digest {s['digest'][:12]}); {where}")
+    return findings
+
+
+def check_collective_consistency(pod, label: str = "") -> None:
+    """TM074 pass-boundary audit: exchange ledger digests across the pod
+    and raise :class:`ContractViolation` on any divergence, naming the
+    first divergent entry of both processes.  No-op unless
+    ``TMOG_CHECK=1`` and the pod is active.  The exchange itself runs
+    with recording suspended so the audit never perturbs the ledger it
+    audits."""
+    if not checks_enabled() or pod is None or \
+            not getattr(pod, "active", False):
+        return
+    led = _COLLECTIVE_LEDGER
+    with led.suspended():
+        snaps = pod.allgather_obj(led.snapshot(process=pod.process_index))
+    findings = diff_collective_ledgers(snaps)
+    if findings:
+        from ..obs.flight import record_event
+
+        record_event("collective.divergence", label=label,
+                     messages=[d.message for d in findings])
+        raise ContractViolation(findings.diagnostics[0])
+
+
+class CollectiveWatchdog:
+    """TM073 — armed around one blocking host collective.
+
+    If the collective has not returned within ``timeout`` seconds
+    (default: the ``TMOG_COLLECTIVE_TIMEOUT`` env; None disarms), the
+    per-process ledger tail is dumped into the flight recorder and
+    stderr and the process exits non-zero — a hung collective never
+    returns, so an exception from the timer thread could not unblock
+    it.  ``on_hang`` (called with the TM073 :class:`Diagnostic`)
+    replaces the exit for tests.
+    """
+
+    def __init__(self, kind: str, site: str,
+                 timeout: Optional[float] = None,
+                 ledger: Optional[CollectiveLedger] = None,
+                 on_hang=None):
+        self.kind = kind
+        self.site = site
+        self.timeout = collective_timeout() if timeout is None else timeout
+        self.ledger = ledger if ledger is not None else _COLLECTIVE_LEDGER
+        self.on_hang = on_hang
+        self._timer: Optional[threading.Timer] = None
+
+    def diagnostic(self) -> Diagnostic:
+        return Diagnostic(
+            rule="TM073",
+            message=(f"host collective {self.kind} did not complete "
+                     f"within {self.timeout}s — a peer process never "
+                     f"arrived (ledger seq {self.ledger.seq}; tail "
+                     f"dumped to the flight recorder)"),
+            location=str(self.site))
+
+    def __enter__(self) -> "CollectiveWatchdog":
+        if self.timeout is not None and self.timeout > 0:
+            self._timer = threading.Timer(self.timeout, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._timer is not None:
+            self._timer.cancel()
+        return False
+
+    def _fire(self) -> None:
+        from ..obs.flight import record_event, record_events
+
+        diag = self.diagnostic()
+        tail = list(self.ledger.tail)
+        record_event("collective.hang", collective=self.kind,
+                     site=self.site, seq=self.ledger.seq,
+                     timeoutS=self.timeout)
+        record_events("collective.ledger",
+                      [{"seq": s, "kind": k, "site": st}
+                       for s, k, st in tail])
+        if self.on_hang is not None:
+            self.on_hang(diag)
+            return
+        sys.stderr.write(diag.format() + "\n")
+        for s, k, st in tail:
+            sys.stderr.write(f"  ledger[{s}] {k} @ {st}\n")
+        sys.stderr.flush()
+        os._exit(74)
 
 
 def check_workflow_contracts(wf, data=None,

@@ -94,11 +94,30 @@ RULES: Dict[str, Any] = {
     "TM060": (ERROR, "event-time leakage: a predictor reads event data not "
                      "provably before the key's cutoff (no cutoff spec, or "
                      "a response event field consumed as a predictor)"),
+    # -- collective safety (analysis/pod_lint.py + contracts.py) --------
+    "TM070": (ERROR, "host collective reachable only under a process-"
+                     "divergent guard (is_coordinator / process_index / "
+                     "per-host counters): some pod processes skip it and "
+                     "the rest deadlock"),
+    "TM071": (ERROR, "collective-order mismatch: sibling branches or an "
+                     "early return/continue path of one pod-aware function "
+                     "issue host collectives in different sequences"),
+    "TM072": (ERROR, "non-deterministic fold of gathered partials: "
+                     "iterating a set / unsorted dict / os.listdir to "
+                     "combine allgathered state or build a durable artifact "
+                     "in pod-aware code (breaks the byte-identical-on-"
+                     "every-host contract)"),
+    "TM073": (ERROR, "collective watchdog timeout: a host collective did "
+                     "not complete within TMOG_COLLECTIVE_TIMEOUT seconds "
+                     "(ledger tail dumped to the flight recorder)"),
+    "TM074": (ERROR, "collective-ledger divergence: processes issued "
+                     "different collective sequences (kind/site mismatch "
+                     "at the same ledger seq)"),
 }
 
 #: version of the ``tmog lint --json`` report shape (bumped with any
 #: field addition/removal; consumers gate on it instead of sniffing keys)
-JSON_SCHEMA_VERSION = 2
+JSON_SCHEMA_VERSION = 3
 
 
 @dataclasses.dataclass

@@ -1,0 +1,319 @@
+"""Collective-safety lint (TM070–TM072) — the static half of the TM07x
+family.
+
+SPMD host collectives (``allgather_obj`` / ``broadcast_obj`` /
+``allsum`` / ``pod.barrier``) hang the whole pod when any process skips
+one or issues them out of order, with no error and no attribution.
+These rules reject the three source shapes that produce that hang (or
+the subtler cross-host artifact divergence) before the code ever runs;
+the runtime ledger in ``analysis/contracts.py`` (TM073/TM074) catches
+whatever slips through.
+
+* **TM070 — collective under a process-divergent guard.**  A collective
+  (or a call that provably reaches one through the package-local
+  :mod:`analysis.callgraph`) appears on exactly one side of a branch
+  whose test depends on per-process state — ``is_coordinator()``,
+  ``process_index`` comparisons, per-host counters (local row counts,
+  chunk cursors).  Coordinator processes enter the collective, the rest
+  never do: deadlock.  Pod-uniform guards (``pod.active``, config
+  flags) are NOT flagged — every process branches the same way.
+* **TM071 — collective-order mismatch.**  Sibling branches of one
+  ``if``/``else`` — or an early ``return``/``continue``/``break`` path
+  versus the fall-through rest of its suite — issue NON-EMPTY but
+  DIFFERENT collective sequences.  Whichever way the pod splits, the
+  transport pairs an allgather on one host with a barrier on another.
+* **TM072 — non-deterministic fold of gathered partials.**  A
+  pod-aware function iterates a ``set`` (display, comprehension,
+  ``set(...)`` call, or a local name bound to one) or ``os.listdir``
+  without ``sorted(...)``.  Per-host iteration order differs, so
+  combining allgathered state or writing a durable artifact from the
+  loop breaks the byte-identical-on-every-host contract (PR 18).
+
+"Pod-aware" here is the TM047 notion (takes a ``pod``/``pod_ctx``
+parameter or calls ``current_pod``) widened with "issues or reaches a
+collective".  Suppression: ``# tmog: disable=TM07x`` on the flagged
+line or the enclosing ``def`` line.  Entry points: :func:`lint_source`
+(single file — reachability sees only that file) and
+:func:`lint_paths` (whole-tree graph, the CI mode).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .astutil import SCOPE_NODES, Suppressions, dotted
+from .callgraph import CallGraph, collective_call_kind
+from .diagnostics import Findings
+from .trace_lint import iter_py_files
+
+__all__ = ["lint_source", "lint_paths"]
+
+_POD_PARAMS = {"pod", "pod_ctx", "pod_context"}
+#: substrings that mark a branch test as PROCESS-DIVERGENT (different
+#: processes can take different sides).  Deliberately excludes "pod" /
+#: "active": ``if pod.active`` is pod-uniform — every process agrees.
+_DIVERGENT_NEEDLES = ("is_coordinator", "process_index", "coordinator",
+                      "local_rows", "local_chunk", "chunks_done",
+                      "cursor", "rows_done")
+
+
+def _last(name: Optional[str]) -> Optional[str]:
+    return name.split(".")[-1] if name else None
+
+
+def _fmt_seq(seq: List[Tuple[str, int]]) -> str:
+    return "[" + ", ".join(k for k, _ in seq) + "]" if seq else "[]"
+
+
+class _PodLinter:
+    def __init__(self, code: str, filename: str, graph: CallGraph):
+        self.filename = filename
+        self.findings = Findings()
+        self.suppressions = Suppressions(code)
+        self.tree = ast.parse(code, filename=filename)
+        self.reaching = graph.reaching_names()
+
+    def run(self) -> Findings:
+        self._visit(self.tree)
+        return self.findings
+
+    def _visit(self, scope: ast.AST) -> None:
+        for n in ast.iter_child_nodes(scope):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_fn(n)
+                self._visit(n)
+            elif not isinstance(n, SCOPE_NODES):
+                self._visit(n)
+            elif isinstance(n, ast.ClassDef):
+                self._visit(n)
+
+    def _emit(self, rule: str, node: ast.AST, message: str,
+              def_line: Optional[int] = None) -> None:
+        if self.suppressions.suppressed(rule, node,
+                                        extra_lines=(def_line,)):
+            return
+        self.findings.add(rule, message,
+                          location=f"{self.filename}:{node.lineno}")
+
+    # -- collective-event extraction ----------------------------------
+
+    def _event_kind(self, call: ast.Call) -> Optional[str]:
+        kind = collective_call_kind(call)
+        if kind is not None:
+            return kind
+        leaf = _last(dotted(call.func))
+        if leaf and leaf in self.reaching:
+            return f"call:{leaf}"
+        return None
+
+    def _events(self, node: ast.AST) -> List[Tuple[str, int]]:
+        """Collective events in AST order, not descending into nested
+        scopes (a nested def is its own graph node)."""
+        out: List[Tuple[str, int]] = []
+        if isinstance(node, ast.Call):
+            kind = self._event_kind(node)
+            if kind is not None:
+                out.append((kind, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, SCOPE_NODES):
+                out.extend(self._events(child))
+        return out
+
+    def _suite_events(self, stmts: Iterable[ast.stmt]) \
+            -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        for st in stmts:
+            out.extend(self._events(st))
+        return out
+
+    # -- divergence classification ------------------------------------
+
+    @staticmethod
+    def _divergent_test(test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            name = None
+            if isinstance(sub, ast.Attribute):
+                name = sub.attr
+            elif isinstance(sub, ast.Name):
+                name = sub.id
+            if name and any(n in name.lower()
+                            for n in _DIVERGENT_NEEDLES):
+                return True
+        return False
+
+    # -- per-function checks ------------------------------------------
+
+    def _pod_aware(self, fn) -> bool:
+        a = fn.args
+        params = {p.arg for p in (getattr(a, "posonlyargs", []) + a.args
+                                  + getattr(a, "kwonlyargs", []))}
+        if params & _POD_PARAMS:
+            return True
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and \
+                    _last(dotted(n.func)) == "current_pod":
+                return True
+        return False
+
+    def _check_fn(self, fn) -> None:
+        events = self._suite_events(fn.body)
+        aware = self._pod_aware(fn) or bool(events)
+        if not aware:
+            return
+        if events:
+            self._check_suite(fn, fn.body)
+        self._check_nondet_folds(fn)
+
+    def _check_suite(self, fn, stmts: List[ast.stmt]) -> None:
+        """Branch discipline over one statement suite, recursing into
+        every nested suite (if/for/while/with/try bodies)."""
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.If):
+                self._check_if(fn, st, rest=stmts[i + 1:])
+                self._check_suite(fn, st.body)
+                self._check_suite(fn, st.orelse)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                self._check_suite(fn, st.body)
+                self._check_suite(fn, st.orelse)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                self._check_suite(fn, st.body)
+            elif isinstance(st, ast.Try):
+                self._check_suite(fn, st.body)
+                for h in st.handlers:
+                    self._check_suite(fn, h.body)
+                self._check_suite(fn, st.orelse)
+                self._check_suite(fn, st.finalbody)
+
+    @staticmethod
+    def _exits(stmts: List[ast.stmt]) -> bool:
+        return any(isinstance(s, (ast.Return, ast.Continue, ast.Break))
+                   for s in stmts)
+
+    def _check_if(self, fn, node: ast.If,
+                  rest: List[ast.stmt]) -> None:
+        body_seq = self._suite_events(node.body)
+        orelse_seq = self._suite_events(node.orelse)
+        divergent = self._divergent_test(node.test)
+
+        if node.orelse or not self._exits(node.body):
+            # sibling-branch comparison (an explicit else, or a
+            # fall-through if whose body rejoins the suite)
+            other = orelse_seq
+            label = "the else branch"
+        else:
+            # early-exit path: the body leaves the suite, so its
+            # collective sequence must match what the fall-through
+            # rest of the suite issues
+            other = self._suite_events(rest)
+            label = "the fall-through path"
+
+        if body_seq == other:
+            return
+        if divergent and (not body_seq or not other):
+            only = body_seq or other
+            self._emit(
+                "TM070", node,
+                f"collective sequence {_fmt_seq(only)} is reachable "
+                f"only under a process-divergent guard "
+                f"(line {node.lineno}): processes that skip the branch "
+                f"never enter the collective and the rest deadlock — "
+                f"hoist the collective out of the guard",
+                fn.lineno)
+        elif body_seq and other:
+            self._emit(
+                "TM071", node,
+                f"collective-order mismatch: this branch issues "
+                f"{_fmt_seq(body_seq)} but {label} issues "
+                f"{_fmt_seq(other)} — if any per-process state decides "
+                f"the branch, hosts pair mismatched collectives; make "
+                f"both paths issue the same sequence",
+                fn.lineno)
+        elif divergent:
+            # both empty can't reach here; guard kept for clarity
+            pass
+
+    # -- TM072 --------------------------------------------------------
+
+    def _nondet_iter(self, fn, it: ast.AST,
+                     depth: int = 0) -> Optional[str]:
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            return "a set"
+        if isinstance(it, ast.Call):
+            leaf = _last(dotted(it.func))
+            if leaf == "set":
+                return "set(...)"
+            if leaf == "listdir":
+                return "os.listdir(...)"
+            return None
+        if isinstance(it, ast.Name) and depth == 0:
+            src = None
+            for st in ast.walk(fn):
+                if isinstance(st, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == it.id
+                        for t in st.targets):
+                    src = st.value
+            if src is not None:
+                return self._nondet_iter(fn, src, depth=1)
+        return None
+
+    def _check_nondet_folds(self, fn) -> None:
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, SCOPE_NODES):
+                continue
+            iters = []
+            if isinstance(n, (ast.For, ast.AsyncFor)):
+                iters.append(n.iter)
+            elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                iters.extend(g.iter for g in n.generators)
+            for it in iters:
+                what = self._nondet_iter(fn, it)
+                if what is not None:
+                    self._emit(
+                        "TM072", n,
+                        f"pod-aware code iterates {what}: per-host "
+                        f"iteration order differs, so folding gathered "
+                        f"partials or writing a durable artifact from "
+                        f"this loop diverges across hosts — wrap the "
+                        f"iterable in sorted(...)",
+                        fn.lineno)
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def lint_source(code: str, filename: str = "<string>",
+                graph: Optional[CallGraph] = None) -> Findings:
+    """Collective-safety lint one source string.  Without ``graph``,
+    reachability sees only this file; :func:`lint_paths` supplies the
+    whole-tree graph."""
+    try:
+        if graph is None:
+            graph = CallGraph()
+            graph.add_source(code, filename)
+        return _PodLinter(code, filename, graph).run()
+    except SyntaxError as e:
+        f = Findings()
+        f.add("TM070", f"could not parse: {e}", severity="warning",
+              location=f"{filename}:{e.lineno or 0}")
+        return f
+
+
+def lint_paths(paths: Iterable[str]) -> Findings:
+    """Collective-safety lint files / directory trees with a shared
+    call graph, so cross-file reachability (a helper in one module
+    calling ``pod.barrier`` in another) is seen."""
+    findings = Findings()
+    graph = CallGraph()
+    sources = []
+    for full in iter_py_files(paths):
+        with open(full, encoding="utf-8") as fh:
+            code = fh.read()
+        try:
+            graph.add_source(code, full)
+        except SyntaxError:
+            pass   # lint_source reports the parse failure below
+        sources.append((full, code))
+    for full, code in sources:
+        findings.extend(lint_source(code, full, graph=graph))
+    return findings

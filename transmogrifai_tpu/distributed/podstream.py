@@ -304,6 +304,11 @@ class PodStreamContext:
             manager.complete_pass(pass_index, label, self.total_rows,
                                   models, state_payloads=state_payloads)
         self.pod.barrier(f"ckpt.pass{pass_index}")
+        # pass boundary: audit the whole pod's collective ledgers
+        # (TM074) while every process is provably at the same point
+        from ..analysis.contracts import check_collective_consistency
+
+        check_collective_consistency(self.pod, label=f"pass{pass_index}")
 
     # -- CV label sync -------------------------------------------------------
 
@@ -389,7 +394,10 @@ class PodStreamContext:
         """Gather every process's buffered quarantine entries; the
         coordinator appends them to the ONE sidecar (dedupe on
         (source, location) as always) — non-coordinators never open it."""
-        if sink is None:
+        # sink presence is pod-uniform config (the launcher hands every
+        # process the same sidecar setting), so the two sequences below
+        # can never split a live pod
+        if sink is None:  # tmog: disable=TM071
             self.pod.barrier("quarantine.none")
             return
         pending = sink.drain_pending()
@@ -398,6 +406,9 @@ class PodStreamContext:
             for part in gathered[1:]:  # coordinator's own already landed
                 sink.absorb(part)
         self.pod.barrier("quarantine.flush")
+        from ..analysis.contracts import check_collective_consistency
+
+        check_collective_consistency(self.pod, label="quarantine.flush")
 
     def to_json(self) -> Dict[str, Any]:
         return {

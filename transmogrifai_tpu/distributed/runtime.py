@@ -125,23 +125,16 @@ class PodContext:
                 "processIndex": self.process_index}
 
     # -- host-level collectives ---------------------------------------------
+    #
+    # Under TMOG_CHECK=1 every collective records (seq, kind, site) into
+    # the per-process CollectiveLedger (analysis/contracts.py) and
+    # carries that header INSIDE its payload, so two processes whose
+    # collective sequences split fail with both sites named (TM074)
+    # instead of hanging the transport; TMOG_COLLECTIVE_TIMEOUT arms a
+    # watchdog around every blocking exchange (TM073).
 
-    def barrier(self, name: str) -> None:
-        """All processes rendezvous; returns once every peer arrived."""
-        if not self.active:
-            return
-        from jax.experimental import multihost_utils
-
-        self._step += 1
-        multihost_utils.sync_global_devices(f"tmog.{name}.{self._step}")
-
-    def allgather_obj(self, obj: Any) -> List[Any]:
-        """Every process contributes one picklable object; every process
-        receives the full list ORDERED BY PROCESS INDEX — the merge-order
-        anchor of the streaming-fit exchange (states merge host 0 first,
-        matching a single process's sequential chunk order)."""
-        if not self.active:
-            return [obj]
+    def _exchange(self, obj: Any) -> List[Any]:
+        """The raw padded-pickle allgather every host collective rides."""
         from jax.experimental import multihost_utils
 
         raw = np.frombuffer(pickle.dumps(obj), np.uint8)
@@ -162,6 +155,76 @@ class PodContext:
         return [pickle.loads(rows[i, :int(lens[i])].tobytes())
                 for i in range(self.process_count)]
 
+    def _ledger_exchange(self, entry, obj: Any) -> List[Any]:
+        """Header-verified exchange: every payload carries its ledger
+        entry; a peer at a different seq/kind is named (TM074)."""
+        from ..analysis.contracts import (CollectiveWatchdog,
+                                          verify_collective_headers)
+        from ..analysis.diagnostics import ContractViolation, Diagnostic
+
+        with CollectiveWatchdog(entry[1], entry[2]):
+            rows = self._exchange({"h": entry, "o": obj})
+        headers = []
+        for i, r in enumerate(rows):
+            if not (isinstance(r, dict) and "h" in r and "o" in r):
+                raise ContractViolation(Diagnostic(
+                    rule="TM074",
+                    message=(f"collective-ledger divergence: this "
+                             f"process paired {entry[1]} (ledger seq "
+                             f"{entry[0]}, {entry[2]}) with an unledgered "
+                             f"payload from process {i} — the peer is "
+                             f"executing a different exchange"),
+                    location=str(entry[2])))
+            headers.append(tuple(r["h"]))
+        verify_collective_headers(headers)
+        return [r["o"] for r in rows]
+
+    def barrier(self, name: str) -> None:
+        """All processes rendezvous; returns once every peer arrived."""
+        if not self.active:
+            return
+        from ..analysis.contracts import record_collective
+        from ..utils.faults import FaultSkip, fire
+
+        try:
+            fire("pod.barrier", tag=name)
+        except FaultSkip:
+            return
+        self._step += 1
+        entry = record_collective("barrier", name)
+        # TMOG_CHECK is pod-uniform (launch_local_pod inherits the env),
+        # so every process takes the same transport branch
+        if entry is not None:  # tmog: disable=TM071
+            # ledger mode: the rendezvous doubles as a header check, so
+            # a peer arriving with a DIFFERENT collective is attributed
+            self._ledger_exchange(entry, None)
+            return
+        from jax.experimental import multihost_utils
+
+        from ..analysis.contracts import CollectiveWatchdog
+
+        label = f"tmog.{name}.{self._step}"
+        with CollectiveWatchdog(f"barrier({name})", label):
+            multihost_utils.sync_global_devices(label)
+
+    def allgather_obj(self, obj: Any,
+                      _kind: str = "allgather_obj") -> List[Any]:
+        """Every process contributes one picklable object; every process
+        receives the full list ORDERED BY PROCESS INDEX — the merge-order
+        anchor of the streaming-fit exchange (states merge host 0 first,
+        matching a single process's sequential chunk order)."""
+        if not self.active:
+            return [obj]
+        from ..analysis.contracts import (CollectiveWatchdog,
+                                          record_collective)
+
+        entry = record_collective(_kind)
+        # same pod-uniform TMOG_CHECK dispatch as barrier above
+        if entry is not None:  # tmog: disable=TM071
+            return self._ledger_exchange(entry, obj)
+        with CollectiveWatchdog(_kind, "<ledger off>"):
+            return self._exchange(obj)
+
     def broadcast_obj(self, obj: Any) -> Any:
         """Coordinator's object lands on every process (others pass any
         placeholder, conventionally None)."""
@@ -169,13 +232,13 @@ class PodContext:
             return obj
         # one exchange both directions keeps the protocol lockstep-simple;
         # pod payloads here are small (decisions, counters, cursors)
-        return self.allgather_obj(obj)[0]
+        return self.allgather_obj(obj, _kind="broadcast_obj")[0]
 
     def allsum(self, arr: np.ndarray) -> np.ndarray:
         """Elementwise sum of a host float array across processes."""
         if not self.active:
             return np.asarray(arr)
-        parts = self.allgather_obj(np.asarray(arr))
+        parts = self.allgather_obj(np.asarray(arr), _kind="allsum")
         out = parts[0].astype(np.float64, copy=True)
         for p in parts[1:]:
             out += p

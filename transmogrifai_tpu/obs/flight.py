@@ -33,8 +33,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "install_recorder", "current_recorder",
-           "record_event", "arm_crash_dump", "disarm_crash_dump",
-           "merge_flight_dumps"]
+           "record_event", "record_events", "arm_crash_dump",
+           "disarm_crash_dump", "merge_flight_dumps"]
 
 
 class FlightRecorder:
@@ -152,6 +152,16 @@ def record_event(kind: str, **attrs) -> None:
     rec = _RECORDER
     if rec is not None:
         rec.record(kind, attrs)
+
+
+def record_events(kind: str, batch) -> None:
+    """Record a batch of events of one ``kind`` (each item an attrs
+    dict) — the collective watchdog dumps its ledger tail through this
+    so one hang costs one enable check, not one per entry."""
+    rec = _RECORDER
+    if rec is not None:
+        for attrs in batch:
+            rec.record(kind, dict(attrs))
 
 
 # ---------------------------------------------------------------------------

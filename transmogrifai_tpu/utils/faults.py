@@ -68,13 +68,21 @@ no plan is armed):
   ``join.chunk``         before each streamed sort-merge join chunk
                          (readers/events.stream_join); ``index`` is the
                          joined chunk ordinal
+  ``pod.barrier``        at the top of every pod barrier
+                         (distributed/runtime.PodContext.barrier);
+                         ``tag`` is the barrier name — a ``skip`` here
+                         (with a ``process`` selector) makes ONE host
+                         silently skip the rendezvous, the canonical
+                         collective-divergence (TM074) test
 
 Actions: ``io_error`` (raise OSError — the transient class the reader
 retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``
 (sleep ``delay_s``), ``kill`` (SIGKILL this process; subprocess tests
 only), ``device_loss`` (raise :class:`DeviceLossError`, whose message is
 shaped like the XLA backend-loss family so the shared classifier
-``parallel.elastic.is_device_loss`` recognizes it).
+``parallel.elastic.is_device_loss`` recognizes it), ``skip`` (raise
+:class:`FaultSkip`, which the injection SITE catches to skip the guarded
+operation entirely — only sites documented as skippable catch it).
 
 Determinism: a spec matches by explicit call index (``at``/``every``) or by
 a seeded per-point Bernoulli draw (``p`` + plan ``seed``) — same plan, same
@@ -100,12 +108,12 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 __all__ = ["FaultSpec", "FaultPlan", "FaultError", "DeviceLossError",
-           "install_faults", "clear_faults", "current_plan", "inject",
-           "fire", "ENV_VAR"]
+           "FaultSkip", "install_faults", "clear_faults", "current_plan",
+           "inject", "fire", "ENV_VAR"]
 
 ENV_VAR = "TMOG_FAULTS"
 
-_ACTIONS = ("io_error", "raise", "slow", "kill", "device_loss")
+_ACTIONS = ("io_error", "raise", "slow", "kill", "device_loss", "skip")
 
 
 class FaultError(RuntimeError):
@@ -118,6 +126,13 @@ class DeviceLossError(RuntimeError):
     chip/backend dying mid-program.  The message carries the XLA
     backend-loss needles so ``parallel.elastic.is_device_loss`` classifies
     it exactly like the real thing."""
+
+
+class FaultSkip(Exception):
+    """Raised by the ``skip`` action; the injection SITE catches it and
+    skips the guarded operation (e.g. one pod process silently skipping
+    a barrier).  Deliberately not a RuntimeError so generic handlers
+    never swallow it by accident."""
 
 
 @dataclass
@@ -280,6 +295,8 @@ class FaultPlan:
             raise DeviceLossError(
                 f"injected device loss: UNAVAILABLE: TPU backend "
                 f"setup/compile error ({where})")
+        elif hit.action == "skip":
+            raise FaultSkip(f"{hit.message} ({where})")
         elif hit.action == "kill":  # pragma: no cover - dies before report
             os.kill(os.getpid(), signal.SIGKILL)
 

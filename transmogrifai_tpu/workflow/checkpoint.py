@@ -483,7 +483,7 @@ class StreamingCheckpointManager:
         if self.pod_record is not None:
             doc["pod"] = self.pod_record
         npz_name = f"state-{self._seq}.npz"
-        old = [n for n in os.listdir(self.directory)
+        old = [n for n in sorted(os.listdir(self.directory))
                if n.startswith("state-") and n.endswith(".npz")]
         if store.arrays:
             np.savez_compressed(os.path.join(self.directory, npz_name),
@@ -568,7 +568,7 @@ class StreamingCheckpointManager:
                 os.unlink(os.path.join(self.directory, n))
             except OSError:
                 pass
-        for n in os.listdir(self.directory):
+        for n in sorted(os.listdir(self.directory)):
             if n.startswith("state-") and n.endswith(".npz"):
                 try:
                     os.unlink(os.path.join(self.directory, n))

@@ -621,7 +621,10 @@ def fit_dag_streaming(
                             # chunks are still read, so a mid-pass resume
                             # collects them too)
                             cv_ctx.collect_labels(chunk)
-                        if local_idx < src_skip:
+                        # the saver callback rendezvouses per CHUNK
+                        # INDEX, which both the skip and the process
+                        # path advance identically on every host
+                        if local_idx < src_skip:  # tmog: disable=TM071
                             rows += len(chunk)
                             local_row += len(chunk)
                             pass_stats.chunks_skipped += 1
@@ -923,7 +926,9 @@ def fit_dag_streaming(
         finally:
             store.close()
 
-    if not est_idxs:
+    # est_idxs is derived from the pipeline STRUCTURE, identical on
+    # every pod process — both arms run the same collective schedule
+    if not est_idxs:  # tmog: disable=TM071
         # no estimators in the prefix: a single materialize pass
         materialize_only_pass()
     else:
@@ -1006,7 +1011,9 @@ def fit_dag_streaming(
             ordered = [s for lj in range(li) for s in prefix[lj]
                        if s.uid in pass_uids]
             ensure_cv_folds(ests)
-            if pod_ctx is not None:
+            # pod_ctx is non-None iff pod.active — uniform across the
+            # pod, so every process picks the same fit-pass flavour
+            if pod_ctx is not None:  # tmog: disable=TM071
                 # -- pod fit pass: per-entry partial states, barrier-
                 #    fenced mid-pass saves, allgather merge at the end --
                 use_resume = (pod_ctx.resume_pass == pass_idx)
@@ -1082,7 +1089,9 @@ def fit_dag_streaming(
                         if hasattr(est, "export_full_state")})
                 _note_checkpoint(t0)
 
-        if fuse_at is None:
+        # fuse_at depends only on the pipeline layout + CV config, both
+        # identical on every pod process
+        if fuse_at is None:  # tmog: disable=TM071
             # every estimator layer ran as a checkpointable plain pass
             # (the deferred-fuse CV+checkpoint path, and every pod
             # train): one final materialize pass over the fully fitted

