@@ -27,13 +27,34 @@ batcher -> shape-bucketed executor):
 4. **Multi-tenant** — two tenants at 3:1 weights flooding a saturated
    dispatcher; the dispatched-row share must track the weights.
 
+``--fabric`` runs the POD leg instead (the tier1 FABRIC_SMOKE gate): a
+2-process serving fleet — each host a fresh ``--fabric-host`` subprocess
+(ModelServer + HTTP front end + SIGTERM drain) over ONE shared AOTStore
+directory — routed by ``serving/fabric.py``:
+
+5. **Fabric pod** — (a) the second host and every restarted host must
+   cold-start from the shared AOT store LOADING, never compiling, with
+   byte-identical scores; (b) 2-host aggregate QPS >= 1.7x single host
+   (per-host capacity is bounded by a simulated device service time —
+   on a 1-core CI box the model execution itself cannot scale across
+   processes, the ROUTER plane is what's under test); (c) SIGKILL one
+   host mid-load -> ZERO failed requests (single-retry failover), the
+   dead host is evicted by failed probes, a restart readmits it after
+   the hysteresis probes — run TWICE at one seed, the routing decision
+   traces must be byte-identical; (d) rolling swap across the fleet
+   under load keeps p99 under the open-loop bound with zero sheds;
+   (e) graceful drain (drain -> reroute -> SIGTERM exit 0 -> deregister)
+   sheds nothing.
+
 Emits a BENCH-style JSON record (last stdout line) and writes the same
-summary to ``benchmarks/serving_latest.json`` (or argv[1]).  ``--smoke``
-runs reduced request counts for the tier1 SERVING_COLDSTART gate; any
-gate failure exits non-zero.
+summary to ``benchmarks/serving_latest.json`` (or argv[1]; the fabric
+leg writes ``benchmarks/fabric_latest.json``).  ``--smoke`` runs reduced
+request counts for the tier1 gates; any gate failure exits non-zero.
 """
 import json
 import os
+import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -46,11 +67,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SMOKE = "--smoke" in sys.argv
+FABRIC = "--fabric" in sys.argv
 N_REQUESTS = 96 if SMOKE else 192   # per closed-loop level (1/8-way)
 OPEN_LOOP_QPS = 300
 OPEN_LOOP_SECS = 2.0 if SMOKE else 4.0
 P99_GATE_MS = 250.0                 # open-loop tail bound (1-core CPU CI)
 COLDSTART_GATE = 5.0                # AOT cold start >= 5x faster than JIT
+QPS_SCALE_GATE = 1.7                # 2-host aggregate vs single host
+FABRIC_SERVICE_MS = 40.0            # simulated device service time/batch
 
 
 def train_and_save(path: str) -> None:
@@ -381,6 +405,417 @@ def tenancy_leg(model_path: str, rows) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# leg 5: fabric pod (2 host subprocesses, shared AOT store, health routing)
+# ---------------------------------------------------------------------------
+
+def _fabric_host_child(model_path: str, aot_dir: str, port: int,
+                       service_ms: float) -> None:
+    """One fleet host, run in a FRESH process: ModelServer with device
+    programs against the SHARED AOT store + the HTTP front end + SIGTERM
+    drain.  ``service_ms`` injects a fixed per-batch device service time
+    (the tenancy leg's slowed-executor idiom): per-host capacity becomes
+    host-bound instead of CPU-bound, so on a 1-core CI box two hosts can
+    genuinely scale and the ROUTER plane is what the QPS gate measures."""
+    from transmogrifai_tpu.serving import ModelServer
+    from transmogrifai_tpu.serving.http import (install_sigterm_drain,
+                                                make_http_server)
+
+    rows = make_rows(4)
+    server = ModelServer.from_path(
+        model_path, name="fabric", max_batch=32, max_queue_rows=8192,
+        warmup_row=dict(rows[0]), device_programs=True, aot_store=aot_dir)
+    if service_ms > 0:
+        orig = server.batcher.execute
+
+        def execute_with_service(batch_rows, _orig=orig):
+            time.sleep(service_ms / 1000.0)
+            return _orig(batch_rows)
+
+        server.batcher.execute = execute_with_service
+    server.start()
+    httpd = make_http_server(server, port=port, request_timeout_s=10.0)
+    install_sigterm_drain(server, httpd)
+    print("READY", flush=True)
+    try:
+        httpd.serve_forever()   # returns after SIGTERM drain shutdown
+    finally:
+        httpd.server_close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_fabric_host(model_path: str, aot_dir: str, port: int,
+                        tag: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TMOG_COST_HISTORY"] = ""
+    env.pop("TMOG_FAULTS", None)
+    # fresh XLA persistent cache per launch: the shared AOT store must
+    # carry the cold start on its own (same discipline as leg 1)
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(aot_dir, f"xla_{tag}")
+    log = open(os.path.join(aot_dir, f"host_{tag}.log"), "w")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--fabric-host",
+         model_path, aot_dir, str(port), str(FABRIC_SERVICE_MS)],
+        env=env, stdout=log, stderr=log)
+
+
+def _wait_ready(handle, proc, timeout_s: float = 240.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"fabric host {handle.host_id} exited rc={proc.returncode} "
+                f"before becoming ready")
+        try:
+            if handle.healthz(timeout_s=1.0).get("status") == "ok":
+                return
+        except Exception:
+            pass
+        time.sleep(0.1)
+    raise RuntimeError(f"fabric host {handle.host_id} never became ready")
+
+
+def _split_tenants(host_ids, per_host: int):
+    """Tenant names whose consistent-hash primary spreads ``per_host``
+    ways onto each host — the dual-host leg needs both hosts loaded."""
+    from transmogrifai_tpu.serving import HashRing
+
+    ring = HashRing(host_ids)
+    buckets = {h: [] for h in host_ids}
+    i = 0
+    while any(len(v) < per_host for v in buckets.values()):
+        t = f"qps-t{i}"
+        h = ring.primary(t)
+        if len(buckets[h]) < per_host:
+            buckets[h].append(t)
+        i += 1
+    return [t for v in buckets.values() for t in v]
+
+
+def _drive_qps(fab, rows, tenants, secs: float,
+               rows_per_request: int = 16) -> dict:
+    from transmogrifai_tpu.serving import ShedResult
+
+    stop_at = time.perf_counter() + secs
+    totals = {"rows": 0, "failures": 0}
+    lock = threading.Lock()
+
+    def worker(tenant, wid):
+        good = bad = 0
+        i = wid
+        while time.perf_counter() < stop_at:
+            base = (i * rows_per_request) % max(
+                1, len(rows) - rows_per_request)
+            out = fab.score(rows[base:base + rows_per_request],
+                            tenant=tenant, timeout_ms=8000.0)
+            sheds = sum(1 for r in out if isinstance(r, ShedResult))
+            good += len(out) - sheds
+            bad += sheds
+            i += 1
+        with lock:
+            totals["rows"] += good
+            totals["failures"] += bad
+
+    threads = [threading.Thread(target=worker, args=(t, i), daemon=True)
+               for i, t in enumerate(tenants)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {"rows": totals["rows"], "failures": totals["failures"],
+            "wall_s": round(wall, 3),
+            "rows_per_s": round(totals["rows"] / wall, 1)}
+
+
+def _fabric_kill_round(handles, procs, ports, model_path, aot_dir, rows,
+                       seed: int, tag: str) -> dict:
+    """One SIGKILL/evict/restart/readmit round over a FRESH router at
+    ``seed``.  Sequential deterministic driver: the returned trace
+    (decisions + probe verdicts + lifecycle events) must be byte-
+    identical across rounds at one seed."""
+    from transmogrifai_tpu.serving import ServingFabric, ShedResult
+
+    fab = ServingFabric(handles.values(), seed=seed, record_decisions=True,
+                        probe_fail_threshold=2, readmit_probes=2,
+                        evict_after_s=600.0, retry_base_s=0.0)
+    trace = {"probes": [], "events": []}
+    failures = 0
+
+    def drive(n, phase):
+        nonlocal failures
+        for i in range(n):
+            out = fab.score(rows[:4], tenant=f"kt{i % 8}",
+                            timeout_ms=8000.0)
+            failures += sum(1 for r in out if isinstance(r, ShedResult))
+        trace["events"].append(f"{phase}:driven={n}")
+
+    victim = "hA"
+    drive(16, "steady")
+    procs[victim].kill()            # SIGKILL: no drain, no goodbye
+    procs[victim].wait(timeout=30)
+    trace["events"].append(f"sigkill:{victim}")
+    drive(16, "failover")           # retried to the survivor, zero loss
+    trace["probes"].append(fab.probe_once())
+    trace["probes"].append(fab.probe_once())
+    evicted = fab.host_state(victim).evicted
+    trace["events"].append(f"evicted:{evicted}")
+    procs[victim] = _launch_fabric_host(model_path, aot_dir,
+                                        ports[victim], f"{victim}-{tag}")
+    _wait_ready(handles[victim], procs[victim])
+    trace["probes"].append(fab.probe_once())   # hysteresis: 1 of 2
+    trace["probes"].append(fab.probe_once())   # readmitted here
+    readmitted = not fab.host_state(victim).evicted
+    trace["events"].append(f"readmitted:{readmitted}")
+    drive(16, "recovered")
+    trace["decisions"] = fab.decisions
+    snap = fab.metrics.snapshot()
+    return {"failures": failures, "evicted": evicted,
+            "readmitted": readmitted,
+            "retried_requests": snap["retriedRequests"],
+            "trace": json.dumps(trace, sort_keys=True)}
+
+
+def _fabric_rolling_swap(handles, rows, model_path) -> dict:
+    """Swap every host in turn (same artifact -> shared-AOT warm swap)
+    under light routed load; the fleet's p99 stays under the open-loop
+    bound and nothing sheds."""
+    from transmogrifai_tpu.serving import ServingFabric, ShedResult
+
+    fab = ServingFabric(handles.values(), seed=3, retry_base_s=0.0)
+    stop = threading.Event()
+    shed_reasons = []
+    lock = threading.Lock()
+
+    def load(wid):
+        i = 0
+        while not stop.is_set():
+            out = fab.score(rows[(wid * 31 + i * 4) % 200:][:4],
+                            tenant=f"swap-t{(wid + i) % 8}",
+                            timeout_ms=4000.0)
+            with lock:
+                shed_reasons.extend(r.reason for r in out
+                                    if isinstance(r, ShedResult))
+            i += 1
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=load, args=(w,), daemon=True)
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    swapped = []
+    for host_id in sorted(handles):
+        doc = handles[host_id].swap(model_path)
+        swapped.append({"host": host_id,
+                        "version": doc["swapped"]["version"]})
+        time.sleep(0.5)             # let the fleet settle between hosts
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join()
+    snap = fab.metrics.snapshot()
+    return {"swapped": swapped, "sheds": len(shed_reasons),
+            "requests": snap["requests"],
+            "p50_ms": snap["latencyMs"]["p50"],
+            "p99_ms": snap["latencyMs"]["p99"],
+            "p99_gate_ms": P99_GATE_MS}
+
+
+def _fabric_drain_leg(handles, procs, rows) -> dict:
+    """The graceful half of the drain-vs-kill matrix: drain -> healthz
+    flips -> router reroutes (zero sheds) -> SIGTERM -> clean exit ->
+    deregister."""
+    from transmogrifai_tpu.serving import ServingFabric, ShedResult
+
+    fab = ServingFabric(handles.values(), seed=5, record_decisions=True,
+                        retry_base_s=0.0)
+    victim = "hB"
+    handles[victim].drain()
+    status = handles[victim].healthz().get("status")
+    fab.probe_once()
+    draining_seen = fab.host_state(victim).draining
+    sheds = 0
+    for i in range(8):
+        out = fab.score(rows[:4], tenant=f"dt{i}", timeout_ms=8000.0)
+        sheds += sum(1 for r in out if isinstance(r, ShedResult))
+    served_by = {d["served"] for d in fab.decisions}
+    procs[victim].send_signal(signal.SIGTERM)
+    rc = procs[victim].wait(timeout=60)
+    fab.remove_host(victim)
+    for i in range(4):
+        out = fab.score(rows[:4], tenant=f"dt{i}", timeout_ms=8000.0)
+        sheds += sum(1 for r in out if isinstance(r, ShedResult))
+    return {"healthz_status": status, "draining_seen": draining_seen,
+            "sheds": sheds, "exit_code": rc,
+            "served_while_draining": sorted(served_by),
+            "hosts_after": fab.hosts()}
+
+
+def fabric_run(out_path: str) -> dict:
+    from transmogrifai_tpu.serving import HttpHostHandle, ServingFabric
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_path = os.path.join(tmp, "model")
+        t0 = time.perf_counter()
+        train_and_save(model_path)
+        train_s = time.perf_counter() - t0
+        rows = make_rows(256)
+        aot_dir = os.path.join(tmp, "fleet_aot")
+        os.makedirs(aot_dir, exist_ok=True)
+        ports = {"hA": _free_port(), "hB": _free_port()}
+        handles = {h: HttpHostHandle(h, f"127.0.0.1:{ports[h]}",
+                                     connect_timeout_s=10.0)
+                   for h in ports}
+        procs = {}
+        try:
+            # hA populates the shared store (compiles); hB must LOAD
+            procs["hA"] = _launch_fabric_host(model_path, aot_dir,
+                                              ports["hA"], "hA")
+            _wait_ready(handles["hA"], procs["hA"])
+            t1 = time.perf_counter()
+            procs["hB"] = _launch_fabric_host(model_path, aot_dir,
+                                              ports["hB"], "hB")
+            _wait_ready(handles["hB"], procs["hB"])
+            b_ready_s = time.perf_counter() - t1
+            _, snap_b = handles["hB"]._request("GET", "/metrics")
+            b_modes = sorted(set((snap_b.get("aotPrograms") or {})
+                                 .values()))
+            reference = json.dumps(handles["hA"].forward(rows[:8]),
+                                   sort_keys=True)
+            b_parity = json.dumps(handles["hB"].forward(rows[:8]),
+                                  sort_keys=True) == reference
+
+            # QPS scaling: same driver shape against one host, then two
+            tenants = _split_tenants(sorted(handles), per_host=8)
+            secs = 1.5 if SMOKE else 3.0
+            # throughput legs measure capacity, not failover: a transient
+            # connect hiccup under 16-way churn must retry, never evict
+            single = ServingFabric([handles["hB"]], seed=1,
+                                   probe_fail_threshold=1000)
+            _drive_qps(single, rows, tenants, 0.5)          # ramp
+            qps1 = _drive_qps(single, rows, tenants, secs)
+            dual = ServingFabric(handles.values(), seed=1,
+                                 probe_fail_threshold=1000)
+            _drive_qps(dual, rows, tenants, 0.5)            # ramp
+            qps2 = _drive_qps(dual, rows, tenants, secs)
+            scaling = qps2["rows_per_s"] / max(qps1["rows_per_s"], 1e-9)
+
+            # SIGKILL/evict/restart/readmit, twice at one seed
+            round1 = _fabric_kill_round(handles, procs, ports, model_path,
+                                        aot_dir, rows, seed=7, tag="r1")
+            _, snap_a = handles["hA"]._request("GET", "/metrics")
+            restart_modes = sorted(set((snap_a.get("aotPrograms") or {})
+                                       .values()))
+            restart_parity = json.dumps(handles["hA"].forward(rows[:8]),
+                                        sort_keys=True) == reference
+            round2 = _fabric_kill_round(handles, procs, ports, model_path,
+                                        aot_dir, rows, seed=7, tag="r2")
+
+            swap = _fabric_rolling_swap(handles, rows, model_path)
+            drain = _fabric_drain_leg(handles, procs, rows)
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+
+    record = {
+        "metric": "fabric_qps_scaling_2_hosts",
+        "value": round(scaling, 3),
+        "unit": "x",
+        "train_s": round(train_s, 3),
+        "hosts": 2,
+        "service_ms": FABRIC_SERVICE_MS,
+        "aot": {"b_coldstart_s": round(b_ready_s, 3), "b_modes": b_modes,
+                "b_parity": b_parity, "restart_modes": restart_modes,
+                "restart_parity": restart_parity},
+        "qps": {"single_host": qps1, "dual_host": qps2,
+                "scaling": round(scaling, 3), "gate": QPS_SCALE_GATE},
+        "kill": {"round1": {k: v for k, v in round1.items()
+                            if k != "trace"},
+                 "round2": {k: v for k, v in round2.items()
+                            if k != "trace"},
+                 "trace_bytes": len(round1["trace"])},
+        "rolling_swap": swap,
+        "drain": drain,
+        "gates": {
+            # a fresh replica and a restarted one cold-start by LOADING
+            # the fleet artifacts, byte-identically — never compiling
+            "shared_aot_ok": (b_modes == ["aot"] and b_parity
+                              and restart_modes == ["aot"]
+                              and restart_parity),
+            "qps_scaling_ok": scaling >= QPS_SCALE_GATE
+                              and qps1["failures"] == 0
+                              and qps2["failures"] == 0,
+            "sigkill_zero_loss_ok": (
+                round1["failures"] == 0 and round2["failures"] == 0
+                and round1["evicted"] and round1["readmitted"]
+                and round2["evicted"] and round2["readmitted"]),
+            "deterministic_ok": round1["trace"] == round2["trace"],
+            "rolling_swap_ok": (swap["sheds"] == 0
+                                and (swap["p99_ms"] or 0) <= P99_GATE_MS),
+            "drain_zero_loss_ok": (drain["sheds"] == 0
+                                   and drain["exit_code"] == 0
+                                   and drain["draining_seen"]
+                                   and drain["healthz_status"]
+                                   == "draining"),
+        },
+    }
+    record["ok"] = all(record["gates"].values())
+    from transmogrifai_tpu.obs import bench_meta
+    from transmogrifai_tpu.utils.jsonio import write_json_atomic
+    record["meta"] = bench_meta()
+    write_json_atomic(out_path, record)
+    return record
+
+
+def fabric_main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    default_out = (os.path.join(tempfile.gettempdir(),
+                                "tmog_fabric_smoke.json") if SMOKE
+                   else os.path.join(REPO, "benchmarks",
+                                     "fabric_latest.json"))
+    out_path = args[0] if args else default_out
+    record = fabric_run(out_path)
+    aot = record["aot"]
+    print(f"  shared-AOT: hB cold start {aot['b_coldstart_s']:.1f}s "
+          f"modes={aot['b_modes']} parity={'ok' if aot['b_parity'] else 'MISMATCH'} "
+          f"restart modes={aot['restart_modes']}", file=sys.stderr)
+    q = record["qps"]
+    print(f"  qps: single={q['single_host']['rows_per_s']:.0f} rows/s "
+          f"dual={q['dual_host']['rows_per_s']:.0f} rows/s "
+          f"scaling={q['scaling']:.2f}x (gate {QPS_SCALE_GATE}x)",
+          file=sys.stderr)
+    k1 = record["kill"]["round1"]
+    print(f"  sigkill: failures={k1['failures']} "
+          f"evicted={k1['evicted']} readmitted={k1['readmitted']} "
+          f"retried={k1['retried_requests']} "
+          f"deterministic={record['gates']['deterministic_ok']}",
+          file=sys.stderr)
+    sw = record["rolling_swap"]
+    print(f"  rolling swap: p99={sw['p99_ms']}ms sheds={sw['sheds']} "
+          f"(gate {P99_GATE_MS}ms)", file=sys.stderr)
+    dr = record["drain"]
+    print(f"  drain: status={dr['healthz_status']} sheds={dr['sheds']} "
+          f"exit={dr['exit_code']} hosts_after={dr['hosts_after']}",
+          file=sys.stderr)
+    print(json.dumps(record))
+    if not record["ok"]:
+        failed = [g for g, v in record["gates"].items() if not v]
+        print(f"GATES FAILED: {failed}", file=sys.stderr)
+        sys.exit(1)
+
+
+# ---------------------------------------------------------------------------
 
 def run(out_path: str) -> dict:
     with tempfile.TemporaryDirectory() as tmp:
@@ -440,6 +875,13 @@ def run(out_path: str) -> dict:
 def main():
     if len(sys.argv) >= 2 and sys.argv[1] == "--coldstart-child":
         _coldstart_child(sys.argv[2], sys.argv[3])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--fabric-host":
+        _fabric_host_child(sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                           float(sys.argv[5]))
+        return
+    if FABRIC:
+        fabric_main()
         return
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     # smoke runs (the tier1 gate) must not churn the committed benchmark

@@ -72,6 +72,27 @@ def test_tm047_non_pod_function_is_clean():
     assert "TM047" not in f.rules_fired()
 
 
+def test_tm047_fleet_verdict_durable_write_fires():
+    """Fabric control-channel shape (serving/fabric.py): persisting the
+    fleet swap verdict from EVERY pod process tramples one file N ways —
+    the durable write must be coordinator-only."""
+    f = _lint(
+        "def conclude(verdicts):\n"
+        "    pod = current_pod()\n"
+        "    doc = {'accepted': all(v['ok'] for v in verdicts)}\n"
+        "    write_json_atomic('benchmarks/fabric_latest.json', doc)\n")
+    assert f.rules_fired() == ["TM047"]
+
+
+def test_tm047_fleet_verdict_coordinator_guard_is_clean():
+    f = _lint(
+        "def conclude(pod, verdicts):\n"
+        "    doc = {'accepted': all(v['ok'] for v in verdicts)}\n"
+        "    if pod.is_coordinator():\n"
+        "        write_json_atomic('benchmarks/fabric_latest.json', doc)\n")
+    assert "TM047" not in f.rules_fired()
+
+
 # ---------------------------------------------------------------------------
 # TM050 — non-atomic durable writes
 # ---------------------------------------------------------------------------

@@ -1,0 +1,800 @@
+"""Pod-scale serving fabric tests (serving/fabric.py, ISSUE 20).
+
+Covers the acceptance matrix: placement determinism + bounded spill,
+eviction/readmission hysteresis under injected heartbeat loss, deadline-
+budget single-retry failover that never double-counts tenant quotas,
+the drain-vs-kill matrix over real ModelServers, shared-AOTStore
+cross-process warm start (a fresh subprocess cold-starts without
+compiling), fleet-consistent swap/veto/rollback over a threaded
+control-channel transport, the half-open-client socket-timeout
+regression, and the prometheus per-host exposition."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.serving import (ControlChannel, FleetSwapController,
+                                       HashRing, HttpHostHandle,
+                                       LocalHostHandle, ModelRegistry,
+                                       ModelServer, ServingFabric,
+                                       ShedResult)
+from transmogrifai_tpu.serving.fabric import (FabricMetrics, HostUnavailable,
+                                              TenantQuota, stable_digest)
+from transmogrifai_tpu.serving.guarded import probe_digest
+from transmogrifai_tpu.serving.http import healthz_doc, make_http_server
+from transmogrifai_tpu.utils import faults
+from transmogrifai_tpu.utils.faults import FaultSpec
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+MODEL_V1 = os.path.join(FIXTURES, "model_v1")
+MODEL_V2 = os.path.join(FIXTURES, "model_v2")
+
+
+@pytest.fixture(scope="module")
+def rows():
+    df = pd.read_csv(os.path.join(FIXTURES, "model_v1_input.csv"))
+    return df.to_dict("records")
+
+
+class _StubHost:
+    """Scriptable host handle: fail the first ``fail`` forwards with a
+    transport error, shed everything with ``shed_reason``, else serve."""
+
+    def __init__(self, host_id, fail=0, shed_reason=None, delay_s=0.0,
+                 status="ok"):
+        self.host_id = host_id
+        self.fail = fail
+        self.shed_reason = shed_reason
+        self.delay_s = delay_s
+        self.status = status
+        self.forwards = 0
+        self.on_forward = None  # hook(rows) for quota assertions
+
+    def forward(self, rows, tenant=None, timeout_s=None):
+        self.forwards += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail > 0:
+            self.fail -= 1
+            raise HostUnavailable(f"{self.host_id} scripted failure")
+        if self.on_forward is not None:
+            self.on_forward(rows)
+        if self.shed_reason:
+            return [ShedResult(reason=self.shed_reason) for _ in rows]
+        return [{"host": self.host_id, "i": i} for i in range(len(rows))]
+
+    def healthz(self, timeout_s=None):
+        return {"status": self.status, "breakerState": "closed",
+                "shedRate": 0.0, "draining": False}
+
+
+def _fabric(hosts, **kw):
+    kw.setdefault("record_decisions", True)
+    kw.setdefault("retry_base_s", 0.0)  # no sleeps in unit tests
+    return ServingFabric(hosts, **kw)
+
+
+# ---------------------------------------------------------------------------
+# placement: consistent hashing
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_ring_is_instance_and_order_independent(self):
+        a = HashRing(["h0", "h1", "h2"])
+        b = HashRing(["h2", "h0", "h1"])
+        for key in ("alpha", "beta", "gamma", "tenant-42"):
+            assert a.candidates(key) == b.candidates(key)
+        # candidates enumerate every distinct host exactly once
+        assert sorted(a.candidates("alpha")) == ["h0", "h1", "h2"]
+
+    def test_stable_digest_not_process_seeded(self):
+        # pinned value: placement must never depend on PYTHONHASHSEED
+        assert stable_digest("tenant", "alpha") == \
+            stable_digest("tenant", "alpha")
+        assert stable_digest("tenant", "alpha") != \
+            stable_digest("tenant", "beta")
+
+    def test_adding_a_host_remaps_only_its_arcs(self):
+        before = HashRing(["h0", "h1", "h2"])
+        after = HashRing(["h0", "h1", "h2", "h3"])
+        keys = [f"tenant-{i}" for i in range(64)]
+        moved = 0
+        for k in keys:
+            p0, p1 = before.primary(k), after.primary(k)
+            if p0 != p1:
+                assert p1 == "h3"  # only the new host takes keys over
+                moved += 1
+        assert 0 < moved < len(keys)
+
+    def test_all_hosts_get_some_primaries(self):
+        ring = HashRing(["h0", "h1", "h2"])
+        primaries = {ring.primary(f"t{i}") for i in range(128)}
+        assert primaries == {"h0", "h1", "h2"}
+
+
+# ---------------------------------------------------------------------------
+# routing: spill bounds, retry/failover, deadline budgets, quotas
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def _hosts_in_ring_order(self, tenant, n=3, **stub_kw):
+        ids = [f"h{i}" for i in range(n)]
+        order = HashRing(ids).candidates(tenant)
+        return {h: _StubHost(h) for h in order}, order
+
+    def test_routes_to_primary(self):
+        hosts, order = self._hosts_in_ring_order("t1")
+        fab = _fabric(hosts.values())
+        out = fab.score([{"x": 1}], tenant="t1")
+        assert out[0]["host"] == order[0]
+        assert fab.decisions[-1]["served"] == order[0]
+
+    def test_spill_is_bounded(self):
+        hosts, order = self._hosts_in_ring_order("t1")
+        hosts[order[0]].shed_reason = "queue_full"
+        hosts[order[1]].shed_reason = "queue_full"
+        fab = _fabric(hosts.values(), max_spill=1)
+        out = fab.score([{"x": 1}, {"x": 2}], tenant="t1")
+        # one spill allowed: primary shed -> neighbor shed -> STOP; the
+        # third host must never be attempted
+        assert all(isinstance(r, ShedResult)
+                   and r.reason == "queue_full" for r in out)
+        assert hosts[order[2]].forwards == 0
+        fab2 = _fabric((_StubHost(h, shed_reason="queue_full"
+                                  if h != order[2] else None)
+                        for h in order), max_spill=2)
+        out2 = fab2.score([{"x": 1}], tenant="t1")
+        assert out2[0]["host"] == order[2]
+
+    def test_single_retry_failover_to_survivor(self):
+        hosts, order = self._hosts_in_ring_order("t1")
+        hosts[order[0]].fail = 1
+        fab = _fabric(hosts.values())
+        out = fab.score([{"x": 1}], tenant="t1")
+        assert out[0]["host"] == order[1]
+        assert fab.decisions[-1]["attempted"] == [order[0], order[1]]
+        assert fab.metrics.snapshot()["retriedRequests"] == 1
+
+    def test_retry_limit_exhaustion_sheds(self):
+        hosts, order = self._hosts_in_ring_order("t1")
+        for h in hosts.values():
+            h.fail = 5
+        fab = _fabric(hosts.values(), retry_limit=1)
+        out = fab.score([{"x": 1}], tenant="t1")
+        assert [r.reason for r in out] == ["upstream_error"]
+        # exactly primary + one retry were attempted
+        assert sum(h.forwards for h in hosts.values()) == 2
+
+    def test_expired_deadline_sheds_immediately(self):
+        hosts, _ = self._hosts_in_ring_order("t1")
+        fab = _fabric(hosts.values())
+        out = fab.score([{"x": 1}], tenant="t1", timeout_ms=0.0)
+        assert out[0].reason == "deadline"
+        assert sum(h.forwards for h in hosts.values()) == 0
+
+    def test_retried_request_never_double_counts_quota(self):
+        rows = [{"x": i} for i in range(4)]
+        hosts, order = self._hosts_in_ring_order("t1")
+        hosts[order[0]].fail = 1
+        # quota EXACTLY fits one request: a double-acquire on retry
+        # would shed with tenant_quota instead of serving
+        fab = _fabric(hosts.values(), tenant_quota_rows=len(rows))
+        seen = {}
+
+        def check(forwarded):
+            seen["used"] = fab._quotas["t1"].used
+
+        hosts[order[1]].on_forward = check
+        out = fab.score(rows, tenant="t1")
+        assert all(not isinstance(r, ShedResult) for r in out)
+        assert seen["used"] == len(rows)       # held once, not twice
+        assert fab._quotas["t1"].used == 0     # released afterwards
+
+    def test_quota_sheds_when_full(self):
+        hosts, _ = self._hosts_in_ring_order("t1")
+        fab = _fabric(hosts.values(), tenant_quota_rows=2)
+        out = fab.score([{"x": i} for i in range(3)], tenant="t1")
+        assert [r.reason for r in out] == ["tenant_quota"] * 3
+
+    def test_quota_primitive(self):
+        q = TenantQuota(4)
+        assert q.try_acquire(3) and q.try_acquire(1)
+        assert not q.try_acquire(1)
+        q.release(2)
+        assert q.try_acquire(2)
+
+
+# ---------------------------------------------------------------------------
+# determinism: seeded jitter + identical failover choices
+# ---------------------------------------------------------------------------
+
+class TestDeterminism:
+    def _run(self, seed):
+        order = HashRing(["h0", "h1", "h2"]).candidates("t1")
+        hosts = {h: _StubHost(h) for h in order}
+        hosts[order[0]].fail = 2
+        fab = ServingFabric(hosts.values(), seed=seed,
+                            record_decisions=True, retry_base_s=0.0)
+        for i in range(6):
+            fab.score([{"x": i}], tenant="t1")
+        jitter = [fab.failover_jitter_s(r, a)
+                  for r in (1, 2, 3) for a in (1, 2)]
+        return fab.decisions, jitter
+
+    def test_two_routers_one_seed_identical_choices(self):
+        d1, j1 = self._run(7)
+        d2, j2 = self._run(7)
+        assert d1 == d2
+        assert j1 == j2
+
+    def test_jitter_is_bounded_and_seed_sensitive(self):
+        fab = ServingFabric(seed=1, retry_base_s=0.002, retry_cap_s=0.05)
+        other = ServingFabric(seed=2, retry_base_s=0.002, retry_cap_s=0.05)
+        draws = [fab.failover_jitter_s(r, a)
+                 for r in range(8) for a in (1, 2, 3)]
+        assert all(0.0 < d <= 0.05 for d in draws)
+        assert draws != [other.failover_jitter_s(r, a)
+                         for r in range(8) for a in (1, 2, 3)]
+
+
+# ---------------------------------------------------------------------------
+# health: eviction / readmission hysteresis
+# ---------------------------------------------------------------------------
+
+class TestHealthHysteresis:
+    def test_heartbeat_loss_evicts_then_hysteretic_readmit(self):
+        hosts = {h: _StubHost(h) for h in ("h0", "h1")}
+        fab = _fabric(hosts.values(), evict_after_s=1.0,
+                      probe_fail_threshold=2, readmit_probes=2)
+        t0 = time.monotonic()
+        for st in fab._states.values():
+            st.last_seen = t0
+        with faults.inject(FaultSpec(point="host.heartbeat", action="skip",
+                                     tag="h0", times=2)):
+            up = fab.probe_once(now=t0 + 0.5)   # suppressed, age 0.5 < 1.0
+            assert up["h0"] is True
+            up = fab.probe_once(now=t0 + 1.5)   # suppressed, age > 1.0
+            assert up["h0"] is False and up["h1"] is True
+            assert fab.host_state("h0").evicted
+        # first healthy probe: hysteresis holds it OUT of rotation
+        up = fab.probe_once(now=t0 + 2.0)
+        assert up["h0"] is False
+        # second consecutive healthy probe readmits
+        up = fab.probe_once(now=t0 + 2.2)
+        assert up["h0"] is True
+        snap = fab.snapshot()["hosts"]["h0"]
+        assert snap["evictions"] == 1 and snap["readmissions"] == 1
+
+    def test_probe_failures_evict(self):
+        bad = _StubHost("h0")
+        bad.healthz = lambda timeout_s=None: (_ for _ in ()).throw(
+            HostUnavailable("down"))
+        fab = _fabric([bad, _StubHost("h1")], probe_fail_threshold=2)
+        now = time.monotonic()
+        fab.probe_once(now=now)
+        assert not fab.host_state("h0").evicted
+        fab.probe_once(now=now + 0.1)
+        assert fab.host_state("h0").evicted
+
+    def test_evicted_host_not_routed(self):
+        order = HashRing(["h0", "h1"]).candidates("t1")
+        hosts = {h: _StubHost(h) for h in order}
+        fab = _fabric(hosts.values())
+        fab._evict(order[0], "test")
+        out = fab.score([{"x": 1}], tenant="t1")
+        assert out[0]["host"] == order[1]
+        assert hosts[order[0]].forwards == 0
+
+    def test_draining_status_marks_host_non_admitting(self):
+        order = HashRing(["h0", "h1"]).candidates("t1")
+        hosts = {h: _StubHost(h) for h in order}
+        hosts[order[0]].status = "draining"
+        fab = _fabric(hosts.values())
+        fab.probe_once(now=time.monotonic())
+        assert fab.host_state(order[0]).draining
+        out = fab.score([{"x": 1}], tenant="t1")
+        assert out[0]["host"] == order[1]
+
+
+# ---------------------------------------------------------------------------
+# drain vs kill over REAL servers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def pair(rows):
+    servers = [ModelServer.from_path(
+        MODEL_V1, name=f"m{i}", max_batch=8, max_latency_ms=2.0,
+        warmup_row=dict(rows[0])) for i in range(2)]
+    for s in servers:
+        s.start()
+    handles = [LocalHostHandle(f"h{i}", s) for i, s in enumerate(servers)]
+    try:
+        yield handles
+    finally:
+        for s in servers:
+            s.stop()
+
+
+class TestDrainVsKill:
+    def test_graceful_drain_moves_traffic_and_sheds_at_host(self, pair,
+                                                            rows):
+        fab = _fabric(pair)
+        order = fab.ring.candidates("t1")
+        primary = dict((h.host_id, h) for h in pair)[order[0]]
+        before = fab.score(rows[:2], tenant="t1")
+        assert all(not isinstance(r, ShedResult) for r in before)
+        fab.drain_host(order[0])
+        # the drained ModelServer sheds direct submits with "draining"
+        direct = primary.server.score(rows[:2])
+        assert [r.reason for r in direct] == ["draining", "draining"]
+        assert healthz_doc(primary.server)[1]["status"] == "draining"
+        # the router no longer routes there; traffic lands on the peer
+        out = fab.score(rows[:2], tenant="t1")
+        assert all(not isinstance(r, ShedResult) for r in out)
+        assert fab.decisions[-1]["served"] == order[1]
+        fab.remove_host(order[0])
+        assert fab.hosts() == [order[1]]
+
+    def test_hard_kill_zero_failed_then_evict_and_readmit(self, pair,
+                                                          rows):
+        fab = _fabric(pair, probe_fail_threshold=2, readmit_probes=2,
+                      evict_after_s=30.0)
+        order = fab.ring.candidates("t1")
+        handles = {h.host_id: h for h in pair}
+        handles[order[0]].kill()
+        # in-flight retried to the survivor: ZERO failed requests
+        out = fab.score(rows[:3], tenant="t1")
+        assert all(not isinstance(r, ShedResult) for r in out)
+        assert fab.decisions[-1]["attempted"] == [order[0], order[1]]
+        # the forward failure plus one failed probe cross the threshold
+        fab.probe_once(now=time.monotonic())
+        assert fab.host_state(order[0]).evicted
+        # restart -> hysteretic readmission -> traffic returns
+        handles[order[0]].restart()
+        now = time.monotonic()
+        fab.probe_once(now=now)
+        assert fab.host_state(order[0]).evicted     # 1 of 2 healthy probes
+        fab.probe_once(now=now + 0.1)
+        assert not fab.host_state(order[0]).evicted
+        out = fab.score(rows[:2], tenant="t1")
+        assert fab.decisions[-1]["served"] == order[0]
+
+    def test_served_results_match_single_server(self, pair, rows):
+        fab = _fabric(pair)
+        via_fabric = fab.score(rows[:6], tenant="t1")
+        direct = pair[0].server.score(rows[:6])
+        assert json.dumps(via_fabric, sort_keys=True, default=str) == \
+            json.dumps(direct, sort_keys=True, default=str)
+
+
+# ---------------------------------------------------------------------------
+# router.forward fault point
+# ---------------------------------------------------------------------------
+
+class TestRouterForwardFault:
+    def test_injected_io_error_fails_over(self):
+        order = HashRing(["h0", "h1"]).candidates("t1")
+        hosts = {h: _StubHost(h) for h in order}
+        fab = _fabric(hosts.values())
+        with faults.inject(FaultSpec(point="router.forward",
+                                     action="io_error", tag=order[0],
+                                     times=1)):
+            out = fab.score([{"x": 1}], tenant="t1")
+        assert out[0]["host"] == order[1]
+        assert hosts[order[0]].forwards == 0   # faulted before the wire
+        assert fab.metrics.snapshot()["retriedRequests"] == 1
+
+
+# ---------------------------------------------------------------------------
+# control channel + fleet swaps over a threaded transport
+# ---------------------------------------------------------------------------
+
+class _Bus:
+    """N-thread lockstep transport with the PodContext collective API."""
+
+    def __init__(self, n):
+        self.n = n
+        self.barrier = threading.Barrier(n, timeout=30)
+        self.slots = [None] * n
+
+    def port(self, i):
+        return _Port(self, i)
+
+
+class _Port:
+    def __init__(self, bus, index):
+        self.bus = bus
+        self.process_index = index
+        self.process_count = bus.n
+
+    def is_coordinator(self):
+        return self.process_index == 0
+
+    def allgather_obj(self, obj, _kind="allgather_obj"):
+        self.bus.slots[self.process_index] = obj
+        self.bus.barrier.wait()
+        out = list(self.bus.slots)
+        self.bus.barrier.wait()   # nobody reuses slots before all read
+        return out
+
+    def broadcast_obj(self, obj, kind="broadcast_obj"):
+        return self.allgather_obj(obj, _kind=kind)[0]
+
+
+def _run_fleet(n, fn):
+    """Run ``fn(index, port)`` on n threads; return results by index,
+    re-raising the first worker exception."""
+    bus = _Bus(n)
+    results, errors = [None] * n, []
+
+    def worker(i):
+        try:
+            results[i] = fn(i, bus.port(i))
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append(exc)
+            bus.barrier.abort()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if errors:
+        raise errors[0]
+    return results
+
+
+class _LoadShim:
+    """Registry wrapper that loads a FIXED path regardless of the control
+    message — the 'replica got a different artifact' failure."""
+
+    def __init__(self, registry, real_path):
+        self._registry = registry
+        self._real_path = real_path
+
+    def load(self, name, path):
+        return self._registry.load(name, self._real_path)
+
+    def __getattr__(self, attr):
+        return getattr(self._registry, attr)
+
+
+class TestFleetSwap:
+    N = 3
+
+    def _controllers(self, n):
+        regs = []
+        for _ in range(n):
+            reg = ModelRegistry()
+            reg.load("m", MODEL_V1)
+            regs.append(reg)
+        return regs
+
+    def test_clean_fleet_swap_is_consistent(self, rows):
+        regs = self._controllers(self.N)
+        probe = [dict(r) for r in rows[:4]]
+
+        def fn(i, port):
+            ctl = FleetSwapController(
+                regs[i], "m", channel=ControlChannel(transport=port))
+            return ctl.fleet_swap(path=MODEL_V2 if i == 0 else None,
+                                  probe_rows=probe if i == 0 else None)
+
+        results = _run_fleet(self.N, fn)
+        assert all(r["accepted"] for r in results)
+        assert results[0] == results[1] == results[2]
+        digests = {probe_digest(reg.get("m").scorer, probe)
+                   for reg in regs}
+        assert len(digests) == 1   # every replica answers identically
+        assert {reg.get("m").version for reg in regs} == {2}
+
+    def test_bake_failure_on_one_replica_vetoes_the_fleet(self, rows):
+        regs = self._controllers(self.N)
+        v1_digest = probe_digest(regs[0].get("m").scorer, rows[:4])
+
+        def fn(i, port):
+            ctl = FleetSwapController(
+                regs[i], "m", channel=ControlChannel(transport=port))
+            return ctl.fleet_swap(path=MODEL_V2 if i == 0 else None,
+                                  probe_rows=rows[:4] if i == 0 else None)
+
+        # times=1: exactly ONE replica's bake raises; the verdict gather
+        # must turn that into a fleet-wide veto + rollback
+        with faults.inject(FaultSpec(point="swap.bake", tag="fleet",
+                                     action="raise", times=1)):
+            results = _run_fleet(self.N, fn)
+        assert all(not r["accepted"] for r in results)
+        assert any("bake:FaultError" in reason
+                   for r in results for reason in r["reasons"])
+        # every replica serves v1 again, byte-identically
+        for reg in regs:
+            assert probe_digest(reg.get("m").scorer,
+                                rows[:4]) == v1_digest
+
+    def test_dropped_control_message_repairs_then_accepts(self, rows):
+        regs = self._controllers(self.N)
+
+        def fn(i, port):
+            ctl = FleetSwapController(
+                regs[i], "m", channel=ControlChannel(transport=port))
+            return ctl.fleet_swap(path=MODEL_V2 if i == 0 else None,
+                                  probe_rows=rows[:4] if i == 0 else None)
+
+        with faults.inject(FaultSpec(point="swap.propagate", tag="swap",
+                                     action="skip", times=1)):
+            results = _run_fleet(self.N, fn)
+        assert all(r["accepted"] for r in results)
+        assert {reg.get("m").version for reg in regs} == {2}
+
+    def test_dropped_message_with_no_repair_budget_rolls_back(self, rows):
+        regs = self._controllers(self.N)
+
+        def fn(i, port):
+            ctl = FleetSwapController(
+                regs[i], "m", channel=ControlChannel(transport=port),
+                max_repairs=0)
+            return ctl.fleet_swap(path=MODEL_V2 if i == 0 else None,
+                                  probe_rows=rows[:4] if i == 0 else None)
+
+        with faults.inject(FaultSpec(point="swap.propagate", tag="swap",
+                                     action="skip", times=1)):
+            results = _run_fleet(self.N, fn)
+        assert all(not r["accepted"] for r in results)
+        assert all("control_message_lost" in r["reasons"]
+                   for r in results)
+        assert {reg.get("m").version for reg in regs} == {1}
+
+    def test_divergent_artifacts_veto_via_digest(self, rows):
+        regs = self._controllers(self.N)
+        shimmed = [_LoadShim(regs[2], MODEL_V1)]
+
+        def fn(i, port):
+            reg = shimmed[0] if i == 2 else regs[i]
+            ctl = FleetSwapController(
+                reg, "m", channel=ControlChannel(transport=port))
+            return ctl.fleet_swap(path=MODEL_V2 if i == 0 else None,
+                                  probe_rows=rows[:4] if i == 0 else None)
+
+        results = _run_fleet(self.N, fn)
+        assert all(not r["accepted"] for r in results)
+        assert all("digest_divergence" in r["reasons"] for r in results)
+        assert {reg.get("m").version for reg in regs[:2]} == {1}
+
+    def test_drift_baseline_sync(self):
+        baselines = {"age": {"mean": 30.0}}
+
+        def fn(i, port):
+            ctl = FleetSwapController(
+                ModelRegistry(), "m",
+                channel=ControlChannel(transport=port))
+            return ctl.sync_drift_baselines(
+                baselines if i == 0 else None)
+
+        results = _run_fleet(self.N, fn)
+        assert results == [baselines] * self.N
+
+    def test_drift_sync_drop_is_local(self):
+        baselines = {"age": {"mean": 30.0}}
+
+        def fn(i, port):
+            ctl = FleetSwapController(
+                ModelRegistry(), "m",
+                channel=ControlChannel(transport=port))
+            return ctl.sync_drift_baselines(
+                baselines if i == 0 else None)
+
+        with faults.inject(FaultSpec(point="swap.propagate", tag="drift",
+                                     action="skip", times=1)):
+            results = _run_fleet(self.N, fn)
+        assert results.count(None) == 1
+        assert results.count(baselines) == self.N - 1
+
+    def test_inert_channel_single_process(self):
+        # no pod: ControlChannel degenerates to local identity
+        reg = ModelRegistry()
+        reg.load("m", MODEL_V1)
+        ctl = FleetSwapController(reg, "m")
+        res = ctl.fleet_swap(path=MODEL_V2, probe_rows=[])
+        assert res["accepted"] and res["processes"] == 1
+        assert reg.get("m").version == 2
+
+
+# ---------------------------------------------------------------------------
+# HTTP: host handle transport + half-open client timeout
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def httpd_server(rows):
+    srv = ModelServer.from_path(
+        MODEL_V1, name="m", max_batch=8, max_latency_ms=2.0,
+        warmup_row=dict(rows[0]))
+    srv.start()
+    httpd = make_http_server(srv, port=0, request_timeout_s=0.5)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv, httpd, httpd.server_address[1]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.stop()
+
+
+class TestHttpTransport:
+    def test_http_handle_round_trip(self, httpd_server, rows):
+        srv, _httpd, port = httpd_server
+        handle = HttpHostHandle("h0", f"127.0.0.1:{port}")
+        out = handle.forward(rows[:3])
+        direct = srv.score(rows[:3])
+        assert json.dumps(out, sort_keys=True) == \
+            json.dumps(direct, sort_keys=True, default=str)
+        doc = handle.healthz()
+        assert doc["status"] == "ok"
+        assert "shedRate" in doc and doc["draining"] is False
+
+    def test_drain_endpoint(self, httpd_server, rows):
+        srv, _httpd, port = httpd_server
+        handle = HttpHostHandle("h0", f"127.0.0.1:{port}")
+        handle.drain()
+        assert srv.draining
+        out = handle.forward(rows[:2])
+        assert all(isinstance(r, ShedResult)
+                   and r.reason == "draining" for r in out)
+        assert handle.healthz()["status"] == "draining"
+
+    def test_dead_host_raises_host_unavailable(self, rows):
+        handle = HttpHostHandle("h0", "127.0.0.1:1",  # nothing listens
+                                connect_timeout_s=0.5)
+        with pytest.raises(HostUnavailable):
+            handle.forward(rows[:1])
+        with pytest.raises(HostUnavailable):
+            handle.healthz()
+
+    def test_half_open_client_releases_worker(self, httpd_server, rows):
+        """A client that stalls mid-request must hit the server-side
+        socket timeout — the connection closes and the worker thread is
+        released instead of pinned forever."""
+        _srv, _httpd, port = httpd_server
+        s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        s.sendall(b"POST /score HTTP/1.1\r\n")   # never completes
+        t0 = time.monotonic()
+        data = s.recv(4096)   # server closes after request_timeout_s=0.5
+        elapsed = time.monotonic() - t0
+        s.close()
+        assert data == b""
+        assert elapsed < 4.0
+        # the server still serves new requests afterwards
+        handle = HttpHostHandle("h0", f"127.0.0.1:{port}")
+        assert handle.healthz()["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# shared AOT store: a fresh PROCESS cold-starts without compiling
+# ---------------------------------------------------------------------------
+
+_CHILD_SCRIPT = r"""
+import json, sys
+import pandas as pd
+from transmogrifai_tpu.serving import ModelServer
+from transmogrifai_tpu.utils import compile_cache
+
+model_path, aot_dir, csv = sys.argv[1], sys.argv[2], sys.argv[3]
+rows = pd.read_csv(csv).to_dict("records")
+srv = ModelServer.from_path(model_path, name="m", max_batch=4,
+                            warmup_row=dict(rows[0]),
+                            device_programs=True, aot_store=aot_dir)
+with srv:
+    out = srv.score(rows[:3])
+    snap = srv.snapshot()
+stats = compile_cache.cache_stats()
+serving_compiles = sum(v for k, v in stats["compiles"].items()
+                       if k.startswith("serving."))
+print(json.dumps({"modes": sorted(set(snap["aotPrograms"].values())),
+                  "servingCompiles": serving_compiles,
+                  "aotLoads": stats["totals"]["aotLoads"],
+                  "scores": out}, default=str))
+"""
+
+
+class TestSharedAOTStore:
+    def test_fresh_process_warm_starts_from_shared_cache(self, rows,
+                                                         tmp_path):
+        aot_dir = str(tmp_path / "shared_aot")
+        srv = ModelServer.from_path(
+            MODEL_V1, name="m", max_batch=4, warmup_row=dict(rows[0]),
+            device_programs=True, aot_store=aot_dir)
+        with srv:
+            expected = srv.score(rows[:3])
+        from transmogrifai_tpu.serving import AOTStore
+
+        stats = AOTStore(aot_dir).stats()
+        assert stats["entries"] > 0 and stats["payloadBytes"] > 0
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("TMOG_FAULTS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_SCRIPT, MODEL_V1, aot_dir,
+             os.path.join(FIXTURES, "model_v1_input.csv")],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        # the fleet contract: the fresh replica LOADED, never compiled
+        assert doc["modes"] == ["aot"]
+        assert doc["servingCompiles"] == 0
+        assert doc["aotLoads"] > 0
+        assert json.dumps(doc["scores"], sort_keys=True) == \
+            json.dumps(expected, sort_keys=True, default=str)
+
+
+# ---------------------------------------------------------------------------
+# prometheus: per-host labels
+# ---------------------------------------------------------------------------
+
+class TestFabricPrometheus:
+    def test_fabric_exposition_parses_with_host_labels(self):
+        from transmogrifai_tpu.obs.prometheus import (parse_exposition,
+                                                      prometheus_text)
+
+        order = HashRing(["h0", "h1"]).candidates("t1")
+        hosts = {h: _StubHost(h) for h in order}
+        hosts[order[0]].fail = 1
+        fab = _fabric(hosts.values())
+        fab.score([{"x": 1}], tenant="t1")
+        fab.score([{"x": 2}], tenant="t1", timeout_ms=0.0)
+        fab._evict(order[0], "test")
+        text = prometheus_text(fabric=fab.snapshot())
+        parsed = parse_exposition(text)
+        assert parsed[
+            f'tmog_fabric_forwards_total{{host="{order[1]}"}}'] == 1.0
+        assert parsed[
+            f'tmog_fabric_failovers_total{{host="{order[0]}"}}'] == 1.0
+        assert parsed[f'tmog_fabric_host_up{{host="{order[0]}"}}'] == 0.0
+        assert parsed[f'tmog_fabric_host_up{{host="{order[1]}"}}'] == 1.0
+        assert parsed['tmog_fabric_shed_total{reason="deadline"}'] == 1.0
+        assert parsed["tmog_fabric_retried_requests_total"] == 1.0
+
+    def test_empty_fabric_section_still_parses(self):
+        from transmogrifai_tpu.obs.prometheus import (parse_exposition,
+                                                      prometheus_text)
+
+        fab = ServingFabric()
+        parsed = parse_exposition(prometheus_text(fabric=fab.snapshot()))
+        assert parsed["tmog_fabric_requests_total"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# metrics ledger details
+# ---------------------------------------------------------------------------
+
+class TestFabricMetrics:
+    def test_shed_by_reason_and_host_ledger(self):
+        m = FabricMetrics()
+        m.record_request("h0", 4, 0.010)
+        m.record_request("h1", 2, 0.020, retried=True)
+        m.record_shed("deadline", 3)
+        m.record_shed("deadline", 1)
+        m.record_failover("h0")
+        snap = m.snapshot()
+        assert snap["requests"] == 2 and snap["rows"] == 6
+        assert snap["retriedRequests"] == 1
+        assert snap["shedByReason"] == {"deadline": 4}
+        assert snap["hosts"]["h0"]["failovers"] == 1
+        assert snap["latencyMs"]["p50"] is not None
+
+    def test_server_shed_reasons_reach_snapshot(self, rows):
+        srv = ModelServer.from_path(
+            MODEL_V1, name="m", max_batch=8, max_latency_ms=2.0,
+            warmup_row=dict(rows[0]))
+        with srv:
+            srv.begin_drain()
+            srv.score(rows[:2])
+            snap = srv.metrics.snapshot()
+        assert snap["shedByReason"] == {"draining": 2}

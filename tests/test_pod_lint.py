@@ -142,6 +142,56 @@ class TestPodLint:
 
 
 # ---------------------------------------------------------------------------
+# fabric control-channel shapes (serving/fabric.py, ISSUE 20)
+# ---------------------------------------------------------------------------
+
+class TestFabricControlChannelShapes:
+    def test_tm070_coordinator_only_publish_fires(self):
+        # the WRONG control channel: only the coordinator broadcasts,
+        # every replica blocks in the collective forever
+        f = _lint(
+            "def publish(pod, msg):\n"
+            "    if pod.is_coordinator():\n"
+            "        return pod.broadcast_obj(msg, kind='fabric.control')\n"
+            "    return None\n")
+        assert "TM070" in f.rules_fired()
+
+    def test_tm070_transitive_through_channel_helper(self):
+        f = _lint(
+            "def gather_verdicts(pod, verdict):\n"
+            "    return pod.allgather_obj(verdict, _kind='fabric.verdicts')\n"
+            "def fleet_swap(pod, verdict):\n"
+            "    if pod.is_coordinator():\n"
+            "        return gather_verdicts(pod, verdict)\n")
+        assert "TM070" in f.rules_fired()
+
+    def test_tm071_repair_branch_diverges(self):
+        # a repair re-publish on one branch while the fallthrough runs
+        # the verdict gather: collective ORDER now depends on local state
+        f = _lint(
+            "def fleet_swap(pod, msg, missing):\n"
+            "    if missing:\n"
+            "        pod.broadcast_obj(msg, kind='fabric.control')\n"
+            "        return\n"
+            "    pod.allgather_obj(msg, _kind='fabric.verdicts')\n")
+        assert "TM071" in f.rules_fired()
+
+    def test_straight_line_publish_then_gather_is_clean(self):
+        # the shape ControlChannel/FleetSwapController actually use:
+        # every process runs the SAME collective sequence; coordinator-
+        # ness only shapes the message CONTENT, never the control flow
+        f = _lint(
+            "def fleet_swap(pod, draft, verdict):\n"
+            "    msg = pod.broadcast_obj(\n"
+            "        draft if pod.is_coordinator() else None,\n"
+            "        kind='fabric.control')\n"
+            "    verdicts = pod.allgather_obj(verdict,\n"
+            "                                 _kind='fabric.verdicts')\n"
+            "    return msg, verdicts\n")
+        assert f.rules_fired() == []
+
+
+# ---------------------------------------------------------------------------
 # runtime ledger
 # ---------------------------------------------------------------------------
 

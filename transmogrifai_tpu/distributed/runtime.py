@@ -225,14 +225,17 @@ class PodContext:
         with CollectiveWatchdog(_kind, "<ledger off>"):
             return self._exchange(obj)
 
-    def broadcast_obj(self, obj: Any) -> Any:
+    def broadcast_obj(self, obj: Any, kind: str = "broadcast_obj") -> Any:
         """Coordinator's object lands on every process (others pass any
-        placeholder, conventionally None)."""
+        placeholder, conventionally None).  ``kind`` labels the exchange
+        in the collective ledger — the serving control channel passes
+        ``"fabric.control"`` so a divergent fleet-control message is
+        attributed as such rather than as a generic broadcast."""
         if not self.active:
             return obj
         # one exchange both directions keeps the protocol lockstep-simple;
         # pod payloads here are small (decisions, counters, cursors)
-        return self.allgather_obj(obj, _kind="broadcast_obj")[0]
+        return self.allgather_obj(obj, _kind=kind)[0]
 
     def allsum(self, arr: np.ndarray) -> np.ndarray:
         """Elementwise sum of a host float array across processes."""

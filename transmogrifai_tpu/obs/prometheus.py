@@ -91,14 +91,16 @@ def _fmt(v: float) -> str:
 
 def prometheus_text(snapshot: Optional[Dict[str, Any]] = None,
                     counters=None,
-                    tenants: Optional[Dict[str, Dict[str, Any]]] = None
-                    ) -> str:
+                    tenants: Optional[Dict[str, Dict[str, Any]]] = None,
+                    fabric: Optional[Dict[str, Any]] = None) -> str:
     """The full exposition.  ``snapshot`` is a ``ServingMetrics.snapshot()``
     dict (None = no serving section); ``counters`` a ``RunCounters``
     (None = the process-global ``COUNTERS``); ``tenants`` maps tenant name
     -> serving snapshot — every serving sample then carries a
     ``tenant="<name>"`` label, one family emitted once with one sample per
-    tenant (the multi-tenant registry's per-tenant exposition)."""
+    tenant (the multi-tenant registry's per-tenant exposition); ``fabric``
+    is a ``ServingFabric.snapshot()`` — the router's fleet view, with
+    every per-host sample carrying a ``host="<id>"`` label."""
     doc = _Doc()
     sections = []
     if snapshot is not None:
@@ -107,6 +109,8 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None,
         sections.append(({"tenant": name}, snap))
     if sections:
         _serving_section(doc, sections)
+    if fabric is not None:
+        _fabric_section(doc, fabric)
     if counters is None:
         from ..utils import profiling
 
@@ -172,6 +176,57 @@ def _serving_section(doc: _Doc, sections) -> None:
     doc.metric("tmog_serving_last_fallback_age_seconds", "gauge",
                "seconds since the last host fallback (absent = never)",
                age_samples)
+
+
+#: per-host fabric counters (FabricMetrics host ledger keys)
+_FABRIC_HOST_COUNTERS = (
+    ("forwards", "requests forwarded to this host"),
+    ("rows", "rows forwarded to this host"),
+    ("failovers", "transport failures failed over away from this host"),
+    ("spills", "requests spilled past this host under pressure"),
+    ("probeFailures", "failed health probes of this host"),
+    ("evictions", "router evictions of this host"),
+    ("readmissions", "router readmissions of this host"),
+)
+
+
+def _fabric_section(doc: _Doc, snap: Dict[str, Any]) -> None:
+    """The router's fleet view: one sample per host (``host="<id>"``
+    labels) plus fleet-level request/retry/shed totals and the routed-
+    request latency summary."""
+    hosts = snap.get("hosts") or {}
+    for key, help_text in _FABRIC_HOST_COUNTERS:
+        doc.metric(f"tmog_fabric_{_snake(key)}_total", "counter",
+                   help_text,
+                   [({"host": h}, _num(c.get(key)) or 0.0)
+                    for h, c in sorted(hosts.items())])
+    doc.metric("tmog_fabric_host_up", "gauge",
+               "1 = host in rotation, 0 = evicted or draining",
+               [({"host": h},
+                 0.0 if (c.get("evicted") or c.get("draining")) else 1.0)
+                for h, c in sorted(hosts.items())])
+    doc.metric("tmog_fabric_requests_total", "counter",
+               "requests routed by the fabric",
+               [(None, _num(snap.get("requests")) or 0.0)])
+    doc.metric("tmog_fabric_rows_total", "counter",
+               "rows routed by the fabric",
+               [(None, _num(snap.get("rows")) or 0.0)])
+    doc.metric("tmog_fabric_retried_requests_total", "counter",
+               "requests that needed at least one failover retry",
+               [(None, _num(snap.get("retriedRequests")) or 0.0)])
+    doc.metric("tmog_fabric_shed_total", "counter",
+               "rows the router shed, by reason",
+               [({"reason": r}, _num(v) or 0.0) for r, v in
+                sorted((snap.get("shedByReason") or {}).items())])
+    q_samples = []
+    lat = snap.get("latencyMs") or {}
+    for q_key, q in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
+        v = _num(lat.get(q_key))
+        if v is not None:
+            q_samples.append(({"quantile": q}, v / 1000.0))
+    doc.metric("tmog_fabric_request_latency_seconds", "summary",
+               "end-to-end routed-request latency (reservoir quantiles)",
+               q_samples)
 
 
 def _run_section(doc: _Doc, counters) -> None:

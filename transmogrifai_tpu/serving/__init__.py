@@ -91,6 +91,10 @@ class ModelServer:
         #: refresh loop's serving half); None keeps the hot path untouched
         self.drift_monitor = None
         self.guard = None
+        #: graceful-drain flag: once set, new submits shed with reason
+        #: "draining" while queued/in-flight work completes — the SIGTERM
+        #: half of the fabric's drain-vs-SIGKILL matrix
+        self._draining = False
         registry.on_swap(self._on_swap)
 
     def with_drift_monitor(self, monitor) -> "ModelServer":
@@ -136,6 +140,17 @@ class ModelServer:
     def stop(self, drain: bool = True) -> None:
         self.batcher.close(drain=drain)
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting (new submits shed with reason ``"draining"``);
+        queued and in-flight batches complete normally.  ``/healthz``
+        reports status "draining" so the fabric router deregisters this
+        host before ``stop(drain=True)`` tears the dispatch loop down."""
+        self._draining = True
+
     def __enter__(self) -> "ModelServer":
         return self.start()
 
@@ -146,6 +161,12 @@ class ModelServer:
 
     def submit(self, rows: Sequence[Dict[str, Any]],
                timeout_ms: Optional[float] = None) -> "Future[List[Any]]":
+        if self._draining:
+            self.metrics.record_shed(len(rows), reason="draining")
+            fut: "Future[List[Any]]" = Future()
+            fut.set_result([ShedResult(reason="draining")
+                            for _ in rows])
+            return fut
         return self.batcher.submit(rows, timeout_ms=timeout_ms)
 
     def score(self, rows: Sequence[Dict[str, Any]],
@@ -260,5 +281,12 @@ class ModelServer:
         return snap
 
 
-# imported last: tenancy composes ModelServer instances per tenant
+# imported last: tenancy composes ModelServer instances per tenant, the
+# fabric composes whole servers into a multi-host plane
+from .fabric import (ControlChannel, FleetSwapController,  # noqa: E402
+                     HashRing, HttpHostHandle, LocalHostHandle,
+                     ServingFabric)
 from .tenancy import MultiTenantServer, TenantConfig  # noqa: E402
+
+__all__ += ["ServingFabric", "HashRing", "LocalHostHandle",
+            "HttpHostHandle", "ControlChannel", "FleetSwapController"]

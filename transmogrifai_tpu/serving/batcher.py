@@ -159,13 +159,13 @@ class MicroBatcher:
         if self._closing or self._closed:
             fut.set_result([ShedResult(reason="shutting_down")
                             for _ in rows])
-            self.metrics.record_shed(len(rows))
+            self.metrics.record_shed(len(rows), reason="shutting_down")
             end_span(admit_span, outcome="shed:shutting_down")
             return fut
         shed = self.admission.try_admit(
             len(rows), est_drain_ms=self._est_drain_ms())
         if shed is not None:
-            self.metrics.record_shed(len(rows))
+            self.metrics.record_shed(len(rows), reason=shed.reason)
             fut.set_result([shed for _ in rows])
             end_span(admit_span, outcome=f"shed:{shed.reason}")
             record_event("serve.shed", rows=len(rows), reason=shed.reason)
@@ -177,7 +177,8 @@ class MicroBatcher:
                 # admission reservation and shed — NEVER enqueue into a
                 # queue the dispatcher may already consider drained
                 self.admission.release(len(rows))
-                self.metrics.record_shed(len(rows))
+                self.metrics.record_shed(len(rows),
+                                         reason="shutting_down")
                 end_span(admit_span, outcome="shed:shutting_down")
                 fut.set_result([ShedResult(reason="shutting_down")
                                 for _ in rows])

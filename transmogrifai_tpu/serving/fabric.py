@@ -1,0 +1,1004 @@
+"""Pod-scale serving fabric — fault-tolerant multi-host serving plane.
+
+ROADMAP open item 2 (the "millions of users" half of the north star): the
+serving plane of PRs 1/13 is one process; this module is the shared-
+nothing ROUTER over N per-host ``ModelServer``/``MultiTenantServer``
+replicas that makes it a fleet.  The TPU serving comparison (PAPERS.md)
+makes the two points the design reproduces: cold-start/compile reuse
+dominates fleet elasticity (the shared :class:`~transmogrifai_tpu.utils.
+compile_cache.AOTStore` directory — one host's compile warms every later
+cold start), and tail latency under replica CHURN — not steady-state
+throughput — is what distinguishes a production tier (health-routed
+failover with zero failed requests through a host SIGKILL).
+
+Layers:
+
+* **placement** — :class:`HashRing`: consistent-hash tenant→host mapping
+  over virtual nodes (stable digests, never Python ``hash()``), so every
+  router instance computes the SAME placement and adding a host remaps
+  only the tenants it takes over;
+* **health** — :meth:`ServingFabric.probe_once` polls every host's
+  ``/healthz`` (heartbeat age + breaker state + shed rate); eviction and
+  readmission are HYSTERETIC (consecutive-failure/age thresholds to
+  evict, ``readmit_probes`` consecutive healthy probes to readmit) so a
+  flapping host cannot oscillate in and out of rotation;
+* **routing** — per-request deadline budgets; bounded spill to the next
+  ring neighbors under quota pressure (``max_spill``); single-retry
+  failover to a survivor on transport failure (idempotent scoring makes
+  the retry safe, and the router-level tenant quota is acquired ONCE per
+  request so a retried request never double-counts);
+* **drain vs kill** — a draining host (SIGTERM → ``begin_drain`` → shed
+  new admissions with reason ``"draining"`` → in-flight completes →
+  deregister) leaves rotation gracefully; a SIGKILLed host is evicted by
+  heartbeat timeout and its in-flight requests are retried to survivors
+  — the zero-failed-requests path bench_serving's pod leg gates on;
+* **control channel** — :class:`ControlChannel` rides the PR 15 host-
+  collective substrate (``PodContext.broadcast_obj``/``allgather_obj``)
+  so registry swaps/rollbacks and drift baselines are FLEET-consistent:
+  :class:`FleetSwapController` makes a ``GuardedSwap``-style bake verdict
+  collective — a bake failure on ANY replica vetoes the fleet swap, and
+  a rollback rolls every replica back.
+
+Determinism: failover choices are a pure function of ring order + health
+state, and retry jitter comes from a stateless seeded draw keyed on
+``(seed, request, attempt)`` (like ``readers/resilience.RetryPolicy`` —
+never ``random`` module state), so two routers at one seed make identical
+choices and the SIGKILL bench leg replays byte-identically.  The
+``host.heartbeat`` / ``router.forward`` / ``swap.propagate`` fault points
+(utils/faults.py) make the whole failover/veto matrix seed-testable.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs.flight import record_event
+from ..utils import faults
+from .admission import ShedResult
+from .guarded import probe_digest
+from .metrics import LatencyReservoir
+
+__all__ = ["HashRing", "HostUnavailable", "LocalHostHandle",
+           "HttpHostHandle", "TenantQuota", "FabricMetrics",
+           "ServingFabric", "ControlChannel", "FleetSwapController",
+           "stable_digest", "probe_digest"]
+
+
+def stable_digest(*parts: Any) -> int:
+    """Stable 64-bit digest of the joined parts — placement and jitter
+    must never depend on process-seeded ``hash()``."""
+    raw = "\x1f".join(str(p) for p in parts).encode()
+    return int.from_bytes(hashlib.blake2s(raw, digest_size=8).digest(),
+                          "big")
+
+
+class HostUnavailable(RuntimeError):
+    """Transport-level failure talking to one host (connection refused /
+    reset, timeout, malformed response) — the class of error the single-
+    retry failover absorbs."""
+
+
+# ---------------------------------------------------------------------------
+# placement — consistent-hash ring over virtual nodes
+# ---------------------------------------------------------------------------
+
+class HashRing:
+    """Consistent-hash ring: ``vnodes`` virtual points per host, placed
+    by stable digest.  ``candidates(key)`` returns the distinct hosts in
+    ring order from the key's point — element 0 is the primary placement,
+    the rest the bounded-spill / failover order.  Adding a host remaps
+    only the keys whose arcs it takes over (test-pinned)."""
+
+    def __init__(self, hosts: Sequence[str] = (), vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._hosts: List[str] = []
+        self._points: List[Any] = []  # sorted (point, host)
+        for h in hosts:
+            self.add(h)
+
+    def add(self, host: str) -> None:
+        if host in self._hosts:
+            return
+        self._hosts.append(host)
+        for v in range(self.vnodes):
+            bisect.insort(self._points,
+                          (stable_digest("vnode", host, v), host))
+
+    def remove(self, host: str) -> None:
+        if host not in self._hosts:
+            return
+        self._hosts.remove(host)
+        self._points = [p for p in self._points if p[1] != host]
+
+    def hosts(self) -> List[str]:
+        return sorted(self._hosts)
+
+    def candidates(self, key: str, k: Optional[int] = None) -> List[str]:
+        if not self._points:
+            return []
+        point = stable_digest("tenant", key)
+        i = bisect.bisect_left(self._points, (point, "")) \
+            % len(self._points)
+        out: List[str] = []
+        seen = set()
+        for j in range(len(self._points)):
+            host = self._points[(i + j) % len(self._points)][1]
+            if host not in seen:
+                seen.add(host)
+                out.append(host)
+                if k is not None and len(out) >= k:
+                    break
+        return out
+
+    def primary(self, key: str) -> Optional[str]:
+        c = self.candidates(key, 1)
+        return c[0] if c else None
+
+
+# ---------------------------------------------------------------------------
+# host handles — the router's transport seam
+# ---------------------------------------------------------------------------
+
+class LocalHostHandle:
+    """In-process replica handle (deterministic unit tests + single-
+    process fleets): wraps a ``ModelServer``/``MultiTenantServer``
+    directly.  ``kill()`` simulates a SIGKILLed host (every call raises
+    :class:`HostUnavailable` until ``restart()``)."""
+
+    def __init__(self, host_id: str, server: Any):
+        self.host_id = str(host_id)
+        self.server = server
+        self.killed = False
+
+    def _check(self) -> None:
+        if self.killed:
+            raise HostUnavailable(f"host {self.host_id} is down")
+
+    def forward(self, rows: Sequence[Dict[str, Any]],
+                tenant: Optional[str] = None,
+                timeout_s: Optional[float] = None) -> List[Any]:
+        self._check()
+        timeout_ms = None if timeout_s is None else timeout_s * 1000.0
+        wait_s = None if timeout_s is None else timeout_s + 5.0
+        try:
+            if getattr(self.server, "is_multi_tenant", False):
+                return self.server.score(rows, tenant=tenant,
+                                         timeout_ms=timeout_ms,
+                                         wait_s=wait_s)
+            return self.server.score(rows, timeout_ms=timeout_ms,
+                                     wait_s=wait_s)
+        except FutureTimeout as exc:
+            raise HostUnavailable(
+                f"host {self.host_id} deadline overrun") from exc
+
+    def healthz(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        self._check()
+        from .http import healthz_doc
+
+        return healthz_doc(self.server)[1]
+
+    def swap(self, path: str, tenant: Optional[str] = None) -> Any:
+        self._check()
+        if getattr(self.server, "is_multi_tenant", False):
+            return self.server.swap(tenant, path)
+        return self.server.swap(path)
+
+    def drain(self) -> None:
+        self._check()
+        self.server.begin_drain()
+
+    def kill(self) -> None:
+        self.killed = True
+
+    def restart(self) -> None:
+        self.killed = False
+
+
+class HttpHostHandle:
+    """HTTP replica handle against ``serving/http.py`` endpoints.  Every
+    transport-level problem (refused/reset connection, timeout, non-JSON
+    body) raises :class:`HostUnavailable`; structured 503 sheds come back
+    as ``ShedResult`` rows, exactly like the in-process path."""
+
+    def __init__(self, host_id: str, address: str,
+                 connect_timeout_s: float = 2.0):
+        self.host_id = str(host_id)
+        self.address = str(address)  # "127.0.0.1:8080"
+        self.connect_timeout_s = float(connect_timeout_s)
+
+    def _request(self, method: str, path: str, body: Any = None,
+                 timeout_s: Optional[float] = None):
+        import http.client
+
+        timeout = timeout_s if timeout_s and timeout_s > 0 \
+            else self.connect_timeout_s
+        conn = http.client.HTTPConnection(self.address, timeout=timeout)
+        try:
+            payload = None if body is None else json.dumps(
+                body, default=str).encode()
+            headers = {"Content-Type": "application/json"} \
+                if payload is not None else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        except (OSError, http.client.HTTPException,
+                json.JSONDecodeError) as exc:
+            raise HostUnavailable(
+                f"host {self.host_id} transport failure: "
+                f"{type(exc).__name__}") from exc
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _parse_row(r: Any) -> Any:
+        if isinstance(r, dict) and r.get("status") == 503 and "reason" in r:
+            return ShedResult(reason=r["reason"],
+                              queue_depth=r.get("queueDepth"),
+                              retry_after_ms=r.get("retryAfterMs"))
+        return r
+
+    def forward(self, rows: Sequence[Dict[str, Any]],
+                tenant: Optional[str] = None,
+                timeout_s: Optional[float] = None) -> List[Any]:
+        body: Dict[str, Any] = {"rows": list(rows)}
+        if tenant is not None:
+            body["tenant"] = tenant
+        if timeout_s is not None:
+            body["timeoutMs"] = timeout_s * 1000.0
+        status, doc = self._request("POST", "/score", body, timeout_s)
+        if status in (200, 503) and isinstance(doc.get("scores"), list):
+            return [self._parse_row(r) for r in doc["scores"]]
+        raise HostUnavailable(
+            f"host {self.host_id} bad /score response ({status}): "
+            f"{doc.get('error')}")
+
+    def healthz(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        _status, doc = self._request("GET", "/healthz",
+                                     timeout_s=timeout_s)
+        return doc
+
+    def swap(self, path: str, tenant: Optional[str] = None) -> Any:
+        body: Dict[str, Any] = {"path": path}
+        if tenant is not None:
+            body["tenant"] = tenant
+        status, doc = self._request("POST", "/swap", body)
+        if status != 200:
+            raise RuntimeError(f"swap on {self.host_id} failed "
+                               f"({status}): {doc.get('error')}")
+        return doc
+
+    def drain(self) -> None:
+        self._request("POST", "/drain", {})
+
+
+# ---------------------------------------------------------------------------
+# router-level tenant quotas
+# ---------------------------------------------------------------------------
+
+class TenantQuota:
+    """Router-side in-flight row quota for one tenant.  Acquired ONCE per
+    request — retries and spills reuse the same admission, so a failed-
+    over request never double-counts (ISSUE-pinned)."""
+
+    def __init__(self, max_inflight_rows: int):
+        self.max_inflight_rows = int(max_inflight_rows)
+        self._lock = threading.Lock()
+        self._used = 0
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+    def try_acquire(self, n_rows: int) -> bool:
+        with self._lock:
+            if self._used + n_rows > self.max_inflight_rows:
+                return False
+            self._used += n_rows
+            return True
+
+    def release(self, n_rows: int) -> None:
+        with self._lock:
+            self._used = max(0, self._used - n_rows)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+_HOST_COUNTER_KEYS = ("forwards", "rows", "failovers", "spills",
+                      "probeFailures", "evictions", "readmissions")
+
+
+class FabricMetrics:
+    """Thread-safe router-side ledger: per-host counters (the Prometheus
+    ``host="..."`` labels) plus fleet-level request/shed/latency totals."""
+
+    def __init__(self, reservoir_capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._latency = LatencyReservoir(reservoir_capacity)
+        self.requests = 0
+        self.rows = 0
+        self.retried_requests = 0
+        self.shed_by_reason: Dict[str, int] = {}
+        self._hosts: Dict[str, Dict[str, int]] = {}
+
+    def _host(self, host: str) -> Dict[str, int]:
+        h = self._hosts.get(host)
+        if h is None:
+            h = self._hosts[host] = {k: 0 for k in _HOST_COUNTER_KEYS}
+        return h
+
+    def record_request(self, host: str, n_rows: int, seconds: float,
+                       retried: bool = False) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += n_rows
+            if retried:
+                self.retried_requests += 1
+            self._latency.observe(seconds)
+            h = self._host(host)
+            h["forwards"] += 1
+            h["rows"] += n_rows
+
+    def record_failover(self, host: str) -> None:
+        with self._lock:
+            self._host(host)["failovers"] += 1
+
+    def record_spill(self, host: str) -> None:
+        with self._lock:
+            self._host(host)["spills"] += 1
+
+    def record_probe_failure(self, host: str) -> None:
+        with self._lock:
+            self._host(host)["probeFailures"] += 1
+
+    def record_evict(self, host: str) -> None:
+        with self._lock:
+            self._host(host)["evictions"] += 1
+
+    def record_readmit(self, host: str) -> None:
+        with self._lock:
+            self._host(host)["readmissions"] += 1
+
+    def record_shed(self, reason: str, n_rows: int) -> None:
+        with self._lock:
+            self.shed_by_reason[reason] = \
+                self.shed_by_reason.get(reason, 0) + n_rows
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = {f"p{int(q * 100)}": (None if v is None
+                                        else round(v * 1000.0, 3))
+                   for q, v in ((q, self._latency.quantile(q))
+                                for q in (0.50, 0.95, 0.99))}
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "retriedRequests": self.retried_requests,
+                "shedByReason": dict(sorted(self.shed_by_reason.items())),
+                "latencyMs": lat,
+                "hosts": {h: dict(c)
+                          for h, c in sorted(self._hosts.items())},
+            }
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class _HostState:
+    """Router-side health record for one host."""
+
+    def __init__(self, host_id: str, now: float):
+        self.host_id = host_id
+        self.last_seen = now          # monotonic time of last healthy obs
+        self.evicted = False
+        self.draining = False
+        self.consecutive_fail = 0
+        self.consecutive_ok = 0
+        self.breaker_state: Optional[str] = None
+        self.shed_rate = 0.0
+        self.probes = 0
+
+    def admitting(self) -> bool:
+        return not self.evicted and not self.draining
+
+    def describe(self, now: float) -> Dict[str, Any]:
+        return {"evicted": self.evicted, "draining": self.draining,
+                "heartbeatAgeSecs": round(now - self.last_seen, 3),
+                "consecutiveFail": self.consecutive_fail,
+                "consecutiveOk": self.consecutive_ok,
+                "breakerState": self.breaker_state,
+                "shedRate": self.shed_rate}
+
+
+class ServingFabric:
+    """Shared-nothing router over N host replicas.
+
+    ``hosts`` is an iterable of handles (``LocalHostHandle`` /
+    ``HttpHostHandle`` / anything with ``host_id``/``forward``/
+    ``healthz``).  ``tenant_quota_rows`` (int, or ``{tenant: int}``) arms
+    the router-level in-flight quota; ``record_decisions=True`` keeps the
+    per-request decision log the determinism gate compares."""
+
+    def __init__(self, hosts: Sequence[Any] = (), seed: int = 0,
+                 vnodes: int = 64, max_spill: int = 1,
+                 retry_limit: int = 1,
+                 default_timeout_ms: Optional[float] = 2000.0,
+                 evict_after_s: float = 3.0,
+                 probe_fail_threshold: int = 2,
+                 readmit_probes: int = 2,
+                 shed_rate_spill: float = 0.5,
+                 retry_base_s: float = 0.002,
+                 retry_cap_s: float = 0.05,
+                 probe_timeout_s: float = 2.0,
+                 tenant_quota_rows: Any = None,
+                 record_decisions: bool = False):
+        self.seed = int(seed)
+        self.max_spill = int(max_spill)
+        self.retry_limit = int(retry_limit)
+        self.default_timeout_ms = default_timeout_ms
+        self.evict_after_s = float(evict_after_s)
+        self.probe_fail_threshold = int(probe_fail_threshold)
+        self.readmit_probes = int(readmit_probes)
+        self.shed_rate_spill = float(shed_rate_spill)
+        self.retry_base_s = float(retry_base_s)
+        self.retry_cap_s = float(retry_cap_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.metrics = FabricMetrics()
+        self.ring = HashRing(vnodes=vnodes)
+        self._hosts: Dict[str, Any] = {}
+        self._states: Dict[str, _HostState] = {}
+        self._quotas: Dict[str, TenantQuota] = {}
+        self._quota_rows = tenant_quota_rows
+        self._lock = threading.Lock()   # LEAF: seq/log/quota-map only
+        self._req_seq = 0
+        self.decisions: Optional[List[Dict[str, Any]]] = \
+            [] if record_decisions else None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_stop = threading.Event()
+        for h in hosts:
+            self.add_host(h)
+
+    # -- topology ------------------------------------------------------------
+
+    def add_host(self, handle: Any) -> None:
+        host_id = handle.host_id
+        self._hosts[host_id] = handle
+        self._states[host_id] = _HostState(host_id, time.monotonic())
+        self.ring.add(host_id)
+        record_event("fabric.add_host", host=host_id)
+
+    def remove_host(self, host_id: str) -> None:
+        """Deregister (the drain protocol's last step)."""
+        self._hosts.pop(host_id, None)
+        self._states.pop(host_id, None)
+        self.ring.remove(host_id)
+        record_event("fabric.remove_host", host=host_id)
+
+    def hosts(self) -> List[str]:
+        return sorted(self._hosts)
+
+    def host_state(self, host_id: str) -> _HostState:
+        return self._states[host_id]
+
+    # -- health --------------------------------------------------------------
+
+    def probe_once(self, now: Optional[float] = None) -> Dict[str, bool]:
+        """One health sweep over every host (deterministic tests/benches
+        drive this directly; ``start_probing`` runs it on a thread).
+        Returns ``{host_id: admitting}`` after the sweep."""
+        now = time.monotonic() if now is None else now
+        for host_id in sorted(self._hosts):
+            st = self._states[host_id]
+            st.probes += 1
+            observed, ok, doc = True, False, None
+            try:
+                faults.fire("host.heartbeat", tag=host_id)
+                doc = self._hosts[host_id].healthz(
+                    timeout_s=self.probe_timeout_s)
+                ok = doc.get("status") in ("ok", "degraded", "draining")
+            except faults.FaultSkip:
+                observed = False   # suppressed heartbeat: age keeps growing
+            except Exception:
+                ok = False
+            if observed:
+                if ok:
+                    st.last_seen = now
+                    st.consecutive_ok += 1
+                    st.consecutive_fail = 0
+                    st.breaker_state = doc.get("breakerState")
+                    st.shed_rate = float(doc.get("shedRate") or 0.0)
+                    st.draining = (doc.get("status") == "draining"
+                                   or bool(doc.get("draining")))
+                else:
+                    st.consecutive_fail += 1
+                    st.consecutive_ok = 0
+                    self.metrics.record_probe_failure(host_id)
+            age = now - st.last_seen
+            if not st.evicted and (
+                    st.consecutive_fail >= self.probe_fail_threshold
+                    or age > self.evict_after_s):
+                reason = ("probe_failures"
+                          if st.consecutive_fail
+                          >= self.probe_fail_threshold
+                          else "heartbeat_timeout")
+                self._evict(host_id, reason)
+            elif st.evicted and st.consecutive_ok >= self.readmit_probes:
+                self._readmit(host_id)
+        return {h: self._states[h].admitting() for h in sorted(self._hosts)}
+
+    def _evict(self, host_id: str, reason: str) -> None:
+        st = self._states[host_id]
+        st.evicted = True
+        st.consecutive_ok = 0   # hysteresis: readmission starts from zero
+        self.metrics.record_evict(host_id)
+        record_event("fabric.evict", host=host_id, reason=reason)
+
+    def _readmit(self, host_id: str) -> None:
+        st = self._states[host_id]
+        st.evicted = False
+        st.consecutive_fail = 0
+        self.metrics.record_readmit(host_id)
+        record_event("fabric.readmit", host=host_id)
+
+    def start_probing(self, interval_s: float = 0.5) -> None:
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            return
+        self._probe_stop.clear()
+
+        def loop():
+            while not self._probe_stop.wait(interval_s):
+                self.probe_once()
+
+        self._probe_thread = threading.Thread(
+            target=loop, name="op-fabric-probe", daemon=True)
+        self._probe_thread.start()
+
+    def stop_probing(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
+            self._probe_thread = None
+
+    def drain_host(self, host_id: str) -> None:
+        """Graceful-drain entry: tell the host to stop admissions and
+        mark it non-admitting immediately (in-flight completes on the
+        host; ``remove_host`` deregisters once it exits)."""
+        self._states[host_id].draining = True
+        try:
+            self._hosts[host_id].drain()
+        finally:
+            record_event("fabric.drain", host=host_id)
+
+    # -- deterministic jitter ------------------------------------------------
+
+    def failover_jitter_s(self, request_id: int, attempt: int) -> float:
+        """Stateless seeded backoff draw — keyed on (seed, request,
+        attempt), independent of call interleaving across threads, so two
+        routers at one seed produce identical delays."""
+        h = stable_digest("jitter", self.seed, request_id, attempt)
+        rng = np.random.default_rng(h & 0xFFFFFFFF)
+        base = self.retry_base_s * (2.0 ** (attempt - 1))
+        return float(min(self.retry_cap_s, base * (1.0 + rng.random())))
+
+    # -- routing -------------------------------------------------------------
+
+    def _quota_for(self, tenant: str) -> Optional[TenantQuota]:
+        cfg = self._quota_rows
+        if cfg is None:
+            return None
+        with self._lock:
+            q = self._quotas.get(tenant)
+            if q is None:
+                rows = cfg.get(tenant) if isinstance(cfg, dict) else cfg
+                if rows is None:
+                    return None
+                q = self._quotas[tenant] = TenantQuota(rows)
+            return q
+
+    def _pressured(self, host_id: str) -> bool:
+        st = self._states[host_id]
+        return (st.breaker_state == "open"
+                or st.shed_rate > self.shed_rate_spill)
+
+    def _log(self, req: int, tenant: str, attempted: List[str],
+             served: str) -> None:
+        if self.decisions is not None:
+            with self._lock:
+                self.decisions.append({
+                    "request": req, "tenant": tenant,
+                    "attempted": list(attempted), "served": served})
+
+    def _note_forward_failure(self, host_id: str) -> None:
+        st = self._states.get(host_id)
+        if st is None:
+            return
+        st.consecutive_fail += 1
+        st.consecutive_ok = 0
+        if (not st.evicted
+                and st.consecutive_fail >= self.probe_fail_threshold):
+            self._evict(host_id, "forward_failures")
+
+    def _note_forward_success(self, host_id: str) -> None:
+        st = self._states.get(host_id)
+        if st is None:
+            return
+        st.last_seen = time.monotonic()
+        st.consecutive_fail = 0
+
+    def score(self, rows: Sequence[Dict[str, Any]],
+              tenant: str = "default",
+              timeout_ms: Optional[float] = None) -> List[Any]:
+        """Route one scoring request: placement → bounded spill under
+        quota pressure → single-retry failover on transport failure, all
+        within the request's deadline budget.  Every row comes back as a
+        score map or a ``ShedResult`` — never an exception storm."""
+        rows = list(rows)
+        if not rows:
+            return []
+        with self._lock:
+            self._req_seq += 1
+            req = self._req_seq
+        t0 = time.monotonic()
+        budget_ms = timeout_ms if timeout_ms is not None \
+            else self.default_timeout_ms
+        deadline = None if budget_ms is None else t0 + budget_ms / 1000.0
+        quota = self._quota_for(tenant)
+        if quota is not None and not quota.try_acquire(len(rows)):
+            self.metrics.record_shed("tenant_quota", len(rows))
+            self._log(req, tenant, [], "shed:tenant_quota")
+            return [ShedResult(reason="tenant_quota") for _ in rows]
+        try:
+            # the quota token is held across EVERY attempt below: a
+            # retried/spilled request is admitted once, not re-admitted
+            return self._route(req, rows, tenant, deadline, t0)
+        finally:
+            if quota is not None:
+                quota.release(len(rows))
+
+    def _shed(self, req: int, tenant: str, attempted: List[str],
+              reason: str, n: int) -> List[Any]:
+        self.metrics.record_shed(reason, n)
+        self._log(req, tenant, attempted, f"shed:{reason}")
+        return [ShedResult(reason=reason) for _ in range(n)]
+
+    def _route(self, req: int, rows: List[Dict[str, Any]], tenant: str,
+               deadline: Optional[float], t0: float) -> List[Any]:
+        order = [h for h in self.ring.candidates(tenant)
+                 if self._states[h].admitting()]
+        attempted: List[str] = []
+        spills = 0
+        retries = 0
+        i = 0
+        last_shed: Optional[ShedResult] = None
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                return self._shed(req, tenant, attempted, "deadline",
+                                  len(rows))
+            while i < len(order) and not self._states[
+                    order[i]].admitting():
+                i += 1   # evicted mid-request (e.g. by our own failure)
+            if i >= len(order):
+                reason = last_shed.reason if last_shed is not None \
+                    else "no_hosts"
+                return self._shed(req, tenant, attempted, reason,
+                                  len(rows))
+            host = order[i]
+            # proactive spill: the placement target is shedding or its
+            # breaker is open — prefer the next neighbor (bounded)
+            if (spills < self.max_spill and i + 1 < len(order)
+                    and self._pressured(host)
+                    and not self._pressured(order[i + 1])):
+                spills += 1
+                self.metrics.record_spill(host)
+                record_event("fabric.spill", host=host, request=req,
+                             reason="pressure")
+                i += 1
+                continue
+            attempted.append(host)
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            try:
+                faults.fire("router.forward", tag=host)
+                out = self._hosts[host].forward(
+                    rows, tenant=tenant, timeout_s=remaining)
+            except (HostUnavailable, OSError, FutureTimeout,
+                    TimeoutError) as exc:
+                self._note_forward_failure(host)
+                self.metrics.record_failover(host)
+                record_event("fabric.failover", host=host, request=req,
+                             error=type(exc).__name__)
+                if retries >= self.retry_limit:
+                    return self._shed(req, tenant, attempted,
+                                      "upstream_error", len(rows))
+                retries += 1
+                delay = self.failover_jitter_s(req, retries)
+                if remaining is not None:
+                    delay = max(0.0, min(delay, remaining))
+                if delay > 0:
+                    time.sleep(delay)
+                i += 1
+                continue
+            self._note_forward_success(host)
+            sheds = [r for r in out if isinstance(r, ShedResult)]
+            if (sheds and len(sheds) == len(out)
+                    and sheds[0].reason in ("queue_full", "draining",
+                                            "shutting_down")):
+                # quota pressure on the placement target: bounded spill
+                # to the next ring neighbor
+                last_shed = sheds[0]
+                self.metrics.record_spill(host)
+                record_event("fabric.spill", host=host, request=req,
+                             reason=sheds[0].reason)
+                if sheds[0].reason == "draining":
+                    self._states[host].draining = True
+                if spills >= self.max_spill:
+                    self._log(req, tenant, attempted,
+                              f"shed:{sheds[0].reason}")
+                    self.metrics.record_shed(sheds[0].reason, len(rows))
+                    return out
+                spills += 1
+                i += 1
+                continue
+            self.metrics.record_request(host, len(rows),
+                                        time.monotonic() - t0,
+                                        retried=retries > 0)
+            self._log(req, tenant, attempted, host)
+            return out
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        snap = self.metrics.snapshot()
+        hosts = snap.get("hosts", {})
+        for host_id in sorted(self._hosts):
+            doc = hosts.setdefault(
+                host_id, {k: 0 for k in _HOST_COUNTER_KEYS})
+            doc.update(self._states[host_id].describe(now))
+        snap["hosts"] = hosts
+        snap["ring"] = {"vnodes": self.ring.vnodes,
+                        "hosts": self.ring.hosts()}
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# control channel + fleet-consistent swaps (PR 15 substrate)
+# ---------------------------------------------------------------------------
+
+class ControlChannel:
+    """Small fleet-control bus on the pod host-collective substrate.
+
+    Every operation is a COLLECTIVE: all pod processes call it at the
+    same point (the collective ledger, TM07x, attributes any divergence).
+    ``publish`` broadcasts the coordinator's message; the
+    ``swap.propagate`` fault point fires AFTER the exchange, so an armed
+    ``skip`` drops the message on one process only — the transport stays
+    lockstep while the delivery is lost, exactly a dropped control
+    datagram, and the verdict gather detects it."""
+
+    def __init__(self, transport: Any = None):
+        self._transport = transport
+        self.seq = 0
+
+    def _pod(self) -> Any:
+        if self._transport is not None:
+            return self._transport
+        from ..distributed.runtime import current_pod
+
+        return current_pod()
+
+    @property
+    def process_index(self) -> int:
+        return int(getattr(self._pod(), "process_index", 0))
+
+    @property
+    def process_count(self) -> int:
+        return int(getattr(self._pod(), "process_count", 1))
+
+    def is_coordinator(self) -> bool:
+        pod = self._pod()
+        if hasattr(pod, "is_coordinator"):
+            return bool(pod.is_coordinator())
+        return True
+
+    def publish(self, msg: Optional[Dict[str, Any]]
+                ) -> Optional[Dict[str, Any]]:
+        """Coordinator's ``msg`` lands on every process; replicas may
+        pass anything (conventionally their own draft — ignored).
+        Returns the delivered message, or None when an armed
+        ``swap.propagate`` fault dropped it locally."""
+        pod = self._pod()
+        self.seq += 1
+        out = pod.broadcast_obj(msg if self.is_coordinator() else None,
+                                kind="fabric.control")
+        op = (out or {}).get("op") if isinstance(out, dict) else None
+        try:
+            faults.fire("swap.propagate", tag=op, index=self.seq - 1)
+        except faults.FaultSkip:
+            record_event("fabric.control_drop", seq=self.seq - 1, op=op)
+            return None
+        return out
+
+    def gather(self, obj: Any) -> List[Any]:
+        """Allgather one object per process (verdict collection)."""
+        pod = self._pod()
+        return pod.allgather_obj(obj, _kind="fabric.verdicts")
+
+
+class FleetSwapController:
+    """Fleet-consistent guarded swap/rollback over the control channel.
+
+    The single-host ``GuardedSwap`` gates a swap on one replica's shadow
+    + bake verdict; at pod scale the verdict must be FLEET-consistent.
+    Protocol (every process calls :meth:`fleet_swap` at a synchronized
+    point — all branches below derive from allgathered data, so every
+    process takes the same one):
+
+    1. the coordinator publishes ``{"op": "swap", path, probe}`` (probe
+       rows ride the message so every replica bakes the SAME queries);
+    2. every replica that received it applies — pin the outgoing
+       generation first (the rollback target), load the artifact, bake-
+       score the probe rows (``swap.bake`` fault point) — and digests
+       its answers;
+    3. verdicts allgather; every process computes the same decision:
+       a bake failure on ANY replica **vetoes** the fleet swap (all
+       applied replicas roll back to the pinned generation); a dropped
+       control message (non-receipt) triggers ONE repair re-publish
+       before the rollback; divergent probe digests (replicas loaded
+       different artifacts) also veto.
+    """
+
+    def __init__(self, registry: Any, name: str,
+                 channel: Optional[ControlChannel] = None,
+                 metrics: Any = None, max_repairs: int = 1):
+        self.registry = registry
+        self.name = name
+        self.channel = channel or ControlChannel()
+        self.metrics = metrics
+        self.max_repairs = int(max_repairs)
+        self._probes = 0
+        self._round_applied = False
+        self._pending: Optional[Dict[str, Any]] = None
+        self.last_result: Optional[Dict[str, Any]] = None
+
+    # -- one replica's apply+bake -------------------------------------------
+
+    def _apply(self, msg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        idx = self.channel.process_index
+        if msg is None:
+            cur = self.registry.maybe_get(self.name)
+            return {"process": idx, "received": False, "ok": False,
+                    "reason": "not_received",
+                    "version": cur.version if cur else None,
+                    "digest": None}
+        if self._pending is not None:
+            # repair round: already applied this candidate — re-verdict
+            # from the recorded bake, don't re-load
+            return {"process": idx, "received": True, "ok": True,
+                    "reason": None,
+                    "version": self._pending["version"],
+                    "digest": self._pending["digest"]}
+        version = None
+        try:
+            if self.registry.maybe_get(self.name) is not None:
+                # outgoing generation = the fleet rollback target
+                self.registry.pin(self.name)
+            entry = self.registry.load(self.name, msg["path"])
+            self._round_applied = True
+            version = entry.version
+            self._probes += 1
+            faults.fire("swap.bake", tag="fleet", index=self._probes - 1)
+            digest = probe_digest(entry.scorer, msg.get("probe") or [])
+            self._pending = {"version": entry.version, "digest": digest}
+            return {"process": idx, "received": True, "ok": True,
+                    "reason": None, "version": entry.version,
+                    "digest": digest}
+        except Exception as exc:
+            return {"process": idx, "received": True, "ok": False,
+                    "reason": f"bake:{type(exc).__name__}",
+                    "version": version, "digest": None}
+
+    def _rollback_local(self, reason: str) -> None:
+        if not self._round_applied:
+            return   # this replica never switched; nothing to undo
+        if self.registry.pinned(self.name) is not None:
+            self.registry.rollback(self.name)
+        else:
+            self.registry.evict(self.name)   # first deploy: no fallback
+        if self.metrics is not None:
+            self.metrics.record_rollback(reason)
+
+    # -- the collective ------------------------------------------------------
+
+    def fleet_swap(self, path: Optional[str] = None,
+                   probe_rows: Optional[Sequence[Dict[str, Any]]] = None
+                   ) -> Dict[str, Any]:
+        """COLLECTIVE: run on every pod process.  The coordinator's
+        ``path``/``probe_rows`` are authoritative (replicas may pass
+        None).  Returns the fleet decision (identical on every
+        process)."""
+        self._round_applied = False
+        self._pending = None
+        draft = {"op": "swap", "path": path,
+                 "probe": list(probe_rows or [])}
+        msg = self.channel.publish(draft)
+        repairs = 0
+        while True:
+            verdict = self._apply(msg)
+            verdicts = self.channel.gather(verdict)
+            vetoes = [v for v in verdicts
+                      if v["received"] and not v["ok"]]
+            missing = [v for v in verdicts if not v["received"]]
+            digests = {v["digest"] for v in verdicts
+                       if v["received"] and v["ok"]}
+            reasons = sorted(
+                f"p{v['process']}:{v['reason']}" for v in vetoes)
+            if len(digests) > 1:
+                reasons.append("digest_divergence")
+            if not reasons and not missing:
+                return self._conclude(True, verdicts, [])
+            if reasons or repairs >= self.max_repairs:
+                if missing and not reasons:
+                    reasons.append("control_message_lost")
+                return self._conclude(False, verdicts, reasons)
+            # non-receipt only, repair budget left: re-publish — applied
+            # replicas re-verdict from their recorded bake, the dropped
+            # one applies now
+            repairs += 1
+            record_event("fleet.repair",
+                         missing=[v["process"] for v in missing])
+            msg = self.channel.publish(draft)
+
+    def _conclude(self, accepted: bool, verdicts: List[Dict[str, Any]],
+                  reasons: List[str]) -> Dict[str, Any]:
+        versions = sorted({v["version"] for v in verdicts
+                           if v["version"] is not None})
+        result = {"accepted": accepted, "reasons": reasons,
+                  "verdicts": verdicts, "versions": versions,
+                  "processes": len(verdicts)}
+        if accepted:
+            record_event("fleet.swap", version=versions[-1]
+                         if versions else None,
+                         processes=len(verdicts))
+        else:
+            record_event("fleet.veto", reasons=reasons,
+                         processes=len(verdicts))
+            self._rollback_local(";".join(reasons) or "fleet_veto")
+            record_event("fleet.rollback", reasons=reasons)
+        if self.metrics is not None:
+            self.metrics.record_swap_decision(
+                {"accepted": accepted, "reasons": reasons,
+                 "checks": {"fleet": len(verdicts),
+                            "versions": versions},
+                 "version": versions[-1] if versions else None})
+        self._pending = None
+        self._round_applied = False
+        self.last_result = result
+        return result
+
+    def sync_drift_baselines(self, baselines: Optional[Dict[str, Any]]
+                             = None) -> Optional[Dict[str, Any]]:
+        """COLLECTIVE: the coordinator's exported drift baselines land on
+        every replica (so fleet drift decisions compare against ONE
+        reference, not N per-host ones).  Returns the fleet baselines, or
+        None when the control message was dropped locally (caller keeps
+        its local baselines — the next sync repairs)."""
+        msg = self.channel.publish({"op": "drift",
+                                    "baselines": baselines})
+        if msg is None:
+            return None
+        out = msg.get("baselines")
+        record_event("fleet.drift_baselines",
+                     features=sorted(out) if isinstance(out, dict)
+                     else None)
+        return out

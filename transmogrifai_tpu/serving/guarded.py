@@ -40,6 +40,8 @@ paths are seed-deterministically testable.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import math
 import threading
 import time
@@ -53,7 +55,8 @@ from .drift import psi_from_counts
 from .metrics import ServingMetrics
 from .registry import ModelEntry, ModelRegistry
 
-__all__ = ["SwapGateConfig", "SwapDecision", "GuardedSwap"]
+__all__ = ["SwapGateConfig", "SwapDecision", "GuardedSwap",
+           "probe_digest"]
 
 
 class SwapGateConfig:
@@ -138,6 +141,22 @@ def _first_result(row_out: Dict[str, Any]) -> Any:
     for v in row_out.values():
         return v
     return None
+
+
+def probe_digest(scorer, rows: Sequence[Dict[str, Any]],
+                 decimals: int = 9) -> Optional[str]:
+    """Content digest of a scorer's answers on probe ``rows`` (rounded to
+    ``decimals`` so float formatting noise cannot diverge it).  The
+    fleet-swap consistency check (serving/fabric.FleetSwapController):
+    replicas that loaded the same artifact answer the same bake probe
+    byte-identically, so divergent digests across the pod mean divergent
+    artifacts — an automatic fleet veto."""
+    if not rows:
+        return None
+    out = scorer(list(rows))
+    scores = [round(_score_of(_first_result(o)), decimals) for o in out]
+    return hashlib.sha256(
+        json.dumps(scores, sort_keys=True).encode()).hexdigest()
 
 
 def _shadow_score(scorer, rows: Sequence[Dict[str, Any]],

@@ -16,15 +16,27 @@ Endpoints:
                  global RunCounters) in Prometheus text exposition for a
                  stock scraper (obs/prometheus.py) — multi-tenant servers
                  label every serving sample ``tenant="<name>"``
-  GET  /healthz  {"status": "ok", "model": {...}} (multi-tenant: per-tenant
-                 statuses; overall degraded if ANY tenant is)
+  GET  /healthz  {"status": "ok", "model": {...}, "shedRate": ...,
+                 "draining": ...} (multi-tenant: per-tenant statuses;
+                 overall degraded if ANY tenant is) — the router's
+                 health probe (serving/fabric.py) feeds on this doc
   GET  /tenants  multi-tenant only: configured tenants + weights
   POST /swap     {"path": "/models/titanic_v2"}           -> new entry info
                  (multi-tenant: {"tenant": ..., "path": ...})
+  POST /drain    begin graceful drain: stop admitting (new submits shed
+                 with reason "draining"), let in-flight complete; /healthz
+                 flips to "draining" so the router stops routing here
+
+Handler connections carry a SERVER-SIDE socket timeout
+(``request_timeout_s``): a stalled or half-open client used to hold its
+worker thread indefinitely, which under the fabric router's retry policy
+turns one slow client into a thread leak across the fleet — now the read
+times out and the connection closes.
 """
 from __future__ import annotations
 
 import json
+import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
@@ -32,7 +44,8 @@ from urllib.parse import parse_qs, urlsplit
 
 from .admission import ShedResult
 
-__all__ = ["make_http_server", "serve_forever"]
+__all__ = ["make_http_server", "serve_forever", "healthz_doc",
+           "install_sigterm_drain"]
 
 
 def _jsonable_scores(results) -> Tuple[list, bool]:
@@ -46,12 +59,77 @@ def _jsonable_scores(results) -> Tuple[list, bool]:
     return out, any_shed
 
 
-def make_http_server(server, host: str = "127.0.0.1",
-                     port: int = 8080) -> ThreadingHTTPServer:
+def _healthz_single_doc(srv) -> Tuple[bool, dict]:
+    """One server's health doc: model presence, breaker state, shed rate
+    (the fraction of offered rows shed — the router's spill signal), and
+    drain state."""
+    entry = srv.registry.maybe_get(srv.name)
+    breaker_state = srv.breaker.state
+    draining = bool(getattr(srv, "draining", False))
+    status = "ok" if entry else "no_model"
+    if entry and breaker_state != srv.breaker.CLOSED:
+        status = "degraded"  # serving, but from the host path
+    if entry and draining:
+        status = "draining"
+    snap = srv.metrics.snapshot()
+    offered = (snap.get("requests") or 0) + (snap.get("shed") or 0)
+    shed_rate = (snap.get("shed") or 0) / offered if offered else 0.0
+    return entry is not None, {
+        "status": status,
+        "model": entry.describe() if entry else None,
+        "breakerState": breaker_state,
+        "lastFallbackReason": srv.metrics.last_fallback_reason,
+        "shedRate": round(shed_rate, 4),
+        "draining": draining,
+    }
+
+
+def healthz_doc(server) -> Tuple[bool, dict]:
+    """The ``/healthz`` document for a single- or multi-tenant server —
+    module-level so in-process host handles (fabric.LocalHostHandle) see
+    the exact same doc a remote router reads over HTTP."""
+    if getattr(server, "is_multi_tenant", False):
+        tenants = {}
+        any_model, degraded = False, False
+        draining = bool(getattr(server, "draining", False))
+        shed_rates = []
+        for name in server.tenants():
+            ok, doc = _healthz_single_doc(server.tenant(name))
+            tenants[name] = doc
+            any_model = any_model or ok
+            degraded = degraded or doc["status"] not in ("ok", "draining")
+            shed_rates.append(doc["shedRate"])
+        status = "ok" if any_model else "no_model"
+        if any_model and degraded:
+            status = "degraded"
+        if any_model and draining:
+            status = "draining"
+        return any_model, {
+            "status": status,
+            "tenants": tenants,
+            "draining": draining,
+            "shedRate": round(max(shed_rates), 4) if shed_rates else 0.0,
+        }
+    return _healthz_single_doc(server)
+
+
+def make_http_server(server, host: str = "127.0.0.1", port: int = 8080,
+                     request_timeout_s: float = 30.0
+                     ) -> ThreadingHTTPServer:
     """Build (not start) an HTTP server wrapping ``ModelServer`` ``server``."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # server-side socket timeout: BaseHTTPRequestHandler applies this
+        # to the connection before reading the request line, so a half-
+        # open client releases its worker thread instead of pinning it
+        timeout = request_timeout_s
+
+        def handle_one_request(self):
+            try:
+                super().handle_one_request()
+            except TimeoutError:  # socket.timeout — stalled client
+                self.close_connection = True
 
         def log_message(self, fmt, *args):  # quiet by default
             pass
@@ -80,42 +158,14 @@ def make_http_server(server, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(body)
 
-        def _healthz_single(self, srv):
-            entry = srv.registry.maybe_get(srv.name)
-            breaker_state = srv.breaker.state
-            status = "ok" if entry else "no_model"
-            if entry and breaker_state != srv.breaker.CLOSED:
-                status = "degraded"  # serving, but from the host path
-            return entry is not None, {
-                "status": status,
-                "model": entry.describe() if entry else None,
-                "breakerState": breaker_state,
-                "lastFallbackReason":
-                    srv.metrics.last_fallback_reason,
-            }
-
         def do_GET(self):
             url = urlsplit(self.path)
             self.path = url.path
             query = parse_qs(url.query)
             multi = getattr(server, "is_multi_tenant", False)
             if self.path == "/healthz":
-                if multi:
-                    tenants = {}
-                    any_model, degraded = False, False
-                    for name in server.tenants():
-                        ok, doc = self._healthz_single(server.tenant(name))
-                        tenants[name] = doc
-                        any_model = any_model or ok
-                        degraded = degraded or doc["status"] != "ok"
-                    self._reply(200 if any_model else 503, {
-                        "status": ("degraded" if degraded else "ok")
-                        if any_model else "no_model",
-                        "tenants": tenants,
-                    })
-                else:
-                    ok, doc = self._healthz_single(server)
-                    self._reply(200 if ok else 503, doc)
+                ok, doc = healthz_doc(server)
+                self._reply(200 if ok else 503, doc)
             elif self.path == "/metrics":
                 fmt = (query.get("format") or ["json"])[0]
                 if fmt == "prometheus":
@@ -178,16 +228,48 @@ def make_http_server(server, host: str = "127.0.0.1",
                 except Exception as exc:
                     return self._reply(500, {"error": str(exc)})
                 self._reply(200, {"swapped": entry.describe()})
+            elif self.path == "/drain":
+                server.begin_drain()
+                self._reply(200, {"draining": True})
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
-    return ThreadingHTTPServer((host, port), Handler)
+    class _Server(ThreadingHTTPServer):
+        # stdlib default is 5: under fabric-router load (many clients,
+        # one connection per request) a connect burst deeper than that
+        # gets REFUSED at the socket, which the router reads as a dead
+        # host — a healthy replica must absorb the burst in the backlog
+        request_queue_size = 128
+
+    return _Server((host, port), Handler)
+
+
+def install_sigterm_drain(server, httpd) -> None:
+    """SIGTERM → graceful drain: stop admissions immediately (new submits
+    shed with reason ``"draining"``, /healthz flips to "draining" so the
+    router deregisters this host), let in-flight batches complete, then
+    stop the server and shut the HTTP listener down.  Pair with the
+    router's hard-failure path: SIGKILL skips all of this and relies on
+    heartbeat-timeout eviction + retry-to-survivor instead."""
+
+    def _drain(_signum, _frame):
+        def worker():
+            server.begin_drain()
+            server.stop(drain=True)
+            httpd.shutdown()
+
+        threading.Thread(target=worker, name="op-serving-drain",
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain)
 
 
 def serve_forever(server, host: str = "127.0.0.1", port: int = 8080,
-                  background: bool = False):
+                  background: bool = False,
+                  request_timeout_s: float = 30.0):
     """Start serving HTTP; returns the httpd (after start when background)."""
-    httpd = make_http_server(server, host, port)
+    httpd = make_http_server(server, host, port,
+                             request_timeout_s=request_timeout_s)
     if background:
         t = threading.Thread(target=httpd.serve_forever,
                              name="op-serving-http", daemon=True)

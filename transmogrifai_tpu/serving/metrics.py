@@ -74,6 +74,10 @@ class ServingMetrics:
         self.batches = 0
         self.padded_rows = 0
         self.shed = 0
+        #: shed rows broken down by ShedResult reason ("queue_full",
+        #: "draining", "shutting_down", ...) — the router's spill logic
+        #: treats these differently, so the operator view must too
+        self.shed_by_reason: Dict[str, int] = {}
         self.deadline_expired = 0
         self.device_errors = 0
         self.host_fallbacks = 0
@@ -123,9 +127,12 @@ class ServingMetrics:
         with self._lock:
             self._latency.observe(seconds)
 
-    def record_shed(self, n: int = 1) -> None:
+    def record_shed(self, n: int = 1, reason: Optional[str] = None) -> None:
         with self._lock:
             self.shed += n
+            if reason is not None:
+                self.shed_by_reason[reason] = \
+                    self.shed_by_reason.get(reason, 0) + n
 
     def record_deadline_expired(self, n: int = 1) -> None:
         with self._lock:
@@ -193,6 +200,7 @@ class ServingMetrics:
                 "latencyMs": lat_ms,
                 "latencyObservations": self._latency.count,
                 "shed": self.shed,
+                "shedByReason": dict(sorted(self.shed_by_reason.items())),
                 "deadlineExpired": self.deadline_expired,
                 "deviceErrors": self.device_errors,
                 "hostFallbacks": self.host_fallbacks,

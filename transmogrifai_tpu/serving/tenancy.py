@@ -117,6 +117,9 @@ class MultiTenantServer:
         self._work = threading.Condition(self._lock)
         self._closing = False
         self._closed = False
+        #: graceful-drain flag (fabric drain protocol): new submits shed
+        #: with reason "draining" while queued work dispatches normally
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         #: WFQ virtual clock: the vtime of the most recently dispatched
         #: lane; re-activating lanes are clamped up to it (no hoarding)
@@ -165,7 +168,8 @@ class MultiTenantServer:
                 lane.queue.clear()
         for p in pendings:
             lane.server.admission.release(len(p.rows))
-            lane.server.metrics.record_shed(len(p.rows))
+            lane.server.metrics.record_shed(len(p.rows),
+                                            reason=drain_shed_reason)
             p.future.set_result(
                 [ShedResult(reason=drain_shed_reason) for _ in p.rows])
         self.registry.evict(name)
@@ -224,6 +228,16 @@ class MultiTenantServer:
             self._thread.start()
         return self
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting on every lane (new submits shed with reason
+        ``"draining"``); queued pendings still dispatch.  The fabric
+        router reads the flag via ``/healthz`` and deregisters."""
+        self._draining = True
+
     def stop(self, drain: bool = True, timeout_s: float = 10.0) -> None:
         alive = self._thread is not None and self._thread.is_alive()
         with self._work:
@@ -264,15 +278,21 @@ class MultiTenantServer:
         server = lane.server
         span = begin_span("serve.admit", cat="serve", rows=len(rows),
                           tenant=lane.config.name)
+        if self._draining:
+            server.metrics.record_shed(len(rows), reason="draining")
+            fut.set_result([ShedResult(reason="draining")
+                            for _ in rows])
+            end_span(span, outcome="shed:draining")
+            return fut
         if self._closing or self._closed:
-            server.metrics.record_shed(len(rows))
+            server.metrics.record_shed(len(rows), reason="shutting_down")
             fut.set_result([ShedResult(reason="shutting_down")
                             for _ in rows])
             end_span(span, outcome="shed:shutting_down")
             return fut
         shed = server.admission.try_admit(len(rows))
         if shed is not None:
-            server.metrics.record_shed(len(rows))
+            server.metrics.record_shed(len(rows), reason=shed.reason)
             fut.set_result([shed for _ in rows])
             end_span(span, outcome=f"shed:{shed.reason}")
             record_event("serve.shed", rows=len(rows), reason=shed.reason,
@@ -283,7 +303,8 @@ class MultiTenantServer:
         with self._work:
             if self._closing or self._closed:
                 server.admission.release(len(rows))
-                server.metrics.record_shed(len(rows))
+                server.metrics.record_shed(len(rows),
+                                           reason="shutting_down")
                 end_span(span, outcome="shed:shutting_down")
                 fut.set_result([ShedResult(reason="shutting_down")
                                 for _ in rows])

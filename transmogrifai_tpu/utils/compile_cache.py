@@ -212,6 +212,18 @@ class AOTStore:
     ``<key>.json`` (sidecar meta: checksum, backend, jax version, format
     version, output arity — everything a loader needs to validate the
     entry and rebuild the call trees without tracing).
+
+    The store is a FLEET-shared artifact cache, not a per-process one:
+    keys are content digests of (model, bucket, backend, jax version), a
+    write is atomic tmp+fsync+``os.replace``, and ``get`` validates the
+    checksummed sidecar before trusting a payload — so N serving hosts
+    (or a host and its replacement) can safely point at one shared
+    directory (``TMOG_AOT_CACHE_DIR``, e.g. on NFS).  The first host to
+    compile a bucket warms every later cold start: a fresh replica loads
+    the serialized executable byte-identically instead of compiling
+    (bench_serving's shared-cache leg gates ``compiles == 0`` on the
+    second process).  Concurrent writers of the same key race benignly —
+    content addressing makes both payloads identical.
     """
 
     def __init__(self, root: Optional[str] = None):
@@ -305,3 +317,17 @@ class AOTStore:
         except OSError:
             return []
         return sorted(n[:-4] for n in names if n.endswith(".bin"))
+
+    def stats(self) -> Dict[str, Any]:
+        """Fleet-operator view of the shared cache directory: entry count
+        + payload bytes (the answer to "is the shared cache actually
+        warming cold starts, and how big has it grown")."""
+        entries = self.keys()
+        payload_bytes = 0
+        for k in entries:
+            try:
+                payload_bytes += os.path.getsize(self._paths(k)[0])
+            except OSError:
+                pass
+        return {"root": self.root, "entries": len(entries),
+                "payloadBytes": payload_bytes}

@@ -74,6 +74,25 @@ no plan is armed):
                          (with a ``process`` selector) makes ONE host
                          silently skip the rendezvous, the canonical
                          collective-divergence (TM074) test
+  ``host.heartbeat``     before the fabric router probes one host's
+                         /healthz (serving/fabric.ServingFabric.
+                         probe_once); ``tag`` is the host id — a ``skip``
+                         SUPPRESSES the heartbeat (age grows toward
+                         eviction), a ``slow``/``io_error`` delays/fails
+                         the probe; the hysteresis test handle
+  ``router.forward``     before the router forwards a request to a host
+                         (serving/fabric.ServingFabric.score); ``tag``
+                         is the host id — an ``io_error`` here exercises
+                         single-retry failover to a survivor, a ``slow``
+                         burns the deadline budget
+  ``swap.propagate``     after each control-channel exchange delivers
+                         (serving/fabric.ControlChannel.publish);
+                         ``index`` is the channel sequence, ``tag`` the
+                         op ("swap"/"drift") — a ``skip`` (with a
+                         ``process`` selector) drops the message on ONE
+                         replica only: the transport stays lockstep, the
+                         fleet-swap verdict gather detects non-receipt
+                         and repairs or vetoes
 
 Actions: ``io_error`` (raise OSError — the transient class the reader
 retry policy handles), ``raise`` (RuntimeError — non-transient), ``slow``
